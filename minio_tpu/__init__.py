@@ -1,0 +1,21 @@
+"""minio_tpu — a TPU-native, S3-compatible, erasure-coded object storage framework.
+
+A ground-up re-design of the capabilities of MinIO (reference: sytolk/minio,
+see SURVEY.md) for TPU hardware:
+
+- The hot data path — Reed-Solomon GF(2^8) parity generation, any-k
+  reconstruction, and HighwayHash-256 bitrot checksums — runs as batched
+  XLA/Pallas kernels on TPU. GF(2^8) arithmetic is recast as GF(2) bit-matrix
+  multiplication so the MXU (systolic array) does the work
+  (see ``minio_tpu.ops``).
+- Scale-out uses ``jax.sharding.Mesh`` + ``shard_map`` with XLA collectives
+  (psum over the sharded GF(2) contraction) instead of per-drive goroutines
+  (see ``minio_tpu.parallel``).
+- The control plane (quorum metadata, locking, routing, the S3/admin HTTP
+  surface) is host-side Python/C++, mirroring the reference's layer contracts:
+  ObjectLayer (cmd/object-api-interface.go:88), StorageAPI
+  (cmd/storage-interface.go:25) and the Erasure codec surface
+  (cmd/erasure-coding.go:28).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,8 @@
+"""TPU compute kernels: GF(2^8) erasure coding, bitrot hashing.
+
+The reference delegates these to hand-written AVX2/AVX512 assembly
+(klauspost/reedsolomon, minio/highwayhash — SURVEY.md §2.3). Here they are
+batched TPU kernels built on a bit-matrix formulation of GF(2^8) arithmetic.
+"""
+
+from minio_tpu.ops import gf  # noqa: F401

@@ -1,0 +1,108 @@
+"""Batched Reed-Solomon encode/reconstruct as XLA GF(2) matmuls.
+
+The device formulation (see ops/gf.py for the math): lift shard bytes to bits,
+contract against a GF(2) bit-matrix on the MXU, reduce mod 2, repack to bytes.
+
+    data  [B, k, S] u8   --bits-->  [B, S, k*8]
+    out   [B, S, t*8] = data_bits @ W[k*8, t*8]   (integer matmul, exact)
+    out   mod 2, packed --> [B, t, S] u8
+
+One function serves both encode (W = encode_bitmatrix) and reconstruct
+(W = decode_bitmatrix for the observed failure pattern) — exactly the
+symmetry the reference exploits in Erasure.Encode/DecodeDataBlocks
+(cmd/erasure-coding.go:70,89). B batches many 1 MiB blocks per launch
+(the reference's per-block goroutine loop, cmd/erasure-encode.go:80-107,
+becomes a batch dimension).
+
+This file is pure jax.numpy — it runs on CPU (tests, virtual meshes) and TPU.
+rs_pallas.py (planned) will provide the fused-VMEM TPU kernel with the same
+contract.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from minio_tpu.ops import gf
+
+_POW2 = np.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=np.float32)
+
+
+def _bits_from_bytes(x: jax.Array) -> jax.Array:
+    """[B, k, S] u8 -> [B, S, k*8] bit tensor (still uint8 {0,1})."""
+    b, k, s = x.shape
+    bits = (x[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & jnp.uint8(1)  # [B,k,S,8]
+    return bits.transpose(0, 2, 1, 3).reshape(b, s, k * 8)
+
+
+@functools.partial(jax.jit, static_argnames=("out_shards",))
+def _gf2_matmul(x: jax.Array, w: jax.Array, out_shards: int) -> jax.Array:
+    """Core GF(2) contraction: x [B, k, S] u8, w [k*8, t*8] bf16 -> [B, t, S] u8.
+
+    The matmul accumulates <= k*8 ones per output — up to 2048 for the max
+    k=256 — so accumulation must be f32 (exact to 2^24); bf16 inputs are fine
+    (bits are 0/1) but a bf16 or int8 *accumulator* would be wrong for k > 16.
+    Epilogue: mod 2, then pack each group of 8 bit-lanes back to one byte —
+    the pack is itself a tiny matmul against powers of two, so the whole op
+    is MXU + elementwise (no gathers, no scatters: TPU-friendly).
+    """
+    b, _, s = x.shape
+    bits = _bits_from_bytes(x).astype(jnp.bfloat16)             # [B, S, k*8]
+    y = jax.lax.dot_general(
+        bits, w.astype(jnp.bfloat16),
+        (((2,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                            # [B, S, t*8]
+    y = y - 2.0 * jnp.floor(y * 0.5)                             # mod 2, exact in f32
+    y = y.reshape(b, s, out_shards, 8) @ jnp.asarray(_POW2)      # pack bits -> byte value
+    return y.astype(jnp.uint8).transpose(0, 2, 1)                # [B, t, S]
+
+
+@functools.lru_cache(maxsize=256)
+def _device_encode_weights(k: int, m: int) -> jax.Array:
+    """Device-resident bf16 encode weights, uploaded once per geometry."""
+    return jnp.asarray(gf.encode_bitmatrix(k, m), dtype=jnp.bfloat16)
+
+
+@functools.lru_cache(maxsize=4096)
+def _device_decode_weights(
+    k: int, n: int, survivors: tuple[int, ...], targets: tuple[int, ...]
+) -> jax.Array:
+    """Device-resident bf16 decode weights per failure pattern."""
+    return jnp.asarray(gf.decode_bitmatrix(k, n, survivors, targets),
+                       dtype=jnp.bfloat16)
+
+
+def encode(data: jax.Array, k: int, m: int) -> jax.Array:
+    """data [B, k, S] u8 -> parity [B, m, S] u8."""
+    return _gf2_matmul(data, _device_encode_weights(k, m), m)
+
+
+def reconstruct(
+    shards: jax.Array,
+    k: int,
+    n: int,
+    survivors: tuple[int, ...],
+    targets: tuple[int, ...],
+) -> jax.Array:
+    """Reconstruct `targets` from any-k `survivors`.
+
+    shards: [B, n, S] u8 with only the survivor rows meaningful. The decode
+    matrix for the failure pattern is built host-side and cached
+    (gf.decode_bitmatrix) — the reference's ReconstructData does its matrix
+    inversion per call; here patterns are cached because only C(n, <=m)
+    exist (SURVEY.md §7 hard part (d)).
+    """
+    surv = shards[:, list(survivors), :]
+    w = _device_decode_weights(k, n, tuple(survivors), tuple(targets))
+    return _gf2_matmul(surv, w, len(targets))
+
+
+def gf2_matmul_with_weights(x: jax.Array, w: jax.Array, out_shards: int) -> jax.Array:
+    """Expose the raw contraction for callers that manage weights themselves
+    (the sharded heal path feeds per-pattern decode matrices at runtime)."""
+    return _gf2_matmul(x, w, out_shards)

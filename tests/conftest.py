@@ -1,0 +1,19 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Tests never require real TPU hardware; multi-chip sharding is validated on a
+virtual CPU mesh exactly as the driver's dryrun does. Note the environment's
+site hook force-registers the remote-TPU ("axon") backend and overrides the
+JAX_PLATFORMS env var, so we must also override at the jax.config level —
+config wins because backends initialize lazily, after conftest runs.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,85 @@
+"""GF(2^8) field + matrix unit tests (mirrors the codec-layer tier of the
+reference's test strategy, SURVEY.md §4 tier 1; cmd/erasure_test.go)."""
+
+import numpy as np
+import pytest
+
+from minio_tpu.ops import gf
+
+
+def test_field_axioms_sampled():
+    rng = np.random.default_rng(0)
+    a, b, c = (rng.integers(0, 256, 200, dtype=np.uint8) for _ in range(3))
+    # commutativity, associativity, distributivity over XOR
+    assert np.array_equal(gf.gf_mul(a, b), gf.gf_mul(b, a))
+    assert np.array_equal(gf.gf_mul(a, gf.gf_mul(b, c)), gf.gf_mul(gf.gf_mul(a, b), c))
+    assert np.array_equal(gf.gf_mul(a, b ^ c), gf.gf_mul(a, b) ^ gf.gf_mul(a, c))
+
+
+def test_known_products():
+    # Hand-checked products in the 0x11D field.
+    assert int(gf.gf_mul(2, 128)) == 0x1D  # x * x^7 = x^8 = poly remainder
+    assert int(gf.gf_mul(0, 7)) == 0
+    assert int(gf.gf_mul(1, 199)) == 199
+    assert gf.gf_pow(2, 8) == 0x1D
+
+
+def test_inverses():
+    for a in range(1, 256):
+        assert int(gf.gf_mul(a, gf.gf_inv(a))) == 1
+
+
+def test_mat_inv_roundtrip():
+    rng = np.random.default_rng(1)
+    for n in (1, 2, 4, 8):
+        while True:
+            m = rng.integers(0, 256, (n, n), dtype=np.uint8)
+            try:
+                mi = gf.gf_mat_inv(m)
+                break
+            except ValueError:
+                continue
+        assert np.array_equal(gf.gf_matmul(m, mi), np.eye(n, dtype=np.uint8))
+
+
+def test_mat_inv_singular_raises():
+    m = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+    with pytest.raises(ValueError):
+        gf.gf_mat_inv(m)
+
+
+def test_generator_systematic_and_mds():
+    k, m = 4, 3
+    g = gf.rs_generator_matrix(k, k + m)
+    assert np.array_equal(g[:k], np.eye(k, dtype=np.uint8))
+    # MDS: every k-row subset is invertible.
+    import itertools
+
+    for rows in itertools.combinations(range(k + m), k):
+        gf.gf_mat_inv(g[list(rows)])  # must not raise
+
+
+def test_bitmatrix_matches_table_mul():
+    """Multiplying via the 8x8 bit-matrix == table multiply, for all constants."""
+    bm = gf._const_mul_bitmatrices()  # [256, 8(out), 8(in)]
+    rng = np.random.default_rng(2)
+    xs = rng.integers(0, 256, 64, dtype=np.uint8)
+    xbits = ((xs[:, None] >> np.arange(8)) & 1).astype(np.uint8)  # [64, 8]
+    for c in (0, 1, 2, 3, 29, 128, 255):
+        ybits = (xbits @ bm[c].T) % 2
+        y = (ybits * (1 << np.arange(8))).sum(axis=1).astype(np.uint8)
+        assert np.array_equal(y, gf.gf_mul(c, xs)), f"c={c}"
+
+
+def test_encode_ref_then_reconstruct_ref():
+    rng = np.random.default_rng(3)
+    k, m, s = 8, 4, 512
+    data = rng.integers(0, 256, (k, s), dtype=np.uint8)
+    parity = gf.encode_ref(data, m)
+    shards = np.concatenate([data, parity], axis=0)
+    # Lose 2 data + 2 parity shards; reconstruct everything lost.
+    lost = (0, 5, 8, 11)
+    survivors = tuple(i for i in range(k + m) if i not in lost)[:k]
+    rec = gf.reconstruct_ref(shards, k, survivors, lost)
+    for j, idx in enumerate(lost):
+        assert np.array_equal(rec[j], shards[idx]), f"shard {idx}"
