@@ -1,0 +1,72 @@
+"""North-star benchmark: sustained erasure-encode throughput, EC 8+4, 1 MiB blocks.
+
+Mirrors the reference's encode benchmark semantics
+(cmd/erasure-encode_test.go:168 — b.SetBytes(data size) => GiB/s of *input
+data* encoded), at the BASELINE.json config: EC:4 (8 data + 4 parity),
+1 MiB erasure blocks (blockSizeV2, cmd/object-api-common.go:41).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline is the fraction of the 40 GiB/s TPU north-star target
+(BASELINE.md — the reference publishes no absolute numbers; its AVX2
+harnesses are run-to-measure).
+
+Run standalone on the real TPU (no other JAX process may hold the chip).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+K, M = 8, 4
+BLOCK_SIZE = 1 << 20          # 1 MiB erasure block
+SHARD_LEN = BLOCK_SIZE // K   # 131072
+BATCH = 32                    # blocks per launch (32 MiB data per step)
+WARMUP = 3
+ITERS = 20
+NORTH_STAR_GIBS = 40.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from minio_tpu.ops import rs_xla
+
+    dev = jax.devices()[0]
+    # Generate data on-device: the host link is not part of the measured path
+    # (the reference bench reads from prepared memory, not disk).
+    key = jax.random.PRNGKey(0)
+    data = jax.random.randint(
+        key, (BATCH, K, SHARD_LEN), 0, 256, dtype=jnp.int32
+    ).astype(jnp.uint8)
+    data.block_until_ready()
+
+    encode = jax.jit(lambda x: rs_xla.encode(x, K, M))
+
+    for _ in range(WARMUP):
+        encode(data).block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        encode(data).block_until_ready()
+    dt = time.perf_counter() - t0
+
+    data_bytes = BATCH * BLOCK_SIZE * ITERS
+    gibs = data_bytes / dt / (1 << 30)
+
+    print(
+        json.dumps(
+            {
+                "metric": f"erasure_encode_{K}+{M}_1MiB_blocks[{dev.platform}]",
+                "value": round(gibs, 3),
+                "unit": "GiB/s",
+                "vs_baseline": round(gibs / NORTH_STAR_GIBS, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,11 @@
+"""The erasure-coded object layer (reference L2+L3).
+
+ErasureObjects stripes each object across a set of drives as k data + m
+parity shards computed by the TPU codec (ops/rs_xla.py), with streaming
+bitrot framing. The layer contracts mirror the reference:
+Erasure codec surface (cmd/erasure-coding.go:28), erasureObjects
+(cmd/erasure.go:49, cmd/erasure-object.go).
+"""
+
+from minio_tpu.erasure.codec import ErasureCodec  # noqa: F401
+from minio_tpu.erasure.objects import ErasureObjects  # noqa: F401

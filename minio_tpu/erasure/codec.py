@@ -1,0 +1,143 @@
+"""ErasureCodec — geometry + batched device codec for object streams.
+
+Mirrors the Erasure surface (cmd/erasure-coding.go:28-143): shard_size /
+shard_file_size / shard_file_offset math plus Encode/Decode entry points —
+but batched: the streaming loops hand the codec a *batch* of 1 MiB blocks
+per call so the GF(2) matmul launches stay MXU-sized (the reference encodes
+block-at-a-time per goroutine; on TPU batching across blocks is where
+throughput comes from — SURVEY.md §2.4 P2).
+
+Partial-block handling exploits column independence of the GF math: a short
+block is split into ceil(len/k) shards, zero-padded to the full shard width,
+batch-encoded with the full blocks, and the parity is simply truncated back
+— parity columns never mix, so padding is free.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from minio_tpu.ops import rs_xla
+from minio_tpu.utils.shardmath import ceil_div as _ceil_div
+from minio_tpu.utils import shardmath
+
+DEFAULT_BLOCK_SIZE = 1 << 20  # reference blockSizeV2, cmd/object-api-common.go:41
+
+
+class ErasureCodec:
+    def __init__(self, data_blocks: int, parity_blocks: int,
+                 block_size: int = DEFAULT_BLOCK_SIZE):
+        if data_blocks <= 0 or parity_blocks < 0:
+            raise ValueError(f"bad geometry k={data_blocks} m={parity_blocks}")
+        if data_blocks + parity_blocks > 256:
+            raise ValueError("k+m exceeds GF(2^8) limit of 256")
+        self.k = data_blocks
+        self.m = parity_blocks
+        self.block_size = block_size
+
+    # --- geometry (cmd/erasure-coding.go:115-143) ---
+
+    def shard_size(self) -> int:
+        return shardmath.shard_size(self.block_size, self.k)
+
+    def shard_file_size(self, total_length: int) -> int:
+        return shardmath.shard_file_size(total_length, self.block_size, self.k)
+
+    def shard_file_offset(self, start: int, length: int, total_length: int) -> int:
+        return shardmath.shard_file_offset(start, length, total_length,
+                                           self.block_size, self.k)
+
+    # --- batched encode ---
+
+    def encode_blocks(self, blocks: list[bytes]) -> list[list[bytes]]:
+        """Encode a batch of erasure blocks.
+
+        Returns, per block, the n = k+m shard chunks (data first, then
+        parity), each ceil(len(block)/k) bytes.
+        """
+        if not blocks:
+            return []
+        s_full = self.shard_size()
+        batch = np.zeros((len(blocks), self.k, s_full), dtype=np.uint8)
+        chunk_lens = []
+        for bi, block in enumerate(blocks):
+            if not 0 < len(block) <= self.block_size:
+                raise ValueError(f"block {bi} size {len(block)}")
+            s = _ceil_div(len(block), self.k)
+            chunk_lens.append(s)
+            flat = np.frombuffer(block, dtype=np.uint8)
+            padded = np.zeros(self.k * s, dtype=np.uint8)
+            padded[: flat.size] = flat
+            batch[bi, :, :s] = padded.reshape(self.k, s)
+        if self.m:
+            parity = np.asarray(rs_xla.encode(batch, self.k, self.m))
+        out = []
+        for bi, s in enumerate(chunk_lens):
+            chunks = [batch[bi, i, :s].tobytes() for i in range(self.k)]
+            if self.m:
+                chunks += [parity[bi, j, :s].tobytes() for j in range(self.m)]
+            out.append(chunks)
+        return out
+
+    # --- batched decode / reconstruct ---
+
+    def decode_blocks(
+        self,
+        shard_chunks: list[list[bytes | None]],
+        block_lens: list[int],
+        need_all: bool = False,
+    ) -> list[list[bytes]]:
+        """Rebuild data (and optionally parity) chunks for a batch of blocks.
+
+        shard_chunks[b][i] is shard i's chunk for block b, or None if that
+        drive is unavailable — the any-k semantics of the reference's
+        DecodeDataBlocks/Reconstruct (cmd/erasure-coding.go:89-113). All
+        blocks in one call must share a single failure pattern (the caller
+        groups by pattern; patterns are per-GET stable since drive health
+        doesn't flip per block).
+
+        Returns per block the k data chunks (need_all=False) or all n chunks.
+        """
+        n = self.k + self.m
+        if not shard_chunks:
+            return []
+        present = [shard_chunks[0][i] is not None for i in range(n)]
+        for row in shard_chunks:
+            if [c is not None for c in row] != present:
+                raise ValueError("all blocks in a batch must share a failure pattern")
+        if sum(present) < self.k:
+            from minio_tpu.utils import errors as se
+            raise se.InsufficientReadQuorum(
+                "", "", f"only {sum(present)} of required {self.k} shards available"
+            )
+        want = range(n) if need_all else range(self.k)
+        targets = [i for i in want if not present[i]]
+
+        chunk_lens = [_ceil_div(bl, self.k) for bl in block_lens]
+        if not targets:
+            return [
+                [row[i] for i in want]  # type: ignore[misc]
+                for row in shard_chunks
+            ]
+
+        survivors = tuple([i for i in range(n) if present[i]][: self.k])
+        s_full = self.shard_size()
+        # Rows are already compacted to the k survivors, so feed the raw
+        # GF(2) contraction with the per-pattern decode weights directly.
+        batch = np.zeros((len(shard_chunks), self.k, s_full), dtype=np.uint8)
+        for bi, row in enumerate(shard_chunks):
+            for si, shard_idx in enumerate(survivors):
+                c = row[shard_idx]
+                batch[bi, si, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+        w = rs_xla._device_decode_weights(self.k, n, survivors, tuple(targets))
+        rebuilt = np.asarray(
+            rs_xla.gf2_matmul_with_weights(batch, w, len(targets))
+        )
+        out = []
+        for bi, row in enumerate(shard_chunks):
+            s = chunk_lens[bi]
+            fixed = list(row)
+            for ti, shard_idx in enumerate(targets):
+                fixed[shard_idx] = rebuilt[bi, ti, :s].tobytes()
+            out.append([fixed[i] for i in want])
+        return out

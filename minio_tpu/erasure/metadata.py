@@ -1,0 +1,89 @@
+"""Erasure metadata helpers: deterministic drive ordering + quorum election.
+
+Reference: hashOrder (cmd/erasure-metadata-utils.go:100), readAllFileInfo
+(:118), pickValidFileInfo / findFileInfoInQuorum (cmd/erasure-metadata.go),
+listOnlineDisks modtime election (cmd/erasure-healing-common.go:103).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import Counter
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Sequence
+
+from minio_tpu.storage.fileinfo import FileInfo
+from minio_tpu.utils import errors as se
+
+
+def hash_order(key: str, card: int) -> list[int]:
+    """Deterministic 1-based drive ordering for an object key: a rotation of
+    [1..card] starting at a key-derived index. Same role as the reference's
+    crc-based hashOrder (cmd/erasure-metadata-utils.go:100) — it fixes which
+    drive holds shard 1, 2, ... so readers and writers agree without
+    coordination. We key it with blake2b for better dispersion."""
+    if card <= 0:
+        return []
+    seed = int.from_bytes(
+        hashlib.blake2b(key.encode(), digest_size=8).digest(), "big"
+    )
+    start = seed % card
+    return [(start + i) % card + 1 for i in range(card)]
+
+
+def shuffle_by_distribution(items: Sequence, distribution: Sequence[int]) -> list:
+    """Arrange items so result[shard_index-1] = the drive that holds that
+    shard: distribution[i] is the 1-based shard index of physical drive i
+    (cmd/erasure-metadata-utils.go:148-210)."""
+    out = [None] * len(items)
+    for physical, shard_idx in enumerate(distribution):
+        out[shard_idx - 1] = items[physical]
+    return out
+
+
+def parallel_map(fns: Sequence[Callable], max_workers: int | None = None) -> list:
+    """Run per-drive closures concurrently, capturing exceptions as values
+    (the reference's errgroup-with-indexed-errors pattern, pkg/sync)."""
+    results: list = [None] * len(fns)
+
+    def run(i):
+        try:
+            results[i] = fns[i]()
+        except Exception as e:  # noqa: BLE001 - per-drive errors are data
+            results[i] = e
+
+    with ThreadPoolExecutor(max_workers=max_workers or max(4, len(fns))) as ex:
+        list(ex.map(run, range(len(fns))))
+    return results
+
+
+def find_fileinfo_in_quorum(fis: Sequence[object], quorum: int,
+                            bucket: str, obj: str) -> FileInfo:
+    """Elect the authoritative FileInfo: at least `quorum` drives must agree
+    on (mod_time, data_dir, version). Reference findFileInfoInQuorum
+    (cmd/erasure-metadata.go:124-155)."""
+    def sig(fi: FileInfo):
+        return (round(fi.mod_time, 6), fi.data_dir, fi.version_id, fi.deleted)
+
+    counter = Counter(sig(fi) for fi in fis if isinstance(fi, FileInfo))
+    if counter:
+        best, count = counter.most_common(1)[0]
+        if count >= quorum:
+            for fi in fis:
+                if isinstance(fi, FileInfo) and sig(fi) == best:
+                    return fi
+    err, count = _dominant_error(fis)
+    if err is not None and count >= quorum:
+        raise err
+    raise se.InsufficientReadQuorum(bucket, obj, f"metadata quorum {quorum} not met")
+
+
+def _dominant_error(results: Sequence[object]):
+    errs = [r for r in results if isinstance(r, Exception)]
+    if not errs:
+        return None, 0
+    name, count = Counter(type(e).__name__ for e in errs).most_common(1)[0]
+    for e in errs:
+        if type(e).__name__ == name:
+            return e, count
+    return None, 0

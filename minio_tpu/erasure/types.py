@@ -1,0 +1,103 @@
+"""Object-layer data types (reference cmd/object-api-datatypes.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class BucketInfo:
+    name: str
+    created: float
+
+
+@dataclass
+class ObjectInfo:
+    bucket: str
+    name: str
+    mod_time: float = 0.0
+    size: int = 0
+    etag: str = ""
+    version_id: str = ""
+    is_latest: bool = True
+    delete_marker: bool = False
+    content_type: str = ""
+    user_defined: dict[str, str] = field(default_factory=dict)
+    parity_blocks: int = 0
+    data_blocks: int = 0
+    num_versions: int = 0
+    is_dir: bool = False
+    actual_size: int | None = None
+
+    @property
+    def storage_class(self) -> str:
+        return self.user_defined.get("x-amz-storage-class", "STANDARD")
+
+
+@dataclass
+class ObjectOptions:
+    """Per-call options (reference cmd/object-api-interface.go:44-63)."""
+
+    version_id: str = ""
+    versioned: bool = False
+    version_suspended: bool = False
+    user_defined: dict[str, str] = field(default_factory=dict)
+    mod_time: float = 0.0
+    part_number: int = 0
+    delete_prefix: bool = False
+    no_lock: bool = False
+
+
+@dataclass
+class ListObjectsInfo:
+    is_truncated: bool = False
+    next_marker: str = ""
+    objects: list[ObjectInfo] = field(default_factory=list)
+    prefixes: list[str] = field(default_factory=list)
+
+
+@dataclass
+class ListObjectVersionsInfo:
+    is_truncated: bool = False
+    next_marker: str = ""
+    next_version_id_marker: str = ""
+    objects: list[ObjectInfo] = field(default_factory=list)
+    prefixes: list[str] = field(default_factory=list)
+
+
+@dataclass
+class ObjectToDelete:
+    object_name: str
+    version_id: str = ""
+
+
+@dataclass
+class DeletedObject:
+    object_name: str = ""
+    version_id: str = ""
+    delete_marker: bool = False
+    delete_marker_version_id: str = ""
+
+
+@dataclass
+class MultipartInfo:
+    bucket: str
+    object: str
+    upload_id: str
+    initiated: float = 0.0
+    user_defined: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class CompletePart:
+    part_number: int
+    etag: str
+
+
+@dataclass
+class PartInfoResult:
+    part_number: int
+    etag: str
+    size: int
+    actual_size: int
+    last_modified: float = 0.0

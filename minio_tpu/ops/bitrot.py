@@ -1,0 +1,174 @@
+"""Bitrot protection: checksum algorithms + the streaming shard-file format.
+
+Format (role-equivalent of the reference's streaming bitrot files,
+cmd/bitrot-streaming.go:46-74): a shard file is a sequence of
+[digest][chunk] records, one per shard_size chunk — each chunk's digest sits
+immediately before the chunk, so reads verify incrementally without a
+second pass and writes hash each chunk while it is still hot.
+
+Algorithms (registry analogous to cmd/bitrot.go:31-41):
+  blake2b256  - keyed BLAKE2b-256 (hashlib, C speed)       [default, host]
+  sha256      - SHA-256 (hashlib)
+  xxh64       - xxHash64 (xxhash, non-cryptographic, fastest host option)
+  mxhash256   - keyed GF(2) matmul tree hash on the TPU MXU, fused with the
+                erasure kernel (ops/mxhash.py). Registered lazily.
+
+The framework's fixed bitrot key plays the role of the reference's
+magicHighwayHash256Key (cmd/bitrot.go:31): bitrot is integrity against
+random corruption, not an authenticated-crypto boundary, so a fixed public
+key is fine — it only has to be stable across the cluster.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import BinaryIO, Callable
+
+from minio_tpu.utils import errors as se
+
+try:
+    import xxhash
+
+    _HAVE_XXHASH = True
+except ImportError:  # pragma: no cover - baked into this image
+    _HAVE_XXHASH = False
+
+# Fixed 256-bit bitrot key (same role as the reference's magic HH key).
+BITROT_KEY = bytes.fromhex(
+    "6d696e696f5f7470755f626974726f74"  # "minio_tpu_bitrot"
+    "5f6b65795f76315f3230323630373239"  # "_key_v1_20260729"
+)
+
+DEFAULT_ALGORITHM = "blake2b256"
+
+
+class _Blake2b256:
+    digest_len = 32
+
+    @staticmethod
+    def digest(data: bytes) -> bytes:
+        return hashlib.blake2b(data, digest_size=32, key=BITROT_KEY).digest()
+
+
+class _Sha256:
+    digest_len = 32
+
+    @staticmethod
+    def digest(data: bytes) -> bytes:
+        return hashlib.sha256(data).digest()
+
+
+class _Xxh64:
+    digest_len = 8
+
+    @staticmethod
+    def digest(data: bytes) -> bytes:
+        return xxhash.xxh64(data, seed=0x6D74_7075).digest()
+
+
+_REGISTRY: dict[str, object] = {
+    "blake2b256": _Blake2b256,
+    "sha256": _Sha256,
+}
+if _HAVE_XXHASH:
+    _REGISTRY["xxh64"] = _Xxh64
+
+
+def register_algorithm(name: str, algo: object) -> None:
+    """Register an algorithm object exposing digest_len and digest(bytes)."""
+    _REGISTRY[name] = algo
+
+
+def get_algorithm(name: str):
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise se.CorruptedFormat(f"unknown bitrot algorithm {name!r}") from None
+
+
+def digest_len(algorithm: str) -> int:
+    return get_algorithm(algorithm).digest_len
+
+
+def bitrot_shard_file_size(data_size: int, shard_size: int, algorithm: str) -> int:
+    """On-disk size of a shard file holding data_size shard bytes
+    (cmd/bitrot.go:140-145)."""
+    if data_size == 0:
+        return 0
+    n_chunks = -(-data_size // shard_size)
+    return data_size + n_chunks * digest_len(algorithm)
+
+
+class BitrotWriter:
+    """Writes [digest][chunk] records. Chunks must arrive in shard_size units
+    (the last may be short) — exactly how the erasure encoder emits them."""
+
+    def __init__(self, out: BinaryIO, shard_size: int, algorithm: str = DEFAULT_ALGORITHM):
+        self.out = out
+        self.shard_size = shard_size
+        self.algo = get_algorithm(algorithm)
+        self.algorithm = algorithm
+        self._written = 0
+
+    def write(self, chunk: bytes) -> None:
+        if len(chunk) > self.shard_size:
+            raise ValueError(f"chunk {len(chunk)} > shard_size {self.shard_size}")
+        self.out.write(self.algo.digest(chunk))
+        self.out.write(chunk)
+        self._written += len(chunk)
+
+    @property
+    def bytes_written(self) -> int:
+        return self._written
+
+
+class BitrotReader:
+    """Verifying reader over a [digest][chunk] shard file.
+
+    read_at(offset, length) addresses *logical* shard bytes; the reader maps
+    to physical records, verifies every touched chunk, and raises FileCorrupt
+    on digest mismatch (reference returns errFileCorrupt,
+    cmd/bitrot-streaming.go:139-158)."""
+
+    def __init__(self, src: BinaryIO, data_size: int, shard_size: int,
+                 algorithm: str = DEFAULT_ALGORITHM):
+        self.src = src
+        self.data_size = data_size
+        self.shard_size = shard_size
+        self.algo = get_algorithm(algorithm)
+
+    def read_at(self, offset: int, length: int) -> bytes:
+        if offset < 0 or length < 0 or offset + length > self.data_size:
+            raise se.FileCorrupt(
+                f"read [{offset}, {offset + length}) outside shard of {self.data_size}"
+            )
+        if length == 0:
+            return b""
+        dl = self.algo.digest_len
+        first = offset // self.shard_size
+        last = (offset + length - 1) // self.shard_size
+        out = bytearray()
+        for ci in range(first, last + 1):
+            rec_off = ci * (dl + self.shard_size)
+            self.src.seek(rec_off)
+            want = self.src.read(dl)
+            chunk_len = min(self.shard_size, self.data_size - ci * self.shard_size)
+            chunk = self.src.read(chunk_len)
+            if len(want) != dl or len(chunk) != chunk_len:
+                raise se.FileCorrupt(f"short read at chunk {ci}")
+            if self.algo.digest(chunk) != want:
+                raise se.FileCorrupt(f"bitrot digest mismatch at chunk {ci}")
+            out += chunk
+        rel = offset - first * self.shard_size
+        return bytes(out[rel:rel + length])
+
+
+def verify_shard_file(src: BinaryIO, data_size: int, shard_size: int,
+                      algorithm: str = DEFAULT_ALGORITHM) -> None:
+    """Whole-file deep verify (reference VerifyFile, cmd/xl-storage.go:2179)."""
+    reader = BitrotReader(src, data_size, shard_size, algorithm)
+    off = 0
+    while off < data_size:
+        n = min(shard_size, data_size - off)
+        reader.read_at(off, n)
+        off += n

@@ -1,0 +1,18 @@
+"""Scale-out: device meshes, sharded codec steps, collectives.
+
+The reference scales by fanning goroutines out over drives and nodes
+(SURVEY.md §2.4). Here every parallelism axis is a mesh dimension:
+
+  dp   - batch of independent erasure blocks (the reference's per-request /
+         per-part concurrency, P7-P9)
+  tp   - the GF(2) contraction over data shards: each device holds a slice
+         of the k input shards and psum-reduces partial parity
+         (the reference's per-drive shard fan-out, P1)
+  sp   - byte positions within a shard ("sequence" dim; blockwise streaming,
+         §5.7) - embarrassingly parallel
+
+Collectives ride ICI via XLA (psum / all_gather), replacing the reference's
+storage-REST data plane for intra-pod shard movement (SURVEY.md §5.8).
+"""
+
+from minio_tpu.parallel.sharded import make_mesh, sharded_encode, sharded_reconstruct  # noqa: F401

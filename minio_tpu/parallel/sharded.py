@@ -1,0 +1,150 @@
+"""Mesh-sharded erasure codec: the multi-chip data path.
+
+The reference's distributed story is one goroutine per drive plus HTTP for
+remote drives (cmd/erasure-encode.go:36, cmd/storage-rest-client.go). The
+TPU-native story: shard the codec math itself over a device mesh and let XLA
+insert collectives —
+
+  encode:  data [B, k, S] sharded (dp, tp, sp). Each device computes a
+           partial GF(2) matmul over its local slice of the k*8 bit
+           contraction; an integer psum over 'tp' completes the XOR
+           (mod 2 is deferred until after the reduction, which is what makes
+           XOR expressible as psum). Parity comes out sharded (dp, -, sp).
+
+  heal:    whole-set reconstruction is the same contraction with a decode
+           matrix — a "psum-sharded batched solve" (BASELINE.json north
+           star; reference: cmd/erasure-healing.go:401-461 per-part loop).
+
+This file is the dryrun_multichip surface: it must compile and run on a
+virtual CPU mesh of any size as well as a real TPU slice.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from minio_tpu.ops import gf
+
+_POW2F = np.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=np.float32)
+
+
+def make_mesh(n_devices: int | None = None, *, devices=None) -> Mesh:
+    """Build a (dp, tp, sp) mesh over the available devices.
+
+    tp (shard-contraction) gets the largest power-of-two factor <= min(4, n)
+    so the GF contraction actually exercises psum; remaining devices split
+    between dp and sp.
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    n = len(devices)
+    tp = 1
+    while tp * 2 <= min(4, n) and n % (tp * 2) == 0:
+        tp *= 2
+    rest = n // tp
+    dp = 1
+    while dp * 2 <= rest and rest % (dp * 2) == 0 and dp < rest // dp:
+        dp *= 2
+    sp = rest // dp
+    mesh_devices = np.asarray(devices).reshape(dp, tp, sp)
+    return Mesh(mesh_devices, axis_names=("dp", "tp", "sp"))
+
+
+def _local_gf2_partial(x_local: jax.Array, w_local: jax.Array) -> jax.Array:
+    """Per-device partial contraction: [b, k_loc, s] u8 x [k_loc*8, t8] bf16
+    -> [b, s, t8] f32 partial bit-counts (mod 2 NOT yet applied)."""
+    b, k_loc, s = x_local.shape
+    bits = (x_local[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & jnp.uint8(1)
+    bits = bits.transpose(0, 2, 1, 3).reshape(b, s, k_loc * 8).astype(jnp.bfloat16)
+    return jax.lax.dot_general(
+        bits, w_local, (((2,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _finish(y: jax.Array, t: int) -> jax.Array:
+    """mod-2 + bit-pack epilogue: [b, s, t*8] f32 -> [b, t, s] u8."""
+    b, s, _ = y.shape
+    y = y - 2.0 * jnp.floor(y * 0.5)
+    y = y.reshape(b, s, t, 8) @ jnp.asarray(_POW2F)
+    return y.astype(jnp.uint8).transpose(0, 2, 1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "out_shards", "mesh")
+)
+def _sharded_gf2_matmul(data, w, *, k: int, out_shards: int, mesh: Mesh):
+    """data [B, k, S] u8, w [k*8, t*8] bf16 -> [B, t, S] u8, over the mesh.
+
+    Sharding: B over dp, the k shard rows over tp (the contraction axis —
+    completed by an integer psum), S over sp. Output parity is replicated
+    over tp, matching how every drive-writer needs every parity shard.
+    """
+    t = out_shards
+
+    def step(x_local, w_local):
+        partial = _local_gf2_partial(x_local, w_local)
+        total = jax.lax.psum(partial, "tp")
+        return _finish(total, t)
+
+    return jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P("dp", "tp", "sp"), P("tp", None)),
+        out_specs=P("dp", None, "sp"),
+    )(data, w)
+
+
+def sharded_encode(mesh: Mesh, data: jax.Array, k: int, m: int) -> jax.Array:
+    """Encode a batch of blocks over the mesh: [B, k, S] -> [B, m, S].
+
+    Requires k divisible by the tp axis size and S by sp (callers pad; the
+    object layer always has power-of-two friendly shapes: k in {2,4,8,16},
+    S = blockSize/k with blockSize 1 MiB — cmd/object-api-common.go:41).
+    """
+    _check_divisibility(mesh, data.shape, k)
+    w = jnp.asarray(gf.encode_bitmatrix(k, m), dtype=jnp.bfloat16)
+    return _sharded_gf2_matmul(data, w, k=k, out_shards=m, mesh=mesh)
+
+
+def sharded_reconstruct(
+    mesh: Mesh,
+    survivors_data: jax.Array,
+    k: int,
+    n: int,
+    survivors: tuple[int, ...],
+    targets: tuple[int, ...],
+) -> jax.Array:
+    """Whole-set heal solve: [B, k, S] survivor shards -> [B, t, S] rebuilt.
+
+    The batched-psum heal path: B spans every (object, part, block) needing
+    reconstruction in a set, so a whole-drive heal is a few big launches
+    instead of the reference's per-object Decode->Encode pipe
+    (cmd/erasure-lowlevel-heal.go:28).
+    """
+    _check_divisibility(mesh, survivors_data.shape, k)
+    w = jnp.asarray(
+        gf.decode_bitmatrix(k, n, tuple(survivors), tuple(targets)),
+        dtype=jnp.bfloat16,
+    )
+    return _sharded_gf2_matmul(
+        survivors_data, w, k=k, out_shards=len(targets), mesh=mesh
+    )
+
+
+def _check_divisibility(mesh: Mesh, shape, k: int) -> None:
+    b, kk, s = shape
+    if kk != k:
+        raise ValueError(f"shape {shape} does not match k={k}")
+    dp, tp, sp = (mesh.shape[a] for a in ("dp", "tp", "sp"))
+    if b % dp or k % tp or s % sp:
+        raise ValueError(
+            f"[B={b}, k={k}, S={s}] not divisible by mesh (dp={dp}, tp={tp}, sp={sp})"
+        )

@@ -1,0 +1,12 @@
+"""Per-drive storage layer (reference L1, cmd/storage-interface.go:25).
+
+A drive stores erasure shards plus a per-object versioned metadata journal
+(meta.mp, the analogue of xl.meta v2 — cmd/xl-storage-format-v2.go). Local
+drives are POSIX dirs; remote drives are reached through the storage RPC
+client with the same interface, which is what makes distribution transparent
+to the erasure layer (SURVEY.md §1 L1).
+"""
+
+from minio_tpu.storage.api import StorageAPI  # noqa: F401
+from minio_tpu.storage.fileinfo import ChecksumInfo, ErasureInfo, FileInfo, PartInfo  # noqa: F401
+from minio_tpu.storage.local import LocalDrive  # noqa: F401

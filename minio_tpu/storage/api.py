@@ -1,0 +1,174 @@
+"""StorageAPI — the per-drive contract (reference cmd/storage-interface.go:25-81).
+
+Everything above L1 (the erasure codec, object layer, healing, listing)
+talks to drives exclusively through this interface; local drives implement
+it directly (storage/local.py) and remote drives over the storage RPC
+(distributed plane), which is what makes multi-node transparent to the
+erasure layer.
+
+Paths and volumes are always '/'-separated logical names; implementations
+map them to their physical layout. Metadata ops trade in FileInfo
+(storage/fileinfo.py); file ops trade in byte chunks sized by the caller
+(the erasure layer uses bitrot-framed shard chunks).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import BinaryIO, Iterable, Iterator
+
+from minio_tpu.storage.fileinfo import FileInfo
+
+
+@dataclass
+class VolInfo:
+    name: str
+    created: float
+
+
+@dataclass
+class DiskInfo:
+    """Identity + health of one drive (reference DiskInfo,
+    cmd/storage-interface.go:36-41)."""
+
+    total: int = 0
+    free: int = 0
+    used: int = 0
+    used_inodes: int = 0
+    endpoint: str = ""
+    mount_path: str = ""
+    id: str = ""
+    healing: bool = False
+    error: str = ""
+    metrics: dict = field(default_factory=dict)
+
+
+@dataclass
+class WalkEntry:
+    """One entry from walk_dir: an object (with raw journal bytes) or a
+    directory prefix (name ends with '/')."""
+
+    name: str
+    meta: bytes = b""
+
+    @property
+    def is_dir(self) -> bool:
+        return self.name.endswith("/")
+
+
+class StorageAPI(abc.ABC):
+    """One drive. All methods raise minio_tpu.utils.errors.StorageError
+    subclasses on failure."""
+
+    # --- identity / health ---
+
+    @abc.abstractmethod
+    def disk_info(self) -> DiskInfo: ...
+
+    @abc.abstractmethod
+    def get_disk_id(self) -> str: ...
+
+    @abc.abstractmethod
+    def set_disk_id(self, disk_id: str) -> None:
+        """Expected-identity check wrapper state (reference
+        cmd/xl-storage-disk-id-check.go)."""
+
+    def is_online(self) -> bool:
+        return True
+
+    def is_local(self) -> bool:
+        return True
+
+    def endpoint(self) -> str:
+        return ""
+
+    def close(self) -> None:
+        pass
+
+    # --- volumes ---
+
+    @abc.abstractmethod
+    def make_vol(self, volume: str) -> None: ...
+
+    @abc.abstractmethod
+    def list_vols(self) -> list[VolInfo]: ...
+
+    @abc.abstractmethod
+    def stat_vol(self, volume: str) -> VolInfo: ...
+
+    @abc.abstractmethod
+    def delete_vol(self, volume: str, force: bool = False) -> None: ...
+
+    # --- plain files (config, formats, tmp) ---
+
+    @abc.abstractmethod
+    def write_all(self, volume: str, path: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def read_all(self, volume: str, path: str) -> bytes: ...
+
+    @abc.abstractmethod
+    def delete(self, volume: str, path: str, recursive: bool = False) -> None: ...
+
+    @abc.abstractmethod
+    def list_dir(self, volume: str, dir_path: str, count: int = -1) -> list[str]: ...
+
+    # --- shard files (streaming, bitrot-framed by the caller) ---
+
+    @abc.abstractmethod
+    def create_file(self, volume: str, path: str, chunks: Iterable[bytes]) -> int:
+        """Stream chunks into a new file (fsync'd); returns bytes written
+        (reference CreateFile, cmd/xl-storage.go:1430)."""
+
+    @abc.abstractmethod
+    def append_file(self, volume: str, path: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def read_file_stream(self, volume: str, path: str) -> BinaryIO:
+        """Open a shard file for seekable reads (reference ReadFileStream,
+        cmd/xl-storage.go:1318)."""
+
+    @abc.abstractmethod
+    def rename_file(self, src_volume: str, src_path: str,
+                    dst_volume: str, dst_path: str) -> None: ...
+
+    # --- versioned object metadata (the journal) ---
+
+    @abc.abstractmethod
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        """Add fi as a version in the object's journal
+        (reference WriteMetadata, cmd/xl-storage.go:897)."""
+
+    @abc.abstractmethod
+    def read_version(self, volume: str, path: str, version_id: str = "",
+                     read_data: bool = False) -> FileInfo: ...
+
+    @abc.abstractmethod
+    def read_xl(self, volume: str, path: str) -> bytes:
+        """Raw journal bytes (for listing merge + healing comparison)."""
+
+    @abc.abstractmethod
+    def delete_version(self, volume: str, path: str, fi: FileInfo) -> None:
+        """Remove a version (or write a delete marker if fi.deleted); prunes
+        the object dir when the journal empties (reference DeleteVersion,
+        cmd/xl-storage.go)."""
+
+    @abc.abstractmethod
+    def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
+                    dst_volume: str, dst_path: str) -> None:
+        """Commit: move fi.data_dir from the tmp area into the object dir and
+        append fi to the journal, atomically per-drive (reference RenameData,
+        cmd/xl-storage.go:1780)."""
+
+    # --- verification / listing ---
+
+    @abc.abstractmethod
+    def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
+        """Deep bitrot verify of every part this drive holds (reference
+        VerifyFile, cmd/xl-storage.go:2179)."""
+
+    @abc.abstractmethod
+    def walk_dir(self, volume: str, prefix: str = "") -> Iterator[WalkEntry]:
+        """Stream sorted entries under prefix with raw journal bytes
+        (reference WalkDir, cmd/metacache-walk.go)."""

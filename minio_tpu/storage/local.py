@@ -1,0 +1,471 @@
+"""LocalDrive — POSIX implementation of StorageAPI.
+
+Layout under the drive root (role-equivalent of xl-storage,
+cmd/xl-storage.go:90, with our own format):
+
+    <root>/.mtpu.sys/format.json      drive identity (format v1)
+    <root>/.mtpu.sys/tmp/<uuid>/      staging area for in-flight writes
+    <root>/<volume>/<object-key>/meta.mp          version journal
+    <root>/<volume>/<object-key>/<data-dir>/part.N  bitrot-framed shards
+
+Commit protocol: shards stream into the tmp area, then rename_data moves the
+data dir into the object dir and rewrites the journal — rename is the atomic
+commit point per drive, exactly the reference's tmp->rename discipline
+(cmd/xl-storage.go:1780). fsync on data files and parent dirs at commit.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import shutil
+import time
+import uuid
+from typing import BinaryIO, Iterable, Iterator
+
+from minio_tpu.ops import bitrot
+from minio_tpu.storage.api import DiskInfo, StorageAPI, VolInfo, WalkEntry
+from minio_tpu.storage.fileinfo import FileInfo
+from minio_tpu.storage.xlmeta import XLMeta
+from minio_tpu.utils import errors as se
+
+SYS_VOL = ".mtpu.sys"
+META_FILE = "meta.mp"
+FORMAT_FILE = "format.json"
+FORMAT_VERSION = 1
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class LocalDrive(StorageAPI):
+    def __init__(self, root: str, endpoint: str = ""):
+        self.root = os.path.abspath(root)
+        self._endpoint = endpoint or self.root
+        self._expected_id = ""
+        try:
+            os.makedirs(os.path.join(self.root, SYS_VOL, "tmp"), exist_ok=True)
+        except OSError as e:
+            raise se.DiskAccessDenied(str(e)) from e
+
+    # ---------- identity ----------
+
+    def _format_path(self) -> str:
+        return os.path.join(self.root, SYS_VOL, FORMAT_FILE)
+
+    def read_format(self) -> dict:
+        try:
+            with open(self._format_path(), "rb") as f:
+                return json.load(f)
+        except FileNotFoundError:
+            raise se.UnformattedDisk(self.root) from None
+        except (OSError, ValueError) as e:
+            raise se.CorruptedFormat(str(e)) from e
+
+    def write_format(self, fmt: dict) -> None:
+        tmp = self._format_path() + f".tmp.{uuid.uuid4().hex}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(fmt, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._format_path())
+        _fsync_dir(os.path.dirname(self._format_path()))
+
+    def disk_info(self) -> DiskInfo:
+        st = os.statvfs(self.root)
+        return DiskInfo(
+            total=st.f_blocks * st.f_frsize,
+            free=st.f_bavail * st.f_frsize,
+            used=(st.f_blocks - st.f_bfree) * st.f_frsize,
+            used_inodes=st.f_files - st.f_ffree,
+            endpoint=self._endpoint,
+            mount_path=self.root,
+            id=self._safe_disk_id(),
+        )
+
+    def _safe_disk_id(self) -> str:
+        try:
+            return self.get_disk_id()
+        except se.StorageError:
+            return ""
+
+    def get_disk_id(self) -> str:
+        fmt = self.read_format()
+        this = fmt.get("this", "")
+        if self._expected_id and this != self._expected_id:
+            raise se.InconsistentDisk(
+                f"drive {self.root}: id {this!r} != expected {self._expected_id!r}"
+            )
+        return this
+
+    def set_disk_id(self, disk_id: str) -> None:
+        self._expected_id = disk_id
+
+    def endpoint(self) -> str:
+        return self._endpoint
+
+    # ---------- path mapping ----------
+
+    def _vol_dir(self, volume: str) -> str:
+        if not volume or volume.startswith("/") or ".." in volume.split("/"):
+            raise se.VolumeNotFound(volume)
+        return os.path.join(self.root, volume)
+
+    def _file_path(self, volume: str, path: str) -> str:
+        parts = [p for p in path.split("/") if p not in ("", ".")]
+        if any(p == ".." for p in parts):
+            raise se.FileAccessDenied(path)
+        return os.path.join(self._vol_dir(volume), *parts)
+
+    # ---------- volumes ----------
+
+    def make_vol(self, volume: str) -> None:
+        d = self._vol_dir(volume)
+        try:
+            os.makedirs(d, exist_ok=False)
+        except FileExistsError:
+            raise se.VolumeExists(volume) from None
+        except OSError as e:
+            raise se.FaultyDisk(str(e)) from e
+
+    def list_vols(self) -> list[VolInfo]:
+        out = []
+        try:
+            with os.scandir(self.root) as it:
+                for entry in it:
+                    if entry.is_dir() and entry.name != SYS_VOL:
+                        out.append(VolInfo(entry.name, entry.stat().st_ctime))
+        except OSError as e:
+            raise se.FaultyDisk(str(e)) from e
+        return sorted(out, key=lambda v: v.name)
+
+    def stat_vol(self, volume: str) -> VolInfo:
+        d = self._vol_dir(volume)
+        try:
+            st = os.stat(d)
+        except FileNotFoundError:
+            raise se.VolumeNotFound(volume) from None
+        return VolInfo(volume, st.st_ctime)
+
+    def delete_vol(self, volume: str, force: bool = False) -> None:
+        d = self._vol_dir(volume)
+        try:
+            if force:
+                shutil.rmtree(d)
+            else:
+                os.rmdir(d)
+        except FileNotFoundError:
+            raise se.VolumeNotFound(volume) from None
+        except OSError as e:
+            if e.errno == errno.ENOTEMPTY:
+                raise se.VolumeNotEmpty(volume) from None
+            raise se.FaultyDisk(str(e)) from e
+
+    # ---------- plain files ----------
+
+    def write_all(self, volume: str, path: str, data: bytes) -> None:
+        self.stat_vol(volume)
+        fp = self._file_path(volume, path)
+        os.makedirs(os.path.dirname(fp), exist_ok=True)
+        tmp = fp + f".tmp.{uuid.uuid4().hex}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, fp)
+        except OSError as e:
+            raise se.FaultyDisk(str(e)) from e
+
+    def read_all(self, volume: str, path: str) -> bytes:
+        fp = self._file_path(volume, path)
+        try:
+            with open(fp, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise se.FileNotFound(f"{volume}/{path}") from None
+        except IsADirectoryError:
+            raise se.IsNotRegular(f"{volume}/{path}") from None
+        except OSError as e:
+            raise se.FaultyDisk(str(e)) from e
+
+    def delete(self, volume: str, path: str, recursive: bool = False) -> None:
+        fp = self._file_path(volume, path)
+        try:
+            if recursive:
+                shutil.rmtree(fp)
+            elif os.path.isdir(fp):
+                os.rmdir(fp)
+            else:
+                os.remove(fp)
+        except FileNotFoundError:
+            raise se.FileNotFound(f"{volume}/{path}") from None
+        except OSError as e:
+            if e.errno == errno.ENOTEMPTY:
+                raise se.VolumeNotEmpty(path) from None
+            raise se.FaultyDisk(str(e)) from e
+        self._prune_empty_parents(os.path.dirname(fp), volume)
+
+    def _prune_empty_parents(self, d: str, volume: str) -> None:
+        vol_dir = self._vol_dir(volume)
+        while d.startswith(vol_dir) and d != vol_dir:
+            try:
+                os.rmdir(d)
+            except OSError:
+                return
+            d = os.path.dirname(d)
+
+    def list_dir(self, volume: str, dir_path: str, count: int = -1) -> list[str]:
+        d = self._file_path(volume, dir_path) if dir_path else self._vol_dir(volume)
+        try:
+            names = []
+            with os.scandir(d) as it:
+                for entry in it:
+                    names.append(entry.name + "/" if entry.is_dir() else entry.name)
+                    if 0 < count <= len(names):
+                        break
+            return sorted(names)
+        except FileNotFoundError:
+            raise se.FileNotFound(f"{volume}/{dir_path}") from None
+        except NotADirectoryError:
+            raise se.IsNotRegular(f"{volume}/{dir_path}") from None
+        except OSError as e:
+            raise se.FaultyDisk(str(e)) from e
+
+    # ---------- shard files ----------
+
+    def create_file(self, volume: str, path: str, chunks: Iterable[bytes]) -> int:
+        fp = self._file_path(volume, path)
+        os.makedirs(os.path.dirname(fp), exist_ok=True)
+        written = 0
+        try:
+            with open(fp, "wb") as f:
+                for chunk in chunks:
+                    f.write(chunk)
+                    written += len(chunk)
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as e:
+            raise se.FaultyDisk(str(e)) from e
+        return written
+
+    def append_file(self, volume: str, path: str, data: bytes) -> None:
+        fp = self._file_path(volume, path)
+        os.makedirs(os.path.dirname(fp), exist_ok=True)
+        try:
+            with open(fp, "ab") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as e:
+            raise se.FaultyDisk(str(e)) from e
+
+    def read_file_stream(self, volume: str, path: str) -> BinaryIO:
+        fp = self._file_path(volume, path)
+        try:
+            return open(fp, "rb")
+        except FileNotFoundError:
+            raise se.FileNotFound(f"{volume}/{path}") from None
+        except IsADirectoryError:
+            raise se.IsNotRegular(f"{volume}/{path}") from None
+        except OSError as e:
+            raise se.FaultyDisk(str(e)) from e
+
+    def rename_file(self, src_volume: str, src_path: str,
+                    dst_volume: str, dst_path: str) -> None:
+        src = self._file_path(src_volume, src_path)
+        dst = self._file_path(dst_volume, dst_path)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        try:
+            os.replace(src, dst)
+        except FileNotFoundError:
+            raise se.FileNotFound(f"{src_volume}/{src_path}") from None
+        except OSError as e:
+            raise se.FaultyDisk(str(e)) from e
+        _fsync_dir(os.path.dirname(dst))
+
+    # ---------- versioned metadata ----------
+
+    def _meta_path(self, volume: str, path: str) -> str:
+        return os.path.join(self._file_path(volume, path), META_FILE)
+
+    def _load_meta(self, volume: str, path: str) -> XLMeta:
+        try:
+            with open(self._meta_path(volume, path), "rb") as f:
+                return XLMeta.parse(f.read())
+        except FileNotFoundError:
+            raise se.FileNotFound(f"{volume}/{path}") from None
+        except NotADirectoryError:
+            raise se.FileNotFound(f"{volume}/{path}") from None
+        except OSError as e:
+            raise se.FaultyDisk(str(e)) from e
+
+    def _store_meta(self, volume: str, path: str, meta: XLMeta) -> None:
+        mp = self._meta_path(volume, path)
+        os.makedirs(os.path.dirname(mp), exist_ok=True)
+        tmp = mp + f".tmp.{uuid.uuid4().hex}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(meta.serialize())
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, mp)
+        except OSError as e:
+            raise se.FaultyDisk(str(e)) from e
+
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        self.stat_vol(volume)
+        try:
+            meta = self._load_meta(volume, path)
+        except se.FileNotFound:
+            meta = XLMeta()
+        # Replacing a version (e.g. erasure object overwritten by an inline
+        # one): reclaim the old data dir or its shards leak unreferenced.
+        try:
+            old = meta.to_fileinfo(volume, path, fi.version_id)
+            if old.data_dir and old.data_dir != fi.data_dir and not old.deleted:
+                shutil.rmtree(
+                    os.path.join(self._file_path(volume, path), old.data_dir),
+                    ignore_errors=True,
+                )
+        except se.StorageError:
+            pass
+        meta.add_version(fi)
+        self._store_meta(volume, path, meta)
+
+    def read_version(self, volume: str, path: str, version_id: str = "",
+                     read_data: bool = False) -> FileInfo:
+        meta = self._load_meta(volume, path)
+        fi = meta.to_fileinfo(volume, path, version_id)
+        return fi
+
+    def read_xl(self, volume: str, path: str) -> bytes:
+        try:
+            with open(self._meta_path(volume, path), "rb") as f:
+                return f.read()
+        except (FileNotFoundError, NotADirectoryError):
+            raise se.FileNotFound(f"{volume}/{path}") from None
+        except OSError as e:
+            raise se.FaultyDisk(str(e)) from e
+
+    def delete_version(self, volume: str, path: str, fi: FileInfo) -> None:
+        try:
+            meta = self._load_meta(volume, path)
+        except se.FileNotFound:
+            if fi.deleted:  # delete marker on nonexistent object is legal
+                meta = XLMeta()
+                meta.add_version(fi)
+                self._store_meta(volume, path, meta)
+                return
+            raise
+        if fi.deleted:
+            meta.add_version(fi)
+            self._store_meta(volume, path, meta)
+            return
+        removed = meta.delete_version(fi.version_id, volume, path)
+        obj_dir = self._file_path(volume, path)
+        if removed.data_dir:
+            shutil.rmtree(os.path.join(obj_dir, removed.data_dir), ignore_errors=True)
+        if meta.versions:
+            self._store_meta(volume, path, meta)
+        else:
+            try:
+                os.remove(self._meta_path(volume, path))
+            except OSError:
+                pass
+            try:
+                os.rmdir(obj_dir)
+            except OSError:
+                pass
+            self._prune_empty_parents(os.path.dirname(obj_dir), volume)
+
+    def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
+                    dst_volume: str, dst_path: str) -> None:
+        src_dir = self._file_path(src_volume, src_path)
+        obj_dir = self._file_path(dst_volume, dst_path)
+        os.makedirs(obj_dir, exist_ok=True)
+        if fi.data_dir:
+            dst_data = os.path.join(obj_dir, fi.data_dir)
+            try:
+                os.replace(src_dir, dst_data)
+            except FileNotFoundError:
+                raise se.FileNotFound(f"{src_volume}/{src_path}") from None
+            except OSError as e:
+                raise se.FaultyDisk(str(e)) from e
+        try:
+            meta = self._load_meta(dst_volume, dst_path)
+        except se.FileNotFound:
+            meta = XLMeta()
+        # Replacing a null version: reclaim its data dir.
+        try:
+            old = meta.to_fileinfo(dst_volume, dst_path, fi.version_id)
+            if old.data_dir and old.data_dir != fi.data_dir and not old.deleted:
+                shutil.rmtree(os.path.join(obj_dir, old.data_dir), ignore_errors=True)
+        except se.StorageError:
+            pass
+        meta.add_version(fi)
+        self._store_meta(dst_volume, dst_path, meta)
+        _fsync_dir(obj_dir)
+
+    # ---------- verification / walking ----------
+
+    def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
+        shard_size = fi.erasure.shard_size()
+        algo = next((c.algorithm for c in fi.erasure.checksums), bitrot.DEFAULT_ALGORITHM)
+        for part in fi.parts:
+            shard_data_size = fi.erasure.shard_file_size(part.size)
+            rel = f"{path}/{fi.data_dir}/part.{part.number}"
+            with self.read_file_stream(volume, rel) as f:
+                bitrot.verify_shard_file(f, shard_data_size, shard_size, algo)
+
+    def walk_dir(self, volume: str, prefix: str = "") -> Iterator[WalkEntry]:
+        base = self._vol_dir(volume)
+        if not os.path.isdir(base):
+            raise se.VolumeNotFound(volume)
+
+        def _walk(rel: str) -> Iterator[WalkEntry]:
+            d = os.path.join(base, rel) if rel else base
+            try:
+                entries = sorted(os.scandir(d), key=lambda e: e.name)
+            except OSError:
+                return
+            for entry in entries:
+                name = f"{rel}/{entry.name}" if rel else entry.name
+                if not entry.is_dir():
+                    continue
+                meta_p = os.path.join(entry.path, META_FILE)
+                if os.path.isfile(meta_p):
+                    if prefix and not name.startswith(prefix):
+                        # still descend: prefix may point deeper
+                        if prefix.startswith(name + "/"):
+                            yield from _walk(name)
+                        continue
+                    try:
+                        with open(meta_p, "rb") as f:
+                            yield WalkEntry(name=name, meta=f.read())
+                    except OSError:
+                        continue
+                else:
+                    if prefix and not (name.startswith(prefix) or prefix.startswith(name + "/")):
+                        continue
+                    yield from _walk(name)
+
+        yield from _walk("")
+
+    # ---------- tmp helpers (used by the erasure layer) ----------
+
+    def new_tmp_dir(self) -> str:
+        """Unique staging path under the sys tmp volume."""
+        return f"tmp/{uuid.uuid4().hex}"
+
+    def sys_volume(self) -> str:
+        return SYS_VOL

@@ -1,0 +1,1 @@
+"""Shared utilities: error taxonomy, quorum reducers, hashing helpers."""

@@ -1,0 +1,75 @@
+"""Quorum accounting over per-drive outcomes.
+
+The reference threads []error values from every parallel drive call through
+reduceReadQuorumErrs / reduceWriteQuorumErrs (cmd/erasure-metadata-utils.go:
+34-100). Here drive fan-out returns a list of (result | StorageError) and
+these reducers decide the aggregate outcome.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Iterable, Sequence, TypeVar
+
+from minio_tpu.utils import errors as se
+
+T = TypeVar("T")
+
+# Errors that should be ignored when counting agreement (object simply absent
+# on that drive is a normal state during heal/rebalance).
+OBJECT_OP_IGNORED = (se.DiskNotFound,)
+
+
+def count_errs(results: Sequence[object], err_type: type) -> int:
+    return sum(1 for r in results if isinstance(r, err_type))
+
+
+def reduce_errs(results: Sequence[object], ignored: Iterable[type] = ()) -> tuple[object, int]:
+    """Return (most-common-error-or-None, its count). None stands for success."""
+    keys = []
+    for r in results:
+        if isinstance(r, Exception):
+            if any(isinstance(r, ig) for ig in ignored):
+                continue
+            keys.append(type(r).__name__)
+        else:
+            keys.append(None)
+    if not keys:
+        return None, 0
+    (key, cnt), = Counter(keys).most_common(1)
+    if key is None:
+        return None, cnt
+    for r in results:
+        if isinstance(r, Exception) and type(r).__name__ == key:
+            return r, cnt
+    raise AssertionError("unreachable")
+
+
+def reduce_read_quorum(results: Sequence[object], quorum: int,
+                       bucket: str = "", object: str = "") -> None:
+    """Raise InsufficientReadQuorum (or the dominant error) unless at least
+    `quorum` drives succeeded-or-agree."""
+    err, count = reduce_errs(results, OBJECT_OP_IGNORED)
+    if err is None and count >= quorum:
+        return
+    if err is not None and count >= quorum:
+        raise err
+    raise se.InsufficientReadQuorum(bucket, object,
+                                    f"read quorum {quorum} not met: {_summary(results)}")
+
+
+def reduce_write_quorum(results: Sequence[object], quorum: int,
+                        bucket: str = "", object: str = "") -> None:
+    err, count = reduce_errs(results, OBJECT_OP_IGNORED)
+    if err is None and count >= quorum:
+        return
+    if err is not None and count >= quorum:
+        raise err
+    raise se.InsufficientWriteQuorum(bucket, object,
+                                     f"write quorum {quorum} not met: {_summary(results)}")
+
+
+def _summary(results: Sequence[object]) -> str:
+    return ", ".join(
+        type(r).__name__ if isinstance(r, Exception) else "ok" for r in results
+    )

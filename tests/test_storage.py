@@ -1,0 +1,206 @@
+"""LocalDrive + journal + bitrot-format tests (SURVEY.md §4 tier 1: real
+files in temp dirs, mirroring cmd/xl-storage_test.go / cmd/bitrot_test.go)."""
+
+import io
+import os
+
+import pytest
+
+from minio_tpu.ops import bitrot
+from minio_tpu.storage import LocalDrive
+from minio_tpu.storage.fileinfo import ChecksumInfo, ErasureInfo, FileInfo, PartInfo
+from minio_tpu.storage.xlmeta import XLMeta
+from minio_tpu.utils import errors as se
+
+
+@pytest.fixture()
+def drive(tmp_path):
+    return LocalDrive(str(tmp_path / "d0"))
+
+
+# ---------------- volumes / files ----------------
+
+
+def test_volume_lifecycle(drive):
+    drive.make_vol("bucket1")
+    with pytest.raises(se.VolumeExists):
+        drive.make_vol("bucket1")
+    assert [v.name for v in drive.list_vols()] == ["bucket1"]
+    drive.stat_vol("bucket1")
+    drive.delete_vol("bucket1")
+    with pytest.raises(se.VolumeNotFound):
+        drive.stat_vol("bucket1")
+
+
+def test_write_read_all_roundtrip(drive):
+    drive.make_vol("v")
+    drive.write_all("v", "cfg/x.json", b"{}")
+    assert drive.read_all("v", "cfg/x.json") == b"{}"
+    with pytest.raises(se.FileNotFound):
+        drive.read_all("v", "cfg/missing")
+
+
+def test_path_traversal_rejected(drive):
+    drive.make_vol("v")
+    with pytest.raises(se.FileAccessDenied):
+        drive.write_all("v", "../escape", b"x")
+    with pytest.raises(se.VolumeNotFound):
+        drive.read_all("../../etc", "passwd")
+
+
+def test_delete_prunes_empty_parents(drive):
+    drive.make_vol("v")
+    drive.write_all("v", "a/b/c.bin", b"1")
+    drive.delete("v", "a/b/c.bin")
+    assert not os.path.exists(os.path.join(drive.root, "v", "a"))
+    drive.stat_vol("v")  # volume itself survives
+
+
+# ---------------- bitrot format ----------------
+
+
+def test_bitrot_roundtrip_and_sizes():
+    payload = os.urandom(10000)
+    shard_size = 4096
+    buf = io.BytesIO()
+    w = bitrot.BitrotWriter(buf, shard_size)
+    for off in range(0, len(payload), shard_size):
+        w.write(payload[off:off + shard_size])
+    assert buf.tell() == bitrot.bitrot_shard_file_size(
+        len(payload), shard_size, bitrot.DEFAULT_ALGORITHM
+    )
+    r = bitrot.BitrotReader(buf, len(payload), shard_size)
+    assert r.read_at(0, len(payload)) == payload
+    assert r.read_at(5000, 2000) == payload[5000:7000]  # cross-chunk read
+
+
+def test_bitrot_detects_corruption():
+    payload = os.urandom(9000)
+    shard_size = 4096
+    buf = io.BytesIO()
+    w = bitrot.BitrotWriter(buf, shard_size)
+    for off in range(0, len(payload), shard_size):
+        w.write(payload[off:off + shard_size])
+    raw = bytearray(buf.getvalue())
+    raw[len(raw) // 2] ^= 0x01  # flip one bit mid-file
+    r = bitrot.BitrotReader(io.BytesIO(bytes(raw)), len(payload), shard_size)
+    with pytest.raises(se.FileCorrupt):
+        r.read_at(0, len(payload))
+    with pytest.raises(se.FileCorrupt):
+        bitrot.verify_shard_file(io.BytesIO(bytes(raw)), len(payload), shard_size)
+
+
+def test_bitrot_unknown_algorithm():
+    with pytest.raises(se.CorruptedFormat):
+        bitrot.get_algorithm("nope")
+
+
+# ---------------- version journal ----------------
+
+
+def _mk_fi(vid="", size=100, deleted=False):
+    fi = FileInfo.new("v", "obj", vid)
+    fi.size = size
+    fi.deleted = deleted
+    fi.parts = [PartInfo(1, size, size)]
+    fi.erasure = ErasureInfo(
+        data_blocks=4, parity_blocks=2, block_size=1 << 20, index=1,
+        distribution=list(range(1, 7)),
+        checksums=[ChecksumInfo(1, "blake2b256")],
+    )
+    return fi
+
+
+def test_xlmeta_roundtrip():
+    meta = XLMeta()
+    fi = _mk_fi(vid="11111111-1111-1111-1111-111111111111")
+    meta.add_version(fi)
+    meta2 = XLMeta.parse(meta.serialize())
+    got = meta2.to_fileinfo("v", "obj", fi.version_id)
+    assert got.size == fi.size
+    assert got.erasure.data_blocks == 4
+    assert got.erasure.distribution == list(range(1, 7))
+    assert got.parts[0].number == 1
+
+
+def test_xlmeta_corrupt_raises():
+    with pytest.raises(se.CorruptedFormat):
+        XLMeta.parse(b"garbage")
+    with pytest.raises(se.CorruptedFormat):
+        XLMeta.parse(b"MTP1\xff\xff\xff")
+
+
+def test_journal_versions_ordering_and_null_replacement(drive):
+    drive.make_vol("v")
+    import time
+    fi1 = _mk_fi(vid="")
+    fi1.mod_time = time.time() - 10
+    drive.write_metadata("v", "obj", fi1)
+    fi2 = _mk_fi(vid="22222222-2222-2222-2222-222222222222")
+    drive.write_metadata("v", "obj", fi2)
+    latest = drive.read_version("v", "obj")
+    assert latest.version_id == fi2.version_id
+    assert latest.num_versions == 2
+    # null version replaced in place, not duplicated
+    fi3 = _mk_fi(vid="")
+    drive.write_metadata("v", "obj", fi3)
+    assert drive.read_version("v", "obj").num_versions == 2
+
+
+def test_delete_version_prunes_object(drive):
+    drive.make_vol("v")
+    fi = _mk_fi(vid="")
+    drive.write_metadata("v", "obj", fi)
+    drive.delete_version("v", "obj", fi)
+    with pytest.raises(se.FileNotFound):
+        drive.read_version("v", "obj")
+    assert not os.path.exists(os.path.join(drive.root, "v", "obj"))
+
+
+def test_rename_data_commit_flow(drive):
+    """Full per-drive write flow: stage shard in tmp, commit via rename_data."""
+    drive.make_vol("bkt")
+    tmp = drive.new_tmp_dir()
+    fi = _mk_fi(vid="")
+    drive.create_file(drive.sys_volume(), f"{tmp}/part.1", [b"shard-bytes"])
+    drive.rename_data(drive.sys_volume(), tmp, fi, "bkt", "key")
+    got = drive.read_version("bkt", "key")
+    assert got.data_dir == fi.data_dir
+    with drive.read_file_stream("bkt", f"key/{fi.data_dir}/part.1") as f:
+        assert f.read() == b"shard-bytes"
+    # tmp staging dir is gone (moved, not copied)
+    assert not os.path.exists(os.path.join(drive.root, drive.sys_volume(), tmp))
+
+
+def test_walk_dir_streams_sorted_entries(drive):
+    drive.make_vol("v")
+    for key in ["z/obj2", "a/obj1", "a/obj0", "solo"]:
+        fi = _mk_fi(vid="")
+        drive.write_metadata("v", key, fi)
+    names = [e.name for e in drive.walk_dir("v")]
+    assert names == ["a/obj0", "a/obj1", "solo", "z/obj2"]
+    under_a = [e.name for e in drive.walk_dir("v", prefix="a/")]
+    assert under_a == ["a/obj0", "a/obj1"]
+    assert all(e.meta for e in drive.walk_dir("v"))
+
+
+def test_verify_file_detects_shard_corruption(drive):
+    drive.make_vol("bkt")
+    shard_size = 4096
+    payload = os.urandom(8192)
+    tmp = drive.new_tmp_dir()
+    buf = io.BytesIO()
+    w = bitrot.BitrotWriter(buf, shard_size)
+    w.write(payload[:4096]); w.write(payload[4096:])
+    drive.create_file(drive.sys_volume(), f"{tmp}/part.1", [buf.getvalue()])
+    fi = _mk_fi(vid="", size=len(payload))
+    fi.erasure.block_size = shard_size * fi.erasure.data_blocks
+    fi.parts = [PartInfo(1, len(payload) * fi.erasure.data_blocks, 0)]
+    drive.rename_data(drive.sys_volume(), tmp, fi, "bkt", "key")
+    drive.verify_file("bkt", "key", fi)  # clean passes
+    # corrupt one byte on disk
+    shard_path = os.path.join(drive.root, "bkt", "key", fi.data_dir, "part.1")
+    with open(shard_path, "r+b") as f:
+        f.seek(100); b = f.read(1); f.seek(100); f.write(bytes([b[0] ^ 1]))
+    with pytest.raises(se.FileCorrupt):
+        drive.verify_file("bkt", "key", fi)
