@@ -1,0 +1,8 @@
+"""S3-compatible HTTP surface (reference L5-L6: cmd/api-router.go,
+cmd/object-handlers.go, cmd/bucket-handlers.go) on aiohttp.
+
+The handler chain mirrors the reference's middleware stack
+(cmd/routers.go:41-83) in compressed form: request classification ->
+signature verification -> handler -> XML/streaming response, with every
+response carrying x-amz-request-id and the S3 error XML schema.
+"""

@@ -1,0 +1,95 @@
+"""S3 API error codes + mapping from internal exceptions.
+
+Reference: cmd/api-errors.go (the big toAPIErrorCode switch). Each APIError
+renders as the S3 error XML document with Code/Message/Resource/RequestId.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from minio_tpu.utils import errors as se
+
+
+@dataclass(frozen=True)
+class APIError:
+    code: str
+    message: str
+    http_status: int
+
+
+ERRORS = {
+    "AccessDenied": APIError("AccessDenied", "Access Denied.", 403),
+    "BadDigest": APIError("BadDigest", "The Content-Md5 you specified did not match what we received.", 400),
+    "BucketAlreadyOwnedByYou": APIError("BucketAlreadyOwnedByYou", "Your previous request to create the named bucket succeeded and you already own it.", 409),
+    "BucketNotEmpty": APIError("BucketNotEmpty", "The bucket you tried to delete is not empty.", 409),
+    "EntityTooLarge": APIError("EntityTooLarge", "Your proposed upload exceeds the maximum allowed object size.", 400),
+    "EntityTooSmall": APIError("EntityTooSmall", "Your proposed upload is smaller than the minimum allowed object size.", 400),
+    "IncompleteBody": APIError("IncompleteBody", "You did not provide the number of bytes specified by the Content-Length HTTP header.", 400),
+    "InternalError": APIError("InternalError", "We encountered an internal error, please try again.", 500),
+    "InvalidAccessKeyId": APIError("InvalidAccessKeyId", "The Access Key Id you provided does not exist in our records.", 403),
+    "InvalidArgument": APIError("InvalidArgument", "Invalid Argument", 400),
+    "InvalidBucketName": APIError("InvalidBucketName", "The specified bucket is not valid.", 400),
+    "InvalidDigest": APIError("InvalidDigest", "The Content-Md5 you specified is not valid.", 400),
+    "InvalidPart": APIError("InvalidPart", "One or more of the specified parts could not be found.", 400),
+    "InvalidPartOrder": APIError("InvalidPartOrder", "The list of parts was not in ascending order.", 400),
+    "InvalidRange": APIError("InvalidRange", "The requested range is not satisfiable", 416),
+    "InvalidRequest": APIError("InvalidRequest", "Invalid Request", 400),
+    "MalformedXML": APIError("MalformedXML", "The XML you provided was not well-formed or did not validate against our published schema.", 400),
+    "MethodNotAllowed": APIError("MethodNotAllowed", "The specified method is not allowed against this resource.", 405),
+    "MissingContentLength": APIError("MissingContentLength", "You must provide the Content-Length HTTP header.", 411),
+    "NoSuchBucket": APIError("NoSuchBucket", "The specified bucket does not exist", 404),
+    "NoSuchKey": APIError("NoSuchKey", "The specified key does not exist.", 404),
+    "NoSuchUpload": APIError("NoSuchUpload", "The specified multipart upload does not exist. The upload ID may be invalid, or the upload may have been aborted or completed.", 404),
+    "NoSuchVersion": APIError("NoSuchVersion", "The specified version does not exist.", 404),
+    "NoSuchTagSet": APIError("NoSuchTagSet", "The TagSet does not exist", 404),
+    "NotImplemented": APIError("NotImplemented", "A header you provided implies functionality that is not implemented", 501),
+    "PreconditionFailed": APIError("PreconditionFailed", "At least one of the pre-conditions you specified did not hold", 412),
+    "RequestTimeTooSkewed": APIError("RequestTimeTooSkewed", "The difference between the request time and the server's time is too large.", 403),
+    "SignatureDoesNotMatch": APIError("SignatureDoesNotMatch", "The request signature we calculated does not match the signature you provided. Check your key and signing method.", 403),
+    "SlowDown": APIError("SlowDown", "Resource requested is unreadable, please reduce your request rate", 503),
+    "XAmzContentSHA256Mismatch": APIError("XAmzContentSHA256Mismatch", "The provided 'x-amz-content-sha256' header does not match what was computed.", 400),
+    "ServiceUnavailable": APIError("ServiceUnavailable", "The service is unavailable. Please retry.", 503),
+    "AuthorizationHeaderMalformed": APIError("AuthorizationHeaderMalformed", "The authorization header is malformed.", 400),
+}
+
+
+class S3Error(Exception):
+    def __init__(self, code: str, message: str | None = None,
+                 resource: str = "", extra: dict | None = None):
+        self.api = ERRORS[code]
+        self.message = message or self.api.message
+        self.resource = resource
+        self.extra = extra or {}
+        super().__init__(f"{code}: {self.message}")
+
+
+_EXC_MAP: list[tuple[type, str]] = [
+    (se.BucketNameInvalid, "InvalidBucketName"),
+    (se.BucketExists, "BucketAlreadyOwnedByYou"),
+    (se.BucketNotEmpty, "BucketNotEmpty"),
+    (se.BucketNotFound, "NoSuchBucket"),
+    (se.VersionNotFound, "NoSuchVersion"),
+    (se.ObjectNotFound, "NoSuchKey"),
+    (se.ObjectNameInvalid, "NoSuchKey"),
+    (se.InvalidUploadID, "NoSuchUpload"),
+    (se.InvalidPart, "InvalidPart"),
+    (se.PartTooSmall, "EntityTooSmall"),
+    (se.IncompleteBody, "IncompleteBody"),
+    (se.InvalidRange, "InvalidRange"),
+    (se.PreconditionFailed, "PreconditionFailed"),
+    (se.InsufficientReadQuorum, "SlowDown"),
+    (se.InsufficientWriteQuorum, "SlowDown"),
+    (se.MethodNotAllowed, "MethodNotAllowed"),
+    (se.FileNotFound, "NoSuchKey"),
+    (se.StorageError, "InternalError"),
+]
+
+
+def from_exception(exc: Exception, resource: str = "") -> S3Error:
+    if isinstance(exc, S3Error):
+        return exc
+    for etype, code in _EXC_MAP:
+        if isinstance(exc, etype):
+            return S3Error(code, resource=resource)
+    return S3Error("InternalError", message=str(exc) or None, resource=resource)

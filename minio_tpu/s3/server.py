@@ -1,0 +1,491 @@
+"""The S3 HTTP server: request classification, auth, dispatch.
+
+Reference: cmd/routers.go + cmd/api-router.go + cmd/object-handlers.go /
+cmd/bucket-handlers.go. S3 routing is query-string-driven, so instead of a
+route table per verb we classify each request once (bucket, key, query,
+method) and dispatch from one table — the same effect as the reference's
+gorilla/mux Queries() matchers without the mux.
+
+Run: python -m minio_tpu.s3.server --address 127.0.0.1:9000 /tmp/d{0...5}
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import os
+import tempfile
+import urllib.parse
+import uuid
+from typing import Iterator
+
+from aiohttp import web
+
+from minio_tpu.erasure import ErasureObjects
+from minio_tpu.erasure.types import ObjectOptions, ObjectToDelete
+from minio_tpu.s3 import sigv4, xmlutil
+from minio_tpu.s3.errors import S3Error, from_exception
+from minio_tpu.storage import LocalDrive
+
+XML_TYPE = "application/xml"
+MAX_OBJECT_SIZE = 5 * (1 << 40)
+SPOOL_LIMIT = 32 << 20
+
+
+def _int_q(q: dict, name: str, default: int, lo: int = 0, hi: int = 100_000) -> int:
+    raw = q.get(name)
+    if raw in (None, ""):
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        raise S3Error("InvalidArgument", f"invalid {name}") from None
+    if not lo <= v <= hi:
+        raise S3Error("InvalidArgument", f"{name} out of range")
+    return v
+
+
+class S3Server:
+    def __init__(self, object_layer, credentials: sigv4.Credentials,
+                 region: str = "us-east-1", versioned_buckets: bool = False):
+        self.obj = object_layer
+        self.creds = credentials
+        self.region = region
+        # Per-bucket versioning config lives in bucket metadata once that
+        # subsystem lands; until then a server-level default.
+        self.versioned_buckets = versioned_buckets
+        self.app = web.Application(client_max_size=1 << 30)
+        self.app.router.add_route("*", "/{tail:.*}", self._entry)
+
+    # ------------------------------------------------------------------
+
+    def _lookup(self, access_key: str):
+        if access_key == self.creds.access_key:
+            return self.creds
+        return None
+
+    async def _entry(self, request: web.Request) -> web.StreamResponse:
+        request_id = uuid.uuid4().hex[:16].upper()
+        path = urllib.parse.unquote(request.raw_path.split("?", 1)[0])
+        try:
+            return await self._dispatch(request, path, request_id)
+        except S3Error as e:
+            return self._error_response(e, path, request_id)
+        except Exception as e:  # noqa: BLE001 - surface as S3 InternalError
+            return self._error_response(from_exception(e, path), path, request_id)
+
+    def _error_response(self, e: S3Error, resource: str, request_id: str):
+        body = xmlutil.error_xml(e.api.code, e.message, resource, request_id, e.extra)
+        return web.Response(
+            status=e.api.http_status, body=body, content_type=XML_TYPE,
+            headers={"x-amz-request-id": request_id},
+        )
+
+    async def _dispatch(self, request: web.Request, path: str,
+                        request_id: str) -> web.StreamResponse:
+        query_items = [(k, v) for k, v in urllib.parse.parse_qsl(
+            request.query_string, keep_blank_values=True)]
+        q = dict(query_items)
+        # --- auth (reference cmd/auth-handler.go:102 classification) ---
+        if "X-Amz-Signature" in q:
+            sigv4.verify_presigned(request.method, path, query_items,
+                                   request.headers, self._lookup)
+            # Honor a content binding if the signer pinned one in the
+            # signed query (else anyone with the URL uploads arbitrary bytes).
+            payload_hash = q.get("X-Amz-Content-Sha256", sigv4.UNSIGNED_PAYLOAD)
+            auth_sig = None
+        elif request.headers.get("Authorization", "").startswith(sigv4.ALGORITHM):
+            _, payload_hash = sigv4.verify_header_auth(
+                request.method, path, query_items, request.headers, self._lookup)
+            auth_sig = sigv4.parse_auth_header(request.headers["Authorization"])
+        else:
+            raise S3Error("AccessDenied", "anonymous access is not allowed")
+
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+
+        loop = asyncio.get_running_loop()
+
+        def run(fn, *args, **kw):
+            return loop.run_in_executor(None, lambda: fn(*args, **kw))
+
+        m = request.method
+        hdr = {"x-amz-request-id": request_id}
+
+        # ---------- service level ----------
+        if not bucket:
+            if m == "GET":
+                buckets = await run(self.obj.list_buckets)
+                return web.Response(body=xmlutil.list_buckets_xml(buckets),
+                                    content_type=XML_TYPE, headers=hdr)
+            raise S3Error("MethodNotAllowed", resource=path)
+
+        # ---------- bucket level ----------
+        if not key:
+            if m == "PUT" and not q:
+                await run(self.obj.make_bucket, bucket)
+                return web.Response(status=200, headers={**hdr, "Location": f"/{bucket}"})
+            if m == "HEAD":
+                await run(self.obj.get_bucket_info, bucket)
+                return web.Response(status=200, headers=hdr)
+            if m == "DELETE":
+                await run(self.obj.delete_bucket, bucket)
+                return web.Response(status=204, headers=hdr)
+            if m == "POST" and "delete" in q:
+                return await self._delete_objects(request, bucket, hdr, run)
+            if m == "GET":
+                if "versions" in q:
+                    res = await run(
+                        self.obj.list_object_versions, bucket,
+                        q.get("prefix", ""), q.get("key-marker", ""),
+                        q.get("version-id-marker", ""), q.get("delimiter", ""),
+                        _int_q(q, "max-keys", 1000),
+                    )
+                    return web.Response(
+                        body=xmlutil.list_versions_xml(bucket, q.get("prefix", ""), res),
+                        content_type=XML_TYPE, headers=hdr)
+                if "uploads" in q:
+                    return web.Response(
+                        body=xmlutil.list_uploads_xml(bucket, []),
+                        content_type=XML_TYPE, headers=hdr)
+                if "location" in q:
+                    await run(self.obj.get_bucket_info, bucket)
+                    body = (b'<?xml version="1.0" encoding="UTF-8"?>'
+                            b'<LocationConstraint xmlns="http://s3.amazonaws.com/'
+                            b'doc/2006-03-01/"></LocationConstraint>')
+                    return web.Response(body=body, content_type=XML_TYPE, headers=hdr)
+                if q.get("list-type") == "2":
+                    token = q.get("continuation-token", "")
+                    start_after = q.get("start-after", "")
+                    marker = token or start_after
+                    res = await run(
+                        self.obj.list_objects, bucket, q.get("prefix", ""),
+                        marker, q.get("delimiter", ""),
+                        _int_q(q, "max-keys", 1000),
+                    )
+                    return web.Response(
+                        body=xmlutil.list_objects_v2_xml(
+                            bucket, q.get("prefix", ""), token, start_after,
+                            q.get("delimiter", ""), _int_q(q, "max-keys", 1000), res),
+                        content_type=XML_TYPE, headers=hdr)
+                res = await run(
+                    self.obj.list_objects, bucket, q.get("prefix", ""),
+                    q.get("marker", ""), q.get("delimiter", ""),
+                    _int_q(q, "max-keys", 1000),
+                )
+                return web.Response(
+                    body=xmlutil.list_objects_v1_xml(
+                        bucket, q.get("prefix", ""), q.get("marker", ""),
+                        q.get("delimiter", ""), _int_q(q, "max-keys", 1000), res),
+                    content_type=XML_TYPE, headers=hdr)
+            raise S3Error("MethodNotAllowed", resource=path)
+
+        # ---------- object level ----------
+        opts = ObjectOptions(
+            version_id=q.get("versionId", ""),
+            versioned=self.versioned_buckets,
+        )
+        if m in ("GET", "HEAD") and "tagging" in q:
+            tags = await run(self.obj.get_object_tags, bucket, key, opts)
+            return web.Response(body=xmlutil.tagging_xml(tags),
+                                content_type=XML_TYPE, headers=hdr)
+        if m == "PUT" and "tagging" in q:
+            body = await request.read()
+            tags = xmlutil.parse_tagging_xml(body)
+            await run(self.obj.put_object_tags, bucket, key, tags, opts)
+            return web.Response(status=200, headers=hdr)
+        if m == "DELETE" and "tagging" in q:
+            await run(self.obj.delete_object_tags, bucket, key, opts)
+            return web.Response(status=204, headers=hdr)
+
+        if m == "HEAD":
+            info = await run(self.obj.get_object_info, bucket, key, opts)
+            if _check_conditional(request, info):
+                return web.Response(status=304,
+                                    headers={**hdr, "ETag": f'"{info.etag}"'})
+            return web.Response(status=200, headers={**hdr, **_object_headers(info)})
+        if m == "GET":
+            return await self._get_object(request, bucket, key, opts, hdr, run)
+        if m == "PUT":
+            src = request.headers.get("x-amz-copy-source")
+            if src:
+                return await self._copy_object(request, bucket, key, src, opts, hdr, run)
+            return await self._put_object(request, bucket, key, opts, hdr,
+                                          payload_hash, auth_sig, run)
+        if m == "DELETE":
+            info = await run(self.obj.delete_object, bucket, key, opts)
+            extra = {}
+            if info.delete_marker:
+                extra["x-amz-delete-marker"] = "true"
+            if info.version_id:
+                extra["x-amz-version-id"] = info.version_id
+            return web.Response(status=204, headers={**hdr, **extra})
+        if m == "POST" and ("uploads" in q or "uploadId" in q):
+            raise S3Error("NotImplemented", "multipart upload lands next milestone")
+        raise S3Error("MethodNotAllowed", resource=path)
+
+    # ------------------------------------------------------------------
+
+    async def _put_object(self, request, bucket, key, opts, hdr,
+                          payload_hash, auth_sig, run):
+        if request.content_length is None and \
+                "x-amz-decoded-content-length" not in request.headers:
+            raise S3Error("MissingContentLength")
+        size = request.content_length or 0
+        decoded_len = request.headers.get("x-amz-decoded-content-length")
+        streaming = payload_hash == sigv4.STREAMING_PAYLOAD
+        if streaming:
+            if decoded_len is None:
+                raise S3Error("MissingContentLength")
+            size = int(decoded_len)
+        if size > MAX_OBJECT_SIZE:
+            raise S3Error("EntityTooLarge")
+
+        user_defined = {}
+        ct = request.headers.get("Content-Type")
+        if ct:
+            user_defined["content-type"] = ct
+        sc = request.headers.get("x-amz-storage-class")
+        if sc:
+            user_defined["x-amz-storage-class"] = sc
+        for hk, hv in request.headers.items():
+            if hk.lower().startswith("x-amz-meta-"):
+                user_defined[hk.lower()] = hv
+        opts.user_defined = user_defined
+
+        spool = tempfile.SpooledTemporaryFile(max_size=SPOOL_LIMIT)
+        sha = hashlib.sha256() if payload_hash not in (
+            sigv4.UNSIGNED_PAYLOAD, sigv4.STREAMING_PAYLOAD) else None
+        chunked = None
+        if streaming:
+            amz_date = request.headers.get("x-amz-date", "")
+            chunked = sigv4.ChunkedSigV4Reader(
+                self.creds, auth_sig.signature, amz_date, auth_sig.scope_date,
+                auth_sig.region, auth_sig.service)
+        try:
+            async for chunk in request.content.iter_chunked(1 << 20):
+                if chunked is not None:
+                    chunked.feed(chunk)
+                    spool.write(chunked.take())
+                else:
+                    if sha is not None:
+                        sha.update(chunk)
+                    spool.write(chunk)
+            if chunked is not None and not chunked.done:
+                raise S3Error("IncompleteBody")
+            if sha is not None and sha.hexdigest() != payload_hash:
+                raise S3Error("XAmzContentSHA256Mismatch")
+            spool.seek(0)
+            info = await run(self.obj.put_object, bucket, key, spool, size, opts)
+        finally:
+            spool.close()
+        extra = {"ETag": f'"{info.etag}"'}
+        if info.version_id:
+            extra["x-amz-version-id"] = info.version_id
+        return web.Response(status=200, headers={**hdr, **extra})
+
+    async def _copy_object(self, request, bucket, key, src, opts, hdr, run):
+        src = urllib.parse.unquote(src)
+        src_vid = ""
+        if "?versionId=" in src:
+            src, src_vid = src.split("?versionId=", 1)
+        src = src.lstrip("/")
+        if "/" not in src:
+            raise S3Error("InvalidArgument", "bad x-amz-copy-source")
+        src_bucket, src_key = src.split("/", 1)
+        src_opts = ObjectOptions(version_id=src_vid)
+        info, stream = await run(self.obj.get_object, src_bucket, src_key,
+                                 0, -1, src_opts)
+        directive = request.headers.get("x-amz-metadata-directive", "COPY")
+        user_defined = dict(info.user_defined)
+        user_defined["content-type"] = info.content_type
+        if directive == "REPLACE":
+            user_defined = {
+                hk.lower(): hv for hk, hv in request.headers.items()
+                if hk.lower().startswith("x-amz-meta-")
+            }
+            if request.headers.get("Content-Type"):
+                user_defined["content-type"] = request.headers["Content-Type"]
+        opts.user_defined = user_defined
+
+        reader = _IterReader(stream)
+        new_info = await run(self.obj.put_object, bucket, key, reader,
+                             info.size, opts)
+        return web.Response(body=xmlutil.copy_object_xml(new_info.etag,
+                                                         new_info.mod_time),
+                            content_type=XML_TYPE, headers=hdr)
+
+    async def _get_object(self, request, bucket, key, opts, hdr, run):
+        rng = request.headers.get("Range")
+        status = 200
+        if rng:
+            # Range needs the size before the read; costs one extra quorum
+            # metadata round, paid only by range requests.
+            pre = await run(self.obj.get_object_info, bucket, key, opts)
+            offset, length = _parse_range(rng, pre.size)
+            status = 206
+        else:
+            offset, length = 0, -1
+        info, stream = await run(self.obj.get_object, bucket, key,
+                                 offset, length, opts)
+        not_modified = _check_conditional(request, info)
+        if not_modified:
+            return web.Response(status=304, headers={
+                **hdr, "ETag": f'"{info.etag}"',
+            })
+        if length < 0:
+            length = info.size
+        headers = {**hdr, **_object_headers(info)}
+        headers["Content-Length"] = str(length)
+        if status == 206:
+            headers["Content-Range"] = f"bytes {offset}-{offset + length - 1}/{info.size}"
+        resp = web.StreamResponse(status=status, headers=headers)
+        await resp.prepare(request)
+        loop = asyncio.get_running_loop()
+        it = iter(stream)
+        while True:
+            chunk = await loop.run_in_executor(None, next, it, None)
+            if chunk is None:
+                break
+            await resp.write(chunk)
+        await resp.write_eof()
+        return resp
+
+    async def _delete_objects(self, request, bucket, hdr, run):
+        body = await request.read()
+        objects, quiet = xmlutil.parse_delete_xml(body)
+        todo = [ObjectToDelete(k, v) for k, v in objects]
+        results = await run(self.obj.delete_objects, bucket, todo,
+                            ObjectOptions(versioned=self.versioned_buckets))
+        deleted, errors = [], []
+        for (k, v), r in zip(objects, results):
+            if isinstance(r, Exception):
+                s3e = from_exception(r, k)
+                if s3e.api.code == "NoSuchKey":
+                    # S3 semantics: deleting a missing key succeeds.
+                    if not quiet:
+                        from minio_tpu.erasure.types import DeletedObject
+                        deleted.append(DeletedObject(object_name=k, version_id=v))
+                else:
+                    errors.append((k, s3e.api.code, s3e.message))
+            elif not quiet:
+                deleted.append(r)
+        return web.Response(body=xmlutil.delete_result_xml(deleted, errors),
+                            content_type=XML_TYPE, headers=hdr)
+
+
+class _IterReader:
+    """File-like over a bytes iterator (bridges GET streams into put_object)."""
+
+    def __init__(self, it: Iterator[bytes]):
+        self._it = iter(it)
+        self._buf = bytearray()
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            for c in self._it:
+                self._buf += c
+            out = bytes(self._buf)
+            self._buf.clear()
+            return out
+        while len(self._buf) < n:
+            try:
+                self._buf += next(self._it)
+            except StopIteration:
+                break
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+
+def _object_headers(info) -> dict:
+    h = {
+        "ETag": f'"{info.etag}"',
+        "Last-Modified": _http_time(info.mod_time),
+        "Content-Type": info.content_type or "binary/octet-stream",
+        "Accept-Ranges": "bytes",
+        "Content-Length": str(info.size),
+    }
+    if info.version_id:
+        h["x-amz-version-id"] = info.version_id
+    for k, v in info.user_defined.items():
+        if k.startswith("x-amz-meta-"):
+            h[k] = v
+    tags = info.user_defined.get("x-amz-tagging")
+    if tags:
+        h["x-amz-tagging-count"] = str(len(urllib.parse.parse_qsl(tags)))
+    return h
+
+
+def _http_time(ts: float) -> str:
+    import email.utils
+
+    return email.utils.formatdate(ts, usegmt=True)
+
+
+def _parse_range(value: str, size: int) -> tuple[int, int]:
+    if not value.startswith("bytes="):
+        raise S3Error("InvalidRange")
+    spec = value[6:].split(",")[0].strip()
+    try:
+        if spec.startswith("-"):
+            suffix = int(spec[1:])
+            if suffix == 0:
+                raise S3Error("InvalidRange")
+            start = max(0, size - suffix)
+            end = size - 1
+        else:
+            se_ = spec.split("-")
+            start = int(se_[0])
+            end = int(se_[1]) if len(se_) > 1 and se_[1] else size - 1
+    except ValueError:
+        raise S3Error("InvalidRange") from None
+    if start >= size or end < start:
+        raise S3Error("InvalidRange")
+    end = min(end, size - 1)
+    return start, end - start + 1
+
+
+def _check_conditional(request, info) -> bool:
+    """Returns True for a 304 Not Modified outcome; raises for 412."""
+    im = request.headers.get("If-Match")
+    if im and im.strip('"') != info.etag:
+        raise S3Error("PreconditionFailed", "ETag does not match If-Match")
+    inm = request.headers.get("If-None-Match")
+    if inm and inm.strip('"') == info.etag:
+        if request.method in ("GET", "HEAD"):
+            return True  # cache revalidation hit
+        raise S3Error("PreconditionFailed", "ETag matches If-None-Match")
+    return False
+
+
+# ----------------------------------------------------------------------
+
+
+def build_server(drive_paths: list[str], access_key: str, secret_key: str,
+                 versioned: bool = False, parity: int | None = None) -> S3Server:
+    drives = [LocalDrive(p) for p in drive_paths]
+    layer = ErasureObjects(drives, parity=parity)
+    return S3Server(layer, sigv4.Credentials(access_key, secret_key),
+                    versioned_buckets=versioned)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="minio_tpu S3 server")
+    ap.add_argument("drives", nargs="+", help="drive directories")
+    ap.add_argument("--address", default="0.0.0.0:9000")
+    ap.add_argument("--versioned", action="store_true")
+    ap.add_argument("--parity", type=int, default=None)
+    args = ap.parse_args(argv)
+    host, _, port = args.address.rpartition(":")
+    access = os.environ.get("MTPU_ROOT_USER", "minioadmin")
+    secret = os.environ.get("MTPU_ROOT_PASSWORD", "minioadmin")
+    srv = build_server(args.drives, access, secret,
+                       versioned=args.versioned, parity=args.parity)
+    web.run_app(srv.app, host=host or "0.0.0.0", port=int(port))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Minimal SigV4-signing S3 test client (the reference signs requests in
+cmd/test-utils_test.go; this is an independent client-side implementation so
+server verification is cross-checked, not mirrored)."""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import urllib.parse
+
+import requests
+
+
+class SigV4Client:
+    def __init__(self, endpoint: str, access_key: str, secret_key: str,
+                 region: str = "us-east-1"):
+        self.endpoint = endpoint.rstrip("/")
+        self.ak = access_key
+        self.sk = secret_key
+        self.region = region
+        self.session = requests.Session()
+
+    def _sign(self, method: str, path: str, query: dict, headers: dict,
+              body: bytes) -> dict:
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        scope_date = amz_date[:8]
+        payload_hash = hashlib.sha256(body).hexdigest()
+        host = urllib.parse.urlparse(self.endpoint).netloc
+        headers = {k.lower(): v for k, v in headers.items()}
+        headers.update({"host": host, "x-amz-date": amz_date,
+                        "x-amz-content-sha256": payload_hash})
+        signed = sorted(headers)
+        cq = "&".join(
+            f"{urllib.parse.quote(k, safe='-._~')}={urllib.parse.quote(str(v), safe='-._~')}"
+            for k, v in sorted(query.items())
+        )
+        canonical = "\n".join([
+            method,
+            urllib.parse.quote(path, safe="/-._~"),
+            cq,
+            "".join(f"{h}:{' '.join(str(headers[h]).split())}\n" for h in signed),
+            ";".join(signed),
+            payload_hash,
+        ])
+        scope = f"{scope_date}/{self.region}/s3/aws4_request"
+        sts = "\n".join([
+            "AWS4-HMAC-SHA256", amz_date, scope,
+            hashlib.sha256(canonical.encode()).hexdigest(),
+        ])
+        key = ("AWS4" + self.sk).encode()
+        for part in (scope_date, self.region, "s3", "aws4_request"):
+            key = hmac.new(key, part.encode(), hashlib.sha256).digest()
+        sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+        headers["authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self.ak}/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}"
+        )
+        return headers
+
+    def request(self, method: str, path: str, query: dict | None = None,
+                headers: dict | None = None, data: bytes = b"") -> requests.Response:
+        query = query or {}
+        headers = dict(headers or {})
+        signed = self._sign(method, path, query, headers, data)
+        url = self.endpoint + urllib.parse.quote(path, safe="/-._~")
+        return self.session.request(method, url, params=query, headers=signed,
+                                    data=data, timeout=30)
+
+    # convenience verbs
+    def put(self, path, data=b"", **kw):
+        return self.request("PUT", path, data=data, **kw)
+
+    def get(self, path, **kw):
+        return self.request("GET", path, **kw)
+
+    def head(self, path, **kw):
+        return self.request("HEAD", path, **kw)
+
+    def delete(self, path, **kw):
+        return self.request("DELETE", path, **kw)
+
+    def post(self, path, data=b"", **kw):
+        return self.request("POST", path, data=data, **kw)
+
+    def presigned_url(self, method: str, path: str, expires: int = 3600) -> str:
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        scope_date = amz_date[:8]
+        scope = f"{scope_date}/{self.region}/s3/aws4_request"
+        host = urllib.parse.urlparse(self.endpoint).netloc
+        q = {
+            "X-Amz-Algorithm": "AWS4-HMAC-SHA256",
+            "X-Amz-Credential": f"{self.ak}/{scope}",
+            "X-Amz-Date": amz_date,
+            "X-Amz-Expires": str(expires),
+            "X-Amz-SignedHeaders": "host",
+        }
+        cq = "&".join(
+            f"{urllib.parse.quote(k, safe='-._~')}={urllib.parse.quote(v, safe='-._~')}"
+            for k, v in sorted(q.items())
+        )
+        canonical = "\n".join([
+            method, urllib.parse.quote(path, safe="/-._~"), cq,
+            f"host:{host}\n", "host", "UNSIGNED-PAYLOAD",
+        ])
+        sts = "\n".join([
+            "AWS4-HMAC-SHA256", amz_date, scope,
+            hashlib.sha256(canonical.encode()).hexdigest(),
+        ])
+        key = ("AWS4" + self.sk).encode()
+        for part in (scope_date, self.region, "s3", "aws4_request"):
+            key = hmac.new(key, part.encode(), hashlib.sha256).digest()
+        sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+        return f"{self.endpoint}{path}?{cq}&X-Amz-Signature={sig}"
