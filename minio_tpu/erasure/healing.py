@@ -1,0 +1,500 @@
+"""Healing: whole-set reconstruct of damaged/missing shards.
+
+Role-equivalent of the reference's healing plane (cmd/erasure-healing.go:233-498,
+cmd/erasure-healing-common.go:103,161, cmd/erasure-lowlevel-heal.go): classify
+every drive of the set as ok/offline/missing/outdated/corrupt for an object
+version, elect the authoritative metadata by modtime, reconstruct the target
+shards for every part, and commit them with the same tmp→rename discipline as
+PutObject. Dangling objects (ones that can never reach read quorum again) are
+purged.
+
+TPU-first difference: the reference heals shard-by-shard through a Decode→
+Encode pipe (erasure-lowlevel-heal.go:28). Here reconstruction is the same
+batched GF(2) contraction as GET — all missing shard columns for a batch of
+blocks are produced by ONE device launch with decode weights for the failure
+pattern, so healing a 4-drives-down set costs one matmul per block batch, not
+four passes.
+
+The MRF ("most recently failed") queue mirrors cmd/erasure.go:41-75: partial
+writes and corrupt reads enqueue (bucket, object, version) and a background
+worker re-heals them.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+
+from minio_tpu.erasure.codec import ErasureCodec
+from minio_tpu.erasure.metadata import parallel_map, shuffle_by_distribution
+from minio_tpu.ops import bitrot
+from minio_tpu.storage.fileinfo import FileInfo
+from minio_tpu.utils import errors as se
+
+# Drive states (reference madmin drive states).
+DRIVE_STATE_OK = "ok"
+DRIVE_STATE_OFFLINE = "offline"
+DRIVE_STATE_MISSING = "missing"
+DRIVE_STATE_CORRUPT = "corrupt"
+DRIVE_STATE_OUTDATED = "outdated"
+
+
+@dataclass
+class HealDriveState:
+    endpoint: str
+    state: str
+
+
+@dataclass
+class HealResultItem:
+    """Result of one heal operation (reference madmin.HealResultItem)."""
+
+    heal_type: str = "object"
+    bucket: str = ""
+    object: str = ""
+    version_id: str = ""
+    object_size: int = 0
+    data_blocks: int = 0
+    parity_blocks: int = 0
+    disk_count: int = 0
+    before: list[HealDriveState] = field(default_factory=list)
+    after: list[HealDriveState] = field(default_factory=list)
+    dry_run: bool = False
+    purged: bool = False
+
+    @property
+    def healed_count(self) -> int:
+        return sum(
+            1
+            for b, a in zip(self.before, self.after)
+            if b.state != DRIVE_STATE_OK and a.state == DRIVE_STATE_OK
+        )
+
+
+def latest_fileinfo(results: list) -> FileInfo | None:
+    """Elect the authoritative version: the FileInfo cohort with the newest
+    mod_time (reference listOnlineDisks modtime election,
+    cmd/erasure-healing-common.go:103). Returns None if no drive has one."""
+    valid = [r for r in results if isinstance(r, FileInfo)]
+    if not valid:
+        return None
+    latest_mt = max(fi.mod_time for fi in valid)
+    cohort = [fi for fi in valid if fi.mod_time == latest_mt]
+    # Prefer an entry carrying erasure geometry (a data-holding drive).
+    for fi in cohort:
+        if fi.deleted or fi.erasure.data_blocks:
+            return fi
+    return cohort[0]
+
+
+def _same_version(fi: FileInfo, latest: FileInfo) -> bool:
+    return (
+        fi.mod_time == latest.mod_time
+        and fi.data_dir == latest.data_dir
+        and fi.version_id == latest.version_id
+        and fi.deleted == latest.deleted
+    )
+
+
+class _ShardWriterPool:
+    """Fan-out writer: one streaming create_file per (target drive, part),
+    fed from queues — the healing analogue of PutObject's fan-out."""
+
+    def __init__(self, drives_by_pos: dict[int, object], sys_vol: str, tmp_dirs: dict[int, str]):
+        self.sys_vol = sys_vol
+        self.tmp_dirs = tmp_dirs
+        self.drives = drives_by_pos
+        self.queues: dict[int, queue.Queue] = {}
+        self.threads: dict[int, threading.Thread] = {}
+        self.errs: dict[int, Exception | None] = {pos: None for pos in drives_by_pos}
+
+    def start_part(self, part_number: int) -> None:
+        for pos, drive in self.drives.items():
+            if self.errs[pos] is not None:
+                continue
+            q: queue.Queue = queue.Queue(maxsize=4)
+            self.queues[pos] = q
+
+            def writer(pos=pos, drive=drive, q=q):
+                def gen():
+                    while True:
+                        chunk = q.get()
+                        if chunk is None:
+                            return
+                        yield chunk
+
+                try:
+                    drive.create_file(
+                        self.sys_vol, f"{self.tmp_dirs[pos]}/part.{part_number}", gen()
+                    )
+                except Exception as e:  # noqa: BLE001 - per-drive failure is data
+                    self.errs[pos] = e
+                    while q.get() is not None:
+                        pass
+
+            t = threading.Thread(target=writer, daemon=True)
+            self.threads[pos] = t
+            t.start()
+
+    def put(self, pos: int, framed: bytes) -> None:
+        q = self.queues.get(pos)
+        if q is not None:
+            q.put(framed)
+
+    def finish_part(self) -> None:
+        for q in self.queues.values():
+            q.put(None)
+        for t in self.threads.values():
+            t.join()
+        self.queues.clear()
+        self.threads.clear()
+
+
+class HealingMixin:
+    """Healing entry points for ErasureObjects (self provides drives, parity,
+    codec config, bitrot_algorithm)."""
+
+    # -- bucket heal (reference healBucket, cmd/erasure-healing.go:56) --
+
+    def heal_bucket(self, bucket: str, dry_run: bool = False) -> HealResultItem:
+        results = parallel_map([lambda d=d: d.stat_vol(bucket) for d in self.drives])
+        res = HealResultItem(heal_type="bucket", bucket=bucket,
+                             disk_count=self.n, dry_run=dry_run)
+        have = [not isinstance(r, Exception) for r in results]
+        for i, ok in enumerate(have):
+            st = DRIVE_STATE_OK if ok else (
+                DRIVE_STATE_MISSING
+                if isinstance(results[i], se.VolumeNotFound)
+                else DRIVE_STATE_OFFLINE
+            )
+            res.before.append(HealDriveState(self.drives[i].endpoint(), st))
+        if not any(have):
+            raise se.BucketNotFound(bucket)
+        res.after = [HealDriveState(s.endpoint, s.state) for s in res.before]
+        if dry_run:
+            return res
+        for i, ok in enumerate(have):
+            if ok or not isinstance(results[i], se.VolumeNotFound):
+                continue
+            try:
+                self.drives[i].make_vol(bucket)
+                res.after[i].state = DRIVE_STATE_OK
+            except se.VolumeExists:
+                res.after[i].state = DRIVE_STATE_OK
+            except se.StorageError:
+                pass
+        return res
+
+    # -- object heal (reference healObject, cmd/erasure-healing.go:233) --
+
+    def heal_object(
+        self,
+        bucket: str,
+        obj: str,
+        version_id: str = "",
+        dry_run: bool = False,
+        remove_dangling: bool = True,
+        scan_deep: bool = False,
+    ) -> HealResultItem:
+        results = parallel_map(
+            [lambda d=d: d.read_version(bucket, obj, version_id) for d in self.drives]
+        )
+        latest = latest_fileinfo(results)
+        if latest is None:
+            if all(isinstance(r, (se.FileNotFound, se.FileVersionNotFound)) for r in results):
+                raise se.ObjectNotFound(bucket, obj)
+            raise se.InsufficientReadQuorum(bucket, obj, "no readable metadata")
+
+        if latest.deleted or not latest.erasure.distribution:
+            return self._heal_metadata_only(bucket, obj, latest, results, dry_run)
+
+        dist = latest.erasure.distribution
+        k = latest.erasure.data_blocks
+        n = len(dist)
+        shuffled_drives = shuffle_by_distribution(self.drives, dist)
+        shuffled_results = shuffle_by_distribution(results, dist)
+
+        states = self._classify(bucket, obj, latest, shuffled_drives,
+                                shuffled_results, scan_deep)
+
+        res = HealResultItem(
+            bucket=bucket, object=obj, version_id=latest.version_id,
+            object_size=latest.size, data_blocks=k,
+            parity_blocks=latest.erasure.parity_blocks,
+            disk_count=self.n, dry_run=dry_run,
+            before=[HealDriveState(d.endpoint(), s) for d, s in zip(shuffled_drives, states)],
+        )
+        res.after = [HealDriveState(s.endpoint, s.state) for s in res.before]
+
+        avail = [i for i, s in enumerate(states) if s == DRIVE_STATE_OK]
+        targets = [i for i, s in enumerate(states)
+                   if s in (DRIVE_STATE_MISSING, DRIVE_STATE_CORRUPT, DRIVE_STATE_OUTDATED)]
+
+        if len(avail) < k:
+            # Can this object ever be healed? If missing-metadata drives alone
+            # exceed parity, no quorum is reachable: dangling
+            # (reference isObjectDangling, cmd/erasure-healing.go:758).
+            notfound = sum(
+                1 for r in results
+                if isinstance(r, (se.FileNotFound, se.FileVersionNotFound))
+            )
+            if notfound > latest.erasure.parity_blocks and remove_dangling:
+                if not dry_run:
+                    self._purge_dangling(bucket, obj, latest)
+                    res.purged = True
+                return res
+            raise se.InsufficientReadQuorum(
+                bucket, obj, f"{len(avail)} of {k} shards available"
+            )
+
+        if not targets or dry_run:
+            return res
+
+        if latest.inline_data:
+            self._heal_write_metadata(bucket, obj, latest, shuffled_drives, targets, res)
+            return res
+
+        healed = self._reconstruct_to_targets(
+            bucket, obj, latest, shuffled_drives, avail, targets
+        )
+        for pos in healed:
+            res.after[pos].state = DRIVE_STATE_OK
+        return res
+
+    # -- classification (reference disksWithAllParts,
+    #    cmd/erasure-healing-common.go:161) --
+
+    def _classify(self, bucket, obj, latest, shuffled_drives, shuffled_results,
+                  scan_deep) -> list[str]:
+        states: list[str] = []
+        checks = []
+        for pos, (drive, r) in enumerate(zip(shuffled_drives, shuffled_results)):
+            if isinstance(r, (se.FileNotFound, se.FileVersionNotFound)):
+                states.append(DRIVE_STATE_MISSING)
+                checks.append(None)
+            elif isinstance(r, Exception):
+                states.append(DRIVE_STATE_OFFLINE)
+                checks.append(None)
+            elif not _same_version(r, latest):
+                states.append(DRIVE_STATE_OUTDATED)
+                checks.append(None)
+            else:
+                states.append(DRIVE_STATE_OK)
+                if latest.inline_data:
+                    checks.append(None)
+                elif scan_deep:
+                    checks.append(lambda d=drive: d.verify_file(bucket, obj, latest))
+                else:
+                    checks.append(lambda d=drive: d.check_parts(bucket, obj, latest))
+        to_run = [(i, c) for i, c in enumerate(checks) if c is not None]
+        outcomes = parallel_map([c for _, c in to_run])
+        for (i, _), out in zip(to_run, outcomes):
+            if isinstance(out, Exception):
+                states[i] = (
+                    DRIVE_STATE_CORRUPT
+                    if isinstance(out, (se.FileCorrupt, se.FileNotFound))
+                    else DRIVE_STATE_OFFLINE
+                )
+        return states
+
+    # -- reconstruction core --
+
+    def _reconstruct_to_targets(self, bucket, obj, latest, shuffled_drives,
+                                avail, targets) -> list[int]:
+        """Rebuild every part's shards for the target positions; returns the
+        positions successfully healed (committed via rename_data)."""
+        k = latest.erasure.data_blocks
+        m = latest.erasure.parity_blocks
+        n = k + m
+        codec = ErasureCodec(k, m, latest.erasure.block_size)
+        shard_size = codec.shard_size()
+        algo = next((c.algorithm for c in latest.erasure.checksums),
+                    self.bitrot_algorithm)
+        bitrot_algo = bitrot.get_algorithm(algo)
+        sys_vol = ".mtpu.sys"
+
+        tmp_dirs = {pos: f"tmp/heal-{latest.data_dir}-{pos}" for pos in targets}
+        pool = _ShardWriterPool(
+            {pos: shuffled_drives[pos] for pos in targets}, sys_vol, tmp_dirs
+        )
+
+        chosen = avail[:k]
+        try:
+            for part in latest.parts:
+                shard_data_size = latest.erasure.shard_file_size(part.size)
+                rel = f"{obj}/{latest.data_dir}/part.{part.number}"
+                readers = {}
+                for pos in chosen:
+                    f = shuffled_drives[pos].read_file_stream(bucket, rel)
+                    readers[pos] = bitrot.BitrotReader(f, shard_data_size, shard_size, algo)
+                pool.start_part(part.number)
+                try:
+                    n_blocks = max(1, -(-part.size // latest.erasure.block_size))
+                    bi = 0
+                    while bi < n_blocks:
+                        batch_ids = list(range(bi, min(bi + self.batch_blocks, n_blocks)))
+                        block_lens = [
+                            min(latest.erasure.block_size,
+                                part.size - b * latest.erasure.block_size)
+                            for b in batch_ids
+                        ]
+                        rows = []
+                        for j, b in enumerate(batch_ids):
+                            chunk_len = -(-block_lens[j] // k)
+                            row: list[bytes | None] = [None] * n
+                            for pos in chosen:
+                                row[pos] = readers[pos].read_at(b * shard_size, chunk_len)
+                            rows.append(row)
+                        rebuilt = codec.decode_blocks(rows, block_lens, need_all=True)
+                        for j in range(len(batch_ids)):
+                            for pos in targets:
+                                chunk = rebuilt[j][pos]
+                                pool.put(pos, bitrot_algo.digest(chunk) + chunk)
+                        bi = batch_ids[-1] + 1
+                finally:
+                    for r in readers.values():
+                        try:
+                            r.src.close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                    pool.finish_part()
+        except Exception:
+            for pos in targets:
+                try:
+                    shuffled_drives[pos].delete(sys_vol, tmp_dirs[pos], recursive=True)
+                except se.StorageError:
+                    pass
+            raise
+
+        healed = []
+        for pos in targets:
+            if pool.errs[pos] is not None:
+                continue
+            fi = _clone_fi(latest, pos + 1)
+            try:
+                shuffled_drives[pos].rename_data(sys_vol, tmp_dirs[pos], fi, bucket, obj)
+                healed.append(pos)
+            except se.StorageError:
+                try:
+                    shuffled_drives[pos].delete(sys_vol, tmp_dirs[pos], recursive=True)
+                except se.StorageError:
+                    pass
+        return healed
+
+    # -- metadata-only heals (delete markers, inline objects) --
+
+    def _heal_metadata_only(self, bucket, obj, latest, results, dry_run) -> HealResultItem:
+        res = HealResultItem(
+            bucket=bucket, object=obj, version_id=latest.version_id,
+            object_size=latest.size, disk_count=self.n, dry_run=dry_run,
+        )
+        targets = []
+        for i, r in enumerate(results):
+            if isinstance(r, FileInfo) and _same_version(r, latest):
+                st = DRIVE_STATE_OK
+            elif isinstance(r, (se.FileNotFound, se.FileVersionNotFound)) or isinstance(
+                r, FileInfo
+            ):
+                st = DRIVE_STATE_MISSING
+                targets.append(i)
+            else:
+                st = DRIVE_STATE_OFFLINE
+            res.before.append(HealDriveState(self.drives[i].endpoint(), st))
+        res.after = [HealDriveState(s.endpoint, s.state) for s in res.before]
+        if dry_run:
+            return res
+        self._heal_write_metadata(bucket, obj, latest, self.drives, targets, res,
+                                  positions_are_physical=True)
+        return res
+
+    def _heal_write_metadata(self, bucket, obj, latest, drives, targets, res,
+                             positions_are_physical=False):
+        def write(pos):
+            fi = _clone_fi(latest, 0 if positions_are_physical else pos + 1)
+            if latest.deleted:
+                drives[pos].delete_version(bucket, obj, fi)
+            else:
+                drives[pos].write_metadata(bucket, obj, fi)
+
+        outcomes = parallel_map([lambda p=p: write(p) for p in targets])
+        for pos, out in zip(targets, outcomes):
+            if not isinstance(out, Exception):
+                res.after[pos].state = DRIVE_STATE_OK
+
+    # -- dangling purge (reference purgeObjectDangling,
+    #    cmd/erasure-healing.go:700) --
+
+    def _purge_dangling(self, bucket: str, obj: str, latest: FileInfo) -> None:
+        target = FileInfo(volume=bucket, name=obj, version_id=latest.version_id,
+                          data_dir=latest.data_dir)
+        parallel_map(
+            [lambda d=d: d.delete_version(bucket, obj, target) for d in self.drives]
+        )
+
+
+class MRFHealer:
+    """Most-recently-failed heal queue (reference mrfOpCh, cmd/erasure.go:41-75):
+    partial writes and corrupt reads enqueue here; a background worker retries
+    the heal out of band."""
+
+    def __init__(self, er, maxsize: int = 10000):
+        self.er = er
+        self.q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self._seen_lock = threading.Lock()
+        self._pending: set[tuple[str, str, str]] = set()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def add_partial(self, bucket: str, obj: str, version_id: str = "") -> None:
+        key = (bucket, obj, version_id)
+        with self._seen_lock:
+            if key in self._pending:
+                return
+            self._pending.add(key)
+        try:
+            self.q.put_nowait(key)
+        except queue.Full:
+            with self._seen_lock:
+                self._pending.discard(key)
+
+    def _drain(self) -> None:
+        while not self._stop.is_set():
+            try:
+                key = self.q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            bucket, obj, version_id = key
+            try:
+                self.er.heal_object(bucket, obj, version_id)
+            except Exception:  # noqa: BLE001 - best-effort background heal
+                pass
+            finally:
+                with self._seen_lock:
+                    self._pending.discard(key)
+                self.q.task_done()
+
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        """Testing hook: block until the queue drains."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            with self._seen_lock:
+                if not self._pending and self.q.empty():
+                    return True
+            _time.sleep(0.01)
+        return False
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+def _clone_fi(fi: FileInfo, index: int) -> FileInfo:
+    import copy
+
+    out = copy.deepcopy(fi)
+    out.erasure.index = index
+    return out

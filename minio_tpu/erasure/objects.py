@@ -27,6 +27,7 @@ import uuid
 from typing import BinaryIO, Iterator
 
 from minio_tpu.erasure.codec import DEFAULT_BLOCK_SIZE, ErasureCodec
+from minio_tpu.erasure.healing import HealingMixin, MRFHealer
 from minio_tpu.erasure.metadata import (
     find_fileinfo_in_quorum,
     hash_order,
@@ -84,7 +85,7 @@ def default_parity(n_drives: int) -> int:
     return 4
 
 
-class ErasureObjects:
+class ErasureObjects(HealingMixin):
     def __init__(
         self,
         drives: list[StorageAPI],
@@ -92,6 +93,7 @@ class ErasureObjects:
         block_size: int = DEFAULT_BLOCK_SIZE,
         batch_blocks: int = 8,
         bitrot_algorithm: str = bitrot.DEFAULT_ALGORITHM,
+        enable_mrf: bool = False,
     ):
         if not drives:
             raise ValueError("empty drive set")
@@ -103,6 +105,11 @@ class ErasureObjects:
         self.block_size = block_size
         self.batch_blocks = batch_blocks
         self.bitrot_algorithm = bitrot_algorithm
+        self.mrf: MRFHealer | None = MRFHealer(self) if enable_mrf else None
+
+    def close(self) -> None:
+        if self.mrf is not None:
+            self.mrf.close()
 
     # ------------------------------------------------------------------
     # buckets (cmd/erasure-bucket.go)
@@ -309,6 +316,10 @@ class ErasureObjects:
                 [lambda d=d: d.delete(sys_vol, tmp_rel, recursive=True) for d in shuffled]
             )
             raise
+        # Partial success: quorum met but some drive missed the write — queue
+        # it for background heal (reference addPartial, cmd/erasure-object.go:1150).
+        if self.mrf is not None and any(isinstance(o, Exception) for o in outcomes):
+            self.mrf.add_partial(bucket, obj, fi.version_id)
         return self._fi_to_object_info(bucket, obj, fi)
 
     # ------------------------------------------------------------------
@@ -391,38 +402,46 @@ class ErasureObjects:
                 raise se.InsufficientReadQuorum(bucket, obj, "not enough live shards")
             return sorted(chosen)
 
-        bi = first_block
-        while bi <= last_block:
-            batch_ids = list(range(bi, min(bi + self.batch_blocks, last_block + 1)))
-            block_lens = [
-                min(fi.erasure.block_size, fi.size - b * fi.erasure.block_size)
-                for b in batch_ids
-            ]
-            while True:
-                chosen = ensure_readers()
-                try:
-                    rows = self._read_chunk_rows(
-                        readers, chosen, batch_ids, block_lens, codec, n, dead
-                    )
-                    break
-                except se.StorageError:
-                    continue  # a reader died; re-choose and retry the batch
-            decoded = codec.decode_blocks(rows, block_lens)
-            for j, b in enumerate(batch_ids):
-                block = b"".join(decoded[j])[: block_lens[j]]
-                blk_start = b * fi.erasure.block_size
-                lo = max(offset, blk_start) - blk_start
-                hi = min(offset + length, blk_start + block_lens[j]) - blk_start
-                if hi > lo:
-                    yield block[lo:hi]
-            bi = batch_ids[-1] + 1
-
-        for r in readers:
-            if r is not None:
-                try:
-                    r.src.close()
-                except Exception:  # noqa: BLE001
-                    pass
+        try:
+            bi = first_block
+            while bi <= last_block:
+                batch_ids = list(range(bi, min(bi + self.batch_blocks, last_block + 1)))
+                block_lens = [
+                    min(fi.erasure.block_size, fi.size - b * fi.erasure.block_size)
+                    for b in batch_ids
+                ]
+                while True:
+                    chosen = ensure_readers()
+                    try:
+                        rows = self._read_chunk_rows(
+                            readers, chosen, batch_ids, block_lens, codec, n, dead
+                        )
+                        break
+                    except se.StorageError:
+                        continue  # a reader died; re-choose and retry the batch
+                decoded = codec.decode_blocks(rows, block_lens)
+                for j, b in enumerate(batch_ids):
+                    block = b"".join(decoded[j])[: block_lens[j]]
+                    blk_start = b * fi.erasure.block_size
+                    lo = max(offset, blk_start) - blk_start
+                    hi = min(offset + length, blk_start + block_lens[j]) - blk_start
+                    if hi > lo:
+                        yield block[lo:hi]
+                bi = batch_ids[-1] + 1
+        finally:
+            # Runs on normal completion AND early close (GeneratorExit) —
+            # callers that read exactly length bytes leave the generator
+            # paused, so cleanup cannot live after the loop.
+            for r in readers:
+                if r is not None:
+                    try:
+                        r.src.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+            # Served the read but some shard was dead/corrupt: one-shot heal
+            # trigger (reference cmd/erasure-object.go:321-344).
+            if dead and self.mrf is not None:
+                self.mrf.add_partial(bucket, obj, fi.version_id)
 
     def _read_chunk_rows(self, readers, chosen, batch_ids, block_lens, codec, n, dead):
         """Read one batch of chunk rows from the chosen shards; marks dead

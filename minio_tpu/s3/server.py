@@ -122,9 +122,13 @@ class S3Server:
                                     content_type=XML_TYPE, headers=hdr)
             raise S3Error("MethodNotAllowed", resource=path)
 
+        # Auth params travel in the query on presigned requests; they are not
+        # S3 subresources and must not affect routing.
+        sub = {k for k in q if not k.startswith("X-Amz-")}
+
         # ---------- bucket level ----------
         if not key:
-            if m == "PUT" and not q:
+            if m == "PUT" and not sub:
                 await run(self.obj.make_bucket, bucket)
                 return web.Response(status=200, headers={**hdr, "Location": f"/{bucket}"})
             if m == "HEAD":
@@ -237,9 +241,18 @@ class S3Server:
         decoded_len = request.headers.get("x-amz-decoded-content-length")
         streaming = payload_hash == sigv4.STREAMING_PAYLOAD
         if streaming:
+            if auth_sig is None:
+                # Chunk signatures chain off the header-auth seed signature;
+                # a presigned URL has none, so streaming is undefined there.
+                raise S3Error("InvalidArgument",
+                              "streaming payload requires header authorization")
             if decoded_len is None:
                 raise S3Error("MissingContentLength")
-            size = int(decoded_len)
+            try:
+                size = int(decoded_len)
+            except ValueError:
+                raise S3Error("InvalidArgument",
+                              "malformed x-amz-decoded-content-length") from None
         if size > MAX_OBJECT_SIZE:
             raise S3Error("EntityTooLarge")
 
@@ -311,8 +324,16 @@ class S3Server:
         opts.user_defined = user_defined
 
         reader = _IterReader(stream)
-        new_info = await run(self.obj.put_object, bucket, key, reader,
-                             info.size, opts)
+        try:
+            new_info = await run(self.obj.put_object, bucket, key, reader,
+                                 info.size, opts)
+        finally:
+            # put_object reads exactly info.size bytes, leaving the source
+            # generator paused before its cleanup — drive close() so shard
+            # readers release and heal triggers fire.
+            close = getattr(stream, "close", None)
+            if close is not None:
+                await run(close)
         return web.Response(body=xmlutil.copy_object_xml(new_info.etag,
                                                          new_info.mod_time),
                             content_type=XML_TYPE, headers=hdr)
@@ -451,10 +472,10 @@ def _parse_range(value: str, size: int) -> tuple[int, int]:
 def _check_conditional(request, info) -> bool:
     """Returns True for a 304 Not Modified outcome; raises for 412."""
     im = request.headers.get("If-Match")
-    if im and im.strip('"') != info.etag:
+    if im and im != "*" and im.strip('"') != info.etag:
         raise S3Error("PreconditionFailed", "ETag does not match If-Match")
     inm = request.headers.get("If-None-Match")
-    if inm and inm.strip('"') == info.etag:
+    if inm and (inm == "*" or inm.strip('"') == info.etag):
         if request.method in ("GET", "HEAD"):
             return True  # cache revalidation hit
         raise S3Error("PreconditionFailed", "ETag matches If-None-Match")

@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 from typing import BinaryIO, Iterable, Iterator
 
 from minio_tpu.storage.fileinfo import FileInfo
+from minio_tpu.utils.errors import FileCorrupt as FileCorruptError
 
 
 @dataclass
@@ -167,6 +168,27 @@ class StorageAPI(abc.ABC):
     def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
         """Deep bitrot verify of every part this drive holds (reference
         VerifyFile, cmd/xl-storage.go:2179)."""
+
+    def check_parts(self, volume: str, path: str, fi: FileInfo) -> None:
+        """Shallow part-presence check: every part file exists with exactly
+        the bitrot-framed size (reference CheckParts, cmd/xl-storage.go).
+        Raises FileNotFound / FileCorrupt."""
+        from minio_tpu.ops import bitrot
+
+        algo = next((c.algorithm for c in fi.erasure.checksums),
+                    bitrot.DEFAULT_ALGORITHM)
+        shard_size = fi.erasure.shard_size()
+        for part in fi.parts:
+            expected = bitrot.bitrot_shard_file_size(
+                fi.erasure.shard_file_size(part.size), shard_size, algo
+            )
+            rel = f"{path}/{fi.data_dir}/part.{part.number}"
+            with self.read_file_stream(volume, rel) as f:
+                f.seek(0, 2)
+                if f.tell() != expected:
+                    raise FileCorruptError(
+                        f"{volume}/{rel}: size {f.tell()} != expected {expected}"
+                    )
 
     @abc.abstractmethod
     def walk_dir(self, volume: str, prefix: str = "") -> Iterator[WalkEntry]:

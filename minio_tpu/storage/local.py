@@ -395,12 +395,27 @@ class LocalDrive(StorageAPI):
         os.makedirs(obj_dir, exist_ok=True)
         if fi.data_dir:
             dst_data = os.path.join(obj_dir, fi.data_dir)
+            # Healing overwrites an existing (corrupt/stale) data dir.
+            # os.replace cannot clobber a non-empty dir, so move the old one
+            # aside first and only discard it after the new data is in place —
+            # a failed rename must never leave the drive with less data than
+            # it had.
+            aside = None
+            if os.path.isdir(dst_data):
+                aside = dst_data + f".old.{uuid.uuid4().hex}"
+                os.replace(dst_data, aside)
             try:
                 os.replace(src_dir, dst_data)
             except FileNotFoundError:
+                if aside:
+                    os.replace(aside, dst_data)
                 raise se.FileNotFound(f"{src_volume}/{src_path}") from None
             except OSError as e:
+                if aside:
+                    os.replace(aside, dst_data)
                 raise se.FaultyDisk(str(e)) from e
+            if aside:
+                shutil.rmtree(aside, ignore_errors=True)
         try:
             meta = self._load_meta(dst_volume, dst_path)
         except se.FileNotFound:
