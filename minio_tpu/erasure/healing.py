@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import uuid
 from dataclasses import dataclass, field
 
 from minio_tpu.erasure.codec import ErasureCodec
@@ -314,7 +315,10 @@ class HealingMixin:
         bitrot_algo = bitrot.get_algorithm(algo)
         sys_vol = ".mtpu.sys"
 
-        tmp_dirs = {pos: f"tmp/heal-{latest.data_dir}-{pos}" for pos in targets}
+        # Unique per invocation: concurrent heals of the same object (MRF
+        # worker + admin heal) must never share tmp files.
+        heal_id = uuid.uuid4().hex
+        tmp_dirs = {pos: f"tmp/heal-{heal_id}-{pos}" for pos in targets}
         pool = _ShardWriterPool(
             {pos: shuffled_drives[pos] for pos in targets}, sys_vol, tmp_dirs
         )

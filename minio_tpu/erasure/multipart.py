@@ -1,0 +1,353 @@
+"""Multipart uploads: per-part erasure streams composed at complete time.
+
+Role-equivalent of cmd/erasure-multipart.go: an upload session lives under
+the sys volume at multipart/<key-hash>/<upload-id>/ on every drive of the
+set; each part is an independent erasure+bitrot stream (PutObjectPart
+:379); CompleteMultipartUpload validates the client's part list against the
+stored part metadata, moves the part shard files into a fresh data dir and
+commits the final version journal with the same rename discipline as
+PutObject (:727). Parts keep their client-assigned numbers end to end; the
+GET path walks fi.parts in order, so sparse numbering is fine.
+
+TPU note: every part reuses the batched codec fan-out (_fan_out_encode), so
+concurrent part uploads become independent batched device streams — the P9
+axis in SURVEY.md §2.4.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+import uuid
+from typing import BinaryIO
+
+from minio_tpu.erasure.codec import ErasureCodec
+from minio_tpu.erasure.metadata import hash_order, parallel_map, shuffle_by_distribution
+from minio_tpu.erasure.types import (
+    CompletePart,
+    MultipartInfo,
+    ObjectInfo,
+    ObjectOptions,
+    PartInfoResult,
+)
+from minio_tpu.storage.fileinfo import ChecksumInfo, ErasureInfo, FileInfo, PartInfo
+from minio_tpu.utils import errors as se
+from minio_tpu.utils.quorum import reduce_write_quorum
+
+SYS_VOL = ".mtpu.sys"
+MP_ROOT = "multipart"
+MIN_PART_SIZE = 5 << 20  # S3 minimum for all but the last part
+MAX_PARTS = 10_000
+
+
+def _key_hash(bucket: str, obj: str) -> str:
+    return hashlib.sha256(f"{bucket}/{obj}".encode()).hexdigest()[:32]
+
+
+def multipart_etag(part_etags: list[str]) -> str:
+    """S3 multipart ETag: md5 over the binary concatenation of part MD5s,
+    suffixed with the part count."""
+    md5 = hashlib.md5()
+    for e in part_etags:
+        md5.update(bytes.fromhex(e))
+    return f"{md5.hexdigest()}-{len(part_etags)}"
+
+
+class MultipartMixin:
+    """Multipart entry points for ErasureObjects."""
+
+    # -- session helpers --
+
+    def _mp_dir(self, bucket: str, obj: str, upload_id: str) -> str:
+        return f"{MP_ROOT}/{_key_hash(bucket, obj)}/{upload_id}"
+
+    def _elect_json(self, rel: str) -> dict | None:
+        """Read a small JSON doc from every drive and elect the majority
+        payload; ties break toward the newer mod_time. Guards against a
+        drive that missed a rewrite within write tolerance serving stale
+        state."""
+        results = parallel_map(
+            [lambda d=d: d.read_all(SYS_VOL, rel) for d in self.drives]
+        )
+        tally: dict[bytes, int] = {}
+        for r in results:
+            if isinstance(r, (bytes, bytearray)):
+                tally[bytes(r)] = tally.get(bytes(r), 0) + 1
+        if not tally:
+            return None
+
+        def rank(raw: bytes):
+            try:
+                mt = json.loads(raw).get("mod_time", 0.0)
+            except ValueError:
+                return (-1, 0.0)
+            return (tally[raw], mt)
+
+        best = max(tally, key=rank)
+        try:
+            return json.loads(best)
+        except ValueError:
+            return None
+
+    def _read_mp_meta(self, bucket: str, obj: str, upload_id: str) -> dict:
+        mp = self._mp_dir(bucket, obj, upload_id)
+        meta = self._elect_json(f"{mp}/upload.json")
+        if meta is not None and meta.get("bucket") == bucket \
+                and meta.get("object") == obj:
+            return meta
+        raise se.InvalidUploadID(bucket, obj, f"upload {upload_id} not found")
+
+    # -- API --
+
+    def new_multipart_upload(self, bucket: str, obj: str,
+                             opts: ObjectOptions | None = None) -> str:
+        opts = opts or ObjectOptions()
+        self.get_bucket_info(bucket)
+        upload_id = uuid.uuid4().hex
+        dist = hash_order(f"{bucket}/{obj}", self.n)
+
+        m = self.parity
+        sc = opts.user_defined.get("x-amz-storage-class", "")
+        if sc == "REDUCED_REDUNDANCY" and self.n >= 4:
+            m = max(1, m - 2)
+
+        meta = {
+            "bucket": bucket,
+            "object": obj,
+            "upload_id": upload_id,
+            "initiated": time.time(),
+            "user_defined": dict(opts.user_defined),
+            "distribution": dist,
+            "parity": m,
+            "block_size": self.block_size,
+            "bitrot": self.bitrot_algorithm,
+        }
+        raw = json.dumps(meta).encode()
+        mp = self._mp_dir(bucket, obj, upload_id)
+        results = parallel_map(
+            [lambda d=d: d.write_all(SYS_VOL, f"{mp}/upload.json", raw)
+             for d in self.drives]
+        )
+        reduce_write_quorum(results, self._write_quorum_meta(), bucket, obj)
+        return upload_id
+
+    def put_object_part(self, bucket: str, obj: str, upload_id: str,
+                        part_number: int, data: BinaryIO, size: int = -1,
+                        opts: ObjectOptions | None = None) -> PartInfoResult:
+        if not 1 <= part_number <= MAX_PARTS:
+            raise se.InvalidPart(bucket, obj, f"part number {part_number}")
+        meta = self._read_mp_meta(bucket, obj, upload_id)
+        k = self.n - meta["parity"]
+        write_quorum = self._write_quorum_data(meta["parity"])
+        codec = ErasureCodec(k, meta["parity"], meta["block_size"])
+        shuffled = shuffle_by_distribution(self.drives, meta["distribution"])
+        mp = self._mp_dir(bucket, obj, upload_id)
+
+        # Encode into a tmp name, then atomically rename into the session so
+        # a re-upload of the same part number can never interleave shards.
+        tmp_rel = f"{mp}/tmp-{uuid.uuid4().hex}"
+        total, md5_hex, errs = self._fan_out_encode(
+            shuffled, SYS_VOL, tmp_rel, data, size, codec, write_quorum,
+            bucket, obj,
+        )
+        if size >= 0 and total != size:
+            parallel_map([lambda d=d: d.delete(SYS_VOL, tmp_rel) for d in shuffled])
+            raise se.IncompleteBody(bucket, obj, f"got {total} of {size} bytes")
+
+        mod_time = time.time()
+
+        def commit(i, drive):
+            if errs[i] is not None:
+                raise errs[i]
+            drive.rename_file(SYS_VOL, tmp_rel, SYS_VOL, f"{mp}/part.{part_number}")
+            drive.write_all(
+                SYS_VOL, f"{mp}/part.{part_number}.json",
+                json.dumps({"size": total, "etag": md5_hex,
+                            "mod_time": mod_time}).encode(),
+            )
+
+        outcomes = parallel_map(
+            [lambda i=i, d=d: commit(i, d) for i, d in enumerate(shuffled)]
+        )
+        try:
+            reduce_write_quorum(outcomes, write_quorum, bucket, obj)
+        except Exception:
+            parallel_map([lambda d=d: d.delete(SYS_VOL, tmp_rel) for d in shuffled])
+            raise
+        return PartInfoResult(part_number, md5_hex, total, total, mod_time)
+
+    def list_parts(self, bucket: str, obj: str, upload_id: str,
+                   part_marker: int = 0, max_parts: int = 1000) -> list[PartInfoResult]:
+        mp = self._mp_dir(bucket, obj, upload_id)
+        self._read_mp_meta(bucket, obj, upload_id)
+        # Union of part numbers across drives — a single drive may have
+        # missed a part write within quorum tolerance.
+        listings = parallel_map(
+            [lambda d=d: d.list_dir(SYS_VOL, mp) for d in self.drives]
+        )
+        numbers: set[int] = set()
+        for names in listings:
+            if isinstance(names, Exception):
+                continue
+            numbers.update(
+                int(n[5:-5]) for n in names
+                if n.startswith("part.") and n.endswith(".json")
+            )
+        out: list[PartInfoResult] = []
+        for num in sorted(numbers):
+            if num <= part_marker or len(out) >= max_parts:
+                continue
+            pj = self._elect_json(f"{mp}/part.{num}.json")
+            if pj is None:
+                continue
+            out.append(PartInfoResult(num, pj["etag"], pj["size"],
+                                      pj["size"], pj["mod_time"]))
+        return out
+
+    def list_multipart_uploads(self, bucket: str, prefix: str = "",
+                               max_uploads: int = 1000) -> list[MultipartInfo]:
+        self.get_bucket_info(bucket)
+        # Union of session dirs across all drives, then quorum-read each.
+        sessions: set[str] = set()
+        listings = parallel_map(
+            [lambda d=d: d.list_dir(SYS_VOL, MP_ROOT) for d in self.drives]
+        )
+        for i, hash_dirs in enumerate(listings):
+            if isinstance(hash_dirs, Exception):
+                continue
+            for hd in hash_dirs:
+                hd = hd.rstrip("/")
+                try:
+                    uploads = self.drives[i].list_dir(SYS_VOL, f"{MP_ROOT}/{hd}")
+                except se.StorageError:
+                    continue
+                sessions.update(f"{MP_ROOT}/{hd}/{u.rstrip('/')}" for u in uploads)
+        out: list[MultipartInfo] = []
+        for sess in sorted(sessions):
+            meta = self._elect_json(f"{sess}/upload.json")
+            if meta is None or meta.get("bucket") != bucket:
+                continue
+            if prefix and not meta.get("object", "").startswith(prefix):
+                continue
+            out.append(MultipartInfo(
+                bucket, meta["object"], meta["upload_id"],
+                meta.get("initiated", 0.0), meta.get("user_defined", {}),
+            ))
+            if len(out) >= max_uploads:
+                break
+        return sorted(out, key=lambda u: (u.object, u.initiated))
+
+    def abort_multipart_upload(self, bucket: str, obj: str, upload_id: str) -> None:
+        self._read_mp_meta(bucket, obj, upload_id)
+        mp = self._mp_dir(bucket, obj, upload_id)
+        parallel_map(
+            [lambda d=d: d.delete(SYS_VOL, mp, recursive=True) for d in self.drives]
+        )
+
+    def complete_multipart_upload(
+        self,
+        bucket: str,
+        obj: str,
+        upload_id: str,
+        parts: list[CompletePart],
+        opts: ObjectOptions | None = None,
+    ) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        meta = self._read_mp_meta(bucket, obj, upload_id)
+        if not parts:
+            raise se.InvalidPart(bucket, obj, "empty part list")
+        numbers = [p.part_number for p in parts]
+        if numbers != sorted(numbers) or len(set(numbers)) != len(numbers):
+            raise se.InvalidPart(bucket, obj, "parts out of order")
+
+        k = self.n - meta["parity"]
+        write_quorum = self._write_quorum_data(meta["parity"])
+        mp = self._mp_dir(bucket, obj, upload_id)
+        shuffled = shuffle_by_distribution(self.drives, meta["distribution"])
+
+        # Validate against stored part metadata (majority-elected).
+        stored: dict[int, dict] = {}
+        for p in parts:
+            pj = self._elect_json(f"{mp}/part.{p.part_number}.json")
+            if pj is None:
+                raise se.InvalidPart(bucket, obj, f"part {p.part_number} not uploaded")
+            if pj["etag"] != p.etag.strip('"'):
+                raise se.InvalidPart(bucket, obj, f"part {p.part_number} etag mismatch")
+            stored[p.part_number] = pj
+        for i, p in enumerate(parts[:-1]):
+            if stored[p.part_number]["size"] < MIN_PART_SIZE:
+                raise se.PartTooSmall(bucket, obj, f"part {p.part_number}")
+
+        mod_time = opts.mod_time or time.time()
+        fi = FileInfo.new(bucket, obj)
+        if opts.versioned:
+            fi.version_id = opts.version_id or str(uuid.uuid4())
+        fi.mod_time = mod_time
+        fi.metadata = dict(meta.get("user_defined", {}))
+        fi.metadata["etag"] = multipart_etag([p.etag.strip('"') for p in parts])
+        fi.size = sum(stored[p.part_number]["size"] for p in parts)
+        fi.parts = [
+            PartInfo(p.part_number, stored[p.part_number]["size"],
+                     stored[p.part_number]["size"], stored[p.part_number]["mod_time"],
+                     stored[p.part_number]["etag"])
+            for p in parts
+        ]
+        fi.erasure = ErasureInfo(
+            data_blocks=k,
+            parity_blocks=meta["parity"],
+            block_size=meta["block_size"],
+            distribution=meta["distribution"],
+            checksums=[ChecksumInfo(p.part_number, meta.get("bitrot", self.bitrot_algorithm))
+                       for p in parts],
+        )
+
+        tmp_rel = f"tmp/{uuid.uuid4().hex}"
+
+        def commit(i, drive):
+            for p in parts:
+                drive.rename_file(SYS_VOL, f"{mp}/part.{p.part_number}",
+                                  SYS_VOL, f"{tmp_rel}/part.{p.part_number}")
+            import copy
+
+            f = copy.deepcopy(fi)
+            f.erasure.index = i + 1
+            drive.rename_data(SYS_VOL, tmp_rel, f, bucket, obj)
+
+        outcomes = parallel_map(
+            [lambda i=i, d=d: commit(i, d) for i, d in enumerate(shuffled)]
+        )
+        try:
+            reduce_write_quorum(outcomes, write_quorum, bucket, obj)
+        except Exception:
+            # Quorum failed after parts may have moved into tmp: move them
+            # BACK into the session so the client can retry Complete —
+            # uploaded part data must never be destroyed by a transient
+            # failure.
+            def restore(drive):
+                for p in parts:
+                    try:
+                        drive.rename_file(SYS_VOL, f"{tmp_rel}/part.{p.part_number}",
+                                          SYS_VOL, f"{mp}/part.{p.part_number}")
+                    except se.StorageError:
+                        pass
+                try:
+                    drive.delete(SYS_VOL, tmp_rel, recursive=True)
+                except se.StorageError:
+                    pass
+
+            parallel_map([lambda d=d: restore(d) for d in shuffled])
+            raise
+        # Success: reclaim tmp leftovers on drives whose commit failed midway.
+        for i, o in enumerate(outcomes):
+            if isinstance(o, Exception):
+                try:
+                    shuffled[i].delete(SYS_VOL, tmp_rel, recursive=True)
+                except se.StorageError:
+                    pass
+        parallel_map(
+            [lambda d=d: d.delete(SYS_VOL, mp, recursive=True) for d in self.drives]
+        )
+        if self.mrf is not None and any(isinstance(o, Exception) for o in outcomes):
+            self.mrf.add_partial(bucket, obj, fi.version_id)
+        return self._fi_to_object_info(bucket, obj, fi)

@@ -28,6 +28,7 @@ from typing import BinaryIO, Iterator
 
 from minio_tpu.erasure.codec import DEFAULT_BLOCK_SIZE, ErasureCodec
 from minio_tpu.erasure.healing import HealingMixin, MRFHealer
+from minio_tpu.erasure.multipart import MultipartMixin
 from minio_tpu.erasure.metadata import (
     find_fileinfo_in_quorum,
     hash_order,
@@ -85,7 +86,7 @@ def default_parity(n_drives: int) -> int:
     return 4
 
 
-class ErasureObjects(HealingMixin):
+class ErasureObjects(HealingMixin, MultipartMixin):
     def __init__(
         self,
         drives: list[StorageAPI],
@@ -163,6 +164,12 @@ class ErasureObjects(HealingMixin):
     def _write_quorum_meta(self) -> int:
         return self.n // 2 + 1
 
+    def _write_quorum_data(self, parity: int) -> int:
+        """Data write quorum: k drives, +1 when k == m so two conflicting
+        half-writes can't both claim quorum (cmd/erasure-object.go:639-642)."""
+        k = self.n - parity
+        return k + (1 if k == parity else 0)
+
     # ------------------------------------------------------------------
     # put object (cmd/erasure-object.go:606-810)
     # ------------------------------------------------------------------
@@ -184,7 +191,7 @@ class ErasureObjects(HealingMixin):
         if sc == "REDUCED_REDUNDANCY" and self.n >= 4:
             m = max(1, m - 2)
         k = self.n - m
-        write_quorum = k + (1 if k == m else 0)
+        write_quorum = self._write_quorum_data(m)
 
         fi = FileInfo.new(bucket, obj)
         if opts.versioned:
@@ -232,64 +239,11 @@ class ErasureObjects(HealingMixin):
         # Streaming erasure path.
         tmp_rel = f"tmp/{uuid.uuid4().hex}"
         sys_vol = ".mtpu.sys"
-        shard_size = codec.shard_size()
 
-        qs: list[queue.Queue] = [queue.Queue(maxsize=4) for _ in range(self.n)]
-        errs: list[Exception | None] = [None] * self.n
-
-        def writer(i: int, drive: StorageAPI):
-            def gen():
-                while True:
-                    chunk = qs[i].get()
-                    if chunk is _WRITE_SENTINEL:
-                        return
-                    yield chunk
-            try:
-                drive.create_file(sys_vol, f"{tmp_rel}/part.1", gen())
-            except Exception as e:  # noqa: BLE001
-                errs[i] = e
-                # Drain so the producer never blocks on a dead drive.
-                while qs[i].get() is not _WRITE_SENTINEL:
-                    pass
-
-        threads = [
-            threading.Thread(target=writer, args=(i, d), daemon=True)
-            for i, d in enumerate(shuffled)
-        ]
-        for t in threads:
-            t.start()
-
-        bitrot_algo = bitrot.get_algorithm(self.bitrot_algorithm)
-
-        def feed(block_batch: list[bytes]) -> None:
-            encoded = codec.encode_blocks(block_batch)
-            for chunks in encoded:
-                for i in range(self.n):
-                    framed = bitrot_algo.digest(chunks[i]) + chunks[i]
-                    qs[i].put(framed)
-            alive = sum(1 for e in errs if e is None)
-            if alive < write_quorum:
-                raise se.InsufficientWriteQuorum(bucket, obj, "write fan-out lost quorum")
-
-        try:
-            batch: list[bytes] = []
-            block = first_block
-            while block:
-                md5.update(block)
-                total += len(block)
-                batch.append(block)
-                if len(batch) >= self.batch_blocks:
-                    feed(batch)
-                    batch = []
-                remaining = self.block_size if size < 0 else min(self.block_size, size - total)
-                block = _read_full(data, remaining)
-            if batch:
-                feed(batch)
-        finally:
-            for q in qs:
-                q.put(_WRITE_SENTINEL)
-            for t in threads:
-                t.join()
+        total, md5_hex, errs = self._fan_out_encode(
+            shuffled, sys_vol, f"{tmp_rel}/part.1", data, size, codec,
+            write_quorum, bucket, obj, initial=first_block,
+        )
 
         if size >= 0 and total != size:
             parallel_map(
@@ -298,7 +252,7 @@ class ErasureObjects(HealingMixin):
             raise se.IncompleteBody(bucket, obj, f"got {total} of {size} bytes")
 
         fi.size = total
-        fi.metadata.setdefault("etag", md5.hexdigest())
+        fi.metadata.setdefault("etag", md5_hex)
         fi.parts = [PartInfo(1, total, total, fi.mod_time)]
 
         def commit(i: int, drive: StorageAPI):
@@ -360,14 +314,34 @@ class ErasureObjects(HealingMixin):
 
     def _stream_erasure(self, bucket: str, obj: str, fi: FileInfo,
                         offset: int, length: int) -> Iterator[bytes]:
+        """Stream [offset, offset+length) across the object's parts — each
+        part is an independent erasure stream with its own shard files
+        (reference per-part decode loop, cmd/erasure-object.go:297-316)."""
+        if length == 0:
+            return
+        part_off = 0
+        for part in fi.parts:
+            part_end = part_off + part.size
+            if part_end <= offset:
+                part_off = part_end
+                continue
+            if part_off >= offset + length:
+                break
+            lo = max(offset, part_off) - part_off
+            hi = min(offset + length, part_end) - part_off
+            yield from self._stream_one_part(bucket, obj, fi, part, lo, hi - lo)
+            part_off = part_end
+
+    def _stream_one_part(self, bucket: str, obj: str, fi: FileInfo, part,
+                         offset: int, length: int) -> Iterator[bytes]:
         k = fi.erasure.data_blocks
         n = k + fi.erasure.parity_blocks
         codec = ErasureCodec(k, fi.erasure.parity_blocks, fi.erasure.block_size)
         shard_size = codec.shard_size()
         algo = next((c.algorithm for c in fi.erasure.checksums), self.bitrot_algorithm)
         shuffled = shuffle_by_distribution(self.drives, fi.erasure.distribution)
-        rel = f"{obj}/{fi.data_dir}/part.1"
-        shard_data_size = codec.shard_file_size(fi.size)
+        rel = f"{obj}/{fi.data_dir}/part.{part.number}"
+        shard_data_size = codec.shard_file_size(part.size)
 
         readers: list[bitrot.BitrotReader | None] = [None] * n
 
@@ -407,7 +381,7 @@ class ErasureObjects(HealingMixin):
             while bi <= last_block:
                 batch_ids = list(range(bi, min(bi + self.batch_blocks, last_block + 1)))
                 block_lens = [
-                    min(fi.erasure.block_size, fi.size - b * fi.erasure.block_size)
+                    min(fi.erasure.block_size, part.size - b * fi.erasure.block_size)
                     for b in batch_ids
                 ]
                 while True:
@@ -655,6 +629,88 @@ class ErasureObjects(HealingMixin):
     # ------------------------------------------------------------------
     # internals
     # ------------------------------------------------------------------
+
+    def _fan_out_encode(
+        self,
+        shuffled: list[StorageAPI],
+        vol: str,
+        rel: str,
+        data: BinaryIO,
+        size: int,
+        codec: ErasureCodec,
+        write_quorum: int,
+        bucket: str,
+        obj: str,
+        initial: bytes = b"",
+    ) -> tuple[int, str, list[Exception | None]]:
+        """Stream `data` through the batched codec, fanning bitrot-framed
+        shards to one create_file per drive (the io.Pipe + goroutine fan-out
+        of cmd/erasure-encode.go:36-70, collapsed into queues). Returns
+        (bytes consumed, md5 hex, per-drive errors). `initial` is a prefix
+        the caller already consumed from `data`."""
+        qs: list[queue.Queue] = [queue.Queue(maxsize=4) for _ in range(self.n)]
+        errs: list[Exception | None] = [None] * self.n
+
+        def writer(i: int, drive: StorageAPI):
+            def gen():
+                while True:
+                    chunk = qs[i].get()
+                    if chunk is _WRITE_SENTINEL:
+                        return
+                    yield chunk
+
+            try:
+                drive.create_file(vol, rel, gen())
+            except Exception as e:  # noqa: BLE001
+                errs[i] = e
+                # Drain so the producer never blocks on a dead drive.
+                while qs[i].get() is not _WRITE_SENTINEL:
+                    pass
+
+        threads = [
+            threading.Thread(target=writer, args=(i, d), daemon=True)
+            for i, d in enumerate(shuffled)
+        ]
+        for t in threads:
+            t.start()
+
+        bitrot_algo = bitrot.get_algorithm(self.bitrot_algorithm)
+        md5 = hashlib.md5()
+        total = 0
+
+        def feed(block_batch: list[bytes]) -> None:
+            encoded = codec.encode_blocks(block_batch)
+            for chunks in encoded:
+                for i in range(self.n):
+                    framed = bitrot_algo.digest(chunks[i]) + chunks[i]
+                    qs[i].put(framed)
+            alive = sum(1 for e in errs if e is None)
+            if alive < write_quorum:
+                raise se.InsufficientWriteQuorum(bucket, obj, "write fan-out lost quorum")
+
+        try:
+            bs = codec.block_size  # geometry travels with the codec, not self
+            batch: list[bytes] = []
+            block = initial or _read_full(
+                data, min(bs, size) if size >= 0 else bs
+            )
+            while block:
+                md5.update(block)
+                total += len(block)
+                batch.append(block)
+                if len(batch) >= self.batch_blocks:
+                    feed(batch)
+                    batch = []
+                remaining = bs if size < 0 else min(bs, size - total)
+                block = _read_full(data, remaining)
+            if batch:
+                feed(batch)
+        finally:
+            for q in qs:
+                q.put(_WRITE_SENTINEL)
+            for t in threads:
+                t.join()
+        return total, md5.hexdigest(), errs
 
     def _read_quorum_fileinfo(self, bucket: str, obj: str, version_id: str) -> FileInfo:
         results = parallel_map(

@@ -23,7 +23,7 @@ from typing import Iterator
 from aiohttp import web
 
 from minio_tpu.erasure import ErasureObjects
-from minio_tpu.erasure.types import ObjectOptions, ObjectToDelete
+from minio_tpu.erasure.types import CompletePart, ObjectOptions, ObjectToDelete
 from minio_tpu.s3 import sigv4, xmlutil
 from minio_tpu.s3.errors import S3Error, from_exception
 from minio_tpu.storage import LocalDrive
@@ -151,8 +151,12 @@ class S3Server:
                         body=xmlutil.list_versions_xml(bucket, q.get("prefix", ""), res),
                         content_type=XML_TYPE, headers=hdr)
                 if "uploads" in q:
+                    uploads = await run(
+                        self.obj.list_multipart_uploads, bucket,
+                        q.get("prefix", ""), _int_q(q, "max-uploads", 1000),
+                    )
                     return web.Response(
-                        body=xmlutil.list_uploads_xml(bucket, []),
+                        body=xmlutil.list_uploads_xml(bucket, uploads),
                         content_type=XML_TYPE, headers=hdr)
                 if "location" in q:
                     await run(self.obj.get_bucket_info, bucket)
@@ -204,6 +208,52 @@ class S3Server:
             await run(self.obj.delete_object_tags, bucket, key, opts)
             return web.Response(status=204, headers=hdr)
 
+        # ----- multipart (reference cmd/erasure-multipart.go via
+        #       object-handlers) -----
+        if m == "POST" and "uploads" in q:
+            user_defined = _metadata_headers(request)
+            mp_opts = ObjectOptions(user_defined=user_defined)
+            upload_id = await run(self.obj.new_multipart_upload, bucket, key, mp_opts)
+            return web.Response(
+                body=xmlutil.initiate_multipart_xml(bucket, key, upload_id),
+                content_type=XML_TYPE, headers=hdr)
+        if "uploadId" in q:
+            upload_id = q["uploadId"]
+            if m == "PUT":
+                part_number = _int_q(q, "partNumber", 0, lo=1, hi=10000)
+                src = request.headers.get("x-amz-copy-source")
+                if src:
+                    return await self._upload_part_copy(
+                        request, bucket, key, upload_id, part_number, src, hdr, run)
+                return await self._put_part(request, bucket, key, upload_id,
+                                            part_number, hdr, payload_hash,
+                                            auth_sig, run)
+            if m == "GET":
+                parts = await run(self.obj.list_parts, bucket, key, upload_id,
+                                  _int_q(q, "part-number-marker", 0),
+                                  _int_q(q, "max-parts", 1000))
+                return web.Response(
+                    body=xmlutil.list_parts_xml(bucket, key, upload_id, parts),
+                    content_type=XML_TYPE, headers=hdr)
+            if m == "DELETE":
+                await run(self.obj.abort_multipart_upload, bucket, key, upload_id)
+                return web.Response(status=204, headers=hdr)
+            if m == "POST":
+                body = await request.read()
+                pairs = xmlutil.parse_complete_multipart_xml(body)
+                if not pairs:
+                    raise S3Error("MalformedXML")
+                parts = [CompletePart(n, e) for n, e in pairs]
+                info = await run(self.obj.complete_multipart_upload, bucket,
+                                 key, upload_id, parts, opts)
+                extra = {}
+                if info.version_id:
+                    extra["x-amz-version-id"] = info.version_id
+                return web.Response(
+                    body=xmlutil.complete_multipart_xml(
+                        f"/{bucket}/{key}", bucket, key, info.etag),
+                    content_type=XML_TYPE, headers={**hdr, **extra})
+
         if m == "HEAD":
             info = await run(self.obj.get_object_info, bucket, key, opts)
             if _check_conditional(request, info):
@@ -226,14 +276,14 @@ class S3Server:
             if info.version_id:
                 extra["x-amz-version-id"] = info.version_id
             return web.Response(status=204, headers={**hdr, **extra})
-        if m == "POST" and ("uploads" in q or "uploadId" in q):
-            raise S3Error("NotImplemented", "multipart upload lands next milestone")
         raise S3Error("MethodNotAllowed", resource=path)
 
     # ------------------------------------------------------------------
 
-    async def _put_object(self, request, bucket, key, opts, hdr,
-                          payload_hash, auth_sig, run):
+    async def _spool_body(self, request, payload_hash, auth_sig):
+        """Stream the request body into a spooled temp file, verifying the
+        content sha256 or per-chunk streaming signatures. Returns
+        (spool, size); caller closes the spool."""
         if request.content_length is None and \
                 "x-amz-decoded-content-length" not in request.headers:
             raise S3Error("MissingContentLength")
@@ -255,18 +305,6 @@ class S3Server:
                               "malformed x-amz-decoded-content-length") from None
         if size > MAX_OBJECT_SIZE:
             raise S3Error("EntityTooLarge")
-
-        user_defined = {}
-        ct = request.headers.get("Content-Type")
-        if ct:
-            user_defined["content-type"] = ct
-        sc = request.headers.get("x-amz-storage-class")
-        if sc:
-            user_defined["x-amz-storage-class"] = sc
-        for hk, hv in request.headers.items():
-            if hk.lower().startswith("x-amz-meta-"):
-                user_defined[hk.lower()] = hv
-        opts.user_defined = user_defined
 
         spool = tempfile.SpooledTemporaryFile(max_size=SPOOL_LIMIT)
         sha = hashlib.sha256() if payload_hash not in (
@@ -290,7 +328,17 @@ class S3Server:
                 raise S3Error("IncompleteBody")
             if sha is not None and sha.hexdigest() != payload_hash:
                 raise S3Error("XAmzContentSHA256Mismatch")
-            spool.seek(0)
+        except BaseException:
+            spool.close()
+            raise
+        spool.seek(0)
+        return spool, size
+
+    async def _put_object(self, request, bucket, key, opts, hdr,
+                          payload_hash, auth_sig, run):
+        opts.user_defined = _metadata_headers(request)
+        spool, size = await self._spool_body(request, payload_hash, auth_sig)
+        try:
             info = await run(self.obj.put_object, bucket, key, spool, size, opts)
         finally:
             spool.close()
@@ -299,16 +347,43 @@ class S3Server:
             extra["x-amz-version-id"] = info.version_id
         return web.Response(status=200, headers={**hdr, **extra})
 
+    async def _put_part(self, request, bucket, key, upload_id, part_number,
+                        hdr, payload_hash, auth_sig, run):
+        spool, size = await self._spool_body(request, payload_hash, auth_sig)
+        try:
+            res = await run(self.obj.put_object_part, bucket, key, upload_id,
+                            part_number, spool, size)
+        finally:
+            spool.close()
+        return web.Response(status=200, headers={**hdr, "ETag": f'"{res.etag}"'})
+
+    async def _upload_part_copy(self, request, bucket, key, upload_id,
+                                part_number, src, hdr, run):
+        src_bucket, src_key, src_opts = _parse_copy_source(src)
+        rng = request.headers.get("x-amz-copy-source-range")
+        if rng:
+            pre = await run(self.obj.get_object_info, src_bucket, src_key, src_opts)
+            offset, length = _parse_range(rng, pre.size)
+        else:
+            offset, length = 0, -1
+        info, stream = await run(self.obj.get_object, src_bucket, src_key,
+                                 offset, length, src_opts)
+        if length < 0:
+            length = info.size
+        reader = _IterReader(stream)
+        try:
+            res = await run(self.obj.put_object_part, bucket, key, upload_id,
+                            part_number, reader, length)
+        finally:
+            close = getattr(stream, "close", None)
+            if close is not None:
+                await run(close)
+        return web.Response(
+            body=xmlutil.copy_object_xml(res.etag, res.last_modified),
+            content_type=XML_TYPE, headers=hdr)
+
     async def _copy_object(self, request, bucket, key, src, opts, hdr, run):
-        src = urllib.parse.unquote(src)
-        src_vid = ""
-        if "?versionId=" in src:
-            src, src_vid = src.split("?versionId=", 1)
-        src = src.lstrip("/")
-        if "/" not in src:
-            raise S3Error("InvalidArgument", "bad x-amz-copy-source")
-        src_bucket, src_key = src.split("/", 1)
-        src_opts = ObjectOptions(version_id=src_vid)
+        src_bucket, src_key, src_opts = _parse_copy_source(src)
         info, stream = await run(self.obj.get_object, src_bucket, src_key,
                                  0, -1, src_opts)
         directive = request.headers.get("x-amz-metadata-directive", "COPY")
@@ -419,6 +494,37 @@ class _IterReader:
         out = bytes(self._buf[:n])
         del self._buf[:n]
         return out
+
+
+def _metadata_headers(request) -> dict:
+    """User-controlled object metadata extracted from request headers."""
+    user_defined = {}
+    ct = request.headers.get("Content-Type")
+    if ct:
+        user_defined["content-type"] = ct
+    sc = request.headers.get("x-amz-storage-class")
+    if sc:
+        user_defined["x-amz-storage-class"] = sc
+    tags = request.headers.get("x-amz-tagging")
+    if tags:
+        user_defined["x-amz-tagging"] = tags
+    for hk, hv in request.headers.items():
+        if hk.lower().startswith("x-amz-meta-"):
+            user_defined[hk.lower()] = hv
+    return user_defined
+
+
+def _parse_copy_source(src: str):
+    """x-amz-copy-source → (bucket, key, ObjectOptions with versionId)."""
+    src = urllib.parse.unquote(src)
+    src_vid = ""
+    if "?versionId=" in src:
+        src, src_vid = src.split("?versionId=", 1)
+    src = src.lstrip("/")
+    if "/" not in src:
+        raise S3Error("InvalidArgument", "bad x-amz-copy-source")
+    src_bucket, src_key = src.split("/", 1)
+    return src_bucket, src_key, ObjectOptions(version_id=src_vid)
 
 
 def _object_headers(info) -> dict:

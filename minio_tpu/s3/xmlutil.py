@@ -256,7 +256,10 @@ def parse_complete_multipart_xml(body: bytes):
         for c in child:
             local = c.tag.rsplit("}", 1)[-1]
             if local == "PartNumber":
-                num = int(c.text)
+                try:
+                    num = int(c.text)
+                except (TypeError, ValueError):
+                    raise S3Error("MalformedXML") from None
             elif local == "ETag":
                 etag = (c.text or "").strip('"')
         if num is not None and etag is not None:

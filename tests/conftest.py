@@ -17,3 +17,72 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# ---------------------------------------------------------------------------
+# Shared in-process S3 server fixtures (SURVEY.md §4 tier 3). Modules that
+# need a different topology define their own overriding fixtures.
+# ---------------------------------------------------------------------------
+
+import socket  # noqa: E402
+import threading  # noqa: E402
+
+import pytest  # noqa: E402
+
+S3_ACCESS, S3_SECRET = "testadmin", "testsecret123"
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="session")
+def server(tmp_path_factory):
+    import asyncio
+
+    from aiohttp import web
+
+    from minio_tpu.s3.server import build_server
+
+    root = tmp_path_factory.mktemp("shared-drives")
+    srv = build_server([str(root / f"d{i}") for i in range(4)], S3_ACCESS,
+                       S3_SECRET, versioned=False)
+    port = free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(srv.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(30)
+    yield f"http://127.0.0.1:{port}"
+    loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.fixture(scope="session")
+def client(server):
+    from tests.s3client import SigV4Client
+
+    return SigV4Client(server, S3_ACCESS, S3_SECRET)
+
+
+@pytest.fixture(scope="session")
+def bucket(client):
+    r = client.put("/apitest")
+    assert r.status_code in (200, 409), r.text
+    return "apitest"

@@ -1,0 +1,239 @@
+"""Multipart upload tests: engine level (mirrors cmd/erasure-multipart
+behavior via object-api-multipart_test.go scenarios) and HTTP level."""
+
+import hashlib
+import io
+import os
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.erasure.objects import ErasureObjects
+from minio_tpu.erasure.types import CompletePart, ObjectOptions
+from minio_tpu.storage.local import LocalDrive
+from minio_tpu.utils import errors as se
+
+PART = 5 << 20  # S3 minimum part size
+
+
+@pytest.fixture
+def er(tmp_path):
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(6)]
+    er = ErasureObjects(drives, parity=2)
+    er.make_bucket("bkt")
+    yield er
+    er.close()
+
+
+def test_multipart_roundtrip(er):
+    body1 = os.urandom(PART + 4096)
+    body2 = os.urandom(PART)
+    body3 = os.urandom(123456)  # last part may be small
+    uid = er.new_multipart_upload("bkt", "mp/obj")
+    p1 = er.put_object_part("bkt", "mp/obj", uid, 1, io.BytesIO(body1), len(body1))
+    p3 = er.put_object_part("bkt", "mp/obj", uid, 7, io.BytesIO(body3), len(body3))
+    p2 = er.put_object_part("bkt", "mp/obj", uid, 3, io.BytesIO(body2), len(body2))
+    assert p1.etag == hashlib.md5(body1).hexdigest()
+
+    parts = er.list_parts("bkt", "mp/obj", uid)
+    assert [p.part_number for p in parts] == [1, 3, 7]
+
+    uploads = er.list_multipart_uploads("bkt")
+    assert [u.upload_id for u in uploads] == [uid]
+
+    info = er.complete_multipart_upload(
+        "bkt", "mp/obj", uid,
+        [CompletePart(1, p1.etag), CompletePart(3, p2.etag), CompletePart(7, p3.etag)],
+    )
+    full = body1 + body2 + body3
+    assert info.size == len(full)
+    assert info.etag.endswith("-3")
+
+    _, stream = er.get_object("bkt", "mp/obj")
+    assert b"".join(stream) == full
+    # Session is gone.
+    assert er.list_multipart_uploads("bkt") == []
+    with pytest.raises(se.InvalidUploadID):
+        er.list_parts("bkt", "mp/obj", uid)
+
+
+def test_multipart_range_across_parts(er):
+    body1, body2 = os.urandom(PART), os.urandom(PART)
+    uid = er.new_multipart_upload("bkt", "rng")
+    e1 = er.put_object_part("bkt", "rng", uid, 1, io.BytesIO(body1), len(body1)).etag
+    e2 = er.put_object_part("bkt", "rng", uid, 2, io.BytesIO(body2), len(body2)).etag
+    er.complete_multipart_upload("bkt", "rng", uid,
+                                 [CompletePart(1, e1), CompletePart(2, e2)])
+    full = body1 + body2
+    # Range straddling the part boundary.
+    off, ln = PART - 1000, 5000
+    _, stream = er.get_object("bkt", "rng", off, ln)
+    assert b"".join(stream) == full[off:off + ln]
+    # Range entirely inside part 2.
+    off = PART + 4096
+    _, stream = er.get_object("bkt", "rng", off, 100)
+    assert b"".join(stream) == full[off:off + 100]
+
+
+def test_multipart_part_overwrite(er):
+    a, b = os.urandom(PART), os.urandom(PART)
+    uid = er.new_multipart_upload("bkt", "ow")
+    er.put_object_part("bkt", "ow", uid, 1, io.BytesIO(a), len(a))
+    e1 = er.put_object_part("bkt", "ow", uid, 1, io.BytesIO(b), len(b)).etag
+    tail = os.urandom(10)
+    e2 = er.put_object_part("bkt", "ow", uid, 2, io.BytesIO(tail), len(tail)).etag
+    er.complete_multipart_upload("bkt", "ow", uid,
+                                 [CompletePart(1, e1), CompletePart(2, e2)])
+    _, stream = er.get_object("bkt", "ow")
+    assert b"".join(stream) == b + tail
+
+
+def test_multipart_complete_validation(er):
+    body = os.urandom(PART)
+    small = os.urandom(100)
+    uid = er.new_multipart_upload("bkt", "val")
+    e1 = er.put_object_part("bkt", "val", uid, 1, io.BytesIO(small), len(small)).etag
+    e2 = er.put_object_part("bkt", "val", uid, 2, io.BytesIO(body), len(body)).etag
+    # Non-last part below the 5 MiB minimum.
+    with pytest.raises(se.PartTooSmall):
+        er.complete_multipart_upload("bkt", "val", uid,
+                                     [CompletePart(1, e1), CompletePart(2, e2)])
+    # Wrong etag.
+    with pytest.raises(se.InvalidPart):
+        er.complete_multipart_upload("bkt", "val", uid, [CompletePart(2, "0" * 32)])
+    # Unordered part list.
+    with pytest.raises(se.InvalidPart):
+        er.complete_multipart_upload("bkt", "val", uid,
+                                     [CompletePart(2, e2), CompletePart(1, e1)])
+    # Never-uploaded part number.
+    with pytest.raises(se.InvalidPart):
+        er.complete_multipart_upload("bkt", "val", uid, [CompletePart(9, e1)])
+    # Valid single-part complete (part 2 is last → size ok).
+    er.complete_multipart_upload("bkt", "val", uid, [CompletePart(2, e2)])
+    _, stream = er.get_object("bkt", "val")
+    assert b"".join(stream) == body
+
+
+def test_multipart_abort(er):
+    uid = er.new_multipart_upload("bkt", "ab")
+    body = os.urandom(1024)
+    er.put_object_part("bkt", "ab", uid, 1, io.BytesIO(body), len(body))
+    er.abort_multipart_upload("bkt", "ab", uid)
+    with pytest.raises(se.InvalidUploadID):
+        er.put_object_part("bkt", "ab", uid, 2, io.BytesIO(body), len(body))
+    with pytest.raises(se.ObjectNotFound):
+        er.get_object_info("bkt", "ab")
+
+
+def test_multipart_unknown_upload(er):
+    with pytest.raises(se.InvalidUploadID):
+        er.put_object_part("bkt", "x", "deadbeef", 1, io.BytesIO(b"z"), 1)
+    with pytest.raises(se.InvalidUploadID):
+        er.complete_multipart_upload("bkt", "x", "deadbeef", [CompletePart(1, "0" * 32)])
+    with pytest.raises(se.InvalidUploadID):
+        er.abort_multipart_upload("bkt", "x", "deadbeef")
+
+
+def test_multipart_survives_drive_loss(er):
+    """Parts written while all drives live must decode after parity-many
+    drives disappear post-complete."""
+    import shutil
+
+    body = os.urandom(2 * PART)
+    uid = er.new_multipart_upload("bkt", "dl")
+    e1 = er.put_object_part("bkt", "dl", uid, 1, io.BytesIO(body[:PART]), PART)
+    e2 = er.put_object_part("bkt", "dl", uid, 2, io.BytesIO(body[PART:]), PART)
+    er.complete_multipart_upload("bkt", "dl", uid,
+                                 [CompletePart(1, e1.etag), CompletePart(2, e2.etag)])
+    for d in er.drives[:2]:
+        shutil.rmtree(os.path.join(d.root, "bkt", "dl"))
+    _, stream = er.get_object("bkt", "dl")
+    assert b"".join(stream) == body
+
+
+# ---------------- HTTP level ----------------
+
+
+def test_http_multipart(client, bucket):
+    key = "/apitest/http-mp"
+    body1, body2 = os.urandom(PART), os.urandom(4321)
+    r = client.post(key, query={"uploads": ""})
+    assert r.status_code == 200, r.text
+    uid = ET.fromstring(r.content).findtext(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}UploadId")
+    assert uid
+
+    r1 = client.put(key, data=body1, query={"uploadId": uid, "partNumber": "1"})
+    assert r1.status_code == 200, r1.text
+    r2 = client.put(key, data=body2, query={"uploadId": uid, "partNumber": "2"})
+    assert r2.status_code == 200
+
+    r = client.get(key, query={"uploadId": uid})
+    assert r.status_code == 200
+    nums = [e.text for e in ET.fromstring(r.content).iter(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}PartNumber")]
+    assert nums == ["1", "2"]
+
+    cx = (
+        '<CompleteMultipartUpload>'
+        f'<Part><PartNumber>1</PartNumber><ETag>{r1.headers["ETag"]}</ETag></Part>'
+        f'<Part><PartNumber>2</PartNumber><ETag>{r2.headers["ETag"]}</ETag></Part>'
+        '</CompleteMultipartUpload>'
+    ).encode()
+    r = client.post(key, data=cx, query={"uploadId": uid})
+    assert r.status_code == 200, r.text
+
+    r = client.get(key)
+    assert r.status_code == 200
+    assert r.content == body1 + body2
+    assert r.headers["ETag"].strip('"').endswith("-2")
+
+    # Range across the boundary via HTTP.
+    r = client.get(key, headers={"Range": f"bytes={PART - 10}-{PART + 9}"})
+    assert r.status_code == 206
+    assert r.content == (body1 + body2)[PART - 10:PART + 10]
+
+
+def test_http_upload_part_copy(client, bucket):
+    src_body = os.urandom(PART + 100)
+    r = client.put("/apitest/copy-src", data=src_body)
+    assert r.status_code == 200
+    r = client.post("/apitest/copy-dst", query={"uploads": ""})
+    uid = ET.fromstring(r.content).findtext(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}UploadId")
+    r1 = client.put("/apitest/copy-dst",
+                    query={"uploadId": uid, "partNumber": "1"},
+                    headers={"x-amz-copy-source": "/apitest/copy-src"})
+    assert r1.status_code == 200, r1.text
+    etag1 = ET.fromstring(r1.content).findtext(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}ETag").strip('"')
+    r2 = client.put("/apitest/copy-dst",
+                    query={"uploadId": uid, "partNumber": "2"},
+                    headers={"x-amz-copy-source": "/apitest/copy-src",
+                             "x-amz-copy-source-range": "bytes=0-99"})
+    assert r2.status_code == 200, r2.text
+    etag2 = ET.fromstring(r2.content).findtext(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}ETag").strip('"')
+    cx = (
+        '<CompleteMultipartUpload>'
+        f'<Part><PartNumber>1</PartNumber><ETag>{etag1}</ETag></Part>'
+        f'<Part><PartNumber>2</PartNumber><ETag>{etag2}</ETag></Part>'
+        '</CompleteMultipartUpload>'
+    ).encode()
+    r = client.post("/apitest/copy-dst", data=cx, query={"uploadId": uid})
+    assert r.status_code == 200, r.text
+    r = client.get("/apitest/copy-dst")
+    assert r.content == src_body + src_body[:100]
+
+
+def test_http_abort_multipart(client, bucket):
+    r = client.post("/apitest/http-ab", query={"uploads": ""})
+    uid = ET.fromstring(r.content).findtext(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}UploadId")
+    r = client.put("/apitest/http-ab", data=b"x" * 100,
+                   query={"uploadId": uid, "partNumber": "1"})
+    assert r.status_code == 200
+    r = client.delete("/apitest/http-ab", query={"uploadId": uid})
+    assert r.status_code == 204
+    r = client.get("/apitest/http-ab", query={"uploadId": uid})
+    assert r.status_code == 404
