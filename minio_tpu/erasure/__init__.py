@@ -9,3 +9,5 @@ Erasure codec surface (cmd/erasure-coding.go:28), erasureObjects
 
 from minio_tpu.erasure.codec import ErasureCodec  # noqa: F401
 from minio_tpu.erasure.objects import ErasureObjects  # noqa: F401
+from minio_tpu.erasure.pools import ErasureServerPools  # noqa: F401
+from minio_tpu.erasure.sets import ErasureSets  # noqa: F401

@@ -1,0 +1,132 @@
+"""format.json — per-drive identity and cluster layout.
+
+Role-equivalent of cmd/format-erasure.go (formatErasureV3 :110,
+waitForFormatErasure): every drive carries a format document naming the
+deployment, its own UUID, and the full sets×drives UUID matrix, so any
+subset of drives can prove (by quorum) what the layout is and a swapped or
+fresh drive is detected and healed.
+
+Document (our own v1 — not byte-compatible with the reference's):
+
+    {"version": 1, "format": "erasure", "id": "<deployment uuid>",
+     "erasure": {"this": "<drive uuid>",
+                 "sets": [["<uuid>", ...], ...],
+                 "distribution_algo": "sipmod"}}
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass
+
+from minio_tpu.erasure.metadata import parallel_map
+from minio_tpu.storage.api import StorageAPI
+from minio_tpu.utils import errors as se
+
+FORMAT_ERASURE = "erasure"
+DISTRIBUTION_ALGO = "sipmod"
+
+
+@dataclass
+class FormatInfo:
+    deployment_id: str
+    sets: list[list[str]]           # sets × drives UUID matrix
+    this: str = ""                  # the drive's own UUID
+
+    def to_doc(self, this: str) -> dict:
+        return {
+            "version": 1,
+            "format": FORMAT_ERASURE,
+            "id": self.deployment_id,
+            "erasure": {
+                "this": this,
+                "sets": self.sets,
+                "distribution_algo": DISTRIBUTION_ALGO,
+            },
+        }
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "FormatInfo":
+        if doc.get("version") != 1 or doc.get("format") != FORMAT_ERASURE:
+            raise se.CorruptedFormat(f"unrecognized format doc {doc.get('version')}")
+        ec = doc.get("erasure", {})
+        return cls(deployment_id=doc["id"], sets=ec["sets"], this=ec.get("this", ""))
+
+
+def init_format_erasure(
+    drives: list[StorageAPI], set_drive_count: int
+) -> FormatInfo:
+    """Read-or-create formats across all drives (reference
+    waitForFormatErasure): fresh drives are formatted into the layout,
+    existing formats are quorum-verified, and a minority of blank/replaced
+    drives is healed in place. Returns the elected FormatInfo."""
+    n = len(drives)
+    if n % set_drive_count:
+        raise ValueError(f"{n} drives not divisible into sets of {set_drive_count}")
+    set_count = n // set_drive_count
+
+    results = parallel_map([lambda d=d: d.read_format() for d in drives])
+    existing = [
+        (i, FormatInfo.from_doc(r))
+        for i, r in enumerate(results)
+        if isinstance(r, dict)
+    ]
+
+    if not existing:
+        # Fresh cluster: mint deployment + drive UUIDs.
+        fmt = FormatInfo(
+            deployment_id=str(uuid.uuid4()),
+            sets=[[str(uuid.uuid4()) for _ in range(set_drive_count)]
+                  for _ in range(set_count)],
+        )
+        def write(i, d):
+            this = fmt.sets[i // set_drive_count][i % set_drive_count]
+            d.write_format(fmt.to_doc(this))
+            d.set_disk_id(this)
+        outcomes = parallel_map(
+            [lambda i=i, d=d: write(i, d) for i, d in enumerate(drives)]
+        )
+        bad = [o for o in outcomes if isinstance(o, Exception)]
+        if bad:
+            raise bad[0]
+        return fmt
+
+    # Elect the reference format by quorum on (deployment, layout).
+    tally: dict[tuple, int] = {}
+    for _, f in existing:
+        key = (f.deployment_id, tuple(tuple(s) for s in f.sets))
+        tally[key] = tally.get(key, 0) + 1
+    (dep_id, sets_key), count = max(tally.items(), key=lambda kv: kv[1])
+    if count <= len(existing) // 2:
+        raise se.CorruptedFormat("no format quorum across drives")
+    ref = FormatInfo(deployment_id=dep_id, sets=[list(s) for s in sets_key])
+    if len(ref.sets) != set_count or any(
+        len(s) != set_drive_count for s in ref.sets
+    ):
+        raise se.CorruptedFormat(
+            f"on-disk layout {len(ref.sets)}x{len(ref.sets[0])} does not match "
+            f"requested {set_count}x{set_drive_count}"
+        )
+
+    # Heal: blank drives (UnformattedDisk) adopt the UUID of their slot. A
+    # drive carrying a format for a DIFFERENT deployment is someone else's
+    # data — refuse to touch it (the reference errors on deployment-ID
+    # mismatch rather than reformatting).
+    for i, r in enumerate(results):
+        slot_uuid = ref.sets[i // set_drive_count][i % set_drive_count]
+        if isinstance(r, dict):
+            f = FormatInfo.from_doc(r)
+            if f.deployment_id != dep_id:
+                raise se.CorruptedFormat(
+                    f"drive {i} belongs to deployment {f.deployment_id}, "
+                    f"not {dep_id} — refusing to reformat a foreign drive"
+                )
+            if f.this == slot_uuid:
+                drives[i].set_disk_id(slot_uuid)
+                continue
+        try:
+            drives[i].write_format(ref.to_doc(slot_uuid))
+            drives[i].set_disk_id(slot_uuid)
+        except se.StorageError:
+            pass
+    return ref

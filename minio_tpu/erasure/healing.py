@@ -426,6 +426,15 @@ class HealingMixin:
             if not isinstance(out, Exception):
                 res.after[pos].state = DRIVE_STATE_OK
 
+    def heal_objects(self, bucket: str, prefix: str = "", **kw):
+        """Walk every object under prefix and heal it (reference HealObjects
+        walk, cmd/erasure-server-pool.go:1500)."""
+        for name in sorted(self.merged_journals(bucket, prefix)):
+            try:
+                yield self.heal_object(bucket, name, **kw)
+            except se.ObjectError as e:
+                yield e
+
     # -- dangling purge (reference purgeObjectDangling,
     #    cmd/erasure-healing.go:700) --
 

@@ -1,0 +1,169 @@
+"""Shared listing pagination over merged journal maps.
+
+Every layer (one set, a sets group, a pools group) produces the same shape —
+object name → version journal, merged by modtime — and pages it with
+identical S3 semantics (prefix/marker/delimiter/max-keys). Centralizing the
+pagination here is what lets sets and pools reuse one implementation
+(the reference's equivalent merge lives in cmd/metacache-entries.go /
+cmd/metacache-set.go; the streamed metacache layer can replace the
+materialized map later without touching callers).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from minio_tpu.erasure.types import ListObjectsInfo, ListObjectVersionsInfo
+from minio_tpu.storage.fileinfo import FileInfo
+from minio_tpu.storage.xlmeta import XLMeta
+from minio_tpu.utils import errors as se
+
+
+def bulk_delete(delete_object, bucket, objects, opts=None):
+    """Per-key delete loop shared by every layer (reference DeleteObjects,
+    cmd/erasure-server-pool.go): each key resolves independently; errors are
+    returned as values, not raised."""
+    from minio_tpu.erasure.types import DeletedObject, ObjectOptions
+
+    out = []
+    for o in objects:
+        per = ObjectOptions(version_id=o.version_id,
+                            versioned=(opts.versioned if opts else False))
+        try:
+            info = delete_object(bucket, o.object_name, per)
+            out.append(DeletedObject(
+                object_name=o.object_name, version_id=o.version_id,
+                delete_marker=info.delete_marker,
+                delete_marker_version_id=info.version_id if info.delete_marker else "",
+            ))
+        except Exception as e:  # noqa: BLE001 - per-key results
+            out.append(e)
+    return out
+
+
+def merge_journal_maps(maps: list[dict[str, XLMeta]]) -> dict[str, XLMeta]:
+    """Merge per-source journal maps, newest journal wins per object."""
+    merged: dict[str, XLMeta] = {}
+    for m in maps:
+        for name, meta in m.items():
+            cur = merged.get(name)
+            if cur is None or journal_newer(meta, cur):
+                merged[name] = meta
+    return merged
+
+
+def journal_newer(a: XLMeta, b: XLMeta) -> bool:
+    amt = a.versions[0].get("mt", 0.0) if a.versions else 0.0
+    bmt = b.versions[0].get("mt", 0.0) if b.versions else 0.0
+    if amt != bmt:
+        return amt > bmt
+    return len(a.versions) > len(b.versions)
+
+
+def paginate_objects(
+    journals: dict[str, XLMeta],
+    to_info: Callable[[str, FileInfo], object],
+    prefix: str = "",
+    marker: str = "",
+    delimiter: str = "",
+    max_keys: int = 1000,
+) -> ListObjectsInfo:
+    objects = []
+    prefixes: list[str] = []
+    seen_prefix: set[str] = set()
+    truncated = False
+    next_marker = ""
+    for name in sorted(journals):
+        if _skip_for_marker(name, marker, delimiter):
+            continue
+        if delimiter:
+            rest = name[len(prefix):]
+            d = rest.find(delimiter)
+            if d >= 0:
+                cp = prefix + rest[: d + len(delimiter)]
+                if cp not in seen_prefix:
+                    if len(objects) + len(seen_prefix) >= max_keys:
+                        truncated = True
+                        break
+                    seen_prefix.add(cp)
+                    prefixes.append(cp)
+                    next_marker = cp
+                continue
+        try:
+            fi = journals[name].to_fileinfo("", name, None)
+        except se.StorageError:
+            continue
+        if fi.deleted:
+            continue
+        if len(objects) + len(seen_prefix) >= max_keys:
+            truncated = True
+            break
+        objects.append(to_info(name, fi))
+        next_marker = name
+    return ListObjectsInfo(is_truncated=truncated,
+                           next_marker=next_marker if truncated else "",
+                           objects=objects, prefixes=prefixes)
+
+
+def _skip_for_marker(name: str, marker: str, delimiter: str) -> bool:
+    """Resume semantics: skip names at or before the marker; a marker that
+    names a common prefix also skips everything under it (so NextMarker may
+    be a CommonPrefix, as in S3)."""
+    if not marker:
+        return False
+    if name <= marker:
+        return True
+    return bool(delimiter) and marker.endswith(delimiter) and name.startswith(marker)
+
+
+def paginate_versions(
+    journals: dict[str, XLMeta],
+    to_info: Callable[[str, FileInfo], object],
+    prefix: str = "",
+    marker: str = "",
+    version_marker: str = "",
+    delimiter: str = "",
+    max_keys: int = 1000,
+) -> ListObjectVersionsInfo:
+    out = ListObjectVersionsInfo()
+    seen_prefix: set[str] = set()
+    count = 0
+    for name in sorted(journals):
+        if name == marker and version_marker:
+            pass  # resume mid-object below
+        elif _skip_for_marker(name, marker, delimiter) or name == marker:
+            continue
+        if delimiter:
+            rest = name[len(prefix):]
+            d = rest.find(delimiter)
+            if d >= 0:
+                cp = prefix + rest[: d + len(delimiter)]
+                if cp not in seen_prefix:
+                    if count + len(seen_prefix) >= max_keys:
+                        out.is_truncated = True
+                        return out
+                    seen_prefix.add(cp)
+                    out.prefixes.append(cp)
+                    out.next_marker = cp
+                    out.next_version_id_marker = ""
+                continue
+        meta = journals[name]
+        resuming = name == marker and bool(version_marker)
+        skipping = resuming  # drop versions up to and incl. version_marker
+        for fi in meta.list_versions("", name):
+            if skipping:
+                if fi.version_id == version_marker:
+                    skipping = False
+                continue
+            if count >= max_keys:
+                # Markers already name the last emitted item; resume skips
+                # through it.
+                out.is_truncated = True
+                return out
+            out.objects.append(to_info(name, fi))
+            out.next_marker = name
+            out.next_version_id_marker = fi.version_id
+            count += 1
+    out.next_marker = ""
+    out.next_version_id_marker = ""
+    return out

@@ -27,6 +27,7 @@ import uuid
 from typing import BinaryIO, Iterator
 
 from minio_tpu.erasure.codec import DEFAULT_BLOCK_SIZE, ErasureCodec
+from minio_tpu.erasure import listing
 from minio_tpu.erasure.healing import HealingMixin, MRFHealer
 from minio_tpu.erasure.multipart import MultipartMixin
 from minio_tpu.erasure.metadata import (
@@ -111,6 +112,20 @@ class ErasureObjects(HealingMixin, MultipartMixin):
     def close(self) -> None:
         if self.mrf is not None:
             self.mrf.close()
+
+    def health(self) -> dict:
+        online = 0
+        for d in self.drives:
+            try:
+                d.disk_info()
+                online += 1
+            except Exception:  # noqa: BLE001
+                pass
+        quorum = self._write_quorum_data(self.parity)
+        return {
+            "healthy": online >= quorum,
+            "sets": [{"online": online, "total": self.n, "write_quorum": quorum}],
+        }
 
     # ------------------------------------------------------------------
     # buckets (cmd/erasure-bucket.go)
@@ -476,22 +491,7 @@ class ErasureObjects(HealingMixin, MultipartMixin):
     def delete_objects(self, bucket: str, objects: list[ObjectToDelete],
                        opts: ObjectOptions | None = None
                        ) -> list[DeletedObject | Exception]:
-        out: list[DeletedObject | Exception] = []
-        for o in objects:
-            per = ObjectOptions(
-                version_id=o.version_id,
-                versioned=(opts.versioned if opts else False),
-            )
-            try:
-                info = self.delete_object(bucket, o.object_name, per)
-                out.append(DeletedObject(
-                    object_name=o.object_name, version_id=o.version_id,
-                    delete_marker=info.delete_marker,
-                    delete_marker_version_id=info.version_id if info.delete_marker else "",
-                ))
-            except Exception as e:  # noqa: BLE001 - per-key results
-                out.append(e)
-        return out
+        return listing.bulk_delete(self.delete_object, bucket, objects, opts)
 
     # ------------------------------------------------------------------
     # listing (flat merge; the metacache system layers on top later)
@@ -500,71 +500,23 @@ class ErasureObjects(HealingMixin, MultipartMixin):
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
                      delimiter: str = "", max_keys: int = 1000) -> ListObjectsInfo:
         self.get_bucket_info(bucket)
-        merged = self._merged_entries(bucket, prefix)
-        objects: list[ObjectInfo] = []
-        prefixes: list[str] = []
-        seen_prefix: set[str] = set()
-        truncated = False
-        next_marker = ""
-        for name in sorted(merged):
-            if name <= marker:
-                continue
-            if delimiter:
-                rest = name[len(prefix):]
-                d = rest.find(delimiter)
-                if d >= 0:
-                    cp = prefix + rest[: d + len(delimiter)]
-                    if cp not in seen_prefix:
-                        if len(objects) + len(seen_prefix) >= max_keys:
-                            truncated = True
-                            break
-                        seen_prefix.add(cp)
-                        prefixes.append(cp)
-                    continue
-            fi = merged[name]
-            if fi.deleted:
-                continue
-            if len(objects) + len(seen_prefix) >= max_keys:
-                truncated = True
-                break
-            objects.append(self._fi_to_object_info(bucket, name, fi))
-            next_marker = name
-        return ListObjectsInfo(is_truncated=truncated,
-                               next_marker=next_marker if truncated else "",
-                               objects=objects, prefixes=prefixes)
+        return listing.paginate_objects(
+            self.merged_journals(bucket, prefix),
+            lambda name, fi: self._fi_to_object_info(bucket, name, fi),
+            prefix, marker, delimiter, max_keys,
+        )
 
     def list_object_versions(self, bucket: str, prefix: str = "", marker: str = "",
                              version_marker: str = "", delimiter: str = "",
                              max_keys: int = 1000) -> ListObjectVersionsInfo:
         self.get_bucket_info(bucket)
-        journals = self._merged_journals(bucket, prefix)
-        out = ListObjectVersionsInfo()
-        count = 0
-        for name in sorted(journals):
-            if name < marker or (name == marker and not version_marker):
-                continue
-            meta = journals[name]
-            resuming = name == marker and bool(version_marker)
-            skipping = resuming  # drop versions up to and incl. version_marker
-            for fi in meta.list_versions(bucket, name):
-                if skipping:
-                    if fi.version_id == version_marker:
-                        skipping = False
-                    continue
-                if count >= max_keys:
-                    # Markers name the last *emitted* version; resume skips
-                    # through it.
-                    out.is_truncated = True
-                    last = out.objects[-1]
-                    out.next_marker = last.name
-                    out.next_version_id_marker = last.version_id
-                    return out
-                info = self._fi_to_object_info(bucket, name, fi)
-                out.objects.append(info)
-                count += 1
-        return out
+        return listing.paginate_versions(
+            self.merged_journals(bucket, prefix),
+            lambda name, fi: self._fi_to_object_info(bucket, name, fi),
+            prefix, marker, version_marker, delimiter, max_keys,
+        )
 
-    def _merged_journals(self, bucket: str, prefix: str) -> dict[str, XLMeta]:
+    def merged_journals(self, bucket: str, prefix: str) -> dict[str, XLMeta]:
         results = parallel_map(
             [lambda d=d: list(d.walk_dir(bucket, prefix)) for d in self.drives]
         )
@@ -578,18 +530,9 @@ class ErasureObjects(HealingMixin, MultipartMixin):
                 except se.StorageError:
                     continue
                 cur = merged.get(entry.name)
-                if cur is None or _journal_newer(meta, cur):
+                if cur is None or listing.journal_newer(meta, cur):
                     merged[entry.name] = meta
         return merged
-
-    def _merged_entries(self, bucket: str, prefix: str) -> dict[str, FileInfo]:
-        out: dict[str, FileInfo] = {}
-        for name, meta in self._merged_journals(bucket, prefix).items():
-            try:
-                out[name] = meta.to_fileinfo(bucket, name, None)
-            except se.StorageError:
-                continue
-        return out
 
     # ------------------------------------------------------------------
     # tagging (cmd/erasure-object.go:1158)
@@ -753,14 +696,6 @@ def _clone_for_drive(fi: FileInfo, index: int) -> FileInfo:
     out = copy.deepcopy(fi)
     out.erasure.index = index
     return out
-
-
-def _journal_newer(a: XLMeta, b: XLMeta) -> bool:
-    amt = a.versions[0].get("mt", 0.0) if a.versions else 0.0
-    bmt = b.versions[0].get("mt", 0.0) if b.versions else 0.0
-    if amt != bmt:
-        return amt > bmt
-    return len(a.versions) > len(b.versions)
 
 
 def _validate_bucket_name(bucket: str) -> None:

@@ -1,0 +1,273 @@
+"""ErasureServerPools — capacity-routed pools of erasure sets; the top-level
+ObjectLayer.
+
+Role-equivalent of erasureServerPools (cmd/erasure-server-pool.go:41): writes
+land in the pool chosen by free-capacity weighting unless the object already
+exists in some pool (:176-293); reads/deletes fan out across pools and the
+owning pool answers; listings and healing merge across pools. With one pool
+this adds a thin pass-through — the common single-pool deployment costs
+nothing.
+"""
+
+from __future__ import annotations
+
+from typing import BinaryIO, Iterator
+
+from minio_tpu.erasure import listing
+from minio_tpu.erasure.healing import HealResultItem
+from minio_tpu.erasure.metadata import parallel_map
+from minio_tpu.erasure.sets import ErasureSets
+from minio_tpu.erasure.types import (
+    BucketInfo,
+    CompletePart,
+    DeletedObject,
+    ListObjectsInfo,
+    ListObjectVersionsInfo,
+    MultipartInfo,
+    ObjectInfo,
+    ObjectOptions,
+    ObjectToDelete,
+    PartInfoResult,
+)
+from minio_tpu.storage.xlmeta import XLMeta
+from minio_tpu.utils import errors as se
+
+
+class ErasureServerPools:
+    def __init__(self, pools: list[ErasureSets]):
+        if not pools:
+            raise ValueError("no pools")
+        self.pools = pools
+
+    def close(self) -> None:
+        for p in self.pools:
+            p.close()
+
+    # -- pool choice --
+
+    def _pool_free(self, pool: ErasureSets) -> int:
+        free = 0
+        for d in pool.drives:
+            try:
+                free += d.disk_info().free
+            except Exception:  # noqa: BLE001
+                pass
+        return free
+
+    def _get_pool_idx_existing(self, bucket: str, obj: str,
+                               version_id: str = "") -> int | None:
+        """Index of the pool already holding the object, newest wins
+        (reference getPoolIdxExisting, cmd/erasure-server-pool.go:252)."""
+        results = parallel_map(
+            [lambda p=p: p.get_object_info(
+                bucket, obj, ObjectOptions(version_id=version_id))
+             for p in self.pools]
+        )
+        best, best_mt = None, -1.0
+        for i, r in enumerate(results):
+            if isinstance(r, ObjectInfo) and r.mod_time > best_mt:
+                best, best_mt = i, r.mod_time
+        return best
+
+    def _get_pool_for_put(self, bucket: str, obj: str,
+                          version_id: str = "") -> ErasureSets:
+        if len(self.pools) == 1:
+            return self.pools[0]
+        existing = self._get_pool_idx_existing(bucket, obj, version_id)
+        if existing is not None:
+            return self.pools[existing]
+        frees = [self._pool_free(p) for p in self.pools]
+        return self.pools[max(range(len(frees)), key=frees.__getitem__)]
+
+    def _owning_pool(self, bucket: str, obj: str, version_id: str = "") -> ErasureSets:
+        if len(self.pools) == 1:
+            return self.pools[0]
+        idx = self._get_pool_idx_existing(bucket, obj, version_id)
+        if idx is None:
+            raise se.ObjectNotFound(bucket, obj)
+        return self.pools[idx]
+
+    # -- buckets --
+
+    def make_bucket(self, bucket: str, opts: ObjectOptions | None = None) -> None:
+        outcomes = parallel_map([lambda p=p: p.make_bucket(bucket, opts)
+                                 for p in self.pools])
+        for o in outcomes:
+            if isinstance(o, Exception):
+                raise o
+
+    def get_bucket_info(self, bucket: str) -> BucketInfo:
+        return self.pools[0].get_bucket_info(bucket)
+
+    def list_buckets(self) -> list[BucketInfo]:
+        return self.pools[0].list_buckets()
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        outcomes = parallel_map(
+            [lambda p=p: p.delete_bucket(bucket, force=force) for p in self.pools]
+        )
+        for o in outcomes:
+            if isinstance(o, Exception):
+                raise o
+
+    # -- objects --
+
+    def put_object(self, bucket: str, obj: str, data: BinaryIO, size: int = -1,
+                   opts: ObjectOptions | None = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        return self._get_pool_for_put(bucket, obj, opts.version_id).put_object(
+            bucket, obj, data, size, opts)
+
+    def get_object(self, bucket: str, obj: str, offset: int = 0,
+                   length: int = -1, opts: ObjectOptions | None = None):
+        opts = opts or ObjectOptions()
+        return self._owning_pool(bucket, obj, opts.version_id).get_object(
+            bucket, obj, offset, length, opts)
+
+    def get_object_info(self, bucket: str, obj: str,
+                        opts: ObjectOptions | None = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        self.get_bucket_info(bucket)
+        return self._owning_pool(bucket, obj, opts.version_id).get_object_info(
+            bucket, obj, opts)
+
+    def delete_object(self, bucket: str, obj: str,
+                      opts: ObjectOptions | None = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        if opts.versioned and not opts.version_id:
+            # Delete markers land in the pool that owns (or would own) the key.
+            idx = self._get_pool_idx_existing(bucket, obj)
+            pool = self.pools[idx] if idx is not None else self.pools[0]
+            return pool.delete_object(bucket, obj, opts)
+        return self._owning_pool(bucket, obj, opts.version_id).delete_object(
+            bucket, obj, opts)
+
+    def delete_objects(self, bucket: str, objects: list[ObjectToDelete],
+                       opts: ObjectOptions | None = None
+                       ) -> list[DeletedObject | Exception]:
+        return listing.bulk_delete(self.delete_object, bucket, objects, opts)
+
+    def put_object_tags(self, bucket: str, obj: str, tags: str,
+                        opts: ObjectOptions | None = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        return self._owning_pool(bucket, obj, opts.version_id).put_object_tags(
+            bucket, obj, tags, opts)
+
+    def get_object_tags(self, bucket: str, obj: str,
+                        opts: ObjectOptions | None = None) -> str:
+        opts = opts or ObjectOptions()
+        return self._owning_pool(bucket, obj, opts.version_id).get_object_tags(
+            bucket, obj, opts)
+
+    def delete_object_tags(self, bucket: str, obj: str,
+                           opts: ObjectOptions | None = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        return self._owning_pool(bucket, obj, opts.version_id).delete_object_tags(
+            bucket, obj, opts)
+
+    # -- multipart --
+
+    def new_multipart_upload(self, bucket: str, obj: str,
+                             opts: ObjectOptions | None = None) -> str:
+        return self._get_pool_for_put(bucket, obj).new_multipart_upload(
+            bucket, obj, opts)
+
+    def _upload_pool(self, bucket: str, obj: str, upload_id: str) -> ErasureSets:
+        for p in self.pools:
+            try:
+                p.get_hashed_set(obj)._read_mp_meta(bucket, obj, upload_id)
+                return p
+            except se.InvalidUploadID:
+                continue
+        raise se.InvalidUploadID(bucket, obj, f"upload {upload_id} not found")
+
+    def put_object_part(self, bucket: str, obj: str, upload_id: str,
+                        part_number: int, data: BinaryIO, size: int = -1,
+                        opts: ObjectOptions | None = None) -> PartInfoResult:
+        return self._upload_pool(bucket, obj, upload_id).put_object_part(
+            bucket, obj, upload_id, part_number, data, size, opts)
+
+    def list_parts(self, bucket: str, obj: str, upload_id: str,
+                   part_marker: int = 0, max_parts: int = 1000):
+        return self._upload_pool(bucket, obj, upload_id).list_parts(
+            bucket, obj, upload_id, part_marker, max_parts)
+
+    def list_multipart_uploads(self, bucket: str, prefix: str = "",
+                               max_uploads: int = 1000) -> list[MultipartInfo]:
+        out: list[MultipartInfo] = []
+        for p in self.pools:
+            out.extend(p.list_multipart_uploads(bucket, prefix, max_uploads))
+        return sorted(out, key=lambda u: (u.object, u.initiated))[:max_uploads]
+
+    def abort_multipart_upload(self, bucket: str, obj: str, upload_id: str) -> None:
+        return self._upload_pool(bucket, obj, upload_id).abort_multipart_upload(
+            bucket, obj, upload_id)
+
+    def complete_multipart_upload(self, bucket: str, obj: str, upload_id: str,
+                                  parts: list[CompletePart],
+                                  opts: ObjectOptions | None = None) -> ObjectInfo:
+        return self._upload_pool(bucket, obj, upload_id).complete_multipart_upload(
+            bucket, obj, upload_id, parts, opts)
+
+    # -- listing --
+
+    def merged_journals(self, bucket: str, prefix: str) -> dict[str, XLMeta]:
+        results = parallel_map(
+            [lambda p=p: p.merged_journals(bucket, prefix) for p in self.pools]
+        )
+        return listing.merge_journal_maps(
+            [r for r in results if not isinstance(r, Exception)]
+        )
+
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000) -> ListObjectsInfo:
+        self.get_bucket_info(bucket)
+        fi2info = self.pools[0].sets[0]._fi_to_object_info
+        return listing.paginate_objects(
+            self.merged_journals(bucket, prefix),
+            lambda name, fi: fi2info(bucket, name, fi),
+            prefix, marker, delimiter, max_keys,
+        )
+
+    def list_object_versions(self, bucket: str, prefix: str = "", marker: str = "",
+                             version_marker: str = "", delimiter: str = "",
+                             max_keys: int = 1000) -> ListObjectVersionsInfo:
+        self.get_bucket_info(bucket)
+        fi2info = self.pools[0].sets[0]._fi_to_object_info
+        return listing.paginate_versions(
+            self.merged_journals(bucket, prefix),
+            lambda name, fi: fi2info(bucket, name, fi),
+            prefix, marker, version_marker, delimiter, max_keys,
+        )
+
+    # -- healing --
+
+    def heal_bucket(self, bucket: str, dry_run: bool = False) -> HealResultItem:
+        results = [p.heal_bucket(bucket, dry_run) for p in self.pools]
+        out = results[0]
+        for r in results[1:]:
+            out.before.extend(r.before)
+            out.after.extend(r.after)
+            out.disk_count += r.disk_count
+        return out
+
+    def heal_object(self, bucket: str, obj: str, version_id: str = "",
+                    **kw) -> HealResultItem:
+        last: Exception | None = None
+        for p in self.pools:
+            try:
+                return p.heal_object(bucket, obj, version_id, **kw)
+            except se.ObjectError as e:
+                last = e
+        raise last or se.ObjectNotFound(bucket, obj)
+
+    def heal_objects(self, bucket: str, prefix: str = "",
+                     **kw) -> Iterator[HealResultItem]:
+        for p in self.pools:
+            yield from p.heal_objects(bucket, prefix, **kw)
+
+    # -- health --
+
+    def health(self) -> dict:
+        pools = [p.health() for p in self.pools]
+        return {"healthy": all(h["healthy"] for h in pools), "pools": pools}

@@ -1,0 +1,235 @@
+"""ErasureSets — N independent erasure stripes behind one object namespace.
+
+Role-equivalent of erasureSets (cmd/erasure-sets.go:55): objects are routed
+to a set by sipHashMod(key, setCount, deploymentID) (:697-736), bucket
+operations fan out to every set, listings are a merged view across sets.
+Each set is a full ErasureObjects engine — quorums, healing and multipart
+stay per-set, exactly the reference's layering.
+"""
+
+from __future__ import annotations
+
+from typing import BinaryIO, Iterator
+
+from minio_tpu.erasure import listing
+from minio_tpu.erasure.format import FormatInfo, init_format_erasure
+from minio_tpu.erasure.healing import HealResultItem
+from minio_tpu.erasure.metadata import parallel_map
+from minio_tpu.erasure.objects import ErasureObjects
+from minio_tpu.erasure.types import (
+    BucketInfo,
+    CompletePart,
+    DeletedObject,
+    ListObjectsInfo,
+    ListObjectVersionsInfo,
+    MultipartInfo,
+    ObjectInfo,
+    ObjectOptions,
+    ObjectToDelete,
+    PartInfoResult,
+)
+from minio_tpu.storage.api import StorageAPI
+from minio_tpu.storage.xlmeta import XLMeta
+from minio_tpu.utils import errors as se
+from minio_tpu.utils.siphash import sip_hash_mod
+
+
+class ErasureSets:
+    def __init__(
+        self,
+        drives: list[StorageAPI],
+        set_drive_count: int | None = None,
+        parity: int | None = None,
+        fmt: FormatInfo | None = None,
+        enable_mrf: bool = False,
+        **set_kwargs,
+    ):
+        set_drive_count = set_drive_count or len(drives)
+        if fmt is None:
+            fmt = init_format_erasure(drives, set_drive_count)
+        self.format = fmt
+        self.deployment_id = fmt.deployment_id
+        self.set_count = len(drives) // set_drive_count
+        self.set_drive_count = set_drive_count
+        self.sets: list[ErasureObjects] = [
+            ErasureObjects(
+                drives[i * set_drive_count:(i + 1) * set_drive_count],
+                parity=parity, enable_mrf=enable_mrf, **set_kwargs,
+            )
+            for i in range(self.set_count)
+        ]
+        self.drives = drives
+
+    def close(self) -> None:
+        for s in self.sets:
+            s.close()
+
+    # -- routing (cmd/erasure-sets.go:716-736) --
+
+    def get_hashed_set(self, obj: str) -> ErasureObjects:
+        return self.sets[sip_hash_mod(obj, self.set_count, self.deployment_id)]
+
+    # -- buckets: fan out to every set --
+
+    def make_bucket(self, bucket: str, opts: ObjectOptions | None = None) -> None:
+        outcomes = parallel_map([lambda s=s: s.make_bucket(bucket, opts)
+                                 for s in self.sets])
+        for o in outcomes:
+            if isinstance(o, Exception):
+                raise o
+
+    def get_bucket_info(self, bucket: str) -> BucketInfo:
+        return self.sets[0].get_bucket_info(bucket)
+
+    def list_buckets(self) -> list[BucketInfo]:
+        return self.sets[0].list_buckets()
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        outcomes = parallel_map(
+            [lambda s=s: s.delete_bucket(bucket, force=force) for s in self.sets]
+        )
+        for o in outcomes:
+            if isinstance(o, Exception):
+                raise o
+
+    # -- objects: route by hash --
+
+    def put_object(self, bucket: str, obj: str, data: BinaryIO, size: int = -1,
+                   opts: ObjectOptions | None = None) -> ObjectInfo:
+        return self.get_hashed_set(obj).put_object(bucket, obj, data, size, opts)
+
+    def get_object(self, bucket: str, obj: str, offset: int = 0,
+                   length: int = -1, opts: ObjectOptions | None = None):
+        return self.get_hashed_set(obj).get_object(bucket, obj, offset, length, opts)
+
+    def get_object_info(self, bucket: str, obj: str,
+                        opts: ObjectOptions | None = None) -> ObjectInfo:
+        return self.get_hashed_set(obj).get_object_info(bucket, obj, opts)
+
+    def delete_object(self, bucket: str, obj: str,
+                      opts: ObjectOptions | None = None) -> ObjectInfo:
+        return self.get_hashed_set(obj).delete_object(bucket, obj, opts)
+
+    def delete_objects(self, bucket: str, objects: list[ObjectToDelete],
+                       opts: ObjectOptions | None = None
+                       ) -> list[DeletedObject | Exception]:
+        return listing.bulk_delete(self.delete_object, bucket, objects, opts)
+
+    def put_object_tags(self, bucket: str, obj: str, tags: str,
+                        opts: ObjectOptions | None = None) -> ObjectInfo:
+        return self.get_hashed_set(obj).put_object_tags(bucket, obj, tags, opts)
+
+    def get_object_tags(self, bucket: str, obj: str,
+                        opts: ObjectOptions | None = None) -> str:
+        return self.get_hashed_set(obj).get_object_tags(bucket, obj, opts)
+
+    def delete_object_tags(self, bucket: str, obj: str,
+                           opts: ObjectOptions | None = None) -> ObjectInfo:
+        return self.get_hashed_set(obj).delete_object_tags(bucket, obj, opts)
+
+    # -- multipart: route by hash --
+
+    def new_multipart_upload(self, bucket: str, obj: str,
+                             opts: ObjectOptions | None = None) -> str:
+        return self.get_hashed_set(obj).new_multipart_upload(bucket, obj, opts)
+
+    def put_object_part(self, bucket: str, obj: str, upload_id: str,
+                        part_number: int, data: BinaryIO, size: int = -1,
+                        opts: ObjectOptions | None = None) -> PartInfoResult:
+        return self.get_hashed_set(obj).put_object_part(
+            bucket, obj, upload_id, part_number, data, size, opts)
+
+    def list_parts(self, bucket: str, obj: str, upload_id: str,
+                   part_marker: int = 0, max_parts: int = 1000):
+        return self.get_hashed_set(obj).list_parts(
+            bucket, obj, upload_id, part_marker, max_parts)
+
+    def list_multipart_uploads(self, bucket: str, prefix: str = "",
+                               max_uploads: int = 1000) -> list[MultipartInfo]:
+        results = parallel_map(
+            [lambda s=s: s.list_multipart_uploads(bucket, prefix, max_uploads)
+             for s in self.sets]
+        )
+        if all(isinstance(r, Exception) for r in results):
+            raise results[0]
+        out: list[MultipartInfo] = []
+        for r in results:
+            if isinstance(r, Exception):
+                continue
+            out.extend(r)
+        return sorted(out, key=lambda u: (u.object, u.initiated))[:max_uploads]
+
+    def abort_multipart_upload(self, bucket: str, obj: str, upload_id: str) -> None:
+        return self.get_hashed_set(obj).abort_multipart_upload(bucket, obj, upload_id)
+
+    def complete_multipart_upload(self, bucket: str, obj: str, upload_id: str,
+                                  parts: list[CompletePart],
+                                  opts: ObjectOptions | None = None) -> ObjectInfo:
+        return self.get_hashed_set(obj).complete_multipart_upload(
+            bucket, obj, upload_id, parts, opts)
+
+    # -- listing: merged view across sets --
+
+    def merged_journals(self, bucket: str, prefix: str) -> dict[str, XLMeta]:
+        results = parallel_map(
+            [lambda s=s: s.merged_journals(bucket, prefix) for s in self.sets]
+        )
+        return listing.merge_journal_maps(
+            [r for r in results if not isinstance(r, Exception)]
+        )
+
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000) -> ListObjectsInfo:
+        self.get_bucket_info(bucket)
+        return listing.paginate_objects(
+            self.merged_journals(bucket, prefix),
+            lambda name, fi: self.sets[0]._fi_to_object_info(bucket, name, fi),
+            prefix, marker, delimiter, max_keys,
+        )
+
+    def list_object_versions(self, bucket: str, prefix: str = "", marker: str = "",
+                             version_marker: str = "", delimiter: str = "",
+                             max_keys: int = 1000) -> ListObjectVersionsInfo:
+        self.get_bucket_info(bucket)
+        return listing.paginate_versions(
+            self.merged_journals(bucket, prefix),
+            lambda name, fi: self.sets[0]._fi_to_object_info(bucket, name, fi),
+            prefix, marker, version_marker, delimiter, max_keys,
+        )
+
+    # -- healing --
+
+    def heal_bucket(self, bucket: str, dry_run: bool = False) -> HealResultItem:
+        results = [s.heal_bucket(bucket, dry_run) for s in self.sets]
+        out = results[0]
+        for r in results[1:]:
+            out.before.extend(r.before)
+            out.after.extend(r.after)
+            out.disk_count += r.disk_count
+        return out
+
+    def heal_object(self, bucket: str, obj: str, version_id: str = "",
+                    **kw) -> HealResultItem:
+        return self.get_hashed_set(obj).heal_object(bucket, obj, version_id, **kw)
+
+    def heal_objects(self, bucket: str, prefix: str = "",
+                     **kw) -> Iterator[HealResultItem]:
+        """Walk every object (all sets) and heal it — the bucket-wide heal
+        sequence (reference HealObjects, cmd/erasure-server-pool.go:1500)."""
+        for s in self.sets:
+            for name in sorted(s.merged_journals(bucket, prefix)):
+                try:
+                    yield s.heal_object(bucket, name, **kw)
+                except se.ObjectError as e:
+                    yield e  # type: ignore[misc]
+
+    # -- health --
+
+    def health(self) -> dict:
+        """Per-set drive health: online counts vs write quorum (reference
+        Health, cmd/erasure-server-pool.go)."""
+        per_set = [s.health() for s in self.sets]
+        return {
+            "healthy": all(h["healthy"] for h in per_set),
+            "sets": [h["sets"][0] for h in per_set],
+        }

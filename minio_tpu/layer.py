@@ -1,0 +1,159 @@
+"""ObjectLayer — the single contract every backend implements.
+
+Role-equivalent of cmd/object-api-interface.go:88-168: the reference's
+~40-method interface is the seam between the HTTP/API surfaces and every
+backend (erasure pools, FS, gateways, cache). Here the same seam: the S3
+server, admin plane and background services talk only to this contract;
+ErasureObjects / ErasureSets / ErasureServerPools / FSObjects all satisfy it
+(structurally — Python duck typing; this ABC is the checkable spec and the
+registration point).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import BinaryIO, Iterator
+
+from minio_tpu.erasure.healing import HealResultItem
+from minio_tpu.erasure.types import (
+    BucketInfo,
+    CompletePart,
+    DeletedObject,
+    ListObjectsInfo,
+    ListObjectVersionsInfo,
+    MultipartInfo,
+    ObjectInfo,
+    ObjectOptions,
+    ObjectToDelete,
+    PartInfoResult,
+)
+
+
+class ObjectLayer(abc.ABC):
+    """The core object-storage API (cmd/object-api-interface.go:88)."""
+
+    # -- bucket operations (:101-109) --
+
+    @abc.abstractmethod
+    def make_bucket(self, bucket: str, opts: ObjectOptions | None = None) -> None: ...
+
+    @abc.abstractmethod
+    def get_bucket_info(self, bucket: str) -> BucketInfo: ...
+
+    @abc.abstractmethod
+    def list_buckets(self) -> list[BucketInfo]: ...
+
+    @abc.abstractmethod
+    def delete_bucket(self, bucket: str, force: bool = False) -> None: ...
+
+    @abc.abstractmethod
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000) -> ListObjectsInfo: ...
+
+    @abc.abstractmethod
+    def list_object_versions(
+        self, bucket: str, prefix: str = "", marker: str = "",
+        version_marker: str = "", delimiter: str = "",
+        max_keys: int = 1000) -> ListObjectVersionsInfo: ...
+
+    # -- object operations (:111-124) --
+
+    @abc.abstractmethod
+    def put_object(self, bucket: str, obj: str, data: BinaryIO, size: int = -1,
+                   opts: ObjectOptions | None = None) -> ObjectInfo: ...
+
+    @abc.abstractmethod
+    def get_object(self, bucket: str, obj: str, offset: int = 0, length: int = -1,
+                   opts: ObjectOptions | None = None
+                   ) -> tuple[ObjectInfo, Iterator[bytes]]: ...
+
+    @abc.abstractmethod
+    def get_object_info(self, bucket: str, obj: str,
+                        opts: ObjectOptions | None = None) -> ObjectInfo: ...
+
+    @abc.abstractmethod
+    def delete_object(self, bucket: str, obj: str,
+                      opts: ObjectOptions | None = None) -> ObjectInfo: ...
+
+    @abc.abstractmethod
+    def delete_objects(self, bucket: str, objects: list[ObjectToDelete],
+                       opts: ObjectOptions | None = None
+                       ) -> list[DeletedObject | Exception]: ...
+
+    # -- multipart (:126-135) --
+
+    @abc.abstractmethod
+    def new_multipart_upload(self, bucket: str, obj: str,
+                             opts: ObjectOptions | None = None) -> str: ...
+
+    @abc.abstractmethod
+    def put_object_part(self, bucket: str, obj: str, upload_id: str,
+                        part_number: int, data: BinaryIO, size: int = -1,
+                        opts: ObjectOptions | None = None) -> PartInfoResult: ...
+
+    @abc.abstractmethod
+    def list_parts(self, bucket: str, obj: str, upload_id: str,
+                   part_marker: int = 0,
+                   max_parts: int = 1000) -> list[PartInfoResult]: ...
+
+    @abc.abstractmethod
+    def list_multipart_uploads(self, bucket: str, prefix: str = "",
+                               max_uploads: int = 1000) -> list[MultipartInfo]: ...
+
+    @abc.abstractmethod
+    def abort_multipart_upload(self, bucket: str, obj: str, upload_id: str) -> None: ...
+
+    @abc.abstractmethod
+    def complete_multipart_upload(
+        self, bucket: str, obj: str, upload_id: str, parts: list[CompletePart],
+        opts: ObjectOptions | None = None) -> ObjectInfo: ...
+
+    # -- tagging (:164-167) --
+
+    @abc.abstractmethod
+    def put_object_tags(self, bucket: str, obj: str, tags: str,
+                        opts: ObjectOptions | None = None) -> ObjectInfo: ...
+
+    @abc.abstractmethod
+    def get_object_tags(self, bucket: str, obj: str,
+                        opts: ObjectOptions | None = None) -> str: ...
+
+    @abc.abstractmethod
+    def delete_object_tags(self, bucket: str, obj: str,
+                           opts: ObjectOptions | None = None) -> ObjectInfo: ...
+
+    # -- healing (:151-155) --
+
+    @abc.abstractmethod
+    def heal_bucket(self, bucket: str, dry_run: bool = False) -> HealResultItem: ...
+
+    @abc.abstractmethod
+    def heal_object(self, bucket: str, obj: str, version_id: str = "",
+                    **kw) -> HealResultItem: ...
+
+    @abc.abstractmethod
+    def heal_objects(self, bucket: str, prefix: str = "",
+                     **kw) -> Iterator[HealResultItem]: ...
+
+    # -- health (:160-162) --
+
+    @abc.abstractmethod
+    def health(self) -> dict: ...
+
+    def close(self) -> None:
+        pass
+
+
+def _register_backends() -> None:
+    """Register the concrete backends as virtual subclasses so
+    isinstance(obj, ObjectLayer) is the contract check."""
+    from minio_tpu.erasure.objects import ErasureObjects
+    from minio_tpu.erasure.pools import ErasureServerPools
+    from minio_tpu.erasure.sets import ErasureSets
+
+    ObjectLayer.register(ErasureObjects)
+    ObjectLayer.register(ErasureSets)
+    ObjectLayer.register(ErasureServerPools)
+
+
+_register_backends()

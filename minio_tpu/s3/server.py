@@ -592,9 +592,19 @@ def _check_conditional(request, info) -> bool:
 
 
 def build_server(drive_paths: list[str], access_key: str, secret_key: str,
-                 versioned: bool = False, parity: int | None = None) -> S3Server:
+                 versioned: bool = False, parity: int | None = None,
+                 set_drive_count: int | None = None,
+                 enable_mrf: bool = True) -> S3Server:
+    """Assemble the full backend stack: drives → sets (sipHash routing) →
+    pools (capacity placement) → S3 front door (reference newObjectLayer,
+    cmd/server-main.go:557)."""
+    from minio_tpu.erasure.pools import ErasureServerPools
+    from minio_tpu.erasure.sets import ErasureSets
+
     drives = [LocalDrive(p) for p in drive_paths]
-    layer = ErasureObjects(drives, parity=parity)
+    sets = ErasureSets(drives, set_drive_count=set_drive_count, parity=parity,
+                       enable_mrf=enable_mrf)
+    layer = ErasureServerPools([sets])
     return S3Server(layer, sigv4.Credentials(access_key, secret_key),
                     versioned_buckets=versioned)
 
@@ -605,12 +615,15 @@ def main(argv=None):
     ap.add_argument("--address", default="0.0.0.0:9000")
     ap.add_argument("--versioned", action="store_true")
     ap.add_argument("--parity", type=int, default=None)
+    ap.add_argument("--set-drives", type=int, default=None,
+                    help="drives per erasure set (default: all drives, one set)")
     args = ap.parse_args(argv)
     host, _, port = args.address.rpartition(":")
     access = os.environ.get("MTPU_ROOT_USER", "minioadmin")
     secret = os.environ.get("MTPU_ROOT_PASSWORD", "minioadmin")
     srv = build_server(args.drives, access, secret,
-                       versioned=args.versioned, parity=args.parity)
+                       versioned=args.versioned, parity=args.parity,
+                       set_drive_count=args.set_drives)
     web.run_app(srv.app, host=host or "0.0.0.0", port=int(port))
 
 

@@ -100,7 +100,7 @@ class LocalDrive(StorageAPI):
 
     def get_disk_id(self) -> str:
         fmt = self.read_format()
-        this = fmt.get("this", "")
+        this = fmt.get("erasure", {}).get("this", "") or fmt.get("this", "")
         if self._expected_id and this != self._expected_id:
             raise se.InconsistentDisk(
                 f"drive {self.root}: id {this!r} != expected {self._expected_id!r}"

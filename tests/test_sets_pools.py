@@ -1,0 +1,277 @@
+"""Sets (sipHash routing), pools (capacity placement), format bootstrap.
+
+Mirrors the reference's erasure-sets/server-pool test strategy
+(cmd/erasure-sets_test.go TestSipHashMod-style routing checks,
+format-erasure_test.go quorum/heal scenarios) on local temp drives."""
+
+import io
+import os
+
+import pytest
+
+from minio_tpu.erasure.format import init_format_erasure
+from minio_tpu.erasure.pools import ErasureServerPools
+from minio_tpu.erasure.sets import ErasureSets
+from minio_tpu.erasure.types import CompletePart, ObjectOptions
+from minio_tpu.layer import ObjectLayer
+from minio_tpu.storage.local import LocalDrive
+from minio_tpu.utils import errors as se
+from minio_tpu.utils.siphash import sip_hash_mod, siphash24
+
+
+# ---------------- siphash ----------------
+
+
+def test_siphash24_reference_vector():
+    # Official SipHash-2-4 test vector (key 000102...0f, msg 00..0e).
+    key = bytes(range(16))
+    msg = bytes(range(15))
+    assert siphash24(key, msg) == 0xA129CA6149BE45E5
+
+
+def test_sip_hash_mod_stable_and_spread():
+    dep = "9cb09b54-8ab4-4d0a-95b6-3a1cd7e2a0a0"
+    vals = [sip_hash_mod(f"obj-{i}", 8, dep) for i in range(500)]
+    assert vals == [sip_hash_mod(f"obj-{i}", 8, dep) for i in range(500)]
+    assert all(0 <= v < 8 for v in vals)
+    # Every set gets a reasonable share.
+    counts = [vals.count(s) for s in range(8)]
+    assert min(counts) > 20
+    # Keyed: a different deployment shuffles the routing.
+    dep2 = "2e4f7a10-10e2-45c9-bd2e-0f6c2b7c1111"
+    assert vals != [sip_hash_mod(f"obj-{i}", 8, dep2) for i in range(500)]
+
+
+# ---------------- format ----------------
+
+
+def test_format_fresh_then_reload(tmp_path):
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(8)]
+    fmt = init_format_erasure(drives, 4)
+    assert len(fmt.sets) == 2 and all(len(s) == 4 for s in fmt.sets)
+    # Reload elects the same layout.
+    fmt2 = init_format_erasure(drives, 4)
+    assert fmt2.deployment_id == fmt.deployment_id
+    assert fmt2.sets == fmt.sets
+
+
+def test_format_heals_blank_drive(tmp_path):
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    fmt = init_format_erasure(drives, 4)
+    # Simulate a replaced drive: wipe its format file.
+    os.remove(drives[2]._format_path())
+    drives2 = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    fmt2 = init_format_erasure(drives2, 4)
+    assert fmt2.sets == fmt.sets
+    assert drives2[2].read_format()["erasure"]["this"] == fmt.sets[0][2]
+
+
+def test_format_rejects_layout_change(tmp_path):
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(8)]
+    init_format_erasure(drives, 4)
+    with pytest.raises(se.CorruptedFormat):
+        init_format_erasure(drives, 8)
+
+
+# ---------------- sets ----------------
+
+
+@pytest.fixture
+def sets(tmp_path):
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(8)]
+    s = ErasureSets(drives, set_drive_count=4, parity=1)
+    s.make_bucket("bkt")
+    yield s
+    s.close()
+
+
+def test_sets_routing_and_roundtrip(sets):
+    bodies = {}
+    for i in range(20):
+        body = os.urandom(1000 + i)
+        bodies[f"k/{i}"] = body
+        sets.put_object("bkt", f"k/{i}", io.BytesIO(body), len(body))
+    # Objects land in exactly the set the router names, and only there.
+    used = set()
+    for name in bodies:
+        owner = sip_hash_mod(name, sets.set_count, sets.deployment_id)
+        used.add(owner)
+        sets.sets[owner].get_object_info("bkt", name)
+        other = sets.sets[1 - owner]
+        with pytest.raises(se.ObjectNotFound):
+            other.get_object_info("bkt", name)
+    assert used == {0, 1}  # 20 keys hit both sets
+    # Reads through the routed layer.
+    for name, body in bodies.items():
+        _, stream = sets.get_object("bkt", name)
+        assert b"".join(stream) == body
+
+
+def test_sets_merged_listing(sets):
+    for i in range(30):
+        sets.put_object("bkt", f"list/{i:03d}", io.BytesIO(b"x"), 1)
+    res = sets.list_objects("bkt", prefix="list/", max_keys=1000)
+    assert [o.name for o in res.objects] == [f"list/{i:03d}" for i in range(30)]
+    # Pagination across the set merge.
+    page1 = sets.list_objects("bkt", prefix="list/", max_keys=10)
+    assert page1.is_truncated and len(page1.objects) == 10
+    page2 = sets.list_objects("bkt", prefix="list/", marker=page1.next_marker,
+                              max_keys=1000)
+    assert [o.name for o in page1.objects + page2.objects] == \
+        [f"list/{i:03d}" for i in range(30)]
+
+
+def test_sets_delimiter_listing(sets):
+    for p in ("a/x", "a/y", "b/z", "top"):
+        sets.put_object("bkt", p, io.BytesIO(b"v"), 1)
+    res = sets.list_objects("bkt", delimiter="/")
+    assert res.prefixes == ["a/", "b/"]
+    assert [o.name for o in res.objects] == ["top"]
+
+
+def test_sets_multipart_routed(sets):
+    body = os.urandom(5 << 20)
+    uid = sets.new_multipart_upload("bkt", "mp/big")
+    e = sets.put_object_part("bkt", "mp/big", uid, 1, io.BytesIO(body), len(body))
+    assert [u.upload_id for u in sets.list_multipart_uploads("bkt")] == [uid]
+    sets.complete_multipart_upload("bkt", "mp/big", uid, [CompletePart(1, e.etag)])
+    _, stream = sets.get_object("bkt", "mp/big")
+    assert b"".join(stream) == body
+
+
+def test_sets_heal_routed(sets):
+    import shutil
+
+    body = os.urandom(200000)
+    sets.put_object("bkt", "heal/me", io.BytesIO(body), len(body))
+    owner = sets.get_hashed_set("heal/me")
+    shutil.rmtree(os.path.join(owner.drives[0].root, "bkt", "heal/me"))
+    res = sets.heal_object("bkt", "heal/me")
+    assert res.healed_count == 1
+    results = list(sets.heal_objects("bkt"))
+    assert all(not isinstance(r, Exception) for r in results)
+
+
+def test_sets_health(sets):
+    h = sets.health()
+    assert h["healthy"] and len(h["sets"]) == 2
+
+
+def test_sets_is_object_layer(sets):
+    assert isinstance(sets, ObjectLayer)
+
+
+# ---------------- pools ----------------
+
+
+@pytest.fixture
+def pools(tmp_path):
+    p1 = ErasureSets([LocalDrive(str(tmp_path / f"p1d{i}")) for i in range(4)],
+                     parity=1)
+    p2 = ErasureSets([LocalDrive(str(tmp_path / f"p2d{i}")) for i in range(4)],
+                     parity=1)
+    pool = ErasureServerPools([p1, p2])
+    pool.make_bucket("bkt")
+    yield pool
+    pool.close()
+
+
+def test_pools_put_get_roundtrip(pools):
+    body = os.urandom(100000)
+    pools.put_object("bkt", "obj", io.BytesIO(body), len(body))
+    _, stream = pools.get_object("bkt", "obj")
+    assert b"".join(stream) == body
+    # Overwrite goes to the SAME pool that owns it.
+    owner_before = pools._get_pool_idx_existing("bkt", "obj")
+    body2 = os.urandom(5000)
+    pools.put_object("bkt", "obj", io.BytesIO(body2), len(body2))
+    assert pools._get_pool_idx_existing("bkt", "obj") == owner_before
+    _, stream = pools.get_object("bkt", "obj")
+    assert b"".join(stream) == body2
+
+
+def test_pools_listing_merges(pools):
+    # Force objects into both pools by writing directly to each.
+    pools.pools[0].put_object("bkt", "a-from-p1", io.BytesIO(b"1"), 1)
+    pools.pools[1].put_object("bkt", "b-from-p2", io.BytesIO(b"2"), 1)
+    res = pools.list_objects("bkt")
+    assert [o.name for o in res.objects] == ["a-from-p1", "b-from-p2"]
+    # get fans out to the owning pool.
+    _, s1 = pools.get_object("bkt", "a-from-p1")
+    _, s2 = pools.get_object("bkt", "b-from-p2")
+    assert b"".join(s1) == b"1" and b"".join(s2) == b"2"
+
+
+def test_pools_delete_routes_to_owner(pools):
+    pools.pools[1].put_object("bkt", "del-me", io.BytesIO(b"x"), 1)
+    pools.delete_object("bkt", "del-me")
+    with pytest.raises(se.ObjectNotFound):
+        pools.get_object_info("bkt", "del-me")
+
+
+def test_pools_multipart_finds_upload(pools):
+    body = os.urandom(5 << 20)
+    uid = pools.new_multipart_upload("bkt", "mp")
+    e = pools.put_object_part("bkt", "mp", uid, 1, io.BytesIO(body), len(body))
+    pools.complete_multipart_upload("bkt", "mp", uid, [CompletePart(1, e.etag)])
+    _, stream = pools.get_object("bkt", "mp")
+    assert b"".join(stream) == body
+    with pytest.raises(se.InvalidUploadID):
+        pools.put_object_part("bkt", "mp", "bogus", 1, io.BytesIO(b"z"), 1)
+
+
+def test_pools_versioned_delete_marker(pools):
+    body = b"versioned body"
+    pools.put_object("bkt", "v", io.BytesIO(body), len(body),
+                     ObjectOptions(versioned=True))
+    info = pools.delete_object("bkt", "v", ObjectOptions(versioned=True))
+    assert info.delete_marker
+    res = pools.list_object_versions("bkt", prefix="v")
+    assert len(res.objects) == 2  # marker + original
+    assert res.objects[0].delete_marker
+
+
+def test_pools_is_object_layer(pools):
+    assert isinstance(pools, ObjectLayer)
+
+
+def test_delimiter_pagination_advances(sets):
+    """Truncating at a common-prefix boundary must still let clients resume
+    (regression: empty NextMarker looped clients on page 1 forever)."""
+    for i in range(5):
+        sets.put_object("bkt", f"pg/d{i}/o", io.BytesIO(b"x"), 1)
+    seen_prefixes, marker = [], ""
+    for _ in range(10):
+        res = sets.list_objects("bkt", prefix="pg/", delimiter="/",
+                                marker=marker, max_keys=2)
+        seen_prefixes.extend(res.prefixes)
+        if not res.is_truncated:
+            break
+        assert res.next_marker, "truncated page must carry a resume marker"
+        marker = res.next_marker
+    assert seen_prefixes == [f"pg/d{i}/" for i in range(5)]
+
+
+def test_format_refuses_foreign_drive(tmp_path):
+    a = [LocalDrive(str(tmp_path / f"a{i}")) for i in range(4)]
+    init_format_erasure(a, 4)
+    b = [LocalDrive(str(tmp_path / f"b{i}")) for i in range(4)]
+    init_format_erasure(b, 4)
+    mixed = a[:3] + [b[0]]
+    with pytest.raises(se.CorruptedFormat):
+        init_format_erasure(mixed, 4)
+    # The foreign drive's format is untouched.
+    assert LocalDrive(str(tmp_path / "b0")).read_format()["id"] == b[0].read_format()["id"]
+
+
+def test_disk_id_roundtrip(tmp_path):
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    fmt = init_format_erasure(drives, 4)
+    for i, d in enumerate(drives):
+        assert d.get_disk_id() == fmt.sets[0][i]
+        assert d.disk_info().id == fmt.sets[0][i]
+
+
+def test_list_multipart_uploads_missing_bucket(sets):
+    with pytest.raises(se.BucketNotFound):
+        sets.list_multipart_uploads("no-such-bucket")
