@@ -30,7 +30,6 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from minio_tpu.ops import gf
 
-_POW2F = np.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=np.float32)
 
 
 def make_mesh(n_devices: int | None = None, *, devices=None) -> Mesh:
@@ -58,30 +57,35 @@ def make_mesh(n_devices: int | None = None, *, devices=None) -> Mesh:
 
 
 def _local_gf2_partial(x_local: jax.Array, w_local: jax.Array) -> jax.Array:
-    """Per-device partial contraction: [b, k_loc, s] u8 x [k_loc*8, t8] bf16
-    -> [b, s, t8] f32 partial bit-counts (mod 2 NOT yet applied)."""
+    """Per-device partial contraction: [b, k_loc, s] u8 x [k_loc*8, t8] i8
+    -> [b, s, t8] i32 partial bit-counts (mod 2 NOT yet applied).
+
+    int8 MXU path with exact int32 accumulation — same formulation as the
+    single-chip kernel (rs_xla._gf2_matmul); the psum over 'tp' stays in
+    int32 so the deferred mod-2 remains exact."""
     b, k_loc, s = x_local.shape
     bits = (x_local[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & jnp.uint8(1)
-    bits = bits.transpose(0, 2, 1, 3).reshape(b, s, k_loc * 8).astype(jnp.bfloat16)
+    bits = bits.transpose(0, 2, 1, 3).reshape(b, s, k_loc * 8).astype(jnp.int8)
     return jax.lax.dot_general(
         bits, w_local, (((2,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.int32,
     )
 
 
 def _finish(y: jax.Array, t: int) -> jax.Array:
-    """mod-2 + bit-pack epilogue: [b, s, t*8] f32 -> [b, t, s] u8."""
+    """mod-2 + bit-pack epilogue: [b, s, t*8] i32 -> [b, t, s] u8."""
     b, s, _ = y.shape
-    y = y - 2.0 * jnp.floor(y * 0.5)
-    y = y.reshape(b, s, t, 8) @ jnp.asarray(_POW2F)
-    return y.astype(jnp.uint8).transpose(0, 2, 1)
+    y = (y & 1).astype(jnp.uint8).reshape(b, s, t, 8)
+    y = y << jnp.arange(8, dtype=jnp.uint8)
+    y = jax.lax.reduce(y, np.uint8(0), jax.lax.bitwise_or, (3,))
+    return y.transpose(0, 2, 1)
 
 
 @functools.partial(
     jax.jit, static_argnames=("k", "out_shards", "mesh")
 )
 def _sharded_gf2_matmul(data, w, *, k: int, out_shards: int, mesh: Mesh):
-    """data [B, k, S] u8, w [k*8, t*8] bf16 -> [B, t, S] u8, over the mesh.
+    """data [B, k, S] u8, w [k*8, t*8] i8 -> [B, t, S] u8, over the mesh.
 
     Sharding: B over dp, the k shard rows over tp (the contraction axis —
     completed by an integer psum), S over sp. Output parity is replicated
@@ -110,7 +114,7 @@ def sharded_encode(mesh: Mesh, data: jax.Array, k: int, m: int) -> jax.Array:
     S = blockSize/k with blockSize 1 MiB — cmd/object-api-common.go:41).
     """
     _check_divisibility(mesh, data.shape, k)
-    w = jnp.asarray(gf.encode_bitmatrix(k, m), dtype=jnp.bfloat16)
+    w = jnp.asarray(gf.encode_bitmatrix(k, m), dtype=jnp.int8)
     return _sharded_gf2_matmul(data, w, k=k, out_shards=m, mesh=mesh)
 
 
@@ -132,7 +136,7 @@ def sharded_reconstruct(
     _check_divisibility(mesh, survivors_data.shape, k)
     w = jnp.asarray(
         gf.decode_bitmatrix(k, n, tuple(survivors), tuple(targets)),
-        dtype=jnp.bfloat16,
+        dtype=jnp.int8,
     )
     return _sharded_gf2_matmul(
         survivors_data, w, k=k, out_shards=len(targets), mesh=mesh
