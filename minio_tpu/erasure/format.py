@@ -108,12 +108,22 @@ def init_format_erasure(
             f"requested {set_count}x{set_drive_count}"
         )
 
-    # Heal: blank drives (UnformattedDisk) adopt the UUID of their slot. A
-    # drive carrying a format for a DIFFERENT deployment is someone else's
-    # data — refuse to touch it (the reference errors on deployment-ID
-    # mismatch rather than reformatting).
+    # Place every formatted drive at the slot its own UUID names — the
+    # reference orders disks by format content, not command-line position,
+    # so permuting the drive arguments across restarts must not scramble the
+    # set layout. Blank/replaced drives then fill the remaining slots and
+    # are healed with that slot's UUID. A drive carrying a format for a
+    # DIFFERENT deployment is someone else's data — refuse to touch it (the
+    # reference errors on deployment-ID mismatch rather than reformatting).
+    uuid_to_slot = {
+        u: si * set_drive_count + di
+        for si, s in enumerate(ref.sets)
+        for di, u in enumerate(s)
+    }
+    ordered: list[StorageAPI | None] = [None] * n
+    blank: list[int] = []     # UnformattedDisk: provably fresh, safe to heal
+    unreadable: list[int] = []  # IO error: may carry a format we can't see
     for i, r in enumerate(results):
-        slot_uuid = ref.sets[i // set_drive_count][i % set_drive_count]
         if isinstance(r, dict):
             f = FormatInfo.from_doc(r)
             if f.deployment_id != dep_id:
@@ -121,12 +131,36 @@ def init_format_erasure(
                     f"drive {i} belongs to deployment {f.deployment_id}, "
                     f"not {dep_id} — refusing to reformat a foreign drive"
                 )
-            if f.this == slot_uuid:
-                drives[i].set_disk_id(slot_uuid)
+            slot = uuid_to_slot.get(f.this)
+            if slot is not None and ordered[slot] is None:
+                ordered[slot] = drives[i]
+                drives[i].set_disk_id(f.this)
                 continue
+            blank.append(i)  # stale/unknown UUID in this deployment: reclaim
+        elif isinstance(r, se.UnformattedDisk):
+            blank.append(i)
+        else:
+            unreadable.append(i)
+    # Only provably-blank drives are healed with a slot UUID. An unreadable
+    # drive may still hold a slot's format — writing that slot's UUID to a
+    # blank drive would mint a duplicate identity that destroys data on a
+    # later boot (reference heals only errUnformattedDisk,
+    # cmd/format-erasure.go). So while any drive is unreadable, blanks are
+    # placed but left unformatted; a later boot (or heal_format) fixes them.
+    heal_blanks = not unreadable
+    for slot in range(n):
+        if ordered[slot] is not None:
+            continue
+        i = blank.pop(0) if blank else unreadable.pop(0)
+        drive = drives[i]
+        ordered[slot] = drive
+        if not (heal_blanks and isinstance(results[i], (dict, se.UnformattedDisk))):
+            continue
+        slot_uuid = ref.sets[slot // set_drive_count][slot % set_drive_count]
         try:
-            drives[i].write_format(ref.to_doc(slot_uuid))
-            drives[i].set_disk_id(slot_uuid)
+            drive.write_format(ref.to_doc(slot_uuid))
+            drive.set_disk_id(slot_uuid)
         except se.StorageError:
             pass
+    drives[:] = ordered  # callers consume the UUID-ordered layout
     return ref

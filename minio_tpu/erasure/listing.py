@@ -13,10 +13,35 @@ from __future__ import annotations
 
 from typing import Callable
 
-from minio_tpu.erasure.types import ListObjectsInfo, ListObjectVersionsInfo
+from minio_tpu.erasure.types import (
+    ListObjectsInfo,
+    ListObjectVersionsInfo,
+    ObjectInfo,
+)
 from minio_tpu.storage.fileinfo import FileInfo
 from minio_tpu.storage.xlmeta import XLMeta
 from minio_tpu.utils import errors as se
+
+
+def fi_to_object_info(bucket: str, obj: str, fi: FileInfo) -> ObjectInfo:
+    """FileInfo -> ObjectInfo (reference fileInfo.ToObjectInfo,
+    cmd/erasure-metadata.go:44). Pure conversion, shared by every layer."""
+    return ObjectInfo(
+        bucket=bucket,
+        name=obj,
+        mod_time=fi.mod_time,
+        size=fi.size,
+        etag=fi.metadata.get("etag", ""),
+        version_id=fi.version_id,
+        is_latest=fi.is_latest,
+        delete_marker=fi.deleted,
+        content_type=fi.metadata.get("content-type", ""),
+        user_defined={k: v for k, v in fi.metadata.items()
+                      if k not in ("etag", "content-type")},
+        parity_blocks=fi.erasure.parity_blocks,
+        data_blocks=fi.erasure.data_blocks,
+        num_versions=fi.num_versions,
+    )
 
 
 def bulk_delete(delete_object, bucket, objects, opts=None):
@@ -155,9 +180,10 @@ def paginate_versions(
                 if fi.version_id == version_marker:
                     skipping = False
                 continue
-            if count >= max_keys:
+            if count + len(seen_prefix) >= max_keys:
                 # Markers already name the last emitted item; resume skips
-                # through it.
+                # through it. Prefixes count against max_keys like versions
+                # do (S3 bounds keys + common prefixes together).
                 out.is_truncated = True
                 return out
             out.objects.append(to_info(name, fi))

@@ -671,23 +671,16 @@ class ErasureObjects(HealingMixin, MultipartMixin):
         read_quorum = max(set(ks), key=ks.count) if ks else self.n // 2
         return find_fileinfo_in_quorum(results, max(1, read_quorum), bucket, obj)
 
+    def latest_fileinfo(self, bucket: str, obj: str,
+                        version_id: str = "") -> FileInfo:
+        """Quorum-elected FileInfo including delete markers — the existence
+        probe pool routing needs (a key whose latest version is a delete
+        marker still *lives* here; reference getPoolIdxExisting,
+        cmd/erasure-server-pool.go:252)."""
+        return self._read_quorum_fileinfo(bucket, obj, version_id)
+
     def _fi_to_object_info(self, bucket: str, obj: str, fi: FileInfo) -> ObjectInfo:
-        return ObjectInfo(
-            bucket=bucket,
-            name=obj,
-            mod_time=fi.mod_time,
-            size=fi.size,
-            etag=fi.metadata.get("etag", ""),
-            version_id=fi.version_id,
-            is_latest=fi.is_latest,
-            delete_marker=fi.deleted,
-            content_type=fi.metadata.get("content-type", ""),
-            user_defined={k: v for k, v in fi.metadata.items()
-                          if k not in ("etag", "content-type")},
-            parity_blocks=fi.erasure.parity_blocks,
-            data_blocks=fi.erasure.data_blocks,
-            num_versions=fi.num_versions,
-        )
+        return listing.fi_to_object_info(bucket, obj, fi)
 
 
 def _clone_for_drive(fi: FileInfo, index: int) -> FileInfo:

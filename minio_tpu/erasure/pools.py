@@ -29,6 +29,7 @@ from minio_tpu.erasure.types import (
     ObjectToDelete,
     PartInfoResult,
 )
+from minio_tpu.storage.fileinfo import FileInfo
 from minio_tpu.storage.xlmeta import XLMeta
 from minio_tpu.utils import errors as se
 
@@ -57,15 +58,19 @@ class ErasureServerPools:
     def _get_pool_idx_existing(self, bucket: str, obj: str,
                                version_id: str = "") -> int | None:
         """Index of the pool already holding the object, newest wins
-        (reference getPoolIdxExisting, cmd/erasure-server-pool.go:252)."""
+        (reference getPoolIdxExisting, cmd/erasure-server-pool.go:252).
+
+        Probes at the journal level (latest_fileinfo) so a key whose latest
+        version is a delete marker still pins its pool — a re-PUT after a
+        versioned delete must land where the version history lives, not be
+        re-routed by free capacity (which would split versions across pools)."""
         results = parallel_map(
-            [lambda p=p: p.get_object_info(
-                bucket, obj, ObjectOptions(version_id=version_id))
+            [lambda p=p: p.latest_fileinfo(bucket, obj, version_id)
              for p in self.pools]
         )
         best, best_mt = None, -1.0
         for i, r in enumerate(results):
-            if isinstance(r, ObjectInfo) and r.mod_time > best_mt:
+            if isinstance(r, FileInfo) and r.mod_time > best_mt:
                 best, best_mt = i, r.mod_time
         return best
 
@@ -222,10 +227,9 @@ class ErasureServerPools:
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
                      delimiter: str = "", max_keys: int = 1000) -> ListObjectsInfo:
         self.get_bucket_info(bucket)
-        fi2info = self.pools[0].sets[0]._fi_to_object_info
         return listing.paginate_objects(
             self.merged_journals(bucket, prefix),
-            lambda name, fi: fi2info(bucket, name, fi),
+            lambda name, fi: listing.fi_to_object_info(bucket, name, fi),
             prefix, marker, delimiter, max_keys,
         )
 
@@ -233,10 +237,9 @@ class ErasureServerPools:
                              version_marker: str = "", delimiter: str = "",
                              max_keys: int = 1000) -> ListObjectVersionsInfo:
         self.get_bucket_info(bucket)
-        fi2info = self.pools[0].sets[0]._fi_to_object_info
         return listing.paginate_versions(
             self.merged_journals(bucket, prefix),
-            lambda name, fi: fi2info(bucket, name, fi),
+            lambda name, fi: listing.fi_to_object_info(bucket, name, fi),
             prefix, marker, version_marker, delimiter, max_keys,
         )
 

@@ -30,7 +30,6 @@ from minio_tpu.erasure.types import (
 )
 from minio_tpu.storage.api import StorageAPI
 from minio_tpu.storage.xlmeta import XLMeta
-from minio_tpu.utils import errors as se
 from minio_tpu.utils.siphash import sip_hash_mod
 
 
@@ -127,6 +126,9 @@ class ErasureSets:
                            opts: ObjectOptions | None = None) -> ObjectInfo:
         return self.get_hashed_set(obj).delete_object_tags(bucket, obj, opts)
 
+    def latest_fileinfo(self, bucket: str, obj: str, version_id: str = ""):
+        return self.get_hashed_set(obj).latest_fileinfo(bucket, obj, version_id)
+
     # -- multipart: route by hash --
 
     def new_multipart_upload(self, bucket: str, obj: str,
@@ -183,7 +185,7 @@ class ErasureSets:
         self.get_bucket_info(bucket)
         return listing.paginate_objects(
             self.merged_journals(bucket, prefix),
-            lambda name, fi: self.sets[0]._fi_to_object_info(bucket, name, fi),
+            lambda name, fi: listing.fi_to_object_info(bucket, name, fi),
             prefix, marker, delimiter, max_keys,
         )
 
@@ -193,7 +195,7 @@ class ErasureSets:
         self.get_bucket_info(bucket)
         return listing.paginate_versions(
             self.merged_journals(bucket, prefix),
-            lambda name, fi: self.sets[0]._fi_to_object_info(bucket, name, fi),
+            lambda name, fi: listing.fi_to_object_info(bucket, name, fi),
             prefix, marker, version_marker, delimiter, max_keys,
         )
 
@@ -217,11 +219,7 @@ class ErasureSets:
         """Walk every object (all sets) and heal it — the bucket-wide heal
         sequence (reference HealObjects, cmd/erasure-server-pool.go:1500)."""
         for s in self.sets:
-            for name in sorted(s.merged_journals(bucket, prefix)):
-                try:
-                    yield s.heal_object(bucket, name, **kw)
-                except se.ObjectError as e:
-                    yield e  # type: ignore[misc]
+            yield from s.heal_objects(bucket, prefix, **kw)
 
     # -- health --
 

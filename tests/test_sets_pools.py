@@ -275,3 +275,64 @@ def test_disk_id_roundtrip(tmp_path):
 def test_list_multipart_uploads_missing_bucket(sets):
     with pytest.raises(se.BucketNotFound):
         sets.list_multipart_uploads("no-such-bucket")
+
+def test_format_reorders_permuted_drives(tmp_path):
+    """Restarting with the drive paths permuted must not scramble the set
+    layout: drives are placed by their on-disk format UUID, not argv order."""
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(8)]
+    s = ErasureSets(drives, set_drive_count=4, parity=1)
+    s.make_bucket("bkt")
+    bodies = {f"o{i}": os.urandom(5000) for i in range(12)}
+    for name, body in bodies.items():
+        s.put_object("bkt", name, io.BytesIO(body), len(body))
+    s.close()
+
+    permuted = [LocalDrive(str(tmp_path / f"d{i}"))
+                for i in (5, 2, 7, 0, 3, 6, 1, 4)]
+    fmt2 = init_format_erasure(permuted, 4)
+    assert fmt2.sets == s.format.sets
+    for i, d in enumerate(permuted):  # list reordered back to UUID slots
+        assert d.read_format()["erasure"]["this"] == fmt2.sets[i // 4][i % 4]
+
+    s2 = ErasureSets([LocalDrive(str(tmp_path / f"d{i}"))
+                      for i in (5, 2, 7, 0, 3, 6, 1, 4)],
+                     set_drive_count=4, parity=1)
+    for name, body in bodies.items():
+        _, stream = s2.get_object("bkt", name)
+        assert b"".join(stream) == body, name
+    s2.close()
+
+
+def test_pools_versioned_reput_stays_in_owner_pool(pools, monkeypatch):
+    """A re-PUT after a versioned delete must land in the pool holding the
+    key's version history, even when capacity weighting prefers another."""
+    pools.put_object("bkt", "vv", io.BytesIO(b"one"), 3,
+                     ObjectOptions(versioned=True))
+    owner = pools._get_pool_idx_existing("bkt", "vv")
+    assert owner is not None
+    pools.delete_object("bkt", "vv", ObjectOptions(versioned=True))
+    # Delete marker keeps the pool pinned.
+    assert pools._get_pool_idx_existing("bkt", "vv") == owner
+    # Make capacity weighting prefer the OTHER pool.
+    other = 1 - owner
+    monkeypatch.setattr(
+        pools, "_pool_free",
+        lambda p: 10**12 if p is pools.pools[other] else 1,
+    )
+    pools.put_object("bkt", "vv", io.BytesIO(b"two"), 3,
+                     ObjectOptions(versioned=True))
+    assert pools._get_pool_idx_existing("bkt", "vv") == owner
+    res = pools.list_object_versions("bkt", prefix="vv")
+    assert len(res.objects) == 3  # v2, delete marker, v1 — one pool, intact
+    assert sum(1 for o in res.objects if o.delete_marker) == 1
+
+
+def test_paginate_versions_counts_prefixes_against_max_keys(sets):
+    for i in range(3):
+        sets.put_object("bkt", f"vp/a/{i}", io.BytesIO(b"x"), 1)
+    for n in ("b", "c", "d"):
+        sets.put_object("bkt", f"vp/{n}", io.BytesIO(b"x"), 1)
+    res = sets.list_object_versions("bkt", prefix="vp/", delimiter="/",
+                                    max_keys=2)
+    assert len(res.objects) + len(res.prefixes) <= 2
+    assert res.is_truncated
