@@ -1,0 +1,20 @@
+"""Distributed plane: node-to-node RPC fabric.
+
+Four planes share one generic HTTP client/server pair, exactly the
+reference's layering (SURVEY §5.8; cmd/rest/client.go):
+
+  storage  - per-drive StorageAPI served remotely (cmd/storage-rest-*.go)
+  lock     - dsync NetLocker quorum locks       (cmd/lock-rest-*.go)
+  peer     - control plane fan-out              (cmd/peer-rest-*.go)
+  bootstrap- startup topology verification      (cmd/bootstrap-peer-server.go)
+
+The TPU split (SURVEY §5.8): control planes are host RPC; the *data* plane
+keeps the StorageAPI seam so "remote drive" is transparent to the erasure
+engine — shard bytes stream over DCN into host buffers that feed the same
+batched device kernels as local drives.
+"""
+
+from minio_tpu.dist.rpc import RestClient, sign_token, verify_token
+from minio_tpu.dist.server import NodeServer
+
+__all__ = ["RestClient", "NodeServer", "sign_token", "verify_token"]

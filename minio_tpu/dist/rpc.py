@@ -1,0 +1,249 @@
+"""Generic inter-node REST client — the shared transport for all RPC planes.
+
+Role-equivalent of cmd/rest/client.go: POST with URL-encoded args, streaming
+request/response bodies, msgpack payloads, and a health-check-driven
+online/offline state machine with background reconnect (rest.Client:75,
+Call:120, MarkOffline:208).
+
+Auth: every call carries an HMAC token derived from the cluster secret
+(the reference signs inter-node requests with a JWT from the root
+credentials, cmd/jwt/). Tokens are cheap to mint per call and expire.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import http.client
+import json
+import threading
+import time
+import urllib.parse
+from typing import BinaryIO, Iterable, Iterator
+
+import msgpack
+
+from minio_tpu.utils import errors as se
+
+DEFAULT_TIMEOUT = 30.0
+HEALTH_INTERVAL = 1.0
+ERR_STATUS = 599  # carries a typed storage error in the body
+
+
+# --- auth tokens -------------------------------------------------------------
+
+def sign_token(secret: str, ttl: float = 900.0, now: float | None = None) -> str:
+    """Mint an expiring HMAC bearer token binding the cluster secret."""
+    payload = json.dumps({"exp": (now or time.time()) + ttl}).encode()
+    mac = hmac.new(secret.encode(), payload, hashlib.sha256).digest()
+    return (base64.urlsafe_b64encode(payload).decode().rstrip("=")
+            + "." + base64.urlsafe_b64encode(mac).decode().rstrip("="))
+
+
+def verify_token(secret: str, token: str, now: float | None = None) -> bool:
+    try:
+        p64, m64 = token.split(".")
+        pad = lambda s: s + "=" * (-len(s) % 4)  # noqa: E731
+        payload = base64.urlsafe_b64decode(pad(p64))
+        mac = base64.urlsafe_b64decode(pad(m64))
+        want = hmac.new(secret.encode(), payload, hashlib.sha256).digest()
+        if not hmac.compare_digest(mac, want):
+            return False
+        return json.loads(payload)["exp"] >= (now or time.time())
+    except Exception:
+        return False
+
+
+# --- wire helpers ------------------------------------------------------------
+
+def pack(obj) -> bytes:
+    return msgpack.packb(obj)
+
+
+def unpack(raw: bytes):
+    return msgpack.unpackb(raw, strict_map_key=False)
+
+
+class _ResponseStream:
+    """File-like over an HTTP response that returns its connection to the
+    pool on close (exactly-once)."""
+
+    def __init__(self, resp: http.client.HTTPResponse, client: "RestClient",
+                 conn: http.client.HTTPConnection):
+        self._resp = resp
+        self._client = client
+        self._conn = conn
+        self._closed = False
+
+    def read(self, n: int = -1) -> bytes:
+        return self._resp.read() if n is None or n < 0 else self._resp.read(n)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        # Drain so the connection is reusable; give up past 1 MiB.
+        try:
+            leftover = self._resp.read(1 << 20)
+            if leftover and len(leftover) == (1 << 20):
+                self._conn.close()
+            self._client._put_conn(self._conn)
+        except Exception:
+            try:
+                self._conn.close()
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RestClient:
+    """One per (node, plane-root). `call()` raises typed storage errors
+    re-hydrated from the wire; network failures mark the client offline and
+    a daemon probe brings it back (cmd/rest/client.go:135-168)."""
+
+    def __init__(self, host: str, port: int, secret: str,
+                 timeout: float = DEFAULT_TIMEOUT, scheme: str = "http"):
+        self.host = host
+        self.port = port
+        self.secret = secret
+        self.timeout = timeout
+        self.scheme = scheme
+        self._online = True
+        self._lock = threading.Lock()
+        self._pool: list[http.client.HTTPConnection] = []
+        self._probing = False
+
+    # -- connection pool --
+
+    def _get_conn(self) -> http.client.HTTPConnection:
+        with self._lock:
+            if self._pool:
+                return self._pool.pop()
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+
+    def _put_conn(self, conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            if len(self._pool) < 8:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    # -- online state machine --
+
+    def is_online(self) -> bool:
+        return self._online
+
+    def mark_offline(self) -> None:
+        with self._lock:
+            if not self._online:
+                return
+            self._online = False
+            if self._probing:
+                return
+            self._probing = True
+        t = threading.Thread(target=self._probe_loop, daemon=True,
+                             name=f"rpc-health-{self.host}:{self.port}")
+        t.start()
+
+    def _probe_loop(self) -> None:
+        while True:
+            time.sleep(HEALTH_INTERVAL)
+            try:
+                conn = http.client.HTTPConnection(self.host, self.port,
+                                                  timeout=2.0)
+                conn.request("GET", "/health")
+                ok = conn.getresponse().status == 200
+                conn.close()
+            except Exception:
+                ok = False
+            if ok:
+                with self._lock:
+                    self._online = True
+                    self._probing = False
+                return
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._pool:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+            self._pool.clear()
+
+    # -- calls --
+
+    def call(self, path: str, params: dict | None = None,
+             body: bytes | Iterable[bytes] | None = None,
+             stream: bool = False) -> bytes | _ResponseStream:
+        """POST {path}?{params} with optional (possibly chunked) body.
+
+        Returns the full response body, or a file-like if stream=True.
+        Raises DiskNotFound when the node is offline / unreachable
+        (the per-drive error the quorum reducers expect)."""
+        if not self._online:
+            raise se.DiskNotFound(f"{self.host}:{self.port} offline")
+        qs = urllib.parse.urlencode(params or {})
+        url = path + ("?" + qs if qs else "")
+        headers = {"Authorization": "Bearer " + sign_token(self.secret)}
+        conn = self._get_conn()
+        try:
+            if body is None:
+                conn.request("POST", url, headers=headers)
+            elif isinstance(body, (bytes, bytearray)):
+                conn.request("POST", url, body=bytes(body), headers=headers)
+            else:
+                headers["Transfer-Encoding"] = "chunked"
+                conn.request("POST", url, body=iter(body), headers=headers,
+                             encode_chunked=True)
+            resp = conn.getresponse()
+        except (OSError, http.client.HTTPException) as e:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            self.mark_offline()
+            raise se.DiskNotFound(
+                f"{self.host}:{self.port}: {e}") from e
+
+        if resp.status == ERR_STATUS:
+            doc = unpack(resp.read())
+            self._put_conn(conn)
+            raise se.by_name(doc.get("err", "StorageError"), doc.get("msg", ""))
+        if resp.status != 200:
+            msg = resp.read()[:512].decode(errors="replace")
+            self._put_conn(conn)
+            raise se.FaultyDisk(
+                f"{self.host}:{self.port}{path}: HTTP {resp.status} {msg}")
+        if stream:
+            return _ResponseStream(resp, self, conn)
+        data = resp.read()
+        self._put_conn(conn)
+        return data
+
+    def call_msgpack(self, path: str, params: dict | None = None,
+                     body: bytes | Iterable[bytes] | None = None):
+        raw = self.call(path, params, body)
+        return unpack(raw) if raw else None
+
+    def iter_msgpack(self, path: str, params: dict | None = None) -> Iterator:
+        """Stream a sequence of msgpack documents (walk_dir entries)."""
+        st = self.call(path, params, stream=True)
+        assert isinstance(st, _ResponseStream)
+        try:
+            unpacker = msgpack.Unpacker(strict_map_key=False)
+            while True:
+                chunk = st.read(1 << 16)
+                if not chunk:
+                    break
+                unpacker.feed(chunk)
+                yield from unpacker
+        finally:
+            st.close()

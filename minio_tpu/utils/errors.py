@@ -159,3 +159,20 @@ class InvalidRange(ObjectError):
 
 class OperationTimedOut(ObjectError):
     pass
+
+
+# --- wire transport helpers (dist/rpc.py) -----------------------------------
+#
+# Storage RPC carries errors by class name; the client re-raises the same
+# typed exception so quorum reducers behave identically for local and remote
+# drives (the reference ships error *strings* over storage REST and converts
+# back with toStorageErr, cmd/storage-rest-client.go:113-160).
+
+def by_name(name: str, msg: str = "") -> Exception:
+    """Rebuild a typed storage/object error from its class name."""
+    cls = globals().get(name)
+    if isinstance(cls, type) and issubclass(cls, ObjectError):
+        return cls(msg=msg)
+    if isinstance(cls, type) and issubclass(cls, StorageError):
+        return cls(msg)
+    return StorageError(f"{name}: {msg}")
