@@ -198,6 +198,21 @@ class HealingMixin:
         remove_dangling: bool = True,
         scan_deep: bool = False,
     ) -> HealResultItem:
+        # Heal mutates shard files + journal: exclusive per-object lock
+        # (reference cmd/erasure-healing.go:252-258).
+        with self.nslock.lock(bucket, obj):
+            return self._heal_object_locked(
+                bucket, obj, version_id, dry_run, remove_dangling, scan_deep)
+
+    def _heal_object_locked(
+        self,
+        bucket: str,
+        obj: str,
+        version_id: str = "",
+        dry_run: bool = False,
+        remove_dangling: bool = True,
+        scan_deep: bool = False,
+    ) -> HealResultItem:
         results = parallel_map(
             [lambda d=d: d.read_version(bucket, obj, version_id) for d in self.drives]
         )

@@ -314,9 +314,12 @@ class MultipartMixin:
             f.erasure.index = i + 1
             drive.rename_data(SYS_VOL, tmp_rel, f, bucket, obj)
 
-        outcomes = parallel_map(
-            [lambda i=i, d=d: commit(i, d) for i, d in enumerate(shuffled)]
-        )
+        # Commit under the per-object namespace lock (reference takes the
+        # dist lock around CompleteMultipartUpload's rename commit).
+        with self.nslock.lock(bucket, obj):
+            outcomes = parallel_map(
+                [lambda i=i, d=d: commit(i, d) for i, d in enumerate(shuffled)]
+            )
         try:
             reduce_write_quorum(outcomes, write_quorum, bucket, obj)
         except Exception:

@@ -96,10 +96,18 @@ class ErasureObjects(HealingMixin, MultipartMixin):
         batch_blocks: int = 8,
         bitrot_algorithm: str = bitrot.DEFAULT_ALGORITHM,
         enable_mrf: bool = False,
+        nslock=None,
     ):
         if not drives:
             raise ValueError("empty drive set")
         self.drives = drives
+        # Per-(bucket,object) namespace lock around mutating commits —
+        # in-process by default, dsync-quorum in distributed topologies
+        # (reference NewNSLock, cmd/namespace-lock.go:48).
+        if nslock is None:
+            from minio_tpu.dist.nslock import NamespaceLockMap
+            nslock = NamespaceLockMap()
+        self.nslock = nslock
         self.n = len(drives)
         self.parity = default_parity(self.n) if parity is None else parity
         if not 0 <= self.parity < self.n:
@@ -242,13 +250,14 @@ class ErasureObjects(HealingMixin, MultipartMixin):
             fi.data_dir = ""
             fi.metadata.setdefault("etag", md5.hexdigest())
             fi.parts = [PartInfo(1, fi.size, fi.size, fi.mod_time)]
-            outcomes = parallel_map(
-                [
-                    lambda d=d, f=_clone_for_drive(fi, i + 1): d.write_metadata(bucket, obj, f)
-                    for i, d in enumerate(shuffled)
-                ]
-            )
-            reduce_write_quorum(outcomes, write_quorum, bucket, obj)
+            with self.nslock.lock(bucket, obj):
+                outcomes = parallel_map(
+                    [
+                        lambda d=d, f=_clone_for_drive(fi, i + 1): d.write_metadata(bucket, obj, f)
+                        for i, d in enumerate(shuffled)
+                    ]
+                )
+                reduce_write_quorum(outcomes, write_quorum, bucket, obj)
             return self._fi_to_object_info(bucket, obj, fi)
 
         # Streaming erasure path.
@@ -275,16 +284,19 @@ class ErasureObjects(HealingMixin, MultipartMixin):
                 raise errs[i]
             drive.rename_data(sys_vol, tmp_rel, _clone_for_drive(fi, i + 1), bucket, obj)
 
-        outcomes = parallel_map(
-            [lambda i=i, d=d: commit(i, d) for i, d in enumerate(shuffled)]
-        )
-        try:
-            reduce_write_quorum(outcomes, write_quorum, bucket, obj)
-        except Exception:
-            parallel_map(
-                [lambda d=d: d.delete(sys_vol, tmp_rel, recursive=True) for d in shuffled]
+        # Commit under the namespace lock (the reference takes the dist
+        # lock just before metadata write + rename, cmd/erasure-object.go:736).
+        with self.nslock.lock(bucket, obj):
+            outcomes = parallel_map(
+                [lambda i=i, d=d: commit(i, d) for i, d in enumerate(shuffled)]
             )
-            raise
+            try:
+                reduce_write_quorum(outcomes, write_quorum, bucket, obj)
+            except Exception:
+                parallel_map(
+                    [lambda d=d: d.delete(sys_vol, tmp_rel, recursive=True) for d in shuffled]
+                )
+                raise
         # Partial success: quorum met but some drive missed the write — queue
         # it for background heal (reference addPartial, cmd/erasure-object.go:1150).
         if self.mrf is not None and any(isinstance(o, Exception) for o in outcomes):
@@ -466,25 +478,27 @@ class ErasureObjects(HealingMixin, MultipartMixin):
                 volume=bucket, name=obj, version_id=str(uuid.uuid4()),
                 deleted=True, mod_time=time.time(),
             )
-            results = parallel_map(
-                [lambda d=d: d.delete_version(bucket, obj, marker) for d in self.drives]
-            )
-            reduce_write_quorum(results, write_quorum, bucket, obj)
+            with self.nslock.lock(bucket, obj):
+                results = parallel_map(
+                    [lambda d=d: d.delete_version(bucket, obj, marker) for d in self.drives]
+                )
+                reduce_write_quorum(results, write_quorum, bucket, obj)
             return ObjectInfo(bucket=bucket, name=obj, version_id=marker.version_id,
                               delete_marker=True, mod_time=marker.mod_time)
 
-        fi = self._read_quorum_fileinfo(bucket, obj, opts.version_id)
-        target = FileInfo(volume=bucket, name=obj, version_id=opts.version_id,
-                          data_dir=fi.data_dir)
-        results = parallel_map(
-            [lambda d=d: d.delete_version(bucket, obj, target) for d in self.drives]
-        )
-        # A drive that never had the version is as good as deleted on it.
-        results = [
-            None if isinstance(r, (se.FileNotFound, se.FileVersionNotFound)) else r
-            for r in results
-        ]
-        reduce_write_quorum(results, write_quorum, bucket, obj)
+        with self.nslock.lock(bucket, obj):
+            fi = self._read_quorum_fileinfo(bucket, obj, opts.version_id)
+            target = FileInfo(volume=bucket, name=obj, version_id=opts.version_id,
+                              data_dir=fi.data_dir)
+            results = parallel_map(
+                [lambda d=d: d.delete_version(bucket, obj, target) for d in self.drives]
+            )
+            # A drive that never had the version is as good as deleted on it.
+            results = [
+                None if isinstance(r, (se.FileNotFound, se.FileVersionNotFound)) else r
+                for r in results
+            ]
+            reduce_write_quorum(results, write_quorum, bucket, obj)
         return ObjectInfo(bucket=bucket, name=obj, version_id=opts.version_id,
                           delete_marker=fi.deleted)
 

@@ -1,0 +1,225 @@
+"""dsync distributed-lock tests.
+
+Mirrors pkg/dsync/dsync_test.go:48 — N in-process lock servers, quorum
+acquisition, locker-failure tolerance, refresh keepalive, stale-lock
+reaping, and RW exclusion; plus the namespace-lock map both local and
+distributed.
+"""
+
+import threading
+import time
+
+import pytest
+
+from minio_tpu.dist import dsync
+from minio_tpu.dist.dsync import (
+    DRWMutex,
+    LocalLocker,
+    LockArgs,
+    RemoteLocker,
+    lock_routes,
+)
+from minio_tpu.dist.nslock import NamespaceLockMap
+from minio_tpu.dist.rpc import RestClient
+from minio_tpu.dist.server import NodeServer
+from minio_tpu.utils import errors as se
+
+SECRET = "dsync-secret"
+N_NODES = 5
+
+
+@pytest.fixture()
+def cluster():
+    """N lock servers + RemoteLocker clients for each."""
+    servers, clients, lockers = [], [], []
+    for _ in range(N_NODES):
+        locker = LocalLocker()
+        srv = NodeServer(secret=SECRET)
+        srv.register_plane("lock", lock_routes(locker))
+        srv.start()
+        client = RestClient(srv.host, srv.port, SECRET)
+        servers.append((srv, locker))
+        clients.append(client)
+        lockers.append(RemoteLocker(client))
+    yield servers, clients, lockers
+    for c in clients:
+        c.close()
+    for srv, _ in servers:
+        try:
+            srv.close()
+        except Exception:
+            pass
+
+
+def test_local_locker_rw_semantics():
+    lk = LocalLocker()
+    w = LockArgs("u1", ["res"], "me")
+    r1 = LockArgs("u2", ["res"], "me", readonly=True)
+    r2 = LockArgs("u3", ["res"], "me", readonly=True)
+
+    assert lk.lock(w)
+    assert not lk.rlock(r1)          # writer blocks readers
+    assert lk.unlock(w)
+    assert lk.rlock(r1)
+    assert lk.rlock(r2)              # readers coexist
+    assert not lk.lock(w)            # readers block writer
+    assert lk.runlock(r1)
+    assert lk.runlock(r2)
+    assert lk.lock(w)
+
+
+def test_local_locker_multi_resource_all_or_nothing():
+    lk = LocalLocker()
+    assert lk.lock(LockArgs("u1", ["a"], "me"))
+    # Second lock wants [a, b]: must fail entirely and leave b free.
+    assert not lk.lock(LockArgs("u2", ["a", "b"], "me"))
+    assert lk.lock(LockArgs("u3", ["b"], "me"))
+
+
+def test_stale_lock_reaped(monkeypatch):
+    lk = LocalLocker()
+    assert lk.lock(LockArgs("dead", ["res"], "crashed-node"))
+    # Unrefreshed beyond LOCK_STALE_AFTER -> reapable.
+    monkeypatch.setattr(dsync, "LOCK_STALE_AFTER", 0.05)
+    time.sleep(0.1)
+    assert lk.lock(LockArgs("live", ["res"], "me"))
+
+
+def test_quorum_acquisition(cluster):
+    _, _, lockers = cluster
+    mx = DRWMutex(["bucket/obj"], lockers)
+    assert mx.get_lock(timeout=2.0)
+    # A competing writer must fail while held.
+    mx2 = DRWMutex(["bucket/obj"], lockers)
+    assert not mx2.get_lock(timeout=0.5)
+    mx.unlock()
+    assert mx2.get_lock(timeout=2.0)
+    mx2.unlock()
+
+
+def test_read_locks_coexist_write_excluded(cluster):
+    _, _, lockers = cluster
+    r1 = DRWMutex(["res"], lockers)
+    r2 = DRWMutex(["res"], lockers)
+    w = DRWMutex(["res"], lockers)
+    assert r1.get_rlock(timeout=2.0)
+    assert r2.get_rlock(timeout=2.0)
+    assert not w.get_lock(timeout=0.5)
+    r1.unlock()
+    r2.unlock()
+    assert w.get_lock(timeout=2.0)
+    w.unlock()
+
+
+def test_tolerates_minority_locker_failure(cluster):
+    servers, clients, lockers = cluster
+    # Kill 2 of 5 lockers: write quorum is 3, still achievable.
+    for srv, _ in servers[:2]:
+        srv.close()
+    for c in clients[:2]:
+        c.close()
+        c.mark_offline()
+    mx = DRWMutex(["res"], lockers)
+    assert mx.get_lock(timeout=3.0)
+    mx.unlock()
+
+
+def test_fails_on_majority_locker_failure(cluster):
+    servers, clients, lockers = cluster
+    for srv, _ in servers[:3]:
+        srv.close()
+    for c in clients[:3]:
+        c.close()
+        c.mark_offline()
+    mx = DRWMutex(["res"], lockers)
+    assert not mx.get_lock(timeout=0.8)
+
+
+def test_refresh_keeps_lock_alive(cluster):
+    _, _, lockers = cluster
+    mx = DRWMutex(["res"], lockers, refresh_interval=0.05)
+    assert mx.get_lock(timeout=2.0)
+    time.sleep(0.3)  # several refresh cycles
+    assert mx.held
+    mx.unlock()
+
+
+def test_competing_writers_one_winner(cluster):
+    """Under contention exactly one writer holds at any moment."""
+    _, _, lockers = cluster
+    holders = []
+    overlap = []
+    active = threading.Semaphore(1)
+
+    def contender(i):
+        mx = DRWMutex([f"hot"], lockers)
+        if not mx.get_lock(timeout=10.0):
+            return
+        if not active.acquire(blocking=False):
+            overlap.append(i)
+        else:
+            holders.append(i)
+            time.sleep(0.02)
+            active.release()
+        mx.unlock()
+
+    threads = [threading.Thread(target=contender, args=(i,))
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not overlap
+    assert len(holders) == 6
+
+
+# --- namespace lock map ------------------------------------------------------
+
+def test_nslock_local_exclusion():
+    ns = NamespaceLockMap()
+    order = []
+
+    def worker(i):
+        with ns.lock("bkt", "obj"):
+            order.append(("in", i))
+            time.sleep(0.02)
+            order.append(("out", i))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # Strict nesting: every "in" is immediately followed by its "out".
+    for j in range(0, len(order), 2):
+        assert order[j][0] == "in" and order[j + 1][0] == "out"
+        assert order[j][1] == order[j + 1][1]
+    assert not ns._table  # gc'd when idle
+
+
+def test_nslock_local_timeout():
+    ns = NamespaceLockMap()
+    with ns.lock("bkt", "obj"):
+        with pytest.raises(se.OperationTimedOut):
+            with ns.lock("bkt", "obj", timeout=0.1):
+                pass
+
+
+def test_nslock_readers_coexist():
+    ns = NamespaceLockMap()
+    with ns.lock("bkt", "obj", readonly=True):
+        with ns.lock("bkt", "obj", readonly=True, timeout=0.5):
+            pass
+
+
+def test_nslock_distributed(cluster):
+    _, _, lockers = cluster
+    ns = NamespaceLockMap(distributed=True, lockers=lockers)
+    with ns.lock("bkt", "obj"):
+        ns2 = NamespaceLockMap(distributed=True, lockers=lockers)
+        with pytest.raises(se.OperationTimedOut):
+            with ns2.lock("bkt", "obj", timeout=0.3):
+                pass
+    # Released -> acquirable again.
+    with ns.lock("bkt", "obj", timeout=2.0):
+        pass
