@@ -1,0 +1,137 @@
+"""Peer control plane + bootstrap verification.
+
+Role-equivalent of cmd/peer-rest-{server,client}.go (the node-to-node admin
+fabric) and cmd/bootstrap-peer-server.go (pre-start topology handshake).
+The peer plane starts minimal — health, layout verification, cache
+invalidation hooks — and grows with the subsystems that need fan-out
+(IAM reload, bucket-metadata invalidation, trace subscription).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from minio_tpu.dist.rpc import RestClient, pack, unpack
+from minio_tpu.utils import errors as se
+
+PLANE = "peer"
+BOOTSTRAP_PLANE = "bootstrap"
+
+
+# --- server side -------------------------------------------------------------
+
+def bootstrap_routes(layout_sig: str, version: str = "1") -> dict:
+    """The handshake target: peers compare topology before serving
+    (cmd/bootstrap-peer-server.go:162)."""
+
+    def h_verify(params, body):
+        return pack({"sig": layout_sig, "version": version,
+                     "time": time.time()})
+
+    return {"verify": h_verify}
+
+
+class PeerHooks:
+    """Callbacks the peer plane invokes on this node. Subsystems register
+    theirs at init (NotificationSys role, cmd/notification.go:60)."""
+
+    def __init__(self):
+        self.on_bucket_metadata_invalidate: Callable[[str], None] = lambda b: None
+        self.on_iam_reload: Callable[[], None] = lambda: None
+        self.health: Callable[[], dict] = lambda: {"ok": True}
+
+
+def peer_routes(hooks: PeerHooks) -> dict:
+    def h_health(params, body):
+        return pack(hooks.health())
+
+    def h_invalidate_bucket_metadata(params, body):
+        hooks.on_bucket_metadata_invalidate(params.get("bucket", ""))
+
+    def h_reload_iam(params, body):
+        hooks.on_iam_reload()
+
+    return {"health": h_health,
+            "invalidate_bucket_metadata": h_invalidate_bucket_metadata,
+            "reload_iam": h_reload_iam}
+
+
+# --- client side -------------------------------------------------------------
+
+class PeerClient:
+    """One per peer node (cmd/peer-rest-client.go)."""
+
+    def __init__(self, client: RestClient):
+        self._client = client
+
+    def health(self) -> dict:
+        return self._client.call_msgpack(f"/rpc/{PLANE}/v1/health")
+
+    def invalidate_bucket_metadata(self, bucket: str) -> None:
+        self._client.call(f"/rpc/{PLANE}/v1/invalidate_bucket_metadata",
+                          {"bucket": bucket})
+
+    def reload_iam(self) -> None:
+        self._client.call(f"/rpc/{PLANE}/v1/reload_iam")
+
+    def verify_bootstrap(self) -> dict:
+        return self._client.call_msgpack(f"/rpc/{BOOTSTRAP_PLANE}/v1/verify")
+
+    def is_online(self) -> bool:
+        return self._client.is_online()
+
+
+def verify_cluster_bootstrap(peers: list[PeerClient], layout_sig: str,
+                             timeout: float = 60.0,
+                             interval: float = 0.25) -> None:
+    """Retry until every peer answers with the same topology signature
+    (the reference's retry loop, cmd/server-main.go:484-498). Raises
+    CorruptedFormat on a signature mismatch (misconfigured cluster) and
+    OperationTimedOut if peers never come up."""
+    deadline = time.monotonic() + timeout
+    pending = list(peers)
+    while pending:
+        still = []
+        for p in pending:
+            try:
+                doc = p.verify_bootstrap()
+            except Exception:
+                still.append(p)
+                continue
+            if doc.get("sig") != layout_sig:
+                raise se.CorruptedFormat(
+                    f"peer topology mismatch: {doc.get('sig')!r} != "
+                    f"{layout_sig!r} — all nodes must be started with the "
+                    f"same endpoint arguments")
+        pending = still
+        if pending:
+            if time.monotonic() > deadline:
+                raise se.OperationTimedOut(
+                    "", "", f"{len(pending)} peers unreachable during bootstrap")
+            time.sleep(interval)
+
+
+class NotificationSys:
+    """Fan-out wrapper over all peers (cmd/notification.go:60): best-effort
+    broadcast of control-plane events; a down peer reconciles from
+    persistent state when it returns."""
+
+    def __init__(self, peers: list[PeerClient]):
+        self.peers = peers
+
+    def _fanout(self, fn: Callable[[PeerClient], None]) -> list[Exception | None]:
+        out: list[Exception | None] = []
+        for p in self.peers:
+            try:
+                fn(p)
+                out.append(None)
+            except Exception as e:  # noqa: BLE001 - best-effort plane
+                out.append(e)
+        return out
+
+    def invalidate_bucket_metadata(self, bucket: str) -> None:
+        self._fanout(lambda p: p.invalidate_bucket_metadata(bucket))
+
+    def reload_iam(self) -> None:
+        self._fanout(lambda p: p.reload_iam())

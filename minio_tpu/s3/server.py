@@ -594,12 +594,30 @@ def _check_conditional(request, info) -> bool:
 def build_server(drive_paths: list[str], access_key: str, secret_key: str,
                  versioned: bool = False, parity: int | None = None,
                  set_drive_count: int | None = None,
-                 enable_mrf: bool = True) -> S3Server:
+                 enable_mrf: bool = True,
+                 server_addr: str = "") -> S3Server:
     """Assemble the full backend stack: drives → sets (sipHash routing) →
     pools (capacity placement) → S3 front door (reference newObjectLayer,
-    cmd/server-main.go:557)."""
+    cmd/server-main.go:557). URL endpoints (http://host/disk) boot the
+    distributed path: RPC fabric + bootstrap handshake + dsync locks
+    (reference serverMain distributed branch, cmd/server-main.go:484-500)."""
     from minio_tpu.erasure.pools import ErasureServerPools
     from minio_tpu.erasure.sets import ErasureSets
+
+    if any("://" in p for p in drive_paths):
+        from minio_tpu.dist.cluster import ClusterNode
+
+        host, _, port = server_addr.rpartition(":")
+        node = ClusterNode([drive_paths], host=host or "127.0.0.1",
+                           port=int(port or 9000), secret=secret_key,
+                           set_drive_count=set_drive_count or 0,
+                           parity=parity)
+        node.wait_for_peers()
+        layer = node.build_object_layer(enable_mrf=enable_mrf)
+        srv = S3Server(layer, sigv4.Credentials(access_key, secret_key),
+                       versioned_buckets=versioned)
+        srv.cluster_node = node
+        return srv
 
     drives = [LocalDrive(p) for p in drive_paths]
     sets = ErasureSets(drives, set_drive_count=set_drive_count, parity=parity,
@@ -623,7 +641,8 @@ def main(argv=None):
     secret = os.environ.get("MTPU_ROOT_PASSWORD", "minioadmin")
     srv = build_server(args.drives, access, secret,
                        versioned=args.versioned, parity=args.parity,
-                       set_drive_count=args.set_drives)
+                       set_drive_count=args.set_drives,
+                       server_addr=args.address)
     web.run_app(srv.app, host=host or "0.0.0.0", port=int(port))
 
 
