@@ -1,0 +1,2 @@
+"""Bucket-scoped subsystems: metadata (policy/versioning/lifecycle/...),
+quota, and the config documents S3 bucket subresources read and write."""

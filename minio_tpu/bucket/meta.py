@@ -1,0 +1,138 @@
+"""BucketMetadataSys — one document per bucket holding every bucket config.
+
+Role-equivalent of cmd/bucket-metadata-sys.go:41 + cmd/bucket-metadata.go:
+a single `.metadata.bin`-style msgpack doc per bucket (policy, versioning,
+lifecycle, tagging, SSE, object-lock, quota, notification), persisted in
+the quorum sys store, cached cluster-wide in memory, and invalidated across
+peers via the control plane.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+import msgpack
+
+from minio_tpu.utils import errors as se
+
+VERSIONING_ENABLED = "Enabled"
+VERSIONING_SUSPENDED = "Suspended"
+
+
+@dataclass
+class BucketMetadata:
+    """All config for one bucket (cmd/bucket-metadata.go:64-90). XML/JSON
+    payloads are stored verbatim — parsing happens at the consumer, so the
+    stored doc round-trips exactly what the client sent."""
+
+    name: str = ""
+    created: float = 0.0
+    versioning_status: str = ""         # "", Enabled, Suspended
+    policy_json: bytes = b""
+    lifecycle_xml: bytes = b""
+    tagging_xml: bytes = b""
+    sse_xml: bytes = b""
+    object_lock_xml: bytes = b""
+    quota_json: bytes = b""
+    notification_xml: bytes = b""
+    replication_xml: bytes = b""
+
+    def serialize(self) -> bytes:
+        return msgpack.packb({
+            "name": self.name, "created": self.created,
+            "ver": self.versioning_status,
+            "pol": self.policy_json, "ilm": self.lifecycle_xml,
+            "tag": self.tagging_xml, "sse": self.sse_xml,
+            "olk": self.object_lock_xml, "qta": self.quota_json,
+            "ntf": self.notification_xml, "rep": self.replication_xml,
+        })
+
+    @classmethod
+    def parse(cls, raw: bytes) -> "BucketMetadata":
+        d = msgpack.unpackb(raw, strict_map_key=False)
+        return cls(name=d.get("name", ""), created=d.get("created", 0.0),
+                   versioning_status=d.get("ver", ""),
+                   policy_json=d.get("pol", b""),
+                   lifecycle_xml=d.get("ilm", b""),
+                   tagging_xml=d.get("tag", b""),
+                   sse_xml=d.get("sse", b""),
+                   object_lock_xml=d.get("olk", b""),
+                   quota_json=d.get("qta", b""),
+                   notification_xml=d.get("ntf", b""),
+                   replication_xml=d.get("rep", b""))
+
+    @property
+    def versioning_enabled(self) -> bool:
+        return self.versioning_status == VERSIONING_ENABLED
+
+    @property
+    def versioning_configured(self) -> bool:
+        """Suspended still writes null-versions but keeps old versions."""
+        return self.versioning_status in (VERSIONING_ENABLED,
+                                          VERSIONING_SUSPENDED)
+
+
+class BucketMetadataSys:
+    """In-memory cache over the persisted per-bucket docs
+    (cmd/bucket-metadata-sys.go:41,424). `notify` broadcasts invalidation
+    to peers (wired to NotificationSys.invalidate_bucket_metadata)."""
+
+    def __init__(self, store, notify=None):
+        """store: object with read/write/delete_sys_config (the erasure
+        sys store)."""
+        self._store = store
+        self._notify = notify
+        self._cache: dict[str, BucketMetadata] = {}
+        self._mu = threading.Lock()
+
+    @staticmethod
+    def _path(bucket: str) -> str:
+        return f"buckets/{bucket}/metadata.mp"
+
+    def get(self, bucket: str) -> BucketMetadata:
+        """Cached metadata; a missing doc is an empty (default) config."""
+        with self._mu:
+            meta = self._cache.get(bucket)
+        if meta is not None:
+            return meta
+        try:
+            meta = BucketMetadata.parse(self._store.read_sys_config(
+                self._path(bucket)))
+        except se.FileNotFound:
+            meta = BucketMetadata(name=bucket, created=time.time())
+        with self._mu:
+            self._cache[bucket] = meta
+        return meta
+
+    def update(self, bucket: str, **changes) -> BucketMetadata:
+        """Read-modify-write one or more config fields, persist, recache,
+        and fan out invalidation."""
+        meta = self.get(bucket)
+        for k, v in changes.items():
+            if not hasattr(meta, k):
+                raise AttributeError(k)
+            setattr(meta, k, v)
+        self._store.write_sys_config(self._path(bucket), meta.serialize())
+        with self._mu:
+            self._cache[bucket] = meta
+        if self._notify is not None:
+            self._notify(bucket)
+        return meta
+
+    def drop_bucket(self, bucket: str) -> None:
+        """Called on DeleteBucket: remove the doc + cache entry."""
+        try:
+            self._store.delete_sys_config(self._path(bucket))
+        except se.FileNotFound:
+            pass
+        self.invalidate(bucket)
+        if self._notify is not None:
+            self._notify(bucket)
+
+    def invalidate(self, bucket: str) -> None:
+        """Peer-RPC target: drop the cache entry so the next get() reloads
+        from the store (PeerHooks.on_bucket_metadata_invalidate)."""
+        with self._mu:
+            self._cache.pop(bucket, None)

@@ -28,6 +28,7 @@ from typing import BinaryIO, Iterator
 
 from minio_tpu.erasure.codec import DEFAULT_BLOCK_SIZE, ErasureCodec
 from minio_tpu.erasure import listing
+from minio_tpu.erasure.sysstore import SysConfigStore
 from minio_tpu.erasure.healing import HealingMixin, MRFHealer
 from minio_tpu.erasure.multipart import MultipartMixin
 from minio_tpu.erasure.metadata import (
@@ -87,7 +88,7 @@ def default_parity(n_drives: int) -> int:
     return 4
 
 
-class ErasureObjects(HealingMixin, MultipartMixin):
+class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
     def __init__(
         self,
         drives: list[StorageAPI],

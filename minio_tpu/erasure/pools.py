@@ -271,6 +271,18 @@ class ErasureServerPools:
 
     # -- health --
 
+    def read_sys_config(self, path: str) -> bytes:
+        return self.pools[0].read_sys_config(path)
+
+    def write_sys_config(self, path: str, data: bytes) -> None:
+        self.pools[0].write_sys_config(path, data)
+
+    def delete_sys_config(self, path: str) -> None:
+        self.pools[0].delete_sys_config(path)
+
+    def list_sys_config(self, prefix: str = "") -> list[str]:
+        return self.pools[0].list_sys_config(prefix)
+
     def health(self) -> dict:
         pools = [p.health() for p in self.pools]
         return {"healthy": all(h["healthy"] for h in pools), "pools": pools}

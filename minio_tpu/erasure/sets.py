@@ -172,6 +172,21 @@ class ErasureSets:
 
     # -- listing: merged view across sets --
 
+    # Sys-config store lives on set 0 (small mirrored docs need no
+    # sharding; reference routes .minio.sys through the same hashing but
+    # pins config to deterministic names).
+    def read_sys_config(self, path: str) -> bytes:
+        return self.sets[0].read_sys_config(path)
+
+    def write_sys_config(self, path: str, data: bytes) -> None:
+        self.sets[0].write_sys_config(path, data)
+
+    def delete_sys_config(self, path: str) -> None:
+        self.sets[0].delete_sys_config(path)
+
+    def list_sys_config(self, prefix: str = "") -> list[str]:
+        return self.sets[0].list_sys_config(prefix)
+
     def merged_journals(self, bucket: str, prefix: str) -> dict[str, XLMeta]:
         results = parallel_map(
             [lambda s=s: s.merged_journals(bucket, prefix) for s in self.sets]

@@ -1,0 +1,92 @@
+"""Quorum-replicated system-config store.
+
+Role-equivalent of MinIO storing its own state as objects under the
+reserved `.minio.sys` bucket (SURVEY §5.4 — config, IAM, bucket metadata
+all live *inside* the system so node loss loses nothing). Small configs
+don't need erasure striping: each document is mirrored to every drive of
+the first set via write_all, and reads elect content by majority, so
+config survives the same drive losses the data path does.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from minio_tpu.erasure.metadata import parallel_map
+from minio_tpu.utils import errors as se
+from minio_tpu.utils.quorum import reduce_write_quorum
+
+SYS_VOL = ".mtpu.sys"
+CONFIG_PREFIX = "config"
+
+
+class SysConfigStore:
+    """Mirrored key→bytes store over one drive group (mixin host provides
+    `drives` and `_write_quorum_meta()`)."""
+
+    def read_sys_config(self, path: str) -> bytes:
+        """Majority-elected content (drives can hold stale generations
+        after missing a write)."""
+        rel = f"{CONFIG_PREFIX}/{path}"
+        results = parallel_map(
+            [lambda d=d: d.read_all(SYS_VOL, rel) for d in self.drives]
+        )
+        tally: dict[bytes, tuple[int, bytes]] = {}
+        for r in results:
+            if isinstance(r, (bytes, bytearray)):
+                h = hashlib.sha256(r).digest()
+                n, _ = tally.get(h, (0, b""))
+                tally[h] = (n + 1, bytes(r))
+        if not tally:
+            if all(isinstance(r, se.FileNotFound) for r in results):
+                raise se.FileNotFound(path)
+            raise se.InsufficientReadQuorum("", path, "no readable config copy")
+        (count, data) = max(tally.values(), key=lambda v: v[0])
+        return data
+
+    def write_sys_config(self, path: str, data: bytes) -> None:
+        rel = f"{CONFIG_PREFIX}/{path}"
+        results = parallel_map(
+            [lambda d=d: d.write_all(SYS_VOL, rel, data) for d in self.drives]
+        )
+        reduce_write_quorum(results, self._write_quorum_meta(), SYS_VOL, path)
+
+    def delete_sys_config(self, path: str) -> None:
+        rel = f"{CONFIG_PREFIX}/{path}"
+        results = parallel_map(
+            [lambda d=d: d.delete(SYS_VOL, rel) for d in self.drives]
+        )
+        results = [None if isinstance(r, se.FileNotFound) else r
+                   for r in results]
+        reduce_write_quorum(results, self._write_quorum_meta(), SYS_VOL, path)
+
+    def list_sys_config(self, prefix: str = "") -> list[str]:
+        """Merged, sorted keys under prefix (union across drives — a key
+        exists if any drive has it; stale deletes resolve on read)."""
+        rel = f"{CONFIG_PREFIX}/{prefix}".rstrip("/")
+        names: set[str] = set()
+        results = parallel_map(
+            [lambda d=d: _walk_names(d, rel) for d in self.drives]
+        )
+        for r in results:
+            if isinstance(r, set):
+                names |= r
+        strip = len(CONFIG_PREFIX) + 1
+        return sorted(n[strip:] for n in names)
+
+
+def _walk_names(drive, rel: str) -> set:
+    out = set()
+    try:
+        stack = [rel]
+        while stack:
+            d = stack.pop()
+            for name in drive.list_dir(SYS_VOL, d):
+                full = f"{d}/{name}" if d else name
+                if name.endswith("/"):
+                    stack.append(full.rstrip("/"))
+                else:
+                    out.add(full)
+    except (se.FileNotFound, se.VolumeNotFound):
+        pass
+    return out

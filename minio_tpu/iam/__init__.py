@@ -1,0 +1,7 @@
+"""IAM: identities (users/groups/service-accounts/STS), policy documents,
+and request authorization. Role-equivalent of cmd/iam.go + pkg/iam/policy."""
+
+from minio_tpu.iam.policy import Policy, PolicyArgs
+from minio_tpu.iam.sys import IAMSys, Identity
+
+__all__ = ["Policy", "PolicyArgs", "IAMSys", "Identity"]

@@ -1,0 +1,205 @@
+"""AWS-style policy documents: parse + evaluate.
+
+Role-equivalent of pkg/iam/policy (identity policies) and
+pkg/bucket/policy (resource policies) — one model serves both: bucket
+policies carry Principal, identity policies don't.
+
+Evaluation semantics (AWS): explicit Deny wins; else any matching Allow
+grants; else implicit deny. Actions and resources match with * and ?
+wildcards; a practical subset of condition operators is supported.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+from dataclasses import dataclass, field
+
+from minio_tpu.utils import errors as se
+
+# Canned policies (pkg/iam/policy/*-canned-policy definitions).
+CANNED_POLICIES: dict[str, str] = {
+    "readonly": json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [{"Effect": "Allow",
+                       "Action": ["s3:GetBucketLocation", "s3:GetObject"],
+                       "Resource": ["arn:aws:s3:::*"]}]}),
+    "writeonly": json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [{"Effect": "Allow",
+                       "Action": ["s3:PutObject"],
+                       "Resource": ["arn:aws:s3:::*"]}]}),
+    "readwrite": json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [{"Effect": "Allow", "Action": ["s3:*"],
+                       "Resource": ["arn:aws:s3:::*"]}]}),
+    "diagnostics": json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [{"Effect": "Allow",
+                       "Action": ["admin:ServerInfo", "admin:ServerTrace",
+                                  "admin:Profiling", "admin:Prometheus"],
+                       "Resource": ["arn:aws:s3:::*"]}]}),
+    "consoleAdmin": json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [{"Effect": "Allow", "Action": ["s3:*", "admin:*"],
+                       "Resource": ["arn:aws:s3:::*"]}]}),
+}
+
+
+@dataclass
+class PolicyArgs:
+    """One authorization question (pkg/iam/policy/args.go)."""
+
+    action: str                      # e.g. "s3:GetObject"
+    bucket: str = ""
+    object: str = ""
+    is_owner: bool = False
+    account: str = ""                # requesting access key
+    conditions: dict[str, list[str]] = field(default_factory=dict)
+
+    @property
+    def resource(self) -> str:
+        return f"{self.bucket}/{self.object}" if self.object else self.bucket
+
+
+def _as_list(v) -> list:
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+def _match(pattern: str, value: str) -> bool:
+    """AWS wildcard match: * and ? only — translate to fnmatch while
+    escaping fnmatch's [] character-class syntax."""
+    pattern = pattern.replace("[", "[[]")
+    return fnmatch.fnmatchcase(value, pattern)
+
+
+_CONDITION_OPS = {
+    "StringEquals": lambda want, have: any(h in want for h in have),
+    "StringNotEquals": lambda want, have: all(h not in want for h in have),
+    "StringLike": lambda want, have: any(
+        _match(w, h) for w in want for h in have),
+    "StringNotLike": lambda want, have: not any(
+        _match(w, h) for w in want for h in have),
+}
+
+
+@dataclass
+class Statement:
+    effect: str                          # Allow | Deny
+    actions: list[str]
+    not_actions: list[str]
+    resources: list[str]
+    conditions: dict[str, dict[str, list[str]]]
+    principals: list[str] | None         # None = identity policy (no field)
+
+    def matches_principal(self, account: str) -> bool:
+        if self.principals is None:
+            return True
+        return any(p == "*" or p == account for p in self.principals)
+
+    def matches_action(self, action: str) -> bool:
+        if self.not_actions:
+            return not any(_match(p, action) for p in self.not_actions)
+        return any(_match(p, action) for p in self.actions)
+
+    def matches_resource(self, resource: str) -> bool:
+        if not self.resources:
+            return True
+        for r in self.resources:
+            pat = r[len("arn:aws:s3:::"):] if r.startswith("arn:aws:s3:::") else r
+            if _match(pat, resource) or pat == "*":
+                return True
+            # A bucket-level pattern "bkt/*" must also cover bucket-level
+            # actions on "bkt" (ListBucket's resource is the bucket arn).
+            if pat.endswith("/*") and _match(pat[:-2], resource):
+                return True
+        return False
+
+    def matches_conditions(self, have: dict[str, list[str]]) -> bool:
+        for op, kv in self.conditions.items():
+            fn = _CONDITION_OPS.get(op)
+            if fn is None:
+                return False  # unknown operator -> statement can't apply
+            for key, want in kv.items():
+                if not fn(_as_list(want),
+                          have.get(key, have.get(key.lower(), []))):
+                    return False
+        return True
+
+    def applies(self, args: PolicyArgs) -> bool:
+        return (self.matches_principal(args.account)
+                and self.matches_action(args.action)
+                and self.matches_resource(args.resource)
+                and self.matches_conditions(args.conditions))
+
+
+class Policy:
+    def __init__(self, statements: list[Statement], version: str = ""):
+        self.statements = statements
+        self.version = version
+
+    @classmethod
+    def parse(cls, raw: bytes | str) -> "Policy":
+        try:
+            doc = json.loads(raw)
+        except (ValueError, TypeError) as e:
+            raise se.MalformedPolicy(str(e)) from e
+        stmts = []
+        for s in _as_list(doc.get("Statement")):
+            principals = None
+            if "Principal" in s:
+                p = s["Principal"]
+                if p == "*":
+                    principals = ["*"]
+                elif isinstance(p, dict):
+                    principals = [str(x) for x in _as_list(p.get("AWS"))]
+                else:
+                    principals = [str(p)]
+            effect = s.get("Effect", "")
+            if effect not in ("Allow", "Deny"):
+                raise se.MalformedPolicy(f"bad Effect {effect!r}")
+            stmts.append(Statement(
+                effect=effect,
+                actions=[str(a) for a in _as_list(s.get("Action"))],
+                not_actions=[str(a) for a in _as_list(s.get("NotAction"))],
+                resources=[str(r) for r in _as_list(s.get("Resource"))],
+                conditions=s.get("Condition", {}) or {},
+                principals=principals,
+            ))
+        return cls(stmts, version=doc.get("Version", ""))
+
+    def is_allowed(self, args: PolicyArgs) -> bool:
+        """Deny wins; any Allow grants; default deny
+        (pkg/iam/policy/policy.go IsAllowed)."""
+        allowed = False
+        for s in self.statements:
+            if not s.applies(args):
+                continue
+            if s.effect == "Deny":
+                return False
+            allowed = True
+        return allowed
+
+    def is_empty(self) -> bool:
+        return not self.statements
+
+    def validate(self) -> None:
+        for s in self.statements:
+            if not s.actions and not s.not_actions:
+                raise se.MalformedPolicy("statement without Action")
+
+
+def merge_is_allowed(policies: list[Policy], args: PolicyArgs) -> bool:
+    """Union of Allows, any Deny wins — evaluation over a set of attached
+    policies behaves like one concatenated document."""
+    allowed = False
+    for p in policies:
+        for s in p.statements:
+            if not s.applies(args):
+                continue
+            if s.effect == "Deny":
+                return False
+            allowed = True
+    return allowed

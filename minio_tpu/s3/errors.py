@@ -51,6 +51,17 @@ ERRORS = {
     "XAmzContentSHA256Mismatch": APIError("XAmzContentSHA256Mismatch", "The provided 'x-amz-content-sha256' header does not match what was computed.", 400),
     "ServiceUnavailable": APIError("ServiceUnavailable", "The service is unavailable. Please retry.", 503),
     "AuthorizationHeaderMalformed": APIError("AuthorizationHeaderMalformed", "The authorization header is malformed.", 400),
+    "NoSuchBucketPolicy": APIError("NoSuchBucketPolicy", "The bucket policy does not exist", 404),
+    "MalformedPolicy": APIError("MalformedPolicy", "Policy has invalid resource.", 400),
+    "NoSuchLifecycleConfiguration": APIError("NoSuchLifecycleConfiguration", "The lifecycle configuration does not exist", 404),
+    "ServerSideEncryptionConfigurationNotFoundError": APIError("ServerSideEncryptionConfigurationNotFoundError", "The server side encryption configuration was not found", 404),
+    "ObjectLockConfigurationNotFoundError": APIError("ObjectLockConfigurationNotFoundError", "Object Lock configuration does not exist for this bucket", 404),
+    "ReplicationConfigurationNotFoundError": APIError("ReplicationConfigurationNotFoundError", "The replication configuration was not found", 404),
+    "InvalidBucketState": APIError("InvalidBucketState", "The request is not valid with the current state of the bucket.", 409),
+    "ExpiredToken": APIError("ExpiredToken", "The provided token has expired.", 400),
+    "InvalidToken": APIError("InvalidToken", "The provided token is malformed or otherwise invalid.", 400),
+    "STSMissingParameter": APIError("MissingParameter", "A required parameter is missing.", 400),
+    "STSNotImplemented": APIError("NotImplemented", "The requested STS action is not implemented.", 501),
 }
 
 
@@ -83,6 +94,9 @@ _EXC_MAP: list[tuple[type, str]] = [
     (se.MethodNotAllowed, "MethodNotAllowed"),
     (se.FileNotFound, "NoSuchKey"),
     (se.StorageError, "InternalError"),
+    (se.MalformedPolicy, "MalformedPolicy"),
+    (se.InvalidAccessKey, "InvalidAccessKeyId"),
+    (se.IAMError, "InvalidRequest"),
 ]
 
 

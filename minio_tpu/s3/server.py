@@ -22,11 +22,38 @@ from typing import Iterator
 
 from aiohttp import web
 
+from minio_tpu.bucket.meta import BucketMetadataSys
 from minio_tpu.erasure import ErasureObjects
 from minio_tpu.erasure.types import CompletePart, ObjectOptions, ObjectToDelete
+from minio_tpu.iam.actions import action_for
+from minio_tpu.iam.policy import Policy, PolicyArgs
+from minio_tpu.iam.sys import ANONYMOUS, IAMSys
 from minio_tpu.s3 import sigv4, xmlutil
 from minio_tpu.s3.errors import S3Error, from_exception
 from minio_tpu.storage import LocalDrive
+from minio_tpu.utils import errors as se
+
+
+class _MemStore:
+    """In-memory sys-config store for backends without one (FS/tests)."""
+
+    def __init__(self):
+        self._docs: dict[str, bytes] = {}
+
+    def read_sys_config(self, path: str) -> bytes:
+        if path not in self._docs:
+            raise se.FileNotFound(path)
+        return self._docs[path]
+
+    def write_sys_config(self, path: str, data: bytes) -> None:
+        self._docs[path] = data
+
+    def delete_sys_config(self, path: str) -> None:
+        if self._docs.pop(path, None) is None:
+            raise se.FileNotFound(path)
+
+    def list_sys_config(self, prefix: str = "") -> list[str]:
+        return sorted(k for k in self._docs if k.startswith(prefix))
 
 XML_TYPE = "application/xml"
 MAX_OBJECT_SIZE = 5 * (1 << 40)
@@ -48,22 +75,66 @@ def _int_q(q: dict, name: str, default: int, lo: int = 0, hi: int = 100_000) -> 
 
 class S3Server:
     def __init__(self, object_layer, credentials: sigv4.Credentials,
-                 region: str = "us-east-1", versioned_buckets: bool = False):
+                 region: str = "us-east-1", versioned_buckets: bool = False,
+                 notification_sys=None):
         self.obj = object_layer
         self.creds = credentials
         self.region = region
-        # Per-bucket versioning config lives in bucket metadata once that
-        # subsystem lands; until then a server-level default.
+        # Server-level versioning default (tests/simple deployments);
+        # per-bucket config from BucketMetadataSys overrides.
         self.versioned_buckets = versioned_buckets
         self.app = web.Application(client_max_size=1 << 30)
         self.app.router.add_route("*", "/{tail:.*}", self._entry)
 
+        # Subsystems persist into the quorum sys store when the backend
+        # provides one (erasure); memory-only otherwise.
+        has_store = hasattr(object_layer, "read_sys_config")
+        store = object_layer if has_store else None
+        notify_bm = (notification_sys.invalidate_bucket_metadata
+                     if notification_sys is not None else None)
+        notify_iam = (notification_sys.reload_iam
+                      if notification_sys is not None else None)
+        self.bucket_meta = BucketMetadataSys(store, notify=notify_bm) \
+            if has_store else BucketMetadataSys(_MemStore())
+        self.iam = IAMSys(credentials.access_key, credentials.secret_key,
+                          store=store, notify=notify_iam)
+
     # ------------------------------------------------------------------
 
     def _lookup(self, access_key: str):
-        if access_key == self.creds.access_key:
-            return self.creds
-        return None
+        try:
+            return sigv4.Credentials(access_key,
+                                     self.iam.get_secret(access_key))
+        except se.InvalidAccessKey:
+            return None
+
+    def _bucket_versioned(self, bucket: str) -> bool:
+        if self.versioned_buckets:
+            return True
+        return self.bucket_meta.get(bucket).versioning_enabled
+
+    def _check_access(self, identity, action: str, bucket: str, key: str,
+                      conditions: dict | None = None) -> None:
+        """Authorize: identity policies ∪ bucket policy; explicit denies in
+        either win (cmd/auth-handler.go:274 checkRequestAuthType)."""
+        args = PolicyArgs(action=action, bucket=bucket, object=key,
+                          conditions=conditions or {})
+        pol_raw = (self.bucket_meta.get(bucket).policy_json
+                   if bucket else b"")
+        if pol_raw:
+            bp = Policy.parse(pol_raw)
+            bargs = PolicyArgs(action=action, bucket=bucket, object=key,
+                               conditions=conditions or {},
+                               account=identity.access_key or "*")
+            # Bucket-policy deny beats everything, including identity allow.
+            for st in bp.statements:
+                if st.effect == "Deny" and st.applies(bargs):
+                    raise S3Error("AccessDenied", resource=f"/{bucket}/{key}")
+            if bp.is_allowed(bargs):
+                return
+        if self.iam.is_allowed(identity, args):
+            return
+        raise S3Error("AccessDenied", resource=f"/{bucket}/{key}")
 
     async def _entry(self, request: web.Request) -> web.StreamResponse:
         request_id = uuid.uuid4().hex[:16].upper()
@@ -89,18 +160,31 @@ class S3Server:
         q = dict(query_items)
         # --- auth (reference cmd/auth-handler.go:102 classification) ---
         if "X-Amz-Signature" in q:
-            sigv4.verify_presigned(request.method, path, query_items,
-                                   request.headers, self._lookup)
+            creds = sigv4.verify_presigned(
+                request.method, path, query_items, request.headers,
+                self._lookup)
             # Honor a content binding if the signer pinned one in the
             # signed query (else anyone with the URL uploads arbitrary bytes).
             payload_hash = q.get("X-Amz-Content-Sha256", sigv4.UNSIGNED_PAYLOAD)
             auth_sig = None
+            identity = self.iam.identify(creds.access_key)
         elif request.headers.get("Authorization", "").startswith(sigv4.ALGORITHM):
             _, payload_hash = sigv4.verify_header_auth(
                 request.method, path, query_items, request.headers, self._lookup)
             auth_sig = sigv4.parse_auth_header(request.headers["Authorization"])
+            identity = self.iam.identify(auth_sig.access_key)
         else:
-            raise S3Error("AccessDenied", "anonymous access is not allowed")
+            # Anonymous: allowed only where the bucket policy grants it.
+            identity, payload_hash, auth_sig = (
+                ANONYMOUS, sigv4.UNSIGNED_PAYLOAD, None)
+
+        # Temp (STS) credentials must also present their session token
+        # (cmd/auth-handler.go getSessionToken check).
+        if identity.kind == "sts":
+            token = (request.headers.get("x-amz-security-token", "")
+                     or q.get("X-Amz-Security-Token", ""))
+            if not self.iam.verify_session_token(identity.access_key, token):
+                raise S3Error("InvalidToken")
 
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0]
@@ -116,8 +200,20 @@ class S3Server:
 
         # ---------- service level ----------
         if not bucket:
+            if m == "POST":  # STS API rides the root path (sts-handlers.go)
+                return await self._sts_handler(request, identity, hdr)
             if m == "GET":
+                if identity.kind == "anonymous":
+                    raise S3Error("AccessDenied", resource=path)
                 buckets = await run(self.obj.list_buckets)
+                if not identity.is_owner:
+                    allowed = []
+                    for b in buckets:
+                        ok_args = PolicyArgs(action="s3:ListBucket",
+                                             bucket=b.name)
+                        if self.iam.is_allowed(identity, ok_args):
+                            allowed.append(b)
+                    buckets = allowed
                 return web.Response(body=xmlutil.list_buckets_xml(buckets),
                                     content_type=XML_TYPE, headers=hdr)
             raise S3Error("MethodNotAllowed", resource=path)
@@ -126,16 +222,38 @@ class S3Server:
         # S3 subresources and must not affect routing.
         sub = {k for k in q if not k.startswith("X-Amz-")}
 
+        # --- authorization (identity policies ∪ bucket policy) ---
+        action = action_for(m, sub, bucket, key, request.headers)
+        self._check_access(identity, action, bucket, key)
+
+        # ---------- bucket config subresources ----------
+        if not key:
+            resp = await self._bucket_subresource(request, bucket, m, sub,
+                                                  q, hdr, run)
+            if resp is not None:
+                return resp
+
         # ---------- bucket level ----------
         if not key:
             if m == "PUT" and not sub:
                 await run(self.obj.make_bucket, bucket)
+                changes = {"created": __import__("time").time()}
+                if request.headers.get(
+                        "x-amz-bucket-object-lock-enabled", "").lower() == "true":
+                    # Object lock requires versioning (S3 semantics).
+                    changes["versioning_status"] = "Enabled"
+                    changes["object_lock_xml"] = (
+                        b'<ObjectLockConfiguration xmlns="http://s3.amazonaws'
+                        b'.com/doc/2006-03-01/"><ObjectLockEnabled>Enabled'
+                        b'</ObjectLockEnabled></ObjectLockConfiguration>')
+                await run(self.bucket_meta.update, bucket, **changes)
                 return web.Response(status=200, headers={**hdr, "Location": f"/{bucket}"})
             if m == "HEAD":
                 await run(self.obj.get_bucket_info, bucket)
                 return web.Response(status=200, headers=hdr)
-            if m == "DELETE":
+            if m == "DELETE" and not sub:
                 await run(self.obj.delete_bucket, bucket)
+                await run(self.bucket_meta.drop_bucket, bucket)
                 return web.Response(status=204, headers=hdr)
             if m == "POST" and "delete" in q:
                 return await self._delete_objects(request, bucket, hdr, run)
@@ -193,7 +311,7 @@ class S3Server:
         # ---------- object level ----------
         opts = ObjectOptions(
             version_id=q.get("versionId", ""),
-            versioned=self.versioned_buckets,
+            versioned=self._bucket_versioned(bucket),
         )
         if m in ("GET", "HEAD") and "tagging" in q:
             tags = await run(self.obj.get_object_tags, bucket, key, opts)
@@ -277,6 +395,153 @@ class S3Server:
                 extra["x-amz-version-id"] = info.version_id
             return web.Response(status=204, headers={**hdr, **extra})
         raise S3Error("MethodNotAllowed", resource=path)
+
+    # ------------------------------------------------------------------
+    # bucket config subresources (policy/versioning/lifecycle/... —
+    # reference per-feature files cmd/bucket-policy-handlers.go etc.)
+    # ------------------------------------------------------------------
+
+    async def _bucket_subresource(self, request, bucket, m, sub, q, hdr, run):
+        """Handle ?policy/?versioning/?lifecycle/?tagging/?encryption/
+        ?object-lock/?notification/?replication. Returns None if the
+        request isn't a config subresource."""
+        # Stored-verbatim XML configs: (query key, metadata field,
+        # GET-miss error code).
+        verbatim = {
+            "lifecycle": ("lifecycle_xml", "NoSuchLifecycleConfiguration"),
+            "tagging": ("tagging_xml", "NoSuchTagSet"),
+            "encryption": ("sse_xml",
+                           "ServerSideEncryptionConfigurationNotFoundError"),
+            "replication": ("replication_xml",
+                            "ReplicationConfigurationNotFoundError"),
+        }
+        config_subs = ({"policy", "versioning", "object-lock", "notification"}
+                       | set(verbatim))
+        if not (sub & config_subs):
+            return None
+
+        await run(self.obj.get_bucket_info, bucket)  # 404 before config
+
+        if "policy" in sub:
+            if m == "PUT":
+                body = await request.read()
+                pol = Policy.parse(body)
+                pol.validate()
+                if any(s.principals is None for s in pol.statements):
+                    raise S3Error("MalformedPolicy",
+                                  "bucket policy requires Principal")
+                await run(self.bucket_meta.update, bucket, policy_json=body)
+                return web.Response(status=204, headers=hdr)
+            if m == "GET":
+                raw = self.bucket_meta.get(bucket).policy_json
+                if not raw:
+                    raise S3Error("NoSuchBucketPolicy", resource=f"/{bucket}")
+                return web.Response(body=raw, content_type="application/json",
+                                    headers=hdr)
+            if m == "DELETE":
+                await run(self.bucket_meta.update, bucket, policy_json=b"")
+                return web.Response(status=204, headers=hdr)
+
+        if "versioning" in sub:
+            if m == "PUT":
+                body = await request.read()
+                try:
+                    status = xmlutil.parse_versioning_xml(body)
+                except ValueError:
+                    raise S3Error("MalformedXML") from None
+                meta = self.bucket_meta.get(bucket)
+                if meta.object_lock_xml and status == "Suspended":
+                    raise S3Error("InvalidBucketState",
+                                  "object lock requires versioning")
+                await run(self.bucket_meta.update, bucket,
+                          versioning_status=status)
+                return web.Response(status=200, headers=hdr)
+            if m == "GET":
+                status = self.bucket_meta.get(bucket).versioning_status
+                if self.versioned_buckets and not status:
+                    status = "Enabled"
+                return web.Response(body=xmlutil.versioning_xml(status),
+                                    content_type=XML_TYPE, headers=hdr)
+
+        if "object-lock" in sub:
+            if m == "PUT":
+                body = await request.read()
+                meta = self.bucket_meta.get(bucket)
+                if not meta.versioning_enabled:
+                    raise S3Error("InvalidBucketState",
+                                  "object lock requires versioning")
+                await run(self.bucket_meta.update, bucket,
+                          object_lock_xml=body)
+                return web.Response(status=200, headers=hdr)
+            if m == "GET":
+                raw = self.bucket_meta.get(bucket).object_lock_xml
+                if not raw:
+                    raise S3Error("ObjectLockConfigurationNotFoundError",
+                                  resource=f"/{bucket}")
+                return web.Response(body=raw, content_type=XML_TYPE,
+                                    headers=hdr)
+
+        if "notification" in sub:
+            if m == "PUT":
+                body = await request.read()
+                await run(self.bucket_meta.update, bucket,
+                          notification_xml=body)
+                return web.Response(status=200, headers=hdr)
+            if m == "GET":
+                raw = self.bucket_meta.get(bucket).notification_xml
+                if not raw:
+                    raw = (b'<?xml version="1.0" encoding="UTF-8"?>'
+                           b'<NotificationConfiguration xmlns="http://s3.'
+                           b'amazonaws.com/doc/2006-03-01/">'
+                           b'</NotificationConfiguration>')
+                return web.Response(body=raw, content_type=XML_TYPE,
+                                    headers=hdr)
+
+        for name, (attr, miss_code) in verbatim.items():
+            if name not in sub:
+                continue
+            if m == "PUT":
+                body = await request.read()
+                _validate_xml(body)
+                await run(self.bucket_meta.update, bucket, **{attr: body})
+                return web.Response(status=200, headers=hdr)
+            if m == "GET":
+                raw = getattr(self.bucket_meta.get(bucket), attr)
+                if not raw:
+                    raise S3Error(miss_code, resource=f"/{bucket}")
+                return web.Response(body=raw, content_type=XML_TYPE,
+                                    headers=hdr)
+            if m == "DELETE":
+                await run(self.bucket_meta.update, bucket, **{attr: b""})
+                return web.Response(status=204, headers=hdr)
+
+        return None
+
+    # ------------------------------------------------------------------
+    # STS (reference cmd/sts-handlers.go — AssumeRole on the root path)
+    # ------------------------------------------------------------------
+
+    async def _sts_handler(self, request, identity, hdr):
+        form = urllib.parse.parse_qs((await request.read()).decode())
+        action = form.get("Action", [""])[0]
+        if action != "AssumeRole":
+            raise S3Error("STSNotImplemented")
+        if identity.kind == "anonymous":
+            raise S3Error("AccessDenied", "STS requires signed credentials")
+        if identity.kind in ("sts", "svc"):
+            raise S3Error("AccessDenied",
+                          "temporary credentials cannot assume roles")
+        duration = int(form.get("DurationSeconds", ["3600"])[0])
+        session_policy = form.get("Policy", [""])[0]
+        tc = self.iam.assume_role(identity.access_key, duration,
+                                  session_policy)
+        import datetime
+        exp = datetime.datetime.fromtimestamp(
+            tc.expiry, datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+        body = xmlutil.sts_assume_role_xml(
+            tc.access_key, tc.secret_key, tc.session_token, exp,
+            hdr["x-amz-request-id"])
+        return web.Response(body=body, content_type=XML_TYPE, headers=hdr)
 
     # ------------------------------------------------------------------
 
@@ -494,6 +759,15 @@ class _IterReader:
         out = bytes(self._buf[:n])
         del self._buf[:n]
         return out
+
+
+def _validate_xml(body: bytes) -> None:
+    import xml.etree.ElementTree as _ET
+
+    try:
+        _ET.fromstring(body)
+    except _ET.ParseError:
+        raise S3Error("MalformedXML") from None
 
 
 def _metadata_headers(request) -> dict:

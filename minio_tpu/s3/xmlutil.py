@@ -296,3 +296,37 @@ def list_uploads_xml(bucket, uploads, truncated=False) -> bytes:
         _el(e, "UploadId", u.upload_id)
         _el(e, "Initiated", _iso(u.initiated))
     return render(root)
+
+
+def versioning_xml(status: str) -> bytes:
+    root = _doc("VersioningConfiguration")
+    if status:
+        _el(root, "Status", status)
+    return render(root)
+
+
+def parse_versioning_xml(body: bytes) -> str:
+    try:
+        root = ET.fromstring(body)
+    except ET.ParseError:
+        raise ValueError("malformed XML") from None
+    status = root.findtext("{*}Status") or root.findtext("Status") or ""
+    if status not in ("Enabled", "Suspended"):
+        raise ValueError(f"bad versioning status {status!r}")
+    return status
+
+
+def sts_assume_role_xml(access_key: str, secret_key: str,
+                        session_token: str, expiry_iso: str,
+                        request_id: str) -> bytes:
+    ns = "https://sts.amazonaws.com/doc/2011-06-15/"
+    root = ET.Element(f"AssumeRoleResponse", xmlns=ns)
+    result = _el(root, "AssumeRoleResult")
+    creds = _el(result, "Credentials")
+    _el(creds, "AccessKeyId", access_key)
+    _el(creds, "SecretAccessKey", secret_key)
+    _el(creds, "SessionToken", session_token)
+    _el(creds, "Expiration", expiry_iso)
+    meta = _el(root, "ResponseMetadata")
+    _el(meta, "RequestId", request_id)
+    return render(root)

@@ -161,6 +161,41 @@ class OperationTimedOut(ObjectError):
     pass
 
 
+# --- IAM / policy errors (reference cmd/iam-errors.go, pkg/iam/policy) ---
+
+
+class IAMError(Exception):
+    pass
+
+
+class MalformedPolicy(IAMError):
+    pass
+
+
+class NoSuchPolicy(IAMError):
+    pass
+
+
+class NoSuchUser(IAMError):
+    pass
+
+
+class NoSuchGroup(IAMError):
+    pass
+
+
+class NoSuchServiceAccount(IAMError):
+    pass
+
+
+class InvalidAccessKey(IAMError):
+    pass
+
+
+class IAMActionNotAllowed(IAMError):
+    pass
+
+
 # --- wire transport helpers (dist/rpc.py) -----------------------------------
 #
 # Storage RPC carries errors by class name; the client re-raises the same

@@ -1,0 +1,218 @@
+"""Policy-document evaluation unit tests (pkg/iam/policy semantics)."""
+
+import json
+
+import pytest
+
+from minio_tpu.iam.policy import CANNED_POLICIES, Policy, PolicyArgs, merge_is_allowed
+from minio_tpu.iam.sys import IAMSys
+from minio_tpu.utils import errors as se
+
+
+def P(**kw):
+    return PolicyArgs(**kw)
+
+
+def mk(statements):
+    return Policy.parse(json.dumps(
+        {"Version": "2012-10-17", "Statement": statements}))
+
+
+def test_allow_matching_action_and_resource():
+    p = mk([{"Effect": "Allow", "Action": "s3:GetObject",
+             "Resource": "arn:aws:s3:::mybucket/*"}])
+    assert p.is_allowed(P(action="s3:GetObject", bucket="mybucket", object="x"))
+    assert not p.is_allowed(P(action="s3:PutObject", bucket="mybucket", object="x"))
+    assert not p.is_allowed(P(action="s3:GetObject", bucket="other", object="x"))
+
+
+def test_action_wildcards():
+    p = mk([{"Effect": "Allow", "Action": ["s3:Get*", "s3:List*"],
+             "Resource": "arn:aws:s3:::*"}])
+    assert p.is_allowed(P(action="s3:GetObject", bucket="b", object="o"))
+    assert p.is_allowed(P(action="s3:ListBucket", bucket="b"))
+    assert not p.is_allowed(P(action="s3:PutObject", bucket="b", object="o"))
+
+
+def test_deny_wins():
+    p = mk([
+        {"Effect": "Allow", "Action": "s3:*", "Resource": "arn:aws:s3:::*"},
+        {"Effect": "Deny", "Action": "s3:DeleteObject",
+         "Resource": "arn:aws:s3:::b/*"},
+    ])
+    assert p.is_allowed(P(action="s3:GetObject", bucket="b", object="o"))
+    assert not p.is_allowed(P(action="s3:DeleteObject", bucket="b", object="o"))
+
+
+def test_resource_prefix_wildcard():
+    p = mk([{"Effect": "Allow", "Action": "s3:GetObject",
+             "Resource": "arn:aws:s3:::logs/2026/*"}])
+    assert p.is_allowed(P(action="s3:GetObject", bucket="logs",
+                          object="2026/jan.log"))
+    assert not p.is_allowed(P(action="s3:GetObject", bucket="logs",
+                              object="2025/dec.log"))
+
+
+def test_bucket_level_action_covered_by_object_pattern():
+    # "bkt/*" must also authorize ListBucket on "bkt" (common policy shape).
+    p = mk([{"Effect": "Allow", "Action": ["s3:ListBucket", "s3:GetObject"],
+             "Resource": "arn:aws:s3:::bkt/*"}])
+    assert p.is_allowed(P(action="s3:ListBucket", bucket="bkt"))
+
+
+def test_principal_matching():
+    p = mk([{"Effect": "Allow", "Principal": "*", "Action": "s3:GetObject",
+             "Resource": "arn:aws:s3:::pub/*"}])
+    assert p.is_allowed(P(action="s3:GetObject", bucket="pub", object="o",
+                          account="*"))
+    p2 = mk([{"Effect": "Allow", "Principal": {"AWS": ["alice"]},
+              "Action": "s3:GetObject", "Resource": "arn:aws:s3:::b/*"}])
+    assert p2.is_allowed(P(action="s3:GetObject", bucket="b", object="o",
+                           account="alice"))
+    assert not p2.is_allowed(P(action="s3:GetObject", bucket="b", object="o",
+                               account="bob"))
+
+
+def test_conditions_string_equals_and_like():
+    p = mk([{"Effect": "Allow", "Action": "s3:ListBucket",
+             "Resource": "arn:aws:s3:::b",
+             "Condition": {"StringLike": {"s3:prefix": ["photos/*"]}}}])
+    assert p.is_allowed(P(action="s3:ListBucket", bucket="b",
+                          conditions={"s3:prefix": ["photos/2026"]}))
+    assert not p.is_allowed(P(action="s3:ListBucket", bucket="b",
+                              conditions={"s3:prefix": ["docs/"]}))
+
+
+def test_malformed_policy_raises():
+    with pytest.raises(se.MalformedPolicy):
+        Policy.parse(b"not json")
+    with pytest.raises(se.MalformedPolicy):
+        mk([{"Effect": "Maybe", "Action": "s3:*", "Resource": "*"}])
+
+
+def test_canned_policies_parse_and_behave():
+    ro = Policy.parse(CANNED_POLICIES["readonly"])
+    assert ro.is_allowed(P(action="s3:GetObject", bucket="b", object="o"))
+    assert not ro.is_allowed(P(action="s3:PutObject", bucket="b", object="o"))
+    rw = Policy.parse(CANNED_POLICIES["readwrite"])
+    assert rw.is_allowed(P(action="s3:PutObject", bucket="b", object="o"))
+    wo = Policy.parse(CANNED_POLICIES["writeonly"])
+    assert wo.is_allowed(P(action="s3:PutObject", bucket="b", object="o"))
+    assert not wo.is_allowed(P(action="s3:GetObject", bucket="b", object="o"))
+
+
+def test_merge_deny_across_policies():
+    allow = mk([{"Effect": "Allow", "Action": "s3:*",
+                 "Resource": "arn:aws:s3:::*"}])
+    deny = mk([{"Effect": "Deny", "Action": "s3:DeleteObject",
+                "Resource": "arn:aws:s3:::*"}])
+    assert merge_is_allowed([allow, deny],
+                            P(action="s3:GetObject", bucket="b", object="o"))
+    assert not merge_is_allowed(
+        [allow, deny], P(action="s3:DeleteObject", bucket="b", object="o"))
+
+
+# --- IAMSys ------------------------------------------------------------------
+
+
+def test_iam_users_and_policies():
+    iam = IAMSys("root", "rootsecret")
+    iam.set_user("alice", "alicesecret")
+    iam.attach_policy("alice", ["readonly"])
+
+    assert iam.get_secret("alice") == "alicesecret"
+    with pytest.raises(se.InvalidAccessKey):
+        iam.get_secret("nobody")
+
+    ident = iam.identify("alice")
+    assert ident.kind == "user"
+    assert iam.is_allowed(ident, P(action="s3:GetObject", bucket="b", object="o"))
+    assert not iam.is_allowed(ident, P(action="s3:PutObject", bucket="b", object="o"))
+
+    # Root bypasses policy.
+    root = iam.identify("root")
+    assert iam.is_allowed(root, P(action="s3:DeleteBucket", bucket="b"))
+
+    # Disabled user can't authenticate.
+    iam.set_user_status("alice", "off")
+    with pytest.raises(se.InvalidAccessKey):
+        iam.get_secret("alice")
+
+
+def test_iam_groups():
+    iam = IAMSys("root", "rs")
+    iam.set_user("bob", "bs")
+    iam.add_group_members("devs", ["bob"])
+    iam.attach_policy("devs", ["readwrite"], group=True)
+    ident = iam.identify("bob")
+    assert iam.is_allowed(ident, P(action="s3:PutObject", bucket="b", object="o"))
+
+
+def test_iam_sts_lifecycle():
+    iam = IAMSys("root", "rs")
+    iam.set_user("carol", "cs")
+    iam.attach_policy("carol", ["readwrite"])
+    tc = iam.assume_role("carol", duration=3600)
+    ident = iam.identify(tc.access_key)
+    assert ident.kind == "sts" and ident.parent == "carol"
+    # Inherits parent's allows.
+    assert iam.is_allowed(ident, P(action="s3:PutObject", bucket="b", object="o"))
+    assert iam.verify_session_token(tc.access_key, tc.session_token)
+    assert not iam.verify_session_token(tc.access_key, "wrong")
+
+
+def test_iam_sts_session_policy_restricts():
+    iam = IAMSys("root", "rs")
+    iam.set_user("dave", "ds")
+    iam.attach_policy("dave", ["readwrite"])
+    session = json.dumps({"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Action": "s3:GetObject",
+         "Resource": "arn:aws:s3:::only/*"}]})
+    tc = iam.assume_role("dave", session_policy_json=session)
+    ident = iam.identify(tc.access_key)
+    assert iam.is_allowed(ident, P(action="s3:GetObject", bucket="only", object="o"))
+    # Parent allows puts, session policy doesn't -> denied.
+    assert not iam.is_allowed(ident, P(action="s3:PutObject", bucket="only", object="o"))
+
+
+def test_iam_service_account():
+    iam = IAMSys("root", "rs")
+    tc = iam.add_service_account("root")
+    ident = iam.identify(tc.access_key)
+    assert ident.kind == "svc"
+    # Root-parented service account inherits root's omnipotence.
+    assert iam.is_allowed(ident, P(action="s3:PutObject", bucket="b", object="o"))
+    iam.delete_service_account(tc.access_key)
+    with pytest.raises(se.InvalidAccessKey):
+        iam.identify(tc.access_key)
+
+
+def test_iam_persistence_roundtrip(tmp_path):
+    from minio_tpu.erasure.objects import ErasureObjects
+    from minio_tpu.storage.local import LocalDrive
+
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    store = ErasureObjects(drives, parity=1)
+
+    iam = IAMSys("root", "rs", store=store)
+    iam.set_user("erin", "es")
+    iam.attach_policy("erin", ["readonly"])
+    iam.set_policy("custom", json.dumps({"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Action": "s3:ListBucket",
+         "Resource": "arn:aws:s3:::*"}]}))
+    tc = iam.add_service_account("erin")
+
+    # Fresh IAMSys over the same store sees everything.
+    iam2 = IAMSys("root", "rs", store=store)
+    assert "erin" in iam2.users
+    assert iam2.users["erin"].policies == ["readonly"]
+    assert "custom" in iam2.policies
+    assert iam2.identify(tc.access_key).kind == "svc"
+
+    # Deletions persist too.
+    iam.delete_user("erin")
+    iam2.reload()
+    assert "erin" not in iam2.users
+    # Cascade removed erin's service account.
+    with pytest.raises(se.InvalidAccessKey):
+        iam2.identify(tc.access_key)

@@ -1,0 +1,275 @@
+"""HTTP tests for bucket config subresources, per-bucket versioning,
+bucket policies (incl. anonymous access), IAM-scoped requests, and STS.
+
+Mirrors the reference's handler-level tiers (cmd/bucket-handlers_test.go,
+cmd/sts-handlers tests) against an in-process server.
+"""
+
+import json
+import socket
+import threading
+import xml.etree.ElementTree as ET
+
+import pytest
+import requests
+from aiohttp import web
+
+from tests.s3client import SigV4Client
+
+ACCESS = "minioadmin"
+SECRET = "minioadmin-secret"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    import asyncio
+
+    from minio_tpu.s3.server import build_server
+
+    root = tmp_path_factory.mktemp("drives")
+    srv = build_server([str(root / f"d{i}") for i in range(4)], ACCESS, SECRET)
+    port = _free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(srv.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(30)
+    yield f"http://127.0.0.1:{port}", srv
+    loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return SigV4Client(server[0], ACCESS, SECRET)
+
+
+@pytest.fixture(scope="module")
+def bucket(client):
+    assert client.put("/cfg").status_code == 200
+    return "cfg"
+
+
+# ---------------- versioning ----------------
+
+def test_versioning_config_roundtrip(client, bucket):
+    r = client.get("/cfg", query={"versioning": ""})
+    assert r.status_code == 200
+    assert "Status" not in r.text  # unconfigured
+
+    body = (b'<VersioningConfiguration><Status>Enabled</Status>'
+            b'</VersioningConfiguration>')
+    assert client.put("/cfg", data=body,
+                      query={"versioning": ""}).status_code == 200
+    r = client.get("/cfg", query={"versioning": ""})
+    assert "<Status>Enabled</Status>" in r.text
+
+    bad = b"<VersioningConfiguration><Status>Bogus</Status></VersioningConfiguration>"
+    r = client.put("/cfg", data=bad, query={"versioning": ""})
+    assert r.status_code == 400
+
+
+def test_versioned_put_creates_versions(client, bucket):
+    # Bucket versioning was enabled above: puts mint version ids.
+    r = client.put("/cfg/vobj", data=b"v1")
+    assert r.status_code == 200
+    r = client.put("/cfg/vobj", data=b"v2")
+    assert r.status_code == 200
+
+    r = client.get("/cfg", query={"versions": ""})
+    assert r.status_code == 200
+    root = ET.fromstring(r.content)
+    versions = [e for e in root.iter() if e.tag.endswith("Version")]
+    names = [v.findtext("{*}Key") for v in versions]
+    assert names.count("vobj") == 2
+
+    # Delete without version -> delete marker; object 404s but versions remain.
+    r = client.delete("/cfg/vobj")
+    assert r.status_code == 204
+    assert r.headers.get("x-amz-delete-marker") == "true"
+    assert client.get("/cfg/vobj").status_code == 404
+    r = client.get("/cfg", query={"versions": ""})
+    markers = [e for e in ET.fromstring(r.content).iter()
+               if e.tag.endswith("DeleteMarker")]
+    assert len(markers) == 1
+
+    # Reading a specific surviving version works.
+    vids = [v.findtext("{*}VersionId") for v in
+            ET.fromstring(r.content).iter() if v.tag.endswith("Version")
+            and v.findtext("{*}Key") == "vobj"]
+    r = client.get("/cfg/vobj", query={"versionId": vids[-1]})
+    assert r.status_code == 200
+
+
+# ---------------- policy + anonymous ----------------
+
+def test_bucket_policy_crud_and_anonymous(server, client, bucket):
+    base, _ = server
+    # No policy yet.
+    assert client.get("/cfg", query={"policy": ""}).status_code == 404
+    # Anonymous denied before policy.
+    assert requests.get(f"{base}/cfg/pub.txt").status_code == 403
+
+    client.put("/cfg/pub.txt", data=b"public data")
+    pol = json.dumps({"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Principal": "*", "Action": "s3:GetObject",
+         "Resource": "arn:aws:s3:::cfg/pub*"}]})
+    r = client.put("/cfg", data=pol.encode(), query={"policy": ""})
+    assert r.status_code == 204, r.text
+
+    r = client.get("/cfg", query={"policy": ""})
+    assert r.status_code == 200 and json.loads(r.text)["Statement"]
+
+    # Anonymous GET now allowed for the granted prefix only.
+    r = requests.get(f"{base}/cfg/pub.txt")
+    assert r.status_code == 200 and r.content == b"public data"
+    client.put("/cfg/priv.txt", data=b"secret")
+    assert requests.get(f"{base}/cfg/priv.txt").status_code == 403
+    # Anonymous writes not granted.
+    assert requests.put(f"{base}/cfg/pub2.txt", data=b"x").status_code == 403
+
+    # Malformed policy rejected.
+    r = client.put("/cfg", data=b"{bad json", query={"policy": ""})
+    assert r.status_code == 400
+    # Identity policy (no Principal) rejected as bucket policy.
+    r = client.put("/cfg", data=json.dumps(
+        {"Version": "2012-10-17", "Statement": [
+            {"Effect": "Allow", "Action": "s3:GetObject",
+             "Resource": "arn:aws:s3:::cfg/*"}]}).encode(),
+        query={"policy": ""})
+    assert r.status_code == 400
+
+    assert client.delete("/cfg", query={"policy": ""}).status_code == 204
+    assert requests.get(f"{base}/cfg/pub.txt").status_code == 403
+
+
+# ---------------- verbatim configs ----------------
+
+@pytest.mark.parametrize("sub,payload,miss", [
+    ("lifecycle",
+     b'<LifecycleConfiguration><Rule><ID>r1</ID><Status>Enabled</Status>'
+     b'<Expiration><Days>30</Days></Expiration></Rule></LifecycleConfiguration>',
+     404),
+    ("tagging",
+     b'<Tagging><TagSet><Tag><Key>team</Key><Value>infra</Value></Tag>'
+     b'</TagSet></Tagging>', 404),
+    ("encryption",
+     b'<ServerSideEncryptionConfiguration><Rule>'
+     b'<ApplyServerSideEncryptionByDefault><SSEAlgorithm>AES256</SSEAlgorithm>'
+     b'</ApplyServerSideEncryptionByDefault></Rule>'
+     b'</ServerSideEncryptionConfiguration>', 404),
+    ("replication",
+     b'<ReplicationConfiguration><Rule><Status>Enabled</Status></Rule>'
+     b'</ReplicationConfiguration>', 404),
+])
+def test_verbatim_config_roundtrip(client, bucket, sub, payload, miss):
+    q = {sub: ""}
+    assert client.get("/cfg", query=q).status_code == miss
+    assert client.put("/cfg", data=payload, query=q).status_code == 200
+    r = client.get("/cfg", query=q)
+    assert r.status_code == 200 and r.content == payload
+    assert client.put("/cfg", data=b"<unclosed", query=q).status_code == 400
+    assert client.delete("/cfg", query=q).status_code == 204
+    assert client.get("/cfg", query=q).status_code == miss
+
+
+def test_object_lock_requires_versioning(client):
+    assert client.put("/lockless").status_code == 200
+    r = client.put("/lockless", data=b"<ObjectLockConfiguration/>",
+                   query={"object-lock": ""})
+    assert r.status_code == 409  # versioning not enabled
+    assert client.get("/lockless",
+                      query={"object-lock": ""}).status_code == 404
+
+
+def test_object_lock_enabled_at_creation(client):
+    r = client.put("/locked", headers={
+        "x-amz-bucket-object-lock-enabled": "true"})
+    assert r.status_code == 200
+    r = client.get("/locked", query={"object-lock": ""})
+    assert r.status_code == 200 and b"Enabled" in r.content
+    r = client.get("/locked", query={"versioning": ""})
+    assert "<Status>Enabled</Status>" in r.text
+    # Suspending versioning is rejected while object lock is on.
+    r = client.put("/locked", data=(
+        b"<VersioningConfiguration><Status>Suspended</Status>"
+        b"</VersioningConfiguration>"), query={"versioning": ""})
+    assert r.status_code == 409
+
+
+def test_notification_default_empty(client, bucket):
+    r = client.get("/cfg", query={"notification": ""})
+    assert r.status_code == 200
+    assert b"NotificationConfiguration" in r.content
+
+
+# ---------------- IAM over HTTP ----------------
+
+def test_iam_user_request_scoping(server, bucket):
+    base, srv = server
+    srv.iam.set_user("alice", "alice-secret-key")
+    srv.iam.attach_policy("alice", ["readonly"])
+    alice = SigV4Client(base, "alice", "alice-secret-key")
+
+    # Owner seeds an object.
+    SigV4Client(base, ACCESS, SECRET).put("/cfg/iam.txt", data=b"data")
+
+    r = alice.get("/cfg/iam.txt")
+    assert r.status_code == 200 and r.content == b"data"
+    assert alice.put("/cfg/denied.txt", data=b"x").status_code == 403
+    assert alice.delete("/cfg/iam.txt").status_code == 403
+    # Bucket creation denied too.
+    assert alice.put("/alicebucket").status_code == 403
+
+
+def test_sts_assume_role_over_http(server):
+    base, srv = server
+    srv.iam.set_user("bob", "bob-secret-key12")
+    srv.iam.attach_policy("bob", ["readwrite"])
+    bob = SigV4Client(base, "bob", "bob-secret-key12")
+
+    r = bob.post("/", data="Action=AssumeRole&Version=2011-06-15".encode(),
+                 headers={"content-type": "application/x-www-form-urlencoded"})
+    assert r.status_code == 200, r.text
+    root = ET.fromstring(r.content)
+    creds = {e.tag.split("}")[-1]: e.text for e in root.iter()
+             if e.tag.split("}")[-1] in
+             ("AccessKeyId", "SecretAccessKey", "SessionToken")}
+    assert set(creds) == {"AccessKeyId", "SecretAccessKey", "SessionToken"}
+
+    tmp = SigV4Client(base, creds["AccessKeyId"], creds["SecretAccessKey"])
+    # Temp creds must carry the session token.
+    r = tmp.put("/cfg/sts.txt", data=b"via-sts")
+    assert r.status_code == 400  # InvalidToken without session token
+    r = tmp.put("/cfg/sts.txt", data=b"via-sts",
+                headers={"x-amz-security-token": creds["SessionToken"]})
+    assert r.status_code == 200, r.text
+    r = tmp.get("/cfg/sts.txt",
+                headers={"x-amz-security-token": creds["SessionToken"]})
+    assert r.content == b"via-sts"
+
+
+def test_sts_anonymous_rejected(server):
+    base, _ = server
+    r = requests.post(f"{base}/", data={"Action": "AssumeRole"})
+    assert r.status_code == 403
