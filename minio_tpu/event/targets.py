@@ -1,0 +1,179 @@
+"""Event targets with at-least-once store-and-forward delivery.
+
+Role-equivalent of pkg/event/target/*: each target has an ARN; events are
+journaled to an on-disk queue first (pkg/event/target/queuestore.go), then a
+worker delivers with retry — so a target outage never loses events and
+never blocks the data path. Webhook is the first-class target (the
+reference's other nine targets need client libraries this image doesn't
+ship; the Target interface is the seam they plug into).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+import urllib.parse
+import uuid
+from typing import Protocol
+
+RETRY_INTERVAL = 3.0
+
+
+class Target(Protocol):
+    arn: str
+
+    def send(self, records: dict) -> None:
+        """Deliver one event document; raise on failure (triggers retry)."""
+
+    def close(self) -> None: ...
+
+
+class MemoryTarget:
+    """In-process sink for tests and for the admin `listen` stream."""
+
+    def __init__(self, arn: str = "arn:minio_tpu:sqs::memory:memory"):
+        self.arn = arn
+        self.events: list[dict] = []
+        self._cond = threading.Condition()
+
+    def send(self, records: dict) -> None:
+        with self._cond:
+            self.events.append(records)
+            self._cond.notify_all()
+
+    def wait_for(self, n: int, timeout: float = 5.0) -> list[dict]:
+        with self._cond:
+            self._cond.wait_for(lambda: len(self.events) >= n, timeout)
+            return list(self.events)
+
+    def close(self) -> None:
+        pass
+
+
+class WebhookTarget:
+    """POST the event JSON to an HTTP endpoint
+    (pkg/event/target/webhook.go)."""
+
+    def __init__(self, endpoint: str, arn_id: str = "webhook",
+                 auth_token: str = "", timeout: float = 10.0):
+        self.arn = f"arn:minio_tpu:sqs::{arn_id}:webhook"
+        self.endpoint = endpoint
+        self.auth_token = auth_token
+        self.timeout = timeout
+        u = urllib.parse.urlsplit(endpoint)
+        self._host = u.hostname or "127.0.0.1"
+        self._port = u.port or (443 if u.scheme == "https" else 80)
+        self._path = u.path or "/"
+        self._https = u.scheme == "https"
+
+    def send(self, records: dict) -> None:
+        body = json.dumps(records).encode()
+        cls = (http.client.HTTPSConnection if self._https
+               else http.client.HTTPConnection)
+        conn = cls(self._host, self._port, timeout=self.timeout)
+        try:
+            headers = {"Content-Type": "application/json"}
+            if self.auth_token:
+                headers["Authorization"] = f"Bearer {self.auth_token}"
+            conn.request("POST", self._path, body=body, headers=headers)
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status // 100 != 2:
+                raise OSError(f"webhook {self.endpoint}: HTTP {resp.status}")
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        pass
+
+
+class QueueStore:
+    """Durable per-target event queue: one JSON file per pending event
+    (pkg/event/target/queuestore.go). Survives restarts; replayed by the
+    delivery worker."""
+
+    def __init__(self, directory: str, limit: int = 10000):
+        self.dir = directory
+        self.limit = limit
+        os.makedirs(directory, exist_ok=True)
+
+    def put(self, doc: dict) -> str:
+        names = os.listdir(self.dir)
+        if len(names) >= self.limit:
+            raise OSError(f"event queue full ({self.limit})")
+        name = f"{time.time():.6f}-{uuid.uuid4().hex[:8]}.json"
+        tmp = os.path.join(self.dir, "." + name)
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, os.path.join(self.dir, name))
+        return name
+
+    def list(self) -> list[str]:
+        return sorted(n for n in os.listdir(self.dir)
+                      if not n.startswith("."))
+
+    def get(self, name: str) -> dict:
+        with open(os.path.join(self.dir, name)) as f:
+            return json.load(f)
+
+    def delete(self, name: str) -> None:
+        try:
+            os.remove(os.path.join(self.dir, name))
+        except FileNotFoundError:
+            pass
+
+    def __len__(self) -> int:
+        return len(self.list())
+
+
+class DeliveryWorker:
+    """One per target: drains the queue store in order, retrying failures
+    with backoff — at-least-once, order-preserving per target."""
+
+    def __init__(self, target, store: QueueStore,
+                 retry_interval: float = RETRY_INTERVAL):
+        self.target = target
+        self.store = store
+        self.retry_interval = retry_interval
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"event-delivery-{target.arn.rsplit(':', 1)[-1]}")
+        self._thread.start()
+
+    def enqueue(self, doc: dict) -> None:
+        self.store.put(doc)
+        self._wake.set()
+
+    def _loop(self) -> None:
+        while not self._stop:
+            pending = self.store.list()
+            if not pending:
+                self._wake.wait(timeout=1.0)
+                self._wake.clear()
+                continue
+            for name in pending:
+                if self._stop:
+                    return
+                try:
+                    doc = self.store.get(name)
+                except (OSError, ValueError):
+                    self.store.delete(name)  # corrupt entry
+                    continue
+                try:
+                    self.target.send(doc)
+                except Exception:  # noqa: BLE001 - retry later, keep order
+                    self._wake.wait(timeout=self.retry_interval)
+                    self._wake.clear()
+                    break
+                self.store.delete(name)
+
+    def close(self) -> None:
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=2.0)
+        self.target.close()

@@ -25,6 +25,8 @@ from aiohttp import web
 from minio_tpu.bucket.meta import BucketMetadataSys
 from minio_tpu.erasure import ErasureObjects
 from minio_tpu.erasure.types import CompletePart, ObjectOptions, ObjectToDelete
+from minio_tpu.event import EventNotifier, new_object_event
+from minio_tpu.event import event as evt
 from minio_tpu.iam.actions import action_for
 from minio_tpu.iam.policy import Policy, PolicyArgs
 from minio_tpu.iam.sys import ANONYMOUS, IAMSys
@@ -98,6 +100,14 @@ class S3Server:
             if has_store else BucketMetadataSys(_MemStore())
         self.iam = IAMSys(credentials.access_key, credentials.secret_key,
                           store=store, notify=notify_iam)
+
+        # Eventing: durable per-target queues under a local spool dir
+        # (reference pkg/event/target/queuestore.go).
+        queue_dir = os.environ.get(
+            "MTPU_EVENT_QUEUE_DIR",
+            os.path.join(tempfile.gettempdir(), f"mtpu-events-{os.getpid()}"))
+        self.notifier = EventNotifier(queue_dir=queue_dir)
+        self._rules_loaded: set = set()
 
     # ------------------------------------------------------------------
 
@@ -177,6 +187,8 @@ class S3Server:
             # Anonymous: allowed only where the bucket policy grants it.
             identity, payload_hash, auth_sig = (
                 ANONYMOUS, sigv4.UNSIGNED_PAYLOAD, None)
+
+        request["identity"] = identity
 
         # Temp (STS) credentials must also present their session token
         # (cmd/auth-handler.go getSessionToken check).
@@ -367,6 +379,9 @@ class S3Server:
                 extra = {}
                 if info.version_id:
                     extra["x-amz-version-id"] = info.version_id
+                self._emit(request, evt.OBJECT_CREATED_COMPLETE_MULTIPART,
+                           bucket, key, size=info.size, etag=info.etag,
+                           version_id=info.version_id)
                 return web.Response(
                     body=xmlutil.complete_multipart_xml(
                         f"/{bucket}/{key}", bucket, key, info.etag),
@@ -393,6 +408,10 @@ class S3Server:
                 extra["x-amz-delete-marker"] = "true"
             if info.version_id:
                 extra["x-amz-version-id"] = info.version_id
+            self._emit(request,
+                       evt.OBJECT_REMOVED_DELETE_MARKER if info.delete_marker
+                       else evt.OBJECT_REMOVED_DELETE,
+                       bucket, key, version_id=info.version_id)
             return web.Response(status=204, headers={**hdr, **extra})
         raise S3Error("MethodNotAllowed", resource=path)
 
@@ -484,6 +503,11 @@ class S3Server:
         if "notification" in sub:
             if m == "PUT":
                 body = await request.read()
+                try:
+                    await run(self.notifier.set_bucket_rules, bucket, body)
+                except ValueError as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                self._rules_loaded.add(bucket)
                 await run(self.bucket_meta.update, bucket,
                           notification_xml=body)
                 return web.Response(status=200, headers=hdr)
@@ -542,6 +566,33 @@ class S3Server:
             tc.access_key, tc.secret_key, tc.session_token, exp,
             hdr["x-amz-request-id"])
         return web.Response(body=body, content_type=XML_TYPE, headers=hdr)
+
+    # ------------------------------------------------------------------
+    # eventing glue (reference sendEvent calls at the end of each handler)
+    # ------------------------------------------------------------------
+
+    def _ensure_rules(self, bucket: str) -> None:
+        if bucket in self._rules_loaded:
+            return
+        self._rules_loaded.add(bucket)
+        xml_cfg = self.bucket_meta.get(bucket).notification_xml
+        if xml_cfg:
+            try:
+                self.notifier.set_bucket_rules(bucket, xml_cfg)
+            except ValueError:
+                pass  # stored config references a target gone from config
+
+    def _emit(self, request, event_name: str, bucket: str, key: str,
+              size: int = 0, etag: str = "", version_id: str = "") -> None:
+        self._ensure_rules(bucket)
+        if not self.notifier.has_rules(bucket):
+            return
+        ident = request.get("identity")
+        self.notifier.send(new_object_event(
+            event_name, bucket, key, size=size, etag=etag,
+            version_id=version_id,
+            user=getattr(ident, "access_key", "") or "anonymous",
+            host=request.remote or "", region=self.region))
 
     # ------------------------------------------------------------------
 
@@ -610,6 +661,8 @@ class S3Server:
         extra = {"ETag": f'"{info.etag}"'}
         if info.version_id:
             extra["x-amz-version-id"] = info.version_id
+        self._emit(request, evt.OBJECT_CREATED_PUT, bucket, key,
+                   size=info.size, etag=info.etag, version_id=info.version_id)
         return web.Response(status=200, headers={**hdr, **extra})
 
     async def _put_part(self, request, bucket, key, upload_id, part_number,

@@ -1,0 +1,190 @@
+"""Eventing tests: rule parsing/matching, durable queue delivery with
+retry, webhook target against a live HTTP sink, and end-to-end emission
+through the S3 server (pkg/event + cmd/notification.go roles)."""
+
+import http.server
+import json
+import socket
+import threading
+import time
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.event import (
+    EventNotifier,
+    MemoryTarget,
+    WebhookTarget,
+    new_object_event,
+    parse_notification_xml,
+)
+from minio_tpu.event import event as evt
+from minio_tpu.event.targets import DeliveryWorker, QueueStore
+
+NOTIF_XML = b"""<NotificationConfiguration>
+  <QueueConfiguration>
+    <Id>r1</Id>
+    <Queue>arn:minio_tpu:sqs::memory:memory</Queue>
+    <Event>s3:ObjectCreated:*</Event>
+    <Filter><S3Key>
+      <FilterRule><Name>prefix</Name><Value>photos/</Value></FilterRule>
+      <FilterRule><Name>suffix</Name><Value>.jpg</Value></FilterRule>
+    </S3Key></Filter>
+  </QueueConfiguration>
+  <QueueConfiguration>
+    <Queue>arn:minio_tpu:sqs::memory:memory</Queue>
+    <Event>s3:ObjectRemoved:Delete</Event>
+  </QueueConfiguration>
+</NotificationConfiguration>"""
+
+
+def test_parse_notification_xml():
+    cfg = parse_notification_xml(NOTIF_XML)
+    assert len(cfg.rules) == 2
+    r = cfg.rules[0]
+    assert r.arn == "arn:minio_tpu:sqs::memory:memory"
+    assert evt.OBJECT_CREATED_PUT in r.events
+    assert evt.OBJECT_CREATED_COMPLETE_MULTIPART in r.events
+    assert evt.OBJECT_REMOVED_DELETE not in r.events
+    assert r.prefix == "photos/" and r.suffix == ".jpg"
+
+    assert cfg.match(evt.OBJECT_CREATED_PUT, "photos/cat.jpg")
+    assert not cfg.match(evt.OBJECT_CREATED_PUT, "docs/cat.jpg")
+    assert not cfg.match(evt.OBJECT_CREATED_PUT, "photos/cat.png")
+    assert cfg.match(evt.OBJECT_REMOVED_DELETE, "anything")
+
+    with pytest.raises(ValueError):
+        parse_notification_xml(b"<NotificationConfiguration><QueueConfiguration>"
+                               b"<Queue>arn:x</Queue></QueueConfiguration>"
+                               b"</NotificationConfiguration>")  # no Event
+
+
+def test_event_record_schema():
+    e = new_object_event(evt.OBJECT_CREATED_PUT, "bkt", "a/b c.txt",
+                         size=42, etag="abc", version_id="v1", user="alice")
+    rec = e.to_record()
+    assert rec["eventName"] == "s3:ObjectCreated:Put"
+    assert rec["s3"]["bucket"]["name"] == "bkt"
+    assert rec["s3"]["object"]["key"] == "a/b%20c.txt"
+    assert rec["s3"]["object"]["size"] == 42
+    assert rec["s3"]["object"]["versionId"] == "v1"
+    assert rec["userIdentity"]["principalId"] == "alice"
+    assert rec["eventTime"].endswith("Z")
+
+
+def test_queue_store_roundtrip(tmp_path):
+    qs = QueueStore(str(tmp_path / "q"))
+    n1 = qs.put({"a": 1})
+    time.sleep(0.01)  # timestamps order the queue
+    n2 = qs.put({"b": 2})
+    assert qs.list() == [n1, n2]
+    assert qs.get(n1) == {"a": 1}
+    qs.delete(n1)
+    assert qs.list() == [n2]
+
+
+class _FlakyTarget:
+    """Fails the first N sends, then succeeds — exercises retry."""
+
+    arn = "arn:minio_tpu:sqs::flaky:test"
+
+    def __init__(self, fail_times: int):
+        self.fail_times = fail_times
+        self.delivered = []
+
+    def send(self, doc):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise OSError("transient")
+        self.delivered.append(doc)
+
+    def close(self):
+        pass
+
+
+def test_delivery_retry_preserves_order(tmp_path):
+    t = _FlakyTarget(fail_times=2)
+    w = DeliveryWorker(t, QueueStore(str(tmp_path / "q")),
+                       retry_interval=0.05)
+    for i in range(3):
+        w.enqueue({"seq": i})
+    deadline = time.time() + 5
+    while len(t.delivered) < 3 and time.time() < deadline:
+        time.sleep(0.02)
+    w.close()
+    assert [d["seq"] for d in t.delivered] == [0, 1, 2]
+
+
+def test_queue_survives_restart(tmp_path):
+    qdir = str(tmp_path / "q")
+    dead = _FlakyTarget(fail_times=10**9)
+    w = DeliveryWorker(dead, QueueStore(qdir), retry_interval=0.05)
+    w.enqueue({"seq": "persisted"})
+    w.close()
+    # New worker over the same dir delivers the leftover event.
+    good = _FlakyTarget(fail_times=0)
+    w2 = DeliveryWorker(good, QueueStore(qdir), retry_interval=0.05)
+    deadline = time.time() + 5
+    while not good.delivered and time.time() < deadline:
+        time.sleep(0.02)
+    w2.close()
+    assert good.delivered and good.delivered[0]["seq"] == "persisted"
+
+
+def test_notifier_routing(tmp_path):
+    notif = EventNotifier(queue_dir=str(tmp_path))
+    mem = MemoryTarget()
+    notif.register_target(mem)
+    notif.set_bucket_rules("bkt", NOTIF_XML)
+
+    notif.send(new_object_event(evt.OBJECT_CREATED_PUT, "bkt",
+                                "photos/x.jpg", size=1))
+    notif.send(new_object_event(evt.OBJECT_CREATED_PUT, "bkt",
+                                "docs/x.pdf", size=1))     # filtered out
+    notif.send(new_object_event(evt.OBJECT_CREATED_PUT, "other",
+                                "photos/y.jpg", size=1))   # no rules
+    got = mem.wait_for(1)
+    assert len(got) == 1
+    assert got[0]["Key"] == "bkt/photos/x.jpg"
+    notif.close()
+
+
+def test_notifier_rejects_unknown_arn(tmp_path):
+    notif = EventNotifier(queue_dir=str(tmp_path))
+    with pytest.raises(ValueError):
+        notif.set_bucket_rules("bkt", NOTIF_XML)  # no registered target
+    notif.close()
+
+
+def test_webhook_target_live(tmp_path):
+    received = []
+
+    class Sink(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+
+    wh = WebhookTarget(f"http://127.0.0.1:{port}/hook", arn_id="t1")
+    notif = EventNotifier(queue_dir=str(tmp_path))
+    notif.register_target(wh)
+    notif.set_bucket_rules("bkt", f"""<NotificationConfiguration>
+      <QueueConfiguration><Queue>{wh.arn}</Queue>
+      <Event>s3:ObjectCreated:*</Event></QueueConfiguration>
+    </NotificationConfiguration>""".encode())
+
+    notif.send(new_object_event(evt.OBJECT_CREATED_PUT, "bkt", "k", size=9))
+    deadline = time.time() + 5
+    while not received and time.time() < deadline:
+        time.sleep(0.02)
+    notif.close()
+    srv.shutdown()
+    assert received and received[0]["Records"][0]["s3"]["object"]["size"] == 9

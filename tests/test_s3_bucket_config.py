@@ -273,3 +273,38 @@ def test_sts_anonymous_rejected(server):
     base, _ = server
     r = requests.post(f"{base}/", data={"Action": "AssumeRole"})
     assert r.status_code == 403
+
+
+# ---------------- eventing end-to-end ----------------
+
+def test_notification_end_to_end(server, client):
+    from minio_tpu.event import MemoryTarget
+
+    base, srv = server
+    mem = MemoryTarget()
+    srv.notifier.register_target(mem)
+
+    assert client.put("/evt").status_code == 200
+    cfg = f"""<NotificationConfiguration>
+      <QueueConfiguration><Queue>{mem.arn}</Queue>
+      <Event>s3:ObjectCreated:*</Event>
+      <Event>s3:ObjectRemoved:*</Event></QueueConfiguration>
+    </NotificationConfiguration>""".encode()
+    r = client.put("/evt", data=cfg, query={"notification": ""})
+    assert r.status_code == 200, r.text
+
+    client.put("/evt/hello.txt", data=b"hi")
+    got = mem.wait_for(1)
+    assert got[0]["EventName"] == "s3:ObjectCreated:Put"
+    assert got[0]["Key"] == "evt/hello.txt"
+    assert got[0]["Records"][0]["s3"]["object"]["size"] == 2
+    assert got[0]["Records"][0]["userIdentity"]["principalId"] == ACCESS
+
+    client.delete("/evt/hello.txt")
+    got = mem.wait_for(2)
+    assert got[1]["EventName"] == "s3:ObjectRemoved:Delete"
+
+    # Unknown ARN rejected at PUT time.
+    bad = cfg.replace(mem.arn.encode(), b"arn:minio_tpu:sqs::nope:none")
+    r = client.put("/evt", data=bad, query={"notification": ""})
+    assert r.status_code == 400
