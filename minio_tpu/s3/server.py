@@ -108,6 +108,19 @@ class S3Server:
             os.path.join(tempfile.gettempdir(), f"mtpu-events-{os.getpid()}"))
         self.notifier = EventNotifier(queue_dir=queue_dir)
         self._rules_loaded: set = set()
+        self.scanner = None
+
+    def start_scanner(self, interval: float = 60.0,
+                      heal_objects: bool = True) -> None:
+        """Boot the background data scanner (reference initDataScanner,
+        cmd/data-scanner.go:65)."""
+        from minio_tpu.scanner import DataScanner
+
+        self.scanner = DataScanner(self.obj, self.bucket_meta,
+                                   notifier=self.notifier,
+                                   interval=interval,
+                                   heal_objects=heal_objects)
+        self.scanner.start()
 
     # ------------------------------------------------------------------
 
@@ -962,6 +975,8 @@ def main(argv=None):
     ap.add_argument("--parity", type=int, default=None)
     ap.add_argument("--set-drives", type=int, default=None,
                     help="drives per erasure set (default: all drives, one set)")
+    ap.add_argument("--scan-interval", type=float, default=60.0,
+                    help="background scanner cycle pause (seconds; 0 disables)")
     args = ap.parse_args(argv)
     host, _, port = args.address.rpartition(":")
     access = os.environ.get("MTPU_ROOT_USER", "minioadmin")
@@ -970,6 +985,8 @@ def main(argv=None):
                        versioned=args.versioned, parity=args.parity,
                        set_drive_count=args.set_drives,
                        server_addr=args.address)
+    if args.scan_interval > 0:
+        srv.start_scanner(interval=args.scan_interval)
     web.run_app(srv.app, host=host or "0.0.0.0", port=int(port))
 
 

@@ -1,0 +1,12 @@
+"""Background data scanner, usage accounting, and ILM lifecycle evaluation.
+
+Role-equivalent of cmd/data-scanner.go + cmd/data-usage-cache.go +
+pkg/bucket/lifecycle + cmd/bucket-lifecycle.go.
+"""
+
+from minio_tpu.scanner.lifecycle import Lifecycle, parse_lifecycle_xml
+from minio_tpu.scanner.scanner import DataScanner
+from minio_tpu.scanner.usage import DataUsageCache, UsageEntry
+
+__all__ = ["Lifecycle", "parse_lifecycle_xml", "DataScanner",
+           "DataUsageCache", "UsageEntry"]

@@ -1,0 +1,175 @@
+"""DataScanner — the background crawl that feeds usage accounting,
+lifecycle expiry, and heal triggers.
+
+Role-equivalent of cmd/data-scanner.go (initDataScanner:65,
+runDataScanner:72): cycles over every bucket's version listing, updates the
+usage tree, applies due ILM actions through the object layer, aborts
+expired multipart uploads, and (optionally) probabilistically heals
+objects. Runs as a daemon thread with an adaptive pause; `scan_once()` is
+the deterministic unit the tests drive.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from minio_tpu.bucket.meta import BucketMetadataSys
+from minio_tpu.erasure.types import ObjectOptions
+from minio_tpu.scanner import lifecycle as lc
+from minio_tpu.scanner.usage import DataUsageCache
+from minio_tpu.utils import errors as se
+
+log = logging.getLogger("minio_tpu.scanner")
+
+SCAN_INTERVAL = 60.0
+HEAL_EVERY_N_CYCLES = 16   # objects deep-checked 1/N of cycles (reference
+                           # healObjectSelectProb, data-scanner.go)
+PAGE = 1000
+
+
+class DataScanner:
+    def __init__(self, object_layer, bucket_meta: BucketMetadataSys,
+                 store=None, notifier=None,
+                 interval: float = SCAN_INTERVAL,
+                 heal_objects: bool = False):
+        self.obj = object_layer
+        self.bucket_meta = bucket_meta
+        self.store = store if store is not None else (
+            object_layer if hasattr(object_layer, "read_sys_config") else None)
+        self.notifier = notifier
+        self.interval = interval
+        self.heal_objects = heal_objects
+        self.usage = (DataUsageCache.load(self.store)
+                      if self.store is not None else DataUsageCache())
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle of the scanner itself --
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="data-scanner")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.scan_once()
+            except Exception:  # noqa: BLE001 - scanner must never die
+                log.exception("scan cycle failed")
+
+    # -- one full cycle --
+
+    def scan_once(self, now: float | None = None) -> DataUsageCache:
+        """Crawl everything once; returns the fresh usage cache."""
+        fresh = DataUsageCache()
+        fresh.cycles = self.usage.cycles + 1
+        deep_heal = self.heal_objects and fresh.cycles % HEAL_EVERY_N_CYCLES == 0
+
+        for binfo in self.obj.list_buckets():
+            if self._stop.is_set():
+                break
+            bucket = binfo.name
+            meta = self.bucket_meta.get(bucket) if self.bucket_meta else None
+            lifecycle = None
+            if meta is not None and meta.lifecycle_xml:
+                try:
+                    lifecycle = lc.parse_lifecycle_xml(meta.lifecycle_xml)
+                except ValueError:
+                    lifecycle = None
+            self._scan_bucket(bucket, lifecycle, fresh, deep_heal, now)
+            if lifecycle is not None:
+                self._expire_mpus(bucket, lifecycle, now)
+
+        self.usage = fresh
+        if self.store is not None:
+            try:
+                fresh.save(self.store)
+            except Exception:  # noqa: BLE001 - accounting is best-effort
+                log.exception("usage persist failed")
+        return fresh
+
+    def _scan_bucket(self, bucket: str, lifecycle, fresh: DataUsageCache,
+                     deep_heal: bool, now: float | None) -> None:
+        entry = fresh.bucket(bucket)
+        marker = vmarker = ""
+        while True:
+            try:
+                page = self.obj.list_object_versions(
+                    bucket, "", marker, vmarker, "", PAGE)
+            except se.BucketNotFound:
+                return
+            # Group versions per object so num_versions/successor times are
+            # known to the lifecycle evaluator.
+            by_key: dict[str, list] = {}
+            for o in page.objects:
+                by_key.setdefault(o.name, []).append(o)
+            for key, versions in by_key.items():
+                versions.sort(key=lambda o: o.mod_time, reverse=True)
+                for i, o in enumerate(versions):
+                    entry.add_version(o.size, o.is_latest, o.delete_marker)
+                    if lifecycle is not None:
+                        self._apply_ilm(bucket, o, lifecycle,
+                                        num_versions=len(versions),
+                                        successor=versions[i - 1].mod_time
+                                        if i > 0 else 0.0,
+                                        now=now)
+                if deep_heal:
+                    try:
+                        self.obj.heal_object(bucket, key, scan_deep=False)
+                    except Exception:  # noqa: BLE001
+                        pass
+            if not page.is_truncated:
+                return
+            marker = page.next_marker
+            vmarker = page.next_version_id_marker
+
+    def _apply_ilm(self, bucket: str, o, lifecycle, *, num_versions: int,
+                   successor: float, now: float | None) -> None:
+        action = lifecycle.eval(
+            o.name, o.mod_time, is_latest=o.is_latest,
+            delete_marker=o.delete_marker, num_versions=num_versions,
+            successor_mod_time=successor, now=now)
+        try:
+            if action == lc.DELETE:
+                # Expiring the latest version of a versioned object writes a
+                # delete marker; unversioned objects are removed outright.
+                versioned = (self.bucket_meta.get(bucket).versioning_enabled
+                             if self.bucket_meta else False)
+                self.obj.delete_object(
+                    bucket, o.name, ObjectOptions(versioned=versioned))
+            elif action in (lc.DELETE_VERSION, lc.DELETE_MARKER):
+                self.obj.delete_object(
+                    bucket, o.name,
+                    ObjectOptions(version_id=o.version_id, versioned=True))
+            else:
+                return
+        except (se.ObjectError, se.StorageError):
+            return
+        if self.notifier is not None:
+            from minio_tpu.event import event as evt
+            from minio_tpu.event import new_object_event
+
+            self.notifier.send(new_object_event(
+                evt.OBJECT_REMOVED_DELETE, bucket, o.name,
+                version_id=o.version_id, user="minio_tpu:ilm"))
+
+    def _expire_mpus(self, bucket: str, lifecycle, now: float | None) -> None:
+        try:
+            uploads = self.obj.list_multipart_uploads(bucket, "", 1000)
+        except (se.ObjectError, se.StorageError):
+            return
+        for up in uploads:
+            if lifecycle.mpu_expired(up.initiated, now):
+                try:
+                    self.obj.abort_multipart_upload(bucket, up.object,
+                                                    up.upload_id)
+                except (se.ObjectError, se.StorageError):
+                    pass

@@ -1,0 +1,222 @@
+"""Scanner + lifecycle tests: rule parsing/evaluation, usage accounting,
+and ILM expiry actions applied through the object layer (cmd/data-scanner
++ pkg/bucket/lifecycle roles)."""
+
+import io
+import time
+
+import pytest
+
+from minio_tpu.bucket.meta import BucketMetadataSys
+from minio_tpu.erasure.objects import ErasureObjects
+from minio_tpu.erasure.types import ObjectOptions
+from minio_tpu.scanner import DataScanner, DataUsageCache, parse_lifecycle_xml
+from minio_tpu.scanner import lifecycle as lc
+from minio_tpu.storage.local import LocalDrive
+from minio_tpu.utils import errors as se
+
+DAY = 86400.0
+
+
+# ---------------- lifecycle parsing + eval ----------------
+
+def test_parse_lifecycle_basic():
+    xml = b"""<LifecycleConfiguration>
+      <Rule><ID>expire-logs</ID><Status>Enabled</Status>
+        <Filter><Prefix>logs/</Prefix></Filter>
+        <Expiration><Days>30</Days></Expiration>
+      </Rule>
+      <Rule><ID>old-versions</ID><Status>Enabled</Status>
+        <NoncurrentVersionExpiration><NoncurrentDays>7</NoncurrentDays>
+        </NoncurrentVersionExpiration>
+      </Rule>
+      <Rule><ID>stale-mpu</ID><Status>Enabled</Status>
+        <AbortIncompleteMultipartUpload><DaysAfterInitiation>2
+        </DaysAfterInitiation></AbortIncompleteMultipartUpload>
+      </Rule>
+    </LifecycleConfiguration>"""
+    l = parse_lifecycle_xml(xml)
+    assert len(l.rules) == 3
+    assert l.rules[0].prefix == "logs/" and l.rules[0].expiration_days == 30
+    assert l.rules[1].noncurrent_days == 7
+    assert l.rules[2].abort_mpu_days == 2
+
+
+def test_parse_lifecycle_rejects_empty():
+    with pytest.raises(ValueError):
+        parse_lifecycle_xml(b"<LifecycleConfiguration></LifecycleConfiguration>")
+    with pytest.raises(ValueError):
+        parse_lifecycle_xml(
+            b"<LifecycleConfiguration><Rule><ID>x</ID><Status>Enabled"
+            b"</Status></Rule></LifecycleConfiguration>")
+
+
+def test_eval_expiration_days():
+    l = parse_lifecycle_xml(
+        b"<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+        b"<Filter><Prefix>tmp/</Prefix></Filter>"
+        b"<Expiration><Days>10</Days></Expiration></Rule>"
+        b"</LifecycleConfiguration>")
+    now = time.time()
+    assert l.eval("tmp/x", now - 11 * DAY, now=now) == lc.DELETE
+    assert l.eval("tmp/x", now - 9 * DAY, now=now) == lc.NONE
+    assert l.eval("keep/x", now - 100 * DAY, now=now) == lc.NONE
+
+
+def test_eval_disabled_rule_ignored():
+    l = parse_lifecycle_xml(
+        b"<LifecycleConfiguration><Rule><Status>Disabled</Status>"
+        b"<Expiration><Days>1</Days></Expiration></Rule>"
+        b"</LifecycleConfiguration>")
+    assert l.eval("x", time.time() - 100 * DAY) == lc.NONE
+
+
+def test_eval_noncurrent_counts_from_successor():
+    l = parse_lifecycle_xml(
+        b"<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+        b"<NoncurrentVersionExpiration><NoncurrentDays>5</NoncurrentDays>"
+        b"</NoncurrentVersionExpiration></Rule></LifecycleConfiguration>")
+    now = time.time()
+    # Old version, but only became noncurrent 1 day ago -> keep.
+    assert l.eval("x", now - 100 * DAY, is_latest=False,
+                  successor_mod_time=now - 1 * DAY, now=now) == lc.NONE
+    # Noncurrent for 6 days -> expire.
+    assert l.eval("x", now - 100 * DAY, is_latest=False,
+                  successor_mod_time=now - 6 * DAY, now=now) == lc.DELETE_VERSION
+
+
+def test_eval_tag_filter():
+    xml = b"""<LifecycleConfiguration><Rule><Status>Enabled</Status>
+      <Filter><And><Prefix>p/</Prefix>
+        <Tag><Key>tier</Key><Value>scratch</Value></Tag></And></Filter>
+      <Expiration><Days>1</Days></Expiration></Rule>
+    </LifecycleConfiguration>"""
+    l = parse_lifecycle_xml(xml)
+    now = time.time()
+    old = now - 2 * DAY
+    assert l.eval("p/x", old, tags={"tier": "scratch"}, now=now) == lc.DELETE
+    assert l.eval("p/x", old, tags={"tier": "gold"}, now=now) == lc.NONE
+    assert l.eval("p/x", old, tags={}, now=now) == lc.NONE
+
+
+# ---------------- usage accounting ----------------
+
+def test_usage_entry_and_serialization():
+    c = DataUsageCache()
+    b = c.bucket("bkt")
+    b.add_version(100, True, False)
+    b.add_version(5 << 20, True, False)
+    b.add_version(200, False, False)     # noncurrent version
+    b.add_version(0, True, True)         # delete marker
+    assert b.objects == 2 and b.versions == 3 and b.delete_markers == 1
+    assert b.size == 100 + (5 << 20) + 200
+    assert b.histogram["LESS_THAN_1024_B"] == 1
+    assert b.histogram["BETWEEN_1_MB_AND_10_MB"] == 1
+
+    c2 = DataUsageCache.parse(c.serialize())
+    assert c2.buckets["bkt"].size == b.size
+    info = c2.to_info()
+    assert info["objectsCount"] == 2
+    assert "bkt" in info["bucketsUsage"]
+
+
+# ---------------- the scanner over a real erasure layer ----------------
+
+@pytest.fixture()
+def layer(tmp_path):
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    return ErasureObjects(drives, parity=1)
+
+
+def _put(layer, bucket, key, data=b"x", **opt_kw):
+    layer.put_object(bucket, key, io.BytesIO(data), size=len(data),
+                     opts=ObjectOptions(**opt_kw) if opt_kw else None)
+
+
+def test_scanner_usage_cycle(layer):
+    layer.make_bucket("bkt")
+    _put(layer, "bkt", "a", b"12345")
+    _put(layer, "bkt", "dir/b", b"x" * 2000)
+    bm = BucketMetadataSys(layer)
+    sc = DataScanner(layer, bm)
+    usage = sc.scan_once()
+    e = usage.buckets["bkt"]
+    assert e.objects == 2 and e.size == 2005
+    # Persisted: a fresh scanner loads it.
+    sc2 = DataScanner(layer, bm)
+    assert sc2.usage.buckets["bkt"].objects == 2
+    assert sc2.usage.cycles == 1
+
+
+def test_scanner_expires_by_lifecycle(layer):
+    layer.make_bucket("bkt")
+    _put(layer, "bkt", "tmp/old", b"stale")
+    _put(layer, "bkt", "tmp/new", b"fresh")
+    _put(layer, "bkt", "keep/old", b"kept")
+    # Backdate tmp/old by rewriting its mod time through a direct put
+    # with an old mod_time option.
+    layer.put_object("bkt", "tmp/old", io.BytesIO(b"stale"), size=5,
+                     opts=ObjectOptions(mod_time=time.time() - 40 * DAY))
+    layer.put_object("bkt", "keep/old", io.BytesIO(b"kept"), size=4,
+                     opts=ObjectOptions(mod_time=time.time() - 40 * DAY))
+
+    bm = BucketMetadataSys(layer)
+    bm.update("bkt", lifecycle_xml=(
+        b"<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+        b"<Filter><Prefix>tmp/</Prefix></Filter>"
+        b"<Expiration><Days>30</Days></Expiration></Rule>"
+        b"</LifecycleConfiguration>"))
+
+    sc = DataScanner(layer, bm)
+    sc.scan_once()
+
+    with pytest.raises(se.ObjectNotFound):
+        layer.get_object_info("bkt", "tmp/old")
+    assert layer.get_object_info("bkt", "tmp/new").size == 5
+    assert layer.get_object_info("bkt", "keep/old").size == 4
+
+
+def test_scanner_expires_noncurrent_versions(layer):
+    layer.make_bucket("bkt")
+    old = time.time() - 10 * DAY
+    layer.put_object("bkt", "v", io.BytesIO(b"old"), size=3,
+                     opts=ObjectOptions(versioned=True, mod_time=old))
+    layer.put_object("bkt", "v", io.BytesIO(b"new"), size=3,
+                     opts=ObjectOptions(versioned=True,
+                                        mod_time=time.time() - 9 * DAY))
+    bm = BucketMetadataSys(layer)
+    bm.update("bkt", versioning_status="Enabled", lifecycle_xml=(
+        b"<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+        b"<NoncurrentVersionExpiration><NoncurrentDays>5</NoncurrentDays>"
+        b"</NoncurrentVersionExpiration></Rule></LifecycleConfiguration>"))
+
+    sc = DataScanner(layer, bm)
+    sc.scan_once()
+
+    res = layer.list_object_versions("bkt", "v")
+    live = [o for o in res.objects if not o.delete_marker]
+    assert len(live) == 1          # noncurrent one expired
+    _, it = layer.get_object("bkt", "v")
+    assert b"".join(it) == b"new"  # latest untouched
+
+
+def test_scanner_aborts_expired_mpu(layer):
+    layer.make_bucket("bkt")
+    uid = layer.new_multipart_upload("bkt", "big")
+    # Backdate the session by patching its initiated time in the session
+    # metadata on every drive.
+    bm = BucketMetadataSys(layer)
+    bm.update("bkt", lifecycle_xml=(
+        b"<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+        b"<AbortIncompleteMultipartUpload><DaysAfterInitiation>2"
+        b"</DaysAfterInitiation></AbortIncompleteMultipartUpload></Rule>"
+        b"</LifecycleConfiguration>"))
+    sc = DataScanner(layer, bm)
+    # Not yet expired.
+    sc.scan_once()
+    assert any(u.upload_id == uid
+               for u in layer.list_multipart_uploads("bkt"))
+    # Evaluate "now" three days in the future -> aborted.
+    sc.scan_once(now=time.time() + 3 * DAY)
+    assert not any(u.upload_id == uid
+                   for u in layer.list_multipart_uploads("bkt"))
