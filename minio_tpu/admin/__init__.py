@@ -1,0 +1,11 @@
+"""Admin plane: the /minio/admin/v3 API, trace pubsub, HTTP stats,
+Prometheus metrics, and the config KV subsystem.
+
+Role-equivalent of cmd/admin-router.go + cmd/admin-handlers*.go,
+pkg/pubsub, cmd/http-stats.go, cmd/metrics-v2.go, cmd/config/.
+"""
+
+from minio_tpu.admin.pubsub import PubSub
+from minio_tpu.admin.stats import HTTPStats
+
+__all__ = ["PubSub", "HTTPStats"]

@@ -1,0 +1,103 @@
+"""Config KV subsystem.
+
+Role-equivalent of cmd/config/config.go:103-130: subsystem.key = value
+configuration with registered defaults, env override
+(MTPU_<SUBSYS>_<KEY> — env beats stored config, matching the reference's
+precedence), persistence in the sys store, and `mc admin config get/set`
+semantics over the admin API.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from minio_tpu.utils import errors as se
+
+# Registered subsystems and their default keys (cmd/config/config.go:103).
+DEFAULTS: dict[str, dict[str, str]] = {
+    "api": {"requests_max": "0", "cors_allow_origin": "*"},
+    "region": {"name": "us-east-1"},
+    "storageclass": {"standard": "", "rrs": "EC:1"},
+    "compression": {"enable": "off", "extensions": ".txt,.log,.csv,.json",
+                    "mime_types": "text/*,application/json"},
+    "scanner": {"delay": "10", "max_wait": "15s", "cycle": "1m"},
+    "heal": {"bitrotscan": "off", "max_sleep": "1s", "max_io": "10"},
+    "notify_webhook": {"enable": "off", "endpoint": "", "auth_token": "",
+                       "queue_limit": "10000"},
+    "logger_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
+    "audit_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
+}
+
+# Subsystems that apply without restart (cmd/config/config.go:133).
+DYNAMIC = {"api", "scanner", "heal"}
+
+PATH = "config/config.json"
+ENV_PREFIX = "MTPU"
+
+
+class ConfigSys:
+    def __init__(self, store=None):
+        self._store = store
+        self._mu = threading.Lock()
+        self._kv: dict[str, dict[str, str]] = {
+            s: dict(kv) for s, kv in DEFAULTS.items()}
+        if store is not None:
+            self._load()
+
+    def _load(self) -> None:
+        try:
+            doc = json.loads(self._store.read_sys_config(PATH))
+        except (se.FileNotFound, ValueError):
+            return
+        for subsys, kv in doc.items():
+            if subsys in self._kv:
+                self._kv[subsys].update({str(k): str(v)
+                                         for k, v in kv.items()})
+
+    def _persist(self) -> None:
+        if self._store is not None:
+            self._store.write_sys_config(
+                PATH, json.dumps(self._kv, indent=1).encode())
+
+    def get(self, subsys: str, key: str) -> str:
+        """env > stored > default (the reference's precedence)."""
+        env = os.environ.get(f"{ENV_PREFIX}_{subsys.upper()}_{key.upper()}")
+        if env is not None:
+            return env
+        with self._mu:
+            try:
+                return self._kv[subsys][key]
+            except KeyError:
+                raise se.IAMError(f"unknown config {subsys}.{key}") from None
+
+    def set_kv(self, subsys: str, updates: dict[str, str]) -> None:
+        with self._mu:
+            if subsys not in self._kv:
+                raise se.IAMError(f"unknown config subsystem {subsys!r}")
+            unknown = set(updates) - set(DEFAULTS[subsys])
+            if unknown:
+                raise se.IAMError(
+                    f"unknown keys for {subsys}: {sorted(unknown)}")
+            self._kv[subsys].update(
+                {str(k): str(v) for k, v in updates.items()})
+            self._persist()
+
+    def reset(self, subsys: str) -> None:
+        with self._mu:
+            if subsys not in self._kv:
+                raise se.IAMError(f"unknown config subsystem {subsys!r}")
+            self._kv[subsys] = dict(DEFAULTS[subsys])
+            self._persist()
+
+    def dump(self, subsys: str = "") -> dict:
+        with self._mu:
+            if subsys:
+                if subsys not in self._kv:
+                    raise se.IAMError(f"unknown config subsystem {subsys!r}")
+                return {subsys: dict(self._kv[subsys])}
+            return {s: dict(kv) for s, kv in self._kv.items()}
+
+    def is_dynamic(self, subsys: str) -> bool:
+        return subsys in DYNAMIC

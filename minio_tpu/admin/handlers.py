@@ -1,0 +1,308 @@
+"""The /minio/admin/v3 API.
+
+Role-equivalent of cmd/admin-router.go:38 + cmd/admin-handlers*.go: server
+info, data usage, heal, IAM CRUD, config KV, top-locks, and the trace
+stream. Every call requires a signed request whose identity passes the
+admin:* action check (root, or a policy granting admin actions).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from aiohttp import web
+
+from minio_tpu.admin.configkv import ConfigSys
+from minio_tpu.admin.metrics import collect_metrics
+from minio_tpu.iam.policy import PolicyArgs
+from minio_tpu.s3.errors import S3Error
+from minio_tpu.utils import errors as se
+
+VERSION = "minio_tpu/1.0"
+ADMIN_PREFIX = "/minio/admin/v3/"
+
+
+class AdminAPI:
+    def __init__(self, server):
+        """server: the S3Server (provides obj/iam/bucket_meta/stats/
+        trace_bus/scanner/config)."""
+        self.s = server
+        self.started = time.time()
+
+    # ------------------------------------------------------------------
+
+    def _authorize(self, identity, action: str) -> None:
+        if identity.kind == "anonymous":
+            raise S3Error("AccessDenied", "admin API requires credentials")
+        if not self.s.iam.is_allowed(identity, PolicyArgs(action=action)):
+            raise S3Error("AccessDenied", f"{action} not allowed")
+
+    async def handle(self, request: web.Request, path: str,
+                     identity) -> web.StreamResponse:
+        """Dispatch /minio/admin/v3/<op>. `path` excludes the prefix."""
+        loop = asyncio.get_running_loop()
+
+        def run(fn, *a, **kw):
+            return loop.run_in_executor(None, lambda: fn(*a, **kw))
+
+        q = dict(request.query)
+        m = request.method
+        op, _, rest = path.partition("/")
+
+        if op == "info" and m == "GET":
+            self._authorize(identity, "admin:ServerInfo")
+            return _json(await run(self._server_info))
+        if op == "datausageinfo" and m == "GET":
+            self._authorize(identity, "admin:ServerInfo")
+            usage = (self.s.scanner.usage.to_info()
+                     if self.s.scanner is not None else
+                     {"objectsCount": 0, "bucketsUsage": {}})
+            return _json(usage)
+        if op == "metrics" and m == "GET":
+            self._authorize(identity, "admin:Prometheus")
+            body = await run(
+                collect_metrics, self.s.obj, self.s.stats,
+                self.s.scanner.usage if self.s.scanner else None)
+            return web.Response(body=body, content_type="text/plain")
+
+        if op == "heal":
+            self._authorize(identity, "admin:Heal")
+            return await self._heal(request, rest, q, run)
+
+        if op == "top" and rest == "locks" and m == "GET":
+            self._authorize(identity, "admin:TopLocksInfo")
+            dump = {}
+            locker = getattr(self.s, "local_locker", None)
+            if locker is not None:
+                dump = locker.dump()
+            return _json({"locks": dump})
+
+        if op == "config-kv" or op == "config":
+            return await self._config_kv(request, m, q, identity, run)
+
+        if op == "trace" and m == "GET":
+            self._authorize(identity, "admin:ServerTrace")
+            return await self._trace_stream(request)
+
+        # -- IAM surface (cmd/admin-handlers-users.go) --
+        iam_ops = {
+            "add-user": self._add_user,
+            "remove-user": self._remove_user,
+            "list-users": self._list_users,
+            "set-user-status": self._set_user_status,
+            "add-canned-policy": self._add_policy,
+            "remove-canned-policy": self._remove_policy,
+            "list-canned-policies": self._list_policies,
+            "set-user-or-group-policy": self._set_policy_mapping,
+            "update-group-members": self._update_group,
+            "add-service-account": self._add_service_account,
+            "delete-service-account": self._delete_service_account,
+        }
+        if op in iam_ops:
+            self._authorize(identity, "admin:*")
+            try:
+                return await iam_ops[op](request, q, run)
+            except se.IAMError as e:
+                raise S3Error("InvalidRequest", str(e)) from None
+
+        raise S3Error("MethodNotAllowed", resource=request.path)
+
+    # ------------------------------------------------------------------
+
+    def _server_info(self) -> dict:
+        layer = self.s.obj
+        drives = []
+        online = offline = 0
+        for d in getattr(layer, "all_drives", lambda: [])():
+            try:
+                di = d.disk_info()
+                online += 1
+                drives.append({"endpoint": di.endpoint or di.mount_path,
+                               "state": "ok", "uuid": di.id,
+                               "totalspace": di.total,
+                               "availspace": di.free,
+                               "healing": di.healing})
+            except Exception:  # noqa: BLE001
+                offline += 1
+                drives.append({"endpoint": d.endpoint(), "state": "offline"})
+        health = {}
+        try:
+            health = layer.health()
+        except Exception:  # noqa: BLE001
+            pass
+        return {
+            "mode": "online" if health.get("healthy") else "degraded",
+            "version": VERSION,
+            "uptime": round(time.time() - self.started, 3),
+            "drives": drives,
+            "drivesOnline": online,
+            "drivesOffline": offline,
+            "backend": {
+                "backendType": "Erasure",
+                "pools": health.get("pools", health.get("sets", [])),
+            },
+            "stats": self.s.stats.snapshot(),
+        }
+
+    async def _heal(self, request, rest, q, run):
+        """POST heal/{bucket}[/{prefix}] — runs the heal and returns the
+        per-item results (the reference runs async sequences with polling
+        tokens, admin-heal-ops.go:394; synchronous completion returns the
+        same result shape without the second round-trip)."""
+        if request.method != "POST":
+            raise S3Error("MethodNotAllowed", resource=request.path)
+        bucket, _, prefix = rest.partition("/")
+        opts = {}
+        body = await request.read()
+        if body:
+            try:
+                opts = json.loads(body)
+            except ValueError:
+                raise S3Error("InvalidArgument", "bad heal opts") from None
+        dry = bool(opts.get("dryRun"))
+
+        def do() -> dict:
+            items = []
+            if not bucket:
+                for b in self.s.obj.list_buckets():
+                    items.append(self.s.obj.heal_bucket(b.name, dry_run=dry))
+            else:
+                items.append(self.s.obj.heal_bucket(bucket, dry_run=dry))
+                for r in self.s.obj.heal_objects(bucket, prefix, dry_run=dry):
+                    items.append(r)
+            return {"items": [_heal_item(i) for i in items]}
+
+        try:
+            return _json(await run(do))
+        except se.BucketNotFound:
+            raise S3Error("NoSuchBucket", resource=f"/{bucket}") from None
+
+    async def _config_kv(self, request, m, q, identity, run):
+        cfg: ConfigSys = self.s.config
+        if m == "GET":
+            self._authorize(identity, "admin:ConfigUpdate")
+            return _json(cfg.dump(q.get("subsys", "")))
+        if m == "PUT":
+            self._authorize(identity, "admin:ConfigUpdate")
+            body = await request.read()
+            try:
+                doc = json.loads(body)
+            except ValueError:
+                raise S3Error("InvalidArgument", "config body must be "
+                              "{subsys: {key: value}}") from None
+            for subsys, kv in doc.items():
+                try:
+                    await run(cfg.set_kv, subsys, kv)
+                except se.IAMError as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+            return _json({"restart": [s for s in doc
+                                      if not cfg.is_dynamic(s)]})
+        raise S3Error("MethodNotAllowed", resource=request.path)
+
+    async def _trace_stream(self, request) -> web.StreamResponse:
+        resp = web.StreamResponse()
+        resp.content_type = "application/json"
+        await resp.prepare(request)
+        with self.s.trace_bus.subscribe() as sub:
+            loop = asyncio.get_running_loop()
+            try:
+                while True:
+                    item = await loop.run_in_executor(
+                        None, lambda: sub.get(timeout=1.0))
+                    if item is None:
+                        # Heartbeat keeps the connection honest.
+                        await resp.write(b"\n")
+                        continue
+                    await resp.write(json.dumps(item).encode() + b"\n")
+            except (ConnectionResetError, asyncio.CancelledError):
+                pass
+        return resp
+
+    # -- IAM handlers --
+
+    async def _add_user(self, request, q, run):
+        body = json.loads(await request.read() or b"{}")
+        await run(self.s.iam.set_user, q["accessKey"],
+                  body.get("secretKey", ""), body.get("status", "on"))
+        return _json({})
+
+    async def _remove_user(self, request, q, run):
+        await run(self.s.iam.delete_user, q["accessKey"])
+        return _json({})
+
+    async def _list_users(self, request, q, run):
+        users = await run(self.s.iam.list_users)
+        return _json({ak: {"status": u.status, "policyName": u.policies}
+                      for ak, u in users.items()})
+
+    async def _set_user_status(self, request, q, run):
+        await run(self.s.iam.set_user_status, q["accessKey"], q["status"])
+        return _json({})
+
+    async def _add_policy(self, request, q, run):
+        body = await request.read()
+        await run(self.s.iam.set_policy, q["name"], body.decode())
+        return _json({})
+
+    async def _remove_policy(self, request, q, run):
+        await run(self.s.iam.delete_policy, q["name"])
+        return _json({})
+
+    async def _list_policies(self, request, q, run):
+        return _json({name: json.loads(doc)
+                      for name, doc in self.s.iam.policies.items()})
+
+    async def _set_policy_mapping(self, request, q, run):
+        names = [p for p in q.get("policyName", "").split(",") if p]
+        await run(self.s.iam.attach_policy, q["userOrGroup"], names,
+                  q.get("isGroup") == "true")
+        return _json({})
+
+    async def _update_group(self, request, q, run):
+        body = json.loads(await request.read() or b"{}")
+        group = body.get("group", "")
+        members = body.get("members", [])
+        if body.get("isRemove"):
+            await run(self.s.iam.remove_group_members, group, members)
+        else:
+            await run(self.s.iam.add_group_members, group, members)
+        return _json({})
+
+    async def _add_service_account(self, request, q, run):
+        body = json.loads(await request.read() or b"{}")
+        tc = await run(self.s.iam.add_service_account,
+                       body.get("parent") or self.s.iam.root_access_key,
+                       body.get("policy", ""),
+                       body.get("accessKey", ""), body.get("secretKey", ""))
+        return _json({"credentials": {"accessKey": tc.access_key,
+                                      "secretKey": tc.secret_key}})
+
+    async def _delete_service_account(self, request, q, run):
+        await run(self.s.iam.delete_service_account, q["accessKey"])
+        return _json({})
+
+
+def _heal_item(i) -> dict:
+    if isinstance(i, dict):
+        return i
+    out = {"bucket": getattr(i, "bucket", ""),
+           "object": getattr(i, "object", ""),
+           "versionId": getattr(i, "version_id", ""),
+           "objectSize": getattr(i, "object_size", 0),
+           "diskCount": getattr(i, "disk_count", 0)}
+    before = getattr(i, "before", None)
+    after = getattr(i, "after", None)
+    if before is not None:
+        out["before"] = [{"endpoint": s.endpoint, "state": s.state}
+                         for s in before]
+    if after is not None:
+        out["after"] = [{"endpoint": s.endpoint, "state": s.state}
+                        for s in after]
+    return out
+
+
+def _json(doc) -> web.Response:
+    return web.Response(body=json.dumps(doc).encode(),
+                        content_type="application/json")

@@ -1,0 +1,109 @@
+"""Prometheus exposition.
+
+Role-equivalent of cmd/metrics-v2.go: cluster/node metric families
+rendered in the text format at /minio/v2/metrics/cluster. Collectors are
+lazy — gathered per scrape, like the reference's MetricsGroup cached
+collectors (:147-154).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def _esc(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class PromText:
+    def __init__(self):
+        self.lines: list[str] = []
+
+    def family(self, name: str, help_: str, typ: str = "gauge") -> None:
+        self.lines.append(f"# HELP {name} {help_}")
+        self.lines.append(f"# TYPE {name} {typ}")
+
+    def sample(self, name: str, value, labels: dict | None = None) -> None:
+        if labels:
+            lbl = ",".join(f'{k}="{_esc(str(v))}"'
+                           for k, v in sorted(labels.items()))
+            self.lines.append(f"{name}{{{lbl}}} {value}")
+        else:
+            self.lines.append(f"{name} {value}")
+
+    def render(self) -> bytes:
+        return ("\n".join(self.lines) + "\n").encode()
+
+
+def collect_metrics(object_layer, stats, usage=None,
+                    started: float | None = None) -> bytes:
+    """One scrape (families mirror docs/metrics/prometheus/list.md)."""
+    p = PromText()
+
+    # -- process --
+    p.family("minio_tpu_process_uptime_seconds", "Server uptime", "counter")
+    p.sample("minio_tpu_process_uptime_seconds",
+             round(time.time() - (started or stats.started), 3))
+
+    # -- per-API request stats --
+    snap = stats.snapshot()
+    p.family("minio_tpu_s3_requests_total",
+             "Total S3 requests by API", "counter")
+    p.family("minio_tpu_s3_requests_errors_total",
+             "Total S3 requests that errored, by API", "counter")
+    p.family("minio_tpu_s3_requests_seconds_total",
+             "Cumulative time serving each API", "counter")
+    p.family("minio_tpu_s3_traffic_received_bytes",
+             "Bytes received by API", "counter")
+    p.family("minio_tpu_s3_traffic_sent_bytes", "Bytes sent by API", "counter")
+    for api, s in sorted(snap["apis"].items()):
+        lbl = {"api": api}
+        p.sample("minio_tpu_s3_requests_total", s["count"], lbl)
+        p.sample("minio_tpu_s3_requests_errors_total", s["errors"], lbl)
+        p.sample("minio_tpu_s3_requests_seconds_total", s["totalSeconds"], lbl)
+        p.sample("minio_tpu_s3_traffic_received_bytes", s["rxBytes"], lbl)
+        p.sample("minio_tpu_s3_traffic_sent_bytes", s["txBytes"], lbl)
+    p.family("minio_tpu_s3_requests_current", "In-flight S3 requests")
+    p.sample("minio_tpu_s3_requests_current", snap["currentRequests"])
+
+    # -- drives / capacity --
+    online = offline = 0
+    total_cap = free_cap = 0
+    for d in getattr(object_layer, "all_drives", lambda: [])():
+        try:
+            di = d.disk_info()
+            online += 1
+            total_cap += di.total
+            free_cap += di.free
+        except Exception:  # noqa: BLE001
+            offline += 1
+    p.family("minio_tpu_cluster_disk_online_total", "Drives online")
+    p.sample("minio_tpu_cluster_disk_online_total", online)
+    p.family("minio_tpu_cluster_disk_offline_total", "Drives offline")
+    p.sample("minio_tpu_cluster_disk_offline_total", offline)
+    p.family("minio_tpu_cluster_capacity_raw_total_bytes", "Raw capacity")
+    p.sample("minio_tpu_cluster_capacity_raw_total_bytes", total_cap)
+    p.family("minio_tpu_cluster_capacity_raw_free_bytes", "Raw free")
+    p.sample("minio_tpu_cluster_capacity_raw_free_bytes", free_cap)
+
+    # -- usage (scanner-fed) --
+    if usage is not None:
+        p.family("minio_tpu_bucket_usage_object_total",
+                 "Objects per bucket (scanner)")
+        p.family("minio_tpu_bucket_usage_total_bytes",
+                 "Bytes per bucket (scanner)")
+        for b, e in sorted(usage.buckets.items()):
+            p.sample("minio_tpu_bucket_usage_object_total", e.objects,
+                     {"bucket": b})
+            p.sample("minio_tpu_bucket_usage_total_bytes", e.size,
+                     {"bucket": b})
+
+    # -- health --
+    try:
+        healthy = 1 if object_layer.health().get("healthy") else 0
+    except Exception:  # noqa: BLE001
+        healthy = 0
+    p.family("minio_tpu_cluster_health_status",
+             "1 when every set holds write quorum")
+    p.sample("minio_tpu_cluster_health_status", healthy)
+    return p.render()

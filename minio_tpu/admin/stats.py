@@ -1,0 +1,65 @@
+"""Per-API HTTP statistics (cmd/http-stats.go:32,139).
+
+Feeds both the admin server-info API and the Prometheus exporter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _APIStat:
+    count: int = 0
+    errors: int = 0
+    e4xx: int = 0
+    e5xx: int = 0
+    canceled: int = 0
+    total_seconds: float = 0.0
+    rx_bytes: int = 0
+    tx_bytes: int = 0
+
+
+class HTTPStats:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._apis: dict[str, _APIStat] = {}
+        self.started = time.time()
+        self.current_requests = 0
+
+    def begin(self) -> float:
+        with self._mu:
+            self.current_requests += 1
+        return time.perf_counter()
+
+    def end(self, api: str, t0: float, status: int,
+            rx: int = 0, tx: int = 0) -> None:
+        dt = time.perf_counter() - t0
+        with self._mu:
+            self.current_requests -= 1
+            st = self._apis.setdefault(api, _APIStat())
+            st.count += 1
+            st.total_seconds += dt
+            st.rx_bytes += rx
+            st.tx_bytes += tx
+            if status >= 500:
+                st.errors += 1
+                st.e5xx += 1
+            elif status >= 400:
+                st.errors += 1
+                st.e4xx += 1
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "uptime": time.time() - self.started,
+                "currentRequests": self.current_requests,
+                "apis": {
+                    name: {"count": s.count, "errors": s.errors,
+                           "4xx": s.e4xx, "5xx": s.e5xx,
+                           "totalSeconds": round(s.total_seconds, 6),
+                           "rxBytes": s.rx_bytes, "txBytes": s.tx_bytes}
+                    for name, s in self._apis.items()},
+            }

@@ -122,6 +122,9 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         if self.mrf is not None:
             self.mrf.close()
 
+    def all_drives(self) -> list[StorageAPI]:
+        return list(self.drives)
+
     def health(self) -> dict:
         online = 0
         for d in self.drives:

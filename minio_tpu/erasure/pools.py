@@ -271,6 +271,9 @@ class ErasureServerPools:
 
     # -- health --
 
+    def all_drives(self):
+        return [d for p in self.pools for d in p.all_drives()]
+
     def read_sys_config(self, path: str) -> bytes:
         return self.pools[0].read_sys_config(path)
 

@@ -172,6 +172,9 @@ class ErasureSets:
 
     # -- listing: merged view across sets --
 
+    def all_drives(self):
+        return list(self.drives)
+
     # Sys-config store lives on set 0 (small mirrored docs need no
     # sharding; reference routes .minio.sys through the same hashing but
     # pins config to deterministic names).

@@ -22,6 +22,11 @@ from typing import Iterator
 
 from aiohttp import web
 
+from minio_tpu.admin.configkv import ConfigSys
+from minio_tpu.admin.handlers import ADMIN_PREFIX, AdminAPI
+from minio_tpu.admin.metrics import collect_metrics
+from minio_tpu.admin.pubsub import PubSub
+from minio_tpu.admin.stats import HTTPStats
 from minio_tpu.bucket.meta import BucketMetadataSys
 from minio_tpu.erasure import ErasureObjects
 from minio_tpu.erasure.types import CompletePart, ObjectOptions, ObjectToDelete
@@ -110,6 +115,14 @@ class S3Server:
         self._rules_loaded: set = set()
         self.scanner = None
 
+        # Admin plane + observability (cmd/admin-router.go, pkg/pubsub,
+        # cmd/http-stats.go, cmd/config/).
+        self.stats = HTTPStats()
+        self.trace_bus = PubSub()
+        self.config = ConfigSys(store)
+        self.admin = AdminAPI(self)
+        self.local_locker = None  # set by the cluster node when distributed
+
     def start_scanner(self, interval: float = 60.0,
                       heal_objects: bool = True) -> None:
         """Boot the background data scanner (reference initDataScanner,
@@ -162,12 +175,35 @@ class S3Server:
     async def _entry(self, request: web.Request) -> web.StreamResponse:
         request_id = uuid.uuid4().hex[:16].upper()
         path = urllib.parse.unquote(request.raw_path.split("?", 1)[0])
+        t0 = self.stats.begin()
+        resp = None
         try:
-            return await self._dispatch(request, path, request_id)
+            resp = await self._dispatch(request, path, request_id)
+            return resp
         except S3Error as e:
-            return self._error_response(e, path, request_id)
+            resp = self._error_response(e, path, request_id)
+            return resp
         except Exception as e:  # noqa: BLE001 - surface as S3 InternalError
-            return self._error_response(from_exception(e, path), path, request_id)
+            resp = self._error_response(from_exception(e, path), path, request_id)
+            return resp
+        finally:
+            status = resp.status if resp is not None else 500
+            api = request.get("api", request.method.lower())
+            self.stats.end(api, t0, status,
+                           rx=request.content_length or 0,
+                           tx=(resp.content_length or 0)
+                           if resp is not None else 0)
+            # Trace record only when someone is watching
+            # (cmd/handler-utils.go:362-364 zero-overhead contract).
+            if self.trace_bus.has_subscribers:
+                import time as _time
+
+                self.trace_bus.publish({
+                    "time": _time.time(), "api": api,
+                    "method": request.method, "path": path,
+                    "status": status, "requestId": request_id,
+                    "remote": request.remote,
+                })
 
     def _error_response(self, e: S3Error, resource: str, request_id: str):
         body = xmlutil.error_xml(e.api.code, e.message, resource, request_id, e.extra)
@@ -178,6 +214,18 @@ class S3Server:
 
     async def _dispatch(self, request: web.Request, path: str,
                         request_id: str) -> web.StreamResponse:
+        # ---------- health probes: unauthenticated (healthcheck-router) ----
+        if path.startswith("/minio/health/"):
+            request["api"] = "healthcheck"
+            kind = path.rsplit("/", 1)[-1]
+            if kind == "live":
+                return web.Response(status=200)
+            if kind in ("ready", "cluster"):
+                loop = asyncio.get_running_loop()
+                h = await loop.run_in_executor(None, self.obj.health)
+                return web.Response(status=200 if h.get("healthy") else 503)
+            raise S3Error("MethodNotAllowed", resource=path)
+
         query_items = [(k, v) for k, v in urllib.parse.parse_qsl(
             request.query_string, keep_blank_values=True)]
         q = dict(query_items)
@@ -210,6 +258,25 @@ class S3Server:
                      or q.get("X-Amz-Security-Token", ""))
             if not self.iam.verify_session_token(identity.access_key, token):
                 raise S3Error("InvalidToken")
+
+        # ---------- admin + metrics planes (signed requests only) ----------
+        if path.startswith("/minio/"):
+            from minio_tpu.admin.handlers import ADMIN_PREFIX
+
+            if path.startswith(ADMIN_PREFIX):
+                request["api"] = "admin." + path[len(ADMIN_PREFIX):].split(
+                    "/", 1)[0]
+                return await self.admin.handle(
+                    request, path[len(ADMIN_PREFIX):], identity)
+            if path == "/minio/v2/metrics/cluster":
+                request["api"] = "metrics"
+                self.admin._authorize(identity, "admin:Prometheus")
+                loop = asyncio.get_running_loop()
+                body = await loop.run_in_executor(
+                    None, collect_metrics, self.obj, self.stats,
+                    self.scanner.usage if self.scanner else None)
+                return web.Response(body=body, content_type="text/plain")
+            raise S3Error("MethodNotAllowed", resource=path)
 
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0]
@@ -249,6 +316,7 @@ class S3Server:
 
         # --- authorization (identity policies ∪ bucket policy) ---
         action = action_for(m, sub, bucket, key, request.headers)
+        request["api"] = action.split(":", 1)[-1]
         self._check_access(identity, action, bucket, key)
 
         # ---------- bucket config subresources ----------

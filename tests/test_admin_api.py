@@ -1,0 +1,220 @@
+"""Admin API tests: server info, data usage, heal, IAM CRUD over HTTP,
+config KV, metrics, trace stream, health probes (cmd/admin-handlers_test.go
+role)."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+import requests
+from aiohttp import web
+
+from tests.s3client import SigV4Client
+
+ACCESS = "adminroot"
+SECRET = "adminroot-secret"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    import asyncio
+
+    from minio_tpu.s3.server import build_server
+
+    root = tmp_path_factory.mktemp("drives")
+    srv = build_server([str(root / f"d{i}") for i in range(4)], ACCESS, SECRET)
+    port = _free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(srv.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(30)
+    yield f"http://127.0.0.1:{port}", srv
+    loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return SigV4Client(server[0], ACCESS, SECRET)
+
+
+def test_health_probes_unauthenticated(server):
+    base, _ = server
+    assert requests.get(f"{base}/minio/health/live").status_code == 200
+    assert requests.get(f"{base}/minio/health/ready").status_code == 200
+    assert requests.get(f"{base}/minio/health/cluster").status_code == 200
+
+
+def test_admin_requires_auth(server):
+    base, _ = server
+    r = requests.get(f"{base}/minio/admin/v3/info")
+    assert r.status_code == 403
+
+
+def test_server_info(client):
+    r = client.get("/minio/admin/v3/info")
+    assert r.status_code == 200, r.text
+    info = r.json()
+    assert info["mode"] == "online"
+    assert info["drivesOnline"] == 4 and info["drivesOffline"] == 0
+    assert len(info["drives"]) == 4
+    assert "uptime" in info and "stats" in info
+
+
+def test_heal_api(client):
+    assert client.put("/healbkt").status_code == 200
+    client.put("/healbkt/obj", data=b"heal me")
+    r = client.post("/minio/admin/v3/heal/healbkt",
+                    data=json.dumps({"dryRun": False}).encode())
+    assert r.status_code == 200, r.text
+    items = r.json()["items"]
+    assert any(i.get("object") == "obj" for i in items)
+    # Missing bucket -> 404.
+    r = client.post("/minio/admin/v3/heal/nosuchbucket")
+    assert r.status_code == 404
+
+
+def test_iam_crud_over_http(server, client):
+    base, _ = server
+    r = client.put("/minio/admin/v3/add-user", query={"accessKey": "webuser"},
+                   data=json.dumps({"secretKey": "webuser-secret1"}).encode())
+    assert r.status_code == 200, r.text
+    r = client.put("/minio/admin/v3/set-user-or-group-policy",
+                   query={"userOrGroup": "webuser", "policyName": "readwrite"})
+    assert r.status_code == 200, r.text
+    r = client.get("/minio/admin/v3/list-users")
+    assert "webuser" in r.json()
+    assert r.json()["webuser"]["policyName"] == ["readwrite"]
+
+    # The new user works over S3 and cannot reach admin APIs.
+    u = SigV4Client(base, "webuser", "webuser-secret1")
+    assert u.put("/userbkt").status_code == 200
+    assert u.get("/minio/admin/v3/info").status_code == 403
+
+    # Custom policy CRUD.
+    pol = json.dumps({"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Action": "s3:GetObject",
+         "Resource": "arn:aws:s3:::*"}]})
+    r = client.put("/minio/admin/v3/add-canned-policy",
+                   query={"name": "getonly"}, data=pol.encode())
+    assert r.status_code == 200, r.text
+    assert "getonly" in client.get(
+        "/minio/admin/v3/list-canned-policies").json()
+    assert client.delete("/minio/admin/v3/remove-canned-policy",
+                         query={"name": "getonly"}).status_code == 200
+
+    # Service accounts.
+    r = client.put("/minio/admin/v3/add-service-account",
+                   data=json.dumps({"parent": "webuser"}).encode())
+    sa = r.json()["credentials"]
+    svc = SigV4Client(base, sa["accessKey"], sa["secretKey"])
+    assert svc.put("/userbkt/from-svc", data=b"x").status_code == 200
+    assert client.delete("/minio/admin/v3/delete-service-account",
+                         query={"accessKey": sa["accessKey"]}).status_code == 200
+
+    r = client.delete("/minio/admin/v3/remove-user",
+                      query={"accessKey": "webuser"})
+    assert r.status_code == 200
+    assert u.put("/userbkt/x", data=b"y").status_code == 403
+
+
+def test_config_kv(client):
+    r = client.get("/minio/admin/v3/config-kv")
+    assert r.status_code == 200
+    cfg = r.json()
+    assert "scanner" in cfg and "api" in cfg
+
+    r = client.put("/minio/admin/v3/config-kv",
+                   data=json.dumps({"scanner": {"delay": "20"}}).encode())
+    assert r.status_code == 200
+    assert r.json()["restart"] == []  # scanner is dynamic
+    r = client.get("/minio/admin/v3/config-kv", query={"subsys": "scanner"})
+    assert r.json()["scanner"]["delay"] == "20"
+
+    # Unknown key rejected.
+    r = client.put("/minio/admin/v3/config-kv",
+                   data=json.dumps({"scanner": {"bogus": "1"}}).encode())
+    assert r.status_code == 400
+
+
+def test_data_usage_info(server, client):
+    _, srv = server
+    srv.start_scanner(interval=3600)  # manual cycles only
+    srv.scanner.scan_once()
+    r = client.get("/minio/admin/v3/datausageinfo")
+    assert r.status_code == 200
+    info = r.json()
+    assert "bucketsUsage" in info
+    assert info["objectsCount"] >= 1  # healbkt/obj from the heal test
+
+
+def test_prometheus_metrics(client):
+    r = client.get("/minio/v2/metrics/cluster")
+    assert r.status_code == 200
+    text = r.text
+    assert "minio_tpu_s3_requests_total" in text
+    assert "minio_tpu_cluster_disk_online_total 4" in text
+    assert "minio_tpu_cluster_health_status 1" in text
+    assert 'api="PutObject"' in text
+
+
+def test_stats_accumulate(server, client):
+    _, srv = server
+    before = srv.stats.snapshot()["apis"].get("GetObject", {}).get("count", 0)
+    client.get("/healbkt/obj")
+    # The stat lands in the handler's finally block, a hair after the
+    # client sees the response body — poll briefly.
+    deadline = time.time() + 2
+    while time.time() < deadline:
+        snap = srv.stats.snapshot()
+        if snap["apis"].get("GetObject", {}).get("count", 0) == before + 1:
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"GetObject stat not recorded: {snap['apis']}")
+
+
+def test_trace_stream(server, client):
+    base, srv = server
+    got = []
+
+    def consume():
+        with requests.get(f"{base}/minio/admin/v3/trace", stream=True,
+                          headers=SigV4Client(base, ACCESS, SECRET)._sign(
+                              "GET", "/minio/admin/v3/trace", {}, {}, b"")
+                          ) as r:
+            for line in r.iter_lines():
+                if line:
+                    got.append(json.loads(line))
+                    return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.3)  # let the subscription attach
+    client.get("/healbkt/obj")
+    t.join(timeout=5)
+    assert got, "no trace record received"
+    assert got[0]["api"] in ("GetObject", "admin.trace")
+    assert got[0]["status"] in (200, 206)
