@@ -558,14 +558,24 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
 
     def put_object_tags(self, bucket: str, obj: str, tags: str,
                         opts: ObjectOptions | None = None) -> ObjectInfo:
+        return self.put_object_metadata(
+            bucket, obj, {"x-amz-tagging": tags or None}, opts)
+
+    def put_object_metadata(self, bucket: str, obj: str,
+                            updates: dict[str, str | None],
+                            opts: ObjectOptions | None = None) -> ObjectInfo:
+        """Quorum metadata-only update of one version (reference
+        PutObjectMetadata/PutObjectTags, cmd/erasure-object.go:1031,1158).
+        A None value deletes the key."""
         opts = opts or ObjectOptions()
         fi = self._read_quorum_fileinfo(bucket, obj, opts.version_id)
         if fi.deleted:
             raise se.ObjectNotFound(bucket, obj)
-        if tags:
-            fi.metadata["x-amz-tagging"] = tags
-        else:
-            fi.metadata.pop("x-amz-tagging", None)
+        for k, v in updates.items():
+            if v is None:
+                fi.metadata.pop(k, None)
+            else:
+                fi.metadata[k] = v
         results = parallel_map(
             [
                 lambda d=d, f=_clone_for_drive(fi, i + 1): d.write_metadata(bucket, obj, f)

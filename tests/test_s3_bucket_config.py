@@ -308,3 +308,97 @@ def test_notification_end_to_end(server, client):
     bad = cfg.replace(mem.arn.encode(), b"arn:minio_tpu:sqs::nope:none")
     r = client.put("/evt", data=bad, query={"notification": ""})
     assert r.status_code == 400
+
+
+# ---------------- object lock: retention + legal hold ----------------
+
+def test_object_retention_and_legal_hold(client):
+    import datetime
+
+    # Versioned bucket with object lock.
+    assert client.put("/wormbkt", headers={
+        "x-amz-bucket-object-lock-enabled": "true"}).status_code == 200
+    client.put("/wormbkt/doc", data=b"important")
+
+    # Fetch the version id.
+    r = client.get("/wormbkt", query={"versions": ""})
+    vid = next(v.findtext("{*}VersionId") for v in
+               ET.fromstring(r.content).iter() if v.tag.endswith("Version"))
+
+    # No retention yet.
+    assert client.get("/wormbkt/doc",
+                      query={"retention": ""}).status_code == 404
+
+    until = (datetime.datetime.now(datetime.timezone.utc)
+             + datetime.timedelta(days=1)).strftime("%Y-%m-%dT%H:%M:%SZ")
+    ret = (f"<Retention><Mode>COMPLIANCE</Mode>"
+           f"<RetainUntilDate>{until}</RetainUntilDate></Retention>")
+    r = client.put("/wormbkt/doc", data=ret.encode(), query={"retention": ""})
+    assert r.status_code == 200, r.text
+    r = client.get("/wormbkt/doc", query={"retention": ""})
+    assert r.status_code == 200 and b"COMPLIANCE" in r.content
+
+    # Destroying the retained version is blocked (delete marker is fine).
+    r = client.delete("/wormbkt/doc", query={"versionId": vid})
+    assert r.status_code == 403
+    r = client.delete("/wormbkt/doc")          # marker: allowed
+    assert r.status_code == 204
+
+    # Tightening compliance retention is not allowed to shorten... but a
+    # second COMPLIANCE put while active is rejected by the WORM check.
+    r = client.put("/wormbkt/doc", data=ret.encode(),
+                   query={"retention": "", "versionId": vid})
+    assert r.status_code == 403
+
+    # Legal hold on a fresh object blocks deletion until released.
+    client.put("/wormbkt/held", data=b"hold me")
+    r2 = client.get("/wormbkt", query={"versions": ""})
+    hvid = next(v.findtext("{*}VersionId") for v in
+                ET.fromstring(r2.content).iter() if v.tag.endswith("Version")
+                and v.findtext("{*}Key") == "held")
+    assert client.put("/wormbkt/held", data=b"<LegalHold><Status>ON</Status></LegalHold>",
+                      query={"legal-hold": ""}).status_code == 200
+    r = client.get("/wormbkt/held", query={"legal-hold": ""})
+    assert b"ON" in r.content
+    assert client.delete("/wormbkt/held",
+                         query={"versionId": hvid}).status_code == 403
+    assert client.put("/wormbkt/held", data=b"<LegalHold><Status>OFF</Status></LegalHold>",
+                      query={"legal-hold": ""}).status_code == 200
+    assert client.delete("/wormbkt/held",
+                         query={"versionId": hvid}).status_code == 204
+
+
+def test_governance_bypass(client):
+    import datetime
+
+    assert client.put("/govbkt", headers={
+        "x-amz-bucket-object-lock-enabled": "true"}).status_code == 200
+    until = (datetime.datetime.now(datetime.timezone.utc)
+             + datetime.timedelta(days=1)).strftime("%Y-%m-%dT%H:%M:%SZ")
+    # Retention stamped at PUT via headers.
+    client.put("/govbkt/gdoc", data=b"gov", headers={
+        "x-amz-object-lock-mode": "GOVERNANCE",
+        "x-amz-object-lock-retain-until-date": until})
+    r = client.get("/govbkt", query={"versions": ""})
+    vid = next(v.findtext("{*}VersionId") for v in
+               ET.fromstring(r.content).iter() if v.tag.endswith("Version"))
+    assert client.delete("/govbkt/gdoc",
+                         query={"versionId": vid}).status_code == 403
+    # Governance yields to the bypass header (root has BypassGovernance).
+    r = client.delete("/govbkt/gdoc", query={"versionId": vid},
+                      headers={"x-amz-bypass-governance-retention": "true"})
+    assert r.status_code == 204
+
+
+def test_default_retention_from_bucket_config(client):
+    assert client.put("/defret", headers={
+        "x-amz-bucket-object-lock-enabled": "true"}).status_code == 200
+    cfg = (b"<ObjectLockConfiguration>"
+           b"<ObjectLockEnabled>Enabled</ObjectLockEnabled>"
+           b"<Rule><DefaultRetention><Mode>GOVERNANCE</Mode><Days>1</Days>"
+           b"</DefaultRetention></Rule></ObjectLockConfiguration>")
+    assert client.put("/defret", data=cfg,
+                      query={"object-lock": ""}).status_code == 200
+    client.put("/defret/auto", data=b"x")
+    r = client.get("/defret/auto", query={"retention": ""})
+    assert r.status_code == 200 and b"GOVERNANCE" in r.content
