@@ -1,0 +1,20 @@
+"""Server-side encryption (SSE-C / SSE-S3) — host AES-GCM.
+
+Role-equivalent of cmd/encryption-v1.go + cmd/crypto/ + the DARE stream
+format (secure-io/sio-go): authenticated streaming encryption applied
+before erasure coding, preserving the reference's ordering (encrypt →
+erasure → bitrot)."""
+
+from minio_tpu.crypto.sse import (
+    CHUNK_SIZE,
+    DecryptReader,
+    EncryptReader,
+    SSEError,
+    decrypted_range,
+    seal_key,
+    sse_headers_for,
+    unseal_key,
+)
+
+__all__ = ["EncryptReader", "DecryptReader", "seal_key", "unseal_key",
+           "SSEError", "CHUNK_SIZE", "decrypted_range", "sse_headers_for"]

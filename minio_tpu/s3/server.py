@@ -28,6 +28,7 @@ from minio_tpu.admin.metrics import collect_metrics
 from minio_tpu.admin.pubsub import PubSub
 from minio_tpu.admin.stats import HTTPStats
 from minio_tpu.bucket import objectlock as olock
+from minio_tpu.crypto import sse
 from minio_tpu.bucket.meta import BucketMetadataSys
 from minio_tpu.erasure import ErasureObjects
 from minio_tpu.erasure.types import CompletePart, ObjectOptions, ObjectToDelete
@@ -520,6 +521,8 @@ class S3Server:
 
         if m == "HEAD":
             info = await run(self.obj.get_object_info, bucket, key, opts)
+            if sse.META_ALGO in info.user_defined:
+                self._sse_unseal(request, bucket, key, info.user_defined)
             if _check_conditional(request, info):
                 return web.Response(status=304,
                                     headers={**hdr, "ETag": f'"{info.etag}"'})
@@ -714,6 +717,137 @@ class S3Server:
             hdr["x-amz-request-id"])
         return web.Response(body=body, content_type=XML_TYPE, headers=hdr)
 
+    # ------------------------------------------------------------------
+    # SSE (cmd/encryption-v1.go EncryptRequest/DecryptObjectInfo roles)
+    # ------------------------------------------------------------------
+
+    def _sse_master_key(self) -> bytes:
+        """SSE-S3 master key: MTPU_KMS_SECRET_KEY env, else derived from
+        the root secret (the reference requires a KMS; a derived local
+        master keeps SSE-S3 usable out of the box)."""
+        import hashlib as _hl
+
+        secret = os.environ.get("MTPU_KMS_SECRET_KEY",
+                                "mtpu-sse-s3:" + self.creds.secret_key)
+        return _hl.sha256(secret.encode()).digest()
+
+    def _maybe_encrypt_put(self, request, bucket: str, key: str, opts,
+                           spool, size: int):
+        """Wrap the upload stream in a DARE encryptor when SSE applies.
+        Returns (reader, stored_size)."""
+        import base64 as _b64
+        import hashlib as _hl
+
+        try:
+            ssec_key = sse.parse_ssec_headers(request.headers)
+        except sse.SSEError as e:
+            raise S3Error("InvalidArgument", str(e)) from None
+        sse_s3 = (request.headers.get(
+            "x-amz-server-side-encryption", "") == "AES256")
+        if not sse_s3 and ssec_key is None:
+            # Bucket default SSE config (PUT ?encryption).
+            if b"AES256" in self.bucket_meta.get(bucket).sse_xml:
+                sse_s3 = True
+        if ssec_key is None and not sse_s3:
+            return spool, size
+        if size < 0:
+            raise S3Error("MissingContentLength",
+                          "SSE requires a known content length")
+
+        object_key = os.urandom(32)
+        nonce = os.urandom(12)
+        aad = f"{bucket}/{key}"
+        if ssec_key is not None:
+            opts.user_defined[sse.META_ALGO] = "SSE-C"
+            opts.user_defined[sse.META_SEALED_KEY] = sse.seal_key(
+                object_key, ssec_key, aad)
+            opts.user_defined[sse.META_KEY_MD5] = _b64.b64encode(
+                _hl.md5(ssec_key).digest()).decode()
+        else:
+            opts.user_defined[sse.META_ALGO] = "SSE-S3"
+            opts.user_defined[sse.META_SEALED_KEY] = sse.seal_key(
+                object_key, self._sse_master_key(), aad)
+        opts.user_defined[sse.META_NONCE] = _b64.b64encode(nonce).decode()
+        opts.user_defined[sse.META_ACTUAL_SIZE] = str(size)
+        return (sse.EncryptReader(spool, object_key, nonce),
+                sse.encrypted_size(size))
+
+    def _sse_unseal(self, request, bucket: str, key: str, meta: dict,
+                    copy_source: bool = False) -> tuple:
+        """(object_key, nonce, actual_size) for an encrypted object;
+        verifies SSE-C key headers match."""
+        import base64 as _b64
+
+        algo = meta.get(sse.META_ALGO, "")
+        aad = f"{bucket}/{key}"
+        try:
+            if algo == "SSE-C":
+                ssec_key = sse.parse_ssec_headers(request.headers,
+                                                  copy_source=copy_source)
+                if ssec_key is None:
+                    raise S3Error("InvalidRequest",
+                                  "object is SSE-C encrypted: key required")
+                object_key = sse.unseal_key(
+                    meta[sse.META_SEALED_KEY], ssec_key, aad)
+            else:
+                object_key = sse.unseal_key(
+                    meta[sse.META_SEALED_KEY], self._sse_master_key(), aad)
+        except sse.SSEError as e:
+            raise S3Error("AccessDenied", str(e)) from None
+        nonce = _b64.b64decode(meta[sse.META_NONCE])
+        actual = int(meta.get(sse.META_ACTUAL_SIZE, "0"))
+        return object_key, nonce, actual
+
+    async def _open_object_stream(self, request, bucket, key, opts,
+                                  offset, length, run, copy_source=False):
+        """get_object with transparent SSE decryption. Returns
+        (info, iterator, plaintext_size) where info.size is the client-
+        visible size."""
+        pre = await run(self.obj.get_object_info, bucket, key, opts)
+        if sse.META_ALGO not in pre.user_defined:
+            if length < 0:
+                length = pre.size - offset
+            info, stream = await run(self.obj.get_object, bucket, key,
+                                     offset, length, opts)
+            return info, stream, pre.size
+        object_key, nonce, actual = self._sse_unseal(
+            request, bucket, key, pre.user_defined, copy_source=copy_source)
+        if length < 0:
+            length = actual - offset
+        if offset < 0 or length < 0 or offset + length > actual:
+            raise S3Error("InvalidRange", resource=f"/{bucket}/{key}")
+        if length == 0:
+            return pre, iter([]), actual
+        enc_off, enc_len, skip = sse.decrypted_range(offset, length, actual)
+        info, enc_stream = await run(self.obj.get_object, bucket, key,
+                                     enc_off, enc_len, opts)
+        dec = sse.DecryptReader(
+            enc_stream, object_key, nonce,
+            start_chunk=enc_off // sse.ENC_CHUNK,
+            total_chunks=sse.total_chunks(actual))
+
+        def trimmed():
+            remaining = length
+            drop = skip
+            for chunk in dec:
+                if drop:
+                    if len(chunk) <= drop:
+                        drop -= len(chunk)
+                        continue
+                    chunk = chunk[drop:]
+                    drop = 0
+                if len(chunk) >= remaining:
+                    yield chunk[:remaining]
+                    remaining = 0
+                    break
+                remaining -= len(chunk)
+                yield chunk
+            close = getattr(enc_stream, "close", None)
+            if close is not None:
+                close()
+
+        return info, trimmed(), actual
+
     def _apply_object_lock(self, request, bucket: str, opts) -> None:
         """Stamp retention/legal-hold from request headers, falling back to
         the bucket's default retention (putOpts from object lock config,
@@ -825,8 +959,11 @@ class S3Server:
         opts.user_defined = _metadata_headers(request)
         self._apply_object_lock(request, bucket, opts)
         spool, size = await self._spool_body(request, payload_hash, auth_sig)
+        reader, stored_size = self._maybe_encrypt_put(
+            request, bucket, key, opts, spool, size)
         try:
-            info = await run(self.obj.put_object, bucket, key, spool, size, opts)
+            info = await run(self.obj.put_object, bucket, key, reader,
+                             stored_size, opts)
         finally:
             spool.close()
         extra = {"ETag": f'"{info.etag}"'}
@@ -873,8 +1010,9 @@ class S3Server:
 
     async def _copy_object(self, request, bucket, key, src, opts, hdr, run):
         src_bucket, src_key, src_opts = _parse_copy_source(src)
-        info, stream = await run(self.obj.get_object, src_bucket, src_key,
-                                 0, -1, src_opts)
+        info, stream, src_visible = await self._open_object_stream(
+            request, src_bucket, src_key, src_opts, 0, -1, run,
+            copy_source=True)
         directive = request.headers.get("x-amz-metadata-directive", "COPY")
         user_defined = dict(info.user_defined)
         user_defined["content-type"] = info.content_type
@@ -885,12 +1023,18 @@ class S3Server:
             }
             if request.headers.get("Content-Type"):
                 user_defined["content-type"] = request.headers["Content-Type"]
+        # Strip source encryption bookkeeping; destination re-encrypts per
+        # its own headers/bucket config.
+        for k in (sse.META_ALGO, sse.META_SEALED_KEY, sse.META_NONCE,
+                  sse.META_KEY_MD5, sse.META_ACTUAL_SIZE):
+            user_defined.pop(k, None)
         opts.user_defined = user_defined
 
-        reader = _IterReader(stream)
+        reader, stored_size = self._maybe_encrypt_put(
+            request, bucket, key, opts, _IterReader(stream), src_visible)
         try:
             new_info = await run(self.obj.put_object, bucket, key, reader,
-                                 info.size, opts)
+                                 stored_size, opts)
         finally:
             # put_object reads exactly info.size bytes, leaving the source
             # generator paused before its cleanup — drive close() so shard
@@ -909,23 +1053,25 @@ class S3Server:
             # Range needs the size before the read; costs one extra quorum
             # metadata round, paid only by range requests.
             pre = await run(self.obj.get_object_info, bucket, key, opts)
-            offset, length = _parse_range(rng, pre.size)
+            visible = int(pre.user_defined.get(sse.META_ACTUAL_SIZE,
+                                               pre.size))
+            offset, length = _parse_range(rng, visible)
             status = 206
         else:
             offset, length = 0, -1
-        info, stream = await run(self.obj.get_object, bucket, key,
-                                 offset, length, opts)
+        info, stream, visible = await self._open_object_stream(
+            request, bucket, key, opts, offset, length, run)
         not_modified = _check_conditional(request, info)
         if not_modified:
             return web.Response(status=304, headers={
                 **hdr, "ETag": f'"{info.etag}"',
             })
         if length < 0:
-            length = info.size
+            length = visible
         headers = {**hdr, **_object_headers(info)}
         headers["Content-Length"] = str(length)
         if status == 206:
-            headers["Content-Range"] = f"bytes {offset}-{offset + length - 1}/{info.size}"
+            headers["Content-Range"] = f"bytes {offset}-{offset + length - 1}/{visible}"
         resp = web.StreamResponse(status=status, headers=headers)
         await resp.prepare(request)
         loop = asyncio.get_running_loop()
@@ -1026,13 +1172,17 @@ def _parse_copy_source(src: str):
 
 
 def _object_headers(info) -> dict:
+    size = info.size
+    if sse.META_ACTUAL_SIZE in info.user_defined:
+        size = int(info.user_defined[sse.META_ACTUAL_SIZE])
     h = {
         "ETag": f'"{info.etag}"',
         "Last-Modified": _http_time(info.mod_time),
         "Content-Type": info.content_type or "binary/octet-stream",
         "Accept-Ranges": "bytes",
-        "Content-Length": str(info.size),
+        "Content-Length": str(size),
     }
+    h.update(sse.sse_headers_for(info.user_defined))
     if info.version_id:
         h["x-amz-version-id"] = info.version_id
     for k, v in info.user_defined.items():
