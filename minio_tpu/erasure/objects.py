@@ -248,6 +248,9 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         if len(first_block) <= INLINE_DATA_LIMIT and (
             size < 0 and len(first_block) < self.block_size or 0 <= size <= INLINE_DATA_LIMIT
         ):
+            if 0 <= size != len(first_block):
+                raise se.IncompleteBody(
+                    bucket, obj, f"got {len(first_block)} of {size} bytes")
             md5.update(first_block)
             fi.size = len(first_block)
             fi.inline_data = bytes(first_block)

@@ -1,0 +1,5 @@
+"""FS backend — single-directory, non-erasure ObjectLayer."""
+
+from minio_tpu.fs.backend import FSObjects
+
+__all__ = ["FSObjects"]

@@ -1,0 +1,530 @@
+"""FSObjects — the plain-filesystem ObjectLayer.
+
+Role-equivalent of cmd/fs-v1.go (NewFSObjectLayer:120) + fs-v1-multipart.go
++ fs-v1-metadata.go: one directory per bucket, one file per object, a JSON
+metadata sidecar per object (the fs.json role) kept under the hidden
+`.mtpu.sys` tree, atomic temp-file+rename commits, and its own multipart
+implementation that concatenates parts at complete time. No versioning and
+no healing — exactly the reference's FS-mode feature set; heal calls
+return empty results rather than erroring so admin tooling works
+uniformly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+import uuid
+from typing import BinaryIO, Iterator
+
+from minio_tpu.erasure.types import (
+    BucketInfo,
+    CompletePart,
+    DeletedObject,
+    ListObjectsInfo,
+    ListObjectVersionsInfo,
+    MultipartInfo,
+    ObjectInfo,
+    ObjectOptions,
+    ObjectToDelete,
+    PartInfoResult,
+)
+from minio_tpu.erasure.healing import HealResultItem
+from minio_tpu.utils import errors as se
+
+SYS = ".mtpu.sys"
+MIN_PART_SIZE = 5 << 20
+
+
+def _validate_bucket_name(bucket: str) -> None:
+    if not (3 <= len(bucket) <= 63) or bucket != bucket.lower() or "/" in bucket:
+        raise se.BucketNameInvalid(bucket)
+    if bucket.startswith((".", "-")) or bucket.endswith("-"):
+        raise se.BucketNameInvalid(bucket)
+    if not all(c.isalnum() or c in ".-" for c in bucket):
+        raise se.BucketNameInvalid(bucket)
+
+
+class FSObjects:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        os.makedirs(self._sys("tmp"), exist_ok=True)
+        os.makedirs(self._sys("multipart"), exist_ok=True)
+        os.makedirs(self._sys("meta"), exist_ok=True)
+        os.makedirs(self._sys("config"), exist_ok=True)
+
+    # -- paths --
+
+    def _sys(self, *parts: str) -> str:
+        return os.path.join(self.root, SYS, *parts)
+
+    def _bucket_dir(self, bucket: str) -> str:
+        return os.path.join(self.root, bucket)
+
+    def _obj_path(self, bucket: str, obj: str) -> str:
+        p = os.path.normpath(os.path.join(self._bucket_dir(bucket), obj))
+        if not p.startswith(self._bucket_dir(bucket) + os.sep):
+            raise se.ObjectNameInvalid(bucket, obj)
+        return p
+
+    def _meta_path(self, bucket: str, obj: str) -> str:
+        return self._sys("meta", bucket, obj + ".json")
+
+    def _check_bucket(self, bucket: str) -> None:
+        if bucket == SYS or not os.path.isdir(self._bucket_dir(bucket)):
+            raise se.BucketNotFound(bucket)
+
+    # -- sys-config store (same contract as the erasure quorum store) --
+
+    def read_sys_config(self, path: str) -> bytes:
+        try:
+            with open(self._sys("config", path), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise se.FileNotFound(path) from None
+
+    def write_sys_config(self, path: str, data: bytes) -> None:
+        fp = self._sys("config", path)
+        os.makedirs(os.path.dirname(fp), exist_ok=True)
+        tmp = fp + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, fp)
+
+    def delete_sys_config(self, path: str) -> None:
+        try:
+            os.remove(self._sys("config", path))
+        except FileNotFoundError:
+            raise se.FileNotFound(path) from None
+
+    def list_sys_config(self, prefix: str = "") -> list[str]:
+        base = self._sys("config")
+        out = []
+        for dirpath, _, files in os.walk(base):
+            for name in files:
+                rel = os.path.relpath(os.path.join(dirpath, name), base)
+                rel = rel.replace(os.sep, "/")
+                if rel.startswith(prefix):
+                    out.append(rel)
+        return sorted(out)
+
+    # -- buckets --
+
+    def make_bucket(self, bucket: str,
+                    opts: ObjectOptions | None = None) -> None:
+        _validate_bucket_name(bucket)
+        d = self._bucket_dir(bucket)
+        if os.path.isdir(d):
+            raise se.BucketExists(bucket)
+        os.makedirs(d)
+
+    def get_bucket_info(self, bucket: str) -> BucketInfo:
+        self._check_bucket(bucket)
+        st = os.stat(self._bucket_dir(bucket))
+        return BucketInfo(bucket, st.st_mtime)
+
+    def list_buckets(self) -> list[BucketInfo]:
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            if name == SYS:
+                continue
+            d = os.path.join(self.root, name)
+            if os.path.isdir(d):
+                out.append(BucketInfo(name, os.stat(d).st_mtime))
+        return out
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        self._check_bucket(bucket)
+        d = self._bucket_dir(bucket)
+        if not force and any(os.scandir(d)):
+            raise se.BucketNotEmpty(bucket)
+        shutil.rmtree(d)
+        shutil.rmtree(self._sys("meta", bucket), ignore_errors=True)
+
+    # -- metadata sidecar --
+
+    def _load_meta(self, bucket: str, obj: str) -> dict:
+        try:
+            with open(self._meta_path(bucket, obj)) as f:
+                return json.load(f)
+        except (FileNotFoundError, ValueError):
+            return {}
+
+    def _store_meta(self, bucket: str, obj: str, meta: dict) -> None:
+        fp = self._meta_path(bucket, obj)
+        os.makedirs(os.path.dirname(fp), exist_ok=True)
+        tmp = fp + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, fp)
+
+    # -- objects --
+
+    def put_object(self, bucket: str, obj: str, data: BinaryIO,
+                   size: int = -1,
+                   opts: ObjectOptions | None = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        self._check_bucket(bucket)
+        if not obj or obj.endswith("/"):
+            raise se.ObjectNameInvalid(bucket, obj)
+        tmp = self._sys("tmp", uuid.uuid4().hex)
+        md5 = hashlib.md5()
+        total = 0
+        with open(tmp, "wb") as f:
+            while True:
+                want = 1 << 20 if size < 0 else min(1 << 20, size - total)
+                if want == 0:
+                    break
+                chunk = data.read(want)
+                if not chunk:
+                    break
+                md5.update(chunk)
+                f.write(chunk)
+                total += len(chunk)
+            f.flush()
+            os.fsync(f.fileno())
+        if 0 <= size != total:
+            os.remove(tmp)
+            raise se.IncompleteBody(bucket, obj, f"got {total} of {size}")
+        dst = self._obj_path(bucket, obj)
+        if os.path.isdir(dst):
+            os.remove(tmp)
+            raise se.ObjectExistsAsDirectory(bucket, obj)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        os.replace(tmp, dst)
+        etag = md5.hexdigest()
+        mod_time = opts.mod_time or time.time()
+        os.utime(dst, (mod_time, mod_time))
+        meta = {"etag": etag, "metadata": dict(opts.user_defined)}
+        self._store_meta(bucket, obj, meta)
+        return ObjectInfo(bucket=bucket, name=obj, mod_time=mod_time,
+                          size=total, etag=etag,
+                          user_defined=dict(opts.user_defined))
+
+    def get_object_info(self, bucket: str, obj: str,
+                        opts: ObjectOptions | None = None) -> ObjectInfo:
+        self._check_bucket(bucket)
+        p = self._obj_path(bucket, obj)
+        if not os.path.isfile(p):
+            raise se.ObjectNotFound(bucket, obj)
+        st = os.stat(p)
+        meta = self._load_meta(bucket, obj)
+        ud = meta.get("metadata", {})
+        return ObjectInfo(bucket=bucket, name=obj, mod_time=st.st_mtime,
+                          size=st.st_size, etag=meta.get("etag", ""),
+                          content_type=ud.get("content-type", ""),
+                          user_defined=ud)
+
+    def get_object(self, bucket: str, obj: str, offset: int = 0,
+                   length: int = -1, opts: ObjectOptions | None = None
+                   ) -> tuple[ObjectInfo, Iterator[bytes]]:
+        info = self.get_object_info(bucket, obj, opts)
+        if length < 0:
+            length = info.size - offset
+        if offset < 0 or length < 0 or offset + length > info.size:
+            raise se.InvalidRange(bucket, obj)
+        p = self._obj_path(bucket, obj)
+
+        def gen() -> Iterator[bytes]:
+            with open(p, "rb") as f:
+                f.seek(offset)
+                remaining = length
+                while remaining > 0:
+                    chunk = f.read(min(1 << 20, remaining))
+                    if not chunk:
+                        return
+                    remaining -= len(chunk)
+                    yield chunk
+
+        return info, gen()
+
+    def delete_object(self, bucket: str, obj: str,
+                      opts: ObjectOptions | None = None) -> ObjectInfo:
+        self._check_bucket(bucket)
+        p = self._obj_path(bucket, obj)
+        if not os.path.isfile(p):
+            raise se.ObjectNotFound(bucket, obj)
+        os.remove(p)
+        try:
+            os.remove(self._meta_path(bucket, obj))
+        except FileNotFoundError:
+            pass
+        self._prune(os.path.dirname(p), self._bucket_dir(bucket))
+        return ObjectInfo(bucket=bucket, name=obj)
+
+    def _prune(self, d: str, stop: str) -> None:
+        while d != stop:
+            try:
+                os.rmdir(d)
+            except OSError:
+                return
+            d = os.path.dirname(d)
+
+    def delete_objects(self, bucket: str, objects: list[ObjectToDelete],
+                       opts: ObjectOptions | None = None
+                       ) -> list[DeletedObject | Exception]:
+        out: list[DeletedObject | Exception] = []
+        for o in objects:
+            try:
+                self.delete_object(bucket, o.object_name, opts)
+                out.append(DeletedObject(object_name=o.object_name))
+            except Exception as e:  # noqa: BLE001
+                out.append(e)
+        return out
+
+    # -- metadata updates (tags / retention share this path) --
+
+    def put_object_metadata(self, bucket: str, obj: str, updates,
+                            opts: ObjectOptions | None = None) -> ObjectInfo:
+        info = self.get_object_info(bucket, obj, opts)
+        meta = self._load_meta(bucket, obj)
+        ud = meta.setdefault("metadata", {})
+        for k, v in updates.items():
+            if v is None:
+                ud.pop(k, None)
+            else:
+                ud[k] = v
+        self._store_meta(bucket, obj, meta)
+        info.user_defined = ud
+        return info
+
+    def put_object_tags(self, bucket: str, obj: str, tags: str,
+                        opts: ObjectOptions | None = None) -> ObjectInfo:
+        return self.put_object_metadata(
+            bucket, obj, {"x-amz-tagging": tags or None}, opts)
+
+    def get_object_tags(self, bucket: str, obj: str,
+                        opts: ObjectOptions | None = None) -> str:
+        return self.get_object_info(bucket, obj, opts).user_defined.get(
+            "x-amz-tagging", "")
+
+    def delete_object_tags(self, bucket: str, obj: str,
+                           opts: ObjectOptions | None = None) -> ObjectInfo:
+        return self.put_object_tags(bucket, obj, "", opts)
+
+    # -- listing --
+
+    def _walk_keys(self, bucket: str) -> Iterator[str]:
+        """All keys in strict lexicographic order. A directory-grouped walk
+        would order "top1" before "a/1"; S3 listing is byte-ordered on the
+        full key, so entries are merged name-wise ("a/" sorts by the
+        expanded child keys)."""
+        base = self._bucket_dir(bucket)
+
+        def _walk(d: str, prefix: str) -> Iterator[str]:
+            entries = sorted(os.scandir(d),
+                             key=lambda e: e.name + ("/" if e.is_dir() else ""))
+            for e in entries:
+                if e.is_dir():
+                    yield from _walk(e.path, prefix + e.name + "/")
+                else:
+                    yield prefix + e.name
+
+        keys = list(_walk(base, ""))
+        keys.sort()
+        yield from keys
+
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000
+                     ) -> ListObjectsInfo:
+        self._check_bucket(bucket)
+        res = ListObjectsInfo()
+        prefixes: set[str] = set()
+        for key in self._walk_keys(bucket):
+            if not key.startswith(prefix) or key <= marker:
+                continue
+            if delimiter:
+                rest = key[len(prefix):]
+                if delimiter in rest:
+                    prefixes.add(prefix + rest.split(delimiter, 1)[0]
+                                 + delimiter)
+                    continue
+            if len(res.objects) >= max_keys:
+                res.is_truncated = True
+                res.next_marker = res.objects[-1].name
+                break
+            res.objects.append(self.get_object_info(bucket, key))
+        res.prefixes = sorted(prefixes)
+        return res
+
+    def list_object_versions(self, bucket: str, prefix: str = "",
+                             marker: str = "", version_marker: str = "",
+                             delimiter: str = "", max_keys: int = 1000
+                             ) -> ListObjectVersionsInfo:
+        flat = self.list_objects(bucket, prefix, marker, delimiter, max_keys)
+        return ListObjectVersionsInfo(
+            is_truncated=flat.is_truncated, next_marker=flat.next_marker,
+            objects=flat.objects, prefixes=flat.prefixes)
+
+    # -- multipart (cmd/fs-v1-multipart.go) --
+
+    def _mp_dir(self, upload_id: str) -> str:
+        return self._sys("multipart", upload_id)
+
+    def new_multipart_upload(self, bucket: str, obj: str,
+                             opts: ObjectOptions | None = None) -> str:
+        opts = opts or ObjectOptions()
+        self._check_bucket(bucket)
+        upload_id = uuid.uuid4().hex
+        d = self._mp_dir(upload_id)
+        os.makedirs(d)
+        with open(os.path.join(d, "session.json"), "w") as f:
+            json.dump({"bucket": bucket, "object": obj,
+                       "initiated": time.time(),
+                       "metadata": dict(opts.user_defined)}, f)
+        return upload_id
+
+    def _mp_session(self, bucket: str, obj: str, upload_id: str) -> dict:
+        try:
+            with open(os.path.join(self._mp_dir(upload_id),
+                                   "session.json")) as f:
+                s = json.load(f)
+        except FileNotFoundError:
+            raise se.InvalidUploadID(bucket, obj, upload_id) from None
+        if s["bucket"] != bucket or s["object"] != obj:
+            raise se.InvalidUploadID(bucket, obj, upload_id)
+        return s
+
+    def put_object_part(self, bucket: str, obj: str, upload_id: str,
+                        part_number: int, data: BinaryIO, size: int = -1
+                        ) -> PartInfoResult:
+        self._mp_session(bucket, obj, upload_id)
+        md5 = hashlib.md5()
+        total = 0
+        fp = os.path.join(self._mp_dir(upload_id), f"part.{part_number}")
+        with open(fp, "wb") as f:
+            while True:
+                want = 1 << 20 if size < 0 else min(1 << 20, size - total)
+                if want == 0:
+                    break
+                chunk = data.read(want)
+                if not chunk:
+                    break
+                md5.update(chunk)
+                f.write(chunk)
+                total += len(chunk)
+        if 0 <= size != total:
+            os.remove(fp)
+            raise se.IncompleteBody(bucket, obj, f"got {total} of {size}")
+        return PartInfoResult(part_number=part_number, etag=md5.hexdigest(),
+                              size=total, actual_size=total,
+                              last_modified=time.time())
+
+    def list_parts(self, bucket: str, obj: str, upload_id: str,
+                   part_marker: int = 0, max_parts: int = 1000
+                   ) -> list[PartInfoResult]:
+        self._mp_session(bucket, obj, upload_id)
+        d = self._mp_dir(upload_id)
+        out = []
+        for name in os.listdir(d):
+            if not name.startswith("part."):
+                continue
+            n = int(name.split(".", 1)[1])
+            if n <= part_marker:
+                continue
+            fp = os.path.join(d, name)
+            st = os.stat(fp)
+            md5 = hashlib.md5()
+            with open(fp, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    md5.update(chunk)
+            out.append(PartInfoResult(part_number=n, etag=md5.hexdigest(),
+                                      size=st.st_size,
+                                      actual_size=st.st_size,
+                                      last_modified=st.st_mtime))
+        return sorted(out, key=lambda p: p.part_number)[:max_parts]
+
+    def list_multipart_uploads(self, bucket: str, prefix: str = "",
+                               max_uploads: int = 1000
+                               ) -> list[MultipartInfo]:
+        self._check_bucket(bucket)
+        out = []
+        base = self._sys("multipart")
+        for uid in os.listdir(base):
+            try:
+                with open(os.path.join(base, uid, "session.json")) as f:
+                    s = json.load(f)
+            except (FileNotFoundError, ValueError):
+                continue
+            if s["bucket"] == bucket and s["object"].startswith(prefix):
+                out.append(MultipartInfo(
+                    bucket=bucket, object=s["object"], upload_id=uid,
+                    initiated=s.get("initiated", 0.0),
+                    user_defined=s.get("metadata", {})))
+        return sorted(out, key=lambda u: (u.object, u.initiated)
+                      )[:max_uploads]
+
+    def abort_multipart_upload(self, bucket: str, obj: str,
+                               upload_id: str) -> None:
+        self._mp_session(bucket, obj, upload_id)
+        shutil.rmtree(self._mp_dir(upload_id), ignore_errors=True)
+
+    def complete_multipart_upload(self, bucket: str, obj: str,
+                                  upload_id: str, parts: list[CompletePart],
+                                  opts: ObjectOptions | None = None
+                                  ) -> ObjectInfo:
+        session = self._mp_session(bucket, obj, upload_id)
+        d = self._mp_dir(upload_id)
+        listed = {p.part_number: p for p in
+                  self.list_parts(bucket, obj, upload_id)}
+        md5_of_md5s = hashlib.md5()
+        total = 0
+        last = 0
+        for i, cp in enumerate(parts):
+            if cp.part_number <= last:
+                raise se.InvalidPart(bucket, obj, "parts out of order")
+            last = cp.part_number
+            have = listed.get(cp.part_number)
+            if have is None or have.etag != cp.etag.strip('"'):
+                raise se.InvalidPart(bucket, obj, f"part {cp.part_number}")
+            if i < len(parts) - 1 and have.size < MIN_PART_SIZE:
+                raise se.PartTooSmall(bucket, obj, f"part {cp.part_number}")
+            md5_of_md5s.update(bytes.fromhex(have.etag))
+            total += have.size
+        tmp = self._sys("tmp", uuid.uuid4().hex)
+        with open(tmp, "wb") as out:
+            for cp in parts:
+                with open(os.path.join(d, f"part.{cp.part_number}"),
+                          "rb") as f:
+                    shutil.copyfileobj(f, out, 1 << 20)
+            out.flush()
+            os.fsync(out.fileno())
+        dst = self._obj_path(bucket, obj)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        os.replace(tmp, dst)
+        etag = f"{md5_of_md5s.hexdigest()}-{len(parts)}"
+        self._store_meta(bucket, obj, {
+            "etag": etag, "metadata": session.get("metadata", {})})
+        shutil.rmtree(d, ignore_errors=True)
+        return ObjectInfo(bucket=bucket, name=obj, size=total, etag=etag,
+                          mod_time=time.time(),
+                          user_defined=session.get("metadata", {}))
+
+    # -- healing: FS has no redundancy; report cleanly (fs-v1.go HealObject
+    #    returns NotImplemented; empty results keep admin tooling uniform) --
+
+    def heal_bucket(self, bucket: str, dry_run: bool = False) -> HealResultItem:
+        self.get_bucket_info(bucket)
+        return HealResultItem(bucket=bucket)
+
+    def heal_object(self, bucket: str, obj: str, version_id: str = "",
+                    **kw) -> HealResultItem:
+        self.get_object_info(bucket, obj)
+        return HealResultItem(bucket=bucket, object=obj)
+
+    def heal_objects(self, bucket: str, prefix: str = "", **kw):
+        return iter(())
+
+    def health(self) -> dict:
+        return {"healthy": os.path.isdir(self.root),
+                "sets": [{"online": 1, "total": 1, "write_quorum": 1}]}
+
+    def all_drives(self):
+        return []
+
+    def close(self) -> None:
+        pass

@@ -151,9 +151,12 @@ def _register_backends() -> None:
     from minio_tpu.erasure.pools import ErasureServerPools
     from minio_tpu.erasure.sets import ErasureSets
 
+    from minio_tpu.fs.backend import FSObjects
+
     ObjectLayer.register(ErasureObjects)
     ObjectLayer.register(ErasureSets)
     ObjectLayer.register(ErasureServerPools)
+    ObjectLayer.register(FSObjects)
 
 
 _register_backends()

@@ -1252,6 +1252,15 @@ def build_server(drive_paths: list[str], access_key: str, secret_key: str,
     from minio_tpu.erasure.pools import ErasureServerPools
     from minio_tpu.erasure.sets import ErasureSets
 
+    # Single plain path -> FS backend (reference newObjectLayer: one
+    # endpoint means NewFSObjectLayer, cmd/server-main.go:557).
+    if len(drive_paths) == 1 and "://" not in drive_paths[0]:
+        from minio_tpu.fs import FSObjects
+
+        layer = FSObjects(drive_paths[0])
+        return S3Server(layer, sigv4.Credentials(access_key, secret_key),
+                        versioned_buckets=versioned)
+
     if any("://" in p for p in drive_paths):
         from minio_tpu.dist.cluster import ClusterNode
 
