@@ -1,0 +1,16 @@
+"""ctypes bindings for the native host kernels (native/mtpu_native.cc).
+
+The library is compiled on first import (g++, cached beside the source);
+every entry point has a pure-Python fallback so the framework runs — more
+slowly — without a toolchain. `available()` reports which path is active.
+"""
+
+from minio_tpu.native.lib import (
+    DirectWriter,
+    available,
+    pread,
+    sip256,
+    sip256_batch,
+)
+
+__all__ = ["available", "sip256", "sip256_batch", "DirectWriter", "pread"]

@@ -39,7 +39,18 @@ BITROT_KEY = bytes.fromhex(
     "5f6b65795f76315f3230323630373239"  # "_key_v1_20260729"
 )
 
-DEFAULT_ALGORITHM = "blake2b256"
+def _pick_default() -> str:
+    """sip256 (native C++ 4-lane SipHash kernel, native/mtpu_native.cc)
+    plays the reference's HighwayHash-256S default role
+    (cmd/xl-storage-format-v1.go:117-119); blake2b when no toolchain."""
+    try:
+        from minio_tpu.native import available
+
+        if available():
+            return "sip256"
+    except Exception:  # noqa: BLE001
+        pass
+    return "blake2b256"
 
 
 class _Blake2b256:
@@ -66,12 +77,28 @@ class _Xxh64:
         return xxhash.xxh64(data, seed=0x6D74_7075).digest()
 
 
+class _Sip256:
+    """Keyed 4-lane SipHash-256 — native C++ kernel with bit-exact Python
+    fallback (minio_tpu/native). The framework's HighwayHash analogue."""
+
+    digest_len = 32
+
+    @staticmethod
+    def digest(data: bytes) -> bytes:
+        from minio_tpu.native import sip256
+
+        return sip256(BITROT_KEY, data)
+
+
 _REGISTRY: dict[str, object] = {
     "blake2b256": _Blake2b256,
     "sha256": _Sha256,
+    "sip256": _Sip256,
 }
 if _HAVE_XXHASH:
     _REGISTRY["xxh64"] = _Xxh64
+
+DEFAULT_ALGORITHM = _pick_default()
 
 
 def register_algorithm(name: str, algo: object) -> None:

@@ -1,0 +1,257 @@
+// mtpu_native — the host-side native kernels of the framework.
+//
+// Role-equivalent of the reference's SIMD-assembly dependencies
+// (SURVEY §2.3): minio/highwayhash (the default bitrot hash; here a
+// 4-lane keyed SipHash-2-4 tree producing 256 bits, autovectorizable) and
+// ncw/directio + fdatasync (the O_DIRECT aligned file engine behind
+// xl-storage's CreateFile/ReadFileStream, cmd/xl-storage.go:1430,1318).
+//
+// Exposed as a C ABI for ctypes; built with: make (see native/Makefile).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// sip256: 4 parallel keyed SipHash-2-4 lanes over interleaved 8-byte words.
+//
+// Lane L consumes words L, L+4, L+8, ... of the message; each lane's key is
+// the 128-bit user key XOR a lane constant, so the lanes are independent
+// permutations. The four 64-bit lane digests concatenate to the 256-bit
+// bitrot digest. One pass over the data; the four lanes are independent
+// chains the compiler vectorizes across.
+// ---------------------------------------------------------------------------
+
+static inline uint64_t rotl64(uint64_t x, int b) {
+  return (x << b) | (x >> (64 - b));
+}
+
+struct SipState {
+  uint64_t v0, v1, v2, v3;
+};
+
+static inline void sip_init(SipState& s, uint64_t k0, uint64_t k1) {
+  s.v0 = k0 ^ 0x736f6d6570736575ULL;
+  s.v1 = k1 ^ 0x646f72616e646f6dULL;
+  s.v2 = k0 ^ 0x6c7967656e657261ULL;
+  s.v3 = k1 ^ 0x7465646279746573ULL;
+}
+
+static inline void sip_round(SipState& s) {
+  s.v0 += s.v1;
+  s.v1 = rotl64(s.v1, 13);
+  s.v1 ^= s.v0;
+  s.v0 = rotl64(s.v0, 32);
+  s.v2 += s.v3;
+  s.v3 = rotl64(s.v3, 16);
+  s.v3 ^= s.v2;
+  s.v0 += s.v3;
+  s.v3 = rotl64(s.v3, 21);
+  s.v3 ^= s.v0;
+  s.v2 += s.v1;
+  s.v1 = rotl64(s.v1, 17);
+  s.v1 ^= s.v2;
+  s.v2 = rotl64(s.v2, 32);
+}
+
+static inline void sip_absorb(SipState& s, uint64_t m) {
+  s.v3 ^= m;
+  sip_round(s);
+  sip_round(s);
+  s.v0 ^= m;
+}
+
+static inline uint64_t sip_final(SipState& s, uint64_t len_tag) {
+  sip_absorb(s, len_tag);
+  s.v2 ^= 0xff;
+  sip_round(s);
+  sip_round(s);
+  sip_round(s);
+  sip_round(s);
+  return s.v0 ^ s.v1 ^ s.v2 ^ s.v3;
+}
+
+static inline uint64_t load_le64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (x86-64 / aarch64)
+}
+
+void mtpu_sip256(const uint8_t* key32, const uint8_t* data, uint64_t len,
+                 uint8_t* out32) {
+  const uint64_t k0 = load_le64(key32);
+  const uint64_t k1 = load_le64(key32 + 8);
+  const uint64_t k2 = load_le64(key32 + 16);
+  const uint64_t k3 = load_le64(key32 + 24);
+
+  SipState lane[4];
+  // Distinct keys per lane: mix both key halves with lane constants.
+  sip_init(lane[0], k0, k1);
+  sip_init(lane[1], k0 ^ 0xa5a5a5a5a5a5a5a5ULL, k2);
+  sip_init(lane[2], k1 ^ 0x3c3c3c3c3c3c3c3cULL, k3);
+  sip_init(lane[3], k2 ^ 0x9696969696969696ULL, k3 ^ k0);
+
+  // Bulk: groups of 32 bytes feed one word to each lane.
+  uint64_t ngroups = len / 32;
+  const uint8_t* p = data;
+  for (uint64_t g = 0; g < ngroups; ++g, p += 32) {
+    sip_absorb(lane[0], load_le64(p));
+    sip_absorb(lane[1], load_le64(p + 8));
+    sip_absorb(lane[2], load_le64(p + 16));
+    sip_absorb(lane[3], load_le64(p + 24));
+  }
+
+  // Tail: remaining full words round-robin, final partial word padded.
+  uint64_t rem = len - ngroups * 32;
+  int lane_i = 0;
+  while (rem >= 8) {
+    sip_absorb(lane[lane_i++ & 3], load_le64(p));
+    p += 8;
+    rem -= 8;
+  }
+  if (rem) {
+    uint8_t pad[8] = {0};
+    std::memcpy(pad, p, rem);
+    sip_absorb(lane[lane_i & 3], load_le64(pad));
+  }
+
+  // Length tag binds total size into every lane (distinct per lane).
+  for (int i = 0; i < 4; ++i) {
+    uint64_t d = sip_final(lane[i], len ^ (0x0101010101010101ULL * i));
+    std::memcpy(out32 + 8 * i, &d, 8);
+  }
+}
+
+// Batched form: n chunks of chunk_len (last may be short via last_len),
+// digests written consecutively. Amortizes the ctypes call overhead over a
+// whole bitrot frame sequence.
+void mtpu_sip256_batch(const uint8_t* key32, const uint8_t* data,
+                       uint64_t chunk_len, uint64_t n_chunks,
+                       uint64_t last_len, uint8_t* out) {
+  for (uint64_t i = 0; i < n_chunks; ++i) {
+    uint64_t len = (i == n_chunks - 1) ? last_len : chunk_len;
+    mtpu_sip256(key32, data + i * chunk_len, len, out + i * 32);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Direct file engine (pkg/disk/directio_unix.go:25-40 + fdatasync role).
+//
+// Writer: buffered into an aligned 1 MiB block; full blocks written
+// O_DIRECT, the final partial block written after dropping O_DIRECT;
+// close performs fdatasync. Reader: plain pread (page cache reads are the
+// right default for shard reads; O_DIRECT reads hurt the heal path).
+// ---------------------------------------------------------------------------
+
+static const size_t kAlign = 4096;
+static const size_t kBufSize = 1 << 20;
+
+struct Writer {
+  int fd;
+  uint8_t* buf;
+  size_t fill;
+  int direct;  // O_DIRECT currently active
+};
+
+void* mtpu_writer_open(const char* path, int use_direct) {
+  int flags = O_WRONLY | O_CREAT | O_TRUNC;
+#ifdef O_DIRECT
+  if (use_direct) flags |= O_DIRECT;
+#else
+  use_direct = 0;
+#endif
+  int fd = open(path, flags, 0644);
+  if (fd < 0 && use_direct) {
+    // tmpfs and friends reject O_DIRECT: fall back transparently.
+    fd = open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    use_direct = 0;
+  }
+  if (fd < 0) return nullptr;
+  Writer* w = new Writer();
+  w->fd = fd;
+  w->fill = 0;
+  w->direct = use_direct;
+  if (posix_memalign(reinterpret_cast<void**>(&w->buf), kAlign, kBufSize)) {
+    close(fd);
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+static int writer_flush_aligned(Writer* w) {
+  size_t aligned = (w->fill / kAlign) * kAlign;
+  if (!aligned) return 0;
+  ssize_t n = write(w->fd, w->buf, aligned);
+  if (n != static_cast<ssize_t>(aligned)) return -1;
+  std::memmove(w->buf, w->buf + aligned, w->fill - aligned);
+  w->fill -= aligned;
+  return 0;
+}
+
+int64_t mtpu_writer_write(void* handle, const uint8_t* data, uint64_t len) {
+  Writer* w = static_cast<Writer*>(handle);
+  uint64_t total = 0;
+  while (total < len) {
+    size_t take = kBufSize - w->fill;
+    if (take > len - total) take = len - total;
+    std::memcpy(w->buf + w->fill, data + total, take);
+    w->fill += take;
+    total += take;
+    if (w->fill == kBufSize && writer_flush_aligned(w) != 0) return -1;
+  }
+  return static_cast<int64_t>(total);
+}
+
+int mtpu_writer_close(void* handle, int do_sync) {
+  Writer* w = static_cast<Writer*>(handle);
+  int rc = 0;
+  if (writer_flush_aligned(w) != 0) rc = -1;
+  if (w->fill) {
+#ifdef O_DIRECT
+    if (w->direct) {
+      // Final unaligned tail: drop O_DIRECT for the last write
+      // (the reference disables directio for the tail the same way).
+      int flags = fcntl(w->fd, F_GETFL);
+      fcntl(w->fd, F_SETFL, flags & ~O_DIRECT);
+    }
+#endif
+    if (write(w->fd, w->buf, w->fill) != static_cast<ssize_t>(w->fill))
+      rc = -1;
+  }
+#ifdef __linux__
+  if (do_sync && rc == 0 && fdatasync(w->fd) != 0) rc = -1;
+#else
+  if (do_sync && rc == 0 && fsync(w->fd) != 0) rc = -1;
+#endif
+  if (close(w->fd) != 0) rc = -1;
+  free(w->buf);
+  delete w;
+  return rc;
+}
+
+int64_t mtpu_pread(const char* path, uint8_t* out, uint64_t offset,
+                   uint64_t len) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  uint64_t total = 0;
+  while (total < len) {
+    ssize_t n = pread(fd, out + total, len - total, offset + total);
+    if (n < 0) {
+      close(fd);
+      return -1;
+    }
+    if (n == 0) break;
+    total += n;
+  }
+  close(fd);
+  return static_cast<int64_t>(total);
+}
+
+}  // extern "C"

@@ -106,7 +106,7 @@ def _mk_fi(vid="", size=100, deleted=False):
     fi.erasure = ErasureInfo(
         data_blocks=4, parity_blocks=2, block_size=1 << 20, index=1,
         distribution=list(range(1, 7)),
-        checksums=[ChecksumInfo(1, "blake2b256")],
+        checksums=[ChecksumInfo(1, bitrot.DEFAULT_ALGORITHM)],
     )
     return fi
 
