@@ -110,6 +110,11 @@ def get_algorithm(name: str):
     try:
         return _REGISTRY[name]
     except KeyError:
+        if name == "mxhash256":  # device hash: registered on first use
+            from minio_tpu.ops import mxhash
+
+            mxhash.register()
+            return _REGISTRY[name]
         raise se.CorruptedFormat(f"unknown bitrot algorithm {name!r}") from None
 
 

@@ -1,0 +1,166 @@
+"""mxhash256 — keyed GF(2) matmul tree hash on the TPU MXU.
+
+The device-side bitrot hash the erasure kernels fuse with encode/decode
+(the role HighwayHash plays host-side in the reference,
+cmd/bitrot-streaming.go:46: every shard chunk hashed while hot). The
+construction is a Merkle–Damgård chain whose compression function is one
+GF(2) bit-matrix contraction — exactly the op the MXU is fastest at, and
+the same int8 matmul shape the erasure codec uses, so hash and parity
+share a launch.
+
+    state_{i+1} = pack( [state_i bits ‖ block_i bits] @ K  mod 2 )
+
+K is a keyed [256 + BLOCK_BITS, 256] GF(2) matrix (full rank on the state
+columns so chaining never loses entropy), derived from BITROT_KEY by a
+seeded PRNG. Chunks are length-padded (a 1-bit terminator then zeros, with
+the bit-length folded into the final block) so distinct lengths can't
+collide trivially. The map is GF(2)-affine in the data: a corruption e
+escapes detection only if its bit-pattern lands in the kernel of the
+chain — probability 2^-256 for random bitrot, which is the threat model
+(cmd/bitrot.go: integrity against corruption, not an auth boundary).
+
+Pure jax.numpy: runs on CPU for tests and on TPU fused with the codec.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BLOCK_BYTES = 512               # one compression block
+BLOCK_BITS = BLOCK_BYTES * 8
+STATE_BITS = 256
+DIGEST_LEN = 32
+
+
+@functools.lru_cache(maxsize=1)
+def _key_matrix() -> np.ndarray:
+    """Keyed [STATE_BITS + BLOCK_BITS, STATE_BITS] GF(2) matrix with the
+    state block guaranteed invertible (keeps the chain a permutation of
+    the state for fixed data)."""
+    from minio_tpu.ops.bitrot import BITROT_KEY
+
+    seed = int.from_bytes(BITROT_KEY[:8], "little")
+    rng = np.random.Generator(np.random.PCG64(seed))
+    while True:
+        sk = rng.integers(0, 2, (STATE_BITS, STATE_BITS), dtype=np.uint8)
+        if _gf2_rank(sk.copy()) == STATE_BITS:
+            break
+    dk = rng.integers(0, 2, (BLOCK_BITS, STATE_BITS), dtype=np.uint8)
+    return np.concatenate([sk, dk], axis=0)
+
+
+def _gf2_rank(m: np.ndarray) -> int:
+    rank = 0
+    rows, cols = m.shape
+    for c in range(cols):
+        piv = None
+        for r in range(rank, rows):
+            if m[r, c]:
+                piv = r
+                break
+        if piv is None:
+            continue
+        m[[rank, piv]] = m[[piv, rank]]
+        mask = m[:, c].copy()
+        mask[rank] = 0
+        m ^= np.outer(mask, m[rank])
+        rank += 1
+    return rank
+
+
+def _device_key() -> jax.Array:
+    # NOTE: no lru_cache here — caching a jnp array created during a jit
+    # trace would leak the tracer; the numpy matrix is cached instead and
+    # becomes a folded constant in the jaxpr.
+    return jnp.asarray(_key_matrix(), dtype=jnp.int8)
+
+
+def _pad_blocks(n_bytes: int) -> int:
+    """Blocks after terminator+length padding."""
+    padded = n_bytes + 1 + 8
+    return -(-padded // BLOCK_BYTES)
+
+
+def _prepare(chunks: jax.Array, n_bytes: int) -> jax.Array:
+    """[B, L] u8 -> [B, nblocks, BLOCK_BITS] i8 bit tensor, padded."""
+    b, _ = chunks.shape
+    nblocks = _pad_blocks(n_bytes)
+    total = nblocks * BLOCK_BYTES
+    tail = np.zeros((b, total - n_bytes), dtype=np.uint8)
+    tail[:, 0] = 0x80                                  # terminator bit
+    lenb = np.frombuffer(np.uint64(n_bytes * 8).tobytes(), dtype=np.uint8)
+    tail[:, -8:] = lenb                                # bit-length, LE
+    padded = jnp.concatenate(
+        [chunks[:, :n_bytes], jnp.asarray(tail)], axis=1)
+    bits = (padded[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & jnp.uint8(1)
+    return bits.reshape(b, nblocks, BLOCK_BITS).astype(jnp.int8)
+
+
+@functools.partial(jax.jit, static_argnames=("n_bytes",))
+def mxhash256(chunks: jax.Array, n_bytes: int) -> jax.Array:
+    """Digest each row: chunks [B, n_bytes] u8 -> [B, 32] u8."""
+    key = _device_key()
+    blocks = _prepare(chunks, n_bytes)                 # [B, nb, BLOCK_BITS]
+    b = blocks.shape[0]
+    state = jnp.zeros((b, STATE_BITS), dtype=jnp.int8)
+
+    def step(state, block):
+        x = jnp.concatenate([state, block], axis=1)    # [B, S+BB]
+        y = jax.lax.dot_general(
+            x, key, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        return (y & 1).astype(jnp.int8), None
+
+    state, _ = jax.lax.scan(step, state, blocks.transpose(1, 0, 2))
+    bits = state.astype(jnp.uint8).reshape(b, DIGEST_LEN, 8)
+    packed = bits << jnp.arange(8, dtype=jnp.uint8)
+    return jax.lax.reduce(packed, np.uint8(0), jax.lax.bitwise_or, (2,))
+
+
+def digest_host(data: bytes) -> bytes:
+    """Single-chunk host entry point (registered in the bitrot registry)."""
+    arr = jnp.asarray(np.frombuffer(data, dtype=np.uint8))[None, :]
+    return bytes(np.asarray(mxhash256(arr, len(data)))[0])
+
+
+# --- fused erasure encode + bitrot ------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("k", "m"))
+def encode_with_bitrot(data: jax.Array, k: int, m: int
+                       ) -> tuple[jax.Array, jax.Array]:
+    """One launch computing parity AND per-shard chunk digests.
+
+    data [B, k, S] u8 -> (parity [B, m, S] u8, digests [B, k+m, 32] u8).
+    The digests are the mxhash256 of each shard's S bytes — the
+    [digest][chunk] records the streaming bitrot writer emits
+    (ops/bitrot.py), computed while the shards are resident on device
+    instead of re-read host-side (SURVEY §2.3: fuse the hash into the
+    same pass as encode).
+    """
+    from minio_tpu.ops import rs_xla
+
+    b, _, s = data.shape
+    parity = rs_xla.encode(data, k, m)
+    shards = jnp.concatenate([data, parity], axis=1)    # [B, n, S]
+    digests = mxhash256(shards.reshape(b * (k + m), s), s)
+    return parity, digests.reshape(b, k + m, DIGEST_LEN)
+
+
+class MXHash256:
+    """Bitrot registry adapter (ops/bitrot.py register_algorithm)."""
+
+    digest_len = DIGEST_LEN
+
+    @staticmethod
+    def digest(data: bytes) -> bytes:
+        return digest_host(data)
+
+
+def register() -> None:
+    from minio_tpu.ops import bitrot
+
+    bitrot.register_algorithm("mxhash256", MXHash256)

@@ -65,18 +65,29 @@ def _gf2_matmul(x: jax.Array, w: jax.Array, out_shards: int) -> jax.Array:
 
 
 @functools.lru_cache(maxsize=256)
-def _device_encode_weights(k: int, m: int) -> jax.Array:
-    """Device-resident i8 encode weights, uploaded once per geometry."""
-    return jnp.asarray(gf.encode_bitmatrix(k, m), dtype=jnp.int8)
+def _encode_weights_np(k: int, m: int) -> np.ndarray:
+    return np.ascontiguousarray(gf.encode_bitmatrix(k, m), dtype=np.int8)
 
 
 @functools.lru_cache(maxsize=4096)
+def _decode_weights_np(
+    k: int, n: int, survivors: tuple[int, ...], targets: tuple[int, ...]
+) -> np.ndarray:
+    return np.ascontiguousarray(
+        gf.decode_bitmatrix(k, n, survivors, targets), dtype=np.int8)
+
+
+# NOTE: only the numpy matrices are cached. Caching the jnp array would
+# leak a tracer whenever the first call happens inside another jit trace
+# (sharded paths); jnp.asarray of a cached ndarray folds to a constant.
+def _device_encode_weights(k: int, m: int) -> jax.Array:
+    return jnp.asarray(_encode_weights_np(k, m))
+
+
 def _device_decode_weights(
     k: int, n: int, survivors: tuple[int, ...], targets: tuple[int, ...]
 ) -> jax.Array:
-    """Device-resident i8 decode weights per failure pattern."""
-    return jnp.asarray(gf.decode_bitmatrix(k, n, survivors, targets),
-                       dtype=jnp.int8)
+    return jnp.asarray(_decode_weights_np(k, n, survivors, targets))
 
 
 def encode(data: jax.Array, k: int, m: int) -> jax.Array:
