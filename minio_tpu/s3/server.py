@@ -470,6 +470,44 @@ class S3Server:
                 return web.Response(body=olock.legal_hold_xml(status),
                                     content_type=XML_TYPE, headers=hdr)
 
+        # ----- S3 Select (reference SelectObjectContentHandler,
+        #       cmd/object-handlers.go:95; engine pkg/s3select) -----
+        if m == "POST" and "select" in q:
+            from minio_tpu.s3select import S3SelectRequest, run_select
+            from minio_tpu.s3select.sql import SelectError
+
+            body = await request.read()
+            try:
+                sel = S3SelectRequest.parse_xml(body)
+            except SelectError as e:
+                raise S3Error("InvalidArgument", str(e)) from None
+            info, stream, _size = await self._open_object_stream(
+                request, bucket, key, opts, 0, -1, run)
+            reader = _IterReader(stream)
+            resp = web.StreamResponse(status=200, headers={
+                **hdr, "Content-Type": "application/octet-stream"})
+            await resp.prepare(request)
+
+            def frames():
+                try:
+                    yield from run_select(reader, sel)
+                except SelectError:
+                    raise
+            it = iter(frames())
+            try:
+                while True:
+                    frame = await run(next, it, None)
+                    if frame is None:
+                        break
+                    await resp.write(frame)
+            except SelectError as e:
+                # Past the prepared response: close the stream; errors
+                # before any frame surface normally via the except path.
+                await resp.write_eof()
+                return resp
+            await resp.write_eof()
+            return resp
+
         # ----- multipart (reference cmd/erasure-multipart.go via
         #       object-handlers) -----
         if m == "POST" and "uploads" in q:
@@ -1108,11 +1146,34 @@ class S3Server:
 
 
 class _IterReader:
-    """File-like over a bytes iterator (bridges GET streams into put_object)."""
+    """File-like over a bytes iterator (bridges GET streams into
+    put_object and feeds TextIOWrapper in the select engine)."""
+
+    closed = False
 
     def __init__(self, it: Iterator[bytes]):
         self._it = iter(it)
         self._buf = bytearray()
+
+    def readable(self) -> bool:
+        return True
+
+    def writable(self) -> bool:
+        return False
+
+    def seekable(self) -> bool:
+        return False
+
+    def flush(self) -> None:
+        pass
+
+    def read1(self, n: int = -1) -> bytes:
+        return self.read(n)
+
+    def readinto(self, b) -> int:
+        data = self.read(len(b))
+        b[:len(data)] = data
+        return len(data)
 
     def read(self, n: int = -1) -> bytes:
         if n < 0:

@@ -1,0 +1,13 @@
+"""S3 Select — SQL over CSV/JSON objects.
+
+Role-equivalent of pkg/s3select (22k LoC in the reference: sql parser +
+evaluator, csv/json/parquet readers, RecordBatch responses). This build
+covers the working core: the S3 Select SQL dialect over CSV (headers,
+custom delimiters, gzip/bz2) and JSON (LINES/DOCUMENT), streamed back in
+the AWS event-stream framing real SDKs parse. Parquet needs an arrow
+reader this image doesn't ship — the reader interface is the seam.
+"""
+
+from minio_tpu.s3select.engine import S3SelectRequest, run_select
+
+__all__ = ["S3SelectRequest", "run_select"]

@@ -1,0 +1,187 @@
+"""The select engine: request XML → reader → sql → output events.
+
+Role-equivalent of pkg/s3select/select.go (NewS3Select:541 + Evaluate):
+parse the SelectObjectContent request document, stream the object through
+the chosen reader, filter/project with the SQL evaluator, and serialize
+matching records into the event-stream the handler writes back.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from minio_tpu.s3select import eventstream as es
+from minio_tpu.s3select import readers
+from minio_tpu.s3select.sql import MISSING, Evaluator, SelectError, parse
+
+RECORDS_FLUSH = 128 << 10     # flush a Records event at ~128 KiB
+
+
+def _strip(tag: str) -> str:
+    return tag.split("}")[-1]
+
+
+def _find(node, *path):
+    for name in path:
+        nxt = None
+        if node is None:
+            return None
+        for child in node:
+            if _strip(child.tag) == name:
+                nxt = child
+                break
+        node = nxt
+    return node
+
+
+def _text(node, *path, default: str = "") -> str:
+    n = _find(node, *path)
+    return (n.text or "").strip() if n is not None and n.text else default
+
+
+@dataclass
+class S3SelectRequest:
+    expression: str
+    input_format: str            # CSV | JSON
+    output_format: str           # CSV | JSON
+    compression: str = "NONE"
+    csv_header: str = "USE"
+    csv_delimiter: str = ","
+    csv_quote: str = '"'
+    csv_comments: str = ""
+    json_type: str = "LINES"
+    out_csv_delimiter: str = ","
+    out_record_delimiter: str = "\n"
+
+    @classmethod
+    def parse_xml(cls, body: bytes) -> "S3SelectRequest":
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise SelectError("malformed SelectObjectContent XML") from None
+        expr = _text(root, "Expression")
+        etype = _text(root, "ExpressionType", default="SQL").upper()
+        if etype != "SQL" or not expr:
+            raise SelectError("ExpressionType must be SQL with an Expression")
+        inp = _find(root, "InputSerialization")
+        out = _find(root, "OutputSerialization")
+        if inp is None or out is None:
+            raise SelectError("Input/OutputSerialization required")
+        if _find(inp, "Parquet") is not None:
+            raise SelectError("Parquet input needs an arrow reader — "
+                              "not available in this build")
+        in_csv = _find(inp, "CSV")
+        in_json = _find(inp, "JSON")
+        if in_csv is None and in_json is None:
+            raise SelectError("input must be CSV or JSON")
+        out_csv = _find(out, "CSV")
+        out_json = _find(out, "JSON")
+        return cls(
+            expression=expr,
+            input_format="CSV" if in_csv is not None else "JSON",
+            output_format="JSON" if out_json is not None else "CSV",
+            compression=_text(inp, "CompressionType", default="NONE"),
+            csv_header=_text(in_csv, "FileHeaderInfo", default="USE")
+            if in_csv is not None else "USE",
+            csv_delimiter=_text(in_csv, "FieldDelimiter", default=",")
+            if in_csv is not None else ",",
+            csv_quote=_text(in_csv, "QuoteCharacter", default='"')
+            if in_csv is not None else '"',
+            csv_comments=_text(in_csv, "Comments", default="")
+            if in_csv is not None else "",
+            json_type=_text(in_json, "Type", default="LINES")
+            if in_json is not None else "LINES",
+            out_csv_delimiter=_text(out_csv, "FieldDelimiter", default=",")
+            if out_csv is not None else ",",
+            out_record_delimiter=_text(out_csv, "RecordDelimiter",
+                                       default="\n")
+            if out_csv is not None else "\n",
+        )
+
+
+def _serialize(row: dict, req: S3SelectRequest, header_order: list[str]) -> str:
+    if req.output_format == "JSON":
+        # Positional _N keys duplicate named CSV columns — prefer names.
+        named = {k: v for k, v in row.items()
+                 if not (k.startswith("_") and k[1:].isdigit())}
+        use = named if named else row
+        clean = {k: (None if v is MISSING else v) for k, v in use.items()}
+        return json.dumps(clean, default=str) + "\n"
+    buf = io.StringIO()
+    w = csv.writer(buf, delimiter=req.out_csv_delimiter,
+                   lineterminator=req.out_record_delimiter)
+    keys = header_order or list(row)
+    w.writerow(["" if row.get(k) in (None, MISSING) else row.get(k)
+                for k in keys])
+    return buf.getvalue()
+
+
+def run_select(body_stream, request: S3SelectRequest
+               ) -> Iterator[bytes]:
+    """Evaluate and yield event-stream frames (Records*, Stats, End)."""
+    query = parse(request.expression)
+    ev = Evaluator(query)
+
+    raw = readers.decompress(body_stream, request.compression)
+    if request.input_format == "CSV":
+        rows = readers.csv_rows(
+            raw, header=request.csv_header, delimiter=request.csv_delimiter,
+            quote=request.csv_quote, comments=request.csv_comments)
+    else:
+        rows = readers.json_rows(raw, json_type=request.json_type)
+
+    scanned = 0
+    returned = 0
+    emitted = 0
+    pending = io.BytesIO()
+
+    def flush() -> bytes | None:
+        nonlocal returned
+        data = pending.getvalue()
+        if not data:
+            return None
+        pending.seek(0)
+        pending.truncate()
+        returned += len(data)
+        return es.records_message(data)
+
+    if ev.is_aggregate:
+        for row in rows:
+            scanned += 1
+            if ev.where_matches(row):
+                ev.accumulate(row)
+        out_row = ev.project({})
+        pending.write(_serialize(out_row, request, list(out_row)).encode())
+        msg = flush()
+        if msg:
+            yield msg
+    else:
+        header_order: list[str] = []
+        for row in rows:
+            scanned += 1
+            if not ev.where_matches(row):
+                continue
+            out = ev.project(row)
+            if not header_order:
+                header_order = [k for k in out
+                                if not (k.startswith("_")
+                                        and k[1:].isdigit())] or list(out)
+            pending.write(_serialize(out, request, header_order).encode())
+            emitted += 1
+            if pending.tell() >= RECORDS_FLUSH:
+                msg = flush()
+                if msg:
+                    yield msg
+            if query.limit is not None and emitted >= query.limit:
+                break
+        msg = flush()
+        if msg:
+            yield msg
+
+    yield es.stats_message(scanned, scanned, returned)
+    yield es.end_message()

@@ -1,0 +1,97 @@
+"""AWS event-stream framing for the SelectObjectContent response.
+
+The wire format S3 SDKs parse (pkg/s3select/message.go role): each
+message is
+
+    [4B total length][4B headers length][4B prelude CRC32]
+    [headers][payload][4B message CRC32]
+
+headers are (1B name-len, name, 1B type=7 string, 2B value-len, value).
+The response stream is Records* Stats End (Progress/Cont omitted — they
+are optional keep-alives).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+
+def _headers(pairs: dict[str, str]) -> bytes:
+    out = bytearray()
+    for name, value in pairs.items():
+        nb = name.encode()
+        vb = value.encode()
+        out += bytes([len(nb)]) + nb + b"\x07" + struct.pack(">H", len(vb)) + vb
+    return bytes(out)
+
+
+def encode_message(headers: dict[str, str], payload: bytes) -> bytes:
+    h = _headers(headers)
+    total = 12 + len(h) + len(payload) + 4
+    prelude = struct.pack(">II", total, len(h))
+    prelude_crc = struct.pack(">I", zlib.crc32(prelude))
+    body = prelude + prelude_crc + h + payload
+    return body + struct.pack(">I", zlib.crc32(body))
+
+
+def records_message(payload: bytes) -> bytes:
+    return encode_message({
+        ":message-type": "event",
+        ":event-type": "Records",
+        ":content-type": "application/octet-stream",
+    }, payload)
+
+
+def stats_message(bytes_scanned: int, bytes_processed: int,
+                  bytes_returned: int) -> bytes:
+    xml = (f'<Stats xmlns=""><BytesScanned>{bytes_scanned}</BytesScanned>'
+           f'<BytesProcessed>{bytes_processed}</BytesProcessed>'
+           f'<BytesReturned>{bytes_returned}</BytesReturned></Stats>'
+           ).encode()
+    return encode_message({
+        ":message-type": "event",
+        ":event-type": "Stats",
+        ":content-type": "text/xml",
+    }, xml)
+
+
+def end_message() -> bytes:
+    return encode_message({
+        ":message-type": "event",
+        ":event-type": "End",
+    }, b"")
+
+
+# --- decoding (tests + any client tooling) ----------------------------------
+
+def decode_stream(data: bytes) -> list[tuple[dict, bytes]]:
+    """Parse a concatenated event stream into (headers, payload) pairs,
+    verifying both CRCs."""
+    out = []
+    pos = 0
+    while pos < len(data):
+        total, hlen = struct.unpack_from(">II", data, pos)
+        pcrc = struct.unpack_from(">I", data, pos + 8)[0]
+        if zlib.crc32(data[pos:pos + 8]) != pcrc:
+            raise ValueError("prelude CRC mismatch")
+        msg = data[pos:pos + total]
+        mcrc = struct.unpack_from(">I", msg, total - 4)[0]
+        if zlib.crc32(msg[:total - 4]) != mcrc:
+            raise ValueError("message CRC mismatch")
+        hdr_raw = msg[12:12 + hlen]
+        headers = {}
+        i = 0
+        while i < len(hdr_raw):
+            nlen = hdr_raw[i]
+            name = hdr_raw[i + 1:i + 1 + nlen].decode()
+            i += 1 + nlen
+            assert hdr_raw[i] == 7
+            vlen = struct.unpack_from(">H", hdr_raw, i + 1)[0]
+            value = hdr_raw[i + 3:i + 3 + vlen].decode()
+            headers[name] = value
+            i += 3 + vlen
+        payload = msg[12 + hlen:total - 4]
+        out.append((headers, payload))
+        pos += total
+    return out

@@ -1,0 +1,98 @@
+"""Input readers: CSV and JSON record sources (pkg/s3select/csv, /json).
+
+Each reader yields dict rows. CSV rows also carry positional _1.._N keys
+(the dialect used when FileHeaderInfo is NONE/IGNORE); JSON documents
+flatten one level of nesting with dotted keys, matching how the reference
+addresses nested fields.
+"""
+
+from __future__ import annotations
+
+import bz2
+import csv
+import gzip
+import io
+import json
+from typing import Iterator
+
+from minio_tpu.s3select.sql import SelectError
+
+
+def decompress(stream: io.BufferedIOBase, kind: str) -> io.BufferedIOBase:
+    kind = (kind or "NONE").upper()
+    if kind == "NONE":
+        return stream
+    if kind == "GZIP":
+        return gzip.GzipFile(fileobj=stream)
+    if kind == "BZIP2":
+        return bz2.BZ2File(stream)
+    raise SelectError(f"unsupported CompressionType {kind}")
+
+
+def csv_rows(stream, *, header: str = "USE", delimiter: str = ",",
+             quote: str = '"', record_delimiter: str = "\n",
+             comments: str = "") -> Iterator[dict]:
+    """header: USE (first row names columns) | IGNORE | NONE."""
+    header = (header or "USE").upper()
+    text = io.TextIOWrapper(stream, encoding="utf-8", newline="")
+    reader = csv.reader(text, delimiter=delimiter or ",",
+                        quotechar=quote or '"')
+    names: list[str] | None = None
+    for rec in reader:
+        if not rec or (comments and rec[0].startswith(comments)):
+            continue
+        if names is None and header in ("USE", "IGNORE"):
+            names = rec if header == "USE" else []
+            if header == "IGNORE":
+                names = []
+            if header == "USE":
+                continue
+        row: dict = {}
+        for i, v in enumerate(rec):
+            row[f"_{i + 1}"] = v
+            if names and i < len(names):
+                row[names[i]] = v
+        yield row
+
+
+def json_rows(stream, *, json_type: str = "LINES") -> Iterator[dict]:
+    """LINES: one JSON value per line; DOCUMENT: a single value (or a
+    top-level array, which selects each element)."""
+    json_type = (json_type or "LINES").upper()
+    if json_type == "LINES":
+        text = io.TextIOWrapper(stream, encoding="utf-8")
+        for line in text:
+            line = line.strip()
+            if not line:
+                continue
+            yield _as_row(_loads(line))
+        return
+    if json_type == "DOCUMENT":
+        raw = stream.read()
+        doc = _loads(raw.decode("utf-8") if isinstance(raw, bytes) else raw)
+        if isinstance(doc, list):
+            for item in doc:
+                yield _as_row(item)
+        else:
+            yield _as_row(doc)
+        return
+    raise SelectError(f"unsupported JSON Type {json_type}")
+
+
+def _loads(s: str):
+    try:
+        return json.loads(s)
+    except ValueError as e:
+        raise SelectError(f"malformed JSON record: {e}") from None
+
+
+def _as_row(doc) -> dict:
+    if not isinstance(doc, dict):
+        return {"_1": doc}
+    row: dict = {}
+    for k, v in doc.items():
+        row[k] = v
+        if isinstance(v, dict):
+            for k2, v2 in v.items():
+                row[f"{k}.{k2}"] = v2
+    return row

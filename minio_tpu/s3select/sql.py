@@ -1,0 +1,692 @@
+"""The S3 Select SQL dialect: tokenizer, recursive-descent parser,
+evaluator (pkg/s3select/sql role).
+
+Supported: SELECT <*|expr [AS alias], ...> FROM S3Object[.path] [alias]
+[WHERE expr] [LIMIT n]; operators || * / % + - = != <> < <= > >= AND OR
+NOT, LIKE [ESCAPE], IN (...), BETWEEN, IS [NOT] NULL/MISSING; aggregates
+COUNT/SUM/AVG/MIN/MAX; scalar functions CAST, LOWER, UPPER, TRIM,
+CHAR_LENGTH, CHARACTER_LENGTH, SUBSTRING, COALESCE, NULLIF.
+
+Values are dynamically typed (MISSING ≠ NULL, matching the reference's
+sql.Value); CSV fields arrive as strings and comparisons against numeric
+operands coerce when the text parses as a number.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any
+
+MISSING = object()          # absent column (distinct from SQL NULL)
+
+
+class SelectError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# tokenizer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<number>\d+\.\d*|\.\d+|\d+)
+    | (?P<dqident>"(?:[^"]|"")*")
+    | (?P<string>'(?:[^']|'')*')
+    | (?P<op><>|!=|<=|>=|\|\||[=<>(),.*/%+\-])
+    | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+    )""", re.VERBOSE)
+
+_KEYWORDS = {
+    "SELECT", "FROM", "WHERE", "LIMIT", "AS", "AND", "OR", "NOT", "LIKE",
+    "ESCAPE", "IN", "BETWEEN", "IS", "NULL", "MISSING", "TRUE", "FALSE",
+    "CAST", "COUNT", "SUM", "AVG", "MIN", "MAX", "LOWER", "UPPER", "TRIM",
+    "CHAR_LENGTH", "CHARACTER_LENGTH", "SUBSTRING", "COALESCE", "NULLIF",
+    "INT", "INTEGER", "FLOAT", "DECIMAL", "NUMERIC", "STRING", "BOOL",
+    "BOOLEAN", "VARCHAR", "FOR",
+}
+
+
+@dataclass
+class Tok:
+    kind: str      # number | string | ident | kw | op | eof
+    text: str
+
+
+def tokenize(src: str) -> list[Tok]:
+    out: list[Tok] = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if not m:
+            if src[pos:].strip() == "":
+                break
+            raise SelectError(f"bad token at {src[pos:pos + 20]!r}")
+        pos = m.end()
+        if m.lastgroup == "number":
+            out.append(Tok("number", m.group("number")))
+        elif m.lastgroup == "string":
+            out.append(Tok("string",
+                           m.group("string")[1:-1].replace("''", "'")))
+        elif m.lastgroup == "dqident":
+            out.append(Tok("ident",
+                           m.group("dqident")[1:-1].replace('""', '"')))
+        elif m.lastgroup == "op":
+            out.append(Tok("op", m.group("op")))
+        else:
+            word = m.group("ident")
+            up = word.upper()
+            out.append(Tok("kw", up) if up in _KEYWORDS
+                       else Tok("ident", word))
+    out.append(Tok("eof", ""))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Lit:
+    value: Any
+
+
+@dataclass
+class Col:
+    name: str          # "" means whole record; "_N" positional
+
+
+@dataclass
+class Unary:
+    op: str
+    e: Any
+
+
+@dataclass
+class Binary:
+    op: str
+    l: Any
+    r: Any
+
+
+@dataclass
+class Like:
+    e: Any
+    pattern: Any
+    escape: str | None
+    negate: bool
+
+
+@dataclass
+class InList:
+    e: Any
+    items: list
+    negate: bool
+
+
+@dataclass
+class Between:
+    e: Any
+    lo: Any
+    hi: Any
+    negate: bool
+
+
+@dataclass
+class IsNull:
+    e: Any
+    negate: bool
+    missing: bool
+
+
+@dataclass
+class Func:
+    name: str
+    args: list
+    star: bool = False          # COUNT(*)
+    cast_type: str = ""         # CAST
+
+
+@dataclass
+class Projection:
+    expr: Any                   # None == *
+    alias: str
+
+
+@dataclass
+class Query:
+    projections: list[Projection]
+    alias: str
+    where: Any
+    limit: int | None
+    aggregates: list = field(default_factory=list)   # Func nodes
+
+
+_AGG = {"COUNT", "SUM", "AVG", "MIN", "MAX"}
+
+
+class Parser:
+    def __init__(self, toks: list[Tok], ):
+        self.toks = toks
+        self.i = 0
+        self.aggs: list[Func] = []
+
+    def peek(self) -> Tok:
+        return self.toks[self.i]
+
+    def next(self) -> Tok:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept(self, kind: str, text: str | None = None) -> Tok | None:
+        t = self.peek()
+        if t.kind == kind and (text is None or t.text == text):
+            return self.next()
+        return None
+
+    def expect(self, kind: str, text: str | None = None) -> Tok:
+        t = self.accept(kind, text)
+        if t is None:
+            raise SelectError(
+                f"expected {text or kind}, got {self.peek().text!r}")
+        return t
+
+    # -- grammar --
+
+    def parse(self) -> Query:
+        self.expect("kw", "SELECT")
+        projections = [self.projection()]
+        while self.accept("op", ","):
+            projections.append(self.projection())
+        self.expect("kw", "FROM")
+        alias = self.from_clause()
+        where = None
+        if self.accept("kw", "WHERE"):
+            where = self.expr()
+        limit = None
+        if self.accept("kw", "LIMIT"):
+            limit = int(self.expect("number").text)
+        self.expect("eof")
+        return Query(projections, alias, where, limit, self.aggs)
+
+    def projection(self) -> Projection:
+        if self.accept("op", "*"):
+            return Projection(None, "")
+        e = self.expr()
+        alias = ""
+        if self.accept("kw", "AS"):
+            alias = self.next().text
+        elif self.peek().kind == "ident":
+            alias = self.next().text
+        return Projection(e, alias)
+
+    def from_clause(self) -> str:
+        t = self.next()
+        if t.kind not in ("ident", "kw") or not t.text.upper().startswith(
+                "S3OBJECT"):
+            raise SelectError("FROM must reference S3Object")
+        while self.accept("op", "."):
+            self.next()  # S3Object.path — path is applied by the reader
+        if self.peek().kind == "ident":
+            return self.next().text
+        return ""
+
+    # precedence: OR < AND < NOT < comparison < additive < multiplicative
+    def expr(self):
+        e = self.and_expr()
+        while self.accept("kw", "OR"):
+            e = Binary("OR", e, self.and_expr())
+        return e
+
+    def and_expr(self):
+        e = self.not_expr()
+        while self.accept("kw", "AND"):
+            e = Binary("AND", e, self.not_expr())
+        return e
+
+    def not_expr(self):
+        if self.accept("kw", "NOT"):
+            return Unary("NOT", self.not_expr())
+        return self.comparison()
+
+    def comparison(self):
+        e = self.additive()
+        negate = bool(self.accept("kw", "NOT"))
+        t = self.peek()
+        if t.kind == "op" and t.text in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            if negate:
+                raise SelectError("NOT before comparison operator")
+            op = self.next().text
+            return Binary("<>" if op == "!=" else op, e, self.additive())
+        if self.accept("kw", "LIKE"):
+            pat = self.additive()
+            esc = None
+            if self.accept("kw", "ESCAPE"):
+                esc = self.expect("string").text
+            return Like(e, pat, esc, negate)
+        if self.accept("kw", "IN"):
+            self.expect("op", "(")
+            items = [self.expr()]
+            while self.accept("op", ","):
+                items.append(self.expr())
+            self.expect("op", ")")
+            return InList(e, items, negate)
+        if self.accept("kw", "BETWEEN"):
+            lo = self.additive()
+            self.expect("kw", "AND")
+            return Between(e, lo, self.additive(), negate)
+        if self.accept("kw", "IS"):
+            neg2 = bool(self.accept("kw", "NOT"))
+            if self.accept("kw", "MISSING"):
+                return IsNull(e, neg2, missing=True)
+            self.expect("kw", "NULL")
+            return IsNull(e, neg2, missing=False)
+        if negate:
+            raise SelectError("dangling NOT")
+        return e
+
+    def additive(self):
+        e = self.multiplicative()
+        while True:
+            if self.accept("op", "+"):
+                e = Binary("+", e, self.multiplicative())
+            elif self.accept("op", "-"):
+                e = Binary("-", e, self.multiplicative())
+            elif self.accept("op", "||"):
+                e = Binary("||", e, self.multiplicative())
+            else:
+                return e
+
+    def multiplicative(self):
+        e = self.unary()
+        while True:
+            if self.accept("op", "*"):
+                e = Binary("*", e, self.unary())
+            elif self.accept("op", "/"):
+                e = Binary("/", e, self.unary())
+            elif self.accept("op", "%"):
+                e = Binary("%", e, self.unary())
+            else:
+                return e
+
+    def unary(self):
+        if self.accept("op", "-"):
+            return Unary("-", self.unary())
+        if self.accept("op", "+"):
+            return self.unary()
+        return self.primary()
+
+    def primary(self):
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            txt = t.text
+            return Lit(float(txt) if "." in txt else int(txt))
+        if t.kind == "string":
+            self.next()
+            return Lit(t.text)
+        if t.kind == "kw" and t.text in ("TRUE", "FALSE"):
+            self.next()
+            return Lit(t.text == "TRUE")
+        if t.kind == "kw" and t.text == "NULL":
+            self.next()
+            return Lit(None)
+        if self.accept("op", "("):
+            e = self.expr()
+            self.expect("op", ")")
+            return e
+        if t.kind == "kw" and (t.text in _AGG or t.text in (
+                "CAST", "LOWER", "UPPER", "TRIM", "CHAR_LENGTH",
+                "CHARACTER_LENGTH", "SUBSTRING", "COALESCE", "NULLIF")):
+            return self.func()
+        if t.kind in ("ident",):
+            return self.column()
+        raise SelectError(f"unexpected {t.text!r}")
+
+    def func(self):
+        name = self.next().text
+        self.expect("op", "(")
+        if name == "CAST":
+            e = self.expr()
+            self.expect("kw", "AS")
+            ty = self.next().text.upper()
+            self.expect("op", ")")
+            return Func("CAST", [e], cast_type=ty)
+        if name == "COUNT" and self.accept("op", "*"):
+            self.expect("op", ")")
+            f = Func("COUNT", [], star=True)
+            self.aggs.append(f)
+            return f
+        if name == "SUBSTRING":
+            args = [self.expr()]
+            if self.accept("op", ","):
+                args.append(self.expr())
+                if self.accept("op", ","):
+                    args.append(self.expr())
+            elif self.accept("kw", "FROM"):
+                args.append(self.expr())
+                if self.accept("kw", "FOR"):
+                    args.append(self.expr())
+            else:
+                raise SelectError("SUBSTRING needs FROM or comma arguments")
+            self.expect("op", ")")
+            return Func("SUBSTRING", args)
+        args = []
+        if not self.accept("op", ")"):
+            args.append(self.expr())
+            while self.accept("op", ","):
+                args.append(self.expr())
+            self.expect("op", ")")
+        f = Func(name, args)
+        if name in _AGG:
+            self.aggs.append(f)
+        return f
+
+    def column(self):
+        parts = [self.next().text]
+        while self.accept("op", "."):
+            parts.append(self.next().text)
+        return Col(".".join(parts))
+
+
+def parse(sql: str) -> Query:
+    return Parser(tokenize(sql)).parse()
+
+
+# ---------------------------------------------------------------------------
+# evaluation
+# ---------------------------------------------------------------------------
+
+def _num(v):
+    """Coerce to number when possible (CSV fields are text)."""
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, (int, float)):
+        return v
+    if isinstance(v, str):
+        try:
+            return int(v)
+        except ValueError:
+            try:
+                return float(v)
+            except ValueError:
+                return None
+    return None
+
+
+def _cmp_pair(a, b):
+    """Comparison operands: numeric compare when both sides look numeric,
+    else string compare."""
+    na, nb = _num(a), _num(b)
+    if na is not None and nb is not None:
+        return na, nb
+    return str(a), str(b)
+
+
+def _like_to_re(pattern: str, escape: str | None) -> re.Pattern:
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if escape and c == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return re.compile("^" + "".join(out) + "$", re.S)
+
+
+class Evaluator:
+    def __init__(self, query: Query):
+        self.q = query
+        self._like_cache: dict[tuple, re.Pattern] = {}
+        # aggregate states, parallel to query.aggregates
+        self.agg_state = [{"count": 0, "sum": 0.0, "min": None, "max": None}
+                          for _ in query.aggregates]
+        self.is_aggregate = bool(query.aggregates)
+
+    # -- row evaluation --
+
+    def eval(self, node, row: dict):
+        if isinstance(node, Lit):
+            return node.value
+        if isinstance(node, Col):
+            v = row.get(node.name, MISSING)
+            if v is MISSING and "." in node.name:
+                # First segment may be the table alias (s.age): drop it;
+                # a remaining dotted path addresses nested JSON fields.
+                rest = node.name.split(".", 1)[1]
+                v = row.get(rest, MISSING)
+                if v is MISSING:
+                    v = row.get(node.name.rsplit(".", 1)[-1], MISSING)
+            return v
+        if isinstance(node, Unary):
+            v = self.eval(node.e, row)
+            if node.op == "NOT":
+                return (not _truthy(v)) if v not in (None, MISSING) else None
+            n = _num(v)
+            return -n if n is not None else None
+        if isinstance(node, Binary):
+            return self._binary(node, row)
+        if isinstance(node, Like):
+            v = self.eval(node.e, row)
+            pat = self.eval(node.pattern, row)
+            if v in (None, MISSING) or pat in (None, MISSING):
+                return None
+            key = (pat, node.escape)
+            rx = self._like_cache.get(key)
+            if rx is None:
+                rx = self._like_cache[key] = _like_to_re(str(pat), node.escape)
+            hit = rx.match(str(v)) is not None
+            return hit != node.negate
+        if isinstance(node, InList):
+            v = self.eval(node.e, row)
+            if v in (None, MISSING):
+                return None
+            hit = False
+            for item in node.items:
+                a, b = _cmp_pair(v, self.eval(item, row))
+                if a == b:
+                    hit = True
+                    break
+            return hit != node.negate
+        if isinstance(node, Between):
+            v = self.eval(node.e, row)
+            lo = self.eval(node.lo, row)
+            hi = self.eval(node.hi, row)
+            if v in (None, MISSING):
+                return None
+            a, l = _cmp_pair(v, lo)
+            a2, h = _cmp_pair(v, hi)
+            hit = l <= a and a2 <= h
+            return hit != node.negate
+        if isinstance(node, IsNull):
+            v = self.eval(node.e, row)
+            if node.missing:
+                hit = v is MISSING
+            else:
+                hit = v is None or v is MISSING
+            return hit != node.negate
+        if isinstance(node, Func):
+            return self._func(node, row)
+        raise SelectError(f"cannot evaluate {node!r}")
+
+    def _binary(self, node: Binary, row: dict):
+        op = node.op
+        if op in ("AND", "OR"):
+            lv = self.eval(node.l, row)
+            lt = _truthy(lv) if lv not in (None, MISSING) else None
+            if op == "AND":
+                if lt is False:
+                    return False
+                rv = self.eval(node.r, row)
+                rt = _truthy(rv) if rv not in (None, MISSING) else None
+                return rt if lt is True else (False if rt is False else None)
+            if lt is True:
+                return True
+            rv = self.eval(node.r, row)
+            rt = _truthy(rv) if rv not in (None, MISSING) else None
+            return rt if lt is False else (True if rt is True else None)
+
+        lv = self.eval(node.l, row)
+        rv = self.eval(node.r, row)
+        if lv in (None, MISSING) or rv in (None, MISSING):
+            return None
+        if op == "||":
+            return str(lv) + str(rv)
+        if op in ("=", "<>", "<", "<=", ">", ">="):
+            a, b = _cmp_pair(lv, rv)
+            return {"=": a == b, "<>": a != b, "<": a < b,
+                    "<=": a <= b, ">": a > b, ">=": a >= b}[op]
+        a, b = _num(lv), _num(rv)
+        if a is None or b is None:
+            return None
+        try:
+            if op == "+":
+                return a + b
+            if op == "-":
+                return a - b
+            if op == "*":
+                return a * b
+            if op == "/":
+                return a / b
+            if op == "%":
+                return a % b
+        except ZeroDivisionError:
+            raise SelectError("division by zero") from None
+        raise SelectError(f"bad operator {op}")
+
+    def _func(self, node: Func, row: dict):
+        name = node.name
+        if name in _AGG:
+            # During accumulation aggregates return their *index marker*;
+            # final projection reads the state.
+            idx = self.q.aggregates.index(node)
+            return ("__agg__", idx)
+        args = [self.eval(a, row) for a in node.args]
+        if name == "CAST":
+            return _cast(args[0], node.cast_type)
+        if any(a is MISSING for a in args) and name != "COALESCE":
+            return None
+        if name == "LOWER":
+            return None if args[0] is None else str(args[0]).lower()
+        if name == "UPPER":
+            return None if args[0] is None else str(args[0]).upper()
+        if name == "TRIM":
+            return None if args[0] is None else str(args[0]).strip()
+        if name in ("CHAR_LENGTH", "CHARACTER_LENGTH"):
+            return None if args[0] is None else len(str(args[0]))
+        if name == "SUBSTRING":
+            if args[0] is None:
+                return None
+            s = str(args[0])
+            start = int(_num(args[1]) or 1)
+            begin = max(start - 1, 0)
+            if len(args) > 2:
+                ln = int(_num(args[2]) or 0)
+                return s[begin:begin + ln]
+            return s[begin:]
+        if name == "COALESCE":
+            for a in args:
+                if a not in (None, MISSING):
+                    return a
+            return None
+        if name == "NULLIF":
+            a, b = _cmp_pair(args[0], args[1])
+            return None if a == b else args[0]
+        raise SelectError(f"unknown function {name}")
+
+    # -- aggregation --
+
+    def accumulate(self, row: dict) -> None:
+        for f, st in zip(self.q.aggregates, self.agg_state):
+            if f.star:
+                st["count"] += 1
+                continue
+            v = self.eval(f.args[0], row)
+            if v in (None, MISSING):
+                continue
+            st["count"] += 1
+            n = _num(v)
+            if n is not None:
+                st["sum"] += n
+                st["min"] = n if st["min"] is None else min(st["min"], n)
+                st["max"] = n if st["max"] is None else max(st["max"], n)
+
+    def agg_value(self, f: Func) -> Any:
+        st = self.agg_state[self.q.aggregates.index(f)]
+        if f.name == "COUNT":
+            return st["count"]
+        if st["count"] == 0:
+            return None
+        if f.name == "SUM":
+            return st["sum"]
+        if f.name == "AVG":
+            return st["sum"] / st["count"]
+        if f.name == "MIN":
+            return st["min"]
+        return st["max"]
+
+    # -- projection --
+
+    def project(self, row: dict) -> dict:
+        out: dict[str, Any] = {}
+        for i, p in enumerate(self.q.projections):
+            if p.expr is None:                       # SELECT *
+                out.update(row)
+                continue
+            v = self.eval(p.expr, row)
+            if isinstance(v, tuple) and len(v) == 2 and v[0] == "__agg__":
+                v = self.agg_value(self.q.aggregates[v[1]])
+            name = p.alias or _auto_name(p.expr, i)
+            out[name] = v
+        return out
+
+    def where_matches(self, row: dict) -> bool:
+        if self.q.where is None:
+            return True
+        return self.eval(self.q.where, row) is True
+
+
+def _truthy(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, str):
+        return v.lower() == "true"
+    return bool(v)
+
+
+def _auto_name(expr, i: int) -> str:
+    if isinstance(expr, Col):
+        return expr.name
+    return f"_{i + 1}"
+
+
+def _cast(v, ty: str):
+    if v in (None, MISSING):
+        return None
+    try:
+        if ty in ("INT", "INTEGER"):
+            return int(float(v)) if not isinstance(v, str) or "." in v \
+                else int(v)
+        if ty in ("FLOAT", "DECIMAL", "NUMERIC"):
+            return float(v)
+        if ty in ("STRING", "VARCHAR"):
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            return str(v)
+        if ty in ("BOOL", "BOOLEAN"):
+            if isinstance(v, str):
+                return v.lower() == "true"
+            return bool(v)
+    except (ValueError, TypeError):
+        raise SelectError(f"cannot CAST {v!r} to {ty}") from None
+    raise SelectError(f"unknown CAST type {ty}")

@@ -1,0 +1,283 @@
+"""S3 Select tests: SQL parse/eval units, readers, event-stream framing,
+and the HTTP SelectObjectContent flow (pkg/s3select role, mirroring
+pkg/s3select/select_test.go shapes)."""
+
+import gzip
+import io
+import json
+import socket
+import threading
+
+import pytest
+from aiohttp import web
+
+from minio_tpu.s3select import S3SelectRequest, run_select
+from minio_tpu.s3select import eventstream as es
+from minio_tpu.s3select.sql import Evaluator, MISSING, SelectError, parse
+from tests.s3client import SigV4Client
+
+CSV_DATA = b"""name,age,city
+alice,30,paris
+bob,25,london
+carol,35,paris
+dave,28,berlin
+"""
+
+JSON_LINES = (b'{"name":"alice","age":30,"nested":{"x":1}}\n'
+              b'{"name":"bob","age":25}\n'
+              b'{"name":"carol","age":35}\n')
+
+
+# ---------------- sql unit ----------------
+
+def _rows(sql, rows):
+    q = parse(sql)
+    ev = Evaluator(q)
+    out = []
+    for r in rows:
+        if ev.where_matches(r):
+            out.append(ev.project(r))
+    return out
+
+
+def test_parse_basic_shapes():
+    q = parse("SELECT * FROM S3Object")
+    assert q.projections[0].expr is None and q.where is None
+    q = parse("SELECT s.name, s.age FROM S3Object s WHERE s.age > 28 LIMIT 5")
+    assert len(q.projections) == 2 and q.limit == 5
+    with pytest.raises(SelectError):
+        parse("SELECT FROM S3Object")
+    with pytest.raises(SelectError):
+        parse("SELECT * FROM OtherTable")
+
+
+def test_where_filtering_and_projection():
+    rows = [{"name": "alice", "age": "30"}, {"name": "bob", "age": "25"}]
+    out = _rows("SELECT name FROM S3Object WHERE age > 28", rows)
+    assert out == [{"name": "alice"}]
+    # numeric coercion both ways
+    out = _rows("SELECT name FROM S3Object WHERE age = 25", rows)
+    assert out == [{"name": "bob"}]
+
+
+def test_operators():
+    rows = [{"a": "5", "b": "hello"}]
+    assert _rows("SELECT a FROM S3Object WHERE a BETWEEN 1 AND 10", rows)
+    assert not _rows("SELECT a FROM S3Object WHERE a NOT BETWEEN 1 AND 10", rows)
+    assert _rows("SELECT a FROM S3Object WHERE b LIKE 'he%'", rows)
+    assert _rows("SELECT a FROM S3Object WHERE b LIKE '_ello'", rows)
+    assert not _rows("SELECT a FROM S3Object WHERE b NOT LIKE 'he%'", rows)
+    assert _rows("SELECT a FROM S3Object WHERE a IN (3, 5, 7)", rows)
+    assert _rows("SELECT a FROM S3Object WHERE a = 5 AND b = 'hello'", rows)
+    assert _rows("SELECT a FROM S3Object WHERE a = 9 OR b = 'hello'", rows)
+    assert _rows("SELECT a FROM S3Object WHERE NOT a = 9", rows)
+    assert _rows("SELECT a FROM S3Object WHERE missingcol IS MISSING", rows)
+    assert not _rows("SELECT a FROM S3Object WHERE a IS NULL", rows)
+
+
+def test_arithmetic_and_concat():
+    rows = [{"x": "4", "y": "3"}]
+    out = _rows("SELECT x * y + 1 AS v FROM S3Object", rows)
+    assert out[0]["v"] == 13
+    out = _rows("SELECT x || '-' || y AS j FROM S3Object", rows)
+    assert out[0]["j"] == "4-3"
+    with pytest.raises(SelectError):
+        _rows("SELECT x / 0 AS bad FROM S3Object", rows)
+
+
+def test_scalar_functions():
+    rows = [{"s": "  Hello  "}]
+    out = _rows("SELECT TRIM(s) AS t, LOWER(s) AS l, UPPER(s) AS u, "
+                "CHAR_LENGTH(s) AS n FROM S3Object", rows)[0]
+    assert out["t"] == "Hello" and out["l"] == "  hello  "
+    assert out["u"] == "  HELLO  " and out["n"] == 9
+    out = _rows("SELECT SUBSTRING(s FROM 3 FOR 5) AS sub FROM S3Object",
+                rows)[0]
+    assert out["sub"] == "Hello"
+    out = _rows("SELECT COALESCE(nothere, s) AS c, "
+                "CAST('42' AS INT) AS i FROM S3Object", rows)[0]
+    assert out["c"] == "  Hello  " and out["i"] == 42
+
+
+def test_aggregates():
+    sql = ("SELECT COUNT(*) AS n, SUM(age) AS s, AVG(age) AS a, "
+           "MIN(age) AS lo, MAX(age) AS hi FROM S3Object WHERE age > 26")
+    q = parse(sql)
+    ev = Evaluator(q)
+    for r in [{"age": "30"}, {"age": "25"}, {"age": "35"}, {"age": "28"}]:
+        if ev.where_matches(r):
+            ev.accumulate(r)
+    out = ev.project({})
+    assert out == {"n": 3, "s": 93.0, "a": 31.0, "lo": 28, "hi": 35}
+
+
+# ---------------- event stream ----------------
+
+def test_eventstream_roundtrip():
+    frames = (es.records_message(b"payload-1")
+              + es.stats_message(10, 10, 9)
+              + es.end_message())
+    msgs = es.decode_stream(frames)
+    assert [m[0][":event-type"] for m in msgs] == ["Records", "Stats", "End"]
+    assert msgs[0][1] == b"payload-1"
+    assert b"<BytesScanned>10</BytesScanned>" in msgs[1][1]
+    # CRC tamper detection
+    bad = bytearray(frames)
+    bad[20] ^= 1
+    with pytest.raises(ValueError):
+        es.decode_stream(bytes(bad))
+
+
+# ---------------- engine ----------------
+
+def _select(data: bytes, sql: str, **req_kw) -> list[tuple[dict, bytes]]:
+    req = S3SelectRequest(expression=sql, input_format="CSV",
+                          output_format="CSV", **req_kw)
+    return es.decode_stream(b"".join(run_select(io.BytesIO(data), req)))
+
+
+def test_engine_csv_where():
+    msgs = _select(CSV_DATA,
+                   "SELECT name, age FROM S3Object WHERE city = 'paris'")
+    recs = b"".join(p for h, p in msgs if h[":event-type"] == "Records")
+    assert recs == b"alice,30\r\ncarol,35\r\n".replace(b"\r\n", b"\n") or \
+        recs.replace(b"\r\n", b"\n") == b"alice,30\ncarol,35\n"
+    assert msgs[-1][0][":event-type"] == "End"
+
+
+def test_engine_limit_and_star():
+    msgs = _select(CSV_DATA, "SELECT * FROM S3Object LIMIT 2")
+    recs = b"".join(p for h, p in msgs if h[":event-type"] == "Records")
+    lines = [l for l in recs.replace(b"\r\n", b"\n").split(b"\n") if l]
+    assert len(lines) == 2 and lines[0] == b"alice,30,paris"
+
+
+def test_engine_aggregate_csv():
+    msgs = _select(CSV_DATA, "SELECT COUNT(*) FROM S3Object")
+    recs = b"".join(p for h, p in msgs if h[":event-type"] == "Records")
+    assert recs.strip() == b"4"
+
+
+def test_engine_json_input_output():
+    req = S3SelectRequest(
+        expression="SELECT name, age FROM S3Object WHERE age >= 30",
+        input_format="JSON", output_format="JSON")
+    msgs = es.decode_stream(b"".join(run_select(io.BytesIO(JSON_LINES), req)))
+    recs = b"".join(p for h, p in msgs if h[":event-type"] == "Records")
+    got = [json.loads(l) for l in recs.decode().strip().split("\n")]
+    assert got == [{"name": "alice", "age": 30}, {"name": "carol", "age": 35}]
+
+
+def test_engine_nested_json_field():
+    req = S3SelectRequest(
+        expression="SELECT s FROM S3Object WHERE s IS NOT MISSING",
+        input_format="JSON", output_format="JSON")
+    # nested.x addressed with dotted key
+    req.expression = "SELECT name FROM S3Object WHERE nested.x = 1"
+    msgs = es.decode_stream(b"".join(run_select(io.BytesIO(JSON_LINES), req)))
+    recs = b"".join(p for h, p in msgs if h[":event-type"] == "Records")
+    assert json.loads(recs.decode().strip()) == {"name": "alice"}
+
+
+def test_engine_gzip_input():
+    gz = gzip.compress(CSV_DATA)
+    msgs = _select(gz, "SELECT COUNT(*) FROM S3Object", compression="GZIP")
+    recs = b"".join(p for h, p in msgs if h[":event-type"] == "Records")
+    assert recs.strip() == b"4"
+
+
+def test_engine_headerless_positional():
+    data = b"1,foo\n2,bar\n"
+    msgs = _select(data, "SELECT _2 FROM S3Object WHERE _1 = 2",
+                   csv_header="NONE")
+    recs = b"".join(p for h, p in msgs if h[":event-type"] == "Records")
+    assert recs.strip() == b"bar"
+
+
+def test_request_xml_parse():
+    body = b"""<SelectObjectContentRequest>
+      <Expression>SELECT * FROM S3Object</Expression>
+      <ExpressionType>SQL</ExpressionType>
+      <InputSerialization><CompressionType>GZIP</CompressionType>
+        <CSV><FileHeaderInfo>IGNORE</FileHeaderInfo>
+          <FieldDelimiter>;</FieldDelimiter></CSV>
+      </InputSerialization>
+      <OutputSerialization><JSON/></OutputSerialization>
+    </SelectObjectContentRequest>"""
+    req = S3SelectRequest.parse_xml(body)
+    assert req.input_format == "CSV" and req.output_format == "JSON"
+    assert req.compression == "GZIP" and req.csv_delimiter == ";"
+    assert req.csv_header == "IGNORE"
+    with pytest.raises(SelectError):
+        S3SelectRequest.parse_xml(b"<SelectObjectContentRequest>"
+                                  b"<Expression>SELECT 1</Expression>"
+                                  b"<InputSerialization><Parquet/>"
+                                  b"</InputSerialization>"
+                                  b"<OutputSerialization><CSV/>"
+                                  b"</OutputSerialization>"
+                                  b"</SelectObjectContentRequest>")
+
+
+# ---------------- HTTP flow ----------------
+
+ACCESS, SECRET = "selroot", "selroot-secret"
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    import asyncio
+
+    from minio_tpu.s3.server import build_server
+
+    root = tmp_path_factory.mktemp("drives")
+    srv = build_server([str(root / f"d{i}") for i in range(4)], ACCESS, SECRET)
+    port = _free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(srv.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(30)
+    yield f"http://127.0.0.1:{port}"
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def test_select_over_http(server):
+    c = SigV4Client(server, ACCESS, SECRET)
+    assert c.put("/selbkt").status_code == 200
+    c.put("/selbkt/data.csv", data=CSV_DATA)
+    body = b"""<SelectObjectContentRequest>
+      <Expression>SELECT name FROM S3Object WHERE city = 'paris'</Expression>
+      <ExpressionType>SQL</ExpressionType>
+      <InputSerialization><CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV>
+      </InputSerialization>
+      <OutputSerialization><CSV/></OutputSerialization>
+    </SelectObjectContentRequest>"""
+    r = c.post("/selbkt/data.csv", data=body,
+               query={"select": "", "select-type": "2"})
+    assert r.status_code == 200, r.text
+    msgs = es.decode_stream(r.content)
+    kinds = [h[":event-type"] for h, _ in msgs]
+    assert kinds[-1] == "End" and "Stats" in kinds
+    recs = b"".join(p for h, p in msgs if h[":event-type"] == "Records")
+    assert recs.replace(b"\r\n", b"\n").strip() == b"alice\ncarol"
