@@ -1,0 +1,97 @@
+"""Transparent object compression (the klauspost/compress S2 role,
+cmd/object-api-utils.go:926 newS2CompressReader / isCompressible:440).
+
+zlib level-1 streaming (the stdlib's fastest wide-format codec) stands in
+for S2: the goal is cheap ingest compression gated by extension/MIME
+config, not maximum ratio. Compressed objects store
+x-mtpu-internal-compression plus the original size; GET decompresses
+transparently, and ranged GETs decompress-and-skip (sequential formats
+can't seek — the reference has the same constraint and stores skip
+indexes only for large objects).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import zlib
+from typing import BinaryIO, Iterator
+
+META_COMPRESSION = "x-mtpu-internal-compression"
+META_ACTUAL_SIZE = "x-mtpu-internal-uncompressed-size"
+SCHEME = "zlib/1"
+
+
+def is_compressible(key: str, content_type: str,
+                    extensions: list[str], mime_types: list[str]) -> bool:
+    """Extension/MIME gating (cmd/object-api-utils.go isCompressible).
+    Empty filter lists mean "everything"."""
+    ext_ok = not extensions or any(
+        key.lower().endswith(e.lower()) for e in extensions if e)
+    mime_ok = not mime_types or any(
+        fnmatch.fnmatch(content_type or "", p) for p in mime_types if p)
+    if extensions and mime_types:
+        return ext_ok or mime_ok
+    return ext_ok and mime_ok
+
+
+class CompressReader:
+    """File-like producing the zlib stream of an underlying reader."""
+
+    def __init__(self, src: BinaryIO):
+        self._src = src
+        self._z = zlib.compressobj(level=1)
+        self._buf = b""
+        self._eof = False
+        self.bytes_in = 0
+
+    def read(self, n: int = -1) -> bytes:
+        while not self._eof and (n < 0 or len(self._buf) < n):
+            chunk = self._src.read(1 << 20)
+            if not chunk:
+                self._buf += self._z.flush()
+                self._eof = True
+                break
+            self.bytes_in += len(chunk)
+            self._buf += self._z.compress(chunk)
+        if n < 0:
+            out, self._buf = self._buf, b""
+        else:
+            out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def close(self) -> None:
+        try:
+            self._src.close()
+        except Exception:
+            pass
+
+
+def decompress_iter(it: Iterator[bytes], offset: int = 0,
+                    length: int = -1) -> Iterator[bytes]:
+    """Decompress a zlib stream, yielding [offset, offset+length) of the
+    plaintext."""
+    z = zlib.decompressobj()
+    skip = offset
+    remaining = length
+    for chunk in it:
+        out = z.decompress(chunk)
+        if not out:
+            continue
+        if skip:
+            if len(out) <= skip:
+                skip -= len(out)
+                continue
+            out = out[skip:]
+            skip = 0
+        if remaining >= 0:
+            if len(out) >= remaining:
+                yield out[:remaining]
+                return
+            remaining -= len(out)
+        yield out
+    tail = z.flush()
+    if tail and not skip:
+        if remaining >= 0:
+            tail = tail[:remaining]
+        if tail:
+            yield tail

@@ -28,6 +28,7 @@ from minio_tpu.admin.metrics import collect_metrics
 from minio_tpu.admin.pubsub import PubSub
 from minio_tpu.admin.stats import HTTPStats
 from minio_tpu.bucket import objectlock as olock
+from minio_tpu.crypto import compress as czip
 from minio_tpu.crypto import sse
 from minio_tpu.bucket.meta import BucketMetadataSys
 from minio_tpu.erasure import ErasureObjects
@@ -769,6 +770,31 @@ class S3Server:
                                 "mtpu-sse-s3:" + self.creds.secret_key)
         return _hl.sha256(secret.encode()).digest()
 
+    def _maybe_compress_put(self, request, bucket: str, key: str, opts,
+                            spool, size: int):
+        """Wrap the upload in the streaming compressor when the
+        compression config matches (isCompressible role). Returns
+        (reader, size) — size becomes -1 (stream length unknown)."""
+        if self.config.get("compression", "enable") != "on":
+            return spool, size
+        # SSE and compression don't stack (compressed-then-encrypted sizes
+        # become doubly virtual; the reference also refuses).
+        if (request.headers.get("x-amz-server-side-encryption")
+                or request.headers.get(
+                    "x-amz-server-side-encryption-customer-algorithm")):
+            return spool, size
+        exts = [e for e in self.config.get(
+            "compression", "extensions").split(",") if e]
+        mimes = [m for m in self.config.get(
+            "compression", "mime_types").split(",") if m]
+        ct = opts.user_defined.get("content-type", "")
+        if not czip.is_compressible(key, ct, exts, mimes):
+            return spool, size
+        if size >= 0:
+            opts.user_defined[czip.META_ACTUAL_SIZE] = str(size)
+        opts.user_defined[czip.META_COMPRESSION] = czip.SCHEME
+        return czip.CompressReader(spool), -1
+
     def _maybe_encrypt_put(self, request, bucket: str, key: str, opts,
                            spool, size: int):
         """Wrap the upload stream in a DARE encryptor when SSE applies.
@@ -842,6 +868,15 @@ class S3Server:
         (info, iterator, plaintext_size) where info.size is the client-
         visible size."""
         pre = await run(self.obj.get_object_info, bucket, key, opts)
+        if czip.META_COMPRESSION in pre.user_defined:
+            actual = int(pre.user_defined.get(czip.META_ACTUAL_SIZE, "-1"))
+            if length < 0:
+                length = (actual - offset) if actual >= 0 else -1
+            info, stream = await run(self.obj.get_object, bucket, key,
+                                     0, -1, opts)
+            return (info,
+                    czip.decompress_iter(stream, offset, length),
+                    actual if actual >= 0 else pre.size)
         if sse.META_ALGO not in pre.user_defined:
             if length < 0:
                 length = pre.size - offset
@@ -997,8 +1032,10 @@ class S3Server:
         opts.user_defined = _metadata_headers(request)
         self._apply_object_lock(request, bucket, opts)
         spool, size = await self._spool_body(request, payload_hash, auth_sig)
-        reader, stored_size = self._maybe_encrypt_put(
+        reader, size2 = self._maybe_compress_put(
             request, bucket, key, opts, spool, size)
+        reader, stored_size = self._maybe_encrypt_put(
+            request, bucket, key, opts, reader, size2)
         try:
             info = await run(self.obj.put_object, bucket, key, reader,
                              stored_size, opts)
@@ -1091,8 +1128,9 @@ class S3Server:
             # Range needs the size before the read; costs one extra quorum
             # metadata round, paid only by range requests.
             pre = await run(self.obj.get_object_info, bucket, key, opts)
-            visible = int(pre.user_defined.get(sse.META_ACTUAL_SIZE,
-                                               pre.size))
+            visible = int(pre.user_defined.get(
+                sse.META_ACTUAL_SIZE,
+                pre.user_defined.get(czip.META_ACTUAL_SIZE, pre.size)))
             offset, length = _parse_range(rng, visible)
             status = 206
         else:
@@ -1236,6 +1274,8 @@ def _object_headers(info) -> dict:
     size = info.size
     if sse.META_ACTUAL_SIZE in info.user_defined:
         size = int(info.user_defined[sse.META_ACTUAL_SIZE])
+    elif czip.META_ACTUAL_SIZE in info.user_defined:
+        size = int(info.user_defined[czip.META_ACTUAL_SIZE])
     h = {
         "ETag": f'"{info.etag}"',
         "Last-Modified": _http_time(info.mod_time),

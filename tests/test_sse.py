@@ -215,3 +215,53 @@ def test_copy_decrypts_and_reencrypts(client):
     assert r.status_code == 200, r.text
     r = client.get("/ssebkt/copy-plain.bin")
     assert r.content == payload
+
+
+# ---------------- compression (S2 role) ----------------
+
+def test_compression_roundtrip(server, client):
+    import json as _json
+
+    base, srv = server
+    # Enable compression for .log files via config KV.
+    srv.config.set_kv("compression", {"enable": "on",
+                                      "extensions": ".log",
+                                      "mime_types": ""})
+    try:
+        payload = (b"repetitive line of log text\n" * 20000)
+        r = client.put("/ssebkt/app.log", data=payload)
+        assert r.status_code == 200, r.text
+
+        # Stored bytes are compressed (smaller, not equal to plaintext).
+        info = srv.obj.get_object_info("ssebkt", "app.log")
+        from minio_tpu.crypto import compress as czip
+        assert info.user_defined.get(czip.META_COMPRESSION)
+        assert info.size < len(payload) // 4
+
+        # Transparent decompression, full + ranged.
+        r = client.get("/ssebkt/app.log")
+        assert r.content == payload
+        r = client.get("/ssebkt/app.log",
+                       headers={"Range": "bytes=100000-100099"})
+        assert r.status_code == 206
+        assert r.content == payload[100000:100100]
+
+        # Non-matching extension is stored verbatim.
+        r = client.put("/ssebkt/photo.bin", data=b"\x00" * 1000)
+        info = srv.obj.get_object_info("ssebkt", "photo.bin")
+        assert czip.META_COMPRESSION not in info.user_defined
+    finally:
+        srv.config.set_kv("compression", {"enable": "off"})
+
+
+def test_compressed_head_reports_plain_size(server, client):
+    _, srv = server
+    srv.config.set_kv("compression", {"enable": "on", "extensions": ".txt",
+                                      "mime_types": ""})
+    try:
+        payload = b"compressible text " * 5000
+        client.put("/ssebkt/head.txt", data=payload)
+        r = client.head("/ssebkt/head.txt")
+        assert int(r.headers["Content-Length"]) == len(payload)
+    finally:
+        srv.config.set_kv("compression", {"enable": "off"})
