@@ -100,6 +100,34 @@ class AdminAPI:
             "add-service-account": self._add_service_account,
             "delete-service-account": self._delete_service_account,
         }
+        # -- replication targets (cmd/admin-handlers bucket targets) --
+        if op == "set-remote-target" and m == "PUT":
+            self._authorize(identity, "admin:SetBucketTarget")
+            from minio_tpu.replication.pool import BucketTarget
+
+            body = json.loads(await request.read())
+            self.s.bucket_targets.set_target(
+                q["bucket"], BucketTarget(
+                    endpoint=body["endpoint"],
+                    access_key=body["accessKey"],
+                    secret_key=body["secretKey"],
+                    target_bucket=body.get("targetBucket", ""),
+                    region=body.get("region", "us-east-1")))
+            return _json({})
+        if op == "list-remote-targets" and m == "GET":
+            self._authorize(identity, "admin:GetBucketTarget")
+            t = self.s.bucket_targets.get_target(q["bucket"])
+            return _json([] if t is None else [
+                {"endpoint": t.endpoint, "targetBucket": t.target_bucket,
+                 "region": t.region}])
+        if op == "remove-remote-target" and m == "DELETE":
+            self._authorize(identity, "admin:SetBucketTarget")
+            self.s.bucket_targets.remove_target(q["bucket"])
+            return _json({})
+        if op == "replication-status" and m == "GET":
+            self._authorize(identity, "admin:ServerInfo")
+            return _json(self.s.replication.stats)
+
         if op in iam_ops:
             self._authorize(identity, "admin:*")
             try:

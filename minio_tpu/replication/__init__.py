@@ -1,0 +1,15 @@
+"""Bucket replication: async replication of objects to remote S3 targets.
+
+Role-equivalent of cmd/bucket-replication.go (ReplicationPool:810,
+replicateObject:566) + cmd/bucket-targets.go: per-bucket remote targets,
+rules parsed from the replication XML, a resizable worker pool draining a
+replication queue, and x-amz-replication-status bookkeeping
+(PENDING → COMPLETED/FAILED, REPLICA on the far side).
+"""
+
+from minio_tpu.replication.pool import ReplicationPool
+from minio_tpu.replication.rules import ReplicationConfig, parse_replication_xml
+from minio_tpu.replication.client import RemoteS3Client
+
+__all__ = ["ReplicationPool", "ReplicationConfig", "parse_replication_xml",
+           "RemoteS3Client"]

@@ -1,0 +1,109 @@
+"""Minimal SigV4 S3 client for replication targets.
+
+The runtime-side S3 client (the reference uses minio-go for its remote
+targets): stdlib http.client + an independent SigV4 signer. Only the verbs
+replication needs: PUT object, DELETE object, HEAD object, HEAD bucket.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import http.client
+import urllib.parse
+
+
+class RemoteS3Error(Exception):
+    def __init__(self, status: int, body: str = ""):
+        self.status = status
+        super().__init__(f"remote S3 error HTTP {status}: {body[:200]}")
+
+
+class RemoteS3Client:
+    def __init__(self, endpoint: str, access_key: str, secret_key: str,
+                 region: str = "us-east-1", timeout: float = 30.0):
+        u = urllib.parse.urlsplit(endpoint)
+        self.host = u.hostname or "127.0.0.1"
+        self.port = u.port or (443 if u.scheme == "https" else 80)
+        self.https = u.scheme == "https"
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.timeout = timeout
+
+    # -- signing (independent SigV4 implementation) --
+
+    def _sign(self, method: str, path: str, headers: dict,
+              payload_hash: str) -> dict:
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        scope_date = amz_date[:8]
+        headers = {k.lower(): str(v) for k, v in headers.items()}
+        headers["host"] = f"{self.host}:{self.port}"
+        headers["x-amz-date"] = amz_date
+        headers["x-amz-content-sha256"] = payload_hash
+        signed = sorted(headers)
+        canonical = "\n".join([
+            method,
+            urllib.parse.quote(path, safe="/-._~"),
+            "",
+            "".join(f"{h}:{' '.join(headers[h].split())}\n" for h in signed),
+            ";".join(signed),
+            payload_hash,
+        ])
+        scope = f"{scope_date}/{self.region}/s3/aws4_request"
+        sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                         hashlib.sha256(canonical.encode()).hexdigest()])
+        key = ("AWS4" + self.secret_key).encode()
+        for part in (scope_date, self.region, "s3", "aws4_request"):
+            key = hmac.new(key, part.encode(), hashlib.sha256).digest()
+        sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+        headers["authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+        return headers
+
+    def _request(self, method: str, path: str, body: bytes = b"",
+                 headers: dict | None = None) -> tuple[int, dict, bytes]:
+        payload_hash = hashlib.sha256(body).hexdigest()
+        hdrs = self._sign(method, path, dict(headers or {}), payload_hash)
+        cls = (http.client.HTTPSConnection if self.https
+               else http.client.HTTPConnection)
+        conn = cls(self.host, self.port, timeout=self.timeout)
+        try:
+            conn.request(method, path, body=body or None, headers=hdrs)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, dict(resp.getheaders()), data
+        finally:
+            conn.close()
+
+    # -- the replication verbs --
+
+    def put_object(self, bucket: str, key: str, data: bytes,
+                   metadata: dict | None = None) -> None:
+        headers = dict(metadata or {})
+        st, _, body = self._request(
+            "PUT", f"/{bucket}/{urllib.parse.quote(key)}", data, headers)
+        if st // 100 != 2:
+            raise RemoteS3Error(st, body.decode(errors="replace"))
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        st, _, body = self._request(
+            "DELETE", f"/{bucket}/{urllib.parse.quote(key)}")
+        if st not in (200, 204, 404):
+            raise RemoteS3Error(st, body.decode(errors="replace"))
+
+    def head_object(self, bucket: str, key: str) -> dict | None:
+        st, headers, _ = self._request(
+            "HEAD", f"/{bucket}/{urllib.parse.quote(key)}")
+        if st == 404:
+            return None
+        if st // 100 != 2:
+            raise RemoteS3Error(st)
+        return headers
+
+    def bucket_exists(self, bucket: str) -> bool:
+        st, _, _ = self._request("HEAD", f"/{bucket}")
+        return st // 100 == 2

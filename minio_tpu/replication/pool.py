@@ -1,0 +1,218 @@
+"""ReplicationPool — async workers draining the replication queue.
+
+Role-equivalent of cmd/bucket-replication.go:810-859 (resizable worker
+pool) + replicateObject:566: tasks carry (bucket, key, version, op); a
+worker reads the object locally, pushes it to the bucket's remote target
+with the replica marker, and flips the source's
+x-amz-replication-status PENDING → COMPLETED/FAILED. Targets come from
+the bucket metadata targets registry (cmd/bucket-targets.go).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+from dataclasses import dataclass
+
+from minio_tpu.erasure.types import ObjectOptions
+from minio_tpu.replication.client import RemoteS3Client, RemoteS3Error
+from minio_tpu.replication.rules import (
+    META_STATUS,
+    ReplicationConfig,
+    parse_replication_xml,
+)
+from minio_tpu.utils import errors as se
+
+log = logging.getLogger("minio_tpu.replication")
+
+OP_PUT = "put"
+OP_DELETE = "delete"
+
+
+@dataclass
+class ReplicationTask:
+    bucket: str
+    key: str
+    version_id: str = ""
+    op: str = OP_PUT
+
+
+@dataclass
+class BucketTarget:
+    """One remote target (cmd/bucket-targets.go BucketTarget)."""
+
+    endpoint: str
+    access_key: str
+    secret_key: str
+    target_bucket: str = ""
+    region: str = "us-east-1"
+
+    def to_doc(self) -> dict:
+        return {"endpoint": self.endpoint, "accessKey": self.access_key,
+                "secretKey": self.secret_key,
+                "targetBucket": self.target_bucket, "region": self.region}
+
+    @classmethod
+    def from_doc(cls, d: dict) -> "BucketTarget":
+        return cls(endpoint=d["endpoint"], access_key=d["accessKey"],
+                   secret_key=d["secretKey"],
+                   target_bucket=d.get("targetBucket", ""),
+                   region=d.get("region", "us-east-1"))
+
+
+class BucketTargetSys:
+    """Per-bucket target registry persisted in the sys store."""
+
+    def __init__(self, store):
+        self._store = store
+
+    @staticmethod
+    def _path(bucket: str) -> str:
+        return f"buckets/{bucket}/replication-targets.json"
+
+    def set_target(self, bucket: str, target: BucketTarget) -> None:
+        self._store.write_sys_config(
+            self._path(bucket), json.dumps(target.to_doc()).encode())
+
+    def get_target(self, bucket: str) -> BucketTarget | None:
+        try:
+            raw = self._store.read_sys_config(self._path(bucket))
+        except se.FileNotFound:
+            return None
+        return BucketTarget.from_doc(json.loads(raw))
+
+    def remove_target(self, bucket: str) -> None:
+        try:
+            self._store.delete_sys_config(self._path(bucket))
+        except se.FileNotFound:
+            pass
+
+
+class ReplicationPool:
+    def __init__(self, object_layer, bucket_meta, targets: BucketTargetSys,
+                 workers: int = 2, queue_size: int = 10000):
+        self.obj = object_layer
+        self.bucket_meta = bucket_meta
+        self.targets = targets
+        self._q: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._stop = False
+        self._threads: list[threading.Thread] = []
+        self.resize(workers)
+        self.stats = {"queued": 0, "completed": 0, "failed": 0}
+
+    # -- pool management (resizable, :810-849) --
+
+    def resize(self, workers: int) -> None:
+        while len(self._threads) < workers:
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"replication-{len(self._threads)}")
+            t.start()
+            self._threads.append(t)
+
+    def close(self) -> None:
+        self._stop = True
+        for _ in self._threads:
+            try:
+                self._q.put_nowait(None)
+            except queue.Full:
+                pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    # -- config resolution --
+
+    def config_for(self, bucket: str) -> ReplicationConfig | None:
+        raw = self.bucket_meta.get(bucket).replication_xml
+        if not raw:
+            return None
+        try:
+            return parse_replication_xml(raw)
+        except ValueError:
+            return None
+
+    # -- enqueue (called from the data path; never blocks) --
+
+    def queue_task(self, task: ReplicationTask) -> bool:
+        cfg = self.config_for(task.bucket)
+        if cfg is None:
+            return False
+        rule = cfg.rule_for(task.key)
+        if rule is None:
+            return False
+        if task.op == OP_DELETE and not (rule.delete_marker_replication
+                                         or rule.delete_replication):
+            return False
+        try:
+            self._q.put_nowait(task)
+            self.stats["queued"] += 1
+            return True
+        except queue.Full:
+            return False
+
+    def drain(self, timeout: float = 10.0) -> None:
+        """Tests/shutdown: wait until the queue empties."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while not self._q.empty() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        time.sleep(0.05)  # let in-flight tasks finish status writes
+
+    # -- the worker --
+
+    def _worker(self) -> None:
+        while not self._stop:
+            task = self._q.get()
+            if task is None:
+                return
+            try:
+                self._replicate(task)
+            except Exception:  # noqa: BLE001 - worker must survive
+                log.exception("replication task failed hard: %s", task)
+
+    def _replicate(self, task: ReplicationTask) -> None:
+        target = self.targets.get_target(task.bucket)
+        cfg = self.config_for(task.bucket)
+        rule = cfg.rule_for(task.key) if cfg else None
+        if target is None or rule is None:
+            return
+        client = RemoteS3Client(target.endpoint, target.access_key,
+                                target.secret_key, region=target.region)
+        dest_bucket = target.target_bucket or rule.target_bucket
+
+        if task.op == OP_DELETE:
+            try:
+                client.delete_object(dest_bucket, task.key)
+                self.stats["completed"] += 1
+            except (RemoteS3Error, OSError):
+                self.stats["failed"] += 1
+            return
+
+        opts = ObjectOptions(version_id=task.version_id)
+        try:
+            info, stream = self.obj.get_object(task.bucket, task.key,
+                                               opts=opts)
+            body = b"".join(stream)
+        except (se.ObjectError, se.StorageError):
+            return  # deleted before replication ran
+        headers = {"x-amz-replication-status": "REPLICA"}
+        for k, v in info.user_defined.items():
+            if k.startswith("x-amz-meta-"):
+                headers[k] = v
+        ct = info.user_defined.get("content-type")
+        if ct:
+            headers["content-type"] = ct
+        status = "COMPLETED"
+        try:
+            client.put_object(dest_bucket, task.key, body, headers)
+            self.stats["completed"] += 1
+        except (RemoteS3Error, OSError):
+            status = "FAILED"
+            self.stats["failed"] += 1
+        try:
+            self.obj.put_object_metadata(
+                task.bucket, task.key, {META_STATUS: status}, opts)
+        except (se.ObjectError, se.StorageError):
+            pass

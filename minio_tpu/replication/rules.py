@@ -1,0 +1,94 @@
+"""Replication configuration parsing (pkg/bucket/replication role)."""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+
+META_STATUS = "x-amz-replication-status"   # PENDING/COMPLETED/FAILED/REPLICA
+
+
+def _strip(tag: str) -> str:
+    return tag.split("}")[-1]
+
+
+def _text(node, name: str, default: str = "") -> str:
+    for c in node:
+        if _strip(c.tag) == name:
+            return (c.text or "").strip()
+    return default
+
+
+def _child(node, name: str):
+    for c in node:
+        if _strip(c.tag) == name:
+            return c
+    return None
+
+
+@dataclass
+class ReplicationRule:
+    id: str = ""
+    status: str = "Enabled"
+    priority: int = 0
+    prefix: str = ""
+    target_bucket: str = ""       # from Destination/Bucket arn
+    delete_marker_replication: bool = False
+    delete_replication: bool = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.status == "Enabled"
+
+    def matches(self, key: str) -> bool:
+        return key.startswith(self.prefix)
+
+
+@dataclass
+class ReplicationConfig:
+    rules: list[ReplicationRule] = field(default_factory=list)
+
+    def rule_for(self, key: str) -> ReplicationRule | None:
+        best = None
+        for r in self.rules:
+            if r.enabled and r.matches(key):
+                if best is None or r.priority > best.priority:
+                    best = r
+        return best
+
+
+def parse_replication_xml(body: bytes) -> ReplicationConfig:
+    try:
+        root = ET.fromstring(body)
+    except ET.ParseError as e:
+        raise ValueError(f"malformed replication XML: {e}") from None
+    cfg = ReplicationConfig()
+    for node in root:
+        if _strip(node.tag) != "Rule":
+            continue
+        r = ReplicationRule(
+            id=_text(node, "ID"),
+            status=_text(node, "Status", "Enabled"),
+            priority=int(_text(node, "Priority", "0") or 0),
+        )
+        flt = _child(node, "Filter")
+        if flt is not None:
+            r.prefix = _text(flt, "Prefix")
+        else:
+            r.prefix = _text(node, "Prefix")
+        dest = _child(node, "Destination")
+        if dest is not None:
+            arn = _text(dest, "Bucket")
+            r.target_bucket = arn.rsplit(":", 1)[-1] if arn else ""
+        dmr = _child(node, "DeleteMarkerReplication")
+        if dmr is not None:
+            r.delete_marker_replication = _text(dmr, "Status") == "Enabled"
+        dr = _child(node, "DeleteReplication")
+        if dr is not None:
+            r.delete_replication = _text(dr, "Status") == "Enabled"
+        if not r.target_bucket:
+            raise ValueError("replication rule needs Destination Bucket")
+        cfg.rules.append(r)
+    if not cfg.rules:
+        raise ValueError("replication configuration has no rules")
+    return cfg

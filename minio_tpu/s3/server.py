@@ -99,15 +99,16 @@ class S3Server:
         # Subsystems persist into the quorum sys store when the backend
         # provides one (erasure); memory-only otherwise.
         has_store = hasattr(object_layer, "read_sys_config")
-        store = object_layer if has_store else None
+        store = object_layer if has_store else _MemStore()
+        self.sys_store = store
         notify_bm = (notification_sys.invalidate_bucket_metadata
                      if notification_sys is not None else None)
         notify_iam = (notification_sys.reload_iam
                       if notification_sys is not None else None)
-        self.bucket_meta = BucketMetadataSys(store, notify=notify_bm) \
-            if has_store else BucketMetadataSys(_MemStore())
+        self.bucket_meta = BucketMetadataSys(store, notify=notify_bm)
         self.iam = IAMSys(credentials.access_key, credentials.secret_key,
-                          store=store, notify=notify_iam)
+                          store=store if has_store else None,
+                          notify=notify_iam)
 
         # Eventing: durable per-target queues under a local spool dir
         # (reference pkg/event/target/queuestore.go).
@@ -122,7 +123,13 @@ class S3Server:
         # cmd/http-stats.go, cmd/config/).
         self.stats = HTTPStats()
         self.trace_bus = PubSub()
-        self.config = ConfigSys(store)
+        self.config = ConfigSys(store if has_store else None)
+
+        # Replication plane (cmd/bucket-replication.go).
+        from minio_tpu.replication.pool import BucketTargetSys, ReplicationPool
+        self.bucket_targets = BucketTargetSys(store)
+        self.replication = ReplicationPool(object_layer, self.bucket_meta,
+                                           self.bucket_targets)
         self.admin = AdminAPI(self)
         self.local_locker = None  # set by the cluster node when distributed
 
@@ -601,6 +608,9 @@ class S3Server:
                        evt.OBJECT_REMOVED_DELETE_MARKER if info.delete_marker
                        else evt.OBJECT_REMOVED_DELETE,
                        bucket, key, version_id=info.version_id)
+            from minio_tpu.replication.pool import OP_DELETE, ReplicationTask
+            self.replication.queue_task(ReplicationTask(
+                bucket, key, op=OP_DELETE))
             return web.Response(status=204, headers={**hdr, **extra})
         raise S3Error("MethodNotAllowed", resource=path)
 
@@ -1031,6 +1041,10 @@ class S3Server:
                           payload_hash, auth_sig, run):
         opts.user_defined = _metadata_headers(request)
         self._apply_object_lock(request, bucket, opts)
+        repl_cfg = self.replication.config_for(bucket)
+        if repl_cfg is not None and repl_cfg.rule_for(key) is not None:
+            from minio_tpu.replication.rules import META_STATUS
+            opts.user_defined[META_STATUS] = "PENDING"
         spool, size = await self._spool_body(request, payload_hash, auth_sig)
         reader, size2 = self._maybe_compress_put(
             request, bucket, key, opts, spool, size)
@@ -1046,6 +1060,10 @@ class S3Server:
             extra["x-amz-version-id"] = info.version_id
         self._emit(request, evt.OBJECT_CREATED_PUT, bucket, key,
                    size=info.size, etag=info.etag, version_id=info.version_id)
+        if repl_cfg is not None:
+            from minio_tpu.replication.pool import ReplicationTask
+            self.replication.queue_task(ReplicationTask(
+                bucket, key, info.version_id))
         return web.Response(status=200, headers={**hdr, **extra})
 
     async def _put_part(self, request, bucket, key, upload_id, part_number,
@@ -1251,6 +1269,9 @@ def _metadata_headers(request) -> dict:
     tags = request.headers.get("x-amz-tagging")
     if tags:
         user_defined["x-amz-tagging"] = tags
+    repl = request.headers.get("x-amz-replication-status")
+    if repl:
+        user_defined["x-amz-replication-status"] = repl
     for hk, hv in request.headers.items():
         if hk.lower().startswith("x-amz-meta-"):
             user_defined[hk.lower()] = hv
@@ -1289,6 +1310,9 @@ def _object_headers(info) -> dict:
     for k, v in info.user_defined.items():
         if k.startswith("x-amz-meta-"):
             h[k] = v
+    repl = info.user_defined.get("x-amz-replication-status")
+    if repl:
+        h["x-amz-replication-status"] = repl
     tags = info.user_defined.get("x-amz-tagging")
     if tags:
         h["x-amz-tagging-count"] = str(len(urllib.parse.parse_qsl(tags)))

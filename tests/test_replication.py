@@ -1,0 +1,172 @@
+"""Bucket replication tests: rule parsing, and the live two-server flow —
+source replicates puts and deletes to a second in-process server
+(cmd/bucket-replication.go role)."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+from aiohttp import web
+
+from minio_tpu.replication import parse_replication_xml
+from minio_tpu.replication.rules import META_STATUS
+from tests.s3client import SigV4Client
+
+ACCESS, SECRET = "reproot", "reproot-secret"
+
+REPL_XML = b"""<ReplicationConfiguration>
+  <Rule><ID>r1</ID><Status>Enabled</Status><Priority>1</Priority>
+    <Filter><Prefix>docs/</Prefix></Filter>
+    <Destination><Bucket>arn:aws:s3:::mirror</Bucket></Destination>
+    <DeleteMarkerReplication><Status>Enabled</Status>
+    </DeleteMarkerReplication>
+    <DeleteReplication><Status>Enabled</Status></DeleteReplication>
+  </Rule>
+</ReplicationConfiguration>"""
+
+
+def test_parse_replication_xml():
+    cfg = parse_replication_xml(REPL_XML)
+    assert len(cfg.rules) == 1
+    r = cfg.rules[0]
+    assert r.target_bucket == "mirror" and r.prefix == "docs/"
+    assert r.delete_marker_replication and r.delete_replication
+    assert cfg.rule_for("docs/a.txt") is r
+    assert cfg.rule_for("other/a.txt") is None
+    with pytest.raises(ValueError):
+        parse_replication_xml(b"<ReplicationConfiguration Rule='x'>"
+                              b"</ReplicationConfiguration>")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _boot(tmp_path, name):
+    import asyncio
+
+    from minio_tpu.s3.server import build_server
+
+    srv = build_server([str(tmp_path / f"{name}{i}") for i in range(4)],
+                       ACCESS, SECRET)
+    port = _free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(srv.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(30)
+    return srv, f"http://127.0.0.1:{port}", loop
+
+
+@pytest.fixture()
+def pair(tmp_path):
+    src_srv, src_url, l1 = _boot(tmp_path, "src")
+    dst_srv, dst_url, l2 = _boot(tmp_path, "dst")
+    yield (src_srv, src_url), (dst_srv, dst_url)
+    src_srv.replication.close()
+    l1.call_soon_threadsafe(l1.stop)
+    l2.call_soon_threadsafe(l2.stop)
+
+
+def test_end_to_end_replication(pair):
+    (src_srv, src_url), (dst_srv, dst_url) = pair
+    src = SigV4Client(src_url, ACCESS, SECRET)
+    dst = SigV4Client(dst_url, ACCESS, SECRET)
+
+    assert src.put("/origin").status_code == 200
+    assert dst.put("/mirror").status_code == 200
+
+    # Register the remote target + replication config.
+    r = src.put("/minio/admin/v3/set-remote-target",
+                query={"bucket": "origin"},
+                data=json.dumps({"endpoint": dst_url, "accessKey": ACCESS,
+                                 "secretKey": SECRET,
+                                 "targetBucket": "mirror"}).encode())
+    assert r.status_code == 200, r.text
+    r = src.put("/origin", data=REPL_XML, query={"replication": ""})
+    assert r.status_code == 200, r.text
+
+    # Matching put replicates; status flips to COMPLETED at the source.
+    payload = b"replicate me" * 100
+    r = src.put("/origin/docs/a.txt", data=payload,
+                headers={"x-amz-meta-team": "infra"})
+    assert r.status_code == 200
+    src_srv.replication.drain()
+
+    r = dst.get("/mirror/docs/a.txt")
+    assert r.status_code == 200, r.text
+    assert r.content == payload
+    assert r.headers.get("x-amz-replication-status") == "REPLICA"
+    assert r.headers.get("x-amz-meta-team") == "infra"
+
+    deadline = time.time() + 5
+    status = ""
+    while time.time() < deadline:
+        info = src_srv.obj.get_object_info("origin", "docs/a.txt")
+        status = info.user_defined.get(META_STATUS, "")
+        if status == "COMPLETED":
+            break
+        time.sleep(0.05)
+    assert status == "COMPLETED"
+
+    # Non-matching prefix does not replicate.
+    src.put("/origin/other/b.txt", data=b"stays local")
+    src_srv.replication.drain()
+    assert dst.get("/mirror/other/b.txt").status_code == 404
+
+    # Delete replication.
+    assert src.delete("/origin/docs/a.txt").status_code == 204
+    src_srv.replication.drain()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if dst.get("/mirror/docs/a.txt").status_code == 404:
+            break
+        time.sleep(0.05)
+    assert dst.get("/mirror/docs/a.txt").status_code == 404
+
+    # Stats moved.
+    st = src_srv.replication.stats
+    assert st["completed"] >= 2 and st["queued"] >= 2
+
+
+def test_replication_failure_marks_failed(pair):
+    (src_srv, src_url), (dst_srv, dst_url) = pair
+    src = SigV4Client(src_url, ACCESS, SECRET)
+    assert src.put("/origin").status_code == 200
+    # Target endpoint is unreachable.
+    src.put("/minio/admin/v3/set-remote-target", query={"bucket": "origin"},
+            data=json.dumps({"endpoint": "http://127.0.0.1:1",
+                             "accessKey": "x", "secretKey": "y",
+                             "targetBucket": "mirror"}).encode())
+    src.put("/origin", data=REPL_XML, query={"replication": ""})
+    src.put("/origin/docs/fail.txt", data=b"x")
+    src_srv.replication.drain()
+    deadline = time.time() + 5
+    status = ""
+    while time.time() < deadline:
+        info = src_srv.obj.get_object_info("origin", "docs/fail.txt")
+        status = info.user_defined.get(META_STATUS, "")
+        if status == "FAILED":
+            break
+        time.sleep(0.05)
+    assert status == "FAILED"
+    assert src_srv.replication.stats["failed"] >= 1
