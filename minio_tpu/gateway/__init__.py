@@ -1,0 +1,26 @@
+"""Gateways: the S3 front door over non-erasure backends.
+
+Role-equivalent of cmd/gateway/ + cmd/gateway-main.go:155 StartGateway:
+each gateway implements the ObjectLayer seam, so the full middleware
+chain (auth, IAM, policies, eventing) applies unchanged.
+
+  nas  - shared-filesystem gateway: FSObjects over a mount path
+         (cmd/gateway/nas — 122 LoC in the reference, because it IS the
+         FS backend on a path; same here)
+  s3   - proxy gateway to any remote S3 endpoint (cmd/gateway/s3)
+
+Azure/GCS/HDFS gateways need their cloud SDKs (not in this image); the
+ObjectLayer protocol is the plug point.
+"""
+
+from minio_tpu.gateway.s3 import S3Gateway
+
+
+def nas_gateway(path: str):
+    """NAS gateway == the FS backend rooted at a shared mount."""
+    from minio_tpu.fs import FSObjects
+
+    return FSObjects(path)
+
+
+__all__ = ["S3Gateway", "nas_gateway"]

@@ -1,0 +1,345 @@
+"""S3 proxy gateway — ObjectLayer over a remote S3 endpoint.
+
+Role-equivalent of cmd/gateway/s3 (1807 LoC): serve our full front door
+(auth, IAM, policy, eventing, select) while objects live in another S3
+deployment. Multipart is assembled locally and pushed as one put — the
+reference proxies multipart natively; buffered assembly keeps this
+gateway dependency-free (document the 5 GiB practical cap).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import tempfile
+import time
+import uuid
+from typing import BinaryIO, Iterator
+
+from minio_tpu.erasure.healing import HealResultItem
+from minio_tpu.erasure.types import (
+    BucketInfo,
+    CompletePart,
+    DeletedObject,
+    ListObjectsInfo,
+    ListObjectVersionsInfo,
+    MultipartInfo,
+    ObjectInfo,
+    ObjectOptions,
+    ObjectToDelete,
+    PartInfoResult,
+)
+from minio_tpu.replication.client import RemoteS3Client, RemoteS3Error
+from minio_tpu.utils import errors as se
+
+
+def _map_error(e: RemoteS3Error, bucket: str = "", obj: str = ""):
+    if e.status == 404:
+        if obj:
+            return se.ObjectNotFound(bucket, obj)
+        return se.BucketNotFound(bucket)
+    if e.status in (301, 409):
+        return se.BucketExists(bucket)
+    if e.status == 403:
+        return se.FileAccessDenied(f"{bucket}/{obj}")
+    return se.FaultyDisk(str(e))
+
+
+def _parse_http_time(s: str) -> float:
+    for fmt in ("%Y-%m-%dT%H:%M:%S.%fZ", "%Y-%m-%dT%H:%M:%SZ",
+                "%a, %d %b %Y %H:%M:%S %Z"):
+        try:
+            return datetime.datetime.strptime(s, fmt).replace(
+                tzinfo=datetime.timezone.utc).timestamp()
+        except ValueError:
+            continue
+    return 0.0
+
+
+class S3Gateway:
+    def __init__(self, endpoint: str, access_key: str, secret_key: str,
+                 region: str = "us-east-1"):
+        self.client = RemoteS3Client(endpoint, access_key, secret_key,
+                                     region=region)
+        self._mp: dict[str, dict] = {}          # local multipart sessions
+        self._mp_dir = tempfile.mkdtemp(prefix="mtpu-s3gw-mp-")
+
+    def close(self) -> None:
+        pass
+
+    # -- buckets --
+
+    def make_bucket(self, bucket: str,
+                    opts: ObjectOptions | None = None) -> None:
+        try:
+            self.client.make_bucket(bucket)
+        except RemoteS3Error as e:
+            raise _map_error(e, bucket) from None
+
+    def get_bucket_info(self, bucket: str) -> BucketInfo:
+        if not self.client.bucket_exists(bucket):
+            raise se.BucketNotFound(bucket)
+        return BucketInfo(bucket, 0.0)
+
+    def list_buckets(self) -> list[BucketInfo]:
+        try:
+            return [BucketInfo(name, _parse_http_time(created))
+                    for name, created in self.client.list_buckets()]
+        except RemoteS3Error as e:
+            raise _map_error(e) from None
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        try:
+            self.client.delete_bucket(bucket)
+        except RemoteS3Error as e:
+            if e.status == 409:
+                raise se.BucketNotEmpty(bucket) from None
+            raise _map_error(e, bucket) from None
+
+    # -- objects --
+
+    def put_object(self, bucket: str, obj: str, data: BinaryIO,
+                   size: int = -1,
+                   opts: ObjectOptions | None = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        body = data.read(size) if size >= 0 else data.read(-1)
+        if size >= 0 and len(body) != size:
+            raise se.IncompleteBody(bucket, obj,
+                                    f"got {len(body)} of {size}")
+        headers = {k: v for k, v in opts.user_defined.items()
+                   if k.startswith("x-amz-meta-") or k == "content-type"}
+        try:
+            self.client.put_object(bucket, obj, body, headers)
+        except RemoteS3Error as e:
+            raise _map_error(e, bucket, obj) from None
+        return ObjectInfo(bucket=bucket, name=obj, size=len(body),
+                          etag=hashlib.md5(body).hexdigest(),
+                          mod_time=time.time(),
+                          user_defined=dict(opts.user_defined))
+
+    def get_object_info(self, bucket: str, obj: str,
+                        opts: ObjectOptions | None = None) -> ObjectInfo:
+        headers = self.client.head_object(bucket, obj)
+        if headers is None:
+            if not self.client.bucket_exists(bucket):
+                raise se.BucketNotFound(bucket)
+            raise se.ObjectNotFound(bucket, obj)
+        h = {k.lower(): v for k, v in headers.items()}
+        ud = {k: v for k, v in h.items() if k.startswith("x-amz-meta-")}
+        if "content-type" in h:
+            ud["content-type"] = h["content-type"]
+        return ObjectInfo(
+            bucket=bucket, name=obj,
+            size=int(h.get("content-length", "0")),
+            etag=h.get("etag", "").strip('"'),
+            mod_time=_parse_http_time(h.get("last-modified", "")),
+            content_type=h.get("content-type", ""), user_defined=ud)
+
+    def get_object(self, bucket: str, obj: str, offset: int = 0,
+                   length: int = -1, opts: ObjectOptions | None = None
+                   ) -> tuple[ObjectInfo, Iterator[bytes]]:
+        info = self.get_object_info(bucket, obj, opts)
+        if length < 0:
+            length = info.size - offset
+        if offset < 0 or length < 0 or offset + length > info.size:
+            raise se.InvalidRange(bucket, obj)
+        try:
+            if length == 0:
+                return info, iter(())
+            _, body = self.client.get_object(bucket, obj, offset, length)
+        except RemoteS3Error as e:
+            raise _map_error(e, bucket, obj) from None
+        return info, iter([body])
+
+    def delete_object(self, bucket: str, obj: str,
+                      opts: ObjectOptions | None = None) -> ObjectInfo:
+        self.get_object_info(bucket, obj, opts)  # 404 semantics
+        try:
+            self.client.delete_object(bucket, obj)
+        except RemoteS3Error as e:
+            raise _map_error(e, bucket, obj) from None
+        return ObjectInfo(bucket=bucket, name=obj)
+
+    def delete_objects(self, bucket: str, objects: list[ObjectToDelete],
+                       opts: ObjectOptions | None = None
+                       ) -> list[DeletedObject | Exception]:
+        out: list[DeletedObject | Exception] = []
+        for o in objects:
+            try:
+                self.delete_object(bucket, o.object_name, opts)
+                out.append(DeletedObject(object_name=o.object_name))
+            except Exception as e:  # noqa: BLE001
+                out.append(e)
+        return out
+
+    # -- metadata/tags (stored as remote metadata re-put; small objects) --
+
+    def put_object_metadata(self, bucket: str, obj: str, updates,
+                            opts: ObjectOptions | None = None) -> ObjectInfo:
+        info, stream = self.get_object(bucket, obj, opts=opts)
+        body = b"".join(stream)
+        ud = dict(info.user_defined)
+        for k, v in updates.items():
+            if v is None:
+                ud.pop(k, None)
+            else:
+                ud[k] = v
+        # Tags ride a dedicated meta key through the proxy.
+        headers = {k: v for k, v in ud.items()
+                   if k.startswith("x-amz-meta-") or k == "content-type"}
+        if "x-amz-tagging" in ud:
+            headers["x-amz-meta-mtpu-tagging"] = ud["x-amz-tagging"]
+        try:
+            self.client.put_object(bucket, obj, body, headers)
+        except RemoteS3Error as e:
+            raise _map_error(e, bucket, obj) from None
+        info.user_defined = ud
+        return info
+
+    def put_object_tags(self, bucket: str, obj: str, tags: str,
+                        opts: ObjectOptions | None = None) -> ObjectInfo:
+        return self.put_object_metadata(
+            bucket, obj, {"x-amz-tagging": tags or None}, opts)
+
+    def get_object_tags(self, bucket: str, obj: str,
+                        opts: ObjectOptions | None = None) -> str:
+        info = self.get_object_info(bucket, obj, opts)
+        return info.user_defined.get(
+            "x-amz-meta-mtpu-tagging",
+            info.user_defined.get("x-amz-tagging", ""))
+
+    def delete_object_tags(self, bucket: str, obj: str,
+                           opts: ObjectOptions | None = None) -> ObjectInfo:
+        return self.put_object_tags(bucket, obj, "", opts)
+
+    # -- listing --
+
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000
+                     ) -> ListObjectsInfo:
+        try:
+            objs, prefixes, truncated, token = self.client.list_objects(
+                bucket, prefix, marker, delimiter, max_keys)
+        except RemoteS3Error as e:
+            raise _map_error(e, bucket) from None
+        res = ListObjectsInfo(is_truncated=truncated, next_marker=token,
+                              prefixes=prefixes)
+        for o in objs:
+            res.objects.append(ObjectInfo(
+                bucket=bucket, name=o["key"], size=o["size"],
+                etag=o["etag"],
+                mod_time=_parse_http_time(o["last_modified"])))
+        return res
+
+    def list_object_versions(self, bucket: str, prefix: str = "",
+                             marker: str = "", version_marker: str = "",
+                             delimiter: str = "", max_keys: int = 1000
+                             ) -> ListObjectVersionsInfo:
+        flat = self.list_objects(bucket, prefix, marker, delimiter, max_keys)
+        return ListObjectVersionsInfo(
+            is_truncated=flat.is_truncated, next_marker=flat.next_marker,
+            objects=flat.objects, prefixes=flat.prefixes)
+
+    # -- multipart (assembled locally, pushed as one put) --
+
+    def new_multipart_upload(self, bucket: str, obj: str,
+                             opts: ObjectOptions | None = None) -> str:
+        self.get_bucket_info(bucket)
+        uid = uuid.uuid4().hex
+        self._mp[uid] = {"bucket": bucket, "object": obj,
+                         "initiated": time.time(), "parts": {},
+                         "metadata": dict((opts or ObjectOptions()
+                                           ).user_defined)}
+        return uid
+
+    def _session(self, bucket, obj, uid) -> dict:
+        s = self._mp.get(uid)
+        if s is None or s["bucket"] != bucket or s["object"] != obj:
+            raise se.InvalidUploadID(bucket, obj, uid)
+        return s
+
+    def put_object_part(self, bucket: str, obj: str, upload_id: str,
+                        part_number: int, data: BinaryIO, size: int = -1
+                        ) -> PartInfoResult:
+        s = self._session(bucket, obj, upload_id)
+        body = data.read(size) if size >= 0 else data.read(-1)
+        etag = hashlib.md5(body).hexdigest()
+        s["parts"][part_number] = (etag, body)
+        return PartInfoResult(part_number=part_number, etag=etag,
+                              size=len(body), actual_size=len(body),
+                              last_modified=time.time())
+
+    def list_parts(self, bucket: str, obj: str, upload_id: str,
+                   part_marker: int = 0, max_parts: int = 1000
+                   ) -> list[PartInfoResult]:
+        s = self._session(bucket, obj, upload_id)
+        return [PartInfoResult(part_number=n, etag=e, size=len(b),
+                               actual_size=len(b))
+                for n, (e, b) in sorted(s["parts"].items())
+                if n > part_marker][:max_parts]
+
+    def list_multipart_uploads(self, bucket: str, prefix: str = "",
+                               max_uploads: int = 1000
+                               ) -> list[MultipartInfo]:
+        return [MultipartInfo(bucket=bucket, object=s["object"],
+                              upload_id=uid, initiated=s["initiated"],
+                              user_defined=s["metadata"])
+                for uid, s in sorted(self._mp.items())
+                if s["bucket"] == bucket
+                and s["object"].startswith(prefix)][:max_uploads]
+
+    def abort_multipart_upload(self, bucket: str, obj: str,
+                               upload_id: str) -> None:
+        self._session(bucket, obj, upload_id)
+        del self._mp[upload_id]
+
+    def complete_multipart_upload(self, bucket: str, obj: str,
+                                  upload_id: str, parts: list[CompletePart],
+                                  opts: ObjectOptions | None = None
+                                  ) -> ObjectInfo:
+        s = self._session(bucket, obj, upload_id)
+        body = bytearray()
+        md5s = hashlib.md5()
+        for cp in parts:
+            have = s["parts"].get(cp.part_number)
+            if have is None or have[0] != cp.etag.strip('"'):
+                raise se.InvalidPart(bucket, obj, f"part {cp.part_number}")
+            md5s.update(bytes.fromhex(have[0]))
+            body += have[1]
+        headers = {k: v for k, v in s["metadata"].items()
+                   if k.startswith("x-amz-meta-") or k == "content-type"}
+        try:
+            self.client.put_object(bucket, obj, bytes(body), headers)
+        except RemoteS3Error as e:
+            raise _map_error(e, bucket, obj) from None
+        del self._mp[upload_id]
+        return ObjectInfo(bucket=bucket, name=obj, size=len(body),
+                          etag=f"{md5s.hexdigest()}-{len(parts)}",
+                          mod_time=time.time(),
+                          user_defined=s["metadata"])
+
+    # -- heal/health: the remote owns durability --
+
+    def heal_bucket(self, bucket: str, dry_run: bool = False) -> HealResultItem:
+        self.get_bucket_info(bucket)
+        return HealResultItem(bucket=bucket)
+
+    def heal_object(self, bucket: str, obj: str, version_id: str = "",
+                    **kw) -> HealResultItem:
+        return HealResultItem(bucket=bucket, object=obj)
+
+    def heal_objects(self, bucket: str, prefix: str = "", **kw):
+        return iter(())
+
+    def health(self) -> dict:
+        try:
+            self.client.list_buckets()
+            ok = True
+        except Exception:  # noqa: BLE001
+            ok = False
+        return {"healthy": ok,
+                "sets": [{"online": 1 if ok else 0, "total": 1,
+                          "write_quorum": 1}]}
+
+    def all_drives(self):
+        return []

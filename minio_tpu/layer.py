@@ -157,6 +157,9 @@ def _register_backends() -> None:
     ObjectLayer.register(ErasureSets)
     ObjectLayer.register(ErasureServerPools)
     ObjectLayer.register(FSObjects)
+    from minio_tpu.gateway.s3 import S3Gateway
+
+    ObjectLayer.register(S3Gateway)
 
 
 _register_backends()

@@ -34,7 +34,7 @@ class RemoteS3Client:
 
     # -- signing (independent SigV4 implementation) --
 
-    def _sign(self, method: str, path: str, headers: dict,
+    def _sign(self, method: str, path: str, query: str, headers: dict,
               payload_hash: str) -> dict:
         now = datetime.datetime.now(datetime.timezone.utc)
         amz_date = now.strftime("%Y%m%dT%H%M%SZ")
@@ -44,10 +44,15 @@ class RemoteS3Client:
         headers["x-amz-date"] = amz_date
         headers["x-amz-content-sha256"] = payload_hash
         signed = sorted(headers)
+        cq = "&".join(sorted(
+            f"{urllib.parse.quote(k, safe='-._~')}="
+            f"{urllib.parse.quote(v, safe='-._~')}"
+            for k, v in urllib.parse.parse_qsl(query,
+                                               keep_blank_values=True)))
         canonical = "\n".join([
             method,
             urllib.parse.quote(path, safe="/-._~"),
-            "",
+            cq,
             "".join(f"{h}:{' '.join(headers[h].split())}\n" for h in signed),
             ";".join(signed),
             payload_hash,
@@ -67,7 +72,9 @@ class RemoteS3Client:
     def _request(self, method: str, path: str, body: bytes = b"",
                  headers: dict | None = None) -> tuple[int, dict, bytes]:
         payload_hash = hashlib.sha256(body).hexdigest()
-        hdrs = self._sign(method, path, dict(headers or {}), payload_hash)
+        raw_path, _, query = path.partition("?")
+        hdrs = self._sign(method, raw_path, query, dict(headers or {}),
+                          payload_hash)
         cls = (http.client.HTTPSConnection if self.https
                else http.client.HTTPConnection)
         conn = cls(self.host, self.port, timeout=self.timeout)
@@ -107,3 +114,91 @@ class RemoteS3Client:
     def bucket_exists(self, bucket: str) -> bool:
         st, _, _ = self._request("HEAD", f"/{bucket}")
         return st // 100 == 2
+
+
+# --- extended verbs (gateway/s3.py uses these; replication does not) --------
+
+def _extend(cls):
+    import xml.etree.ElementTree as _ET
+
+    def get_object(self, bucket, key, offset=0, length=-1):
+        headers = {}
+        if offset or length >= 0:
+            end = "" if length < 0 else str(offset + length - 1)
+            headers["Range"] = f"bytes={offset}-{end}"
+        st, hdrs, body = self._request(
+            "GET", f"/{bucket}/{urllib.parse.quote(key)}", b"", headers)
+        if st == 404:
+            raise RemoteS3Error(404, "NoSuchKey")
+        if st // 100 != 2:
+            raise RemoteS3Error(st, body.decode(errors="replace"))
+        return hdrs, body
+
+    def make_bucket(self, bucket):
+        st, _, body = self._request("PUT", f"/{bucket}")
+        if st // 100 != 2:
+            raise RemoteS3Error(st, body.decode(errors="replace"))
+
+    def delete_bucket(self, bucket):
+        st, _, body = self._request("DELETE", f"/{bucket}")
+        if st not in (200, 204):
+            raise RemoteS3Error(st, body.decode(errors="replace"))
+
+    def list_buckets(self):
+        st, _, body = self._request("GET", "/")
+        if st // 100 != 2:
+            raise RemoteS3Error(st, body.decode(errors="replace"))
+        out = []
+        root = _ET.fromstring(body)
+        for b in root.iter():
+            if b.tag.split("}")[-1] == "Bucket":
+                name = created = ""
+                for c in b:
+                    t = c.tag.split("}")[-1]
+                    if t == "Name":
+                        name = c.text or ""
+                    elif t == "CreationDate":
+                        created = c.text or ""
+                out.append((name, created))
+        return out
+
+    def list_objects(self, bucket, prefix="", marker="", delimiter="",
+                     max_keys=1000):
+        q = urllib.parse.urlencode({
+            "list-type": "2", "prefix": prefix, "delimiter": delimiter,
+            "max-keys": str(max_keys),
+            **({"continuation-token": marker} if marker else {})})
+        st, _, body = self._request("GET", f"/{bucket}?{q}")
+        if st // 100 != 2:
+            raise RemoteS3Error(st, body.decode(errors="replace"))
+        root = _ET.fromstring(body)
+
+        def _t(node, name, default=""):
+            for c in node:
+                if c.tag.split("}")[-1] == name:
+                    return c.text or default
+            return default
+
+        objects, prefixes = [], []
+        truncated = _t(root, "IsTruncated") == "true"
+        next_token = _t(root, "NextContinuationToken")
+        for node in root:
+            t = node.tag.split("}")[-1]
+            if t == "Contents":
+                objects.append({
+                    "key": _t(node, "Key"), "size": int(_t(node, "Size", "0")),
+                    "etag": _t(node, "ETag").strip('"'),
+                    "last_modified": _t(node, "LastModified")})
+            elif t == "CommonPrefixes":
+                prefixes.append(_t(node, "Prefix"))
+        return objects, prefixes, truncated, next_token
+
+    cls.get_object = get_object
+    cls.make_bucket = make_bucket
+    cls.delete_bucket = delete_bucket
+    cls.list_buckets = list_buckets
+    cls.list_objects = list_objects
+    return cls
+
+
+_extend(RemoteS3Client)

@@ -1409,9 +1409,30 @@ def build_server(drive_paths: list[str], access_key: str, secret_key: str,
                     versioned_buckets=versioned)
 
 
+def build_gateway_server(kind: str, target: str, access_key: str,
+                         secret_key: str,
+                         remote_access: str = "", remote_secret: str = ""
+                         ) -> S3Server:
+    """Gateway modes (reference StartGateway, cmd/gateway-main.go:155):
+    nas <path> | s3 <endpoint>."""
+    from minio_tpu.gateway import S3Gateway, nas_gateway
+
+    if kind == "nas":
+        layer = nas_gateway(target)
+    elif kind == "s3":
+        layer = S3Gateway(target, remote_access or access_key,
+                          remote_secret or secret_key)
+    else:
+        raise ValueError(f"unknown gateway {kind!r} (nas|s3)")
+    return S3Server(layer, sigv4.Credentials(access_key, secret_key))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description="minio_tpu S3 server")
     ap.add_argument("drives", nargs="+", help="drive directories")
+    ap.add_argument("--gateway", default="",
+                    help="gateway mode: nas|s3 (drives arg becomes the "
+                         "path/endpoint)")
     ap.add_argument("--address", default="0.0.0.0:9000")
     ap.add_argument("--versioned", action="store_true")
     ap.add_argument("--parity", type=int, default=None)
@@ -1423,6 +1444,15 @@ def main(argv=None):
     host, _, port = args.address.rpartition(":")
     access = os.environ.get("MTPU_ROOT_USER", "minioadmin")
     secret = os.environ.get("MTPU_ROOT_PASSWORD", "minioadmin")
+    if args.gateway:
+        srv = build_gateway_server(
+            args.gateway, args.drives[0], access, secret,
+            remote_access=os.environ.get("MTPU_GATEWAY_ACCESS_KEY", ""),
+            remote_secret=os.environ.get("MTPU_GATEWAY_SECRET_KEY", ""))
+        web.run_app(srv.app, host=(args.address.rpartition(":")[0]
+                                   or "0.0.0.0"),
+                    port=int(args.address.rpartition(":")[2]))
+        return
     srv = build_server(args.drives, access, secret,
                        versioned=args.versioned, parity=args.parity,
                        set_drive_count=args.set_drives,
