@@ -1,0 +1,5 @@
+"""Disk cache — read/write-through caching ObjectLayer decorator."""
+
+from minio_tpu.cache.disk import CacheObjects
+
+__all__ = ["CacheObjects"]

@@ -1,0 +1,209 @@
+"""CacheObjects — local-SSD read/write-through cache over any ObjectLayer.
+
+Role-equivalent of cmd/disk-cache.go:88 (cacheObjects) +
+cmd/disk-cache-backend.go: GETs fill the cache and later hits serve from
+local disk with an ETag revalidation against the backend; PUTs write
+through; deletes evict; an LRU garbage collector holds the cache under
+its quota. Every other ObjectLayer method delegates untouched, so the
+cache stacks over erasure pools and gateways alike (the reference wraps
+gateways the same way, cmd/server-main.go newServerCacheObjects).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import BinaryIO, Iterator
+
+from minio_tpu.utils import errors as se
+
+GC_LOW_WATERMARK = 0.8       # evict down to 80% of quota
+
+
+class CacheObjects:
+    def __init__(self, inner, cache_dir: str,
+                 quota_bytes: int = 1 << 30,
+                 revalidate_after: float = 5.0):
+        """revalidate_after: cached entries younger than this serve
+        without a backend HEAD (the reference's cache freshness window);
+        older hits revalidate by ETag."""
+        self.inner = inner
+        self.dir = cache_dir
+        self.quota = quota_bytes
+        self.revalidate_after = revalidate_after
+        os.makedirs(cache_dir, exist_ok=True)
+        self._mu = threading.Lock()
+        self.stats = {"hits": 0, "misses": 0, "evictions": 0,
+                      "revalidations": 0}
+
+    # -- entry layout --
+
+    def _paths(self, bucket: str, obj: str) -> tuple[str, str]:
+        h = hashlib.sha256(f"{bucket}/{obj}".encode()).hexdigest()
+        base = os.path.join(self.dir, h[:2], h)
+        return base + ".data", base + ".meta"
+
+    def _load_meta(self, mp: str) -> dict | None:
+        try:
+            with open(mp) as f:
+                return json.load(f)
+        except (FileNotFoundError, ValueError):
+            return None
+
+    def _store(self, bucket: str, obj: str, info, data: bytes) -> None:
+        dp, mp = self._paths(bucket, obj)
+        os.makedirs(os.path.dirname(dp), exist_ok=True)
+        tmp = dp + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, dp)
+        with open(mp + ".tmp", "w") as f:
+            json.dump({"etag": info.etag, "size": len(data),
+                       "mod_time": info.mod_time,
+                       "cached_at": time.time(),
+                       "content_type": info.content_type,
+                       "user_defined": info.user_defined,
+                       "bucket": bucket, "object": obj}, f)
+        os.replace(mp + ".tmp", mp)
+        self._gc()
+
+    def _evict(self, bucket: str, obj: str) -> None:
+        dp, mp = self._paths(bucket, obj)
+        for p in (dp, mp):
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
+
+    # -- garbage collection (LRU by atime) --
+
+    def _gc(self) -> None:
+        with self._mu:
+            entries = []
+            total = 0
+            for sub in os.listdir(self.dir):
+                d = os.path.join(self.dir, sub)
+                if not os.path.isdir(d):
+                    continue
+                for name in os.listdir(d):
+                    if not name.endswith(".data"):
+                        continue
+                    p = os.path.join(d, name)
+                    try:
+                        st = os.stat(p)
+                    except FileNotFoundError:
+                        continue
+                    entries.append((st.st_atime, st.st_size, p))
+                    total += st.st_size
+            if total <= self.quota:
+                return
+            entries.sort()
+            target = int(self.quota * GC_LOW_WATERMARK)
+            for _, size, p in entries:
+                if total <= target:
+                    break
+                for victim in (p, p[:-5] + ".meta"):
+                    try:
+                        os.remove(victim)
+                    except FileNotFoundError:
+                        pass
+                total -= size
+                self.stats["evictions"] += 1
+
+    # -- the cached read path --
+
+    def get_object(self, bucket: str, obj: str, offset: int = 0,
+                   length: int = -1, opts=None):
+        from minio_tpu.erasure.types import ObjectInfo
+
+        version = getattr(opts, "version_id", "") if opts else ""
+        if version:  # versioned reads bypass the cache (latest-only cache)
+            return self.inner.get_object(bucket, obj, offset, length, opts)
+
+        dp, mp = self._paths(bucket, obj)
+        meta = self._load_meta(mp)
+        if meta is not None:
+            fresh = time.time() - meta.get("cached_at", 0) < self.revalidate_after
+            valid = fresh
+            if not fresh:
+                try:
+                    cur = self.inner.get_object_info(bucket, obj, opts)
+                    valid = cur.etag == meta["etag"]
+                    self.stats["revalidations"] += 1
+                except (se.ObjectError, se.StorageError):
+                    valid = False
+            if valid:
+                try:
+                    with open(dp, "rb") as f:
+                        data = f.read()
+                    os.utime(dp)  # LRU touch
+                except FileNotFoundError:
+                    data = None
+                if data is not None and len(data) == meta["size"]:
+                    self.stats["hits"] += 1
+                    end = meta["size"] if length < 0 else offset + length
+                    if offset < 0 or end > meta["size"]:
+                        raise se.InvalidRange(bucket, obj)
+                    info = ObjectInfo(
+                        bucket=bucket, name=obj, size=meta["size"],
+                        etag=meta["etag"], mod_time=meta["mod_time"],
+                        content_type=meta.get("content_type", ""),
+                        user_defined=dict(meta.get("user_defined", {})))
+                    return info, iter([data[offset:end]])
+            self._evict(bucket, obj)
+
+        self.stats["misses"] += 1
+        info, stream = self.inner.get_object(bucket, obj, 0, -1, opts)
+        data = b"".join(stream)
+        self._store(bucket, obj, info, data)
+        end = len(data) if length < 0 else offset + length
+        if offset < 0 or end > len(data):
+            raise se.InvalidRange(bucket, obj)
+        return info, iter([data[offset:end]])
+
+    # -- write-through + eviction hooks --
+
+    def put_object(self, bucket: str, obj: str, data: BinaryIO,
+                   size: int = -1, opts=None):
+        info = self.inner.put_object(bucket, obj, data, size, opts)
+        self._evict(bucket, obj)  # next read re-fills with committed bytes
+        return info
+
+    def delete_object(self, bucket: str, obj: str, opts=None):
+        out = self.inner.delete_object(bucket, obj, opts)
+        self._evict(bucket, obj)
+        return out
+
+    def delete_objects(self, bucket: str, objects, opts=None):
+        out = self.inner.delete_objects(bucket, objects, opts)
+        for o in objects:
+            self._evict(bucket, o.object_name)
+        return out
+
+    def put_object_metadata(self, bucket: str, obj: str, updates, opts=None):
+        out = self.inner.put_object_metadata(bucket, obj, updates, opts)
+        self._evict(bucket, obj)
+        return out
+
+    def put_object_tags(self, bucket: str, obj: str, tags: str, opts=None):
+        out = self.inner.put_object_tags(bucket, obj, tags, opts)
+        self._evict(bucket, obj)
+        return out
+
+    def complete_multipart_upload(self, bucket, obj, upload_id, parts,
+                                  opts=None):
+        out = self.inner.complete_multipart_upload(bucket, obj, upload_id,
+                                                   parts, opts)
+        self._evict(bucket, obj)
+        return out
+
+    def delete_bucket(self, bucket: str, force: bool = False):
+        return self.inner.delete_bucket(bucket, force)
+
+    # -- everything else delegates --
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

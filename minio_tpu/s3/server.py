@@ -1440,6 +1440,10 @@ def main(argv=None):
                     help="drives per erasure set (default: all drives, one set)")
     ap.add_argument("--scan-interval", type=float, default=60.0,
                     help="background scanner cycle pause (seconds; 0 disables)")
+    ap.add_argument("--cache-dir", default="",
+                    help="local SSD cache directory (enables the disk cache)")
+    ap.add_argument("--cache-quota", type=int, default=1 << 30,
+                    help="disk cache quota in bytes")
     args = ap.parse_args(argv)
     host, _, port = args.address.rpartition(":")
     access = os.environ.get("MTPU_ROOT_USER", "minioadmin")
@@ -1457,6 +1461,11 @@ def main(argv=None):
                        versioned=args.versioned, parity=args.parity,
                        set_drive_count=args.set_drives,
                        server_addr=args.address)
+    if args.cache_dir:
+        from minio_tpu.cache import CacheObjects
+
+        srv.obj = CacheObjects(srv.obj, args.cache_dir,
+                               quota_bytes=args.cache_quota)
     if args.scan_interval > 0:
         srv.start_scanner(interval=args.scan_interval)
     web.run_app(srv.app, host=host or "0.0.0.0", port=int(port))
