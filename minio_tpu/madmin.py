@@ -1,0 +1,145 @@
+"""AdminClient — the Python SDK for the admin API.
+
+Role-equivalent of pkg/madmin (5.8k LoC in the reference — the client
+`mc admin` builds on): typed wrappers over /minio/admin/v3 with SigV4
+signing, reusing the same independent signer the replication client uses.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+
+from minio_tpu.replication.client import RemoteS3Client, RemoteS3Error
+
+ADMIN = "/minio/admin/v3"
+
+
+class AdminClient(RemoteS3Client):
+    """AdminClient("http://host:9000", access, secret)."""
+
+    # -- plumbing --
+
+    def _admin(self, method: str, op: str, params: dict | None = None,
+               body: bytes = b"") -> bytes:
+        qs = urllib.parse.urlencode(params or {})
+        path = f"{ADMIN}/{op}" + (f"?{qs}" if qs else "")
+        st, _, data = self._request(method, path, body)
+        if st // 100 != 2:
+            raise RemoteS3Error(st, data.decode(errors="replace"))
+        return data
+
+    def _admin_json(self, method: str, op: str, params: dict | None = None,
+                    body: bytes = b""):
+        data = self._admin(method, op, params, body)
+        return json.loads(data) if data else None
+
+    # -- server --
+
+    def server_info(self) -> dict:
+        return self._admin_json("GET", "info")
+
+    def data_usage_info(self) -> dict:
+        return self._admin_json("GET", "datausageinfo")
+
+    def metrics(self) -> str:
+        st, _, data = self._request("GET", "/minio/v2/metrics/cluster")
+        if st // 100 != 2:
+            raise RemoteS3Error(st)
+        return data.decode()
+
+    def top_locks(self) -> dict:
+        return self._admin_json("GET", "top/locks")
+
+    # -- heal --
+
+    def heal(self, bucket: str = "", prefix: str = "",
+             dry_run: bool = False) -> dict:
+        op = "heal"
+        if bucket:
+            op += f"/{bucket}"
+            if prefix:
+                op += f"/{prefix}"
+        return self._admin_json("POST", op,
+                                body=json.dumps({"dryRun": dry_run}).encode())
+
+    # -- config --
+
+    def get_config(self, subsys: str = "") -> dict:
+        params = {"subsys": subsys} if subsys else {}
+        return self._admin_json("GET", "config-kv", params)
+
+    def set_config(self, subsys: str, kv: dict) -> dict:
+        return self._admin_json("PUT", "config-kv",
+                                body=json.dumps({subsys: kv}).encode())
+
+    # -- IAM --
+
+    def add_user(self, access_key: str, secret_key: str) -> None:
+        self._admin("PUT", "add-user", {"accessKey": access_key},
+                    json.dumps({"secretKey": secret_key}).encode())
+
+    def remove_user(self, access_key: str) -> None:
+        self._admin("DELETE", "remove-user", {"accessKey": access_key})
+
+    def list_users(self) -> dict:
+        return self._admin_json("GET", "list-users")
+
+    def set_user_status(self, access_key: str, status: str) -> None:
+        self._admin("PUT", "set-user-status",
+                    {"accessKey": access_key, "status": status})
+
+    def add_canned_policy(self, name: str, policy_json: str) -> None:
+        self._admin("PUT", "add-canned-policy", {"name": name},
+                    policy_json.encode())
+
+    def remove_canned_policy(self, name: str) -> None:
+        self._admin("DELETE", "remove-canned-policy", {"name": name})
+
+    def list_canned_policies(self) -> dict:
+        return self._admin_json("GET", "list-canned-policies")
+
+    def set_policy(self, user_or_group: str, policies: list[str],
+                   group: bool = False) -> None:
+        self._admin("PUT", "set-user-or-group-policy",
+                    {"userOrGroup": user_or_group,
+                     "policyName": ",".join(policies),
+                     "isGroup": "true" if group else "false"})
+
+    def update_group_members(self, group: str, members: list[str],
+                             remove: bool = False) -> None:
+        self._admin("PUT", "update-group-members", None,
+                    json.dumps({"group": group, "members": members,
+                                "isRemove": remove}).encode())
+
+    def add_service_account(self, parent: str = "",
+                            policy: str = "") -> dict:
+        doc = self._admin_json(
+            "PUT", "add-service-account", None,
+            json.dumps({"parent": parent, "policy": policy}).encode())
+        return doc["credentials"]
+
+    def delete_service_account(self, access_key: str) -> None:
+        self._admin("DELETE", "delete-service-account",
+                    {"accessKey": access_key})
+
+    # -- replication targets --
+
+    def set_remote_target(self, bucket: str, endpoint: str,
+                          access_key: str, secret_key: str,
+                          target_bucket: str = "") -> None:
+        self._admin("PUT", "set-remote-target", {"bucket": bucket},
+                    json.dumps({"endpoint": endpoint,
+                                "accessKey": access_key,
+                                "secretKey": secret_key,
+                                "targetBucket": target_bucket}).encode())
+
+    def list_remote_targets(self, bucket: str) -> list:
+        return self._admin_json("GET", "list-remote-targets",
+                                {"bucket": bucket})
+
+    def remove_remote_target(self, bucket: str) -> None:
+        self._admin("DELETE", "remove-remote-target", {"bucket": bucket})
+
+    def replication_status(self) -> dict:
+        return self._admin_json("GET", "replication-status")

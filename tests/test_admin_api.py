@@ -218,3 +218,43 @@ def test_trace_stream(server, client):
     assert got, "no trace record received"
     assert got[0]["api"] in ("GetObject", "admin.trace")
     assert got[0]["status"] in (200, 206)
+
+
+# ---------------- the AdminClient SDK (pkg/madmin role) ----------------
+
+def test_madmin_client_end_to_end(server):
+    base, srv = server
+    from minio_tpu.madmin import AdminClient
+    from minio_tpu.replication.client import RemoteS3Error
+
+    mc = AdminClient(base, ACCESS, SECRET)
+
+    info = mc.server_info()
+    assert info["drivesOnline"] == 4
+
+    cfg = mc.get_config("api")
+    assert "api" in cfg
+    mc.set_config("heal", {"bitrotscan": "on"})
+    assert mc.get_config("heal")["heal"]["bitrotscan"] == "on"
+
+    mc.add_user("sdkuser", "sdkuser-secret12")
+    mc.set_policy("sdkuser", ["readwrite"])
+    assert "sdkuser" in mc.list_users()
+    sa = mc.add_service_account(parent="sdkuser")
+    assert sa["accessKey"]
+    mc.delete_service_account(sa["accessKey"])
+    mc.set_user_status("sdkuser", "off")
+    mc.remove_user("sdkuser")
+    assert "sdkuser" not in mc.list_users()
+
+    assert "minio_tpu_s3_requests_total" in mc.metrics()
+    assert "locks" in mc.top_locks()
+
+    res = mc.heal("healbkt")
+    assert any(i.get("object") == "obj" for i in res["items"])
+
+    # Bad credentials rejected.
+    bad = AdminClient(base, ACCESS, "wrong-secret")
+    import pytest as _pytest
+    with _pytest.raises(RemoteS3Error):
+        bad.server_info()
