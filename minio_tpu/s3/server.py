@@ -133,6 +133,9 @@ class S3Server:
         self.admin = AdminAPI(self)
         self.local_locker = None  # set by the cluster node when distributed
 
+        from minio_tpu.s3.web import WebAPI
+        self.web = WebAPI(self)
+
     def start_scanner(self, interval: float = 60.0,
                       heal_objects: bool = True) -> None:
         """Boot the background data scanner (reference initDataScanner,
@@ -193,6 +196,9 @@ class S3Server:
         except S3Error as e:
             resp = self._error_response(e, path, request_id)
             return resp
+        except web.HTTPException as e:  # web-console handlers raise these
+            resp = e
+            raise
         except Exception as e:  # noqa: BLE001 - surface as S3 InternalError
             resp = self._error_response(from_exception(e, path), path, request_id)
             return resp
@@ -278,6 +284,17 @@ class S3Server:
                     "/", 1)[0]
                 return await self.admin.handle(
                     request, path[len(ADMIN_PREFIX):], identity)
+            if path == "/minio/webrpc":
+                request["api"] = "webrpc"
+                return await self.web.rpc(request)
+            if path.startswith("/minio/upload/"):
+                request["api"] = "webupload"
+                b, _, k = path[len("/minio/upload/"):].partition("/")
+                return await self.web.upload(request, b, k)
+            if path.startswith("/minio/download/"):
+                request["api"] = "webdownload"
+                b, _, k = path[len("/minio/download/"):].partition("/")
+                return await self.web.download(request, b, k)
             if path == "/minio/v2/metrics/cluster":
                 request["api"] = "metrics"
                 self.admin._authorize(identity, "admin:Prometheus")

@@ -1,0 +1,318 @@
+"""Web console backend: JSON-RPC 2.0 + JWT upload/download.
+
+Role-equivalent of cmd/web-handlers.go:102-1358 + cmd/web-router.go:55 +
+cmd/jwt/: the API the browser console talks to — Login issues a JWT bound
+to an IAM identity; RPC methods cover bucket/object browsing and
+management; upload/download endpoints stream bodies with the JWT (or a
+short-lived URL token for downloads, matching CreateURLToken).
+
+Mounted at /minio/webrpc (RPC), /minio/upload/{bucket}/{object},
+/minio/download/{bucket}/{object}?token=...
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+import urllib.parse
+
+from aiohttp import web
+
+from minio_tpu.iam.policy import PolicyArgs
+from minio_tpu.utils import errors as se
+
+TOKEN_TTL = 24 * 3600.0
+URL_TOKEN_TTL = 60.0
+
+
+# --- JWT (HMAC-SHA256, cmd/jwt role) ----------------------------------------
+
+def _b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).decode().rstrip("=")
+
+
+def _unb64(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def make_jwt(secret: str, access_key: str, ttl: float = TOKEN_TTL) -> str:
+    header = _b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    payload = _b64(json.dumps({"sub": access_key,
+                               "exp": time.time() + ttl}).encode())
+    signing = f"{header}.{payload}".encode()
+    sig = _b64(hmac.new(secret.encode(), signing, hashlib.sha256).digest())
+    return f"{header}.{payload}.{sig}"
+
+
+def verify_jwt(secret: str, token: str) -> str | None:
+    """Returns the access key, or None."""
+    try:
+        header, payload, sig = token.split(".")
+        signing = f"{header}.{payload}".encode()
+        want = _b64(hmac.new(secret.encode(), signing,
+                             hashlib.sha256).digest())
+        if not hmac.compare_digest(want, sig):
+            return None
+        doc = json.loads(_unb64(payload))
+        if doc.get("exp", 0) < time.time():
+            return None
+        return doc.get("sub")
+    except Exception:  # noqa: BLE001
+        return None
+
+
+class WebAPI:
+    """The RPC surface. `server` is the S3Server."""
+
+    def __init__(self, server):
+        self.s = server
+
+    # -- auth plumbing --
+
+    def _jwt_secret(self) -> str:
+        return "mtpu-web-jwt:" + self.s.creds.secret_key
+
+    def _identity_from(self, request) -> "object | None":
+        auth = request.headers.get("Authorization", "")
+        token = auth[7:] if auth.startswith("Bearer ") else ""
+        ak = verify_jwt(self._jwt_secret(), token)
+        if ak is None:
+            return None
+        try:
+            return self.s.iam.identify(ak)
+        except se.InvalidAccessKey:
+            return None
+
+    def _allowed(self, ident, action: str, bucket: str = "",
+                 obj: str = "") -> bool:
+        return self.s.iam.is_allowed(
+            ident, PolicyArgs(action=action, bucket=bucket, object=obj))
+
+    # -- JSON-RPC 2.0 endpoint --
+
+    async def rpc(self, request: web.Request) -> web.Response:
+        try:
+            req = json.loads(await request.read())
+        except ValueError:
+            return _rpc_error(None, -32700, "parse error")
+        rid = req.get("id")
+        method = str(req.get("method", ""))
+        params = req.get("params") or {}
+        short = method.rsplit(".", 1)[-1]
+
+        if short == "Login":
+            return await self._login(rid, params)
+
+        ident = self._identity_from(request)
+        if ident is None:
+            return _rpc_error(rid, 401, "invalid or expired token")
+
+        handlers = {
+            "ListBuckets": self._list_buckets,
+            "MakeBucket": self._make_bucket,
+            "DeleteBucket": self._delete_bucket,
+            "ListObjects": self._list_objects,
+            "RemoveObject": self._remove_objects,
+            "ServerInfo": self._server_info,
+            "StorageInfo": self._storage_info,
+            "CreateURLToken": self._create_url_token,
+            "PresignedGet": self._presigned_get,
+        }
+        fn = handlers.get(short)
+        if fn is None:
+            return _rpc_error(rid, -32601, f"unknown method {method}")
+        try:
+            result = await fn(ident, params)
+        except (se.ObjectError, se.StorageError) as e:
+            return _rpc_error(rid, 500, str(e))
+        except PermissionError as e:
+            return _rpc_error(rid, 403, str(e))
+        return _rpc_result(rid, result)
+
+    async def _login(self, rid, params) -> web.Response:
+        ak = params.get("username", "")
+        sk = params.get("password", "")
+        try:
+            if self.s.iam.get_secret(ak) != sk:
+                raise se.InvalidAccessKey(ak)
+        except se.InvalidAccessKey:
+            return _rpc_error(rid, 401, "invalid credentials")
+        return _rpc_result(rid, {
+            "token": make_jwt(self._jwt_secret(), ak),
+            "uiVersion": "minio_tpu-console/1.0"})
+
+    # -- methods --
+
+    async def _list_buckets(self, ident, params):
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        buckets = await loop.run_in_executor(None, self.s.obj.list_buckets)
+        out = []
+        for b in buckets:
+            if ident.is_owner or self._allowed(ident, "s3:ListBucket", b.name):
+                out.append({"name": b.name, "creationDate": b.created})
+        return {"buckets": out}
+
+    async def _make_bucket(self, ident, params):
+        bucket = params["bucketName"]
+        if not self._allowed(ident, "s3:CreateBucket", bucket):
+            raise PermissionError("CreateBucket denied")
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self.s.obj.make_bucket, bucket)
+        return {}
+
+    async def _delete_bucket(self, ident, params):
+        bucket = params["bucketName"]
+        if not self._allowed(ident, "s3:DeleteBucket", bucket):
+            raise PermissionError("DeleteBucket denied")
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self.s.obj.delete_bucket, bucket)
+        self.s.bucket_meta.drop_bucket(bucket)
+        return {}
+
+    async def _list_objects(self, ident, params):
+        import asyncio
+
+        bucket = params["bucketName"]
+        prefix = params.get("prefix", "")
+        if not self._allowed(ident, "s3:ListBucket", bucket):
+            raise PermissionError("ListBucket denied")
+        loop = asyncio.get_running_loop()
+        res = await loop.run_in_executor(
+            None, lambda: self.s.obj.list_objects(
+                bucket, prefix, params.get("marker", ""), "/", 1000))
+        return {
+            "objects": [{"name": o.name, "size": o.size,
+                         "lastModified": o.mod_time, "etag": o.etag}
+                        for o in res.objects],
+            "prefixes": res.prefixes,
+            "isTruncated": res.is_truncated,
+            "nextMarker": res.next_marker,
+        }
+
+    async def _remove_objects(self, ident, params):
+        import asyncio
+
+        from minio_tpu.erasure.types import ObjectOptions, ObjectToDelete
+
+        bucket = params["bucketName"]
+        objects = params.get("objects", [])
+        for o in objects:
+            if not self._allowed(ident, "s3:DeleteObject", bucket, o):
+                raise PermissionError(f"DeleteObject denied on {o}")
+        loop = asyncio.get_running_loop()
+        results = await loop.run_in_executor(
+            None, lambda: self.s.obj.delete_objects(
+                bucket, [ObjectToDelete(o) for o in objects],
+                ObjectOptions(versioned=self.s._bucket_versioned(bucket))))
+        errors = [str(r) for r in results if isinstance(r, Exception)]
+        return {"errors": errors}
+
+    async def _server_info(self, ident, params):
+        return {"version": "minio_tpu/1.0",
+                "platform": "tpu",
+                "uptime": self.s.stats.snapshot()["uptime"]}
+
+    async def _storage_info(self, ident, params):
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        h = await loop.run_in_executor(None, self.s.obj.health)
+        total = free = 0
+        for d in getattr(self.s.obj, "all_drives", lambda: [])():
+            try:
+                di = d.disk_info()
+                total += di.total
+                free += di.free
+            except Exception:  # noqa: BLE001
+                pass
+        return {"healthy": h.get("healthy", False), "total": total,
+                "free": free}
+
+    async def _create_url_token(self, ident, params):
+        return {"token": make_jwt(self._jwt_secret(), ident.access_key,
+                                  ttl=URL_TOKEN_TTL)}
+
+    async def _presigned_get(self, ident, params):
+        bucket = params["bucketName"]
+        obj = params["objectName"]
+        if not self._allowed(ident, "s3:GetObject", bucket, obj):
+            raise PermissionError("GetObject denied")
+        token = make_jwt(self._jwt_secret(), ident.access_key,
+                         ttl=URL_TOKEN_TTL)
+        return {"url": f"/minio/download/{bucket}/"
+                       f"{urllib.parse.quote(obj)}?token={token}"}
+
+    # -- streaming upload / download --
+
+    async def upload(self, request: web.Request, bucket: str,
+                     key: str) -> web.Response:
+        ident = self._identity_from(request)
+        if ident is None:
+            raise web.HTTPForbidden(text="invalid token")
+        if not self._allowed(ident, "s3:PutObject", bucket, key):
+            raise web.HTTPForbidden(text="PutObject denied")
+        import asyncio
+        import io
+
+        from minio_tpu.erasure.types import ObjectOptions
+
+        body = await request.read()
+        loop = asyncio.get_running_loop()
+        opts = ObjectOptions(
+            versioned=self.s._bucket_versioned(bucket),
+            user_defined={"content-type": request.headers.get(
+                "Content-Type", "application/octet-stream")})
+        await loop.run_in_executor(
+            None, lambda: self.s.obj.put_object(
+                bucket, key, io.BytesIO(body), len(body), opts))
+        return web.Response(status=200)
+
+    async def download(self, request: web.Request, bucket: str,
+                       key: str) -> web.StreamResponse:
+        token = request.query.get("token", "")
+        ak = verify_jwt(self._jwt_secret(), token)
+        if ak is None:
+            raise web.HTTPForbidden(text="invalid token")
+        try:
+            ident = self.s.iam.identify(ak)
+        except se.InvalidAccessKey:
+            raise web.HTTPForbidden(text="unknown identity") from None
+        if not self._allowed(ident, "s3:GetObject", bucket, key):
+            raise web.HTTPForbidden(text="GetObject denied")
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        info, stream = await loop.run_in_executor(
+            None, lambda: self.s.obj.get_object(bucket, key))
+        resp = web.StreamResponse(status=200, headers={
+            "Content-Type": info.content_type or "application/octet-stream",
+            "Content-Length": str(info.size),
+            "Content-Disposition":
+                f'attachment; filename="{key.rsplit("/", 1)[-1]}"'})
+        await resp.prepare(request)
+        it = iter(stream)
+        while True:
+            chunk = await loop.run_in_executor(None, next, it, None)
+            if chunk is None:
+                break
+            await resp.write(chunk)
+        await resp.write_eof()
+        return resp
+
+
+def _rpc_result(rid, result) -> web.Response:
+    return web.json_response({"jsonrpc": "2.0", "id": rid, "result": result})
+
+
+def _rpc_error(rid, code: int, message: str) -> web.Response:
+    return web.json_response({"jsonrpc": "2.0", "id": rid,
+                              "error": {"code": code, "message": message}})
