@@ -118,6 +118,9 @@ class S3Server:
         self.notifier = EventNotifier(queue_dir=queue_dir)
         self._rules_loaded: set = set()
         self.scanner = None
+        from minio_tpu.scanner.tracker import UpdateTracker
+        self.update_tracker = UpdateTracker(
+            store if has_store else None)
 
         # Admin plane + observability (cmd/admin-router.go, pkg/pubsub,
         # cmd/http-stats.go, cmd/config/).
@@ -145,7 +148,8 @@ class S3Server:
         self.scanner = DataScanner(self.obj, self.bucket_meta,
                                    notifier=self.notifier,
                                    interval=interval,
-                                   heal_objects=heal_objects)
+                                   heal_objects=heal_objects,
+                                   tracker=self.update_tracker)
         self.scanner.start()
 
     # ------------------------------------------------------------------
@@ -191,6 +195,12 @@ class S3Server:
         t0 = self.stats.begin()
         resp = None
         try:
+            # Request-concurrency throttle (reference maxClients,
+            # cmd/handler-api.go:136): over the configured ceiling new
+            # requests shed with 503 + Retry-After rather than queue.
+            limit = int(self.config.get("api", "requests_max") or 0)
+            if limit and self.stats.current_requests > limit:
+                raise S3Error("SlowDown", resource=path)
             resp = await self._dispatch(request, path, request_id)
             return resp
         except S3Error as e:
@@ -574,6 +584,7 @@ class S3Server:
                 extra = {}
                 if info.version_id:
                     extra["x-amz-version-id"] = info.version_id
+                self.update_tracker.mark(bucket)
                 self._emit(request, evt.OBJECT_CREATED_COMPLETE_MULTIPART,
                            bucket, key, size=info.size, etag=info.etag,
                            version_id=info.version_id)
@@ -621,6 +632,7 @@ class S3Server:
                 extra["x-amz-delete-marker"] = "true"
             if info.version_id:
                 extra["x-amz-version-id"] = info.version_id
+            self.update_tracker.mark(bucket)
             self._emit(request,
                        evt.OBJECT_REMOVED_DELETE_MARKER if info.delete_marker
                        else evt.OBJECT_REMOVED_DELETE,
@@ -1075,6 +1087,7 @@ class S3Server:
         extra = {"ETag": f'"{info.etag}"'}
         if info.version_id:
             extra["x-amz-version-id"] = info.version_id
+        self.update_tracker.mark(bucket)
         self._emit(request, evt.OBJECT_CREATED_PUT, bucket, key,
                    size=info.size, etag=info.etag, version_id=info.version_id)
         if repl_cfg is not None:

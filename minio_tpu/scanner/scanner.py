@@ -33,7 +33,7 @@ class DataScanner:
     def __init__(self, object_layer, bucket_meta: BucketMetadataSys,
                  store=None, notifier=None,
                  interval: float = SCAN_INTERVAL,
-                 heal_objects: bool = False):
+                 heal_objects: bool = False, tracker=None):
         self.obj = object_layer
         self.bucket_meta = bucket_meta
         self.store = store if store is not None else (
@@ -43,6 +43,13 @@ class DataScanner:
         self.heal_objects = heal_objects
         self.usage = (DataUsageCache.load(self.store)
                       if self.store is not None else DataUsageCache())
+        # Change tracker: skip clean buckets between full sweeps
+        # (cmd/data-update-tracker.go role).
+        if tracker is None and self.store is not None:
+            from minio_tpu.scanner.tracker import UpdateTracker
+
+            tracker = UpdateTracker(self.store)
+        self.tracker = tracker
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -73,17 +80,35 @@ class DataScanner:
         fresh.cycles = self.usage.cycles + 1
         deep_heal = self.heal_objects and fresh.cycles % HEAL_EVERY_N_CYCLES == 0
 
-        for binfo in self.obj.list_buckets():
-            if self._stop.is_set():
-                break
-            bucket = binfo.name
+        buckets = [b.name for b in self.obj.list_buckets()]
+        lifecycles: dict[str, object] = {}
+        for bucket in buckets:
             meta = self.bucket_meta.get(bucket) if self.bucket_meta else None
-            lifecycle = None
             if meta is not None and meta.lifecycle_xml:
                 try:
-                    lifecycle = lc.parse_lifecycle_xml(meta.lifecycle_xml)
+                    lifecycles[bucket] = lc.parse_lifecycle_xml(
+                        meta.lifecycle_xml)
                 except ValueError:
-                    lifecycle = None
+                    pass
+
+        if self.tracker is not None:
+            scan_set, _full = self.tracker.begin_cycle(buckets)
+            # Time-based expiry must fire without writes: lifecycle-bearing
+            # buckets always scan.
+            to_scan = sorted(set(scan_set) | set(lifecycles))
+        else:
+            to_scan = buckets
+
+        for bucket in buckets:
+            if self._stop.is_set():
+                break
+            lifecycle = lifecycles.get(bucket)
+            if bucket not in to_scan:
+                # Clean since last cycle: carry the previous accounting.
+                prev = self.usage.buckets.get(bucket)
+                if prev is not None:
+                    fresh.buckets[bucket] = prev
+                continue
             self._scan_bucket(bucket, lifecycle, fresh, deep_heal, now)
             if lifecycle is not None:
                 self._expire_mpus(bucket, lifecycle, now)

@@ -220,3 +220,41 @@ def test_scanner_aborts_expired_mpu(layer):
     sc.scan_once(now=time.time() + 3 * DAY)
     assert not any(u.upload_id == uid
                    for u in layer.list_multipart_uploads("bkt"))
+
+
+def test_update_tracker_skips_clean_buckets(tmp_path):
+    """Tracker-driven cycles only rescan dirty buckets; full sweeps still
+    happen periodically (cmd/data-update-tracker.go role)."""
+    from minio_tpu.scanner.tracker import UpdateTracker
+
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    layer = ErasureObjects(drives, parity=1)
+    layer.make_bucket("aaa")
+    layer.make_bucket("bbb")
+    _put(layer, "aaa", "x", b"1")
+    _put(layer, "bbb", "y", b"22")
+
+    bm = BucketMetadataSys(layer)
+    tracker = UpdateTracker(layer)
+    sc = DataScanner(layer, bm, tracker=tracker)
+
+    u1 = sc.scan_once()                     # empty dirty set -> full sweep
+    assert u1.buckets["aaa"].size == 1 and u1.buckets["bbb"].size == 2
+
+    # Write only to aaa; mark it (the server does this on the data path).
+    _put(layer, "aaa", "x2", b"333")
+    tracker.mark("aaa")
+    # Mutate bbb WITHOUT marking: the skipped bucket keeps stale (carried)
+    # accounting — proving it was not rescanned.
+    _put(layer, "bbb", "hidden", b"4444")
+
+    u2 = sc.scan_once()
+    assert u2.buckets["aaa"].size == 4      # rescanned: 1 + 3
+    assert u2.buckets["bbb"].size == 2      # carried, not rescanned
+
+    # Tracker state survives a restart via the sys store.
+    tracker2 = UpdateTracker(layer)
+    tracker2.mark("bbb")
+    sc2 = DataScanner(layer, bm, tracker=tracker2)
+    u3 = sc2.scan_once()
+    assert u3.buckets["bbb"].size == 6      # now rescanned: 2 + 4
