@@ -352,9 +352,14 @@ class S3Server:
         sub = {k for k in q if not k.startswith("X-Amz-")}
 
         # --- authorization (identity policies ∪ bucket policy) ---
+        post_form = (m == "POST" and not key
+                     and request.content_type == "multipart/form-data")
         action = action_for(m, sub, bucket, key, request.headers)
-        request["api"] = action.split(":", 1)[-1]
-        self._check_access(identity, action, bucket, key)
+        request["api"] = "PostPolicy" if post_form else action.split(":", 1)[-1]
+        if not post_form:
+            # Browser POST uploads authenticate via the signed policy
+            # document inside the form; the handler checks access itself.
+            self._check_access(identity, action, bucket, key)
 
         # ---------- bucket config subresources ----------
         if not key:
@@ -387,6 +392,9 @@ class S3Server:
                 return web.Response(status=204, headers=hdr)
             if m == "POST" and "delete" in q:
                 return await self._delete_objects(request, bucket, hdr, run)
+            if m == "POST" and request.content_type == "multipart/form-data":
+                return await self._post_policy_upload(request, bucket, hdr,
+                                                      run)
             if m == "GET":
                 if "versions" in q:
                     res = await run(
@@ -642,6 +650,67 @@ class S3Server:
                 bucket, key, op=OP_DELETE))
             return web.Response(status=204, headers={**hdr, **extra})
         raise S3Error("MethodNotAllowed", resource=path)
+
+    async def _post_policy_upload(self, request, bucket, hdr, run):
+        """Browser form upload (reference PostPolicyBucketHandler,
+        cmd/bucket-handlers.go + cmd/postpolicyform.go): the policy
+        document IS the auth — signature over its base64, conditions
+        enforced against the submitted fields."""
+        reader = await request.multipart()
+        form: dict[str, str] = {}
+        file_bytes = b""
+        filename = ""
+        async for part in reader:
+            name = (part.name or "").lower()
+            if name == "file":
+                filename = part.filename or ""
+                file_bytes = await part.read(decode=False)
+                break  # fields after the file are ignored (S3 semantics)
+            form[name] = (await part.read(decode=False)).decode(
+                "utf-8", "replace")
+
+        creds = sigv4.verify_post_policy(form, self._lookup)
+        # The "bucket" condition matches the request target, not a form
+        # field (cmd/postpolicyform.go injects it the same way).
+        form.setdefault("bucket", bucket)
+        sigv4.check_post_policy_conditions(
+            form.get("policy", ""), form, len(file_bytes))
+
+        key = form.get("key", "")
+        if not key:
+            raise S3Error("InvalidArgument", "POST form requires key")
+        key = key.replace("${filename}", filename)
+
+        identity = self.iam.identify(creds.access_key)
+        request["identity"] = identity
+        self._check_access(identity, "s3:PutObject", bucket, key)
+
+        opts = ObjectOptions(versioned=self._bucket_versioned(bucket))
+        if "content-type" in form:
+            opts.user_defined["content-type"] = form["content-type"]
+        for k, v in form.items():
+            if k.startswith("x-amz-meta-"):
+                opts.user_defined[k] = v
+        import io as _io
+
+        info = await run(self.obj.put_object, bucket, key,
+                         _io.BytesIO(file_bytes), len(file_bytes), opts)
+        self.update_tracker.mark(bucket)
+        self._emit(request, evt.OBJECT_CREATED_POST, bucket, key,
+                   size=info.size, etag=info.etag,
+                   version_id=info.version_id)
+        status = int(form.get("success_action_status", "204"))
+        if status not in (200, 201, 204):
+            status = 204
+        if status == 201:
+            body = (f'<?xml version="1.0" encoding="UTF-8"?>'
+                    f'<PostResponse><Location>/{bucket}/{key}</Location>'
+                    f'<Bucket>{bucket}</Bucket><Key>{key}</Key>'
+                    f'<ETag>"{info.etag}"</ETag></PostResponse>').encode()
+            return web.Response(status=201, body=body,
+                                content_type=XML_TYPE, headers=hdr)
+        return web.Response(status=status,
+                            headers={**hdr, "ETag": f'"{info.etag}"'})
 
     # ------------------------------------------------------------------
     # bucket config subresources (policy/versioning/lifecycle/... —

@@ -281,3 +281,82 @@ class ChunkedSigV4Reader:
         out = bytes(self._out)
         self._out.clear()
         return out
+
+
+def verify_post_policy(form: dict, creds_lookup) -> "Credentials":
+    """Verify a browser POST upload's policy signature
+    (cmd/signature-v4.go:153 doesPolicySignatureMatch): the string-to-sign
+    is the base64 policy document itself."""
+    import base64 as _b64
+    import json as _json
+
+    policy_b64 = form.get("policy", "")
+    credential = form.get("x-amz-credential", "")
+    amz_date = form.get("x-amz-date", "")
+    signature = form.get("x-amz-signature", "")
+    if form.get("x-amz-algorithm") != ALGORITHM:
+        raise S3Error("AuthorizationHeaderMalformed")
+    try:
+        parts = credential.split("/")
+        access_key = "/".join(parts[:-4])
+        scope_date, region, service, _ = parts[-4:]
+    except ValueError:
+        raise S3Error("AuthorizationHeaderMalformed") from None
+    creds = creds_lookup(access_key)
+    if creds is None:
+        raise S3Error("InvalidAccessKeyId")
+    key = signing_key(creds.secret_key, scope_date, region, service)
+    want = hmac.new(key, policy_b64.encode(), hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(want, signature):
+        raise S3Error("SignatureDoesNotMatch")
+    # Expiry check from the policy document itself.
+    try:
+        doc = _json.loads(_b64.b64decode(policy_b64))
+        expiry = doc.get("expiration", "")
+        if expiry:
+            import datetime as _dt
+
+            exp = _dt.datetime.fromisoformat(
+                expiry.replace("Z", "+00:00")).timestamp()
+            if exp < _dt.datetime.now(_dt.timezone.utc).timestamp():
+                raise S3Error("AccessDenied", "policy has expired")
+    except (ValueError, TypeError):
+        raise S3Error("AuthorizationHeaderMalformed",
+                      "bad policy document") from None
+    return creds
+
+
+def check_post_policy_conditions(policy_b64: str, form: dict,
+                                 file_size: int) -> None:
+    """Enforce the policy's conditions against the submitted form
+    (cmd/postpolicyform.go checkPostPolicy): eq / starts-with /
+    content-length-range."""
+    import base64 as _b64
+    import json as _json
+
+    doc = _json.loads(_b64.b64decode(policy_b64))
+    for cond in doc.get("conditions", []):
+        if isinstance(cond, dict):
+            for k, v in cond.items():
+                have = form.get(k.lower(), "")
+                if have != str(v):
+                    raise S3Error("AccessDenied",
+                                  f"policy condition failed: {k}")
+        elif isinstance(cond, list) and len(cond) == 3:
+            op, field, value = cond
+            name = str(field).lstrip("$").lower()
+            if op == "eq":
+                if form.get(name, "") != str(value):
+                    raise S3Error("AccessDenied",
+                                  f"policy condition failed: eq {name}")
+            elif op == "starts-with":
+                if not form.get(name, "").startswith(str(value)):
+                    raise S3Error(
+                        "AccessDenied",
+                        f"policy condition failed: starts-with {name}")
+            elif op == "content-length-range":
+                lo, hi = int(field), int(value)
+                # shape: ["content-length-range", lo, hi]
+                if not lo <= file_size <= hi:
+                    raise S3Error("EntityTooLarge" if file_size > hi
+                                  else "EntityTooSmall")

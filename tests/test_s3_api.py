@@ -312,3 +312,74 @@ def test_malformed_presigned_date(server):
         timeout=10)
     assert r.status_code in (400, 403)
     assert "InternalError" not in r.text
+
+
+# ---------------- POST policy upload (browser form upload) ----------------
+
+def test_post_policy_upload(server, client, bucket):
+    import base64
+    import datetime
+    import hashlib
+    import hmac
+    import json
+
+    import requests as rq
+
+    exp = (datetime.datetime.now(datetime.timezone.utc)
+           + datetime.timedelta(hours=1)).strftime("%Y-%m-%dT%H:%M:%S.000Z")
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    scope_date = amz_date[:8]
+    credential = f"{ACCESS}/{scope_date}/us-east-1/s3/aws4_request"
+    policy = {
+        "expiration": exp,
+        "conditions": [
+            {"bucket": bucket},
+            ["starts-with", "$key", "uploads/"],
+            {"x-amz-algorithm": "AWS4-HMAC-SHA256"},
+            {"x-amz-credential": credential},
+            {"x-amz-date": amz_date},
+            ["content-length-range", 1, 1024],
+        ],
+    }
+    policy_b64 = base64.b64encode(json.dumps(policy).encode()).decode()
+    key = ("AWS4" + SECRET).encode()
+    for part in (scope_date, "us-east-1", "s3", "aws4_request"):
+        key = hmac.new(key, part.encode(), hashlib.sha256).digest()
+    signature = hmac.new(key, policy_b64.encode(), hashlib.sha256).hexdigest()
+
+    fields = {
+        "key": "uploads/${filename}",
+        "policy": policy_b64,
+        "x-amz-algorithm": "AWS4-HMAC-SHA256",
+        "x-amz-credential": credential,
+        "x-amz-date": amz_date,
+        "x-amz-signature": signature,
+        "success_action_status": "201",
+    }
+    r = rq.post(f"{server}/{bucket}", data=fields,
+                files={"file": ("form.txt", b"browser upload body")})
+    assert r.status_code == 201, r.text
+    assert "<Key>uploads/form.txt</Key>" in r.text
+
+    got = client.get(f"/{bucket}/uploads/form.txt")
+    assert got.status_code == 200 and got.content == b"browser upload body"
+
+    # Tampered signature rejected.
+    bad = dict(fields, **{"x-amz-signature": "0" * 64})
+    r = rq.post(f"{server}/{bucket}", data=bad,
+                files={"file": ("x.txt", b"data")})
+    assert r.status_code == 403
+
+    # Condition violation (key outside starts-with) rejected.
+    ok = dict(fields)
+    ok["x-amz-signature"] = signature
+    wrong_key = dict(ok, key="elsewhere/${filename}")
+    r = rq.post(f"{server}/{bucket}", data=wrong_key,
+                files={"file": ("x.txt", b"data")})
+    assert r.status_code == 403
+
+    # Oversize vs content-length-range rejected.
+    r = rq.post(f"{server}/{bucket}", data=ok,
+                files={"file": ("big.txt", b"x" * 2000)})
+    assert r.status_code == 400
