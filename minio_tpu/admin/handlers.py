@@ -127,6 +127,13 @@ class AdminAPI:
         if op == "replication-status" and m == "GET":
             self._authorize(identity, "admin:ServerInfo")
             return _json(self.s.replication.stats)
+        if op == "bandwidth" and m == "GET":
+            self._authorize(identity, "admin:ServerInfo")
+            with self.s._bw_mu:
+                return _json({"buckets": dict(self.s.bandwidth)})
+        if op in ("obdinfo", "healthinfo") and m == "GET":
+            self._authorize(identity, "admin:OBDInfo")
+            return _json(await run(self._obd_info))
 
         if op in iam_ops:
             self._authorize(identity, "admin:*")
@@ -173,6 +180,65 @@ class AdminAPI:
             },
             "stats": self.s.stats.snapshot(),
         }
+
+    def _obd_info(self) -> dict:
+        """Node diagnostics (reference OBDInfo fan-out,
+        cmd/notification.go:848-1237): host cpu/mem plus a per-drive
+        write+read micro-benchmark."""
+        import os as _os
+        import tempfile as _tmp
+        import uuid as _uuid
+
+        info: dict = {"host": {}, "drives": []}
+        try:
+            info["host"]["cpus"] = _os.cpu_count()
+            info["host"]["loadavg"] = _os.getloadavg()
+        except OSError:
+            pass
+        try:
+            with open("/proc/meminfo") as f:
+                mem = {}
+                for line in f:
+                    k, _, v = line.partition(":")
+                    if k in ("MemTotal", "MemAvailable"):
+                        mem[k] = v.strip()
+                info["host"]["memory"] = mem
+        except OSError:
+            pass
+        payload = b"\0" * (4 << 20)
+        for d in getattr(self.s.obj, "all_drives", lambda: [])():
+            if not d.is_local():
+                info["drives"].append({"endpoint": d.endpoint(),
+                                       "remote": True})
+                continue
+            root = getattr(d, "root", None)
+            if root is None:
+                continue
+            probe = _os.path.join(root, f".obd-{_uuid.uuid4().hex}")
+            entry = {"endpoint": d.endpoint(), "remote": False}
+            try:
+                t0 = time.perf_counter()
+                with open(probe, "wb") as f:
+                    f.write(payload)
+                    f.flush()
+                    _os.fsync(f.fileno())
+                entry["writeMiBps"] = round(
+                    4 / (time.perf_counter() - t0), 1)
+                t0 = time.perf_counter()
+                with open(probe, "rb") as f:
+                    while f.read(1 << 20):
+                        pass
+                entry["readMiBps"] = round(
+                    4 / (time.perf_counter() - t0), 1)
+            except OSError as e:
+                entry["error"] = str(e)
+            finally:
+                try:
+                    _os.remove(probe)
+                except OSError:
+                    pass
+            info["drives"].append(entry)
+        return info
 
     async def _heal(self, request, rest, q, run):
         """POST heal/{bucket}[/{prefix}] — runs the heal and returns the

@@ -125,6 +125,8 @@ class S3Server:
         # Admin plane + observability (cmd/admin-router.go, pkg/pubsub,
         # cmd/http-stats.go, cmd/config/).
         self.stats = HTTPStats()
+        self.bandwidth: dict[str, dict[str, int]] = {}
+        self._bw_mu = __import__("threading").Lock()
         self.trace_bus = PubSub()
         self.config = ConfigSys(store if has_store else None)
 
@@ -215,10 +217,17 @@ class S3Server:
         finally:
             status = resp.status if resp is not None else 500
             api = request.get("api", request.method.lower())
-            self.stats.end(api, t0, status,
-                           rx=request.content_length or 0,
-                           tx=(resp.content_length or 0)
-                           if resp is not None else 0)
+            rx = request.content_length or 0
+            tx = (resp.content_length or 0) if resp is not None else 0
+            self.stats.end(api, t0, status, rx=rx, tx=tx)
+            # Per-bucket bandwidth accounting (pkg/bandwidth role).
+            bkt = path.lstrip("/").split("/", 1)[0]
+            if bkt and not bkt.startswith("minio") and (rx or tx):
+                with self._bw_mu:
+                    b = self.bandwidth.setdefault(
+                        bkt, {"rx": 0, "tx": 0})
+                    b["rx"] += rx
+                    b["tx"] += tx
             # Trace record only when someone is watching
             # (cmd/handler-utils.go:362-364 zero-overhead contract).
             if self.trace_bus.has_subscribers:
@@ -1138,6 +1147,13 @@ class S3Server:
     async def _put_object(self, request, bucket, key, opts, hdr,
                           payload_hash, auth_sig, run):
         opts.user_defined = _metadata_headers(request)
+        if "content-type" not in opts.user_defined:
+            # Extension-based inference (the pkg/mimedb role).
+            import mimetypes
+
+            guessed, _ = mimetypes.guess_type(key)
+            opts.user_defined["content-type"] = (
+                guessed or "application/octet-stream")
         self._apply_object_lock(request, bucket, opts)
         repl_cfg = self.replication.config_for(bucket)
         if repl_cfg is not None and repl_cfg.rule_for(key) is not None:

@@ -274,3 +274,37 @@ def test_requests_max_throttle(server, client):
         srv.stats.current_requests -= 5
         srv.config.set_kv("api", {"requests_max": "0"})
     assert client.get("/minio/health/live").status_code == 200
+
+
+def test_obd_and_bandwidth(server, client):
+    # Generate some traffic for the bandwidth ledger.
+    client.put("/bwbkt")
+    client.put("/bwbkt/o", data=b"z" * 5000)
+    client.get("/bwbkt/o")
+
+    r = client.get("/minio/admin/v3/obdinfo")
+    assert r.status_code == 200, r.text
+    obd = r.json()
+    assert obd["host"]["cpus"] >= 1
+    assert len(obd["drives"]) == 4
+    assert all("writeMiBps" in d for d in obd["drives"])
+
+    deadline = time.time() + 2
+    while time.time() < deadline:
+        bw = client.get("/minio/admin/v3/bandwidth").json()["buckets"]
+        if bw.get("bwbkt", {}).get("rx", 0) >= 5000 and \
+                bw.get("bwbkt", {}).get("tx", 0) >= 5000:
+            break
+        time.sleep(0.05)
+    assert bw["bwbkt"]["rx"] >= 5000 and bw["bwbkt"]["tx"] >= 5000
+
+
+def test_content_type_inferred_from_extension(server, client):
+    client.put("/bwbkt/page.html", data=b"<html></html>")
+    r = client.head("/bwbkt/page.html")
+    assert r.headers["Content-Type"] == "text/html"
+    # Explicit header wins.
+    client.put("/bwbkt/data.bin", data=b"x",
+               headers={"Content-Type": "application/x-custom"})
+    r = client.head("/bwbkt/data.bin")
+    assert r.headers["Content-Type"] == "application/x-custom"
