@@ -15,4 +15,11 @@ Collectives ride ICI via XLA (psum / all_gather), replacing the reference's
 storage-REST data plane for intra-pod shard movement (SURVEY.md §5.8).
 """
 
-from minio_tpu.parallel.sharded import make_mesh, sharded_encode, sharded_reconstruct  # noqa: F401
+from minio_tpu.parallel.sharded import (  # noqa: F401
+    make_mesh,
+    ring_encode,
+    ring_reconstruct,
+    sharded_encode,
+    sharded_encode_with_bitrot,
+    sharded_reconstruct,
+)

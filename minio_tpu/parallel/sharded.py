@@ -152,3 +152,101 @@ def _check_divisibility(mesh: Mesh, shape, k: int) -> None:
         raise ValueError(
             f"[B={b}, k={k}, S={s}] not divisible by mesh (dp={dp}, tp={tp}, sp={sp})"
         )
+
+
+# ---------------------------------------------------------------------------
+# ring-exchange heal — the "ring attention" of this system (SURVEY §5.7):
+# when a set spans chips, survivor shard tiles rotate around the tp ring
+# via ppermute while each device contracts its resident tile against the
+# matching decode-weight slice. Same math as the psum path, but peak
+# memory per device stays one shard tile instead of the full [b, s, t*8]
+# partial — the shape that matters when S is long (huge objects) exactly
+# as sequence length matters in ring attention.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("k", "out_shards", "mesh"))
+def _ring_gf2_matmul(data, w, *, k: int, out_shards: int, mesh: Mesh):
+    t = out_shards
+    tp = mesh.shape["tp"]
+
+    def step(x_local, w_all):
+        # x_local: [b, k/tp, s_loc] — this device's resident shard tile.
+        # w_all:   [k*8, t*8] replicated; each rotation contracts the slice
+        #          matching the tile currently resident.
+        b, k_loc, s = x_local.shape
+        my = jax.lax.axis_index("tp")
+
+        def body(i, carry):
+            acc, tile = carry
+            # The tile now resident started life on device (my - i) % tp.
+            src = (my - i) % tp
+            w_slice = jax.lax.dynamic_slice(
+                w_all, (src * k_loc * 8, 0), (k_loc * 8, t * 8))
+            acc = acc + _local_gf2_partial(tile, w_slice)
+            # Rotate tiles one step around the ring for the next round.
+            tile = jax.lax.ppermute(
+                tile, "tp", [(j, (j + 1) % tp) for j in range(tp)])
+            return acc, tile
+
+        acc = jnp.zeros((b, s, t * 8), dtype=jnp.int32)
+        # The carry must enter the loop already marked device-varying
+        # (ppermute output is varying; scan carries must type-match).
+        acc = jax.lax.pvary(acc, ("dp", "tp", "sp"))
+        acc, _ = jax.lax.fori_loop(0, tp, body, (acc, x_local))
+        return _finish(acc, t)
+
+    return jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P("dp", "tp", "sp"), P(None, None)),
+        out_specs=P("dp", None, "sp"),
+        # After tp full rotations every device has accumulated every
+        # tile's contribution — the output IS tp-replicated, but the
+        # static checker can't see through the fori_loop to prove it.
+        check_vma=False,
+    )(data, w)
+
+
+def ring_reconstruct(
+    mesh: Mesh,
+    survivors_data: jax.Array,
+    k: int,
+    n: int,
+    survivors: tuple[int, ...],
+    targets: tuple[int, ...],
+) -> jax.Array:
+    """Heal solve via ring exchange (ppermute) instead of psum — bit-exact
+    with sharded_reconstruct; preferred when S (and so the psum payload)
+    is large."""
+    _check_divisibility(mesh, survivors_data.shape, k)
+    w = jnp.asarray(
+        gf.decode_bitmatrix(k, n, tuple(survivors), tuple(targets)),
+        dtype=jnp.int8,
+    )
+    return _ring_gf2_matmul(
+        survivors_data, w, k=k, out_shards=len(targets), mesh=mesh
+    )
+
+
+def ring_encode(mesh: Mesh, data: jax.Array, k: int, m: int) -> jax.Array:
+    """Encode via the ring path (same collective structure as the heal)."""
+    _check_divisibility(mesh, data.shape, k)
+    w = jnp.asarray(gf.encode_bitmatrix(k, m), dtype=jnp.int8)
+    return _ring_gf2_matmul(data, w, k=k, out_shards=m, mesh=mesh)
+
+
+def sharded_encode_with_bitrot(
+    mesh: Mesh, data: jax.Array, k: int, m: int
+) -> tuple[jax.Array, jax.Array]:
+    """Sharded fused parity + per-shard mxhash digests: one mesh launch
+    produces parity [B, m, S] and digests [B, k+m, 32] (ops/mxhash
+    fused with the codec, sharded over dp; the hash chain is sequential
+    in its blocks so it shards over the batch axes only)."""
+    from minio_tpu.ops import mxhash
+
+    parity = sharded_encode(mesh, data, k, m)
+    b, _, s = data.shape
+    shards = jnp.concatenate([data, parity], axis=1)
+    digests = mxhash.mxhash256(shards.reshape(b * (k + m), s), s)
+    return parity, digests.reshape(b, k + m, mxhash.DIGEST_LEN)
