@@ -54,6 +54,24 @@ def encrypted_size(plain: int) -> int:
     return full * ENC_CHUNK + (rem + TAG_SIZE if rem else 0)
 
 
+def encrypted_part_size(plain: int) -> int:
+    """Stored size of one multipart part: 12-byte nonce prefix + DARE
+    stream. Each part is an independent stream (its own random nonce),
+    matching the reference where every part is encrypted separately
+    (cmd/encryption-v1.go DecryptObjectInfo part walk)."""
+    return NONCE_SIZE + encrypted_size(plain)
+
+
+def part_plain_size(stored: int) -> int:
+    """Invert encrypted_part_size — plaintext length from a part's stored
+    length. Deterministic because the framing is fixed-size chunks."""
+    e = stored - NONCE_SIZE
+    if e <= TAG_SIZE:
+        return 0
+    full, rem = divmod(e, ENC_CHUNK)
+    return full * CHUNK_SIZE + (rem - TAG_SIZE if rem else 0)
+
+
 def decrypted_range(offset: int, length: int, actual_size: int
                     ) -> tuple[int, int, int]:
     """Map a plaintext range to (encrypted offset, encrypted length,
@@ -63,6 +81,21 @@ def decrypted_range(offset: int, length: int, actual_size: int
     enc_off = first * ENC_CHUNK
     enc_end = min(encrypted_size(actual_size), (last + 1) * ENC_CHUNK)
     return enc_off, enc_end - enc_off, offset - first * CHUNK_SIZE
+
+
+def derive_part_key(object_key: bytes, part_nonce: bytes) -> bytes:
+    """Per-part data key for multipart SSE: HMAC-SHA256(object_key,
+    part_nonce). Parts all descend from one sealed object key, but each
+    encrypts under its own derived key — _chunk_nonce keeps only 4 bytes
+    of the random nonce, so sharing the raw object key across parts would
+    risk GCM (key, nonce) reuse between same-indexed chunks of different
+    parts. Distinct keys make chunk-nonce collisions across parts
+    harmless (the reference likewise encrypts each part under its own
+    derived key, cmd/encryption-v1.go part crypto)."""
+    import hmac as _hmac
+
+    return _hmac.new(object_key, b"mtpu-part-key" + part_nonce,
+                     hashlib.sha256).digest()
 
 
 def seal_key(object_key: bytes, sealing_key: bytes, aad: str) -> str:

@@ -58,12 +58,6 @@ class _RWLock:
             self._writer = False
             self._cond.notify_all()
 
-    @property
-    def idle(self) -> bool:
-        with self._cond:
-            return (not self._writer and self._readers == 0
-                    and self._writers_waiting == 0)
-
 
 class NamespaceLockMap:
     """Lock table keyed by "bucket/object" pathnames.
@@ -77,20 +71,29 @@ class NamespaceLockMap:
         self.distributed = distributed
         self.lockers = lockers or []
         self.owner = owner
-        self._table: dict[str, _RWLock] = {}
+        # resource -> [lock, refcount]; the refcount is mutated only under
+        # _mu (the reference nsLockMap keeps `ref` under lockMapMutex,
+        # cmd/namespace-lock.go:141) so an entry can never be GC'd between
+        # another thread's _get and its acquire — deleting in that window
+        # would hand two writers two different 'same' locks.
+        self._table: dict[str, list] = {}
         self._mu = threading.Lock()
 
     def _get(self, resource: str) -> _RWLock:
         with self._mu:
-            lk = self._table.get(resource)
-            if lk is None:
-                lk = self._table[resource] = _RWLock()
-            return lk
+            entry = self._table.get(resource)
+            if entry is None:
+                entry = self._table[resource] = [_RWLock(), 0]
+            entry[1] += 1
+            return entry[0]
 
-    def _gc(self, resource: str) -> None:
+    def _unref(self, resource: str) -> None:
         with self._mu:
-            lk = self._table.get(resource)
-            if lk is not None and lk.idle:
+            entry = self._table.get(resource)
+            if entry is None:
+                return
+            entry[1] -= 1
+            if entry[1] <= 0:
                 del self._table[resource]
 
     @contextlib.contextmanager
@@ -113,9 +116,11 @@ class NamespaceLockMap:
 
         # Local mode: acquire in sorted order (deadlock-free), all-or-release.
         acquired: list[_RWLock] = []
+        referenced: list[str] = []
         try:
             for res in resources:
                 lk = self._get(res)
+                referenced.append(res)
                 ok = (lk.acquire_read(timeout) if readonly
                       else lk.acquire_write(timeout))
                 if not ok:
@@ -129,5 +134,5 @@ class NamespaceLockMap:
                     lk.release_read()
                 else:
                     lk.release_write()
-            for res in resources:
-                self._gc(res)
+            for res in referenced:
+                self._unref(res)

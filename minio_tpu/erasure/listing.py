@@ -41,6 +41,7 @@ def fi_to_object_info(bucket: str, obj: str, fi: FileInfo) -> ObjectInfo:
         parity_blocks=fi.erasure.parity_blocks,
         data_blocks=fi.erasure.data_blocks,
         num_versions=fi.num_versions,
+        parts=[(p.number, p.size) for p in fi.parts],
     )
 
 

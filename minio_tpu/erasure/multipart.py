@@ -132,6 +132,16 @@ class MultipartMixin:
         reduce_write_quorum(results, self._write_quorum_meta(), bucket, obj)
         return upload_id
 
+    def get_multipart_info(self, bucket: str, obj: str,
+                           upload_id: str) -> MultipartInfo:
+        """Session metadata for a live upload (reference GetMultipartInfo,
+        cmd/erasure-multipart.go:339) — the S3 layer reads the sealed SSE
+        key from user_defined to encrypt each part under it."""
+        meta = self._read_mp_meta(bucket, obj, upload_id)
+        return MultipartInfo(bucket, obj, upload_id,
+                             meta.get("initiated", 0.0),
+                             meta.get("user_defined", {}))
+
     def put_object_part(self, bucket: str, obj: str, upload_id: str,
                         part_number: int, data: BinaryIO, size: int = -1,
                         opts: ObjectOptions | None = None) -> PartInfoResult:

@@ -204,6 +204,10 @@ class ErasureServerPools:
         return self._upload_pool(bucket, obj, upload_id).list_parts(
             bucket, obj, upload_id, part_marker, max_parts)
 
+    def get_multipart_info(self, bucket: str, obj: str, upload_id: str):
+        return self._upload_pool(bucket, obj, upload_id).get_multipart_info(
+            bucket, obj, upload_id)
+
     def list_multipart_uploads(self, bucket: str, prefix: str = "",
                                max_uploads: int = 1000) -> list[MultipartInfo]:
         out: list[MultipartInfo] = []

@@ -146,6 +146,10 @@ class ErasureSets:
         return self.get_hashed_set(obj).put_object_part(
             bucket, obj, upload_id, part_number, data, size, opts)
 
+    def get_multipart_info(self, bucket: str, obj: str, upload_id: str):
+        return self.get_hashed_set(obj).get_multipart_info(
+            bucket, obj, upload_id)
+
     def list_parts(self, bucket: str, obj: str, upload_id: str,
                    part_marker: int = 0, max_parts: int = 1000):
         return self.get_hashed_set(obj).list_parts(

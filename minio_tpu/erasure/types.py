@@ -28,6 +28,10 @@ class ObjectInfo:
     num_versions: int = 0
     is_dir: bool = False
     actual_size: int | None = None
+    # (part_number, stored_size) pairs for multipart objects; empty for
+    # single-PUT objects (reference ObjectInfo.Parts). Needed by the SSE
+    # GET path: multipart parts are independently encrypted streams.
+    parts: list = field(default_factory=list)
 
     @property
     def storage_class(self) -> str:

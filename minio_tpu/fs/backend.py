@@ -217,7 +217,8 @@ class FSObjects:
         return ObjectInfo(bucket=bucket, name=obj, mod_time=st.st_mtime,
                           size=st.st_size, etag=meta.get("etag", ""),
                           content_type=ud.get("content-type", ""),
-                          user_defined=ud)
+                          user_defined=ud,
+                          parts=[tuple(p) for p in meta.get("parts", [])])
 
     def get_object(self, bucket: str, obj: str, offset: int = 0,
                    length: int = -1, opts: ObjectOptions | None = None
@@ -389,6 +390,13 @@ class FSObjects:
             raise se.InvalidUploadID(bucket, obj, upload_id)
         return s
 
+    def get_multipart_info(self, bucket: str, obj: str,
+                           upload_id: str) -> MultipartInfo:
+        s = self._mp_session(bucket, obj, upload_id)
+        return MultipartInfo(bucket=bucket, object=obj, upload_id=upload_id,
+                             initiated=s.get("initiated", 0.0),
+                             user_defined=s.get("metadata", {}))
+
     def put_object_part(self, bucket: str, obj: str, upload_id: str,
                         part_number: int, data: BinaryIO, size: int = -1
                         ) -> PartInfoResult:
@@ -497,8 +505,12 @@ class FSObjects:
         os.makedirs(os.path.dirname(dst), exist_ok=True)
         os.replace(tmp, dst)
         etag = f"{md5_of_md5s.hexdigest()}-{len(parts)}"
+        # Part boundaries survive the concatenation: the SSE GET path needs
+        # them because multipart parts are independently encrypted streams.
         self._store_meta(bucket, obj, {
-            "etag": etag, "metadata": session.get("metadata", {})})
+            "etag": etag, "metadata": session.get("metadata", {}),
+            "parts": [[cp.part_number, listed[cp.part_number].size]
+                      for cp in parts]})
         shutil.rmtree(d, ignore_errors=True)
         return ObjectInfo(bucket=bucket, name=obj, size=total, etag=etag,
                           mod_time=time.time(),

@@ -258,6 +258,13 @@ class S3Gateway:
             raise se.InvalidUploadID(bucket, obj, uid)
         return s
 
+    def get_multipart_info(self, bucket: str, obj: str,
+                           upload_id: str) -> MultipartInfo:
+        s = self._session(bucket, obj, upload_id)
+        return MultipartInfo(bucket=bucket, object=obj, upload_id=upload_id,
+                             initiated=s["initiated"],
+                             user_defined=s["metadata"])
+
     def put_object_part(self, bucket: str, obj: str, upload_id: str,
                         part_number: int, data: BinaryIO, size: int = -1
                         ) -> PartInfoResult:

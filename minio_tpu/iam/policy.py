@@ -12,6 +12,7 @@ wildcards; a practical subset of condition operators is supported.
 from __future__ import annotations
 
 import fnmatch
+import functools
 import json
 from dataclasses import dataclass, field
 
@@ -104,16 +105,28 @@ class Statement:
             return not any(_match(p, action) for p in self.not_actions)
         return any(_match(p, action) for p in self.actions)
 
-    def matches_resource(self, resource: str) -> bool:
+    # Read-only bucket actions a console-style object policy ("bkt/*")
+    # implicitly needs. Mutating bucket actions (DeleteBucket,
+    # PutBucketPolicy, ...) require the bucket ARN itself — an object-only
+    # Allow must not escalate to them (AWS/reference semantics,
+    # pkg/bucket/policy resource matching).
+    _LIST_ONLY_ACTIONS = frozenset({
+        "s3:ListBucket", "s3:ListBucketVersions",
+        "s3:ListBucketMultipartUploads", "s3:GetBucketLocation",
+    })
+
+    def matches_resource(self, resource: str, action: str = "") -> bool:
         if not self.resources:
             return True
         for r in self.resources:
             pat = r[len("arn:aws:s3:::"):] if r.startswith("arn:aws:s3:::") else r
             if _match(pat, resource) or pat == "*":
                 return True
-            # A bucket-level pattern "bkt/*" must also cover bucket-level
-            # actions on "bkt" (ListBucket's resource is the bucket arn).
-            if pat.endswith("/*") and _match(pat[:-2], resource):
+            # An object pattern "bkt/*" also covers the bare bucket arn,
+            # but only for read-only listing actions (ListBucket's resource
+            # is the bucket arn) — never for mutating bucket-level actions.
+            if (pat.endswith("/*") and _match(pat[:-2], resource)
+                    and action in self._LIST_ONLY_ACTIONS):
                 return True
         return False
 
@@ -131,7 +144,7 @@ class Statement:
     def applies(self, args: PolicyArgs) -> bool:
         return (self.matches_principal(args.account)
                 and self.matches_action(args.action)
-                and self.matches_resource(args.resource)
+                and self.matches_resource(args.resource, args.action)
                 and self.matches_conditions(args.conditions))
 
 
@@ -139,6 +152,14 @@ class Policy:
     def __init__(self, statements: list[Statement], version: str = ""):
         self.statements = statements
         self.version = version
+
+    @classmethod
+    def parse_cached(cls, raw: bytes | str) -> "Policy":
+        """parse() behind a small LRU — bucket policies are evaluated per
+        request (and per key on bulk delete); the parsed form is immutable
+        so re-parsing identical JSON is pure waste."""
+        return _parse_cached(bytes(raw) if isinstance(raw, (bytes, bytearray))
+                             else raw.encode())
 
     @classmethod
     def parse(cls, raw: bytes | str) -> "Policy":
@@ -189,6 +210,11 @@ class Policy:
         for s in self.statements:
             if not s.actions and not s.not_actions:
                 raise se.MalformedPolicy("statement without Action")
+
+
+@functools.lru_cache(maxsize=256)
+def _parse_cached(raw: bytes) -> "Policy":
+    return Policy.parse(raw)
 
 
 def merge_is_allowed(policies: list[Policy], args: PolicyArgs) -> bool:

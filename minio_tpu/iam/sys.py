@@ -236,7 +236,7 @@ class IAMSys:
         with self._mu:
             docs = [self.policies[n] for n in dict.fromkeys(names)
                     if n in self.policies]
-        return merge_is_allowed([Policy.parse(d) for d in docs], args)
+        return merge_is_allowed([Policy.parse_cached(d) for d in docs], args)
 
     # ------------------------------------------------------------------
     # admin CRUD (cmd/admin-handlers-users.go surface)

@@ -97,6 +97,10 @@ class ObjectLayer(abc.ABC):
                    max_parts: int = 1000) -> list[PartInfoResult]: ...
 
     @abc.abstractmethod
+    def get_multipart_info(self, bucket: str, obj: str,
+                           upload_id: str) -> MultipartInfo: ...
+
+    @abc.abstractmethod
     def list_multipart_uploads(self, bucket: str, prefix: str = "",
                                max_uploads: int = 1000) -> list[MultipartInfo]: ...
 

@@ -138,6 +138,11 @@ class S3Server:
         self.admin = AdminAPI(self)
         self.local_locker = None  # set by the cluster node when distributed
 
+        # upload_id -> user_defined: saves a quorum metadata read per
+        # UploadPart/ListParts (SSE decisions are sealed at create time and
+        # immutable for the upload's life).
+        self._mp_sse_cache: dict[str, dict] = {}
+
         from minio_tpu.s3.web import WebAPI
         self.web = WebAPI(self)
 
@@ -177,7 +182,7 @@ class S3Server:
         pol_raw = (self.bucket_meta.get(bucket).policy_json
                    if bucket else b"")
         if pol_raw:
-            bp = Policy.parse(pol_raw)
+            bp = Policy.parse_cached(pol_raw)
             bargs = PolicyArgs(action=action, bucket=bucket, object=key,
                                conditions=conditions or {},
                                account=identity.access_key or "*")
@@ -365,9 +370,13 @@ class S3Server:
                      and request.content_type == "multipart/form-data")
         action = action_for(m, sub, bucket, key, request.headers)
         request["api"] = "PostPolicy" if post_form else action.split(":", 1)[-1]
-        if not post_form:
+        bulk_delete = m == "POST" and not key and "delete" in q
+        if not post_form and not bulk_delete:
             # Browser POST uploads authenticate via the signed policy
             # document inside the form; the handler checks access itself.
+            # Bulk delete authorizes per object key (AWS DeleteObjects
+            # semantics) — an endpoint-level check against the bare bucket
+            # resource would wrongly reject object-scoped policies.
             self._check_access(identity, action, bucket, key)
 
         # ---------- bucket config subresources ----------
@@ -564,8 +573,11 @@ class S3Server:
         #       object-handlers) -----
         if m == "POST" and "uploads" in q:
             user_defined = _metadata_headers(request)
+            self._maybe_sse_multipart_create(request, bucket, key,
+                                             user_defined)
             mp_opts = ObjectOptions(user_defined=user_defined)
             upload_id = await run(self.obj.new_multipart_upload, bucket, key, mp_opts)
+            self._mp_cache_put(upload_id, dict(user_defined))
             return web.Response(
                 body=xmlutil.initiate_multipart_xml(bucket, key, upload_id),
                 content_type=XML_TYPE, headers=hdr)
@@ -584,11 +596,23 @@ class S3Server:
                 parts = await run(self.obj.list_parts, bucket, key, upload_id,
                                   _int_q(q, "part-number-marker", 0),
                                   _int_q(q, "max-parts", 1000))
+                mp_meta = await run(self._mp_user_defined, bucket, key,
+                                    upload_id)
+                if sse.META_ALGO in mp_meta:
+                    # Report plaintext sizes (the reference reports the
+                    # decrypted part size in ListObjectParts) so a client
+                    # resuming by summing sizes lands on the right offset.
+                    import dataclasses
+                    parts = [dataclasses.replace(
+                        p, size=sse.part_plain_size(p.size),
+                        actual_size=sse.part_plain_size(p.size))
+                        for p in parts]
                 return web.Response(
                     body=xmlutil.list_parts_xml(bucket, key, upload_id, parts),
                     content_type=XML_TYPE, headers=hdr)
             if m == "DELETE":
                 await run(self.obj.abort_multipart_upload, bucket, key, upload_id)
+                self._mp_sse_cache.pop(upload_id, None)
                 return web.Response(status=204, headers=hdr)
             if m == "POST":
                 body = await request.read()
@@ -596,8 +620,23 @@ class S3Server:
                 if not pairs:
                     raise S3Error("MalformedXML")
                 parts = [CompletePart(n, e) for n, e in pairs]
+                mp_meta = await run(self._mp_user_defined, bucket, key,
+                                    upload_id)
+                if sse.META_ALGO in mp_meta:
+                    # The layer's 5 MiB minimum checks stored sizes; SSE
+                    # framing inflates them, so enforce the S3 minimum on
+                    # *plaintext* sizes here (AWS validates decrypted).
+                    listed = {p.part_number: p for p in await run(
+                        self.obj.list_parts, bucket, key, upload_id,
+                        0, 10000)}
+                    for n, _ in pairs[:-1]:
+                        p = listed.get(n)
+                        if p is not None and sse.part_plain_size(
+                                p.size) < (5 << 20):
+                            raise S3Error("EntityTooSmall")
                 info = await run(self.obj.complete_multipart_upload, bucket,
                                  key, upload_id, parts, opts)
+                self._mp_sse_cache.pop(upload_id, None)
                 extra = {}
                 if info.version_id:
                     extra["x-amz-version-id"] = info.version_id
@@ -912,10 +951,13 @@ class S3Server:
         opts.user_defined[czip.META_COMPRESSION] = czip.SCHEME
         return czip.CompressReader(spool), -1
 
-    def _maybe_encrypt_put(self, request, bucket: str, key: str, opts,
-                           spool, size: int):
-        """Wrap the upload stream in a DARE encryptor when SSE applies.
-        Returns (reader, stored_size)."""
+    def _sse_setup(self, request, bucket: str, key: str,
+                   user_defined: dict) -> bytes | None:
+        """Decide SSE applicability (request headers or bucket default),
+        then generate + seal a fresh per-object data key into metadata.
+        Returns the plaintext object key, or None when SSE does not apply.
+        Shared by single PUT and CreateMultipartUpload so their encryption
+        decisions can never diverge."""
         import base64 as _b64
         import hashlib as _hl
 
@@ -930,35 +972,166 @@ class S3Server:
             if b"AES256" in self.bucket_meta.get(bucket).sse_xml:
                 sse_s3 = True
         if ssec_key is None and not sse_s3:
+            return None
+        object_key = os.urandom(32)
+        aad = f"{bucket}/{key}"
+        if ssec_key is not None:
+            user_defined[sse.META_ALGO] = "SSE-C"
+            user_defined[sse.META_SEALED_KEY] = sse.seal_key(
+                object_key, ssec_key, aad)
+            user_defined[sse.META_KEY_MD5] = _b64.b64encode(
+                _hl.md5(ssec_key).digest()).decode()
+        else:
+            user_defined[sse.META_ALGO] = "SSE-S3"
+            user_defined[sse.META_SEALED_KEY] = sse.seal_key(
+                object_key, self._sse_master_key(), aad)
+        return object_key
+
+    def _maybe_encrypt_put(self, request, bucket: str, key: str, opts,
+                           spool, size: int):
+        """Wrap the upload stream in a DARE encryptor when SSE applies.
+        Returns (reader, stored_size)."""
+        import base64 as _b64
+
+        staged: dict = {}
+        object_key = self._sse_setup(request, bucket, key, staged)
+        if object_key is None:
             return spool, size
         if size < 0:
             raise S3Error("MissingContentLength",
                           "SSE requires a known content length")
-
-        object_key = os.urandom(32)
+        opts.user_defined.update(staged)
         nonce = os.urandom(12)
-        aad = f"{bucket}/{key}"
-        if ssec_key is not None:
-            opts.user_defined[sse.META_ALGO] = "SSE-C"
-            opts.user_defined[sse.META_SEALED_KEY] = sse.seal_key(
-                object_key, ssec_key, aad)
-            opts.user_defined[sse.META_KEY_MD5] = _b64.b64encode(
-                _hl.md5(ssec_key).digest()).decode()
-        else:
-            opts.user_defined[sse.META_ALGO] = "SSE-S3"
-            opts.user_defined[sse.META_SEALED_KEY] = sse.seal_key(
-                object_key, self._sse_master_key(), aad)
         opts.user_defined[sse.META_NONCE] = _b64.b64encode(nonce).decode()
         opts.user_defined[sse.META_ACTUAL_SIZE] = str(size)
         return (sse.EncryptReader(spool, object_key, nonce),
                 sse.encrypted_size(size))
 
-    def _sse_unseal(self, request, bucket: str, key: str, meta: dict,
-                    copy_source: bool = False) -> tuple:
-        """(object_key, nonce, actual_size) for an encrypted object;
-        verifies SSE-C key headers match."""
-        import base64 as _b64
+    def _maybe_sse_multipart_create(self, request, bucket: str, key: str,
+                                    user_defined: dict) -> None:
+        """Seal a per-upload object key at CreateMultipartUpload time when
+        SSE applies; every part is then encrypted under it (reference
+        newMultipartUpload encryption setup, cmd/erasure-multipart.go:269 +
+        cmd/object-handlers.go NewMultipartUploadHandler). No META_NONCE is
+        stored: parts are independent streams, each carrying its own
+        random nonce as a 12-byte prefix."""
+        self._sse_setup(request, bucket, key, user_defined)
 
+    def _mp_cache_put(self, upload_id: str, meta: dict) -> None:
+        if len(self._mp_sse_cache) > 2048:
+            self._mp_sse_cache.clear()
+        self._mp_sse_cache[upload_id] = meta
+
+    def _mp_user_defined(self, bucket: str, key: str,
+                         upload_id: str) -> dict:
+        """The upload session's user metadata, cached per upload_id —
+        immutable after CreateMultipartUpload, so UploadPart/ListParts
+        skip the per-call quorum metadata read."""
+        meta = self._mp_sse_cache.get(upload_id)
+        if meta is None:
+            meta = self.obj.get_multipart_info(
+                bucket, key, upload_id).user_defined
+            self._mp_cache_put(upload_id, meta)
+        return meta
+
+    def _maybe_encrypt_part(self, request, bucket: str, key: str,
+                            upload_id: str, reader, size: int):
+        """Wrap one part's stream in DARE encryption under the upload's
+        sealed object key, with a fresh per-part nonce carried as a stream
+        prefix. Returns (reader, stored_size)."""
+        mp_meta = self._mp_user_defined(bucket, key, upload_id)
+        if sse.META_ALGO not in mp_meta:
+            return reader, size
+        if size < 0:
+            raise S3Error("MissingContentLength",
+                          "SSE requires a known content length")
+        object_key = self._sse_object_key(request, bucket, key, mp_meta)
+        nonce = os.urandom(sse.NONCE_SIZE)
+        part_key = sse.derive_part_key(object_key, nonce)
+        return (_PrefixReader(nonce,
+                              sse.EncryptReader(reader, part_key, nonce)),
+                sse.encrypted_part_size(size))
+
+    @staticmethod
+    def _visible_size(info) -> int:
+        """Client-visible (plaintext/uncompressed) byte count of an object
+        — info.size is the stored size, which SSE and compression inflate
+        or shrink."""
+        if sse.META_ACTUAL_SIZE in info.user_defined:
+            return int(info.user_defined[sse.META_ACTUAL_SIZE])
+        if czip.META_ACTUAL_SIZE in info.user_defined:
+            return int(info.user_defined[czip.META_ACTUAL_SIZE])
+        if sse.META_ALGO in info.user_defined and info.parts:
+            # Multipart SSE: derivable from the fixed DARE framing of each
+            # independently-encrypted part.
+            return sum(sse.part_plain_size(s) for _, s in info.parts)
+        return info.size
+
+    def _mp_sse_stream(self, request, bucket, key, opts, pre,
+                       offset, length, copy_source=False):
+        """(info, iterator, actual_size) for a multipart SSE object —
+        parts are independently encrypted [nonce | DARE] streams laid
+        back-to-back; decrypt only the chunks each part-range touches."""
+        object_key = self._sse_object_key(request, bucket, key,
+                                          pre.user_defined,
+                                          copy_source=copy_source)
+        if pre.version_id and not opts.version_id:
+            # Pin the version across the per-part reads — a concurrent
+            # overwrite mid-download must not splice replacement bytes
+            # into the stream (single-PUT SSE reads in one backend call
+            # and has no such window).
+            import dataclasses
+            opts = dataclasses.replace(opts, version_id=pre.version_id)
+        plains = [sse.part_plain_size(stored) for _, stored in pre.parts]
+        actual = sum(plains)
+        if length < 0:
+            length = actual - offset
+        if offset < 0 or length < 0 or offset + length > actual:
+            raise S3Error("InvalidRange", resource=f"/{bucket}/{key}")
+
+        get = self.obj.get_object
+
+        def gen():
+            pos = 0        # plaintext cursor at current part start
+            enc_pos = 0    # stored-byte cursor at current part start
+            for (_, stored), plain in zip(pre.parts, plains):
+                lo = max(offset - pos, 0)
+                hi = min(offset + length - pos, plain)
+                if hi > lo:
+                    enc_off, enc_len, skip = sse.decrypted_range(
+                        lo, hi - lo, plain)
+                    if enc_off == 0:
+                        # Nonce and data are adjacent: one backend read,
+                        # peel the 12-byte nonce off the front.
+                        _, raw = get(bucket, key, enc_pos,
+                                     sse.NONCE_SIZE + enc_len, opts)
+                        estream, nonce = _peel_prefix(raw, sse.NONCE_SIZE)
+                        estream = _CloseProxy(estream, raw)
+                    else:
+                        _, nstream = get(bucket, key, enc_pos,
+                                         sse.NONCE_SIZE, opts)
+                        nonce = b"".join(nstream)
+                        if len(nonce) != sse.NONCE_SIZE:
+                            raise sse.SSEError(
+                                f"part nonce truncated: {len(nonce)} bytes")
+                        _, estream = get(
+                            bucket, key, enc_pos + sse.NONCE_SIZE + enc_off,
+                            enc_len, opts)
+                    dec = sse.DecryptReader(
+                        estream, sse.derive_part_key(object_key, nonce),
+                        nonce, start_chunk=enc_off // sse.ENC_CHUNK,
+                        total_chunks=sse.total_chunks(plain))
+                    yield from _trim_iter(dec, skip, hi - lo, estream)
+                pos += plain
+                enc_pos += stored
+                if pos >= offset + length:
+                    return
+
+        return pre, gen(), actual
+
+    def _sse_object_key(self, request, bucket: str, key: str, meta: dict,
+                        copy_source: bool = False) -> bytes:
+        """Unseal the per-object data key; verifies SSE-C key headers."""
         algo = meta.get(sse.META_ALGO, "")
         aad = f"{bucket}/{key}"
         try:
@@ -968,23 +1141,35 @@ class S3Server:
                 if ssec_key is None:
                     raise S3Error("InvalidRequest",
                                   "object is SSE-C encrypted: key required")
-                object_key = sse.unseal_key(
+                return sse.unseal_key(
                     meta[sse.META_SEALED_KEY], ssec_key, aad)
-            else:
-                object_key = sse.unseal_key(
-                    meta[sse.META_SEALED_KEY], self._sse_master_key(), aad)
+            return sse.unseal_key(
+                meta[sse.META_SEALED_KEY], self._sse_master_key(), aad)
         except sse.SSEError as e:
             raise S3Error("AccessDenied", str(e)) from None
-        nonce = _b64.b64decode(meta[sse.META_NONCE])
+
+    def _sse_unseal(self, request, bucket: str, key: str, meta: dict,
+                    copy_source: bool = False) -> tuple:
+        """(object_key, nonce, actual_size) for an encrypted object;
+        verifies SSE-C key headers match."""
+        import base64 as _b64
+
+        object_key = self._sse_object_key(request, bucket, key, meta,
+                                          copy_source=copy_source)
+        nonce = (_b64.b64decode(meta[sse.META_NONCE])
+                 if sse.META_NONCE in meta else b"")
         actual = int(meta.get(sse.META_ACTUAL_SIZE, "0"))
         return object_key, nonce, actual
 
     async def _open_object_stream(self, request, bucket, key, opts,
-                                  offset, length, run, copy_source=False):
+                                  offset, length, run, copy_source=False,
+                                  pre=None):
         """get_object with transparent SSE decryption. Returns
         (info, iterator, plaintext_size) where info.size is the client-
-        visible size."""
-        pre = await run(self.obj.get_object_info, bucket, key, opts)
+        visible size. Pass `pre` when the caller already paid the quorum
+        metadata read (range parsing)."""
+        if pre is None:
+            pre = await run(self.obj.get_object_info, bucket, key, opts)
         if czip.META_COMPRESSION in pre.user_defined:
             actual = int(pre.user_defined.get(czip.META_ACTUAL_SIZE, "-1"))
             if length < 0:
@@ -1000,6 +1185,11 @@ class S3Server:
             info, stream = await run(self.obj.get_object, bucket, key,
                                      offset, length, opts)
             return info, stream, pre.size
+        if sse.META_NONCE not in pre.user_defined and pre.parts:
+            # Multipart SSE: no object-level nonce; parts are independent
+            # [nonce | DARE] streams.
+            return self._mp_sse_stream(request, bucket, key, opts, pre,
+                                       offset, length, copy_source)
         object_key, nonce, actual = self._sse_unseal(
             request, bucket, key, pre.user_defined, copy_source=copy_source)
         if length < 0:
@@ -1015,28 +1205,7 @@ class S3Server:
             enc_stream, object_key, nonce,
             start_chunk=enc_off // sse.ENC_CHUNK,
             total_chunks=sse.total_chunks(actual))
-
-        def trimmed():
-            remaining = length
-            drop = skip
-            for chunk in dec:
-                if drop:
-                    if len(chunk) <= drop:
-                        drop -= len(chunk)
-                        continue
-                    chunk = chunk[drop:]
-                    drop = 0
-                if len(chunk) >= remaining:
-                    yield chunk[:remaining]
-                    remaining = 0
-                    break
-                remaining -= len(chunk)
-                yield chunk
-            close = getattr(enc_stream, "close", None)
-            if close is not None:
-                close()
-
-        return info, trimmed(), actual
+        return info, _trim_iter(dec, skip, length, enc_stream), actual
 
     def _apply_object_lock(self, request, bucket: str, opts) -> None:
         """Stamp retention/legal-hold from request headers, falling back to
@@ -1122,8 +1291,13 @@ class S3Server:
         chunked = None
         if streaming:
             amz_date = request.headers.get("x-amz-date", "")
+            # The chunk signing key derives from the *requester's* secret
+            # (reference calculateSeedSignature, streaming-signature-v4.go:77),
+            # not the root credential — otherwise every aws-chunked PUT by a
+            # non-root IAM/STS user fails with SignatureDoesNotMatch.
+            req_creds = self._lookup(auth_sig.access_key) or self.creds
             chunked = sigv4.ChunkedSigV4Reader(
-                self.creds, auth_sig.signature, amz_date, auth_sig.scope_date,
+                req_creds, auth_sig.signature, amz_date, auth_sig.scope_date,
                 auth_sig.region, auth_sig.service)
         try:
             async for chunk in request.content.iter_chunked(1 << 20):
@@ -1185,8 +1359,11 @@ class S3Server:
                         hdr, payload_hash, auth_sig, run):
         spool, size = await self._spool_body(request, payload_hash, auth_sig)
         try:
+            reader, stored_size = await run(
+                self._maybe_encrypt_part, request, bucket, key, upload_id,
+                spool, size)
             res = await run(self.obj.put_object_part, bucket, key, upload_id,
-                            part_number, spool, size)
+                            part_number, reader, stored_size)
         finally:
             spool.close()
         return web.Response(status=200, headers={**hdr, "ETag": f'"{res.etag}"'})
@@ -1194,20 +1371,27 @@ class S3Server:
     async def _upload_part_copy(self, request, bucket, key, upload_id,
                                 part_number, src, hdr, run):
         src_bucket, src_key, src_opts = _parse_copy_source(src)
+        # Read the *client-visible* bytes — decrypt/decompress the source
+        # (the reference decrypts the source in CopyObjectPartHandler;
+        # reading raw shards here would store ciphertext as a plain part).
         rng = request.headers.get("x-amz-copy-source-range")
         if rng:
-            pre = await run(self.obj.get_object_info, src_bucket, src_key, src_opts)
-            offset, length = _parse_range(rng, pre.size)
+            pre = await run(self.obj.get_object_info, src_bucket, src_key,
+                            src_opts)
+            offset, length = _parse_range(rng, self._visible_size(pre))
         else:
-            offset, length = 0, -1
-        info, stream = await run(self.obj.get_object, src_bucket, src_key,
-                                 offset, length, src_opts)
+            pre, offset, length = None, 0, -1
+        info, stream, visible_size = await self._open_object_stream(
+            request, src_bucket, src_key, src_opts, offset, length, run,
+            copy_source=True, pre=pre)
         if length < 0:
-            length = info.size
-        reader = _IterReader(stream)
+            length = visible_size - offset
         try:
+            reader, stored_size = await run(
+                self._maybe_encrypt_part, request, bucket, key, upload_id,
+                _IterReader(stream), length)
             res = await run(self.obj.put_object_part, bucket, key, upload_id,
-                            part_number, reader, length)
+                            part_number, reader, stored_size)
         finally:
             close = getattr(stream, "close", None)
             if close is not None:
@@ -1261,15 +1445,12 @@ class S3Server:
             # Range needs the size before the read; costs one extra quorum
             # metadata round, paid only by range requests.
             pre = await run(self.obj.get_object_info, bucket, key, opts)
-            visible = int(pre.user_defined.get(
-                sse.META_ACTUAL_SIZE,
-                pre.user_defined.get(czip.META_ACTUAL_SIZE, pre.size)))
-            offset, length = _parse_range(rng, visible)
+            offset, length = _parse_range(rng, self._visible_size(pre))
             status = 206
         else:
-            offset, length = 0, -1
+            pre, offset, length = None, 0, -1
         info, stream, visible = await self._open_object_stream(
-            request, bucket, key, opts, offset, length, run)
+            request, bucket, key, opts, offset, length, run, pre=pre)
         not_modified = _check_conditional(request, info)
         if not_modified:
             return web.Response(status=304, headers={
@@ -1296,10 +1477,27 @@ class S3Server:
     async def _delete_objects(self, request, bucket, hdr, run):
         body = await request.read()
         objects, quiet = xmlutil.parse_delete_xml(body)
+        identity = request.get("identity")
+
+        def authorize():
+            ok, den = [], []
+            for k, v in objects:
+                action = ("s3:DeleteObjectVersion" if v
+                          else "s3:DeleteObject")
+                try:
+                    self._check_access(identity, action, bucket, k)
+                    ok.append((k, v))
+                except S3Error:
+                    den.append((k, "AccessDenied", "Access Denied."))
+            return ok, den
+
+        # Off the event loop: N policy evaluations for N keys.
+        authorized, denied = await run(authorize)
+        objects = authorized
         todo = [ObjectToDelete(k, v) for k, v in objects]
         results = await run(self.obj.delete_objects, bucket, todo,
                             ObjectOptions(versioned=self.versioned_buckets))
-        deleted, errors = [], []
+        deleted, errors = [], list(denied)
         for (k, v), r in zip(objects, results):
             if isinstance(r, Exception):
                 s3e = from_exception(r, k)
@@ -1314,6 +1512,95 @@ class S3Server:
                 deleted.append(r)
         return web.Response(body=xmlutil.delete_result_xml(deleted, errors),
                             content_type=XML_TYPE, headers=hdr)
+
+
+class _CloseProxy:
+    """Iterator wrapper whose close() also closes the underlying source
+    stream (generators can't carry extra attributes)."""
+
+    def __init__(self, it, source):
+        self._it = iter(it)
+        self._source = source
+
+    def __iter__(self):
+        return self._it
+
+    def close(self) -> None:
+        close = getattr(self._source, "close", None)
+        if close is not None:
+            close()
+
+
+def _peel_prefix(stream, n: int):
+    """Take the first n bytes off a bytes-iterator; returns (rest_iter,
+    prefix). rest_iter preserves the remaining bytes and close()."""
+    it = iter(stream)
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            buf += next(it)
+        except StopIteration:
+            # PEP 479: letting this escape into a consuming generator
+            # becomes RuntimeError mid-response; surface a clean error.
+            raise sse.SSEError(
+                f"stream truncated: {len(buf)} of {n} prefix bytes"
+            ) from None
+    prefix, rest = bytes(buf[:n]), bytes(buf[n:])
+
+    def gen():
+        if rest:
+            yield rest
+        yield from it
+
+    return gen(), prefix
+
+
+def _trim_iter(it, skip: int, length: int, source=None):
+    """Yield `length` bytes from `it` after dropping the first `skip`
+    (chunk-aligned decrypt streams overshoot a byte range on both ends);
+    closes `source` when done."""
+    remaining = length
+    drop = skip
+    for chunk in it:
+        if drop:
+            if len(chunk) <= drop:
+                drop -= len(chunk)
+                continue
+            chunk = chunk[drop:]
+            drop = 0
+        if len(chunk) >= remaining:
+            yield chunk[:remaining]
+            remaining = 0
+            break
+        remaining -= len(chunk)
+        yield chunk
+    close = getattr(source, "close", None)
+    if close is not None:
+        close()
+
+
+class _PrefixReader:
+    """File-like that serves a fixed prefix, then an inner reader — carries
+    a part's random nonce at the head of its encrypted stream."""
+
+    def __init__(self, prefix: bytes, inner):
+        self._prefix = prefix
+        self._inner = inner
+
+    def read(self, n: int = -1) -> bytes:
+        if self._prefix:
+            if n < 0 or n >= len(self._prefix):
+                out, self._prefix = self._prefix, b""
+                rest = self._inner.read(n - len(out) if n >= 0 else -1)
+                return out + rest
+            out, self._prefix = self._prefix[:n], self._prefix[n:]
+            return out
+        return self._inner.read(n)
+
+    def close(self) -> None:
+        close = getattr(self._inner, "close", None)
+        if close is not None:
+            close()
 
 
 class _IterReader:
@@ -1407,11 +1694,7 @@ def _parse_copy_source(src: str):
 
 
 def _object_headers(info) -> dict:
-    size = info.size
-    if sse.META_ACTUAL_SIZE in info.user_defined:
-        size = int(info.user_defined[sse.META_ACTUAL_SIZE])
-    elif czip.META_ACTUAL_SIZE in info.user_defined:
-        size = int(info.user_defined[czip.META_ACTUAL_SIZE])
+    size = S3Server._visible_size(info)
     h = {
         "ETag": f'"{info.etag}"',
         "Last-Modified": _http_time(info.mod_time),

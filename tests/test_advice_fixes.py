@@ -1,0 +1,449 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+
+1. aws-chunked (STREAMING-AWS4-HMAC-SHA256-PAYLOAD) uploads by non-root
+   IAM users derive the chunk signing key from the *requester's* secret
+   (reference calculateSeedSignature, cmd/streaming-signature-v4.go:77).
+2. Multipart uploads honour SSE-C/SSE-S3: parts are encrypted under a
+   per-upload sealed object key (cmd/erasure-multipart.go:269).
+3. An object-scoped policy ("bkt/*") must not grant mutating bucket-level
+   actions (pkg/bucket/policy resource-matching semantics).
+4. NamespaceLockMap entries are refcounted — no GC window in which two
+   writers get two different locks for the same resource
+   (cmd/namespace-lock.go:141).
+5. UploadPartCopy reads the client-visible (decrypted) source bytes
+   (CopyObjectPartHandler decrypts the source in the reference).
+"""
+
+import base64
+import datetime
+import hashlib
+import hmac
+import io
+import os
+import socket
+import threading
+
+import pytest
+import requests
+from aiohttp import web
+
+from minio_tpu.crypto import sse
+from tests.s3client import SigV4Client
+
+ACCESS = "advroot"
+SECRET = "advroot-secret"
+REGION = "us-east-1"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    import asyncio
+
+    from minio_tpu.s3.server import build_server
+
+    root = tmp_path_factory.mktemp("drives")
+    srv = build_server([str(root / f"d{i}") for i in range(4)], ACCESS, SECRET)
+    port = _free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(srv.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(30)
+    yield f"http://127.0.0.1:{port}", srv
+    loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    c = SigV4Client(server[0], ACCESS, SECRET)
+    assert c.put("/advbkt").status_code == 200
+    return c
+
+
+# ---------------- 1. streaming chunked signature for IAM users ----------
+
+
+def _chunked_put(endpoint: str, ak: str, sk: str, path: str,
+                 payload: bytes, chunk_size: int = 64 << 10
+                 ) -> requests.Response:
+    """Hand-rolled aws-chunked PUT: header auth seeds the per-chunk
+    signature chain."""
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    scope_date = amz_date[:8]
+    scope = f"{scope_date}/{REGION}/s3/aws4_request"
+    import urllib.parse
+
+    host = urllib.parse.urlparse(endpoint).netloc
+    payload_hash = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
+
+    headers = {
+        "host": host,
+        "x-amz-content-sha256": payload_hash,
+        "x-amz-date": amz_date,
+        "x-amz-decoded-content-length": str(len(payload)),
+    }
+    signed = sorted(headers)
+    canonical = "\n".join([
+        "PUT", path, "",
+        "".join(f"{h}:{headers[h]}\n" for h in signed),
+        ";".join(signed),
+        payload_hash,
+    ])
+    sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                     hashlib.sha256(canonical.encode()).hexdigest()])
+    key = ("AWS4" + sk).encode()
+    for part in (scope_date, REGION, "s3", "aws4_request"):
+        key = hmac.new(key, part.encode(), hashlib.sha256).digest()
+    seed_sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+    headers["authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={ak}/{scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={seed_sig}")
+
+    body = bytearray()
+    prev = seed_sig
+    offsets = list(range(0, len(payload), chunk_size)) or [0]
+    chunks = [payload[o:o + chunk_size] for o in offsets] + [b""]
+    for c in chunks:
+        csts = "\n".join([
+            "AWS4-HMAC-SHA256-PAYLOAD", amz_date, scope, prev,
+            hashlib.sha256(b"").hexdigest(),
+            hashlib.sha256(c).hexdigest(),
+        ])
+        sig = hmac.new(key, csts.encode(), hashlib.sha256).hexdigest()
+        body += f"{len(c):x};chunk-signature={sig}\r\n".encode()
+        body += c + b"\r\n"
+        prev = sig
+    return requests.put(endpoint + path, data=bytes(body), headers=headers,
+                        timeout=30)
+
+
+def test_streaming_chunked_put_by_iam_user(server, client):
+    endpoint, srv = server
+    srv.iam.set_user("chunkuser", "chunkuser-secret-key")
+    srv.iam.attach_policy("chunkuser", ["readwrite"])
+
+    payload = os.urandom(200_000)
+    r = _chunked_put(endpoint, "chunkuser", "chunkuser-secret-key",
+                     "/advbkt/chunked.bin", payload)
+    assert r.status_code == 200, r.text
+    got = client.get("/advbkt/chunked.bin")
+    assert got.content == payload
+
+    # Root still works too (the original path).
+    r = _chunked_put(endpoint, ACCESS, SECRET, "/advbkt/chunked2.bin",
+                     payload[:1000])
+    assert r.status_code == 200, r.text
+
+    # A wrong secret must fail the chunk chain.
+    r = _chunked_put(endpoint, "chunkuser", "wrong-secret",
+                     "/advbkt/chunked3.bin", payload[:1000])
+    assert r.status_code == 403
+
+
+# ---------------- 2. multipart SSE ----------------
+
+
+def _ssec_headers(key: bytes) -> dict:
+    return {
+        "x-amz-server-side-encryption-customer-algorithm": "AES256",
+        "x-amz-server-side-encryption-customer-key":
+            base64.b64encode(key).decode(),
+        "x-amz-server-side-encryption-customer-key-md5":
+            base64.b64encode(hashlib.md5(key).digest()).decode(),
+    }
+
+
+def _complete_xml(parts: list[tuple[int, str]]) -> bytes:
+    inner = "".join(
+        f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
+        for n, e in parts)
+    return (f"<CompleteMultipartUpload>{inner}"
+            f"</CompleteMultipartUpload>").encode()
+
+
+def _multipart_upload(client, path, part_payloads, extra_headers=None):
+    import re
+
+    extra_headers = extra_headers or {}
+    r = client.post(path, query={"uploads": ""}, headers=extra_headers)
+    assert r.status_code == 200, r.text
+    upload_id = re.search(r"<UploadId>([^<]+)</UploadId>", r.text).group(1)
+    etags = []
+    for i, body in enumerate(part_payloads, start=1):
+        r = client.put(path, query={"uploadId": upload_id,
+                                    "partNumber": str(i)},
+                       data=body, headers=extra_headers)
+        assert r.status_code == 200, r.text
+        etags.append((i, r.headers["ETag"].strip('"')))
+    r = client.post(path, query={"uploadId": upload_id},
+                    data=_complete_xml(etags), headers=extra_headers)
+    assert r.status_code == 200, r.text
+    return upload_id
+
+
+def test_multipart_ssec_roundtrip(server, client):
+    _, srv = server
+    key = os.urandom(32)
+    p1 = os.urandom(5 << 20)          # >= S3 min part size
+    p2 = os.urandom(700_001)
+    _multipart_upload(client, "/advbkt/mp-ssec.bin", [p1, p2],
+                      extra_headers=_ssec_headers(key))
+
+    # Stored bytes are ciphertext of the expected framed size.
+    _, it = srv.obj.get_object("advbkt", "mp-ssec.bin")
+    stored = b"".join(it)
+    assert stored != p1 + p2
+    assert len(stored) == (sse.encrypted_part_size(len(p1))
+                           + sse.encrypted_part_size(len(p2)))
+
+    # Without the key the GET is rejected; with it the full plaintext.
+    assert client.get("/advbkt/mp-ssec.bin").status_code in (400, 403)
+    r = client.get("/advbkt/mp-ssec.bin", headers=_ssec_headers(key))
+    assert r.status_code == 200
+    assert r.content == p1 + p2
+
+    # HEAD reports the plaintext size.
+    r = client.head("/advbkt/mp-ssec.bin", headers=_ssec_headers(key))
+    assert int(r.headers["Content-Length"]) == len(p1) + len(p2)
+
+    # Ranged GET spanning the part boundary decrypts both sides.
+    h = _ssec_headers(key)
+    lo, hi = (5 << 20) - 100, (5 << 20) + 99
+    h["Range"] = f"bytes={lo}-{hi}"
+    r = client.get("/advbkt/mp-ssec.bin", headers=h)
+    assert r.status_code == 206
+    assert r.content == (p1 + p2)[lo:hi + 1]
+
+    # Open-ended and suffix ranges parse against the *plaintext* size.
+    h = _ssec_headers(key)
+    h["Range"] = "bytes=0-"
+    r = client.get("/advbkt/mp-ssec.bin", headers=h)
+    assert r.status_code == 206 and r.content == p1 + p2
+    h["Range"] = "bytes=-100"
+    r = client.get("/advbkt/mp-ssec.bin", headers=h)
+    assert r.status_code == 206 and r.content == (p1 + p2)[-100:]
+
+
+def test_multipart_ssec_list_parts_plain_sizes(client):
+    import re
+
+    key = os.urandom(32)
+    h = _ssec_headers(key)
+    r = client.post("/advbkt/mp-lp.bin", query={"uploads": ""}, headers=h)
+    uid = re.search(r"<UploadId>([^<]+)</UploadId>", r.text).group(1)
+    body = os.urandom(123_456)
+    r = client.put("/advbkt/mp-lp.bin",
+                   query={"uploadId": uid, "partNumber": "1"},
+                   data=body, headers=h)
+    assert r.status_code == 200
+    r = client.get("/advbkt/mp-lp.bin", query={"uploadId": uid})
+    assert r.status_code == 200
+    sizes = [int(s) for s in re.findall(r"<Size>(\d+)</Size>", r.text)]
+    assert sizes == [len(body)]  # plaintext, not ciphertext+framing
+    client.delete("/advbkt/mp-lp.bin", query={"uploadId": uid})
+
+
+def test_multipart_sse_s3_roundtrip(client):
+    h = {"x-amz-server-side-encryption": "AES256"}
+    p1 = os.urandom(5 << 20)
+    p2 = os.urandom(123_456)
+    _multipart_upload(client, "/advbkt/mp-sses3.bin", [p1, p2],
+                      extra_headers=h)
+    r = client.get("/advbkt/mp-sses3.bin")
+    assert r.status_code == 200
+    assert r.content == p1 + p2
+    assert r.headers.get("x-amz-server-side-encryption") == "AES256"
+
+
+# ---------------- 5. UploadPartCopy decrypts the source ----------------
+
+
+def test_upload_part_copy_from_encrypted_source(client):
+    import re
+
+    key = os.urandom(32)
+    src = os.urandom(300_000)
+    r = client.put("/advbkt/upc-src.bin", data=src,
+                   headers=_ssec_headers(key))
+    assert r.status_code == 200
+
+    r = client.post("/advbkt/upc-dst.bin", query={"uploads": ""})
+    upload_id = re.search(r"<UploadId>([^<]+)</UploadId>", r.text).group(1)
+
+    copy_headers = {
+        "x-amz-copy-source": "/advbkt/upc-src.bin",
+        "x-amz-copy-source-server-side-encryption-customer-algorithm":
+            "AES256",
+        "x-amz-copy-source-server-side-encryption-customer-key":
+            base64.b64encode(key).decode(),
+        "x-amz-copy-source-server-side-encryption-customer-key-md5":
+            base64.b64encode(hashlib.md5(key).digest()).decode(),
+    }
+    r = client.put("/advbkt/upc-dst.bin",
+                   query={"uploadId": upload_id, "partNumber": "1"},
+                   headers=copy_headers)
+    assert r.status_code == 200, r.text
+    etag = re.search(r"<ETag>(?:&#34;|&quot;|\")?([0-9a-f]+)", r.text).group(1)
+
+    r = client.post("/advbkt/upc-dst.bin", query={"uploadId": upload_id},
+                    data=_complete_xml([(1, etag)]))
+    assert r.status_code == 200, r.text
+
+    # Destination (unencrypted) serves the source *plaintext*.
+    r = client.get("/advbkt/upc-dst.bin")
+    assert r.status_code == 200
+    assert r.content == src
+
+
+def test_upload_part_copy_ranged_from_encrypted_source(client):
+    import re
+
+    key = os.urandom(32)
+    src = os.urandom(200_000)
+    client.put("/advbkt/upcr-src.bin", data=src, headers=_ssec_headers(key))
+    r = client.post("/advbkt/upcr-dst.bin", query={"uploads": ""})
+    upload_id = re.search(r"<UploadId>([^<]+)</UploadId>", r.text).group(1)
+    copy_headers = {
+        "x-amz-copy-source": "/advbkt/upcr-src.bin",
+        "x-amz-copy-source-range": "bytes=1000-150999",
+        "x-amz-copy-source-server-side-encryption-customer-algorithm":
+            "AES256",
+        "x-amz-copy-source-server-side-encryption-customer-key":
+            base64.b64encode(key).decode(),
+        "x-amz-copy-source-server-side-encryption-customer-key-md5":
+            base64.b64encode(hashlib.md5(key).digest()).decode(),
+    }
+    r = client.put("/advbkt/upcr-dst.bin",
+                   query={"uploadId": upload_id, "partNumber": "1"},
+                   headers=copy_headers)
+    assert r.status_code == 200, r.text
+    etag = re.search(r"<ETag>(?:&#34;|&quot;|\")?([0-9a-f]+)", r.text).group(1)
+    r = client.post("/advbkt/upcr-dst.bin", query={"uploadId": upload_id},
+                    data=_complete_xml([(1, etag)]))
+    assert r.status_code == 200, r.text
+    r = client.get("/advbkt/upcr-dst.bin")
+    assert r.content == src[1000:151000]
+
+
+# ---------------- 3. policy: no object->bucket escalation ----------------
+
+
+def test_object_policy_does_not_grant_bucket_mutations():
+    from minio_tpu.iam.policy import Policy, PolicyArgs
+
+    pol = Policy.parse(b"""{
+      "Version": "2012-10-17",
+      "Statement": [{"Effect": "Allow", "Action": ["s3:*"],
+                     "Resource": ["arn:aws:s3:::bkt/*"]}]
+    }""")
+    allowed = lambda action, resource: pol.is_allowed(  # noqa: E731
+        PolicyArgs(action=action, bucket="bkt",
+                   object=resource.partition("/")[2], account="u"))
+
+    # Object-level actions: allowed.
+    assert pol.is_allowed(PolicyArgs(action="s3:GetObject", bucket="bkt",
+                                     object="x", account="u"))
+    assert pol.is_allowed(PolicyArgs(action="s3:PutObject", bucket="bkt",
+                                     object="a/b", account="u"))
+    # Read-only listing convenience: allowed.
+    assert pol.is_allowed(PolicyArgs(action="s3:ListBucket", bucket="bkt",
+                                     object="", account="u"))
+    # Mutating bucket-level actions: NOT allowed from an object pattern.
+    for action in ("s3:DeleteBucket", "s3:PutBucketPolicy",
+                   "s3:PutLifecycleConfiguration",
+                   "s3:PutBucketVersioning"):
+        assert not pol.is_allowed(PolicyArgs(action=action, bucket="bkt",
+                                             object="", account="u")), action
+
+
+def test_bulk_delete_with_object_scoped_policy(server):
+    """DeleteObjects authorizes per object key (AWS semantics) — an
+    object-only policy must still permit bulk delete of its objects."""
+    endpoint, srv = server
+    srv.iam.set_policy("objonly", """{
+      "Version": "2012-10-17",
+      "Statement": [{"Effect": "Allow",
+                     "Action": ["s3:PutObject", "s3:DeleteObject",
+                                "s3:GetObject"],
+                     "Resource": ["arn:aws:s3:::advbkt/*"]}]
+    }""")
+    srv.iam.set_user("bulkuser", "bulkuser-secret-key")
+    srv.iam.attach_policy("bulkuser", ["objonly"])
+    u = SigV4Client(endpoint, "bulkuser", "bulkuser-secret-key")
+    for i in range(3):
+        assert u.put(f"/advbkt/bulk/{i}", data=b"x").status_code == 200
+    xml = ("<Delete>" + "".join(
+        f"<Object><Key>bulk/{i}</Key></Object>" for i in range(3))
+        + "</Delete>").encode()
+    r = u.post("/advbkt", query={"delete": ""}, data=xml)
+    assert r.status_code == 200, r.text
+    assert "<Error>" not in r.text
+    for i in range(3):
+        assert u.get(f"/advbkt/bulk/{i}").status_code == 404
+
+    # And the same user still cannot delete the bucket itself.
+    assert u.delete("/advbkt").status_code == 403
+
+
+# ---------------- 4. nslock refcount ----------------
+
+
+def test_nslock_refcount_pins_entry():
+    from minio_tpu.dist.nslock import NamespaceLockMap
+
+    m = NamespaceLockMap()
+    # Simulate thread B having fetched (referenced) the lock but not yet
+    # acquired it. A full lock/unlock cycle by thread A must NOT delete
+    # the table entry out from under B.
+    lk_b = m._get("bkt/obj")
+    with m.lock("bkt", "obj"):
+        pass
+    assert m._table["bkt/obj"][0] is lk_b  # entry survived, same lock
+    m._unref("bkt/obj")
+    assert "bkt/obj" not in m._table       # now truly idle -> collected
+
+
+def test_nslock_concurrent_writers_exclusive():
+    from minio_tpu.dist.nslock import NamespaceLockMap
+
+    m = NamespaceLockMap()
+    active = []
+    overlap = []
+
+    def worker():
+        for _ in range(200):
+            with m.lock("b", "o"):
+                active.append(1)
+                if len(active) > 1:
+                    overlap.append(1)
+                active.pop()
+
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not overlap
+    assert not m._table  # fully collected when idle
