@@ -1,86 +1,324 @@
-"""North-star benchmark: sustained erasure-encode throughput, EC 8+4, 1 MiB blocks.
+"""North-star benchmark suite: all 5 BASELINE.json configs on the real chip.
 
-Mirrors the reference's encode benchmark semantics
-(cmd/erasure-encode_test.go:168 — b.SetBytes(data size) => GiB/s of *input
-data* encoded), at the BASELINE.json config: EC:4 (8 data + 4 parity),
-1 MiB erasure blocks (blockSizeV2, cmd/object-api-common.go:41).
+Mirrors the reference's bench harness semantics (GiB/s via b.SetBytes of the
+*data* size processed):
+  1. Erasure.Encode 8+4 on 1 MiB blocks     (cmd/erasure-encode_test.go:168)
+  2. Erasure.Decode, 2 missing data shards  (cmd/erasure-decode_test.go:344)
+  3. bitrot verify fused with decode        (cmd/bitrot-streaming.go verify path)
+  4. HealObject full-set reconstruct 16/4   (cmd/erasure-heal_test.go:64)
+  5. PutObject e2e multipart over an erasure set (cmd/object-api-putobject_test.go:452)
+plus the fused encode+bitrot launch (the north-star config: parity AND
+per-shard mxhash digests in one launch — SURVEY.md §2.3).
 
-Methodology: launches are queued asynchronously (JAX async dispatch) with a
-data dependency chaining one launch's parity into the next launch's input,
-so the device pipeline stays full, no two launches are identical (defeats
-any transparent result caching), and the measured wall covers ITERS real
-encodes. The kernel is the Pallas fused path on TPU backends
-(ops/rs_pallas.py), the XLA int8-MXU path elsewhere (ops/rs_xla.py).
+Methodology for the kernel configs: launches are queued asynchronously (JAX
+async dispatch) with a data dependency chaining one launch's output into the
+next launch's input, so the device pipeline stays full, no two launches are
+identical (defeats transparent result caching), and the measured wall covers
+ITERS real launches.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
-vs_baseline is the fraction of the 40 GiB/s TPU north-star target
-(BASELINE.md — the reference publishes no absolute numbers; its AVX2
-harnesses are run-to-measure).
+Prints ONE JSON line: the headline metric (sustained fused encode+bitrot,
+the BASELINE north-star config) with a "configs" array carrying every
+sub-benchmark. Robust against the round-1 failure mode: backend init is
+retried with backoff and any error is reported as a parseable JSON line with
+an "error" key, never a raw traceback.
 
 Run standalone on the real TPU (no other JAX process may hold the chip).
 """
 
+from __future__ import annotations
+
 import json
 import sys
 import time
+import traceback
 
 K, M = 8, 4
-BLOCK_SIZE = 1 << 20          # 1 MiB erasure block
+BLOCK_SIZE = 1 << 20          # 1 MiB erasure block (blockSizeV2)
 SHARD_LEN = BLOCK_SIZE // K   # 131072
 BATCH = 32                    # blocks per launch (32 MiB data per step)
 WARMUP = 3
 ITERS = 30
 NORTH_STAR_GIBS = 40.0
 
+HEAL_N = 16                   # config 4: 16-drive set, EC:4 -> 12+4
+HEAL_K = 12
+HEAL_OFFLINE = (0, 5, 12, 13)  # 2 data + 2 parity drives offline
 
-def main() -> None:
-    import jax
-    import jax.numpy as jnp
 
-    from minio_tpu.ops import rs_pallas, rs_xla
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
 
-    dev = jax.devices()[0]
-    use_pallas = rs_pallas.use_pallas()
-    mod = rs_pallas if use_pallas else rs_xla
 
+def init_jax(attempts: int = 4):
+    """Initialize the JAX backend with retry/backoff (round 1 died at a
+    transient 'Unable to initialize backend: UNAVAILABLE' — e.g. a stray
+    process briefly holding the chip)."""
+    delays = [0, 5, 15, 30]
+    last: Exception | None = None
+    for i in range(attempts):
+        if delays[min(i, len(delays) - 1)] and i:
+            time.sleep(delays[min(i, len(delays) - 1)])
+        try:
+            import jax
+
+            devs = jax.devices()
+            return jax, devs
+        except Exception as e:  # noqa: BLE001
+            last = e
+            log(f"backend init attempt {i + 1}/{attempts} failed: {e}")
+            try:  # drop the cached failed-backend state so a retry re-inits
+                from jax._src import xla_bridge
+
+                xla_bridge._clear_backends()  # noqa: SLF001
+            except Exception:  # noqa: BLE001
+                pass
+    raise RuntimeError(f"JAX backend unavailable after {attempts} attempts: {last}")
+
+
+def _timed_chain(step, x0, iters: int) -> float:
+    """Run `x = step(x)` iters times; step returns the next input (a real
+    data dependency between launches). Returns wall seconds."""
+    x = x0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        x = step(x)
+    if isinstance(x, (tuple, list)):
+        for v in x:
+            v.block_until_ready()
+    else:
+        x.block_until_ready()
+    return time.perf_counter() - t0
+
+
+def bench_encode(jax, jnp, mod, kernel: str) -> dict:
+    """Config 1: plain encode 8+4, 1 MiB blocks."""
     key = jax.random.PRNGKey(0)
-    data = jax.random.randint(
-        key, (BATCH, K, SHARD_LEN), 0, 256, dtype=jnp.int32
-    ).astype(jnp.uint8)
+    data = jax.random.randint(key, (BATCH, K, SHARD_LEN), 0, 256,
+                              dtype=jnp.int32).astype(jnp.uint8)
     data.block_until_ready()
-
     encode = jax.jit(lambda x: mod.encode(x, K, M))
-    # Chain: fold the previous parity into the next input — a real data
-    # dependency between launches with negligible extra work.
     chain = jax.jit(lambda x, p: x.at[:, :M, :].set(p))
 
-    def run(iters: int) -> float:
-        x = data
+    def step(x):
+        return chain(x, encode(x))
+
+    _timed_chain(step, data, WARMUP)
+    dt = _timed_chain(step, data, ITERS)
+    gibs = BATCH * BLOCK_SIZE * ITERS / dt / (1 << 30)
+    return {"metric": f"erasure_encode_{K}+{M}_1MiB[{kernel}]",
+            "value": round(gibs, 3), "unit": "GiB/s",
+            "vs_baseline": round(gibs / NORTH_STAR_GIBS, 4)}
+
+
+def bench_encode_fused(jax, jnp, dev_platform: str) -> dict:
+    """North-star config: encode + per-shard bitrot digests, one launch."""
+    from minio_tpu.ops import fused
+
+    key = jax.random.PRNGKey(1)
+    data = jax.random.randint(key, (BATCH, K, SHARD_LEN), 0, 256,
+                              dtype=jnp.int32).astype(jnp.uint8)
+    data.block_until_ready()
+    enc = jax.jit(lambda x: fused.encode_with_digests(x, K, M))
+    chain = jax.jit(lambda x, p: x.at[:, :M, :].set(p))
+
+    def step(x):
+        parity, _dig = enc(x)
+        return chain(x, parity)
+
+    _timed_chain(step, data, WARMUP)
+    dt = _timed_chain(step, data, ITERS)
+    gibs = BATCH * BLOCK_SIZE * ITERS / dt / (1 << 30)
+    return {"metric": f"erasure_encode_bitrot_fused_{K}+{M}_1MiB[{dev_platform}]",
+            "value": round(gibs, 3), "unit": "GiB/s",
+            "vs_baseline": round(gibs / NORTH_STAR_GIBS, 4)}
+
+
+def bench_decode(jax, jnp) -> dict:
+    """Config 2: reconstruct 2 missing data shards at 8+4."""
+    from minio_tpu.ops import rs_xla
+
+    n = K + M
+    key = jax.random.PRNGKey(2)
+    data = jax.random.randint(key, (BATCH, K, SHARD_LEN), 0, 256,
+                              dtype=jnp.int32).astype(jnp.uint8)
+    parity = rs_xla.encode(data, K, M)
+    shards = jnp.concatenate([data, parity], axis=1)
+    shards.block_until_ready()
+    targets = (0, 1)
+    survivors = tuple(i for i in range(n) if i not in targets)[:K]
+    rec = jax.jit(lambda s: rs_xla.reconstruct(s, K, n, survivors, targets))
+    chain = jax.jit(lambda s, r: s.at[:, 2:4, :].set(r))
+
+    def step(s):
+        return chain(s, rec(s))
+
+    _timed_chain(step, shards, WARMUP)
+    dt = _timed_chain(step, shards, ITERS)
+    gibs = BATCH * BLOCK_SIZE * ITERS / dt / (1 << 30)
+    return {"metric": f"erasure_decode_2missing_{K}+{M}_1MiB",
+            "value": round(gibs, 3), "unit": "GiB/s",
+            "vs_baseline": round(gibs / NORTH_STAR_GIBS, 4)}
+
+
+def bench_verify_decode_fused(jax, jnp) -> dict:
+    """Config 3: bitrot verify (mxhash digests of every survivor shard)
+    fused into the same launch as the reconstruct."""
+    from minio_tpu.ops import mxhash, rs_xla
+
+    n = K + M
+    key = jax.random.PRNGKey(3)
+    data = jax.random.randint(key, (BATCH, K, SHARD_LEN), 0, 256,
+                              dtype=jnp.int32).astype(jnp.uint8)
+    parity = rs_xla.encode(data, K, M)
+    shards = jnp.concatenate([data, parity], axis=1)
+    shards.block_until_ready()
+    targets = (0, 1)
+    survivors = tuple(i for i in range(n) if i not in targets)[:K]
+
+    @jax.jit
+    def rec_verify(s):
+        surv = s[:, list(survivors), :]
+        dig = mxhash.mxhash256(surv.reshape(BATCH * K, SHARD_LEN), SHARD_LEN)
+        r = rs_xla.reconstruct(s, K, n, survivors, targets)
+        return r, dig
+
+    chain = jax.jit(lambda s, r: s.at[:, 2:4, :].set(r))
+
+    def step(s):
+        r, _d = rec_verify(s)
+        return chain(s, r)
+
+    _timed_chain(step, shards, WARMUP)
+    dt = _timed_chain(step, shards, ITERS)
+    gibs = BATCH * BLOCK_SIZE * ITERS / dt / (1 << 30)
+    return {"metric": f"bitrot_verify_fused_decode_{K}+{M}_1MiB",
+            "value": round(gibs, 3), "unit": "GiB/s",
+            "vs_baseline": round(gibs / NORTH_STAR_GIBS, 4)}
+
+
+def bench_heal(jax, jnp) -> dict:
+    """Config 4: whole-set heal — 16-drive set (12+4), 4 drives offline,
+    rebuild all 4 in one batched solve."""
+    from minio_tpu.ops import rs_xla
+
+    n, k = HEAL_N, HEAL_K
+    shard = -(-BLOCK_SIZE // k)
+    shard = -(-shard // 512) * 512  # pad to lane multiple
+    key = jax.random.PRNGKey(4)
+    data = jax.random.randint(key, (BATCH, k, shard), 0, 256,
+                              dtype=jnp.int32).astype(jnp.uint8)
+    parity = rs_xla.encode(data, k, n - k)
+    shards = jnp.concatenate([data, parity], axis=1)
+    shards.block_until_ready()
+    targets = HEAL_OFFLINE
+    survivors = tuple(i for i in range(n) if i not in targets)[:k]
+    heal = jax.jit(lambda s: rs_xla.reconstruct(s, k, n, survivors, targets))
+    chain = jax.jit(lambda s, r: s.at[:, 1:5, :].set(r))
+
+    def step(s):
+        return chain(s, heal(s))
+
+    _timed_chain(step, shards, WARMUP)
+    dt = _timed_chain(step, shards, ITERS)
+    gibs = BATCH * BLOCK_SIZE * ITERS / dt / (1 << 30)
+    return {"metric": f"heal_reconstruct_{HEAL_N}drive_4offline_1MiB",
+            "value": round(gibs, 3), "unit": "GiB/s",
+            "vs_baseline": round(gibs / NORTH_STAR_GIBS, 4)}
+
+
+def bench_e2e_multipart() -> dict:
+    """Config 5: PutObject end-to-end through a 16-drive erasure set with a
+    multipart upload (scaled from the reference's 5 GiB to keep the bench
+    under a minute; the per-byte path is identical)."""
+    import io
+    import os
+    import shutil
+    import tempfile
+
+    from minio_tpu.erasure import ErasureObjects
+    from minio_tpu.erasure.types import CompletePart
+    from minio_tpu.storage import LocalDrive
+
+    part_size = 64 << 20
+    n_parts = 4
+    root = tempfile.mkdtemp(prefix="mtpu_bench_")
+    try:
+        drives = [LocalDrive(os.path.join(root, f"d{i}")) for i in range(16)]
+        es = ErasureObjects(drives, parity=4)
+        es.make_bucket("bench")
+        payload = os.urandom(part_size)
         t0 = time.perf_counter()
-        for _ in range(iters):
-            p = encode(x)
-            x = chain(x, p)
-        x.block_until_ready()
-        return time.perf_counter() - t0
+        upload_id = es.new_multipart_upload("bench", "obj")
+        parts = []
+        for pn in range(1, n_parts + 1):
+            pi = es.put_object_part("bench", "obj", upload_id, pn,
+                                    io.BytesIO(payload), part_size)
+            parts.append(CompletePart(pn, pi.etag))
+        es.complete_multipart_upload("bench", "obj", upload_id, parts)
+        dt = time.perf_counter() - t0
+        total = part_size * n_parts
+        gibs = total / dt / (1 << 30)
+        return {"metric": "putobject_e2e_multipart_16drive",
+                "value": round(gibs, 3), "unit": "GiB/s",
+                "vs_baseline": round(gibs / NORTH_STAR_GIBS, 4)}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
 
-    run(WARMUP)
-    dt = run(ITERS)
 
-    data_bytes = BATCH * BLOCK_SIZE * ITERS
-    gibs = data_bytes / dt / (1 << 30)
+def main() -> int:
+    t_start = time.time()
+    configs: list[dict] = []
+    headline: dict | None = None
+    try:
+        jax, devs = init_jax()
+        import jax.numpy as jnp
 
-    kernel = "pallas" if use_pallas else "xla"
-    print(
-        json.dumps(
-            {
-                "metric": f"erasure_encode_{K}+{M}_1MiB_blocks"
-                          f"[{dev.platform}:{kernel}]",
-                "value": round(gibs, 3),
-                "unit": "GiB/s",
-                "vs_baseline": round(gibs / NORTH_STAR_GIBS, 4),
-            }
-        )
-    )
+        from minio_tpu.ops import rs_pallas, rs_xla
+
+        dev = devs[0]
+        use_pallas = rs_pallas.use_pallas()
+        mod = rs_pallas if use_pallas else rs_xla
+        kernel = f"{dev.platform}:{'pallas' if use_pallas else 'xla'}"
+        log(f"device: {dev} kernel: {kernel}")
+
+        for name, fn in [
+            ("encode", lambda: bench_encode(jax, jnp, mod, kernel)),
+            ("encode_fused", lambda: bench_encode_fused(jax, jnp, kernel)),
+            ("decode", lambda: bench_decode(jax, jnp)),
+            ("verify_decode", lambda: bench_verify_decode_fused(jax, jnp)),
+            ("heal", lambda: bench_heal(jax, jnp)),
+            ("e2e", bench_e2e_multipart),
+        ]:
+            try:
+                t0 = time.time()
+                r = fn()
+                log(f"{name}: {r['value']} {r['unit']} ({time.time() - t0:.1f}s)")
+                configs.append(r)
+                if name == "encode_fused":
+                    headline = r
+            except Exception as e:  # noqa: BLE001
+                log(traceback.format_exc())
+                configs.append({"metric": name, "error": str(e)})
+    except Exception as e:  # noqa: BLE001
+        log(traceback.format_exc())
+        print(json.dumps({
+            "metric": "erasure_encode_bitrot_fused_8+4_1MiB",
+            "value": 0.0, "unit": "GiB/s", "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        return 0
+
+    if headline is None:  # fused bench failed; fall back to best config
+        ok = [c for c in configs if "value" in c]
+        headline = ok[0] if ok else {
+            "metric": "erasure_encode_bitrot_fused_8+4_1MiB",
+            "value": 0.0, "unit": "GiB/s", "vs_baseline": 0.0,
+            "error": "all configs failed"}
+    out = dict(headline)
+    out["configs"] = configs
+    out["wall_s"] = round(time.time() - t_start, 1)
+    print(json.dumps(out))
+    return 0
 
 
 if __name__ == "__main__":

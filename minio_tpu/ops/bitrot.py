@@ -100,6 +100,25 @@ if _HAVE_XXHASH:
 
 DEFAULT_ALGORITHM = _pick_default()
 
+_DEVICE_DEFAULT: str | None = None
+
+
+def device_default_algorithm() -> str:
+    """Default bitrot algorithm for the active JAX backend: mxsum256 on
+    accelerators (hashed inside the fused codec launch, ops/fused.py),
+    the host-native default on CPU. Lazy — touching jax.default_backend()
+    initializes the backend, so only call when a codec path is in play."""
+    global _DEVICE_DEFAULT
+    if _DEVICE_DEFAULT is None:
+        try:
+            import jax
+
+            on_device = jax.default_backend() not in ("cpu",)
+        except Exception:  # noqa: BLE001
+            on_device = False
+        _DEVICE_DEFAULT = "mxsum256" if on_device else DEFAULT_ALGORITHM
+    return _DEVICE_DEFAULT
+
 
 def register_algorithm(name: str, algo: object) -> None:
     """Register an algorithm object exposing digest_len and digest(bytes)."""
@@ -114,6 +133,11 @@ def get_algorithm(name: str):
             from minio_tpu.ops import mxhash
 
             mxhash.register()
+            return _REGISTRY[name]
+        if name == "mxsum256":  # device linear checksum: registered on first use
+            from minio_tpu.ops import mxsum
+
+            mxsum.register()
             return _REGISTRY[name]
         raise se.CorruptedFormat(f"unknown bitrot algorithm {name!r}") from None
 

@@ -1,0 +1,104 @@
+"""Fused erasure-codec + bitrot launches — the production device path.
+
+One jit launch per batch of erasure blocks computes parity AND the mxsum256
+bitrot digest of every shard chunk while the shards are resident on device
+(SURVEY.md §2.3: the reference hashes each chunk host-side while hot,
+cmd/bitrot-streaming.go:46-74; here the hash shares the launch with the
+GF(2) contraction). The serving paths call these:
+
+  PutObject  -> encode_with_digests      (erasure/codec.py begin_encode)
+  GetObject  -> verify_digests           (batched chunk verify on read)
+  Heal       -> reconstruct_with_digests (rebuilt shards + their digests)
+
+Kernel dispatch: the Pallas tiled kernel (ops/rs_pallas.py) on TPU-like
+backends — ragged shard widths are zero-padded to its TILE in-graph (parity
+columns never mix, so padding is free and sliced back off) — and the pure
+XLA path (ops/rs_xla.py) on CPU. Ragged *chunk lengths* need no padding
+tricks at all: mxsum256 digests are computed under per-row dynamic lengths
+(zero tail bytes contribute nothing), so a batch mixing full and short
+chunks is one launch, one compiled program.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from minio_tpu.ops import mxsum, rs_pallas, rs_xla
+
+
+def _encode_dispatch(data: jax.Array, k: int, m: int) -> jax.Array:
+    b, _, s = data.shape
+    if rs_pallas.use_pallas():
+        pad = (-s) % rs_pallas.TILE
+        if pad:
+            dp = jnp.pad(data, ((0, 0), (0, 0), (0, pad)))
+            return rs_pallas.encode(dp, k, m)[:, :, :s]
+        return rs_pallas.encode(data, k, m)
+    return rs_xla.encode(data, k, m)
+
+
+def _reconstruct_dispatch(shards: jax.Array, k: int, n: int,
+                          survivors: tuple[int, ...],
+                          targets: tuple[int, ...]) -> jax.Array:
+    b, _, s = shards.shape
+    if rs_pallas.use_pallas():
+        pad = (-s) % rs_pallas.TILE
+        if pad:
+            sp = jnp.pad(shards, ((0, 0), (0, 0), (0, pad)))
+            return rs_pallas.reconstruct(sp, k, n, survivors, targets)[:, :, :s]
+        return rs_pallas.reconstruct(shards, k, n, survivors, targets)
+    return rs_xla.reconstruct(shards, k, n, survivors, targets)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m"))
+def encode_with_digests(data: jax.Array, k: int, m: int,
+                        chunk_lens: jax.Array | None = None
+                        ) -> tuple[jax.Array, jax.Array]:
+    """data [B, k, S] u8 (rows zero-padded past each block's chunk length)
+    -> (parity [B, m, S] u8, digests [B, k+m, 32] u8).
+
+    chunk_lens [B] int32: each block's actual chunk byte-length (defaults to
+    S). Digests are mxsum256 over each shard's chunk_lens[b] bytes — exactly
+    the [digest][chunk] records the bitrot writer frames (ops/bitrot.py)."""
+    b, _, s = data.shape
+    n = k + m
+    if chunk_lens is None:
+        chunk_lens = jnp.full((b,), s, dtype=jnp.int32)
+    parity = _encode_dispatch(data, k, m)
+    shards = jnp.concatenate([data, parity], axis=1)        # [B, n, S]
+    lens = jnp.repeat(chunk_lens, n)                        # row-major [B*n]
+    digs = mxsum.digest_device(shards.reshape(b * n, s), lens)
+    return parity, digs.reshape(b, n, mxsum.DIGEST_LEN)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n", "survivors", "targets"))
+def reconstruct_with_digests(shards: jax.Array, k: int, n: int,
+                             survivors: tuple[int, ...],
+                             targets: tuple[int, ...],
+                             chunk_lens: jax.Array | None = None
+                             ) -> tuple[jax.Array, jax.Array]:
+    """Rebuild `targets` from any-k `survivors` and digest the rebuilt
+    chunks in the same launch (heal writes them straight into fresh
+    [digest][chunk] shard files — cmd/erasure-healing.go:401-461).
+
+    shards [B, n, S] u8 -> (rebuilt [B, t, S] u8, digests [B, t, 32] u8)."""
+    b, _, s = shards.shape
+    t = len(targets)
+    if chunk_lens is None:
+        chunk_lens = jnp.full((b,), s, dtype=jnp.int32)
+    rebuilt = _reconstruct_dispatch(shards, k, n, survivors, targets)
+    lens = jnp.repeat(chunk_lens, t)
+    digs = mxsum.digest_device(rebuilt.reshape(b * t, s), lens)
+    return rebuilt, digs.reshape(b, t, mxsum.DIGEST_LEN)
+
+
+@jax.jit
+def verify_digests(chunks: jax.Array, lens: jax.Array) -> jax.Array:
+    """Batched read-path verify: chunks [N, S] u8 (zero-padded rows),
+    lens [N] int32 -> digests [N, 32] u8. The GET path compares these to the
+    stored record digests — one launch per read batch instead of one host
+    hash per chunk (cmd/bitrot-streaming.go:115-158 verifies per ReadAt)."""
+    return mxsum.digest_device(chunks, lens)

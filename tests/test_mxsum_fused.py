@@ -1,0 +1,151 @@
+"""mxsum256 device checksum + fused codec launches.
+
+Host/device bit-exactness, cap-independence (the property that makes one
+compiled program serve every chunk length), and the fused encode/reconstruct
+paths against the rs_xla ground truth. Pallas kernels run in interpreter
+mode (conftest forces the CPU backend)."""
+
+import io
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from minio_tpu.ops import bitrot, fused, gf, mxsum, rs_pallas, rs_xla
+
+rng = np.random.default_rng(7)
+
+
+# ---------------- mxsum core ----------------
+
+
+def test_digest_host_device_bitexact():
+    for s in (0, 1, 7, 511, 512, 4096, 131072):
+        data = rng.integers(0, 256, s, dtype=np.uint8)
+        host = mxsum.digest_np(data.tobytes())
+        padded = np.zeros((1, max(s, 1)), dtype=np.uint8)
+        padded[0, :s] = data
+        dev = np.asarray(mxsum.digest_device(
+            jnp.asarray(padded), jnp.asarray([s], dtype=jnp.int32)))[0]
+        assert host == bytes(dev), s
+
+
+def test_digest_cap_independent():
+    data = rng.integers(0, 256, 1000, dtype=np.uint8)
+    base = mxsum.digest_np(data.tobytes())
+    for cap in (1000, 1024, 4096, 131072):
+        padded = np.zeros((1, cap), dtype=np.uint8)
+        padded[0, :1000] = data
+        dev = np.asarray(mxsum.digest_device(
+            jnp.asarray(padded), jnp.asarray([1000], dtype=jnp.int32)))[0]
+        assert bytes(dev) == base, cap
+        host = mxsum.digest_batch_np(padded, [1000])[0]
+        assert bytes(host) == base, cap
+
+
+def test_digest_length_sensitive():
+    a = mxsum.digest_np(b"abc")
+    b = mxsum.digest_np(b"abc\x00")
+    c = mxsum.digest_np(b"")
+    assert a != b and a != c and b != c
+
+
+def test_digest_detects_corruption():
+    data = bytearray(rng.integers(0, 256, 8192, dtype=np.uint8).tobytes())
+    want = mxsum.digest_np(bytes(data))
+    data[1234] ^= 0x40
+    assert mxsum.digest_np(bytes(data)) != want
+
+
+def test_batch_matches_single():
+    lens = [100, 512, 513, 0, 4096]
+    cap = 4096
+    chunks = np.zeros((len(lens), cap), dtype=np.uint8)
+    rows = []
+    for i, s in enumerate(lens):
+        row = rng.integers(0, 256, s, dtype=np.uint8)
+        chunks[i, :s] = row
+        rows.append(row)
+    batch = mxsum.digest_batch_np(chunks, lens)
+    for i, row in enumerate(rows):
+        assert bytes(batch[i]) == mxsum.digest_np(row.tobytes())
+    dev = np.asarray(mxsum.digest_device(
+        jnp.asarray(chunks), jnp.asarray(lens, dtype=jnp.int32)))
+    assert dev.tobytes() == batch.tobytes()
+
+
+def test_bitrot_registry_roundtrip():
+    buf = io.BytesIO()
+    w = bitrot.BitrotWriter(buf, shard_size=256, algorithm="mxsum256")
+    payload = rng.integers(0, 256, 1000, dtype=np.uint8).tobytes()
+    for off in range(0, 1000, 256):
+        w.write(payload[off:off + 256])
+    buf.seek(0)
+    r = bitrot.BitrotReader(buf, 1000, 256, algorithm="mxsum256")
+    assert r.read_at(0, 1000) == payload
+    assert r.read_at(300, 400) == payload[300:700]
+    # corrupt one byte inside chunk 2
+    raw = bytearray(buf.getvalue())
+    raw[2 * (32 + 256) + 32 + 5] ^= 1
+    r2 = bitrot.BitrotReader(io.BytesIO(bytes(raw)), 1000, 256,
+                             algorithm="mxsum256")
+    from minio_tpu.utils import errors as se
+    with pytest.raises(se.FileCorrupt):
+        r2.read_at(0, 1000)
+
+
+# ---------------- fused launches ----------------
+
+
+def test_encode_with_digests_matches_ground_truth():
+    k, m, s = 4, 2, 640
+    lens = [640, 640, 100]
+    data = np.zeros((3, k, s), dtype=np.uint8)
+    for b, ln in enumerate(lens):
+        data[b, :, :ln] = rng.integers(0, 256, (k, ln), dtype=np.uint8)
+    parity, digs = fused.encode_with_digests(
+        jnp.asarray(data), k, m, jnp.asarray(lens, dtype=jnp.int32))
+    parity, digs = np.asarray(parity), np.asarray(digs)
+    want_parity = np.asarray(rs_xla.encode(jnp.asarray(data), k, m))
+    assert parity.tobytes() == want_parity.tobytes()
+    shards = np.concatenate([data, parity], axis=1)
+    for b, ln in enumerate(lens):
+        for i in range(k + m):
+            assert bytes(digs[b, i]) == mxsum.digest_np(shards[b, i, :ln].tobytes())
+
+
+def test_reconstruct_with_digests():
+    k, n, s = 4, 6, 512
+    data = rng.integers(0, 256, (2, k, s), dtype=np.uint8)
+    parity = np.asarray(rs_xla.encode(jnp.asarray(data), k, n - k))
+    shards = np.concatenate([data, parity], axis=1)
+    targets = (0, 4)
+    survivors = tuple(i for i in range(n) if i not in targets)[:k]
+    rebuilt, digs = fused.reconstruct_with_digests(
+        jnp.asarray(shards), k, n, survivors, targets)
+    rebuilt, digs = np.asarray(rebuilt), np.asarray(digs)
+    for ti, t in enumerate(targets):
+        assert rebuilt[:, ti].tobytes() == shards[:, t].tobytes()
+        for b in range(2):
+            assert bytes(digs[b, ti]) == mxsum.digest_np(rebuilt[b, ti].tobytes())
+
+
+def test_pallas_reconstruct_matches_xla():
+    k, n, s = 8, 12, rs_pallas.TILE
+    data = rng.integers(0, 256, (2, k, s), dtype=np.uint8)
+    parity = np.asarray(rs_xla.encode(jnp.asarray(data), k, n - k))
+    shards = jnp.asarray(np.concatenate([data, parity], axis=1))
+    targets = (1, 3, 9)
+    survivors = tuple(i for i in range(n) if i not in targets)[:k]
+    a = np.asarray(rs_pallas.reconstruct(shards, k, n, survivors, targets,
+                                         interpret=True))
+    b = np.asarray(rs_xla.reconstruct(shards, k, n, survivors, targets))
+    assert a.tobytes() == b.tobytes()
+
+
+def test_verify_digests_entry():
+    chunks = rng.integers(0, 256, (5, 300), dtype=np.uint8)
+    lens = jnp.full((5,), 300, dtype=jnp.int32)
+    digs = np.asarray(fused.verify_digests(jnp.asarray(chunks), lens))
+    for i in range(5):
+        assert bytes(digs[i]) == mxsum.digest_np(chunks[i].tobytes())
