@@ -28,10 +28,12 @@ DEFAULTS: dict[str, dict[str, str]] = {
                        "queue_limit": "10000"},
     "logger_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
     "audit_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
+    "audit_file": {"path": ""},
 }
 
 # Subsystems that apply without restart (cmd/config/config.go:133).
-DYNAMIC = {"api", "scanner", "heal"}
+DYNAMIC = {"api", "scanner", "heal",
+           "logger_webhook", "audit_webhook", "audit_file"}
 
 PATH = "config/config.json"
 ENV_PREFIX = "MTPU"

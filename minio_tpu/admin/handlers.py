@@ -53,7 +53,11 @@ class AdminAPI:
 
         if op == "info" and m == "GET":
             self._authorize(identity, "admin:ServerInfo")
-            return _json(await run(self._server_info))
+            info = await run(self._server_info)
+            notif = getattr(self.s, "notification", None)
+            if notif is not None and notif.peers:
+                info["servers"] = await run(notif.server_info_all)
+            return _json(info)
         if op == "datausageinfo" and m == "GET":
             self._authorize(identity, "admin:ServerInfo")
             usage = (self.s.scanner.usage.to_info()
@@ -84,7 +88,36 @@ class AdminAPI:
 
         if op == "trace" and m == "GET":
             self._authorize(identity, "admin:ServerTrace")
-            return await self._trace_stream(request)
+            return await self._bus_stream(request, self.s.trace_bus,
+                                          peer_stream="trace_stream",
+                                          all_nodes=q.get("all", "true") != "false")
+        if op == "consolelog" and m == "GET":
+            self._authorize(identity, "admin:ConsoleLog")
+            return await self._bus_stream(request,
+                                          self.s.logger.console_bus,
+                                          peer_stream="console_stream",
+                                          all_nodes=q.get("all", "true") != "false")
+        if op == "profiling" and rest == "start" and m == "POST":
+            self._authorize(identity, "admin:Profiling")
+            kinds = q.get("profilerType", q.get("kinds", "cpu"))
+            self.s.profiler.start(tuple(kinds.split(",")))
+            notif = getattr(self.s, "notification", None)
+            if notif is not None:
+                await run(notif.start_profiling_all, kinds)
+            return _json({"startResults": [{"success": True}]})
+        if op == "profiling" and rest == "download" and m == "GET":
+            self._authorize(identity, "admin:Profiling")
+            from minio_tpu.admin.profiling import zip_profiles
+
+            def collect() -> bytes:
+                per_node = {"local": self.s.profiler.stop_collect()}
+                notif = getattr(self.s, "notification", None)
+                if notif is not None:
+                    per_node.update(notif.download_profiling_all())
+                return zip_profiles(per_node)
+
+            return web.Response(body=await run(collect),
+                                content_type="application/zip")
 
         # -- IAM surface (cmd/admin-handlers-users.go) --
         iam_ops = {
@@ -133,7 +166,11 @@ class AdminAPI:
                 return _json({"buckets": dict(self.s.bandwidth)})
         if op in ("obdinfo", "healthinfo") and m == "GET":
             self._authorize(identity, "admin:OBDInfo")
-            return _json(await run(self._obd_info))
+            obd = await run(self._obd_info)
+            notif = getattr(self.s, "notification", None)
+            if notif is not None and notif.peers:
+                obd["peers"] = await run(notif.obd_all)
+            return _json(obd)
 
         if op in iam_ops:
             self._authorize(identity, "admin:*")
@@ -291,20 +328,62 @@ class AdminAPI:
                     await run(cfg.set_kv, subsys, kv)
                 except se.IAMError as e:
                     raise S3Error("InvalidArgument", str(e)) from None
+            if any(s in ("logger_webhook", "audit_webhook", "audit_file")
+                   for s in doc):
+                self.s.configure_logging()  # dynamic re-apply
             return _json({"restart": [s for s in doc
                                       if not cfg.is_dynamic(s)]})
         raise S3Error("MethodNotAllowed", resource=request.path)
 
-    async def _trace_stream(self, request) -> web.StreamResponse:
+    async def _bus_stream(self, request, bus, peer_stream: str = "",
+                          all_nodes: bool = True) -> web.StreamResponse:
+        """Stream a local pubsub as JSON lines, merged with every peer's
+        matching stream (reference `mc admin trace`/`console` subscribe to
+        all nodes via peer REST, cmd/peer-rest-client.go:782): peer pullers
+        run in daemon threads feeding the same local queue."""
+        import queue as _queue
+        import threading as _threading
+
         resp = web.StreamResponse()
         resp.content_type = "application/json"
         await resp.prepare(request)
-        with self.s.trace_bus.subscribe() as sub:
+        merged: _queue.Queue = _queue.Queue(maxsize=2000)
+        stop = _threading.Event()
+
+        def pull(peer):
+            try:
+                # heartbeats=True: the stop flag must be re-checked even
+                # when the peer is idle, else this thread (and its
+                # connection + peer-side subscription) leaks forever.
+                for item in getattr(peer, peer_stream)(heartbeats=True):
+                    if stop.is_set():
+                        return
+                    if item.get("hb"):
+                        continue
+                    try:
+                        merged.put_nowait(item)
+                    except _queue.Full:
+                        pass
+            except Exception:  # noqa: BLE001 - peer went away
+                pass
+
+        notif = getattr(self.s, "notification", None)
+        if all_nodes and peer_stream and notif is not None:
+            for p in notif.peers:
+                _threading.Thread(target=pull, args=(p,), daemon=True).start()
+
+        with bus.subscribe() as sub:
             loop = asyncio.get_running_loop()
+
+            def next_item():
+                try:
+                    return merged.get_nowait()
+                except _queue.Empty:
+                    return sub.get(timeout=0.5)
+
             try:
                 while True:
-                    item = await loop.run_in_executor(
-                        None, lambda: sub.get(timeout=1.0))
+                    item = await loop.run_in_executor(None, next_item)
                     if item is None:
                         # Heartbeat keeps the connection honest.
                         await resp.write(b"\n")
@@ -312,6 +391,8 @@ class AdminAPI:
                     await resp.write(json.dumps(item).encode() + b"\n")
             except (ConnectionResetError, asyncio.CancelledError):
                 pass
+            finally:
+                stop.set()
         return resp
 
     # -- IAM handlers --

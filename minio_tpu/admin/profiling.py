@@ -1,0 +1,97 @@
+"""Profiling plane: host cProfile + optional JAX device trace capture.
+
+Role-equivalent of cmd/utils.go:276 startProfiler and the peer fan-out
+(cmd/notification.go:286-301 StartProfiling/DownloadProfilingData): an
+admin starts profiling on every node, lets the workload run, then downloads
+one archive holding each node's profiles. The TPU-native addition is the
+device trace — jax.profiler captures XLA/Pallas execution timelines
+alongside the host CPU profile (SURVEY.md §5.1 TPU mapping)."""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import os
+import pstats
+import shutil
+import tempfile
+import threading
+import zipfile
+
+
+class Profiler:
+    """One node's profiling session (at most one active at a time)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._cpu: cProfile.Profile | None = None
+        self._jax_dir: str | None = None
+
+    @property
+    def running(self) -> bool:
+        return self._cpu is not None or self._jax_dir is not None
+
+    def start(self, kinds: tuple[str, ...] = ("cpu",)) -> None:
+        with self._mu:
+            if self.running:
+                raise RuntimeError("profiler already running")
+            if "cpu" in kinds:
+                self._cpu = cProfile.Profile()
+                self._cpu.enable()
+            if "device" in kinds:
+                d = tempfile.mkdtemp(prefix="mtpu-jaxprof-")
+                try:
+                    import jax
+
+                    jax.profiler.start_trace(d)
+                    self._jax_dir = d
+                except Exception:  # noqa: BLE001 - no device / no profiler
+                    shutil.rmtree(d, ignore_errors=True)
+
+    def stop_collect(self) -> dict[str, bytes]:
+        """Stop everything and return {filename: payload}."""
+        out: dict[str, bytes] = {}
+        with self._mu:
+            if self._cpu is not None:
+                self._cpu.disable()
+                stats = pstats.Stats(self._cpu)
+                txt = io.StringIO()
+                stats.stream = txt
+                stats.sort_stats("cumulative").print_stats(100)
+                out["cpu.txt"] = txt.getvalue().encode()
+                with tempfile.NamedTemporaryFile(suffix=".pstats",
+                                                 delete=False) as f:
+                    tmp = f.name
+                stats.dump_stats(tmp)
+                with open(tmp, "rb") as f:
+                    out["cpu.pstats"] = f.read()
+                os.unlink(tmp)
+                self._cpu = None
+            if self._jax_dir is not None:
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001
+                    pass
+                buf = io.BytesIO()
+                with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+                    for root, _dirs, files in os.walk(self._jax_dir):
+                        for fn in files:
+                            p = os.path.join(root, fn)
+                            z.write(p, os.path.relpath(p, self._jax_dir))
+                out["device_trace.zip"] = buf.getvalue()
+                shutil.rmtree(self._jax_dir, ignore_errors=True)
+                self._jax_dir = None
+        return out
+
+
+def zip_profiles(per_node: dict[str, dict[str, bytes]]) -> bytes:
+    """Bundle every node's profile files into one archive
+    (DownloadProfilingData's zip, cmd/notification.go:301)."""
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for node, files in per_node.items():
+            for name, payload in files.items():
+                z.writestr(f"{node}/{name}", payload)
+    return buf.getvalue()

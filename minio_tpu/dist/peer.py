@@ -40,6 +40,23 @@ class PeerHooks:
         self.on_bucket_metadata_invalidate: Callable[[str], None] = lambda b: None
         self.on_iam_reload: Callable[[], None] = lambda: None
         self.health: Callable[[], dict] = lambda: {"ok": True}
+        # Observability fan-in (cmd/peer-rest-common.go:27-61 breadth):
+        self.server_info: Callable[[], dict] = lambda: {}
+        self.obd_info: Callable[[], dict] = lambda: {}
+        self.trace_bus = None        # admin.pubsub.PubSub | None
+        self.console_bus = None      # admin.pubsub.PubSub | None
+        self.profiler = None         # admin.profiling.Profiler | None
+
+
+def _stream_bus(bus):
+    """Chunked-stream a pubsub as msgpack docs with 1 s heartbeats (the
+    heartbeat is what lets the server notice a gone subscriber)."""
+    if bus is None:
+        return
+    with bus.subscribe() as sub:
+        while True:
+            item = sub.get(timeout=1.0)
+            yield pack({"hb": 1} if item is None else item)
 
 
 def peer_routes(hooks: PeerHooks) -> dict:
@@ -52,9 +69,39 @@ def peer_routes(hooks: PeerHooks) -> dict:
     def h_reload_iam(params, body):
         hooks.on_iam_reload()
 
+    def h_server_info(params, body):
+        return pack(hooks.server_info())
+
+    def h_obd_info(params, body):
+        return pack(hooks.obd_info())
+
+    def h_trace(params, body):
+        return _stream_bus(hooks.trace_bus)
+
+    def h_consolelog(params, body):
+        return _stream_bus(hooks.console_bus)
+
+    def h_profile_start(params, body):
+        if hooks.profiler is None:
+            raise se.FaultyDisk("no profiler on this node")
+        kinds = tuple((params.get("kinds") or "cpu").split(","))
+        hooks.profiler.start(kinds)
+        return pack({"ok": True})
+
+    def h_profile_download(params, body):
+        if hooks.profiler is None:
+            raise se.FaultyDisk("no profiler on this node")
+        return pack(hooks.profiler.stop_collect())
+
     return {"health": h_health,
             "invalidate_bucket_metadata": h_invalidate_bucket_metadata,
-            "reload_iam": h_reload_iam}
+            "reload_iam": h_reload_iam,
+            "server_info": h_server_info,
+            "obd_info": h_obd_info,
+            "trace": h_trace,
+            "consolelog": h_consolelog,
+            "profile_start": h_profile_start,
+            "profile_download": h_profile_download}
 
 
 # --- client side -------------------------------------------------------------
@@ -64,6 +111,10 @@ class PeerClient:
 
     def __init__(self, client: RestClient):
         self._client = client
+
+    @property
+    def name(self) -> str:
+        return f"{self._client.host}:{self._client.port}"
 
     def health(self) -> dict:
         return self._client.call_msgpack(f"/rpc/{PLANE}/v1/health")
@@ -77,6 +128,35 @@ class PeerClient:
 
     def verify_bootstrap(self) -> dict:
         return self._client.call_msgpack(f"/rpc/{BOOTSTRAP_PLANE}/v1/verify")
+
+    def server_info(self) -> dict:
+        return self._client.call_msgpack(f"/rpc/{PLANE}/v1/server_info")
+
+    def obd_info(self) -> dict:
+        return self._client.call_msgpack(f"/rpc/{PLANE}/v1/obd_info")
+
+    def trace_stream(self, heartbeats: bool = False):
+        """Iterator over the peer's trace records — the remote half of
+        `mc admin trace` (cmd/peer-rest-client.go:782). heartbeats=True
+        also yields the 1 s keepalive docs ({"hb": 1}) so a consumer can
+        re-check its stop condition on an idle peer."""
+        for doc in self._client.iter_msgpack(f"/rpc/{PLANE}/v1/trace"):
+            if doc.get("hb") and not heartbeats:
+                continue
+            yield doc
+
+    def console_stream(self, heartbeats: bool = False):
+        for doc in self._client.iter_msgpack(f"/rpc/{PLANE}/v1/consolelog"):
+            if doc.get("hb") and not heartbeats:
+                continue
+            yield doc
+
+    def profile_start(self, kinds: str = "cpu") -> None:
+        self._client.call(f"/rpc/{PLANE}/v1/profile_start", {"kinds": kinds})
+
+    def profile_download(self) -> dict:
+        """-> {filename: bytes} of the peer's collected profiles."""
+        return self._client.call_msgpack(f"/rpc/{PLANE}/v1/profile_download")
 
     def is_online(self) -> bool:
         return self._client.is_online()
@@ -120,18 +200,47 @@ class NotificationSys:
     def __init__(self, peers: list[PeerClient]):
         self.peers = peers
 
-    def _fanout(self, fn: Callable[[PeerClient], None]) -> list[Exception | None]:
-        out: list[Exception | None] = []
-        for p in self.peers:
+    def _fanout(self, fn: Callable[[PeerClient], object]) -> list:
+        """Concurrent best-effort broadcast — latency is one peer's RPC
+        (bounded by the client timeout), not the sum over peers (the
+        reference fans out in goroutines, cmd/notification.go)."""
+        if not self.peers:
+            return []
+        from concurrent.futures import ThreadPoolExecutor
+
+        def one(p):
             try:
-                fn(p)
-                out.append(None)
+                return fn(p)
             except Exception as e:  # noqa: BLE001 - best-effort plane
-                out.append(e)
-        return out
+                return e
+
+        with ThreadPoolExecutor(max_workers=min(16, len(self.peers))) as ex:
+            return list(ex.map(one, self.peers))
 
     def invalidate_bucket_metadata(self, bucket: str) -> None:
         self._fanout(lambda p: p.invalidate_bucket_metadata(bucket))
 
     def reload_iam(self) -> None:
         self._fanout(lambda p: p.reload_iam())
+
+    # -- observability fan-in (cmd/notification.go:286-1237) --
+
+    def server_info_all(self) -> list[dict]:
+        results = self._fanout(lambda p: p.server_info())
+        return [r if not isinstance(r, Exception)
+                else {"error": str(r), "node": p.name}
+                for p, r in zip(self.peers, results)]
+
+    def obd_all(self) -> list[dict]:
+        results = self._fanout(lambda p: p.obd_info())
+        return [r if not isinstance(r, Exception)
+                else {"error": str(r), "node": p.name}
+                for p, r in zip(self.peers, results)]
+
+    def start_profiling_all(self, kinds: str = "cpu") -> list:
+        return self._fanout(lambda p: p.profile_start(kinds))
+
+    def download_profiling_all(self) -> dict[str, dict[str, bytes]]:
+        results = self._fanout(lambda p: p.profile_download())
+        return {p.name: r for p, r in zip(self.peers, results)
+                if not isinstance(r, Exception)}

@@ -79,15 +79,35 @@ class _ResponseStream:
     def read(self, n: int = -1) -> bytes:
         return self._resp.read() if n is None or n < 0 else self._resp.read(n)
 
+    def read1(self, n: int = 65536) -> bytes:
+        """Return whatever is available (at most n) without waiting for n
+        bytes — read(n) on a chunked response blocks until it accumulates n,
+        which would stall live streams (trace/console subscriptions) whose
+        documents trickle in."""
+        return self._resp.read1(n)
+
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
-        # Drain so the connection is reusable; give up past 1 MiB.
+        # Drain so the connection is reusable — but bounded in both bytes
+        # (1 MiB) and time (250 ms): an endless subscription stream
+        # (trace/console heartbeats) would otherwise block this close
+        # forever. Undrainable connections are dropped, not pooled.
         try:
+            if self._resp.isclosed():
+                self._client._put_conn(self._conn)
+                return
+            sock = self._conn.sock
+            prev_timeout = sock.gettimeout() if sock is not None else None
+            if sock is not None:
+                sock.settimeout(0.25)
             leftover = self._resp.read(1 << 20)
             if leftover and len(leftover) == (1 << 20):
                 self._conn.close()
+                return
+            if sock is not None:
+                sock.settimeout(prev_timeout)  # the client's configured timeout
             self._client._put_conn(self._conn)
         except Exception:
             try:
@@ -240,7 +260,7 @@ class RestClient:
         try:
             unpacker = msgpack.Unpacker(strict_map_key=False)
             while True:
-                chunk = st.read(1 << 16)
+                chunk = st.read1(1 << 16)
                 if not chunk:
                     break
                 unpacker.feed(chunk)

@@ -1,0 +1,180 @@
+"""Background new-drive auto-heal with a persisted, resumable tracker.
+
+Role-equivalent of cmd/background-newdisks-heal-ops.go: when a fresh or
+replaced drive joins a set (detected at format time or by the background
+monitor), a healing tracker is persisted ON THE HEALING DRIVE ITSELF
+(:47,139 — the tracker travels with the drive, so a restart resumes the
+walk instead of starting over), the whole set's namespace is walked through
+the standard healObject path, progress is checkpointed every few objects,
+and the tracker is removed on completion (initAutoHeal :241,
+monitorLocalDisksAndHeal :310).
+
+The walk itself heals through ErasureObjects.heal_object, i.e. the batched
+device reconstruct (codec.decode_blocks / gf2_matmul_multi) — the TPU
+design means a resumed heal is the same batched solve, just restarted at
+the bookmark.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from minio_tpu.storage.api import StorageAPI
+from minio_tpu.utils import errors as se
+
+SYS_VOL = ".mtpu.sys"
+TRACKER_PATH = "healing.json"
+CHECKPOINT_EVERY = 16  # objects healed between tracker saves
+
+
+class HealingTracker:
+    """Progress bookmark persisted on the healing drive."""
+
+    def __init__(self, drive_uuid: str = "", started: float = 0.0,
+                 bucket: str = "", obj: str = "",
+                 healed: int = 0, failed: int = 0,
+                 finished_buckets: list[str] | None = None):
+        self.drive_uuid = drive_uuid
+        self.started = started or time.time()
+        self.bucket = bucket              # bucket currently being walked
+        self.obj = obj                    # last object healed in it
+        self.healed = healed
+        self.failed = failed
+        self.finished_buckets = finished_buckets or []
+
+    def to_doc(self) -> dict:
+        return {
+            "drive_uuid": self.drive_uuid, "started": self.started,
+            "bucket": self.bucket, "object": self.obj,
+            "healed": self.healed, "failed": self.failed,
+            "finished_buckets": self.finished_buckets,
+        }
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "HealingTracker":
+        return cls(drive_uuid=doc.get("drive_uuid", ""),
+                   started=doc.get("started", 0.0),
+                   bucket=doc.get("bucket", ""), obj=doc.get("object", ""),
+                   healed=doc.get("healed", 0), failed=doc.get("failed", 0),
+                   finished_buckets=doc.get("finished_buckets", []))
+
+    # -- persistence on the drive --
+
+    def save(self, drive: StorageAPI) -> None:
+        try:
+            drive.make_vol(SYS_VOL)
+        except se.StorageError:
+            pass
+        drive.write_all(SYS_VOL, TRACKER_PATH, json.dumps(self.to_doc()).encode())
+
+    @staticmethod
+    def load(drive: StorageAPI) -> "HealingTracker | None":
+        try:
+            raw = drive.read_all(SYS_VOL, TRACKER_PATH)
+        except se.StorageError:
+            return None
+        try:
+            return HealingTracker.from_doc(json.loads(raw))
+        except (ValueError, KeyError):
+            return None
+
+    @staticmethod
+    def delete(drive: StorageAPI) -> None:
+        try:
+            drive.delete(SYS_VOL, TRACKER_PATH)
+        except se.StorageError:
+            pass
+
+
+def mark_drive_healing(drive: StorageAPI, drive_uuid: str) -> None:
+    """Persist a fresh tracker on a just-formatted replacement drive —
+    called by the format layer when it heals a blank drive into a slot that
+    belongs to a set with existing data (cmd/erasure-sets.go:197 connectDisks
+    -> healFreshDisk)."""
+    if HealingTracker.load(drive) is None:
+        HealingTracker(drive_uuid=drive_uuid).save(drive)
+
+
+class AutoHealer:
+    """Background monitor: finds drives carrying a healing tracker and
+    walks their set's namespace through heal_object, checkpointing and
+    resuming via the tracker (reference monitorLocalDisksAndHeal)."""
+
+    def __init__(self, sets, interval: float = 10.0):
+        # `sets` is anything exposing .sets -> list[ErasureObjects]
+        # (ErasureSets / pools) or a single ErasureObjects.
+        self._sets = getattr(sets, "sets", None) or [sets]
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.run_once()
+            except Exception:  # noqa: BLE001 - keep the monitor alive
+                pass
+
+    # -- one monitor pass (test entry point) --
+
+    def run_once(self) -> int:
+        """Heal every drive that carries a tracker; returns drives healed."""
+        healed_drives = 0
+        for es in self._sets:
+            for drive in es.drives:
+                tracker = HealingTracker.load(drive)
+                if tracker is None:
+                    continue
+                self._heal_set_onto(es, drive, tracker)
+                healed_drives += 1
+        return healed_drives
+
+    def _heal_set_onto(self, es, drive: StorageAPI,
+                       tracker: HealingTracker) -> None:
+        """Walk the set's buckets/objects, healing each (the standard
+        healObject path rebuilds shards onto every outdated drive — this
+        one included), resuming after the tracker's bookmark."""
+        buckets = sorted(b.name for b in es.list_buckets())
+        since_save = 0
+        for bucket in buckets:
+            if bucket in tracker.finished_buckets:
+                continue
+            if tracker.bucket and bucket < tracker.bucket:
+                tracker.finished_buckets.append(bucket)
+                continue
+            try:
+                es.heal_bucket(bucket)
+            except se.StorageError:
+                pass
+            start_after = tracker.obj if tracker.bucket == bucket else ""
+            for name in sorted(es.merged_journals(bucket, "")):
+                if self._stop.is_set():
+                    tracker.save(drive)
+                    return
+                if start_after and name <= start_after:
+                    continue
+                try:
+                    es.heal_object(bucket, name)
+                    tracker.healed += 1
+                except Exception:  # noqa: BLE001
+                    tracker.failed += 1
+                tracker.bucket, tracker.obj = bucket, name
+                since_save += 1
+                if since_save >= CHECKPOINT_EVERY:
+                    tracker.save(drive)
+                    since_save = 0
+            tracker.finished_buckets.append(bucket)
+            tracker.bucket, tracker.obj = "", ""
+            tracker.save(drive)
+        HealingTracker.delete(drive)

@@ -24,6 +24,52 @@ from minio_tpu.utils import shardmath
 DEFAULT_BLOCK_SIZE = 1 << 20  # reference blockSizeV2, cmd/object-api-common.go:41
 
 
+class PendingEncode:
+    """Handle to an in-flight device encode launch (JAX async dispatch).
+
+    begin_encode returns immediately after queuing the launch; the host
+    thread overlaps the next batch's read/copy and the previous batch's
+    drive fan-out with this batch's device compute — the reference's
+    read/encode/write block pipeline (cmd/erasure-encode.go:80-107, P2 in
+    SURVEY §2.4) expressed as dispatch-ahead instead of goroutines.
+
+    wait() materializes results with ONE contiguous device->host transfer
+    per tensor and hands out zero-copy memoryview slices (no per-shard
+    .tobytes()). Data chunks alias the caller's original block buffers;
+    parity chunks alias the transferred array, which the views keep alive.
+    """
+
+    def __init__(self, codec: "ErasureCodec", blocks: list[bytes],
+                 chunk_lens: list[int], padded: list[bytes | None],
+                 parity_dev, digs_dev):
+        self._codec = codec
+        self._blocks = blocks
+        self._lens = chunk_lens
+        self._padded = padded
+        self._parity_dev = parity_dev
+        self._digs_dev = digs_dev
+
+    def wait(self) -> tuple[list[list[memoryview]], list[list[bytes]] | None]:
+        """-> (per-block list of n shard chunks, per-block list of n chunk
+        digests or None when digests were not requested)."""
+        k, m = self._codec.k, self._codec.m
+        parity = np.asarray(self._parity_dev) if self._parity_dev is not None else None
+        digs = np.asarray(self._digs_dev) if self._digs_dev is not None else None
+        out_chunks: list[list[memoryview]] = []
+        out_digs: list[list[bytes]] | None = [] if digs is not None else None
+        for bi, block in enumerate(self._blocks):
+            s = self._lens[bi]
+            src = self._padded[bi] if self._padded[bi] is not None else block
+            mv = memoryview(src)
+            chunks = [mv[i * s:(i + 1) * s] for i in range(k)]
+            if m:
+                chunks += [memoryview(parity[bi, j])[:s] for j in range(m)]
+            out_chunks.append(chunks)
+            if out_digs is not None:
+                out_digs.append([bytes(digs[bi, i]) for i in range(k + m)])
+        return out_chunks, out_digs
+
+
 class ErasureCodec:
     def __init__(self, data_blocks: int, parity_blocks: int,
                  block_size: int = DEFAULT_BLOCK_SIZE):
@@ -49,35 +95,59 @@ class ErasureCodec:
 
     # --- batched encode ---
 
-    def encode_blocks(self, blocks: list[bytes]) -> list[list[bytes]]:
-        """Encode a batch of erasure blocks.
+    def begin_encode(self, blocks: list[bytes],
+                     with_digests: bool = False) -> PendingEncode:
+        """Queue one device launch encoding a batch of erasure blocks
+        (parity, and with_digests=True the mxsum256 bitrot digest of every
+        shard chunk in the same launch — ops/fused.py). Returns immediately;
+        results come from PendingEncode.wait()."""
+        import jax.numpy as jnp
 
-        Returns, per block, the n = k+m shard chunks (data first, then
-        parity), each ceil(len(block)/k) bytes.
-        """
-        if not blocks:
-            return []
+        from minio_tpu.ops import fused
+
         s_full = self.shard_size()
-        batch = np.zeros((len(blocks), self.k, s_full), dtype=np.uint8)
-        chunk_lens = []
+        batch = np.empty((len(blocks), self.k, s_full), dtype=np.uint8)
+        chunk_lens: list[int] = []
+        padded: list[bytes | None] = []
         for bi, block in enumerate(blocks):
             if not 0 < len(block) <= self.block_size:
                 raise ValueError(f"block {bi} size {len(block)}")
             s = _ceil_div(len(block), self.k)
             chunk_lens.append(s)
-            flat = np.frombuffer(block, dtype=np.uint8)
-            padded = np.zeros(self.k * s, dtype=np.uint8)
-            padded[: flat.size] = flat
-            batch[bi, :, :s] = padded.reshape(self.k, s)
-        if self.m:
-            parity = np.asarray(rs_xla.encode(batch, self.k, self.m))
-        out = []
-        for bi, s in enumerate(chunk_lens):
-            chunks = [batch[bi, i, :s].tobytes() for i in range(self.k)]
-            if self.m:
-                chunks += [parity[bi, j, :s].tobytes() for j in range(self.m)]
-            out.append(chunks)
-        return out
+            if s == s_full and len(block) == self.k * s_full:
+                padded.append(None)
+                batch[bi] = np.frombuffer(block, dtype=np.uint8).reshape(
+                    self.k, s_full)
+            else:
+                flat = np.zeros(self.k * s, dtype=np.uint8)
+                flat[: len(block)] = np.frombuffer(block, dtype=np.uint8)
+                padded.append(flat.tobytes())
+                batch[bi, :, :s] = flat.reshape(self.k, s)
+                batch[bi, :, s:] = 0
+        parity_dev = digs_dev = None
+        if self.m or with_digests:
+            data_dev = jnp.asarray(batch)
+            lens_dev = jnp.asarray(chunk_lens, dtype=jnp.int32)
+            if self.m and with_digests:
+                parity_dev, digs_dev = fused.encode_with_digests(
+                    data_dev, self.k, self.m, lens_dev)
+            elif self.m:
+                parity_dev = fused.encode_only(data_dev, self.k, self.m)
+            else:  # digests for a parity-less geometry (k shards only)
+                digs_dev = fused.verify_digests(
+                    data_dev.reshape(len(blocks) * self.k, s_full),
+                    jnp.repeat(lens_dev, self.k),
+                ).reshape(len(blocks), self.k, -1)
+        return PendingEncode(self, blocks, chunk_lens, padded,
+                             parity_dev, digs_dev)
+
+    def encode_blocks(self, blocks: list[bytes]) -> list[list[bytes]]:
+        """Synchronous encode: per block, the n = k+m shard chunks (data
+        first, then parity), each ceil(len(block)/k) bytes."""
+        if not blocks:
+            return []
+        chunks, _ = self.begin_encode(blocks).wait()
+        return [[bytes(c) for c in row] for row in chunks]
 
     # --- batched decode / reconstruct ---
 
@@ -104,7 +174,8 @@ class ErasureCodec:
         present = [shard_chunks[0][i] is not None for i in range(n)]
         for row in shard_chunks:
             if [c is not None for c in row] != present:
-                raise ValueError("all blocks in a batch must share a failure pattern")
+                # Mixed failure patterns: the per-block-weight launch.
+                return self.decode_blocks_multi(shard_chunks, block_lens, need_all)
         if sum(present) < self.k:
             from minio_tpu.utils import errors as se
             raise se.InsufficientReadQuorum(
@@ -135,6 +206,65 @@ class ErasureCodec:
         )
         out = []
         for bi, row in enumerate(shard_chunks):
+            s = chunk_lens[bi]
+            fixed = list(row)
+            for ti, shard_idx in enumerate(targets):
+                fixed[shard_idx] = rebuilt[bi, ti, :s].tobytes()
+            out.append([fixed[i] for i in want])
+        return out
+
+    def decode_blocks_multi(
+        self,
+        shard_chunks: list[list[bytes | None]],
+        block_lens: list[int],
+        need_all: bool = False,
+    ) -> list[list[bytes]]:
+        """decode_blocks for a batch whose blocks have DIFFERENT failure
+        patterns: every block carries its own stacked decode matrix and the
+        whole batch rebuilds in ONE launch (rs_xla.gf2_matmul_multi) — the
+        TPU-native form of healing many objects with differing drive states
+        in a single batched solve (cmd/erasure-healing.go heals pattern by
+        pattern)."""
+        from minio_tpu.utils import errors as se
+
+        n = self.k + self.m
+        if not shard_chunks:
+            return []
+        s_full = self.shard_size()
+        want = list(range(n) if need_all else range(self.k))
+        chunk_lens = [_ceil_div(bl, self.k) for bl in block_lens]
+
+        per_block: list[tuple[tuple[int, ...], tuple[int, ...]]] = []
+        t_max = 1
+        for bi, row in enumerate(shard_chunks):
+            present = [i for i in range(n) if row[i] is not None]
+            if len(present) < self.k:
+                raise se.InsufficientReadQuorum(
+                    "", "",
+                    f"block {bi}: only {len(present)} of {self.k} shards")
+            survivors = tuple(present[: self.k])
+            targets = tuple(i for i in want if row[i] is None)
+            per_block.append((survivors, targets))
+            t_max = max(t_max, len(targets))
+
+        if all(not t for _, t in per_block):
+            return [[row[i] for i in want] for row in shard_chunks]  # type: ignore[misc]
+
+        batch = np.zeros((len(shard_chunks), self.k, s_full), dtype=np.uint8)
+        weights = np.zeros((len(shard_chunks), self.k * 8, t_max * 8),
+                           dtype=np.int8)
+        for bi, row in enumerate(shard_chunks):
+            survivors, targets = per_block[bi]
+            for si, shard_idx in enumerate(survivors):
+                c = row[shard_idx]
+                batch[bi, si, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+            if targets:
+                w = rs_xla._decode_weights_np(self.k, n, survivors, targets)
+                weights[bi, :, : len(targets) * 8] = w
+        rebuilt = np.asarray(rs_xla.gf2_matmul_multi(batch, weights, t_max))
+        out = []
+        for bi, row in enumerate(shard_chunks):
+            _, targets = per_block[bi]
             s = chunk_lens[bi]
             fixed = list(row)
             for ti, shard_idx in enumerate(targets):

@@ -160,6 +160,13 @@ def init_format_erasure(
         try:
             drive.write_format(ref.to_doc(slot_uuid))
             drive.set_disk_id(slot_uuid)
+            # A blank drive joining a deployment that already has data is a
+            # replacement: leave a healing tracker on it so the background
+            # auto-healer rebuilds its shards and resumes across restarts
+            # (reference healFreshDisk, background-newdisks-heal-ops.go:139).
+            from minio_tpu.erasure.autoheal import mark_drive_healing
+
+            mark_drive_healing(drive, slot_uuid)
         except se.StorageError:
             pass
     drives[:] = ordered  # callers consume the UUID-ordered layout

@@ -366,10 +366,26 @@ class HealingMixin:
                                 row[pos] = readers[pos].read_at(b * shard_size, chunk_len)
                             rows.append(row)
                         rebuilt = codec.decode_blocks(rows, block_lens, need_all=True)
-                        for j in range(len(batch_ids)):
-                            for pos in targets:
-                                chunk = rebuilt[j][pos]
-                                pool.put(pos, bitrot_algo.digest(chunk) + chunk)
+                        if algo == "mxsum256":
+                            # Digest every rebuilt chunk in one device
+                            # launch (ops/fused.py) instead of per-chunk
+                            # host hashing.
+                            from minio_tpu.ops import fused
+
+                            flat = [rebuilt[j][pos]
+                                    for j in range(len(batch_ids))
+                                    for pos in targets]
+                            digs = fused.digest_chunks_host(flat, shard_size)
+                            di = 0
+                            for j in range(len(batch_ids)):
+                                for pos in targets:
+                                    pool.put(pos, digs[di] + rebuilt[j][pos])
+                                    di += 1
+                        else:
+                            for j in range(len(batch_ids)):
+                                for pos in targets:
+                                    chunk = rebuilt[j][pos]
+                                    pool.put(pos, bitrot_algo.digest(chunk) + chunk)
                         bi = batch_ids[-1] + 1
                 finally:
                     for r in readers.values():

@@ -95,7 +95,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         parity: int | None = None,
         block_size: int = DEFAULT_BLOCK_SIZE,
         batch_blocks: int = 8,
-        bitrot_algorithm: str = bitrot.DEFAULT_ALGORITHM,
+        bitrot_algorithm: str | None = None,
         enable_mrf: bool = False,
         nslock=None,
     ):
@@ -115,7 +115,11 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             raise ValueError(f"parity {self.parity} invalid for {self.n} drives")
         self.block_size = block_size
         self.batch_blocks = batch_blocks
-        self.bitrot_algorithm = bitrot_algorithm
+        # Default bitrot algorithm follows the backend: mxsum256 on
+        # accelerators (fused into the codec launches), host-native hash on
+        # CPU (reference default HH256S, cmd/xl-storage-format-v1.go:117).
+        self.bitrot_algorithm = (bitrot_algorithm if bitrot_algorithm
+                                 else bitrot.device_default_algorithm())
         self.mrf: MRFHealer | None = MRFHealer(self) if enable_mrf else None
 
     def close(self) -> None:
@@ -422,7 +426,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     chosen = ensure_readers()
                     try:
                         rows = self._read_chunk_rows(
-                            readers, chosen, batch_ids, block_lens, codec, n, dead
+                            readers, chosen, batch_ids, block_lens, codec, n,
+                            dead, algo,
                         )
                         break
                     except se.StorageError:
@@ -451,23 +456,66 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             if dead and self.mrf is not None:
                 self.mrf.add_partial(bucket, obj, fi.version_id)
 
-    def _read_chunk_rows(self, readers, chosen, batch_ids, block_lens, codec, n, dead):
+    def _read_chunk_rows(self, readers, chosen, batch_ids, block_lens, codec,
+                         n, dead, algo=None):
         """Read one batch of chunk rows from the chosen shards; marks dead
-        drives and raises StorageError to trigger re-selection."""
+        drives and raises StorageError to trigger re-selection.
+
+        mxsum256 shard files verify in ONE device launch per batch
+        (fused.verify_digests) instead of per-chunk host hashing — the
+        TPU-native form of the reference's verify-every-ReadAt
+        (cmd/bitrot-streaming.go:115-158)."""
+        batched_verify = algo == "mxsum256"
         rows: list[list[bytes | None]] = []
+        records: list[tuple[int, bytes, bytes]] = []  # (drive, want, chunk)
         for j, b in enumerate(batch_ids):
             chunk_len = -(-block_lens[j] // codec.k)
-            chunk_off = b * codec.shard_size()
             row: list[bytes | None] = [None] * n
             for i in chosen:
                 try:
-                    row[i] = readers[i].read_at(chunk_off, chunk_len)
+                    if batched_verify:
+                        want, chunk = readers[i].read_record(b)
+                        if len(chunk) != chunk_len:
+                            raise se.FileCorrupt(
+                                f"chunk {b} length {len(chunk)} != {chunk_len}")
+                        records.append((i, want, chunk))
+                        row[i] = chunk
+                    else:
+                        row[i] = readers[i].read_at(
+                            b * codec.shard_size(), chunk_len)
                 except (se.StorageError, OSError) as e:
                     dead.add(i)
                     readers[i] = None
                     raise se.FileCorrupt(f"shard {i}: {e}") from e
             rows.append(row)
+        if records:
+            self._verify_records(records, codec, readers, dead)
         return rows
+
+    def _verify_records(self, records, codec, readers, dead) -> None:
+        """One batched mxsum256 launch over every chunk just read; a digest
+        mismatch marks the drive dead and retriggers shard selection."""
+        import numpy as np
+
+        from minio_tpu.ops import fused
+
+        s_full = codec.shard_size()
+        # Pad the row count to a power of two so the jitted verify sees a
+        # bounded set of shapes (padding rows have length 0, digests unused).
+        cap = 1
+        while cap < len(records):
+            cap *= 2
+        batch = np.zeros((cap, s_full), dtype=np.uint8)
+        lens = np.zeros(cap, dtype=np.int32)
+        for ri, (_i, _want, chunk) in enumerate(records):
+            batch[ri, : len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+            lens[ri] = len(chunk)
+        got = np.asarray(fused.verify_digests(batch, lens))
+        for ri, (i, want, _chunk) in enumerate(records):
+            if got[ri].tobytes() != want:
+                dead.add(i)
+                readers[i] = None
+                raise se.FileCorrupt(f"shard {i}: bitrot digest mismatch")
 
     # ------------------------------------------------------------------
     # delete (cmd/erasure-object.go:894-1031)
@@ -622,15 +670,17 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         of cmd/erasure-encode.go:36-70, collapsed into queues). Returns
         (bytes consumed, md5 hex, per-drive errors). `initial` is a prefix
         the caller already consumed from `data`."""
-        qs: list[queue.Queue] = [queue.Queue(maxsize=4) for _ in range(self.n)]
+        qs: list[queue.Queue] = [queue.Queue(maxsize=8) for _ in range(self.n)]
         errs: list[Exception | None] = [None] * self.n
 
         def writer(i: int, drive: StorageAPI):
             def gen():
                 while True:
-                    chunk = qs[i].get()
-                    if chunk is _WRITE_SENTINEL:
+                    item = qs[i].get()
+                    if item is _WRITE_SENTINEL:
                         return
+                    digest, chunk = item  # [digest][chunk] record, unconcatenated
+                    yield digest
                     yield chunk
 
             try:
@@ -648,16 +698,27 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         for t in threads:
             t.start()
 
+        # Device-fused digests share the encode launch (ops/fused.py); any
+        # other algorithm is hashed host-side per chunk.
+        use_fused = self.bitrot_algorithm == "mxsum256"
         bitrot_algo = bitrot.get_algorithm(self.bitrot_algorithm)
         md5 = hashlib.md5()
         total = 0
+        # Dispatch-ahead pipeline (P2, SURVEY §2.4): up to PIPELINE batches
+        # are in flight on device while the host reads the next batch and
+        # fans out completed ones — the reference's read/encode/write
+        # overlap (cmd/erasure-encode.go:80-107) via JAX async dispatch.
+        pipeline_depth = 2
+        pending: list = []
 
-        def feed(block_batch: list[bytes]) -> None:
-            encoded = codec.encode_blocks(block_batch)
-            for chunks in encoded:
+        def drain_one() -> None:
+            chunk_rows, dig_rows = pending.pop(0).wait()
+            for bi, chunks in enumerate(chunk_rows):
+                digs = dig_rows[bi] if dig_rows is not None else None
                 for i in range(self.n):
-                    framed = bitrot_algo.digest(chunks[i]) + chunks[i]
-                    qs[i].put(framed)
+                    d = (digs[i] if digs is not None
+                         else bitrot_algo.digest(bytes(chunks[i])))
+                    qs[i].put((d, chunks[i]))
             alive = sum(1 for e in errs if e is None)
             if alive < write_quorum:
                 raise se.InsufficientWriteQuorum(bucket, obj, "write fan-out lost quorum")
@@ -673,12 +734,16 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 total += len(block)
                 batch.append(block)
                 if len(batch) >= self.batch_blocks:
-                    feed(batch)
+                    pending.append(codec.begin_encode(batch, with_digests=use_fused))
                     batch = []
+                    if len(pending) >= pipeline_depth:
+                        drain_one()
                 remaining = bs if size < 0 else min(bs, size - total)
                 block = _read_full(data, remaining)
             if batch:
-                feed(batch)
+                pending.append(codec.begin_encode(batch, with_digests=use_fused))
+            while pending:
+                drain_one()
         finally:
             for q in qs:
                 q.put(_WRITE_SENTINEL)

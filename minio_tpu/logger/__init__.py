@@ -1,0 +1,24 @@
+"""Structured logging + audit subsystem.
+
+Role-equivalent of cmd/logger/: a process-wide structured logger with
+pluggable targets (JSON console, append-file, HTTP webhook with an
+at-least-once retry queue), per-message dedup (logonce), a console pubsub
+feeding `mc admin console`-style streaming, and the per-request AUDIT log
+the S3 front door emits for every API call (reference logger.AuditLog at
+the top of every handler, e.g. cmd/object-handlers.go:1378; audit target
+config cmd/logger/audit.go; HTTP target cmd/logger/target/http).
+
+Two planes, separately targeted:
+  - ops log   (Logger.info/warning/error)  -> log targets
+  - audit log (Logger.audit / audit_entry) -> audit targets
+"""
+
+from minio_tpu.logger.logger import (  # noqa: F401
+    AuditEntry,
+    ConsoleTarget,
+    FileTarget,
+    HTTPTarget,
+    Logger,
+    audit_entry,
+    get_logger,
+)

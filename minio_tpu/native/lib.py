@@ -161,6 +161,8 @@ class DirectWriter:
 
     def write(self, data: bytes) -> int:
         if self._h is not None:
+            if isinstance(data, memoryview):
+                data = bytes(data)  # ctypes c_char_p needs a bytes object
             n = self._lib.mtpu_writer_write(self._h, data, len(data))
             if n != len(data):
                 raise OSError(f"native write failed on {self._path}")

@@ -193,6 +193,24 @@ class BitrotReader:
         self.shard_size = shard_size
         self.algo = get_algorithm(algorithm)
 
+    def read_record(self, chunk_index: int) -> tuple[bytes, bytes]:
+        """One raw [digest][chunk] record WITHOUT verifying — the erasure
+        read path collects records across drives and blocks and verifies
+        them in one batched device launch (ops/fused.verify_digests)
+        instead of hashing per chunk host-side."""
+        dl = self.algo.digest_len
+        first_byte = chunk_index * self.shard_size
+        if not 0 <= first_byte < max(self.data_size, 1):
+            raise se.FileCorrupt(f"chunk {chunk_index} outside shard")
+        rec_off = chunk_index * (dl + self.shard_size)
+        self.src.seek(rec_off)
+        want = self.src.read(dl)
+        chunk_len = min(self.shard_size, self.data_size - first_byte)
+        chunk = self.src.read(chunk_len)
+        if len(want) != dl or len(chunk) != chunk_len:
+            raise se.FileCorrupt(f"short read at chunk {chunk_index}")
+        return want, chunk
+
     def read_at(self, offset: int, length: int) -> bytes:
         if offset < 0 or length < 0 or offset + length > self.data_size:
             raise se.FileCorrupt(

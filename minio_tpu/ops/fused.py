@@ -54,6 +54,13 @@ def _reconstruct_dispatch(shards: jax.Array, k: int, n: int,
 
 
 @functools.partial(jax.jit, static_argnames=("k", "m"))
+def encode_only(data: jax.Array, k: int, m: int) -> jax.Array:
+    """Plain parity launch with the same kernel dispatch (used when the
+    bitrot algorithm is a host hash): data [B, k, S] u8 -> [B, m, S] u8."""
+    return _encode_dispatch(data, k, m)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m"))
 def encode_with_digests(data: jax.Array, k: int, m: int,
                         chunk_lens: jax.Array | None = None
                         ) -> tuple[jax.Array, jax.Array]:
@@ -102,3 +109,21 @@ def verify_digests(chunks: jax.Array, lens: jax.Array) -> jax.Array:
     stored record digests — one launch per read batch instead of one host
     hash per chunk (cmd/bitrot-streaming.go:115-158 verifies per ReadAt)."""
     return mxsum.digest_device(chunks, lens)
+
+
+def digest_chunks_host(chunks: list[bytes], cap: int) -> list[bytes]:
+    """Host convenience: mxsum256 digests of a ragged list of byte chunks
+    (each <= cap) in one device launch. Row count pads to a power of two so
+    the jitted program sees a bounded shape set."""
+    import numpy as np
+
+    n = 1
+    while n < len(chunks):
+        n *= 2
+    batch = np.zeros((n, cap), dtype=np.uint8)
+    lens = np.zeros(n, dtype=np.int32)
+    for i, c in enumerate(chunks):
+        batch[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+        lens[i] = len(c)
+    got = np.asarray(verify_digests(batch, lens))
+    return [got[i].tobytes() for i in range(len(chunks))]

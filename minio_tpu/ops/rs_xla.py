@@ -119,3 +119,26 @@ def gf2_matmul_with_weights(x: jax.Array, w: jax.Array, out_shards: int) -> jax.
     """Expose the raw contraction for callers that manage weights themselves
     (the sharded heal path feeds per-pattern decode matrices at runtime)."""
     return _gf2_matmul(x, w, out_shards)
+
+
+@functools.partial(jax.jit, static_argnames=("out_shards",))
+def gf2_matmul_multi(x: jax.Array, w: jax.Array, out_shards: int) -> jax.Array:
+    """Per-block-weight contraction: x [B, k, S] u8, w [B, k*8, t*8] i8
+    -> [B, t, S] u8.
+
+    The multi-pattern batched solve: every block carries its OWN decode
+    matrix, so one launch heals blocks (or objects) whose drives failed in
+    different patterns — what "whole-set heal in one batched solve" means
+    when a heal sweep crosses objects with differing drive states
+    (cmd/erasure-healing.go:401-461 runs one pattern at a time)."""
+    b, _, s = x.shape
+    bits = _bits_from_bytes(x).astype(jnp.int8)                  # [B, S, k*8]
+    y = jax.lax.dot_general(
+        bits, w,
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.int32,
+    )                                                            # [B, S, t*8]
+    y = (y & 1).astype(jnp.uint8).reshape(b, s, out_shards, 8)
+    y = y << jnp.arange(8, dtype=jnp.uint8)
+    y = jax.lax.reduce(y, np.uint8(0), jax.lax.bitwise_or, (3,))
+    return y.transpose(0, 2, 1)                                  # [B, t, S]

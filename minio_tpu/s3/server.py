@@ -130,13 +130,24 @@ class S3Server:
         self.trace_bus = PubSub()
         self.config = ConfigSys(store if has_store else None)
 
+        # Structured ops + audit logging (reference cmd/logger/): targets
+        # come from the config KV subsystems logger_webhook / audit_webhook /
+        # audit_file and can be (re)applied at runtime via admin config-set.
+        from minio_tpu.logger import get_logger
+        self.logger = get_logger()
+        self.configure_logging()
+
         # Replication plane (cmd/bucket-replication.go).
         from minio_tpu.replication.pool import BucketTargetSys, ReplicationPool
         self.bucket_targets = BucketTargetSys(store)
         self.replication = ReplicationPool(object_layer, self.bucket_meta,
                                            self.bucket_targets)
+        from minio_tpu.admin.profiling import Profiler
+        self.profiler = Profiler()
         self.admin = AdminAPI(self)
         self.local_locker = None  # set by the cluster node when distributed
+        self.notification = notification_sys  # peer fan-out (distributed)
+        self.cluster_node = None
 
         # upload_id -> user_defined: saves a quorum metadata read per
         # UploadPart/ListParts (SSE decisions are sealed at create time and
@@ -158,6 +169,64 @@ class S3Server:
                                    heal_objects=heal_objects,
                                    tracker=self.update_tracker)
         self.scanner.start()
+
+    def attach_cluster(self, node) -> None:
+        """Wire this node's observability into the peer plane so every
+        peer can pull our trace/console/info/profiles (the NotificationSys
+        breadth of cmd/peer-rest-common.go:27-61)."""
+        self.cluster_node = node
+        self.notification = node.notification
+        node.hooks.trace_bus = self.trace_bus
+        node.hooks.console_bus = self.logger.console_bus
+        node.hooks.server_info = self.admin._server_info
+        node.hooks.obd_info = self.admin._obd_info
+        node.hooks.profiler = self.profiler
+
+    def configure_logging(self) -> None:
+        """(Re)build log/audit targets from the config KV store — the
+        dynamic subset of cmd/config: logger_webhook.{enable,endpoint,
+        auth_token}, audit_webhook.{...}, audit_file.path."""
+        from minio_tpu.logger import FileTarget, HTTPTarget
+
+        log_targets: list = []
+        audit_targets: list = []
+        if (self.config.get("logger_webhook", "enable") or "") in ("on", "1", "true"):
+            ep = self.config.get("logger_webhook", "endpoint") or ""
+            if ep:
+                log_targets.append(HTTPTarget(
+                    ep, self.config.get("logger_webhook", "auth_token") or ""))
+        if (self.config.get("audit_webhook", "enable") or "") in ("on", "1", "true"):
+            ep = self.config.get("audit_webhook", "endpoint") or ""
+            if ep:
+                audit_targets.append(HTTPTarget(
+                    ep, self.config.get("audit_webhook", "auth_token") or ""))
+        audit_path = self.config.get("audit_file", "path") or ""
+        if audit_path:
+            audit_targets.append(FileTarget(audit_path))
+        self.logger.targets = self.logger.targets[:1] + log_targets
+        self.logger.audit_targets = audit_targets
+
+    def start_auto_heal(self, interval: float = 10.0) -> None:
+        """Boot the background new-drive healer (reference initAutoHeal,
+        cmd/background-newdisks-heal-ops.go:241): drives carrying a
+        persisted healing tracker get their set rebuilt and the tracker
+        resumes across restarts."""
+        from minio_tpu.erasure.autoheal import AutoHealer
+
+        target = self.obj
+        # unwrap decorators (cache) down to something with sets/drives
+        while not hasattr(target, "drives") and hasattr(target, "inner"):
+            target = target.inner
+        pools = getattr(target, "pools", None)
+        if pools:
+            self.auto_healer = [AutoHealer(p, interval=interval) for p in pools]
+            for h in self.auto_healer:
+                h.start()
+        elif hasattr(target, "drives") or hasattr(target, "sets"):
+            self.auto_healer = [AutoHealer(target, interval=interval)]
+            self.auto_healer[0].start()
+        else:
+            self.auto_healer = []
 
     # ------------------------------------------------------------------
 
@@ -244,6 +313,29 @@ class S3Server:
                     "status": status, "requestId": request_id,
                     "remote": request.remote,
                 })
+            # Per-request AUDIT record (reference logger.AuditLog at every
+            # handler, cmd/object-handlers.go:1378) — zero cost unless an
+            # audit target is configured.
+            if self.logger.audit_targets:
+                import time as _time
+
+                from minio_tpu.logger import audit_entry
+
+                parts = path.lstrip("/").split("/", 1)
+                ident = request.get("identity")
+                self.logger.audit(audit_entry(
+                    api=api,
+                    bucket=parts[0] if parts and not parts[0].startswith("minio") else "",
+                    object=parts[1] if len(parts) > 1 else "",
+                    status_code=status,
+                    access_key=getattr(ident, "access_key", "") or "",
+                    remote_host=request.remote or "",
+                    user_agent=request.headers.get("User-Agent", ""),
+                    request_id=request_id,
+                    rx_bytes=rx, tx_bytes=tx,
+                    duration_ms=(_time.perf_counter() - t0) * 1000,
+                    query=dict(urllib.parse.parse_qsl(request.query_string)),
+                ))
 
     def _error_response(self, e: S3Error, resource: str, request_id: str):
         body = xmlutil.error_xml(e.api.code, e.message, resource, request_id, e.extra)
@@ -1795,8 +1887,9 @@ def build_server(drive_paths: list[str], access_key: str, secret_key: str,
         node.wait_for_peers()
         layer = node.build_object_layer(enable_mrf=enable_mrf)
         srv = S3Server(layer, sigv4.Credentials(access_key, secret_key),
-                       versioned_buckets=versioned)
-        srv.cluster_node = node
+                       versioned_buckets=versioned,
+                       notification_sys=node.notification)
+        srv.attach_cluster(node)
         return srv
 
     drives = [LocalDrive(p) for p in drive_paths]
@@ -1866,6 +1959,7 @@ def main(argv=None):
                                quota_bytes=args.cache_quota)
     if args.scan_interval > 0:
         srv.start_scanner(interval=args.scan_interval)
+    srv.start_auto_heal()
     web.run_app(srv.app, host=host or "0.0.0.0", port=int(port))
 
 

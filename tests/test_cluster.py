@@ -257,3 +257,78 @@ def test_distributed_heal_over_rpc(two_nodes):
     # And the object reads bit-exact end-to-end.
     _, it = ol1.get_object("healbkt", "obj2")
     assert b"".join(it) == payload
+
+
+def test_peer_observability_plane(two_nodes):
+    """Remote trace/console subscription, server-info and profiling over
+    the peer plane (reference peer-rest breadth, cmd/peer-rest-common.go:
+    27-61): node 1 watches node 2's buses and pulls its profiles."""
+    import threading
+    import time
+
+    from minio_tpu.admin.profiling import Profiler
+    from minio_tpu.admin.pubsub import PubSub
+
+    n1, n2 = two_nodes
+    n1.wait_for_peers(timeout=5)
+
+    # wire node 2's observability hooks (the S3 server does this in
+    # attach_cluster; here the buses stand alone)
+    n2.hooks.trace_bus = PubSub()
+    n2.hooks.console_bus = PubSub()
+    n2.hooks.server_info = lambda: {"node": "n2", "mode": "online"}
+    n2.hooks.obd_info = lambda: {"node": "n2", "drives": []}
+    n2.hooks.profiler = Profiler()
+
+    peer = n1.peers[0]  # n1's client for n2
+
+    # -- server info / obd over the wire --
+    assert peer.server_info()["node"] == "n2"
+    assert n1.notification.server_info_all()[0]["mode"] == "online"
+    assert peer.obd_info()["node"] == "n2"
+
+    # -- remote trace subscription --
+    got = []
+    done = threading.Event()
+
+    def watch():
+        for item in peer.trace_stream():
+            got.append(item)
+            if len(got) >= 2:
+                break
+        done.set()
+
+    t = threading.Thread(target=watch, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while not n2.hooks.trace_bus.has_subscribers and time.time() < deadline:
+        time.sleep(0.02)
+    n2.hooks.trace_bus.publish({"api": "PutObject", "path": "/b/o"})
+    n2.hooks.trace_bus.publish({"api": "GetObject", "path": "/b/o"})
+    assert done.wait(10), "remote trace items never arrived"
+    assert [g["api"] for g in got] == ["PutObject", "GetObject"]
+
+    # -- remote console subscription --
+    got2 = []
+    done2 = threading.Event()
+
+    def watch2():
+        for item in peer.console_stream():
+            got2.append(item)
+            break
+        done2.set()
+
+    threading.Thread(target=watch2, daemon=True).start()
+    deadline = time.time() + 5
+    while not n2.hooks.console_bus.has_subscribers and time.time() < deadline:
+        time.sleep(0.02)
+    n2.hooks.console_bus.publish({"level": "ERROR", "message": "disk gone"})
+    assert done2.wait(10)
+    assert got2[0]["message"] == "disk gone"
+
+    # -- remote profiling --
+    peer.profile_start("cpu")
+    n2.hooks.server_info()  # some work on n2
+    files = peer.profile_download()
+    assert "cpu.pstats" in files and "cpu.txt" in files
+    assert b"cumulative" in files["cpu.txt"]

@@ -1,0 +1,160 @@
+"""Structured logging + audit subsystem (minio_tpu/logger) and the
+admin observability plane (consolelog stream, profiling start/download)."""
+
+import io
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from minio_tpu.logger import (
+    AuditEntry,
+    ConsoleTarget,
+    FileTarget,
+    HTTPTarget,
+    Logger,
+    audit_entry,
+)
+
+from tests.conftest import S3_ACCESS, S3_SECRET, free_port
+
+
+# ---------------- logger core ----------------
+
+
+def test_console_target_json_lines():
+    buf = io.StringIO()
+    lg = Logger(node="n1")
+    lg.targets = [ConsoleTarget(stream=buf)]
+    lg.info("hello", bucket="b")
+    lg.error("boom")
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    assert lines[0]["level"] == "INFO" and lines[0]["message"] == "hello"
+    assert lines[0]["bucket"] == "b" and lines[0]["node"] == "n1"
+    assert lines[1]["level"] == "ERROR"
+
+
+def test_min_level_filters():
+    buf = io.StringIO()
+    lg = Logger()
+    lg.targets = [ConsoleTarget(stream=buf)]
+    lg.min_level = "WARNING"
+    lg.info("quiet")
+    lg.warning("loud")
+    assert "quiet" not in buf.getvalue()
+    assert "loud" in buf.getvalue()
+
+
+def test_log_once_dedups():
+    buf = io.StringIO()
+    lg = Logger()
+    lg.targets = [ConsoleTarget(stream=buf)]
+    for _ in range(5):
+        lg.log_once("ERROR", "same failure", interval=60)
+    assert buf.getvalue().count("same failure") == 1
+
+
+def test_file_target(tmp_path):
+    p = str(tmp_path / "logs" / "audit.log")
+    t = FileTarget(p)
+    t.send({"a": 1})
+    t.send({"b": 2})
+    lines = [json.loads(x) for x in open(p).read().splitlines()]
+    assert lines == [{"a": 1}, {"b": 2}]
+
+
+def test_console_bus_publishes():
+    lg = Logger()
+    lg.targets = []
+    with lg.console_bus.subscribe() as sub:
+        lg.info("streamed")
+        item = sub.get(timeout=2)
+    assert item and item["message"] == "streamed"
+
+
+def test_audit_entry_shape():
+    e = audit_entry("PutObject", bucket="b", object="o", status_code=200,
+                    access_key="AK", rx_bytes=10, tx_bytes=0,
+                    duration_ms=1.25)
+    doc = e.to_doc()
+    assert doc["api"]["name"] == "PutObject"
+    assert doc["api"]["bucket"] == "b" and doc["api"]["statusCode"] == 200
+    assert doc["accessKey"] == "AK" and doc["version"] == "1"
+    assert doc["time"].endswith("Z")
+
+
+def test_http_target_delivers():
+    got = []
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            got.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        t = HTTPTarget(f"http://127.0.0.1:{httpd.server_address[1]}/log")
+        t.send({"message": "one"})
+        t.send({"message": "two"})
+        deadline = time.time() + 5
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        assert [g["message"] for g in got] == ["one", "two"]
+        t.close()
+    finally:
+        httpd.shutdown()
+
+
+# ---------------- front-door audit + admin plane ----------------
+
+
+def test_s3_requests_emit_audit(client, bucket, tmp_path_factory):
+    """Every API call produces an audit record once an audit target is
+    configured (reference logger.AuditLog per handler)."""
+    audit_path = str(tmp_path_factory.mktemp("audit") / "audit.jsonl")
+    r = client.request(
+        "PUT", "/minio/admin/v3/config-kv",
+        data=json.dumps({"audit_file": {"path": audit_path}}).encode())
+    assert r.status_code == 200, r.text
+
+    try:
+        client.put(f"/{bucket}/audited-obj", data=b"payload")
+        client.get(f"/{bucket}/audited-obj")
+        client.delete(f"/{bucket}/audited-obj")
+        entries = [json.loads(x) for x in open(audit_path).read().splitlines()]
+        apis = [e["api"]["name"] for e in entries]
+        assert "PutObject" in apis and "GetObject" in apis
+        put = next(e for e in entries if e["api"]["name"] == "PutObject")
+        assert put["api"]["bucket"] == bucket
+        assert put["api"]["object"] == "audited-obj"
+        assert put["api"]["statusCode"] == 200
+        assert put["accessKey"] == S3_ACCESS
+        assert put["api"]["rx"] == 7
+        assert put["requestID"]
+    finally:  # detach the audit file for other tests on the shared server
+        client.request("PUT", "/minio/admin/v3/config-kv",
+                       data=json.dumps({"audit_file": {"path": ""}}).encode())
+
+
+def test_admin_profiling_roundtrip(client):
+    r = client.post("/minio/admin/v3/profiling/start")
+    assert r.status_code == 200, r.text
+    client.get("/")  # some traffic to profile
+    r = client.get("/minio/admin/v3/profiling/download")
+    assert r.status_code == 200
+    import io as _io
+    import zipfile
+
+    z = zipfile.ZipFile(_io.BytesIO(r.content))
+    names = z.namelist()
+    assert "local/cpu.txt" in names and "local/cpu.pstats" in names
+    assert b"cumulative" in z.read("local/cpu.txt")

@@ -143,6 +143,84 @@ def test_pallas_reconstruct_matches_xla():
     assert a.tobytes() == b.tobytes()
 
 
+def test_decode_blocks_multi_mixed_patterns():
+    """Blocks with different failure patterns rebuild in one batched
+    launch (per-block stacked decode weights)."""
+    from minio_tpu.erasure.codec import ErasureCodec
+
+    codec = ErasureCodec(4, 2, block_size=4096)
+    blocks = [rng.integers(0, 256, ln, dtype=np.uint8).tobytes()
+              for ln in (4096, 4096, 1000, 4096)]
+    encoded = codec.encode_blocks(blocks)
+    lens = [len(b) for b in blocks]
+    patterns = [(0,), (1, 5), (), (2, 3)]  # per-block missing shards
+    rows = []
+    for bi, chunks in enumerate(encoded):
+        rows.append([None if i in patterns[bi] else chunks[i]
+                     for i in range(6)])
+    decoded = codec.decode_blocks(rows, lens)  # auto-delegates to multi
+    for bi, chunks in enumerate(encoded):
+        assert decoded[bi] == chunks[:4], bi
+    full = codec.decode_blocks(rows, lens, need_all=True)
+    for bi, chunks in enumerate(encoded):
+        assert full[bi] == chunks, bi
+    # quorum failure on any single block fails the batch
+    bad = [list(r) for r in rows]
+    bad[1] = [None, None, None, encoded[1][3], None, encoded[1][5]]
+    from minio_tpu.utils import errors as se
+    with pytest.raises(se.InsufficientReadQuorum):
+        codec.decode_blocks(bad, lens)
+
+
+def test_object_layer_mxsum_roundtrip(tmp_path):
+    """PutObject encodes through the fused pipeline (begin_encode with
+    device digests) and GetObject verifies through the batched launch."""
+    from minio_tpu.erasure import ErasureObjects
+    from minio_tpu.storage import LocalDrive
+
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(6)]
+    es = ErasureObjects(drives, bitrot_algorithm="mxsum256", batch_blocks=2,
+                        block_size=1 << 16)
+    es.make_bucket("bkt")
+    # multiple batches + a ragged tail, above the inline threshold
+    payload = rng.integers(0, 256, 5 * (1 << 16) + 777, dtype=np.uint8).tobytes()
+    es.put_object("bkt", "o", io.BytesIO(payload), len(payload))
+    info, stream = es.get_object("bkt", "o")
+    assert b"".join(stream) == payload
+    # ranged read crossing block boundaries
+    _, stream = es.get_object("bkt", "o", offset=60000, length=100000)
+    assert b"".join(stream) == payload[60000:160000]
+
+
+def test_object_layer_mxsum_corruption_heals_read(tmp_path):
+    """Flipping a byte in one shard file must be caught by the batched
+    verify and served via reconstruction from the surviving shards."""
+    import os
+
+    from minio_tpu.erasure import ErasureObjects
+    from minio_tpu.storage import LocalDrive
+
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(6)]
+    es = ErasureObjects(drives, bitrot_algorithm="mxsum256",
+                        block_size=1 << 16)
+    es.make_bucket("bkt")
+    payload = rng.integers(0, 256, 3 * (1 << 16), dtype=np.uint8).tobytes()
+    es.put_object("bkt", "o", io.BytesIO(payload), len(payload))
+    # corrupt one data byte in every shard file on drive 0
+    corrupted = 0
+    for root, _dirs, files in os.walk(tmp_path / "d0" / "bkt"):
+        for f in files:
+            if f.startswith("part."):
+                p = os.path.join(root, f)
+                raw = bytearray(open(p, "rb").read())
+                raw[40] ^= 0x5A
+                open(p, "wb").write(bytes(raw))
+                corrupted += 1
+    assert corrupted
+    _, stream = es.get_object("bkt", "o")
+    assert b"".join(stream) == payload
+
+
 def test_verify_digests_entry():
     chunks = rng.integers(0, 256, (5, 300), dtype=np.uint8)
     lens = jnp.full((5,), 300, dtype=jnp.int32)
