@@ -28,6 +28,7 @@ Run standalone on the real TPU (no other JAX process may hold the chip).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 import traceback
@@ -49,30 +50,42 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def init_jax(attempts: int = 4):
-    """Initialize the JAX backend with retry/backoff (round 1 died at a
-    transient 'Unable to initialize backend: UNAVAILABLE' — e.g. a stray
-    process briefly holding the chip)."""
-    delays = [0, 5, 15, 30]
-    last: Exception | None = None
+def probe_backend(timeout_s: float = 150.0) -> str:
+    """Probe backend health in a SUBPROCESS first: a wedged device tunnel
+    makes jax.devices() hang indefinitely (not raise), which would strand
+    the bench with no output at all — the round-1 failure mode's worse
+    sibling. A killed subprocess costs nothing; only a healthy probe lets
+    the main process touch JAX."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); print('OK', d[0].platform)"],
+            capture_output=True, timeout=timeout_s, text=True)
+        if "OK" in r.stdout:
+            return ""
+        return (r.stdout + r.stderr).strip().splitlines()[-1][:300] \
+            if (r.stdout + r.stderr).strip() else f"probe rc={r.returncode}"
+    except subprocess.TimeoutExpired:
+        return f"backend probe hung >{timeout_s:.0f}s (device tunnel wedged?)"
+
+
+def init_jax(attempts: int = 3):
+    """Initialize the JAX backend with probe + retry/backoff (round 1 died
+    at a transient 'Unable to initialize backend: UNAVAILABLE')."""
+    delays = [0, 10, 30]
+    last = ""
     for i in range(attempts):
-        if delays[min(i, len(delays) - 1)] and i:
+        if i:
             time.sleep(delays[min(i, len(delays) - 1)])
-        try:
+        last = probe_backend()
+        if not last:
             import jax
 
-            devs = jax.devices()
-            return jax, devs
-        except Exception as e:  # noqa: BLE001
-            last = e
-            log(f"backend init attempt {i + 1}/{attempts} failed: {e}")
-            try:  # drop the cached failed-backend state so a retry re-inits
-                from jax._src import xla_bridge
-
-                xla_bridge._clear_backends()  # noqa: SLF001
-            except Exception:  # noqa: BLE001
-                pass
-    raise RuntimeError(f"JAX backend unavailable after {attempts} attempts: {last}")
+            return jax, jax.devices()
+        log(f"backend probe {i + 1}/{attempts} failed: {last}")
+    raise RuntimeError(f"JAX backend unavailable after {attempts} probes: {last}")
 
 
 def _timed_chain(step, x0, iters: int) -> float:
@@ -231,7 +244,6 @@ def bench_e2e_multipart() -> dict:
     multipart upload (scaled from the reference's 5 GiB to keep the bench
     under a minute; the per-byte path is identical)."""
     import io
-    import os
     import shutil
     import tempfile
 
@@ -269,6 +281,28 @@ def main() -> int:
     t_start = time.time()
     configs: list[dict] = []
     headline: dict | None = None
+
+    # Last-resort watchdog: if anything below wedges (a hung device call
+    # can't be interrupted in-process), still emit ONE parseable JSON line
+    # with whatever completed, then hard-exit.
+    import threading
+
+    done = threading.Event()
+    watchdog_s = float(os.environ.get("MTPU_BENCH_WATCHDOG", "2400"))
+
+    def _watchdog():
+        if done.wait(watchdog_s):
+            return
+        ok = [c for c in configs if "value" in c]
+        out = dict(ok[0]) if ok else {
+            "metric": "erasure_encode_bitrot_fused_8+4_1MiB",
+            "value": 0.0, "unit": "GiB/s", "vs_baseline": 0.0,
+            "error": f"bench wedged past {watchdog_s:.0f}s watchdog"}
+        out["configs"] = list(configs)
+        print(json.dumps(out), flush=True)
+        os._exit(0)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
     try:
         jax, devs = init_jax()
         import jax.numpy as jnp
@@ -314,6 +348,7 @@ def main() -> int:
             "metric": "erasure_encode_bitrot_fused_8+4_1MiB",
             "value": 0.0, "unit": "GiB/s", "vs_baseline": 0.0,
             "error": "all configs failed"}
+    done.set()
     out = dict(headline)
     out["configs"] = configs
     out["wall_s"] = round(time.time() - t_start, 1)

@@ -29,6 +29,11 @@ DEFAULTS: dict[str, dict[str, str]] = {
     "logger_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
     "audit_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
     "audit_file": {"path": ""},
+    # OIDC federation (cmd/config/identity/openid): jwks is inline JSON or
+    # a local file path — zero-egress deployments mount the IdP's JWKS.
+    "identity_openid": {"enable": "off", "jwks": "", "issuer": "",
+                        "audience": "", "claim_name": "policy"},
+    "kms": {"enable": "off", "key_file": "", "default_key": ""},
 }
 
 # Subsystems that apply without restart (cmd/config/config.go:133).

@@ -164,6 +164,20 @@ class AdminAPI:
             self._authorize(identity, "admin:ServerInfo")
             with self.s._bw_mu:
                 return _json({"buckets": dict(self.s.bandwidth)})
+        # -- KMS surface (cmd/kms-router KMSStatus/KMSCreateKey roles) --
+        if op == "kms" and m == "GET" and rest in ("status", "key-status"):
+            self._authorize(identity, "admin:KMSKeyStatus")
+            return _json(self.s.kms.status())
+        if op == "kms" and rest == "key/create" and m == "POST":
+            self._authorize(identity, "admin:KMSCreateKey")
+            from minio_tpu.crypto.kms import KMSError
+
+            try:
+                self.s.kms.create_key(q.get("key-id", "") or "default")
+            except KMSError as e:
+                raise S3Error("InvalidRequest", str(e)) from None
+            return _json({})
+
         if op in ("obdinfo", "healthinfo") and m == "GET":
             self._authorize(identity, "admin:OBDInfo")
             obd = await run(self._obd_info)

@@ -31,11 +31,12 @@ NONCE_SIZE = 12
 ENC_CHUNK = CHUNK_SIZE + TAG_SIZE
 
 # Internal metadata keys (reference crypto.MetaSealedKeySSEC etc.)
-META_ALGO = "x-mtpu-internal-sse"           # "SSE-C" | "SSE-S3"
+META_ALGO = "x-mtpu-internal-sse"           # "SSE-C" | "SSE-S3" | "SSE-KMS"
 META_SEALED_KEY = "x-mtpu-internal-sse-sealed-key"
 META_NONCE = "x-mtpu-internal-sse-nonce"
 META_KEY_MD5 = "x-mtpu-internal-ssec-key-md5"
 META_ACTUAL_SIZE = "x-mtpu-internal-actual-size"
+META_KMS_KEY_ID = "x-mtpu-internal-sse-kms-key-id"
 
 
 class SSEError(Exception):
@@ -230,6 +231,10 @@ def sse_headers_for(metadata: dict) -> dict:
                     metadata.get(META_KEY_MD5, "")}
     if algo == "SSE-S3":
         return {"x-amz-server-side-encryption": "AES256"}
+    if algo == "SSE-KMS":
+        return {"x-amz-server-side-encryption": "aws:kms",
+                "x-amz-server-side-encryption-aws-kms-key-id":
+                    metadata.get(META_KMS_KEY_ID, "")}
     return {}
 
 

@@ -495,24 +495,12 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
     def _verify_records(self, records, codec, readers, dead) -> None:
         """One batched mxsum256 launch over every chunk just read; a digest
         mismatch marks the drive dead and retriggers shard selection."""
-        import numpy as np
-
         from minio_tpu.ops import fused
 
-        s_full = codec.shard_size()
-        # Pad the row count to a power of two so the jitted verify sees a
-        # bounded set of shapes (padding rows have length 0, digests unused).
-        cap = 1
-        while cap < len(records):
-            cap *= 2
-        batch = np.zeros((cap, s_full), dtype=np.uint8)
-        lens = np.zeros(cap, dtype=np.int32)
-        for ri, (_i, _want, chunk) in enumerate(records):
-            batch[ri, : len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
-            lens[ri] = len(chunk)
-        got = np.asarray(fused.verify_digests(batch, lens))
+        got = fused.digest_chunks_host([c for _i, _w, c in records],
+                                       codec.shard_size())
         for ri, (i, want, _chunk) in enumerate(records):
-            if got[ri].tobytes() != want:
+            if got[ri] != want:
                 dead.add(i)
                 readers[i] = None
                 raise se.FileCorrupt(f"shard {i}: bitrot digest mismatch")

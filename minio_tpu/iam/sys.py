@@ -72,6 +72,10 @@ class TempCredential:
     expiry: float
     session_policy_json: str = ""
     kind: str = "sts"         # sts | svc (service accounts don't expire)
+    # Federated (OIDC) credentials have no parent account; their policies
+    # come from the token's policy claim (cmd/sts-handlers.go WebIdentity).
+    policies: list[str] = field(default_factory=list)
+    subject: str = ""         # IdP subject, for audit
 
     @property
     def expired(self) -> bool:
@@ -195,6 +199,11 @@ class IAMSys:
             if tc is not None and not tc.expired:
                 sp = (Policy.parse(tc.session_policy_json)
                       if tc.session_policy_json else None)
+                if not tc.parent:  # federated: claim-mapped policies
+                    return Identity(access_key, tc.kind,
+                                    policies=list(tc.policies),
+                                    session_policy=sp,
+                                    claims={"sub": tc.subject})
                 parent_id = (self.identify(tc.parent)
                              if tc.parent != access_key else None)
                 return Identity(
@@ -350,6 +359,34 @@ class IAMSys:
             parent=parent_access_key,
             expiry=time.time() + duration,
             session_policy_json=session_policy_json,
+        )
+        with self._mu:
+            self.temp_creds[tc.access_key] = tc
+            self._persist(f"creds/{tc.access_key}", vars(tc))
+        return tc
+
+    def assume_role_with_claims(self, subject: str, policies: list[str],
+                                duration: int = 3600,
+                                session_policy_json: str = "") -> TempCredential:
+        """Federated temp credentials from a validated IdP token
+        (AssumeRoleWithWebIdentity/ClientGrants, cmd/sts-handlers.go:49-102):
+        no parent account; authorization comes from the claim-mapped policy
+        names, optionally narrowed by a session policy."""
+        if session_policy_json:
+            Policy.parse(session_policy_json)
+        # No 900 s floor here: the caller caps at the identity token's own
+        # remaining lifetime, which may legitimately be shorter.
+        duration = max(1, min(duration, 7 * 24 * 3600))
+        tc = TempCredential(
+            access_key=_gen_access_key(),
+            secret_key=_gen_secret_key(),
+            session_token=base64.b64encode(
+                pysecrets.token_bytes(24)).decode(),
+            parent="",
+            expiry=time.time() + duration,
+            session_policy_json=session_policy_json,
+            policies=list(policies),
+            subject=subject,
         )
         with self._mu:
             self.temp_creds[tc.access_key] = tc

@@ -16,6 +16,7 @@ import asyncio
 import hashlib
 import os
 import tempfile
+import time
 import urllib.parse
 import uuid
 from typing import Iterator
@@ -144,6 +145,13 @@ class S3Server:
                                            self.bucket_targets)
         from minio_tpu.admin.profiling import Profiler
         self.profiler = Profiler()
+
+        # KMS for SSE-KMS envelope encryption (cmd/crypto/kes.go role;
+        # local master-key backend first).
+        from minio_tpu.crypto.kms import LocalKMS
+        self.kms = LocalKMS(
+            key_file=self.config.get("kms", "key_file") or "",
+            default_key_id=self.config.get("kms", "default_key") or "")
         self.admin = AdminAPI(self)
         self.local_locker = None  # set by the cluster node when distributed
         self.notification = notification_sys  # peer fan-out (distributed)
@@ -203,6 +211,11 @@ class S3Server:
         audit_path = self.config.get("audit_file", "path") or ""
         if audit_path:
             audit_targets.append(FileTarget(audit_path))
+        # Close displaced webhook targets — each holds a drain thread and
+        # a bounded queue that would otherwise leak on every re-apply.
+        for t in self.logger.targets[1:] + self.logger.audit_targets:
+            if hasattr(t, "close"):
+                t.close()
         self.logger.targets = self.logger.targets[:1] + log_targets
         self.logger.audit_targets = audit_targets
 
@@ -985,23 +998,60 @@ class S3Server:
     async def _sts_handler(self, request, identity, hdr):
         form = urllib.parse.parse_qs((await request.read()).decode())
         action = form.get("Action", [""])[0]
-        if action != "AssumeRole":
-            raise S3Error("STSNotImplemented")
-        if identity.kind == "anonymous":
-            raise S3Error("AccessDenied", "STS requires signed credentials")
-        if identity.kind in ("sts", "svc"):
-            raise S3Error("AccessDenied",
-                          "temporary credentials cannot assume roles")
         duration = int(form.get("DurationSeconds", ["3600"])[0])
         session_policy = form.get("Policy", [""])[0]
-        tc = self.iam.assume_role(identity.access_key, duration,
-                                  session_policy)
+
+        if action == "AssumeRole":
+            if identity.kind == "anonymous":
+                raise S3Error("AccessDenied", "STS requires signed credentials")
+            if identity.kind in ("sts", "svc"):
+                raise S3Error("AccessDenied",
+                              "temporary credentials cannot assume roles")
+            tc = self.iam.assume_role(identity.access_key, duration,
+                                      session_policy)
+            subject = ""
+        elif action in ("AssumeRoleWithWebIdentity",
+                        "AssumeRoleWithClientGrants"):
+            # Federated: unauthenticated call carrying an IdP-signed JWT
+            # (cmd/sts-handlers.go:49-102). The token IS the credential.
+            from minio_tpu.iam.oidc import OIDCError, OpenIDValidator
+
+            token = form.get(
+                "WebIdentityToken" if action.endswith("WebIdentity")
+                else "Token", [""])[0]
+            if not token:
+                raise S3Error("InvalidRequest", "missing identity token")
+            try:
+                validator = OpenIDValidator.from_config(self.config)
+                if validator is None:
+                    raise S3Error("STSNotImplemented",
+                                  "identity_openid is not configured")
+                claims = validator.validate(token)
+                policies = validator.policies_from(claims)
+            except OIDCError as e:
+                raise S3Error("AccessDenied", str(e)) from None
+            if not policies:
+                raise S3Error(
+                    "AccessDenied",
+                    f"token carries no {validator.claim_name!r} claim")
+            subject = str(claims.get("sub", ""))
+            # Credentials never outlive the identity token itself
+            # (cmd/sts-handlers.go caps at the JWT expiry).
+            remaining = int(float(claims["exp"]) - time.time())
+            if remaining <= 0:
+                raise S3Error("AccessDenied", "identity token expired")
+            duration = min(max(900, duration), remaining)
+            tc = self.iam.assume_role_with_claims(
+                subject, policies, duration, session_policy)
+        else:
+            raise S3Error("STSNotImplemented")
+
         import datetime
         exp = datetime.datetime.fromtimestamp(
             tc.expiry, datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
         body = xmlutil.sts_assume_role_xml(
             tc.access_key, tc.secret_key, tc.session_token, exp,
-            hdr["x-amz-request-id"])
+            hdr["x-amz-request-id"], action=action, subject=subject)
         return web.Response(body=body, content_type=XML_TYPE, headers=hdr)
 
     # ------------------------------------------------------------------
@@ -1057,16 +1107,37 @@ class S3Server:
             ssec_key = sse.parse_ssec_headers(request.headers)
         except sse.SSEError as e:
             raise S3Error("InvalidArgument", str(e)) from None
-        sse_s3 = (request.headers.get(
-            "x-amz-server-side-encryption", "") == "AES256")
-        if not sse_s3 and ssec_key is None:
+        sse_hdr = request.headers.get("x-amz-server-side-encryption", "")
+        sse_s3 = sse_hdr == "AES256"
+        sse_kms = sse_hdr == "aws:kms"
+        kms_key_id = request.headers.get(
+            "x-amz-server-side-encryption-aws-kms-key-id", "")
+        if not sse_s3 and not sse_kms and ssec_key is None:
             # Bucket default SSE config (PUT ?encryption).
-            if b"AES256" in self.bucket_meta.get(bucket).sse_xml:
+            default = self.bucket_meta.get(bucket).sse_xml
+            if b"aws:kms" in default:
+                sse_kms = True
+            elif b"AES256" in default:
                 sse_s3 = True
-        if ssec_key is None and not sse_s3:
+        if ssec_key is None and not sse_s3 and not sse_kms:
             return None
-        object_key = os.urandom(32)
         aad = f"{bucket}/{key}"
+        if sse_kms:
+            # Envelope encryption: the KMS mints the per-object data key
+            # and only the sealed blob is stored (cmd/encryption-v1.go:195
+            # + cmd/crypto/kes.go GenerateKey role).
+            from minio_tpu.crypto.kms import KMSError
+
+            try:
+                kid, object_key, sealed = self.kms.generate_data_key(
+                    kms_key_id, context=aad)
+            except KMSError as e:
+                raise S3Error("InvalidRequest", f"KMS: {e}") from None
+            user_defined[sse.META_ALGO] = "SSE-KMS"
+            user_defined[sse.META_SEALED_KEY] = sealed
+            user_defined[sse.META_KMS_KEY_ID] = kid
+            return object_key
+        object_key = os.urandom(32)
         if ssec_key is not None:
             user_defined[sse.META_ALGO] = "SSE-C"
             user_defined[sse.META_SEALED_KEY] = sse.seal_key(
@@ -1235,6 +1306,14 @@ class S3Server:
                                   "object is SSE-C encrypted: key required")
                 return sse.unseal_key(
                     meta[sse.META_SEALED_KEY], ssec_key, aad)
+            if algo == "SSE-KMS":
+                from minio_tpu.crypto.kms import KMSError
+
+                try:
+                    return self.kms.decrypt_data_key(
+                        meta[sse.META_SEALED_KEY], context=aad)
+                except KMSError as e:
+                    raise S3Error("AccessDenied", f"KMS: {e}") from None
             return sse.unseal_key(
                 meta[sse.META_SEALED_KEY], self._sse_master_key(), aad)
         except sse.SSEError as e:

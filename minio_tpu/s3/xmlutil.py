@@ -318,15 +318,21 @@ def parse_versioning_xml(body: bytes) -> str:
 
 def sts_assume_role_xml(access_key: str, secret_key: str,
                         session_token: str, expiry_iso: str,
-                        request_id: str) -> bytes:
+                        request_id: str, action: str = "AssumeRole",
+                        subject: str = "") -> bytes:
+    """STS response document for AssumeRole and its federated variants
+    (AssumeRoleWithWebIdentity / AssumeRoleWithClientGrants,
+    cmd/sts-handlers.go response types)."""
     ns = "https://sts.amazonaws.com/doc/2011-06-15/"
-    root = ET.Element(f"AssumeRoleResponse", xmlns=ns)
-    result = _el(root, "AssumeRoleResult")
+    root = ET.Element(f"{action}Response", xmlns=ns)
+    result = _el(root, f"{action}Result")
     creds = _el(result, "Credentials")
     _el(creds, "AccessKeyId", access_key)
     _el(creds, "SecretAccessKey", secret_key)
     _el(creds, "SessionToken", session_token)
     _el(creds, "Expiration", expiry_iso)
+    if subject and action == "AssumeRoleWithWebIdentity":
+        _el(result, "SubjectFromWebIdentityToken", subject)
     meta = _el(root, "ResponseMetadata")
     _el(meta, "RequestId", request_id)
     return render(root)

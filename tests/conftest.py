@@ -30,6 +30,14 @@ import pytest  # noqa: E402
 
 S3_ACCESS, S3_SECRET = "testadmin", "testsecret123"
 
+# Isolate KMS key persistence per test session (LocalKMS would otherwise
+# write runtime-created keys to ~/.mtpu/kms-keys, colliding across runs).
+import tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "MTPU_KMS_KEY_FILE",
+    os.path.join(tempfile.mkdtemp(prefix="mtpu-test-kms-"), "keys"))
+
 
 def free_port() -> int:
     s = socket.socket()

@@ -14,11 +14,12 @@ import requests
 
 class SigV4Client:
     def __init__(self, endpoint: str, access_key: str, secret_key: str,
-                 region: str = "us-east-1"):
+                 region: str = "us-east-1", session_token: str = ""):
         self.endpoint = endpoint.rstrip("/")
         self.ak = access_key
         self.sk = secret_key
         self.region = region
+        self.session_token = session_token
         self.session = requests.Session()
 
     def _sign(self, method: str, path: str, query: dict, headers: dict,
@@ -31,6 +32,8 @@ class SigV4Client:
         headers = {k.lower(): v for k, v in headers.items()}
         headers.update({"host": host, "x-amz-date": amz_date,
                         "x-amz-content-sha256": payload_hash})
+        if self.session_token:
+            headers["x-amz-security-token"] = self.session_token
         signed = sorted(headers)
         cq = "&".join(
             f"{urllib.parse.quote(k, safe='-._~')}={urllib.parse.quote(str(v), safe='-._~')}"
