@@ -26,6 +26,12 @@ DEFAULTS: dict[str, dict[str, str]] = {
     "heal": {"bitrotscan": "off", "max_sleep": "1s", "max_io": "10"},
     "notify_webhook": {"enable": "off", "endpoint": "", "auth_token": "",
                        "queue_limit": "10000"},
+    "notify_nats": {"enable": "off", "address": "", "subject": "minio"},
+    "notify_redis": {"enable": "off", "address": "", "key": "minio_events",
+                     "password": "", "format": "access"},
+    "notify_mqtt": {"enable": "off", "address": "", "topic": "minio"},
+    "notify_elasticsearch": {"enable": "off", "url": "", "index": "minio"},
+    "notify_nsq": {"enable": "off", "address": "", "topic": "minio"},
     "logger_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
     "audit_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
     "audit_file": {"path": ""},
@@ -38,7 +44,9 @@ DEFAULTS: dict[str, dict[str, str]] = {
 
 # Subsystems that apply without restart (cmd/config/config.go:133).
 DYNAMIC = {"api", "scanner", "heal",
-           "logger_webhook", "audit_webhook", "audit_file"}
+           "logger_webhook", "audit_webhook", "audit_file",
+           "notify_webhook", "notify_nats", "notify_redis", "notify_mqtt",
+           "notify_elasticsearch", "notify_nsq"}
 
 PATH = "config/config.json"
 ENV_PREFIX = "MTPU"

@@ -345,6 +345,8 @@ class AdminAPI:
             if any(s in ("logger_webhook", "audit_webhook", "audit_file")
                    for s in doc):
                 self.s.configure_logging()  # dynamic re-apply
+            if any(s.startswith("notify_") for s in doc):
+                self.s.configure_event_targets()
             return _json({"restart": [s for s in doc
                                       if not cfg.is_dynamic(s)]})
         raise S3Error("MethodNotAllowed", resource=request.path)

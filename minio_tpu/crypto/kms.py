@@ -73,8 +73,14 @@ class LocalKMS:
         return sorted(self._keys)
 
     def create_key(self, key_id: str) -> None:
-        if key_id in self._keys or ":" in key_id:
-            raise KMSError(f"key {key_id!r} exists or is invalid")
+        import re
+
+        # Strict id charset: anything else (newlines, ':') would corrupt
+        # the line-oriented key file and brick the next boot.
+        if not re.fullmatch(r"[A-Za-z0-9._-]{1,64}", key_id):
+            raise KMSError(f"invalid key id {key_id!r}")
+        if key_id in self._keys:
+            raise KMSError(f"key {key_id!r} exists")
         key = pysecrets.token_bytes(32)
         # Persist BEFORE registering: a key that can seal objects but
         # wouldn't survive a restart is data loss waiting to happen.

@@ -131,6 +131,66 @@ def paginate_objects(
                            objects=objects, prefixes=prefixes)
 
 
+def entries_from_journals(
+    journals: dict[str, XLMeta],
+    to_info: Callable[[str, FileInfo], object],
+) -> list[tuple[str, object]]:
+    """Render a journal map into the sorted live-object entry stream the
+    metacache persists (cmd/metacache-stream.go role)."""
+    out = []
+    for name in sorted(journals):
+        try:
+            fi = journals[name].to_fileinfo("", name, None)
+        except se.StorageError:
+            continue
+        if fi.deleted:
+            continue
+        out.append((name, to_info(name, fi)))
+    return out
+
+
+def paginate_cached(
+    entries: list[tuple[str, object]],
+    prefix: str = "",
+    marker: str = "",
+    delimiter: str = "",
+    max_keys: int = 1000,
+) -> ListObjectsInfo:
+    """paginate_objects over a pre-rendered metacache entry stream —
+    continuation pages pay a seek, not a namespace walk."""
+    objects = []
+    prefixes: list[str] = []
+    seen_prefix: set[str] = set()
+    truncated = False
+    next_marker = ""
+    for name, info in entries:
+        if not name.startswith(prefix):
+            continue
+        if _skip_for_marker(name, marker, delimiter):
+            continue
+        if delimiter:
+            rest = name[len(prefix):]
+            d = rest.find(delimiter)
+            if d >= 0:
+                cp = prefix + rest[: d + len(delimiter)]
+                if cp not in seen_prefix:
+                    if len(objects) + len(seen_prefix) >= max_keys:
+                        truncated = True
+                        break
+                    seen_prefix.add(cp)
+                    prefixes.append(cp)
+                    next_marker = cp
+                continue
+        if len(objects) + len(seen_prefix) >= max_keys:
+            truncated = True
+            break
+        objects.append(info)
+        next_marker = name
+    return ListObjectsInfo(is_truncated=truncated,
+                           next_marker=next_marker if truncated else "",
+                           objects=objects, prefixes=prefixes)
+
+
 def _skip_for_marker(name: str, marker: str, delimiter: str) -> bool:
     """Resume semantics: skip names at or before the marker; a marker that
     names a common prefix also skips everything under it (so NextMarker may

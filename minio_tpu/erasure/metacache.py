@@ -1,0 +1,110 @@
+"""Metacache — persisted listing streams for resumable pagination.
+
+Role-equivalent of cmd/metacache-stream.go:57 / metacache-bucket.go:43 /
+metacache-set.go: the first page of a large listing walks the drives once,
+and the merged, sorted result is persisted as a msgpack stream object under
+the system bucket; every continuation page then seeks into the persisted
+stream instead of re-walking the namespace. Caches are keyed by
+(bucket, prefix), expire by TTL, and are rebuilt transparently whenever a
+continuation misses (the token is the S3 marker, so a rebuilt cache
+resumes exactly where the client stopped — no wire-format coupling).
+
+Unlike the reference's per-set .metacache files + bucket cache manager +
+cross-peer coordination, the stream persists through the same replicated
+sys-store the config/IAM already use — one mechanism, cluster-visible,
+quorum-durable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+
+from minio_tpu.dist.rpc import pack, unpack
+from minio_tpu.erasure.types import ObjectInfo
+from minio_tpu.utils import errors as se
+
+DEFAULT_TTL = 60.0
+_PREFIX = "buckets"
+
+
+class Metacache:
+    def __init__(self, store, ttl: float = DEFAULT_TTL):
+        """store: read/write/delete_sys_config provider (the pools)."""
+        self._store = store
+        self.ttl = ttl
+        self.hits = 0
+        self.misses = 0
+        self._saved_at: dict[tuple[str, str], float] = {}
+        self._dirty_at: dict[str, float] = {}
+
+    def mark_dirty(self, bucket: str) -> None:
+        """A mutation touched the bucket: cached streams written before
+        this instant stop being served (the role the reference's bloom
+        cycle plays for metacache invalidation)."""
+        self._dirty_at[bucket] = time.time()
+        if len(self._dirty_at) > 4096:
+            self._dirty_at.clear()
+
+    def _stale(self, bucket: str, created: float) -> bool:
+        return created <= self._dirty_at.get(bucket, 0)
+
+    def recently_saved(self, bucket: str, prefix: str) -> bool:
+        """True while this node wrote the cache within ttl/2 and nothing
+        mutated the bucket since — lets the pools skip re-rendering +
+        re-persisting the stream on every truncated page-1 request of a
+        hot bucket."""
+        saved = self._saved_at.get((bucket, prefix), 0)
+        return (time.time() - saved < self.ttl / 2
+                and not self._stale(bucket, saved))
+
+    def _path(self, bucket: str, prefix: str) -> str:
+        h = hashlib.sha1(prefix.encode()).hexdigest()[:16]
+        return f"{_PREFIX}/{bucket}/metacache/{h}"
+
+    def save(self, bucket: str, prefix: str,
+             entries: list[tuple[str, ObjectInfo]]) -> None:
+        doc = {
+            "v": 1, "bucket": bucket, "prefix": prefix,
+            "created": time.time(),
+            "entries": [(n, dataclasses.asdict(oi)) for n, oi in entries],
+        }
+        try:
+            self._store.write_sys_config(self._path(bucket, prefix), pack(doc))
+            self._saved_at[(bucket, prefix)] = time.time()
+            if len(self._saved_at) > 4096:
+                self._saved_at.clear()
+        except se.StorageError:
+            pass  # cache is an optimization; never fail the listing
+
+    def load(self, bucket: str, prefix: str
+             ) -> list[tuple[str, ObjectInfo]] | None:
+        try:
+            raw = self._store.read_sys_config(self._path(bucket, prefix))
+        except se.StorageError:
+            self.misses += 1
+            return None
+        try:
+            doc = unpack(raw)
+            if (doc.get("v") != 1 or doc.get("bucket") != bucket
+                    or doc.get("prefix") != prefix):
+                self.misses += 1
+                return None
+            created = doc.get("created", 0)
+            if time.time() - created > self.ttl or self._stale(bucket, created):
+                self.drop(bucket, prefix)
+                self.misses += 1
+                return None
+            out = [(n, ObjectInfo(**d)) for n, d in doc["entries"]]
+        except (ValueError, TypeError, KeyError):
+            self.misses += 1
+            return None
+        self.hits += 1
+        return out
+
+    def drop(self, bucket: str, prefix: str = "") -> None:
+        try:
+            self._store.delete_sys_config(self._path(bucket, prefix))
+        except se.StorageError:
+            pass

@@ -39,6 +39,8 @@ class ErasureServerPools:
         if not pools:
             raise ValueError("no pools")
         self.pools = pools
+        from minio_tpu.erasure.metacache import Metacache
+        self.metacache = Metacache(self)
 
     def close(self) -> None:
         for p in self.pools:
@@ -120,6 +122,7 @@ class ErasureServerPools:
     def put_object(self, bucket: str, obj: str, data: BinaryIO, size: int = -1,
                    opts: ObjectOptions | None = None) -> ObjectInfo:
         opts = opts or ObjectOptions()
+        self.metacache.mark_dirty(bucket)
         return self._get_pool_for_put(bucket, obj, opts.version_id).put_object(
             bucket, obj, data, size, opts)
 
@@ -139,6 +142,7 @@ class ErasureServerPools:
     def delete_object(self, bucket: str, obj: str,
                       opts: ObjectOptions | None = None) -> ObjectInfo:
         opts = opts or ObjectOptions()
+        self.metacache.mark_dirty(bucket)
         if opts.versioned and not opts.version_id:
             # Delete markers land in the pool that owns (or would own) the key.
             idx = self._get_pool_idx_existing(bucket, obj)
@@ -222,6 +226,7 @@ class ErasureServerPools:
     def complete_multipart_upload(self, bucket: str, obj: str, upload_id: str,
                                   parts: list[CompletePart],
                                   opts: ObjectOptions | None = None) -> ObjectInfo:
+        self.metacache.mark_dirty(bucket)
         return self._upload_pool(bucket, obj, upload_id).complete_multipart_upload(
             bucket, obj, upload_id, parts, opts)
 
@@ -238,11 +243,26 @@ class ErasureServerPools:
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
                      delimiter: str = "", max_keys: int = 1000) -> ListObjectsInfo:
         self.get_bucket_info(bucket)
-        return listing.paginate_objects(
-            self.merged_journals(bucket, prefix),
-            lambda name, fi: listing.fi_to_object_info(bucket, name, fi),
-            prefix, marker, delimiter, max_keys,
-        )
+        to_info = lambda name, fi: listing.fi_to_object_info(bucket, name, fi)  # noqa: E731
+        # Continuation pages serve from the persisted metacache stream —
+        # the first page walked the namespace and saved it; the S3 marker
+        # doubles as the seek position (cmd/metacache-stream.go role).
+        if marker:
+            cached = self.metacache.load(bucket, prefix)
+            if cached is not None:
+                return listing.paginate_cached(
+                    cached, prefix, marker, delimiter, max_keys)
+        journals = self.merged_journals(bucket, prefix)
+        res = listing.paginate_objects(
+            journals, to_info, prefix, marker, delimiter, max_keys)
+        if res.is_truncated and not self.metacache.recently_saved(bucket, prefix):
+            # More pages will follow: persist the full rendered stream so
+            # they don't re-walk. Skipped when this node refreshed the
+            # stream moments ago (hot page-1 traffic).
+            self.metacache.save(
+                bucket, prefix,
+                listing.entries_from_journals(journals, to_info))
+        return res
 
     def list_object_versions(self, bucket: str, prefix: str = "", marker: str = "",
                              version_marker: str = "", delimiter: str = "",

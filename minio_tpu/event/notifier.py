@@ -39,6 +39,14 @@ class EventNotifier:
         with self._mu:
             return sorted(self._workers)
 
+    def unregister_target(self, arn: str) -> None:
+        """Stop and drop a target's delivery worker (dynamic reconfigure:
+        endpoint changed or target disabled)."""
+        with self._mu:
+            worker = self._workers.pop(arn, None)
+        if worker is not None:
+            worker.close()
+
     # -- per-bucket rules --
 
     def set_bucket_rules(self, bucket: str, notification_xml: bytes) -> None:

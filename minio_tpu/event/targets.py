@@ -3,9 +3,17 @@
 Role-equivalent of pkg/event/target/*: each target has an ARN; events are
 journaled to an on-disk queue first (pkg/event/target/queuestore.go), then a
 worker delivers with retry — so a target outage never loses events and
-never blocks the data path. Webhook is the first-class target (the
-reference's other nine targets need client libraries this image doesn't
-ship; the Target interface is the seam they plug into).
+never blocks the data path.
+
+Implemented targets (no client libraries in this image — each speaks the
+wire protocol directly over stdlib sockets/HTTP):
+  memory         in-process (tests + admin `listen` stream)
+  webhook        HTTP POST            (pkg/event/target/webhook.go)
+  nats           NATS text protocol   (pkg/event/target/nats.go)
+  redis          RESP RPUSH/PUBLISH   (pkg/event/target/redis.go)
+  mqtt           MQTT 3.1.1 QoS1      (pkg/event/target/mqtt.go)
+  elasticsearch  index via REST       (pkg/event/target/elasticsearch.go)
+  nsq            nsqd HTTP /pub       (pkg/event/target/nsq.go)
 """
 
 from __future__ import annotations
@@ -13,6 +21,8 @@ from __future__ import annotations
 import http.client
 import json
 import os
+import socket
+import struct
 import threading
 import time
 import urllib.parse
@@ -83,6 +93,218 @@ class WebhookTarget:
             resp.read()
             if resp.status // 100 != 2:
                 raise OSError(f"webhook {self.endpoint}: HTTP {resp.status}")
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        pass
+
+
+class NATSTarget:
+    """PUB the event JSON to a NATS subject (pkg/event/target/nats.go).
+    Speaks the NATS text protocol directly: INFO/CONNECT handshake, PUB,
+    then PING/PONG as a flush barrier so delivery is confirmed before the
+    queue entry is dropped."""
+
+    def __init__(self, address: str, subject: str, arn_id: str = "nats",
+                 timeout: float = 10.0):
+        self.arn = f"arn:minio_tpu:sqs::{arn_id}:nats"
+        host, _, port = address.partition(":")
+        self._addr = (host or "127.0.0.1", int(port or 4222))
+        self.subject = subject
+        self.timeout = timeout
+
+    def send(self, records: dict) -> None:
+        payload = json.dumps(records).encode()
+        with socket.create_connection(self._addr, timeout=self.timeout) as s:
+            f = s.makefile("rb")
+            info = f.readline()
+            if not info.startswith(b"INFO "):
+                raise OSError(f"nats: unexpected greeting {info[:40]!r}")
+            s.sendall(b'CONNECT {"verbose":false,"pedantic":false,'
+                      b'"name":"minio-tpu"}\r\n')
+            s.sendall(f"PUB {self.subject} {len(payload)}\r\n".encode()
+                      + payload + b"\r\nPING\r\n")
+            while True:
+                line = f.readline()
+                if not line:
+                    raise OSError("nats: connection closed before PONG")
+                if line.startswith(b"PONG"):
+                    return
+                if line.startswith(b"-ERR"):
+                    raise OSError(f"nats: {line.strip().decode()}")
+
+    def close(self) -> None:
+        pass
+
+
+class RedisTarget:
+    """RPUSH (list format) or PUBLISH (channel format) the event JSON
+    (pkg/event/target/redis.go), speaking RESP directly."""
+
+    def __init__(self, address: str, key: str, arn_id: str = "redis",
+                 password: str = "", publish: bool = False,
+                 timeout: float = 10.0):
+        self.arn = f"arn:minio_tpu:sqs::{arn_id}:redis"
+        host, _, port = address.partition(":")
+        self._addr = (host or "127.0.0.1", int(port or 6379))
+        self.key = key
+        self.password = password
+        self.publish = publish
+        self.timeout = timeout
+
+    @staticmethod
+    def _cmd(*args: bytes) -> bytes:
+        out = b"*%d\r\n" % len(args)
+        for a in args:
+            out += b"$%d\r\n%s\r\n" % (len(a), a)
+        return out
+
+    @staticmethod
+    def _reply(f) -> bytes:
+        line = f.readline()
+        if not line:
+            raise OSError("redis: connection closed")
+        if line[:1] == b"-":
+            raise OSError(f"redis: {line.strip().decode()}")
+        if line[:1] == b"$":  # bulk string
+            n = int(line[1:])
+            if n >= 0:
+                f.read(n + 2)
+        return line.strip()
+
+    def send(self, records: dict) -> None:
+        payload = json.dumps(records).encode()
+        with socket.create_connection(self._addr, timeout=self.timeout) as s:
+            f = s.makefile("rb")
+            if self.password:
+                s.sendall(self._cmd(b"AUTH", self.password.encode()))
+                self._reply(f)
+            verb = b"PUBLISH" if self.publish else b"RPUSH"
+            s.sendall(self._cmd(verb, self.key.encode(), payload))
+            self._reply(f)
+
+    def close(self) -> None:
+        pass
+
+
+class MQTTTarget:
+    """PUBLISH the event JSON at QoS 1 (pkg/event/target/mqtt.go),
+    speaking MQTT 3.1.1 packets directly: CONNECT/CONNACK,
+    PUBLISH/PUBACK, DISCONNECT."""
+
+    def __init__(self, address: str, topic: str, arn_id: str = "mqtt",
+                 timeout: float = 10.0):
+        self.arn = f"arn:minio_tpu:sqs::{arn_id}:mqtt"
+        host, _, port = address.partition(":")
+        self._addr = (host or "127.0.0.1", int(port or 1883))
+        self.topic = topic
+        self.timeout = timeout
+
+    @staticmethod
+    def _varint(n: int) -> bytes:
+        out = b""
+        while True:
+            b = n % 128
+            n //= 128
+            out += bytes([b | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    @staticmethod
+    def _mstr(s: bytes) -> bytes:
+        return struct.pack(">H", len(s)) + s
+
+    @staticmethod
+    def _recv_exact(s: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:  # TCP may legally deliver short reads
+            chunk = s.recv(n - len(buf))
+            if not chunk:
+                raise OSError("mqtt: connection closed mid-packet")
+            buf += chunk
+        return buf
+
+    def send(self, records: dict) -> None:
+        payload = json.dumps(records).encode()
+        cid = f"mtpu-{uuid.uuid4().hex[:12]}".encode()
+        with socket.create_connection(self._addr, timeout=self.timeout) as s:
+            # CONNECT: protocol "MQTT" level 4, clean session, 60s keepalive
+            var = (self._mstr(b"MQTT") + b"\x04\x02" + struct.pack(">H", 60)
+                   + self._mstr(cid))
+            s.sendall(b"\x10" + self._varint(len(var)) + var)
+            ack = self._recv_exact(s, 4)
+            if ack[0] != 0x20 or ack[3] != 0x00:
+                raise OSError(f"mqtt: CONNACK refused {ack.hex()}")
+            # PUBLISH QoS1, packet id 1
+            var = self._mstr(self.topic.encode()) + struct.pack(">H", 1) + payload
+            s.sendall(b"\x32" + self._varint(len(var)) + var)
+            puback = self._recv_exact(s, 4)
+            if puback[0] != 0x40:
+                raise OSError(f"mqtt: no PUBACK ({puback.hex()})")
+            s.sendall(b"\xe0\x00")  # DISCONNECT
+
+    def close(self) -> None:
+        pass
+
+
+class ElasticsearchTarget:
+    """Index the event as a document (pkg/event/target/elasticsearch.go):
+    POST {url}/{index}/_doc via plain REST."""
+
+    def __init__(self, url: str, index: str, arn_id: str = "elasticsearch",
+                 timeout: float = 10.0):
+        self.arn = f"arn:minio_tpu:sqs::{arn_id}:elasticsearch"
+        self.url = url.rstrip("/")
+        self.index = index
+        self.timeout = timeout
+
+    def send(self, records: dict) -> None:
+        u = urllib.parse.urlsplit(self.url)
+        cls = (http.client.HTTPSConnection if u.scheme == "https"
+               else http.client.HTTPConnection)
+        conn = cls(u.hostname or "127.0.0.1",
+                   u.port or (443 if u.scheme == "https" else 9200),
+                   timeout=self.timeout)
+        try:
+            path = f"{u.path}/{self.index}/_doc"
+            conn.request("POST", path or f"/{self.index}/_doc",
+                         body=json.dumps(records).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status // 100 != 2:
+                raise OSError(f"elasticsearch: HTTP {resp.status}")
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        pass
+
+
+class NSQTarget:
+    """Publish via nsqd's HTTP API (pkg/event/target/nsq.go):
+    POST /pub?topic=..."""
+
+    def __init__(self, address: str, topic: str, arn_id: str = "nsq",
+                 timeout: float = 10.0):
+        self.arn = f"arn:minio_tpu:sqs::{arn_id}:nsq"
+        host, _, port = address.partition(":")
+        self._host, self._port = host or "127.0.0.1", int(port or 4151)
+        self.topic = topic
+        self.timeout = timeout
+
+    def send(self, records: dict) -> None:
+        conn = http.client.HTTPConnection(self._host, self._port,
+                                          timeout=self.timeout)
+        try:
+            conn.request("POST", f"/pub?topic={urllib.parse.quote(self.topic)}",
+                         body=json.dumps(records).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status // 100 != 2:
+                raise OSError(f"nsq: HTTP {resp.status}")
         finally:
             conn.close()
 

@@ -118,6 +118,7 @@ class S3Server:
             os.path.join(tempfile.gettempdir(), f"mtpu-events-{os.getpid()}"))
         self.notifier = EventNotifier(queue_dir=queue_dir)
         self._rules_loaded: set = set()
+        self._event_targets_cfg: str = ""
         self.scanner = None
         from minio_tpu.scanner.tracker import UpdateTracker
         self.update_tracker = UpdateTracker(
@@ -137,6 +138,7 @@ class S3Server:
         from minio_tpu.logger import get_logger
         self.logger = get_logger()
         self.configure_logging()
+        self.configure_event_targets()
 
         # Replication plane (cmd/bucket-replication.go).
         from minio_tpu.replication.pool import BucketTargetSys, ReplicationPool
@@ -218,6 +220,77 @@ class S3Server:
                 t.close()
         self.logger.targets = self.logger.targets[:1] + log_targets
         self.logger.audit_targets = audit_targets
+
+    def configure_event_targets(self) -> None:
+        """(Re)apply notification targets from the notify_* config
+        subsystems (reference cmd/config/notify + pkg/event/target/*):
+        enabled targets register, changed ones are replaced, disabled ones
+        unregister. Reads through ConfigSys.get so env overrides keep
+        their documented precedence."""
+        import json as _json
+
+        from minio_tpu.event.targets import (
+            ElasticsearchTarget,
+            MQTTTarget,
+            NATSTarget,
+            NSQTarget,
+            RedisTarget,
+            WebhookTarget,
+        )
+
+        subsys_keys = {
+            "notify_webhook": ("enable", "endpoint", "auth_token"),
+            "notify_nats": ("enable", "address", "subject"),
+            "notify_redis": ("enable", "address", "key", "password", "format"),
+            "notify_mqtt": ("enable", "address", "topic"),
+            "notify_elasticsearch": ("enable", "url", "index"),
+            "notify_nsq": ("enable", "address", "topic"),
+        }
+        cfg = {s: {k: self.config.get(s, k) or "" for k in keys}
+               for s, keys in subsys_keys.items()}
+        sig = _json.dumps(cfg, sort_keys=True)
+        if sig == self._event_targets_cfg:
+            return
+        self._event_targets_cfg = sig
+
+        def on(s):
+            return cfg[s]["enable"] in ("on", "1", "true")
+
+        targets = []
+        if on("notify_webhook") and cfg["notify_webhook"]["endpoint"]:
+            targets.append(WebhookTarget(
+                cfg["notify_webhook"]["endpoint"],
+                auth_token=cfg["notify_webhook"]["auth_token"]))
+        if on("notify_nats") and cfg["notify_nats"]["address"]:
+            targets.append(NATSTarget(cfg["notify_nats"]["address"],
+                                      cfg["notify_nats"]["subject"]))
+        if on("notify_redis") and cfg["notify_redis"]["address"]:
+            targets.append(RedisTarget(
+                cfg["notify_redis"]["address"], cfg["notify_redis"]["key"],
+                password=cfg["notify_redis"]["password"],
+                publish=cfg["notify_redis"]["format"] == "channel"))
+        if on("notify_mqtt") and cfg["notify_mqtt"]["address"]:
+            targets.append(MQTTTarget(cfg["notify_mqtt"]["address"],
+                                      cfg["notify_mqtt"]["topic"]))
+        if on("notify_elasticsearch") and cfg["notify_elasticsearch"]["url"]:
+            targets.append(ElasticsearchTarget(
+                cfg["notify_elasticsearch"]["url"],
+                cfg["notify_elasticsearch"]["index"]))
+        if on("notify_nsq") and cfg["notify_nsq"]["address"]:
+            targets.append(NSQTarget(cfg["notify_nsq"]["address"],
+                                     cfg["notify_nsq"]["topic"]))
+
+        # Replace-or-remove semantics over the config-managed ARN space.
+        managed_kinds = ("webhook", "nats", "redis", "mqtt",
+                         "elasticsearch", "nsq")
+        want = {t.arn: t for t in targets}
+        for arn in list(self.notifier.target_arns):
+            if arn.rsplit(":", 1)[-1] in managed_kinds and arn not in want:
+                self.notifier.unregister_target(arn)
+        for arn, t in want.items():
+            if arn in self.notifier.target_arns:
+                self.notifier.unregister_target(arn)  # config changed
+            self.notifier.register_target(t)
 
     def start_auto_heal(self, interval: float = 10.0) -> None:
         """Boot the background new-drive healer (reference initAutoHeal,
@@ -1589,7 +1662,8 @@ class S3Server:
         # Strip source encryption bookkeeping; destination re-encrypts per
         # its own headers/bucket config.
         for k in (sse.META_ALGO, sse.META_SEALED_KEY, sse.META_NONCE,
-                  sse.META_KEY_MD5, sse.META_ACTUAL_SIZE):
+                  sse.META_KEY_MD5, sse.META_ACTUAL_SIZE,
+                  sse.META_KMS_KEY_ID):
             user_defined.pop(k, None)
         opts.user_defined = user_defined
 
