@@ -164,6 +164,28 @@ class AdminAPI:
             self._authorize(identity, "admin:ServerInfo")
             with self.s._bw_mu:
                 return _json({"buckets": dict(self.s.bandwidth)})
+        # -- ILM tier admin (madmin tier add/ls/rm roles) --
+        if op == "tier":
+            self._authorize(identity, "admin:SetTier")
+            reg = self.s.tiers
+            from minio_tpu.scanner.tiers import TierError, _from_doc
+
+            if m == "GET":
+                return _json({"tiers": reg.list_docs()})
+            if m == "PUT":
+                try:
+                    reg.add(_from_doc(json.loads(await request.read())))
+                except (TierError, ValueError, KeyError) as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                return _json({})
+            if m == "DELETE":
+                try:
+                    reg.remove(q.get("name", ""),
+                               force=q.get("force", "") in ("true", "1"))
+                except TierError as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+                return _json({})
+
         # -- KMS surface (cmd/kms-router KMSStatus/KMSCreateKey roles) --
         if op == "kms" and m == "GET" and rest in ("status", "key-status"):
             self._authorize(identity, "admin:KMSKeyStatus")

@@ -224,6 +224,12 @@ class HealingMixin:
 
         if latest.deleted or not latest.erasure.distribution:
             return self._heal_metadata_only(bucket, obj, latest, results, dry_run)
+        if (latest.metadata.get("x-mtpu-internal-transition-tier")
+                and not latest.data_dir):
+            # Transitioned stub: the data's only copy lives on the tier;
+            # heal just the metadata quorum, never "reconstruct" (and never
+            # purge) what is deliberately absent locally.
+            return self._heal_metadata_only(bucket, obj, latest, results, dry_run)
 
         dist = latest.erasure.distribution
         k = latest.erasure.data_blocks

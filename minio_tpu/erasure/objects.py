@@ -262,6 +262,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             fi.metadata.setdefault("etag", md5.hexdigest())
             fi.parts = [PartInfo(1, fi.size, fi.size, fi.mod_time)]
             with self.nslock.lock(bucket, obj):
+                self._check_put_precondition(bucket, obj, opts)
                 outcomes = parallel_map(
                     [
                         lambda d=d, f=_clone_for_drive(fi, i + 1): d.write_metadata(bucket, obj, f)
@@ -298,6 +299,14 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         # Commit under the namespace lock (the reference takes the dist
         # lock just before metadata write + rename, cmd/erasure-object.go:736).
         with self.nslock.lock(bucket, obj):
+            try:
+                self._check_put_precondition(bucket, obj, opts)
+            except se.ObjectError:
+                parallel_map(
+                    [lambda d=d: d.delete(sys_vol, tmp_rel, recursive=True)
+                     for d in shuffled]
+                )
+                raise
             outcomes = parallel_map(
                 [lambda i=i, d=d: commit(i, d) for i, d in enumerate(shuffled)]
             )
@@ -348,6 +357,28 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         if fi.inline_data:
             payload = fi.inline_data[offset: offset + length]
             return info, iter([payload])
+        tier_name = fi.metadata.get(
+            "x-mtpu-internal-transition-tier") if fi.metadata else ""
+        if tier_name and not fi.data_dir:
+            # Transitioned version: data lives on the remote tier; stream
+            # through transparently (reference transitioned-object reads,
+            # cmd/bucket-lifecycle.go getTransitionedObjectReader). Parts
+            # metadata survives transition, so multipart-SSE decryption
+            # still sees its per-part layout.
+            from minio_tpu.scanner import tiers as tiermod
+
+            reg = tiermod.global_registry()
+            key = fi.metadata.get("x-mtpu-internal-transition-key", "")
+            try:
+                if reg is None:
+                    raise tiermod.TierError("no tier registry configured")
+                tier = reg.get(tier_name)
+                return info, tier.get(key, offset, length)
+            except tiermod.TierError as e:
+                # Typed, not a 500: the data's only copy is on a tier we
+                # can't reach (e.g. tier deleted with force).
+                raise se.ObjectNotFound(bucket, obj,
+                                        f"tier {tier_name!r}: {e}") from e
         return info, self._stream_erasure(bucket, obj, fi, offset, length)
 
     def _stream_erasure(self, bucket: str, obj: str, fi: FileInfo,
@@ -627,6 +658,81 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         reduce_write_quorum(results, self._write_quorum_meta(), bucket, obj)
         return self._fi_to_object_info(bucket, obj, fi)
 
+    def transition_version(self, bucket: str, obj: str, version_id: str,
+                           tier_name: str, tier_key: str,
+                           storage_class: str = "",
+                           expect_mod_time: float | None = None) -> None:
+        """Mark a version transitioned: metadata keeps size/etag/parts (the
+        part layout drives multipart-SSE decryption on read-through) but
+        data_dir empties and the shard data is reclaimed (write_metadata
+        deletes the orphaned data dir on each drive) — reference transition
+        state in xl.meta v2 + free of the data parts.
+
+        expect_mod_time: abort if the version changed since the caller
+        copied its data to the tier (the scanner's TOCTOU guard)."""
+        with self.nslock.lock(bucket, obj):
+            fi = self._read_quorum_fileinfo(bucket, obj, version_id)
+            if fi.deleted:
+                raise se.ObjectNotFound(bucket, obj)
+            if fi.inline_data:
+                raise se.ObjectError(
+                    bucket, obj, "inline objects are too small to tier")
+            if (expect_mod_time is not None
+                    and abs(fi.mod_time - expect_mod_time) > 1e-6):
+                raise se.ObjectError(
+                    bucket, obj,
+                    "object changed while its data was being tiered")
+            fi.metadata["x-mtpu-internal-transition-tier"] = tier_name
+            fi.metadata["x-mtpu-internal-transition-key"] = tier_key
+            if storage_class:
+                fi.metadata["x-amz-storage-class"] = storage_class
+            fi.data_dir = ""
+            results = parallel_map(
+                [lambda d=d, f=_clone_for_drive(fi, i + 1):
+                 d.write_metadata(bucket, obj, f)
+                 for i, d in enumerate(
+                     shuffle_by_distribution(self.drives, fi.erasure.distribution)
+                     if fi.erasure.distribution else self.drives)]
+            )
+            reduce_write_quorum(results, self._write_quorum_meta(), bucket, obj)
+
+    def restore_transitioned(self, bucket: str, obj: str,
+                             version_id: str = "") -> None:
+        """Re-materialize a transitioned version's data from its tier
+        (RestoreObject role): shards are rebuilt locally and the transition
+        markers are dropped; the tier copy is removed. The conditional PUT
+        (expect_mod_time, checked under the commit lock) guarantees a
+        concurrent client write is never clobbered by stale tier data."""
+        from minio_tpu.scanner import tiers as tiermod
+        from minio_tpu.utils.streams import IterReader
+
+        fi = self._read_quorum_fileinfo(bucket, obj, version_id)
+        tier_name = fi.metadata.get("x-mtpu-internal-transition-tier", "")
+        if not tier_name or fi.data_dir:
+            return  # nothing to restore
+        if len(fi.parts) > 1 and any(
+                k.endswith("-sse") for k in fi.metadata):
+            # Multipart SSE relies on the original per-part boundaries,
+            # which a restore-as-single-part would destroy; reads already
+            # stream through the tier, so refuse rather than corrupt.
+            raise se.ObjectError(
+                bucket, obj, "restore of multipart SSE objects is not "
+                "supported; reads stream through the tier")
+        reg = tiermod.global_registry()
+        if reg is None:
+            raise se.ObjectError(bucket, obj, "no tier registry configured")
+        tier = reg.get(tier_name)
+        key = fi.metadata.get("x-mtpu-internal-transition-key", "")
+
+        meta = {k: v for k, v in fi.metadata.items()
+                if not k.startswith("x-mtpu-internal-transition-")}
+        opts = ObjectOptions(version_id=fi.version_id,
+                             versioned=bool(fi.version_id),
+                             user_defined=meta,
+                             expect_mod_time=fi.mod_time)
+        self.put_object(bucket, obj, IterReader(tier.get(key)), fi.size, opts)
+        tier.remove(key)
+
     def get_object_tags(self, bucket: str, obj: str,
                         opts: ObjectOptions | None = None) -> str:
         info = self.get_object_info(bucket, obj, opts)
@@ -738,6 +844,22 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             for t in threads:
                 t.join()
         return total, md5.hexdigest(), errs
+
+    def _check_put_precondition(self, bucket: str, obj: str,
+                                opts: ObjectOptions) -> None:
+        """Conditional-PUT guard, called INSIDE the commit lock: abort the
+        write if the latest (or named) version's mod_time moved since the
+        caller observed it (tier restore's lost-update protection)."""
+        if opts.expect_mod_time is None:
+            return
+        try:
+            cur = self._read_quorum_fileinfo(bucket, obj, opts.version_id)
+        except (se.ObjectNotFound, se.VersionNotFound):
+            raise se.ObjectError(
+                bucket, obj, "precondition failed: object vanished") from None
+        if abs(cur.mod_time - opts.expect_mod_time) > 1e-6:
+            raise se.ObjectError(
+                bucket, obj, "precondition failed: object changed")
 
     def _read_quorum_fileinfo(self, bucket: str, obj: str, version_id: str) -> FileInfo:
         results = parallel_map(

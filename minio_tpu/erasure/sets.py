@@ -46,6 +46,12 @@ class ErasureSets:
         set_drive_count = set_drive_count or len(drives)
         if fmt is None:
             fmt = init_format_erasure(drives, set_drive_count)
+            # Bind each drive to its slot UUID: a swapped/replugged disk
+            # surfaces as DiskNotFound on the next guarded call
+            # (cmd/xl-storage-disk-id-check.go:64 role).
+            from minio_tpu.storage.idcheck import wrap_with_id_check
+
+            drives = wrap_with_id_check(drives, fmt)
         self.format = fmt
         self.deployment_id = fmt.deployment_id
         self.set_count = len(drives) // set_drive_count
@@ -133,6 +139,19 @@ class ErasureSets:
 
     def latest_fileinfo(self, bucket: str, obj: str, version_id: str = ""):
         return self.get_hashed_set(obj).latest_fileinfo(bucket, obj, version_id)
+
+    def transition_version(self, bucket: str, obj: str, version_id: str,
+                           tier_name: str, tier_key: str,
+                           storage_class: str = "",
+                           expect_mod_time: float | None = None) -> None:
+        return self.get_hashed_set(obj).transition_version(
+            bucket, obj, version_id, tier_name, tier_key, storage_class,
+            expect_mod_time)
+
+    def restore_transitioned(self, bucket: str, obj: str,
+                             version_id: str = "") -> None:
+        return self.get_hashed_set(obj).restore_transitioned(
+            bucket, obj, version_id)
 
     # -- multipart: route by hash --
 

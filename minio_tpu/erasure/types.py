@@ -50,6 +50,9 @@ class ObjectOptions:
     part_number: int = 0
     delete_prefix: bool = False
     no_lock: bool = False
+    # Conditional PUT: commit only while the current latest version's
+    # mod_time still matches (tier restore's lost-update guard).
+    expect_mod_time: float | None = None
 
 
 @dataclass

@@ -246,3 +246,53 @@ def verify_shard_file(src: BinaryIO, data_size: int, shard_size: int,
         n = min(shard_size, data_size - off)
         reader.read_at(off, n)
         off += n
+
+
+class WholeBitrotWriter:
+    """Legacy whole-file bitrot (cmd/bitrot-whole.go): ONE digest over the
+    entire shard file, stored in metadata (ChecksumInfo.hash) rather than
+    interleaved — the chunk stream on disk is the raw shard bytes. Kept for
+    format parity; the streaming format is the default."""
+
+    def __init__(self, out: BinaryIO, algorithm: str = DEFAULT_ALGORITHM):
+        self.out = out
+        self.algorithm = algorithm
+        self._algo = get_algorithm(algorithm)
+        self._buf = bytearray()
+
+    def write(self, chunk: bytes) -> None:
+        self.out.write(chunk)
+        self._buf += chunk
+
+    def digest(self) -> bytes:
+        """Final whole-file digest for the metadata record."""
+        return self._algo.digest(bytes(self._buf))
+
+
+class WholeBitrotReader:
+    """Verify-on-first-read whole-file reader: the entire shard is hashed
+    once against the metadata digest; subsequent read_at calls serve from
+    the verified buffer (cmd/bitrot-whole.go wholeBitrotReader)."""
+
+    def __init__(self, src: BinaryIO, expected_digest: bytes,
+                 algorithm: str = DEFAULT_ALGORITHM):
+        self.src = src
+        self.expected = expected_digest
+        self._algo = get_algorithm(algorithm)
+        self._data: bytes | None = None
+
+    def _load(self) -> bytes:
+        if self._data is None:
+            self.src.seek(0)
+            data = self.src.read()
+            if self._algo.digest(data) != self.expected:
+                raise se.FileCorrupt("whole-file bitrot digest mismatch")
+            self._data = data
+        return self._data
+
+    def read_at(self, offset: int, length: int) -> bytes:
+        data = self._load()
+        if offset < 0 or offset + length > len(data):
+            raise se.FileCorrupt(
+                f"read [{offset}, {offset + length}) outside {len(data)}")
+        return data[offset:offset + length]

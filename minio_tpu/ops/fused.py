@@ -114,16 +114,21 @@ def verify_digests(chunks: jax.Array, lens: jax.Array) -> jax.Array:
 def digest_chunks_host(chunks: list[bytes], cap: int) -> list[bytes]:
     """Host convenience: mxsum256 digests of a ragged list of byte chunks
     (each <= cap) in one device launch. Row count pads to a power of two so
-    the jitted program sees a bounded shape set."""
+    the jitted program sees a bounded shape set; the staging array recycles
+    through the byte pool (pkg/bpool role) — np.asarray on the launch
+    output blocks until the input was consumed, so returning it is safe."""
     import numpy as np
+
+    from minio_tpu.utils.bufpool import GLOBAL_POOL
 
     n = 1
     while n < len(chunks):
         n *= 2
-    batch = np.zeros((n, cap), dtype=np.uint8)
+    batch = GLOBAL_POOL.get((n, cap), zero=True)
     lens = np.zeros(n, dtype=np.int32)
     for i, c in enumerate(chunks):
         batch[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
         lens[i] = len(c)
     got = np.asarray(verify_digests(batch, lens))
+    GLOBAL_POOL.put(batch)
     return [got[i].tobytes() for i in range(len(chunks))]

@@ -39,7 +39,7 @@ from minio_tpu.event import event as evt
 from minio_tpu.iam.actions import action_for
 from minio_tpu.iam.policy import Policy, PolicyArgs
 from minio_tpu.iam.sys import ANONYMOUS, IAMSys
-from minio_tpu.s3 import sigv4, xmlutil
+from minio_tpu.s3 import sigv2, sigv4, xmlutil
 from minio_tpu.s3.errors import S3Error, from_exception
 from minio_tpu.storage import LocalDrive
 from minio_tpu.utils import errors as se
@@ -154,6 +154,11 @@ class S3Server:
         self.kms = LocalKMS(
             key_file=self.config.get("kms", "key_file") or "",
             default_key_id=self.config.get("kms", "default_key") or "")
+
+        # ILM tiers (transition targets; reference tier subsystem).
+        from minio_tpu.scanner.tiers import TierRegistry, set_global
+        self.tiers = TierRegistry(store if has_store else None)
+        set_global(self.tiers)
         self.admin = AdminAPI(self)
         self.local_locker = None  # set by the cluster node when distributed
         self.notification = notification_sys  # peer fan-out (distributed)
@@ -462,6 +467,21 @@ class S3Server:
                 request.method, path, query_items, request.headers, self._lookup)
             auth_sig = sigv4.parse_auth_header(request.headers["Authorization"])
             identity = self.iam.identify(auth_sig.access_key)
+        elif sigv2.is_v2_header(request.headers):
+            # Legacy SigV2 clients (cmd/signature-v2.go).
+            creds = sigv2.verify_header_auth(
+                request.method, path, query_items, request.headers,
+                self._lookup)
+            auth_sig = None
+            payload_hash = sigv4.UNSIGNED_PAYLOAD
+            identity = self.iam.identify(creds.access_key)
+        elif sigv2.is_v2_presigned(q):
+            creds = sigv2.verify_presigned(
+                request.method, path, query_items, request.headers,
+                self._lookup)
+            auth_sig = None
+            payload_hash = sigv4.UNSIGNED_PAYLOAD
+            identity = self.iam.identify(creds.access_key)
         else:
             # Anonymous: allowed only where the bucket policy grants it.
             identity, payload_hash, auth_sig = (
@@ -711,6 +731,21 @@ class S3Server:
 
         # ----- S3 Select (reference SelectObjectContentHandler,
         #       cmd/object-handlers.go:95; engine pkg/s3select) -----
+        if m == "POST" and "restore" in q:
+            # RestoreObject: re-materialize a tiered version's data
+            # (reference PostRestoreObjectHandler; our tiers read through,
+            # so restore = pull the data back into the cluster).
+            request["api"] = "RestoreObject"
+            self._check_access(identity, "s3:RestoreObject", bucket, key)
+            if not hasattr(self.obj, "restore_transitioned"):
+                raise S3Error("NotImplemented", resource=path)
+            try:
+                await run(self.obj.restore_transitioned, bucket, key,
+                          opts.version_id)
+            except se.ObjectError as e:
+                raise from_exception(e, path) from None
+            return web.Response(status=202, headers=hdr)
+
         if m == "POST" and "select" in q:
             from minio_tpu.s3select import S3SelectRequest, run_select
             from minio_tpu.s3select.sql import SelectError
@@ -1848,51 +1883,8 @@ class _PrefixReader:
             close()
 
 
-class _IterReader:
-    """File-like over a bytes iterator (bridges GET streams into
-    put_object and feeds TextIOWrapper in the select engine)."""
-
-    closed = False
-
-    def __init__(self, it: Iterator[bytes]):
-        self._it = iter(it)
-        self._buf = bytearray()
-
-    def readable(self) -> bool:
-        return True
-
-    def writable(self) -> bool:
-        return False
-
-    def seekable(self) -> bool:
-        return False
-
-    def flush(self) -> None:
-        pass
-
-    def read1(self, n: int = -1) -> bytes:
-        return self.read(n)
-
-    def readinto(self, b) -> int:
-        data = self.read(len(b))
-        b[:len(data)] = data
-        return len(data)
-
-    def read(self, n: int = -1) -> bytes:
-        if n < 0:
-            for c in self._it:
-                self._buf += c
-            out = bytes(self._buf)
-            self._buf.clear()
-            return out
-        while len(self._buf) < n:
-            try:
-                self._buf += next(self._it)
-            except StopIteration:
-                break
-        out = bytes(self._buf[:n])
-        del self._buf[:n]
-        return out
+# File-like over a bytes iterator — canonical home: utils/streams.py.
+from minio_tpu.utils.streams import IterReader as _IterReader  # noqa: E402
 
 
 def _validate_xml(body: bytes) -> None:
@@ -2088,6 +2080,9 @@ def main(argv=None):
                     help="local SSD cache directory (enables the disk cache)")
     ap.add_argument("--cache-quota", type=int, default=1 << 30,
                     help="disk cache quota in bytes")
+    ap.add_argument("--certs-dir", default=os.environ.get("MTPU_CERTS_DIR", ""),
+                    help="TLS certs dir (public.crt + private.key, "
+                         "hot-reloaded); empty serves plaintext HTTP")
     args = ap.parse_args(argv)
     host, _, port = args.address.rpartition(":")
     access = os.environ.get("MTPU_ROOT_USER", "minioadmin")
@@ -2113,7 +2108,13 @@ def main(argv=None):
     if args.scan_interval > 0:
         srv.start_scanner(interval=args.scan_interval)
     srv.start_auto_heal()
-    web.run_app(srv.app, host=host or "0.0.0.0", port=int(port))
+    ssl_context = None
+    if args.certs_dir:
+        from minio_tpu.utils.certs import CertManager
+
+        ssl_context = CertManager(args.certs_dir).ssl_context
+    web.run_app(srv.app, host=host or "0.0.0.0", port=int(port),
+                ssl_context=ssl_context)
 
 
 if __name__ == "__main__":

@@ -18,6 +18,7 @@ NONE = "none"
 DELETE = "delete"                     # expire the (latest) version
 DELETE_VERSION = "delete-version"     # expire one noncurrent version
 DELETE_MARKER = "delete-marker"       # remove an expired delete marker
+TRANSITION = "transition"             # move data to a colder tier
 ABORT_MPU = "abort-mpu"
 
 _DAY = 86400.0
@@ -52,7 +53,7 @@ class Rule:
     expired_object_delete_marker: bool = False
     noncurrent_days: int = 0
     abort_mpu_days: int = 0
-    transition_days: int = 0          # parsed, inert (no tier backend yet)
+    transition_days: int = 0          # StorageClass names a tier (tiers.py)
     transition_storage_class: str = ""
 
     @property
@@ -76,11 +77,14 @@ class Lifecycle:
              delete_marker: bool = False, num_versions: int = 1,
              successor_mod_time: float = 0.0,
              tags: dict[str, str] | None = None,
+             transitioned: bool = False,
              now: float | None = None) -> str:
         """Compute the due action for one object version
-        (lifecycle.go ComputeAction)."""
+        (lifecycle.go ComputeAction). Expiry outranks transition; an
+        already-transitioned version never re-transitions."""
         now = now if now is not None else datetime.datetime.now(
             datetime.timezone.utc).timestamp()
+        due_transition = False
         for r in self.rules:
             if not r.enabled or not r.matches(key, tags):
                 continue
@@ -100,7 +104,26 @@ class Lifecycle:
                 return DELETE
             if r.expiration_days and now - mod_time >= r.expiration_days * _DAY:
                 return DELETE
-        return NONE
+            if (r.transition_days and r.transition_storage_class
+                    and not transitioned
+                    and now - mod_time >= r.transition_days * _DAY):
+                due_transition = True
+        return TRANSITION if due_transition else NONE
+
+    def transition_tier(self, key: str, mod_time: float,
+                        tags: dict[str, str] | None = None,
+                        now: float | None = None) -> str:
+        """Tier (StorageClass) named by the first matching transition rule
+        that is actually DUE — a matching-but-not-yet-due rule must not
+        move the object early."""
+        now = now if now is not None else datetime.datetime.now(
+            datetime.timezone.utc).timestamp()
+        for r in self.rules:
+            if (r.enabled and r.matches(key, tags)
+                    and r.transition_days and r.transition_storage_class
+                    and now - mod_time >= r.transition_days * _DAY):
+                return r.transition_storage_class
+        return ""
 
     def mpu_expired(self, initiated: float, now: float | None = None) -> bool:
         now = now if now is not None else datetime.datetime.now(

@@ -158,10 +158,17 @@ class DataScanner:
 
     def _apply_ilm(self, bucket: str, o, lifecycle, *, num_versions: int,
                    successor: float, now: float | None) -> None:
+        from minio_tpu.scanner import tiers as tiermod
+
         action = lifecycle.eval(
             o.name, o.mod_time, is_latest=o.is_latest,
             delete_marker=o.delete_marker, num_versions=num_versions,
-            successor_mod_time=successor, now=now)
+            successor_mod_time=successor,
+            transitioned=tiermod.TRANSITION_TIER in o.user_defined,
+            now=now)
+        if action == lc.TRANSITION:
+            self._transition(bucket, o, lifecycle, now)
+            return
         try:
             if action == lc.DELETE:
                 # Expiring the latest version of a versioned object writes a
@@ -185,6 +192,39 @@ class DataScanner:
             self.notifier.send(new_object_event(
                 evt.OBJECT_REMOVED_DELETE, bucket, o.name,
                 version_id=o.version_id, user="minio_tpu:ilm"))
+
+    def _transition(self, bucket: str, o, lifecycle,
+                    now: float | None = None) -> None:
+        """Move a due version's data to its rule's tier and stub the
+        version (reference transition workers, cmd/bucket-lifecycle.go:
+        108-135). Stored bytes (post-SSE/compression) move verbatim, so
+        read-through decrypts exactly as local reads do."""
+        from minio_tpu.scanner import tiers as tiermod
+
+        reg = tiermod.global_registry()
+        if reg is None:
+            return
+        tier_name = lifecycle.transition_tier(o.name, o.mod_time, now=now)
+        if not tier_name:
+            return
+        try:
+            tier = reg.get(tier_name)
+        except tiermod.TierError:
+            return
+        opts = ObjectOptions(version_id=o.version_id)
+        tier_key = f"{bucket}/{o.name}/{o.version_id or 'null'}"
+        try:
+            _info, stream = self.obj.get_object(bucket, o.name, opts=opts)
+            tier.put(tier_key, stream)
+            # expect_mod_time guards the stub commit: if a client replaced
+            # the object while we copied, the transition aborts and the
+            # tier copy is discarded (no TOCTOU data loss).
+            self.obj.transition_version(bucket, o.name, o.version_id,
+                                        tier_name, tier_key,
+                                        storage_class=tier_name,
+                                        expect_mod_time=o.mod_time)
+        except (se.ObjectError, se.StorageError, tiermod.TierError, OSError):
+            tier.remove(tier_key)  # best-effort cleanup of a half-move
 
     def _expire_mpus(self, bucket: str, lifecycle, now: float | None) -> None:
         try:

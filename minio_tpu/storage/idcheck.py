@@ -1,0 +1,113 @@
+"""Disk-identity check decorator.
+
+Role-equivalent of cmd/xl-storage-disk-id-check.go:64: every per-drive call
+is guarded by "is this still the same physical drive" — a swapped, remounted
+or replugged disk must surface as DiskNotFound (so the quorum layers treat
+it as offline and the auto-healer reclaims it) rather than silently serving
+another drive's shards.
+
+The identity probe reads the on-drive format document, so it is throttled
+(CHECK_INTERVAL) instead of per-call; any storage error on the probe marks
+the drive failed for that call. Mutating calls after a detected swap are
+refused until the probe sees the right UUID again (a drive swap-back, or a
+reformat by the heal path).
+"""
+
+from __future__ import annotations
+
+import time
+
+from minio_tpu.storage.api import StorageAPI
+from minio_tpu.utils import errors as se
+
+CHECK_INTERVAL = 5.0
+
+_GUARDED = {
+    "make_vol", "stat_vol", "list_vols", "delete_vol",
+    "list_dir", "walk_dir", "read_all", "write_all", "delete",
+    "create_file", "append_file", "read_file_stream", "rename_file",
+    "write_metadata", "read_version", "read_xl", "delete_version",
+    "rename_data", "verify_file", "check_parts",
+}
+
+
+class DiskIDChecker:
+    """Transparent StorageAPI wrapper binding a drive to its format UUID."""
+
+    def __init__(self, inner: StorageAPI, expected_id: str,
+                 interval: float = CHECK_INTERVAL):
+        self._inner = inner
+        self._expected = expected_id
+        self._interval = interval
+        self._last_ok = 0.0
+
+    # -- identity plumbing (unguarded: these ARE the probe surface) --
+
+    @property
+    def inner(self) -> StorageAPI:
+        return self._inner
+
+    def get_disk_id(self) -> str:
+        return self._inner.get_disk_id()
+
+    def set_disk_id(self, disk_id: str) -> None:
+        self._expected = disk_id
+        self._inner.set_disk_id(disk_id)
+
+    def disk_info(self):
+        return self._inner.disk_info()
+
+    def is_local(self) -> bool:
+        return self._inner.is_local()
+
+    def endpoint(self) -> str:
+        return self._inner.endpoint()
+
+    def read_format(self):
+        return self._inner.read_format()
+
+    def write_format(self, doc) -> None:
+        self._inner.write_format(doc)
+        self._last_ok = 0.0  # re-probe after identity rewrite
+
+    # -- the guard --
+
+    def _check(self) -> None:
+        if not self._expected:
+            return
+        now = time.monotonic()
+        if now - self._last_ok < self._interval:
+            return
+        try:
+            this = self._inner.get_disk_id()
+        except se.StorageError as e:
+            raise se.DiskNotFound(
+                f"{self._inner.endpoint()}: identity probe failed: {e}") from e
+        if this != self._expected:
+            raise se.DiskNotFound(
+                f"{self._inner.endpoint()}: drive id {this!r} != expected "
+                f"{self._expected!r} (swapped drive?)")
+        self._last_ok = now
+
+    def __getattr__(self, name: str):
+        fn = getattr(self._inner, name)
+        if name not in _GUARDED or not callable(fn):
+            return fn
+
+        def guarded(*a, **kw):
+            self._check()
+            return fn(*a, **kw)
+
+        return guarded
+
+
+def wrap_with_id_check(drives: list[StorageAPI],
+                       fmt) -> list[StorageAPI]:
+    """Wrap an ordered drive list with its format layout's UUIDs
+    (drives arrive UUID-ordered from init_format_erasure)."""
+    flat = [u for s in fmt.sets for u in s]
+    out: list[StorageAPI] = []
+    for i, d in enumerate(drives):
+        uid = flat[i] if i < len(flat) else ""
+        out.append(DiskIDChecker(d, uid) if uid else d)
+    return out

@@ -1,0 +1,50 @@
+"""Capped reusable numpy staging-buffer pool.
+
+Role-equivalent of pkg/bpool/bpool.go (BytePoolCap): the read-verify and
+digest paths stage chunk batches into [N, shard_size] arrays on every
+batch; recycling them avoids a multi-MiB allocation + page-fault storm per
+GET batch. Buffers are handed out dirty — callers overwrite every row they
+use and pass explicit row lengths, so stale bytes never leak into digests.
+
+Safe-reuse contract: return a buffer only after any device computation
+consuming it has completed (np.asarray on the launch's OUTPUT blocks until
+then, which is how the callers sequence it).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class ArrayPool:
+    def __init__(self, max_per_shape: int = 4, max_shapes: int = 32):
+        self._mu = threading.Lock()
+        self._pools: dict[tuple, list[np.ndarray]] = {}
+        self.max_per_shape = max_per_shape
+        self.max_shapes = max_shapes
+
+    def get(self, shape: tuple[int, ...],
+            dtype=np.uint8, zero: bool = False) -> np.ndarray:
+        key = (shape, np.dtype(dtype).str)
+        with self._mu:
+            lst = self._pools.get(key)
+            arr = lst.pop() if lst else None
+        if arr is None:
+            return (np.zeros if zero else np.empty)(shape, dtype=dtype)
+        if zero:
+            arr.fill(0)
+        return arr
+
+    def put(self, arr: np.ndarray) -> None:
+        key = (arr.shape, arr.dtype.str)
+        with self._mu:
+            if len(self._pools) >= self.max_shapes and key not in self._pools:
+                self._pools.clear()  # shape churn: drop everything, stay capped
+            lst = self._pools.setdefault(key, [])
+            if len(lst) < self.max_per_shape:
+                lst.append(arr)
+
+
+GLOBAL_POOL = ArrayPool()

@@ -1,0 +1,102 @@
+"""TLS certificate management with hot reload.
+
+Role-equivalent of pkg/certs: the server loads public.crt/private.key from
+a certs directory and picks up replaced files WITHOUT a restart. Python's
+ssl can't mutate a context's chain under live connections, so the reload
+rides the SNI callback: every new handshake consults the manager, which
+rebuilds a fresh SSLContext whenever the cert/key mtimes change — exactly
+the reference's GetCertificate indirection (pkg/certs/certs.go).
+"""
+
+from __future__ import annotations
+
+import os
+import ssl
+import threading
+
+PUBLIC_CERT = "public.crt"
+PRIVATE_KEY = "private.key"
+
+
+class CertManager:
+    def __init__(self, certs_dir: str):
+        self.cert_file = os.path.join(certs_dir, PUBLIC_CERT)
+        self.key_file = os.path.join(certs_dir, PRIVATE_KEY)
+        if not (os.path.exists(self.cert_file) and os.path.exists(self.key_file)):
+            raise FileNotFoundError(
+                f"certs dir {certs_dir!r} needs {PUBLIC_CERT} + {PRIVATE_KEY}")
+        self._mu = threading.Lock()
+        self._mtimes = (0.0, 0.0)
+        self._inner: ssl.SSLContext | None = None
+        self.reloads = -1  # first build is not a reload
+        self._refresh()
+
+        # The outer context is what the listener binds; its sni_callback
+        # swaps in the freshest inner context per handshake.
+        outer = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        outer.load_cert_chain(self.cert_file, self.key_file)
+
+        def _sni(ssl_obj, server_name, _ctx):
+            ssl_obj.context = self.current()
+
+        outer.sni_callback = _sni
+        self.ssl_context = outer
+
+    def _stat(self) -> tuple[float, float]:
+        try:
+            return (os.stat(self.cert_file).st_mtime,
+                    os.stat(self.key_file).st_mtime)
+        except OSError:
+            return self._mtimes
+
+    def _refresh(self) -> None:
+        mt = self._stat()
+        if mt == self._mtimes and self._inner is not None:
+            return
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.cert_file, self.key_file)
+        self._inner = ctx
+        self._mtimes = mt
+        self.reloads += 1
+
+    def current(self) -> ssl.SSLContext:
+        """Freshest context (mtime-checked; cheap stat per handshake)."""
+        with self._mu:
+            try:
+                self._refresh()
+            except (OSError, ssl.SSLError):
+                pass  # half-written files during rotation: keep serving old
+            return self._inner  # type: ignore[return-value]
+
+
+def self_signed(certs_dir: str, common_name: str = "minio-tpu") -> None:
+    """Mint a self-signed cert pair into certs_dir (test/dev helper — the
+    reference ships none; operators bring real certs)."""
+    import datetime
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(certs_dir, exist_ok=True)
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=365))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.DNSName("localhost"), x509.DNSName(common_name)]),
+                critical=False)
+            .sign(key, hashes.SHA256()))
+    with open(os.path.join(certs_dir, PRIVATE_KEY), "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption()))
+    with open(os.path.join(certs_dir, PUBLIC_CERT), "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))

@@ -1,0 +1,52 @@
+"""Stream adapters shared across layers."""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+
+class IterReader:
+    """File-like over a bytes iterator (bridges GET streams into
+    put_object, tier restores, and the select engine's TextIOWrapper)."""
+
+    closed = False
+
+    def __init__(self, it: Iterator[bytes]):
+        self._it = iter(it)
+        self._buf = bytearray()
+
+    def readable(self) -> bool:
+        return True
+
+    def writable(self) -> bool:
+        return False
+
+    def seekable(self) -> bool:
+        return False
+
+    def flush(self) -> None:
+        pass
+
+    def read1(self, n: int = -1) -> bytes:
+        return self.read(n)
+
+    def readinto(self, b) -> int:
+        data = self.read(len(b))
+        b[:len(data)] = data
+        return len(data)
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            for c in self._it:
+                self._buf += c
+            out = bytes(self._buf)
+            self._buf.clear()
+            return out
+        while len(self._buf) < n:
+            try:
+                self._buf += next(self._it)
+            except StopIteration:
+                break
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out

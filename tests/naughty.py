@@ -1,0 +1,50 @@
+"""naughty-disk — programmable fault-injection StorageAPI decorator.
+
+Role-equivalent of cmd/naughty-disk_test.go: wraps a real drive and returns
+programmed errors at chosen call indices or for chosen methods, so failure
+tests exercise per-call error handling (timeouts, partial writes, flaky
+drives) instead of only wrecking files on disk."""
+
+from __future__ import annotations
+
+import threading
+
+
+class NaughtyDisk:
+    def __init__(self, inner, per_call: dict[int, Exception] | None = None,
+                 per_method: dict[str, Exception] | None = None,
+                 default: Exception | None = None):
+        """per_call: {global call index (1-based): error to raise};
+        per_method: {method name: error} (every call of that method fails);
+        default: raised for any call index not in per_call (when set)."""
+        self.inner = inner
+        self.per_call = per_call or {}
+        self.per_method = per_method or {}
+        self.default = default
+        self.calls = 0
+        self._mu = threading.Lock()
+
+    def _maybe_fail(self, name: str) -> None:
+        with self._mu:
+            self.calls += 1
+            n = self.calls
+        if name in self.per_method:
+            raise self.per_method[name]
+        if n in self.per_call:
+            raise self.per_call[n]
+        if self.default is not None and self.per_call:
+            # default fires only when a per_call program exists and the
+            # index is past it (mirrors naughty-disk's defaultErr)
+            if n > max(self.per_call):
+                raise self.default
+
+    def __getattr__(self, name: str):
+        fn = getattr(self.inner, name)
+        if not callable(fn) or name.startswith("_"):
+            return fn
+
+        def wrapped(*a, **kw):
+            self._maybe_fail(name)
+            return fn(*a, **kw)
+
+        return wrapped

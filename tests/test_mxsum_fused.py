@@ -227,3 +227,40 @@ def test_verify_digests_entry():
     digs = np.asarray(fused.verify_digests(jnp.asarray(chunks), lens))
     for i in range(5):
         assert bytes(digs[i]) == mxsum.digest_np(chunks[i].tobytes())
+
+
+def test_whole_file_bitrot_roundtrip():
+    """Legacy whole-file bitrot (cmd/bitrot-whole.go): single metadata
+    digest, verify-on-first-read."""
+    import io as _io
+
+    payload = rng.integers(0, 256, 5000, dtype=np.uint8).tobytes()
+    buf = _io.BytesIO()
+    w = bitrot.WholeBitrotWriter(buf, algorithm="blake2b256")
+    for off in range(0, 5000, 1024):
+        w.write(payload[off:off + 1024])
+    digest = w.digest()
+    r = bitrot.WholeBitrotReader(_io.BytesIO(buf.getvalue()), digest,
+                                 algorithm="blake2b256")
+    assert r.read_at(0, 5000) == payload
+    assert r.read_at(1234, 100) == payload[1234:1334]
+    raw = bytearray(buf.getvalue())
+    raw[99] ^= 1
+    r2 = bitrot.WholeBitrotReader(_io.BytesIO(bytes(raw)), digest,
+                                  algorithm="blake2b256")
+    from minio_tpu.utils import errors as se
+    with pytest.raises(se.FileCorrupt):
+        r2.read_at(0, 10)
+
+
+def test_array_pool_recycles():
+    from minio_tpu.utils.bufpool import ArrayPool
+
+    pool = ArrayPool(max_per_shape=2)
+    a = pool.get((4, 100), zero=True)
+    a[1, 5] = 7
+    pool.put(a)
+    b = pool.get((4, 100), zero=True)
+    assert b is a and b[1, 5] == 0  # recycled and re-zeroed
+    c = pool.get((4, 100))
+    assert c is not a
