@@ -124,15 +124,39 @@ def digest_batch_np(chunks: np.ndarray, lengths) -> np.ndarray:
 # --- device path -------------------------------------------------------------
 
 
+def len_term_device(lengths):
+    """Device length-key contribution: lengths [B] (< 2^32) -> [B, 8] i32.
+    Only the low 4 LE bytes are nonzero (no uint64 on device; the host's
+    key rows 4-7 multiply zeros), so L[:4] suffices."""
+    import jax
+    import jax.numpy as jnp
+
+    lengths = lengths.astype(jnp.uint32)
+    shifts = jnp.arange(4, dtype=jnp.uint32) * 8
+    lrows = ((lengths[:, None] >> shifts) & jnp.uint32(0xFF)).astype(jnp.int8)
+    return jax.lax.dot_general(
+        lrows, jnp.asarray(_len_key()[:4]),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+
+def pack_words_device(acc):
+    """Device digest framing: acc [B, 8] i32 -> [B, 32] u8 (LE words)."""
+    import jax.numpy as jnp
+
+    w = acc.astype(jnp.uint32)
+    bshift = jnp.arange(4, dtype=jnp.uint32) * 8
+    by = (w[:, :, None] >> bshift) & jnp.uint32(0xFF)          # [B, 8, 4]
+    return by.reshape(w.shape[0], DIGEST_LEN).astype(jnp.uint8)
+
+
 def digest_device(chunks, lengths):
     """Device batched digest: chunks [B, S] u8 (zero-padded beyond each
     row's length), lengths [B] int32/uint32 (< 2^32). Returns [B, 32] u8.
 
     jnp-traceable — call inside jit (the fused codec launches). One int8
     MXU contraction + a tiny length term; int32 accumulation wraps mod 2^32
-    exactly like the host's int64-then-mask path. No uint64 anywhere (JAX
-    x64 stays off); lengths are chunk lengths, always < 2^32, so only the
-    low 4 LE bytes are nonzero and the host's rows 4-7 contribute zero.
+    exactly like the host's int64-then-mask path.
     """
     import jax
     import jax.numpy as jnp
@@ -145,19 +169,7 @@ def digest_device(chunks, lengths):
             chunks.astype(jnp.int8), k,
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.int32)                  # [B, 8]
-    lengths = lengths.astype(jnp.uint32)
-    shifts = jnp.arange(4, dtype=jnp.uint32) * 8
-    lrows = ((lengths[:, None] >> shifts) & jnp.uint32(0xFF)).astype(jnp.int8)
-    lterm = jax.lax.dot_general(
-        lrows, jnp.asarray(_len_key()[:4]),
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32)
-    acc = acc + lterm
-    # int32 words -> LE bytes
-    w = acc.astype(jnp.uint32)
-    bshift = jnp.arange(4, dtype=jnp.uint32) * 8
-    by = (w[:, :, None] >> bshift) & jnp.uint32(0xFF)          # [B, 8, 4]
-    return by.reshape(b, DIGEST_LEN).astype(jnp.uint8)
+    return pack_words_device(acc + len_term_device(lengths))
 
 
 class MXSum256:

@@ -21,5 +21,7 @@ from minio_tpu.parallel.sharded import (  # noqa: F401
     ring_reconstruct,
     sharded_encode,
     sharded_encode_with_bitrot,
+    sharded_encode_with_mxsum,
+    sharded_mxsum_digests,
     sharded_reconstruct,
 )

@@ -250,3 +250,57 @@ def sharded_encode_with_bitrot(
     shards = jnp.concatenate([data, parity], axis=1)
     digests = mxhash.mxhash256(shards.reshape(b * (k + m), s), s)
     return parity, digests.reshape(b, k + m, mxhash.DIGEST_LEN)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def _sharded_mxsum(chunks: jax.Array, key: jax.Array, lens: jax.Array,
+                   *, mesh: Mesh) -> jax.Array:
+    from minio_tpu.ops import mxsum
+
+    def step(x_local, k_local, lens_local):
+        acc = jax.lax.dot_general(
+            x_local.astype(jnp.int8), k_local,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)               # [n/dp, 8]
+        acc = jax.lax.psum(acc, "sp")
+        return mxsum.pack_words_device(
+            acc + mxsum.len_term_device(lens_local))
+
+    return jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P("dp", "sp"), P("sp", None), P("dp")),
+        out_specs=P("dp", None),
+    )(chunks, key, lens)
+
+
+def sharded_mxsum_digests(mesh: Mesh, chunks: jax.Array,
+                          lens: jax.Array) -> jax.Array:
+    """Sharded production bitrot digest (ops/mxsum): chunks [N, S] u8
+    (rows zero-padded past each length), lens [N] int32 -> [N, 32] u8.
+
+    The digest is a linear map over the S axis, so it shards the same way
+    the codec does: each device contracts its local S-slice against its
+    slice of the key stream, an integer psum over 'sp' completes the sum
+    (wrap-exact mod 2^32), and the tiny length term is added replicated.
+    N shards over dp. The key constant folds under jit, so repeated calls
+    at one shape neither re-transfer it nor re-trace.
+    """
+    from minio_tpu.ops import mxsum
+
+    _n, s = chunks.shape
+    key = jnp.asarray(mxsum._key_rows(s))                   # [S, 8] i8
+    return _sharded_mxsum(chunks, key, lens, mesh=mesh)
+
+
+def sharded_encode_with_mxsum(
+    mesh: Mesh, data: jax.Array, k: int, m: int
+) -> tuple[jax.Array, jax.Array]:
+    """The production fused launch, mesh-sharded: parity via the psum
+    contraction + mxsum256 digests of every shard via the sp-sharded
+    linear checksum — the multi-chip form of ops/fused.encode_with_digests."""
+    parity = sharded_encode(mesh, data, k, m)
+    b, _, s = data.shape
+    shards = jnp.concatenate([data, parity], axis=1)
+    lens = jnp.full((b * (k + m),), s, dtype=jnp.int32)
+    digests = sharded_mxsum_digests(mesh, shards.reshape(b * (k + m), s), lens)
+    return parity, digests.reshape(b, k + m, 32)

@@ -506,6 +506,13 @@ class S3Server:
                     "/", 1)[0]
                 return await self.admin.handle(
                     request, path[len(ADMIN_PREFIX):], identity)
+            if path in ("/minio/browser", "/minio/browser/"):
+                # Single-file object browser (role of the reference's React
+                # console, browser/app/js) — static page; auth happens
+                # in-page against /minio/webrpc.
+                request["api"] = "browser"
+                return web.Response(body=_browser_page(),
+                                    content_type="text/html")
             if path == "/minio/webrpc":
                 request["api"] = "webrpc"
                 return await self.web.rpc(request)
@@ -1885,6 +1892,19 @@ class _PrefixReader:
 
 # File-like over a bytes iterator — canonical home: utils/streams.py.
 from minio_tpu.utils.streams import IterReader as _IterReader  # noqa: E402
+
+_BROWSER_HTML: bytes | None = None
+
+
+def _browser_page() -> bytes:
+    """browser.html, read once (immutable bytes; no per-request disk IO)."""
+    global _BROWSER_HTML
+    if _BROWSER_HTML is None:
+        import importlib.resources as _res
+
+        _BROWSER_HTML = (_res.files("minio_tpu.s3")
+                         / "browser.html").read_bytes()
+    return _BROWSER_HTML
 
 
 def _validate_xml(body: bytes) -> None:

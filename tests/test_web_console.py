@@ -142,3 +142,13 @@ def test_web_iam_scoping(server):
     r = requests.put(f"{base}/minio/upload/webbkt2/x",
                      data=b"x", headers={"Authorization": f"Bearer {token}"})
     assert r.status_code == 403
+
+
+def test_browser_page_served(server):
+    import requests
+
+    base, _srv = server
+    r = requests.get(base + "/minio/browser")
+    assert r.status_code == 200
+    assert "text/html" in r.headers["Content-Type"]
+    assert "minio-tpu console" in r.text and "webrpc" in r.text
