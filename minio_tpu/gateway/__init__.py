@@ -4,15 +4,24 @@ Role-equivalent of cmd/gateway/ + cmd/gateway-main.go:155 StartGateway:
 each gateway implements the ObjectLayer seam, so the full middleware
 chain (auth, IAM, policies, eventing) applies unchanged.
 
-  nas  - shared-filesystem gateway: FSObjects over a mount path
-         (cmd/gateway/nas — 122 LoC in the reference, because it IS the
-         FS backend on a path; same here)
-  s3   - proxy gateway to any remote S3 endpoint (cmd/gateway/s3)
+  nas   - shared-filesystem gateway: FSObjects over a mount path
+          (cmd/gateway/nas — 122 LoC in the reference, because it IS the
+          FS backend on a path; same here)
+  s3    - proxy gateway to any remote S3 endpoint (cmd/gateway/s3)
+  gcs   - Google Cloud Storage via its XML/interop API — GCS accepts
+          AWS-style HMAC signing on storage.googleapis.com, so the S3
+          dialect client serves it (cmd/gateway/gcs uses the JSON SDK;
+          the wire capability is the same surface)
+  azure - Azure Blob REST with SharedKey auth (cmd/gateway/azure)
+  hdfs  - WebHDFS REST (cmd/gateway/hdfs uses libhdfs; same namenode ops)
 
-Azure/GCS/HDFS gateways need their cloud SDKs (not in this image); the
-ObjectLayer protocol is the plug point.
+No cloud SDKs in this image — azure/hdfs speak their REST dialects
+directly (gateway/azure.py, gateway/hdfs.py over gateway/base.py).
 """
 
+from minio_tpu.gateway.azure import AzureGateway
+from minio_tpu.gateway.base import FlatGateway
+from minio_tpu.gateway.hdfs import HDFSGateway
 from minio_tpu.gateway.s3 import S3Gateway
 
 
@@ -23,4 +32,11 @@ def nas_gateway(path: str):
     return FSObjects(path)
 
 
-__all__ = ["S3Gateway", "nas_gateway"]
+def gcs_gateway(access_key: str, secret_key: str,
+                endpoint: str = "https://storage.googleapis.com"):
+    """GCS via the XML interop API (HMAC keys)."""
+    return S3Gateway(endpoint, access_key, secret_key)
+
+
+__all__ = ["AzureGateway", "FlatGateway", "HDFSGateway", "S3Gateway",
+           "gcs_gateway", "nas_gateway"]

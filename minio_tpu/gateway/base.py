@@ -1,0 +1,300 @@
+"""FlatGateway — shared ObjectLayer scaffolding for flat-namespace backends.
+
+The reference implements each gateway (Azure 1456 LoC, GCS 1506, HDFS 957,
+NAS 122, S3 1807 — cmd/gateway/) as a full ObjectLayer. Here every backend
+reduces to seven storage primitives; the common ObjectLayer behavior —
+tags-as-metadata, locally-assembled multipart (pushed as one put),
+flat version listing, heal/health stubs — lives once in this base.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import tempfile
+import time
+import uuid
+from typing import BinaryIO, Iterator
+
+from minio_tpu.erasure.healing import HealResultItem
+from minio_tpu.erasure.types import (
+    BucketInfo,
+    CompletePart,
+    DeletedObject,
+    ListObjectsInfo,
+    ListObjectVersionsInfo,
+    MultipartInfo,
+    ObjectInfo,
+    ObjectOptions,
+    ObjectToDelete,
+    PartInfoResult,
+)
+from minio_tpu.utils import errors as se
+
+TAG_META = "x-amz-meta-mtpu-tagging"
+
+
+class FlatGateway:
+    """Subclass contract (all raise StorageError subclasses on failure):
+
+      _gw_make_bucket(b) / _gw_delete_bucket(b) / _gw_bucket_exists(b)
+      _gw_list_buckets() -> [(name, created_ts)]
+      _gw_put(b, key, body: bytes, meta: dict, content_type: str)
+      _gw_head(b, key) -> (size, etag, mtime, meta, content_type) | None
+      _gw_get_range(b, key, offset, length) -> bytes
+      _gw_delete(b, key)
+      _gw_list(b, prefix, marker, delimiter, max_keys)
+          -> ([(key, size, etag, mtime)], [prefixes], truncated, next_marker)
+    """
+
+    def __init__(self):
+        self._mp: dict[str, dict] = {}
+        self._mp_dir = tempfile.mkdtemp(prefix="mtpu-gw-mp-")
+
+    def close(self) -> None:
+        shutil.rmtree(self._mp_dir, ignore_errors=True)
+
+    # -- buckets --
+
+    def make_bucket(self, bucket: str,
+                    opts: ObjectOptions | None = None) -> None:
+        self._gw_make_bucket(bucket)
+
+    def get_bucket_info(self, bucket: str) -> BucketInfo:
+        if not self._gw_bucket_exists(bucket):
+            raise se.BucketNotFound(bucket)
+        return BucketInfo(bucket, 0.0)
+
+    def list_buckets(self) -> list[BucketInfo]:
+        return [BucketInfo(n, t) for n, t in self._gw_list_buckets()]
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        self._gw_delete_bucket(bucket)
+
+    # -- objects --
+
+    def put_object(self, bucket: str, obj: str, data: BinaryIO,
+                   size: int = -1,
+                   opts: ObjectOptions | None = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        body = data.read(size) if size >= 0 else data.read(-1)
+        if size >= 0 and len(body) != size:
+            raise se.IncompleteBody(bucket, obj, f"got {len(body)} of {size}")
+        meta = {k: v for k, v in opts.user_defined.items()
+                if k.startswith("x-amz-meta-")}
+        if "x-amz-tagging" in opts.user_defined:
+            meta[TAG_META] = opts.user_defined["x-amz-tagging"]
+        ct = opts.user_defined.get("content-type", "")
+        self._gw_put(bucket, obj, body, meta, ct)
+        return ObjectInfo(bucket=bucket, name=obj, size=len(body),
+                          etag=hashlib.md5(body).hexdigest(),
+                          mod_time=time.time(),
+                          user_defined=dict(opts.user_defined))
+
+    def get_object_info(self, bucket: str, obj: str,
+                        opts: ObjectOptions | None = None) -> ObjectInfo:
+        head = self._gw_head(bucket, obj)
+        if head is None:
+            if not self._gw_bucket_exists(bucket):
+                raise se.BucketNotFound(bucket)
+            raise se.ObjectNotFound(bucket, obj)
+        size, etag, mtime, meta, ct = head
+        ud = dict(meta)
+        if ct:
+            ud["content-type"] = ct
+        return ObjectInfo(bucket=bucket, name=obj, size=size, etag=etag,
+                          mod_time=mtime, content_type=ct, user_defined=ud)
+
+    def get_object(self, bucket: str, obj: str, offset: int = 0,
+                   length: int = -1, opts: ObjectOptions | None = None
+                   ) -> tuple[ObjectInfo, Iterator[bytes]]:
+        info = self.get_object_info(bucket, obj, opts)
+        if length < 0:
+            length = info.size - offset
+        if offset < 0 or length < 0 or offset + length > info.size:
+            raise se.InvalidRange(bucket, obj)
+        if length == 0:
+            return info, iter(())
+        return info, iter([self._gw_get_range(bucket, obj, offset, length)])
+
+    def delete_object(self, bucket: str, obj: str,
+                      opts: ObjectOptions | None = None) -> ObjectInfo:
+        self.get_object_info(bucket, obj, opts)  # 404 semantics
+        self._gw_delete(bucket, obj)
+        return ObjectInfo(bucket=bucket, name=obj)
+
+    def delete_objects(self, bucket: str, objects: list[ObjectToDelete],
+                       opts: ObjectOptions | None = None
+                       ) -> list[DeletedObject | Exception]:
+        out: list[DeletedObject | Exception] = []
+        for o in objects:
+            try:
+                self.delete_object(bucket, o.object_name, opts)
+                out.append(DeletedObject(object_name=o.object_name))
+            except Exception as e:  # noqa: BLE001
+                out.append(e)
+        return out
+
+    # -- metadata / tags (re-put; gateway namespaces are flat) --
+
+    def put_object_metadata(self, bucket: str, obj: str, updates,
+                            opts: ObjectOptions | None = None) -> ObjectInfo:
+        info, stream = self.get_object(bucket, obj, opts=opts)
+        body = b"".join(stream)
+        ud = dict(info.user_defined)
+        for k, v in updates.items():
+            if v is None:
+                ud.pop(k, None)
+            else:
+                ud[k] = v
+        meta = {k: v for k, v in ud.items() if k.startswith("x-amz-meta-")}
+        if "x-amz-tagging" in ud:
+            meta[TAG_META] = ud["x-amz-tagging"]
+        self._gw_put(bucket, obj, body, meta, ud.get("content-type", ""))
+        info.user_defined = ud
+        return info
+
+    def put_object_tags(self, bucket: str, obj: str, tags: str,
+                        opts: ObjectOptions | None = None) -> ObjectInfo:
+        return self.put_object_metadata(
+            bucket, obj, {"x-amz-tagging": tags or None}, opts)
+
+    def get_object_tags(self, bucket: str, obj: str,
+                        opts: ObjectOptions | None = None) -> str:
+        info = self.get_object_info(bucket, obj, opts)
+        return info.user_defined.get(
+            TAG_META, info.user_defined.get("x-amz-tagging", ""))
+
+    def delete_object_tags(self, bucket: str, obj: str,
+                           opts: ObjectOptions | None = None) -> ObjectInfo:
+        return self.put_object_tags(bucket, obj, "", opts)
+
+    # -- listing --
+
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000
+                     ) -> ListObjectsInfo:
+        entries, prefixes, truncated, nxt = self._gw_list(
+            bucket, prefix, marker, delimiter, max_keys)
+        res = ListObjectsInfo(is_truncated=truncated, next_marker=nxt,
+                              prefixes=prefixes)
+        for key, size, etag, mtime in entries:
+            res.objects.append(ObjectInfo(bucket=bucket, name=key, size=size,
+                                          etag=etag, mod_time=mtime))
+        return res
+
+    def list_object_versions(self, bucket: str, prefix: str = "",
+                             marker: str = "", version_marker: str = "",
+                             delimiter: str = "", max_keys: int = 1000
+                             ) -> ListObjectVersionsInfo:
+        flat = self.list_objects(bucket, prefix, marker, delimiter, max_keys)
+        return ListObjectVersionsInfo(
+            is_truncated=flat.is_truncated, next_marker=flat.next_marker,
+            objects=flat.objects, prefixes=flat.prefixes)
+
+    # -- multipart: assembled locally, pushed as one put --
+
+    def new_multipart_upload(self, bucket: str, obj: str,
+                             opts: ObjectOptions | None = None) -> str:
+        self.get_bucket_info(bucket)
+        uid = uuid.uuid4().hex
+        self._mp[uid] = {"bucket": bucket, "object": obj,
+                         "initiated": time.time(),
+                         "user_defined": dict(
+                             (opts or ObjectOptions()).user_defined),
+                         "parts": {}}
+        os.makedirs(os.path.join(self._mp_dir, uid), exist_ok=True)
+        return uid
+
+    def _session(self, bucket, obj, uid) -> dict:
+        s = self._mp.get(uid)
+        if s is None or s["bucket"] != bucket or s["object"] != obj:
+            raise se.InvalidUploadID(bucket, obj, uid)
+        return s
+
+    def get_multipart_info(self, bucket: str, obj: str,
+                           upload_id: str) -> MultipartInfo:
+        s = self._session(bucket, obj, upload_id)
+        return MultipartInfo(bucket, obj, upload_id, s["initiated"],
+                             s["user_defined"])
+
+    def put_object_part(self, bucket: str, obj: str, upload_id: str,
+                        part_number: int, data: BinaryIO, size: int = -1,
+                        opts: ObjectOptions | None = None) -> PartInfoResult:
+        s = self._session(bucket, obj, upload_id)
+        body = data.read(size) if size >= 0 else data.read(-1)
+        path = os.path.join(self._mp_dir, upload_id, str(part_number))
+        with open(path, "wb") as f:
+            f.write(body)
+        etag = hashlib.md5(body).hexdigest()
+        s["parts"][part_number] = (etag, len(body), time.time())
+        return PartInfoResult(part_number, etag, len(body), time.time())
+
+    def list_parts(self, bucket: str, obj: str, upload_id: str,
+                   part_marker: int = 0, max_parts: int = 1000):
+        s = self._session(bucket, obj, upload_id)
+        return [PartInfoResult(n, e, sz, t)
+                for n, (e, sz, t) in sorted(s["parts"].items())
+                if n > part_marker][:max_parts]
+
+    def list_multipart_uploads(self, bucket: str, prefix: str = "",
+                               max_uploads: int = 1000
+                               ) -> list[MultipartInfo]:
+        return [MultipartInfo(s["bucket"], s["object"], uid, s["initiated"],
+                              s["user_defined"])
+                for uid, s in sorted(self._mp.items(),
+                                     key=lambda kv: kv[1]["initiated"])
+                if s["bucket"] == bucket and s["object"].startswith(prefix)
+                ][:max_uploads]
+
+    def abort_multipart_upload(self, bucket: str, obj: str,
+                               upload_id: str) -> None:
+        self._session(bucket, obj, upload_id)
+        self._mp.pop(upload_id, None)
+        shutil.rmtree(os.path.join(self._mp_dir, upload_id),
+                      ignore_errors=True)
+
+    def complete_multipart_upload(self, bucket: str, obj: str,
+                                  upload_id: str, parts: list[CompletePart],
+                                  opts: ObjectOptions | None = None
+                                  ) -> ObjectInfo:
+        s = self._session(bucket, obj, upload_id)
+        body = bytearray()
+        for p in parts:
+            if p.part_number not in s["parts"]:
+                raise se.InvalidPart(bucket, obj, f"part {p.part_number}")
+            stored_etag = s["parts"][p.part_number][0]
+            if p.etag.strip('"') != stored_etag:
+                raise se.InvalidPart(bucket, obj,
+                                     f"part {p.part_number} etag mismatch")
+            with open(os.path.join(self._mp_dir, upload_id,
+                                   str(p.part_number)), "rb") as f:
+                body += f.read()
+        info = self.put_object(
+            bucket, obj, __import__("io").BytesIO(bytes(body)), len(body),
+            ObjectOptions(user_defined=s["user_defined"]))
+        self.abort_multipart_upload(bucket, obj, upload_id)
+        return info
+
+    # -- heal / health (remote backend owns durability) --
+
+    def heal_bucket(self, bucket: str, dry_run: bool = False) -> HealResultItem:
+        return HealResultItem(bucket=bucket, dry_run=dry_run)
+
+    def heal_object(self, bucket: str, obj: str, version_id: str = "",
+                    **kw) -> HealResultItem:
+        return HealResultItem(bucket=bucket, object=obj)
+
+    def heal_objects(self, bucket: str, prefix: str = "", **kw):
+        return iter(())
+
+    def health(self) -> dict:
+        try:
+            self._gw_list_buckets()
+            return {"healthy": True, "sets": []}
+        except Exception:  # noqa: BLE001
+            return {"healthy": False, "sets": []}
+
+    def all_drives(self):
+        return []

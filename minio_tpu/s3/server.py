@@ -2070,16 +2070,35 @@ def build_gateway_server(kind: str, target: str, access_key: str,
                          remote_access: str = "", remote_secret: str = ""
                          ) -> S3Server:
     """Gateway modes (reference StartGateway, cmd/gateway-main.go:155):
-    nas <path> | s3 <endpoint>."""
-    from minio_tpu.gateway import S3Gateway, nas_gateway
+    nas <path> | s3 <endpoint> | gcs [<endpoint>] | azure <endpoint>
+    | hdfs <namenode endpoint>. Remote credentials come from
+    MTPU_GATEWAY_ACCESS_KEY/SECRET_KEY (azure: account/base64 key;
+    hdfs: access=user)."""
+    from minio_tpu.gateway import (
+        AzureGateway,
+        HDFSGateway,
+        S3Gateway,
+        gcs_gateway,
+        nas_gateway,
+    )
 
     if kind == "nas":
         layer = nas_gateway(target)
     elif kind == "s3":
         layer = S3Gateway(target, remote_access or access_key,
                           remote_secret or secret_key)
+    elif kind == "gcs":
+        layer = gcs_gateway(remote_access or access_key,
+                            remote_secret or secret_key,
+                            endpoint=target or
+                            "https://storage.googleapis.com")
+    elif kind == "azure":
+        layer = AzureGateway(target, remote_access or access_key,
+                             remote_secret or secret_key)
+    elif kind == "hdfs":
+        layer = HDFSGateway(target, user=remote_access or "minio")
     else:
-        raise ValueError(f"unknown gateway {kind!r} (nas|s3)")
+        raise ValueError(f"unknown gateway {kind!r} (nas|s3|gcs|azure|hdfs)")
     return S3Server(layer, sigv4.Credentials(access_key, secret_key))
 
 

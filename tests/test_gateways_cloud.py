@@ -1,0 +1,369 @@
+"""Azure Blob + WebHDFS gateways against in-process fake services
+(reference cmd/gateway/{azure,hdfs}; SURVEY §2.6)."""
+
+import io
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from minio_tpu.erasure.types import CompletePart, ObjectToDelete
+from minio_tpu.gateway import AzureGateway, HDFSGateway
+from minio_tpu.utils import errors as se
+
+
+# ---------------- fake Azure Blob service ----------------
+
+
+class FakeAzure(BaseHTTPRequestHandler):
+    containers: dict  # {name: {blob: (body, meta, content_type)}}
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _respond(self, status, body=b"", headers=None):
+        self.send_response(status)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(body)
+
+    def _auth_ok(self):
+        return self.headers.get("Authorization", "").startswith("SharedKey ")
+
+    def do_PUT(self):
+        if not self._auth_ok():
+            return self._respond(403)
+        u = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query))
+        parts = u.path.lstrip("/").split("/", 1)
+        c = self.containers
+        if q.get("restype") == "container":
+            if parts[0] in c:
+                return self._respond(409)
+            c[parts[0]] = {}
+            return self._respond(201)
+        if parts[0] not in c:
+            return self._respond(404)
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        meta = {k.lower()[len("x-ms-meta-"):]: v for k, v in
+                self.headers.items() if k.lower().startswith("x-ms-meta-")}
+        c[parts[0]][urllib.parse.unquote(parts[1])] = (
+            body, meta, self.headers.get("Content-Type", ""))
+        return self._respond(201, headers={"ETag": f'"{len(body)}-etag"'})
+
+    def do_DELETE(self):
+        u = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query))
+        parts = u.path.lstrip("/").split("/", 1)
+        if q.get("restype") == "container":
+            if parts[0] not in self.containers:
+                return self._respond(404)
+            del self.containers[parts[0]]
+            return self._respond(202)
+        blobs = self.containers.get(parts[0], {})
+        key = urllib.parse.unquote(parts[1])
+        if key not in blobs:
+            return self._respond(404)
+        del blobs[key]
+        return self._respond(202)
+
+    def do_HEAD(self):
+        u = urllib.parse.urlsplit(self.path)
+        parts = u.path.lstrip("/").split("/", 1)
+        blobs = self.containers.get(parts[0], {})
+        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+        if key not in blobs:
+            return self._respond(404)
+        body, meta, ct = blobs[key]
+        h = {"ETag": f'"{len(body)}-etag"',
+             "Last-Modified": "Tue, 01 Jul 2026 00:00:00 GMT",
+             "Content-Type": ct or "application/octet-stream"}
+        for k, v in meta.items():
+            h[f"x-ms-meta-{k}"] = v
+        self.send_response(200)
+        for k, v in h.items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+
+    def do_GET(self):
+        u = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query))
+        parts = [p for p in u.path.lstrip("/").split("/", 1) if p]
+        if not parts and q.get("comp") == "list":   # list containers
+            items = "".join(
+                f"<Container><Name>{n}</Name><Properties>"
+                f"<Last-Modified>Tue, 01 Jul 2026 00:00:00 GMT"
+                f"</Last-Modified></Properties></Container>"
+                for n in sorted(self.containers))
+            xml = (f"<EnumerationResults><Containers>{items}"
+                   f"</Containers></EnumerationResults>").encode()
+            return self._respond(200, xml)
+        if len(parts) == 1 and q.get("comp") == "list":  # list blobs
+            if parts[0] not in self.containers:
+                return self._respond(404)
+            blobs = self.containers[parts[0]]
+            prefix = q.get("prefix", "")
+            delim = q.get("delimiter", "")
+            items, prefixes, seen = [], [], set()
+            for name in sorted(blobs):
+                if not name.startswith(prefix):
+                    continue
+                if delim:
+                    rest = name[len(prefix):]
+                    d = rest.find(delim)
+                    if d >= 0:
+                        cp = prefix + rest[:d + len(delim)]
+                        if cp not in seen:
+                            seen.add(cp)
+                            prefixes.append(cp)
+                        continue
+                body, _m, _ct = blobs[name]
+                items.append(
+                    f"<Blob><Name>{name}</Name><Properties>"
+                    f"<Content-Length>{len(body)}</Content-Length>"
+                    f"<Etag>{len(body)}-etag</Etag>"
+                    f"<Last-Modified>Tue, 01 Jul 2026 00:00:00 GMT"
+                    f"</Last-Modified></Properties></Blob>")
+            pfx = "".join(f"<BlobPrefix><Name>{p}</Name></BlobPrefix>"
+                          for p in prefixes)
+            xml = (f"<EnumerationResults><Blobs>{''.join(items)}{pfx}"
+                   f"</Blobs><NextMarker/></EnumerationResults>").encode()
+            return self._respond(200, xml)
+        # get blob
+        blobs = self.containers.get(parts[0], {})
+        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+        if key not in blobs:
+            return self._respond(404)
+        body, _m, ct = blobs[key]
+        rng = self.headers.get("Range", "")
+        if rng.startswith("bytes="):
+            a, _, b = rng[6:].partition("-")
+            body = body[int(a): int(b) + 1]
+            return self._respond(206, body)
+        return self._respond(200, body)
+
+
+@pytest.fixture()
+def azure_gw():
+    class H(FakeAzure):
+        containers = {}
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    gw = AzureGateway(f"http://127.0.0.1:{httpd.server_address[1]}",
+                      "devaccount", "ZGV2LWtleS1mb3ItdGVzdHM=")
+    yield gw
+    gw.close()
+    httpd.shutdown()
+
+
+def test_azure_gateway_object_roundtrip(azure_gw):
+    gw = azure_gw
+    gw.make_bucket("container1")
+    assert [b.name for b in gw.list_buckets()] == ["container1"]
+    with pytest.raises(se.BucketExists):
+        gw.make_bucket("container1")
+
+    from minio_tpu.erasure.types import ObjectOptions
+
+    payload = b"azure-blob-payload" * 50
+    gw.put_object("container1", "docs/a.txt", io.BytesIO(payload),
+                  len(payload),
+                  ObjectOptions(user_defined={"x-amz-meta-owner": "alice",
+                                              "content-type": "text/plain"}))
+    info = gw.get_object_info("container1", "docs/a.txt")
+    assert info.size == len(payload)
+    assert info.user_defined.get("x-amz-meta-owner") == "alice"
+    _, it = gw.get_object("container1", "docs/a.txt")
+    assert b"".join(it) == payload
+    _, it = gw.get_object("container1", "docs/a.txt", offset=5, length=10)
+    assert b"".join(it) == payload[5:15]
+
+    gw.put_object("container1", "top.bin", io.BytesIO(b"x"), 1)
+    res = gw.list_objects("container1", delimiter="/")
+    assert [o.name for o in res.objects] == ["top.bin"]
+    assert res.prefixes == ["docs/"]
+
+    gw.delete_object("container1", "docs/a.txt")
+    with pytest.raises(se.ObjectNotFound):
+        gw.get_object_info("container1", "docs/a.txt")
+    gw.delete_object("container1", "top.bin")
+    gw.delete_bucket("container1")
+    assert gw.list_buckets() == []
+
+
+def test_azure_gateway_multipart(azure_gw):
+    gw = azure_gw
+    gw.make_bucket("mpc")
+    uid = gw.new_multipart_upload("mpc", "assembled")
+    e1 = gw.put_object_part("mpc", "assembled", uid, 1, io.BytesIO(b"a" * 100), 100)
+    e2 = gw.put_object_part("mpc", "assembled", uid, 2, io.BytesIO(b"b" * 50), 50)
+    gw.complete_multipart_upload("mpc", "assembled", uid, [
+        CompletePart(1, e1.etag), CompletePart(2, e2.etag)])
+    _, it = gw.get_object("mpc", "assembled")
+    assert b"".join(it) == b"a" * 100 + b"b" * 50
+
+
+# ---------------- fake WebHDFS namenode/datanode ----------------
+
+
+class FakeHDFS(BaseHTTPRequestHandler):
+    fs: dict          # path -> bytes (files); dirs implicit
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _respond(self, status, doc=None, raw=None, headers=None):
+        body = (json.dumps(doc).encode() if doc is not None
+                else (raw or b""))
+        self.send_response(status)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _parse(self):
+        u = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(u.query))
+        path = urllib.parse.unquote(u.path[len("/webhdfs/v1"):])
+        return path, q
+
+    def _status_doc(self, path):
+        fs = self.fs
+        if path in fs:
+            return {"type": "FILE", "length": len(fs[path]),
+                    "modificationTime": 1_750_000_000_000,
+                    "pathSuffix": path.rsplit("/", 1)[-1]}
+        if any(p.startswith(path.rstrip("/") + "/") for p in fs) or \
+                path in self.dirs:
+            return {"type": "DIRECTORY", "length": 0,
+                    "modificationTime": 1_750_000_000_000,
+                    "pathSuffix": path.rstrip("/").rsplit("/", 1)[-1]}
+        return None
+
+    def do_PUT(self):
+        path, q = self._parse()
+        op = q.get("op", "").upper()
+        if op == "MKDIRS":
+            self.dirs.add(path.rstrip("/") or "/")
+            return self._respond(200, {"boolean": True})
+        if op == "CREATE":
+            if "redirected" not in q:
+                loc = (f"http://127.0.0.1:{self.server.server_address[1]}"
+                       f"/webhdfs/v1{urllib.parse.quote(path)}?"
+                       f"op=CREATE&redirected=true")
+                return self._respond(307, raw=b"", headers={"Location": loc})
+            n = int(self.headers.get("Content-Length", 0))
+            self.fs[path] = self.rfile.read(n)
+            return self._respond(201, {})
+        return self._respond(400)
+
+    def do_GET(self):
+        path, q = self._parse()
+        op = q.get("op", "").upper()
+        if op == "GETFILESTATUS":
+            doc = self._status_doc(path)
+            if doc is None:
+                return self._respond(404, {"RemoteException": {}})
+            return self._respond(200, {"FileStatus": doc})
+        if op == "LISTSTATUS":
+            base = path.rstrip("/")
+            if self._status_doc(path) is None and base not in ("", "/"):
+                return self._respond(404, {"RemoteException": {}})
+            kids = {}
+            for p in list(self.fs) + [d for d in self.dirs]:
+                if not p.startswith(base + "/"):
+                    continue
+                rest = p[len(base) + 1:]
+                top = rest.split("/", 1)[0]
+                if not top:
+                    continue
+                full = f"{base}/{top}"
+                kids[top] = self._status_doc(full)
+            return self._respond(200, {"FileStatuses": {
+                "FileStatus": [kids[k] for k in sorted(kids)]}})
+        if op == "OPEN":
+            if path not in self.fs:
+                return self._respond(404, {"RemoteException": {}})
+            body = self.fs[path]
+            off = int(q.get("offset", "0"))
+            ln = int(q["length"]) if "length" in q else len(body) - off
+            return self._respond(200, raw=body[off:off + ln])
+        return self._respond(400)
+
+    def do_DELETE(self):
+        path, q = self._parse()
+        recursive = q.get("recursive") == "true"
+        if path in self.fs:
+            del self.fs[path]
+            return self._respond(200, {"boolean": True})
+        doc = self._status_doc(path)
+        if doc is None:
+            return self._respond(404, {"RemoteException": {}})
+        base = path.rstrip("/")
+        kids = [p for p in self.fs if p.startswith(base + "/")]
+        if kids and not recursive:
+            return self._respond(403, {"RemoteException": {}})
+        for p in kids:
+            del self.fs[p]
+        self.dirs.discard(base)
+        return self._respond(200, {"boolean": True})
+
+
+@pytest.fixture()
+def hdfs_gw():
+    class H(FakeHDFS):
+        fs = {}
+        dirs = set()
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    gw = HDFSGateway(f"http://127.0.0.1:{httpd.server_address[1]}",
+                     root="/minio")
+    yield gw
+    gw.close()
+    httpd.shutdown()
+
+
+def test_hdfs_gateway_object_roundtrip(hdfs_gw):
+    gw = hdfs_gw
+    gw.make_bucket("bktone")
+    assert "bktone" in [b.name for b in gw.list_buckets()]
+    payload = b"hdfs-payload" * 80
+    gw.put_object("bktone", "dir/file.bin", io.BytesIO(payload), len(payload))
+    info = gw.get_object_info("bktone", "dir/file.bin")
+    assert info.size == len(payload)
+    _, it = gw.get_object("bktone", "dir/file.bin")
+    assert b"".join(it) == payload
+    _, it = gw.get_object("bktone", "dir/file.bin", offset=7, length=20)
+    assert b"".join(it) == payload[7:27]
+    res = gw.list_objects("bktone", delimiter="/")
+    assert res.prefixes == ["dir/"]
+    res = gw.list_objects("bktone", prefix="dir/")
+    assert [o.name for o in res.objects] == ["dir/file.bin"]
+    gw.delete_object("bktone", "dir/file.bin")
+    with pytest.raises(se.ObjectNotFound):
+        gw.get_object_info("bktone", "dir/file.bin")
+
+
+def test_hdfs_gateway_bucket_semantics(hdfs_gw):
+    gw = hdfs_gw
+    gw.make_bucket("full")
+    with pytest.raises(se.BucketExists):
+        gw.make_bucket("full")
+    gw.put_object("full", "x", io.BytesIO(b"1"), 1)
+    with pytest.raises(se.BucketNotEmpty):
+        gw.delete_bucket("full")
+    gw.delete_object("full", "x")
+    with pytest.raises(se.BucketNotFound):
+        gw.get_bucket_info("absent")
