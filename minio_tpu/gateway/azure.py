@@ -162,8 +162,10 @@ class AzureGateway(FlatGateway):
     def _gw_put(self, bucket, key, body, meta, content_type) -> None:
         headers = {"x-ms-blob-type": "BlockBlob"}
         for k, v in meta.items():
-            headers[f"x-ms-meta-{k[len('x-amz-meta-'):]}" if
-                    k.startswith("x-amz-meta-") else f"x-ms-meta-{k}"] = v
+            name = k[len("x-amz-meta-"):] if k.startswith("x-amz-meta-") else k
+            # Azure meta names must be C#-identifier-like: '-' -> '_'
+            # (the reference's s3MetaToAzureProperties does the same).
+            headers[f"x-ms-meta-{name.replace('-', '_')}"] = v
         if content_type:
             headers["content-type"] = content_type
         st, _, resp = self.client.request(
@@ -180,7 +182,7 @@ class AzureGateway(FlatGateway):
             # 403/5xx must surface, not read as a 0-byte object.
             raise AzureError(st)
         h = {k.lower(): v for k, v in headers.items()}
-        meta = {f"x-amz-meta-{k[len('x-ms-meta-'):]}": v
+        meta = {f"x-amz-meta-{k[len('x-ms-meta-'):].replace('_', '-')}": v
                 for k, v in h.items() if k.startswith("x-ms-meta-")}
         return (int(h.get("content-length", "0")),
                 h.get("etag", "").strip('"'),
@@ -210,6 +212,7 @@ class AzureGateway(FlatGateway):
         entries, prefixes = [], []
         seen_prefix: set[str] = set()
         azure_marker = ""
+        last_key = marker  # resume position of the last emitted name
         while True:
             q = {"restype": "container", "comp": "list",
                  "maxresults": str(max(max_keys, 1000))}
@@ -224,31 +227,34 @@ class AzureGateway(FlatGateway):
                 raise se.BucketNotFound(bucket)
             self.client.check(st, body, ok=(200,))
             root = ET.fromstring(body)
+            # Merge blobs + common prefixes into one name-sorted stream —
+            # truncating mid-page must never skip a prefix that sorts
+            # before the last returned key.
+            page: list[tuple[str, tuple | None]] = []
             for b in root.iter("Blob"):
-                name = _txt(b, "Name")
-                if marker and name <= marker:
-                    continue
-                if len(entries) + len(prefixes) >= max_keys:
-                    return entries, prefixes, True, (
-                        entries[-1][0] if entries else prefixes[-1])
                 props = b.find("Properties")
-                entries.append((
-                    name,
+                page.append((_txt(b, "Name"), (
                     int(_txt(props, "Content-Length", "0"))
                     if props is not None else 0,
                     (_txt(props, "Etag") if props is not None else ""
                      ).strip('"'),
                     _ts(_txt(props, "Last-Modified"))
-                    if props is not None else 0.0))
+                    if props is not None else 0.0)))
             for p in root.iter("BlobPrefix"):
-                name = _txt(p, "Name")
-                if (marker and name <= marker) or name in seen_prefix:
+                page.append((_txt(p, "Name"), None))
+            for name, props in sorted(page):
+                if marker and name <= marker:
+                    continue
+                if props is None and name in seen_prefix:
                     continue
                 if len(entries) + len(prefixes) >= max_keys:
-                    return entries, prefixes, True, (
-                        entries[-1][0] if entries else prefixes[-1])
-                seen_prefix.add(name)
-                prefixes.append(name)
+                    return entries, prefixes, True, last_key
+                if props is None:
+                    seen_prefix.add(name)
+                    prefixes.append(name)
+                else:
+                    entries.append((name, *props))
+                last_key = name
             azure_marker = _txt(root, "NextMarker")
             if not azure_marker:
                 return entries, prefixes, False, ""

@@ -33,6 +33,40 @@ from minio_tpu.erasure.types import (
 from minio_tpu.utils import errors as se
 
 TAG_META = "x-amz-meta-mtpu-tagging"
+# Internal server metadata (SSE bookkeeping etc.) rides packed in ONE
+# reserved meta key — backends only guarantee x-amz-meta-* survival, and
+# dropping x-mtpu-internal-* would serve SSE ciphertext as plaintext.
+PACKED_META = "x-amz-meta-mtpuinternal"
+
+
+def pack_internal_meta(user_defined: dict) -> dict:
+    """x-amz-meta-* pass through; x-mtpu-internal-* + x-amz-tagging pack
+    into PACKED_META (base64 JSON)."""
+    import base64
+    import json
+
+    meta = {k: v for k, v in user_defined.items()
+            if k.startswith("x-amz-meta-") and k != PACKED_META}
+    internal = {k: v for k, v in user_defined.items()
+                if k.startswith("x-mtpu-internal-") or k == "x-amz-tagging"}
+    if internal:
+        meta[PACKED_META] = base64.b64encode(
+            json.dumps(internal, separators=(",", ":")).encode()).decode()
+    return meta
+
+
+def unpack_internal_meta(meta: dict) -> dict:
+    import base64
+    import json
+
+    out = dict(meta)
+    packed = out.pop(PACKED_META, "")
+    if packed:
+        try:
+            out.update(json.loads(base64.b64decode(packed)))
+        except (ValueError, TypeError):
+            pass
+    return out
 
 
 class FlatGateway:
@@ -81,10 +115,7 @@ class FlatGateway:
         body = data.read(size) if size >= 0 else data.read(-1)
         if size >= 0 and len(body) != size:
             raise se.IncompleteBody(bucket, obj, f"got {len(body)} of {size}")
-        meta = {k: v for k, v in opts.user_defined.items()
-                if k.startswith("x-amz-meta-")}
-        if "x-amz-tagging" in opts.user_defined:
-            meta[TAG_META] = opts.user_defined["x-amz-tagging"]
+        meta = pack_internal_meta(opts.user_defined)
         ct = opts.user_defined.get("content-type", "")
         self._gw_put(bucket, obj, body, meta, ct)
         return ObjectInfo(bucket=bucket, name=obj, size=len(body),
@@ -100,7 +131,7 @@ class FlatGateway:
                 raise se.BucketNotFound(bucket)
             raise se.ObjectNotFound(bucket, obj)
         size, etag, mtime, meta, ct = head
-        ud = dict(meta)
+        ud = unpack_internal_meta(meta)
         if ct:
             ud["content-type"] = ct
         return ObjectInfo(bucket=bucket, name=obj, size=size, etag=etag,
@@ -148,9 +179,7 @@ class FlatGateway:
                 ud.pop(k, None)
             else:
                 ud[k] = v
-        meta = {k: v for k, v in ud.items() if k.startswith("x-amz-meta-")}
-        if "x-amz-tagging" in ud:
-            meta[TAG_META] = ud["x-amz-tagging"]
+        meta = pack_internal_meta(ud)
         self._gw_put(bucket, obj, body, meta, ud.get("content-type", ""))
         info.user_defined = ud
         return info
@@ -228,13 +257,15 @@ class FlatGateway:
         with open(path, "wb") as f:
             f.write(body)
         etag = hashlib.md5(body).hexdigest()
-        s["parts"][part_number] = (etag, len(body), time.time())
-        return PartInfoResult(part_number, etag, len(body), time.time())
+        now = time.time()
+        s["parts"][part_number] = (etag, len(body), now)
+        return PartInfoResult(part_number, etag, len(body), len(body),
+                              last_modified=now)
 
     def list_parts(self, bucket: str, obj: str, upload_id: str,
                    part_marker: int = 0, max_parts: int = 1000):
         s = self._session(bucket, obj, upload_id)
-        return [PartInfoResult(n, e, sz, t)
+        return [PartInfoResult(n, e, sz, sz, last_modified=t)
                 for n, (e, sz, t) in sorted(s["parts"].items())
                 if n > part_marker][:max_parts]
 

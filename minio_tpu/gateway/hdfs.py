@@ -85,10 +85,23 @@ class WebHDFSClient:
         return doc["FileStatuses"]["FileStatus"]
 
     def create(self, path: str, body: bytes) -> None:
-        # Two-step: namenode answers 307 with the datanode location; the
-        # redirect-following _req handles both hops.
-        self.op("PUT", path, "CREATE", body=body, ok=(200, 201),
-                overwrite="true")
+        """Two-step CREATE per the WebHDFS protocol: a body-LESS PUT to the
+        namenode yields a 307 with the datanode location; the payload goes
+        only to the datanode (sending it twice would double every upload's
+        wire traffic)."""
+        url = self._url(path, "CREATE", overwrite="true")
+        st, headers, data = self._req("PUT", url, b"", follow=False)
+        if st in (301, 302, 307) and "location" in headers:
+            loc = urllib.parse.urlsplit(headers["location"])
+            st, headers, data = self._req(
+                "PUT", loc.path + ("?" + loc.query if loc.query else ""),
+                body, follow=False, host=loc.hostname, port=loc.port)
+        elif st in (200, 201):
+            # No redirect offered (single-node/test services): retry the
+            # same endpoint with the payload.
+            st, headers, data = self._req("PUT", url, body, follow=False)
+        if st not in (200, 201):
+            raise HDFSError(st, data.decode(errors="replace"))
 
     def read(self, path: str, offset: int = 0, length: int = -1) -> bytes:
         params = {"offset": str(offset)}
@@ -121,13 +134,15 @@ class HDFSGateway(FlatGateway):
         self.client.mkdirs(f"/{bucket}")
 
     def _gw_delete_bucket(self, bucket: str) -> None:
-        try:
-            kids = self.client.list_status(f"/{bucket}")
-        except FileNotFoundError:
-            raise se.BucketNotFound(bucket) from None
-        if kids:
+        if not self._gw_bucket_exists(bucket):
+            raise se.BucketNotFound(bucket)
+        # Emptiness means no OBJECTS: deleted objects leave empty parent
+        # dirs and the ._meta_ sidecar tree behind (HDFS keeps empty
+        # dirs), which must not make the bucket undeletable.
+        entries, _p, _t, _n = self._gw_list(bucket, "", "", "", 1)
+        if entries:
             raise se.BucketNotEmpty(bucket)
-        self.client.delete(f"/{bucket}", recursive=False)
+        self.client.delete(f"/{bucket}", recursive=True)
 
     def _gw_bucket_exists(self, bucket: str) -> bool:
         try:
@@ -209,6 +224,7 @@ class HDFSGateway(FlatGateway):
                 kids = self.client.list_status(f"/{bucket}" + dir_rel)
             except (FileNotFoundError, HDFSError):
                 return
+            kids = [k for k in kids if k]  # defensive: odd namenodes
             for k in sorted(kids, key=lambda x: x.get("pathSuffix", "")):
                 name = k.get("pathSuffix", "")
                 rel = f"{dir_rel}/{name}".lstrip("/")
@@ -225,7 +241,8 @@ class HDFSGateway(FlatGateway):
                 else:
                     entries.append((
                         rel, k.get("length", 0),
-                        f"hdfs-{k.get('modificationTime', 0)}",
+                        f"hdfs-{k.get('modificationTime', 0)}"
+                        f"-{k.get('length', 0)}",  # match _gw_head's etag
                         k.get("modificationTime", 0) / 1000.0))
 
         # Start at the deepest directory the prefix names.

@@ -106,8 +106,11 @@ class S3Gateway:
         if size >= 0 and len(body) != size:
             raise se.IncompleteBody(bucket, obj,
                                     f"got {len(body)} of {size}")
-        headers = {k: v for k, v in opts.user_defined.items()
-                   if k.startswith("x-amz-meta-") or k == "content-type"}
+        from minio_tpu.gateway.base import pack_internal_meta
+
+        headers = pack_internal_meta(opts.user_defined)
+        if "content-type" in opts.user_defined:
+            headers["content-type"] = opts.user_defined["content-type"]
         try:
             self.client.put_object(bucket, obj, body, headers)
         except RemoteS3Error as e:
@@ -124,8 +127,11 @@ class S3Gateway:
             if not self.client.bucket_exists(bucket):
                 raise se.BucketNotFound(bucket)
             raise se.ObjectNotFound(bucket, obj)
+        from minio_tpu.gateway.base import unpack_internal_meta
+
         h = {k.lower(): v for k, v in headers.items()}
-        ud = {k: v for k, v in h.items() if k.startswith("x-amz-meta-")}
+        ud = unpack_internal_meta(
+            {k: v for k, v in h.items() if k.startswith("x-amz-meta-")})
         if "content-type" in h:
             ud["content-type"] = h["content-type"]
         return ObjectInfo(
@@ -184,11 +190,11 @@ class S3Gateway:
                 ud.pop(k, None)
             else:
                 ud[k] = v
-        # Tags ride a dedicated meta key through the proxy.
-        headers = {k: v for k, v in ud.items()
-                   if k.startswith("x-amz-meta-") or k == "content-type"}
-        if "x-amz-tagging" in ud:
-            headers["x-amz-meta-mtpu-tagging"] = ud["x-amz-tagging"]
+        from minio_tpu.gateway.base import pack_internal_meta
+
+        headers = pack_internal_meta(ud)
+        if "content-type" in ud:
+            headers["content-type"] = ud["content-type"]
         try:
             self.client.put_object(bucket, obj, body, headers)
         except RemoteS3Error as e:

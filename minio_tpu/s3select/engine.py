@@ -72,18 +72,17 @@ class S3SelectRequest:
         out = _find(root, "OutputSerialization")
         if inp is None or out is None:
             raise SelectError("Input/OutputSerialization required")
-        if _find(inp, "Parquet") is not None:
-            raise SelectError("Parquet input needs an arrow reader — "
-                              "not available in this build")
+        in_parquet = _find(inp, "Parquet")
         in_csv = _find(inp, "CSV")
         in_json = _find(inp, "JSON")
-        if in_csv is None and in_json is None:
-            raise SelectError("input must be CSV or JSON")
+        if in_csv is None and in_json is None and in_parquet is None:
+            raise SelectError("input must be CSV, JSON or Parquet")
         out_csv = _find(out, "CSV")
         out_json = _find(out, "JSON")
         return cls(
             expression=expr,
-            input_format="CSV" if in_csv is not None else "JSON",
+            input_format=("PARQUET" if in_parquet is not None
+                          else "CSV" if in_csv is not None else "JSON"),
             output_format="JSON" if out_json is not None else "CSV",
             compression=_text(inp, "CompressionType", default="NONE"),
             csv_header=_text(in_csv, "FileHeaderInfo", default="USE")
@@ -127,13 +126,22 @@ def run_select(body_stream, request: S3SelectRequest
     query = parse(request.expression)
     ev = Evaluator(query)
 
-    raw = readers.decompress(body_stream, request.compression)
-    if request.input_format == "CSV":
-        rows = readers.csv_rows(
-            raw, header=request.csv_header, delimiter=request.csv_delimiter,
-            quote=request.csv_quote, comments=request.csv_comments)
+    if request.input_format == "PARQUET":
+        from minio_tpu.s3select.parquet import ParquetError, iter_parquet_records
+
+        try:
+            rows = iter(list(iter_parquet_records(body_stream)))
+        except ParquetError as e:
+            raise SelectError(f"parquet: {e}") from None
     else:
-        rows = readers.json_rows(raw, json_type=request.json_type)
+        raw = readers.decompress(body_stream, request.compression)
+        if request.input_format == "CSV":
+            rows = readers.csv_rows(
+                raw, header=request.csv_header,
+                delimiter=request.csv_delimiter,
+                quote=request.csv_quote, comments=request.csv_comments)
+        else:
+            rows = readers.json_rows(raw, json_type=request.json_type)
 
     scanned = 0
     returned = 0

@@ -1,0 +1,617 @@
+"""Pure-Python Parquet reader for S3 Select.
+
+Role-equivalent of pkg/s3select's Parquet input (the reference vendors a
+full parquet-go, ~22k LoC with codegen); this build implements the format
+directly from the Apache Parquet spec — no Arrow, no SDK:
+
+  - Thrift Compact Protocol decoding (the footer/page-header wire format)
+  - flat schemas: BOOLEAN, INT32, INT64, FLOAT, DOUBLE, BYTE_ARRAY
+    (+ UTF8/DECIMAL-free converted types treated as their physical type)
+  - encodings: PLAIN, PLAIN_DICTIONARY / RLE_DICTIONARY,
+    RLE/bit-packed hybrid definition levels (optional columns -> NULLs)
+  - data pages V1 and V2; codecs UNCOMPRESSED, SNAPPY (pure-Python
+    decompressor below), GZIP
+
+Rows come out as ordered dicts feeding the same SQL engine the CSV/JSON
+readers use. Validated against the reference's own public parquet test
+fixtures (pkg/s3select/testdata.parquet).
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+import zlib
+from typing import Iterator
+
+
+class ParquetError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# snappy (raw block format) — pure-Python decompressor
+# ---------------------------------------------------------------------------
+
+
+def snappy_decompress(data: bytes) -> bytes:
+    """Raw snappy block decompress (the framing-free format parquet uses)."""
+    pos = 0
+    # uncompressed length varint
+    shift = out_len = 0
+    while True:
+        if pos >= len(data):
+            raise ParquetError("snappy: truncated length")
+        b = data[pos]
+        pos += 1
+        out_len |= (b & 0x7F) << shift
+        shift += 7
+        if not b & 0x80:
+            break
+    out = bytearray()
+    while pos < len(data):
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            ln = tag >> 2
+            if ln >= 60:
+                nbytes = ln - 59
+                ln = int.from_bytes(data[pos:pos + nbytes], "little")
+                pos += nbytes
+            ln += 1
+            out += data[pos:pos + ln]
+            pos += ln
+            continue
+        if kind == 1:  # copy, 1-byte offset
+            ln = ((tag >> 2) & 0x7) + 4
+            off = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif kind == 2:  # copy, 2-byte offset
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(data[pos:pos + 2], "little")
+            pos += 2
+        else:  # copy, 4-byte offset
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4
+        if off == 0 or off > len(out):
+            raise ParquetError("snappy: bad copy offset")
+        for _ in range(ln):  # overlapping copies are the point — byte-wise
+            out.append(out[-off])
+    if len(out) != out_len:
+        raise ParquetError(f"snappy: length {len(out)} != {out_len}")
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# Thrift Compact Protocol
+# ---------------------------------------------------------------------------
+
+_CT_STOP, _CT_TRUE, _CT_FALSE = 0, 1, 2
+_CT_BYTE, _CT_I16, _CT_I32, _CT_I64 = 3, 4, 5, 6
+_CT_DOUBLE, _CT_BINARY, _CT_LIST, _CT_SET, _CT_MAP, _CT_STRUCT = 7, 8, 9, 10, 11, 12
+
+
+class _Thrift:
+    """Generic compact-protocol reader: structs decode to
+    {field_id: value} dicts; callers pick fields by id per parquet.thrift."""
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.b = buf
+        self.pos = pos
+
+    def _u8(self) -> int:
+        v = self.b[self.pos]
+        self.pos += 1
+        return v
+
+    def varint(self) -> int:
+        out = shift = 0
+        while True:
+            b = self._u8()
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+
+    def zigzag(self) -> int:
+        n = self.varint()
+        return (n >> 1) ^ -(n & 1)
+
+    def read_value(self, ctype: int):
+        if ctype in (_CT_TRUE, _CT_FALSE):
+            return ctype == _CT_TRUE
+        if ctype == _CT_BYTE:
+            return self.zigzag()
+        if ctype in (_CT_I16, _CT_I32, _CT_I64):
+            return self.zigzag()
+        if ctype == _CT_DOUBLE:
+            v = struct.unpack("<d", self.b[self.pos:self.pos + 8])[0]
+            self.pos += 8
+            return v
+        if ctype == _CT_BINARY:
+            n = self.varint()
+            v = self.b[self.pos:self.pos + n]
+            self.pos += n
+            return v
+        if ctype in (_CT_LIST, _CT_SET):
+            head = self._u8()
+            size = head >> 4
+            etype = head & 0x0F
+            if size == 15:
+                size = self.varint()
+            return [self.read_value(etype) for _ in range(size)]
+        if ctype == _CT_MAP:
+            size = self.varint()
+            if size == 0:
+                return {}
+            kv = self._u8()
+            kt, vt = kv >> 4, kv & 0x0F
+            return {self.read_value(kt): self.read_value(vt)
+                    for _ in range(size)}
+        if ctype == _CT_STRUCT:
+            return self.read_struct()
+        raise ParquetError(f"thrift: unknown compact type {ctype}")
+
+    def read_struct(self) -> dict:
+        out: dict[int, object] = {}
+        fid = 0
+        while True:
+            head = self._u8()
+            if head == _CT_STOP:
+                return out
+            delta = head >> 4
+            ctype = head & 0x0F
+            if delta:
+                fid += delta
+            else:
+                fid = self.zigzag()
+            # booleans carry their value in the type nibble
+            out[fid] = self.read_value(ctype)
+
+
+# ---------------------------------------------------------------------------
+# RLE / bit-packed hybrid (definition levels + dictionary indices)
+# ---------------------------------------------------------------------------
+
+
+def _rle_bp_hybrid(buf: bytes, pos: int, end: int, bit_width: int,
+                   count: int) -> list[int]:
+    out: list[int] = []
+    byte_width = (bit_width + 7) // 8
+    t = _Thrift(buf, pos)
+    while len(out) < count and t.pos < end:
+        header = t.varint()
+        if header & 1:  # bit-packed run: header>>1 groups of 8
+            n_groups = header >> 1
+            n_vals = n_groups * 8
+            raw = buf[t.pos:t.pos + n_groups * bit_width]
+            t.pos += n_groups * bit_width
+            acc = int.from_bytes(raw, "little")
+            mask = (1 << bit_width) - 1
+            for i in range(n_vals):
+                if len(out) >= count:
+                    break
+                out.append((acc >> (i * bit_width)) & mask)
+        else:  # RLE run
+            n = header >> 1
+            v = int.from_bytes(buf[t.pos:t.pos + byte_width], "little") \
+                if byte_width else 0
+            t.pos += byte_width
+            out.extend([v] * min(n, count - len(out)))
+    if len(out) < count:
+        out.extend([0] * (count - len(out)))
+    return out[:count]
+
+
+# ---------------------------------------------------------------------------
+# column data decoding
+# ---------------------------------------------------------------------------
+
+_T_BOOLEAN, _T_INT32, _T_INT64, _T_INT96 = 0, 1, 2, 3
+_T_FLOAT, _T_DOUBLE, _T_BYTE_ARRAY, _T_FIXED = 4, 5, 6, 7
+
+_ENC_PLAIN, _ENC_PLAIN_DICT, _ENC_RLE = 0, 2, 3
+_ENC_RLE_DICT = 8
+
+
+def _decode_plain(buf: bytes, ptype: int, count: int,
+                  type_length: int = 0) -> list:
+    out: list = []
+    pos = 0
+    if ptype == _T_BOOLEAN:
+        for i in range(count):
+            out.append(bool((buf[i // 8] >> (i % 8)) & 1))
+        return out
+    if ptype == _T_INT32:
+        return list(struct.unpack_from(f"<{count}i", buf, 0))
+    if ptype == _T_INT64:
+        return list(struct.unpack_from(f"<{count}q", buf, 0))
+    if ptype == _T_FLOAT:
+        return list(struct.unpack_from(f"<{count}f", buf, 0))
+    if ptype == _T_DOUBLE:
+        return list(struct.unpack_from(f"<{count}d", buf, 0))
+    if ptype == _T_BYTE_ARRAY:
+        for _ in range(count):
+            n = int.from_bytes(buf[pos:pos + 4], "little")
+            pos += 4
+            out.append(buf[pos:pos + n])
+            pos += n
+        return out
+    if ptype == _T_FIXED:
+        for _ in range(count):
+            out.append(buf[pos:pos + type_length])
+            pos += type_length
+        return out
+    if ptype == _T_INT96:  # legacy timestamps: surface raw bytes
+        for _ in range(count):
+            out.append(buf[pos:pos + 12])
+            pos += 12
+        return out
+    raise ParquetError(f"unsupported physical type {ptype}")
+
+
+def _decompress(codec: int, data: bytes, uncompressed_size: int) -> bytes:
+    if codec == 0:
+        return data
+    if codec == 1:
+        return snappy_decompress(data)
+    if codec == 2:
+        return zlib.decompress(data, 16 + zlib.MAX_WBITS)  # gzip framing
+    raise ParquetError(f"unsupported codec {codec} "
+                       "(UNCOMPRESSED/SNAPPY/GZIP implemented)")
+
+
+class _Column:
+    def __init__(self, name: str, ptype: int, type_length: int,
+                 optional: bool, utf8: bool):
+        self.name = name
+        self.ptype = ptype
+        self.type_length = type_length
+        self.optional = optional
+        self.utf8 = utf8
+
+    def convert(self, v):
+        if v is None:
+            return None
+        if self.ptype == _T_BYTE_ARRAY:
+            # Old writers omit the UTF8 converted-type on string columns
+            # (the reference fixture does); SQL needs str, so decode
+            # best-effort and keep raw bytes only for true binary.
+            try:
+                return v.decode("utf-8")
+            except UnicodeDecodeError:
+                return v
+        return v
+
+
+class ParquetReader:
+    """Reads a whole parquet object (footer-directed, column by column)."""
+
+    def __init__(self, raw: bytes):
+        if raw[:4] != b"PAR1" or raw[-4:] != b"PAR1":
+            raise ParquetError("not a parquet file (PAR1 magic missing)")
+        self.raw = raw
+        flen = int.from_bytes(raw[-8:-4], "little")
+        meta = _Thrift(raw, len(raw) - 8 - flen).read_struct()
+        self.num_rows = meta.get(3, 0)
+        self.columns = self._schema(meta.get(2, []))
+        self.row_groups = meta.get(4, [])
+
+    def _schema(self, elements: list) -> list[_Column]:
+        cols: list[_Column] = []
+        # elements[0] is the root; flat schemas only (children of root).
+        for el in elements[1:]:
+            if el.get(5):  # num_children -> nested group: unsupported
+                raise ParquetError("nested parquet schemas not supported")
+            name = el.get(4, b"").decode()
+            cols.append(_Column(
+                name=name,
+                ptype=el.get(1, -1),
+                type_length=el.get(2, 0),
+                optional=el.get(3, 0) == 1,   # OPTIONAL
+                utf8=el.get(6, None) == 0,    # ConvertedType UTF8
+            ))
+        return cols
+
+    def _read_column_chunk(self, col: _Column, cc_meta: dict) -> list:
+        codec = cc_meta.get(4, 0)
+        num_values = cc_meta.get(5, 0)
+        start = cc_meta.get(11, None)           # dictionary_page_offset
+        if start is None:
+            start = cc_meta.get(9, 0)           # data_page_offset
+        pos = start
+        values: list = []
+        dictionary: list | None = None
+        while len(values) < num_values:
+            t = _Thrift(self.raw, pos)
+            header = t.read_struct()
+            page_type = header.get(1, 0)
+            comp_size = header.get(3, 0)
+            unc_size = header.get(2, 0)
+            body = self.raw[t.pos:t.pos + comp_size]
+            pos = t.pos + comp_size
+            if page_type == 2:                  # DICTIONARY_PAGE
+                dph = header.get(7, {})
+                n = dph.get(1, 0)
+                data = _decompress(codec, body, unc_size)
+                dictionary = _decode_plain(data, col.ptype, n,
+                                           col.type_length)
+                continue
+            if page_type == 0:                  # DATA_PAGE v1
+                dph = header.get(5, {})
+                n = dph.get(1, 0)
+                enc = dph.get(2, 0)
+                data = _decompress(codec, body, unc_size)
+                values.extend(self._decode_data_page(
+                    col, data, n, enc, dictionary, v2_def=None))
+                continue
+            if page_type == 3:                  # DATA_PAGE v2
+                dph = header.get(8, {})
+                n = dph.get(1, 0)
+                enc = dph.get(4, 0)
+                def_len = dph.get(5, 0)
+                rep_len = dph.get(6, 0)
+                compressed = dph.get(7, True)
+                levels = body[:rep_len + def_len]
+                payload = body[rep_len + def_len:]
+                if compressed:
+                    payload = _decompress(codec, payload,
+                                          unc_size - rep_len - def_len)
+                defs = (_rle_bp_hybrid(levels, rep_len, rep_len + def_len,
+                                       1, n) if col.optional and def_len
+                        else None)
+                values.extend(self._decode_data_page(
+                    col, payload, n, enc, dictionary, v2_def=defs))
+                continue
+            # index/unknown pages: skip
+        return values[:num_values]
+
+    def _decode_data_page(self, col: _Column, data: bytes, n: int, enc: int,
+                          dictionary: list | None, v2_def) -> list:
+        pos = 0
+        if v2_def is not None:
+            defs = v2_def
+        elif col.optional:
+            # v1: def levels length-prefixed RLE (bit width 1 for flat)
+            dlen = int.from_bytes(data[pos:pos + 4], "little")
+            defs = _rle_bp_hybrid(data, pos + 4, pos + 4 + dlen, 1, n)
+            pos += 4 + dlen
+        else:
+            defs = None
+        present = sum(defs) if defs is not None else n
+        if enc in (_ENC_PLAIN_DICT, _ENC_RLE_DICT):
+            if dictionary is None:
+                raise ParquetError("dictionary-encoded page with no dictionary")
+            bit_width = data[pos]
+            idx = _rle_bp_hybrid(data, pos + 1, len(data), bit_width, present)
+            vals = [dictionary[i] for i in idx]
+        elif enc == _ENC_PLAIN:
+            vals = _decode_plain(data[pos:], col.ptype, present,
+                                 col.type_length)
+        elif enc == _ENC_RLE and col.ptype == _T_BOOLEAN:
+            vals = [bool(v) for v in
+                    _rle_bp_hybrid(data, pos + 4, len(data), 1, present)]
+        else:
+            raise ParquetError(f"unsupported encoding {enc}")
+        if defs is None:
+            return [col.convert(v) for v in vals]
+        out, vi = [], 0
+        for d in defs:
+            if d:
+                out.append(col.convert(vals[vi]))
+                vi += 1
+            else:
+                out.append(None)
+        return out
+
+    def iter_rows(self) -> Iterator[dict]:
+        """Yield rows as {column: value} dicts (the SQL engine's shape)."""
+        for rg in self.row_groups:
+            chunks = rg.get(1, [])
+            data: dict[str, list] = {}
+            n_rows = rg.get(3, 0)
+            for cc in chunks:
+                md = cc.get(3, {})
+                path = [p.decode() for p in md.get(3, [])]
+                name = path[0] if path else ""
+                col = next((c for c in self.columns if c.name == name), None)
+                if col is None:
+                    continue
+                data[name] = self._read_column_chunk(col, md)
+            for i in range(n_rows):
+                yield {c.name: (data.get(c.name) or [None] * n_rows)[i]
+                       for c in self.columns}
+
+
+def iter_parquet_records(stream) -> Iterator[dict]:
+    """S3 Select entry: read the (buffered) object and yield row dicts.
+    Parquet is footer-directed, so the input must be fully materialized —
+    matching the reference, which also requires seekable parquet input."""
+    raw = stream.read() if hasattr(stream, "read") else bytes(stream)
+    yield from ParquetReader(raw).iter_rows()
+
+
+# ---------------------------------------------------------------------------
+# minimal writer — PLAIN v1 pages, one row group (test vectors + export)
+# ---------------------------------------------------------------------------
+
+
+class _TWrite:
+    """Thrift Compact Protocol writer (the footer/page-header format)."""
+
+    def __init__(self):
+        self.out = bytearray()
+
+    def varint(self, n: int) -> None:
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            self.out.append(b | (0x80 if n else 0))
+            if not n:
+                return
+
+    def zigzag(self, n: int) -> None:
+        self.varint((n << 1) ^ (n >> 63) if n < 0 else n << 1)
+
+    def field(self, last_id: int, fid: int, ctype: int) -> None:
+        delta = fid - last_id
+        if 0 < delta <= 15:
+            self.out.append((delta << 4) | ctype)
+        else:
+            self.out.append(ctype)
+            self.zigzag(fid)
+
+    def struct(self, fields: list[tuple[int, str, object]]) -> None:
+        """fields: sorted [(id, kind, value)]; kind in
+        i32|i64|bool|binary|list_struct|list_i32|list_binary|struct."""
+        last = 0
+        for fid, kind, val in fields:
+            if kind == "bool":
+                self.field(last, fid, _CT_TRUE if val else _CT_FALSE)
+            elif kind in ("i32", "i64"):
+                self.field(last, fid, _CT_I32 if kind == "i32" else _CT_I64)
+                self.zigzag(val)
+            elif kind == "binary":
+                self.field(last, fid, _CT_BINARY)
+                data = val.encode() if isinstance(val, str) else val
+                self.varint(len(data))
+                self.out += data
+            elif kind == "struct":
+                self.field(last, fid, _CT_STRUCT)
+                self.struct(val)
+            elif kind.startswith("list_"):
+                self.field(last, fid, _CT_LIST)
+                etype = {"list_struct": _CT_STRUCT, "list_i32": _CT_I32,
+                         "list_binary": _CT_BINARY}[kind]
+                n = len(val)
+                if n < 15:
+                    self.out.append((n << 4) | etype)
+                else:
+                    self.out.append((15 << 4) | etype)
+                    self.varint(n)
+                for item in val:
+                    if etype == _CT_STRUCT:
+                        self.struct(item)
+                    elif etype == _CT_I32:
+                        self.zigzag(item)
+                    else:
+                        data = (item.encode()
+                                if isinstance(item, str) else item)
+                        self.varint(len(data))
+                        self.out += data
+            else:
+                raise ParquetError(f"writer: unknown kind {kind}")
+            last = fid
+        self.out.append(_CT_STOP)
+
+
+_WRITE_TYPES = {"int32": _T_INT32, "int64": _T_INT64, "double": _T_DOUBLE,
+                "boolean": _T_BOOLEAN, "string": _T_BYTE_ARRAY,
+                "binary": _T_BYTE_ARRAY}
+
+
+def _plain_encode(ptype: int, vals: list) -> bytes:
+    if ptype == _T_BOOLEAN:
+        out = bytearray((len(vals) + 7) // 8)
+        for i, v in enumerate(vals):
+            if v:
+                out[i // 8] |= 1 << (i % 8)
+        return bytes(out)
+    if ptype == _T_INT32:
+        return struct.pack(f"<{len(vals)}i", *vals)
+    if ptype == _T_INT64:
+        return struct.pack(f"<{len(vals)}q", *vals)
+    if ptype == _T_DOUBLE:
+        return struct.pack(f"<{len(vals)}d", *vals)
+    out = bytearray()
+    for v in vals:
+        data = v.encode() if isinstance(v, str) else v
+        out += len(data).to_bytes(4, "little") + data
+    return bytes(out)
+
+
+def _def_levels(present: list[bool]) -> bytes:
+    """Length-prefixed RLE/bit-packed hybrid, bit width 1."""
+    n_groups = (len(present) + 7) // 8
+    packed = bytearray(n_groups)
+    for i, p in enumerate(present):
+        if p:
+            packed[i // 8] |= 1 << (i % 8)
+    w = _TWrite()
+    w.varint((n_groups << 1) | 1)   # bit-packed run header
+    body = bytes(w.out) + bytes(packed)
+    return len(body).to_bytes(4, "little") + body
+
+
+def write_parquet(rows: list[dict], schema: list[tuple[str, str]],
+                  codec: str = "UNCOMPRESSED") -> bytes:
+    """rows -> a single-row-group parquet file. schema: [(name, type)] with
+    type in int32|int64|double|boolean|string|binary; None values become
+    NULLs (all columns OPTIONAL). codec: UNCOMPRESSED | GZIP."""
+    codec_id = {"UNCOMPRESSED": 0, "GZIP": 2}[codec.upper()]
+    out = bytearray(b"PAR1")
+    col_metas = []
+    for name, tname in schema:
+        ptype = _WRITE_TYPES[tname]
+        col_vals = [r.get(name) for r in rows]
+        present = [v is not None for v in col_vals]
+        payload = _def_levels(present) + _plain_encode(
+            ptype, [v for v in col_vals if v is not None])
+        unc_size = len(payload)
+        body = (zlib.compress(payload, 9) if codec_id == 2 else payload)
+        if codec_id == 2:  # gzip framing
+            c = zlib.compressobj(9, zlib.DEFLATED, 16 + zlib.MAX_WBITS)
+            body = c.compress(payload) + c.flush()
+        hdr = _TWrite()
+        hdr.struct([
+            (1, "i32", 0),                       # DATA_PAGE
+            (2, "i32", unc_size),
+            (3, "i32", len(body)),
+            (5, "struct", [(1, "i32", len(rows)),
+                           (2, "i32", _ENC_PLAIN),
+                           (3, "i32", _ENC_RLE),
+                           (4, "i32", _ENC_RLE)]),
+        ])
+        offset = len(out)
+        out += bytes(hdr.out) + body
+        col_metas.append((name, ptype, offset,
+                          len(bytes(hdr.out)) + len(body), unc_size))
+    # footer
+    schema_elems = [[(4, "binary", "schema"), (5, "i32", len(schema))]]
+    for name, tname in schema:
+        schema_elems.append([
+            (1, "i32", _WRITE_TYPES[tname]),
+            (3, "i32", 1),                       # OPTIONAL
+            (4, "binary", name),
+        ] + ([(6, "i32", 0)] if tname == "string" else []))
+    chunks = []
+    for name, ptype, offset, total, unc in col_metas:
+        chunks.append([
+            (2, "i64", offset),
+            (3, "struct", [
+                (1, "i32", ptype),
+                (2, "list_i32", [_ENC_PLAIN, _ENC_RLE]),
+                (3, "list_binary", [name]),
+                (4, "i32", codec_id),
+                (5, "i64", len(rows)),
+                (6, "i64", unc),
+                (7, "i64", total),
+                (9, "i64", offset),
+            ]),
+        ])
+    row_group = [(1, "list_struct", chunks),
+                 (2, "i64", sum(c[3] for c in col_metas)),
+                 (3, "i64", len(rows))]
+    footer = _TWrite()
+    footer.struct([
+        (1, "i32", 1),
+        (2, "list_struct", schema_elems),
+        (3, "i64", len(rows)),
+        (4, "list_struct", [row_group]),
+    ])
+    fbytes = bytes(footer.out)
+    out += fbytes
+    out += len(fbytes).to_bytes(4, "little") + b"PAR1"
+    return bytes(out)

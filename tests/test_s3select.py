@@ -208,10 +208,19 @@ def test_request_xml_parse():
     assert req.input_format == "CSV" and req.output_format == "JSON"
     assert req.compression == "GZIP" and req.csv_delimiter == ";"
     assert req.csv_header == "IGNORE"
+    # Parquet input is now a first-class format (s3select/parquet.py)
+    req = S3SelectRequest.parse_xml(b"<SelectObjectContentRequest>"
+                                    b"<Expression>SELECT 1</Expression>"
+                                    b"<InputSerialization><Parquet/>"
+                                    b"</InputSerialization>"
+                                    b"<OutputSerialization><CSV/>"
+                                    b"</OutputSerialization>"
+                                    b"</SelectObjectContentRequest>")
+    assert req.input_format == "PARQUET"
     with pytest.raises(SelectError):
         S3SelectRequest.parse_xml(b"<SelectObjectContentRequest>"
                                   b"<Expression>SELECT 1</Expression>"
-                                  b"<InputSerialization><Parquet/>"
+                                  b"<InputSerialization>"
                                   b"</InputSerialization>"
                                   b"<OutputSerialization><CSV/>"
                                   b"</OutputSerialization>"
