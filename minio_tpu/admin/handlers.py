@@ -164,6 +164,31 @@ class AdminAPI:
             self._authorize(identity, "admin:ServerInfo")
             with self.s._bw_mu:
                 return _json({"buckets": dict(self.s.bandwidth)})
+        # -- service control (cmd/admin-handlers ServiceActionHandler) --
+        if op == "service" and m == "POST":
+            self._authorize(identity, "admin:ServiceRestart")
+            action = q.get("action", "")
+            if action == "restart":
+                # Respond first, then re-exec the process in place — the
+                # same binary restart `mc admin service restart` performs.
+                loop = asyncio.get_running_loop()
+                loop.call_later(0.3, self.s.restart)
+                return _json({"restarting": True})
+            if action == "stop":
+                loop = asyncio.get_running_loop()
+                loop.call_later(0.3, self.s.shutdown)
+                return _json({"stopping": True})
+            raise S3Error("InvalidArgument", f"unknown action {action!r}")
+        if op == "update" and m in ("GET", "POST"):
+            self._authorize(identity, "admin:ServerUpdate")
+            # Self-update role (cmd/update.go): this build deploys from
+            # source/images, so update reports version provenance instead
+            # of pulling a binary.
+            return _json({"currentVersion": VERSION,
+                          "updateAvailable": False,
+                          "detail": "deployed from source; update via your "
+                                    "image/package pipeline"})
+
         # -- ILM tier admin (madmin tier add/ls/rm roles) --
         if op == "tier":
             self._authorize(identity, "admin:SetTier")

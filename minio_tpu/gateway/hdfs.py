@@ -138,11 +138,39 @@ class HDFSGateway(FlatGateway):
             raise se.BucketNotFound(bucket)
         # Emptiness means no OBJECTS: deleted objects leave empty parent
         # dirs and the ._meta_ sidecar tree behind (HDFS keeps empty
-        # dirs), which must not make the bucket undeletable.
+        # dirs), which must not make the bucket undeletable. Data dirs are
+        # removed NON-recursively bottom-up, so a concurrently-uploaded
+        # file makes its directory non-empty and the whole delete fails
+        # with BucketNotEmpty instead of destroying an acknowledged write.
         entries, _p, _t, _n = self._gw_list(bucket, "", "", "", 1)
         if entries:
             raise se.BucketNotEmpty(bucket)
-        self.client.delete(f"/{bucket}", recursive=True)
+        try:
+            self.client.delete(f"/{bucket}/._meta_", recursive=True)
+        except (FileNotFoundError, HDFSError):
+            pass
+
+        def rm_empty(path: str) -> None:
+            try:
+                kids = self.client.list_status(path)
+            except (FileNotFoundError, HDFSError):
+                kids = []
+            for k in kids:
+                if not k:
+                    continue
+                if k.get("type") == "DIRECTORY":
+                    rm_empty(f"{path}/{k.get('pathSuffix', '')}")
+                else:
+                    raise se.BucketNotEmpty(bucket)
+            try:
+                if not self.client.delete(path, recursive=False):
+                    raise se.BucketNotEmpty(bucket)
+            except FileNotFoundError:
+                pass
+            except HDFSError:
+                raise se.BucketNotEmpty(bucket) from None
+
+        rm_empty(f"/{bucket}")
 
     def _gw_bucket_exists(self, bucket: str) -> bool:
         try:

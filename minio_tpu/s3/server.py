@@ -185,6 +185,23 @@ class S3Server:
                                    tracker=self.update_tracker)
         self.scanner.start()
 
+    def restart(self) -> None:
+        """In-place process restart (`mc admin service restart` role,
+        cmd/admin-handlers.go ServiceActionHandler): re-exec the same
+        command line; durable state (format, journals, config, IAM) is all
+        on disk, so the new process resumes cleanly. Overridable hook so
+        embedded/test servers can intercept."""
+        import sys
+
+        # Re-exec via -m: under `python -m minio_tpu.s3.server` sys.argv[0]
+        # is the script path, and script-mode would lose the package root
+        # from sys.path (ModuleNotFoundError instead of a restart).
+        os.execv(sys.executable,
+                 [sys.executable, "-m", "minio_tpu.s3.server"] + sys.argv[1:])
+
+    def shutdown(self) -> None:
+        os._exit(0)
+
     def attach_cluster(self, node) -> None:
         """Wire this node's observability into the peer plane so every
         peer can pull our trace/console/info/profiles (the NotificationSys

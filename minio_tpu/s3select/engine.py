@@ -127,12 +127,19 @@ def run_select(body_stream, request: S3SelectRequest
     ev = Evaluator(query)
 
     if request.input_format == "PARQUET":
+        import struct as _struct
+
         from minio_tpu.s3select.parquet import ParquetError, iter_parquet_records
 
         try:
             rows = iter(list(iter_parquet_records(body_stream)))
         except ParquetError as e:
             raise SelectError(f"parquet: {e}") from None
+        except (_struct.error, IndexError, KeyError, ValueError,
+                OverflowError, MemoryError) as e:
+            # Corrupt/truncated input must die as a clean Select error,
+            # not an unhandled 500 mid-stream.
+            raise SelectError(f"parquet: malformed input ({e})") from None
     else:
         raw = readers.decompress(body_stream, request.compression)
         if request.input_format == "CSV":

@@ -290,10 +290,12 @@ class ParquetReader:
     """Reads a whole parquet object (footer-directed, column by column)."""
 
     def __init__(self, raw: bytes):
-        if raw[:4] != b"PAR1" or raw[-4:] != b"PAR1":
+        if len(raw) < 12 or raw[:4] != b"PAR1" or raw[-4:] != b"PAR1":
             raise ParquetError("not a parquet file (PAR1 magic missing)")
         self.raw = raw
         flen = int.from_bytes(raw[-8:-4], "little")
+        if flen <= 0 or flen > len(raw) - 8:
+            raise ParquetError(f"corrupt footer length {flen}")
         meta = _Thrift(raw, len(raw) - 8 - flen).read_struct()
         self.num_rows = meta.get(3, 0)
         self.columns = self._schema(meta.get(2, []))
@@ -560,7 +562,7 @@ def write_parquet(rows: list[dict], schema: list[tuple[str, str]],
         payload = _def_levels(present) + _plain_encode(
             ptype, [v for v in col_vals if v is not None])
         unc_size = len(payload)
-        body = (zlib.compress(payload, 9) if codec_id == 2 else payload)
+        body = payload
         if codec_id == 2:  # gzip framing
             c = zlib.compressobj(9, zlib.DEFLATED, 16 + zlib.MAX_WBITS)
             body = c.compress(payload) + c.flush()

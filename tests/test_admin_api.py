@@ -308,3 +308,28 @@ def test_content_type_inferred_from_extension(server, client):
                headers={"Content-Type": "application/x-custom"})
     r = client.head("/bwbkt/data.bin")
     assert r.headers["Content-Type"] == "application/x-custom"
+
+
+def test_admin_service_restart_and_update(client, server):
+    """Service restart schedules the process re-exec hook; update reports
+    version provenance (cmd/admin-handlers ServiceActionHandler +
+    cmd/update.go roles)."""
+    import time as _time
+
+    _base, srv = server
+    called = []
+    srv.restart = lambda: called.append("restart")
+    r = client.post("/minio/admin/v3/service", query={"action": "restart"})
+    assert r.status_code == 200 and r.json()["restarting"]
+    deadline = _time.time() + 3
+    while not called and _time.time() < deadline:
+        _time.sleep(0.05)
+    assert called == ["restart"]
+
+    r = client.post("/minio/admin/v3/service", query={"action": "bogus"})
+    assert r.status_code == 400
+
+    r = client.get("/minio/admin/v3/update")
+    assert r.status_code == 200
+    doc = r.json()
+    assert doc["currentVersion"] and doc["updateAvailable"] is False
