@@ -166,15 +166,22 @@ class AdminAPI:
                 return _json({"buckets": dict(self.s.bandwidth)})
         # -- service control (cmd/admin-handlers ServiceActionHandler) --
         if op == "service" and m == "POST":
-            self._authorize(identity, "admin:ServiceRestart")
             action = q.get("action", "")
             if action == "restart":
+                # Scoped like the reference: restart and stop are separate
+                # admin actions, a restart-only policy must not stop.
+                self._authorize(identity, "admin:ServiceRestart")
+                if not self.s.can_restart:
+                    raise S3Error("NotImplemented",
+                                  "embedded server: no restart command "
+                                  "registered")
                 # Respond first, then re-exec the process in place — the
                 # same binary restart `mc admin service restart` performs.
                 loop = asyncio.get_running_loop()
                 loop.call_later(0.3, self.s.restart)
                 return _json({"restarting": True})
             if action == "stop":
+                self._authorize(identity, "admin:ServiceStop")
                 loop = asyncio.get_running_loop()
                 loop.call_later(0.3, self.s.shutdown)
                 return _json({"stopping": True})
@@ -314,6 +321,12 @@ class AdminAPI:
                 continue
             probe = _os.path.join(root, f".obd-{_uuid.uuid4().hex}")
             entry = {"endpoint": d.endpoint(), "remote": False}
+            try:  # device identity (pkg/smart + pkg/mountinfo roles)
+                from minio_tpu.utils.mounts import device_health
+
+                entry.update(device_health(root))
+            except Exception:  # noqa: BLE001
+                pass
             try:
                 t0 = time.perf_counter()
                 with open(probe, "wb") as f:

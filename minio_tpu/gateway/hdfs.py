@@ -145,12 +145,11 @@ class HDFSGateway(FlatGateway):
         entries, _p, _t, _n = self._gw_list(bucket, "", "", "", 1)
         if entries:
             raise se.BucketNotEmpty(bucket)
-        try:
-            self.client.delete(f"/{bucket}/._meta_", recursive=True)
-        except (FileNotFoundError, HDFSError):
-            pass
 
-        def rm_empty(path: str) -> None:
+        def rm_empty(path: str, skip: set[str] = frozenset()) -> None:
+            """Delete an empty directory tree bottom-up, NON-recursively:
+            any file encountered (a racing upload) aborts with
+            BucketNotEmpty and nothing of it is destroyed."""
             try:
                 kids = self.client.list_status(path)
             except (FileNotFoundError, HDFSError):
@@ -158,18 +157,40 @@ class HDFSGateway(FlatGateway):
             for k in kids:
                 if not k:
                     continue
+                name = k.get("pathSuffix", "")
+                if name in skip:
+                    continue
                 if k.get("type") == "DIRECTORY":
-                    rm_empty(f"{path}/{k.get('pathSuffix', '')}")
+                    rm_empty(f"{path}/{name}")
                 else:
                     raise se.BucketNotEmpty(bucket)
             try:
-                if not self.client.delete(path, recursive=False):
-                    raise se.BucketNotEmpty(bucket)
+                # boolean:false means the path was already gone (WebHDFS
+                # does not 404 deletes) — that is success, not non-empty.
+                self.client.delete(path, recursive=False)
             except FileNotFoundError:
                 pass
-            except HDFSError:
-                raise se.BucketNotEmpty(bucket) from None
+            except HDFSError as e:
+                if e.status == 403:  # namenode refuses non-empty deletes
+                    raise se.BucketNotEmpty(bucket) from None
+                raise
 
+        # Data dirs first (._meta_ kept until the data side proves empty —
+        # a racing upload must keep both its file AND its sidecar).
+        try:
+            kids = self.client.list_status(f"/{bucket}")
+        except (FileNotFoundError, HDFSError):
+            kids = []
+        for k in kids:
+            if k and k.get("pathSuffix") != "._meta_":
+                if k.get("type") == "DIRECTORY":
+                    rm_empty(f"/{bucket}/{k['pathSuffix']}")
+                else:
+                    raise se.BucketNotEmpty(bucket)
+        try:
+            self.client.delete(f"/{bucket}/._meta_", recursive=True)
+        except (FileNotFoundError, HDFSError):
+            pass
         rm_empty(f"/{bucket}")
 
     def _gw_bucket_exists(self, bucket: str) -> bool:

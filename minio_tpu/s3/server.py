@@ -185,19 +185,22 @@ class S3Server:
                                    tracker=self.update_tracker)
         self.scanner.start()
 
+    # Set by main() (the CLI entry point); embedded servers either leave it
+    # None (restart reports NotImplemented) or override restart().
+    restart_cmd: list[str] | None = None
+
+    @property
+    def can_restart(self) -> bool:
+        return self.restart_cmd is not None or "restart" in self.__dict__
+
     def restart(self) -> None:
         """In-place process restart (`mc admin service restart` role,
-        cmd/admin-handlers.go ServiceActionHandler): re-exec the same
-        command line; durable state (format, journals, config, IAM) is all
-        on disk, so the new process resumes cleanly. Overridable hook so
-        embedded/test servers can intercept."""
-        import sys
-
-        # Re-exec via -m: under `python -m minio_tpu.s3.server` sys.argv[0]
-        # is the script path, and script-mode would lose the package root
-        # from sys.path (ModuleNotFoundError instead of a restart).
-        os.execv(sys.executable,
-                 [sys.executable, "-m", "minio_tpu.s3.server"] + sys.argv[1:])
+        cmd/admin-handlers.go ServiceActionHandler): re-exec the command
+        line main() registered; durable state (format, journals, config,
+        IAM) is all on disk, so the new process resumes cleanly.
+        Overridable hook so embedded/test servers can intercept."""
+        if self.restart_cmd:
+            os.execv(self.restart_cmd[0], self.restart_cmd)
 
     def shutdown(self) -> None:
         os._exit(0)
@@ -2074,6 +2077,14 @@ def build_server(drive_paths: list[str], access_key: str, secret_key: str,
         srv.attach_cluster(node)
         return srv
 
+    # Drives sharing one physical device lose failure independence
+    # (pkg/mountinfo CheckCrossDevice role) — warn loudly, keep serving.
+    from minio_tpu.logger import get_logger
+    from minio_tpu.utils.mounts import check_cross_device
+
+    for w in check_cross_device(drive_paths):
+        get_logger().warning(w)
+
     drives = [LocalDrive(p) for p in drive_paths]
     sets = ErasureSets(drives, set_drive_count=set_drive_count, parity=parity,
                        enable_mrf=enable_mrf)
@@ -2140,6 +2151,12 @@ def main(argv=None):
                     help="TLS certs dir (public.crt + private.key, "
                          "hot-reloaded); empty serves plaintext HTTP")
     args = ap.parse_args(argv)
+    import sys as _sys
+
+    # The exact re-exec line `admin service restart` uses (module entry —
+    # script-mode exec would lose the package root from sys.path).
+    restart_cmd = [_sys.executable, "-m", "minio_tpu.s3.server"] + (
+        list(argv) if argv is not None else _sys.argv[1:])
     host, _, port = args.address.rpartition(":")
     access = os.environ.get("MTPU_ROOT_USER", "minioadmin")
     secret = os.environ.get("MTPU_ROOT_PASSWORD", "minioadmin")
@@ -2148,6 +2165,7 @@ def main(argv=None):
             args.gateway, args.drives[0], access, secret,
             remote_access=os.environ.get("MTPU_GATEWAY_ACCESS_KEY", ""),
             remote_secret=os.environ.get("MTPU_GATEWAY_SECRET_KEY", ""))
+        srv.restart_cmd = restart_cmd
         web.run_app(srv.app, host=(args.address.rpartition(":")[0]
                                    or "0.0.0.0"),
                     port=int(args.address.rpartition(":")[2]))
@@ -2156,6 +2174,7 @@ def main(argv=None):
                        versioned=args.versioned, parity=args.parity,
                        set_drive_count=args.set_drives,
                        server_addr=args.address)
+    srv.restart_cmd = restart_cmd
     if args.cache_dir:
         from minio_tpu.cache import CacheObjects
 

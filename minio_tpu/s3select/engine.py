@@ -135,8 +135,8 @@ def run_select(body_stream, request: S3SelectRequest
             rows = iter(list(iter_parquet_records(body_stream)))
         except ParquetError as e:
             raise SelectError(f"parquet: {e}") from None
-        except (_struct.error, IndexError, KeyError, ValueError,
-                OverflowError, MemoryError) as e:
+        except (_struct.error, __import__("zlib").error, IndexError,
+                KeyError, ValueError, OverflowError, MemoryError) as e:
             # Corrupt/truncated input must die as a clean Select error,
             # not an unhandled 500 mid-stream.
             raise SelectError(f"parquet: malformed input ({e})") from None

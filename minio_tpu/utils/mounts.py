@@ -1,0 +1,87 @@
+"""Mount topology + device health probes.
+
+Role-equivalent of pkg/mountinfo (duplicate/cross-device detection — two
+"drives" on one physical disk silently lose failure independence) and a
+best-effort slice of pkg/smart (device identity/rotational/model read from
+sysfs; real SMART needs ioctls + root, which the reference also gates).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _mounts() -> list[tuple[str, str, str]]:
+    """[(mount_point, device, fstype)] from /proc/self/mountinfo."""
+    out = []
+    try:
+        with open("/proc/self/mountinfo", encoding="utf-8") as f:
+            for line in f:
+                fields = line.split()
+                if "-" not in fields:
+                    continue
+                sep = fields.index("-")
+                mount_point = fields[4]
+                fstype = fields[sep + 1]
+                device = fields[sep + 2]
+                out.append((mount_point, device, fstype))
+    except OSError:
+        pass
+    return out
+
+
+def mount_of(path: str, table: list | None = None) -> tuple[str, str, str]:
+    """(mount_point, device, fstype) owning `path` (longest-prefix mount).
+    Pass a pre-fetched `table` (_mounts()) when resolving many paths —
+    one /proc parse instead of one per path."""
+    path = os.path.abspath(path)
+    best = ("/", "unknown", "unknown")
+    for mp, dev, fstype in (table if table is not None else _mounts()):
+        if (path == mp or path.startswith(mp.rstrip("/") + "/")) and \
+                len(mp) >= len(best[0]):
+            best = (mp, dev, fstype)
+    return best
+
+
+def check_cross_device(paths: list[str]) -> list[str]:
+    """Warnings for drive paths that share one underlying device/mount —
+    erasure parity assumes drives fail independently
+    (pkg/mountinfo CheckCrossDevice role)."""
+    table = _mounts()
+    seen: dict[tuple[str, str], list[str]] = {}
+    for p in paths:
+        mp, dev, _fs = mount_of(p, table)
+        seen.setdefault((mp, dev), []).append(p)
+    warnings = []
+    for (mp, dev), group in seen.items():
+        if len(group) > 1:
+            warnings.append(
+                f"drives {group} share one device ({dev} mounted at {mp}) — "
+                "erasure shards on them fail together, parity does not "
+                "protect against that device's loss")
+    return warnings
+
+
+def device_health(path: str) -> dict:
+    """Best-effort device identity for OBD (pkg/smart role): mount,
+    filesystem, rotational flag and model from sysfs when resolvable."""
+    mp, dev, fstype = mount_of(path)
+    info: dict = {"mountPoint": mp, "device": dev, "fsType": fstype}
+    name = os.path.basename(dev)
+    base = name.rstrip("0123456789") or name  # sda1 -> sda (best effort)
+    for candidate in (name, base):
+        sys_dir = f"/sys/block/{candidate}"
+        if not os.path.isdir(sys_dir):
+            continue
+        try:
+            with open(f"{sys_dir}/queue/rotational") as f:
+                info["rotational"] = f.read().strip() == "1"
+        except OSError:
+            pass
+        try:
+            with open(f"{sys_dir}/device/model") as f:
+                info["model"] = f.read().strip()
+        except OSError:
+            pass
+        break
+    return info

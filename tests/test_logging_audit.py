@@ -158,3 +158,16 @@ def test_admin_profiling_roundtrip(client):
     names = z.namelist()
     assert "local/cpu.txt" in names and "local/cpu.pstats" in names
     assert b"cumulative" in z.read("local/cpu.txt")
+
+
+def test_mounts_cross_device_detection(tmp_path):
+    """Drives under one mount are flagged (pkg/mountinfo role)."""
+    from minio_tpu.utils.mounts import check_cross_device, device_health, mount_of
+
+    a, b = str(tmp_path / "d0"), str(tmp_path / "d1")
+    warnings = check_cross_device([a, b])
+    assert len(warnings) == 1 and "fail together" in warnings[0]
+    mp, dev, fs = mount_of(a)
+    assert mp and fs
+    info = device_health(a)
+    assert info["mountPoint"] == mp and info["fsType"] == fs
