@@ -39,6 +39,11 @@ DEFAULTS: dict[str, dict[str, str]] = {
     # a local file path — zero-egress deployments mount the IdP's JWKS.
     "identity_openid": {"enable": "off", "jwks": "", "issuer": "",
                         "audience": "", "claim_name": "policy"},
+    # LDAP federation (cmd/config/identity/ldap role): simple-bind auth;
+    # policies for LDAP principals are configured, not group-searched.
+    "identity_ldap": {"enable": "off", "server_addr": "",
+                      "user_dn_format": "", "sts_policy": "",
+                      "tls": "on", "tls_skip_verify": "off"},
     "kms": {"enable": "off", "key_file": "", "default_key": ""},
 }
 

@@ -146,7 +146,7 @@ class HDFSGateway(FlatGateway):
         if entries:
             raise se.BucketNotEmpty(bucket)
 
-        def rm_empty(path: str, skip: set[str] = frozenset()) -> None:
+        def rm_empty(path: str) -> None:
             """Delete an empty directory tree bottom-up, NON-recursively:
             any file encountered (a racing upload) aborts with
             BucketNotEmpty and nothing of it is destroyed."""
@@ -158,8 +158,6 @@ class HDFSGateway(FlatGateway):
                 if not k:
                     continue
                 name = k.get("pathSuffix", "")
-                if name in skip:
-                    continue
                 if k.get("type") == "DIRECTORY":
                     rm_empty(f"{path}/{name}")
                 else:

@@ -191,7 +191,9 @@ class S3Server:
 
     @property
     def can_restart(self) -> bool:
-        return self.restart_cmd is not None or "restart" in self.__dict__
+        return (self.restart_cmd is not None
+                or "restart" in self.__dict__           # instance override
+                or type(self).restart is not S3Server.restart)  # subclass
 
     def restart(self) -> None:
         """In-place process restart (`mc admin service restart` role,
@@ -1178,6 +1180,31 @@ class S3Server:
             duration = min(max(900, duration), remaining)
             tc = self.iam.assume_role_with_claims(
                 subject, policies, duration, session_policy)
+        elif action == "AssumeRoleWithLDAPIdentity":
+            from minio_tpu.iam.ldap import LDAPError, LDAPValidator
+
+            username = form.get("LDAPUsername", [""])[0]
+            password = form.get("LDAPPassword", [""])[0]
+            if not username or not password:
+                raise S3Error("InvalidRequest",
+                              "LDAPUsername and LDAPPassword required")
+            validator = LDAPValidator.from_config(self.config)
+            if validator is None:
+                raise S3Error("STSNotImplemented",
+                              "identity_ldap is not configured")
+            try:
+                # Blocking directory I/O stays off the event loop.
+                loop = asyncio.get_running_loop()
+                subject = await loop.run_in_executor(
+                    None, validator.authenticate, username, password)
+            except LDAPError as e:
+                raise S3Error("AccessDenied", str(e)) from None
+            policies = validator.policies
+            if not policies:
+                raise S3Error("AccessDenied",
+                              "no sts_policy configured for LDAP identities")
+            tc = self.iam.assume_role_with_claims(
+                subject, policies, max(900, duration), session_policy)
         else:
             raise S3Error("STSNotImplemented")
 

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import csv
 import io
+import zlib
 import json
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass, field
@@ -135,7 +136,7 @@ def run_select(body_stream, request: S3SelectRequest
             rows = iter(list(iter_parquet_records(body_stream)))
         except ParquetError as e:
             raise SelectError(f"parquet: {e}") from None
-        except (_struct.error, __import__("zlib").error, IndexError,
+        except (_struct.error, zlib.error, IndexError,
                 KeyError, ValueError, OverflowError, MemoryError) as e:
             # Corrupt/truncated input must die as a clean Select error,
             # not an unhandled 500 mid-stream.

@@ -221,3 +221,100 @@ def test_sse_kms_end_to_end(client, bucket):
     r = client.get(f"/{bucket}/kms-obj", headers={"Range": "bytes=100-299"})
     assert r.status_code == 206 and r.content == payload[100:300]
     client.delete(f"/{bucket}/kms-obj")
+
+
+# ---------------- LDAP federation ----------------
+
+
+def _fake_ldap_server(accounts: dict):
+    """Minimal LDAPv3 bind responder: accounts {dn: password}."""
+    import socket
+    import threading
+
+    from minio_tpu.iam.ldap import _ber, _ber_int, _parse_tlv
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(5)
+
+    def serve():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            with conn:
+                try:
+                    req = conn.recv(4096)
+                    _t, body, _ = _parse_tlv(req, 0)
+                    _t2, _msgid, pos = _parse_tlv(body, 0)
+                    _op, op_body, _ = _parse_tlv(body, pos)
+                    _t3, dn, pos2 = _parse_tlv(op_body, 3)  # skip version int
+                    _t4, pwd, _ = _parse_tlv(op_body, pos2)
+                    ok = accounts.get(dn.decode()) == pwd.decode()
+                    rc = 0 if ok else 49
+                    resp = _ber(0x30, _ber_int(1) + _ber(
+                        0x61, _ber(0x0A, bytes([rc])) + _ber(0x04, b"")
+                        + _ber(0x04, b"")))
+                    conn.sendall(resp)
+                except Exception:
+                    pass
+
+    threading.Thread(target=serve, daemon=True).start()
+    return srv, f"127.0.0.1:{srv.getsockname()[1]}"
+
+
+def test_ldap_simple_bind_unit():
+    from minio_tpu.iam.ldap import LDAPError, simple_bind
+
+    srv, addr = _fake_ldap_server(
+        {"uid=alice,dc=test": "alicepw"})
+    try:
+        simple_bind(addr, "uid=alice,dc=test", "alicepw", use_tls=False)
+        with pytest.raises(LDAPError):
+            simple_bind(addr, "uid=alice,dc=test", "wrong", use_tls=False)
+        with pytest.raises(LDAPError):  # unauthenticated bind refused
+            simple_bind(addr, "uid=alice,dc=test", "", use_tls=False)
+        with pytest.raises(LDAPError):  # TLS required against a plain port
+            simple_bind(addr, "uid=alice,dc=test", "alicepw")
+    finally:
+        srv.close()
+
+
+def test_sts_ldap_end_to_end(client, server, bucket):
+    import requests
+
+    from tests.s3client import SigV4Client
+
+    srv, addr = _fake_ldap_server({"uid=bob,ou=people,dc=test": "bobpw1234"})
+    try:
+        r = client.request("PUT", "/minio/admin/v3/config-kv",
+                           data=json.dumps({"identity_ldap": {
+                               "enable": "on", "server_addr": addr,
+                               "user_dn_format": "uid=%s,ou=people,dc=test",
+                               "sts_policy": "readwrite",
+                               "tls": "off"}}).encode())
+        assert r.status_code == 200, r.text
+        r = requests.post(server + "/", data={
+            "Action": "AssumeRoleWithLDAPIdentity", "Version": "2011-06-15",
+            "LDAPUsername": "bob", "LDAPPassword": "bobpw1234"})
+        assert r.status_code == 200, r.text
+        ak = _xml_field(r.text, "AccessKeyId")
+        sk = _xml_field(r.text, "SecretAccessKey")
+        st = _xml_field(r.text, "SessionToken")
+        fed = SigV4Client(server, ak, sk, session_token=st)
+        assert fed.put(f"/{bucket}/ldap-obj", data=b"via-ldap").status_code == 200
+        assert fed.get(f"/{bucket}/ldap-obj").content == b"via-ldap"
+        client.delete(f"/{bucket}/ldap-obj")
+        # wrong password refused
+        r = requests.post(server + "/", data={
+            "Action": "AssumeRoleWithLDAPIdentity", "Version": "2011-06-15",
+            "LDAPUsername": "bob", "LDAPPassword": "nope"})
+        assert r.status_code == 403
+        # DN-injection characters refused
+        r = requests.post(server + "/", data={
+            "Action": "AssumeRoleWithLDAPIdentity", "Version": "2011-06-15",
+            "LDAPUsername": "bob,ou=admins", "LDAPPassword": "x"})
+        assert r.status_code == 403
+    finally:
+        srv.close()
