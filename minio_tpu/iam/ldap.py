@@ -90,15 +90,28 @@ def simple_bind(address: str, dn: str, password: str,
     """
     if not password:
         raise LDAPError("empty password (unauthenticated bind refused)")
-    host, _, port = address.partition(":")
+    addr = address
+    for scheme in ("ldaps://", "ldap://"):
+        if addr.startswith(scheme):
+            addr = addr[len(scheme):]
+    if addr.startswith("["):          # IPv6 literal [::1]:636
+        host, _, rest = addr[1:].partition("]")
+        port = rest.lstrip(":")
+    else:
+        host, sep, port = addr.rpartition(":")
+        if not sep:
+            host, port = addr, ""
+    try:
+        port_n = int(port) if port else (636 if use_tls else 389)
+    except ValueError:
+        raise LDAPError(f"bad LDAP address {address!r}") from None
     bind_op = _ber(0x60,                       # [APPLICATION 0] BindRequest
                    _ber_int(3)                 # version
                    + _ber(0x04, dn.encode())   # name
                    + _ber(0x80, password.encode()))  # simple auth
     msg = _ber(0x30, _ber_int(1) + bind_op)
     try:
-        with socket.create_connection((host or "127.0.0.1",
-                                       int(port or (636 if use_tls else 389))),
+        with socket.create_connection((host or "127.0.0.1", port_n),
                                       timeout=timeout) as raw:
             if use_tls:
                 import ssl
@@ -150,11 +163,15 @@ class LDAPValidator:
             return None
         addr = cfg.get("identity_ldap", "server_addr") or ""
         fmt = cfg.get("identity_ldap", "user_dn_format") or ""
+        if not addr:
+            raise LDAPError("identity_ldap enabled but server_addr is empty")
         # Exactly one %s and no other % directives: the DN is built by
-        # substitution, and a stray % must be a config error here, not a
-        # per-request crash.
-        if not addr or fmt.count("%") != 1 or "%s" not in fmt:
-            return None
+        # substitution, and a stray % must be a config error surfaced to
+        # the operator, not a silent 'not configured'.
+        if fmt.count("%") != 1 or "%s" not in fmt:
+            raise LDAPError(
+                "identity_ldap.user_dn_format must contain exactly one %s "
+                f"(got {fmt!r})")
         pols = [p.strip() for p in
                 (cfg.get("identity_ldap", "sts_policy") or "").split(",")
                 if p.strip()]

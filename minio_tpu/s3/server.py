@@ -1188,10 +1188,19 @@ class S3Server:
             if not username or not password:
                 raise S3Error("InvalidRequest",
                               "LDAPUsername and LDAPPassword required")
-            validator = LDAPValidator.from_config(self.config)
+            try:
+                validator = LDAPValidator.from_config(self.config)
+            except LDAPError as e:  # enabled-but-misconfigured: say so
+                raise S3Error("InvalidRequest", str(e)) from None
             if validator is None:
                 raise S3Error("STSNotImplemented",
                               "identity_ldap is not configured")
+            policies = validator.policies
+            if not policies:
+                # Check BEFORE binding: an always-denied setup must not
+                # hammer the directory with real authentications.
+                raise S3Error("AccessDenied",
+                              "no sts_policy configured for LDAP identities")
             try:
                 # Blocking directory I/O stays off the event loop.
                 loop = asyncio.get_running_loop()
@@ -1199,10 +1208,6 @@ class S3Server:
                     None, validator.authenticate, username, password)
             except LDAPError as e:
                 raise S3Error("AccessDenied", str(e)) from None
-            policies = validator.policies
-            if not policies:
-                raise S3Error("AccessDenied",
-                              "no sts_policy configured for LDAP identities")
             tc = self.iam.assume_role_with_claims(
                 subject, policies, max(900, duration), session_policy)
         else:
