@@ -191,6 +191,75 @@ def paginate_cached(
                            objects=objects, prefixes=prefixes)
 
 
+def version_entries_from_journals(
+    journals: dict[str, XLMeta],
+    to_info: Callable[[str, FileInfo], object],
+) -> list[tuple[str, list]]:
+    """Rendered version stream for the metacache: per name, every version
+    newest-first INCLUDING delete markers (versions listings show them)."""
+    out = []
+    for name in sorted(journals):
+        try:
+            infos = [to_info(name, fi)
+                     for fi in journals[name].list_versions("", name)]
+        except se.StorageError:
+            continue
+        if infos:
+            out.append((name, infos))
+    return out
+
+
+def paginate_versions_cached(
+    entries: list[tuple[str, list]],
+    prefix: str = "",
+    marker: str = "",
+    version_marker: str = "",
+    delimiter: str = "",
+    max_keys: int = 1000,
+) -> ListObjectVersionsInfo:
+    """paginate_versions over a pre-rendered metacache version stream."""
+    out = ListObjectVersionsInfo()
+    seen_prefix: set[str] = set()
+    count = 0
+    for name, infos in entries:
+        if not name.startswith(prefix):
+            continue
+        if name == marker and version_marker:
+            pass  # resume mid-object below
+        elif _skip_for_marker(name, marker, delimiter) or name == marker:
+            continue
+        if delimiter:
+            rest = name[len(prefix):]
+            d = rest.find(delimiter)
+            if d >= 0:
+                cp = prefix + rest[: d + len(delimiter)]
+                if cp not in seen_prefix:
+                    if count + len(seen_prefix) >= max_keys:
+                        out.is_truncated = True
+                        return out
+                    seen_prefix.add(cp)
+                    out.prefixes.append(cp)
+                    out.next_marker = cp
+                    out.next_version_id_marker = ""
+                continue
+        skipping = name == marker and bool(version_marker)
+        for info in infos:
+            if skipping:
+                if info.version_id == version_marker:
+                    skipping = False
+                continue
+            if count + len(seen_prefix) >= max_keys:
+                out.is_truncated = True
+                return out
+            out.objects.append(info)
+            out.next_marker = name
+            out.next_version_id_marker = info.version_id
+            count += 1
+    out.next_marker = ""
+    out.next_version_id_marker = ""
+    return out
+
+
 def _skip_for_marker(name: str, marker: str, delimiter: str) -> bool:
     """Resume semantics: skip names at or before the marker; a marker that
     names a common prefix also skips everything under it (so NextMarker may

@@ -50,38 +50,55 @@ class Metacache:
     def _stale(self, bucket: str, created: float) -> bool:
         return created <= self._dirty_at.get(bucket, 0)
 
-    def recently_saved(self, bucket: str, prefix: str) -> bool:
+    def recently_saved(self, bucket: str, prefix: str,
+                       kind: str = "o") -> bool:
         """True while this node wrote the cache within ttl/2 and nothing
         mutated the bucket since — lets the pools skip re-rendering +
         re-persisting the stream on every truncated page-1 request of a
         hot bucket."""
-        saved = self._saved_at.get((bucket, prefix), 0)
+        saved = self._saved_at.get((bucket, prefix, kind), 0)
         return (time.time() - saved < self.ttl / 2
                 and not self._stale(bucket, saved))
 
-    def _path(self, bucket: str, prefix: str) -> str:
+    def _path(self, bucket: str, prefix: str, kind: str = "o") -> str:
         h = hashlib.sha1(prefix.encode()).hexdigest()[:16]
-        return f"{_PREFIX}/{bucket}/metacache/{h}"
+        return f"{_PREFIX}/{bucket}/metacache/{kind}-{h}"
 
-    def save(self, bucket: str, prefix: str,
-             entries: list[tuple[str, ObjectInfo]]) -> None:
+    # One save/load pair serves both stream kinds; only the entry shape
+    # differs ("o": (name, info), "v": (name, [infos])).
+
+    def _encode_entries(self, kind: str, entries: list) -> list:
+        if kind == "v":
+            return [(n, [dataclasses.asdict(oi) for oi in infos])
+                    for n, infos in entries]
+        return [(n, dataclasses.asdict(oi)) for n, oi in entries]
+
+    def _decode_entries(self, kind: str, raw_entries: list) -> list:
+        if kind == "v":
+            return [(n, [ObjectInfo(**d) for d in infos])
+                    for n, infos in raw_entries]
+        return [(n, ObjectInfo(**d)) for n, d in raw_entries]
+
+    def _save(self, bucket: str, prefix: str, entries: list,
+              kind: str) -> None:
         doc = {
             "v": 1, "bucket": bucket, "prefix": prefix,
             "created": time.time(),
-            "entries": [(n, dataclasses.asdict(oi)) for n, oi in entries],
+            "entries": self._encode_entries(kind, entries),
         }
         try:
-            self._store.write_sys_config(self._path(bucket, prefix), pack(doc))
-            self._saved_at[(bucket, prefix)] = time.time()
+            self._store.write_sys_config(
+                self._path(bucket, prefix, kind), pack(doc))
+            self._saved_at[(bucket, prefix, kind)] = time.time()
             if len(self._saved_at) > 4096:
                 self._saved_at.clear()
         except se.StorageError:
             pass  # cache is an optimization; never fail the listing
 
-    def load(self, bucket: str, prefix: str
-             ) -> list[tuple[str, ObjectInfo]] | None:
+    def _load(self, bucket: str, prefix: str, kind: str) -> list | None:
         try:
-            raw = self._store.read_sys_config(self._path(bucket, prefix))
+            raw = self._store.read_sys_config(
+                self._path(bucket, prefix, kind))
         except se.StorageError:
             self.misses += 1
             return None
@@ -93,18 +110,39 @@ class Metacache:
                 return None
             created = doc.get("created", 0)
             if time.time() - created > self.ttl or self._stale(bucket, created):
-                self.drop(bucket, prefix)
+                self.drop(bucket, prefix, kind)
                 self.misses += 1
                 return None
-            out = [(n, ObjectInfo(**d)) for n, d in doc["entries"]]
+            out = self._decode_entries(kind, doc["entries"])
         except (ValueError, TypeError, KeyError):
             self.misses += 1
             return None
         self.hits += 1
         return out
 
-    def drop(self, bucket: str, prefix: str = "") -> None:
+    def drop(self, bucket: str, prefix: str = "", kind: str = "o") -> None:
         try:
-            self._store.delete_sys_config(self._path(bucket, prefix))
+            self._store.delete_sys_config(self._path(bucket, prefix, kind))
         except se.StorageError:
             pass
+
+    # -- public surface --
+
+    def save(self, bucket: str, prefix: str,
+             entries: list[tuple[str, ObjectInfo]]) -> None:
+        self._save(bucket, prefix, entries, "o")
+
+    def load(self, bucket: str, prefix: str
+             ) -> list[tuple[str, ObjectInfo]] | None:
+        return self._load(bucket, prefix, "o")
+
+    def save_versions(self, bucket: str, prefix: str,
+                      entries: list[tuple[str, list]]) -> None:
+        self._save(bucket, prefix, entries, "v")
+
+    def load_versions(self, bucket: str, prefix: str
+                      ) -> list[tuple[str, list]] | None:
+        return self._load(bucket, prefix, "v")
+
+    def recently_saved_versions(self, bucket: str, prefix: str) -> bool:
+        return self.recently_saved(bucket, prefix, "v")

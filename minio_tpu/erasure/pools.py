@@ -281,11 +281,25 @@ class ErasureServerPools:
                              version_marker: str = "", delimiter: str = "",
                              max_keys: int = 1000) -> ListObjectVersionsInfo:
         self.get_bucket_info(bucket)
-        return listing.paginate_versions(
-            self.merged_journals(bucket, prefix),
-            lambda name, fi: listing.fi_to_object_info(bucket, name, fi),
-            prefix, marker, version_marker, delimiter, max_keys,
-        )
+        to_info = lambda name, fi: listing.fi_to_object_info(bucket, name, fi)  # noqa: E731
+        if marker:
+            cached = self.metacache.load_versions(bucket, prefix)
+            if cached is not None:
+                return listing.paginate_versions_cached(
+                    cached, prefix, marker, version_marker, delimiter,
+                    max_keys)
+        journals = self.merged_journals(bucket, prefix)
+        res = listing.paginate_versions(
+            journals, to_info, prefix, marker, version_marker, delimiter,
+            max_keys)
+        if res.is_truncated and not self.metacache.recently_saved_versions(
+                bucket, prefix):
+            # Scanner + client continuations seek into the persisted
+            # stream instead of re-walking every page.
+            self.metacache.save_versions(
+                bucket, prefix,
+                listing.version_entries_from_journals(journals, to_info))
+        return res
 
     # -- healing --
 

@@ -91,9 +91,14 @@ def simple_bind(address: str, dn: str, password: str,
     if not password:
         raise LDAPError("empty password (unauthenticated bind refused)")
     addr = address
-    for scheme in ("ldaps://", "ldap://"):
-        if addr.startswith(scheme):
-            addr = addr[len(scheme):]
+    # A URL scheme governs the transport (as the reference treats ldap
+    # addresses): ldaps:// forces TLS, ldap:// is explicit plaintext —
+    # either overrides the config flag so 'ldaps://… + tls=off' can never
+    # leak the directory password in cleartext.
+    if addr.startswith("ldaps://"):
+        addr, use_tls = addr[len("ldaps://"):], True
+    elif addr.startswith("ldap://"):
+        addr, use_tls = addr[len("ldap://"):], False
     if addr.startswith("["):          # IPv6 literal [::1]:636
         host, _, rest = addr[1:].partition("]")
         port = rest.lstrip(":")

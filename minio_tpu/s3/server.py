@@ -97,6 +97,17 @@ class S3Server:
         self.app = web.Application(client_max_size=1 << 30)
         self.app.router.add_route("*", "/{tail:.*}", self._entry)
 
+        # Security headers on every response, including prepared streams
+        # (reference addSecurityHeaders, cmd/generic-handlers.go).
+        async def _security_headers(_request, response):
+            response.headers.setdefault("X-Content-Type-Options", "nosniff")
+            response.headers.setdefault("X-XSS-Protection", "1; mode=block")
+            response.headers.setdefault(
+                "Content-Security-Policy", "block-all-mixed-content")
+            response.headers.setdefault("Server", "minio-tpu")
+
+        self.app.on_response_prepare.append(_security_headers)
+
         # Subsystems persist into the quorum sys store when the backend
         # provides one (erasure); memory-only otherwise.
         has_store = hasattr(object_layer, "read_sys_config")
@@ -979,7 +990,7 @@ class S3Server:
         if "content-type" in form:
             opts.user_defined["content-type"] = form["content-type"]
         for k, v in form.items():
-            if k.startswith("x-amz-meta-"):
+            if k.startswith("x-amz-meta-") and not _is_reserved_meta(k):
                 opts.user_defined[k] = v
         import io as _io
 
@@ -1747,10 +1758,10 @@ class S3Server:
         user_defined = dict(info.user_defined)
         user_defined["content-type"] = info.content_type
         if directive == "REPLACE":
-            user_defined = {
+            user_defined = sanitize_user_meta({
                 hk.lower(): hv for hk, hv in request.headers.items()
                 if hk.lower().startswith("x-amz-meta-")
-            }
+            })
             if request.headers.get("Content-Type"):
                 user_defined["content-type"] = request.headers["Content-Type"]
         # Strip source encryption bookkeeping; destination re-encrypts per
@@ -1984,9 +1995,28 @@ def _metadata_headers(request) -> dict:
     if repl:
         user_defined["x-amz-replication-status"] = repl
     for hk, hv in request.headers.items():
-        if hk.lower().startswith("x-amz-meta-"):
-            user_defined[hk.lower()] = hv
+        lk = hk.lower()
+        if lk.startswith("x-amz-meta-") and not _is_reserved_meta(lk):
+            user_defined[lk] = hv
     return user_defined
+
+
+def _is_reserved_meta(key: str) -> bool:
+    """Reserved-metadata filter (reference filterReservedMetadata,
+    cmd/generic-handlers.go): internal bookkeeping namespaces must never be
+    client-settable — a crafted header could otherwise forge SSE/transition
+    state, including via the gateway's packed meta key (whose payload
+    unpack_internal_meta would inject as x-mtpu-internal-*)."""
+    lk = key.lower()
+    suffix = lk[len("x-amz-meta-"):] if lk.startswith("x-amz-meta-") else lk
+    return suffix.startswith(("mtpu", "x-mtpu")) or "mtpu-internal" in suffix
+
+
+def sanitize_user_meta(meta: dict) -> dict:
+    """Drop reserved-namespace keys from client-supplied metadata — the
+    single sanitizer every metadata ingestion path (PUT headers, CopyObject
+    REPLACE, POST-policy forms) runs through."""
+    return {k: v for k, v in meta.items() if not _is_reserved_meta(k)}
 
 
 def _parse_copy_source(src: str):

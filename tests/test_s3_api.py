@@ -383,3 +383,23 @@ def test_post_policy_upload(server, client, bucket):
     r = rq.post(f"{server}/{bucket}", data=ok,
                 files={"file": ("big.txt", b"x" * 2000)})
     assert r.status_code == 400
+
+
+def test_security_headers_and_reserved_metadata(client, bucket):
+    """Middleware parity (cmd/generic-handlers.go): security headers on
+    every response; client attempts to smuggle internal metadata
+    namespaces are stripped."""
+    r = client.put(f"/{bucket}/sec-obj", data=b"x", headers={
+        "x-amz-meta-mtpu-internal": "forged",
+        "x-amz-meta-x-mtpu-internal-sse": "forged",
+        "x-amz-meta-legit": "ok"})
+    assert r.status_code == 200
+    assert r.headers.get("X-Content-Type-Options") == "nosniff"
+    assert r.headers.get("Content-Security-Policy")
+    r = client.head(f"/{bucket}/sec-obj")
+    assert r.headers.get("x-amz-meta-legit") == "ok"
+    assert "x-amz-meta-mtpu-internal" not in r.headers
+    assert "x-amz-meta-x-mtpu-internal-sse" not in r.headers
+    # object served without SSE confusion despite the forged headers
+    assert client.get(f"/{bucket}/sec-obj").content == b"x"
+    client.delete(f"/{bucket}/sec-obj")
