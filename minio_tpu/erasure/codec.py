@@ -70,6 +70,32 @@ class PendingEncode:
         return out_chunks, out_digs
 
 
+class PendingDecode:
+    """Handle to an in-flight rebuild launch (see begin_reconstruct)."""
+
+    def __init__(self, targets: tuple[int, ...], chunk_lens: list[int],
+                 rebuilt_dev, digs_dev):
+        self.targets = targets
+        self._lens = chunk_lens
+        self._rebuilt_dev = rebuilt_dev
+        self._digs_dev = digs_dev
+
+    def wait(self) -> tuple[list[list[bytes]], list[list[bytes]] | None]:
+        """-> (per block: rebuilt chunk per target, per block: digest per
+        target or None)."""
+        rebuilt = np.asarray(self._rebuilt_dev)
+        digs = (np.asarray(self._digs_dev)
+                if self._digs_dev is not None else None)
+        out_chunks, out_digs = [], [] if digs is not None else None
+        for bi, s in enumerate(self._lens):
+            out_chunks.append([rebuilt[bi, ti, :s].tobytes()
+                               for ti in range(len(self.targets))])
+            if out_digs is not None:
+                out_digs.append([bytes(digs[bi, ti])
+                                 for ti in range(len(self.targets))])
+        return out_chunks, out_digs
+
+
 class ErasureCodec:
     def __init__(self, data_blocks: int, parity_blocks: int,
                  block_size: int = DEFAULT_BLOCK_SIZE):
@@ -148,6 +174,57 @@ class ErasureCodec:
             return []
         chunks, _ = self.begin_encode(blocks).wait()
         return [[bytes(c) for c in row] for row in chunks]
+
+    def begin_reconstruct(self, shard_chunks: list[list[bytes | None]],
+                          block_lens: list[int],
+                          targets: tuple[int, ...],
+                          with_digests: bool = False) -> "PendingDecode":
+        """Queue one rebuild launch for a batch of blocks sharing a single
+        failure pattern (the heal loop's shape: one object, one drive
+        state). with_digests=True computes the rebuilt chunks' mxsum256
+        digests in the SAME launch (fused.reconstruct_with_digests) —
+        heal writes them straight into fresh [digest][chunk] shard files.
+        Returns immediately (JAX async dispatch): the heal loop reads the
+        next batch while the device rebuilds this one."""
+        import jax.numpy as jnp
+
+        from minio_tpu.ops import fused
+        from minio_tpu.utils import errors as se
+
+        if not shard_chunks:
+            return PendingDecode(tuple(targets), [], None, None)
+        n = self.k + self.m
+        s_full = self.shard_size()
+        pattern = [c is not None for c in shard_chunks[0]]
+        for row in shard_chunks[1:]:
+            if [c is not None for c in row] != pattern:
+                raise ValueError(
+                    "begin_reconstruct needs one failure pattern per batch "
+                    "(use decode_blocks for mixed patterns)")
+        present = [i for i in range(n) if pattern[i]]
+        if len(present) < self.k:
+            raise se.InsufficientReadQuorum(
+                "", "", f"only {len(present)} of {self.k} shards available")
+        survivors = tuple(present[: self.k])
+        chunk_lens = [_ceil_div(bl, self.k) for bl in block_lens]
+        # Survivor-compacted staging ([B, k, S], no dead parity rows) and
+        # the decode matrix as runtime data — the failure pattern stays
+        # out of the jit compile key (C(n, <=m) patterns exist; static
+        # args would recompile the kernel per pattern mid-sweep).
+        batch = np.zeros((len(shard_chunks), self.k, s_full), dtype=np.uint8)
+        for bi, row in enumerate(shard_chunks):
+            for ci, si in enumerate(survivors):
+                c = row[si]
+                batch[bi, ci, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+        from minio_tpu.ops import rs_pallas
+
+        w_t = jnp.asarray(rs_pallas._decode_weights_t(
+            self.k, n, survivors, tuple(targets)))
+        rebuilt_dev, digs_dev = fused.reconstruct_weights_digests(
+            jnp.asarray(batch), w_t,
+            jnp.asarray(chunk_lens, dtype=jnp.int32),
+            len(targets), with_digests=with_digests)
+        return PendingDecode(tuple(targets), chunk_lens, rebuilt_dev, digs_dev)
 
     # --- batched decode / reconstruct ---
 

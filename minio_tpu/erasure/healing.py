@@ -355,6 +355,23 @@ class HealingMixin:
                     readers[pos] = bitrot.BitrotReader(f, shard_data_size, shard_size, algo)
                 pool.start_part(part.number)
                 try:
+                    # Dispatch-ahead rebuild pipeline (mirrors the put
+                    # path's P2 shape): the host reads batch N+1's shards
+                    # while the device rebuilds batch N; rebuilt chunks +
+                    # their bitrot digests come out of ONE fused launch
+                    # when the algorithm is the device checksum.
+                    use_fused = algo == "mxsum256"
+                    t_tuple = tuple(targets)
+                    pending: list = []
+
+                    def drain_one() -> None:
+                        chunks_rows, dig_rows = pending.pop(0).wait()
+                        for j, chunks in enumerate(chunks_rows):
+                            for ti, pos in enumerate(t_tuple):
+                                d = (dig_rows[j][ti] if dig_rows is not None
+                                     else bitrot_algo.digest(chunks[ti]))
+                                pool.put(pos, d + chunks[ti])
+
                     n_blocks = max(1, -(-part.size // latest.erasure.block_size))
                     bi = 0
                     while bi < n_blocks:
@@ -371,28 +388,14 @@ class HealingMixin:
                             for pos in chosen:
                                 row[pos] = readers[pos].read_at(b * shard_size, chunk_len)
                             rows.append(row)
-                        rebuilt = codec.decode_blocks(rows, block_lens, need_all=True)
-                        if algo == "mxsum256":
-                            # Digest every rebuilt chunk in one device
-                            # launch (ops/fused.py) instead of per-chunk
-                            # host hashing.
-                            from minio_tpu.ops import fused
-
-                            flat = [rebuilt[j][pos]
-                                    for j in range(len(batch_ids))
-                                    for pos in targets]
-                            digs = fused.digest_chunks_host(flat, shard_size)
-                            di = 0
-                            for j in range(len(batch_ids)):
-                                for pos in targets:
-                                    pool.put(pos, digs[di] + rebuilt[j][pos])
-                                    di += 1
-                        else:
-                            for j in range(len(batch_ids)):
-                                for pos in targets:
-                                    chunk = rebuilt[j][pos]
-                                    pool.put(pos, bitrot_algo.digest(chunk) + chunk)
+                        pending.append(codec.begin_reconstruct(
+                            rows, block_lens, t_tuple,
+                            with_digests=use_fused))
+                        if len(pending) >= 2:
+                            drain_one()
                         bi = batch_ids[-1] + 1
+                    while pending:
+                        drain_one()
                 finally:
                     for r in readers.values():
                         try:

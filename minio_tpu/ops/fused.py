@@ -102,6 +102,52 @@ def reconstruct_with_digests(shards: jax.Array, k: int, n: int,
     return rebuilt, digs.reshape(b, t, mxsum.DIGEST_LEN)
 
 
+@functools.partial(jax.jit, static_argnames=("k", "n", "survivors", "targets"))
+def reconstruct_only(shards: jax.Array, k: int, n: int,
+                     survivors: tuple[int, ...],
+                     targets: tuple[int, ...]) -> jax.Array:
+    """Plain rebuild launch with kernel dispatch (host-hash algorithms):
+    shards [B, n, S] u8 -> [B, t, S] u8."""
+    return _reconstruct_dispatch(shards, k, n, survivors, targets)
+
+
+def _weights_matmul_dispatch(surv: jax.Array, w_t: jax.Array,
+                             out_shards: int) -> jax.Array:
+    """Runtime-weights contraction with kernel dispatch: surv [B, k, S],
+    w_t [t*8, k*8] (pre-transposed) -> [B, t, S]."""
+    b, _, s = surv.shape
+    if rs_pallas.use_pallas():
+        pad = (-s) % rs_pallas.TILE
+        if pad:
+            sp = jnp.pad(surv, ((0, 0), (0, 0), (0, pad)))
+            return rs_pallas.gf2_matmul_with_weights(
+                sp, w_t, out_shards)[:, :, :s]
+        return rs_pallas.gf2_matmul_with_weights(surv, w_t, out_shards)
+    return rs_xla.gf2_matmul_with_weights(surv, jnp.transpose(w_t),
+                                          out_shards)
+
+
+@functools.partial(jax.jit, static_argnames=("out_shards", "with_digests"))
+def reconstruct_weights_digests(surv: jax.Array, w_t: jax.Array,
+                                chunk_lens: jax.Array, out_shards: int,
+                                with_digests: bool = True):
+    """Heal rebuild with the decode matrix as RUNTIME DATA: the failure
+    pattern never enters the jit compile key, so a heal sweep over objects
+    with arbitrary drive states reuses one compiled program per shape
+    (there are C(n, <=m) patterns — making them static would recompile per
+    pattern and stall the sweep). surv is survivor-compacted [B, k, S];
+    w_t the pattern's [t*8, k*8] transposed decode matrix.
+
+    -> (rebuilt [B, t, S], digests [B, t, 32] | None)."""
+    b, _, s = surv.shape
+    rebuilt = _weights_matmul_dispatch(surv, w_t, out_shards)
+    if not with_digests:
+        return rebuilt, None
+    lens = jnp.repeat(chunk_lens, out_shards)
+    digs = mxsum.digest_device(rebuilt.reshape(b * out_shards, s), lens)
+    return rebuilt, digs.reshape(b, out_shards, mxsum.DIGEST_LEN)
+
+
 @jax.jit
 def verify_digests(chunks: jax.Array, lens: jax.Array) -> jax.Array:
     """Batched read-path verify: chunks [N, S] u8 (zero-padded rows),
