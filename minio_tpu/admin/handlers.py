@@ -82,6 +82,23 @@ class AdminAPI:
             if locker is not None:
                 dump = locker.dump()
             return _json({"locks": dump})
+        if op == "force-unlock" and m == "POST":
+            # Reference ForceUnlock (lock-rest ForceUnlockHandler): clear a
+            # stuck resource on THIS node's locker; in a cluster the admin
+            # runs it against each node holding the stale entry.
+            self._authorize(identity, "admin:ForceUnlock")
+            locker = getattr(self.s, "local_locker", None)
+            if locker is None:
+                raise S3Error("NotImplemented", "no local locker (not "
+                              "a distributed deployment)")
+            from minio_tpu.dist.dsync import LockArgs
+
+            paths = [p for p in q.get("paths", "").split(",") if p]
+            if not paths:
+                raise S3Error("InvalidArgument", "paths required")
+            locker.force_unlock(LockArgs(uid="", resources=paths,
+                                         owner="admin"))
+            return _json({"unlocked": paths})
 
         if op == "config-kv" or op == "config":
             return await self._config_kv(request, m, q, identity, run)

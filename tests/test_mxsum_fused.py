@@ -311,3 +311,21 @@ def test_begin_reconstruct_guards():
     starved = [[encoded[0][i] if i < 3 else None for i in range(6)]]
     with pytest.raises(se.InsufficientReadQuorum):
         codec.begin_reconstruct(starved, [4096], (4,))
+
+
+def test_verify_shard_file_batched_mxsum():
+    """Deep verify of mxsum shard files runs batched and still catches a
+    single flipped byte anywhere in the stream."""
+    buf = io.BytesIO()
+    w = bitrot.BitrotWriter(buf, shard_size=512, algorithm="mxsum256")
+    payload = rng.integers(0, 256, 40 * 512 + 77, dtype=np.uint8).tobytes()
+    for off in range(0, len(payload), 512):
+        w.write(payload[off:off + 512])
+    buf.seek(0)
+    bitrot.verify_shard_file(buf, len(payload), 512, "mxsum256")  # clean
+    raw = bytearray(buf.getvalue())
+    raw[37 * (32 + 512) + 32 + 100] ^= 1  # chunk 37, past the first batch
+    from minio_tpu.utils import errors as se
+    with pytest.raises(se.FileCorrupt):
+        bitrot.verify_shard_file(io.BytesIO(bytes(raw)), len(payload), 512,
+                                 "mxsum256")
