@@ -87,3 +87,77 @@ def test_bitrot_stream_with_sip256():
                             algorithm="sip256")
     with pytest.raises(Exception):
         r.read_at(0, len(payload))
+
+
+def test_native_kernels_under_tsan(tmp_path):
+    """Concurrency-hammer the native kernels under ThreadSanitizer
+    (SURVEY.md §5.2 — the Go -race role for the C++ bridge). TSan aborts
+    the subprocess on a data race; a clean exit is the assertion."""
+    import subprocess
+    import sys
+    import textwrap
+
+    so = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "libmtpu_native_tsan.so")
+    if not os.path.exists(so):
+        r = subprocess.run(["make", "-C", os.path.dirname(so), "tsan"],
+                           capture_output=True)
+        if r.returncode != 0 or not os.path.exists(so):
+            pytest.skip("no TSan toolchain")
+
+    script = textwrap.dedent(f"""
+        import ctypes, os, threading
+        lib = ctypes.CDLL({so!r})
+        lib.mtpu_sip256.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                    ctypes.c_uint64, ctypes.c_char_p]
+        lib.mtpu_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.mtpu_writer_open.restype = ctypes.c_void_p
+        lib.mtpu_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_uint64]
+        lib.mtpu_writer_write.restype = ctypes.c_int64
+        lib.mtpu_writer_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.mtpu_writer_close.restype = ctypes.c_int
+        key = bytes(range(32))
+        root = {str(tmp_path)!r}
+        failures = []
+
+        def hammer(tid):
+            try:
+                out = ctypes.create_string_buffer(32)
+                data = os.urandom(4096)
+                for i in range(200):
+                    lib.mtpu_sip256(key, data, len(data), out)
+                # use_direct=1: the O_DIRECT paths are what the writer
+                # exists for (falls back transparently on tmpfs)
+                h = lib.mtpu_writer_open(
+                    os.path.join(root, f"w{{tid}}").encode(), 1)
+                for i in range(50):
+                    assert lib.mtpu_writer_write(h, data, len(data)) == len(data)
+                assert lib.mtpu_writer_close(h, 1) == 0
+            except BaseException as e:
+                failures.append(repr(e))
+
+        ts = [threading.Thread(target=hammer, args=(t,)) for t in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not failures, failures
+        print("TSAN_CLEAN")
+    """)
+    # The TSan runtime must be in the process from the start — dlopen of
+    # an instrumented .so into an uninstrumented python needs LD_PRELOAD.
+    import shutil as _shutil
+
+    if not _shutil.which("gcc"):
+        pytest.skip("no gcc toolchain")
+    probe = subprocess.run(["gcc", "-print-file-name=libtsan.so"],
+                           capture_output=True, text=True)
+    libtsan = probe.stdout.strip()
+    if not libtsan or not os.path.exists(libtsan):
+        pytest.skip("libtsan runtime not found")
+    env = dict(os.environ, LD_PRELOAD=libtsan,
+               TSAN_OPTIONS="exitcode=66")
+    r = subprocess.run([sys.executable, "-c", script],
+                       capture_output=True, text=True, timeout=180, env=env)
+    assert "WARNING: ThreadSanitizer" not in r.stderr, r.stderr[:2000]
+    assert r.returncode == 0 and "TSAN_CLEAN" in r.stdout, \
+        (r.returncode, r.stderr[:2000])
