@@ -120,6 +120,8 @@ class WebAPI:
             "StorageInfo": self._storage_info,
             "CreateURLToken": self._create_url_token,
             "PresignedGet": self._presigned_get,
+            "GetBucketPolicy": self._get_bucket_policy,
+            "SetBucketPolicy": self._set_bucket_policy,
         }
         fn = handlers.get(short)
         if fn is None:
@@ -236,6 +238,81 @@ class WebAPI:
                 pass
         return {"healthy": h.get("healthy", False), "total": total,
                 "free": free}
+
+    async def _get_bucket_policy(self, ident, params):
+        """Canned anonymous-access level (reference GetBucketPolicy,
+        cmd/web-handlers.go): none | readonly | writeonly | readwrite —
+        classified by EVALUATING the stored policy for an anonymous
+        principal (the parser handles single-dict statements, principal
+        lists and resource scoping that a hand-rolled walk would not)."""
+        import asyncio
+
+        from minio_tpu.iam.policy import Policy, PolicyArgs
+
+        bucket = params["bucketName"]
+        if not self._allowed(ident, "s3:GetBucketPolicy", bucket):
+            raise PermissionError("GetBucketPolicy denied")
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, self.s.obj.get_bucket_info, bucket)  # 404 semantics
+        raw = self.s.bucket_meta.get(bucket).policy_json
+        level = "none"
+        if raw:
+            try:
+                bp = Policy.parse_cached(raw)
+
+                def anon_allows(action: str) -> bool:
+                    return bp.is_allowed(PolicyArgs(
+                        action=action, bucket=bucket, object="any-object",
+                        account="*"))
+
+                reads = anon_allows("s3:GetObject")
+                writes = anon_allows("s3:PutObject")
+                level = ("readwrite" if reads and writes else
+                         "readonly" if reads else
+                         "writeonly" if writes else "none")
+            except Exception:  # noqa: BLE001 - unparsable doc reads as none
+                pass
+        return {"policy": level}
+
+    async def _set_bucket_policy(self, ident, params):
+        """Apply a canned anonymous-access level (reference
+        SetBucketPolicy): writes the equivalent bucket policy document."""
+        import asyncio
+
+        bucket = params["bucketName"]
+        level = params.get("policy", "none")
+        if level not in ("none", "readonly", "writeonly", "readwrite"):
+            raise PermissionError(f"unknown policy level {level!r}")
+        if not self._allowed(ident, "s3:PutBucketPolicy", bucket):
+            raise PermissionError("PutBucketPolicy denied")
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, self.s.obj.get_bucket_info, bucket)  # 404 semantics
+        arn_b = f"arn:aws:s3:::{bucket}"
+        arn_o = f"arn:aws:s3:::{bucket}/*"
+        statements = []
+        if level in ("readonly", "readwrite"):
+            statements += [
+                {"Effect": "Allow", "Principal": {"AWS": ["*"]},
+                 "Action": ["s3:GetBucketLocation", "s3:ListBucket"],
+                 "Resource": [arn_b]},
+                {"Effect": "Allow", "Principal": {"AWS": ["*"]},
+                 "Action": ["s3:GetObject"], "Resource": [arn_o]},
+            ]
+        if level in ("writeonly", "readwrite"):
+            statements.append(
+                {"Effect": "Allow", "Principal": {"AWS": ["*"]},
+                 "Action": ["s3:PutObject", "s3:DeleteObject",
+                            "s3:AbortMultipartUpload",
+                            "s3:ListMultipartUploadParts"],
+                 "Resource": [arn_o]})
+        body = (b"" if not statements else json.dumps(
+            {"Version": "2012-10-17", "Statement": statements}).encode())
+        await loop.run_in_executor(
+            None, lambda: self.s.bucket_meta.update(
+                bucket, policy_json=body))
+        return {}
 
     async def _create_url_token(self, ident, params):
         return {"token": make_jwt(self._jwt_secret(), ident.access_key,

@@ -152,3 +152,28 @@ def test_browser_page_served(server):
     assert r.status_code == 200
     assert "text/html" in r.headers["Content-Type"]
     assert "minio-tpu console" in r.text and "webrpc" in r.text
+
+
+def test_web_bucket_policy_roundtrip(server):
+    """Canned policy levels through the console RPC grant real anonymous
+    access (reference Set/GetBucketPolicy web handlers)."""
+    base, _srv = server
+    token = _login(base, ACCESS, SECRET)
+    _rpc(base, "MakeBucket", {"bucketName": "polbkt"}, token)
+    doc = _rpc(base, "GetBucketPolicy", {"bucketName": "polbkt"}, token)
+    assert doc["result"]["policy"] == "none"
+    doc = _rpc(base, "SetBucketPolicy",
+               {"bucketName": "polbkt", "policy": "readonly"}, token)
+    assert "error" not in doc or doc["error"] is None
+    doc = _rpc(base, "GetBucketPolicy", {"bucketName": "polbkt"}, token)
+    assert doc["result"]["policy"] == "readonly"
+    # anonymous GET now works; anonymous PUT still refused
+    r = requests.put(f"{base}/minio/upload/polbkt/pub.txt", data=b"hi",
+                     headers={"Authorization": f"Bearer {token}"})
+    assert r.status_code == 200
+    assert requests.get(f"{base}/polbkt/pub.txt").content == b"hi"
+    assert requests.put(f"{base}/polbkt/other", data=b"x").status_code == 403
+    # back to private
+    _rpc(base, "SetBucketPolicy",
+         {"bucketName": "polbkt", "policy": "none"}, token)
+    assert requests.get(f"{base}/polbkt/pub.txt").status_code == 403
