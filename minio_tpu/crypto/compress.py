@@ -1,13 +1,19 @@
 """Transparent object compression (the klauspost/compress S2 role,
 cmd/object-api-utils.go:926 newS2CompressReader / isCompressible:440).
 
-zlib level-1 streaming (the stdlib's fastest wide-format codec) stands in
-for S2: the goal is cheap ingest compression gated by extension/MIME
-config, not maximum ratio. Compressed objects store
-x-mtpu-internal-compression plus the original size; GET decompresses
-transparently, and ranged GETs decompress-and-skip (sequential formats
-can't seek — the reference has the same constraint and stores skip
-indexes only for large objects).
+Two schemes, recorded per object in x-mtpu-internal-compression:
+
+- ``s2/1`` (default when the native lib is present): the snappy framing
+  format over native snappy blocks — 64 KiB frames, each carrying a masked
+  CRC32C of its plaintext, compressed by the C++ greedy matcher in
+  native/mtpu_native.cc. This is the real S2-role codec: LZ-class speed,
+  checksummed frames, incompressible frames stored raw.
+- ``zlib/1``: stdlib fallback when the native codec is unavailable.
+
+GET decompresses transparently by stored scheme; ranged GETs
+decompress-and-skip (sequential formats can't seek — the reference has the
+same constraint). Objects written with the native codec stay readable
+without it via a pure-Python snappy block decoder.
 """
 
 from __future__ import annotations
@@ -16,9 +22,28 @@ import fnmatch
 import zlib
 from typing import BinaryIO, Iterator
 
+from minio_tpu.native import lib as nativelib
+
 META_COMPRESSION = "x-mtpu-internal-compression"
 META_ACTUAL_SIZE = "x-mtpu-internal-uncompressed-size"
-SCHEME = "zlib/1"
+SCHEME_ZLIB = "zlib/1"
+SCHEME_S2 = "s2/1"
+
+# Snappy framing constants (the public framing format: stream identifier,
+# then 4-byte chunk headers [type, len24le] + payload).
+_STREAM_ID = b"\xff\x06\x00\x00sNaPpY"
+_CHUNK_COMPRESSED = 0x00
+_CHUNK_UNCOMPRESSED = 0x01
+_CHUNK_PADDING = 0xFE
+_FRAME_LEN = 1 << 16
+
+
+def default_scheme() -> str:
+    return SCHEME_S2 if nativelib.snappy_available() else SCHEME_ZLIB
+
+
+def _mask_crc(crc: int) -> int:
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
 
 
 def is_compressible(key: str, content_type: str,
@@ -35,24 +60,45 @@ def is_compressible(key: str, content_type: str,
 
 
 class CompressReader:
-    """File-like producing the zlib stream of an underlying reader."""
+    """File-like producing the compressed stream of an underlying reader."""
 
-    def __init__(self, src: BinaryIO):
+    def __init__(self, src: BinaryIO, scheme: str | None = None):
+        self.scheme = scheme or default_scheme()
         self._src = src
-        self._z = zlib.compressobj(level=1)
         self._buf = b""
         self._eof = False
         self.bytes_in = 0
+        if self.scheme == SCHEME_S2:
+            self._buf = _STREAM_ID
+            self._z = None
+        else:
+            self._z = zlib.compressobj(level=1)
+
+    def _pump(self) -> None:
+        chunk = self._src.read(_FRAME_LEN if self._z is None else 1 << 20)
+        if not chunk:
+            if self._z is not None:
+                self._buf += self._z.flush()
+            self._eof = True
+            return
+        self.bytes_in += len(chunk)
+        if self._z is not None:
+            self._buf += self._z.compress(chunk)
+            return
+        crc = _mask_crc(nativelib.crc32c(chunk))
+        body = nativelib.snappy_compress(chunk)
+        if len(body) >= len(chunk):  # incompressible frame: store raw
+            body, ctype = chunk, _CHUNK_UNCOMPRESSED
+        else:
+            ctype = _CHUNK_COMPRESSED
+        n = len(body) + 4
+        self._buf += bytes((ctype, n & 0xFF, (n >> 8) & 0xFF,
+                            (n >> 16) & 0xFF))
+        self._buf += crc.to_bytes(4, "little") + body
 
     def read(self, n: int = -1) -> bytes:
         while not self._eof and (n < 0 or len(self._buf) < n):
-            chunk = self._src.read(1 << 20)
-            if not chunk:
-                self._buf += self._z.flush()
-                self._eof = True
-                break
-            self.bytes_in += len(chunk)
-            self._buf += self._z.compress(chunk)
+            self._pump()
         if n < 0:
             out, self._buf = self._buf, b""
         else:
@@ -66,17 +112,90 @@ class CompressReader:
             pass
 
 
+def _s2_frames(it: Iterator[bytes]) -> Iterator[bytes]:
+    """Parse a snappy framing stream into plaintext frames, verifying each
+    frame's masked CRC32C."""
+    buf = bytearray()
+    pos = 0
+
+    def have(k: int) -> bool:
+        return len(buf) - pos >= k
+
+    it = iter(it)
+    exhausted = False
+    while True:
+        while not have(4) and not exhausted:
+            try:
+                buf += next(it)
+            except StopIteration:
+                exhausted = True
+        if not have(4):
+            if len(buf) - pos:
+                raise ValueError("truncated s2 stream (partial header)")
+            return
+        ctype = buf[pos]
+        clen = int.from_bytes(buf[pos + 1:pos + 4], "little")
+        while not have(4 + clen) and not exhausted:
+            try:
+                buf += next(it)
+            except StopIteration:
+                exhausted = True
+        if not have(4 + clen):
+            raise ValueError("truncated s2 stream (partial chunk)")
+        payload = bytes(buf[pos + 4:pos + 4 + clen])
+        pos += 4 + clen
+        if pos > (1 << 20):
+            del buf[:pos]
+            pos = 0
+        if ctype == 0xFF:  # stream identifier (may repeat at concat points)
+            if payload != _STREAM_ID[4:]:
+                raise ValueError("bad s2 stream identifier")
+            continue
+        if ctype == _CHUNK_PADDING or 0x80 <= ctype <= 0xFD:
+            continue  # padding / skippable
+        if ctype not in (_CHUNK_COMPRESSED, _CHUNK_UNCOMPRESSED):
+            raise ValueError(f"unskippable s2 chunk type {ctype:#x}")
+        if clen < 4:
+            raise ValueError("s2 chunk too short for checksum")
+        want = int.from_bytes(payload[:4], "little")
+        body = payload[4:]
+        if ctype == _CHUNK_COMPRESSED:
+            # Frames carry <= 64 KiB of plaintext (the framing-format cap);
+            # bound the decode so a corrupt length header can't balloon.
+            body = nativelib.snappy_uncompress(body, max_len=_FRAME_LEN)
+        elif len(body) > _FRAME_LEN:
+            raise ValueError("oversized s2 uncompressed chunk")
+        if _mask_crc(nativelib.crc32c(body)) != want:
+            raise ValueError("s2 frame checksum mismatch")
+        yield body
+
+
 def decompress_iter(it: Iterator[bytes], offset: int = 0,
-                    length: int = -1) -> Iterator[bytes]:
-    """Decompress a zlib stream, yielding [offset, offset+length) of the
-    plaintext."""
-    z = zlib.decompressobj()
+                    length: int = -1,
+                    scheme: str = SCHEME_ZLIB) -> Iterator[bytes]:
+    """Decompress a stored stream, yielding [offset, offset+length) of the
+    plaintext. `scheme` is the object's recorded META_COMPRESSION value."""
+    if scheme == SCHEME_S2:
+        src: Iterator[bytes] = _s2_frames(it)
+    elif scheme == SCHEME_ZLIB:
+        z = zlib.decompressobj()
+
+        def _zlib_chunks() -> Iterator[bytes]:
+            for chunk in it:
+                out = z.decompress(chunk)
+                if out:
+                    yield out
+            tail = z.flush()
+            if tail:
+                yield tail
+
+        src = _zlib_chunks()
+    else:
+        raise ValueError(f"unknown compression scheme {scheme!r}")
+
     skip = offset
     remaining = length
-    for chunk in it:
-        out = z.decompress(chunk)
-        if not out:
-            continue
+    for out in src:
         if skip:
             if len(out) <= skip:
                 skip -= len(out)
@@ -89,9 +208,3 @@ def decompress_iter(it: Iterator[bytes], offset: int = 0,
                 return
             remaining -= len(out)
         yield out
-    tail = z.flush()
-    if tail and not skip:
-        if remaining >= 0:
-            tail = tail[:remaining]
-        if tail:
-            yield tail

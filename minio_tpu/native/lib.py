@@ -47,6 +47,20 @@ def _build_and_load():
         lib.mtpu_pread.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
                                    ctypes.c_uint64, ctypes.c_uint64]
         lib.mtpu_pread.restype = ctypes.c_int64
+        lib.mtpu_snappy_max_compressed.argtypes = [ctypes.c_uint64]
+        lib.mtpu_snappy_max_compressed.restype = ctypes.c_uint64
+        lib.mtpu_snappy_compress.argtypes = [ctypes.c_char_p,
+                                             ctypes.c_uint64, ctypes.c_char_p]
+        lib.mtpu_snappy_compress.restype = ctypes.c_int64
+        lib.mtpu_snappy_uncompressed_len.argtypes = [ctypes.c_char_p,
+                                                     ctypes.c_uint64]
+        lib.mtpu_snappy_uncompressed_len.restype = ctypes.c_int64
+        lib.mtpu_snappy_uncompress.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64]
+        lib.mtpu_snappy_uncompress.restype = ctypes.c_int64
+        lib.mtpu_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.mtpu_crc32c.restype = ctypes.c_uint32
         _lib = lib
         return _lib
 
@@ -187,6 +201,146 @@ class DirectWriter:
 
     def __exit__(self, *exc):
         self.close(sync=exc[0] is None)
+
+
+# --- snappy block codec + crc32c (the S2 compression role) -------------------
+
+def snappy_available() -> bool:
+    return _build_and_load() is not None
+
+
+def snappy_compress(data: bytes) -> bytes:
+    """Snappy-format block compression of `data` (native only — callers
+    check snappy_available() and fall back to another scheme)."""
+    lib = _build_and_load()
+    if lib is None:
+        raise OSError("native snappy codec unavailable")
+    out = ctypes.create_string_buffer(
+        lib.mtpu_snappy_max_compressed(len(data)))
+    n = lib.mtpu_snappy_compress(data, len(data), out)
+    if n < 0:
+        raise OSError("snappy compress failed")
+    return out.raw[:n]
+
+
+def snappy_uncompress(data: bytes, max_len: int = 1 << 26) -> bytes:
+    """Decode one snappy block; raises ValueError on malformed input.
+
+    `max_len` bounds the claimed uncompressed length BEFORE any allocation:
+    the length header is corruption/attacker-controlled, so a bit-rotted
+    block must not trigger a multi-GiB buffer. Callers that know their
+    framing (e.g. 64 KiB s2 frames) pass a tight bound."""
+    lib = _build_and_load()
+    if lib is None:
+        return _snappy_uncompress_py(data, max_len)
+    ulen = lib.mtpu_snappy_uncompressed_len(data, len(data))
+    if ulen < 0 or ulen > max_len:
+        raise ValueError("corrupt snappy block (bad length header)")
+    out = ctypes.create_string_buffer(ulen) if ulen else b""
+    if ulen == 0:
+        # Zero-length payload: still validate the varint-only block.
+        if lib.mtpu_snappy_uncompress(data, len(data), b"", 0) != 0:
+            raise ValueError("corrupt snappy block")
+        return b""
+    n = lib.mtpu_snappy_uncompress(data, len(data), out, ulen)
+    if n != ulen:
+        raise ValueError("corrupt snappy block")
+    return out.raw
+
+
+def _snappy_uncompress_py(data: bytes, max_len: int = 1 << 26) -> bytes:
+    """Pure-Python snappy block decoder — the read-side fallback so objects
+    written with the native codec stay readable on hosts without it."""
+    i = 0
+    ulen = 0
+    shift = 0
+    while True:
+        if i >= len(data) or shift >= 35:
+            raise ValueError("corrupt snappy block (bad length header)")
+        b = data[i]
+        i += 1
+        ulen |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    if ulen > max_len:
+        raise ValueError("corrupt snappy block (bad length header)")
+    out = bytearray()
+    n = len(data)
+    while i < n:
+        tag = data[i]
+        i += 1
+        kind = tag & 3
+        if kind == 0:
+            l6 = tag >> 2
+            if l6 < 60:
+                length = l6 + 1
+            else:
+                nb = l6 - 59
+                if i + nb > n:
+                    raise ValueError("corrupt snappy literal")
+                length = int.from_bytes(data[i:i + nb], "little") + 1
+                i += nb
+            if i + length > n:
+                raise ValueError("corrupt snappy literal")
+            out += data[i:i + length]
+            i += length
+            continue
+        if kind == 1:
+            if i >= n:
+                raise ValueError("corrupt snappy copy")
+            length = 4 + ((tag >> 2) & 7)
+            offset = ((tag >> 5) << 8) | data[i]
+            i += 1
+        elif kind == 2:
+            if i + 2 > n:
+                raise ValueError("corrupt snappy copy")
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[i:i + 2], "little")
+            i += 2
+        else:
+            if i + 4 > n:
+                raise ValueError("corrupt snappy copy")
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[i:i + 4], "little")
+            i += 4
+        if offset == 0 or offset > len(out):
+            raise ValueError("corrupt snappy copy offset")
+        if offset >= length:
+            start = len(out) - offset
+            out += out[start:start + length]
+        else:
+            for _ in range(length):
+                out.append(out[-offset])
+    if len(out) != ulen:
+        raise ValueError("snappy length mismatch")
+    return bytes(out)
+
+
+_CRC32C_POLY = 0x82F63B78
+_crc32c_table_py: list[int] = []
+
+
+def crc32c(data: bytes) -> int:
+    global _crc32c_table_py
+    lib = _build_and_load()
+    if lib is not None:
+        return lib.mtpu_crc32c(data, len(data))
+    if not _crc32c_table_py:
+        # Build into a local then swap: concurrent first callers must never
+        # observe (or interleave appends into) a half-built shared table.
+        table = []
+        for b in range(256):
+            c = b
+            for _ in range(8):
+                c = (c >> 1) ^ (_CRC32C_POLY if c & 1 else 0)
+            table.append(c)
+        _crc32c_table_py = table
+    tbl = _crc32c_table_py
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = tbl[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
 
 
 def pread(path: str, offset: int, length: int) -> bytes:

@@ -1268,8 +1268,9 @@ class S3Server:
             return spool, size
         if size >= 0:
             opts.user_defined[czip.META_ACTUAL_SIZE] = str(size)
-        opts.user_defined[czip.META_COMPRESSION] = czip.SCHEME
-        return czip.CompressReader(spool), -1
+        scheme = czip.default_scheme()
+        opts.user_defined[czip.META_COMPRESSION] = scheme
+        return czip.CompressReader(spool, scheme), -1
 
     def _sse_setup(self, request, bucket: str, key: str,
                    user_defined: dict) -> bytes | None:
@@ -1526,7 +1527,9 @@ class S3Server:
             info, stream = await run(self.obj.get_object, bucket, key,
                                      0, -1, opts)
             return (info,
-                    czip.decompress_iter(stream, offset, length),
+                    czip.decompress_iter(
+                        stream, offset, length,
+                        scheme=pre.user_defined[czip.META_COMPRESSION]),
                     actual if actual >= 0 else pre.size)
         if sse.META_ALGO not in pre.user_defined:
             if length < 0:

@@ -254,4 +254,286 @@ int64_t mtpu_pread(const char* path, uint8_t* out, uint64_t offset,
   return static_cast<int64_t>(total);
 }
 
+// ---------------------------------------------------------------------------
+// Snappy-format block codec — the klauspost/compress S2 role (SURVEY §2.3;
+// reference ingest compression cmd/object-api-utils.go:926). The block
+// format is the public snappy encoding: a varint uncompressed length, then
+// literal / copy elements (tag low 2 bits: 00 literal, 01 copy-1byte-offset,
+// 10 copy-2byte-offset, 11 copy-4byte-offset). The compressor is a greedy
+// hash-table matcher over 64 KiB fragments, so offsets always fit copy1/2.
+// Framing (stream chunking + CRC32C) lives host-side in Python; the byte
+// crunching lives here.
+// ---------------------------------------------------------------------------
+
+static inline uint32_t load32(const uint8_t* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+static const int kSnapHashBits = 14;
+
+static inline uint32_t snap_hash(uint32_t v) {
+  return (v * 0x1e35a7bdu) >> (32 - kSnapHashBits);
+}
+
+static inline uint8_t* emit_literal(uint8_t* op, const uint8_t* lit,
+                                    uint32_t len) {
+  uint32_t n = len - 1;
+  if (n < 60) {
+    *op++ = static_cast<uint8_t>(n << 2);
+  } else if (n < (1u << 8)) {
+    *op++ = 60 << 2;
+    *op++ = static_cast<uint8_t>(n);
+  } else if (n < (1u << 16)) {
+    *op++ = 61 << 2;
+    *op++ = static_cast<uint8_t>(n);
+    *op++ = static_cast<uint8_t>(n >> 8);
+  } else if (n < (1u << 24)) {
+    *op++ = 62 << 2;
+    *op++ = static_cast<uint8_t>(n);
+    *op++ = static_cast<uint8_t>(n >> 8);
+    *op++ = static_cast<uint8_t>(n >> 16);
+  } else {
+    *op++ = 63 << 2;
+    *op++ = static_cast<uint8_t>(n);
+    *op++ = static_cast<uint8_t>(n >> 8);
+    *op++ = static_cast<uint8_t>(n >> 16);
+    *op++ = static_cast<uint8_t>(n >> 24);
+  }
+  memcpy(op, lit, len);
+  return op + len;
+}
+
+static inline uint8_t* emit_copy(uint8_t* op, uint32_t offset, uint32_t len) {
+  // First element must keep >= 4 bytes for the tail so every emitted copy
+  // is encodable (copy1 min length 4, copy2 covers 1..64).
+  while (len >= 68) {
+    *op++ = (63 << 2) | 2;  // copy2, length 64
+    *op++ = static_cast<uint8_t>(offset);
+    *op++ = static_cast<uint8_t>(offset >> 8);
+    len -= 64;
+  }
+  if (len > 64) {
+    *op++ = (59 << 2) | 2;  // copy2, length 60 — leaves a 4..8 byte tail
+    *op++ = static_cast<uint8_t>(offset);
+    *op++ = static_cast<uint8_t>(offset >> 8);
+    len -= 60;
+  }
+  if (len >= 12 || offset >= 2048) {
+    *op++ = static_cast<uint8_t>(((len - 1) << 2) | 2);
+    *op++ = static_cast<uint8_t>(offset);
+    *op++ = static_cast<uint8_t>(offset >> 8);
+  } else {
+    *op++ = static_cast<uint8_t>(((offset >> 8) << 5) | ((len - 4) << 2) | 1);
+    *op++ = static_cast<uint8_t>(offset);
+  }
+  return op;
+}
+
+static uint8_t* snap_compress_fragment(const uint8_t* src, uint32_t len,
+                                       uint8_t* op, uint16_t* table) {
+  memset(table, 0, sizeof(uint16_t) << kSnapHashBits);
+  const uint8_t* ip = src;
+  const uint8_t* end = src + len;
+  const uint8_t* lit = src;
+  if (len >= 16) {
+    const uint8_t* limit = end - 15;  // room for load32 + match extension
+    while (ip < limit) {
+      uint32_t v = load32(ip);
+      uint32_t h = snap_hash(v);
+      const uint8_t* cand = src + table[h];
+      table[h] = static_cast<uint16_t>(ip - src);
+      if (cand < ip && load32(cand) == v) {
+        const uint8_t* m = ip + 4;
+        const uint8_t* c = cand + 4;
+        while (m < end && *m == *c) {
+          ++m;
+          ++c;
+        }
+        if (lit < ip) op = emit_literal(op, lit, ip - lit);
+        op = emit_copy(op, ip - cand, m - ip);
+        ip = m;
+        lit = ip;
+        if (ip < limit)
+          table[snap_hash(load32(ip - 1))] = static_cast<uint16_t>(ip - 1 - src);
+      } else {
+        ++ip;
+      }
+    }
+  }
+  if (lit < end) op = emit_literal(op, lit, end - lit);
+  return op;
+}
+
+uint64_t mtpu_snappy_max_compressed(uint64_t n) {
+  return 32 + n + n / 6;
+}
+
+int64_t mtpu_snappy_compress(const uint8_t* in, uint64_t n, uint8_t* out) {
+  uint8_t* op = out;
+  uint64_t v = n;
+  while (v >= 0x80) {
+    *op++ = static_cast<uint8_t>(v) | 0x80;
+    v >>= 7;
+  }
+  *op++ = static_cast<uint8_t>(v);
+  static thread_local uint16_t table[1 << kSnapHashBits];
+  uint64_t pos = 0;
+  while (pos < n) {
+    uint64_t frag = n - pos < 65536 ? n - pos : 65536;
+    op = snap_compress_fragment(in + pos, static_cast<uint32_t>(frag), op,
+                                table);
+    pos += frag;
+  }
+  return op - out;
+}
+
+static int64_t snap_varint(const uint8_t* in, uint64_t n, uint64_t* val) {
+  uint64_t v = 0;
+  int shift = 0;
+  uint64_t i = 0;
+  while (i < n && shift < 35) {
+    uint8_t b = in[i++];
+    v |= static_cast<uint64_t>(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *val = v;
+      return static_cast<int64_t>(i);
+    }
+    shift += 7;
+  }
+  return -1;
+}
+
+int64_t mtpu_snappy_uncompressed_len(const uint8_t* in, uint64_t n) {
+  uint64_t v;
+  if (snap_varint(in, n, &v) < 0) return -1;
+  return static_cast<int64_t>(v);
+}
+
+int64_t mtpu_snappy_uncompress(const uint8_t* in, uint64_t n, uint8_t* out,
+                               uint64_t cap) {
+  uint64_t ulen;
+  int64_t hdr = snap_varint(in, n, &ulen);
+  if (hdr < 0 || ulen > cap) return -1;
+  uint64_t i = static_cast<uint64_t>(hdr);
+  uint8_t* op = out;
+  uint8_t* oend = out + ulen;
+  while (i < n) {
+    uint8_t tag = in[i++];
+    uint32_t len, offset;
+    if ((tag & 3) == 0) {
+      uint32_t l6 = tag >> 2;
+      if (l6 < 60) {
+        len = l6 + 1;
+      } else {
+        uint32_t nb = l6 - 59;  // 1..4 extra length bytes
+        if (i + nb > n) return -1;
+        len = 0;
+        for (uint32_t k = 0; k < nb; ++k) len |= in[i + k] << (8 * k);
+        i += nb;
+        if (len == 0xffffffffu) return -1;
+        len += 1;
+      }
+      if (i + len > n || op + len > oend) return -1;
+      memcpy(op, in + i, len);
+      op += len;
+      i += len;
+      continue;
+    }
+    if ((tag & 3) == 1) {
+      if (i + 1 > n) return -1;
+      len = 4 + ((tag >> 2) & 7);
+      offset = (static_cast<uint32_t>(tag >> 5) << 8) | in[i];
+      i += 1;
+    } else if ((tag & 3) == 2) {
+      if (i + 2 > n) return -1;
+      len = (tag >> 2) + 1;
+      offset = in[i] | (static_cast<uint32_t>(in[i + 1]) << 8);
+      i += 2;
+    } else {
+      if (i + 4 > n) return -1;
+      len = (tag >> 2) + 1;
+      offset = load32(in + i);
+      i += 4;
+    }
+    if (offset == 0 || static_cast<uint64_t>(op - out) < offset ||
+        op + len > oend)
+      return -1;
+    const uint8_t* from = op - offset;
+    if (offset >= len) {
+      memcpy(op, from, len);
+      op += len;
+    } else {
+      for (uint32_t k = 0; k < len; ++k) op[k] = from[k];
+      op += len;
+    }
+  }
+  return op == oend ? static_cast<int64_t>(ulen) : -1;
+}
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli) — the framing checksum. Hardware SSE4.2 when the
+// build arch has it (-march=native), else a slice-by-8 software table.
+// ---------------------------------------------------------------------------
+
+#if defined(__SSE4_2__)
+#include <nmmintrin.h>
+
+uint32_t mtpu_crc32c(const uint8_t* data, uint64_t len) {
+  uint64_t crc = 0xffffffffu;
+  while (len >= 8) {
+    uint64_t v;
+    memcpy(&v, data, 8);
+    crc = _mm_crc32_u64(crc, v);
+    data += 8;
+    len -= 8;
+  }
+  uint32_t c = static_cast<uint32_t>(crc);
+  while (len--) c = _mm_crc32_u8(c, *data++);
+  return c ^ 0xffffffffu;
+}
+
+#else
+
+static uint32_t crc32c_table[8][256];
+
+// Table built at load time (static init) so concurrent first calls from
+// many threads never race on it.
+static struct Crc32cInit {
+  Crc32cInit() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c >> 1) ^ (0x82f63b78u & (0u - (c & 1)));
+      crc32c_table[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = crc32c_table[0][i];
+      for (int t = 1; t < 8; ++t) {
+        c = crc32c_table[0][c & 0xff] ^ (c >> 8);
+        crc32c_table[t][i] = c;
+      }
+    }
+  }
+} crc32c_initializer;
+
+uint32_t mtpu_crc32c(const uint8_t* data, uint64_t len) {
+  uint32_t crc = 0xffffffffu;
+  while (len >= 8) {
+    crc ^= load32(data);
+    uint32_t hi = load32(data + 4);
+    crc = crc32c_table[7][crc & 0xff] ^ crc32c_table[6][(crc >> 8) & 0xff] ^
+          crc32c_table[5][(crc >> 16) & 0xff] ^ crc32c_table[4][crc >> 24] ^
+          crc32c_table[3][hi & 0xff] ^ crc32c_table[2][(hi >> 8) & 0xff] ^
+          crc32c_table[1][(hi >> 16) & 0xff] ^ crc32c_table[0][hi >> 24];
+    data += 8;
+    len -= 8;
+  }
+  while (len--) crc = crc32c_table[0][(crc ^ *data++) & 0xff] ^ (crc >> 8);
+  return crc ^ 0xffffffffu;
+}
+
+#endif  // __SSE4_2__
+
 }  // extern "C"

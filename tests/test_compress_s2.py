@@ -1,0 +1,130 @@
+"""Native snappy/S2-role codec tests: block round-trips, the pure-Python
+fallback decoder, CRC32C, framing, ranged decompression, and corruption
+detection (reference role: klauspost/compress S2,
+cmd/object-api-utils.go:926)."""
+
+import io
+import os
+import random
+
+import pytest
+
+from minio_tpu.crypto import compress as czip
+from minio_tpu.native import lib as nativelib
+
+pytestmark = pytest.mark.skipif(
+    not nativelib.snappy_available(), reason="native codec unavailable")
+
+
+def _payloads():
+    rng = random.Random(7)
+    return [
+        b"",
+        b"x",
+        b"abc" * 5,
+        b"hello world " * 10000,          # long repeated matches
+        os.urandom(70000),                 # incompressible, > 1 fragment
+        bytes(rng.randrange(4) for _ in range(200000)),  # low-entropy
+        b"\x00" * (1 << 18),               # maximal run
+        b"ab" * 100,                       # short-offset overlapping copies
+    ]
+
+
+def test_block_roundtrip_native_and_py():
+    for data in _payloads():
+        c = nativelib.snappy_compress(data)
+        assert nativelib.snappy_uncompress(c) == data
+        assert nativelib._snappy_uncompress_py(c) == data
+
+
+def test_block_corrupt_rejected():
+    c = bytearray(nativelib.snappy_compress(b"payload " * 1000))
+    c = c[: len(c) // 2]  # truncated
+    with pytest.raises(ValueError):
+        nativelib.snappy_uncompress(bytes(c))
+    with pytest.raises(ValueError):
+        nativelib._snappy_uncompress_py(bytes(c))
+
+
+def test_corrupt_length_header_rejected_before_allocation():
+    # A block whose varint claims 2 GiB must be rejected up front, not
+    # allocated: the header is corruption-controlled.
+    huge = (0x80 | 0x00, 0x80, 0x80, 0x80, 0x08)  # varint 2**31
+    blk = bytes(huge) + b"\x00" * 16
+    with pytest.raises(ValueError):
+        nativelib.snappy_uncompress(blk, max_len=1 << 16)
+    with pytest.raises(ValueError):
+        nativelib._snappy_uncompress_py(blk, max_len=1 << 16)
+
+
+def test_crc32c_vectors():
+    # RFC 3720 / public CRC32C check values.
+    assert nativelib.crc32c(b"123456789") == 0xE3069283
+    assert nativelib.crc32c(b"") == 0x0
+    assert nativelib.crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_framing_roundtrip_and_ranges():
+    data = (b"The quick brown fox jumps over the lazy dog. " * 9000
+            + os.urandom(50000))
+    r = czip.CompressReader(io.BytesIO(data), czip.SCHEME_S2)
+    stream = b""
+    while True:
+        chunk = r.read(12345)
+        if not chunk:
+            break
+        stream += chunk
+    assert r.bytes_in == len(data)
+    assert stream.startswith(b"\xff\x06\x00\x00sNaPpY")
+    assert len(stream) < len(data)  # mostly compressible payload
+
+    # Full read, chunked arbitrarily.
+    def chunks(b, n=7777):
+        for i in range(0, len(b), n):
+            yield b[i:i + n]
+
+    out = b"".join(czip.decompress_iter(chunks(stream),
+                                        scheme=czip.SCHEME_S2))
+    assert out == data
+
+    # Ranged reads across frame boundaries.
+    for off, ln in [(0, 10), (65530, 20), (65536, 1), (100000, 300000),
+                    (len(data) - 5, 5), (131072, 65536)]:
+        got = b"".join(czip.decompress_iter(chunks(stream), off, ln,
+                                            scheme=czip.SCHEME_S2))
+        assert got == data[off:off + ln], (off, ln)
+
+
+def test_framing_checksum_mismatch_detected():
+    data = b"payload " * 30000
+    r = czip.CompressReader(io.BytesIO(data), czip.SCHEME_S2)
+    stream = bytearray(r.read(-1))
+    # Flip one byte inside the first frame body (past stream id + header + crc).
+    stream[len(b"\xff\x06\x00\x00sNaPpY") + 9] ^= 0xFF
+    with pytest.raises(ValueError):
+        b"".join(czip.decompress_iter(iter([bytes(stream)]),
+                                      scheme=czip.SCHEME_S2))
+
+
+def test_framing_incompressible_stored_raw():
+    data = os.urandom(65536)
+    r = czip.CompressReader(io.BytesIO(data), czip.SCHEME_S2)
+    stream = r.read(-1)
+    # One uncompressed chunk (type 0x01) after the stream id.
+    assert stream[len(b"\xff\x06\x00\x00sNaPpY")] == 0x01
+    out = b"".join(czip.decompress_iter(iter([stream]),
+                                        scheme=czip.SCHEME_S2))
+    assert out == data
+
+
+def test_zlib_scheme_still_readable():
+    data = b"legacy zlib object " * 5000
+    r = czip.CompressReader(io.BytesIO(data), czip.SCHEME_ZLIB)
+    stream = r.read(-1)
+    out = b"".join(czip.decompress_iter(iter([stream]), 1000, 2000,
+                                        scheme=czip.SCHEME_ZLIB))
+    assert out == data[1000:3000]
+
+
+def test_default_scheme_is_s2_with_native():
+    assert czip.default_scheme() == czip.SCHEME_S2
