@@ -44,7 +44,9 @@ DEFAULTS: dict[str, dict[str, str]] = {
     "identity_ldap": {"enable": "off", "server_addr": "",
                       "user_dn_format": "", "sts_policy": "",
                       "tls": "on", "tls_skip_verify": "off"},
-    "kms": {"enable": "off", "key_file": "", "default_key": ""},
+    "kms": {"enable": "off", "key_file": "", "default_key": "",
+            "kes_endpoint": "", "kes_client_cert": "", "kes_client_key": "",
+            "kes_ca_file": ""},
 }
 
 # Subsystems that apply without restart (cmd/config/config.go:133).

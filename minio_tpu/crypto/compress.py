@@ -65,11 +65,14 @@ class CompressReader:
     def __init__(self, src: BinaryIO, scheme: str | None = None):
         self.scheme = scheme or default_scheme()
         self._src = src
-        self._buf = b""
+        # bytearray, not bytes: S2 pumps 64 KiB frames, and immutable
+        # concatenation would re-copy the whole buffer per frame
+        # (quadratic on large buffered reads).
+        self._buf = bytearray()
         self._eof = False
         self.bytes_in = 0
         if self.scheme == SCHEME_S2:
-            self._buf = _STREAM_ID
+            self._buf += _STREAM_ID
             self._z = None
         else:
             self._z = zlib.compressobj(level=1)
@@ -100,9 +103,10 @@ class CompressReader:
         while not self._eof and (n < 0 or len(self._buf) < n):
             self._pump()
         if n < 0:
-            out, self._buf = self._buf, b""
+            out, self._buf = bytes(self._buf), bytearray()
         else:
-            out, self._buf = self._buf[:n], self._buf[n:]
+            out = bytes(self._buf[:n])
+            del self._buf[:n]
         return out
 
     def close(self) -> None:

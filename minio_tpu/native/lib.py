@@ -283,6 +283,8 @@ def _snappy_uncompress_py(data: bytes, max_len: int = 1 << 26) -> bytes:
                 i += nb
             if i + length > n:
                 raise ValueError("corrupt snappy literal")
+            if len(out) + length > ulen:
+                raise ValueError("snappy output exceeds declared length")
             out += data[i:i + length]
             i += length
             continue
@@ -306,6 +308,10 @@ def _snappy_uncompress_py(data: bytes, max_len: int = 1 << 26) -> bytes:
             i += 4
         if offset == 0 or offset > len(out):
             raise ValueError("corrupt snappy copy offset")
+        # Bound as we go: copy tags amplify 3 bytes in -> up to 64 out, so
+        # a crafted block must not balloon past the declared length.
+        if len(out) + length > ulen:
+            raise ValueError("snappy output exceeds declared length")
         if offset >= length:
             start = len(out) - offset
             out += out[start:start + length]

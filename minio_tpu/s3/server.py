@@ -159,12 +159,11 @@ class S3Server:
         from minio_tpu.admin.profiling import Profiler
         self.profiler = Profiler()
 
-        # KMS for SSE-KMS envelope encryption (cmd/crypto/kes.go role;
-        # local master-key backend first).
-        from minio_tpu.crypto.kms import LocalKMS
-        self.kms = LocalKMS(
-            key_file=self.config.get("kms", "key_file") or "",
-            default_key_id=self.config.get("kms", "default_key") or "")
+        # KMS for SSE-KMS envelope encryption (cmd/crypto/kes.go role):
+        # a networked KES backend when kms.kes_endpoint is configured,
+        # else local master keys.
+        from minio_tpu.crypto.kes import kms_from_config
+        self.kms = kms_from_config(self.config)
 
         # ILM tiers (transition targets; reference tier subsystem).
         from minio_tpu.scanner.tiers import TierRegistry, set_global

@@ -57,6 +57,21 @@ def test_corrupt_length_header_rejected_before_allocation():
         nativelib._snappy_uncompress_py(blk, max_len=1 << 16)
 
 
+def test_py_decoder_bounds_output_amplification():
+    # A block declaring a small ulen but packed with copy tags (3 bytes in
+    # -> 64 out) must be rejected as soon as output would exceed ulen, not
+    # after ballooning.
+    blk = bytearray()
+    blk += bytes([100])                      # varint ulen = 100
+    blk += bytes([(3 << 2) | 0]) + b"abcd"   # literal of 4
+    for _ in range(1000):                    # 1000 × 64-byte copies
+        blk += bytes([(63 << 2) | 2, 0x04, 0x00])
+    with pytest.raises(ValueError):
+        nativelib._snappy_uncompress_py(bytes(blk), max_len=1 << 16)
+    with pytest.raises(ValueError):
+        nativelib.snappy_uncompress(bytes(blk), max_len=1 << 16)
+
+
 def test_crc32c_vectors():
     # RFC 3720 / public CRC32C check values.
     assert nativelib.crc32c(b"123456789") == 0xE3069283
