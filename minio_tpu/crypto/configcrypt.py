@@ -1,0 +1,170 @@
+"""Config-at-rest encryption under the root credential.
+
+Role-equivalent of cmd/config-encrypted.go + madmin EncryptData/DecryptData
+(and the pkg/argon2 dependency): durable server state stored inside the
+cluster — config KV, IAM — is sealed with a key derived from the root
+secret, so drives alone never leak credentials, policies, or service
+account secrets.
+
+Envelope format (all integers little-endian):
+
+    magic   "MTPC1"                       (5 bytes)
+    kdf     1 = argon2id (native kernel)  (1 byte)
+            2 = scrypt   (stdlib fallback when the native lib is absent)
+    t, m_kib, lanes                       (3 x u32; scrypt packs n/r/p)
+    salt                                  (16 bytes)
+    nonce                                 (12 bytes)
+    AES-256-GCM ciphertext || tag
+
+The KDF actually used is recorded in the header, so payloads written with
+either backend decrypt anywhere: argon2id payloads require the native
+kernel (refusing loudly beats silently weakening), scrypt payloads always
+decrypt. Decryption with a wrong credential fails the GCM tag — a clean
+error, not garbage config.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from minio_tpu.native import lib as nativelib
+
+MAGIC = b"MTPC1"
+KDF_ARGON2ID = 1
+KDF_SCRYPT = 2
+
+# Interactive-login-class cost (RFC 9106 §4 second recommendation): 64 MiB,
+# t=1 (argon2id) / scrypt n=2^15,r=8,p=1 — both ~50-100 ms on one core.
+ARGON_T, ARGON_M_KIB, ARGON_LANES = 1, 65536, 4
+SCRYPT_LOG_N, SCRYPT_R, SCRYPT_P = 15, 8, 1
+
+
+class ConfigCryptError(Exception):
+    pass
+
+
+def _derive(kdf: int, secret: str, salt: bytes, p1: int, p2: int,
+            p3: int) -> bytes:
+    if kdf == KDF_ARGON2ID:
+        return nativelib.argon2id(secret.encode(), salt, t=p1, m_kib=p2,
+                                  lanes=p3, outlen=32)
+    if kdf == KDF_SCRYPT:
+        return hashlib.scrypt(secret.encode(), salt=salt, n=1 << p1, r=p2,
+                              p=p3, maxmem=256 << 20, dklen=32)
+    raise ConfigCryptError(f"unknown KDF id {kdf}")
+
+
+def is_encrypted(data: bytes) -> bool:
+    return data.startswith(MAGIC)
+
+
+def _derive_cached(kdf: int, secret: str, salt: bytes, p1: int, p2: int,
+                   p3: int, key_cache: dict | None) -> bytes:
+    if key_cache is None:
+        return _derive(kdf, secret, salt, p1, p2, p3)
+    ck = (kdf, p1, p2, p3, salt)
+    key = key_cache.get(ck)
+    if key is None:
+        key = key_cache[ck] = _derive(kdf, secret, salt, p1, p2, p3)
+    return key
+
+
+def encrypt_data(secret: str, plaintext: bytes, *, salt: bytes | None = None,
+                 key_cache: dict | None = None) -> bytes:
+    """Seal `plaintext` under the credential string `secret`.
+
+    Pass a fixed `salt` + shared `key_cache` to amortize the memory-hard
+    KDF over many payloads (one derivation per process; fresh random
+    nonces keep AES-GCM key reuse safe far beyond realistic write counts).
+    """
+    salt = salt or os.urandom(16)
+    nonce = os.urandom(12)
+    if nativelib.argon2id_available():
+        kdf, p1, p2, p3 = KDF_ARGON2ID, ARGON_T, ARGON_M_KIB, ARGON_LANES
+    else:
+        kdf, p1, p2, p3 = KDF_SCRYPT, SCRYPT_LOG_N, SCRYPT_R, SCRYPT_P
+    key = _derive_cached(kdf, secret, salt, p1, p2, p3, key_cache)
+    header = MAGIC + struct.pack("<BIII", kdf, p1, p2, p3) + salt + nonce
+    # Header as AAD: tampering with the recorded KDF/cost parameters is
+    # detected, not silently honored.
+    ct = AESGCM(key).encrypt(nonce, plaintext, header)
+    return header + ct
+
+
+def decrypt_data(secret: str, data: bytes, *,
+                 key_cache: dict | None = None) -> bytes:
+    """Unseal an encrypt_data payload; raises ConfigCryptError on a wrong
+    credential, tampering, or a missing KDF backend."""
+    if not data.startswith(MAGIC):
+        raise ConfigCryptError("not an encrypted config payload")
+    hdr_len = len(MAGIC) + 13 + 16 + 12
+    if len(data) < hdr_len + 16:
+        raise ConfigCryptError("truncated encrypted config payload")
+    kdf, p1, p2, p3 = struct.unpack_from("<BIII", data, len(MAGIC))
+    salt = data[len(MAGIC) + 13:len(MAGIC) + 29]
+    nonce = data[len(MAGIC) + 29:hdr_len]
+    # The header is read BEFORE the GCM tag can authenticate it, so cost
+    # parameters are attacker-controlled at this point: cap them so a
+    # tampered blob cannot turn the KDF into an OOM/hang at boot. (The
+    # AAD check still rejects the tampering afterwards.)
+    if kdf == KDF_ARGON2ID and not (
+            1 <= p1 <= 16 and 8 <= p2 <= (1 << 21) and 1 <= p3 <= 64):
+        raise ConfigCryptError("unreasonable argon2id cost parameters "
+                               "(tampered header?)")
+    if kdf == KDF_SCRYPT and not (
+            10 <= p1 <= 24 and 1 <= p2 <= 32 and 1 <= p3 <= 16):
+        raise ConfigCryptError("unreasonable scrypt cost parameters "
+                               "(tampered header?)")
+    if kdf == KDF_ARGON2ID and not nativelib.argon2id_available():
+        raise ConfigCryptError(
+            "payload sealed with argon2id but the native kernel is "
+            "unavailable — build native/ (make -C native)")
+    try:
+        key = _derive_cached(kdf, secret, salt, p1, p2, p3, key_cache)
+    except (OSError, ValueError) as e:
+        raise ConfigCryptError(f"KDF failed: {e}") from None
+    try:
+        return AESGCM(key).decrypt(nonce, data[hdr_len:], data[:hdr_len])
+    except Exception:  # noqa: BLE001 - wrong credential or tampered blob
+        raise ConfigCryptError(
+            "config decryption failed (wrong credential or corrupted "
+            "payload)") from None
+
+
+class SealedSysStore:
+    """Sys-store decorator sealing every payload under the root credential
+    (cmd/config-encrypted.go role). Reads pass unencrypted payloads
+    through so pre-encryption deployments migrate transparently: the next
+    write of each entry seals it.
+
+    One random salt per instance + a shared key cache: the memory-hard
+    KDF runs once per process for writes, and once per distinct
+    on-disk salt for reads.
+    """
+
+    def __init__(self, inner, secret: str):
+        self._inner = inner
+        self._secret = secret
+        self._salt = os.urandom(16)
+        self._keys: dict = {}
+
+    def write_sys_config(self, path: str, data: bytes) -> None:
+        self._inner.write_sys_config(
+            path, encrypt_data(self._secret, data, salt=self._salt,
+                               key_cache=self._keys))
+
+    def read_sys_config(self, path: str) -> bytes:
+        raw = self._inner.read_sys_config(path)
+        if is_encrypted(raw):
+            return decrypt_data(self._secret, raw, key_cache=self._keys)
+        return raw
+
+    def delete_sys_config(self, path: str) -> None:
+        self._inner.delete_sys_config(path)
+
+    def list_sys_config(self, prefix: str = "") -> list[str]:
+        return self._inner.list_sys_config(prefix)

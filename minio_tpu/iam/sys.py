@@ -115,13 +115,26 @@ class IAMSys:
     # ------------------------------------------------------------------
 
     def load(self) -> None:
+        from minio_tpu.crypto.configcrypt import ConfigCryptError
+
+        crypt_failures: list[Exception] = []
+        loaded = 0
         with self._mu:
             for key in self._safe_list("iam/"):
                 try:
                     raw = self._store.read_sys_config(f"iam/{key}")
                     doc = json.loads(raw)
+                except ConfigCryptError as e:
+                    # Could be one bit-rotted entry (skip it, like any
+                    # corrupt doc) or the wrong root credential (every
+                    # entry fails). Decide after the loop: booting with
+                    # silently-empty IAM on a wrong credential is the
+                    # disaster case.
+                    crypt_failures.append(e)
+                    continue
                 except Exception:  # noqa: BLE001 - skip corrupt entries
                     continue
+                loaded += 1
                 kind, _, name = key.partition("/")
                 if kind == "users":
                     self.users[name] = UserInfo(**doc)
@@ -133,6 +146,11 @@ class IAMSys:
                     tc = TempCredential(**doc)
                     if not tc.expired:
                         self.temp_creds[name] = tc
+        if crypt_failures and loaded == 0:
+            # Every sealed entry failed to decrypt and nothing loaded:
+            # that's a wrong root credential, not bitrot — refuse to boot
+            # with empty IAM.
+            raise crypt_failures[0]
 
     def _safe_list(self, prefix: str) -> list[str]:
         try:

@@ -61,6 +61,13 @@ def _build_and_load():
         lib.mtpu_snappy_uncompress.restype = ctypes.c_int64
         lib.mtpu_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
         lib.mtpu_crc32c.restype = ctypes.c_uint32
+        lib.mtpu_argon2id.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_char_p,
+            ctypes.c_uint32]
+        lib.mtpu_argon2id.restype = ctypes.c_int
         _lib = lib
         return _lib
 
@@ -201,6 +208,30 @@ class DirectWriter:
 
     def __exit__(self, *exc):
         self.close(sync=exc[0] is None)
+
+
+# --- argon2id (the pkg/argon2 role) ------------------------------------------
+
+def argon2id_available() -> bool:
+    return _build_and_load() is not None
+
+
+def argon2id(password: bytes, salt: bytes, *, t: int = 1,
+             m_kib: int = 65536, lanes: int = 4, outlen: int = 32,
+             secret: bytes = b"", ad: bytes = b"") -> bytes:
+    """Argon2id (RFC 9106) via the native kernel. Raises OSError when the
+    native lib is absent — callers fall back to a different KDF and record
+    which one they used (crypto/configcrypt.py)."""
+    lib = _build_and_load()
+    if lib is None:
+        raise OSError("native argon2id unavailable")
+    out = ctypes.create_string_buffer(outlen)
+    rc = lib.mtpu_argon2id(password, len(password), salt, len(salt),
+                           secret, len(secret), ad, len(ad),
+                           t, m_kib, lanes, out, outlen)
+    if rc != 0:
+        raise OSError("argon2id failed (bad parameters)")
+    return out.raw
 
 
 # --- snappy block codec + crc32c (the S2 compression role) -------------------

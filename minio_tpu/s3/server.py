@@ -113,14 +113,19 @@ class S3Server:
         has_store = hasattr(object_layer, "read_sys_config")
         store = object_layer if has_store else _MemStore()
         self.sys_store = store
+        # Config + IAM are sealed at rest under the root credential
+        # (cmd/config-encrypted.go role); bucket metadata and scanner
+        # state stay plaintext, matching the reference's scope.
+        from minio_tpu.crypto.configcrypt import SealedSysStore
+        sealed = (SealedSysStore(store, credentials.secret_key)
+                  if has_store else None)
         notify_bm = (notification_sys.invalidate_bucket_metadata
                      if notification_sys is not None else None)
         notify_iam = (notification_sys.reload_iam
                       if notification_sys is not None else None)
         self.bucket_meta = BucketMetadataSys(store, notify=notify_bm)
         self.iam = IAMSys(credentials.access_key, credentials.secret_key,
-                          store=store if has_store else None,
-                          notify=notify_iam)
+                          store=sealed, notify=notify_iam)
 
         # Eventing: durable per-target queues under a local spool dir
         # (reference pkg/event/target/queuestore.go).
@@ -141,7 +146,7 @@ class S3Server:
         self.bandwidth: dict[str, dict[str, int]] = {}
         self._bw_mu = __import__("threading").Lock()
         self.trace_bus = PubSub()
-        self.config = ConfigSys(store if has_store else None)
+        self.config = ConfigSys(sealed)
 
         # Structured ops + audit logging (reference cmd/logger/): targets
         # come from the config KV subsystems logger_webhook / audit_webhook /
@@ -165,9 +170,10 @@ class S3Server:
         from minio_tpu.crypto.kes import kms_from_config
         self.kms = kms_from_config(self.config)
 
-        # ILM tiers (transition targets; reference tier subsystem).
+        # ILM tiers (transition targets; reference tier subsystem). Tier
+        # docs carry remote-storage credentials — sealed like config/IAM.
         from minio_tpu.scanner.tiers import TierRegistry, set_global
-        self.tiers = TierRegistry(store if has_store else None)
+        self.tiers = TierRegistry(sealed)
         set_global(self.tiers)
         self.admin = AdminAPI(self)
         self.local_locker = None  # set by the cluster node when distributed

@@ -473,6 +473,342 @@ int64_t mtpu_snappy_uncompress(const uint8_t* in, uint64_t n, uint8_t* out,
 }
 
 // ---------------------------------------------------------------------------
+// Argon2id (RFC 9106) — the pkg/argon2 role: memory-hard KDF used to
+// derive the config-at-rest encryption key from the root credential
+// (reference cmd/config-encrypted.go via madmin EncryptData). Includes
+// the required BLAKE2b-512 core. Checked against the RFC 9106 §5.3 test
+// vector in tests/test_native.py.
+// ---------------------------------------------------------------------------
+
+static const uint64_t kB2bIV[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+    0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+
+static const uint8_t kB2bSigma[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3}};
+
+static inline uint64_t rotr64(uint64_t x, int b) {
+  return (x >> b) | (x << (64 - b));
+}
+
+struct B2bState {
+  uint64_t h[8];
+  uint64_t tlo, thi;
+  uint8_t buf[128];
+  size_t buflen;
+  size_t outlen;
+};
+
+static void b2b_compress(B2bState* s, const uint8_t* block, bool last) {
+  uint64_t m[16], v[16];
+  for (int i = 0; i < 16; ++i) memcpy(&m[i], block + 8 * i, 8);
+  for (int i = 0; i < 8; ++i) v[i] = s->h[i];
+  for (int i = 0; i < 8; ++i) v[8 + i] = kB2bIV[i];
+  v[12] ^= s->tlo;
+  v[13] ^= s->thi;
+  if (last) v[14] = ~v[14];
+#define B2B_G(r, i, a, b, c, d)                  \
+  do {                                           \
+    a = a + b + m[kB2bSigma[r][2 * i]];          \
+    d = rotr64(d ^ a, 32);                       \
+    c = c + d;                                   \
+    b = rotr64(b ^ c, 24);                       \
+    a = a + b + m[kB2bSigma[r][2 * i + 1]];      \
+    d = rotr64(d ^ a, 16);                       \
+    c = c + d;                                   \
+    b = rotr64(b ^ c, 63);                       \
+  } while (0)
+  for (int r = 0; r < 12; ++r) {
+    B2B_G(r, 0, v[0], v[4], v[8], v[12]);
+    B2B_G(r, 1, v[1], v[5], v[9], v[13]);
+    B2B_G(r, 2, v[2], v[6], v[10], v[14]);
+    B2B_G(r, 3, v[3], v[7], v[11], v[15]);
+    B2B_G(r, 4, v[0], v[5], v[10], v[15]);
+    B2B_G(r, 5, v[1], v[6], v[11], v[12]);
+    B2B_G(r, 6, v[2], v[7], v[8], v[13]);
+    B2B_G(r, 7, v[3], v[4], v[9], v[14]);
+  }
+#undef B2B_G
+  for (int i = 0; i < 8; ++i) s->h[i] ^= v[i] ^ v[8 + i];
+}
+
+static void b2b_init(B2bState* s, size_t outlen) {
+  for (int i = 0; i < 8; ++i) s->h[i] = kB2bIV[i];
+  s->h[0] ^= 0x01010000ULL ^ (uint64_t)outlen;
+  s->tlo = s->thi = 0;
+  s->buflen = 0;
+  s->outlen = outlen;
+}
+
+static void b2b_update(B2bState* s, const void* data, size_t len) {
+  const uint8_t* p = (const uint8_t*)data;
+  while (len > 0) {
+    if (s->buflen == 128) {
+      s->tlo += 128;
+      if (s->tlo < 128) s->thi++;
+      b2b_compress(s, s->buf, false);
+      s->buflen = 0;
+    }
+    size_t take = 128 - s->buflen;
+    if (take > len) take = len;
+    memcpy(s->buf + s->buflen, p, take);
+    s->buflen += take;
+    p += take;
+    len -= take;
+  }
+}
+
+static void b2b_final(B2bState* s, uint8_t* out) {
+  s->tlo += s->buflen;
+  if (s->tlo < s->buflen) s->thi++;
+  memset(s->buf + s->buflen, 0, 128 - s->buflen);
+  b2b_compress(s, s->buf, true);
+  uint8_t full[64];
+  for (int i = 0; i < 8; ++i) memcpy(full + 8 * i, &s->h[i], 8);
+  memcpy(out, full, s->outlen);
+}
+
+// Argon2's variable-length hash H' (RFC 9106 §3.3).
+static void argon_hprime(uint8_t* out, uint32_t outlen, const uint8_t* in,
+                         size_t inlen) {
+  uint8_t le[4] = {(uint8_t)outlen, (uint8_t)(outlen >> 8),
+                   (uint8_t)(outlen >> 16), (uint8_t)(outlen >> 24)};
+  B2bState s;
+  if (outlen <= 64) {
+    b2b_init(&s, outlen);
+    b2b_update(&s, le, 4);
+    b2b_update(&s, in, inlen);
+    b2b_final(&s, out);
+    return;
+  }
+  uint32_t r = (outlen + 31) / 32 - 2;
+  uint8_t v[64];
+  b2b_init(&s, 64);
+  b2b_update(&s, le, 4);
+  b2b_update(&s, in, inlen);
+  b2b_final(&s, v);
+  memcpy(out, v, 32);
+  for (uint32_t i = 1; i < r; ++i) {
+    b2b_init(&s, 64);
+    b2b_update(&s, v, 64);
+    b2b_final(&s, v);
+    memcpy(out + 32 * i, v, 32);
+  }
+  uint8_t last[64];
+  b2b_init(&s, outlen - 32 * r);
+  b2b_update(&s, v, 64);
+  b2b_final(&s, last);
+  memcpy(out + 32 * r, last, outlen - 32 * r);
+}
+
+struct ABlock {
+  uint64_t v[128];
+};
+
+static inline uint64_t fblamka(uint64_t x, uint64_t y) {
+  uint64_t xy = (uint64_t)(uint32_t)x * (uint64_t)(uint32_t)y;
+  return x + y + 2 * xy;
+}
+
+#define AGB(a, b, c, d)          \
+  do {                           \
+    a = fblamka(a, b);           \
+    d = rotr64(d ^ a, 32);       \
+    c = fblamka(c, d);           \
+    b = rotr64(b ^ c, 24);       \
+    a = fblamka(a, b);           \
+    d = rotr64(d ^ a, 16);       \
+    c = fblamka(c, d);           \
+    b = rotr64(b ^ c, 63);       \
+  } while (0)
+
+#define AROUND(v0, v1, v2, v3, v4, v5, v6, v7, v8, v9, v10, v11, v12, v13, \
+               v14, v15)                                                   \
+  do {                                                                     \
+    AGB(v0, v4, v8, v12);                                                  \
+    AGB(v1, v5, v9, v13);                                                  \
+    AGB(v2, v6, v10, v14);                                                 \
+    AGB(v3, v7, v11, v15);                                                 \
+    AGB(v0, v5, v10, v15);                                                 \
+    AGB(v1, v6, v11, v12);                                                 \
+    AGB(v2, v7, v8, v13);                                                  \
+    AGB(v3, v4, v9, v14);                                                  \
+  } while (0)
+
+// fill_block: next = P(prev ^ ref) ^ (prev ^ ref) [^ old next if with_xor]
+static void argon_fill_block(const ABlock* prev, const ABlock* ref,
+                             ABlock* next, bool with_xor) {
+  ABlock R, tmp;
+  for (int i = 0; i < 128; ++i) R.v[i] = prev->v[i] ^ ref->v[i];
+  tmp = R;
+  if (with_xor)
+    for (int i = 0; i < 128; ++i) tmp.v[i] ^= next->v[i];
+  uint64_t* w = R.v;
+  for (int i = 0; i < 8; ++i) {
+    uint64_t* r = w + 16 * i;
+    AROUND(r[0], r[1], r[2], r[3], r[4], r[5], r[6], r[7], r[8], r[9], r[10],
+           r[11], r[12], r[13], r[14], r[15]);
+  }
+  for (int i = 0; i < 8; ++i) {
+    uint64_t* c = w + 2 * i;
+    AROUND(c[0], c[1], c[16], c[17], c[32], c[33], c[48], c[49], c[64], c[65],
+           c[80], c[81], c[96], c[97], c[112], c[113]);
+  }
+  for (int i = 0; i < 128; ++i) next->v[i] = tmp.v[i] ^ R.v[i];
+}
+
+static void argon_next_addresses(ABlock* addr, ABlock* input,
+                                 const ABlock* zero) {
+  input->v[6]++;
+  argon_fill_block(zero, input, addr, false);
+  argon_fill_block(zero, addr, addr, false);
+}
+
+// One segment of one lane (RFC 9106 §3.4; argon2id hybrid addressing:
+// pass 0 slices 0-1 data-independent, the rest data-dependent).
+static void argon_fill_segment(ABlock* B, uint32_t pass, uint32_t slice,
+                               uint32_t lane, uint32_t lanes, uint32_t q,
+                               uint32_t seg, uint32_t mp, uint32_t passes) {
+  bool di = (pass == 0 && slice < 2);
+  ABlock addr, input, zero;
+  if (di) {
+    memset(&zero, 0, sizeof(zero));
+    memset(&input, 0, sizeof(input));
+    input.v[0] = pass;
+    input.v[1] = lane;
+    input.v[2] = slice;
+    input.v[3] = mp;
+    input.v[4] = passes;
+    input.v[5] = 2;  // Argon2id
+  }
+  uint32_t start = 0;
+  if (pass == 0 && slice == 0) {
+    start = 2;
+    if (di) argon_next_addresses(&addr, &input, &zero);
+  }
+  for (uint32_t i = start; i < seg; ++i) {
+    uint32_t cur_col = slice * seg + i;
+    uint32_t cur = lane * q + cur_col;
+    uint32_t prev = (cur_col == 0) ? lane * q + q - 1 : cur - 1;
+    uint64_t rand;
+    if (di) {
+      if (i % 128 == 0) argon_next_addresses(&addr, &input, &zero);
+      rand = addr.v[i % 128];
+    } else {
+      rand = B[prev].v[0];
+    }
+    uint32_t j1 = (uint32_t)rand;
+    uint32_t ref_lane = (pass == 0 && slice == 0)
+                            ? lane
+                            : (uint32_t)((rand >> 32) % lanes);
+    bool same = ref_lane == lane;
+    uint32_t area;
+    if (pass == 0) {
+      if (slice == 0)
+        area = i - 1;
+      else if (same)
+        area = slice * seg + i - 1;
+      else
+        area = slice * seg - (i == 0 ? 1 : 0);
+    } else {
+      if (same)
+        area = q - seg + i - 1;
+      else
+        area = q - seg - (i == 0 ? 1 : 0);
+    }
+    uint64_t x = ((uint64_t)j1 * j1) >> 32;
+    uint64_t y = ((uint64_t)area * x) >> 32;
+    uint32_t rel = area - 1 - (uint32_t)y;
+    uint32_t start_pos = (pass == 0) ? 0 : ((slice + 1) % 4) * seg;
+    uint32_t ref = (start_pos + rel) % q;
+    argon_fill_block(&B[prev], &B[ref_lane * q + ref], &B[cur], pass > 0);
+  }
+}
+
+int mtpu_argon2id(const uint8_t* pwd, uint64_t pwd_len, const uint8_t* salt,
+                  uint64_t salt_len, const uint8_t* secret,
+                  uint64_t secret_len, const uint8_t* ad, uint64_t ad_len,
+                  uint32_t t_cost, uint32_t m_kib, uint32_t lanes,
+                  uint8_t* out, uint32_t out_len) {
+  // Parameter bounds (RFC 9106 §3.1 caps lanes at 2^24-1; the others are
+  // sanity limits): these arrive from UNTRUSTED on-disk headers via
+  // decrypt paths, so overflow here would be a remote crash primitive.
+  if (lanes == 0 || lanes > 0xFFFFFF || t_cost == 0 || out_len < 4)
+    return -1;
+  uint64_t m = m_kib;
+  if (m < 8ULL * lanes) m = 8ULL * lanes;
+  if (m > (1ULL << 31)) return -1;  // >2 TiB of blocks is a DoS, not a KDF
+  uint64_t mp64 = 4ULL * lanes * (m / (4ULL * lanes));
+  uint32_t mp = (uint32_t)mp64;
+  uint32_t q = (uint32_t)(mp64 / lanes);
+  uint32_t seg = q / 4;
+  if (seg == 0) return -1;
+  ABlock* B = (ABlock*)malloc((size_t)mp * sizeof(ABlock));
+  if (B == nullptr) return -1;
+
+  // H0 (RFC 9106 §3.2) — note m_kib (the requested cost), not m'.
+  uint8_t h0[72];
+  {
+    B2bState s;
+    b2b_init(&s, 64);
+    uint32_t hdr[6] = {lanes, out_len, m_kib, t_cost, 0x13, 2};
+    b2b_update(&s, hdr, 24);
+    uint32_t n = (uint32_t)pwd_len;
+    b2b_update(&s, &n, 4);
+    b2b_update(&s, pwd, pwd_len);
+    n = (uint32_t)salt_len;
+    b2b_update(&s, &n, 4);
+    b2b_update(&s, salt, salt_len);
+    n = (uint32_t)secret_len;
+    b2b_update(&s, &n, 4);
+    b2b_update(&s, secret, secret_len);
+    n = (uint32_t)ad_len;
+    b2b_update(&s, &n, 4);
+    b2b_update(&s, ad, ad_len);
+    b2b_final(&s, h0);
+  }
+  for (uint32_t l = 0; l < lanes; ++l) {
+    for (uint32_t i = 0; i < 2; ++i) {
+      memcpy(h0 + 64, &i, 4);
+      memcpy(h0 + 68, &l, 4);
+      argon_hprime((uint8_t*)B[l * q + i].v, 1024, h0, 72);
+    }
+  }
+  for (uint32_t pass = 0; pass < t_cost; ++pass)
+    for (uint32_t slice = 0; slice < 4; ++slice)
+      for (uint32_t l = 0; l < lanes; ++l)
+        argon_fill_segment(B, pass, slice, l, lanes, q, seg, mp, t_cost);
+
+  ABlock C = B[q - 1];
+  for (uint32_t l = 1; l < lanes; ++l)
+    for (int i = 0; i < 128; ++i) C.v[i] ^= B[l * q + q - 1].v[i];
+  argon_hprime(out, out_len, (const uint8_t*)C.v, 1024);
+  // Wipe: the block matrix, H0 and C are password-derived key material.
+  // Volatile pointer writes — a plain memset before free() is a dead
+  // store the optimizer may elide.
+  volatile uint8_t* vb = (volatile uint8_t*)B;
+  for (size_t i = 0; i < (size_t)mp * sizeof(ABlock); ++i) vb[i] = 0;
+  volatile uint8_t* vc = (volatile uint8_t*)C.v;
+  for (size_t i = 0; i < sizeof(C); ++i) vc[i] = 0;
+  volatile uint8_t* vh = (volatile uint8_t*)h0;
+  for (size_t i = 0; i < sizeof(h0); ++i) vh[i] = 0;
+  free(B);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
 // CRC32C (Castagnoli) — the framing checksum. Hardware SSE4.2 when the
 // build arch has it (-march=native), else a slice-by-8 software table.
 // ---------------------------------------------------------------------------
