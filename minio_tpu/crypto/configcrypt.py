@@ -108,15 +108,17 @@ def decrypt_data(secret: str, data: bytes, *,
     salt = data[len(MAGIC) + 13:len(MAGIC) + 29]
     nonce = data[len(MAGIC) + 29:hdr_len]
     # The header is read BEFORE the GCM tag can authenticate it, so cost
-    # parameters are attacker-controlled at this point: cap them so a
-    # tampered blob cannot turn the KDF into an OOM/hang at boot. (The
-    # AAD check still rejects the tampering afterwards.)
+    # parameters are attacker-controlled at this point: cap them at a
+    # small multiple of what this module ever writes (64 MiB / t=1 /
+    # scrypt n=2^15) so a tampered blob costs at most ~1 s and ~256 MiB
+    # per attempt, not minutes/OOM. (The AAD check still rejects the
+    # tampering afterwards.)
     if kdf == KDF_ARGON2ID and not (
-            1 <= p1 <= 16 and 8 <= p2 <= (1 << 21) and 1 <= p3 <= 64):
+            1 <= p1 <= 4 and 8 <= p2 <= (1 << 18) and 1 <= p3 <= 16):
         raise ConfigCryptError("unreasonable argon2id cost parameters "
                                "(tampered header?)")
     if kdf == KDF_SCRYPT and not (
-            10 <= p1 <= 24 and 1 <= p2 <= 32 and 1 <= p3 <= 16):
+            10 <= p1 <= 17 and 1 <= p2 <= 16 and 1 <= p3 <= 4):
         raise ConfigCryptError("unreasonable scrypt cost parameters "
                                "(tampered header?)")
     if kdf == KDF_ARGON2ID and not nativelib.argon2id_available():
@@ -151,6 +153,11 @@ class SealedSysStore:
         self._secret = secret
         self._salt = os.urandom(16)
         self._keys: dict = {}
+        # Read outcome counters: callers deciding "wrong credential vs one
+        # bit-rotted entry" need to know whether ANY sealed payload
+        # decrypted (iam/sys.py load()).
+        self.sealed_ok = 0
+        self.sealed_fail = 0
 
     def write_sys_config(self, path: str, data: bytes) -> None:
         self._inner.write_sys_config(
@@ -160,7 +167,13 @@ class SealedSysStore:
     def read_sys_config(self, path: str) -> bytes:
         raw = self._inner.read_sys_config(path)
         if is_encrypted(raw):
-            return decrypt_data(self._secret, raw, key_cache=self._keys)
+            try:
+                out = decrypt_data(self._secret, raw, key_cache=self._keys)
+            except ConfigCryptError:
+                self.sealed_fail += 1
+                raise
+            self.sealed_ok += 1
+            return out
         return raw
 
     def delete_sys_config(self, path: str) -> None:

@@ -26,6 +26,10 @@ from minio_tpu.iam.policy import (
 )
 from minio_tpu.utils import errors as se
 
+import logging
+
+log = logging.getLogger("minio_tpu.iam")
+
 ACCOUNT_ON = "on"
 ACCOUNT_OFF = "off"
 
@@ -118,7 +122,7 @@ class IAMSys:
         from minio_tpu.crypto.configcrypt import ConfigCryptError
 
         crypt_failures: list[Exception] = []
-        loaded = 0
+        sealed_ok0 = getattr(self._store, "sealed_ok", 0)
         with self._mu:
             for key in self._safe_list("iam/"):
                 try:
@@ -127,14 +131,15 @@ class IAMSys:
                 except ConfigCryptError as e:
                     # Could be one bit-rotted entry (skip it, like any
                     # corrupt doc) or the wrong root credential (every
-                    # entry fails). Decide after the loop: booting with
-                    # silently-empty IAM on a wrong credential is the
-                    # disaster case.
+                    # sealed entry fails). Decide after the loop: booting
+                    # with silently-empty IAM on a wrong credential is
+                    # the disaster case.
+                    log.warning("IAM entry %r failed to decrypt: %s",
+                                key, e)
                     crypt_failures.append(e)
                     continue
                 except Exception:  # noqa: BLE001 - skip corrupt entries
                     continue
-                loaded += 1
                 kind, _, name = key.partition("/")
                 if kind == "users":
                     self.users[name] = UserInfo(**doc)
@@ -146,10 +151,12 @@ class IAMSys:
                     tc = TempCredential(**doc)
                     if not tc.expired:
                         self.temp_creds[name] = tc
-        if crypt_failures and loaded == 0:
-            # Every sealed entry failed to decrypt and nothing loaded:
+        sealed_ok = getattr(self._store, "sealed_ok", 0) - sealed_ok0
+        if crypt_failures and sealed_ok == 0:
+            # Every SEALED entry failed to decrypt (plaintext pre-migration
+            # entries don't count as evidence the credential is right):
             # that's a wrong root credential, not bitrot — refuse to boot
-            # with empty IAM.
+            # with silently-partial IAM.
             raise crypt_failures[0]
 
     def _safe_list(self, prefix: str) -> list[str]:

@@ -18,7 +18,7 @@ import time
 from minio_tpu.bucket.meta import BucketMetadataSys
 from minio_tpu.erasure.types import ObjectOptions
 from minio_tpu.scanner import lifecycle as lc
-from minio_tpu.scanner.usage import DataUsageCache
+from minio_tpu.scanner.usage import DataUsageCache, UsageEntry
 from minio_tpu.utils import errors as se
 
 log = logging.getLogger("minio_tpu.scanner")
@@ -27,6 +27,7 @@ SCAN_INTERVAL = 60.0
 HEAL_EVERY_N_CYCLES = 16   # objects deep-checked 1/N of cycles (reference
                            # healObjectSelectProb, data-scanner.go)
 PAGE = 1000
+POSITION_PATH = "scanner/cycle-position.mp"  # mid-cycle checkpoint
 
 
 class DataScanner:
@@ -75,7 +76,14 @@ class DataScanner:
     # -- one full cycle --
 
     def scan_once(self, now: float | None = None) -> DataUsageCache:
-        """Crawl everything once; returns the fresh usage cache."""
+        """Crawl everything once; returns the fresh usage cache.
+
+        Mid-cycle resumable (reference healingTracker/scanner persistence
+        pattern, SURVEY §5.4): a checkpoint doc records the cycle's work
+        list and each bucket's finished accounting after that bucket
+        completes, so a restart resumes the interrupted cycle at the next
+        bucket instead of restarting the crawl.
+        """
         fresh = DataUsageCache()
         fresh.cycles = self.usage.cycles + 1
         deep_heal = self.heal_objects and fresh.cycles % HEAL_EVERY_N_CYCLES == 0
@@ -91,18 +99,36 @@ class DataScanner:
                 except ValueError:
                     pass
 
-        if self.tracker is not None:
-            scan_set, _full = self.tracker.begin_cycle(buckets)
-            # Time-based expiry must fire without writes: lifecycle-bearing
-            # buckets always scan.
-            to_scan = sorted(set(scan_set) | set(lifecycles))
+        ckpt = self._load_position()
+        resume_done: dict[str, UsageEntry] = {}
+        if ckpt is not None and ckpt.get("c") == fresh.cycles:
+            # Interrupted cycle: reuse its work list and finished buckets.
+            to_scan = [b for b in ckpt.get("ts", []) if b in buckets]
+            resume_done = {k: UsageEntry.from_doc(v)
+                           for k, v in ckpt.get("d", {}).items()
+                           if k in buckets}
         else:
-            to_scan = buckets
+            ckpt = None
+            if self.tracker is not None:
+                scan_set, _full = self.tracker.begin_cycle(buckets)
+                # Time-based expiry must fire without writes:
+                # lifecycle-bearing buckets always scan.
+                to_scan = sorted(set(scan_set) | set(lifecycles))
+            else:
+                to_scan = buckets
 
+        done_docs: dict[str, dict] = dict(ckpt.get("d", {})) if ckpt else {}
+        scanned = 0
+        last_ckpt = time.monotonic()
+        interrupted = False
         for bucket in buckets:
             if self._stop.is_set():
+                interrupted = True
                 break
             lifecycle = lifecycles.get(bucket)
+            if bucket in resume_done:
+                fresh.buckets[bucket] = resume_done[bucket]
+                continue
             if bucket not in to_scan:
                 # Clean since last cycle: carry the previous accounting.
                 prev = self.usage.buckets.get(bucket)
@@ -112,6 +138,22 @@ class DataScanner:
             self._scan_bucket(bucket, lifecycle, fresh, deep_heal, now)
             if lifecycle is not None:
                 self._expire_mpus(bucket, lifecycle, now)
+            done_docs[bucket] = fresh.bucket(bucket).to_doc()
+            scanned += 1
+            # Checkpoint after the first bucket, then every 16th / 5 s —
+            # every-bucket rewrites of the full map would be O(n^2) I/O
+            # across a many-bucket cycle.
+            if scanned % 16 == 1 or time.monotonic() - last_ckpt > 5.0:
+                self._save_position(fresh.cycles, to_scan, done_docs)
+                last_ckpt = time.monotonic()
+
+        if interrupted:
+            # Graceful stop mid-cycle: leave the persisted usage at the
+            # last COMPLETE cycle and keep the checkpoint so the next
+            # start resumes this cycle instead of committing a partial
+            # crawl as authoritative accounting.
+            self._save_position(fresh.cycles, to_scan, done_docs)
+            return fresh
 
         self.usage = fresh
         if self.store is not None:
@@ -119,7 +161,42 @@ class DataScanner:
                 fresh.save(self.store)
             except Exception:  # noqa: BLE001 - accounting is best-effort
                 log.exception("usage persist failed")
+            self._clear_position()
         return fresh
+
+    # -- mid-cycle checkpoint --
+
+    def _load_position(self) -> dict | None:
+        if self.store is None:
+            return None
+        import msgpack
+
+        try:
+            return msgpack.unpackb(
+                self.store.read_sys_config(POSITION_PATH),
+                strict_map_key=False)
+        except Exception:  # noqa: BLE001 - missing/corrupt = fresh cycle
+            return None
+
+    def _save_position(self, cycle: int, to_scan: list,
+                       done_docs: dict) -> None:
+        if self.store is None:
+            return
+        import msgpack
+
+        try:
+            self.store.write_sys_config(POSITION_PATH, msgpack.packb(
+                {"c": cycle, "ts": list(to_scan), "d": done_docs}))
+        except Exception:  # noqa: BLE001 - checkpoint is best-effort
+            log.exception("scanner checkpoint persist failed")
+
+    def _clear_position(self) -> None:
+        if self.store is None:
+            return
+        try:
+            self.store.delete_sys_config(POSITION_PATH)
+        except Exception:  # noqa: BLE001
+            pass
 
     def _scan_bucket(self, bucket: str, lifecycle, fresh: DataUsageCache,
                      deep_heal: bool, now: float | None) -> None:

@@ -158,6 +158,29 @@ def test_one_bitrotted_iam_entry_does_not_block_boot(tmp_path):
     assert len(srv2.iam.users) == 1  # the intact entry loaded
 
 
+def test_wrong_credential_with_plaintext_survivors_still_fails(tmp_path):
+    """Half-migrated store (one plaintext pre-migration IAM entry left):
+    a wrong root credential must still refuse to boot — legacy plaintext
+    entries are not evidence the credential is right."""
+    import json
+
+    from minio_tpu.s3.server import build_server
+
+    drives = [str(tmp_path / f"d{i}") for i in range(4)]
+    srv = build_server(drives, "migroot", "migroot-secret", versioned=False)
+    srv.iam.set_user("alice", "alice-secret-key1")
+    # Plant a legacy plaintext entry alongside the sealed one.
+    srv.sys_store.write_sys_config(
+        "iam/users/legacy", json.dumps(
+            {"secret_key": "legacy-secret-00", "status": "on"}).encode())
+    with pytest.raises(cc.ConfigCryptError):
+        build_server(drives, "migroot", "wrong-secret", versioned=False)
+    # Right credential: both load.
+    srv2 = build_server(drives, "migroot", "migroot-secret",
+                        versioned=False)
+    assert {"alice", "legacy"} <= set(srv2.iam.users)
+
+
 def test_server_config_iam_sealed_on_disk(tmp_path):
     """Full stack: config KV + IAM persisted through the erasure sys store
     land encrypted on the drives and reload across a server restart."""

@@ -258,3 +258,64 @@ def test_update_tracker_skips_clean_buckets(tmp_path):
     sc2 = DataScanner(layer, bm, tracker=tracker2)
     u3 = sc2.scan_once()
     assert u3.buckets["bbb"].size == 6      # now rescanned: 2 + 4
+
+
+# ---------------- mid-cycle checkpoint / resume ----------------
+
+def test_scanner_resumes_interrupted_cycle(layer):
+    """Kill the scan mid-cycle; a fresh scanner (restart) must resume at
+    the next bucket — finished buckets are not re-listed — and the final
+    accounting must match an uninterrupted scan."""
+    for i in range(3):
+        layer.make_bucket(f"bkt{i}")
+        _put(layer, f"bkt{i}", "obj", b"y" * (100 + i))
+    bm = BucketMetadataSys(layer)
+
+    sc = DataScanner(layer, bm)
+    real_list = layer.list_object_versions
+    calls: list[str] = []
+
+    def tracked(bucket, *a, **k):
+        calls.append(bucket)
+        if bucket == "bkt1":
+            raise RuntimeError("crash mid-cycle")
+        return real_list(bucket, *a, **k)
+
+    layer.list_object_versions = tracked
+    with pytest.raises(RuntimeError):
+        sc.scan_once()
+    assert calls == ["bkt0", "bkt1"]
+
+    # "Restart": new scanner over the same store, listing healthy again.
+    calls.clear()
+    layer.list_object_versions = real_list
+
+    def tracked2(bucket, *a, **k):
+        calls.append(bucket)
+        return real_list(bucket, *a, **k)
+
+    layer.list_object_versions = tracked2
+    sc2 = DataScanner(layer, bm)
+    usage = sc2.scan_once()
+    layer.list_object_versions = real_list
+    # bkt0 came from the checkpoint, not a re-listing.
+    assert "bkt0" not in calls and "bkt1" in calls and "bkt2" in calls
+    for i in range(3):
+        e = usage.buckets[f"bkt{i}"]
+        assert e.objects == 1 and e.size == 100 + i, (i, e)
+    # Checkpoint cleared after the completed cycle; next cycle is normal.
+    assert sc2._load_position() is None
+    usage2 = DataScanner(layer, bm).scan_once()
+    assert usage2.cycles == usage.cycles + 1
+
+
+def test_scanner_checkpoint_ignored_for_new_cycle(layer):
+    layer.make_bucket("ckb")
+    _put(layer, "ckb", "o", b"zzz")
+    bm = BucketMetadataSys(layer)
+    sc = DataScanner(layer, bm)
+    # A stale checkpoint from some other cycle number is ignored.
+    sc._save_position(999, ["ckb"], {"ckb": {"o": 7, "v": 7, "s": 7}})
+    usage = sc.scan_once()
+    assert usage.buckets["ckb"].objects == 1
+    assert usage.buckets["ckb"].size == 3
