@@ -118,7 +118,9 @@ def decrypt_data(secret: str, data: bytes, *,
         raise ConfigCryptError("unreasonable argon2id cost parameters "
                                "(tampered header?)")
     if kdf == KDF_SCRYPT and not (
-            10 <= p1 <= 17 and 1 <= p2 <= 16 and 1 <= p3 <= 4):
+            10 <= p1 <= 17 and 1 <= p2 <= 8 and 1 <= p3 <= 4):
+        # r*2^n capped so 128*r*n stays under _derive's maxmem — the KDF
+        # must reject, not die on the memory limit.
         raise ConfigCryptError("unreasonable scrypt cost parameters "
                                "(tampered header?)")
     if kdf == KDF_ARGON2ID and not nativelib.argon2id_available():
@@ -127,7 +129,7 @@ def decrypt_data(secret: str, data: bytes, *,
             "unavailable — build native/ (make -C native)")
     try:
         key = _derive_cached(kdf, secret, salt, p1, p2, p3, key_cache)
-    except (OSError, ValueError) as e:
+    except (OSError, ValueError, MemoryError) as e:
         raise ConfigCryptError(f"KDF failed: {e}") from None
     try:
         return AESGCM(key).decrypt(nonce, data[hdr_len:], data[:hdr_len])
@@ -153,11 +155,6 @@ class SealedSysStore:
         self._secret = secret
         self._salt = os.urandom(16)
         self._keys: dict = {}
-        # Read outcome counters: callers deciding "wrong credential vs one
-        # bit-rotted entry" need to know whether ANY sealed payload
-        # decrypted (iam/sys.py load()).
-        self.sealed_ok = 0
-        self.sealed_fail = 0
 
     def write_sys_config(self, path: str, data: bytes) -> None:
         self._inner.write_sys_config(
@@ -165,16 +162,19 @@ class SealedSysStore:
                                key_cache=self._keys))
 
     def read_sys_config(self, path: str) -> bytes:
+        data, _sealed = self.read_sys_config2(path)
+        return data
+
+    def read_sys_config2(self, path: str) -> tuple[bytes, bool]:
+        """-> (payload, was_sealed). The flag lets callers deciding "wrong
+        credential vs one bit-rotted entry" count sealed successes for
+        THEIR reads only (iam/sys.py load()) — a shared counter would be
+        inflated by concurrent readers of other sealed docs."""
         raw = self._inner.read_sys_config(path)
         if is_encrypted(raw):
-            try:
-                out = decrypt_data(self._secret, raw, key_cache=self._keys)
-            except ConfigCryptError:
-                self.sealed_fail += 1
-                raise
-            self.sealed_ok += 1
-            return out
-        return raw
+            return (decrypt_data(self._secret, raw, key_cache=self._keys),
+                    True)
+        return raw, False
 
     def delete_sys_config(self, path: str) -> None:
         self._inner.delete_sys_config(path)

@@ -94,7 +94,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         drives: list[StorageAPI],
         parity: int | None = None,
         block_size: int = DEFAULT_BLOCK_SIZE,
-        batch_blocks: int = 8,
+        batch_blocks: int = 16,
         bitrot_algorithm: str | None = None,
         enable_mrf: bool = False,
         nslock=None,
@@ -121,10 +121,28 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         self.bitrot_algorithm = (bitrot_algorithm if bitrot_algorithm
                                  else bitrot.device_default_algorithm())
         self.mrf: MRFHealer | None = MRFHealer(self) if enable_mrf else None
+        self._read_pool = None
+        self._read_pool_mu = threading.Lock()
+
+    def _shard_read_pool(self):
+        """Long-lived per-instance pool for parallel shard reads — a fresh
+        pool per GET stream would pay thread spawn on the hot read path."""
+        if self._read_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with self._read_pool_mu:
+                if self._read_pool is None:
+                    self._read_pool = ThreadPoolExecutor(
+                        max_workers=max(self.n, 8),
+                        thread_name_prefix="shard-read")
+        return self._read_pool
 
     def close(self) -> None:
         if self.mrf is not None:
             self.mrf.close()
+        if self._read_pool is not None:
+            self._read_pool.shutdown(wait=False, cancel_futures=True)
+            self._read_pool = None
 
     def all_drives(self) -> list[StorageAPI]:
         return list(self.drives)
@@ -445,6 +463,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 raise se.InsufficientReadQuorum(bucket, obj, "not enough live shards")
             return sorted(chosen)
 
+        pool = self._shard_read_pool()
         try:
             bi = first_block
             while bi <= last_block:
@@ -458,7 +477,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     try:
                         rows = self._read_chunk_rows(
                             readers, chosen, batch_ids, block_lens, codec, n,
-                            dead, algo,
+                            dead, algo, pool=pool,
                         )
                         break
                     except se.StorageError:
@@ -475,7 +494,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         finally:
             # Runs on normal completion AND early close (GeneratorExit) —
             # callers that read exactly length bytes leave the generator
-            # paused, so cleanup cannot live after the loop.
+            # paused, so cleanup cannot live after the loop. (The shard
+            # pool is instance-owned and outlives the stream.)
             for r in readers:
                 if r is not None:
                     try:
@@ -488,36 +508,65 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 self.mrf.add_partial(bucket, obj, fi.version_id)
 
     def _read_chunk_rows(self, readers, chosen, batch_ids, block_lens, codec,
-                         n, dead, algo=None):
+                         n, dead, algo=None, pool=None):
         """Read one batch of chunk rows from the chosen shards; marks dead
         drives and raises StorageError to trigger re-selection.
 
-        mxsum256 shard files verify in ONE device launch per batch
-        (fused.verify_digests) instead of per-chunk host hashing — the
-        TPU-native form of the reference's verify-every-ReadAt
-        (cmd/bitrot-streaming.go:115-158)."""
+        Shards read in PARALLEL (one worker per shard, each reading its
+        batch sequentially — per-drive sequential I/O, cross-drive
+        concurrency, the reference's parallelReader goroutine layout,
+        cmd/erasure-decode.go:120-188); host hashing and preads release
+        the GIL in native code. mxsum256 shard files verify in ONE device
+        launch per batch (fused.verify_digests) instead of per-chunk host
+        hashing — the TPU-native form of the reference's
+        verify-every-ReadAt (cmd/bitrot-streaming.go:115-158)."""
         batched_verify = algo == "mxsum256"
+        shard_size = codec.shard_size()
+        chunk_lens = [-(-bl // codec.k) for bl in block_lens]
+
+        def read_shard(i: int) -> list[tuple[bytes | None, bytes]]:
+            out: list[tuple[bytes | None, bytes]] = []
+            for j, b in enumerate(batch_ids):
+                if batched_verify:
+                    want, chunk = readers[i].read_record(b)
+                    if len(chunk) != chunk_lens[j]:
+                        raise se.FileCorrupt(
+                            f"chunk {b} length {len(chunk)} != "
+                            f"{chunk_lens[j]}")
+                    out.append((want, chunk))
+                else:
+                    out.append((None, readers[i].read_at(
+                        b * shard_size, chunk_lens[j])))
+            return out
+
+        results: dict[int, list] = {}
+        first_err: tuple[int, Exception] | None = None
+        if pool is None:
+            futures = None
+        else:
+            futures = {i: pool.submit(read_shard, i) for i in chosen}
+        for i in chosen:
+            try:
+                results[i] = (futures[i].result() if futures is not None
+                              else read_shard(i))
+            except (se.StorageError, OSError) as e:
+                dead.add(i)
+                readers[i] = None
+                if first_err is None:
+                    first_err = (i, e)
+        if first_err is not None:
+            i, e = first_err
+            raise se.FileCorrupt(f"shard {i}: {e}") from e
+
         rows: list[list[bytes | None]] = []
         records: list[tuple[int, bytes, bytes]] = []  # (drive, want, chunk)
-        for j, b in enumerate(batch_ids):
-            chunk_len = -(-block_lens[j] // codec.k)
+        for j, _b in enumerate(batch_ids):
             row: list[bytes | None] = [None] * n
             for i in chosen:
-                try:
-                    if batched_verify:
-                        want, chunk = readers[i].read_record(b)
-                        if len(chunk) != chunk_len:
-                            raise se.FileCorrupt(
-                                f"chunk {b} length {len(chunk)} != {chunk_len}")
-                        records.append((i, want, chunk))
-                        row[i] = chunk
-                    else:
-                        row[i] = readers[i].read_at(
-                            b * codec.shard_size(), chunk_len)
-                except (se.StorageError, OSError) as e:
-                    dead.add(i)
-                    readers[i] = None
-                    raise se.FileCorrupt(f"shard {i}: {e}") from e
+                want, chunk = results[i][j]
+                row[i] = chunk
+                if batched_verify:
+                    records.append((i, want, chunk))
             rows.append(row)
         if records:
             self._verify_records(records, codec, readers, dead)
@@ -774,6 +823,13 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     if item is _WRITE_SENTINEL:
                         return
                     digest, chunk = item  # [digest][chunk] record, unconcatenated
+                    if digest is None:
+                        # Host-hash algorithms digest HERE, in the per-drive
+                        # thread (native call releases the GIL), not in the
+                        # single producer thread — n drives hash in
+                        # parallel, the reference's per-goroutine
+                        # bitrot-writer layout (cmd/bitrot-streaming.go:46).
+                        digest = bitrot_algo.digest(bytes(chunk))
                     yield digest
                     yield chunk
 
@@ -802,7 +858,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         # are in flight on device while the host reads the next batch and
         # fans out completed ones — the reference's read/encode/write
         # overlap (cmd/erasure-encode.go:80-107) via JAX async dispatch.
-        pipeline_depth = 2
+        pipeline_depth = 3
         pending: list = []
 
         def drain_one() -> None:
@@ -810,9 +866,9 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             for bi, chunks in enumerate(chunk_rows):
                 digs = dig_rows[bi] if dig_rows is not None else None
                 for i in range(self.n):
-                    d = (digs[i] if digs is not None
-                         else bitrot_algo.digest(bytes(chunks[i])))
-                    qs[i].put((d, chunks[i]))
+                    # digest None -> the writer thread hashes the chunk.
+                    qs[i].put((digs[i] if digs is not None else None,
+                               chunks[i]))
             alive = sum(1 for e in errs if e is None)
             if alive < write_quorum:
                 raise se.InsufficientWriteQuorum(bucket, obj, "write fan-out lost quorum")

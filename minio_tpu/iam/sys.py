@@ -122,11 +122,19 @@ class IAMSys:
         from minio_tpu.crypto.configcrypt import ConfigCryptError
 
         crypt_failures: list[Exception] = []
-        sealed_ok0 = getattr(self._store, "sealed_ok", 0)
+        sealed_ok = 0
+        read2 = getattr(self._store, "read_sys_config2", None)
         with self._mu:
             for key in self._safe_list("iam/"):
                 try:
-                    raw = self._store.read_sys_config(f"iam/{key}")
+                    if read2 is not None:
+                        raw, was_sealed = read2(f"iam/{key}")
+                    else:
+                        raw = self._store.read_sys_config(f"iam/{key}")
+                        was_sealed = False
+                    # A sealed entry that decrypts proves the credential,
+                    # even if its JSON is then found corrupt.
+                    sealed_ok += 1 if was_sealed else 0
                     doc = json.loads(raw)
                 except ConfigCryptError as e:
                     # Could be one bit-rotted entry (skip it, like any
@@ -151,7 +159,6 @@ class IAMSys:
                     tc = TempCredential(**doc)
                     if not tc.expired:
                         self.temp_creds[name] = tc
-        sealed_ok = getattr(self._store, "sealed_ok", 0) - sealed_ok0
         if crypt_failures and sealed_ok == 0:
             # Every SEALED entry failed to decrypt (plaintext pre-migration
             # entries don't count as evidence the credential is right):

@@ -103,7 +103,12 @@ class DataScanner:
         resume_done: dict[str, UsageEntry] = {}
         if ckpt is not None and ckpt.get("c") == fresh.cycles:
             # Interrupted cycle: reuse its work list and finished buckets.
-            to_scan = [b for b in ckpt.get("ts", []) if b in buckets]
+            # Lifecycle-bearing buckets re-union in (a rule attached after
+            # the checkpoint must still fire this cycle); already-finished
+            # buckets stay skipped via resume_done.
+            to_scan = sorted(
+                {b for b in ckpt.get("ts", []) if b in buckets}
+                | set(lifecycles))
             resume_done = {k: UsageEntry.from_doc(v)
                            for k, v in ckpt.get("d", {}).items()
                            if k in buckets}
