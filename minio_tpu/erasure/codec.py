@@ -23,6 +23,39 @@ from minio_tpu.utils import shardmath
 
 DEFAULT_BLOCK_SIZE = 1 << 20  # reference blockSizeV2, cmd/object-api-common.go:41
 
+_SERVING_MESH: object = "unset"
+
+
+def serving_mesh():
+    """The device mesh the SERVING codec shards over, or None.
+
+    Multi-chip hosts (a v5e-8 slice is 8 local devices) run the fused
+    encode+digest launch sharded (dp, tp, sp) with psum completing the
+    GF(2) contraction over ICI — the P6/ICI path of SURVEY §2.4/§5.8 in
+    the production PutObject, not just the dryrun. Single-device hosts
+    return None (plain fused launch). CPU "devices" are virtual (one
+    physical core), so the mesh path is opt-in there via
+    MTPU_MESH_CODEC=1 — which is how the test suite exercises it on the
+    8-device CPU mesh.
+    """
+    global _SERVING_MESH
+    import os
+
+    if _SERVING_MESH == "unset":
+        import jax
+
+        devs = jax.devices()
+        use = len(devs) > 1 and (
+            devs[0].platform != "cpu"
+            or os.environ.get("MTPU_MESH_CODEC") == "1")
+        if use:
+            from minio_tpu.parallel import make_mesh
+
+            _SERVING_MESH = make_mesh(devices=devs)
+        else:
+            _SERVING_MESH = None
+    return _SERVING_MESH
+
 
 class PendingEncode:
     """Handle to an in-flight device encode launch (JAX async dispatch).
@@ -152,18 +185,41 @@ class ErasureCodec:
                 batch[bi, :, s:] = 0
         parity_dev = digs_dev = None
         if self.m or with_digests:
-            data_dev = jnp.asarray(batch)
-            lens_dev = jnp.asarray(chunk_lens, dtype=jnp.int32)
-            if self.m and with_digests:
-                parity_dev, digs_dev = fused.encode_with_digests(
-                    data_dev, self.k, self.m, lens_dev)
-            elif self.m:
-                parity_dev = fused.encode_only(data_dev, self.k, self.m)
-            else:  # digests for a parity-less geometry (k shards only)
-                digs_dev = fused.verify_digests(
-                    data_dev.reshape(len(blocks) * self.k, s_full),
-                    jnp.repeat(lens_dev, self.k),
-                ).reshape(len(blocks), self.k, -1)
+            mesh = serving_mesh()
+            b = len(blocks)
+            dims_ok = (mesh is not None
+                       and b % mesh.shape["dp"] == 0
+                       and self.k % mesh.shape["tp"] == 0
+                       and s_full % mesh.shape["sp"] == 0)
+            if (dims_ok and self.m and with_digests
+                    and all(s == s_full for s in chunk_lens)):
+                # Multi-device host, full blocks: the mesh-sharded fused
+                # launch (psum GF contraction over ICI, sp-sharded mxsum)
+                # — the host numpy batch stays uncommitted so jit shards
+                # it straight onto the mesh. Ragged tails fall through to
+                # the single-device launch, which handles per-block
+                # lengths.
+                from minio_tpu.parallel import sharded_encode_with_mxsum
+
+                parity_dev, digs_dev = sharded_encode_with_mxsum(
+                    mesh, batch, self.k, self.m)
+            elif dims_ok and self.m and not with_digests:
+                from minio_tpu.parallel import sharded_encode
+
+                parity_dev = sharded_encode(mesh, batch, self.k, self.m)
+            else:
+                data_dev = jnp.asarray(batch)
+                lens_dev = jnp.asarray(chunk_lens, dtype=jnp.int32)
+                if self.m and with_digests:
+                    parity_dev, digs_dev = fused.encode_with_digests(
+                        data_dev, self.k, self.m, lens_dev)
+                elif self.m:
+                    parity_dev = fused.encode_only(data_dev, self.k, self.m)
+                else:  # digests for a parity-less geometry (k shards only)
+                    digs_dev = fused.verify_digests(
+                        data_dev.reshape(len(blocks) * self.k, s_full),
+                        jnp.repeat(lens_dev, self.k),
+                    ).reshape(len(blocks), self.k, -1)
         return PendingEncode(self, blocks, chunk_lens, padded,
                              parity_dev, digs_dev)
 

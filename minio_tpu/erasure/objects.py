@@ -8,7 +8,8 @@ codec only when shards are missing; deletes and tagging follow the same
 quorum discipline.
 
 Differences from the reference are deliberate TPU-first design:
-- blocks are encoded in batches (default 8 x 1 MiB per device launch)
+- blocks are encoded in batches (default 16 x 1 MiB per device launch,
+  dispatch-ahead depth 3)
   rather than block-at-a-time (cmd/erasure-encode.go:80);
 - reconstruction groups blocks by failure pattern into single batched
   launches (cmd/erasure-decode.go reconstructs per block);
@@ -127,22 +128,26 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
     def _shard_read_pool(self):
         """Long-lived per-instance pool for parallel shard reads — a fresh
         pool per GET stream would pay thread spawn on the hot read path."""
-        if self._read_pool is None:
-            from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import ThreadPoolExecutor
 
-            with self._read_pool_mu:
-                if self._read_pool is None:
-                    self._read_pool = ThreadPoolExecutor(
-                        max_workers=max(self.n, 8),
-                        thread_name_prefix="shard-read")
-        return self._read_pool
+        with self._read_pool_mu:
+            if self._read_pool is None:
+                self._read_pool = ThreadPoolExecutor(
+                    max_workers=max(self.n, 8),
+                    thread_name_prefix="shard-read")
+            return self._read_pool
 
     def close(self) -> None:
         if self.mrf is not None:
             self.mrf.close()
-        if self._read_pool is not None:
-            self._read_pool.shutdown(wait=False, cancel_futures=True)
-            self._read_pool = None
+        with self._read_pool_mu:
+            if self._read_pool is not None:
+                # Keep the (shut-down) executor referenced: a racing GET
+                # stream then gets RuntimeError from submit — converted to
+                # a quorum error in _read_chunk_rows — rather than an
+                # AttributeError from a nulled pool, and a late caller
+                # can't silently spawn a leaked replacement pool.
+                self._read_pool.shutdown(wait=False, cancel_futures=True)
 
     def all_drives(self) -> list[StorageAPI]:
         return list(self.drives)
@@ -539,17 +544,26 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                         b * shard_size, chunk_lens[j])))
             return out
 
+        from concurrent.futures import CancelledError
+
         results: dict[int, list] = {}
         first_err: tuple[int, Exception] | None = None
         if pool is None:
             futures = None
         else:
-            futures = {i: pool.submit(read_shard, i) for i in chosen}
+            try:
+                futures = {i: pool.submit(read_shard, i) for i in chosen}
+            except RuntimeError:  # pool shut down (layer closing)
+                futures = None
         for i in chosen:
             try:
                 results[i] = (futures[i].result() if futures is not None
                               else read_shard(i))
-            except (se.StorageError, OSError) as e:
+            # CancelledError/RuntimeError: the layer is closing and the
+            # pool rejected/cancelled the read — treat like a dead shard
+            # so the retry loop degrades to a clean quorum error.
+            except (se.StorageError, OSError, CancelledError,
+                    RuntimeError) as e:
                 dead.add(i)
                 readers[i] = None
                 if first_err is None:

@@ -1,0 +1,74 @@
+"""Serving-path mesh codec tests: the PutObject hot loop running the
+mesh-sharded fused encode+digest launch (psum GF contraction + sp-sharded
+mxsum) on the 8-device CPU mesh — the P6/ICI path of SURVEY §2.4 in the
+production codec, not just the dryrun (`__graft_entry__.dryrun_multichip`)."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from minio_tpu.erasure import codec as codecmod
+from minio_tpu.erasure.codec import ErasureCodec
+from minio_tpu.erasure.objects import ErasureObjects
+from minio_tpu.storage.local import LocalDrive
+
+
+@pytest.fixture()
+def mesh_codec(monkeypatch):
+    monkeypatch.setenv("MTPU_MESH_CODEC", "1")
+    codecmod._SERVING_MESH = "unset"
+    yield
+    codecmod._SERVING_MESH = "unset"
+
+
+def test_serving_mesh_active_on_forced_cpu(mesh_codec):
+    mesh = codecmod.serving_mesh()
+    assert mesh is not None
+    assert mesh.devices.size == 8
+
+
+def test_mesh_encode_matches_single_device(mesh_codec):
+    rng = np.random.default_rng(3)
+    blocks = [rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+              for _ in range(8)]
+    c = ErasureCodec(8, 4)
+    mesh_chunks, mesh_digs = c.begin_encode(blocks, with_digests=True).wait()
+    codecmod._SERVING_MESH = None  # force the single-device launch
+    one_chunks, one_digs = c.begin_encode(blocks, with_digests=True).wait()
+    for bi in range(len(blocks)):
+        for i in range(12):
+            assert bytes(mesh_chunks[bi][i]) == bytes(one_chunks[bi][i])
+            assert mesh_digs[bi][i] == one_digs[bi][i]
+
+
+def test_mesh_ragged_batch_falls_back(mesh_codec):
+    # A batch with a short final block must still encode correctly (the
+    # mesh launch only takes all-full batches).
+    rng = np.random.default_rng(4)
+    blocks = [rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+              for _ in range(3)] + [b"tail-block" * 1000]
+    c = ErasureCodec(8, 4)
+    chunks, digs = c.begin_encode(blocks, with_digests=True).wait()
+    assert len(chunks) == 4 and len(digs) == 4
+    from minio_tpu.ops import mxsum
+    assert digs[3][0] == mxsum.digest_np(bytes(chunks[3][0]))
+
+
+def test_mesh_put_get_end_to_end(mesh_codec, tmp_path):
+    """Full PutObject/GetObject through ErasureObjects with the mesh codec
+    active and mxsum digests riding the sharded launch."""
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(12)]
+    es = ErasureObjects(drives, parity=4, bitrot_algorithm="mxsum256")
+    es.make_bucket("meshbkt")
+    payload = os.urandom((16 << 20) + 12345)  # full batches + ragged tail
+    es.put_object("meshbkt", "obj", io.BytesIO(payload), size=len(payload))
+    _, it = es.get_object("meshbkt", "obj")
+    assert b"".join(it) == payload
+    # Deep verify confirms the digests written by the mesh launch: every
+    # drive healthy before AND after means no shard failed its bitrot
+    # check or needed a rebuild.
+    res = es.heal_object("meshbkt", "obj", scan_deep=True)
+    assert all(d.state == "ok" for d in res.before), res.before
+    assert all(d.state == "ok" for d in res.after), res.after
