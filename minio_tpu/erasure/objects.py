@@ -548,13 +548,30 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
 
         results: dict[int, list] = {}
         first_err: tuple[int, Exception] | None = None
-        if pool is None:
-            futures = None
-        else:
+        futures: dict | None = None
+        if pool is not None:
+            futures = {}
             try:
-                futures = {i: pool.submit(read_shard, i) for i in chosen}
-            except RuntimeError:  # pool shut down (layer closing)
-                futures = None
+                for i in chosen:
+                    futures[i] = pool.submit(read_shard, i)
+            except RuntimeError as e:
+                # Pool shut down mid-submit (layer closing). Do NOT fall
+                # back to inline reads: already-running futures share the
+                # BitrotReaders' seek state, so a concurrent inline pass
+                # could serve wrong chunks. Wait the started ones out,
+                # mark every chosen shard dead, and degrade to a clean
+                # quorum error.
+                for f in futures.values():
+                    f.cancel()
+                for f in futures.values():
+                    try:
+                        f.result()
+                    except Exception:  # noqa: BLE001
+                        pass
+                for i in chosen:
+                    dead.add(i)
+                    readers[i] = None
+                raise se.FileCorrupt(f"layer closing: {e}") from None
         for i in chosen:
             try:
                 results[i] = (futures[i].result() if futures is not None
