@@ -13,6 +13,8 @@ the async S3 front door and the threaded RPC plane independent).
 
 from __future__ import annotations
 
+import os
+
 from minio_tpu.dist import endpoint as epmod
 from minio_tpu.dist.dsync import LocalLocker, RemoteLocker, lock_routes
 from minio_tpu.dist.nslock import NamespaceLockMap
@@ -40,16 +42,39 @@ class ClusterNode:
                  secret: str, root_dir_map=None, set_drive_count: int = 0,
                  local_names: set[str] | None = None,
                  rpc_port: int | None = None, parity: int | None = None,
-                 rpc_port_of=None):
+                 rpc_port_of=None, certs_dir: str = ""):
         """pool_args: endpoint args per pool (already split). host/port:
         this node's advertised S3 address — endpoints matching it are local.
         root_dir_map: optional fn(endpoint_path) -> local fs dir (tests map
         drive paths into tmp dirs; production uses the path as-is).
         rpc_port_of: fn(host, s3_port) -> rpc port for a peer (defaults to
-        s3_port + RPC_PORT_OFFSET; tests use OS-assigned ports)."""
+        s3_port + RPC_PORT_OFFSET; tests use OS-assigned ports).
+        certs_dir: when set, the ENTIRE node fabric (storage/lock/peer/
+        bootstrap) serves TLS with the dir's key pair and every client
+        pins the dir's public.crt as its CA — the reference serves all
+        inter-node planes on its TLS listener (pkg/certs role). All
+        nodes share one certs dir (or one CA) by deployment convention."""
         self.host = host
         self.port = port
         self.secret = secret
+        self.certs_dir = certs_dir
+        self._client_ssl = None
+        server_ssl = None
+        self.rpc_scheme = "http"
+        if certs_dir:
+            import ssl as _ssl
+
+            from minio_tpu.utils.certs import CertManager
+
+            # Pass the manager itself: NodeServer handshakes each new
+            # connection against .current(), so rotated certs hot-reload.
+            server_ssl = CertManager(certs_dir)
+            self._client_ssl = _ssl.create_default_context(
+                cafile=os.path.join(certs_dir, "public.crt"))
+            # Peers are addressed by IP/host, not the cert CN: verify the
+            # chain against the pinned cluster cert, skip name matching.
+            self._client_ssl.check_hostname = False
+            self.rpc_scheme = "https"
         self.rpc_port = rpc_port if rpc_port is not None else port + RPC_PORT_OFFSET
         self._rpc_port_of = rpc_port_of or (
             lambda h, p: p + RPC_PORT_OFFSET)
@@ -72,7 +97,8 @@ class ClusterNode:
         self.hooks = PeerHooks()
         self.node_server = NodeServer(host="0.0.0.0" if host not in
                                       ("127.0.0.1", "localhost") else host,
-                                      port=self.rpc_port, secret=secret)
+                                      port=self.rpc_port, secret=secret,
+                                      ssl_context=server_ssl)
         self.node_server.register_plane(
             "storage", storage_routes(self.local_drives))
         self.node_server.register_plane("lock", lock_routes(self.locker))
@@ -106,7 +132,8 @@ class ClusterNode:
         if node not in self._clients:
             host, port = node
             self._clients[node] = RestClient(
-                host, self._rpc_port_of(host, port), self.secret)
+                host, self._rpc_port_of(host, port), self.secret,
+                scheme=self.rpc_scheme, ssl_context=self._client_ssl)
         return self._clients[node]
 
     # -- boot --

@@ -128,12 +128,23 @@ class RestClient:
     a daemon probe brings it back (cmd/rest/client.go:135-168)."""
 
     def __init__(self, host: str, port: int, secret: str,
-                 timeout: float = DEFAULT_TIMEOUT, scheme: str = "http"):
+                 timeout: float = DEFAULT_TIMEOUT, scheme: str = "http",
+                 ssl_context=None):
+        """scheme "https" runs the fabric over TLS. ssl_context should pin
+        the cluster CA (ClusterNode pins certs_dir/public.crt); the
+        default is a verifying system-CA context. An unverified context
+        would let an active MITM replay the bearer token, so never
+        default to CERT_NONE here."""
         self.host = host
         self.port = port
         self.secret = secret
         self.timeout = timeout
         self.scheme = scheme
+        if scheme == "https" and ssl_context is None:
+            import ssl as _ssl
+
+            ssl_context = _ssl.create_default_context()
+        self._ssl_context = ssl_context
         self._online = True
         self._lock = threading.Lock()
         self._pool: list[http.client.HTTPConnection] = []
@@ -141,12 +152,19 @@ class RestClient:
 
     # -- connection pool --
 
+    def _new_conn(self, timeout: float) -> http.client.HTTPConnection:
+        if self.scheme == "https":
+            return http.client.HTTPSConnection(
+                self.host, self.port, timeout=timeout,
+                context=self._ssl_context)
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout)
+
     def _get_conn(self) -> http.client.HTTPConnection:
         with self._lock:
             if self._pool:
                 return self._pool.pop()
-        return http.client.HTTPConnection(self.host, self.port,
-                                          timeout=self.timeout)
+        return self._new_conn(self.timeout)
 
     def _put_conn(self, conn: http.client.HTTPConnection) -> None:
         with self._lock:
@@ -176,8 +194,7 @@ class RestClient:
         while True:
             time.sleep(HEALTH_INTERVAL)
             try:
-                conn = http.client.HTTPConnection(self.host, self.port,
-                                                  timeout=2.0)
+                conn = self._new_conn(timeout=2.0)
                 conn.request("GET", "/health")
                 ok = conn.getresponse().status == 200
                 conn.close()

@@ -79,7 +79,12 @@ class NodeServer:
     """Threaded HTTP server with pluggable RPC planes."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 secret: str = ""):
+                 secret: str = "", ssl_context=None):
+        """ssl_context: serve the fabric over TLS (the reference serves
+        every inter-node plane on its TLS listener). Accepts a plain
+        server-side SSLContext, or an object with .current() (CertManager)
+        — then every new connection handshakes against the freshest
+        context, i.e. rotated certs hot-reload without restart."""
         self.secret = secret
         self._routes: dict[tuple[str, str], Handler] = {}
         outer = self
@@ -102,7 +107,36 @@ class NodeServer:
             def do_POST(self):
                 outer._dispatch(self)
 
-        self._server = ThreadingHTTPServer((host, port), _Req)
+        if ssl_context is None:
+            self._server = ThreadingHTTPServer((host, port), _Req)
+        else:
+            get_ctx = (ssl_context.current
+                       if hasattr(ssl_context, "current")
+                       else lambda: ssl_context)
+
+            class _TLSServer(ThreadingHTTPServer):
+                """TLS handshake runs in the PER-CONNECTION thread (with a
+                timeout), never in the accept loop — a client that opens a
+                socket and sends no ClientHello must not freeze every RPC
+                plane of the node."""
+
+                def finish_request(self, request, client_address):
+                    import ssl as _ssl
+
+                    request.settimeout(10.0)
+                    try:
+                        tls_sock = get_ctx().wrap_socket(
+                            request, server_side=True)
+                        tls_sock.settimeout(None)
+                    except (_ssl.SSLError, OSError):
+                        try:
+                            request.close()
+                        except OSError:
+                            pass
+                        return
+                    super().finish_request(tls_sock, client_address)
+
+            self._server = _TLSServer((host, port), _Req)
         self._server.daemon_threads = True
         self.host, self.port = self._server.server_address[:2]
         self._thread: threading.Thread | None = None

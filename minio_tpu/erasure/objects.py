@@ -566,7 +566,9 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 for f in futures.values():
                     try:
                         f.result()
-                    except Exception:  # noqa: BLE001
+                    # CancelledError is a BaseException on stock
+                    # CPython >= 3.8 — name it or the drain loop leaks it.
+                    except (Exception, CancelledError):  # noqa: BLE001
                         pass
                 for i in chosen:
                     dead.add(i)

@@ -2113,7 +2113,7 @@ def build_server(drive_paths: list[str], access_key: str, secret_key: str,
                  versioned: bool = False, parity: int | None = None,
                  set_drive_count: int | None = None,
                  enable_mrf: bool = True,
-                 server_addr: str = "") -> S3Server:
+                 server_addr: str = "", certs_dir: str = "") -> S3Server:
     """Assemble the full backend stack: drives → sets (sipHash routing) →
     pools (capacity placement) → S3 front door (reference newObjectLayer,
     cmd/server-main.go:557). URL endpoints (http://host/disk) boot the
@@ -2138,7 +2138,7 @@ def build_server(drive_paths: list[str], access_key: str, secret_key: str,
         node = ClusterNode([drive_paths], host=host or "127.0.0.1",
                            port=int(port or 9000), secret=secret_key,
                            set_drive_count=set_drive_count or 0,
-                           parity=parity)
+                           parity=parity, certs_dir=certs_dir)
         node.wait_for_peers()
         layer = node.build_object_layer(enable_mrf=enable_mrf)
         srv = S3Server(layer, sigv4.Credentials(access_key, secret_key),
@@ -2243,7 +2243,8 @@ def main(argv=None):
     srv = build_server(args.drives, access, secret,
                        versioned=args.versioned, parity=args.parity,
                        set_drive_count=args.set_drives,
-                       server_addr=args.address)
+                       server_addr=args.address,
+                       certs_dir=args.certs_dir or "")
     srv.restart_cmd = restart_cmd
     if args.cache_dir:
         from minio_tpu.cache import CacheObjects
