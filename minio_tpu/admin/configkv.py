@@ -17,7 +17,11 @@ from minio_tpu.utils import errors as se
 
 # Registered subsystems and their default keys (cmd/config/config.go:103).
 DEFAULTS: dict[str, dict[str, str]] = {
-    "api": {"requests_max": "0", "cors_allow_origin": "*"},
+    "api": {"requests_max": "0", "cors_allow_origin": "*",
+            # Honor X-Forwarded-For / X-Real-IP in audit/trace records —
+            # only enable behind a trusted reverse proxy (spoofable
+            # otherwise; reference pkg/handlers GetSourceIP role).
+            "trust_proxy_headers": "off"},
     "region": {"name": "us-east-1"},
     "storageclass": {"standard": "", "rrs": "EC:1"},
     "compression": {"enable": "off", "extensions": ".txt,.log,.csv,.json",

@@ -327,6 +327,16 @@ class AdminAPI:
                 info["host"]["memory"] = mem
         except OSError:
             pass
+        from minio_tpu.utils import sysres
+
+        info["host"]["cgroup_mem_limit"] = sysres.cgroup_mem_limit()
+        try:
+            import resource as _res
+
+            info["host"]["nofile"] = list(
+                _res.getrlimit(_res.RLIMIT_NOFILE))
+        except Exception:  # noqa: BLE001
+            pass
         payload = b"\0" * (4 << 20)
         for d in getattr(self.s.obj, "all_drives", lambda: [])():
             if not d.is_local():

@@ -1,0 +1,63 @@
+"""System resource helpers — the pkg/sys + pkg/cgroup roles.
+
+The reference raises its own fd limit at boot (pkg/sys rlimits: a drive
+fleet plus fan-out RPC easily exceeds the default 1024 soft limit) and
+reads the container memory limit (pkg/cgroup) for cache sizing and
+diagnostics. Both are cheap, best-effort probes.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def maximize_nofile() -> tuple[int, int]:
+    """Raise RLIMIT_NOFILE soft -> hard (reference setMaxResources).
+    Returns the resulting (soft, hard); never raises."""
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < hard:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+            soft = hard
+        return soft, hard
+    except Exception:  # noqa: BLE001 - platform without rlimits
+        return -1, -1
+
+
+def cgroup_mem_limit() -> int:
+    """Container memory limit in bytes, or 0 when unlimited/unknown
+    (pkg/cgroup GetMemoryLimit: cgroup v2 memory.max, v1
+    memory.limit_in_bytes)."""
+    for path in ("/sys/fs/cgroup/memory.max",
+                 "/sys/fs/cgroup/memory/memory.limit_in_bytes"):
+        try:
+            raw = open(path, encoding="ascii").read().strip()
+        except OSError:
+            continue
+        if raw == "max":
+            return 0
+        try:
+            val = int(raw)
+        except ValueError:
+            continue
+        # v1 reports ~2^63 when unlimited.
+        return 0 if val >= (1 << 60) else val
+    return 0
+
+
+def total_memory() -> int:
+    """Usable memory bound: min(host MemTotal, cgroup limit)."""
+    host = 0
+    try:
+        for line in open("/proc/meminfo", encoding="ascii"):
+            if line.startswith("MemTotal:"):
+                host = int(line.split()[1]) * 1024
+                break
+    except OSError:
+        pass
+    cg = cgroup_mem_limit()
+    if host and cg:
+        return min(host, cg)
+    return host or cg

@@ -1,0 +1,46 @@
+"""pkg/sys + pkg/cgroup + pkg/handlers role tests: rlimit raising, cgroup
+memory probes, and proxy-aware client-IP resolution in audit/trace."""
+
+import pytest
+
+from minio_tpu.utils import sysres
+
+
+def test_maximize_nofile():
+    soft, hard = sysres.maximize_nofile()
+    assert soft == hard != 0
+
+
+def test_cgroup_and_total_memory_probes():
+    # Values are environment-dependent; the probes must not raise and
+    # must be non-negative.
+    assert sysres.cgroup_mem_limit() >= 0
+    assert sysres.total_memory() >= 0
+
+
+def test_client_ip_logic(tmp_path):
+    from minio_tpu.s3.server import build_server
+
+    srv = build_server([str(tmp_path / f"d{i}") for i in range(4)],
+                       "ripuser", "ripuser-secret", versioned=False)
+
+    class Req:
+        def __init__(self, headers):
+            self.headers = headers
+            self.remote = "10.0.0.1"
+
+    srv.config.set_kv("api", {"trust_proxy_headers": "off"})
+    assert srv._client_ip(Req({"X-Forwarded-For": "1.2.3.4"})) == "10.0.0.1"
+    srv.config.set_kv("api", {"trust_proxy_headers": "on"})
+    assert srv._client_ip(
+        Req({"X-Forwarded-For": "1.2.3.4, 5.6.7.8"})) == "1.2.3.4"
+    assert srv._client_ip(Req({"X-Real-IP": "9.9.9.9"})) == "9.9.9.9"
+    assert srv._client_ip(Req({})) == "10.0.0.1"
+
+
+def test_obd_reports_limits(server, client):
+    r = client.get("/minio/admin/v3/obdinfo")
+    assert r.status_code == 200, r.text
+    host = r.json()["host"]
+    assert "cgroup_mem_limit" in host and host["cgroup_mem_limit"] >= 0
+    assert "nofile" in host and host["nofile"][0] > 0
