@@ -62,18 +62,16 @@ class ClusterNode:
         server_ssl = None
         self.rpc_scheme = "http"
         if certs_dir:
-            import ssl as _ssl
+            from minio_tpu.utils.certs import CertManager, ClientCAManager
 
-            from minio_tpu.utils.certs import CertManager
-
-            # Pass the manager itself: NodeServer handshakes each new
-            # connection against .current(), so rotated certs hot-reload.
+            # Pass the managers, not contexts: both sides of the fabric
+            # consult .current() per connection, so rotated certs
+            # hot-reload inbound AND outbound. Peers are addressed by
+            # IP/host, not the cert CN: the client verifies the chain
+            # against the pinned cluster cert, skipping name matching.
             server_ssl = CertManager(certs_dir)
-            self._client_ssl = _ssl.create_default_context(
-                cafile=os.path.join(certs_dir, "public.crt"))
-            # Peers are addressed by IP/host, not the cert CN: verify the
-            # chain against the pinned cluster cert, skip name matching.
-            self._client_ssl.check_hostname = False
+            self._client_ssl = ClientCAManager(
+                os.path.join(certs_dir, "public.crt"))
             self.rpc_scheme = "https"
         self.rpc_port = rpc_port if rpc_port is not None else port + RPC_PORT_OFFSET
         self._rpc_port_of = rpc_port_of or (

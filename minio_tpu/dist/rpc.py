@@ -131,10 +131,12 @@ class RestClient:
                  timeout: float = DEFAULT_TIMEOUT, scheme: str = "http",
                  ssl_context=None):
         """scheme "https" runs the fabric over TLS. ssl_context should pin
-        the cluster CA (ClusterNode pins certs_dir/public.crt); the
-        default is a verifying system-CA context. An unverified context
-        would let an active MITM replay the bearer token, so never
-        default to CERT_NONE here."""
+        the cluster CA (ClusterNode pins certs_dir/public.crt) — either a
+        plain SSLContext or an object with .current() (ClientCAManager),
+        consulted per connection so CA rotation hot-reloads. The default
+        is a verifying system-CA context. An unverified context would let
+        an active MITM replay the bearer token, so never default to
+        CERT_NONE here."""
         self.host = host
         self.port = port
         self.secret = secret
@@ -144,7 +146,9 @@ class RestClient:
             import ssl as _ssl
 
             ssl_context = _ssl.create_default_context()
-        self._ssl_context = ssl_context
+        self._get_ssl = (ssl_context.current
+                         if hasattr(ssl_context, "current")
+                         else lambda: ssl_context)
         self._online = True
         self._lock = threading.Lock()
         self._pool: list[http.client.HTTPConnection] = []
@@ -156,7 +160,7 @@ class RestClient:
         if self.scheme == "https":
             return http.client.HTTPSConnection(
                 self.host, self.port, timeout=timeout,
-                context=self._ssl_context)
+                context=self._get_ssl())
         return http.client.HTTPConnection(self.host, self.port,
                                           timeout=timeout)
 

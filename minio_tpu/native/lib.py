@@ -68,6 +68,16 @@ def _build_and_load():
             ctypes.c_uint32, ctypes.c_uint32, ctypes.c_char_p,
             ctypes.c_uint32]
         lib.mtpu_argon2id.restype = ctypes.c_int
+        lib.mtpu_csv_index.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint8,
+            ctypes.c_uint8, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.mtpu_csv_index.restype = ctypes.c_int64
+        lib.mtpu_csv_parse_floats.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_uint8, ctypes.c_void_p]
+        lib.mtpu_csv_parse_floats.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -232,6 +242,58 @@ def argon2id(password: bytes, salt: bytes, *, t: int = 1,
     if rc != 0:
         raise OSError("argon2id failed (bad parameters)")
     return out.raw
+
+
+# --- CSV indexer + bulk float parse (S3 Select vector engine) ----------------
+
+def csv_index_available() -> bool:
+    return _build_and_load() is not None
+
+
+def csv_index(data: bytes, delim: bytes = b",", quote: bytes = b'"'):
+    """Tokenize a CSV buffer natively. Returns (row_start int64[nrows+1],
+    foff int64[nfields], flen int32[nfields]) — row r's fields are
+    foff/flen[row_start[r]:row_start[r+1]]; quoted fields keep their
+    quotes. Raises OSError without the native lib."""
+    import numpy as np
+
+    lib = _build_and_load()
+    if lib is None:
+        raise OSError("native csv indexer unavailable")
+    # The tokenizer ends records at \n, \r and \r\n — bound capacity by
+    # BOTH terminators (CR-only files would otherwise overflow the bound).
+    newlines = data.count(b"\n") + data.count(b"\r")
+    max_fields = data.count(delim) + newlines + 2
+    max_rows = newlines + 2
+    foff = np.empty(max_fields, dtype=np.int64)
+    flen = np.empty(max_fields, dtype=np.int32)
+    row_start = np.empty(max_rows + 1, dtype=np.int64)
+    nfields = ctypes.c_uint64(0)
+    nrows = lib.mtpu_csv_index(
+        data, len(data), delim[0], quote[0],
+        foff.ctypes.data, flen.ctypes.data, row_start.ctypes.data,
+        max_fields, max_rows, ctypes.byref(nfields))
+    if nrows < 0:
+        raise ValueError("csv index capacity exceeded")
+    return (row_start[:nrows + 1], foff[:nfields.value],
+            flen[:nfields.value])
+
+
+def csv_parse_floats(data: bytes, foff, flen, quote: bytes = b'"'):
+    """Bulk-parse fields to float64 (NaN for empty/non-numeric; hex/inf/
+    nan spellings report NaN so callers fall back to exact row-wise
+    coercion). Returns float64 array."""
+    import numpy as np
+
+    lib = _build_and_load()
+    if lib is None:
+        raise OSError("native csv parser unavailable")
+    foff = np.ascontiguousarray(foff, dtype=np.int64)
+    flen = np.ascontiguousarray(flen, dtype=np.int32)
+    out = np.empty(len(foff), dtype=np.float64)
+    lib.mtpu_csv_parse_floats(data, foff.ctypes.data, flen.ctypes.data,
+                              len(foff), quote[0], out.ctypes.data)
+    return out
 
 
 # --- snappy block codec + crc32c (the S2 compression role) -------------------

@@ -440,7 +440,7 @@ class S3Server:
                     "time": _time.time(), "api": api,
                     "method": request.method, "path": path,
                     "status": status, "requestId": request_id,
-                    "remote": request.remote,
+                    "remote": self._client_ip(request),
                 })
             # Per-request AUDIT record (reference logger.AuditLog at every
             # handler, cmd/object-handlers.go:1378) — zero cost unless an
@@ -458,13 +458,28 @@ class S3Server:
                     object=parts[1] if len(parts) > 1 else "",
                     status_code=status,
                     access_key=getattr(ident, "access_key", "") or "",
-                    remote_host=request.remote or "",
+                    remote_host=self._client_ip(request),
                     user_agent=request.headers.get("User-Agent", ""),
                     request_id=request_id,
                     rx_bytes=rx, tx_bytes=tx,
                     duration_ms=(_time.perf_counter() - t0) * 1000,
                     query=dict(urllib.parse.parse_qsl(request.query_string)),
                 ))
+
+    def _client_ip(self, request) -> str:
+        """Requester IP for audit/trace records. Proxy headers
+        (X-Forwarded-For leftmost hop, X-Real-IP) are honored only when
+        api.trust_proxy_headers is on — they are client-spoofable
+        otherwise (pkg/handlers GetSourceIP role)."""
+        if (self.config.get("api", "trust_proxy_headers") or "") in (
+                "on", "1", "true"):
+            fwd = request.headers.get("X-Forwarded-For", "")
+            if fwd:
+                return fwd.split(",")[0].strip()
+            real = request.headers.get("X-Real-IP", "")
+            if real:
+                return real.strip()
+        return request.remote or ""
 
     def _error_response(self, e: S3Error, resource: str, request_id: str):
         body = xmlutil.error_xml(e.api.code, e.message, resource, request_id, e.extra)
@@ -2222,6 +2237,13 @@ def main(argv=None):
                          "hot-reloaded); empty serves plaintext HTTP")
     args = ap.parse_args(argv)
     import sys as _sys
+
+    # Raise the fd soft limit to the hard limit (reference pkg/sys
+    # setMaxResources) — a drive fleet + RPC fan-out outgrows the default
+    # 1024 fast.
+    from minio_tpu.utils import sysres
+
+    sysres.maximize_nofile()
 
     # The exact re-exec line `admin service restart` uses (module entry —
     # script-mode exec would lose the package root from sys.path).

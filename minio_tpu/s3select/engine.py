@@ -127,6 +127,17 @@ def run_select(body_stream, request: S3SelectRequest
     query = parse(request.expression)
     ev = Evaluator(query)
 
+    if request.input_format == "CSV":
+        # Vector fast lane: native CSV indexing + columnar WHERE/aggregate
+        # evaluation (s3select/vector.py); row-engine-exact or declined.
+        from minio_tpu.s3select import vector
+
+        plan = vector.compile_plan(query, request)
+        if plan is not None:
+            raw = readers.decompress(body_stream, request.compression)
+            yield from vector.run_vectorized(plan, raw, request, query)
+            return
+
     if request.input_format == "PARQUET":
         import struct as _struct
 

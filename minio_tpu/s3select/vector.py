@@ -1,0 +1,489 @@
+"""Vectorized S3 Select execution over the native CSV indexer.
+
+The simdjson-go / csvparser role (SURVEY §2.3) re-designed columnar: the
+C++ tokenizer (native/mtpu_native.cc mtpu_csv_index) turns each chunk
+into a flat (offset, length) field table, WHERE predicates evaluate as
+numpy masks over natively-parsed float columns, and aggregates reduce
+whole columns — no per-row dict, no per-row Python eval on the hot path.
+
+Exactness contract: rows whose fields defeat the bulk float parser
+(non-numeric strings, exotic spellings) are re-evaluated ROW-WISE with
+the ordinary `sql.Evaluator` on the original parsed values, so results
+match the row engine bit-for-bit; the vector path is a fast lane for the
+common shape, not a second dialect. Queries outside the supported shape
+(LIKE, IN, BETWEEN, string ordering, expressions in projections,
+custom record delimiters, comment lines) return None from compile_plan
+and take the row engine.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from minio_tpu.native import lib as nativelib
+from minio_tpu.s3select.sql import (
+    Binary,
+    Col,
+    Evaluator,
+    Func,
+    Lit,
+    Query,
+    Unary,
+)
+
+CHUNK = 4 << 20
+
+
+class _Unsupported(Exception):
+    pass
+
+
+# --- predicate tree ----------------------------------------------------------
+
+class _Cmp:
+    __slots__ = ("col", "op", "lit", "node")
+
+    def __init__(self, col: str, op: str, lit, node):
+        self.col = col
+        self.op = op          # one of = <> < <= > >=
+        self.lit = lit        # int/float (numeric compare) or str (eq only)
+        self.node = node      # original AST node, for exact fallback
+
+
+class _Bool:
+    __slots__ = ("op", "kids")
+
+    def __init__(self, op: str, kids: list):
+        self.op = op          # AND | OR | NOT
+        self.kids = kids
+
+
+_FLOAT_CASTS = {"FLOAT", "DOUBLE", "DECIMAL", "NUMERIC", "REAL"}
+_SWAP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "<>": "<>"}
+
+
+def _as_col(node) -> str | None:
+    if isinstance(node, Col) and node.name:
+        return node.name
+    if (isinstance(node, Func) and node.name == "CAST"
+            and node.cast_type.upper() in _FLOAT_CASTS
+            and len(node.args) == 1 and isinstance(node.args[0], Col)
+            and node.args[0].name):
+        # CAST(col AS FLOAT): identical numeric lane; non-numeric fields
+        # go to the row fallback, which raises exactly as CAST does.
+        return node.args[0].name
+    return None
+
+
+def _compile_where(node):
+    if node is None:
+        return None
+    if isinstance(node, Binary):
+        if node.op in ("AND", "OR"):
+            return _Bool(node.op, [_compile_where(node.l),
+                                   _compile_where(node.r)])
+        if node.op in ("=", "<>", "<", "<=", ">", ">="):
+            for l, r, op in ((node.l, node.r, node.op),
+                             (node.r, node.l, _SWAP[node.op])):
+                col = _as_col(l)
+                if col is not None and isinstance(r, Lit):
+                    v = r.value
+                    if isinstance(v, bool):
+                        raise _Unsupported("bool literal")
+                    if isinstance(v, (int, float)):
+                        return _Cmp(col, op, v, node)
+                    if isinstance(v, str) and op in ("=", "<>"):
+                        try:
+                            float(v)
+                        except ValueError:
+                            if v.isascii():
+                                return _Cmp(col, op, v, node)
+                        raise _Unsupported("numeric-ish string literal")
+            raise _Unsupported(f"comparison shape {node!r}")
+        raise _Unsupported(f"operator {node.op}")
+    if isinstance(node, Unary) and node.op == "NOT":
+        return _Bool("NOT", [_compile_where(node.e)])
+    if isinstance(node, Lit) and isinstance(node.value, bool):
+        return _Bool("LIT_TRUE" if node.value else "LIT_FALSE", [])
+    raise _Unsupported(f"node {type(node).__name__}")
+
+
+def compile_plan(query: Query, request) -> "VectorPlan | None":
+    """A VectorPlan when (query, request) fits the vector shape, else
+    None (row engine)."""
+    if not nativelib.csv_index_available():
+        return None
+    if request.input_format != "CSV":
+        return None
+    if (request.csv_comments or len(request.csv_delimiter or ",") != 1
+            or len(request.csv_quote or '"') != 1
+            or (request.csv_header or "USE").upper()
+            not in ("USE", "NONE", "IGNORE")):
+        return None
+    try:
+        where = _compile_where(query.where)
+    except _Unsupported:
+        return None
+    if query.aggregates:
+        # Every projection must be one of the collected aggregate Funcs.
+        for p in query.projections:
+            if not (isinstance(p.expr, Func)
+                    and p.expr in query.aggregates):
+                return None
+        for f in query.aggregates:
+            if not f.star and not (len(f.args) == 1
+                                   and isinstance(f.args[0], Col)
+                                   and f.args[0].name):
+                return None
+    else:
+        for p in query.projections:
+            if p.expr is None:
+                continue
+            if not (isinstance(p.expr, Col) and p.expr.name):
+                return None
+    return VectorPlan(query, where, request)
+
+
+# --- execution ---------------------------------------------------------------
+
+class _Batch:
+    """One indexed chunk: lazy column materialization.
+
+    Kept rows are addressed through `rfirst` (first-field index per row)
+    + `nfields`; BLANK records (one zero-length field — empty lines,
+    and the stray records CRLF splitting can produce at chunk seams) are
+    filtered out everywhere, exactly as csv.reader skips blank lines in
+    the row engine."""
+
+    def __init__(self, data: bytes, plan: "VectorPlan"):
+        self.data = data
+        delim = (plan.request.csv_delimiter or ",").encode()
+        self.quote = (plan.request.csv_quote or '"').encode()
+        row_start, self.foff, self.flen = nativelib.csv_index(
+            data, delim, self.quote)
+        self.rfirst = row_start[:-1]
+        self.nfields = row_start[1:] - row_start[:-1]
+        blank = (self.nfields == 1) & (self.flen[self.rfirst] == 0)
+        if blank.any():
+            keep = ~blank
+            self.rfirst = self.rfirst[keep]
+            self.nfields = self.nfields[keep]
+        self.nrows = len(self.rfirst)
+        self._floats: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+
+    def drop_first_row(self) -> None:
+        self.rfirst = self.rfirst[1:]
+        self.nfields = self.nfields[1:]
+        self.nrows -= 1
+
+    def col_field_idx(self, ci: int) -> tuple[np.ndarray, np.ndarray]:
+        """(field table indices, present mask) for column ci."""
+        present = self.nfields > ci
+        idx = self.rfirst + ci
+        return np.where(present, idx, 0), present
+
+    def floats(self, ci: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(values f64, numeric mask, present mask) for column ci."""
+        got = self._floats.get(ci)
+        if got is None:
+            idx, present = self.col_field_idx(ci)
+            vals = nativelib.csv_parse_floats(
+                self.data, self.foff[idx], self.flen[idx], self.quote)
+            ok = ~np.isnan(vals) & present
+            got = self._floats[ci] = (vals, ok, present)
+        return got
+
+    def field_str(self, ri: int, ci: int) -> str:
+        f = self.rfirst[ri] + ci
+        off, ln = self.foff[f], self.flen[f]
+        raw = self.data[off:off + ln]
+        q = self.quote
+        if ln >= 2 and raw[:1] == q and raw[-1:] == q:
+            raw = raw[1:-1].replace(q + q, q)
+        return raw.decode("utf-8", "replace")
+
+    def row_dict(self, ri: int, names: list[str]) -> dict:
+        row: dict = {}
+        for ci in range(int(self.nfields[ri])):
+            v = self.field_str(ri, ci)
+            row[f"_{ci + 1}"] = v
+            if ci < len(names):
+                row[names[ci]] = v
+        return row
+
+    def record_bytes(self, ri: int) -> bytes:
+        first = self.rfirst[ri]
+        last = first + self.nfields[ri] - 1
+        return self.data[self.foff[first]:
+                         self.foff[last] + self.flen[last]]
+
+
+class VectorPlan:
+    def __init__(self, query: Query, where, request):
+        self.query = query
+        self.where = where
+        self.request = request
+        self.names: list[str] = []
+        self._col_idx: dict[str, int] = {}
+        self._header_done = (request.csv_header or "USE").upper() == "NONE"
+
+    # -- column resolution --
+
+    def _ci(self, name: str) -> int | None:
+        """Mirror Evaluator's Col resolution: exact name, then with the
+        leading table-alias segment dropped, then the last segment."""
+        for cand in ([name]
+                     + ([name.split(".", 1)[1], name.rsplit(".", 1)[-1]]
+                        if "." in name else [])):
+            if cand.startswith("_") and cand[1:].isdigit():
+                return int(cand[1:]) - 1
+            ci = self._col_idx.get(cand)
+            if ci is not None:
+                return ci
+        return None
+
+    # -- predicate evaluation: three-valued (value, known) masks --
+
+    def _eval(self, node, batch: _Batch, ev: Evaluator):
+        n = batch.nrows
+        if node is None:
+            return np.ones(n, bool), np.ones(n, bool)
+        if isinstance(node, _Bool):
+            if node.op == "LIT_TRUE":
+                return np.ones(n, bool), np.ones(n, bool)
+            if node.op == "LIT_FALSE":
+                return np.zeros(n, bool), np.ones(n, bool)
+            if node.op == "NOT":
+                v, k = self._eval(node.kids[0], batch, ev)
+                return ~v, k
+            lv, lk = self._eval(node.kids[0], batch, ev)
+            rv, rk = self._eval(node.kids[1], batch, ev)
+            if node.op == "AND":
+                value = lv & rv
+                known = (lk & rk) | (lk & ~lv) | (rk & ~rv)
+            else:
+                value = lv | rv
+                known = (lk & rk) | (lk & lv) | (rk & rv)
+            return value & known, known
+        # _Cmp
+        ci = self._ci(node.col)
+        if ci is None:  # unknown column -> MISSING -> NULL comparison
+            return np.zeros(n, bool), np.zeros(n, bool)
+        if isinstance(node.lit, str):
+            # = / <> against a non-numeric ASCII literal: pure bytes
+            # equality on the unquoted field (the row engine string-
+            # compares exactly this way for non-numeric literals).
+            idx, present = batch.col_field_idx(ci)
+            lit = node.lit.encode()
+            eq = np.zeros(n, bool)
+            cand = np.nonzero(present)[0]
+            offs, lens = batch.foff[idx], batch.flen[idx]
+            q = batch.quote[0]
+            for ri in cand:
+                off, ln = offs[ri], lens[ri]
+                raw = batch.data[off:off + ln]
+                if ln >= 2 and raw[0] == q and raw[-1] == q:
+                    raw = raw[1:-1].replace(batch.quote * 2, batch.quote)
+                eq[ri] = raw == lit
+            value = eq if node.op == "=" else (~eq & present)
+            return value & present, present
+        vals, ok, present = batch.floats(ci)
+        lit = float(node.lit)
+        if node.op == "=":
+            value = vals == lit
+        elif node.op == "<>":
+            value = vals != lit
+        elif node.op == "<":
+            value = vals < lit
+        elif node.op == "<=":
+            value = vals <= lit
+        elif node.op == ">":
+            value = vals > lit
+        else:
+            value = vals >= lit
+        value = value & ok
+        known = ok.copy()
+        # Exact fallback for present-but-non-numeric fields: evaluate the
+        # ORIGINAL AST node row-wise (string/exotic coercion rules).
+        odd = np.nonzero(present & ~ok)[0]
+        for ri in odd:
+            res = ev.eval(node.node, batch.row_dict(int(ri), self.names))
+            if res is None:
+                continue
+            known[ri] = True
+            value[ri] = bool(res)
+        return value, known
+
+    def match_mask(self, batch: _Batch, ev: Evaluator) -> np.ndarray:
+        v, k = self._eval(self.where, batch, ev)
+        return v & k
+
+    # -- chunked streaming split on record boundaries --
+
+    def chunks(self, stream):
+        carry = b""
+        q = (self.request.csv_quote or '"').encode()
+        while True:
+            buf = stream.read(CHUNK)
+            if not buf:
+                if carry:
+                    yield carry
+                return
+            data = carry + buf
+            cut = len(data)
+            while True:
+                # A record terminator is \n, \r or \r\n: split at the
+                # last one with even quote parity (an unbalanced quote
+                # means it sits inside a quoted field). A CRLF split
+                # between \r and \n leaves a blank record at the next
+                # chunk's head, which _Batch filters.
+                cut = max(data.rfind(b"\n", 0, cut),
+                          data.rfind(b"\r", 0, cut))
+                if cut < 0:
+                    break
+                if data.count(q, 0, cut + 1) % 2 == 0:
+                    break
+            if cut < 0:
+                carry = data
+                continue
+            yield data[:cut + 1]
+            carry = data[cut + 1:]
+
+    def consume_header(self, batch: _Batch) -> None:
+        """Resolve column names from the first row of the first batch."""
+        hdr = (self.request.csv_header or "USE").upper()
+        if self._header_done:
+            return
+        if batch.nrows and hdr == "USE":
+            self.names = [batch.field_str(0, ci)
+                          for ci in range(int(batch.nfields[0]))]
+            self._col_idx = {nm: i for i, nm in enumerate(self.names)}
+        if batch.nrows:
+            batch.drop_first_row()
+            self._header_done = True
+
+
+def _num_py(v):
+    from minio_tpu.s3select import sql as _sql
+
+    return _sql._num(v)
+
+
+def run_vectorized(plan: VectorPlan, raw_stream, request,
+                   query: Query):
+    """Evaluate the plan over the (decompressed) stream, yielding the same
+    event-stream frames run_select's row loop produces."""
+    import io
+
+    from minio_tpu.s3select import eventstream as es
+    from minio_tpu.s3select.engine import RECORDS_FLUSH, _serialize
+
+    ev = Evaluator(query)
+    scanned = 0
+    returned = 0
+    emitted = 0
+    pending = io.BytesIO()
+
+    def flush():
+        nonlocal returned
+        data = pending.getvalue()
+        if not data:
+            return None
+        pending.seek(0)
+        pending.truncate()
+        returned += len(data)
+        return es.records_message(data)
+
+    select_star = all(p.expr is None for p in query.projections)
+    raw_ok = (not query.aggregates and select_star
+              and request.output_format == "CSV"
+              and request.out_csv_delimiter == (request.csv_delimiter or ",")
+              and request.out_record_delimiter == "\n")
+    header_order: list[str] = []
+    done = False
+
+    for chunk in plan.chunks(raw_stream):
+        if done:
+            break
+        batch = _Batch(chunk, plan)
+        plan.consume_header(batch)
+        if batch.nrows == 0:
+            continue
+        scanned += batch.nrows
+        mask = plan.match_mask(batch, ev)
+
+        if ev.is_aggregate:
+            for f, st in zip(query.aggregates, ev.agg_state):
+                if f.star:
+                    st["count"] += int(mask.sum())
+                    continue
+                ci = plan._ci(f.args[0].name)
+                if ci is None:
+                    continue  # column MISSING everywhere
+                vals, ok, present = batch.floats(ci)
+                sel = mask & present
+                st["count"] += int(sel.sum())
+                num = sel & ok
+                if num.any():
+                    s = vals[num]
+                    tot, mn, mx = float(s.sum()), float(s.min()), float(s.max())
+                    st["sum"] += tot
+                    st["min"] = mn if st["min"] is None else min(st["min"], mn)
+                    st["max"] = mx if st["max"] is None else max(st["max"], mx)
+                for ri in np.nonzero(sel & ~ok)[0]:
+                    n = _num_py(batch.field_str(int(ri), ci))
+                    if n is not None:
+                        st["sum"] += n
+                        st["min"] = n if st["min"] is None else min(st["min"], n)
+                        st["max"] = n if st["max"] is None else max(st["max"], n)
+            continue
+
+        q = batch.quote[0]
+        for ri in np.nonzero(mask)[0]:
+            ri = int(ri)
+            if raw_ok:
+                rec = batch.record_bytes(ri)
+                if q not in rec and b"\r" not in rec:
+                    pending.write(rec + b"\n")
+                    emitted += 1
+                else:
+                    row = batch.row_dict(ri, plan.names)
+                    out = ev.project(row)
+                    if not header_order:
+                        header_order = [k for k in out
+                                        if not (k.startswith("_")
+                                                and k[1:].isdigit())] \
+                            or list(out)
+                    pending.write(
+                        _serialize(out, request, header_order).encode())
+                    emitted += 1
+            else:
+                row = batch.row_dict(ri, plan.names)
+                out = ev.project(row)
+                if not header_order:
+                    header_order = [k for k in out
+                                    if not (k.startswith("_")
+                                            and k[1:].isdigit())] \
+                        or list(out)
+                pending.write(
+                    _serialize(out, request, header_order).encode())
+                emitted += 1
+            if pending.tell() >= RECORDS_FLUSH:
+                msg = flush()
+                if msg:
+                    yield msg
+            if query.limit is not None and emitted >= query.limit:
+                # Mirror the row engine's stats: it stops pulling rows at
+                # the limit-th match, so rows after it are never scanned.
+                scanned -= batch.nrows - (ri + 1)
+                done = True
+                break
+
+    if ev.is_aggregate:
+        out_row = ev.project({})
+        pending.write(_serialize(out_row, request, list(out_row)).encode())
+    msg = flush()
+    if msg:
+        yield msg
+    yield es.stats_message(scanned, scanned, returned)
+    yield es.end_message()

@@ -100,3 +100,32 @@ def self_signed(certs_dir: str, common_name: str = "minio-tpu") -> None:
             serialization.NoEncryption()))
     with open(os.path.join(certs_dir, PUBLIC_CERT), "wb") as f:
         f.write(cert.public_bytes(serialization.Encoding.PEM))
+
+
+class ClientCAManager:
+    """Client-side counterpart of CertManager: a verifying SSLContext
+    pinning `cafile`, rebuilt when the file's mtime changes — so the
+    OUTBOUND half of the fabric follows a cert rotation too (a client
+    that pinned the boot-time CA would reject every peer after the
+    rotation until restart)."""
+
+    def __init__(self, cafile: str, check_hostname: bool = False):
+        self.cafile = cafile
+        self.check_hostname = check_hostname
+        self._mu = threading.Lock()
+        self._mtime = -1.0
+        self._ctx: ssl.SSLContext | None = None
+        self.current()  # fail fast on a missing/bad CA file
+
+    def current(self) -> ssl.SSLContext:
+        with self._mu:
+            try:
+                mtime = os.stat(self.cafile).st_mtime
+            except OSError:
+                mtime = self._mtime  # keep serving the last good context
+            if self._ctx is None or mtime != self._mtime:
+                ctx = ssl.create_default_context(cafile=self.cafile)
+                ctx.check_hostname = self.check_hostname
+                self._ctx = ctx
+                self._mtime = mtime
+            return self._ctx

@@ -473,6 +473,125 @@ int64_t mtpu_snappy_uncompress(const uint8_t* in, uint64_t n, uint8_t* out,
 }
 
 // ---------------------------------------------------------------------------
+// CSV field indexer + bulk float parser — the simdjson-go / pkg/csvparser
+// role for S3 Select (SURVEY §2.3): tokenize a CSV buffer into a flat
+// (offset, length) field table in one native pass so the Python engine
+// evaluates WHERE/aggregates vectorized over columns instead of building
+// a dict per row.
+// ---------------------------------------------------------------------------
+
+// RFC 4180 tokenizer. Writes per-field (offset, length) — quoted fields
+// keep their surrounding quotes (the consumer unquotes lazily) — and
+// row_start[r] = index of row r's first field (with a final sentinel, so
+// row_start needs max_rows+1 capacity). Records end at \n or \r\n.
+// Returns the row count, or -1 when a capacity is exceeded.
+int64_t mtpu_csv_index(const uint8_t* data, uint64_t n, uint8_t delim,
+                       uint8_t quote, int64_t* foff, int32_t* flen,
+                       int64_t* row_start, uint64_t max_fields,
+                       uint64_t max_rows, uint64_t* out_nfields) {
+  uint64_t i = 0, nf = 0, nr = 0;
+  while (i < n) {
+    if (nr >= max_rows) return -1;
+    row_start[nr++] = static_cast<int64_t>(nf);
+    for (;;) {
+      if (nf >= max_fields) return -1;
+      uint64_t start = i;
+      if (i < n && data[i] == quote) {
+        ++i;
+        while (i < n) {
+          if (data[i] == quote) {
+            if (i + 1 < n && data[i + 1] == quote) {
+              i += 2;  // doubled quote escapes
+            } else {
+              ++i;
+              break;
+            }
+          } else {
+            ++i;
+          }
+        }
+      }
+      while (i < n && data[i] != delim && data[i] != '\n' &&
+             data[i] != '\r')
+        ++i;
+      foff[nf] = static_cast<int64_t>(start);
+      flen[nf] = static_cast<int32_t>(i - start);
+      ++nf;
+      if (i >= n) break;
+      if (data[i] == delim) {
+        ++i;
+        continue;
+      }
+      if (data[i] == '\r') {
+        ++i;
+        if (i < n && data[i] == '\n') ++i;
+      } else {
+        ++i;  // '\n'
+      }
+      break;
+    }
+  }
+  row_start[nr] = static_cast<int64_t>(nf);
+  *out_nfields = nf;
+  return static_cast<int64_t>(nr);
+}
+
+// Bulk strtod over an (offset, length) field table. Surrounding quotes and
+// ASCII whitespace are stripped; empty or non-fully-numeric fields parse
+// as NaN. Returns the count of numeric fields.
+int64_t mtpu_csv_parse_floats(const uint8_t* data, const int64_t* off,
+                              const int32_t* len, uint64_t n, uint8_t quote,
+                              double* out) {
+  int64_t ok = 0;
+  char buf[64];
+  const double nan = __builtin_nan("");
+  for (uint64_t i = 0; i < n; ++i) {
+    const uint8_t* p = data + off[i];
+    int32_t l = len[i];
+    if (l >= 2 && p[0] == quote && p[l - 1] == quote) {
+      ++p;
+      l -= 2;
+    }
+    while (l > 0 && (*p == ' ' || *p == '\t')) {
+      ++p;
+      --l;
+    }
+    while (l > 0 && (p[l - 1] == ' ' || p[l - 1] == '\t')) --l;
+    if (l <= 0 || l >= (int32_t)sizeof(buf)) {
+      out[i] = nan;
+      continue;
+    }
+    // strtod accepts hex/nan/inf spellings that the Python engine's
+    // numeric coercion treats differently — push those to the exact
+    // row-wise fallback by reporting them non-numeric here.
+    bool odd = false;
+    for (int32_t k = 0; k < l; ++k) {
+      uint8_t c = p[k];
+      if (c == 'x' || c == 'X' || c == 'n' || c == 'N' || c == 'i' ||
+          c == 'I') {
+        odd = true;
+        break;
+      }
+    }
+    if (odd) {
+      out[i] = nan;
+      continue;
+    }
+    memcpy(buf, p, l);
+    buf[l] = '\0';
+    char* end = nullptr;
+    double v = strtod(buf, &end);
+    if (end != buf + l) {
+      out[i] = nan;
+      continue;
+    }
+    out[i] = v;
+    ++ok;
+  }
+  return ok;
+}
+
+// ---------------------------------------------------------------------------
 // Argon2id (RFC 9106) — the pkg/argon2 role: memory-hard KDF used to
 // derive the config-at-rest encryption key from the root credential
 // (reference cmd/config-encrypted.go via madmin EncryptData). Includes

@@ -96,6 +96,14 @@ def test_fabric_cert_hot_reload(tls_nodes, tmp_path):
     c = RestClient("127.0.0.1", n1.node_server.port, SECRET,
                    scheme="https", ssl_context=ctx, timeout=5.0)
     assert c.call_msgpack("/rpc/peer/v1/health") is not None
+    # Node-to-node: drop pooled connections so the peer client must do a
+    # FRESH handshake — its CA manager must have picked up the rotation.
+    peer_client = n1.peers[0]._client
+    with peer_client._lock:
+        for conn in peer_client._pool:
+            conn.close()
+        peer_client._pool.clear()
+    assert isinstance(n1.peers[0].health(), dict)
 
 
 def test_plaintext_client_rejected_by_tls_fabric(tls_nodes):
