@@ -296,6 +296,40 @@ def bench_e2e_multipart() -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_select_csv() -> dict:
+    """S3 Select CSV scan rate (BASELINE 'run-to-measure' matrix,
+    pkg/s3select/select_benchmark_test.go:132 role): aggregate + WHERE
+    over 1M rows through the vectorized engine."""
+    import io
+
+    from minio_tpu.s3select.engine import S3SelectRequest, run_select
+
+    data = b"id,price,qty\n" + b"".join(
+        b"%d,%d.5,%d\n" % (i, i % 1000, i % 7) for i in range(1_000_000))
+    req = S3SelectRequest.__new__(S3SelectRequest)
+    req.expression = ("SELECT COUNT(*), SUM(s.price) FROM S3Object s "
+                      "WHERE CAST(s.price AS FLOAT) > 500")
+    req.input_format = "CSV"
+    req.compression = "NONE"
+    req.csv_header = "USE"
+    req.csv_delimiter = ","
+    req.csv_quote = '"'
+    req.csv_comments = ""
+    req.json_type = "LINES"
+    req.output_format = "CSV"
+    req.out_csv_delimiter = ","
+    req.out_record_delimiter = "\n"
+    b"".join(run_select(io.BytesIO(data), req))  # warmup
+    t0 = time.perf_counter()
+    iters = 3
+    for _ in range(iters):
+        b"".join(run_select(io.BytesIO(data), req))
+    dt = time.perf_counter() - t0
+    mbs = len(data) * iters / dt / 1e6
+    return {"metric": "s3select_csv_scan_1M_rows", "value": round(mbs, 1),
+            "unit": "MB/s", "vs_baseline": 0.0}
+
+
 def main() -> int:
     t_start = time.time()
     configs: list[dict] = []
@@ -348,6 +382,7 @@ def main() -> int:
             ("verify_decode", lambda: bench_verify_decode_fused(jax, jnp)),
             ("heal", lambda: bench_heal(jax, jnp)),
             ("e2e", bench_e2e_multipart),
+            ("select", bench_select_csv),
         ]
         if use_pallas:
             plans.insert(1, ("encode_pallas",

@@ -36,6 +36,10 @@ DEFAULTS: dict[str, dict[str, str]] = {
     "notify_mqtt": {"enable": "off", "address": "", "topic": "minio"},
     "notify_elasticsearch": {"enable": "off", "url": "", "index": "minio"},
     "notify_nsq": {"enable": "off", "address": "", "topic": "minio"},
+    "notify_kafka": {"enable": "off", "brokers": "", "topic": "minio"},
+    "notify_amqp": {"enable": "off", "url": "", "exchange": "",
+                    "routing_key": "minio", "user": "guest",
+                    "password": "guest", "vhost": "/"},
     "logger_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
     "audit_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
     "audit_file": {"path": ""},
@@ -57,7 +61,8 @@ DEFAULTS: dict[str, dict[str, str]] = {
 DYNAMIC = {"api", "scanner", "heal",
            "logger_webhook", "audit_webhook", "audit_file",
            "notify_webhook", "notify_nats", "notify_redis", "notify_mqtt",
-           "notify_elasticsearch", "notify_nsq"}
+           "notify_elasticsearch", "notify_nsq", "notify_kafka",
+           "notify_amqp"}
 
 PATH = "config/config.json"
 ENV_PREFIX = "MTPU"

@@ -27,6 +27,7 @@ import threading
 import time
 import urllib.parse
 import uuid
+import zlib
 from typing import Protocol
 
 RETRY_INTERVAL = 3.0
@@ -310,6 +311,197 @@ class NSQTarget:
 
     def close(self) -> None:
         pass
+
+
+class KafkaTarget:
+    """Produce the event JSON to a Kafka topic
+    (pkg/event/target/kafka.go). Speaks the Kafka wire protocol directly
+    — Produce v0 with acks=1, so the broker's response confirms the
+    write before the queue entry is dropped."""
+
+    def __init__(self, brokers: str | list[str], topic: str,
+                 arn_id: str = "kafka", partition: int = 0,
+                 timeout: float = 10.0):
+        self.arn = f"arn:minio_tpu:sqs::{arn_id}:kafka"
+        if isinstance(brokers, str):
+            brokers = [b for b in brokers.split(",") if b.strip()]
+        self._addrs = []
+        for b in brokers:
+            host, _, port = b.strip().partition(":")
+            self._addrs.append((host or "127.0.0.1", int(port or 9092)))
+        self.topic = topic
+        self.partition = partition
+        self.timeout = timeout
+        self._corr = 0
+
+    @staticmethod
+    def _str(s: str) -> bytes:
+        b = s.encode()
+        return struct.pack(">h", len(b)) + b
+
+    def _message_set(self, value: bytes) -> bytes:
+        # MessageSet v0: [offset int64][size int32][crc][magic][attrs]
+        # [key bytes=-1][value bytes]
+        body = (b"\x00\x00"                       # magic 0, attributes 0
+                + struct.pack(">i", -1)           # null key
+                + struct.pack(">i", len(value)) + value)
+        msg = struct.pack(">I", zlib.crc32(body) & 0xFFFFFFFF) + body
+        return struct.pack(">qi", 0, len(msg)) + msg
+
+    def send(self, records: dict) -> None:
+        payload = json.dumps(records).encode()
+        mset = self._message_set(payload)
+        self._corr += 1
+        req = (struct.pack(">hhi", 0, 0, self._corr)   # Produce v0
+               + self._str("minio-tpu")
+               + struct.pack(">hi", 1, int(self.timeout * 1000))  # acks=1
+               + struct.pack(">i", 1) + self._str(self.topic)
+               + struct.pack(">i", 1)
+               + struct.pack(">i", self.partition)
+               + struct.pack(">i", len(mset)) + mset)
+        # Bootstrap-list semantics: try each broker until one accepts.
+        last: Exception | None = None
+        for addr in self._addrs:
+            try:
+                self._produce(addr, req)
+                return
+            except OSError as e:
+                last = e
+        raise last if last is not None else OSError("kafka: no brokers")
+
+    def _produce(self, addr, req: bytes) -> None:
+        with socket.create_connection(addr, timeout=self.timeout) as s:
+            s.sendall(struct.pack(">i", len(req)) + req)
+            raw = _read_exact(s, 4)
+            resp = _read_exact(s, struct.unpack(">i", raw)[0])
+        # [corr][ntopics][topic][nparts][partition][err int16][offset i64]
+        corr = struct.unpack_from(">i", resp, 0)[0]
+        if corr != self._corr:
+            raise OSError(f"kafka: correlation mismatch {corr}")
+        tlen = struct.unpack_from(">h", resp, 8)[0]
+        off = 10 + tlen + 4 + 4
+        err = struct.unpack_from(">h", resp, off)[0]
+        if err != 0:
+            raise OSError(f"kafka: produce error code {err}")
+
+    def close(self) -> None:
+        pass
+
+
+class AMQPTarget:
+    """basic.publish the event JSON to an AMQP 0-9-1 exchange
+    (pkg/event/target/amqp.go). Implements the minimal client dialogue —
+    Start/Tune/Open handshake with PLAIN auth, channel open, publish,
+    connection close — and treats the broker's CloseOk as the delivery
+    flush barrier."""
+
+    _FRAME_END = b"\xce"
+
+    def __init__(self, address: str, exchange: str, routing_key: str,
+                 arn_id: str = "amqp", user: str = "guest",
+                 password: str = "guest", vhost: str = "/",
+                 timeout: float = 10.0):
+        self.arn = f"arn:minio_tpu:sqs::{arn_id}:amqp"
+        host, _, port = address.partition(":")
+        self._addr = (host or "127.0.0.1", int(port or 5672))
+        self.exchange = exchange
+        self.routing_key = routing_key
+        self.user = user
+        self.password = password
+        self.vhost = vhost
+        self.timeout = timeout
+
+    def _frame(self, ftype: int, channel: int, payload: bytes) -> bytes:
+        return (struct.pack(">BHI", ftype, channel, len(payload))
+                + payload + self._FRAME_END)
+
+    def _method(self, channel: int, class_id: int, method_id: int,
+                args: bytes) -> bytes:
+        return self._frame(1, channel,
+                           struct.pack(">HH", class_id, method_id) + args)
+
+    @staticmethod
+    def _shortstr(s: str) -> bytes:
+        b = s.encode()
+        return bytes((len(b),)) + b
+
+    @staticmethod
+    def _read_frame(f) -> tuple[int, int, bytes]:
+        hdr = f.read(7)
+        if len(hdr) < 7:
+            raise OSError("amqp: connection closed")
+        ftype, channel, size = struct.unpack(">BHI", hdr)
+        payload = f.read(size)
+        if f.read(1) != b"\xce":
+            raise OSError("amqp: bad frame end")
+        return ftype, channel, payload
+
+    def _expect(self, f, class_id: int, method_id: int) -> bytes:
+        while True:
+            ftype, _ch, payload = self._read_frame(f)
+            if ftype == 8:  # heartbeat
+                continue
+            if ftype != 1:
+                raise OSError(f"amqp: unexpected frame type {ftype}")
+            cid, mid = struct.unpack_from(">HH", payload, 0)
+            if (cid, mid) == (class_id, method_id):
+                return payload[4:]
+            if cid in (20, 10) and mid == 40:  # channel/connection close
+                raise OSError("amqp: broker closed the channel")
+
+    def send(self, records: dict) -> None:
+        body = json.dumps(records).encode()
+        with socket.create_connection(self._addr, timeout=self.timeout) as s:
+            f = s.makefile("rb")
+            s.sendall(b"AMQP\x00\x00\x09\x01")
+            self._expect(f, 10, 10)  # connection.start
+            sasl = f"\x00{self.user}\x00{self.password}".encode()
+            s.sendall(self._method(0, 10, 11,                # start-ok
+                      struct.pack(">I", 0)                   # empty table
+                      + self._shortstr("PLAIN")
+                      + struct.pack(">I", len(sasl)) + sasl
+                      + self._shortstr("en_US")))
+            tune = self._expect(f, 10, 30)  # connection.tune
+            # Honor the broker's frame-max (0 = no limit): sending larger
+            # frames than negotiated is a connection-fatal frame error.
+            srv_max = struct.unpack_from(">I", tune, 2)[0]
+            frame_max = min(srv_max or 131072, 131072)
+            s.sendall(self._method(0, 10, 31,                # tune-ok
+                      struct.pack(">HIH", 1, frame_max, 0)))
+            s.sendall(self._method(0, 10, 40,                # open
+                      self._shortstr(self.vhost)
+                      + self._shortstr("") + b"\x00"))
+            self._expect(f, 10, 41)  # open-ok
+            s.sendall(self._method(1, 20, 10, b"\x00"))      # channel.open
+            self._expect(f, 20, 11)
+            s.sendall(self._method(1, 60, 40,                # basic.publish
+                      struct.pack(">H", 0)
+                      + self._shortstr(self.exchange)
+                      + self._shortstr(self.routing_key) + b"\x00"))
+            # content header (class 60, weight 0, size, no properties)
+            s.sendall(self._frame(2, 1, struct.pack(
+                ">HHQH", 60, 0, len(body), 0)))
+            # Body split at frame-max (8 bytes of frame overhead).
+            step = max(frame_max - 8, 1)
+            for i in range(0, len(body), step):
+                s.sendall(self._frame(3, 1, body[i:i + step]))
+            s.sendall(self._method(0, 10, 50,                # connection.close
+                      struct.pack(">H", 0) + self._shortstr("ok")
+                      + struct.pack(">HH", 0, 0)))
+            self._expect(f, 10, 51)  # close-ok: everything flushed
+
+    def close(self) -> None:
+        pass
+
+
+def _read_exact(s: socket.socket, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = s.recv(n - len(out))
+        if not chunk:
+            raise OSError("connection closed mid-response")
+        out += chunk
+    return out
 
 
 class QueueStore:

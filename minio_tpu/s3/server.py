@@ -273,7 +273,9 @@ class S3Server:
         import json as _json
 
         from minio_tpu.event.targets import (
+            AMQPTarget,
             ElasticsearchTarget,
+            KafkaTarget,
             MQTTTarget,
             NATSTarget,
             NSQTarget,
@@ -288,6 +290,9 @@ class S3Server:
             "notify_mqtt": ("enable", "address", "topic"),
             "notify_elasticsearch": ("enable", "url", "index"),
             "notify_nsq": ("enable", "address", "topic"),
+            "notify_kafka": ("enable", "brokers", "topic"),
+            "notify_amqp": ("enable", "url", "exchange", "routing_key",
+                            "user", "password", "vhost"),
         }
         cfg = {s: {k: self.config.get(s, k) or "" for k in keys}
                for s, keys in subsys_keys.items()}
@@ -322,10 +327,21 @@ class S3Server:
         if on("notify_nsq") and cfg["notify_nsq"]["address"]:
             targets.append(NSQTarget(cfg["notify_nsq"]["address"],
                                      cfg["notify_nsq"]["topic"]))
+        if on("notify_kafka") and cfg["notify_kafka"]["brokers"]:
+            targets.append(KafkaTarget(cfg["notify_kafka"]["brokers"],
+                                       cfg["notify_kafka"]["topic"]))
+        if on("notify_amqp") and cfg["notify_amqp"]["url"]:
+            targets.append(AMQPTarget(
+                cfg["notify_amqp"]["url"],
+                cfg["notify_amqp"]["exchange"],
+                cfg["notify_amqp"]["routing_key"],
+                user=cfg["notify_amqp"]["user"],
+                password=cfg["notify_amqp"]["password"],
+                vhost=cfg["notify_amqp"]["vhost"]))
 
         # Replace-or-remove semantics over the config-managed ARN space.
         managed_kinds = ("webhook", "nats", "redis", "mqtt",
-                         "elasticsearch", "nsq")
+                         "elasticsearch", "nsq", "kafka", "amqp")
         want = {t.arn: t for t in targets}
         for arn in list(self.notifier.target_arns):
             if arn.rsplit(":", 1)[-1] in managed_kinds and arn not in want:

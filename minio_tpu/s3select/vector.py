@@ -226,6 +226,10 @@ class VectorPlan:
         self.names: list[str] = []
         self._col_idx: dict[str, int] = {}
         self._header_done = (request.csv_header or "USE").upper() == "NONE"
+        # Field count the row engine's header_order implies for SELECT *
+        # output (ragged rows are truncated/padded to it) — set from the
+        # header row, or the first data row when there is none.
+        self.expected_fields: int | None = None
 
     # -- column resolution --
 
@@ -424,39 +428,44 @@ def run_vectorized(plan: VectorPlan, raw_stream, request,
                 sel = mask & present
                 st["count"] += int(sel.sum())
                 num = sel & ok
+                # min/max candidates re-read through _num so Python
+                # number types (int vs float) match the row engine's
+                # serialization exactly; merged with the exotic-row
+                # fallbacks IN ROW ORDER so tie-breaking matches too.
+                cands: list[tuple[int, object]] = []
                 if num.any():
                     s = vals[num]
-                    tot, mn, mx = float(s.sum()), float(s.min()), float(s.max())
-                    st["sum"] += tot
-                    st["min"] = mn if st["min"] is None else min(st["min"], mn)
-                    st["max"] = mx if st["max"] is None else max(st["max"], mx)
+                    st["sum"] += float(s.sum())
+                    rows_idx = np.nonzero(num)[0]
+                    for pos in (int(np.argmin(s)), int(np.argmax(s))):
+                        ri = int(rows_idx[pos])
+                        cands.append((ri, _num_py(batch.field_str(ri, ci))))
                 for ri in np.nonzero(sel & ~ok)[0]:
                     n = _num_py(batch.field_str(int(ri), ci))
                     if n is not None:
                         st["sum"] += n
-                        st["min"] = n if st["min"] is None else min(st["min"], n)
-                        st["max"] = n if st["max"] is None else max(st["max"], n)
+                        cands.append((int(ri), n))
+                for _ri, n in sorted(cands, key=lambda c: c[0]):
+                    if n is None:
+                        continue
+                    st["min"] = n if st["min"] is None else min(st["min"], n)
+                    st["max"] = n if st["max"] is None else max(st["max"], n)
             continue
 
         q = batch.quote[0]
         for ri in np.nonzero(mask)[0]:
             ri = int(ri)
-            if raw_ok:
-                rec = batch.record_bytes(ri)
-                if q not in rec and b"\r" not in rec:
-                    pending.write(rec + b"\n")
-                    emitted += 1
-                else:
-                    row = batch.row_dict(ri, plan.names)
-                    out = ev.project(row)
-                    if not header_order:
-                        header_order = [k for k in out
-                                        if not (k.startswith("_")
-                                                and k[1:].isdigit())] \
-                            or list(out)
-                    pending.write(
-                        _serialize(out, request, header_order).encode())
-                    emitted += 1
+            rec = None
+            if raw_ok and header_order:
+                # Raw pass-through only for rows shaped exactly like the
+                # row engine's header_order (it truncates/pads ragged
+                # rows) and free of quoting/CR re-encoding concerns.
+                if int(batch.nfields[ri]) == plan.expected_fields:
+                    rb = batch.record_bytes(ri)
+                    if q not in rb and b"\r" not in rb:
+                        rec = rb
+            if rec is not None:
+                pending.write(rec + b"\n")
             else:
                 row = batch.row_dict(ri, plan.names)
                 out = ev.project(row)
@@ -465,9 +474,10 @@ def run_vectorized(plan: VectorPlan, raw_stream, request,
                                     if not (k.startswith("_")
                                             and k[1:].isdigit())] \
                         or list(out)
+                    plan.expected_fields = len(header_order)
                 pending.write(
                     _serialize(out, request, header_order).encode())
-                emitted += 1
+            emitted += 1
             if pending.tell() >= RECORDS_FLUSH:
                 msg = flush()
                 if msg:

@@ -562,9 +562,12 @@ int64_t mtpu_csv_parse_floats(const uint8_t* data, const int64_t* off,
       continue;
     }
     // strtod accepts hex/nan/inf spellings that the Python engine's
-    // numeric coercion treats differently — push those to the exact
-    // row-wise fallback by reporting them non-numeric here.
+    // numeric coercion treats differently, and float64 cannot represent
+    // integers beyond 2^53 that Python compares exactly — push both to
+    // the exact row-wise fallback by reporting them non-numeric here.
     bool odd = false;
+    bool integral = true;
+    int digits = 0;
     for (int32_t k = 0; k < l; ++k) {
       uint8_t c = p[k];
       if (c == 'x' || c == 'X' || c == 'n' || c == 'N' || c == 'i' ||
@@ -572,8 +575,10 @@ int64_t mtpu_csv_parse_floats(const uint8_t* data, const int64_t* off,
         odd = true;
         break;
       }
+      if (c >= '0' && c <= '9') ++digits;
+      if (c == '.' || c == 'e' || c == 'E') integral = false;
     }
-    if (odd) {
+    if (odd || (integral && digits > 15)) {
       out[i] = nan;
       continue;
     }

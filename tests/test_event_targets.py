@@ -260,3 +260,135 @@ def test_metacache_versions_continuation(tmp_path):
         assert pages < 40
     assert got == truth
     assert pools.metacache.hits >= 3
+
+
+def test_kafka_target():
+    from minio_tpu.event.targets import KafkaTarget
+
+    got = {}
+
+    def broker(conn):
+        raw = conn.recv(4)
+        size = struct.unpack(">i", raw)[0]
+        req = b""
+        while len(req) < size:
+            req += conn.recv(size - len(req))
+        api_key, api_ver, corr = struct.unpack_from(">hhi", req, 0)
+        got["api"] = (api_key, api_ver)
+        pos = 8
+        clen = struct.unpack_from(">h", req, pos)[0]
+        pos += 2 + clen
+        acks, _timeout = struct.unpack_from(">hi", req, pos)
+        got["acks"] = acks
+        pos += 6 + 4                       # + topic array count
+        tlen = struct.unpack_from(">h", req, pos)[0]
+        got["topic"] = req[pos + 2:pos + 2 + tlen].decode()
+        pos += 2 + tlen + 4                # + partition array count
+        _part, mset_size = struct.unpack_from(">ii", req, pos)
+        pos += 8
+        mset = req[pos:pos + mset_size]
+        # offset(8) size(4) crc(4) magic(1) attrs(1) keylen(4)=-1 vlen(4)
+        crc = struct.unpack_from(">I", mset, 12)[0]
+        body = mset[16:]
+        assert crc == __import__("zlib").crc32(body) & 0xFFFFFFFF
+        vlen = struct.unpack_from(">i", mset, 22)[0]
+        got["value"] = mset[26:26 + vlen]
+        resp = (struct.pack(">i", corr) + struct.pack(">i", 1)
+                + struct.pack(">h", tlen) + got["topic"].encode()
+                + struct.pack(">i", 1)
+                + struct.pack(">ihq", 0, 0, 42))
+        conn.sendall(struct.pack(">i", len(resp)) + resp)
+
+    addr, t = _serve_once(broker)
+    KafkaTarget(addr, "minio-events").send(EVENT)
+    t.join(5)
+    assert got["api"] == (0, 0) and got["acks"] == 1
+    assert got["topic"] == "minio-events"
+    assert json.loads(got["value"]) == EVENT
+
+
+def test_kafka_target_raises_on_error_code():
+    from minio_tpu.event.targets import KafkaTarget
+
+    def broker(conn):
+        raw = conn.recv(4)
+        size = struct.unpack(">i", raw)[0]
+        req = b""
+        while len(req) < size:
+            req += conn.recv(size - len(req))
+        corr = struct.unpack_from(">i", req, 4)[0]
+        topic = b"minio-events"
+        resp = (struct.pack(">i", corr) + struct.pack(">i", 1)
+                + struct.pack(">h", len(topic)) + topic
+                + struct.pack(">i", 1)
+                + struct.pack(">ihq", 0, 3, -1))  # UNKNOWN_TOPIC
+        conn.sendall(struct.pack(">i", len(resp)) + resp)
+
+    addr, t = _serve_once(broker)
+    with pytest.raises(OSError):
+        KafkaTarget(addr, "minio-events").send(EVENT)
+    t.join(5)
+
+
+def test_amqp_target():
+    from minio_tpu.event.targets import AMQPTarget
+
+    got = {}
+
+    def _frame(conn, ftype, channel, payload):
+        conn.sendall(struct.pack(">BHI", ftype, channel, len(payload))
+                     + payload + b"\xce")
+
+    def _method(conn, channel, cid, mid, args=b""):
+        _frame(conn, 1, channel, struct.pack(">HH", cid, mid) + args)
+
+    def _read_frame(f):
+        ftype, channel, size = struct.unpack(">BHI", f.read(7))
+        payload = f.read(size)
+        assert f.read(1) == b"\xce"
+        return ftype, channel, payload
+
+    def broker(conn):
+        f = conn.makefile("rb")
+        assert f.read(8) == b"AMQP\x00\x00\x09\x01"
+        _method(conn, 0, 10, 10, struct.pack(">BB", 0, 9)
+                + struct.pack(">I", 0)       # empty server-properties
+                + struct.pack(">I", 5) + b"PLAIN"
+                + struct.pack(">I", 5) + b"en_US")
+        _t, _c, p = _read_frame(f)           # start-ok
+        assert struct.unpack_from(">HH", p) == (10, 11)
+        # sasl response carries \0user\0pass
+        got["sasl"] = b"PLAIN" in p or b"guest" in p
+        _method(conn, 0, 10, 30, struct.pack(">HIH", 1, 131072, 0))  # tune
+        _t, _c, p = _read_frame(f)           # tune-ok
+        assert struct.unpack_from(">HH", p) == (10, 31)
+        _t, _c, p = _read_frame(f)           # connection.open
+        assert struct.unpack_from(">HH", p) == (10, 40)
+        _method(conn, 0, 10, 41, b"\x00")    # open-ok
+        _t, _c, p = _read_frame(f)           # channel.open
+        assert struct.unpack_from(">HH", p) == (20, 10)
+        _method(conn, 1, 20, 11, struct.pack(">I", 0))  # channel.open-ok
+        _t, _c, p = _read_frame(f)           # basic.publish
+        assert struct.unpack_from(">HH", p) == (60, 40)
+        off = 4 + 2
+        elen = p[off]
+        got["exchange"] = p[off + 1:off + 1 + elen].decode()
+        off += 1 + elen
+        rlen = p[off]
+        got["routing_key"] = p[off + 1:off + 1 + rlen].decode()
+        ftype, _c, hdr = _read_frame(f)      # content header
+        assert ftype == 2
+        _cls, _w, size, _flags = struct.unpack_from(">HHQH", hdr, 0)
+        ftype, _c, body = _read_frame(f)     # content body
+        assert ftype == 3 and len(body) == size
+        got["body"] = body
+        _t, _c, p = _read_frame(f)           # connection.close
+        assert struct.unpack_from(">HH", p) == (10, 50)
+        _method(conn, 0, 10, 51)             # close-ok
+
+    addr, t = _serve_once(broker)
+    AMQPTarget(addr, "minio-ex", "events.key").send(EVENT)
+    t.join(5)
+    assert got["exchange"] == "minio-ex"
+    assert got["routing_key"] == "events.key"
+    assert json.loads(got["body"]) == EVENT

@@ -166,3 +166,33 @@ def test_vector_is_actually_faster():
         vector.compile_plan = real_compile
     assert vec == row
     assert t_vec * 3 < t_row, (t_vec, t_row)
+
+
+@pytest.mark.parametrize("expr", [
+    "SELECT MIN(s.qty), MAX(s.id), MIN(s.price) FROM S3Object s",
+    "SELECT MIN(s.id) FROM S3Object s WHERE s.id > 100",
+])
+def test_vector_minmax_integer_formatting(expr):
+    # MIN/MAX over integer columns must serialize as ints ('0'), not
+    # floats ('0.0') — the row engine keeps Python number types.
+    vec, row = _both(DATA, expr)
+    assert vec == row, expr
+
+
+def test_vector_ragged_rows_match_row_engine():
+    data = b"a,b\n1,2\n3,4,5\n6\n7,8\n"
+    for expr in ("SELECT * FROM S3Object s WHERE s.a > 0",
+                 "SELECT * FROM S3Object s"):
+        vec, row = _both(data, expr)
+        assert vec == row, expr
+
+
+def test_vector_bigint_exact_comparison():
+    # Integers beyond 2^53: float64 would collapse them; the row engine
+    # compares exact ints. The vector lane must match.
+    data = (b"a\n9007199254740993\n9007199254740992\n123\n")
+    for expr in ("SELECT COUNT(*) FROM S3Object s "
+                 "WHERE s.a = 9007199254740992",
+                 "SELECT * FROM S3Object s WHERE s.a > 9007199254740992"):
+        vec, row = _both(data, expr)
+        assert vec == row, expr
