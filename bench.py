@@ -306,19 +306,10 @@ def bench_select_csv() -> dict:
 
     data = b"id,price,qty\n" + b"".join(
         b"%d,%d.5,%d\n" % (i, i % 1000, i % 7) for i in range(1_000_000))
-    req = S3SelectRequest.__new__(S3SelectRequest)
-    req.expression = ("SELECT COUNT(*), SUM(s.price) FROM S3Object s "
-                      "WHERE CAST(s.price AS FLOAT) > 500")
-    req.input_format = "CSV"
-    req.compression = "NONE"
-    req.csv_header = "USE"
-    req.csv_delimiter = ","
-    req.csv_quote = '"'
-    req.csv_comments = ""
-    req.json_type = "LINES"
-    req.output_format = "CSV"
-    req.out_csv_delimiter = ","
-    req.out_record_delimiter = "\n"
+    req = S3SelectRequest(
+        expression=("SELECT COUNT(*), SUM(s.price) FROM S3Object s "
+                    "WHERE CAST(s.price AS FLOAT) > 500"),
+        input_format="CSV", output_format="CSV")
     b"".join(run_select(io.BytesIO(data), req))  # warmup
     t0 = time.perf_counter()
     iters = 3

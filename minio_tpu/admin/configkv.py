@@ -40,6 +40,11 @@ DEFAULTS: dict[str, dict[str, str]] = {
     "notify_amqp": {"enable": "off", "url": "", "exchange": "",
                     "routing_key": "minio", "user": "guest",
                     "password": "guest", "vhost": "/"},
+    "notify_postgres": {"enable": "off", "address": "", "table": "",
+                        "user": "postgres", "password": "",
+                        "database": "postgres"},
+    "notify_mysql": {"enable": "off", "address": "", "table": "",
+                     "user": "root", "password": "", "database": "minio"},
     "logger_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
     "audit_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
     "audit_file": {"path": ""},
@@ -62,7 +67,7 @@ DYNAMIC = {"api", "scanner", "heal",
            "logger_webhook", "audit_webhook", "audit_file",
            "notify_webhook", "notify_nats", "notify_redis", "notify_mqtt",
            "notify_elasticsearch", "notify_nsq", "notify_kafka",
-           "notify_amqp"}
+           "notify_amqp", "notify_postgres", "notify_mysql"}
 
 PATH = "config/config.json"
 ENV_PREFIX = "MTPU"

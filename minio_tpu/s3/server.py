@@ -277,8 +277,10 @@ class S3Server:
             ElasticsearchTarget,
             KafkaTarget,
             MQTTTarget,
+            MySQLTarget,
             NATSTarget,
             NSQTarget,
+            PostgresTarget,
             RedisTarget,
             WebhookTarget,
         )
@@ -293,6 +295,10 @@ class S3Server:
             "notify_kafka": ("enable", "brokers", "topic"),
             "notify_amqp": ("enable", "url", "exchange", "routing_key",
                             "user", "password", "vhost"),
+            "notify_postgres": ("enable", "address", "table", "user",
+                                "password", "database"),
+            "notify_mysql": ("enable", "address", "table", "user",
+                             "password", "database"),
         }
         cfg = {s: {k: self.config.get(s, k) or "" for k in keys}
                for s, keys in subsys_keys.items()}
@@ -305,43 +311,69 @@ class S3Server:
             return cfg[s]["enable"] in ("on", "1", "true")
 
         targets = []
+
+        def add(factory) -> None:
+            # A malformed persisted value (bad URL/port/table name) must
+            # degrade to a logged error, never an unbootable server:
+            # this runs during __init__ on every start.
+            try:
+                targets.append(factory())
+            except (ValueError, OSError, KeyError) as e:
+                self.logger.error(f"event target config invalid: {e}")
         if on("notify_webhook") and cfg["notify_webhook"]["endpoint"]:
-            targets.append(WebhookTarget(
+            add(lambda: WebhookTarget(
                 cfg["notify_webhook"]["endpoint"],
                 auth_token=cfg["notify_webhook"]["auth_token"]))
         if on("notify_nats") and cfg["notify_nats"]["address"]:
-            targets.append(NATSTarget(cfg["notify_nats"]["address"],
+            add(lambda: NATSTarget(cfg["notify_nats"]["address"],
                                       cfg["notify_nats"]["subject"]))
         if on("notify_redis") and cfg["notify_redis"]["address"]:
-            targets.append(RedisTarget(
+            add(lambda: RedisTarget(
                 cfg["notify_redis"]["address"], cfg["notify_redis"]["key"],
                 password=cfg["notify_redis"]["password"],
                 publish=cfg["notify_redis"]["format"] == "channel"))
         if on("notify_mqtt") and cfg["notify_mqtt"]["address"]:
-            targets.append(MQTTTarget(cfg["notify_mqtt"]["address"],
+            add(lambda: MQTTTarget(cfg["notify_mqtt"]["address"],
                                       cfg["notify_mqtt"]["topic"]))
         if on("notify_elasticsearch") and cfg["notify_elasticsearch"]["url"]:
-            targets.append(ElasticsearchTarget(
+            add(lambda: ElasticsearchTarget(
                 cfg["notify_elasticsearch"]["url"],
                 cfg["notify_elasticsearch"]["index"]))
         if on("notify_nsq") and cfg["notify_nsq"]["address"]:
-            targets.append(NSQTarget(cfg["notify_nsq"]["address"],
+            add(lambda: NSQTarget(cfg["notify_nsq"]["address"],
                                      cfg["notify_nsq"]["topic"]))
         if on("notify_kafka") and cfg["notify_kafka"]["brokers"]:
-            targets.append(KafkaTarget(cfg["notify_kafka"]["brokers"],
+            add(lambda: KafkaTarget(cfg["notify_kafka"]["brokers"],
                                        cfg["notify_kafka"]["topic"]))
         if on("notify_amqp") and cfg["notify_amqp"]["url"]:
-            targets.append(AMQPTarget(
+            add(lambda: AMQPTarget(
                 cfg["notify_amqp"]["url"],
                 cfg["notify_amqp"]["exchange"],
                 cfg["notify_amqp"]["routing_key"],
                 user=cfg["notify_amqp"]["user"],
                 password=cfg["notify_amqp"]["password"],
                 vhost=cfg["notify_amqp"]["vhost"]))
+        if on("notify_postgres") and cfg["notify_postgres"]["address"] \
+                and cfg["notify_postgres"]["table"]:
+            add(lambda: PostgresTarget(
+                cfg["notify_postgres"]["address"],
+                cfg["notify_postgres"]["table"],
+                user=cfg["notify_postgres"]["user"],
+                password=cfg["notify_postgres"]["password"],
+                database=cfg["notify_postgres"]["database"]))
+        if on("notify_mysql") and cfg["notify_mysql"]["address"] \
+                and cfg["notify_mysql"]["table"]:
+            add(lambda: MySQLTarget(
+                cfg["notify_mysql"]["address"],
+                cfg["notify_mysql"]["table"],
+                user=cfg["notify_mysql"]["user"],
+                password=cfg["notify_mysql"]["password"],
+                database=cfg["notify_mysql"]["database"]))
 
         # Replace-or-remove semantics over the config-managed ARN space.
         managed_kinds = ("webhook", "nats", "redis", "mqtt",
-                         "elasticsearch", "nsq", "kafka", "amqp")
+                         "elasticsearch", "nsq", "kafka", "amqp",
+                         "postgresql", "mysql")
         want = {t.arn: t for t in targets}
         for arn in list(self.notifier.target_arns):
             if arn.rsplit(":", 1)[-1] in managed_kinds and arn not in want:
