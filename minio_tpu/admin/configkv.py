@@ -45,6 +45,9 @@ DEFAULTS: dict[str, dict[str, str]] = {
                         "database": "postgres"},
     "notify_mysql": {"enable": "off", "address": "", "table": "",
                      "user": "root", "password": "", "database": "minio"},
+    # Bucket federation (etcd/DNS role): `directory` is the shared
+    # registry file; `endpoint` this cluster's advertised URL.
+    "federation": {"enable": "off", "directory": "", "endpoint": ""},
     "logger_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
     "audit_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
     "audit_file": {"path": ""},

@@ -1,0 +1,108 @@
+"""Bucket federation directory — the etcd/DNS federation role.
+
+Role-equivalent of cmd/etcd.go + pkg/dns + initFederatorBackend
+(cmd/bucket-handlers.go:71): multiple independent clusters share one
+namespace of buckets. Each cluster registers the buckets it owns in a
+shared directory; a request for a bucket owned elsewhere answers with a
+307 redirect to the owning cluster (the server-side half of what the
+reference's DNS records do client-side).
+
+The directory backend is a shared JSON file (NFS/shared volume — the
+zero-egress stand-in for etcd): atomic same-directory rename writes,
+mtime-checked reloads, last-writer-wins per bucket. The interface is the
+seam where an etcd/Consul client would plug.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+
+class FederationError(Exception):
+    pass
+
+
+class FederationStore:
+    """bucket -> owning cluster endpoint, backed by a shared JSON file."""
+
+    def __init__(self, path: str, endpoint: str):
+        """path: the shared directory file; endpoint: THIS cluster's
+        advertised URL (scheme://host:port), recorded as the owner for
+        buckets registered here."""
+        self.path = path
+        self.endpoint = endpoint.rstrip("/")
+        self._mu = threading.Lock()
+        self._cache: dict[str, str] = {}
+        self._mtime = -1.0
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    # -- directory I/O --
+
+    def _load_locked(self) -> dict[str, str]:
+        try:
+            mtime = os.stat(self.path).st_mtime
+        except OSError:
+            self._cache, self._mtime = {}, -1.0
+            return self._cache
+        if mtime != self._mtime:
+            try:
+                with open(self.path, encoding="utf-8") as f:
+                    doc = json.load(f)
+                self._cache = {str(k): str(v)
+                               for k, v in doc.get("buckets", {}).items()}
+                self._mtime = mtime
+            except (OSError, ValueError):
+                pass  # half-written by a peer: keep the last good view
+        return self._cache
+
+    def _write_locked(self, table: dict[str, str]) -> None:
+        tmp = f"{self.path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"buckets": table, "updated": time.time()}, f,
+                      indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._cache = dict(table)
+        try:
+            self._mtime = os.stat(self.path).st_mtime
+        except OSError:
+            self._mtime = -1.0
+
+    # -- the federation surface --
+
+    def lookup(self, bucket: str) -> str | None:
+        """Owning endpoint, or None when unclaimed."""
+        with self._mu:
+            return self._load_locked().get(bucket)
+
+    def is_remote(self, bucket: str) -> bool:
+        owner = self.lookup(bucket)
+        return owner is not None and owner != self.endpoint
+
+    def register(self, bucket: str) -> None:
+        """Claim `bucket` for this cluster; FederationError if another
+        cluster already owns it (global bucket-name uniqueness — the
+        reference returns BucketAlreadyExists from the DNS check)."""
+        with self._mu:
+            table = dict(self._load_locked())
+            owner = table.get(bucket)
+            if owner is not None and owner != self.endpoint:
+                raise FederationError(
+                    f"bucket {bucket!r} is owned by {owner}")
+            table[bucket] = self.endpoint
+            self._write_locked(table)
+
+    def unregister(self, bucket: str) -> None:
+        with self._mu:
+            table = dict(self._load_locked())
+            if table.get(bucket) == self.endpoint:
+                del table[bucket]
+                self._write_locked(table)
+
+    def buckets(self) -> dict[str, str]:
+        with self._mu:
+            return dict(self._load_locked())

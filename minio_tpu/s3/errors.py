@@ -22,6 +22,7 @@ ERRORS = {
     "AccessDenied": APIError("AccessDenied", "Access Denied.", 403),
     "BadDigest": APIError("BadDigest", "The Content-Md5 you specified did not match what we received.", 400),
     "BucketAlreadyOwnedByYou": APIError("BucketAlreadyOwnedByYou", "Your previous request to create the named bucket succeeded and you already own it.", 409),
+    "BucketAlreadyExists": APIError("BucketAlreadyExists", "The requested bucket name is not available.", 409),
     "BucketNotEmpty": APIError("BucketNotEmpty", "The bucket you tried to delete is not empty.", 409),
     "EntityTooLarge": APIError("EntityTooLarge", "Your proposed upload exceeds the maximum allowed object size.", 400),
     "EntityTooSmall": APIError("EntityTooSmall", "Your proposed upload is smaller than the minimum allowed object size.", 400),

@@ -164,6 +164,32 @@ class S3Server:
         from minio_tpu.admin.profiling import Profiler
         self.profiler = Profiler()
 
+        # Bucket federation (cmd/etcd.go + pkg/dns role): when enabled,
+        # bucket ownership registers in a shared directory and requests
+        # for foreign buckets 307-redirect to the owning cluster.
+        self.federation = None
+        if (self.config.get("federation", "enable") or "") in (
+                "on", "1", "true"):
+            fdir = self.config.get("federation", "directory") or ""
+            fep = self.config.get("federation", "endpoint") or ""
+            if fdir and fep:
+                from minio_tpu.dist.federation import (
+                    FederationError,
+                    FederationStore,
+                )
+                self.federation = FederationStore(fdir, fep)
+                # Register buckets that predate federation (the
+                # reference's initFederatorBackend does the same at
+                # startup) — otherwise another cluster could claim the
+                # name and split the namespace. Conflicts are logged,
+                # not fatal: the operator must resolve a genuine split.
+                for b in object_layer.list_buckets():
+                    try:
+                        self.federation.register(b.name)
+                    except FederationError as e:
+                        self.logger.error(
+                            f"federation conflict at startup: {e}")
+
         # KMS for SSE-KMS envelope encryption (cmd/crypto/kes.go role):
         # a networked KES backend when kms.kes_endpoint is configured,
         # else local master keys.
@@ -457,13 +483,24 @@ class S3Server:
             resp = await self._dispatch(request, path, request_id)
             return resp
         except S3Error as e:
+            if e.api.code == "NoSuchBucket":
+                fed = await self._federation_redirect(request, path)
+                if fed is not None:
+                    resp = fed
+                    return resp
             resp = self._error_response(e, path, request_id)
             return resp
         except web.HTTPException as e:  # web-console handlers raise these
             resp = e
             raise
         except Exception as e:  # noqa: BLE001 - surface as S3 InternalError
-            resp = self._error_response(from_exception(e, path), path, request_id)
+            s3e = from_exception(e, path)
+            if s3e.api.code == "NoSuchBucket":
+                fed = await self._federation_redirect(request, path)
+                if fed is not None:
+                    resp = fed
+                    return resp
+            resp = self._error_response(s3e, path, request_id)
             return resp
         finally:
             status = resp.status if resp is not None else 500
@@ -513,6 +550,30 @@ class S3Server:
                     duration_ms=(_time.perf_counter() - t0) * 1000,
                     query=dict(urllib.parse.parse_qsl(request.query_string)),
                 ))
+
+    async def _federation_redirect(self, request, path: str):
+        """307 to the owning cluster when the missing bucket is federated
+        elsewhere (the server-side analogue of the reference's DNS
+        bucket records; clients re-sign and follow)."""
+        if self.federation is None:
+            return None
+        bucket = path.lstrip("/").split("/", 1)[0]
+        if not bucket or bucket.startswith("minio"):
+            return None
+        # Directory lookup is shared-file I/O (possibly NFS): keep it off
+        # the event loop like every other blocking call.
+        loop = asyncio.get_running_loop()
+        owner = await loop.run_in_executor(
+            None, self.federation.lookup, bucket)
+        if owner is None or owner == self.federation.endpoint:
+            return None
+        # raw_path keeps the client's percent-encoding — the decoded path
+        # would corrupt keys containing '#', '%', '?' or non-ASCII.
+        raw = request.raw_path.split("?", 1)[0]
+        loc = owner + raw
+        if request.query_string:
+            loc += "?" + request.query_string
+        return web.Response(status=307, headers={"Location": loc})
 
     def _client_ip(self, request) -> str:
         """Requester IP for audit/trace records. Proxy headers
@@ -695,7 +756,27 @@ class S3Server:
         # ---------- bucket level ----------
         if not key:
             if m == "PUT" and not sub:
-                await run(self.obj.make_bucket, bucket)
+                if self.federation is not None:
+                    from minio_tpu.dist.federation import FederationError
+                    try:
+                        # Claim BEFORE creating: global name uniqueness
+                        # (the reference's DNS check on MakeBucket).
+                        await run(self.federation.register, bucket)
+                    except FederationError:
+                        raise S3Error("BucketAlreadyExists",
+                                      resource=f"/{bucket}") from None
+                    try:
+                        await run(self.obj.make_bucket, bucket)
+                    except BaseException:
+                        # Release the claim — a failed create must not
+                        # poison the global name for every cluster.
+                        try:
+                            await run(self.federation.unregister, bucket)
+                        except Exception:  # noqa: BLE001
+                            pass
+                        raise
+                else:
+                    await run(self.obj.make_bucket, bucket)
                 changes = {"created": __import__("time").time()}
                 if request.headers.get(
                         "x-amz-bucket-object-lock-enabled", "").lower() == "true":
@@ -713,6 +794,8 @@ class S3Server:
             if m == "DELETE" and not sub:
                 await run(self.obj.delete_bucket, bucket)
                 await run(self.bucket_meta.drop_bucket, bucket)
+                if self.federation is not None:
+                    await run(self.federation.unregister, bucket)
                 return web.Response(status=204, headers=hdr)
             if m == "POST" and "delete" in q:
                 return await self._delete_objects(request, bucket, hdr, run)

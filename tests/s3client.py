@@ -63,13 +63,15 @@ class SigV4Client:
         return headers
 
     def request(self, method: str, path: str, query: dict | None = None,
-                headers: dict | None = None, data: bytes = b"") -> requests.Response:
+                headers: dict | None = None, data: bytes = b"",
+                allow_redirects: bool = True) -> requests.Response:
         query = query or {}
         headers = dict(headers or {})
         signed = self._sign(method, path, query, headers, data)
         url = self.endpoint + urllib.parse.quote(path, safe="/-._~")
         return self.session.request(method, url, params=query, headers=signed,
-                                    data=data, timeout=30)
+                                    data=data, timeout=30,
+                                    allow_redirects=allow_redirects)
 
     # convenience verbs
     def put(self, path, data=b"", **kw):

@@ -1,0 +1,143 @@
+"""Bucket federation (etcd/DNS role): two clusters sharing a directory
+file — global name uniqueness, 307 redirects to the owning cluster, and
+unregistration on delete."""
+
+import asyncio
+import socket
+import threading
+
+import pytest
+from aiohttp import web
+
+from minio_tpu.dist.federation import FederationError, FederationStore
+from minio_tpu.s3.server import build_server
+from tests.s3client import SigV4Client
+
+ACCESS, SECRET = "fedroot", "fedroot-secret"
+
+
+def _boot(srv):
+    sk = socket.socket()
+    sk.bind(("127.0.0.1", 0))
+    port = sk.getsockname()[1]
+    sk.close()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(srv.app)
+            await runner.setup()
+            await web.TCPSite(runner, "127.0.0.1", port).start()
+            started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(30)
+    return port, loop
+
+
+@pytest.fixture()
+def two_clusters(tmp_path):
+    fdir = str(tmp_path / "federation.json")
+    servers = []
+    loops = []
+    clients = []
+    for i in ("a", "b"):
+        drives = [str(tmp_path / f"{i}-d{j}") for j in range(4)]
+        srv = build_server(drives, ACCESS, SECRET, versioned=False)
+        port, loop = _boot(srv)
+        ep = f"http://127.0.0.1:{port}"
+        srv.config.set_kv("federation", {"enable": "on", "directory": fdir,
+                                         "endpoint": ep})
+        srv.federation = FederationStore(fdir, ep)
+        servers.append(srv)
+        loops.append(loop)
+        clients.append(SigV4Client(ep, ACCESS, SECRET))
+    yield servers, clients
+    for loop in loops:
+        loop.call_soon_threadsafe(loop.stop)
+
+
+def test_store_claim_and_conflict(tmp_path):
+    fdir = str(tmp_path / "fed.json")
+    a = FederationStore(fdir, "http://a:9000")
+    b = FederationStore(fdir, "http://b:9000")
+    a.register("shared-bkt")
+    assert b.lookup("shared-bkt") == "http://a:9000"
+    assert b.is_remote("shared-bkt") and not a.is_remote("shared-bkt")
+    with pytest.raises(FederationError):
+        b.register("shared-bkt")
+    a.register("shared-bkt")  # idempotent re-claim by the owner
+    b.unregister("shared-bkt")  # non-owner unregister is a no-op
+    assert a.lookup("shared-bkt") == "http://a:9000"
+    a.unregister("shared-bkt")
+    assert b.lookup("shared-bkt") is None
+
+
+def test_federated_redirect_and_uniqueness(two_clusters):
+    (sa, sb), (ca, cb) = two_clusters
+    assert ca.put("/fedbkt").status_code == 200
+    assert ca.put("/fedbkt/obj", data=b"on cluster A").status_code == 200
+
+    # Cluster B: same name is globally taken.
+    r = cb.put("/fedbkt")
+    assert r.status_code == 409, (r.status_code, r.text)
+
+    # Cluster B: GET for A's bucket redirects to A.
+    r = cb.get("/fedbkt/obj", allow_redirects=False)
+    assert r.status_code == 307, (r.status_code, r.text)
+    loc = r.headers["Location"]
+    assert loc.startswith(sa.federation.endpoint)
+    assert loc.endswith("/fedbkt/obj")
+
+    # Following the redirect with a re-signed request serves the object.
+    r2 = ca.get("/fedbkt/obj")
+    assert r2.content == b"on cluster A"
+
+    # Delete on A unregisters; B then 404s instead of redirecting.
+    assert ca.delete("/fedbkt/obj").status_code == 204
+    assert ca.delete("/fedbkt").status_code == 204
+    r = cb.get("/fedbkt/obj", allow_redirects=False)
+    assert r.status_code == 404
+
+
+def test_existing_buckets_register_at_startup(tmp_path):
+    """Buckets created before federation was enabled must be claimed when
+    the server boots with federation configured (initFederatorBackend
+    role) — otherwise another cluster could take the name."""
+    fdir = str(tmp_path / "fed.json")
+    drives = [str(tmp_path / f"d{j}") for j in range(4)]
+    srv = build_server(drives, ACCESS, SECRET, versioned=False)
+    srv.obj.make_bucket("oldbkt")
+    srv.config.set_kv("federation", {"enable": "on", "directory": fdir,
+                                     "endpoint": "http://a:9000"})
+    # Restart: same drives, federation config persisted.
+    srv2 = build_server(drives, ACCESS, SECRET, versioned=False)
+    assert srv2.federation is not None
+    assert srv2.federation.lookup("oldbkt") == "http://a:9000"
+    other = FederationStore(fdir, "http://b:9000")
+    with pytest.raises(FederationError):
+        other.register("oldbkt")
+
+
+def test_redirect_preserves_percent_encoding(two_clusters):
+    (sa, _sb), (ca, cb) = two_clusters
+    assert ca.put("/encbkt").status_code == 200
+    key = "report#2 +x.txt"
+    assert ca.put(f"/encbkt/{key}", data=b"enc").status_code == 200
+    r = cb.get(f"/encbkt/{key}", allow_redirects=False)
+    assert r.status_code == 307
+    loc = r.headers["Location"]
+    # '#' must stay percent-encoded or the client truncates the URL.
+    assert "#" not in loc and "%232" in loc, loc
+
+
+def test_unfederated_missing_bucket_still_404s(two_clusters):
+    (_sa, _sb), (ca, _cb) = two_clusters
+    r = ca.get("/nevermade/obj", allow_redirects=False)
+    assert r.status_code == 404
