@@ -196,3 +196,20 @@ def test_vector_bigint_exact_comparison():
                  "SELECT * FROM S3Object s WHERE s.a > 9007199254740992"):
         vec, row = _both(data, expr)
         assert vec == row, expr
+
+
+def test_vector_on_gzip_compressed_input():
+    import gzip
+
+    data = b"a,b\n" + b"".join(b"%d,%d\n" % (i, i * 2) for i in range(5000))
+    gz = gzip.compress(data)
+    vec_req = _req("SELECT COUNT(*), SUM(s.b) FROM S3Object s "
+                   "WHERE s.a >= 1000", compression="GZIP")
+    vec = _run_capture(gz, vec_req)
+    real = vector.compile_plan
+    vector.compile_plan = lambda *a, **k: None
+    try:
+        row = _run_capture(gz, vec_req)
+    finally:
+        vector.compile_plan = real
+    assert vec == row
