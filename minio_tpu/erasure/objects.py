@@ -469,24 +469,91 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             return sorted(chosen)
 
         pool = self._shard_read_pool()
-        try:
-            bi = first_block
-            while bi <= last_block:
-                batch_ids = list(range(bi, min(bi + self.batch_blocks, last_block + 1)))
-                block_lens = [
-                    min(fi.erasure.block_size, part.size - b * fi.erasure.block_size)
-                    for b in batch_ids
-                ]
-                while True:
-                    chosen = ensure_readers()
+        batches: list[tuple[list[int], list[int]]] = []
+        bi = first_block
+        while bi <= last_block:
+            ids = list(range(bi, min(bi + self.batch_blocks, last_block + 1)))
+            batches.append((ids, [
+                min(fi.erasure.block_size, part.size - b * fi.erasure.block_size)
+                for b in ids
+            ]))
+            bi = ids[-1] + 1
+
+        # Read-ahead producer (the GET half of P2, SURVEY §2.4): one
+        # dedicated thread reads batch N+1 while the consumer verifies,
+        # decodes and sends batch N. Readers/dead/re-selection are touched
+        # ONLY by the producer, so the existing retry semantics are
+        # unchanged. A bounded queue + stop-checked puts guarantee the
+        # producer exits promptly on early close.
+        out_q: queue.Queue = queue.Queue(maxsize=2)
+        stop = threading.Event()
+
+        def _offer(item) -> bool:
+            while not stop.is_set():
+                try:
+                    out_q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        cleanup_mu = threading.Lock()
+        cleaned = [False]
+
+        def _close_readers() -> None:
+            # Exactly-once, from whichever side owns the readers last:
+            # the consumer's finally (normal case) or the producer's exit
+            # (the consumer's join timed out on a hung read).
+            with cleanup_mu:
+                if cleaned[0]:
+                    return
+                cleaned[0] = True
+            for r in readers:
+                if r is not None:
                     try:
-                        rows = self._read_chunk_rows(
-                            readers, chosen, batch_ids, block_lens, codec, n,
-                            dead, algo, pool=pool,
-                        )
-                        break
-                    except se.StorageError:
-                        continue  # a reader died; re-choose and retry the batch
+                        r.src.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        def producer_run() -> None:
+            try:
+                for ids, lens in batches:
+                    if stop.is_set():
+                        return
+                    while not stop.is_set():
+                        chosen = ensure_readers()
+                        try:
+                            rows = self._read_chunk_rows(
+                                readers, chosen, ids, lens, codec, n,
+                                dead, algo, pool=pool,
+                            )
+                            break
+                        except se.StorageError:
+                            continue  # reader died; re-choose, retry batch
+                    else:
+                        return  # early close during a failing batch
+                    if not _offer(("rows", ids, lens, rows)):
+                        return
+                _offer(("done", None, None, None))
+            except BaseException as e:  # noqa: BLE001 - relay to consumer
+                _offer(("err", e, None, None))
+            finally:
+                if stop.is_set():
+                    # The consumer may already have run its finally (join
+                    # timeout): the readers are ours to close.
+                    _close_readers()
+
+        prod = threading.Thread(target=producer_run, daemon=True,
+                                name="shard-readahead")
+        prod.start()
+        try:
+            while True:
+                tag, a, b_, c = out_q.get()
+                if tag == "done":
+                    break
+                if tag == "err":
+                    raise a
+                batch_ids, block_lens, rows = a, b_, c
                 decoded = codec.decode_blocks(rows, block_lens)
                 for j, b in enumerate(batch_ids):
                     block = b"".join(decoded[j])[: block_lens[j]]
@@ -495,18 +562,25 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     hi = min(offset + length, blk_start + block_lens[j]) - blk_start
                     if hi > lo:
                         yield block[lo:hi]
-                bi = batch_ids[-1] + 1
         finally:
             # Runs on normal completion AND early close (GeneratorExit) —
             # callers that read exactly length bytes leave the generator
             # paused, so cleanup cannot live after the loop. (The shard
-            # pool is instance-owned and outlives the stream.)
-            for r in readers:
-                if r is not None:
-                    try:
-                        r.src.close()
-                    except Exception:  # noqa: BLE001
-                        pass
+            # pool is instance-owned and outlives the stream.) Stop and
+            # join the read-ahead producer BEFORE closing readers — it is
+            # the only thread touching them.
+            stop.set()
+            while True:
+                try:
+                    out_q.get_nowait()
+                except queue.Empty:
+                    break
+            prod.join(timeout=5.0)
+            if not prod.is_alive():
+                _close_readers()
+            # else: producer is wedged in a slow read — closing the files
+            # under it would corrupt its reads/retries; its own finally
+            # closes the readers when it exits.
             # Served the read but some shard was dead/corrupt: one-shot heal
             # trigger (reference cmd/erasure-object.go:321-344).
             if dead and self.mrf is not None:
