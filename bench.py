@@ -289,11 +289,52 @@ def bench_e2e_multipart() -> dict:
         dt = time.perf_counter() - t0
         total = part_size * n_parts
         gibs = total / dt / (1 << 30)
+        # GetObject e2e over the same object (BASELINE GetObject sweep
+        # role, cmd/benchmark-utils_test.go).
+        _info, it = es.get_object("bench", "obj")
+        for _ in it:  # warm (compiles the verify program)
+            pass
+        t0 = time.perf_counter()
+        _info, it = es.get_object("bench", "obj")
+        got = 0
+        for chunk in it:
+            got += len(chunk)
+        get_dt = time.perf_counter() - t0
+        assert got == total
         return {"metric": "putobject_e2e_multipart_16drive",
                 "value": round(gibs, 3), "unit": "GiB/s",
-                "vs_baseline": round(gibs / NORTH_STAR_GIBS, 4)}
+                "vs_baseline": round(gibs / NORTH_STAR_GIBS, 4),
+                "get_e2e_gibs": round(total / get_dt / (1 << 30), 3)}
     finally:
         shutil.rmtree(root, ignore_errors=True)
+
+
+def bench_xlmeta_codec() -> dict:
+    """xl.meta journal codec throughput (BASELINE msgp-codec row,
+    cmd/*_gen_test.go role): serialize+parse a 32-version journal."""
+    from minio_tpu.storage.fileinfo import FileInfo, PartInfo
+    from minio_tpu.storage.xlmeta import XLMeta
+
+    meta = XLMeta()
+    for i in range(32):
+        fi = FileInfo.new("bench", "obj", version_id=f"{i:032x}")
+        fi.size = 1 << 20
+        fi.mod_time = 1700000000.0 + i
+        fi.metadata = {"content-type": "application/octet-stream",
+                       "etag": "d" * 32, "x-amz-meta-run": str(i)}
+        fi.parts = [PartInfo(1, 1 << 20, 1 << 20)]
+        meta.add_version(fi)
+    raw = meta.serialize()
+    iters = 2000
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        blob = meta.serialize()
+        XLMeta.parse(blob)
+    dt = time.perf_counter() - t0
+    ops = 2 * iters / dt
+    return {"metric": "xlmeta_codec_32versions", "value": round(ops, 0),
+            "unit": "ops/s", "vs_baseline": 0.0,
+            "doc_bytes": len(raw)}
 
 
 def bench_select_csv() -> dict:
@@ -374,6 +415,7 @@ def main() -> int:
             ("heal", lambda: bench_heal(jax, jnp)),
             ("e2e", bench_e2e_multipart),
             ("select", bench_select_csv),
+            ("xlmeta", bench_xlmeta_codec),
         ]
         if use_pallas:
             plans.insert(1, ("encode_pallas",

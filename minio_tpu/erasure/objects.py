@@ -479,6 +479,40 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             ]))
             bi = ids[-1] + 1
 
+        if len(batches) <= 1:
+            # Single batch (small/ranged GET, the high-QPS case): nothing
+            # to overlap — read inline, skip the producer thread entirely.
+            try:
+                for ids, lens in batches:
+                    while True:
+                        chosen = ensure_readers()
+                        try:
+                            rows = self._read_chunk_rows(
+                                readers, chosen, ids, lens, codec, n,
+                                dead, algo, pool=pool)
+                            break
+                        except se.StorageError:
+                            continue
+                    decoded = codec.decode_blocks(rows, lens)
+                    for j, b in enumerate(ids):
+                        block = b"".join(decoded[j])[: lens[j]]
+                        blk_start = b * fi.erasure.block_size
+                        lo = max(offset, blk_start) - blk_start
+                        hi = min(offset + length,
+                                 blk_start + lens[j]) - blk_start
+                        if hi > lo:
+                            yield block[lo:hi]
+            finally:
+                for r in readers:
+                    if r is not None:
+                        try:
+                            r.src.close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                if dead and self.mrf is not None:
+                    self.mrf.add_partial(bucket, obj, fi.version_id)
+            return
+
         # Read-ahead producer (the GET half of P2, SURVEY §2.4): one
         # dedicated thread reads batch N+1 while the consumer verifies,
         # decodes and sends batch N. Readers/dead/re-selection are touched
