@@ -78,6 +78,12 @@ def _build_and_load():
             ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_uint64, ctypes.c_uint8, ctypes.c_void_p]
         lib.mtpu_csv_parse_floats.restype = ctypes.c_int64
+        lib.mtpu_jsonl_extract.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint32, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint64]
+        lib.mtpu_jsonl_extract.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -294,6 +300,35 @@ def csv_parse_floats(data: bytes, foff, flen, quote: bytes = b'"'):
     lib.mtpu_csv_parse_floats(data, foff.ctypes.data, flen.ctypes.data,
                               len(foff), quote[0], out.ctypes.data)
     return out
+
+
+# --- JSON-lines field extractor (S3 Select vector engine) --------------------
+
+def jsonl_extract(data: bytes, key: bytes):
+    """Per nonblank line: the LAST depth-1 scalar value of `key`.
+    Returns (line_off i64, line_len i32, val_off i64, val_len i32,
+    kind i8) — kinds: 0 missing, 1 number, 2 string, 3 true, 4 false,
+    5 null, -1 non-scalar, -2 python-fallback (escapes/non-object)."""
+    import numpy as np
+
+    lib = _build_and_load()
+    if lib is None:
+        raise OSError("native jsonl extractor unavailable")
+    max_lines = data.count(b"\n") + 2
+    line_off = np.empty(max_lines, dtype=np.int64)
+    line_len = np.empty(max_lines, dtype=np.int32)
+    val_off = np.empty(max_lines, dtype=np.int64)
+    val_len = np.empty(max_lines, dtype=np.int32)
+    kind = np.empty(max_lines, dtype=np.int8)
+    nl = lib.mtpu_jsonl_extract(
+        data, len(data), key, len(key),
+        line_off.ctypes.data, line_len.ctypes.data,
+        val_off.ctypes.data, val_len.ctypes.data, kind.ctypes.data,
+        max_lines)
+    if nl < 0:
+        raise ValueError("jsonl extract capacity exceeded")
+    return (line_off[:nl], line_len[:nl], val_off[:nl], val_len[:nl],
+            kind[:nl])
 
 
 # --- snappy block codec + crc32c (the S2 compression role) -------------------

@@ -138,6 +138,18 @@ def run_select(body_stream, request: S3SelectRequest
             yield from vector.run_vectorized(plan, raw, request, query)
             return
 
+    if request.input_format == "JSON":
+        # JSON-LINES vector lane: native depth-1 key extraction; odd
+        # rows re-evaluate through json.loads + the row evaluator.
+        from minio_tpu.s3select import vector
+
+        jplan = vector.compile_plan_json(query, request)
+        if jplan is not None:
+            raw = readers.decompress(body_stream, request.compression)
+            yield from vector.run_vectorized_json(jplan, raw, request,
+                                                  query)
+            return
+
     if request.input_format == "PARQUET":
         import struct as _struct
 

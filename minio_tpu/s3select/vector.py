@@ -58,6 +58,32 @@ class _Bool:
         self.kids = kids
 
 
+def _eval_bool_tree(node, n: int, leaf_eval):
+    """Shared three-valued (value, known) mask algebra over the compiled
+    predicate tree; `leaf_eval(_Cmp) -> (value, known)` supplies the
+    comparison masks (CSV and JSON batches differ only there)."""
+    if node is None:
+        return np.ones(n, bool), np.ones(n, bool)
+    if isinstance(node, _Bool):
+        if node.op == "LIT_TRUE":
+            return np.ones(n, bool), np.ones(n, bool)
+        if node.op == "LIT_FALSE":
+            return np.zeros(n, bool), np.ones(n, bool)
+        if node.op == "NOT":
+            v, k = _eval_bool_tree(node.kids[0], n, leaf_eval)
+            return ~v, k
+        lv, lk = _eval_bool_tree(node.kids[0], n, leaf_eval)
+        rv, rk = _eval_bool_tree(node.kids[1], n, leaf_eval)
+        if node.op == "AND":
+            value = lv & rv
+            known = (lk & rk) | (lk & ~lv) | (rk & ~rv)
+        else:
+            value = lv | rv
+            known = (lk & rk) | (lk & lv) | (rk & rv)
+        return value & known, known
+    return leaf_eval(node)
+
+
 _FLOAT_CASTS = {"FLOAT", "DOUBLE", "DECIMAL", "NUMERIC", "REAL"}
 _SWAP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "<>": "<>"}
 
@@ -249,27 +275,11 @@ class VectorPlan:
     # -- predicate evaluation: three-valued (value, known) masks --
 
     def _eval(self, node, batch: _Batch, ev: Evaluator):
+        return _eval_bool_tree(
+            node, batch.nrows, lambda c: self._leaf(c, batch, ev))
+
+    def _leaf(self, node, batch: _Batch, ev: Evaluator):
         n = batch.nrows
-        if node is None:
-            return np.ones(n, bool), np.ones(n, bool)
-        if isinstance(node, _Bool):
-            if node.op == "LIT_TRUE":
-                return np.ones(n, bool), np.ones(n, bool)
-            if node.op == "LIT_FALSE":
-                return np.zeros(n, bool), np.ones(n, bool)
-            if node.op == "NOT":
-                v, k = self._eval(node.kids[0], batch, ev)
-                return ~v, k
-            lv, lk = self._eval(node.kids[0], batch, ev)
-            rv, rk = self._eval(node.kids[1], batch, ev)
-            if node.op == "AND":
-                value = lv & rv
-                known = (lk & rk) | (lk & ~lv) | (rk & ~rv)
-            else:
-                value = lv | rv
-                known = (lk & rk) | (lk & lv) | (rk & rv)
-            return value & known, known
-        # _Cmp
         ci = self._ci(node.col)
         if ci is None:  # unknown column -> MISSING -> NULL comparison
             return np.zeros(n, bool), np.zeros(n, bool)
@@ -371,6 +381,331 @@ def _num_py(v):
     from minio_tpu.s3select import sql as _sql
 
     return _sql._num(v)
+
+
+# --- JSON-lines plan ---------------------------------------------------------
+
+def compile_plan_json(query: Query, request) -> "JSONVectorPlan | None":
+    """Vector plan for JSON LINES input (native depth-1 key extraction;
+    simdjson role). Same query-shape gate as the CSV plan."""
+    if not nativelib.csv_index_available():
+        return None
+    if request.input_format != "JSON" or (
+            request.json_type or "LINES").upper() != "LINES":
+        return None
+    try:
+        where = _compile_where(query.where)
+    except _Unsupported:
+        return None
+    cols: set[str] = set()
+
+    def _collect(nd):
+        if isinstance(nd, _Cmp):
+            cols.add(nd.col)
+        elif isinstance(nd, _Bool):
+            for k in nd.kids:
+                _collect(k)
+
+    _collect(where)
+    if query.aggregates:
+        for p in query.projections:
+            if not (isinstance(p.expr, Func) and p.expr in query.aggregates):
+                return None
+        for f in query.aggregates:
+            if not f.star:
+                if not (len(f.args) == 1 and isinstance(f.args[0], Col)
+                        and f.args[0].name):
+                    return None
+                cols.add(f.args[0].name)
+    else:
+        for p in query.projections:
+            if p.expr is None:
+                continue
+            if not (isinstance(p.expr, Col) and p.expr.name):
+                return None
+    return JSONVectorPlan(query, where, request)
+
+
+def _key_candidates(name: str) -> list[bytes]:
+    """Candidate top-level JSON keys, in the evaluator's resolution order
+    (exact name, alias-stripped, last segment)."""
+    cands = [name]
+    if "." in name:
+        cands += [name.split(".", 1)[1], name.rsplit(".", 1)[-1]]
+    out, seen = [], set()
+    for c in cands:
+        if c not in seen:
+            seen.add(c)
+            out.append(c.encode())
+    return out
+
+
+class _JsonBatch:
+    """One chunk of JSON lines, with lazy per-column extraction."""
+
+    def __init__(self, data: bytes):
+        self.data = data
+        # Zero-length key matches nothing: gives the line table + the
+        # structural python-fallback flags shared by every column.
+        lo, ll, _vo, _vl, kind = nativelib.jsonl_extract(data, b"")
+        self.line_off = lo
+        self.line_len = ll
+        self.pyrow = kind == -2
+        self.nrows = len(lo)
+        self._cols: dict[str, tuple] = {}
+        self._parsed: dict[int, dict] = {}
+
+    def col(self, name: str):
+        """(kind i8, val_off, val_len) with candidate keys merged in the
+        evaluator's resolution order. kind -3 marks rows that must go to
+        the row evaluator because a DOTTED column may address a NESTED
+        field the depth-1 extractor cannot see (_as_row flattens one
+        level, e.g. {"s": {"price": 1}} answers to "s.price")."""
+        got = self._cols.get(name)
+        if got is None:
+            kinds = voff = vlen = None
+            cands = _key_candidates(name)
+            for key in cands:
+                _lo, _ll, vo, vl, k = nativelib.jsonl_extract(self.data, key)
+                if kinds is None:
+                    kinds, voff, vlen = k.copy(), vo.copy(), vl.copy()
+                else:
+                    take = (kinds == 0) & (k != 0)
+                    kinds[take] = k[take]
+                    voff[take] = vo[take]
+                    vlen[take] = vl[take]
+            if "." in name:
+                # Chunk-level probe: if any dotted candidate's FIRST
+                # segment appears as a key anywhere in the chunk,
+                # flattening could produce the column — and the flattened
+                # (exact-name) value SHADOWS top-level candidate matches
+                # in the evaluator's order, so EVERY row of the chunk
+                # must re-check row-wise, not just the misses.
+                needles = {c.decode().split(".", 1)[0]
+                           for c in cands if b"." in c}
+                if any(f'"{seg}"'.encode() in self.data
+                       for seg in needles):
+                    kinds = np.full_like(kinds, -3)
+            got = self._cols[name] = (kinds, voff, vlen)
+        return got
+
+    def floats(self, name: str):
+        """(vals f64, numeric-ok mask, kinds) — numbers + numeric strings
+        parsed natively, booleans as 1/0."""
+        kinds, voff, vlen = self.col(name)
+        vals = nativelib.csv_parse_floats(self.data, voff, vlen)
+        ok = ~np.isnan(vals) & ((kinds == 1) | (kinds == 2))
+        vals = vals.copy()
+        vals[kinds == 3] = 1.0
+        vals[kinds == 4] = 0.0
+        ok = ok | (kinds == 3) | (kinds == 4)
+        return vals, ok, kinds
+
+    def value_text(self, ri: int, name: str) -> str:
+        kinds, voff, vlen = self.col(name)
+        return self.data[voff[ri]:voff[ri] + vlen[ri]].decode(
+            "utf-8", "replace")
+
+    def row_dict(self, ri: int) -> dict:
+        row = self._parsed.get(ri)
+        if row is None:
+            from minio_tpu.s3select.readers import _as_row, _loads
+
+            line = self.data[self.line_off[ri]:
+                             self.line_off[ri] + self.line_len[ri]]
+            row = self._parsed[ri] = _as_row(_loads(line.decode("utf-8")))
+        return row
+
+
+class JSONVectorPlan:
+    def __init__(self, query: Query, where, request):
+        self.query = query
+        self.where = where
+        self.request = request
+
+    def chunks(self, stream):
+        carry = b""
+        while True:
+            buf = stream.read(CHUNK)
+            if not buf:
+                if carry:
+                    yield carry
+                return
+            data = carry + buf
+            cut = data.rfind(b"\n")
+            if cut < 0:
+                carry = data
+                continue
+            yield data[:cut + 1]
+            carry = data[cut + 1:]
+
+    def _eval(self, node, batch: _JsonBatch, ev: Evaluator):
+        return _eval_bool_tree(
+            node, batch.nrows, lambda c: self._leaf(c, batch, ev))
+
+    def _leaf(self, node, batch: _JsonBatch, ev: Evaluator):
+        n = batch.nrows
+        kinds, voff, vlen = batch.col(node.col)
+        value = np.zeros(n, bool)
+        known = np.zeros(n, bool)
+        if isinstance(node.lit, str):
+            # Vector lane: real JSON strings, byte-compared (escape-free
+            # by construction). Everything else odd -> row fallback.
+            lit = node.lit.encode()
+            svals = kinds == 2
+            for ri in np.nonzero(svals & ~batch.pyrow)[0]:
+                raw = batch.data[voff[ri]:voff[ri] + vlen[ri]]
+                eq = raw == lit
+                value[ri] = eq if node.op == "=" else not eq
+                known[ri] = True
+            odd = (~svals & (kinds != 0) & (kinds != 5)) | batch.pyrow
+        else:
+            vals, ok, _k = self.floats_cache(batch, node.col)
+            lit = float(node.lit)
+            if node.op == "=":
+                value = vals == lit
+            elif node.op == "<>":
+                value = vals != lit
+            elif node.op == "<":
+                value = vals < lit
+            elif node.op == "<=":
+                value = vals <= lit
+            elif node.op == ">":
+                value = vals > lit
+            else:
+                value = vals >= lit
+            value = value & ok & ~batch.pyrow
+            known = ok & ~batch.pyrow
+            # non-numeric strings, complex values, possible-nested rows,
+            # pyrows: exact fallback
+            odd = (((kinds == 1) | (kinds == 2)) & ~ok) | (kinds == -1) \
+                | (kinds == -3) | batch.pyrow
+        for ri in np.nonzero(odd)[0]:
+            res = ev.eval(node.node, batch.row_dict(int(ri)))
+            if res is None:
+                continue
+            known[ri] = True
+            value[ri] = bool(res)
+        return value, known
+
+    def floats_cache(self, batch: _JsonBatch, name: str):
+        key = f"__f_{name}"
+        got = batch._cols.get(key)
+        if got is None:
+            got = batch._cols[key] = batch.floats(name)
+        return got
+
+    def match_mask(self, batch: _JsonBatch, ev: Evaluator) -> np.ndarray:
+        v, k = self._eval(self.where, batch, ev)
+        return v & k
+
+
+def run_vectorized_json(plan: JSONVectorPlan, raw_stream, request,
+                        query: Query):
+    """JSON-LINES twin of run_vectorized: same frames, same exactness
+    contract (odd rows re-evaluated through json.loads + the row
+    evaluator)."""
+    import io
+
+    from minio_tpu.s3select import eventstream as es
+    from minio_tpu.s3select.engine import RECORDS_FLUSH, _serialize
+
+    ev = Evaluator(query)
+    scanned = 0
+    returned = 0
+    emitted = 0
+    pending = io.BytesIO()
+
+    def flush():
+        nonlocal returned
+        data = pending.getvalue()
+        if not data:
+            return None
+        pending.seek(0)
+        pending.truncate()
+        returned += len(data)
+        return es.records_message(data)
+
+    header_order: list[str] = []
+    done = False
+    for chunk in plan.chunks(raw_stream):
+        if done:
+            break
+        batch = _JsonBatch(chunk)
+        if batch.nrows == 0:
+            continue
+        scanned += batch.nrows
+        mask = plan.match_mask(batch, ev)
+
+        if ev.is_aggregate:
+            for f, st in zip(query.aggregates, ev.agg_state):
+                if f.star:
+                    st["count"] += int(mask.sum())
+                    continue
+                name = f.args[0].name
+                vals, ok, kinds = plan.floats_cache(batch, name)
+                fb = batch.pyrow | (kinds == -3)
+                sel = mask & ~fb
+                # count: any non-null, non-missing value
+                present = sel & (kinds != 0) & (kinds != 5)
+                st["count"] += int(present.sum())
+                num = sel & ok
+                cands: list[tuple[int, object]] = []
+                if num.any():
+                    s = vals[num]
+                    st["sum"] += float(s.sum())
+                    rows_idx = np.nonzero(num)[0]
+                    for pos in (int(np.argmin(s)), int(np.argmax(s))):
+                        ri = int(rows_idx[pos])
+                        k = int(kinds[ri])
+                        n_exact = (1 if k == 3 else 0 if k == 4
+                                   else _num_py(batch.value_text(ri, name)))
+                        cands.append((ri, n_exact))
+                # python-fallback rows contribute through the evaluator
+                for ri in np.nonzero(mask & fb)[0]:
+                    row = batch.row_dict(int(ri))
+                    v = ev.eval(f.args[0], row)
+                    from minio_tpu.s3select.sql import MISSING
+                    if v is None or v is MISSING:
+                        continue
+                    st["count"] += 1
+                    n_exact = _num_py(v)
+                    if n_exact is not None:
+                        st["sum"] += n_exact
+                        cands.append((int(ri), n_exact))
+                for _ri, nv in sorted(cands, key=lambda c: c[0]):
+                    if nv is None:
+                        continue
+                    st["min"] = nv if st["min"] is None else min(st["min"], nv)
+                    st["max"] = nv if st["max"] is None else max(st["max"], nv)
+            continue
+
+        for ri in np.nonzero(mask)[0]:
+            ri = int(ri)
+            out = ev.project(batch.row_dict(ri))
+            if not header_order:
+                header_order = [k for k in out
+                                if not (k.startswith("_")
+                                        and k[1:].isdigit())] or list(out)
+            pending.write(_serialize(out, request, header_order).encode())
+            emitted += 1
+            if pending.tell() >= RECORDS_FLUSH:
+                msg = flush()
+                if msg:
+                    yield msg
+            if query.limit is not None and emitted >= query.limit:
+                scanned -= batch.nrows - (ri + 1)
+                done = True
+                break
+
+    if ev.is_aggregate:
+        out_row = ev.project({})
+        pending.write(_serialize(out_row, request, list(out_row)).encode())
+    msg = flush()
+    if msg:
+        yield msg
+    yield es.stats_message(scanned, scanned, returned)
+    yield es.end_message()
 
 
 def run_vectorized(plan: VectorPlan, raw_stream, request,

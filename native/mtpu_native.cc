@@ -597,6 +597,285 @@ int64_t mtpu_csv_parse_floats(const uint8_t* data, const int64_t* off,
 }
 
 // ---------------------------------------------------------------------------
+// JSON-lines field extractor — the simdjson role for S3 Select over
+// NDJSON: per line, locate the LAST depth-1 occurrence of a given key
+// and report its scalar value span + kind, without materializing a
+// parse tree. Lines that need real parsing (any backslash, non-object
+// roots, malformed nesting) report kind -2 and the Python engine
+// json.loads's them — the fast lane never guesses.
+//
+// kinds: 0 missing, 1 number, 2 string (span excludes the quotes),
+// 3 true, 4 false, 5 null, -1 non-scalar value, -2 python-fallback.
+// ---------------------------------------------------------------------------
+
+// Strict line scanner: validates the WHOLE line against JSON grammar
+// (key-independently, so every per-key scan flags the same fallback
+// lines) while extracting the target key's depth-1 scalar value.
+
+struct JlScan {
+  const uint8_t* d;
+  uint64_t i, n;
+  const uint8_t* key;
+  uint32_t klen;
+  int64_t voff;
+  int32_t vlen;
+  int8_t vkind;
+  bool bad;
+};
+
+static inline void jl_ws(JlScan* s) {
+  while (s->i < s->n && (s->d[s->i] == ' ' || s->d[s->i] == '\t')) ++s->i;
+}
+
+// Returns the string's content span via *so/*sl; escapes -> bad (python
+// fallback handles them exactly).
+static void jl_string(JlScan* s, uint64_t* so, uint32_t* sl) {
+  ++s->i;  // opening quote
+  uint64_t start = s->i;
+  while (s->i < s->n && s->d[s->i] != '"') {
+    if (s->d[s->i] == '\\') {
+      s->bad = true;
+      return;
+    }
+    ++s->i;
+  }
+  if (s->i >= s->n) {
+    s->bad = true;
+    return;
+  }
+  *so = start;
+  *sl = static_cast<uint32_t>(s->i - start);
+  ++s->i;  // closing quote
+}
+
+static void jl_number(JlScan* s, uint64_t* so, uint32_t* sl) {
+  uint64_t start = s->i;
+  if (s->i < s->n && s->d[s->i] == '-') ++s->i;
+  if (s->i >= s->n) {
+    s->bad = true;
+    return;
+  }
+  if (s->d[s->i] == '0') {
+    ++s->i;
+  } else if (s->d[s->i] >= '1' && s->d[s->i] <= '9') {
+    while (s->i < s->n && s->d[s->i] >= '0' && s->d[s->i] <= '9') ++s->i;
+  } else {
+    s->bad = true;
+    return;
+  }
+  if (s->i < s->n && s->d[s->i] == '.') {
+    ++s->i;
+    if (s->i >= s->n || s->d[s->i] < '0' || s->d[s->i] > '9') {
+      s->bad = true;
+      return;
+    }
+    while (s->i < s->n && s->d[s->i] >= '0' && s->d[s->i] <= '9') ++s->i;
+  }
+  if (s->i < s->n && (s->d[s->i] == 'e' || s->d[s->i] == 'E')) {
+    ++s->i;
+    if (s->i < s->n && (s->d[s->i] == '+' || s->d[s->i] == '-')) ++s->i;
+    if (s->i >= s->n || s->d[s->i] < '0' || s->d[s->i] > '9') {
+      s->bad = true;
+      return;
+    }
+    while (s->i < s->n && s->d[s->i] >= '0' && s->d[s->i] <= '9') ++s->i;
+  }
+  *so = start;
+  *sl = static_cast<uint32_t>(s->i - start);
+}
+
+static inline bool jl_lit(JlScan* s, const char* word, int len) {
+  if (s->i + len > s->n || memcmp(s->d + s->i, word, len) != 0) {
+    s->bad = true;
+    return false;
+  }
+  s->i += len;
+  return true;
+}
+
+static void jl_value(JlScan* s, int depth);
+
+static void jl_object(JlScan* s, int depth) {
+  ++s->i;  // '{'
+  jl_ws(s);
+  if (s->i < s->n && s->d[s->i] == '}') {
+    ++s->i;
+    return;
+  }
+  for (;;) {
+    jl_ws(s);
+    if (s->i >= s->n || s->d[s->i] != '"') {
+      s->bad = true;
+      return;
+    }
+    uint64_t ko = 0;
+    uint32_t kl = 0;
+    jl_string(s, &ko, &kl);
+    if (s->bad) return;
+    jl_ws(s);
+    if (s->i >= s->n || s->d[s->i] != ':') {
+      s->bad = true;
+      return;
+    }
+    ++s->i;
+    jl_ws(s);
+    bool record = (depth == 0 && kl == s->klen &&
+                   memcmp(s->d + ko, s->key, kl) == 0);
+    if (record && s->i < s->n) {
+      uint8_t c = s->d[s->i];
+      uint64_t vo = 0;
+      uint32_t vl = 0;
+      if (c == '"') {
+        uint64_t save = s->i;
+        jl_string(s, &vo, &vl);
+        if (s->bad) return;
+        s->voff = static_cast<int64_t>(vo);
+        s->vlen = static_cast<int32_t>(vl);
+        s->vkind = 2;
+        (void)save;
+      } else if (c == '{' || c == '[') {
+        s->vkind = -1;
+        jl_value(s, depth + 1);
+        if (s->bad) return;
+      } else if (c == 't') {
+        if (!jl_lit(s, "true", 4)) return;
+        s->vkind = 3;
+      } else if (c == 'f') {
+        if (!jl_lit(s, "false", 5)) return;
+        s->vkind = 4;
+      } else if (c == 'n') {
+        if (!jl_lit(s, "null", 4)) return;
+        s->vkind = 5;
+      } else {
+        jl_number(s, &vo, &vl);
+        if (s->bad) return;
+        s->voff = static_cast<int64_t>(vo);
+        s->vlen = static_cast<int32_t>(vl);
+        s->vkind = 1;
+      }
+    } else {
+      jl_value(s, depth + 1);
+      if (s->bad) return;
+    }
+    jl_ws(s);
+    if (s->i < s->n && s->d[s->i] == ',') {
+      ++s->i;
+      continue;
+    }
+    if (s->i < s->n && s->d[s->i] == '}') {
+      ++s->i;
+      return;
+    }
+    s->bad = true;
+    return;
+  }
+}
+
+static void jl_value(JlScan* s, int depth) {
+  if (depth > 64) {  // pathological nesting: python handles
+    s->bad = true;
+    return;
+  }
+  jl_ws(s);
+  if (s->i >= s->n) {
+    s->bad = true;
+    return;
+  }
+  uint8_t c = s->d[s->i];
+  uint64_t so = 0;
+  uint32_t sl = 0;
+  if (c == '"') {
+    jl_string(s, &so, &sl);
+  } else if (c == '{') {
+    jl_object(s, depth);
+  } else if (c == '[') {
+    ++s->i;
+    jl_ws(s);
+    if (s->i < s->n && s->d[s->i] == ']') {
+      ++s->i;
+      return;
+    }
+    for (;;) {
+      jl_value(s, depth + 1);
+      if (s->bad) return;
+      jl_ws(s);
+      if (s->i < s->n && s->d[s->i] == ',') {
+        ++s->i;
+        continue;
+      }
+      if (s->i < s->n && s->d[s->i] == ']') {
+        ++s->i;
+        return;
+      }
+      s->bad = true;
+      return;
+    }
+  } else if (c == 't') {
+    jl_lit(s, "true", 4);
+  } else if (c == 'f') {
+    jl_lit(s, "false", 5);
+  } else if (c == 'n') {
+    jl_lit(s, "null", 4);
+  } else {
+    jl_number(s, &so, &sl);
+  }
+}
+
+int64_t mtpu_jsonl_extract(const uint8_t* data, uint64_t n,
+                           const uint8_t* key, uint32_t key_len,
+                           int64_t* line_off, int32_t* line_len,
+                           int64_t* val_off, int32_t* val_len,
+                           int8_t* kind, uint64_t max_lines) {
+  uint64_t li = 0;
+  uint64_t pos = 0;
+  while (pos < n) {
+    uint64_t start = pos;
+    while (pos < n && data[pos] != '\n') ++pos;
+    uint64_t end = pos;  // [start, end) excludes \n
+    if (pos < n) ++pos;
+    if (end > start && data[end - 1] == '\r') --end;
+    uint64_t a = start;
+    while (a < end && (data[a] == ' ' || data[a] == '\t')) ++a;
+    uint64_t b = end;
+    while (b > a && (data[b - 1] == ' ' || data[b - 1] == '\t')) --b;
+    if (a == b) continue;  // blank line: the row engine skips it too
+    if (li >= max_lines) return -1;
+    line_off[li] = static_cast<int64_t>(a);
+    line_len[li] = static_cast<int32_t>(b - a);
+    val_off[li] = 0;
+    val_len[li] = 0;
+    kind[li] = 0;
+
+    if (data[a] != '{') {  // non-object root: python handles
+      kind[li] = -2;
+      ++li;
+      continue;
+    }
+    JlScan s;
+    s.d = data;
+    s.i = a;
+    s.n = b;
+    s.key = key;
+    s.klen = key_len;
+    s.voff = 0;
+    s.vlen = 0;
+    s.vkind = 0;
+    s.bad = false;
+    jl_object(&s, 0);
+    jl_ws(&s);
+    if (s.bad || s.i != b) {
+      kind[li] = -2;  // malformed: the row engine must raise, not us
+    } else {
+      kind[li] = s.vkind;
+      val_off[li] = s.voff;
+      val_len[li] = s.vlen;
+    }
+    ++li;
+  }
+  return static_cast<int64_t>(li);
+}
+
+// ---------------------------------------------------------------------------
 // Argon2id (RFC 9106) — the pkg/argon2 role: memory-hard KDF used to
 // derive the config-at-rest encryption key from the root credential
 // (reference cmd/config-encrypted.go via madmin EncryptData). Includes

@@ -45,15 +45,20 @@ def _run_capture(data: bytes, req):
 
 
 def _both(data: bytes, expr: str, **kw):
-    """(vector result, row result) for the same request."""
+    """(vector result, row result) for the same request. BOTH plan
+    compilers must be disabled for the row run — patching only the CSV
+    one would make JSON comparisons tautological."""
     req = _req(expr, **kw)
     vec = _run_capture(data, req)
-    real_compile = vector.compile_plan
-    vector.compile_plan = lambda *_a, **_k: None  # force the row engine
+    real_csv = vector.compile_plan
+    real_json = vector.compile_plan_json
+    vector.compile_plan = lambda *_a, **_k: None
+    vector.compile_plan_json = lambda *_a, **_k: None
     try:
         row = _run_capture(data, req)
     finally:
-        vector.compile_plan = real_compile
+        vector.compile_plan = real_csv
+        vector.compile_plan_json = real_json
     return vec, row
 
 
@@ -213,3 +218,119 @@ def test_vector_on_gzip_compressed_input():
     finally:
         vector.compile_plan = real
     assert vec == row
+
+
+# ---------------- JSON-LINES vector lane ----------------
+
+JDATA = (b'{"id": 0, "price": 1.5, "name": "a"}\n'
+         + b"".join(b'{"id": %d, "price": %d.25, "qty": %d, "name": "item-%d"}\n'
+                    % (i, i % 97, i % 7, i) for i in range(1, 4000))
+         + b'{"id": 4000, "price": "12.5", "name": "strnum"}\n'
+         + b'{"id": 4001, "price": null, "name": "nullprice"}\n'
+         + b'{"id": 4002, "name": "missing-price"}\n'
+         + b'{"id": 4003, "price": true}\n'
+         + b'{"id": 4004, "price": {"nested": 1}, "name": "complex"}\n'
+         + b'{"id": 4005, "price": 3, "name": "say \\"hi\\""}\n'   # escape -> pyrow
+         + b'\n'
+         + b'{"id": 4006, "id": 4007, "price": 9}\n')              # dup key
+
+
+@pytest.mark.parametrize("expr", [
+    "SELECT COUNT(*) FROM S3Object",
+    "SELECT COUNT(*) FROM S3Object s WHERE s.price > 50",
+    "SELECT COUNT(s.price), SUM(s.price), MIN(s.price), MAX(s.price), "
+    "AVG(s.price) FROM S3Object s",
+    "SELECT COUNT(*) FROM S3Object s WHERE s.qty >= 3 AND s.id < 2000",
+    "SELECT * FROM S3Object s WHERE s.price > 90",
+    "SELECT s.id, s.name FROM S3Object s WHERE s.qty = 0 AND s.id < 100",
+    "SELECT * FROM S3Object s WHERE s.name = 'item-17'",
+    "SELECT * FROM S3Object s WHERE NOT (s.price > 5) AND s.id < 40",
+    "SELECT * FROM S3Object s WHERE s.id >= 4000",   # all the odd tail rows
+    "SELECT * FROM S3Object s WHERE s.id < 30 LIMIT 7",
+    "SELECT COUNT(*) FROM S3Object s WHERE s.nope > 5",
+    "SELECT MIN(s.id), MAX(s.id) FROM S3Object s",
+])
+@pytest.mark.parametrize("outfmt", ["JSON", "CSV"])
+def test_json_vector_equals_row_engine(expr, outfmt):
+    vec, row = _both(JDATA, expr, input_format="JSON",
+                     output_format=outfmt)
+    assert vec == row, (expr, outfmt)
+
+
+def test_json_vector_malformed_lines_match():
+    # Leading-zero numbers, trailing garbage, bare arrays: the row engine
+    # raises SelectError — the vector lane must do exactly the same.
+    for doc in (b'{"a": 05}\n', b'{"a": 1} trailing\n', b'[1, 2]\n',
+                b'{"a": +3}\n', b'{"a": .5}\n'):
+        vec, row = _both(b'{"a": 1}\n' + doc,
+                         "SELECT COUNT(*) FROM S3Object s WHERE s.a > 0",
+                         input_format="JSON")
+        assert vec == row, doc
+
+
+def test_json_vector_chunk_boundaries():
+    old = vector.CHUNK
+    vector.CHUNK = 256
+    try:
+        vec, row = _both(JDATA, "SELECT COUNT(*), SUM(s.price) FROM "
+                                "S3Object s WHERE s.id < 3500",
+                         input_format="JSON")
+        assert vec == row
+    finally:
+        vector.CHUNK = old
+
+
+def test_json_vector_faster():
+    import time
+
+    data = b"".join(b'{"id": %d, "price": %d.5, "qty": %d}\n'
+                    % (i, i % 1000, i % 7) for i in range(200_000))
+    req = _req("SELECT COUNT(*), SUM(s.price) FROM S3Object s "
+               "WHERE s.price > 500", input_format="JSON")
+    t0 = time.perf_counter()
+    vec = _run_capture(data, req)
+    t_vec = time.perf_counter() - t0
+    realc, realj = vector.compile_plan, vector.compile_plan_json
+    vector.compile_plan = lambda *a, **k: None
+    vector.compile_plan_json = lambda *a, **k: None
+    try:
+        t0 = time.perf_counter()
+        row = _run_capture(data, req)
+        t_row = time.perf_counter() - t0
+    finally:
+        vector.compile_plan, vector.compile_plan_json = realc, realj
+    assert vec == row
+    assert t_vec * 2 < t_row, (t_vec, t_row)
+
+
+def test_json_vector_nested_fields_exact():
+    # Dotted columns addressing NESTED fields (flattened one level by the
+    # row engine) plus a decoy literal top-level "s.price" key.
+    data = (b'{"name": "alice", "nested": {"x": 1}}\n'
+            b'{"name": "bob", "nested": {"x": 2}}\n'
+            b'{"name": "carol", "s.price": 7}\n'
+            b'{"name": "dave", "s": {"price": 9}}\n')
+    for expr in ("SELECT name FROM S3Object WHERE nested.x = 1",
+                 "SELECT * FROM S3Object s WHERE s.price > 5",
+                 "SELECT COUNT(*), SUM(s.price) FROM S3Object s"):
+        vec, row = _both(data, expr, input_format="JSON",
+                         output_format="JSON")
+        assert vec == row, expr
+
+
+def test_json_vector_review_repros():
+    """Exact reproductions from review: flattened-key shadowing of
+    top-level candidates, and malformed values under NON-queried keys."""
+    data = b'{"price": 5, "s": {"price": 9}}\n'
+    vec, row = _both(data, "SELECT COUNT(*) FROM S3Object s "
+                           "WHERE s.price > 6", input_format="JSON")
+    assert vec == row  # flattened "s.price"=9 shadows top-level "price"=5
+    data = b'{"x": 5, "nested": {"x": 7}}\n'
+    vec, row = _both(data, "SELECT COUNT(*) FROM S3Object s "
+                           "WHERE nested.x = 7", input_format="JSON")
+    assert vec == row
+    for doc in (b'{"a": 05, "price": 1}\n', b'{"id" 5, "price": 2}\n'):
+        vec, row = _both(doc, "SELECT COUNT(*) FROM S3Object s "
+                              "WHERE s.price > 0", input_format="JSON")
+        assert vec == row, doc
+        assert isinstance(vec, str) and vec.startswith("SelectError"), doc
