@@ -495,22 +495,31 @@ class MRFHealer:
         self.er = er
         self.q: queue.Queue = queue.Queue(maxsize=maxsize)
         self._seen_lock = threading.Lock()
-        self._pending: set[tuple[str, str, str]] = set()
+        # (bucket, obj, version_id) -> deep flag; a deep request upgrades
+        # a pending shallow one in place (one heal pass, not two).
+        self._pending: dict[tuple[str, str, str], bool] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._drain, daemon=True)
         self._thread.start()
 
-    def add_partial(self, bucket: str, obj: str, version_id: str = "") -> None:
+    def add_partial(self, bucket: str, obj: str, version_id: str = "",
+                    deep: bool = False) -> None:
+        """deep=True when the caller OBSERVED bitrot (a corrupt read): the
+        background heal then bitrot-verifies every shard, so in-place
+        corruption is rebuilt rather than passed over by the presence-only
+        normal scan."""
         key = (bucket, obj, version_id)
         with self._seen_lock:
             if key in self._pending:
+                if deep:
+                    self._pending[key] = True  # upgrade the queued heal
                 return
-            self._pending.add(key)
+            self._pending[key] = deep
         try:
             self.q.put_nowait(key)
         except queue.Full:
             with self._seen_lock:
-                self._pending.discard(key)
+                self._pending.pop(key, None)
 
     def _drain(self) -> None:
         while not self._stop.is_set():
@@ -519,13 +528,15 @@ class MRFHealer:
             except queue.Empty:
                 continue
             bucket, obj, version_id = key
+            # Read the (possibly upgraded) deep flag and retire the entry
+            # together, so an upgrade arriving after this point re-queues.
+            with self._seen_lock:
+                deep = self._pending.pop(key, False)
             try:
-                self.er.heal_object(bucket, obj, version_id)
+                self.er.heal_object(bucket, obj, version_id, scan_deep=deep)
             except Exception:  # noqa: BLE001 - best-effort background heal
                 pass
             finally:
-                with self._seen_lock:
-                    self._pending.discard(key)
                 self.q.task_done()
 
     def wait_idle(self, timeout: float = 10.0) -> bool:

@@ -435,6 +435,12 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         rel = f"{obj}/{fi.data_dir}/part.{part.number}"
         shard_data_size = codec.shard_file_size(part.size)
 
+        native = self._native_stream(bucket, obj, fi, part, algo, shuffled,
+                                     rel, offset, length)
+        if native is not None:
+            yield from native
+            return
+
         readers: list[bitrot.BitrotReader | None] = [None] * n
 
         def open_reader(i: int):
@@ -449,6 +455,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         # Open readers lazily, data shards first (parity only on demand) —
         # the staggered any-k read strategy (cmd/erasure-decode.go:120-188).
         dead: set[int] = set()
+        corrupt: set[int] = set()  # the subset of dead that OBSERVED bitrot
 
         def ensure_readers() -> list[int]:
             chosen: list[int] = []
@@ -489,7 +496,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                         try:
                             rows = self._read_chunk_rows(
                                 readers, chosen, ids, lens, codec, n,
-                                dead, algo, pool=pool)
+                                dead, algo, pool=pool, corrupt=corrupt)
                             break
                         except se.StorageError:
                             continue
@@ -510,7 +517,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                         except Exception:  # noqa: BLE001
                             pass
                 if dead and self.mrf is not None:
-                    self.mrf.add_partial(bucket, obj, fi.version_id)
+                    self.mrf.add_partial(bucket, obj, fi.version_id,
+                                         deep=bool(corrupt))
             return
 
         # Read-ahead producer (the GET half of P2, SURVEY §2.4): one
@@ -559,7 +567,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                         try:
                             rows = self._read_chunk_rows(
                                 readers, chosen, ids, lens, codec, n,
-                                dead, algo, pool=pool,
+                                dead, algo, pool=pool, corrupt=corrupt,
                             )
                             break
                         except se.StorageError:
@@ -618,10 +626,86 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             # Served the read but some shard was dead/corrupt: one-shot heal
             # trigger (reference cmd/erasure-object.go:321-344).
             if dead and self.mrf is not None:
-                self.mrf.add_partial(bucket, obj, fi.version_id)
+                self.mrf.add_partial(bucket, obj, fi.version_id,
+                                     deep=bool(corrupt))
+
+    def _native_stream(self, bucket: str, obj: str, fi: FileInfo, part,
+                       algo: str, shuffled: list[StorageAPI], rel: str,
+                       offset: int, length: int):
+        """Native serving lane for GET: pread + sip256 verify + any-k
+        reconstruct + block assembly in one GIL-released C++ call per
+        window (native/mtpu_native.cc mtpu_decode_part — the reference's
+        parallelReader + bitrot verify + ReconstructData,
+        cmd/erasure-decode.go:120-205). None -> Python/device path."""
+        from minio_tpu.native import plane
+
+        if algo != "sip256" or length <= 0 or not plane.available():
+            return None
+        paths = _local_shard_paths(shuffled, bucket, rel)
+        if paths is None:
+            return None
+        k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
+        bs = fi.erasure.block_size
+
+        def gen():
+            from concurrent.futures import ThreadPoolExecutor
+
+            corrupt_seen = False
+            dead: set[int] = set()  # fed forward so later windows never
+            end = offset + length   # re-read a shard already known bad
+
+            def windows():
+                pos = offset
+                while pos < end:
+                    wend = min(end, (pos // bs + plane.WINDOW_BLOCKS) * bs)
+                    yield pos, wend
+                    pos = wend
+
+            # One-window read-ahead: window N+1 decodes (GIL-released C
+            # call) in a worker while window N streams to the client —
+            # the GET half of P2 (the Python lane's read-ahead producer).
+            with ThreadPoolExecutor(max_workers=1,
+                                    thread_name_prefix="native-decode") as ex:
+                try:
+                    fut = None
+                    pending = windows()
+                    nxt = next(pending, None)
+                    while nxt is not None:
+                        pos, wend = nxt
+                        if fut is None:
+                            fut = ex.submit(plane.decode_range, paths, k, m,
+                                            bs, part.size, pos, wend - pos,
+                                            skip=set(dead))
+                        try:
+                            data, states = fut.result()
+                        except OSError as e:
+                            raise se.FaultyDisk(
+                                f"native decode: {e}") from e
+                        for i, s in enumerate(states):
+                            if s < 0:
+                                dead.add(i)
+                            if s == -2:
+                                corrupt_seen = True
+                        if data is None:
+                            raise se.InsufficientReadQuorum(
+                                bucket, obj, "not enough live shards")
+                        nxt = next(pending, None)
+                        fut = (ex.submit(plane.decode_range, paths, k, m,
+                                         bs, part.size, nxt[0],
+                                         nxt[1] - nxt[0], skip=set(dead))
+                               if nxt is not None else None)
+                        yield data
+                finally:
+                    # One-shot heal trigger on any dead/corrupt shard seen
+                    # (reference cmd/erasure-object.go:321-344).
+                    if dead and self.mrf is not None:
+                        self.mrf.add_partial(bucket, obj, fi.version_id,
+                                             deep=corrupt_seen)
+
+        return gen()
 
     def _read_chunk_rows(self, readers, chosen, batch_ids, block_lens, codec,
-                         n, dead, algo=None, pool=None):
+                         n, dead, algo=None, pool=None, corrupt=None):
         """Read one batch of chunk rows from the chosen shards; marks dead
         drives and raises StorageError to trigger re-selection.
 
@@ -692,6 +776,11 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             except (se.StorageError, OSError, CancelledError,
                     RuntimeError) as e:
                 dead.add(i)
+                # FileCorrupt = observed bitrot/truncation -> the queued
+                # heal must deep-verify; a plain open/read failure only
+                # needs the presence scan.
+                if isinstance(e, se.FileCorrupt) and corrupt is not None:
+                    corrupt.add(i)
                 readers[i] = None
                 if first_err is None:
                     first_err = (i, e)
@@ -710,10 +799,11 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     records.append((i, want, chunk))
             rows.append(row)
         if records:
-            self._verify_records(records, codec, readers, dead)
+            self._verify_records(records, codec, readers, dead, corrupt)
         return rows
 
-    def _verify_records(self, records, codec, readers, dead) -> None:
+    def _verify_records(self, records, codec, readers, dead,
+                        corrupt=None) -> None:
         """One batched mxsum256 launch over every chunk just read; a digest
         mismatch marks the drive dead and retriggers shard selection."""
         from minio_tpu.ops import fused
@@ -723,6 +813,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         for ri, (i, want, _chunk) in enumerate(records):
             if got[ri] != want:
                 dead.add(i)
+                if corrupt is not None:
+                    corrupt.add(i)
                 readers[i] = None
                 raise se.FileCorrupt(f"shard {i}: bitrot digest mismatch")
 
@@ -936,6 +1028,93 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
     # internals
     # ------------------------------------------------------------------
 
+    def _native_fan_out(
+        self,
+        shuffled: list[StorageAPI],
+        vol: str,
+        rel: str,
+        data: BinaryIO,
+        size: int,
+        codec: ErasureCodec,
+        write_quorum: int,
+        bucket: str,
+        obj: str,
+        initial: bytes = b"",
+    ) -> tuple[int, str, list[Exception | None]] | None:
+        """Native serving lane for the PUT fan-out: the whole block→shard→
+        bitrot-frame→per-drive-file pipeline runs in ONE GIL-released C++
+        call per segment (native/mtpu_native.cc mtpu_encode_part — the
+        reference's native Erasure.Encode + parallelWriter + hash.Reader
+        path, cmd/erasure-encode.go:36-109, pkg/hash/reader.go:37).
+
+        Engaged when the set hashes with host-native sip256 and every drive
+        is local; returns None to fall through to the device-codec fan-out
+        otherwise. The per-call disk-ID guard is deferred to the commit
+        (rename_data IS guarded), matching the quorum outcome either way."""
+        from minio_tpu.native import plane
+
+        if self.bitrot_algorithm != "sip256" or not plane.available():
+            return None
+        if codec.block_size % 64:
+            return None  # md5 segment chaining needs 64-byte alignment
+        paths = _local_shard_paths(shuffled, vol, rel)
+        if paths is None:
+            return None
+        import os as _os
+        from concurrent.futures import ThreadPoolExecutor
+
+        enc = plane.PartEncoder(paths, codec.k, codec.m, codec.block_size,
+                                bitrot.BITROT_KEY)
+        for i, p in enumerate(paths):
+            try:
+                _os.makedirs(_os.path.dirname(p), exist_ok=True)
+            except OSError:
+                # One bad drive (read-only/full fs) degrades to quorum
+                # accounting, exactly like a failed writer thread in the
+                # Python lane — never aborts the whole PUT.
+                enc.fail_drive(i)
+        seg = plane.SEG_BLOCKS * codec.block_size
+        total = 0
+        buf = bytearray(initial)
+        # One-segment pipeline: the GIL-released C call for segment N runs
+        # in a worker thread while this thread reads segment N+1 from the
+        # client — the native lane's form of the P2 read/encode overlap
+        # (the Python lane's dispatch-ahead, cmd/erasure-encode.go:80-107).
+        with ThreadPoolExecutor(max_workers=1,
+                                thread_name_prefix="native-encode") as ex:
+            fut = None
+            while True:
+                want = seg - len(buf)
+                if size >= 0:
+                    want = min(want, size - total - len(buf))
+                got = _read_full(data, want) if want > 0 else b""
+                buf += got
+                final = (len(got) < want
+                         or (size >= 0 and total + len(buf) >= size)
+                         or (size < 0 and len(buf) < seg))
+                try:
+                    if fut is not None:
+                        fut.result()  # segment N-1 fully written
+                    fut = ex.submit(enc.feed, buf, final)
+                    if final:
+                        fut.result()
+                except OSError as e:
+                    raise se.FaultyDisk(f"native encode: {e}") from e
+                total += len(buf)
+                alive = sum(1 for lost in enc.errors if not lost)
+                if alive < write_quorum:
+                    raise se.InsufficientWriteQuorum(
+                        bucket, obj, "write fan-out lost quorum")
+                if final:
+                    break
+                buf = bytearray()
+        errs: list[Exception | None] = [
+            se.FaultyDisk(f"native shard write failed: {paths[i]}")
+            if lost else None
+            for i, lost in enumerate(enc.errors)
+        ]
+        return total, enc.md5_hex, errs
+
     def _fan_out_encode(
         self,
         shuffled: list[StorageAPI],
@@ -953,7 +1132,15 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         shards to one create_file per drive (the io.Pipe + goroutine fan-out
         of cmd/erasure-encode.go:36-70, collapsed into queues). Returns
         (bytes consumed, md5 hex, per-drive errors). `initial` is a prefix
-        the caller already consumed from `data`."""
+        the caller already consumed from `data`.
+
+        The all-local sip256 configuration takes the native C++ lane
+        instead (_native_fan_out); this Python/device path serves
+        accelerator-fused digests and remote-drive topologies."""
+        native = self._native_fan_out(shuffled, vol, rel, data, size, codec,
+                                      write_quorum, bucket, obj, initial)
+        if native is not None:
+            return native
         qs: list[queue.Queue] = [queue.Queue(maxsize=8) for _ in range(self.n)]
         errs: list[Exception | None] = [None] * self.n
 
@@ -1084,6 +1271,29 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
 
     def _fi_to_object_info(self, bucket: str, obj: str, fi: FileInfo) -> ObjectInfo:
         return listing.fi_to_object_info(bucket, obj, fi)
+
+
+def _local_shard_paths(drives: list[StorageAPI], vol: str,
+                       rel: str) -> list[str] | None:
+    """Absolute shard-file paths when EVERY drive is local (unwrapping the
+    disk-ID decorator); None if any drive is remote/faulty-wrapped — the
+    native plane needs direct file access on all n drives."""
+    from minio_tpu.storage.idcheck import DiskIDChecker
+    from minio_tpu.storage.local import LocalDrive
+
+    paths: list[str] = []
+    for d in drives:
+        # Unwrap ONLY the disk-ID decorator — any other wrapper (remote
+        # client, fault injector) must keep its per-call interposition,
+        # so its presence routes the stream to the Python path.
+        base = d.inner if isinstance(d, DiskIDChecker) else d
+        if not isinstance(base, LocalDrive):
+            return None
+        try:
+            paths.append(base._file_path(vol, rel))
+        except se.StorageError:
+            return None
+    return paths
 
 
 def _clone_for_drive(fi: FileInfo, index: int) -> FileInfo:

@@ -1,0 +1,180 @@
+"""Native serving data plane — ctypes bridge for the PUT/GET hot pipelines.
+
+Role: the reference's serving path is native end to end (reedsolomon AVX2
+inside Erasure.Encode + per-drive writers, cmd/erasure-encode.go:36-109;
+parallelReader + ReconstructData, cmd/erasure-decode.go:120-205; inline
+bitrot, cmd/bitrot-streaming.go; md5 ETag hashing, pkg/hash/reader.go:37).
+Here one GIL-released call per segment runs the whole pipeline in C++
+threads (native/mtpu_native.cc mtpu_encode_part / mtpu_decode_part);
+Python keeps only control flow — drive selection, quorum, commit.
+
+The erasure layer (erasure/objects.py) engages this lane when the set's
+bitrot algorithm is the host-native sip256 and every drive is local; any
+other configuration streams through the batched device codec instead.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+from minio_tpu.native import lib as nlib
+
+# Segment / window sizing: multiples of the 1 MiB default block keep md5
+# chaining legal (64-byte alignment) and bound the per-call buffers. A PUT
+# segment stages ~seg x (1 + (k+m)/k) bytes of transient heap, a GET
+# window ~2x the window — sized so ten concurrent part streams stay under
+# ~1.5 GiB total, the role of the Python lane's bounded queues.
+SEG_BLOCKS = 64      # PUT: encode segment (64 MiB at 1 MiB blocks)
+WINDOW_BLOCKS = 64   # GET: decode window (64 MiB at 1 MiB blocks)
+
+_MD5_INIT = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476)
+
+_bound = False
+
+
+def _lib():
+    global _bound
+    lib = nlib._build_and_load()
+    if lib is None or not hasattr(lib, "mtpu_encode_part"):
+        return None
+    if not _bound:
+        lib.mtpu_encode_part.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int8)]
+        lib.mtpu_encode_part.restype = ctypes.c_int64
+        lib.mtpu_decode_part.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_char_p,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int8)]
+        lib.mtpu_decode_part.restype = ctypes.c_int64
+        _bound = True
+    return lib
+
+
+def available() -> bool:
+    # Kill switch FIRST: MTPU_NATIVE_PLANE=0 must not build/dlopen the
+    # (possibly suspect) library as a side effect of the check.
+    if os.environ.get("MTPU_NATIVE_PLANE", "1") == "0":
+        return False
+    return _lib() is not None
+
+
+def _threads() -> int:
+    return min(8, os.cpu_count() or 1)
+
+
+class PartEncoder:
+    """Streaming encoder for one part: feed() segments (block_size
+    multiples; the final call any length), then read .md5_hex and .errors.
+    Drive failures are sticky — a failed drive is skipped on later
+    segments and reported once."""
+
+    def __init__(self, paths: list[str], k: int, m: int, block_size: int,
+                 key32: bytes, do_sync: bool = True, threads: int = 0):
+        from minio_tpu.ops import gf
+
+        self._l = _lib()
+        if self._l is None:
+            raise OSError("native plane unavailable")
+        self.k, self.m, self.bs = k, m, block_size
+        self.n = k + m
+        self._key = key32
+        self._paths = (ctypes.c_char_p * self.n)(
+            *[p.encode() for p in paths])
+        pm = gf.parity_matrix(k, m) if m else None
+        self._pmat = bytes(pm.tobytes()) if pm is not None else b"\x00"
+        self._md5_h = (ctypes.c_uint32 * 4)(*_MD5_INIT)
+        self._md5_len = ctypes.c_uint64(0)
+        self._md5_out = ctypes.create_string_buffer(16)
+        self._rc = (ctypes.c_int8 * self.n)()
+        self._append = 0
+        self._do_sync = 1 if do_sync else 0
+        self._threads = threads or _threads()
+        self._final = False
+        self.total = 0
+
+    def feed(self, buf, final: bool) -> None:
+        if self._final:
+            raise ValueError("PartEncoder already finalized")
+        if not final and len(buf) % self.bs:
+            raise ValueError("non-final segment must be block-aligned")
+        n = len(buf)
+        if isinstance(buf, memoryview):
+            buf = bytearray(buf)
+        if isinstance(buf, bytearray):
+            # Zero-copy: borrow the bytearray's buffer for the call.
+            data = (ctypes.cast((ctypes.c_char * n).from_buffer(buf),
+                                ctypes.c_char_p) if n else None)
+        else:
+            data = buf if n else None
+        rc = self._l.mtpu_encode_part(
+            data, n,
+            self.k, self.m, self.bs, self._pmat, self._key,
+            self._paths, self._append, self._do_sync, 1 if final else 0,
+            self._threads, self._md5_h, ctypes.byref(self._md5_len),
+            self._md5_out, self._rc)
+        if rc != 0:
+            raise OSError(f"native encode_part failed (rc={rc})")
+        self._append = 1
+        self._final = final
+        self.total += len(buf)
+
+    def fail_drive(self, i: int) -> None:
+        """Pre-mark a drive failed (e.g. its staging dir could not be
+        created) — the C pipeline skips it and the failure is sticky."""
+        self._rc[i] = -1
+
+    @property
+    def md5_hex(self) -> str:
+        if not self._final:
+            raise ValueError("md5 before finalize")
+        return self._md5_out.raw.hex()
+
+    @property
+    def errors(self) -> list[bool]:
+        """Per-drive failure flags (True = drive lost)."""
+        return [self._rc[i] < 0 for i in range(self.n)]
+
+
+def decode_range(paths: list[str], k: int, m: int, block_size: int,
+                 part_size: int, offset: int, length: int,
+                 threads: int = 0,
+                 skip: set[int] | None = None) -> tuple[bytes | None,
+                                                        list[int]]:
+    """Serve [offset, offset+length) of a part from its shard files.
+
+    Returns (data, shard_state) — data is None when fewer than k shards
+    survived; shard_state[i] is 0 unused, 1 served, -1 read error,
+    -2 bitrot-corrupt (callers feed <0 states to the MRF healer, the
+    reference's one-shot heal trigger, cmd/erasure-object.go:321-344).
+    `skip` marks shards already known dead (a previous window's <0 states)
+    so later windows don't re-read and re-fail them."""
+    from minio_tpu.ops import gf
+    from minio_tpu.ops.bitrot import BITROT_KEY
+
+    lib = _lib()
+    if lib is None:
+        raise OSError("native plane unavailable")
+    n = k + m
+    gmat = bytes(gf.rs_generator_matrix(k, n).tobytes())
+    cpaths = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
+    avail = bytes([0 if skip and i in skip else 1 for i in range(n)])
+    state = (ctypes.c_int8 * n)()
+    out = ctypes.create_string_buffer(length) if length else b""
+    rc = lib.mtpu_decode_part(
+        cpaths, avail, k, m, block_size, part_size, gmat, BITROT_KEY,
+        offset, length, threads or _threads(),
+        ctypes.cast(out, ctypes.c_void_p) if length else None, state)
+    states = [state[i] for i in range(n)]
+    if rc == -2:
+        return None, states
+    if rc != length:
+        raise OSError(f"native decode_part failed (rc={rc})")
+    return (out.raw if length else b""), states

@@ -13,8 +13,16 @@
 #include <cstdlib>
 #include <cstring>
 
+#include <atomic>
+#include <thread>
+#include <vector>
+
 #include <fcntl.h>
 #include <unistd.h>
+
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
 
 extern "C" {
 
@@ -1274,5 +1282,552 @@ uint32_t mtpu_crc32c(const uint8_t* data, uint64_t len) {
 }
 
 #endif  // __SSE4_2__
+
+// ---------------------------------------------------------------------------
+// Serving data plane — the native PUT/GET hot pipelines.
+//
+// Role: the reference's erasure hot loop is native end to end — reedsolomon
+// AVX2 encode inside Erasure.Encode feeding per-drive goroutine writers
+// (cmd/erasure-encode.go:36-109) and parallelReader + ReconstructData on the
+// read side (cmd/erasure-decode.go:120-205), with the bitrot hash inline
+// (cmd/bitrot-streaming.go:46-158) and md5 ETag hashing in hash.Reader
+// (pkg/hash/reader.go:37). Here the same pipeline is one GIL-released call:
+// split blocks into shards, GF(2^8) parity via PSHUFB nibble tables, sip256
+// bitrot framing, md5, and the per-drive file fan-out — all in C++ threads.
+// The device (Pallas/XLA) codec remains the accelerator lane; this is the
+// host lane that keeps a local-attached TPU fed and the CPU backend honest.
+//
+// Field/geometry contracts match the Python codec bit-for-bit:
+// GF(2^8) poly 0x11D (ops/gf.py), chunk = ceil(block_len/k) with zero-pad,
+// shard file = [sip256 digest][chunk] records (ops/bitrot.py).
+// ---------------------------------------------------------------------------
+
+// --- md5 (RFC 1321) — ETag hashing, the md5-simd role ---
+
+static const uint32_t kMd5K[64] = {
+    0xd76aa478, 0xe8c7b756, 0x242070db, 0xc1bdceee, 0xf57c0faf, 0x4787c62a,
+    0xa8304613, 0xfd469501, 0x698098d8, 0x8b44f7af, 0xffff5bb1, 0x895cd7be,
+    0x6b901122, 0xfd987193, 0xa679438e, 0x49b40821, 0xf61e2562, 0xc040b340,
+    0x265e5a51, 0xe9b6c7aa, 0xd62f105d, 0x02441453, 0xd8a1e681, 0xe7d3fbc8,
+    0x21e1cde6, 0xc33707d6, 0xf4d50d87, 0x455a14ed, 0xa9e3e905, 0xfcefa3f8,
+    0x676f02d9, 0x8d2a4c8a, 0xfffa3942, 0x8771f681, 0x6d9d6122, 0xfde5380c,
+    0xa4beea44, 0x4bdecfa9, 0xf6bb4b60, 0xbebfbc70, 0x289b7ec6, 0xeaa127fa,
+    0xd4ef3085, 0x04881d05, 0xd9d4d039, 0xe6db99e5, 0x1fa27cf8, 0xc4ac5665,
+    0xf4292244, 0x432aff97, 0xab9423a7, 0xfc93a039, 0x655b59c3, 0x8f0ccc92,
+    0xffeff47d, 0x85845dd1, 0x6fa87e4f, 0xfe2ce6e0, 0xa3014314, 0x4e0811a1,
+    0xf7537e82, 0xbd3af235, 0x2ad7d2bb, 0xeb86d391};
+
+static const int kMd5R[64] = {
+    7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22,
+    5, 9,  14, 20, 5, 9,  14, 20, 5, 9,  14, 20, 5, 9,  14, 20,
+    4, 11, 16, 23, 4, 11, 16, 23, 4, 11, 16, 23, 4, 11, 16, 23,
+    6, 10, 15, 21, 6, 10, 15, 21, 6, 10, 15, 21, 6, 10, 15, 21};
+
+static void md5_block(uint32_t h[4], const uint8_t* p) {
+  uint32_t m[16];
+  std::memcpy(m, p, 64);
+  uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+  for (int i = 0; i < 64; ++i) {
+    uint32_t f, g;
+    if (i < 16) {
+      f = (b & c) | (~b & d);
+      g = i;
+    } else if (i < 32) {
+      f = (d & b) | (~d & c);
+      g = (5 * i + 1) & 15;
+    } else if (i < 48) {
+      f = b ^ c ^ d;
+      g = (3 * i + 5) & 15;
+    } else {
+      f = c ^ (b | ~d);
+      g = (7 * i) & 15;
+    }
+    uint32_t tmp = d;
+    d = c;
+    c = b;
+    uint32_t x = a + f + kMd5K[i] + m[g];
+    b = b + ((x << kMd5R[i]) | (x >> (32 - kMd5R[i])));
+    a = tmp;
+  }
+  h[0] += a;
+  h[1] += b;
+  h[2] += c;
+  h[3] += d;
+}
+
+// Segment-chained md5: non-final segments must be 64-byte multiples (the
+// Python driver feeds block_size multiples); the final segment may have an
+// arbitrary tail, which is padded and finalized here.
+static void md5_segment(uint32_t h[4], uint64_t* total_len,
+                        const uint8_t* data, uint64_t len, int finalize,
+                        uint8_t* out16) {
+  uint64_t nb = len / 64;
+  for (uint64_t i = 0; i < nb; ++i) md5_block(h, data + 64 * i);
+  if (finalize) {
+    uint64_t tail = len - nb * 64;
+    uint64_t total = *total_len + len;
+    uint8_t pad[128];
+    std::memset(pad, 0, sizeof(pad));
+    if (tail) std::memcpy(pad, data + nb * 64, tail);
+    pad[tail] = 0x80;
+    size_t padlen = (tail < 56) ? 64 : 128;
+    uint64_t bits = total * 8;
+    std::memcpy(pad + padlen - 8, &bits, 8);
+    md5_block(h, pad);
+    if (padlen == 128) md5_block(h, pad + 64);
+    std::memcpy(out16, h, 16);  // little-endian words = md5 byte order
+  }
+  *total_len += len;
+}
+
+// --- GF(2^8) tables + PSHUFB region multiply (the reedsolomon-asm role) ---
+
+// Field 0x11D, generator 2 — identical to ops/gf.py so host- and
+// device-encoded shard files are interchangeable.
+static uint8_t gf_exp2_[512];
+static int16_t gf_log2_[256];
+static uint8_t gf_mul_tab_[256][256];
+static uint8_t gf_nib_lo_[256][16];
+static uint8_t gf_nib_hi_[256][16];
+
+static struct GfInit {
+  GfInit() {
+    int x = 1;
+    for (int i = 0; i < 255; ++i) {
+      gf_exp2_[i] = static_cast<uint8_t>(x);
+      gf_log2_[x] = static_cast<int16_t>(i);
+      x <<= 1;
+      if (x & 0x100) x ^= 0x11D;
+    }
+    for (int i = 255; i < 510; ++i) gf_exp2_[i] = gf_exp2_[i - 255];
+    gf_log2_[0] = 0;
+    for (int a = 0; a < 256; ++a)
+      for (int b = 0; b < 256; ++b)
+        gf_mul_tab_[a][b] =
+            (a && b) ? gf_exp2_[gf_log2_[a] + gf_log2_[b]] : 0;
+    for (int c = 0; c < 256; ++c)
+      for (int v = 0; v < 16; ++v) {
+        gf_nib_lo_[c][v] = gf_mul_tab_[c][v];
+        gf_nib_hi_[c][v] = gf_mul_tab_[c][v << 4];
+      }
+  }
+} gf_initializer_;
+
+static inline uint8_t gf1_mul(uint8_t a, uint8_t b) {
+  return gf_mul_tab_[a][b];
+}
+
+static inline uint8_t gf1_inv(uint8_t a) {
+  return gf_exp2_[255 - gf_log2_[a]];  // a != 0
+}
+
+// dst[0..n) ^= c * src[0..n) over GF(2^8). Split-nibble PSHUFB on AVX2
+// (what klauspost/reedsolomon's assembly does), table fallback otherwise.
+static void gf_mul_xor_region(uint8_t* dst, const uint8_t* src, uint8_t c,
+                              size_t n) {
+  if (c == 0) return;
+  size_t i = 0;
+  if (c == 1) {
+#if defined(__AVX2__)
+    for (; i + 32 <= n; i += 32) {
+      __m256i d = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(dst + i));
+      __m256i s = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(src + i));
+      _mm256_storeu_si256(reinterpret_cast<__m256i*>(dst + i),
+                          _mm256_xor_si256(d, s));
+    }
+#endif
+    for (; i < n; ++i) dst[i] ^= src[i];
+    return;
+  }
+#if defined(__AVX2__)
+  const __m256i vlo = _mm256_broadcastsi128_si256(
+      _mm_loadu_si128(reinterpret_cast<const __m128i*>(gf_nib_lo_[c])));
+  const __m256i vhi = _mm256_broadcastsi128_si256(
+      _mm_loadu_si128(reinterpret_cast<const __m128i*>(gf_nib_hi_[c])));
+  const __m256i mask = _mm256_set1_epi8(0x0f);
+  for (; i + 32 <= n; i += 32) {
+    __m256i s = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(src + i));
+    __m256i lo = _mm256_and_si256(s, mask);
+    __m256i hi = _mm256_and_si256(_mm256_srli_epi16(s, 4), mask);
+    __m256i r = _mm256_xor_si256(_mm256_shuffle_epi8(vlo, lo),
+                                 _mm256_shuffle_epi8(vhi, hi));
+    __m256i d = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(dst + i));
+    _mm256_storeu_si256(reinterpret_cast<__m256i*>(dst + i),
+                        _mm256_xor_si256(d, r));
+  }
+#endif
+  const uint8_t* t = gf_mul_tab_[c];
+  for (; i < n; ++i) dst[i] ^= t[src[i]];
+}
+
+// Gauss-Jordan inverse over GF(2^8); in/out row-major k x k.
+// Returns 0, or -1 when singular (more shards lost than parity covers).
+static int gf_invert_matrix(const uint8_t* in, uint8_t* out, int k) {
+  std::vector<uint8_t> aug(static_cast<size_t>(k) * 2 * k, 0);
+  for (int r = 0; r < k; ++r) {
+    std::memcpy(&aug[static_cast<size_t>(r) * 2 * k], in + r * k, k);
+    aug[static_cast<size_t>(r) * 2 * k + k + r] = 1;
+  }
+  for (int col = 0; col < k; ++col) {
+    int pivot = -1;
+    for (int r = col; r < k; ++r)
+      if (aug[static_cast<size_t>(r) * 2 * k + col]) {
+        pivot = r;
+        break;
+      }
+    if (pivot < 0) return -1;
+    if (pivot != col)
+      for (int j = 0; j < 2 * k; ++j)
+        std::swap(aug[static_cast<size_t>(col) * 2 * k + j],
+                  aug[static_cast<size_t>(pivot) * 2 * k + j]);
+    uint8_t inv_p = gf1_inv(aug[static_cast<size_t>(col) * 2 * k + col]);
+    for (int j = 0; j < 2 * k; ++j)
+      aug[static_cast<size_t>(col) * 2 * k + j] =
+          gf1_mul(aug[static_cast<size_t>(col) * 2 * k + j], inv_p);
+    for (int r = 0; r < k; ++r) {
+      if (r == col) continue;
+      uint8_t f = aug[static_cast<size_t>(r) * 2 * k + col];
+      if (!f) continue;
+      for (int j = 0; j < 2 * k; ++j)
+        aug[static_cast<size_t>(r) * 2 * k + j] ^=
+            gf1_mul(f, aug[static_cast<size_t>(col) * 2 * k + j]);
+    }
+  }
+  for (int r = 0; r < k; ++r)
+    std::memcpy(out + r * k, &aug[static_cast<size_t>(r) * 2 * k + k], k);
+  return 0;
+}
+
+static const int kDigestLen = 32;  // sip256
+
+// --- native PUT pipeline ---
+//
+// One call encodes a segment of a part: splits `data` into block_size
+// erasure blocks, computes chunk = ceil(block_len/k) shard chunks (zero
+// padded), m parity chunks via the GF region kernel, sip256-frames every
+// chunk, chains the part md5, and writes/appends each drive's shard file —
+// encode workers striped over blocks, one writer thread per drive, no GIL.
+//
+// Contract (enforced): non-final segments are block_size multiples and
+// block_size is a 64 multiple (md5 chaining). drive_rc is sticky in/out:
+// drives already failed (<0) are skipped; a failed open/write/sync marks -1.
+// Returns 0, or -1 on parameter violations.
+int64_t mtpu_encode_part(const uint8_t* data, uint64_t len, uint32_t k,
+                         uint32_t m, uint64_t block_size,
+                         const uint8_t* pmat, const uint8_t* key32,
+                         const char* const* paths, int append, int do_sync,
+                         int finalize, int n_threads, uint32_t* md5_h,
+                         uint64_t* md5_len, uint8_t* out_md5,
+                         int8_t* drive_rc) {
+  if (!k || block_size == 0 || block_size % 64 != 0) return -1;
+  if (!finalize && len % block_size != 0) return -1;
+  const uint32_t n = k + m;
+  const uint64_t S = (block_size + k - 1) / k;
+  const uint64_t rec_full = kDigestLen + S;
+  const uint64_t nblocks = (len + block_size - 1) / block_size;
+  const uint64_t last_len = nblocks ? len - (nblocks - 1) * block_size : 0;
+  const uint64_t last_cl = nblocks ? (last_len + k - 1) / k : 0;
+  const uint64_t file_bytes =
+      nblocks ? (nblocks - 1) * rec_full + kDigestLen + last_cl : 0;
+
+  // md5 runs in its own thread over the whole segment — overlapped with the
+  // encode workers on multi-core hosts, timesliced on single-core ones.
+  std::thread md5_thr([&] {
+    md5_segment(md5_h, md5_len, data, len, finalize, out_md5);
+  });
+
+  // Raw malloc staging (vector::resize would zero-fill ~1.4x the input —
+  // a pure waste, every byte is overwritten by the encode workers).
+  std::vector<uint8_t*> bufs(n, nullptr);
+  struct BufGuard {
+    std::vector<uint8_t*>& b;
+    ~BufGuard() {
+      for (auto* p : b) free(p);
+    }
+  } guard{bufs};
+  if (nblocks) {
+    for (uint32_t i = 0; i < n; ++i)
+      if (drive_rc[i] >= 0) {
+        bufs[i] = static_cast<uint8_t*>(malloc(file_bytes));
+        if (!bufs[i]) {
+          md5_thr.join();
+          return -1;
+        }
+      }
+
+    unsigned hw = std::thread::hardware_concurrency();
+    unsigned T = n_threads > 0 ? static_cast<unsigned>(n_threads)
+                               : (hw ? hw : 1);
+    if (T > nblocks) T = static_cast<unsigned>(nblocks);
+
+    auto worker = [&](unsigned tid) {
+      // Per-chunk scratch slots: a short block can have SEVERAL chunks past
+      // its end (tiny blocks), so each zero-padded chunk needs its own
+      // staging — they are all read again by the parity accumulation.
+      std::vector<uint8_t> scratch(static_cast<size_t>(k) * S);
+      std::vector<const uint8_t*> chunks(k);
+      for (uint64_t b = tid; b < nblocks; b += T) {
+        const uint8_t* block = data + b * block_size;
+        const uint64_t blen = (b == nblocks - 1) ? last_len : block_size;
+        const uint64_t cl = (blen + k - 1) / k;
+        const uint64_t off = b * rec_full;
+        for (uint32_t i = 0; i < k; ++i) {
+          const uint64_t lo = static_cast<uint64_t>(i) * cl;
+          const uint8_t* src;
+          if (lo + cl <= blen) {
+            src = block + lo;
+          } else {
+            uint8_t* sc = scratch.data() + static_cast<size_t>(i) * S;
+            std::memset(sc, 0, cl);
+            if (blen > lo) std::memcpy(sc, block + lo, blen - lo);
+            src = sc;
+          }
+          chunks[i] = src;
+          if (drive_rc[i] >= 0) {
+            uint8_t* dst = bufs[i] + off;
+            mtpu_sip256(key32, src, cl, dst);
+            std::memcpy(dst + kDigestLen, src, cl);
+          }
+        }
+        for (uint32_t j = 0; j < m; ++j) {
+          if (drive_rc[k + j] < 0) continue;
+          uint8_t* p = bufs[k + j] + off + kDigestLen;
+          std::memset(p, 0, cl);
+          for (uint32_t i = 0; i < k; ++i)
+            gf_mul_xor_region(p, chunks[i], pmat[j * k + i], cl);
+          mtpu_sip256(key32, p, cl, p - kDigestLen);
+        }
+      }
+    };
+    std::vector<std::thread> ths;
+    for (unsigned t = 1; t < T; ++t) ths.emplace_back(worker, t);
+    worker(0);
+    for (auto& t : ths) t.join();
+  }
+
+  // Per-drive writer threads (the parallelWriter goroutine fan-out).
+  auto write_drive = [&](uint32_t i) {
+    if (drive_rc[i] < 0) return;
+    if (nblocks == 0 && append) {
+      // Zero-byte finalize (stream length was an exact segment multiple):
+      // no data to write, but the durability barrier still belongs to the
+      // finalize call — earlier segments skipped their fdatasync.
+      if (do_sync && finalize) {
+        int fd = open(paths[i], O_WRONLY);
+        if (fd < 0) {
+          drive_rc[i] = -1;
+          return;
+        }
+#ifdef __linux__
+        if (fdatasync(fd) != 0) drive_rc[i] = -1;
+#else
+        if (fsync(fd) != 0) drive_rc[i] = -1;
+#endif
+        if (close(fd) != 0) drive_rc[i] = -1;
+      }
+      return;
+    }
+    int flags = O_WRONLY | O_CREAT | (append ? O_APPEND : O_TRUNC);
+    int fd = open(paths[i], flags, 0644);
+    if (fd < 0) {
+      drive_rc[i] = -1;
+      return;
+    }
+    const uint8_t* p = bufs[i];
+    uint64_t left = nblocks ? file_bytes : 0;
+    while (left) {
+      ssize_t w = write(fd, p, left);
+      if (w <= 0) {
+        drive_rc[i] = -1;
+        close(fd);
+        return;
+      }
+      p += w;
+      left -= static_cast<uint64_t>(w);
+    }
+#ifdef __linux__
+    if (do_sync && finalize && fdatasync(fd) != 0) drive_rc[i] = -1;
+#else
+    if (do_sync && finalize && fsync(fd) != 0) drive_rc[i] = -1;
+#endif
+    if (close(fd) != 0) drive_rc[i] = -1;
+  };
+  std::vector<std::thread> wts;
+  for (uint32_t i = 1; i < n; ++i) wts.emplace_back(write_drive, i);
+  write_drive(0);
+  for (auto& t : wts) t.join();
+  md5_thr.join();
+  return 0;
+}
+
+// --- native GET pipeline ---
+//
+// Serves [offset, offset+length) of one part from its n shard files:
+// chooses k live shards data-first (the staggered any-k strategy), preads
+// each shard's record range in one call, verifies every sip256 record,
+// reconstructs missing data chunks via the inverted generator submatrix,
+// and assembles the byte range into `out`. A shard that fails mid-attempt
+// is marked dead (shard_state: -1 read error, -2 corrupt) and the attempt
+// restarts with replacement shards — retries are rare-path, so re-reading
+// beats partial bookkeeping. gmat is the systematic [n, k] generator
+// (ops/gf.rs_generator_matrix). Returns bytes written, -2 when fewer than
+// k shards survive, -1 on parameter violations.
+int64_t mtpu_decode_part(const char* const* paths, const uint8_t* avail,
+                         uint32_t k, uint32_t m, uint64_t block_size,
+                         uint64_t part_size, const uint8_t* gmat,
+                         const uint8_t* key32, uint64_t offset,
+                         uint64_t length, int n_threads, uint8_t* out,
+                         int8_t* shard_state) {
+  if (!k || !block_size || offset + length > part_size) return -1;
+  const uint32_t n = k + m;
+  if (length == 0) return 0;
+  const uint64_t S = (block_size + k - 1) / k;
+  const uint64_t rec_full = kDigestLen + S;
+  const uint64_t nblocks_part = (part_size + block_size - 1) / block_size;
+  const uint64_t part_last_len = part_size - (nblocks_part - 1) * block_size;
+  const uint64_t first = offset / block_size;
+  const uint64_t last = (offset + length - 1) / block_size;
+  const uint64_t wblocks = last - first + 1;
+
+  // vector<char>, not vector<bool>: concurrent reader threads mark
+  // distinct indices, and vector<bool>'s bit packing would make that a
+  // racy read-modify-write of shared bytes.
+  std::vector<char> dead(n);
+  for (uint32_t i = 0; i < n; ++i) dead[i] = !avail[i];
+
+  auto block_len = [&](uint64_t b) {
+    return b == nblocks_part - 1 ? part_last_len : block_size;
+  };
+  auto chunk_len = [&](uint64_t b) {
+    return (block_len(b) + k - 1) / k;
+  };
+  const uint64_t read_off = first * rec_full;
+  const uint64_t read_len =
+      (wblocks - 1) * rec_full + kDigestLen + chunk_len(last);
+
+  unsigned hw = std::thread::hardware_concurrency();
+  unsigned T =
+      n_threads > 0 ? static_cast<unsigned>(n_threads) : (hw ? hw : 1);
+
+  for (;;) {
+    // Data-first shard selection (cmd/erasure-decode.go:63-88 role).
+    std::vector<uint32_t> chosen;
+    for (uint32_t i = 0; i < n && chosen.size() < k; ++i)
+      if (!dead[i]) chosen.push_back(i);
+    if (chosen.size() < k) return -2;
+
+    std::vector<std::vector<uint8_t>> sbuf(k);
+    std::atomic<bool> failed{false};
+    auto read_verify = [&](uint32_t ci) {
+      uint32_t i = chosen[ci];
+      sbuf[ci].resize(read_len);
+      int fd = open(paths[i], O_RDONLY);
+      if (fd < 0) {
+        shard_state[i] = -1;
+        dead[i] = true;
+        failed.store(true);
+        return;
+      }
+      uint64_t got = 0;
+      while (got < read_len) {
+        ssize_t r = pread(fd, sbuf[ci].data() + got, read_len - got,
+                          read_off + got);
+        if (r <= 0) break;
+        got += static_cast<uint64_t>(r);
+      }
+      close(fd);
+      if (got != read_len) {
+        shard_state[i] = -1;
+        dead[i] = true;
+        failed.store(true);
+        return;
+      }
+      uint8_t dig[kDigestLen];
+      for (uint64_t b = first; b <= last; ++b) {
+        const uint8_t* rec = sbuf[ci].data() + (b - first) * rec_full;
+        const uint64_t cl = chunk_len(b);
+        mtpu_sip256(key32, rec + kDigestLen, cl, dig);
+        if (std::memcmp(dig, rec, kDigestLen) != 0) {
+          shard_state[i] = -2;
+          dead[i] = true;
+          failed.store(true);
+          return;
+        }
+      }
+      shard_state[i] = 1;
+    };
+    {
+      std::vector<std::thread> ths;
+      unsigned rt = T < k ? T : k;
+      std::atomic<uint32_t> next{0};
+      auto pump = [&] {
+        for (;;) {
+          uint32_t ci = next.fetch_add(1);
+          if (ci >= k) return;
+          read_verify(ci);
+        }
+      };
+      for (unsigned t = 1; t < rt; ++t) ths.emplace_back(pump);
+      pump();
+      for (auto& t : ths) t.join();
+    }
+    if (failed.load()) continue;  // replacement shards, fresh attempt
+
+    // Decode weights for missing data shards (identity top rows of gmat
+    // make present data shards pass-through).
+    std::vector<int> pos_of(n, -1);  // shard index -> chosen slot
+    for (uint32_t ci = 0; ci < k; ++ci) pos_of[chosen[ci]] = ci;
+    std::vector<uint8_t> inv;
+    bool need_inv = false;
+    for (uint32_t i = 0; i < k; ++i)
+      if (pos_of[i] < 0) need_inv = true;
+    if (need_inv) {
+      std::vector<uint8_t> sub(static_cast<size_t>(k) * k);
+      for (uint32_t r = 0; r < k; ++r)
+        std::memcpy(&sub[static_cast<size_t>(r) * k], gmat + chosen[r] * k,
+                    k);
+      inv.resize(static_cast<size_t>(k) * k);
+      if (gf_invert_matrix(sub.data(), inv.data(), k) != 0) return -2;
+    }
+
+    // Assemble, striped over blocks.
+    unsigned at = T < wblocks ? T : static_cast<unsigned>(wblocks);
+    auto assemble = [&](unsigned tid) {
+      std::vector<uint8_t> rebuilt(S);
+      for (uint64_t b = first + tid; b <= last; b += at) {
+        const uint64_t blen = block_len(b);
+        const uint64_t cl = chunk_len(b);
+        const uint64_t roff = (b - first) * rec_full + kDigestLen;
+        for (uint32_t i = 0; i < k; ++i) {
+          // Chunk i covers block bytes [i*cl, min((i+1)*cl, blen)).
+          const uint64_t clo = static_cast<uint64_t>(i) * cl;
+          if (clo >= blen) break;
+          const uint64_t chi = (clo + cl < blen) ? clo + cl : blen;
+          const uint64_t glo = b * block_size + clo;
+          const uint64_t ghi = b * block_size + chi;
+          const uint64_t ilo = glo > offset ? glo : offset;
+          const uint64_t ihi = ghi < offset + length ? ghi : offset + length;
+          if (ihi <= ilo) continue;
+          const uint8_t* src;
+          if (pos_of[i] >= 0) {
+            src = sbuf[pos_of[i]].data() + roff;
+          } else {
+            std::memset(rebuilt.data(), 0, cl);
+            for (uint32_t r = 0; r < k; ++r)
+              gf_mul_xor_region(rebuilt.data(), sbuf[r].data() + roff,
+                                inv[static_cast<size_t>(i) * k + r], cl);
+            src = rebuilt.data();
+          }
+          std::memcpy(out + (ilo - offset), src + (ilo - glo), ihi - ilo);
+        }
+      }
+    };
+    std::vector<std::thread> ths;
+    for (unsigned t = 1; t < at; ++t) ths.emplace_back(assemble, t);
+    assemble(0);
+    for (auto& t : ths) t.join();
+    return static_cast<int64_t>(length);
+  }
+}
 
 }  // extern "C"
