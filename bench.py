@@ -393,8 +393,26 @@ def bench_xlmeta_codec() -> dict:
         XLMeta.parse(blob)
     dt = time.perf_counter() - t0
     ops = 2 * iters / dt
+    # Real request-path ops (no serialize-cache benefit): a GET's metadata
+    # read (parse + decode ONE version) and a PUT's full journal write
+    # (parse + add_version + serialize of the mutated journal).
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        XLMeta.parse(raw).to_fileinfo("bench", "obj")
+    read_ops = iters / (time.perf_counter() - t0)
+    nfi = FileInfo.new("bench", "obj", version_id="f" * 32)
+    nfi.size = 1
+    nfi.mod_time = 1.8e9
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        m = XLMeta.parse(raw)
+        m.add_version(nfi)
+        m.serialize()
+    write_ops = iters / (time.perf_counter() - t0)
     return {"metric": "xlmeta_codec_32versions", "value": round(ops, 0),
             "unit": "ops/s", "vs_baseline": 0.0,
+            "read_version_ops": round(read_ops, 0),
+            "write_journal_ops": round(write_ops, 0),
             "doc_bytes": len(raw)}
 
 

@@ -79,11 +79,12 @@ def merge_journal_maps(maps: list[dict[str, XLMeta]]) -> dict[str, XLMeta]:
 
 
 def journal_newer(a: XLMeta, b: XLMeta) -> bool:
-    amt = a.versions[0].get("mt", 0.0) if a.versions else 0.0
-    bmt = b.versions[0].get("mt", 0.0) if b.versions else 0.0
+    # Envelope accessors: the quorum comparator runs once per (object,
+    # drive) during every listing merge and must not materialize bodies.
+    amt, bmt = a.latest_mt, b.latest_mt
     if amt != bmt:
         return amt > bmt
-    return len(a.versions) > len(b.versions)
+    return a.version_count > b.version_count
 
 
 def paginate_objects(

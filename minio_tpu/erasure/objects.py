@@ -657,7 +657,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             def windows():
                 pos = offset
                 while pos < end:
-                    wend = min(end, (pos // bs + plane.WINDOW_BLOCKS) * bs)
+                    wend = min(end,
+                               (pos // bs + plane.window_blocks(bs)) * bs)
                     yield pos, wend
                     pos = wend
 
@@ -1073,7 +1074,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 # accounting, exactly like a failed writer thread in the
                 # Python lane — never aborts the whole PUT.
                 enc.fail_drive(i)
-        seg = plane.SEG_BLOCKS * codec.block_size
+        seg = plane.seg_blocks(codec.block_size) * codec.block_size
         total = 0
         buf = bytearray(initial)
         # One-segment pipeline: the GIL-released C call for segment N runs

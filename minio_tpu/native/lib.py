@@ -18,6 +18,8 @@ _mu = threading.Lock()
 
 def _build_and_load():
     global _lib, _tried
+    if _lib is not None:  # lock-free fast path: set once, never unset —
+        return _lib       # hot callers (crc32c, sip256) hit this per call
     with _mu:
         if _tried:
             return _lib
@@ -61,6 +63,9 @@ def _build_and_load():
         lib.mtpu_snappy_uncompress.restype = ctypes.c_int64
         lib.mtpu_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
         lib.mtpu_crc32c.restype = ctypes.c_uint32
+        lib.mtpu_crc32c_off.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                        ctypes.c_uint64]
+        lib.mtpu_crc32c_off.restype = ctypes.c_uint32
         lib.mtpu_argon2id.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
             ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
@@ -455,11 +460,17 @@ _CRC32C_POLY = 0x82F63B78
 _crc32c_table_py: list[int] = []
 
 
-def crc32c(data: bytes) -> int:
+def crc32c(data: bytes, offset: int = 0) -> int:
+    """CRC32C of data[offset:]. The offset form avoids slicing a copy of
+    a large buffer just to checksum its tail (xl.meta parse hot path)."""
     global _crc32c_table_py
     lib = _build_and_load()
     if lib is not None:
+        if offset:
+            return lib.mtpu_crc32c_off(data, offset, len(data) - offset)
         return lib.mtpu_crc32c(data, len(data))
+    if offset:
+        data = data[offset:]
     if not _crc32c_table_py:
         # Build into a local then swap: concurrent first callers must never
         # observe (or interleave appends into) a half-built shared table.

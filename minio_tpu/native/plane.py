@@ -20,13 +20,22 @@ import os
 
 from minio_tpu.native import lib as nlib
 
-# Segment / window sizing: multiples of the 1 MiB default block keep md5
-# chaining legal (64-byte alignment) and bound the per-call buffers. A PUT
-# segment stages ~seg x (1 + (k+m)/k) bytes of transient heap, a GET
-# window ~2x the window — sized so ten concurrent part streams stay under
-# ~1.5 GiB total, the role of the Python lane's bounded queues.
-SEG_BLOCKS = 64      # PUT: encode segment (64 MiB at 1 MiB blocks)
-WINDOW_BLOCKS = 64   # GET: decode window (64 MiB at 1 MiB blocks)
+# Segment / window sizing: BYTE budgets, realized as whole-block counts
+# per set geometry (multiples of block_size keep md5 chaining legal at
+# any 64-multiple block size). A PUT segment stages ~seg x (1 + n/k)
+# bytes of transient heap, a GET window ~2x the window — bounded so ten
+# concurrent part streams stay under ~1.5 GiB total regardless of the
+# set's configured block_size (the Python lane's bounded-queue role).
+SEG_BYTES = 64 << 20     # PUT: encode segment budget
+WINDOW_BYTES = 64 << 20  # GET: decode window budget
+
+
+def seg_blocks(block_size: int) -> int:
+    return max(1, SEG_BYTES // block_size)
+
+
+def window_blocks(block_size: int) -> int:
+    return max(1, WINDOW_BYTES // block_size)
 
 _MD5_INIT = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476)
 

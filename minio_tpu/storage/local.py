@@ -337,8 +337,10 @@ class LocalDrive(StorageAPI):
             meta = XLMeta()
         # Replacing a version (e.g. erasure object overwritten by an inline
         # one): reclaim the old data dir or its shards leak unreferenced.
+        # Exact-vid lookup: a null-version write must reclaim only the null
+        # version's dir, never "latest" (which may be a live version).
         try:
-            old = meta.to_fileinfo(volume, path, fi.version_id)
+            old = meta.exact_version(volume, path, fi.version_id)
             if old.data_dir and old.data_dir != fi.data_dir and not old.deleted:
                 shutil.rmtree(
                     os.path.join(self._file_path(volume, path), old.data_dir),
@@ -427,9 +429,10 @@ class LocalDrive(StorageAPI):
             meta = self._load_meta(dst_volume, dst_path)
         except se.FileNotFound:
             meta = XLMeta()
-        # Replacing a null version: reclaim its data dir.
+        # Replacing a null version: reclaim its data dir (exact-vid — see
+        # write_metadata).
         try:
-            old = meta.to_fileinfo(dst_volume, dst_path, fi.version_id)
+            old = meta.exact_version(dst_volume, dst_path, fi.version_id)
             if old.data_dir and old.data_dir != fi.data_dir and not old.deleted:
                 shutil.rmtree(os.path.join(obj_dir, old.data_dir), ignore_errors=True)
         except se.StorageError:

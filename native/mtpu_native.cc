@@ -1283,6 +1283,13 @@ uint32_t mtpu_crc32c(const uint8_t* data, uint64_t len) {
 
 #endif  // __SSE4_2__
 
+// Offset form: checksum data[offset, offset+len) without the caller
+// slicing a copy (the xl.meta parse hot path checksums a 10+ KB tail).
+uint32_t mtpu_crc32c_off(const uint8_t* data, uint64_t offset,
+                         uint64_t len) {
+  return mtpu_crc32c(data + offset, len);
+}
+
 // ---------------------------------------------------------------------------
 // Serving data plane — the native PUT/GET hot pipelines.
 //
@@ -1618,11 +1625,17 @@ int64_t mtpu_encode_part(const uint8_t* data, uint64_t len, uint32_t k,
           drive_rc[i] = -1;
           return;
         }
+        int rc;
 #ifdef __linux__
-        if (fdatasync(fd) != 0) drive_rc[i] = -1;
+        do {
+          rc = fdatasync(fd);
+        } while (rc != 0 && errno == EINTR);
 #else
-        if (fsync(fd) != 0) drive_rc[i] = -1;
+        do {
+          rc = fsync(fd);
+        } while (rc != 0 && errno == EINTR);
 #endif
+        if (rc != 0) drive_rc[i] = -1;
         if (close(fd) != 0) drive_rc[i] = -1;
       }
       return;
@@ -1637,7 +1650,8 @@ int64_t mtpu_encode_part(const uint8_t* data, uint64_t len, uint32_t k,
     uint64_t left = nblocks ? file_bytes : 0;
     while (left) {
       ssize_t w = write(fd, p, left);
-      if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;  // signal mid-write: retry,
+      if (w <= 0) {                           // not a dead drive
         drive_rc[i] = -1;
         close(fd);
         return;
@@ -1645,11 +1659,19 @@ int64_t mtpu_encode_part(const uint8_t* data, uint64_t len, uint32_t k,
       p += w;
       left -= static_cast<uint64_t>(w);
     }
+    if (do_sync && finalize) {
+      int rc;
 #ifdef __linux__
-    if (do_sync && finalize && fdatasync(fd) != 0) drive_rc[i] = -1;
+      do {
+        rc = fdatasync(fd);
+      } while (rc != 0 && errno == EINTR);
 #else
-    if (do_sync && finalize && fsync(fd) != 0) drive_rc[i] = -1;
+      do {
+        rc = fsync(fd);
+      } while (rc != 0 && errno == EINTR);
 #endif
+      if (rc != 0) drive_rc[i] = -1;
+    }
     if (close(fd) != 0) drive_rc[i] = -1;
   };
   std::vector<std::thread> wts;
@@ -1732,7 +1754,8 @@ int64_t mtpu_decode_part(const char* const* paths, const uint8_t* avail,
       while (got < read_len) {
         ssize_t r = pread(fd, sbuf[ci].data() + got, read_len - got,
                           read_off + got);
-        if (r <= 0) break;
+        if (r < 0 && errno == EINTR) continue;  // signal: retry the read
+        if (r <= 0) break;  // r == 0 is EOF: a truly short shard file
         got += static_cast<uint64_t>(r);
       }
       close(fd);

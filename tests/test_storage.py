@@ -204,3 +204,100 @@ def test_verify_file_detects_shard_corruption(drive):
         f.seek(100); b = f.read(1); f.seek(100); f.write(bytes([b[0] ^ 1]))
     with pytest.raises(se.FileCorrupt):
         drive.verify_file("bkt", "key", fi)
+
+
+def test_xlmeta_v1_read_compat():
+    """Journals written in the v1 inline-dict format still parse (read
+    compatibility across the envelope format change)."""
+    import msgpack as _mp
+
+    v1_doc = {"v": 1, "versions": [
+        {"t": 1, "vid": "aaaa", "mt": 2.0, "dd": "dd1", "sz": 7,
+         "meta": {"etag": "x"}, "parts": [],
+         "ec": {"algo": "", "k": 2, "m": 1, "bs": 65536, "idx": 1,
+                "dist": [1, 2, 3], "cks": []}},
+        {"t": 2, "vid": "bbbb", "mt": 1.0},
+    ]}
+    raw = b"MTP1" + _mp.packb(v1_doc)
+    meta = XLMeta.parse(raw)
+    assert meta.version_count == 2 and meta.latest_mt == 2.0
+    fi = meta.to_fileinfo("v", "obj")
+    assert fi.size == 7 and fi.is_latest and fi.erasure.data_blocks == 2
+    dm = meta.to_fileinfo("v", "obj", "bbbb")
+    assert dm.deleted
+    # round-trips into the current format
+    meta2 = XLMeta.parse(meta.serialize())
+    assert meta2.to_fileinfo("v", "obj").size == 7
+
+
+def test_xlmeta_envelope_fast_paths():
+    """An unmutated parse answers latest/by-vid/data-dirs/serialize off the
+    raw envelope; materialization still agrees with it."""
+    meta = XLMeta()
+    for i in range(5):
+        fi = _mk_fi(vid=f"{i:04x}-v", size=100 + i)
+        fi.mod_time = 100.0 + i
+        fi.data_dir = f"dir{i}"
+        meta.add_version(fi)
+    raw = meta.serialize()
+    p = XLMeta.parse(raw)
+    # fast paths, before any .versions access
+    assert p.version_count == 5 and p.latest_mt == 104.0
+    assert p.latest_data_dirs == {f"dir{i}" for i in range(5)}
+    assert p.to_fileinfo("v", "obj").size == 104
+    assert p.to_fileinfo("v", "obj", "0002-v").size == 102
+    with pytest.raises(se.FileVersionNotFound):
+        p.to_fileinfo("v", "obj", "nope")
+    assert p.serialize() == raw
+    # materialized path agrees
+    assert [v.vid for v in p.versions] == [f"{4-i:04x}-v" for i in range(5)]
+    assert p.to_fileinfo("v", "obj").size == 104
+    assert XLMeta.parse(p.serialize()).to_fileinfo("v", "obj").size == 104
+
+
+def test_null_version_write_never_reclaims_latest_versioned_dir(tmp_path):
+    """A null-version (versioning-suspended) write must not rmtree the
+    latest VERSIONED entry's data dir (exact-vid reclaim semantics)."""
+    d = LocalDrive(str(tmp_path / "d0"))
+    d.make_vol("v")
+    fi_a = _mk_fi(vid="aaaa-1111")
+    fi_a.data_dir = "dda"
+    fi_a.mod_time = 10.0
+    d.write_metadata("v", "obj", fi_a)
+    dda = tmp_path / "d0" / "v" / "obj" / "dda"
+    dda.mkdir(parents=True)
+    (dda / "part.1").write_bytes(b"shard-a")
+    # Null-version write with its own data dir.
+    fi_null = _mk_fi(vid="")
+    fi_null.data_dir = "ddn"
+    fi_null.mod_time = 20.0
+    d.write_metadata("v", "obj", fi_null)
+    assert (dda / "part.1").read_bytes() == b"shard-a"  # survived
+    # Replacing the null version again DOES reclaim the old null dir.
+    ddn = tmp_path / "d0" / "v" / "obj" / "ddn"
+    ddn.mkdir(parents=True)
+    (ddn / "part.1").write_bytes(b"shard-n")
+    fi_null2 = _mk_fi(vid="")
+    fi_null2.data_dir = "ddn2"
+    fi_null2.mod_time = 30.0
+    d.write_metadata("v", "obj", fi_null2)
+    assert not ddn.exists()
+    assert (dda / "part.1").read_bytes() == b"shard-a"
+
+
+def test_xlmeta_body_bitflip_fails_parse():
+    """A bit-flipped version BODY (envelope intact) must fail parse() on
+    that drive so quorum merges skip the corrupt copy instead of lazily
+    tripping over it mid-listing."""
+    meta = XLMeta()
+    meta.add_version(_mk_fi(vid="aaaa-1111"))
+    raw = bytearray(meta.serialize())
+    # Flip a byte near the end (inside the packed body blob).
+    raw[-20] ^= 0xFF
+    with pytest.raises(se.CorruptedFormat):
+        XLMeta.parse(bytes(raw))
+    # Truncated/malformed rows also fail parse, not later with IndexError.
+    import msgpack as _mp
+    bad = b"MTP2" + _mp.packb({"v": 2, "versions": [[1.0, "x", 1, "d"]]})
+    with pytest.raises(se.CorruptedFormat):
+        XLMeta.parse(bad)
