@@ -158,12 +158,14 @@ class AutoHealer:
             except se.StorageError:
                 pass
             start_after = tracker.obj if tracker.bucket == bucket else ""
-            for name in sorted(es.merged_journals(bucket, "")):
+            # Streamed walk: the heal pass holds O(drives) journal state,
+            # not the whole namespace, and the tracker bookmark skips
+            # already-healed names WITHOUT parsing their journals.
+            for name, _meta in es.stream_journals(bucket, "",
+                                                  start_after=start_after):
                 if self._stop.is_set():
                     tracker.save(drive)
                     return
-                if start_after and name <= start_after:
-                    continue
                 try:
                     es.heal_object(bucket, name)
                     tracker.healed += 1

@@ -468,8 +468,9 @@ class HealingMixin:
 
     def heal_objects(self, bucket: str, prefix: str = "", **kw):
         """Walk every object under prefix and heal it (reference HealObjects
-        walk, cmd/erasure-server-pool.go:1500)."""
-        for name in sorted(self.merged_journals(bucket, prefix)):
+        walk, cmd/erasure-server-pool.go:1500) — streamed, O(page) memory
+        even over a multi-million-object bucket."""
+        for name, _meta in self.stream_journals(bucket, prefix):
             try:
                 yield self.heal_object(bucket, name, **kw)
             except se.ObjectError as e:

@@ -11,7 +11,7 @@ materialized map later without touching callers).
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Iterator
 
 from minio_tpu.erasure.types import (
     ListObjectsInfo,
@@ -78,6 +78,81 @@ def merge_journal_maps(maps: list[dict[str, XLMeta]]) -> dict[str, XLMeta]:
     return merged
 
 
+def merge_journal_streams(streams: list) -> "Iterator[tuple[str, XLMeta]]":
+    """K-way merge of SORTED (name, XLMeta) streams, newest journal wins
+    per name — the cross-set/cross-pool layer of the streamed listing
+    (reference merges per-set metacache streams the same way,
+    cmd/metacache-server-pool.go:59 / metacache-entries.go:198). Pulls
+    lazily: memory is O(streams), not O(namespace)."""
+    import heapq
+
+    merged = heapq.merge(*streams, key=lambda t: t[0])
+    cur_name: str | None = None
+    cur_meta: XLMeta | None = None
+    for name, meta in merged:
+        if name != cur_name:
+            if cur_meta is not None:
+                yield cur_name, cur_meta
+            cur_name, cur_meta = name, meta
+        elif journal_newer(meta, cur_meta):
+            cur_meta = meta
+    if cur_meta is not None:
+        yield cur_name, cur_meta
+
+
+def prefetch_stream(gen, depth: int = 32):
+    """Run `gen` in a producer thread behind a bounded queue: the k-way
+    listing merge then overlaps every drive's walk I/O instead of pulling
+    one drive at a time (the reference's per-drive WalkDir goroutines,
+    cmd/metacache-walk.go). Abandoning the wrapper (early page end) stops
+    the producer promptly — no thread leaks, no unbounded buffering."""
+    import queue
+    import threading
+
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    DONE = object()
+
+    def pump():
+        try:
+            for item in gen:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        finally:
+            while not stop.is_set():
+                try:
+                    q.put(DONE, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+    t = threading.Thread(target=pump, daemon=True, name="walk-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is DONE:
+                return
+            yield item
+    finally:
+        stop.set()
+
+
+def _as_sorted_items(journals) -> "Iterator[tuple[str, XLMeta]]":
+    """Paginators accept either a journal map (legacy, materialized) or an
+    already-sorted lazy (name, XLMeta) stream — the streamed form is what
+    keeps listing at O(page) memory."""
+    if isinstance(journals, dict):
+        return ((n, journals[n]) for n in sorted(journals))
+    return iter(journals)
+
+
 def journal_newer(a: XLMeta, b: XLMeta) -> bool:
     # Envelope accessors: the quorum comparator runs once per (object,
     # drive) during every listing merge and must not materialize bodies.
@@ -88,19 +163,21 @@ def journal_newer(a: XLMeta, b: XLMeta) -> bool:
 
 
 def paginate_objects(
-    journals: dict[str, XLMeta],
+    journals,
     to_info: Callable[[str, FileInfo], object],
     prefix: str = "",
     marker: str = "",
     delimiter: str = "",
     max_keys: int = 1000,
 ) -> ListObjectsInfo:
+    """S3 pagination over a journal map or sorted (name, XLMeta) stream;
+    a stream is consumed only up to the page boundary (O(page) work)."""
     objects = []
     prefixes: list[str] = []
     seen_prefix: set[str] = set()
     truncated = False
     next_marker = ""
-    for name in sorted(journals):
+    for name, meta in _as_sorted_items(journals):
         if _skip_for_marker(name, marker, delimiter):
             continue
         if delimiter:
@@ -117,7 +194,7 @@ def paginate_objects(
                     next_marker = cp
                 continue
         try:
-            fi = journals[name].to_fileinfo("", name, None)
+            fi = meta.to_fileinfo("", name, None)
         except se.StorageError:
             continue
         if fi.deleted:
@@ -133,20 +210,24 @@ def paginate_objects(
 
 
 def entries_from_journals(
-    journals: dict[str, XLMeta],
+    journals,
     to_info: Callable[[str, FileInfo], object],
+    cap: int = 0,
 ) -> list[tuple[str, object]]:
-    """Render a journal map into the sorted live-object entry stream the
-    metacache persists (cmd/metacache-stream.go role)."""
+    """Render a journal map/stream into the sorted live-object entry
+    stream the metacache persists (cmd/metacache-stream.go role). cap > 0
+    bounds how much of a stream is rendered (partial metacache)."""
     out = []
-    for name in sorted(journals):
+    for name, meta in _as_sorted_items(journals):
         try:
-            fi = journals[name].to_fileinfo("", name, None)
+            fi = meta.to_fileinfo("", name, None)
         except se.StorageError:
             continue
         if fi.deleted:
             continue
         out.append((name, to_info(name, fi)))
+        if cap and len(out) >= cap:
+            break
     return out
 
 
@@ -193,20 +274,23 @@ def paginate_cached(
 
 
 def version_entries_from_journals(
-    journals: dict[str, XLMeta],
+    journals,
     to_info: Callable[[str, FileInfo], object],
+    cap: int = 0,
 ) -> list[tuple[str, list]]:
     """Rendered version stream for the metacache: per name, every version
     newest-first INCLUDING delete markers (versions listings show them)."""
     out = []
-    for name in sorted(journals):
+    for name, meta in _as_sorted_items(journals):
         try:
             infos = [to_info(name, fi)
-                     for fi in journals[name].list_versions("", name)]
+                     for fi in meta.list_versions("", name)]
         except se.StorageError:
             continue
         if infos:
             out.append((name, infos))
+        if cap and len(out) >= cap:
+            break
     return out
 
 
@@ -273,7 +357,7 @@ def _skip_for_marker(name: str, marker: str, delimiter: str) -> bool:
 
 
 def paginate_versions(
-    journals: dict[str, XLMeta],
+    journals,
     to_info: Callable[[str, FileInfo], object],
     prefix: str = "",
     marker: str = "",
@@ -284,7 +368,7 @@ def paginate_versions(
     out = ListObjectVersionsInfo()
     seen_prefix: set[str] = set()
     count = 0
-    for name in sorted(journals):
+    for name, meta in _as_sorted_items(journals):
         if name == marker and version_marker:
             pass  # resume mid-object below
         elif _skip_for_marker(name, marker, delimiter) or name == marker:
@@ -303,7 +387,6 @@ def paginate_versions(
                     out.next_marker = cp
                     out.next_version_id_marker = ""
                 continue
-        meta = journals[name]
         resuming = name == marker and bool(version_marker)
         skipping = resuming  # drop versions up to and incl. version_marker
         for fi in meta.list_versions("", name):

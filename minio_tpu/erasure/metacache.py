@@ -80,10 +80,13 @@ class Metacache:
         return [(n, ObjectInfo(**d)) for n, d in raw_entries]
 
     def _save(self, bucket: str, prefix: str, entries: list,
-              kind: str) -> None:
+              kind: str, end: str = "") -> None:
+        """end != "": the stream was rendered up to a cap — the cache
+        covers names <= end only (O(page)-bounded memory; a continuation
+        past `end` misses and falls back to the streamed walk)."""
         doc = {
             "v": 1, "bucket": bucket, "prefix": prefix,
-            "created": time.time(),
+            "created": time.time(), "end": end,
             "entries": self._encode_entries(kind, entries),
         }
         try:
@@ -95,7 +98,8 @@ class Metacache:
         except se.StorageError:
             pass  # cache is an optimization; never fail the listing
 
-    def _load(self, bucket: str, prefix: str, kind: str) -> list | None:
+    def _load(self, bucket: str, prefix: str, kind: str,
+              marker: str = "") -> list | None:
         try:
             raw = self._store.read_sys_config(
                 self._path(bucket, prefix, kind))
@@ -113,12 +117,17 @@ class Metacache:
                 self.drop(bucket, prefix, kind)
                 self.misses += 1
                 return None
+            end = doc.get("end", "")
+            if end and marker >= end:
+                # Partial stream exhausted: the continuation must walk.
+                self.misses += 1
+                return None
             out = self._decode_entries(kind, doc["entries"])
         except (ValueError, TypeError, KeyError):
             self.misses += 1
             return None
         self.hits += 1
-        return out
+        return out, end
 
     def drop(self, bucket: str, prefix: str = "", kind: str = "o") -> None:
         try:
@@ -129,20 +138,23 @@ class Metacache:
     # -- public surface --
 
     def save(self, bucket: str, prefix: str,
-             entries: list[tuple[str, ObjectInfo]]) -> None:
-        self._save(bucket, prefix, entries, "o")
+             entries: list[tuple[str, ObjectInfo]], end: str = "") -> None:
+        self._save(bucket, prefix, entries, "o", end)
 
-    def load(self, bucket: str, prefix: str
-             ) -> list[tuple[str, ObjectInfo]] | None:
-        return self._load(bucket, prefix, "o")
+    def load(self, bucket: str, prefix: str, marker: str = ""
+             ) -> tuple[list, str] | None:
+        """-> (entries, end) or None; end != "" marks a partial stream —
+        a page that drains the entries without filling up must fall back
+        to the walk (names past `end` exist but aren't cached)."""
+        return self._load(bucket, prefix, "o", marker)
 
     def save_versions(self, bucket: str, prefix: str,
-                      entries: list[tuple[str, list]]) -> None:
-        self._save(bucket, prefix, entries, "v")
+                      entries: list[tuple[str, list]], end: str = "") -> None:
+        self._save(bucket, prefix, entries, "v", end)
 
-    def load_versions(self, bucket: str, prefix: str
-                      ) -> list[tuple[str, list]] | None:
-        return self._load(bucket, prefix, "v")
+    def load_versions(self, bucket: str, prefix: str, marker: str = ""
+                      ) -> tuple[list, str] | None:
+        return self._load(bucket, prefix, "v", marker)
 
     def recently_saved_versions(self, bucket: str, prefix: str) -> bool:
         return self.recently_saved(bucket, prefix, "v")

@@ -872,7 +872,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                      delimiter: str = "", max_keys: int = 1000) -> ListObjectsInfo:
         self.get_bucket_info(bucket)
         return listing.paginate_objects(
-            self.merged_journals(bucket, prefix),
+            self.stream_journals(bucket, prefix),
             lambda name, fi: self._fi_to_object_info(bucket, name, fi),
             prefix, marker, delimiter, max_keys,
         )
@@ -882,28 +882,42 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                              max_keys: int = 1000) -> ListObjectVersionsInfo:
         self.get_bucket_info(bucket)
         return listing.paginate_versions(
-            self.merged_journals(bucket, prefix),
+            self.stream_journals(bucket, prefix),
             lambda name, fi: self._fi_to_object_info(bucket, name, fi),
             prefix, marker, version_marker, delimiter, max_keys,
         )
 
+    def stream_journals(self, bucket: str, prefix: str = "",
+                        start_after: str = "") -> Iterator[tuple[str, XLMeta]]:
+        """SORTED (name, elected-journal) stream: per-drive sorted walk_dir
+        streams k-way merged with newest-journal election — O(drives)
+        memory regardless of namespace size (the reference's metacache
+        listPath walk, cmd/metacache-set.go:534 + metacache-entries.go:198;
+        replaces the materialized merged_journals map on every hot path).
+        Names at or before start_after are skipped WITHOUT parsing their
+        journals (cheap resume for heal walks and list markers); each
+        drive's walk runs behind a prefetch thread so per-drive I/O
+        overlaps (the reference's per-drive WalkDir goroutines)."""
+        def drive_stream(d: StorageAPI):
+            try:
+                for e in d.walk_dir(bucket, prefix):
+                    if start_after and e.name <= start_after:
+                        continue
+                    try:
+                        meta = XLMeta.parse(e.meta)
+                    except se.StorageError:
+                        continue  # corrupt copy: other drives elect
+                    yield e.name, meta
+            except se.StorageError:
+                return  # offline/unformatted drive: quorum covers it
+
+        return listing.merge_journal_streams(
+            [listing.prefetch_stream(drive_stream(d)) for d in self.drives])
+
     def merged_journals(self, bucket: str, prefix: str) -> dict[str, XLMeta]:
-        results = parallel_map(
-            [lambda d=d: list(d.walk_dir(bucket, prefix)) for d in self.drives]
-        )
-        merged: dict[str, XLMeta] = {}
-        for r in results:
-            if isinstance(r, Exception):
-                continue
-            for entry in r:
-                try:
-                    meta = XLMeta.parse(entry.meta)
-                except se.StorageError:
-                    continue
-                cur = merged.get(entry.name)
-                if cur is None or listing.journal_newer(meta, cur):
-                    merged[entry.name] = meta
-        return merged
+        """Materialized journal map — O(namespace) memory; only for small
+        bounded uses (tests, sys buckets). Hot paths use stream_journals."""
+        return dict(self.stream_journals(bucket, prefix))
 
     # ------------------------------------------------------------------
     # tagging (cmd/erasure-object.go:1158)

@@ -245,13 +245,23 @@ class ErasureServerPools:
 
     # -- listing --
 
+    def stream_journals(self, bucket: str, prefix: str = "",
+                        start_after: str = ""):
+        """Sorted (name, journal) stream across every pool (reference
+        cmd/metacache-server-pool.go:59) — O(pools x sets x drives)
+        memory regardless of namespace size."""
+        return listing.merge_journal_streams(
+            [p.stream_journals(bucket, prefix, start_after)
+             for p in self.pools])
+
     def merged_journals(self, bucket: str, prefix: str) -> dict[str, XLMeta]:
-        results = parallel_map(
-            [lambda p=p: p.merged_journals(bucket, prefix) for p in self.pools]
-        )
-        return listing.merge_journal_maps(
-            [r for r in results if not isinstance(r, Exception)]
-        )
+        return dict(self.stream_journals(bucket, prefix))
+
+    # Bound on the rendered metacache stream: continuation pages within
+    # the cap seek the persisted stream; pages past it fall back to the
+    # streamed walk (the cache records its end). Keeps the cache itself
+    # O(cap), never O(namespace).
+    METACACHE_MAX_ENTRIES = 10_000
 
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
                      delimiter: str = "", max_keys: int = 1000) -> ListObjectsInfo:
@@ -261,20 +271,30 @@ class ErasureServerPools:
         # the first page walked the namespace and saved it; the S3 marker
         # doubles as the seek position (cmd/metacache-stream.go role).
         if marker:
-            cached = self.metacache.load(bucket, prefix)
+            cached = self.metacache.load(bucket, prefix, marker)
             if cached is not None:
-                return listing.paginate_cached(
-                    cached, prefix, marker, delimiter, max_keys)
-        journals = self.merged_journals(bucket, prefix)
+                entries, end = cached
+                r = listing.paginate_cached(
+                    entries, prefix, marker, delimiter, max_keys)
+                if r.is_truncated or not end:
+                    return r
+                # Partial stream drained mid-page: names past `end` may
+                # exist — fall through to the walk for a correct page.
         res = listing.paginate_objects(
-            journals, to_info, prefix, marker, delimiter, max_keys)
-        if res.is_truncated and not self.metacache.recently_saved(bucket, prefix):
-            # More pages will follow: persist the full rendered stream so
-            # they don't re-walk. Skipped when this node refreshed the
-            # stream moments ago (hot page-1 traffic).
+            self.stream_journals(bucket, prefix), to_info,
+            prefix, marker, delimiter, max_keys)
+        if (res.is_truncated and not marker
+                and not self.metacache.recently_saved(bucket, prefix)):
+            # More pages will follow: render a FRESH stream up to the cap
+            # and persist it so they don't re-walk. Only page 1 renders —
+            # a continuation already past the cap would re-save the same
+            # partial stream uselessly on every page.
+            cap = self.METACACHE_MAX_ENTRIES
+            entries = listing.entries_from_journals(
+                self.stream_journals(bucket, prefix), to_info, cap=cap)
             self.metacache.save(
-                bucket, prefix,
-                listing.entries_from_journals(journals, to_info))
+                bucket, prefix, entries,
+                end=entries[-1][0] if len(entries) >= cap else "")
         return res
 
     def list_object_versions(self, bucket: str, prefix: str = "", marker: str = "",
@@ -283,22 +303,29 @@ class ErasureServerPools:
         self.get_bucket_info(bucket)
         to_info = lambda name, fi: listing.fi_to_object_info(bucket, name, fi)  # noqa: E731
         if marker:
-            cached = self.metacache.load_versions(bucket, prefix)
+            cached = self.metacache.load_versions(bucket, prefix, marker)
             if cached is not None:
-                return listing.paginate_versions_cached(
-                    cached, prefix, marker, version_marker, delimiter,
+                entries, end = cached
+                r = listing.paginate_versions_cached(
+                    entries, prefix, marker, version_marker, delimiter,
                     max_keys)
-        journals = self.merged_journals(bucket, prefix)
+                if r.is_truncated or not end:
+                    return r
         res = listing.paginate_versions(
-            journals, to_info, prefix, marker, version_marker, delimiter,
-            max_keys)
-        if res.is_truncated and not self.metacache.recently_saved_versions(
-                bucket, prefix):
+            self.stream_journals(bucket, prefix), to_info,
+            prefix, marker, version_marker, delimiter, max_keys)
+        if (res.is_truncated and not marker
+                and not self.metacache.recently_saved_versions(
+                    bucket, prefix)):
             # Scanner + client continuations seek into the persisted
-            # stream instead of re-walking every page.
+            # stream instead of re-walking every page (page-1 render only,
+            # see list_objects).
+            cap = self.METACACHE_MAX_ENTRIES
+            entries = listing.version_entries_from_journals(
+                self.stream_journals(bucket, prefix), to_info, cap=cap)
             self.metacache.save_versions(
-                bucket, prefix,
-                listing.version_entries_from_journals(journals, to_info))
+                bucket, prefix, entries,
+                end=entries[-1][0] if len(entries) >= cap else "")
         return res
 
     # -- healing --

@@ -218,19 +218,25 @@ class ErasureSets:
     def list_sys_config(self, prefix: str = "") -> list[str]:
         return self.sets[0].list_sys_config(prefix)
 
+    def stream_journals(self, bucket: str, prefix: str = "",
+                        start_after: str = ""):
+        """Sorted (name, journal) stream across every set — each set's
+        drive-merged stream k-way merged again (objects route to exactly
+        one set, so dupes only arise from topology changes; newest wins).
+        O(sets x drives) memory (reference pool-level metacache merge,
+        cmd/metacache-server-pool.go:59)."""
+        return listing.merge_journal_streams(
+            [s.stream_journals(bucket, prefix, start_after)
+             for s in self.sets])
+
     def merged_journals(self, bucket: str, prefix: str) -> dict[str, XLMeta]:
-        results = parallel_map(
-            [lambda s=s: s.merged_journals(bucket, prefix) for s in self.sets]
-        )
-        return listing.merge_journal_maps(
-            [r for r in results if not isinstance(r, Exception)]
-        )
+        return dict(self.stream_journals(bucket, prefix))
 
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
                      delimiter: str = "", max_keys: int = 1000) -> ListObjectsInfo:
         self.get_bucket_info(bucket)
         return listing.paginate_objects(
-            self.merged_journals(bucket, prefix),
+            self.stream_journals(bucket, prefix),
             lambda name, fi: listing.fi_to_object_info(bucket, name, fi),
             prefix, marker, delimiter, max_keys,
         )
@@ -240,7 +246,7 @@ class ErasureSets:
                              max_keys: int = 1000) -> ListObjectVersionsInfo:
         self.get_bucket_info(bucket)
         return listing.paginate_versions(
-            self.merged_journals(bucket, prefix),
+            self.stream_journals(bucket, prefix),
             lambda name, fi: listing.fi_to_object_info(bucket, name, fi),
             prefix, marker, version_marker, delimiter, max_keys,
         )

@@ -453,6 +453,16 @@ class LocalDrive(StorageAPI):
                 bitrot.verify_shard_file(f, shard_data_size, shard_size, algo)
 
     def walk_dir(self, volume: str, prefix: str = "") -> Iterator[WalkEntry]:
+        """Sorted journal walk. Entries come out in LEXICOGRAPHIC order of
+        the full object name — the invariant the streamed k-way listing
+        merge relies on. Per-directory sorting alone is NOT lexicographic
+        over full names ('a.txt' < 'a/b' because '.' < '/', yet a naive
+        walk emits everything under a/ first), so each directory entry
+        sorts under TWO keys: `name` for the object journal it may hold
+        and `name + "/"` for its subtree (the reference's dir-entries-
+        carry-trailing-slash convention, cmd/metacache-walk.go). This also
+        lists keys nested under an object key ('a' and 'a/b' coexisting).
+        """
         base = self._vol_dir(volume)
         if not os.path.isdir(base):
             raise se.VolumeNotFound(volume)
@@ -460,29 +470,30 @@ class LocalDrive(StorageAPI):
         def _walk(rel: str) -> Iterator[WalkEntry]:
             d = os.path.join(base, rel) if rel else base
             try:
-                entries = sorted(os.scandir(d), key=lambda e: e.name)
+                with os.scandir(d) as it:
+                    dirs = [e.name for e in it if e.is_dir()]
             except OSError:
                 return
-            for entry in entries:
-                name = f"{rel}/{entry.name}" if rel else entry.name
-                if not entry.is_dir():
-                    continue
-                meta_p = os.path.join(entry.path, META_FILE)
-                if os.path.isfile(meta_p):
-                    if prefix and not name.startswith(prefix):
-                        # still descend: prefix may point deeper
-                        if prefix.startswith(name + "/"):
-                            yield from _walk(name)
-                        continue
-                    try:
-                        with open(meta_p, "rb") as f:
-                            yield WalkEntry(name=name, meta=f.read())
-                    except OSError:
-                        continue
-                else:
-                    if prefix and not (name.startswith(prefix) or prefix.startswith(name + "/")):
+            items = []  # (sort_key, name, is_subtree)
+            for dn in dirs:
+                name = f"{rel}/{dn}" if rel else dn
+                items.append((name, name, False))
+                items.append((name + "/", name, True))
+            for _key, name, is_subtree in sorted(items):
+                if is_subtree:
+                    if prefix and not (name.startswith(prefix)
+                                       or prefix.startswith(name + "/")):
                         continue
                     yield from _walk(name)
+                    continue
+                if prefix and not name.startswith(prefix):
+                    continue
+                meta_p = os.path.join(base, *name.split("/"), META_FILE)
+                try:
+                    with open(meta_p, "rb") as f:
+                        yield WalkEntry(name=name, meta=f.read())
+                except OSError:
+                    continue  # plain directory level (no journal here)
 
         yield from _walk("")
 
