@@ -48,6 +48,9 @@ DEFAULTS: dict[str, dict[str, str]] = {
     # Bucket federation (etcd/DNS role): `directory` is the shared
     # registry file; `endpoint` this cluster's advertised URL.
     "federation": {"enable": "off", "directory": "", "endpoint": ""},
+    # Per-bucket bandwidth limits, bytes/second (pkg/bandwidth role):
+    # `default` covers every bucket; additional keys name buckets.
+    "bandwidth": {"default": "0"},
     "logger_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
     "audit_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
     "audit_file": {"path": ""},
@@ -82,6 +85,10 @@ class ConfigSys:
         self._mu = threading.Lock()
         self._kv: dict[str, dict[str, str]] = {
             s: dict(kv) for s, kv in DEFAULTS.items()}
+        # Bumped on every mutation: hot-path consumers (the bandwidth
+        # throttle) cache parsed values against it instead of re-reading
+        # the store per chunk.
+        self.generation = 0
         if store is not None:
             self._load()
 
@@ -115,12 +122,27 @@ class ConfigSys:
         with self._mu:
             if subsys not in self._kv:
                 raise se.IAMError(f"unknown config subsystem {subsys!r}")
-            unknown = set(updates) - set(DEFAULTS[subsys])
-            if unknown:
-                raise se.IAMError(
-                    f"unknown keys for {subsys}: {sorted(unknown)}")
+            # `bandwidth` takes free-form keys (each names a bucket) but
+            # validates VALUES (bytes/sec) — a typo like "10MB" silently
+            # becoming "unlimited" on the data path would be worse than an
+            # error here. Other subsystems validate against their schema.
+            if subsys == "bandwidth":
+                for k, v in updates.items():
+                    try:
+                        if float(v) < 0:
+                            raise ValueError
+                    except (TypeError, ValueError):
+                        raise se.IAMError(
+                            f"bandwidth.{k}: rate must be a non-negative "
+                            f"number of bytes/sec, got {v!r}") from None
+            else:
+                unknown = set(updates) - set(DEFAULTS[subsys])
+                if unknown:
+                    raise se.IAMError(
+                        f"unknown keys for {subsys}: {sorted(unknown)}")
             self._kv[subsys].update(
                 {str(k): str(v) for k, v in updates.items()})
+            self.generation += 1
             self._persist()
 
     def reset(self, subsys: str) -> None:
@@ -128,6 +150,7 @@ class ConfigSys:
             if subsys not in self._kv:
                 raise se.IAMError(f"unknown config subsystem {subsys!r}")
             self._kv[subsys] = dict(DEFAULTS[subsys])
+            self.generation += 1
             self._persist()
 
     def dump(self, subsys: str = "") -> dict:

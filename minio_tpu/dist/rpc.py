@@ -76,15 +76,35 @@ class _ResponseStream:
         self._conn = conn
         self._closed = False
 
+    def _fail(self, e: Exception) -> "se.StorageError":
+        """Mid-stream network failure: degrade like any per-drive error
+        (quorum layers expect StorageError subtypes, not raw socket
+        exceptions) and stop pooling the broken connection."""
+        self._closed = True
+        try:
+            self._conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+        self._client.mark_offline()
+        return se.DiskNotFound(
+            f"{self._client.host}:{self._client.port}: {e}")
+
     def read(self, n: int = -1) -> bytes:
-        return self._resp.read() if n is None or n < 0 else self._resp.read(n)
+        try:
+            return (self._resp.read() if n is None or n < 0
+                    else self._resp.read(n))
+        except (OSError, http.client.HTTPException) as e:
+            raise self._fail(e) from e
 
     def read1(self, n: int = 65536) -> bytes:
         """Return whatever is available (at most n) without waiting for n
         bytes — read(n) on a chunked response blocks until it accumulates n,
         which would stall live streams (trace/console subscriptions) whose
         documents trickle in."""
-        return self._resp.read1(n)
+        try:
+            return self._resp.read1(n)
+        except (OSError, http.client.HTTPException) as e:
+            raise self._fail(e) from e
 
     def close(self) -> None:
         if self._closed:
@@ -137,10 +157,16 @@ class RestClient:
         is a verifying system-CA context. An unverified context would let
         an active MITM replay the bearer token, so never default to
         CERT_NONE here."""
+        from minio_tpu.utils.dyntimeout import DynamicTimeout
+
         self.host = host
         self.port = port
         self.secret = secret
         self.timeout = timeout
+        # Self-tuning per-call deadline (reference dynamicTimeout,
+        # cmd/dynamic-timeouts.go:35): a congested fabric inflates it,
+        # a healthy one converges it down for faster failure detection.
+        self.dyn_timeout = DynamicTimeout(timeout, minimum=min(1.0, timeout))
         self.scheme = scheme
         if scheme == "https" and ssl_context is None:
             import ssl as _ssl
@@ -235,6 +261,12 @@ class RestClient:
         url = path + ("?" + qs if qs else "")
         headers = {"Authorization": "Bearer " + sign_token(self.secret)}
         conn = self._get_conn()
+        deadline = self.dyn_timeout.timeout()
+        if conn.sock is not None:
+            conn.sock.settimeout(deadline)
+        else:
+            conn.timeout = deadline
+        t0 = time.monotonic()
         try:
             if body is None:
                 conn.request("POST", url, headers=headers)
@@ -250,22 +282,46 @@ class RestClient:
                 conn.close()
             except Exception:
                 pass
+            if isinstance(e, TimeoutError):
+                self.dyn_timeout.log_failure()
             self.mark_offline()
             raise se.DiskNotFound(
                 f"{self.host}:{self.port}: {e}") from e
+        self.dyn_timeout.log_success(time.monotonic() - t0)
 
-        if resp.status == ERR_STATUS:
-            doc = unpack(resp.read())
-            self._put_conn(conn)
-            raise se.by_name(doc.get("err", "StorageError"), doc.get("msg", ""))
-        if resp.status != 200:
-            msg = resp.read()[:512].decode(errors="replace")
-            self._put_conn(conn)
-            raise se.FaultyDisk(
-                f"{self.host}:{self.port}{path}: HTTP {resp.status} {msg}")
-        if stream:
-            return _ResponseStream(resp, self, conn)
-        data = resp.read()
+        try:
+            if resp.status == ERR_STATUS:
+                doc = unpack(resp.read())
+                self._put_conn(conn)
+                raise se.by_name(doc.get("err", "StorageError"),
+                                 doc.get("msg", ""))
+            if resp.status != 200:
+                msg = resp.read()[:512].decode(errors="replace")
+                self._put_conn(conn)
+                raise se.FaultyDisk(
+                    f"{self.host}:{self.port}{path}: HTTP {resp.status} {msg}")
+            if stream:
+                # Long-lived body (walk streams, shard reads, trace subs):
+                # restore the STATIC timeout — the adaptive deadline paces
+                # request/first-byte only, and a converged ~1s deadline
+                # must not kill a legitimately slow stream mid-read.
+                if conn.sock is not None:
+                    conn.sock.settimeout(self.timeout)
+                return _ResponseStream(resp, self, conn)
+            data = resp.read()
+        except (OSError, http.client.HTTPException) as e:
+            # Body-read failure (incl. a timeout on a converged deadline):
+            # same per-drive degradation as a connect failure — quorum
+            # layers expect StorageError subtypes, never raw TimeoutError.
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+            if isinstance(e, TimeoutError):
+                self.dyn_timeout.log_failure()
+            self.mark_offline()
+            raise se.DiskNotFound(
+                f"{self.host}:{self.port}: {e}") from e
         self._put_conn(conn)
         return data
 

@@ -104,7 +104,10 @@ class AutoHealer:
 
     def __init__(self, sets, interval: float = 10.0):
         # `sets` is anything exposing .sets -> list[ErasureObjects]
-        # (ErasureSets / pools) or a single ErasureObjects.
+        # (ErasureSets / pools) or a single ErasureObjects. When it is a
+        # full ErasureSets (carries the format layout), the monitor also
+        # runs live drive-replacement detection (heal_format) each pass.
+        self._owner = sets if hasattr(sets, "format") else None
         self._sets = getattr(sets, "sets", None) or [sets]
         self.interval = interval
         self._stop = threading.Event()
@@ -129,7 +132,18 @@ class AutoHealer:
     # -- one monitor pass (test entry point) --
 
     def run_once(self) -> int:
-        """Heal every drive that carries a tracker; returns drives healed."""
+        """Heal every drive that carries a tracker; returns drives healed.
+        Detects wiped/replaced drives first (heal_format) so a blank drive
+        is reformatted, tracker-marked, and rebuilt in the SAME pass —
+        the reference's monitorLocalDisksAndHeal flow (connectDisks ->
+        healFreshDisk -> healErasureSet)."""
+        if self._owner is not None:
+            from minio_tpu.erasure.format import heal_format
+
+            try:
+                heal_format(self._owner)
+            except Exception:  # noqa: BLE001 - keep the monitor alive
+                pass
         healed_drives = 0
         for es in self._sets:
             for drive in es.drives:

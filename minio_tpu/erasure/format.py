@@ -157,17 +157,56 @@ def init_format_erasure(
         if not (heal_blanks and isinstance(results[i], (dict, se.UnformattedDisk))):
             continue
         slot_uuid = ref.sets[slot // set_drive_count][slot % set_drive_count]
-        try:
-            drive.write_format(ref.to_doc(slot_uuid))
-            drive.set_disk_id(slot_uuid)
-            # A blank drive joining a deployment that already has data is a
-            # replacement: leave a healing tracker on it so the background
-            # auto-healer rebuilds its shards and resumes across restarts
-            # (reference healFreshDisk, background-newdisks-heal-ops.go:139).
-            from minio_tpu.erasure.autoheal import mark_drive_healing
-
-            mark_drive_healing(drive, slot_uuid)
-        except se.StorageError:
-            pass
+        _claim_slot(drive, ref, slot_uuid)
     drives[:] = ordered  # callers consume the UUID-ordered layout
     return ref
+
+
+def _claim_slot(drive: StorageAPI, fmt: "FormatInfo",
+                slot_uuid: str) -> bool:
+    """Format a provably-blank drive into a slot: write its format.json,
+    rebind the disk-ID guard, and leave a healing tracker so the
+    background auto-healer rebuilds its shards and resumes across
+    restarts (reference healFreshDisk,
+    cmd/background-newdisks-heal-ops.go:139). Shared by boot-time init
+    and the live heal_format monitor — the claim ritual must not
+    diverge between them."""
+    from minio_tpu.erasure.autoheal import mark_drive_healing
+
+    try:
+        drive.write_format(fmt.to_doc(slot_uuid))
+        drive.set_disk_id(slot_uuid)
+        mark_drive_healing(drive, slot_uuid)
+        return True
+    except se.StorageError:
+        return False  # still dying; retried on the next pass/boot
+
+
+def heal_format(es_sets) -> int:
+    """Live drive-replacement recovery (reference HealFormat,
+    cmd/erasure-server-pool.go:1366 + monitorAndConnectEndpoints,
+    cmd/erasure-sets.go:271): probe every slot of a RUNNING ErasureSets
+    and, when the slot's drive reports UnformattedDisk (wiped in place or
+    swapped for a blank one), rewrite its format.json with the slot's
+    UUID, rebind the disk-ID guard, and leave a healing tracker so the
+    background auto-healer rebuilds its shards — no restart needed.
+
+    Conservative by design, like boot-time init: a drive carrying a
+    FOREIGN deployment's format or a corrupt/unreadable format document
+    is never reformatted (that is an operator decision); only provably
+    blank drives are claimed. Returns the number of slots reformatted."""
+    fmt: FormatInfo = es_sets.format
+    sdc = es_sets.set_drive_count
+    healed = 0
+    for slot, drive in enumerate(es_sets.drives):
+        slot_uuid = fmt.sets[slot // sdc][slot % sdc]
+        try:
+            drive.read_format()
+            continue  # formatted (right or wrong): the disk-ID guard rules
+        except se.UnformattedDisk:
+            pass
+        except se.StorageError:
+            continue  # unreadable/corrupt: refuse to claim it
+        if _claim_slot(drive, fmt, slot_uuid):
+            healed += 1
+    return healed

@@ -147,6 +147,11 @@ class S3Server:
         self._bw_mu = __import__("threading").Lock()
         self.trace_bus = PubSub()
         self.config = ConfigSys(sealed)
+        # Per-bucket bandwidth ENFORCEMENT (pkg/bandwidth role) — rates
+        # from the `bandwidth` config subsystem, applied to PUT ingest and
+        # GET egress streams; the accounting dict above stays the monitor.
+        from minio_tpu.utils.bandwidth import BandwidthThrottle
+        self.bw_throttle = BandwidthThrottle(self.config)
 
         # Structured ops + audit logging (reference cmd/logger/): targets
         # come from the config KV subsystems logger_webhook / audit_webhook /
@@ -1762,10 +1767,12 @@ class S3Server:
 
     # ------------------------------------------------------------------
 
-    async def _spool_body(self, request, payload_hash, auth_sig):
+    async def _spool_body(self, request, payload_hash, auth_sig,
+                          bucket: str = ""):
         """Stream the request body into a spooled temp file, verifying the
         content sha256 or per-chunk streaming signatures. Returns
-        (spool, size); caller closes the spool."""
+        (spool, size); caller closes the spool. `bucket` engages the
+        per-bucket ingest bandwidth limiter."""
         if request.content_length is None and \
                 "x-amz-decoded-content-length" not in request.headers:
             raise S3Error("MissingContentLength")
@@ -1804,6 +1811,9 @@ class S3Server:
                 auth_sig.region, auth_sig.service)
         try:
             async for chunk in request.content.iter_chunked(1 << 20):
+                delay = self.bw_throttle.delay(bucket, len(chunk), "rx")
+                if delay > 0:
+                    await asyncio.sleep(delay)
                 if chunked is not None:
                     chunked.feed(chunk)
                     spool.write(chunked.take())
@@ -1836,7 +1846,8 @@ class S3Server:
         if repl_cfg is not None and repl_cfg.rule_for(key) is not None:
             from minio_tpu.replication.rules import META_STATUS
             opts.user_defined[META_STATUS] = "PENDING"
-        spool, size = await self._spool_body(request, payload_hash, auth_sig)
+        spool, size = await self._spool_body(request, payload_hash,
+                                             auth_sig, bucket)
         reader, size2 = self._maybe_compress_put(
             request, bucket, key, opts, spool, size)
         reader, stored_size = self._maybe_encrypt_put(
@@ -1860,7 +1871,8 @@ class S3Server:
 
     async def _put_part(self, request, bucket, key, upload_id, part_number,
                         hdr, payload_hash, auth_sig, run):
-        spool, size = await self._spool_body(request, payload_hash, auth_sig)
+        spool, size = await self._spool_body(request, payload_hash,
+                                             auth_sig, bucket)
         try:
             reader, stored_size = await run(
                 self._maybe_encrypt_part, request, bucket, key, upload_id,
@@ -1974,6 +1986,9 @@ class S3Server:
             chunk = await loop.run_in_executor(None, next, it, None)
             if chunk is None:
                 break
+            delay = self.bw_throttle.delay(bucket, len(chunk))
+            if delay > 0:
+                await asyncio.sleep(delay)
             await resp.write(chunk)
         await resp.write_eof()
         return resp

@@ -72,6 +72,10 @@ class LocalDrive(StorageAPI):
             raise se.CorruptedFormat(str(e)) from e
 
     def write_format(self, fmt: dict) -> None:
+        # A replaced/blank drive mounted at this path has no directory
+        # skeleton yet — formatting it IS what creates the skeleton
+        # (live heal_format path, reference HealFormat).
+        os.makedirs(os.path.join(self.root, SYS_VOL, "tmp"), exist_ok=True)
         tmp = self._format_path() + f".tmp.{uuid.uuid4().hex}"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(fmt, f)

@@ -1,0 +1,107 @@
+"""Per-bucket bandwidth throttling — token buckets on the data path.
+
+Role-equivalent of pkg/bandwidth (monitor + throttle): the serving loop
+already ACCOUNTS per-bucket bytes; this enforces limits. Rates come from
+the config KV subsystem `bandwidth`: key `default` applies to every
+bucket without its own entry, key `<bucket>` overrides it; 0/absent
+means unlimited. Limits are bytes/second and apply independently to
+upload (rx) and download (tx) streams.
+
+Enforcement is a classic token bucket with a one-second burst: consume()
+returns how long the caller must sleep before the bytes may pass, so the
+async serving loop awaits instead of blocking a thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class TokenBucket:
+    def __init__(self, rate: float):
+        self.rate = float(rate)
+        self.burst = max(self.rate, 1.0)  # one second of burst
+        self._tokens = self.burst
+        self._last = time.monotonic()
+        self._mu = threading.Lock()
+
+    def consume(self, n: int) -> float:
+        """Take n tokens; returns seconds the caller must wait. Debt is
+        allowed (a single chunk may exceed the burst) — the wait covers
+        the shortfall, keeping long-run throughput at the configured
+        rate regardless of chunk size."""
+        with self._mu:
+            now = time.monotonic()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            self._tokens -= n
+            if self._tokens >= 0:
+                return 0.0
+            return -self._tokens / self.rate
+
+
+class BandwidthThrottle:
+    """Config-driven registry of per-(bucket, direction) token buckets.
+
+    Rates are cached against the config generation: the default
+    (unthrottled) deployment pays one dict lookup per chunk, not a
+    config-store round trip; admin config-set bumps the generation and
+    the next chunk re-reads its rate."""
+
+    def __init__(self, config):
+        """config: ConfigSys-like with .get(subsys, key) -> str and a
+        `generation` counter bumped on every mutation."""
+        self._config = config
+        self._mu = threading.Lock()
+        self._gen = -1
+        self._rates: dict[str, float] = {}
+        self._buckets: dict[tuple[str, str], tuple[float, TokenBucket]] = {}
+
+    def _rate_for(self, bucket: str) -> float:
+        gen = getattr(self._config, "generation", 0)
+        with self._mu:
+            if gen == self._gen and bucket in self._rates:
+                return self._rates[bucket]
+        raw = ""
+        try:
+            raw = self._config.get("bandwidth", bucket)
+        except Exception:  # noqa: BLE001 - no per-bucket entry
+            pass
+        if not raw:
+            try:
+                raw = self._config.get("bandwidth", "default")
+            except Exception:  # noqa: BLE001 - config unavailable
+                raw = "0"
+        try:
+            rate = float(raw or 0)
+        except ValueError:
+            rate = 0.0
+        with self._mu:
+            if gen != self._gen:
+                self._rates.clear()
+                self._gen = gen
+            self._rates[bucket] = rate
+        return rate
+
+    def delay(self, bucket: str, n: int, direction: str = "tx") -> float:
+        """Seconds the caller must wait before moving n bytes for
+        `bucket` in `direction` ("rx" upload / "tx" download — limits
+        apply per direction); 0.0 when unlimited. Buckets rebuild when
+        their configured rate changes (admin config-set applies live)."""
+        if not bucket:
+            return 0.0
+        rate = self._rate_for(bucket)
+        key = (bucket, direction)
+        if rate <= 0:
+            if self._buckets:
+                with self._mu:
+                    self._buckets.pop(key, None)
+            return 0.0
+        with self._mu:
+            cur = self._buckets.get(key)
+            if cur is None or cur[0] != rate:
+                cur = (rate, TokenBucket(rate))
+                self._buckets[key] = cur
+        return cur[1].consume(n)

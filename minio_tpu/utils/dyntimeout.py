@@ -1,0 +1,69 @@
+"""Dynamic timeouts — self-tuning deadlines for cluster calls.
+
+Role-equivalent of the reference's dynamicTimeout
+(cmd/dynamic-timeouts.go:35): a fixed timeout is either too tight on a
+busy cluster (spurious failures) or too loose on a healthy one (slow
+failure detection). Each timeout tracks its recent outcomes and adapts:
+many timeouts inflate the deadline by 25%, while consistently-fast
+successes deflate it toward the observed envelope — never below the
+configured floor.
+"""
+
+from __future__ import annotations
+
+import threading
+
+LOG_SIZE = 100           # observations per adjustment window
+MAX_TIMEOUT = 300.0      # absolute ceiling (seconds)
+FAIL_FRACTION = 0.25     # window timeout share that triggers inflation
+SHRINK_MARGIN = 1.5      # keep this much headroom over the observed max
+
+
+class DynamicTimeout:
+    """Thread-safe adaptive timeout.
+
+        dt = DynamicTimeout(timeout=5.0, minimum=1.0)
+        deadline = dt.timeout()
+        ... run the call ...
+        dt.log_success(duration)   # or dt.log_failure() on timeout
+    """
+
+    def __init__(self, timeout: float, minimum: float):
+        if minimum <= 0 or timeout < minimum:
+            raise ValueError(f"bad timeout bounds {timeout}/{minimum}")
+        self._timeout = timeout
+        self.minimum = minimum
+        self._mu = threading.Lock()
+        self._durations: list[float] = []
+        self._failures = 0
+
+    def timeout(self) -> float:
+        return self._timeout
+
+    def log_success(self, duration: float) -> None:
+        with self._mu:
+            self._durations.append(duration)
+            self._maybe_adjust()
+
+    def log_failure(self) -> None:
+        """The operation hit the deadline."""
+        with self._mu:
+            self._failures += 1
+            self._maybe_adjust()
+
+    def _maybe_adjust(self) -> None:
+        n = len(self._durations) + self._failures
+        if n < LOG_SIZE:
+            return
+        if self._failures >= n * FAIL_FRACTION:
+            # The deadline is too tight for current conditions.
+            self._timeout = min(self._timeout * 1.25, MAX_TIMEOUT)
+        elif self._durations:
+            envelope = max(self._durations) * SHRINK_MARGIN
+            if envelope < self._timeout:
+                # Healthy and fast: converge down toward the envelope so
+                # real failures are detected sooner.
+                self._timeout = max(
+                    self.minimum, (self._timeout + envelope) / 2)
+        self._durations.clear()
+        self._failures = 0
