@@ -333,7 +333,6 @@ def bench_host_pipeline() -> dict:
     import shutil
 
     from minio_tpu.native import plane
-    from minio_tpu.ops.bitrot import BITROT_KEY
 
     if not plane.available():
         return {"metric": "host_pipeline_encode_16drive",
@@ -343,14 +342,13 @@ def bench_host_pipeline() -> dict:
     try:
         paths = [os.path.join(root, f"s{i}") for i in range(16)]
         data = os.urandom(size)
-        enc = plane.PartEncoder(paths, HEAL_K, HEAL_N - HEAL_K, BLOCK_SIZE,
-                                BITROT_KEY)
+        enc = plane.PartEncoder(paths, HEAL_K, HEAL_N - HEAL_K, BLOCK_SIZE)
         enc.feed(data[: 16 << 20], final=True)  # warm (tables, page cache)
         best_put = 0.0
         for _ in range(3):
             t0 = time.perf_counter()
             enc = plane.PartEncoder(paths, HEAL_K, HEAL_N - HEAL_K,
-                                    BLOCK_SIZE, BITROT_KEY)
+                                    BLOCK_SIZE)
             enc.feed(data, final=True)
             best_put = max(best_put, size / (time.perf_counter() - t0))
         best_get = 0.0
@@ -360,10 +358,18 @@ def bench_host_pipeline() -> dict:
                 paths, HEAL_K, HEAL_N - HEAL_K, BLOCK_SIZE, size, 0, size)
             best_get = max(best_get, size / (time.perf_counter() - t0))
         assert out == data
+        # Reference-parity lane: same pipeline with HighwayHash-256
+        # framing (the BASELINE config's named bitrot algorithm).
+        t0 = time.perf_counter()
+        enc = plane.PartEncoder(paths, HEAL_K, HEAL_N - HEAL_K,
+                                BLOCK_SIZE, algorithm="highwayhash256")
+        enc.feed(data, final=True)
+        hh_put = size / (time.perf_counter() - t0)
         return {"metric": "host_pipeline_encode_16drive",
                 "value": round(best_put / (1 << 30), 3), "unit": "GiB/s",
                 "vs_baseline": 0.0,
                 "get_gibs": round(best_get / (1 << 30), 3),
+                "hh256_put_gibs": round(hh_put / (1 << 30), 3),
                 "threads": min(8, os.cpu_count() or 1),
                 "cores": os.cpu_count()}
     finally:

@@ -127,14 +127,20 @@ class ConfigSys:
             # becoming "unlimited" on the data path would be worse than an
             # error here. Other subsystems validate against their schema.
             if subsys == "bandwidth":
+                import math
+
                 for k, v in updates.items():
                     try:
-                        if float(v) < 0:
+                        fv = float(v)
+                        # Note the >= polarity: NaN fails it, so a typo
+                        # like "nan" cannot silently disable the limit.
+                        if not (math.isfinite(fv) and fv >= 0):
                             raise ValueError
                     except (TypeError, ValueError):
                         raise se.IAMError(
-                            f"bandwidth.{k}: rate must be a non-negative "
-                            f"number of bytes/sec, got {v!r}") from None
+                            f"bandwidth.{k}: rate must be a finite "
+                            f"non-negative number of bytes/sec, got {v!r}"
+                        ) from None
             else:
                 unknown = set(updates) - set(DEFAULTS[subsys])
                 if unknown:

@@ -179,8 +179,13 @@ class AdminAPI:
             return _json(self.s.replication.stats)
         if op == "bandwidth" and m == "GET":
             self._authorize(identity, "admin:ServerInfo")
+            # Limits shown alongside the accounting so a mistyped bucket
+            # name in `config set bandwidth ...` is visible (the limit key
+            # appears with no matching accounting row).
+            limits = self.s.config.dump("bandwidth").get("bandwidth", {})
             with self.s._bw_mu:
-                return _json({"buckets": dict(self.s.bandwidth)})
+                return _json({"buckets": dict(self.s.bandwidth),
+                              "limits": limits})
         # -- service control (cmd/admin-handlers ServiceActionHandler) --
         if op == "service" and m == "POST":
             action = q.get("action", "")

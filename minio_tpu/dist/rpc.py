@@ -261,7 +261,15 @@ class RestClient:
         url = path + ("?" + qs if qs else "")
         headers = {"Authorization": "Bearer " + sign_token(self.secret)}
         conn = self._get_conn()
-        deadline = self.dyn_timeout.timeout()
+        # The adaptive deadline governs METADATA-class calls only (no
+        # body / small body). Bulk transfers (chunked shard uploads) keep
+        # the static timeout — a deadline converged on 10 ms metadata
+        # round-trips must not declare a healthy node dead because one
+        # multi-MB send waited out a congested TCP window. Convergence
+        # likewise learns only from the metadata class.
+        adaptive = body is None or (
+            isinstance(body, (bytes, bytearray)) and len(body) <= (1 << 20))
+        deadline = self.dyn_timeout.timeout() if adaptive else self.timeout
         if conn.sock is not None:
             conn.sock.settimeout(deadline)
         else:
@@ -282,12 +290,13 @@ class RestClient:
                 conn.close()
             except Exception:
                 pass
-            if isinstance(e, TimeoutError):
+            if adaptive and isinstance(e, TimeoutError):
                 self.dyn_timeout.log_failure()
             self.mark_offline()
             raise se.DiskNotFound(
                 f"{self.host}:{self.port}: {e}") from e
-        self.dyn_timeout.log_success(time.monotonic() - t0)
+        if adaptive:
+            self.dyn_timeout.log_success(time.monotonic() - t0)
 
         try:
             if resp.status == ERR_STATUS:

@@ -639,7 +639,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         cmd/erasure-decode.go:120-205). None -> Python/device path."""
         from minio_tpu.native import plane
 
-        if algo != "sip256" or length <= 0 or not plane.available():
+        if (algo not in ("sip256", "highwayhash256") or length <= 0
+                or not plane.available()):
             return None
         paths = _local_shard_paths(shuffled, bucket, rel)
         if paths is None:
@@ -676,7 +677,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                         if fut is None:
                             fut = ex.submit(plane.decode_range, paths, k, m,
                                             bs, part.size, pos, wend - pos,
-                                            skip=set(dead))
+                                            skip=set(dead), algorithm=algo)
                         try:
                             data, states = fut.result()
                         except OSError as e:
@@ -693,7 +694,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                         nxt = next(pending, None)
                         fut = (ex.submit(plane.decode_range, paths, k, m,
                                          bs, part.size, nxt[0],
-                                         nxt[1] - nxt[0], skip=set(dead))
+                                         nxt[1] - nxt[0], skip=set(dead),
+                                         algorithm=algo)
                                if nxt is not None else None)
                         yield data
                 finally:
@@ -1068,7 +1070,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         (rename_data IS guarded), matching the quorum outcome either way."""
         from minio_tpu.native import plane
 
-        if self.bitrot_algorithm != "sip256" or not plane.available():
+        if (self.bitrot_algorithm not in ("sip256", "highwayhash256")
+                or not plane.available()):
             return None
         if codec.block_size % 64:
             return None  # md5 segment chaining needs 64-byte alignment
@@ -1079,7 +1082,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         from concurrent.futures import ThreadPoolExecutor
 
         enc = plane.PartEncoder(paths, codec.k, codec.m, codec.block_size,
-                                bitrot.BITROT_KEY)
+                                algorithm=self.bitrot_algorithm)
         for i, p in enumerate(paths):
             try:
                 _os.makedirs(_os.path.dirname(p), exist_ok=True)

@@ -36,6 +36,9 @@ def _build_and_load():
             return None
         lib.mtpu_sip256.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
                                     ctypes.c_uint64, ctypes.c_char_p]
+        lib.mtpu_highwayhash256.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p]
         lib.mtpu_sip256_batch.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
             ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p]
@@ -164,6 +167,19 @@ def sip256(key32: bytes, data: bytes) -> bytes:
         return _sip256_py(key32, data)
     out = ctypes.create_string_buffer(32)
     lib.mtpu_sip256(key32, data, len(data), out)
+    return out.raw
+
+
+def highwayhash256(key32: bytes, data: bytes) -> bytes:
+    """HighwayHash-256 (the reference's default bitrot algorithm) via the
+    native kernel; pure-Python fallback when the toolchain is absent."""
+    lib = _build_and_load()
+    if lib is None:
+        from minio_tpu.native.hh_py import highwayhash256_py
+
+        return highwayhash256_py(key32, data)
+    out = ctypes.create_string_buffer(32)
+    lib.mtpu_highwayhash256(key32, data, len(data), out)
     return out.raw
 
 

@@ -39,6 +39,13 @@ def window_blocks(block_size: int) -> int:
 
 _MD5_INIT = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476)
 
+# Bitrot digest selector for the C pipelines: name -> (algo id, key).
+def _algo_spec(algorithm: str):
+    from minio_tpu.ops.bitrot import BITROT_KEY, HH_BITROT_KEY
+
+    return {"sip256": (0, BITROT_KEY),
+            "highwayhash256": (1, HH_BITROT_KEY)}.get(algorithm)
+
 _bound = False
 
 
@@ -50,7 +57,7 @@ def _lib():
     if not _bound:
         lib.mtpu_encode_part.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
-            ctypes.c_uint32, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint32, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int,
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint64),
@@ -59,7 +66,7 @@ def _lib():
         lib.mtpu_decode_part.argtypes = [
             ctypes.POINTER(ctypes.c_char_p), ctypes.c_char_p,
             ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64,
-            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
             ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_int8)]
         lib.mtpu_decode_part.restype = ctypes.c_int64
@@ -86,15 +93,19 @@ class PartEncoder:
     segments and reported once."""
 
     def __init__(self, paths: list[str], k: int, m: int, block_size: int,
-                 key32: bytes, do_sync: bool = True, threads: int = 0):
+                 do_sync: bool = True, threads: int = 0,
+                 algorithm: str = "sip256"):
         from minio_tpu.ops import gf
 
         self._l = _lib()
-        if self._l is None:
+        spec = _algo_spec(algorithm)
+        if self._l is None or spec is None:
             raise OSError("native plane unavailable")
         self.k, self.m, self.bs = k, m, block_size
         self.n = k + m
-        self._key = key32
+        # ONE key source for both pipelines: the algorithm registry —
+        # encode and decode must never disagree on the framing key.
+        self._algo, self._key = spec
         self._paths = (ctypes.c_char_p * self.n)(
             *[p.encode() for p in paths])
         pm = gf.parity_matrix(k, m) if m else None
@@ -125,7 +136,7 @@ class PartEncoder:
             data = buf if n else None
         rc = self._l.mtpu_encode_part(
             data, n,
-            self.k, self.m, self.bs, self._pmat, self._key,
+            self.k, self.m, self.bs, self._pmat, self._algo, self._key,
             self._paths, self._append, self._do_sync, 1 if final else 0,
             self._threads, self._md5_h, ctypes.byref(self._md5_len),
             self._md5_out, self._rc)
@@ -155,8 +166,9 @@ class PartEncoder:
 def decode_range(paths: list[str], k: int, m: int, block_size: int,
                  part_size: int, offset: int, length: int,
                  threads: int = 0,
-                 skip: set[int] | None = None) -> tuple[bytes | None,
-                                                        list[int]]:
+                 skip: set[int] | None = None,
+                 algorithm: str = "sip256") -> tuple[bytes | None,
+                                                     list[int]]:
     """Serve [offset, offset+length) of a part from its shard files.
 
     Returns (data, shard_state) — data is None when fewer than k shards
@@ -166,11 +178,12 @@ def decode_range(paths: list[str], k: int, m: int, block_size: int,
     `skip` marks shards already known dead (a previous window's <0 states)
     so later windows don't re-read and re-fail them."""
     from minio_tpu.ops import gf
-    from minio_tpu.ops.bitrot import BITROT_KEY
 
     lib = _lib()
-    if lib is None:
+    spec = _algo_spec(algorithm)
+    if lib is None or spec is None:
         raise OSError("native plane unavailable")
+    algo, key = spec
     n = k + m
     gmat = bytes(gf.rs_generator_matrix(k, n).tobytes())
     cpaths = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
@@ -178,7 +191,7 @@ def decode_range(paths: list[str], k: int, m: int, block_size: int,
     state = (ctypes.c_int8 * n)()
     out = ctypes.create_string_buffer(length) if length else b""
     rc = lib.mtpu_decode_part(
-        cpaths, avail, k, m, block_size, part_size, gmat, BITROT_KEY,
+        cpaths, avail, k, m, block_size, part_size, gmat, algo, key,
         offset, length, threads or _threads(),
         ctypes.cast(out, ctypes.c_void_p) if length else None, state)
     states = [state[i] for i in range(n)]

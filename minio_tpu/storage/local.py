@@ -63,6 +63,12 @@ class LocalDrive(StorageAPI):
         return os.path.join(self.root, SYS_VOL, FORMAT_FILE)
 
     def read_format(self) -> dict:
+        # A missing ROOT means the drive is gone (unmounted/failed mount)
+        # — that is FaultyDisk, never UnformattedDisk: heal_format must
+        # not mistake an absent mount for a blank replacement and rebuild
+        # the set onto the parent filesystem.
+        if not os.path.isdir(self.root):
+            raise se.FaultyDisk(f"drive root missing (unmounted?): {self.root}")
         try:
             with open(self._format_path(), "rb") as f:
                 return json.load(f)
@@ -72,9 +78,13 @@ class LocalDrive(StorageAPI):
             raise se.CorruptedFormat(str(e)) from e
 
     def write_format(self, fmt: dict) -> None:
-        # A replaced/blank drive mounted at this path has no directory
-        # skeleton yet — formatting it IS what creates the skeleton
-        # (live heal_format path, reference HealFormat).
+        # A replaced/blank drive MOUNTED at this path has a root dir but
+        # no skeleton — formatting creates the skeleton (live heal_format
+        # path, reference HealFormat). A missing root is an absent drive:
+        # refuse, or the format (and every healed shard after it) would
+        # land on the parent filesystem.
+        if not os.path.isdir(self.root):
+            raise se.FaultyDisk(f"drive root missing (unmounted?): {self.root}")
         os.makedirs(os.path.join(self.root, SYS_VOL, "tmp"), exist_ok=True)
         tmp = self._format_path() + f".tmp.{uuid.uuid4().hex}"
         with open(tmp, "w", encoding="utf-8") as f:

@@ -1291,6 +1291,143 @@ uint32_t mtpu_crc32c_off(const uint8_t* data, uint64_t offset,
 }
 
 // ---------------------------------------------------------------------------
+// HighwayHash-256 — the reference's DEFAULT bitrot algorithm
+// (cmd/bitrot.go:31-38 via minio/highwayhash). Implemented from the
+// published algorithm (Google highwayhash, hh_portable reference;
+// validated against vectors generated by that reference implementation
+// in tests/test_native.py). Used with the reference's magic bitrot key
+// for algorithm-level parity; sip256 remains this framework's default.
+// ---------------------------------------------------------------------------
+
+struct HHState {
+  uint64_t v0[4], v1[4], mul0[4], mul1[4];
+};
+
+static inline uint64_t hh_rot32(uint64_t x) { return (x >> 32) | (x << 32); }
+
+static void hh_reset(HHState* s, const uint8_t* key32) {
+  static const uint64_t init0[4] = {
+      0xdbe6d5d5fe4cce2fULL, 0xa4093822299f31d0ULL, 0x13198a2e03707344ULL,
+      0x243f6a8885a308d3ULL};
+  static const uint64_t init1[4] = {
+      0x3bd39e10cb0ef593ULL, 0xc0acf169b5f18a8cULL, 0xbe5466cf34e90c6cULL,
+      0x452821e638d01377ULL};
+  for (int i = 0; i < 4; ++i) {
+    const uint64_t k = load_le64(key32 + 8 * i);
+    s->mul0[i] = init0[i];
+    s->mul1[i] = init1[i];
+    s->v0[i] = init0[i] ^ k;
+    s->v1[i] = init1[i] ^ hh_rot32(k);
+  }
+}
+
+#define HH_MASKB(v, b) ((v) & (0xFFull << ((b) * 8)))
+
+static inline void hh_zipper(const uint64_t v1, const uint64_t v0,
+                             uint64_t* add1, uint64_t* add0) {
+  *add0 += ((HH_MASKB(v0, 3) + HH_MASKB(v1, 4)) >> 24) +
+           ((HH_MASKB(v0, 5) + HH_MASKB(v1, 6)) >> 16) + HH_MASKB(v0, 2) +
+           (HH_MASKB(v0, 1) << 32) + (HH_MASKB(v1, 7) >> 8) + (v0 << 56);
+  *add1 += ((HH_MASKB(v1, 3) + HH_MASKB(v0, 4)) >> 24) + HH_MASKB(v1, 2) +
+           (HH_MASKB(v1, 5) >> 16) + (HH_MASKB(v1, 1) << 24) +
+           (HH_MASKB(v0, 6) >> 8) + (HH_MASKB(v1, 0) << 48) +
+           HH_MASKB(v0, 7);
+}
+
+#undef HH_MASKB
+
+static void hh_update(HHState* s, const uint64_t lanes[4]) {
+  for (int i = 0; i < 4; ++i) s->v1[i] += lanes[i] + s->mul0[i];
+  for (int i = 0; i < 4; ++i) {
+    const uint32_t v1_32 = static_cast<uint32_t>(s->v1[i]);
+    s->mul0[i] ^= v1_32 * (s->v0[i] >> 32);
+    s->v0[i] += s->mul1[i];
+    const uint32_t v0_32 = static_cast<uint32_t>(s->v0[i]);
+    s->mul1[i] ^= v0_32 * (s->v1[i] >> 32);
+  }
+  hh_zipper(s->v1[1], s->v1[0], &s->v0[1], &s->v0[0]);
+  hh_zipper(s->v1[3], s->v1[2], &s->v0[3], &s->v0[2]);
+  hh_zipper(s->v0[1], s->v0[0], &s->v1[1], &s->v1[0]);
+  hh_zipper(s->v0[3], s->v0[2], &s->v1[3], &s->v1[2]);
+}
+
+static void hh_update_packet(HHState* s, const uint8_t* p) {
+  uint64_t lanes[4];
+  for (int i = 0; i < 4; ++i) lanes[i] = load_le64(p + 8 * i);
+  hh_update(s, lanes);
+}
+
+// Length padding for the final 1..31 bytes (the exact Load3 semantics of
+// the reference: these byte placements are part of the definition).
+static void hh_update_remainder(HHState* s, const uint8_t* bytes,
+                                size_t mod32) {
+  const uint64_t mod32_pair = (static_cast<uint64_t>(mod32) << 32) + mod32;
+  for (int i = 0; i < 4; ++i) s->v0[i] += mod32_pair;
+  for (int i = 0; i < 4; ++i) {  // Rotate32By(v1 halves, mod32); mod32 >= 1
+    const uint32_t lo = static_cast<uint32_t>(s->v1[i]);
+    const uint32_t hi = static_cast<uint32_t>(s->v1[i] >> 32);
+    const uint32_t rlo = (lo << mod32) | (lo >> (32 - mod32));
+    const uint32_t rhi = (hi << mod32) | (hi >> (32 - mod32));
+    s->v1[i] = (static_cast<uint64_t>(rhi) << 32) | rlo;
+  }
+  const size_t mod4 = mod32 & 3;
+  const uint8_t* remainder = bytes + (mod32 & ~size_t{3});
+  uint8_t packet[32] = {0};
+  std::memcpy(packet, bytes, mod32 & ~size_t{3});
+  if (mod32 & 16) {  // 16..31 bytes: last 4 ending at remainder+mod4
+    std::memcpy(packet + 28, remainder + mod4 - 4, 4);
+  } else if (mod4) {  // "unordered" load of 1..3 bytes at packet+16
+    uint64_t last3 = remainder[0];
+    last3 += static_cast<uint64_t>(remainder[mod4 >> 1]) << 8;
+    last3 += static_cast<uint64_t>(remainder[mod4 - 1]) << 16;
+    std::memcpy(packet + 16, &last3, 8);
+  }
+  hh_update_packet(s, packet);
+}
+
+static inline void hh_shift128_left(int bits, uint64_t* a1, uint64_t* a0) {
+  const uint64_t shifted1 = (*a1) << bits;
+  const uint64_t top = (*a0) >> (64 - bits);
+  *a0 <<= bits;
+  *a1 = shifted1 | top;
+}
+
+// Modular reduction by x^128 + x^2 + x (256 -> 128 bits).
+static void hh_modular_reduction(uint64_t a3, const uint64_t a2,
+                                 const uint64_t a1, const uint64_t a0,
+                                 uint64_t* m1, uint64_t* m0) {
+  a3 &= 0x3FFFFFFFFFFFFFFFULL;
+  uint64_t a3s1 = a3, a2s1 = a2, a3s2 = a3, a2s2 = a2;
+  hh_shift128_left(1, &a3s1, &a2s1);
+  hh_shift128_left(2, &a3s2, &a2s2);
+  *m1 = a1 ^ a3s1 ^ a3s2;
+  *m0 = a0 ^ a2s1 ^ a2s2;
+}
+
+void mtpu_highwayhash256(const uint8_t* key32, const uint8_t* data,
+                         uint64_t len, uint8_t* out32) {
+  HHState s;
+  hh_reset(&s, key32);
+  uint64_t i = 0;
+  for (; i + 32 <= len; i += 32) hh_update_packet(&s, data + i);
+  if (len & 31) hh_update_remainder(&s, data + i, len & 31);
+  for (int n = 0; n < 10; ++n) {  // PermuteAndUpdate x10 for 256-bit
+    const uint64_t permuted[4] = {hh_rot32(s.v0[2]), hh_rot32(s.v0[3]),
+                                  hh_rot32(s.v0[0]), hh_rot32(s.v0[1])};
+    hh_update(&s, permuted);
+  }
+  uint64_t r0, r1, r2, r3;
+  hh_modular_reduction(s.v1[1] + s.mul1[1], s.v1[0] + s.mul1[0],
+                       s.v0[1] + s.mul0[1], s.v0[0] + s.mul0[0], &r1, &r0);
+  hh_modular_reduction(s.v1[3] + s.mul1[3], s.v1[2] + s.mul1[2],
+                       s.v0[3] + s.mul0[3], s.v0[2] + s.mul0[2], &r3, &r2);
+  std::memcpy(out32, &r0, 8);
+  std::memcpy(out32 + 8, &r1, 8);
+  std::memcpy(out32 + 16, &r2, 8);
+  std::memcpy(out32 + 24, &r3, 8);
+}
+
+// ---------------------------------------------------------------------------
 // Serving data plane — the native PUT/GET hot pipelines.
 //
 // Role: the reference's erasure hot loop is native end to end — reedsolomon
@@ -1505,7 +1642,15 @@ static int gf_invert_matrix(const uint8_t* in, uint8_t* out, int k) {
   return 0;
 }
 
-static const int kDigestLen = 32;  // sip256
+static const int kDigestLen = 32;  // sip256 / highwayhash256
+
+// Bitrot digest selector for the serving pipelines: 0 = sip256 (this
+// framework's default), 1 = HighwayHash-256 (reference-default parity).
+typedef void (*mtpu_digest_fn)(const uint8_t*, const uint8_t*, uint64_t,
+                               uint8_t*);
+static mtpu_digest_fn digest_for(int algo) {
+  return algo == 1 ? mtpu_highwayhash256 : mtpu_sip256;
+}
 
 // --- native PUT pipeline ---
 //
@@ -1521,11 +1666,13 @@ static const int kDigestLen = 32;  // sip256
 // Returns 0, or -1 on parameter violations.
 int64_t mtpu_encode_part(const uint8_t* data, uint64_t len, uint32_t k,
                          uint32_t m, uint64_t block_size,
-                         const uint8_t* pmat, const uint8_t* key32,
+                         const uint8_t* pmat, int algo,
+                         const uint8_t* key32,
                          const char* const* paths, int append, int do_sync,
                          int finalize, int n_threads, uint32_t* md5_h,
                          uint64_t* md5_len, uint8_t* out_md5,
                          int8_t* drive_rc) {
+  const mtpu_digest_fn digest = digest_for(algo);
   if (!k || block_size == 0 || block_size % 64 != 0) return -1;
   if (!finalize && len % block_size != 0) return -1;
   const uint32_t n = k + m;
@@ -1592,7 +1739,7 @@ int64_t mtpu_encode_part(const uint8_t* data, uint64_t len, uint32_t k,
           chunks[i] = src;
           if (drive_rc[i] >= 0) {
             uint8_t* dst = bufs[i] + off;
-            mtpu_sip256(key32, src, cl, dst);
+            digest(key32, src, cl, dst);
             std::memcpy(dst + kDigestLen, src, cl);
           }
         }
@@ -1602,7 +1749,7 @@ int64_t mtpu_encode_part(const uint8_t* data, uint64_t len, uint32_t k,
           std::memset(p, 0, cl);
           for (uint32_t i = 0; i < k; ++i)
             gf_mul_xor_region(p, chunks[i], pmat[j * k + i], cl);
-          mtpu_sip256(key32, p, cl, p - kDigestLen);
+          digest(key32, p, cl, p - kDigestLen);
         }
       }
     };
@@ -1696,10 +1843,11 @@ int64_t mtpu_encode_part(const uint8_t* data, uint64_t len, uint32_t k,
 // k shards survive, -1 on parameter violations.
 int64_t mtpu_decode_part(const char* const* paths, const uint8_t* avail,
                          uint32_t k, uint32_t m, uint64_t block_size,
-                         uint64_t part_size, const uint8_t* gmat,
+                         uint64_t part_size, const uint8_t* gmat, int algo,
                          const uint8_t* key32, uint64_t offset,
                          uint64_t length, int n_threads, uint8_t* out,
                          int8_t* shard_state) {
+  const mtpu_digest_fn digest = digest_for(algo);
   if (!k || !block_size || offset + length > part_size) return -1;
   const uint32_t n = k + m;
   if (length == 0) return 0;
@@ -1769,7 +1917,7 @@ int64_t mtpu_decode_part(const char* const* paths, const uint8_t* avail,
       for (uint64_t b = first; b <= last; ++b) {
         const uint8_t* rec = sbuf[ci].data() + (b - first) * rec_full;
         const uint64_t cl = chunk_len(b);
-        mtpu_sip256(key32, rec + kDigestLen, cl, dig);
+        digest(key32, rec + kDigestLen, cl, dig);
         if (std::memcmp(dig, rec, kDigestLen) != 0) {
           shard_state[i] = -2;
           dead[i] = true;

@@ -161,3 +161,106 @@ def test_native_kernels_under_tsan(tmp_path):
     assert "WARNING: ThreadSanitizer" not in r.stderr, r.stderr[:2000]
     assert r.returncode == 0 and "TSAN_CLEAN" in r.stdout, \
         (r.returncode, r.stderr[:2000])
+
+
+# HighwayHash-256 known-answer vectors GENERATED from Google's reference
+# portable implementation (highwayhash hh_portable.h, compiled locally) —
+# the same algorithm the reference consumes via minio/highwayhash
+# (cmd/bitrot.go:31). Key = bytes 0..31 LE; input data[i] = i.
+_HH256_STD_VECTORS = {
+    0:   "dd44482ac2c874f5d946017313c7351fb3aebeccb98714ff41da233145751df4",
+    1:   "edb941bce45f8254e20d44ef3dcac60f72651b9bcb324a472073624cb275e484",
+    3:   "480aa0d70dd1d95c89225e7c6911d1d08ea8426b8bbb865ae23dfbc390e1c722",
+    31:  "6880e276601a644db3728b20b10fb7dad0bd12060610d16e8aef14ef33452ef2",
+    32:  "bce38c9039a1c3fe42d56326a3c11289e35595f764fcaea9c9b03c6bc9475a99",
+    33:  "f60115cbf034a6e56c36ea75bfce46d03b17c8d3827259907edaa2ed11007a35",
+    63:  "f5b1f8266a3aeb6783b040be4dec1add7fe1c8635b26fbaef4a3a447defed79f",
+    64:  "90d8e6ff6ac124751a422a196edac1f29e3765fe1f8eb002c1bdd7c4c351cfbe",
+    65:  "41719717a410f399a27f4b7cb3c15f677427b7077c68aff126d167386525368c",
+    97:  "7aae8bff45fd4b64d82902a12cda8c06aa00ce9a568ca7e80272748a0c064109",
+    128: "0acddc7cf08a560f46648f07b17cda688a6cf88f307345ffa515bab638bbb6b6",
+    255: "7602e4f9fde48d5ad99756b352d897acfd06627dca5ab1a149e86ddfb4439cae",
+}
+# With the reference's magic bitrot key (cmd/bitrot.go:31):
+_HH256_MAGIC_FOX = ("b984e49eaee75a0f6b3616b875aee3a0"
+                    "35ed82698d49728314203b83e5cbd239")
+_HH256_MAGIC_200 = ("e3b26737efc9d57d0515218d939b90db"
+                    "60142eea69b108cbd2215c04b4ef09c6")
+
+
+def _hh_vec(s: str) -> bytes:
+    """Vectors record the four u64 HASH WORDS; the digest serializes
+    them little-endian (as the Go implementation's Sum does)."""
+    return b"".join(int(s[i:i + 16], 16).to_bytes(8, "little")
+                    for i in range(0, 64, 16))
+
+
+def test_highwayhash256_reference_vectors():
+    from minio_tpu.native.lib import highwayhash256
+    from minio_tpu.ops.bitrot import HH_BITROT_KEY
+
+    std_key = bytes(range(32))
+    data = bytes(range(256))
+    for n, want in _HH256_STD_VECTORS.items():
+        assert highwayhash256(std_key, data[:n]) == _hh_vec(want), n
+    msg = b"The quick brown fox jumps over the lazy dog"
+    assert highwayhash256(HH_BITROT_KEY, msg) == _hh_vec(_HH256_MAGIC_FOX)
+    assert highwayhash256(HH_BITROT_KEY, data[:200]) == _hh_vec(_HH256_MAGIC_200)
+
+
+def test_highwayhash256_python_port_bit_exact():
+    """The pure-Python fallback agrees with the native kernel on the
+    vectors and on fuzzed sizes (both validated against Google's
+    reference implementation)."""
+    import numpy as np
+
+    from minio_tpu.native.hh_py import highwayhash256_py
+    from minio_tpu.native.lib import highwayhash256
+
+    std_key = bytes(range(32))
+    data = bytes(range(256))
+    for n, want in _HH256_STD_VECTORS.items():
+        assert highwayhash256_py(std_key, data[:n]) == _hh_vec(want), n
+    rng2 = np.random.default_rng(11)
+    for n in [0, 1, 2, 4, 5, 7, 8, 15, 16, 17, 29, 30, 47, 100, 1000, 4097]:
+        blob = rng2.integers(0, 256, n, dtype=np.uint8).tobytes()
+        key = rng2.integers(0, 256, 32, dtype=np.uint8).tobytes()
+        assert highwayhash256_py(key, blob) == highwayhash256(key, blob), n
+
+
+def test_highwayhash256_registry_and_serving_plane(tmp_path):
+    """highwayhash256 is a first-class bitrot algorithm: registry digest,
+    native PUT/GET plane round trip, and corruption detection."""
+    import io
+
+    from minio_tpu.erasure import ErasureObjects
+    from minio_tpu.ops import bitrot as br
+    from minio_tpu.storage import LocalDrive
+
+    algo = br.get_algorithm("highwayhash256")
+    assert algo.digest_len == 32
+    assert algo.digest(b"x") != algo.digest(b"y")
+
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureObjects(drives, parity=1, block_size=1 << 16,
+                        bitrot_algorithm="highwayhash256")
+    es.make_bucket("hhb")
+    data = os.urandom(300_000)
+    info = es.put_object("hhb", "obj", io.BytesIO(data), len(data))
+    import hashlib as _hl
+    assert info.etag == _hl.md5(data).hexdigest()
+    _, stream = es.get_object("hhb", "obj")
+    assert b"".join(stream) == data
+    # A flipped byte in a data-slot shard is detected and reconstructed.
+    from minio_tpu.erasure.metadata import hash_order, shuffle_by_distribution
+    root = shuffle_by_distribution(es.drives, hash_order("hhb/obj", 4))[0].root
+    shard = None
+    for dirpath, _d, files in os.walk(os.path.join(root, "hhb", "obj")):
+        for f in files:
+            if f.startswith("part."):
+                shard = os.path.join(dirpath, f)
+    blob = bytearray(open(shard, "rb").read())
+    blob[40] ^= 0xFF
+    open(shard, "wb").write(bytes(blob))
+    _, stream = es.get_object("hhb", "obj")
+    assert b"".join(stream) == data

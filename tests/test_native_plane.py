@@ -18,7 +18,6 @@ import pytest
 from minio_tpu.erasure import ErasureObjects
 from minio_tpu.erasure.types import CompletePart
 from minio_tpu.native import plane
-from minio_tpu.ops.bitrot import BITROT_KEY
 from minio_tpu.storage import LocalDrive
 from minio_tpu.utils import errors as se
 
@@ -151,7 +150,7 @@ def test_segmented_feed_md5_chains():
     root = tempfile.mkdtemp()
     paths = [os.path.join(root, f"s{i}") for i in range(k + m)]
     data = _payload(5 * bs + 123)
-    enc = plane.PartEncoder(paths, k, m, bs, BITROT_KEY)
+    enc = plane.PartEncoder(paths, k, m, bs)
     enc.feed(data[: 2 * bs], final=False)
     enc.feed(data[2 * bs: 4 * bs], final=False)
     enc.feed(data[4 * bs:], final=True)
