@@ -82,6 +82,21 @@ def _build_and_load():
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
             ctypes.POINTER(ctypes.c_uint64)]
         lib.mtpu_csv_index.restype = ctypes.c_int64
+        lib.mtpu_csv_count.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint8,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+        lib.mtpu_csv_agg_fused.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint8,
+            ctypes.c_uint8, ctypes.c_int, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_double, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_uint32, ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.mtpu_csv_agg_fused.restype = ctypes.c_int64
         lib.mtpu_csv_parse_floats.argtypes = [
             ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_uint64, ctypes.c_uint8, ctypes.c_void_p]
@@ -289,9 +304,14 @@ def csv_index(data: bytes, delim: bytes = b",", quote: bytes = b'"'):
         raise OSError("native csv indexer unavailable")
     # The tokenizer ends records at \n, \r and \r\n — bound capacity by
     # BOTH terminators (CR-only files would otherwise overflow the bound).
-    newlines = data.count(b"\n") + data.count(b"\r")
-    max_fields = data.count(delim) + newlines + 2
-    max_rows = newlines + 2
+    # One native pass sizes both tables (three bytes.count passes cost
+    # ~15 ms per 14 MB chunk on the hot Select path).
+    _d = ctypes.c_uint64(0)
+    _nl = ctypes.c_uint64(0)
+    lib.mtpu_csv_count(data, len(data), delim[0],
+                       ctypes.byref(_d), ctypes.byref(_nl))
+    max_fields = _d.value + _nl.value + 2
+    max_rows = _nl.value + 2
     foff = np.empty(max_fields, dtype=np.int64)
     flen = np.empty(max_fields, dtype=np.int32)
     row_start = np.empty(max_rows + 1, dtype=np.int64)
@@ -304,6 +324,51 @@ def csv_index(data: bytes, delim: bytes = b",", quote: bytes = b'"'):
         raise ValueError("csv index capacity exceeded")
     return (row_start[:nrows + 1], foff[:nfields.value],
             flen[:nfields.value])
+
+
+def csv_agg_fused(data: bytes, delim: bytes, quote: bytes,
+                  skip_header: bool, pred_col: int, pred_op: int,
+                  pred_rhs: float, agg_cols: list[int]):
+    """One-pass fused CSV aggregate scan (predicate + COUNT/SUM/min-max
+    candidates). Returns None when the data contains a construct the fast
+    lane must not guess at (quotes, ragged rows, odd numerics) — the
+    caller reruns the chunk through the exact path. Otherwise returns a
+    dict of per-aggregate accumulators plus matched/scanned counts."""
+    lib = _build_and_load()
+    if lib is None:
+        return None
+    na = len(agg_cols)
+    cols = (ctypes.c_int32 * max(na, 1))(*agg_cols)
+    sums = (ctypes.c_double * max(na, 1))()
+    counts = (ctypes.c_uint64 * max(na, 1))()
+    nums = (ctypes.c_uint64 * max(na, 1))()
+    mins = (ctypes.c_double * max(na, 1))()
+    maxs = (ctypes.c_double * max(na, 1))()
+    min_off = (ctypes.c_int64 * max(na, 1))()
+    min_len = (ctypes.c_int32 * max(na, 1))()
+    max_off = (ctypes.c_int64 * max(na, 1))()
+    max_len = (ctypes.c_int32 * max(na, 1))()
+    matched = ctypes.c_uint64(0)
+    scanned = ctypes.c_uint64(0)
+    odd_at = ctypes.c_int64(-1)
+    rc = lib.mtpu_csv_agg_fused(
+        data, len(data), delim[0], quote[0], 1 if skip_header else 0,
+        pred_col, pred_op, pred_rhs, cols, na, sums, counts, nums,
+        mins, maxs, min_off, min_len, max_off, max_len,
+        ctypes.byref(matched), ctypes.byref(scanned), ctypes.byref(odd_at))
+    if rc != 0:
+        return None
+    return {
+        "matched": matched.value, "scanned": scanned.value,
+        "aggs": [
+            {"sum": sums[i], "count": counts[i], "num": nums[i],
+             "min_field": (data[min_off[i]:min_off[i] + min_len[i]]
+                           if nums[i] else None),
+             "max_field": (data[max_off[i]:max_off[i] + max_len[i]]
+                           if nums[i] else None)}
+            for i in range(na)
+        ],
+    }
 
 
 def csv_parse_floats(data: bytes, foff, flen, quote: bytes = b'"'):

@@ -153,7 +153,34 @@ def run_select(body_stream, request: S3SelectRequest
     if request.input_format == "PARQUET":
         import struct as _struct
 
-        from minio_tpu.s3select.parquet import ParquetError, iter_parquet_records
+        from minio_tpu.s3select.parquet import (
+            ParquetError,
+            ParquetReader,
+            iter_parquet_records,
+        )
+
+        # Column-chunk vector lane (vector.py ParquetVectorPlan): masks
+        # over decoded columns, row dicts only for surviving rows.
+        from minio_tpu.s3select import vector as _vec
+
+        pplan = _vec.compile_plan_parquet(query, request)
+        if pplan is not None:
+            # Decode inside the malformed-input guard (exactly the scope
+            # the row path wraps); EVALUATION errors propagate distinctly.
+            try:
+                raw_pq = (body_stream.read()
+                          if hasattr(body_stream, "read")
+                          else bytes(body_stream))
+                reader = ParquetReader(raw_pq)
+                groups = list(reader.iter_column_groups())
+            except ParquetError as e:
+                raise SelectError(f"parquet: {e}") from None
+            except (_struct.error, zlib.error, IndexError,
+                    KeyError, ValueError, OverflowError, MemoryError) as e:
+                raise SelectError(
+                    f"parquet: malformed input ({e})") from None
+            yield from pplan.run(reader, groups, request, query)
+            return
 
         try:
             rows = iter(list(iter_parquet_records(body_stream)))

@@ -408,8 +408,10 @@ class ParquetReader:
                 out.append(None)
         return out
 
-    def iter_rows(self) -> Iterator[dict]:
-        """Yield rows as {column: value} dicts (the SQL engine's shape)."""
+    def iter_column_groups(self) -> Iterator[tuple[int, dict[str, list]]]:
+        """Yield (n_rows, {column: decoded values}) per row group — the
+        COLUMN-CHUNK form the vectorized Select lane consumes directly
+        (row dicts are only materialized for rows that survive WHERE)."""
         for rg in self.row_groups:
             chunks = rg.get(1, [])
             data: dict[str, list] = {}
@@ -422,9 +424,17 @@ class ParquetReader:
                 if col is None:
                     continue
                 data[name] = self._read_column_chunk(col, md)
+            yield n_rows, data
+
+    def row_dict(self, data: dict[str, list], n_rows: int, i: int) -> dict:
+        return {c.name: (data.get(c.name) or [None] * n_rows)[i]
+                for c in self.columns}
+
+    def iter_rows(self) -> Iterator[dict]:
+        """Yield rows as {column: value} dicts (the SQL engine's shape)."""
+        for n_rows, data in self.iter_column_groups():
             for i in range(n_rows):
-                yield {c.name: (data.get(c.name) or [None] * n_rows)[i]
-                       for c in self.columns}
+                yield self.row_dict(data, n_rows, i)
 
 
 def iter_parquet_records(stream) -> Iterator[dict]:

@@ -31,7 +31,7 @@ from minio_tpu.s3select.sql import (
     Unary,
 )
 
-CHUNK = 4 << 20
+CHUNK = 16 << 20
 
 
 class _Unsupported(Exception):
@@ -82,6 +82,14 @@ def _eval_bool_tree(node, n: int, leaf_eval):
             known = (lk & rk) | (lk & lv) | (rk & rv)
         return value & known, known
     return leaf_eval(node)
+
+
+def _name_candidates(name: str) -> list[str]:
+    """Column-name resolution candidates (ONE copy of the rule every
+    lane must share with the Evaluator): exact, alias-segment dropped,
+    last segment."""
+    return ([name] + ([name.split(".", 1)[1], name.rsplit(".", 1)[-1]]
+                      if "." in name else []))
 
 
 _FLOAT_CASTS = {"FLOAT", "DOUBLE", "DECIMAL", "NUMERIC", "REAL"}
@@ -262,9 +270,7 @@ class VectorPlan:
     def _ci(self, name: str) -> int | None:
         """Mirror Evaluator's Col resolution: exact name, then with the
         leading table-alias segment dropped, then the last segment."""
-        for cand in ([name]
-                     + ([name.split(".", 1)[1], name.rsplit(".", 1)[-1]]
-                        if "." in name else [])):
+        for cand in _name_candidates(name):
             if cand.startswith("_") and cand[1:].isdigit():
                 return int(cand[1:]) - 1
             ci = self._col_idx.get(cand)
@@ -337,6 +343,7 @@ class VectorPlan:
     def chunks(self, stream):
         carry = b""
         q = (self.request.csv_quote or '"').encode()
+        clean = True  # no quote char seen yet (carry included)
         while True:
             buf = stream.read(CHUNK)
             if not buf:
@@ -344,6 +351,18 @@ class VectorPlan:
                     yield carry
                 return
             data = carry + buf
+            # Clean-data fast path: with no quote anywhere, every
+            # terminator is a record boundary — skip the quote-parity
+            # rescan of the whole chunk (one memchr vs one count pass).
+            if clean and q not in buf:
+                cut = max(data.rfind(b"\n"), data.rfind(b"\r"))
+                if cut < 0:
+                    carry = data
+                    continue
+                yield data[:cut + 1]
+                carry = data[cut + 1:]
+                continue
+            clean = False
             cut = len(data)
             while True:
                 # A record terminator is \n, \r or \r\n: split at the
@@ -362,6 +381,100 @@ class VectorPlan:
                 continue
             yield data[:cut + 1]
             carry = data[cut + 1:]
+
+    # -- fused native aggregate lane --------------------------------------
+
+    _FUSED_OPS = {">": 1, ">=": 2, "<": 3, "<=": 4, "=": 5, "<>": 6}
+
+    def fused_agg_shape(self) -> bool:
+        """True when the query fits the one-pass native aggregate scan:
+        aggregate-only projections and a WHERE that is absent or a single
+        numeric comparison. The scan itself still aborts per chunk on any
+        data construct whose exact semantics belong to the slow path."""
+        if not self.query.aggregates:
+            return False
+        if self.where is None:
+            return True
+        return (isinstance(self.where, _Cmp)
+                and not isinstance(self.where.lit, str))
+
+    def _bootstrap_header(self, chunk: bytes) -> bool:
+        """Resolve column names from the first line WITHOUT building a
+        batch (the fused lane never tokenizes). False -> fall back."""
+        if self._header_done:
+            return True
+        # The header is the first NON-blank record (blank records are
+        # filtered everywhere, including by the native scan).
+        pos = 0
+        line = b""
+        while pos < len(chunk):
+            ends = [i for i in (chunk.find(b"\n", pos),
+                                chunk.find(b"\r", pos)) if i >= 0]
+            if not ends:
+                return False
+            end = min(ends)
+            line = chunk[pos:end]
+            if line:
+                break
+            pos = end + 1
+        if not line:
+            return False
+        q = (self.request.csv_quote or '"').encode()
+        if q in line:
+            return False  # quoted header: exact path parses it
+        if (self.request.csv_header or "USE").upper() == "USE":
+            delim = (self.request.csv_delimiter or ",").encode()
+            self.names = [f.decode("utf-8", "replace")
+                          for f in line.split(delim)]
+            self._col_idx = {nm: i for i, nm in enumerate(self.names)}
+        return True
+
+    def try_fused_chunk(self, chunk: bytes, ev: Evaluator) -> int | None:
+        """Run the native fused aggregate scan over one chunk and fold the
+        results into ev.agg_state exactly as the vector loop would.
+        Returns rows scanned, or None -> caller uses the exact path."""
+        if not self._bootstrap_header(chunk):
+            return None
+        if self.where is not None:
+            pred_ci = self._ci(self.where.col)
+            if pred_ci is None:
+                return None  # unknown column: NULL semantics, slow path
+            pred_op = self._FUSED_OPS[self.where.op]
+            pred_rhs = float(self.where.lit)
+        else:
+            pred_ci, pred_op, pred_rhs = -1, 0, 0.0
+        agg_cols = []
+        for f in self.query.aggregates:
+            if f.star:
+                agg_cols.append(-1)
+            else:
+                ci = self._ci(f.args[0].name)
+                agg_cols.append(-1 if ci is None else ci)
+        skip_header = (not self._header_done
+                       and (self.request.csv_header or "USE").upper()
+                       in ("USE", "IGNORE"))
+        res = nativelib.csv_agg_fused(
+            chunk, (self.request.csv_delimiter or ",").encode(),
+            (self.request.csv_quote or '"').encode(), skip_header,
+            pred_ci, pred_op, pred_rhs, agg_cols)
+        if res is None:
+            return None
+        self._header_done = True
+        for f, st, agg in zip(self.query.aggregates, ev.agg_state,
+                              res["aggs"]):
+            if f.star:
+                st["count"] += res["matched"]
+                continue
+            st["count"] += agg["count"]
+            if agg["num"]:
+                st["sum"] += agg["sum"]
+                for fld in (agg["min_field"], agg["max_field"]):
+                    nv = _num_py(fld.decode("utf-8", "replace"))
+                    if nv is None:
+                        continue
+                    st["min"] = nv if st["min"] is None else min(st["min"], nv)
+                    st["max"] = nv if st["max"] is None else max(st["max"], nv)
+        return res["scanned"]
 
     def consume_header(self, batch: _Batch) -> None:
         """Resolve column names from the first row of the first batch."""
@@ -741,9 +854,17 @@ def run_vectorized(plan: VectorPlan, raw_stream, request,
     header_order: list[str] = []
     done = False
 
+    fused_ok = ev.is_aggregate and plan.fused_agg_shape()
     for chunk in plan.chunks(raw_stream):
         if done:
             break
+        if fused_ok:
+            # Native one-pass lane: predicate + aggregates with no field
+            # table at all; per-chunk exact fallback on any odd construct.
+            got = plan.try_fused_chunk(chunk, ev)
+            if got is not None:
+                scanned += got
+                continue
         batch = _Batch(chunk, plan)
         plan.consume_header(batch)
         if batch.nrows == 0:
@@ -832,3 +953,189 @@ def run_vectorized(plan: VectorPlan, raw_stream, request,
         yield msg
     yield es.stats_message(scanned, scanned, returned)
     yield es.end_message()
+
+
+# --- Parquet column-chunk lane ----------------------------------------------
+
+def compile_plan_parquet(query: Query, request) -> "ParquetVectorPlan | None":
+    """Column-chunk evaluation for Parquet (the vector lane's third input
+    format): WHERE evaluates as masks over the decoded column chunks and
+    row dicts materialize ONLY for surviving rows; aggregates accumulate
+    sequentially in row order over typed values — the row engine's exact
+    arithmetic, minus its per-row dict builds and AST walks."""
+    if request.input_format != "PARQUET":
+        return None
+    try:
+        where = _compile_where(query.where)
+    except _Unsupported:
+        return None
+    if query.aggregates:
+        for p in query.projections:
+            if not (isinstance(p.expr, Func) and p.expr in query.aggregates):
+                return None
+        for f in query.aggregates:
+            if not f.star and not (len(f.args) == 1
+                                   and isinstance(f.args[0], Col)
+                                   and f.args[0].name):
+                return None
+    else:
+        for p in query.projections:
+            if p.expr is None:
+                continue
+            if not (isinstance(p.expr, Col) and p.expr.name):
+                return None
+    return ParquetVectorPlan(query, where, request)
+
+
+_TWO53 = 1 << 53
+
+
+class _PqCol:
+    """One column chunk classified for vector evaluation: float64 values
+    where exact, with present/numeric masks and the indices of rows whose
+    values need exact row-wise handling (big ints, exotic types)."""
+
+    __slots__ = ("vals", "numeric", "present", "odd")
+
+    def __init__(self, raw: list):
+        n = len(raw)
+        self.vals = np.zeros(n, np.float64)
+        self.numeric = np.zeros(n, bool)
+        self.present = np.zeros(n, bool)
+        odd = []
+        for i, v in enumerate(raw):
+            if v is None:
+                continue
+            self.present[i] = True
+            t = type(v)
+            if t is float:
+                self.vals[i] = v
+                self.numeric[i] = True
+            elif t is int:
+                if -_TWO53 <= v <= _TWO53:
+                    self.vals[i] = v
+                    self.numeric[i] = True
+                else:
+                    odd.append(i)  # exact big-int semantics: row-wise
+            else:
+                # bool / str / anything exotic: the row engine's coercion
+                # rules decide (e.g. numeric strings under CAST) — never
+                # guess in the fast lane.
+                odd.append(i)
+        self.odd = odd
+
+
+class ParquetVectorPlan:
+    def __init__(self, query: Query, where, request):
+        self.query = query
+        self.where = where
+        self.request = request
+        self._names: list[str] = []
+
+    def _colname(self, name: str, data: dict) -> str | None:
+        for cand in _name_candidates(name):
+            if cand in data:
+                return cand
+        return None
+
+    def _leaf(self, node, cols: dict, raw: dict, n: int, ev: Evaluator,
+              row_of):
+        cn = self._colname(node.col, raw)
+        if cn is None:
+            return np.zeros(n, bool), np.zeros(n, bool)
+        if isinstance(node.lit, str):
+            vals = raw[cn]
+            eq = np.fromiter((isinstance(v, str) and v == node.lit
+                              for v in vals), bool, n)
+            present = np.fromiter((v is not None for v in vals), bool, n)
+            value = eq if node.op == "=" else (~eq & present)
+            value = value & present
+            known = present.copy()
+            # Present non-str values (bools, numbers): the row engine's
+            # coercion rules decide — evaluate those rows exactly.
+            for ri, v in enumerate(vals):
+                if v is not None and not isinstance(v, str):
+                    res = ev.eval(node.node, row_of(ri))
+                    known[ri] = res is not None
+                    value[ri] = bool(res) if res is not None else False
+            return value, known
+        c = cols.setdefault(cn, _PqCol(raw[cn]))
+        lit = float(node.lit)
+        ops = {"=": np.equal, "<>": np.not_equal, "<": np.less,
+               "<=": np.less_equal, ">": np.greater, ">=": np.greater_equal}
+        value = ops[node.op](c.vals, lit) & c.numeric
+        known = c.numeric.copy()
+        for ri in c.odd:  # exact row-wise semantics for exotic values
+            res = ev.eval(node.node, row_of(ri))
+            if res is not None:
+                known[ri] = True
+                value[ri] = bool(res)
+        return value, known
+
+    def run(self, reader, groups, request, query) -> "Iterator[bytes]":
+        import io as _io
+
+        from minio_tpu.s3select import eventstream as es
+        from minio_tpu.s3select.engine import RECORDS_FLUSH, _serialize
+
+        ev = Evaluator(query)
+        scanned = 0
+        returned = 0
+        emitted = 0
+        pending = _io.BytesIO()
+        header_order: list[str] = []
+        done = False
+
+        def flush():
+            nonlocal returned
+            data = pending.getvalue()
+            if not data:
+                return None
+            pending.seek(0)
+            pending.truncate()
+            returned += len(data)
+            return es.records_message(data)
+
+        for n_rows, data in groups:
+            if done:
+                break
+            if n_rows == 0:
+                continue
+            scanned += n_rows
+            cols: dict[str, _PqCol] = {}
+            row_of = lambda ri: reader.row_dict(data, n_rows, ri)  # noqa: E731
+            v, k = _eval_bool_tree(
+                self.where, n_rows,
+                lambda nd: self._leaf(nd, cols, data, n_rows, ev, row_of))
+            mask = v & k
+            if ev.is_aggregate:
+                # Sequential accumulation over the surviving rows — the
+                # row engine's arithmetic and order exactly; the columns
+                # only decided WHO survives.
+                for ri in np.nonzero(mask)[0]:
+                    ev.accumulate(row_of(int(ri)))
+                continue
+            for ri in np.nonzero(mask)[0]:
+                out = ev.project(row_of(int(ri)))
+                if not header_order:
+                    header_order = [kk for kk in out
+                                    if not (kk.startswith("_")
+                                            and kk[1:].isdigit())] or list(out)
+                pending.write(_serialize(out, request, header_order).encode())
+                emitted += 1
+                if pending.tell() >= RECORDS_FLUSH:
+                    msg = flush()
+                    if msg:
+                        yield msg
+                if query.limit is not None and emitted >= query.limit:
+                    scanned -= n_rows - (int(ri) + 1)
+                    done = True
+                    break
+        if ev.is_aggregate:
+            out_row = ev.project({})
+            pending.write(_serialize(out_row, request, list(out_row)).encode())
+        msg = flush()
+        if msg:
+            yield msg
+        yield es.stats_message(scanned, scanned, returned)
+        yield es.end_message()

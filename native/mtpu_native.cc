@@ -544,6 +544,257 @@ int64_t mtpu_csv_index(const uint8_t* data, uint64_t n, uint8_t delim,
   return static_cast<int64_t>(nr);
 }
 
+// One-pass capacity counter for csv_index's table sizing — replaces three
+// Python bytes.count passes with a single scan.
+void mtpu_csv_count(const uint8_t* data, uint64_t n, uint8_t delim,
+                    uint64_t* out_delims, uint64_t* out_newlines) {
+  uint64_t d = 0, nl = 0;
+  for (uint64_t i = 0; i < n; ++i) {
+    const uint8_t c = data[i];
+    d += (c == delim);
+    nl += (c == '\n') | (c == '\r');
+  }
+  *out_delims = d;
+  *out_newlines = nl;
+}
+
+// Fast decimal parse for the common [-]digits[.digits] shape; exact for
+// <= 15 significant digits. Returns 1 on clean parse, 0 when the field
+// needs the slow/exact path. Leading/trailing spaces tolerated.
+static const double kPow10[19] = {
+    1e0, 1e1, 1e2, 1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10, 1e11, 1e12,
+    1e13, 1e14, 1e15, 1e16, 1e17, 1e18};
+
+static inline int fast_float_field(const uint8_t* p, int32_t l,
+                                   double* out) {
+  while (l > 0 && (*p == ' ' || *p == '\t')) { ++p; --l; }
+  while (l > 0 && (p[l - 1] == ' ' || p[l - 1] == '\t')) --l;
+  if (l <= 0) return 0;
+  bool neg = false;
+  if (*p == '-' || *p == '+') {
+    neg = *p == '-';
+    ++p; --l;
+  }
+  uint64_t mant = 0;
+  int digits = 0, frac = 0;
+  bool seen_dot = false;
+  for (int32_t i = 0; i < l; ++i) {
+    const uint8_t c = p[i];
+    if (c >= '0' && c <= '9') {
+      mant = mant * 10 + (c - '0');
+      ++digits;
+      if (seen_dot) ++frac;
+    } else if (c == '.' && !seen_dot) {
+      seen_dot = true;
+    } else {
+      return 0;  // exponent/hex/nan/inf/garbage: slow path decides
+    }
+  }
+  if (digits == 0 || digits > 15) return 0;  // >15: exact-int semantics
+  double v = static_cast<double>(mant) / kPow10[frac];
+  *out = neg ? -v : v;
+  return 1;
+}
+
+// ---------------------------------------------------------------------------
+// Fused CSV aggregate scan — the S3 Select fast lane: tokenize rows,
+// evaluate a single numeric WHERE comparison, and accumulate COUNT/SUM
+// and min/max CANDIDATE POSITIONS for up to 8 aggregate columns, all in
+// one pass with no field table. Exactness contract: the scan ABORTS
+// (returns -1 with odd_at) at the first construct whose semantics the
+// fast lane cannot reproduce bit-for-bit — any quote character, a ragged
+// row missing a needed column, or a digit-bearing field that does not
+// parse as a plain <=15-digit decimal — and the caller reruns the chunk
+// through the exact vectorized/row machinery. Sums accumulate
+// SEQUENTIALLY in row order (the row engine's order). min/max report
+// field positions so the caller re-derives exact Python numerics for
+// serialization. pred_op: 0 none, 1 >, 2 >=, 3 <, 4 <=, 5 ==, 6 !=.
+// ---------------------------------------------------------------------------
+int64_t mtpu_csv_agg_fused(
+    const uint8_t* data, uint64_t n, uint8_t delim, uint8_t quote,
+    int skip_header, int32_t pred_col, int32_t pred_op, double pred_rhs,
+    const int32_t* agg_cols, uint32_t n_aggs, double* agg_sum,
+    uint64_t* agg_count, uint64_t* agg_num, double* agg_min,
+    double* agg_max, int64_t* min_off, int32_t* min_len, int64_t* max_off,
+    int32_t* max_len, uint64_t* matched, uint64_t* rows_scanned,
+    int64_t* odd_at) {
+  if (n_aggs > 8) return -2;
+  int32_t max_col = pred_op ? pred_col : -1;
+  for (uint32_t a = 0; a < n_aggs; ++a)
+    if (agg_cols[a] > max_col) max_col = agg_cols[a];
+  int64_t foff[64];
+  int32_t flen[64];
+  if (max_col >= 64) return -2;
+
+  uint64_t row = 0;
+  *matched = 0;
+  *rows_scanned = 0;
+  // Streaming state: current row's field boundaries accumulate as the
+  // special-byte scan advances; rows finish at any terminator. Any
+  // terminator ends a record and empty records are filtered — exactly
+  // the vectorized batch's semantics (so \r\n simply yields a filtered
+  // blank at the \n).
+  int32_t nf = 0;
+  uint64_t fstart = 0, row_start = 0;
+  bool aborted = false;
+  uint64_t abort_at = 0;
+
+  auto end_field = [&](uint64_t at) {
+    if (nf <= max_col) {
+      foff[nf] = static_cast<int64_t>(fstart);
+      flen[nf] = static_cast<int32_t>(at - fstart);
+    }
+    ++nf;
+    fstart = at + 1;
+  };
+
+  auto finish_row = [&](uint64_t at) -> bool {  // false => abort
+    const uint64_t rs = row_start;
+    const int32_t f0len = static_cast<int32_t>(at - rs);
+    end_field(at);
+    const int32_t row_nf = nf;
+    nf = 0;
+    row_start = fstart;
+    if (row_nf == 1 && f0len == 0) return true;  // blank record: filtered
+    ++row;
+    if (skip_header && row == 1) return true;
+    ++*rows_scanned;
+    double pv = 0.0;
+    bool have_pv = false;
+    if (pred_op) {
+      if (pred_col >= row_nf) {
+        abort_at = rs;
+        return false;  // ragged row missing the predicate column
+      }
+      if (!fast_float_field(data + foff[pred_col], flen[pred_col], &pv)) {
+        abort_at = rs;
+        return false;  // CAST semantics on odd input: exact path decides
+      }
+      have_pv = true;
+      bool hit;
+      switch (pred_op) {
+        case 1: hit = pv > pred_rhs; break;
+        case 2: hit = pv >= pred_rhs; break;
+        case 3: hit = pv < pred_rhs; break;
+        case 4: hit = pv <= pred_rhs; break;
+        case 5: hit = pv == pred_rhs; break;
+        default: hit = pv != pred_rhs; break;
+      }
+      if (!hit) return true;
+    }
+    ++*matched;
+    for (uint32_t a = 0; a < n_aggs; ++a) {
+      const int32_t c = agg_cols[a];
+      if (c < 0 || c >= row_nf) continue;  // star / MISSING column
+      const int32_t l = flen[c];
+      if (l == 0) {  // empty field: present for COUNT, never numeric
+        ++agg_count[a];
+        continue;
+      }
+      double v;
+      if (have_pv && c == pred_col) {
+        v = pv;  // aggregate over the predicate column: one parse per row
+      } else if (!fast_float_field(data + foff[c], l, &v)) {
+        // A field that defies the fast parse may still be numeric under
+        // Python's rules: digits (big-int exactness), inf/nan spellings
+        // (any byte in [nNiI]), or non-ASCII (Unicode digits). All such
+        // fields abort to the exact path; only unambiguously non-numeric
+        // ASCII text is counted-but-never-summed, as the row engine does.
+        bool maybe_numeric = false;
+        for (int32_t i = 0; i < l; ++i) {
+          const uint8_t ch = data[foff[c] + i];
+          if ((ch >= '0' && ch <= '9') || ch >= 0x80 || ch == 'n' ||
+              ch == 'N' || ch == 'i' || ch == 'I') {
+            maybe_numeric = true;
+            break;
+          }
+        }
+        if (maybe_numeric) {
+          abort_at = rs;
+          return false;
+        }
+        ++agg_count[a];  // non-numeric text: counted, not summed
+        continue;
+      }
+      ++agg_count[a];
+      agg_sum[a] += v;
+      if (agg_num[a] == 0 || v < agg_min[a]) {
+        agg_min[a] = v;
+        min_off[a] = foff[c];
+        min_len[a] = l;
+      }
+      if (agg_num[a] == 0 || v > agg_max[a]) {
+        agg_max[a] = v;
+        max_off[a] = foff[c];
+        max_len[a] = l;
+      }
+      ++agg_num[a];
+    }
+    return true;
+  };
+
+  auto special = [&](uint64_t i) -> bool {  // false => abort
+    const uint8_t c = data[i];
+    if (c == delim) {
+      end_field(i);
+      return true;
+    }
+    if (c == quote) {
+      abort_at = row_start;
+      return false;  // quoting: exact path handles
+    }
+    return finish_row(i);  // '\n' or '\r'
+  };
+
+  uint64_t pos = 0;
+#if defined(__AVX2__)
+  // 32-byte stride: one load, four compares, one mask; only SPECIAL
+  // bytes (delim/terminator/quote) are ever visited individually.
+  const __m256i vd = _mm256_set1_epi8(static_cast<char>(delim));
+  const __m256i vn = _mm256_set1_epi8('\n');
+  const __m256i vr = _mm256_set1_epi8('\r');
+  const __m256i vq = _mm256_set1_epi8(static_cast<char>(quote));
+  while (pos + 32 <= n && !aborted) {
+    const __m256i v =
+        _mm256_loadu_si256(reinterpret_cast<const __m256i*>(data + pos));
+    uint32_t mask = static_cast<uint32_t>(_mm256_movemask_epi8(
+        _mm256_or_si256(
+            _mm256_or_si256(_mm256_cmpeq_epi8(v, vd),
+                            _mm256_cmpeq_epi8(v, vq)),
+            _mm256_or_si256(_mm256_cmpeq_epi8(v, vn),
+                            _mm256_cmpeq_epi8(v, vr)))));
+    while (mask) {
+      const uint32_t k = __builtin_ctz(mask);
+      mask &= mask - 1;
+      if (!special(pos + k)) {
+        aborted = true;
+        break;
+      }
+    }
+    pos += 32;
+  }
+#endif
+  while (pos < n && !aborted) {
+    const uint8_t c = data[pos];
+    if (c == delim || c == quote || c == '\n' || c == '\r') {
+      if (!special(pos)) aborted = true;
+    }
+    ++pos;
+  }
+  if (aborted) {
+    *odd_at = static_cast<int64_t>(abort_at);
+    return -1;
+  }
+  // Final unterminated record.
+  if (fstart < n || nf > 0) {
+    if (!finish_row(n)) {
+      *odd_at = static_cast<int64_t>(abort_at);
+      return -1;
+    }
+  }
+  return 0;
+}
+
 // Bulk strtod over an (offset, length) field table. Surrounding quotes and
 // ASCII whitespace are stripped; empty or non-fully-numeric fields parse
 // as NaN. Returns the count of numeric fields.
@@ -559,6 +810,11 @@ int64_t mtpu_csv_parse_floats(const uint8_t* data, const int64_t* off,
     if (l >= 2 && p[0] == quote && p[l - 1] == quote) {
       ++p;
       l -= 2;
+    }
+    // Common case first: plain <=15-digit decimal, no strtod round trip.
+    if (fast_float_field(p, l, &out[i])) {
+      ++ok;
+      continue;
     }
     while (l > 0 && (*p == ' ' || *p == '\t')) {
       ++p;

@@ -52,13 +52,18 @@ def _both(data: bytes, expr: str, **kw):
     vec = _run_capture(data, req)
     real_csv = vector.compile_plan
     real_json = vector.compile_plan_json
+    real_pq = vector.compile_plan_parquet
+    # ALL plan compilers off for the row run — patching only some would
+    # make the other formats' comparisons tautological.
     vector.compile_plan = lambda *_a, **_k: None
     vector.compile_plan_json = lambda *_a, **_k: None
+    vector.compile_plan_parquet = lambda *_a, **_k: None
     try:
         row = _run_capture(data, req)
     finally:
         vector.compile_plan = real_csv
         vector.compile_plan_json = real_json
+        vector.compile_plan_parquet = real_pq
     return vec, row
 
 
@@ -334,3 +339,74 @@ def test_json_vector_review_repros():
                               "WHERE s.price > 0", input_format="JSON")
         assert vec == row, doc
         assert isinstance(vec, str) and vec.startswith("SelectError"), doc
+
+
+# ---------------- Parquet column-chunk lane ----------------
+
+def _parquet_blob():
+    from minio_tpu.s3select.parquet import write_parquet
+
+    rows = [{"id": i, "price": (i % 97) + 0.25, "qty": i % 7,
+             "name": f"item-{i}"} for i in range(2000)]
+    rows.append({"id": 2000, "price": None, "qty": 3, "name": "null-price"})
+    rows.append({"id": 2001, "price": 1e18, "qty": 2, "name": "big"})
+    schema = [("id", "int64"), ("price", "double"), ("qty", "int64"),
+              ("name", "string")]
+    return write_parquet(rows, schema)
+
+
+@pytest.mark.parametrize("expr", [
+    "SELECT COUNT(*) FROM S3Object",
+    "SELECT COUNT(*), SUM(s.price) FROM S3Object s WHERE s.price > 50",
+    "SELECT MIN(s.price), MAX(s.price), AVG(s.qty) FROM S3Object s "
+    "WHERE s.qty <= 3",
+    "SELECT s.id, s.name FROM S3Object s WHERE s.price > 90 LIMIT 7",
+    "SELECT s.id FROM S3Object s WHERE s.name = 'item-42'",
+    "SELECT SUM(s.id) FROM S3Object s",
+])
+def test_parquet_column_lane_matches_row_engine(expr):
+    blob = _parquet_blob()
+    vec, row = _both(blob, expr, input_format="PARQUET")
+    assert vec == row, expr
+
+
+def test_parquet_lane_engaged():
+    """The column lane actually compiles for the aggregate shape (guards
+    against silently comparing the row engine to itself)."""
+    from minio_tpu.s3select.sql import parse
+
+    req = _req("SELECT COUNT(*), SUM(s.price) FROM S3Object s "
+               "WHERE s.price > 50", input_format="PARQUET")
+    assert vector.compile_plan_parquet(parse(req.expression), req) is not None
+
+
+def test_fused_leading_blank_line_header():
+    """A blank first line must not become the header — the header is the
+    first NON-blank record, as the batch filter implies."""
+    data = b"\ncolname\n1\n2\n3\n"
+    vec, row = _both(data, "SELECT SUM(s.colname) FROM S3Object s")
+    assert vec == row
+
+
+def test_fused_inf_nan_fields_take_exact_path():
+    """Digit-free numeric spellings (inf/nan) parse via the row engine's
+    float() — the fused lane must not count-without-summing them."""
+    for field in (b"inf", b"nan", b"Infinity", b"-inf", b"NAN"):
+        data = b"x\n1\n" + field + b"\n2\n"
+        vec, row = _both(
+            data, "SELECT SUM(s.x), COUNT(s.x), MAX(s.x) FROM S3Object s")
+        assert vec == row, field
+
+
+def test_parquet_bool_vs_string_literal():
+    """Booleans compared to string literals take the row engine's
+    coercion, both for = and <>."""
+    from minio_tpu.s3select.parquet import write_parquet
+
+    rows = [{"id": 1, "flag": True}, {"id": 2, "flag": False},
+            {"id": 3, "flag": None}]
+    blob = write_parquet(rows, [("id", "int64"), ("flag", "boolean")])
+    for expr in ("SELECT s.id FROM S3Object s WHERE s.flag = 'True'",
+                 "SELECT s.id FROM S3Object s WHERE s.flag <> 'True'"):
+        vec, row = _both(blob, expr, input_format="PARQUET")
+        assert vec == row, expr
