@@ -1,49 +1,82 @@
-"""CacheObjects — local-SSD read/write-through cache over any ObjectLayer.
+"""CacheObjects — local-SSD read/write cache over any ObjectLayer.
 
 Role-equivalent of cmd/disk-cache.go:88 (cacheObjects) +
 cmd/disk-cache-backend.go: GETs fill the cache and later hits serve from
-local disk with an ETag revalidation against the backend; PUTs write
-through; deletes evict; an LRU garbage collector holds the cache under
-its quota. Every other ObjectLayer method delegates untouched, so the
-cache stacks over erasure pools and gateways alike (the reference wraps
-gateways the same way, cmd/server-main.go newServerCacheObjects).
+local disk with an ETag revalidation against the backend; RANGED GETs of
+large objects cache just the requested range as its own entry
+(disk-cache range caching); PUTs either write through (default) or, in
+WRITEBACK commit mode, land in the cache immediately and a background
+committer uploads to the backend with retry — a backend outage never
+fails the PUT (MINIO_CACHE_COMMIT=writeback role). An LRU garbage
+collector holds the cache between high/low watermarks of its quota and
+never evicts dirty (uncommitted writeback) entries. Every other
+ObjectLayer method delegates untouched, so the cache stacks over erasure
+pools and gateways alike (the reference wraps gateways the same way,
+cmd/server-main.go newServerCacheObjects).
 """
 
 from __future__ import annotations
 
 import hashlib
+import io
 import json
 import os
+import queue
 import threading
 import time
 from typing import BinaryIO, Iterator
 
 from minio_tpu.utils import errors as se
 
-GC_LOW_WATERMARK = 0.8       # evict down to 80% of quota
+GC_HIGH_WATERMARK = 0.9      # GC triggers above 90% of quota ...
+GC_LOW_WATERMARK = 0.7       # ... and evicts down to 70%
+RANGE_CACHE_MIN = 1 << 20    # objects above this cache ranges, not wholes
+COMMIT_RETRY = 2.0           # writeback committer retry backoff (seconds)
 
 
 class CacheObjects:
     def __init__(self, inner, cache_dir: str,
                  quota_bytes: int = 1 << 30,
-                 revalidate_after: float = 5.0):
+                 revalidate_after: float = 5.0,
+                 commit: str = "writethrough"):
         """revalidate_after: cached entries younger than this serve
         without a backend HEAD (the reference's cache freshness window);
-        older hits revalidate by ETag."""
+        older hits revalidate by ETag. commit: "writethrough" | "writeback"
+        (cmd/disk-cache.go commit modes)."""
+        if commit not in ("writethrough", "writeback"):
+            raise ValueError(f"unknown cache commit mode {commit!r}")
         self.inner = inner
         self.dir = cache_dir
         self.quota = quota_bytes
         self.revalidate_after = revalidate_after
+        self.commit = commit
         os.makedirs(cache_dir, exist_ok=True)
         self._mu = threading.Lock()
         self.stats = {"hits": 0, "misses": 0, "evictions": 0,
-                      "revalidations": 0}
+                      "revalidations": 0, "writebacks": 0,
+                      "writeback_pending": 0}
+        self._wb_q: queue.Queue = queue.Queue()
+        self._wb_stop = threading.Event()
+        self._wb_thread: threading.Thread | None = None
+        if commit == "writeback":
+            self._resume_dirty()
+            self._wb_thread = threading.Thread(
+                target=self._committer, daemon=True, name="cache-writeback")
+            self._wb_thread.start()
+
+    def close(self) -> None:
+        self._wb_stop.set()
+        if self._wb_thread is not None:
+            self._wb_thread.join(timeout=5)
 
     # -- entry layout --
 
-    def _paths(self, bucket: str, obj: str) -> tuple[str, str]:
+    def _base(self, bucket: str, obj: str) -> str:
         h = hashlib.sha256(f"{bucket}/{obj}".encode()).hexdigest()
-        base = os.path.join(self.dir, h[:2], h)
+        return os.path.join(self.dir, h[:2], h)
+
+    def _paths(self, bucket: str, obj: str) -> tuple[str, str]:
+        base = self._base(bucket, obj)
         return base + ".data", base + ".meta"
 
     def _load_meta(self, mp: str) -> dict | None:
@@ -53,59 +86,168 @@ class CacheObjects:
         except (FileNotFoundError, ValueError):
             return None
 
-    def _store(self, bucket: str, obj: str, info, data: bytes) -> None:
+    def _write_meta(self, mp: str, doc: dict) -> None:
+        with open(mp + ".tmp", "w") as f:
+            json.dump(doc, f)
+        os.replace(mp + ".tmp", mp)
+
+    def _meta_doc(self, bucket: str, obj: str, info, whole: bool,
+                  dirty: bool = False) -> dict:
+        return {"etag": info.etag, "size": info.size,
+                "mod_time": info.mod_time, "cached_at": time.time(),
+                "content_type": info.content_type,
+                "user_defined": info.user_defined,
+                "bucket": bucket, "object": obj,
+                "whole": whole, "dirty": dirty}
+
+    def _purge_ranges(self, bucket: str, obj: str) -> None:
+        base = self._base(bucket, obj)
+        d = os.path.dirname(base)
+        stem = os.path.basename(base) + ".r"
+        try:
+            names = os.listdir(d)
+        except FileNotFoundError:
+            return
+        for name in names:
+            if name.startswith(stem) and name.endswith(".data"):
+                try:
+                    os.remove(os.path.join(d, name))
+                except FileNotFoundError:
+                    pass
+
+    def _store(self, bucket: str, obj: str, info, data: bytes,
+               dirty: bool = False) -> None:
         dp, mp = self._paths(bucket, obj)
         os.makedirs(os.path.dirname(dp), exist_ok=True)
+        # A whole-object (re)fill supersedes any cached ranges — stale
+        # range bytes must never survive under the new entry's etag.
+        self._purge_ranges(bucket, obj)
         tmp = dp + ".tmp"
         with open(tmp, "wb") as f:
             f.write(data)
+            if dirty:  # uncommitted data must survive a crash
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, dp)
-        with open(mp + ".tmp", "w") as f:
-            json.dump({"etag": info.etag, "size": len(data),
-                       "mod_time": info.mod_time,
-                       "cached_at": time.time(),
-                       "content_type": info.content_type,
-                       "user_defined": info.user_defined,
-                       "bucket": bucket, "object": obj}, f)
-        os.replace(mp + ".tmp", mp)
+        self._write_meta(mp, self._meta_doc(bucket, obj, info, whole=True,
+                                            dirty=dirty))
         self._gc()
 
-    def _evict(self, bucket: str, obj: str) -> None:
-        dp, mp = self._paths(bucket, obj)
-        for p in (dp, mp):
-            try:
-                os.remove(p)
-            except FileNotFoundError:
-                pass
+    def _store_range(self, bucket: str, obj: str, info, offset: int,
+                     data: bytes) -> None:
+        base = self._base(bucket, obj)
+        mp = base + ".meta"
+        os.makedirs(os.path.dirname(base), exist_ok=True)
+        rp = f"{base}.r{offset}-{offset + len(data)}.data"
+        with open(rp + ".tmp", "wb") as f:
+            f.write(data)
+        os.replace(rp + ".tmp", rp)
+        meta = self._load_meta(mp)
+        if meta is None or meta.get("etag") != info.etag:
+            # Fresh or CHANGED object: purge every range cached under the
+            # previous etag (keeping them would mix object versions), then
+            # (re)write meta WITHOUT whole data. The just-written range
+            # survives the purge by being re-written after it.
+            self._purge_ranges(bucket, obj)
+            with open(rp + ".tmp", "wb") as f:
+                f.write(data)
+            os.replace(rp + ".tmp", rp)
+            self._write_meta(mp, self._meta_doc(bucket, obj, info,
+                                                whole=False))
+        self._gc()
 
-    # -- garbage collection (LRU by atime) --
+    def _find_range(self, bucket: str, obj: str, offset: int,
+                    end: int) -> bytes | None:
+        """A cached range fully covering [offset, end), or None."""
+        base = self._base(bucket, obj)
+        d = os.path.dirname(base)
+        prefix = os.path.basename(base) + ".r"
+        try:
+            names = os.listdir(d)
+        except FileNotFoundError:
+            return None
+        for name in names:
+            if not (name.startswith(prefix) and name.endswith(".data")):
+                continue
+            try:
+                lo, hi = name[len(prefix):-5].split("-")
+                lo, hi = int(lo), int(hi)
+            except ValueError:
+                continue
+            if lo <= offset and end <= hi:
+                p = os.path.join(d, name)
+                try:
+                    with open(p, "rb") as f:
+                        f.seek(offset - lo)
+                        out = f.read(end - offset)
+                    os.utime(p)  # LRU touch
+                except OSError:
+                    continue
+                if len(out) == end - offset:
+                    return out
+        return None
+
+    def _evict(self, bucket: str, obj: str) -> None:
+        base = self._base(bucket, obj)
+        d = os.path.dirname(base)
+        stem = os.path.basename(base)
+        try:
+            names = os.listdir(d)
+        except FileNotFoundError:
+            return
+        for name in names:
+            if name == stem + ".data" or name == stem + ".meta" \
+                    or (name.startswith(stem + ".r")
+                        and name.endswith(".data")):
+                try:
+                    os.remove(os.path.join(d, name))
+                except FileNotFoundError:
+                    pass
+
+    # -- garbage collection (LRU by atime, high/low watermarks) --
 
     def _gc(self) -> None:
         with self._mu:
             entries = []
             total = 0
+            dirty_bases: set[str] = set()
             for sub in os.listdir(self.dir):
                 d = os.path.join(self.dir, sub)
                 if not os.path.isdir(d):
                     continue
                 for name in os.listdir(d):
+                    p = os.path.join(d, name)
+                    if name.endswith(".meta"):
+                        meta = self._load_meta(p)
+                        if meta and meta.get("dirty"):
+                            dirty_bases.add(p[:-5])
+                        continue
                     if not name.endswith(".data"):
                         continue
-                    p = os.path.join(d, name)
                     try:
                         st = os.stat(p)
                     except FileNotFoundError:
                         continue
                     entries.append((st.st_atime, st.st_size, p))
                     total += st.st_size
-            if total <= self.quota:
+            if total <= self.quota * GC_HIGH_WATERMARK:
                 return
             entries.sort()
             target = int(self.quota * GC_LOW_WATERMARK)
             for _, size, p in entries:
                 if total <= target:
                     break
-                for victim in (p, p[:-5] + ".meta"):
+                base = p[:-5]
+                is_range = ".r" in os.path.basename(base)
+                if is_range:
+                    base = base[:base.rindex(".r")]
+                if base in dirty_bases:
+                    continue  # uncommitted writeback data is sacred
+                # A range piece evicts ALONE — its siblings stay valid
+                # under the shared meta; only a whole-object eviction
+                # removes the meta.
+                victims = (p,) if is_range else (p, base + ".meta")
+                for victim in victims:
                     try:
                         os.remove(victim)
                     except FileNotFoundError:
@@ -113,7 +255,103 @@ class CacheObjects:
                 total -= size
                 self.stats["evictions"] += 1
 
+    # -- writeback committer --
+
+    def _resume_dirty(self) -> None:
+        """Requeue uncommitted entries found on disk (crash/restart)."""
+        for sub in os.listdir(self.dir):
+            d = os.path.join(self.dir, sub)
+            if not os.path.isdir(d):
+                continue
+            for name in os.listdir(d):
+                if not name.endswith(".meta"):
+                    continue
+                meta = self._load_meta(os.path.join(d, name))
+                if meta and meta.get("dirty"):
+                    self._wb_q.put((meta["bucket"], meta["object"]))
+                    self.stats["writeback_pending"] += 1
+
+    def _committer(self) -> None:
+        while not self._wb_stop.is_set():
+            try:
+                bucket, obj = self._wb_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            dp, mp = self._paths(bucket, obj)
+            meta = self._load_meta(mp)
+            if meta is None or not meta.get("dirty"):
+                self.stats["writeback_pending"] = max(
+                    0, self.stats["writeback_pending"] - 1)
+                continue  # evicted/overwritten meanwhile: nothing to do
+            try:
+                with open(dp, "rb") as f:
+                    data = f.read()
+                from minio_tpu.erasure.types import ObjectOptions
+
+                opts = ObjectOptions(
+                    user_defined=dict(meta.get("user_defined", {})))
+                info = self.inner.put_object(bucket, obj, io.BytesIO(data),
+                                             len(data), opts)
+            except (se.StorageError, OSError):
+                # Transient (drives/quorum/network): requeue at the BACK
+                # so healthy entries are not stalled behind this one.
+                if self._wb_stop.wait(COMMIT_RETRY):
+                    return
+                self._wb_q.put((bucket, obj))
+                continue
+            except Exception:  # noqa: BLE001 - permanent rejection
+                # The backend REFUSED the object (bucket deleted, name
+                # invalid, ...): retrying forever would pin the dirty
+                # entry and poison the queue. Keep the bytes, mark the
+                # entry failed, and surface it in stats for the operator.
+                cur = self._load_meta(mp)
+                if cur is not None:
+                    cur["dirty"] = False
+                    cur["failed"] = True
+                    self._write_meta(mp, cur)
+                self.stats["writeback_failed"] =                     self.stats.get("writeback_failed", 0) + 1
+                self.stats["writeback_pending"] = max(
+                    0, self.stats["writeback_pending"] - 1)
+                continue
+            cur = self._load_meta(mp)
+            if cur is not None and cur.get("dirty") \
+                    and cur.get("cached_at") == meta.get("cached_at"):
+                cur["dirty"] = False
+                cur["etag"] = info.etag
+                self._write_meta(mp, cur)
+            self.stats["writebacks"] += 1
+            self.stats["writeback_pending"] = max(
+                0, self.stats["writeback_pending"] - 1)
+
     # -- the cached read path --
+
+    def _meta_valid(self, bucket: str, obj: str, meta: dict, opts) -> bool:
+        if meta.get("dirty"):
+            return True  # the cache IS the source of truth until committed
+        if time.time() - meta.get("cached_at", 0) < self.revalidate_after:
+            return True
+        try:
+            cur = self.inner.get_object_info(bucket, obj, opts)
+            self.stats["revalidations"] += 1
+            return cur.etag == meta["etag"]
+        except (se.ObjectError, se.StorageError):
+            return False
+
+    def get_object_info(self, bucket: str, obj: str, opts=None):
+        from minio_tpu.erasure.types import ObjectInfo
+
+        if self.commit == "writeback":
+            # HEAD must see an uncommitted writeback object — the client
+            # just got a 200 for its PUT.
+            _dp, mp = self._paths(bucket, obj)
+            meta = self._load_meta(mp)
+            if meta is not None and meta.get("dirty"):
+                return ObjectInfo(
+                    bucket=bucket, name=obj, size=meta["size"],
+                    etag=meta["etag"], mod_time=meta["mod_time"],
+                    content_type=meta.get("content_type", ""),
+                    user_defined=dict(meta.get("user_defined", {})))
+        return self.inner.get_object_info(bucket, obj, opts)
 
     def get_object(self, bucket: str, obj: str, offset: int = 0,
                    length: int = -1, opts=None):
@@ -126,36 +364,58 @@ class CacheObjects:
         dp, mp = self._paths(bucket, obj)
         meta = self._load_meta(mp)
         if meta is not None:
-            fresh = time.time() - meta.get("cached_at", 0) < self.revalidate_after
-            valid = fresh
-            if not fresh:
-                try:
-                    cur = self.inner.get_object_info(bucket, obj, opts)
-                    valid = cur.etag == meta["etag"]
-                    self.stats["revalidations"] += 1
-                except (se.ObjectError, se.StorageError):
-                    valid = False
-            if valid:
-                try:
-                    with open(dp, "rb") as f:
-                        data = f.read()
-                    os.utime(dp)  # LRU touch
-                except FileNotFoundError:
-                    data = None
-                if data is not None and len(data) == meta["size"]:
-                    self.stats["hits"] += 1
-                    end = meta["size"] if length < 0 else offset + length
-                    if offset < 0 or end > meta["size"]:
-                        raise se.InvalidRange(bucket, obj)
-                    info = ObjectInfo(
-                        bucket=bucket, name=obj, size=meta["size"],
-                        etag=meta["etag"], mod_time=meta["mod_time"],
-                        content_type=meta.get("content_type", ""),
-                        user_defined=dict(meta.get("user_defined", {})))
-                    return info, iter([data[offset:end]])
+            if self._meta_valid(bucket, obj, meta, opts):
+                size = meta["size"]
+                end = size if length < 0 else offset + length
+                if offset < 0 or end > size:
+                    raise se.InvalidRange(bucket, obj)
+                info = ObjectInfo(
+                    bucket=bucket, name=obj, size=size,
+                    etag=meta["etag"], mod_time=meta["mod_time"],
+                    content_type=meta.get("content_type", ""),
+                    user_defined=dict(meta.get("user_defined", {})))
+                if meta.get("whole", True):
+                    try:
+                        with open(dp, "rb") as f:
+                            data = f.read()
+                        os.utime(dp)  # LRU touch
+                    except FileNotFoundError:
+                        data = None
+                    if data is not None and len(data) == size:
+                        self.stats["hits"] += 1
+                        return info, iter([data[offset:end]])
+                else:
+                    piece = self._find_range(bucket, obj, offset, end)
+                    if piece is not None:
+                        self.stats["hits"] += 1
+                        return info, iter([piece])
+                    # Range miss on a known object: fetch + cache just it.
+                    self.stats["misses"] += 1
+                    binfo, stream = self.inner.get_object(
+                        bucket, obj, offset, end - offset, opts)
+                    data = b"".join(stream)
+                    self._store_range(bucket, obj, binfo, offset, data)
+                    return binfo, iter([data])
             self._evict(bucket, obj)
 
         self.stats["misses"] += 1
+        ranged = offset > 0 or length >= 0
+        if ranged:
+            # Probe size first: large objects cache the RANGE, small ones
+            # fill the whole entry (cmd/disk-cache.go range caching).
+            try:
+                pre = self.inner.get_object_info(bucket, obj, opts)
+            except (se.ObjectError, se.StorageError):
+                pre = None
+            if pre is not None and pre.size > RANGE_CACHE_MIN:
+                end = pre.size if length < 0 else offset + length
+                if offset < 0 or end > pre.size:
+                    raise se.InvalidRange(bucket, obj)
+                binfo, stream = self.inner.get_object(
+                    bucket, obj, offset, end - offset, opts)
+                data = b"".join(stream)
+                self._store_range(bucket, obj, binfo, offset, data)
+                return binfo, iter([data])
         info, stream = self.inner.get_object(bucket, obj, 0, -1, opts)
         data = b"".join(stream)
         self._store(bucket, obj, info, data)
@@ -164,10 +424,28 @@ class CacheObjects:
             raise se.InvalidRange(bucket, obj)
         return info, iter([data[offset:end]])
 
-    # -- write-through + eviction hooks --
+    # -- writes: write-through or writeback --
 
     def put_object(self, bucket: str, obj: str, data: BinaryIO,
                    size: int = -1, opts=None):
+        from minio_tpu.erasure.types import ObjectInfo
+
+        if self.commit == "writeback":
+            payload = data.read() if size < 0 else data.read(size)
+            if size >= 0 and len(payload) != size:
+                raise se.IncompleteBody(bucket, obj,
+                                        f"got {len(payload)} of {size}")
+            user = dict(getattr(opts, "user_defined", {}) or {})
+            info = ObjectInfo(
+                bucket=bucket, name=obj, size=len(payload),
+                etag=hashlib.md5(payload).hexdigest(),
+                mod_time=time.time(),
+                content_type=user.get("content-type", ""),
+                user_defined=user)
+            self._store(bucket, obj, info, payload, dirty=True)
+            self._wb_q.put((bucket, obj))
+            self.stats["writeback_pending"] += 1
+            return info
         info = self.inner.put_object(bucket, obj, data, size, opts)
         self._evict(bucket, obj)  # next read re-fills with committed bytes
         return info

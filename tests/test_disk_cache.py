@@ -81,3 +81,169 @@ def test_delegation(cached):
     cache.put_object("bkt", "t", io.BytesIO(b"v"), 1)
     cache.put_object_tags("bkt", "t", "a=b")
     assert cache.get_object_tags("bkt", "t") == "a=b"
+
+
+def test_range_caching_large_object(cached, tmp_path):
+    """A cold RANGED GET of a large object caches only that range; the
+    next ranged GET inside it is a hit served from the range entry, and
+    the backend is not re-read (cmd/disk-cache.go range caching)."""
+    drives = [LocalDrive(str(tmp_path / f"rd{i}")) for i in range(4)]
+    inner = ErasureObjects(drives, parity=1)
+    cache = CacheObjects(inner, str(tmp_path / "rcache"),
+                         quota_bytes=50 << 20, revalidate_after=60.0)
+    cache.make_bucket("rbk")
+    import os as _os
+    payload = _os.urandom(3 << 20)  # > RANGE_CACHE_MIN
+    cache.put_object("rbk", "big", io.BytesIO(payload), len(payload))
+    # Cold ranged GET: fills a range entry, NOT the whole object.
+    assert _get(cache, "rbk", "big", offset=1 << 20,
+                length=1 << 20) == payload[1 << 20: 2 << 20]
+    assert cache.stats["misses"] == 1
+    dp, _mp = cache._paths("rbk", "big")
+    assert not _os.path.exists(dp)  # whole-object entry never created
+    # Warm ranged GET inside the cached range: pure cache hit.
+    calls = {"n": 0}
+    real = inner.get_object
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    inner.get_object = counting
+    assert _get(cache, "rbk", "big", offset=(1 << 20) + 5000,
+                length=100_000) == payload[(1 << 20) + 5000:
+                                           (1 << 20) + 105_000]
+    assert cache.stats["hits"] == 1
+    assert calls["n"] == 0  # served without touching the backend
+    # A range OUTSIDE the cached piece fetches + caches just itself.
+    assert _get(cache, "rbk", "big", offset=0,
+                length=4096) == payload[:4096]
+    assert calls["n"] == 1
+
+
+class _Outage:
+    """ObjectLayer decorator that fails writes while 'down'."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.down = False
+
+    def put_object(self, *a, **k):
+        if self.down:
+            raise se.FaultyDisk("backend outage")
+        return self.inner.put_object(*a, **k)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def test_writeback_survives_backend_outage(tmp_path):
+    """Writeback commit: a PUT during a backend outage succeeds, serves
+    from cache, and the committer uploads once the backend recovers
+    (cmd/disk-cache.go commit=writeback role)."""
+    drives = [LocalDrive(str(tmp_path / f"wd{i}")) for i in range(4)]
+    inner = ErasureObjects(drives, parity=1)
+    outage = _Outage(inner)
+    cache = CacheObjects(outage, str(tmp_path / "wcache"),
+                         quota_bytes=50 << 20, revalidate_after=60.0,
+                         commit="writeback")
+    try:
+        cache.make_bucket("wbk")
+        outage.down = True
+        payload = b"written-during-outage" * 1000
+        info = cache.put_object("wbk", "k", io.BytesIO(payload),
+                                len(payload))
+        import hashlib as _hl
+        assert info.etag == _hl.md5(payload).hexdigest()
+        # Served from cache although the backend never saw it.
+        assert _get(cache, "wbk", "k") == payload
+        with pytest.raises(se.ObjectError):
+            inner.get_object_info("wbk", "k")
+        # Backend recovers: the committer uploads within its retry loop.
+        outage.down = False
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                if inner.get_object_info("wbk", "k").etag == info.etag:
+                    break
+            except se.ObjectError:
+                pass
+            time.sleep(0.1)
+        else:
+            raise AssertionError("writeback never committed")
+        assert _get(inner, "wbk", "k") == payload
+        assert cache.stats["writebacks"] >= 1
+    finally:
+        cache.close()
+
+
+def test_gc_never_evicts_dirty(tmp_path):
+    """Watermark GC evicts clean LRU entries but NEVER uncommitted
+    writeback data."""
+    drives = [LocalDrive(str(tmp_path / f"gd{i}")) for i in range(4)]
+    inner = ErasureObjects(drives, parity=1)
+    outage = _Outage(inner)
+    outage.down = True  # keep writeback entries dirty
+    cache = CacheObjects(outage, str(tmp_path / "gcache"),
+                         quota_bytes=120_000, revalidate_after=60.0,
+                         commit="writeback")
+    try:
+        cache.make_bucket("gbk")
+        dirty_payload = b"D" * 50_000
+        cache.put_object("gbk", "dirty", io.BytesIO(dirty_payload),
+                         len(dirty_payload))
+        # Fill with clean entries far over quota to force GC.
+        outage.down = False
+        for i in range(6):
+            p = bytes([i]) * 40_000
+            inner.put_object("gbk", f"clean{i}", io.BytesIO(p), len(p))
+            _get(cache, "gbk", f"clean{i}")
+        outage.down = True
+        assert cache.stats["evictions"] >= 1
+        # The dirty entry survived and still serves.
+        assert _get(cache, "gbk", "dirty") == dirty_payload
+    finally:
+        cache.close()
+
+
+def test_range_cache_purged_on_etag_change(tmp_path):
+    """After an object changes, stale range bytes from the old version
+    must never serve under the new etag."""
+    import os as _os
+
+    drives = [LocalDrive(str(tmp_path / f"ed{i}")) for i in range(4)]
+    inner = ErasureObjects(drives, parity=1)
+    cache = CacheObjects(inner, str(tmp_path / "ecache"),
+                         quota_bytes=50 << 20, revalidate_after=0.0)
+    cache.make_bucket("ebk")
+    v1 = bytes([1]) * (3 << 20)
+    v2 = bytes([2]) * (3 << 20)
+    cache.put_object("ebk", "o", io.BytesIO(v1), len(v1))
+    assert _get(cache, "ebk", "o", offset=0, length=1 << 20) == v1[:1 << 20]
+    # Overwrite through the cache, then range-read a DIFFERENT slice
+    # (fills a v2 range + rewrites meta), then the ORIGINAL slice: must
+    # be v2 bytes, not the stale v1 range file.
+    cache.put_object("ebk", "o", io.BytesIO(v2), len(v2))
+    assert _get(cache, "ebk", "o", offset=2 << 20,
+                length=1 << 20) == v2[2 << 20: 3 << 20]
+    assert _get(cache, "ebk", "o", offset=0, length=1 << 20) == v2[:1 << 20]
+
+
+def test_writeback_head_sees_uncommitted(tmp_path):
+    """HEAD of a writeback object during a backend outage serves from the
+    dirty cache entry (the client just got a 200 for its PUT)."""
+    drives = [LocalDrive(str(tmp_path / f"hd{i}")) for i in range(4)]
+    inner = ErasureObjects(drives, parity=1)
+    outage = _Outage(inner)
+    cache = CacheObjects(outage, str(tmp_path / "hcache"),
+                         quota_bytes=50 << 20, commit="writeback")
+    try:
+        cache.make_bucket("hbk")
+        outage.down = True
+        payload = b"head-me" * 500
+        info = cache.put_object("hbk", "k", io.BytesIO(payload),
+                                len(payload))
+        head = cache.get_object_info("hbk", "k")
+        assert head.size == len(payload) and head.etag == info.etag
+    finally:
+        cache.close()
