@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 
 from minio_tpu.native import lib as nlib
 
@@ -46,32 +47,48 @@ def _algo_spec(algorithm: str):
     return {"sip256": (0, BITROT_KEY),
             "highwayhash256": (1, HH_BITROT_KEY)}.get(algorithm)
 
-_bound = False
+# Bound function table: the two pipeline entry points, argtypes applied,
+# materialized ONCE under a lock. Calling through this table (never
+# through lib.<attr>) sidesteps ctypes' CDLL attribute cache entirely —
+# concurrent first accesses to a CDLL attribute each build a fresh
+# _FuncPtr and setattr it, so a stale unbound instance could clobber the
+# bound one. Found by the TSan hammer in tests/test_native.py.
+_fns: dict | None = None
+_bind_mu = threading.Lock()
 
 
-def _lib():
-    global _bound
+def _lib() -> dict | None:
+    global _fns
+    if _fns is not None:
+        return _fns
     lib = nlib._build_and_load()
-    if lib is None or not hasattr(lib, "mtpu_encode_part"):
+    if lib is None:
         return None
-    if not _bound:
-        lib.mtpu_encode_part.argtypes = [
+    with _bind_mu:
+        if _fns is not None:
+            return _fns
+        try:
+            enc = lib.mtpu_encode_part
+            dec = lib.mtpu_decode_part
+        except AttributeError:
+            return None
+        enc.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
             ctypes.c_uint32, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int,
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint64),
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_int8)]
-        lib.mtpu_encode_part.restype = ctypes.c_int64
-        lib.mtpu_decode_part.argtypes = [
+        enc.restype = ctypes.c_int64
+        dec.argtypes = [
             ctypes.POINTER(ctypes.c_char_p), ctypes.c_char_p,
             ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64,
             ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
             ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_int8)]
-        lib.mtpu_decode_part.restype = ctypes.c_int64
-        _bound = True
-    return lib
+        dec.restype = ctypes.c_int64
+        _fns = {"encode_part": enc, "decode_part": dec}
+    return _fns
 
 
 def available() -> bool:
@@ -134,7 +151,7 @@ class PartEncoder:
                                 ctypes.c_char_p) if n else None)
         else:
             data = buf if n else None
-        rc = self._l.mtpu_encode_part(
+        rc = self._l["encode_part"](
             data, n,
             self.k, self.m, self.bs, self._pmat, self._algo, self._key,
             self._paths, self._append, self._do_sync, 1 if final else 0,
@@ -179,9 +196,9 @@ def decode_range(paths: list[str], k: int, m: int, block_size: int,
     so later windows don't re-read and re-fail them."""
     from minio_tpu.ops import gf
 
-    lib = _lib()
+    fns = _lib()
     spec = _algo_spec(algorithm)
-    if lib is None or spec is None:
+    if fns is None or spec is None:
         raise OSError("native plane unavailable")
     algo, key = spec
     n = k + m
@@ -190,7 +207,7 @@ def decode_range(paths: list[str], k: int, m: int, block_size: int,
     avail = bytes([0 if skip and i in skip else 1 for i in range(n)])
     state = (ctypes.c_int8 * n)()
     out = ctypes.create_string_buffer(length) if length else b""
-    rc = lib.mtpu_decode_part(
+    rc = fns["decode_part"](
         cpaths, avail, k, m, block_size, part_size, gmat, algo, key,
         offset, length, threads or _threads(),
         ctypes.cast(out, ctypes.c_void_p) if length else None, state)

@@ -89,6 +89,42 @@ def test_bitrot_stream_with_sip256():
         r.read_at(0, len(payload))
 
 
+def _tsan_setup() -> tuple[str, dict]:
+    """Shared TSan scaffolding: build (or skip) the instrumented .so and
+    return (so_path, env with the TSan runtime preloaded). pytest.skip()s
+    on any toolchain mismatch — both TSan tests must bootstrap the SAME
+    way or the probes drift."""
+    import shutil as _shutil
+    import subprocess
+    import sys as _sys
+
+    so = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "libmtpu_native_tsan.so")
+    src = os.path.join(os.path.dirname(so), "mtpu_native.cc")
+    cxx = os.environ.get("CXX", "g++")
+    if "g++" not in os.path.basename(cxx):
+        pytest.skip(f"TSan scaffolding assumes g++ (CXX={cxx})")
+    if not _shutil.which("gcc"):
+        pytest.skip("no gcc toolchain (libtsan probe)")
+    probe = subprocess.run(["gcc", "-print-file-name=libtsan.so"],
+                           capture_output=True, text=True)
+    libtsan = probe.stdout.strip()
+    if not libtsan or not os.path.exists(libtsan):
+        pytest.skip("libtsan runtime not found")
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)):
+        r = subprocess.run(["make", "-C", os.path.dirname(so), "tsan"],
+                           capture_output=True)
+        if r.returncode != 0 or not os.path.exists(so):
+            pytest.skip("no TSan toolchain")
+    env = dict(os.environ, LD_PRELOAD=libtsan,
+               TSAN_OPTIONS="exitcode=66",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    _ = _sys  # noqa: F841
+    return so, env
+
+
 def test_native_kernels_under_tsan(tmp_path):
     """Concurrency-hammer the native kernels under ThreadSanitizer
     (SURVEY.md §5.2 — the Go -race role for the C++ bridge). TSan aborts
@@ -97,13 +133,7 @@ def test_native_kernels_under_tsan(tmp_path):
     import sys
     import textwrap
 
-    so = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "native", "libmtpu_native_tsan.so")
-    if not os.path.exists(so):
-        r = subprocess.run(["make", "-C", os.path.dirname(so), "tsan"],
-                           capture_output=True)
-        if r.returncode != 0 or not os.path.exists(so):
-            pytest.skip("no TSan toolchain")
+    so, env = _tsan_setup()
 
     script = textwrap.dedent(f"""
         import ctypes, os, threading
@@ -145,17 +175,6 @@ def test_native_kernels_under_tsan(tmp_path):
     """)
     # The TSan runtime must be in the process from the start — dlopen of
     # an instrumented .so into an uninstrumented python needs LD_PRELOAD.
-    import shutil as _shutil
-
-    if not _shutil.which("gcc"):
-        pytest.skip("no gcc toolchain")
-    probe = subprocess.run(["gcc", "-print-file-name=libtsan.so"],
-                           capture_output=True, text=True)
-    libtsan = probe.stdout.strip()
-    if not libtsan or not os.path.exists(libtsan):
-        pytest.skip("libtsan runtime not found")
-    env = dict(os.environ, LD_PRELOAD=libtsan,
-               TSAN_OPTIONS="exitcode=66")
     r = subprocess.run([sys.executable, "-c", script],
                        capture_output=True, text=True, timeout=180, env=env)
     assert "WARNING: ThreadSanitizer" not in r.stderr, r.stderr[:2000]
@@ -264,3 +283,68 @@ def test_highwayhash256_registry_and_serving_plane(tmp_path):
     open(shard, "wb").write(bytes(blob))
     _, stream = es.get_object("hhb", "obj")
     assert b"".join(stream) == data
+
+
+def test_serving_plane_under_tsan(tmp_path):
+    """ThreadSanitizer over the SERVING pipelines — encode_part/decode_part
+    spawn their own worker/writer/reader threads internally, and the fused
+    Select scan runs concurrently from many Python threads. TSan aborts
+    the subprocess on any data race; a clean exit is the assertion."""
+    import subprocess
+    import sys
+    import textwrap
+
+    so, env = _tsan_setup()
+
+    script = textwrap.dedent(f"""
+        import os, threading
+        import minio_tpu.native.lib as nlib
+        # Load the TSan build through the NORMAL binder so every
+        # function gets its argtypes.
+        nlib._SO_NAME = "libmtpu_native_tsan.so"
+        import minio_tpu.native.plane as plane
+        assert plane.available()
+        from minio_tpu.ops import gf  # warm matrix caches pre-threads
+        gf.parity_matrix(4, 2)
+        gf.rs_generator_matrix(4, 6)
+        root = {str(tmp_path)!r}
+        failures = []
+        k, m, bs = 4, 2, 1 << 16
+        data = os.urandom(bs * 3 + 777)
+        csv = b"a,b\\n" + b"".join(b"%d,%d.5\\n" % (i, i) for i in range(5000))
+
+        def hammer(tid):
+            try:
+                paths = [os.path.join(root, f"t{{tid}}s{{i}}")
+                         for i in range(k + m)]
+                for _ in range(3):
+                    # Encode: internal md5 thread + encode workers +
+                    # per-drive writer threads (threads=4 forces real
+                    # worker concurrency even on a 1-core host).
+                    enc = plane.PartEncoder(paths, k, m, bs, threads=4)
+                    enc.feed(bytearray(data), final=True)
+                    assert not any(enc.errors)
+                    # Decode: internal per-shard reader threads + striped
+                    # assembly threads.
+                    out, st = plane.decode_range(
+                        paths, k, m, bs, len(data), 0, len(data),
+                        threads=4)
+                    assert out == data
+                    # Fused Select scan from many threads concurrently.
+                    from minio_tpu.native.lib import csv_agg_fused
+                    r = csv_agg_fused(csv, b",", b'"', True, 1, 1,
+                                      100.0, [-1, 1])
+                    assert r is not None and r["scanned"] == 5000
+            except BaseException as e:
+                failures.append(repr(e))
+
+        ts = [threading.Thread(target=hammer, args=(t,)) for t in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not failures, failures
+        print("TSAN_CLEAN")
+    """)
+    r = subprocess.run([sys.executable, "-c", script],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0 and "TSAN_CLEAN" in r.stdout, (
+        f"rc={r.returncode}\n{r.stdout[-2000:]}\n{r.stderr[-4000:]}")
