@@ -205,6 +205,59 @@ class _Cols:
     def mt_at(self, i: int) -> float:
         return struct.unpack_from("<d", self.mt, 8 * i)[0]
 
+    # -- columnar journal mutation (the PUT write path: parse -> add ->
+    #    serialize touches only column buffers, never Version objects) --
+
+    def remove(self, idx: int) -> None:
+        bl = struct.unpack(f"<{self.n}I", self.bl)
+        vl = struct.unpack(f"<{self.n}H", self.vl)
+        dl = struct.unpack(f"<{self.n}H", self.dl)
+        boff = sum(bl[:idx])
+        voff = sum(vl[:idx])
+        doff = sum(dl[:idx])
+        self.mt = self.mt[:8 * idx] + self.mt[8 * (idx + 1):]
+        self.vt = self.vt[:idx] + self.vt[idx + 1:]
+        self.bl = self.bl[:4 * idx] + self.bl[4 * (idx + 1):]
+        self.vl = self.vl[:2 * idx] + self.vl[2 * (idx + 1):]
+        self.dl = self.dl[:2 * idx] + self.dl[2 * (idx + 1):]
+        self.vids_raw = (self.vids_raw[:voff]
+                         + self.vids_raw[voff + vl[idx]:])
+        self.dds_raw = self.dds_raw[:doff] + self.dds_raw[doff + dl[idx]:]
+        # Normalize tail to bytes on first mutation (slicing a memoryview
+        # then concatenating would copy twice).
+        tail = self.tail if isinstance(self.tail, bytes) else bytes(self.tail)
+        self.tail = tail[:boff] + tail[boff + bl[idx]:]
+        self.n -= 1
+        self.raw = None
+        self._vids = self._dds = self._blobs = None
+
+    def insert(self, idx: int, mt: float, vid: str, vtype: int, dd: str,
+               blob: bytes) -> None:
+        bl = struct.unpack(f"<{self.n}I", self.bl)
+        vl = struct.unpack(f"<{self.n}H", self.vl)
+        dl = struct.unpack(f"<{self.n}H", self.dl)
+        boff = sum(bl[:idx])
+        voff = sum(vl[:idx])
+        doff = sum(dl[:idx])
+        vb = vid.encode("utf-8")
+        db = dd.encode("utf-8")
+        self.mt = (self.mt[:8 * idx] + struct.pack("<d", mt)
+                   + self.mt[8 * idx:])
+        self.vt = self.vt[:idx] + bytes([vtype]) + self.vt[idx:]
+        self.bl = (self.bl[:4 * idx] + struct.pack("<I", len(blob))
+                   + self.bl[4 * idx:])
+        self.vl = (self.vl[:2 * idx] + struct.pack("<H", len(vb))
+                   + self.vl[2 * idx:])
+        self.dl = (self.dl[:2 * idx] + struct.pack("<H", len(db))
+                   + self.dl[2 * idx:])
+        self.vids_raw = self.vids_raw[:voff] + vb + self.vids_raw[voff:]
+        self.dds_raw = self.dds_raw[:doff] + db + self.dds_raw[doff:]
+        tail = self.tail if isinstance(self.tail, bytes) else bytes(self.tail)
+        self.tail = tail[:boff] + blob + tail[boff:]
+        self.n += 1
+        self.raw = None
+        self._vids = self._dds = self._blobs = None
+
 
 class XLMeta:
     """In-memory journal; versions newest-first (reference keeps versions
@@ -266,8 +319,22 @@ class XLMeta:
 
     def serialize(self) -> bytes:
         if self._versions is None:
-            # Untouched parse: the document IS its own serialization.
-            return self._cols.raw
+            c = self._cols
+            if c.raw is not None:
+                # Untouched parse: the document IS its own serialization.
+                return c.raw
+            # Column-mutated journal (columnar add_version): rebuild from
+            # the buffers — nine msgpack objects, no per-version work.
+            env = msgpack.packb({
+                "v": FORMAT_VERSION, "n": c.n, "mt": c.mt, "t": c.vt,
+                "bl": c.bl, "vl": c.vl, "dl": c.dl,
+                "vid": c.vids_raw, "dd": c.dds_raw,
+            })
+            payload = b"".join(
+                (len(env).to_bytes(4, "little"), env, bytes(c.tail)))
+            c.raw = b"".join(
+                (MAGIC, crc32c(payload).to_bytes(4, "little"), payload))
+            return c.raw
         if self._ser is not None:
             # Unchanged since the last serialize (journal mutations all
             # run through add_version/delete_version, which invalidate).
@@ -365,6 +432,37 @@ class XLMeta:
     #    cmd/xl-storage-format-v2.go:231,444,664) --
 
     def add_version(self, fi: FileInfo) -> None:
+        if self._versions is None:
+            # Columnar fast path (the per-PUT write_metadata shape:
+            # parse -> add_version -> serialize): splice the new version
+            # into the column buffers without materializing the journal.
+            c = self._cols
+            # Remove EVERY entry with this vid (a CRC-valid journal from
+            # an alien writer could carry duplicates; the materialized
+            # path filters all matches — the two paths must agree).
+            while True:
+                try:
+                    idx = c.vids().index(fi.version_id)
+                except ValueError:
+                    break
+                except (UnicodeDecodeError, struct.error) as e:
+                    raise se.CorruptedFormat(
+                        f"bad version columns: {e}") from e
+                # Null-version semantics: a write with no version id
+                # replaces the existing null version in place (same rule
+                # for explicit vids).
+                c.remove(idx)
+            doc = _fi_to_doc(fi)
+            blob = msgpack.packb(doc)
+            # Strict comparison: the materialized path appends then
+            # STABLE-sorts descending, so equal-mod_time entries keep the
+            # existing-before-new order — insert AFTER all equals.
+            mts = struct.unpack(f"<{c.n}d", c.mt)
+            pos = next((i for i, m in enumerate(mts)
+                        if m < fi.mod_time), c.n)
+            c.insert(pos, fi.mod_time, fi.version_id, doc["t"],
+                     fi.data_dir if not fi.deleted else "", blob)
+            return
         ver = Version.from_doc(_fi_to_doc(fi))
         # Null-version semantics: a write with no version id replaces the
         # existing null version in place.

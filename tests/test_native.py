@@ -96,7 +96,6 @@ def _tsan_setup() -> tuple[str, dict]:
     way or the probes drift."""
     import shutil as _shutil
     import subprocess
-    import sys as _sys
 
     so = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "native", "libmtpu_native_tsan.so")
@@ -121,7 +120,6 @@ def _tsan_setup() -> tuple[str, dict]:
                TSAN_OPTIONS="exitcode=66",
                PYTHONPATH=os.path.dirname(os.path.dirname(
                    os.path.abspath(__file__))))
-    _ = _sys  # noqa: F841
     return so, env
 
 

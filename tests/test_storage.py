@@ -301,3 +301,91 @@ def test_xlmeta_body_bitflip_fails_parse():
     bad = b"MTP2" + _mp.packb({"v": 2, "versions": [[1.0, "x", 1, "d"]]})
     with pytest.raises(se.CorruptedFormat):
         XLMeta.parse(bad)
+
+
+def test_columnar_add_version_equivalence():
+    """add_version on a PARSED (columnar) journal must produce exactly
+    the document the materialized path produces — inserts at head/middle/
+    tail, same-vid replacement, equal mod_times (stable order), delete
+    markers, and non-ascii ids."""
+    import copy
+
+    def build(base_versions, new_fi):
+        base = XLMeta()
+        for fi in base_versions:
+            base.add_version(fi)
+        raw = base.serialize()
+        # Columnar path: parse (stays columnar) then add.
+        col = XLMeta.parse(raw)
+        assert col._versions is None
+        col.add_version(copy.deepcopy(new_fi))
+        assert col._versions is None  # stayed columnar
+        # Materialized path: parse, touch versions, then add.
+        mat = XLMeta.parse(raw)
+        _ = mat.versions
+        mat.add_version(copy.deepcopy(new_fi))
+        return col.serialize(), mat.serialize()
+
+    def fi_at(vid, mt, size=10, deleted=False, dd=""):
+        fi = _mk_fi(vid=vid, size=size, deleted=deleted)
+        fi.mod_time = mt
+        fi.data_dir = dd
+        return fi
+
+    base = [fi_at("a" * 8, 30.0, dd="d1"), fi_at("b" * 8, 20.0),
+            fi_at("", 10.0)]
+    cases = [
+        fi_at("new-head", 40.0, dd="d9"),       # newest
+        fi_at("new-mid", 25.0),                  # middle
+        fi_at("new-tail", 5.0),                  # oldest
+        fi_at("a" * 8, 35.0, dd="d2"),           # replace existing vid
+        fi_at("", 15.0),                         # replace null version
+        fi_at("eq", 20.0),                       # equal mod_time (stable)
+        fi_at("dm", 22.0, deleted=True),         # delete marker
+        fi_at("ünïcode-vid", 33.0, dd="dïr"),    # multibyte id fields
+    ]
+    for new_fi in cases:
+        col, mat = build(base, new_fi)
+        assert col == mat, new_fi.version_id
+        # And both parse back to the same latest version.
+        a = XLMeta.parse(col).to_fileinfo("v", "o")
+        b = XLMeta.parse(mat).to_fileinfo("v", "o")
+        assert (a.version_id, a.mod_time, a.deleted) == \
+            (b.version_id, b.mod_time, b.deleted)
+
+
+def test_columnar_add_version_purges_duplicate_vids():
+    """A journal carrying DUPLICATE vids (alien writer) must end with
+    exactly one entry for the vid after add_version — on both paths."""
+    import msgpack as _mp
+
+    from minio_tpu.native.lib import crc32c as _crc
+    import struct as _struct
+
+    # Hand-craft an MTP2 doc with two entries sharing vid 'dup'.
+    bodies = [_mp.packb({"t": 1, "vid": "dup", "mt": float(m), "dd": "",
+                         "sz": 1, "meta": {}, "parts": [],
+                         "ec": {"algo": "", "k": 1, "m": 0, "bs": 1,
+                                "idx": 1, "dist": [1], "cks": []}})
+              for m in (20, 10)]
+    env = _mp.packb({
+        "v": 2, "n": 2,
+        "mt": _struct.pack("<2d", 20.0, 10.0),
+        "t": bytes([1, 1]),
+        "bl": _struct.pack("<2I", len(bodies[0]), len(bodies[1])),
+        "vl": _struct.pack("<2H", 3, 3),
+        "dl": _struct.pack("<2H", 0, 0),
+        "vid": b"dupdup", "dd": b"",
+    })
+    payload = b"".join([len(env).to_bytes(4, "little"), env] + bodies)
+    raw = b"MTP2" + _crc(payload).to_bytes(4, "little") + payload
+    fi = _mk_fi(vid="dup", size=7)
+    fi.mod_time = 30.0
+    col = XLMeta.parse(raw)
+    col.add_version(fi)
+    assert col._versions is None
+    mat = XLMeta.parse(raw)
+    _ = mat.versions
+    mat.add_version(fi)
+    assert col.version_count == mat.version_count == 1
+    assert col.serialize() == mat.serialize()
