@@ -9,8 +9,9 @@ threads (native/mtpu_native.cc mtpu_encode_part / mtpu_decode_part);
 Python keeps only control flow — drive selection, quorum, commit.
 
 The erasure layer (erasure/objects.py) engages this lane when the set's
-bitrot algorithm is the host-native sip256 and every drive is local; any
-other configuration streams through the batched device codec instead.
+bitrot algorithm is host-native (sip256 or highwayhash256) and every
+drive is local; any other configuration streams through the batched
+device codec instead.
 """
 
 from __future__ import annotations

@@ -38,17 +38,24 @@ def _unb64(s: str) -> bytes:
     return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
 
 
-def make_jwt(secret: str, access_key: str, ttl: float = TOKEN_TTL) -> str:
+def make_jwt(secret: str, access_key: str, ttl: float = TOKEN_TTL,
+             scope: str = "") -> str:
+    """scope != "" mints a CAPABILITY token (e.g. "dl:bucket/key"):
+    accepted ONLY by the endpoint that checks that scope, never as a
+    console session — a share link must not hand its recipient the
+    sharer's identity."""
     header = _b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
-    payload = _b64(json.dumps({"sub": access_key,
-                               "exp": time.time() + ttl}).encode())
+    claims = {"sub": access_key, "exp": time.time() + ttl}
+    if scope:
+        claims["scope"] = scope
+    payload = _b64(json.dumps(claims).encode())
     signing = f"{header}.{payload}".encode()
     sig = _b64(hmac.new(secret.encode(), signing, hashlib.sha256).digest())
     return f"{header}.{payload}.{sig}"
 
 
-def verify_jwt(secret: str, token: str) -> str | None:
-    """Returns the access key, or None."""
+def verify_jwt_claims(secret: str, token: str) -> dict | None:
+    """Verified claims dict ({sub, exp[, scope]}), or None."""
     try:
         header, payload, sig = token.split(".")
         signing = f"{header}.{payload}".encode()
@@ -59,9 +66,19 @@ def verify_jwt(secret: str, token: str) -> str | None:
         doc = json.loads(_unb64(payload))
         if doc.get("exp", 0) < time.time():
             return None
-        return doc.get("sub")
+        return doc
     except Exception:  # noqa: BLE001
         return None
+
+
+def verify_jwt(secret: str, token: str) -> str | None:
+    """Returns the access key of an UNSCOPED (session) token, or None —
+    scoped capability tokens are refused here so a leaked share link can
+    never authenticate RPC or upload calls."""
+    doc = verify_jwt_claims(secret, token)
+    if doc is None or doc.get("scope"):
+        return None
+    return doc.get("sub")
 
 
 class WebAPI:
@@ -315,18 +332,29 @@ class WebAPI:
         return {}
 
     async def _create_url_token(self, ident, params):
+        # Download-only capability (any object the identity may read) —
+        # never a console session.
         return {"token": make_jwt(self._jwt_secret(), ident.access_key,
-                                  ttl=URL_TOKEN_TTL)}
+                                  ttl=URL_TOKEN_TTL, scope="dl:*")}
 
     async def _presigned_get(self, ident, params):
+        """Download/share URL. `expiry` seconds (optional) supports the
+        console's share dialog — capped at 7 days like S3 presigned URLs
+        (reference ShareObject, cmd/web-handlers.go)."""
         bucket = params["bucketName"]
         obj = params["objectName"]
         if not self._allowed(ident, "s3:GetObject", bucket, obj):
             raise PermissionError("GetObject denied")
-        token = make_jwt(self._jwt_secret(), ident.access_key,
-                         ttl=URL_TOKEN_TTL)
+        try:
+            ttl = float(params.get("expiry", URL_TOKEN_TTL))
+        except (TypeError, ValueError):
+            ttl = URL_TOKEN_TTL
+        ttl = max(1.0, min(ttl, 7 * 24 * 3600.0))
+        token = make_jwt(self._jwt_secret(), ident.access_key, ttl=ttl,
+                         scope=f"dl:{bucket}/{obj}")
         return {"url": f"/minio/download/{bucket}/"
-                       f"{urllib.parse.quote(obj)}?token={token}"}
+                       f"{urllib.parse.quote(obj)}?token={token}",
+                "expiry": ttl}
 
     # -- streaming upload / download --
 
@@ -356,9 +384,15 @@ class WebAPI:
     async def download(self, request: web.Request, bucket: str,
                        key: str) -> web.StreamResponse:
         token = request.query.get("token", "")
-        ak = verify_jwt(self._jwt_secret(), token)
-        if ak is None:
+        claims = verify_jwt_claims(self._jwt_secret(), token)
+        if claims is None:
             raise web.HTTPForbidden(text="invalid token")
+        scope = claims.get("scope", "")
+        if scope not in ("dl:*", f"dl:{bucket}/{key}"):
+            # Session tokens and foreign-object capabilities are refused:
+            # the ?token= travels in a shareable URL.
+            raise web.HTTPForbidden(text="token not valid for this object")
+        ak = claims.get("sub")
         try:
             ident = self.s.iam.identify(ak)
         except se.InvalidAccessKey:

@@ -456,13 +456,18 @@ class XLMeta:
             blob = msgpack.packb(doc)
             # Strict comparison: the materialized path appends then
             # STABLE-sorts descending, so equal-mod_time entries keep the
-            # existing-before-new order — insert AFTER all equals.
+            # existing-before-new order — insert AFTER all equals. The
+            # splice assumes the journal is already sorted descending;
+            # a CRC-valid but UNSORTED journal (alien writer) must take
+            # the materializing path, which re-sorts everything.
             mts = struct.unpack(f"<{c.n}d", c.mt)
-            pos = next((i for i, m in enumerate(mts)
-                        if m < fi.mod_time), c.n)
-            c.insert(pos, fi.mod_time, fi.version_id, doc["t"],
-                     fi.data_dir if not fi.deleted else "", blob)
-            return
+            if all(mts[i] >= mts[i + 1] for i in range(len(mts) - 1)):
+                pos = next((i for i, m in enumerate(mts)
+                            if m < fi.mod_time), c.n)
+                c.insert(pos, fi.mod_time, fi.version_id, doc["t"],
+                         fi.data_dir if not fi.deleted else "", blob)
+                return
+            # fall through: materialize (the .versions access below)
         ver = Version.from_doc(_fi_to_doc(fi))
         # Null-version semantics: a write with no version id replaces the
         # existing null version in place.

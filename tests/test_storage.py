@@ -389,3 +389,40 @@ def test_columnar_add_version_purges_duplicate_vids():
     mat.add_version(fi)
     assert col.version_count == mat.version_count == 1
     assert col.serialize() == mat.serialize()
+
+
+def test_columnar_add_version_unsorted_journal_falls_back():
+    """A CRC-valid but UNSORTED journal (alien writer) must not take the
+    columnar splice — both paths must agree on the re-sorted result."""
+    import msgpack as _mp
+    import struct as _struct
+
+    from minio_tpu.native.lib import crc32c as _crc
+
+    bodies = [_mp.packb({"t": 1, "vid": v, "mt": float(m), "dd": "",
+                         "sz": 1, "meta": {}, "parts": [],
+                         "ec": {"algo": "", "k": 1, "m": 0, "bs": 1,
+                                "idx": 1, "dist": [1], "cks": []}})
+              for v, m in (("old", 10), ("new", 30))]  # ASCENDING = unsorted
+    env = _mp.packb({
+        "v": 2, "n": 2,
+        "mt": _struct.pack("<2d", 10.0, 30.0),
+        "t": bytes([1, 1]),
+        "bl": _struct.pack("<2I", *(len(b) for b in bodies)),
+        "vl": _struct.pack("<2H", 3, 3),
+        "dl": _struct.pack("<2H", 0, 0),
+        "vid": b"oldnew", "dd": b"",
+    })
+    payload = b"".join([len(env).to_bytes(4, "little"), env] + bodies)
+    raw = b"MTP2" + _crc(payload).to_bytes(4, "little") + payload
+    fi = _mk_fi(vid="mid", size=5)
+    fi.mod_time = 20.0
+    col = XLMeta.parse(raw)
+    col.add_version(fi)
+    mat = XLMeta.parse(raw)
+    _ = mat.versions
+    mat.add_version(fi)
+    assert col.serialize() == mat.serialize()
+    # Latest must be the mt=30 entry, not the freshly inserted one.
+    assert XLMeta.parse(col.serialize()).to_fileinfo("v", "o").version_id \
+        == "new"

@@ -177,3 +177,45 @@ def test_web_bucket_policy_roundtrip(server):
     _rpc(base, "SetBucketPolicy",
          {"bucketName": "polbkt", "policy": "none"}, token)
     assert requests.get(f"{base}/polbkt/pub.txt").status_code == 403
+
+
+def test_share_token_is_download_scoped(server):
+    """A share link's token is a CAPABILITY for that one object — it must
+    never authenticate RPC calls, uploads, or other objects' downloads."""
+    import urllib.parse
+
+    import requests
+
+    base, _srv = server
+    tok = _login(base)
+    _rpc(base, "MakeBucket", {"bucketName": "scopebkt"}, token=tok)
+    r = requests.put(base + "/minio/upload/scopebkt/one.txt", data=b"1",
+                     headers={"Authorization": "Bearer " + tok})
+    assert r.status_code == 200
+    requests.put(base + "/minio/upload/scopebkt/two.txt", data=b"2",
+                 headers={"Authorization": "Bearer " + tok})
+    res = _rpc(base, "PresignedGet",
+               {"bucketName": "scopebkt", "objectName": "one.txt",
+                "expiry": 3600}, token=tok)["result"]
+    assert res["expiry"] == 3600
+    url = res["url"]
+    share_tok = urllib.parse.parse_qs(
+        urllib.parse.urlparse(url).query)["token"][0]
+    # The link downloads ITS object...
+    assert requests.get(base + url).content == b"1"
+    # ...but the embedded token is refused everywhere else:
+    r = requests.post(base + "/minio/webrpc", json={
+        "jsonrpc": "2.0", "id": 1, "method": "web.ListBuckets",
+        "params": {}},
+        headers={"Authorization": "Bearer " + share_tok})
+    assert r.json().get("error", {}).get("code") == 401
+    r = requests.put(base + "/minio/upload/scopebkt/evil.txt", data=b"x",
+                     headers={"Authorization": "Bearer " + share_tok})
+    assert r.status_code == 403
+    r = requests.get(base + "/minio/download/scopebkt/two.txt",
+                     params={"token": share_tok})
+    assert r.status_code == 403
+    # And a SESSION token is refused on the download link surface.
+    r = requests.get(base + "/minio/download/scopebkt/one.txt",
+                     params={"token": tok})
+    assert r.status_code == 403
