@@ -177,6 +177,18 @@ class AdminAPI:
         if op == "replication-status" and m == "GET":
             self._authorize(identity, "admin:ServerInfo")
             return _json(self.s.replication.stats)
+        if op == "cache" and m == "GET":
+            # Disk-cache observability (reference CacheMetrics admin
+            # surface): hit/miss/eviction/writeback counters when a cache
+            # decorator wraps the layer.
+            self._authorize(identity, "admin:ServerInfo")
+            layer = self.s.obj
+            while layer is not None and not hasattr(layer, "stats"):
+                layer = getattr(layer, "inner", None)
+            stats = getattr(layer, "stats", None)
+            return _json({"enabled": stats is not None,
+                          "stats": dict(stats) if stats else {}})
+
         if op == "bandwidth" and m == "GET":
             self._authorize(identity, "admin:ServerInfo")
             # Limits shown alongside the accounting so a mistyped bucket
@@ -353,7 +365,7 @@ class AdminAPI:
                 continue
             probe = _os.path.join(root, f".obd-{_uuid.uuid4().hex}")
             entry = {"endpoint": d.endpoint(), "remote": False}
-            try:  # device identity (pkg/smart + pkg/mountinfo roles)
+            try:  # device identity + I/O health (pkg/smart + mountinfo)
                 from minio_tpu.utils.mounts import device_health
 
                 entry.update(device_health(root))

@@ -52,9 +52,12 @@ class CacheObjects:
         self.commit = commit
         os.makedirs(cache_dir, exist_ok=True)
         self._mu = threading.Lock()
+        # All keys pre-seeded: admin snapshots dict(stats) concurrently
+        # with worker-thread updates, and inserting a NEW key mid-copy
+        # would raise "dictionary changed size during iteration".
         self.stats = {"hits": 0, "misses": 0, "evictions": 0,
                       "revalidations": 0, "writebacks": 0,
-                      "writeback_pending": 0}
+                      "writeback_pending": 0, "writeback_failed": 0}
         self._wb_q: queue.Queue = queue.Queue()
         self._wb_stop = threading.Event()
         self._wb_thread: threading.Thread | None = None

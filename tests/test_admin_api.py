@@ -333,3 +333,17 @@ def test_admin_service_restart_and_update(client, server):
     assert r.status_code == 200
     doc = r.json()
     assert doc["currentVersion"] and doc["updateAvailable"] is False
+
+
+def test_smart_drive_health_probe():
+    """The sysfs drive-health probe (pkg/smart role) reports I/O stats
+    for a real path and degrades to a bare record elsewhere."""
+    from minio_tpu.utils.smart import drive_health
+
+    h = drive_health("/")
+    assert h["path"] == "/"
+    if "device" in h:  # containerized hosts may hide sysfs block info
+        assert h.get("read_ios", 0) >= 0
+        assert "write_ios" in h
+    assert drive_health("/definitely/not/here") == {
+        "path": "/definitely/not/here"}
