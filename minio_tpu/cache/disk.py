@@ -312,7 +312,7 @@ class CacheObjects:
                     cur["dirty"] = False
                     cur["failed"] = True
                     self._write_meta(mp, cur)
-                self.stats["writeback_failed"] =                     self.stats.get("writeback_failed", 0) + 1
+                self.stats["writeback_failed"] += 1
                 self.stats["writeback_pending"] = max(
                     0, self.stats["writeback_pending"] - 1)
                 continue

@@ -229,7 +229,8 @@ class S3Server:
                                    notifier=self.notifier,
                                    interval=interval,
                                    heal_objects=heal_objects,
-                                   tracker=self.update_tracker)
+                                   tracker=self.update_tracker,
+                                   config=self.config)
         self.scanner.start()
 
     # Set by main() (the CLI entry point); embedded servers either leave it

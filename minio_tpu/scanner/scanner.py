@@ -34,9 +34,13 @@ class DataScanner:
     def __init__(self, object_layer, bucket_meta: BucketMetadataSys,
                  store=None, notifier=None,
                  interval: float = SCAN_INTERVAL,
-                 heal_objects: bool = False, tracker=None):
+                 heal_objects: bool = False, tracker=None, config=None):
         self.obj = object_layer
         self.bucket_meta = bucket_meta
+        # Config KV provider for the `heal` subsystem (bitrotscan toggle —
+        # reference cmd/config/heal: scanner heals deep-verify shards when
+        # heal.bitrotscan=on). Live: admin config-set applies next cycle.
+        self.config = config
         self.store = store if store is not None else (
             object_layer if hasattr(object_layer, "read_sys_config") else None)
         self.notifier = notifier
@@ -87,6 +91,13 @@ class DataScanner:
         fresh = DataUsageCache()
         fresh.cycles = self.usage.cycles + 1
         deep_heal = self.heal_objects and fresh.cycles % HEAL_EVERY_N_CYCLES == 0
+        bitrot_scan = False
+        if self.config is not None:
+            try:
+                bitrot_scan = (
+                    self.config.get("heal", "bitrotscan") == "on")
+            except Exception:  # noqa: BLE001 - config unavailable
+                pass
 
         buckets = [b.name for b in self.obj.list_buckets()]
         lifecycles: dict[str, object] = {}
@@ -140,7 +151,8 @@ class DataScanner:
                 if prev is not None:
                     fresh.buckets[bucket] = prev
                 continue
-            self._scan_bucket(bucket, lifecycle, fresh, deep_heal, now)
+            self._scan_bucket(bucket, lifecycle, fresh, deep_heal, now,
+                              bitrot_scan)
             if lifecycle is not None:
                 self._expire_mpus(bucket, lifecycle, now)
             done_docs[bucket] = fresh.bucket(bucket).to_doc()
@@ -204,7 +216,8 @@ class DataScanner:
             pass
 
     def _scan_bucket(self, bucket: str, lifecycle, fresh: DataUsageCache,
-                     deep_heal: bool, now: float | None) -> None:
+                     deep_heal: bool, now: float | None,
+                     bitrot_scan: bool = False) -> None:
         entry = fresh.bucket(bucket)
         marker = vmarker = ""
         while True:
@@ -230,7 +243,11 @@ class DataScanner:
                                         now=now)
                 if deep_heal:
                     try:
-                        self.obj.heal_object(bucket, key, scan_deep=False)
+                        # heal.bitrotscan=on upgrades the periodic heal to
+                        # a full shard bitrot verify (reference scanner
+                        # deep scan mode).
+                        self.obj.heal_object(bucket, key,
+                                             scan_deep=bitrot_scan)
                     except Exception:  # noqa: BLE001
                         pass
             if not page.is_truncated:

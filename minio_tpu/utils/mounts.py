@@ -73,6 +73,9 @@ def device_health(path: str) -> dict:
     info: dict = {"mountPoint": mp, "device": dev, "fsType": fstype}
     h = smart.drive_health(path)
     h.pop("path", None)
-    # smart's st_dev resolution beats the mount-table device name.
+    # The sysfs DISK name complements (never replaces) the mount-table
+    # device path — '/dev/sda1' and 'sda' are both identity.
+    if "device" in h:
+        h["disk"] = h.pop("device")
     info.update(h)
     return info

@@ -319,3 +319,46 @@ def test_scanner_checkpoint_ignored_for_new_cycle(layer):
     usage = sc.scan_once()
     assert usage.buckets["ckb"].objects == 1
     assert usage.buckets["ckb"].size == 3
+
+
+def test_scanner_bitrotscan_config_drives_deep_heal(tmp_path, monkeypatch):
+    """heal.bitrotscan=on upgrades the scanner's periodic heal pass to a
+    shard bitrot verify: a silently-corrupted shard is repaired by the
+    scan cycle; with the default off it is not."""
+    import io
+    import os
+
+    from minio_tpu.admin.configkv import ConfigSys
+    from minio_tpu.erasure import ErasureObjects
+    from minio_tpu.erasure.metadata import hash_order, shuffle_by_distribution
+    from minio_tpu.scanner.scanner import DataScanner
+    from minio_tpu.storage import LocalDrive
+
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureObjects(drives, parity=1, block_size=1 << 16,
+                        bitrot_algorithm="sip256")
+    es.make_bucket("scn")
+    data = os.urandom(200_000)
+    es.put_object("scn", "obj", io.BytesIO(data), len(data))
+    root = shuffle_by_distribution(es.drives, hash_order("scn/obj", 4))[0].root
+    shard = None
+    for dirpath, _d, files in os.walk(os.path.join(root, "scn", "obj")):
+        for f in files:
+            if f.startswith("part."):
+                shard = os.path.join(dirpath, f)
+    blob = bytearray(open(shard, "rb").read())
+    blob[50] ^= 0xFF
+    open(shard, "wb").write(bytes(blob))
+
+    cfg = ConfigSys()
+    scanner = DataScanner(es, None, store=None, heal_objects=True,
+                          config=cfg)
+    # Force every cycle to be a heal cycle.
+    import minio_tpu.scanner.scanner as scmod
+    monkeypatch.setattr(scmod, "HEAL_EVERY_N_CYCLES", 1)
+
+    scanner.scan_once()  # bitrotscan off: presence-only heal, not repaired
+    assert open(shard, "rb").read() == bytes(blob)
+    cfg.set_kv("heal", {"bitrotscan": "on"})
+    scanner.scan_once()  # deep verify: corruption found and rebuilt
+    assert open(shard, "rb").read() != bytes(blob)
