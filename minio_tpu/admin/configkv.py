@@ -69,7 +69,7 @@ DEFAULTS: dict[str, dict[str, str]] = {
 }
 
 # Subsystems that apply without restart (cmd/config/config.go:133).
-DYNAMIC = {"api", "scanner", "heal",
+DYNAMIC = {"api", "scanner", "heal", "storageclass", "bandwidth",
            "logger_webhook", "audit_webhook", "audit_file",
            "notify_webhook", "notify_nats", "notify_redis", "notify_mqtt",
            "notify_elasticsearch", "notify_nsq", "notify_kafka",
@@ -126,6 +126,18 @@ class ConfigSys:
             # validates VALUES (bytes/sec) — a typo like "10MB" silently
             # becoming "unlimited" on the data path would be worse than an
             # error here. Other subsystems validate against their schema.
+            if subsys == "storageclass":
+                # "" (default) or "EC:<parity>" — a typo silently becoming
+                # "keep default" would hide a misconfigured redundancy.
+                for k, v in updates.items():
+                    s = str(v).strip().upper()
+                    ok = s == "" or (s.startswith("EC:")
+                                     and s[3:].isdigit()
+                                     and int(s[3:]) <= 16)
+                    if not ok:
+                        raise se.IAMError(
+                            f"storageclass.{k}: expected EC:<0-16>, "
+                            f"got {v!r}")
             if subsys == "bandwidth":
                 import math
 

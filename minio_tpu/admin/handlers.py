@@ -451,6 +451,8 @@ class AdminAPI:
                 self.s.configure_logging()  # dynamic re-apply
             if any(s.startswith("notify_") for s in doc):
                 self.s.configure_event_targets()
+            if "storageclass" in doc:
+                self.s.apply_storage_class_config()
             return _json({"restart": [s for s in doc
                                       if not cfg.is_dynamic(s)]})
         raise S3Error("MethodNotAllowed", resource=request.path)

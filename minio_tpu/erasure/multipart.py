@@ -107,10 +107,8 @@ class MultipartMixin:
         upload_id = uuid.uuid4().hex
         dist = hash_order(f"{bucket}/{obj}", self.n)
 
-        m = self.parity
         sc = opts.user_defined.get("x-amz-storage-class", "")
-        if sc == "REDUCED_REDUNDANCY" and self.n >= 4:
-            m = max(1, m - 2)
+        m = self.parity_for_class(sc)
 
         meta = {
             "bucket": bucket,

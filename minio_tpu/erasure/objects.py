@@ -215,6 +215,23 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             raise se.BucketNotFound(bucket)
         reduce_write_quorum(results, self._write_quorum_meta(), bucket)
 
+    def parity_for_class(self, sc: str) -> int:
+        """Parity for a storage class (reference GetParityForSC,
+        cmd/config/storageclass/storage-class.go:234): the `storageclass`
+        config subsystem ("EC:N") overrides per class when set on the set
+        (sc_parity, applied live by the server); otherwise STANDARD uses
+        the constructor parity and RRS drops two below it."""
+        sc_map = getattr(self, "sc_parity", None) or {}
+        if sc == "REDUCED_REDUNDANCY":
+            m = sc_map.get("RRS")
+            if m is None:
+                m = max(1, self.parity - 2) if self.n >= 4 else self.parity
+        else:
+            m = sc_map.get("STANDARD", self.parity)
+        # Reference validateParity bound: parity never exceeds drives/2 —
+        # k < m would let a sub-majority write claim quorum.
+        return max(0, min(int(m), self.n // 2))
+
     def _write_quorum_meta(self) -> int:
         return self.n // 2 + 1
 
@@ -240,10 +257,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         _validate_object_name(obj)
         self.get_bucket_info(bucket)
 
-        m = self.parity
         sc = opts.user_defined.get("x-amz-storage-class", "")
-        if sc == "REDUCED_REDUNDANCY" and self.n >= 4:
-            m = max(1, m - 2)
+        m = self.parity_for_class(sc)
         k = self.n - m
         write_quorum = self._write_quorum_data(m)
 

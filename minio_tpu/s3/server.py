@@ -97,14 +97,24 @@ class S3Server:
         self.app = web.Application(client_max_size=1 << 30)
         self.app.router.add_route("*", "/{tail:.*}", self._entry)
 
-        # Security headers on every response, including prepared streams
-        # (reference addSecurityHeaders, cmd/generic-handlers.go).
-        async def _security_headers(_request, response):
+        # Security + CORS headers on every response, including prepared
+        # streams (reference addSecurityHeaders + CrossDomainPolicy/CORS,
+        # cmd/generic-handlers.go). The allowed origin comes from the
+        # `api.cors_allow_origin` config ("*" default, "" disables).
+        async def _security_headers(request, response):
             response.headers.setdefault("X-Content-Type-Options", "nosniff")
             response.headers.setdefault("X-XSS-Protection", "1; mode=block")
             response.headers.setdefault(
                 "Content-Security-Policy", "block-all-mixed-content")
             response.headers.setdefault("Server", "minio-tpu")
+            origin = self._cors_origin()
+            if origin and request.headers.get("Origin"):
+                response.headers.setdefault(
+                    "Access-Control-Allow-Origin", origin)
+                response.headers.setdefault(
+                    "Access-Control-Expose-Headers",
+                    "ETag, x-amz-version-id, x-amz-request-id, "
+                    "Content-Range, Content-Length")
 
         self.app.on_response_prepare.append(_security_headers)
 
@@ -160,6 +170,9 @@ class S3Server:
         self.logger = get_logger()
         self.configure_logging()
         self.configure_event_targets()
+
+        # Storage-class parity from the `storageclass` config (EC:N).
+        self.apply_storage_class_config()
 
         # Replication plane (cmd/bucket-replication.go).
         from minio_tpu.replication.pool import BucketTargetSys, ReplicationPool
@@ -218,6 +231,59 @@ class S3Server:
 
         from minio_tpu.s3.web import WebAPI
         self.web = WebAPI(self)
+
+    def _cors_origin(self) -> str:
+        """api.cors_allow_origin, cached against the config generation —
+        this runs on EVERY response."""
+        gen = getattr(self.config, "generation", 0)
+        cached = getattr(self, "_cors_cache", None)
+        if cached is not None and cached[0] == gen:
+            return cached[1]
+        try:
+            origin = self.config.get("api", "cors_allow_origin")
+        except Exception:  # noqa: BLE001 - config not ready yet
+            origin = "*"
+        self._cors_cache = (gen, origin)
+        return origin
+
+    def apply_storage_class_config(self) -> None:
+        """Parse storageclass.standard/rrs ("EC:N") and stamp the parity
+        map onto every erasure set — live-appliable via admin config-set
+        (reference cmd/config/storageclass)."""
+        def parse(v: str):
+            v = (v or "").strip().upper()
+            if v.startswith("EC:"):
+                try:
+                    return int(v[3:])
+                except ValueError:
+                    return None
+            return None
+
+        sc_map = {}
+        for key, name in (("standard", "STANDARD"), ("rrs", "RRS")):
+            try:
+                m = parse(self.config.get("storageclass", key))
+            except Exception:  # noqa: BLE001
+                m = None
+            if m is not None:
+                sc_map[name] = m
+        # The per-set clamp (parity <= drives/2, reference
+        # validateParity) applies where the geometry is known.
+        layer = self.obj
+        while layer is not None and not any(
+                hasattr(layer, a) for a in ("pools", "sets", "drives")):
+            layer = getattr(layer, "inner", None)
+        stack = [layer] if layer is not None else []
+        while stack:
+            node = stack.pop()
+            if node is None:
+                continue
+            for attr in ("pools", "sets"):
+                kids = getattr(node, attr, None)
+                if kids:
+                    stack.extend(kids)
+            if hasattr(node, "parity_for_class"):
+                node.sc_parity = dict(sc_map)
 
     def start_scanner(self, interval: float = 60.0,
                       heal_objects: bool = True) -> None:
@@ -477,6 +543,21 @@ class S3Server:
     async def _entry(self, request: web.Request) -> web.StreamResponse:
         request_id = uuid.uuid4().hex[:16].upper()
         path = urllib.parse.unquote(request.raw_path.split("?", 1)[0])
+        if request.method == "OPTIONS" and request.headers.get("Origin") \
+                and self._cors_origin():
+            # CORS preflight (reference CorsHandler) — only when CORS is
+            # enabled; Authorization must be listed explicitly (the Fetch
+            # spec's wildcard excludes it, which would block signed
+            # cross-origin requests). Allow-Origin attaches in the shared
+            # on_response_prepare hook.
+            return web.Response(status=200, headers={
+                "Access-Control-Allow-Methods":
+                    "GET, PUT, POST, DELETE, HEAD",
+                "Access-Control-Allow-Headers":
+                    "Authorization, Content-Type, Content-MD5, "
+                    "x-amz-date, x-amz-content-sha256, "
+                    "x-amz-security-token, x-amz-user-agent, *",
+                "Access-Control-Max-Age": "3600"})
         t0 = self.stats.begin()
         resp = None
         try:

@@ -98,6 +98,7 @@ class DataScanner:
                     self.config.get("heal", "bitrotscan") == "on")
             except Exception:  # noqa: BLE001 - config unavailable
                 pass
+        self._load_pacing()
 
         buckets = [b.name for b in self.obj.list_buckets()]
         lifecycles: dict[str, object] = {}
@@ -215,12 +216,42 @@ class DataScanner:
         except Exception:  # noqa: BLE001
             pass
 
+    def _load_pacing(self) -> None:
+        """Adaptive pacing from the `scanner` config (the reference's
+        scannerSleeper, cmd/data-scanner.go): after each page the scanner
+        sleeps delay x the time the page took, capped at max_wait — the
+        crawl yields CPU/IO to foreground traffic proportionally to how
+        expensive it is. delay=0 disables."""
+        self._pace_delay = 0.0
+        self._pace_cap = 15.0
+        if self.config is None:
+            return
+        try:
+            self._pace_delay = max(0.0, float(
+                self.config.get("scanner", "delay") or 0))
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            raw = (self.config.get("scanner", "max_wait") or "15s").strip()
+            self._pace_cap = float(raw[:-1]) if raw.endswith("s") \
+                else float(raw)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _pace(self, elapsed: float) -> None:
+        if getattr(self, "_pace_delay", 0.0) <= 0:
+            return
+        self._stop.wait(min(elapsed * self._pace_delay, self._pace_cap))
+
     def _scan_bucket(self, bucket: str, lifecycle, fresh: DataUsageCache,
                      deep_heal: bool, now: float | None,
                      bitrot_scan: bool = False) -> None:
+        import time as _time
+
         entry = fresh.bucket(bucket)
         marker = vmarker = ""
         while True:
+            _t0 = _time.monotonic()
             try:
                 page = self.obj.list_object_versions(
                     bucket, "", marker, vmarker, "", PAGE)
@@ -250,6 +281,7 @@ class DataScanner:
                                              scan_deep=bitrot_scan)
                     except Exception:  # noqa: BLE001
                         pass
+            self._pace(_time.monotonic() - _t0)
             if not page.is_truncated:
                 return
             marker = page.next_marker

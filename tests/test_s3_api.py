@@ -403,3 +403,50 @@ def test_security_headers_and_reserved_metadata(client, bucket):
     # object served without SSE confusion despite the forged headers
     assert client.get(f"/{bucket}/sec-obj").content == b"x"
     client.delete(f"/{bucket}/sec-obj")
+
+
+def test_cors_headers_and_preflight(server):
+    import requests
+
+    # Preflight
+    r = requests.options(server + "/anything",
+                         headers={"Origin": "http://app.example"})
+    assert r.status_code == 200
+    assert "GET" in r.headers.get("Access-Control-Allow-Methods", "")
+    # Simple request carries the configured allow-origin + exposes ETag
+    r = requests.get(server + "/", headers={"Origin": "http://app.example"})
+    assert r.headers.get("Access-Control-Allow-Origin") == "*"
+    assert "ETag" in r.headers.get("Access-Control-Expose-Headers", "")
+    # No Origin header -> no CORS headers
+    r = requests.get(server + "/")
+    assert "Access-Control-Allow-Origin" not in r.headers
+
+
+def test_storage_class_config_drives_parity(tmp_path):
+    """storageclass config (EC:N) overrides the parity per class
+    (reference GetParityForSC)."""
+    import io as _io
+
+    from minio_tpu.erasure import ErasureObjects
+    from minio_tpu.storage.local import LocalDrive
+
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(8)]
+    es = ErasureObjects(drives, parity=3, block_size=1 << 16)
+    # Defaults: STANDARD = constructor parity, RRS = parity - 2.
+    assert es.parity_for_class("") == 3
+    assert es.parity_for_class("REDUCED_REDUNDANCY") == 1
+    es.sc_parity = {"STANDARD": 4, "RRS": 2}
+    assert es.parity_for_class("") == 4
+    assert es.parity_for_class("REDUCED_REDUNDANCY") == 2
+    # And the geometry actually applies to a PUT.
+    es.make_bucket("scp")
+    data = b"x" * 200_000
+    es.put_object("scp", "obj", _io.BytesIO(data),
+                  len(data), )
+    fi = es.latest_fileinfo("scp", "obj")
+    assert fi.erasure.parity_blocks == 4
+    from minio_tpu.erasure.types import ObjectOptions
+    es.put_object("scp", "rrs", _io.BytesIO(data), len(data),
+                  ObjectOptions(user_defined={
+                      "x-amz-storage-class": "REDUCED_REDUNDANCY"}))
+    assert es.latest_fileinfo("scp", "rrs").erasure.parity_blocks == 2

@@ -351,6 +351,7 @@ def test_scanner_bitrotscan_config_drives_deep_heal(tmp_path, monkeypatch):
     open(shard, "wb").write(bytes(blob))
 
     cfg = ConfigSys()
+    cfg.set_kv("scanner", {"delay": "0"})  # no pacing in tests
     scanner = DataScanner(es, None, store=None, heal_objects=True,
                           config=cfg)
     # Force every cycle to be a heal cycle.
