@@ -102,16 +102,38 @@ class AutoHealer:
     walks their set's namespace through heal_object, checkpointing and
     resuming via the tracker (reference monitorLocalDisksAndHeal)."""
 
-    def __init__(self, sets, interval: float = 10.0):
+    def __init__(self, sets, interval: float = 10.0, config=None):
         # `sets` is anything exposing .sets -> list[ErasureObjects]
         # (ErasureSets / pools) or a single ErasureObjects. When it is a
         # full ErasureSets (carries the format layout), the monitor also
         # runs live drive-replacement detection (heal_format) each pass.
+        # `config` provides heal.max_sleep / heal.max_io pacing
+        # (reference cmd/config/heal: the background heal must yield to
+        # foreground traffic).
         self._owner = sets if hasattr(sets, "format") else None
         self._sets = getattr(sets, "sets", None) or [sets]
         self.interval = interval
+        self.config = config
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    def _pacing(self) -> tuple[float, int]:
+        """(max_sleep seconds, objects healed per sleep) from the live
+        heal config; (0, 1) disables pacing."""
+        if self.config is None:
+            return 0.0, 1
+        from minio_tpu.utils.dyntimeout import parse_duration
+
+        try:
+            max_sleep = parse_duration(
+                self.config.get("heal", "max_sleep"), 0.0)
+        except Exception:  # noqa: BLE001
+            max_sleep = 0.0
+        try:
+            max_io = max(1, int(self.config.get("heal", "max_io") or 1))
+        except Exception:  # noqa: BLE001
+            max_io = 1
+        return max(0.0, max_sleep), max_io
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -161,6 +183,8 @@ class AutoHealer:
         one included), resuming after the tracker's bookmark."""
         buckets = sorted(b.name for b in es.list_buckets())
         since_save = 0
+        max_sleep, max_io = self._pacing()
+        since_sleep = 0
         for bucket in buckets:
             if bucket in tracker.finished_buckets:
                 continue
@@ -187,6 +211,14 @@ class AutoHealer:
                     tracker.failed += 1
                 tracker.bucket, tracker.obj = bucket, name
                 since_save += 1
+                since_sleep += 1
+                if max_sleep > 0 and since_sleep >= max_io:
+                    # Yield to foreground traffic (heal.max_sleep per
+                    # heal.max_io healed objects — reference heal config).
+                    since_sleep = 0
+                    if self._stop.wait(max_sleep):
+                        tracker.save(drive)
+                        return
                 if since_save >= CHECKPOINT_EVERY:
                     tracker.save(drive)
                     since_save = 0

@@ -224,13 +224,18 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         sc_map = getattr(self, "sc_parity", None) or {}
         if sc == "REDUCED_REDUNDANCY":
             m = sc_map.get("RRS")
-            if m is None:
-                m = max(1, self.parity - 2) if self.n >= 4 else self.parity
-        else:
-            m = sc_map.get("STANDARD", self.parity)
-        # Reference validateParity bound: parity never exceeds drives/2 —
-        # k < m would let a sub-majority write claim quorum.
-        return max(0, min(int(m), self.n // 2))
+            if m is not None:
+                # CONFIGURED values clamp to the reference validateParity
+                # bound (parity <= drives/2 — beyond it a sub-majority
+                # write could claim quorum). Constructor-chosen defaults
+                # pass through untouched: explicit geometries are the
+                # operator's call, already validated at construction.
+                return max(0, min(int(m), self.n // 2))
+            return max(1, self.parity - 2) if self.n >= 4 else self.parity
+        m = sc_map.get("STANDARD")
+        if m is not None:
+            return max(0, min(int(m), self.n // 2))
+        return self.parity
 
     def _write_quorum_meta(self) -> int:
         return self.n // 2 + 1

@@ -494,11 +494,14 @@ class S3Server:
             target = target.inner
         pools = getattr(target, "pools", None)
         if pools:
-            self.auto_healer = [AutoHealer(p, interval=interval) for p in pools]
+            self.auto_healer = [AutoHealer(p, interval=interval,
+                                           config=self.config)
+                                for p in pools]
             for h in self.auto_healer:
                 h.start()
         elif hasattr(target, "drives") or hasattr(target, "sets"):
-            self.auto_healer = [AutoHealer(target, interval=interval)]
+            self.auto_healer = [AutoHealer(target, interval=interval,
+                                           config=self.config)]
             self.auto_healer[0].start()
         else:
             self.auto_healer = []

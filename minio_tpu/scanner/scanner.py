@@ -231,10 +231,11 @@ class DataScanner:
                 self.config.get("scanner", "delay") or 0))
         except Exception:  # noqa: BLE001
             pass
+        from minio_tpu.utils.dyntimeout import parse_duration
+
         try:
-            raw = (self.config.get("scanner", "max_wait") or "15s").strip()
-            self._pace_cap = float(raw[:-1]) if raw.endswith("s") \
-                else float(raw)
+            self._pace_cap = parse_duration(
+                self.config.get("scanner", "max_wait"), 15.0)
         except Exception:  # noqa: BLE001
             pass
 
@@ -246,12 +247,10 @@ class DataScanner:
     def _scan_bucket(self, bucket: str, lifecycle, fresh: DataUsageCache,
                      deep_heal: bool, now: float | None,
                      bitrot_scan: bool = False) -> None:
-        import time as _time
-
         entry = fresh.bucket(bucket)
         marker = vmarker = ""
         while True:
-            _t0 = _time.monotonic()
+            _t0 = time.monotonic()
             try:
                 page = self.obj.list_object_versions(
                     bucket, "", marker, vmarker, "", PAGE)
@@ -281,7 +280,7 @@ class DataScanner:
                                              scan_deep=bitrot_scan)
                     except Exception:  # noqa: BLE001
                         pass
-            self._pace(_time.monotonic() - _t0)
+            self._pace(time.monotonic() - _t0)
             if not page.is_truncated:
                 return
             marker = page.next_marker

@@ -67,3 +67,20 @@ class DynamicTimeout:
                     self.minimum, (self._timeout + envelope) / 2)
         self._durations.clear()
         self._failures = 0
+
+
+def parse_duration(raw: str, default: float = 0.0) -> float:
+    """Parse a Go-style duration ("250ms", "1.5s", "2m", bare seconds).
+    Returns `default` on empty/invalid input — callers that must not
+    silently degrade validate at config-set time instead."""
+    s = (raw or "").strip().lower()
+    if not s:
+        return default
+    try:
+        for suffix, mult in (("ms", 1e-3), ("s", 1.0), ("m", 60.0),
+                             ("h", 3600.0)):
+            if s.endswith(suffix):
+                return float(s[: -len(suffix)]) * mult
+        return float(s)
+    except ValueError:
+        return default

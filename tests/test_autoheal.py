@@ -168,3 +168,31 @@ def test_live_drive_replacement_heals_end_to_end(tmp_path):
     for name, data in payloads.items():
         _, stream = s.sets[0].get_object("live", name)
         assert b"".join(stream) == data
+
+
+def test_heal_pacing_config(tmp_path):
+    """heal.max_sleep/max_io pace the background heal sweep (reference
+    cmd/config/heal): with pacing on, a sweep over N objects sleeps
+    ~N/max_io times."""
+    import io
+    import time as _t
+
+    from minio_tpu.admin.configkv import ConfigSys
+    from minio_tpu.erasure.sets import ErasureSets
+
+    s = ErasureSets([LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)],
+                    parity=1)
+    s.make_bucket("pace")
+    for i in range(6):
+        s.sets[0].put_object("pace", f"o{i}", io.BytesIO(b"x" * 1000), 1000)
+    cfg = ConfigSys()
+    cfg.set_kv("heal", {"max_sleep": "0.1s", "max_io": "2"})
+    healer = AutoHealer(s, config=cfg)
+    # Mark a drive healing so run_once walks the namespace.
+    victim = s.drives[0]
+    mark_drive_healing(victim, s.format.sets[0][0])
+    t0 = _t.time()
+    healer.run_once()
+    dt = _t.time() - t0
+    assert dt >= 0.3  # 6 objects / max_io 2 = 3 sleeps of 0.1s
+    assert HealingTracker.load(victim) is None  # sweep completed
