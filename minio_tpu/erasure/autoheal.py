@@ -102,18 +102,22 @@ class AutoHealer:
     walks their set's namespace through heal_object, checkpointing and
     resuming via the tracker (reference monitorLocalDisksAndHeal)."""
 
-    def __init__(self, sets, interval: float = 10.0, config=None):
+    def __init__(self, sets, interval: float = 10.0, config=None,
+                 load_fn=None):
         # `sets` is anything exposing .sets -> list[ErasureObjects]
         # (ErasureSets / pools) or a single ErasureObjects. When it is a
         # full ErasureSets (carries the format layout), the monitor also
         # runs live drive-replacement detection (heal_format) each pass.
-        # `config` provides heal.max_sleep / heal.max_io pacing
-        # (reference cmd/config/heal: the background heal must yield to
-        # foreground traffic).
+        # `config` provides heal.max_sleep / heal.max_io; `load_fn`
+        # returns the CURRENT foreground request count. Pacing follows the
+        # reference's waitForLowHTTPReq: the heal sweep sleeps (up to
+        # max_sleep per object) ONLY while foreground load exceeds
+        # max_io — an idle system heals at full speed.
         self._owner = sets if hasattr(sets, "format") else None
         self._sets = getattr(sets, "sets", None) or [sets]
         self.interval = interval
         self.config = config
+        self.load_fn = load_fn
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -184,7 +188,6 @@ class AutoHealer:
         buckets = sorted(b.name for b in es.list_buckets())
         since_save = 0
         max_sleep, max_io = self._pacing()
-        since_sleep = 0
         for bucket in buckets:
             if bucket in tracker.finished_buckets:
                 continue
@@ -211,11 +214,11 @@ class AutoHealer:
                     tracker.failed += 1
                 tracker.bucket, tracker.obj = bucket, name
                 since_save += 1
-                since_sleep += 1
-                if max_sleep > 0 and since_sleep >= max_io:
-                    # Yield to foreground traffic (heal.max_sleep per
-                    # heal.max_io healed objects — reference heal config).
-                    since_sleep = 0
+                if (max_sleep > 0 and self.load_fn is not None
+                        and self.load_fn() > max_io):
+                    # Foreground load above heal.max_io: yield up to
+                    # heal.max_sleep before the next object (reference
+                    # waitForLowHTTPReq) — idle systems never sleep.
                     if self._stop.wait(max_sleep):
                         tracker.save(drive)
                         return

@@ -112,8 +112,13 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         self.nslock = nslock
         self.n = len(drives)
         self.parity = default_parity(self.n) if parity is None else parity
-        if not 0 <= self.parity < self.n:
-            raise ValueError(f"parity {self.parity} invalid for {self.n} drives")
+        # Reference validateParity bound (parity <= drives/2): beyond it
+        # data quorum k(+1) drops below a majority and two conflicting
+        # partial writes could both claim success.
+        if not 0 <= self.parity <= self.n // 2:
+            raise ValueError(
+                f"parity {self.parity} invalid for {self.n} drives "
+                f"(bound: drives/2 = {self.n // 2})")
         self.block_size = block_size
         self.batch_blocks = batch_blocks
         # Default bitrot algorithm follows the backend: mxsum256 on

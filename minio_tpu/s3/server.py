@@ -493,15 +493,18 @@ class S3Server:
         while not hasattr(target, "drives") and hasattr(target, "inner"):
             target = target.inner
         pools = getattr(target, "pools", None)
+        load_fn = lambda: self.stats.current_requests  # noqa: E731
         if pools:
             self.auto_healer = [AutoHealer(p, interval=interval,
-                                           config=self.config)
+                                           config=self.config,
+                                           load_fn=load_fn)
                                 for p in pools]
             for h in self.auto_healer:
                 h.start()
         elif hasattr(target, "drives") or hasattr(target, "sets"):
             self.auto_healer = [AutoHealer(target, interval=interval,
-                                           config=self.config)]
+                                           config=self.config,
+                                           load_fn=load_fn)]
             self.auto_healer[0].start()
         else:
             self.auto_healer = []

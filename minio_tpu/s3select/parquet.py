@@ -35,7 +35,22 @@ class ParquetError(Exception):
 
 
 def snappy_decompress(data: bytes) -> bytes:
-    """Raw snappy block decompress (the framing-free format parquet uses)."""
+    """Raw snappy block decompress (the framing-free format parquet uses).
+    The native codec (native/mtpu_native.cc, same block format) does the
+    byte crunching when available; the pure-Python path remains the
+    no-toolchain fallback."""
+    try:
+        from minio_tpu.native.lib import snappy_available, snappy_uncompress
+
+        if snappy_available():
+            try:
+                # Page sizes are bounded by the column chunk; cap at 1 GiB
+                # against a corrupt length header.
+                return snappy_uncompress(data, max_len=1 << 30)
+            except ValueError as e:
+                raise ParquetError(f"snappy: {e}") from None
+    except ImportError:
+        pass
     pos = 0
     # uncompressed length varint
     shift = out_len = 0
@@ -176,6 +191,26 @@ class _Thrift:
 # ---------------------------------------------------------------------------
 
 
+def _unpack_bit_run(raw: bytes, bit_width: int, n_vals: int) -> list[int]:
+    """Vectorized little-endian bit-packed decode (the former big-int
+    shift loop was O(n^2): each value shifted a run-sized integer)."""
+    import numpy as np
+
+    if bit_width <= 0:
+        # A 1-entry dictionary legally uses bit-width 0: every index is 0.
+        return [0] * n_vals
+    bits = np.unpackbits(np.frombuffer(raw, dtype=np.uint8),
+                         bitorder="little")
+    need = n_vals * bit_width
+    if len(bits) < need:  # truncated run: zero-fill (the old big-int
+        bits = np.pad(bits, (0, need - len(bits)))  # behavior)
+    if bit_width == 1:
+        return bits[:n_vals].tolist()
+    vals = bits[: n_vals * bit_width].reshape(-1, bit_width).astype(np.int64)
+    weights = (1 << np.arange(bit_width, dtype=np.int64))
+    return (vals @ weights).tolist()
+
+
 def _rle_bp_hybrid(buf: bytes, pos: int, end: int, bit_width: int,
                    count: int) -> list[int]:
     out: list[int] = []
@@ -185,15 +220,10 @@ def _rle_bp_hybrid(buf: bytes, pos: int, end: int, bit_width: int,
         header = t.varint()
         if header & 1:  # bit-packed run: header>>1 groups of 8
             n_groups = header >> 1
-            n_vals = n_groups * 8
+            n_vals = min(n_groups * 8, count - len(out))
             raw = buf[t.pos:t.pos + n_groups * bit_width]
             t.pos += n_groups * bit_width
-            acc = int.from_bytes(raw, "little")
-            mask = (1 << bit_width) - 1
-            for i in range(n_vals):
-                if len(out) >= count:
-                    break
-                out.append((acc >> (i * bit_width)) & mask)
+            out.extend(_unpack_bit_run(raw, bit_width, n_vals))
         else:  # RLE run
             n = header >> 1
             v = int.from_bytes(buf[t.pos:t.pos + byte_width], "little") \
@@ -399,13 +429,20 @@ class ParquetReader:
             raise ParquetError(f"unsupported encoding {enc}")
         if defs is None:
             return [col.convert(v) for v in vals]
-        out, vi = [], 0
-        for d in defs:
-            if d:
-                out.append(col.convert(vals[vi]))
-                vi += 1
-            else:
-                out.append(None)
+        # Scatter values into the null skeleton at the defined positions
+        # (one numpy nonzero instead of a per-row branch loop).
+        import numpy as np
+
+        defined = np.nonzero(np.asarray(defs, dtype=bool))[0].tolist()
+        if len(vals) < len(defined):
+            # Truncated page: fabricating NULLs for data that exists
+            # would silently corrupt SELECT results.
+            raise ParquetError(
+                f"page has {len(vals)} values for {len(defined)} "
+                "defined rows")
+        out: list = [None] * len(defs)
+        for i, v in zip(defined, vals):
+            out[i] = col.convert(v)
         return out
 
     def iter_column_groups(self) -> Iterator[tuple[int, dict[str, list]]]:
