@@ -558,8 +558,6 @@ class MRFHealer:
 
 
 def _clone_fi(fi: FileInfo, index: int) -> FileInfo:
-    import copy
-
-    out = copy.deepcopy(fi)
+    out = fi.clone()
     out.erasure.index = index
     return out

@@ -316,9 +316,7 @@ class MultipartMixin:
             for p in parts:
                 drive.rename_file(SYS_VOL, f"{mp}/part.{p.part_number}",
                                   SYS_VOL, f"{tmp_rel}/part.{p.part_number}")
-            import copy
-
-            f = copy.deepcopy(fi)
+            f = fi.clone()
             f.erasure.index = i + 1
             drive.rename_data(SYS_VOL, tmp_rel, f, bucket, obj)
 

@@ -129,6 +129,36 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         self.mrf: MRFHealer | None = MRFHealer(self) if enable_mrf else None
         self._read_pool = None
         self._read_pool_mu = threading.Lock()
+        # Bucket-existence TTL cache: put_object stats every drive for the
+        # bucket otherwise, a pool dispatch per op. Reference keeps bucket
+        # metadata fully in memory (BucketMetadataSys); a short TTL keeps
+        # cross-node deletes visible within a bound instead of a broadcast.
+        self._bucket_cache: dict[str, tuple[float, BucketInfo]] = {}
+        self._bucket_cache_ttl = 2.0
+        # Quorum metadata reads run serially when the set is small and
+        # all-local: with the journal parse cache a per-drive read is ~10us,
+        # below the shared-pool dispatch cost. Wide sets and any remote
+        # drive keep the parallel fan-out (RPC/disk latency dominates there).
+        self._serial_meta_reads = self.n <= 8 and self._drives_all_local()
+
+    @property
+    def fast_local_reads(self) -> bool:
+        """True when a metadata read on this set is reliably cheap (~100us):
+        small all-local set with measured-fast journal stores. The HTTP
+        layer uses this to run small-object opens directly on the event
+        loop instead of paying an executor round trip."""
+        return self._serial_meta_reads and all(
+            getattr(d, "fast_sync", False) for d in self.drives)
+
+    def _drives_all_local(self) -> bool:
+        from minio_tpu.storage.idcheck import DiskIDChecker
+        from minio_tpu.storage.local import LocalDrive
+
+        for d in self.drives:
+            base = d.inner if isinstance(d, DiskIDChecker) else d
+            if type(base) is not LocalDrive:
+                return False
+        return True
 
     def _shard_read_pool(self):
         """Long-lived per-instance pool for parallel shard reads — a fresh
@@ -191,10 +221,17 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             raise
 
     def get_bucket_info(self, bucket: str) -> BucketInfo:
+        hit = self._bucket_cache.get(bucket)
+        if hit is not None and hit[0] > time.monotonic():
+            return hit[1]
         results = parallel_map([lambda d=d: d.stat_vol(bucket) for d in self.drives])
         for r in results:
             if not isinstance(r, Exception):
-                return BucketInfo(r.name, r.created)
+                info = BucketInfo(r.name, r.created)
+                self._bucket_cache[bucket] = (
+                    time.monotonic() + self._bucket_cache_ttl, info)
+                return info
+        self._bucket_cache.pop(bucket, None)
         if any(isinstance(r, se.VolumeNotFound) for r in results):
             raise se.BucketNotFound(bucket)
         raise se.BucketNotFound(bucket, "", "no drive answered")
@@ -211,6 +248,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         return sorted(seen.values(), key=lambda b: b.name)
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        self._bucket_cache.pop(bucket, None)
         results = parallel_map(
             [lambda d=d: d.delete_vol(bucket, force=force) for d in self.drives]
         )
@@ -309,13 +347,29 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             fi.data_dir = ""
             fi.metadata.setdefault("etag", md5.hexdigest())
             fi.parts = [PartInfo(1, fi.size, fi.size, fi.mod_time)]
+            # Inline versions carry no shard files, so the per-drive shard
+            # index is meaningless — writing index 0 on every drive makes
+            # all journals byte-identical, letting the set share ONE
+            # serialized journal (write_metadata_single) instead of four
+            # load+merge+serialize rounds.
+            fi.erasure.index = 0
+            journal = XLMeta()
+            journal.add_version(fi)
+            raw = journal.serialize()
+            # Serial fan-out when every drive's measured journal-store cost
+            # is below the pool-dispatch cost (all-local fast-sync media);
+            # slow-fsync drives keep the parallel write so the op pays
+            # max(fsync) rather than sum(fsync).
+            serial_writes = self.fast_local_reads
             with self.nslock.lock(bucket, obj):
                 self._check_put_precondition(bucket, obj, opts)
                 outcomes = parallel_map(
                     [
-                        lambda d=d, f=_clone_for_drive(fi, i + 1): d.write_metadata(bucket, obj, f)
-                        for i, d in enumerate(shuffled)
-                    ]
+                        lambda d=d: d.write_metadata_single(
+                            bucket, obj, fi, raw, journal)
+                        for d in shuffled
+                    ],
+                    serial=serial_writes,
                 )
                 reduce_write_quorum(outcomes, write_quorum, bucket, obj)
             return self._fi_to_object_info(bucket, obj, fi)
@@ -385,6 +439,30 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             raise se.ObjectNotFound(bucket, obj)
         return self._fi_to_object_info(bucket, obj, fi)
 
+    def get_object_reader(
+        self,
+        bucket: str,
+        obj: str,
+        opts: ObjectOptions | None = None,
+    ):
+        """ONE quorum metadata read for info + data: returns
+        (info, open_range) where open_range(offset, length) streams object
+        bytes using the already-elected FileInfo. The HTTP GET path needs
+        the info before it can choose the byte range (SSE/compression
+        transforms); the two-call shape (get_object_info + get_object) paid
+        the quorum read twice (reference folds this into a single
+        GetObjectNInfo reader, cmd/erasure-object.go:137)."""
+        opts = opts or ObjectOptions()
+        fi = self._read_quorum_fileinfo(bucket, obj, opts.version_id)
+        if fi.deleted:
+            raise se.ObjectNotFound(bucket, obj)
+        info = self._fi_to_object_info(bucket, obj, fi)
+
+        def open_range(offset: int = 0, length: int = -1) -> Iterator[bytes]:
+            return self._open_fi_range(bucket, obj, fi, offset, length)
+
+        return info, open_range
+
     def get_object(
         self,
         bucket: str,
@@ -393,18 +471,18 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         length: int = -1,
         opts: ObjectOptions | None = None,
     ) -> tuple[ObjectInfo, Iterator[bytes]]:
-        opts = opts or ObjectOptions()
-        fi = self._read_quorum_fileinfo(bucket, obj, opts.version_id)
-        if fi.deleted:
-            raise se.ObjectNotFound(bucket, obj)
-        info = self._fi_to_object_info(bucket, obj, fi)
+        info, open_range = self.get_object_reader(bucket, obj, opts)
+        return info, open_range(offset, length)
+
+    def _open_fi_range(self, bucket: str, obj: str, fi: FileInfo,
+                       offset: int, length: int) -> Iterator[bytes]:
         if length < 0:
             length = fi.size - offset
         if offset < 0 or length < 0 or offset + length > fi.size:
             raise se.InvalidRange(bucket, obj, f"[{offset}, {offset + length}) of {fi.size}")
         if fi.inline_data:
             payload = fi.inline_data[offset: offset + length]
-            return info, iter([payload])
+            return iter([payload])
         tier_name = fi.metadata.get(
             "x-mtpu-internal-transition-tier") if fi.metadata else ""
         if tier_name and not fi.data_dir:
@@ -421,13 +499,13 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 if reg is None:
                     raise tiermod.TierError("no tier registry configured")
                 tier = reg.get(tier_name)
-                return info, tier.get(key, offset, length)
+                return tier.get(key, offset, length)
             except tiermod.TierError as e:
                 # Typed, not a 500: the data's only copy is on a tier we
                 # can't reach (e.g. tier deleted with force).
                 raise se.ObjectNotFound(bucket, obj,
                                         f"tier {tier_name!r}: {e}") from e
-        return info, self._stream_erasure(bucket, obj, fi, offset, length)
+        return self._stream_erasure(bucket, obj, fi, offset, length)
 
     def _stream_erasure(self, bucket: str, obj: str, fi: FileInfo,
                         offset: int, length: int) -> Iterator[bytes]:
@@ -1290,7 +1368,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
 
     def _read_quorum_fileinfo(self, bucket: str, obj: str, version_id: str) -> FileInfo:
         results = parallel_map(
-            [lambda d=d: d.read_version(bucket, obj, version_id) for d in self.drives]
+            [lambda d=d: d.read_version(bucket, obj, version_id) for d in self.drives],
+            serial=self._serial_meta_reads,
         )
         if all(isinstance(r, se.FileNotFound) for r in results):
             raise se.ObjectNotFound(bucket, obj)
@@ -1340,9 +1419,7 @@ def _local_shard_paths(drives: list[StorageAPI], vol: str,
 
 
 def _clone_for_drive(fi: FileInfo, index: int) -> FileInfo:
-    import copy
-
-    out = copy.deepcopy(fi)
+    out = fi.clone()
     out.erasure.index = index
     return out
 

@@ -132,6 +132,20 @@ class ErasureServerPools:
         return self._owning_pool(bucket, obj, opts.version_id).get_object(
             bucket, obj, offset, length, opts)
 
+    def get_object_reader(self, bucket: str, obj: str,
+                          opts: ObjectOptions | None = None):
+        opts = opts or ObjectOptions()
+        # Bucket existence first (cached at the set level): a GET for a
+        # bucket that lives on another federated cluster must surface
+        # BucketNotFound (the redirect trigger), not NoSuchKey.
+        self.get_bucket_info(bucket)
+        return self._owning_pool(bucket, obj, opts.version_id).get_object_reader(
+            bucket, obj, opts)
+
+    @property
+    def fast_local_reads(self) -> bool:
+        return all(getattr(p, "fast_local_reads", False) for p in self.pools)
+
     def get_object_info(self, bucket: str, obj: str,
                         opts: ObjectOptions | None = None) -> ObjectInfo:
         opts = opts or ObjectOptions()

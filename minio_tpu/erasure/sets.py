@@ -107,6 +107,14 @@ class ErasureSets:
                    length: int = -1, opts: ObjectOptions | None = None):
         return self.get_hashed_set(obj).get_object(bucket, obj, offset, length, opts)
 
+    def get_object_reader(self, bucket: str, obj: str,
+                          opts: ObjectOptions | None = None):
+        return self.get_hashed_set(obj).get_object_reader(bucket, obj, opts)
+
+    @property
+    def fast_local_reads(self) -> bool:
+        return all(getattr(s, "fast_local_reads", False) for s in self.sets)
+
     def get_object_info(self, bucket: str, obj: str,
                         opts: ObjectOptions | None = None) -> ObjectInfo:
         return self.get_hashed_set(obj).get_object_info(bucket, obj, opts)

@@ -71,6 +71,19 @@ class ObjectLayer(abc.ABC):
     def get_object_info(self, bucket: str, obj: str,
                         opts: ObjectOptions | None = None) -> ObjectInfo: ...
 
+    def get_object_reader(self, bucket: str, obj: str,
+                          opts: ObjectOptions | None = None):
+        """(info, open_range) where open_range(offset, length) -> iterator.
+        Default costs two metadata lookups; erasure backends override with
+        a single quorum read (reference GetObjectNInfo shape)."""
+        info = self.get_object_info(bucket, obj, opts)
+
+        def open_range(offset: int = 0, length: int = -1):
+            _, stream = self.get_object(bucket, obj, offset, length, opts)
+            return stream
+
+        return info, open_range
+
     @abc.abstractmethod
     def delete_object(self, bucket: str, obj: str,
                       opts: ObjectOptions | None = None) -> ObjectInfo: ...

@@ -68,6 +68,9 @@ class _MemStore:
 
 XML_TYPE = "application/xml"
 MAX_OBJECT_SIZE = 5 * (1 << 40)
+# Inline-object streams are plain list iterators (zero IO behind next()) —
+# the GET fast path detects them by type to drain on the event loop.
+_LIST_ITER = type(iter([]))
 SPOOL_LIMIT = 32 << 20
 
 
@@ -1755,22 +1758,43 @@ class S3Server:
         actual = int(meta.get(sse.META_ACTUAL_SIZE, "0"))
         return object_key, nonce, actual
 
-    async def _open_object_stream(self, request, bucket, key, opts,
-                                  offset, length, run, copy_source=False,
-                                  pre=None):
-        """get_object with transparent SSE decryption. Returns
-        (info, iterator, plaintext_size) where info.size is the client-
-        visible size. Pass `pre` when the caller already paid the quorum
-        metadata read (range parsing)."""
+    def _get_reader(self, bucket, key, opts):
+        """(info, open_range) from the layer — via its single-quorum-read
+        get_object_reader when it has one, else the two-call fallback
+        (gateways and other duck-typed layers)."""
+        gr = getattr(self.obj, "get_object_reader", None)
+        if gr is not None:
+            return gr(bucket, key, opts)
+        info = self.obj.get_object_info(bucket, key, opts)
+
+        def open_range(offset=0, length=-1):
+            return self.obj.get_object(bucket, key, offset, length, opts)[1]
+
+        return info, open_range
+
+    def _open_stream_sync(self, request, bucket, key, opts, offset, length,
+                          copy_source=False, pre=None, open_range=None):
+        """Blocking core of the object read path: get_object_reader (ONE
+        quorum metadata read) + transparent SSE/compression unwrap. Runs in
+        a single executor hop — the previous shape paid a quorum read for
+        the info and a second for the data, plus an executor round trip for
+        each. Returns (info, iterator, plaintext_size)."""
         if pre is None:
-            pre = await run(self.obj.get_object_info, bucket, key, opts)
+            pre, open_range = self._get_reader(bucket, key, opts)
+
+        def open_plain(off, ln):
+            if open_range is not None:
+                return open_range(off, ln)
+            # Caller passed a pre-fetched info without a reader: fall back
+            # to the two-call path for the data bytes.
+            return self.obj.get_object(bucket, key, off, ln, opts)[1]
+
         if czip.META_COMPRESSION in pre.user_defined:
             actual = int(pre.user_defined.get(czip.META_ACTUAL_SIZE, "-1"))
             if length < 0:
                 length = (actual - offset) if actual >= 0 else -1
-            info, stream = await run(self.obj.get_object, bucket, key,
-                                     0, -1, opts)
-            return (info,
+            stream = open_plain(0, -1)
+            return (pre,
                     czip.decompress_iter(
                         stream, offset, length,
                         scheme=pre.user_defined[czip.META_COMPRESSION]),
@@ -1778,9 +1802,7 @@ class S3Server:
         if sse.META_ALGO not in pre.user_defined:
             if length < 0:
                 length = pre.size - offset
-            info, stream = await run(self.obj.get_object, bucket, key,
-                                     offset, length, opts)
-            return info, stream, pre.size
+            return pre, open_plain(offset, length), pre.size
         if sse.META_NONCE not in pre.user_defined and pre.parts:
             # Multipart SSE: no object-level nonce; parts are independent
             # [nonce | DARE] streams.
@@ -1795,13 +1817,20 @@ class S3Server:
         if length == 0:
             return pre, iter([]), actual
         enc_off, enc_len, skip = sse.decrypted_range(offset, length, actual)
-        info, enc_stream = await run(self.obj.get_object, bucket, key,
-                                     enc_off, enc_len, opts)
+        enc_stream = open_plain(enc_off, enc_len)
         dec = sse.DecryptReader(
             enc_stream, object_key, nonce,
             start_chunk=enc_off // sse.ENC_CHUNK,
             total_chunks=sse.total_chunks(actual))
-        return info, _trim_iter(dec, skip, length, enc_stream), actual
+        return pre, _trim_iter(dec, skip, length, enc_stream), actual
+
+    async def _open_object_stream(self, request, bucket, key, opts,
+                                  offset, length, run, copy_source=False,
+                                  pre=None):
+        """Async wrapper: one executor hop around _open_stream_sync. Pass
+        `pre` when the caller already paid the quorum metadata read."""
+        return await run(self._open_stream_sync, request, bucket, key,
+                         opts, offset, length, copy_source, pre)
 
     def _apply_object_lock(self, request, bucket: str, opts) -> None:
         """Stamp retention/legal-hold from request headers, falling back to
@@ -1941,6 +1970,11 @@ class S3Server:
         reader, stored_size = self._maybe_encrypt_put(
             request, bucket, key, opts, reader, size2)
         try:
+            # PUT always hops to the executor — even an inline-sized write
+            # takes the namespace WRITE lock (30s timeout under contention)
+            # and fsyncs; either on the event loop would stall every
+            # connection on the server. (GET's on-loop fast path is safe
+            # because reads are lockless and cache-backed.)
             info = await run(self.obj.put_object, bucket, key, reader,
                              stored_size, opts)
         finally:
@@ -2042,30 +2076,67 @@ class S3Server:
                                                          new_info.mod_time),
                             content_type=XML_TYPE, headers=hdr)
 
+    # Objects at or below this client-visible size are drained inside the
+    # same executor hop that opened them and returned as one body — the
+    # per-chunk executor round trips dominate small-object GET latency.
+    _GET_DRAIN_LIMIT = 256 << 10
+
     async def _get_object(self, request, bucket, key, opts, hdr, run):
         rng = request.headers.get("Range")
-        status = 200
-        if rng:
-            # Range needs the size before the read; costs one extra quorum
-            # metadata round, paid only by range requests.
-            pre = await run(self.obj.get_object_info, bucket, key, opts)
-            offset, length = _parse_range(rng, self._visible_size(pre))
-            status = 206
+
+        def open_sync(drain_all):
+            """Quorum read + range math + stream open in one call; for
+            small responses, the full drain too. `drain_all=False` (the
+            on-loop fast path) only drains zero-IO inline streams."""
+            status = 200
+            if rng:
+                # Range needs the size before the read — with the single
+                # reader the info and the data still cost ONE quorum round.
+                pre, open_range = self._get_reader(bucket, key, opts)
+                offset, length = _parse_range(rng, self._visible_size(pre))
+                status = 206
+                info, stream, visible = self._open_stream_sync(
+                    request, bucket, key, opts, offset, length,
+                    pre=pre, open_range=open_range)
+            else:
+                offset, length = 0, -1
+                info, stream, visible = self._open_stream_sync(
+                    request, bucket, key, opts, 0, -1)
+            if length < 0:
+                length = visible
+            body = None
+            if length <= self._GET_DRAIN_LIMIT \
+                    and (drain_all or type(stream) is _LIST_ITER) \
+                    and not _check_conditional(request, info):
+                body = b"".join(stream)
+            return status, offset, length, info, stream, visible, body
+
+        if getattr(self.obj, "fast_local_reads", False):
+            # All-local fast media: the open is ~100us of cached metadata
+            # work — cheaper than an executor round trip, so run it on the
+            # loop (inline streams drain here too; anything with real IO
+            # still hops below).
+            status, offset, length, info, stream, visible, body = \
+                open_sync(False)
+            if body is None and length <= self._GET_DRAIN_LIMIT \
+                    and not _check_conditional(request, info):
+                body = await run(lambda: b"".join(stream))
         else:
-            pre, offset, length = None, 0, -1
-        info, stream, visible = await self._open_object_stream(
-            request, bucket, key, opts, offset, length, run, pre=pre)
-        not_modified = _check_conditional(request, info)
-        if not_modified:
+            status, offset, length, info, stream, visible, body = \
+                await run(open_sync, True)
+        if _check_conditional(request, info):
             return web.Response(status=304, headers={
                 **hdr, "ETag": f'"{info.etag}"',
             })
-        if length < 0:
-            length = visible
         headers = {**hdr, **_object_headers(info)}
         headers["Content-Length"] = str(length)
         if status == 206:
             headers["Content-Range"] = f"bytes {offset}-{offset + length - 1}/{visible}"
+        if body is not None:
+            delay = self.bw_throttle.delay(bucket, len(body))
+            if delay > 0:
+                await asyncio.sleep(delay)
+            return web.Response(status=status, body=body, headers=headers)
         resp = web.StreamResponse(status=status, headers=headers)
         await resp.prepare(request)
         loop = asyncio.get_running_loop()

@@ -16,6 +16,8 @@ from __future__ import annotations
 import datetime
 import hashlib
 import hmac
+import re
+import time
 import urllib.parse
 from dataclasses import dataclass
 
@@ -47,11 +49,33 @@ def _hmac(key: bytes, msg: str) -> bytes:
     return hmac.new(key, msg.encode(), hashlib.sha256).digest()
 
 
+_KEY_CACHE: dict[tuple[str, str, str, str], bytes] = {}
+
+
 def signing_key(secret: str, scope_date: str, region: str, service: str) -> bytes:
-    k = _hmac(("AWS4" + secret).encode(), scope_date)
-    k = _hmac(k, region)
-    k = _hmac(k, service)
-    return _hmac(k, "aws4_request")
+    """Derived signing key (4 chained HMACs), served from a cache that only
+    ever holds VERIFIED scopes: lookups are free for all callers, but
+    entries are inserted by _remember_signing_key after a signature over
+    the derived key actually matches. An unauthenticated requester can
+    therefore recompute but never insert — fabricated region/service
+    scopes can't thrash the cache."""
+    k = _KEY_CACHE.get((secret, scope_date, region, service))
+    if k is None:
+        k = _hmac(("AWS4" + secret).encode(), scope_date)
+        k = _hmac(k, region)
+        k = _hmac(k, service)
+        k = _hmac(k, "aws4_request")
+    return k
+
+
+def _remember_signing_key(secret: str, scope_date: str, region: str,
+                          service: str, key: bytes) -> None:
+    """Cache a derived key AFTER its signature verified. Bound is one
+    entry per live (credential, day, region) combination in practice;
+    4096 is a generous ceiling for multi-tenant IAM."""
+    if len(_KEY_CACHE) >= 4096:
+        _KEY_CACHE.clear()
+    _KEY_CACHE[(secret, scope_date, region, service)] = key
 
 
 def uri_encode(s: str, encode_slash: bool = True) -> str:
@@ -123,15 +147,26 @@ def _string_to_sign(amz_date: str, scope: str, canonical: str) -> str:
     ])
 
 
+_AMZ_DATE_RE = re.compile(r"\A\d{8}T\d{6}Z\Z", re.ASCII)
+
+
 def _check_skew(amz_date: str) -> None:
+    # Manual parse of the fixed "YYYYMMDDTHHMMSSZ" layout: strptime costs
+    # ~50us per call (format-string recompile + locale machinery), which
+    # was the single biggest line of request authentication. The ASCII
+    # regex + explicit range checks keep strptime's strictness (int()
+    # alone would admit unicode digits; timegm alone would silently
+    # normalize Feb 30 or minute 99 into a nearby valid time).
+    if _AMZ_DATE_RE.match(amz_date) is None:
+        raise S3Error("AccessDenied", "invalid x-amz-date")
     try:
-        t = datetime.datetime.strptime(amz_date, "%Y%m%dT%H%M%SZ").replace(
-            tzinfo=datetime.timezone.utc
-        )
+        t = datetime.datetime(
+            int(amz_date[0:4]), int(amz_date[4:6]), int(amz_date[6:8]),
+            int(amz_date[9:11]), int(amz_date[11:13]), int(amz_date[13:15]),
+            tzinfo=datetime.timezone.utc).timestamp()
     except ValueError:
         raise S3Error("AccessDenied", "invalid x-amz-date") from None
-    now = datetime.datetime.now(datetime.timezone.utc)
-    if abs((now - t).total_seconds()) > MAX_SKEW_SECONDS:
+    if abs(time.time() - t) > MAX_SKEW_SECONDS:
         raise S3Error("RequestTimeTooSkewed")
 
 
@@ -166,6 +201,8 @@ def verify_header_auth(
     want = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
     if not hmac.compare_digest(want, auth.signature):
         raise S3Error("SignatureDoesNotMatch")
+    _remember_signing_key(creds.secret_key, auth.scope_date, auth.region,
+                          auth.service, key)
     return creds, payload_hash
 
 
@@ -212,6 +249,7 @@ def verify_presigned(
     want = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
     if not hmac.compare_digest(want, signature):
         raise S3Error("SignatureDoesNotMatch")
+    _remember_signing_key(creds.secret_key, scope_date, region, service, key)
     return creds
 
 
@@ -309,6 +347,7 @@ def verify_post_policy(form: dict, creds_lookup) -> "Credentials":
     want = hmac.new(key, policy_b64.encode(), hashlib.sha256).hexdigest()
     if not hmac.compare_digest(want, signature):
         raise S3Error("SignatureDoesNotMatch")
+    _remember_signing_key(creds.secret_key, scope_date, region, service, key)
     # Expiry check from the policy document itself.
     try:
         doc = _json.loads(_b64.b64decode(policy_b64))

@@ -141,6 +141,17 @@ class StorageAPI(abc.ABC):
         """Add fi as a version in the object's journal
         (reference WriteMetadata, cmd/xl-storage.go:897)."""
 
+    def write_metadata_single(self, volume: str, path: str, fi: FileInfo,
+                              raw: bytes, meta=None) -> None:
+        """write_metadata specialized for a PUT whose resulting journal the
+        caller ALREADY serialized (`raw` = journal holding exactly `fi`):
+        a drive whose journal is absent — or holds only the version this
+        write replaces — may store `raw` verbatim, skipping its own
+        load+merge+serialize. Identical bytes then land on every drive of
+        the set for the price of ONE serialize. Default falls back to the
+        classic merge path (remote drives ship the FileInfo over RPC)."""
+        self.write_metadata(volume, path, fi)
+
     @abc.abstractmethod
     def read_version(self, volume: str, path: str, version_id: str = "",
                      read_data: bool = False) -> FileInfo: ...

@@ -26,7 +26,8 @@ _GUARDED = {
     "make_vol", "stat_vol", "list_vols", "delete_vol",
     "list_dir", "walk_dir", "read_all", "write_all", "delete",
     "create_file", "append_file", "read_file_stream", "rename_file",
-    "write_metadata", "read_version", "read_xl", "delete_version",
+    "write_metadata", "write_metadata_single", "read_version", "read_xl",
+    "delete_version",
     "rename_data", "verify_file", "check_parts",
 }
 

@@ -1,0 +1,242 @@
+"""Scratch: lean raw-socket SigV4 client to find the HTTP stack floor."""
+import asyncio
+import hashlib
+import hmac
+import os
+import shutil
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, "/root/repo")
+
+from aiohttp import web
+
+from minio_tpu.s3.server import build_server
+
+AK, SK = "minioadmin", "minioadmin"
+
+
+class LeanS3:
+    """Keep-alive raw-socket S3 client with a precomputed signing key.
+
+    Per-op cost target: <100us (sigv4 string-to-sign is 2 sha256 of tiny
+    strings + 1 hmac; header assembly is one join)."""
+
+    def __init__(self, host, port, ak, sk, region="us-east-1"):
+        self.host, self.port, self.ak = host, port, ak
+        self.region = region
+        scope_date = time.strftime("%Y%m%d", time.gmtime())
+        key = ("AWS4" + sk).encode()
+        for part in (scope_date, region, "s3", "aws4_request"):
+            key = hmac.new(key, part.encode(), hashlib.sha256).digest()
+        self.signing_key = key
+        self.scope = f"{scope_date}/{region}/s3/aws4_request"
+        self.hosthdr = f"{host}:{port}"
+        self.sock = socket.create_connection((host, port))
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.buf = b""
+
+    def _request(self, method, path, body=b""):
+        amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        payload_hash = hashlib.sha256(body).hexdigest()
+        canonical = (
+            f"{method}\n{path}\n\n"
+            f"host:{self.hosthdr}\n"
+            f"x-amz-content-sha256:{payload_hash}\n"
+            f"x-amz-date:{amz_date}\n\n"
+            "host;x-amz-content-sha256;x-amz-date\n"
+            f"{payload_hash}"
+        )
+        sts = ("AWS4-HMAC-SHA256\n" + amz_date + "\n" + self.scope + "\n"
+               + hashlib.sha256(canonical.encode()).hexdigest())
+        sig = hmac.new(self.signing_key, sts.encode(), hashlib.sha256).hexdigest()
+        req = (
+            f"{method} {path} HTTP/1.1\r\n"
+            f"Host: {self.hosthdr}\r\n"
+            f"x-amz-date: {amz_date}\r\n"
+            f"x-amz-content-sha256: {payload_hash}\r\n"
+            f"Authorization: AWS4-HMAC-SHA256 Credential={self.ak}/{self.scope}, "
+            f"SignedHeaders=host;x-amz-content-sha256;x-amz-date, Signature={sig}\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n"
+        ).encode() + body
+        self.sock.sendall(req)
+        return self._read_response()
+
+    def _read_response(self):
+        # headers
+        while b"\r\n\r\n" not in self.buf:
+            d = self.sock.recv(65536)
+            if not d:
+                raise ConnectionError("closed")
+            self.buf += d
+        head, _, self.buf = self.buf.partition(b"\r\n\r\n")
+        status = int(head[9:12])
+        clen = 0
+        chunked = False
+        for line in head.split(b"\r\n")[1:]:
+            k, _, v = line.partition(b":")
+            lk = k.lower()
+            if lk == b"content-length":
+                clen = int(v)
+            elif lk == b"transfer-encoding" and b"chunked" in v.lower():
+                chunked = True
+        if chunked:
+            body = bytearray()
+            while True:
+                while b"\r\n" not in self.buf:
+                    self.buf += self.sock.recv(65536)
+                szline, _, self.buf = self.buf.partition(b"\r\n")
+                sz = int(szline.split(b";")[0], 16)
+                while len(self.buf) < sz + 2:
+                    self.buf += self.sock.recv(65536)
+                body += self.buf[:sz]
+                self.buf = self.buf[sz + 2:]
+                if sz == 0:
+                    break
+            return status, bytes(body)
+        while len(self.buf) < clen:
+            d = self.sock.recv(65536)
+            if not d:
+                raise ConnectionError("closed")
+            self.buf += d
+        body, self.buf = self.buf[:clen], self.buf[clen:]
+        return status, body
+
+    def put(self, path, body=b""):
+        return self._request("PUT", path, body)
+
+    def get(self, path):
+        return self._request("GET", path)
+
+    def _build(self, method, path, body=b""):
+        amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        payload_hash = hashlib.sha256(body).hexdigest()
+        canonical = (
+            f"{method}\n{path}\n\n"
+            f"host:{self.hosthdr}\n"
+            f"x-amz-content-sha256:{payload_hash}\n"
+            f"x-amz-date:{amz_date}\n\n"
+            "host;x-amz-content-sha256;x-amz-date\n"
+            f"{payload_hash}"
+        )
+        sts = ("AWS4-HMAC-SHA256\n" + amz_date + "\n" + self.scope + "\n"
+               + hashlib.sha256(canonical.encode()).hexdigest())
+        sig = hmac.new(self.signing_key, sts.encode(), hashlib.sha256).hexdigest()
+        return (
+            f"{method} {path} HTTP/1.1\r\n"
+            f"Host: {self.hosthdr}\r\n"
+            f"x-amz-date: {amz_date}\r\n"
+            f"x-amz-content-sha256: {payload_hash}\r\n"
+            f"Authorization: AWS4-HMAC-SHA256 Credential={self.ak}/{self.scope}, "
+            f"SignedHeaders=host;x-amz-content-sha256;x-amz-date, Signature={sig}\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n"
+        ).encode() + body
+
+    def pipeline(self, reqs, window=16):
+        """Issue pre-built requests with up to `window` in flight."""
+        out = []
+        sent = 0
+        for i, req in enumerate(reqs):
+            self.sock.sendall(req)
+            sent += 1
+            if sent - len(out) >= window:
+                out.append(self._read_response())
+        while len(out) < sent:
+            out.append(self._read_response())
+        return out
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def main():
+    root = "/dev/shm/lean_bench"
+    shutil.rmtree(root, ignore_errors=True)
+    os.makedirs(root)
+    srv = build_server([os.path.join(root, f"d{i}") for i in range(4)],
+                       AK, SK, versioned=False)
+    port = free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    prof = None
+    if os.environ.get("PROFILE"):
+        import cProfile
+        prof = cProfile.Profile()
+
+    def run():
+        if prof:
+            prof.enable()
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(srv.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    started.wait(30)
+    c = LeanS3("127.0.0.1", port, AK, SK)
+    st, _ = c.put("/bench")
+    assert st == 200, st
+
+    # HTTP floor: health endpoint (no auth, no object layer)
+    n = 2000
+    for _ in range(50):
+        c.get("/minio/health/live")
+    t0 = time.perf_counter()
+    for _ in range(n):
+        c.get("/minio/health/live")
+    dt = time.perf_counter() - t0
+    print(f"health floor: {n/dt:.0f} ops/s ({dt/n*1e6:.0f} us/op)")
+
+    for size, label in ((4 << 10, "4KiB"), (10 << 10, "10KiB")):
+        payload = os.urandom(size)
+        for i in range(30):
+            c.put(f"/bench/w{i}", payload)
+        n = 1000
+        t0 = time.perf_counter()
+        for i in range(n):
+            st, _ = c.put(f"/bench/o{i}", payload)
+            assert st == 200
+        dt = time.perf_counter() - t0
+        print(f"PUT {label}: {n/dt:.0f} ops/s ({dt/n*1e6:.0f} us/op)")
+        t0 = time.perf_counter()
+        for i in range(n):
+            st, b = c.get(f"/bench/o{i}")
+            assert st == 200 and len(b) == size
+        dt = time.perf_counter() - t0
+        print(f"GET {label}: {n/dt:.0f} ops/s ({dt/n*1e6:.0f} us/op)")
+        reqs = [c._build("GET", f"/bench/o{i}") for i in range(n)]
+        t0 = time.perf_counter()
+        rs = c.pipeline(reqs)
+        dt = time.perf_counter() - t0
+        assert all(st == 200 and len(b) == size for st, b in rs)
+        print(f"GET {label} pipelined: {n/dt:.0f} ops/s")
+        reqs = [c._build("PUT", f"/bench/p{i}", payload) for i in range(n)]
+        t0 = time.perf_counter()
+        rs = c.pipeline(reqs)
+        dt = time.perf_counter() - t0
+        assert all(st == 200 for st, _ in rs)
+        print(f"PUT {label} pipelined: {n/dt:.0f} ops/s")
+    if prof:
+        import pstats
+        prof.disable()
+        pstats.Stats(prof).sort_stats("tottime").print_stats(40)
+    shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
