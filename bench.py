@@ -396,6 +396,107 @@ def bench_host_pipeline() -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_small_objects() -> dict:
+    """Small-object HTTP ops/s (cmd/object-api-putobject_test.go:452-558
+    role, lifted to the full HTTP stack): 4 KiB and 10 KiB PUT/GET over a
+    live SigV4-authenticated server on 4 tmpfs drives, serial (lockstep
+    request/response) and concurrent (HTTP/1.1 pipelined, 16 in flight).
+    Client = LeanS3 raw-socket signer (~70us/op) so the measurement is the
+    server, not a client library. Client and server share this host's
+    core(s); on a 1-core box the numbers are a true single-core
+    (client+server) budget — see PERF.md for the per-op breakdown."""
+    import asyncio
+    import shutil
+    import threading
+
+    from aiohttp import web
+
+    from minio_tpu.s3.leanclient import LeanS3
+    from minio_tpu.s3.server import build_server
+
+    ak, sk = "benchak00", "benchsk00secret0"
+    root = _bench_root()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    port_holder: list[int] = []
+    try:
+        srv = build_server([os.path.join(root, f"d{i}") for i in range(4)],
+                           ak, sk, versioned=False)
+
+        def run_srv():
+            asyncio.set_event_loop(loop)
+
+            async def start():
+                import socket as _socket
+
+                runner = web.AppRunner(srv.app)
+                await runner.setup()
+                s = _socket.socket()
+                s.bind(("127.0.0.1", 0))
+                port_holder.append(s.getsockname()[1])
+                s.close()
+                site = web.TCPSite(runner, "127.0.0.1", port_holder[0])
+                await site.start()
+                started.set()
+
+            loop.run_until_complete(start())
+            loop.run_forever()
+
+        threading.Thread(target=run_srv, daemon=True).start()
+        if not started.wait(30):
+            return {"metric": "putobject_small_e2e",
+                    "error": "server failed to start"}
+        c = LeanS3("127.0.0.1", port_holder[0], ak, sk)
+        st, body = c.put("/bench")
+        assert st == 200, body
+        out: dict = {"metric": "putobject_small_e2e", "unit": "ops/s",
+                     "vs_baseline": 0.0, "cores": os.cpu_count()}
+        n = 600
+        for size, label in ((4 << 10, "4KiB"), (10 << 10, "10KiB")):
+            payload = os.urandom(size)
+            for i in range(40):  # warm: compile paths, prime caches
+                c.put(f"/bench/w{i}", payload)
+                c.get(f"/bench/w{i % 20}")
+            best = {}
+            for _rep in range(2):  # best-of-2: host timing jitter
+                t0 = time.perf_counter()
+                for i in range(n):
+                    st, _ = c.put(f"/bench/o{i}", payload)
+                    assert st == 200
+                best[f"put_{label}"] = max(
+                    best.get(f"put_{label}", 0),
+                    round(n / (time.perf_counter() - t0), 1))
+                t0 = time.perf_counter()
+                for i in range(n):
+                    st, b = c.get(f"/bench/o{i}")
+                    assert st == 200 and len(b) == size
+                best[f"get_{label}"] = max(
+                    best.get(f"get_{label}", 0),
+                    round(n / (time.perf_counter() - t0), 1))
+                reqs = [c.build("PUT", f"/bench/p{i}", payload)
+                        for i in range(n)]
+                t0 = time.perf_counter()
+                rs = c.pipeline(reqs)
+                best[f"put_{label}_concurrent"] = max(
+                    best.get(f"put_{label}_concurrent", 0),
+                    round(n / (time.perf_counter() - t0), 1))
+                assert all(s == 200 for s, _ in rs)
+                reqs = [c.build("GET", f"/bench/o{i}") for i in range(n)]
+                t0 = time.perf_counter()
+                rs = c.pipeline(reqs)
+                best[f"get_{label}_concurrent"] = max(
+                    best.get(f"get_{label}_concurrent", 0),
+                    round(n / (time.perf_counter() - t0), 1))
+                assert all(s == 200 and len(b) == size for s, b in rs)
+            out.update(best)
+        out["value"] = out["put_10KiB"]
+        c.close()
+        return out
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_xlmeta_codec() -> dict:
     """xl.meta journal codec throughput (BASELINE msgp-codec row,
     cmd/*_gen_test.go role): serialize+parse a 32-version journal."""
@@ -520,6 +621,7 @@ def main() -> int:
             ("heal", lambda: bench_heal(jax, jnp)),
             ("e2e", bench_e2e_multipart),
             ("host_pipeline", bench_host_pipeline),
+            ("small_objects", bench_small_objects),
             ("select", bench_select_csv),
             ("xlmeta", bench_xlmeta_codec),
         ]

@@ -1,0 +1,153 @@
+"""LeanS3 — a minimal raw-socket SigV4 S3 client.
+
+Purpose-built for benchmarking and in-tree conformance drives: requests/
+urllib3 cost ~1ms per call (session machinery, header canonicalization,
+response object construction), which would dominate any small-object ops/s
+measurement of the server. This client keeps one persistent connection,
+precomputes the SigV4 signing key, and parses responses with plain bytes
+ops — per-op overhead is ~60-80us.
+
+Independent client-side implementation of the wire protocol (the reference
+signs requests in cmd/test-utils_test.go for the same reason): server
+verification is cross-checked against a second signer, not mirrored.
+
+Supports serial request/response and HTTP/1.1 pipelining (`pipeline`),
+which is how the concurrent axis of the small-object benchmark is driven
+without spawning client threads that would steal the server's CPU.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import socket
+import time
+
+
+class LeanS3:
+    def __init__(self, host: str, port: int, access_key: str,
+                 secret_key: str, region: str = "us-east-1"):
+        self.host, self.port, self.ak = host, port, access_key
+        self.region = region
+        scope_date = time.strftime("%Y%m%d", time.gmtime())
+        key = ("AWS4" + secret_key).encode()
+        for part in (scope_date, region, "s3", "aws4_request"):
+            key = hmac.new(key, part.encode(), hashlib.sha256).digest()
+        self.signing_key = key
+        self.scope = f"{scope_date}/{region}/s3/aws4_request"
+        self.hosthdr = f"{host}:{port}"
+        self.sock = socket.create_connection((host, port))
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.buf = b""
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    # ---------- request building ----------
+
+    def build(self, method: str, path: str, body: bytes = b"") -> bytes:
+        """A fully signed HTTP/1.1 request as bytes (for pipelining)."""
+        amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        payload_hash = hashlib.sha256(body).hexdigest()
+        canonical = (
+            f"{method}\n{path}\n\n"
+            f"host:{self.hosthdr}\n"
+            f"x-amz-content-sha256:{payload_hash}\n"
+            f"x-amz-date:{amz_date}\n\n"
+            "host;x-amz-content-sha256;x-amz-date\n"
+            f"{payload_hash}"
+        )
+        sts = ("AWS4-HMAC-SHA256\n" + amz_date + "\n" + self.scope + "\n"
+               + hashlib.sha256(canonical.encode()).hexdigest())
+        sig = hmac.new(self.signing_key, sts.encode(),
+                       hashlib.sha256).hexdigest()
+        return (
+            f"{method} {path} HTTP/1.1\r\n"
+            f"Host: {self.hosthdr}\r\n"
+            f"x-amz-date: {amz_date}\r\n"
+            f"x-amz-content-sha256: {payload_hash}\r\n"
+            f"Authorization: AWS4-HMAC-SHA256 Credential={self.ak}/"
+            f"{self.scope}, SignedHeaders=host;x-amz-content-sha256;"
+            f"x-amz-date, Signature={sig}\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n"
+        ).encode() + body
+
+    # ---------- wire ----------
+
+    def _read_response(self, read_body: bool = True) -> tuple[int, bytes]:
+        while b"\r\n\r\n" not in self.buf:
+            d = self.sock.recv(65536)
+            if not d:
+                raise ConnectionError("server closed connection")
+            self.buf += d
+        head, _, self.buf = self.buf.partition(b"\r\n\r\n")
+        status = int(head[9:12])
+        clen = 0
+        chunked = False
+        for line in head.split(b"\r\n")[1:]:
+            k, _, v = line.partition(b":")
+            lk = k.lower()
+            if lk == b"content-length":
+                clen = int(v)
+            elif lk == b"transfer-encoding" and b"chunked" in v.lower():
+                chunked = True
+        if not read_body:
+            # HEAD: Content-Length describes the entity that WOULD be sent;
+            # no body follows.
+            return status, b""
+        if chunked:
+            body = bytearray()
+            while True:
+                while b"\r\n" not in self.buf:
+                    self.buf += self.sock.recv(65536)
+                szline, _, self.buf = self.buf.partition(b"\r\n")
+                sz = int(szline.split(b";")[0], 16)
+                while len(self.buf) < sz + 2:
+                    self.buf += self.sock.recv(65536)
+                body += self.buf[:sz]
+                self.buf = self.buf[sz + 2:]
+                if sz == 0:
+                    break
+            return status, bytes(body)
+        while len(self.buf) < clen:
+            d = self.sock.recv(65536)
+            if not d:
+                raise ConnectionError("server closed connection")
+            self.buf += d
+        body, self.buf = self.buf[:clen], self.buf[clen:]
+        return status, body
+
+    def request(self, method: str, path: str,
+                body: bytes = b"") -> tuple[int, bytes]:
+        self.sock.sendall(self.build(method, path, body))
+        return self._read_response(read_body=method != "HEAD")
+
+    def put(self, path: str, body: bytes = b"") -> tuple[int, bytes]:
+        return self.request("PUT", path, body)
+
+    def get(self, path: str) -> tuple[int, bytes]:
+        return self.request("GET", path)
+
+    def head(self, path: str) -> tuple[int, bytes]:
+        return self.request("HEAD", path)
+
+    def delete(self, path: str) -> tuple[int, bytes]:
+        return self.request("DELETE", path)
+
+    def pipeline(self, reqs: list[bytes],
+                 window: int = 16) -> list[tuple[int, bytes]]:
+        """Issue pre-built requests keeping up to `window` in flight —
+        the concurrent-clients axis without client-side threads."""
+        out: list[tuple[int, bytes]] = []
+        sent = 0
+        for req in reqs:
+            self.sock.sendall(req)
+            sent += 1
+            if sent - len(out) >= window:
+                out.append(self._read_response())
+        while len(out) < sent:
+            out.append(self._read_response())
+        return out
