@@ -63,10 +63,20 @@ INLINE_DATA_LIMIT = 16 << 10
 
 def _read_full(data: BinaryIO, n: int) -> bytes:
     """Read exactly n bytes unless EOF — short read()s are legal for
-    sockets/pipes and must not skew the fixed-block erasure layout."""
+    sockets/pipes and must not skew the fixed-block erasure layout.
+
+    Fast path: most sources (BytesIO, spool files) satisfy the whole read
+    in one call — return that buffer directly instead of paying two extra
+    whole-segment copies (bytearray append + bytes()), which showed up as
+    ~25% of large-PUT wall time."""
     if n <= 0:
         return b""
-    buf = bytearray()
+    first = data.read(n)
+    if not first:
+        return b""
+    if len(first) == n:
+        return first
+    buf = bytearray(first)
     while len(buf) < n:
         chunk = data.read(n - len(buf))
         if not chunk:
@@ -1196,7 +1206,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 enc.fail_drive(i)
         seg = plane.seg_blocks(codec.block_size) * codec.block_size
         total = 0
-        buf = bytearray(initial)
+        buf: bytes | bytearray = bytearray(initial) if initial else b""
         # One-segment pipeline: the GIL-released C call for segment N runs
         # in a worker thread while this thread reads segment N+1 from the
         # client — the native lane's form of the P2 read/encode overlap
@@ -1209,26 +1219,31 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 if size >= 0:
                     want = min(want, size - total - len(buf))
                 got = _read_full(data, want) if want > 0 else b""
-                buf += got
+                # Only the first segment carries a caller-consumed prefix;
+                # every later segment hands the read buffer to the C call
+                # as-is (ctypes borrows bytes zero-copy) — the
+                # unconditional append here was a whole-segment memcpy per
+                # segment.
+                chunk = bytes(buf) + got if buf else got
                 final = (len(got) < want
-                         or (size >= 0 and total + len(buf) >= size)
-                         or (size < 0 and len(buf) < seg))
+                         or (size >= 0 and total + len(chunk) >= size)
+                         or (size < 0 and len(chunk) < seg))
                 try:
                     if fut is not None:
                         fut.result()  # segment N-1 fully written
-                    fut = ex.submit(enc.feed, buf, final)
+                    fut = ex.submit(enc.feed, chunk, final)
                     if final:
                         fut.result()
                 except OSError as e:
                     raise se.FaultyDisk(f"native encode: {e}") from e
-                total += len(buf)
+                total += len(chunk)
                 alive = sum(1 for lost in enc.errors if not lost)
                 if alive < write_quorum:
                     raise se.InsufficientWriteQuorum(
                         bucket, obj, "write fan-out lost quorum")
                 if final:
                     break
-                buf = bytearray()
+                buf = b""
         errs: list[Exception | None] = [
             se.FaultyDisk(f"native shard write failed: {paths[i]}")
             if lost else None
