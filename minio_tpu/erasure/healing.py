@@ -22,6 +22,7 @@ worker re-heals them.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import uuid
@@ -345,6 +346,14 @@ class HealingMixin:
         )
 
         chosen = avail[:k]
+        native = self._native_rebuild(bucket, obj, latest, shuffled_drives,
+                                      targets, algo, codec, sys_vol,
+                                      tmp_dirs)
+        if native is not None:
+            for pos, err in native.items():
+                pool.errs[pos] = err
+            return self._commit_healed(bucket, obj, latest, shuffled_drives,
+                                       targets, sys_vol, tmp_dirs, pool)
         try:
             for part in latest.parts:
                 shard_data_size = latest.erasure.shard_file_size(part.size)
@@ -411,6 +420,11 @@ class HealingMixin:
                     pass
             raise
 
+        return self._commit_healed(bucket, obj, latest, shuffled_drives,
+                                   targets, sys_vol, tmp_dirs, pool)
+
+    def _commit_healed(self, bucket, obj, latest, shuffled_drives, targets,
+                       sys_vol, tmp_dirs, pool) -> list[int]:
         healed = []
         for pos in targets:
             if pool.errs[pos] is not None:
@@ -425,6 +439,99 @@ class HealingMixin:
                 except se.StorageError:
                     pass
         return healed
+
+    def _native_rebuild(self, bucket, obj, latest, shuffled_drives, targets,
+                        algo, codec, sys_vol, tmp_dirs
+                        ) -> dict[int, Exception | None] | None:
+        """Native heal lane: the GET-path C decoder reads + bitrot-verifies
+        + reconstructs each part windowed, and the PUT-path C encoder —
+        with every HEALTHY drive pre-failed — re-frames and writes ONLY the
+        target positions' shard files into the heal tmp dirs. Same commit
+        (rename_data) as the Python lane. Returns per-target errors, or
+        None to fall through when the topology/algorithm doesn't qualify
+        (remote drives, device-fused digests, odd block size)."""
+        from minio_tpu.erasure.objects import _local_shard_paths
+        from minio_tpu.native import plane
+
+        if (algo not in ("sip256", "highwayhash256")
+                or not plane.available() or codec.block_size % 64):
+            return None
+        k, m = codec.k, codec.m
+        n = k + m
+        errs: dict[int, Exception | None] = {pos: None for pos in targets}
+        win_blocks = plane.window_blocks(codec.block_size)
+        win = win_blocks * codec.block_size
+        from minio_tpu.storage.idcheck import DiskIDChecker
+
+        for part in latest.parts:
+            rel = f"{obj}/{latest.data_dir}/part.{part.number}"
+            src_paths = _local_shard_paths(shuffled_drives, bucket, rel)
+            if src_paths is None:
+                return None
+            dst_paths = []
+            for pos in range(n):
+                d = shuffled_drives[pos]
+                base = d.inner if isinstance(d, DiskIDChecker) else d
+                # Non-target positions are pre-failed below; the C writer
+                # skips a failed drive before ever opening its path, so
+                # the placeholder is never touched.
+                dst_paths.append(base._file_path(
+                    sys_vol, f"{tmp_dirs[pos]}/part.{part.number}")
+                    if pos in errs else "/dev/null")
+            try:
+                enc = plane.PartEncoder(dst_paths, k, m, codec.block_size,
+                                        algorithm=algo)
+                for pos in range(n):
+                    # Pre-fail non-targets AND targets already lost on an
+                    # earlier part — no point re-framing onto a dead tmp.
+                    if pos not in errs or errs[pos] is not None:
+                        enc.fail_drive(pos)
+                    else:
+                        os.makedirs(os.path.dirname(dst_paths[pos]),
+                                    exist_ok=True)
+                if part.size == 0:
+                    enc.feed(b"", final=True)
+                # 1-deep pipeline: decode window N+1 while the encoder
+                # writes window N (same overlap shape as the PUT lane).
+                # Dead shards found by one window (<0 states) feed the
+                # next window's skip set so they aren't re-read/re-hashed.
+                from concurrent.futures import ThreadPoolExecutor
+
+                dead: set[int] = set()
+                with ThreadPoolExecutor(
+                        1, thread_name_prefix="native-heal") as ex:
+                    fut = None
+                    off = 0
+                    while off < part.size:
+                        ln = min(win, part.size - off)
+                        out, states = plane.decode_range(
+                            src_paths, k, m, codec.block_size, part.size,
+                            off, ln, algorithm=algo, skip=dead)
+                        if out is None:
+                            # Fewer than k shards served this window:
+                            # the Python lane has finer-grained survivor
+                            # fallback. Settle the in-flight write first.
+                            if fut is not None:
+                                fut.result()
+                            return None
+                        dead.update(
+                            i for i, s in enumerate(states) if s < 0)
+                        if fut is not None:
+                            fut.result()
+                        fut = ex.submit(enc.feed, out,
+                                        off + ln >= part.size)
+                        off += ln
+                    if fut is not None:
+                        fut.result()
+            except OSError:
+                # Decode window failed (IO error mid-stream): let the
+                # Python lane decide.
+                return None
+            for pos in errs:
+                if enc.errors[pos]:
+                    errs[pos] = se.FaultyDisk(
+                        f"native heal write failed: {dst_paths[pos]}")
+        return errs
 
     # -- metadata-only heals (delete markers, inline objects) --
 

@@ -1206,7 +1206,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 enc.fail_drive(i)
         seg = plane.seg_blocks(codec.block_size) * codec.block_size
         total = 0
-        buf: bytes | bytearray = bytearray(initial) if initial else b""
+        buf = initial
         # One-segment pipeline: the GIL-released C call for segment N runs
         # in a worker thread while this thread reads segment N+1 from the
         # client — the native lane's form of the P2 read/encode overlap
@@ -1224,7 +1224,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 # as-is (ctypes borrows bytes zero-copy) — the
                 # unconditional append here was a whole-segment memcpy per
                 # segment.
-                chunk = bytes(buf) + got if buf else got
+                chunk = buf + got if buf else got
                 final = (len(got) < want
                          or (size >= 0 and total + len(chunk) >= size)
                          or (size < 0 and len(chunk) < seg))

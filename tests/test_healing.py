@@ -200,3 +200,38 @@ def test_mrf_background_heal(tmp_path):
         assert all(s.state == DRIVE_STATE_OK for s in res.before)
     finally:
         er.close()
+
+
+def test_heal_native_lane_highwayhash(tmp_path):
+    """The native heal lane must decode with the object's own bitrot
+    algorithm (hh256), not the default sip key — a key mismatch would fail
+    every shard's verification and crash the lane."""
+    drives = [LocalDrive(str(tmp_path / f"h{i}")) for i in range(8)]
+    e = ErasureObjects(drives, parity=4, bitrot_algorithm="highwayhash256")
+    e.make_bucket("bkt")
+    try:
+        put(e, "obj", DATA)
+        for d in e.drives[:2]:
+            wipe_object_on(d, "bkt", "obj")
+        res = e.heal_object("bkt", "obj")
+        assert res.healed_count == 2
+        for d in e.drives[2:6]:
+            wipe_object_on(d, "bkt", "obj")
+        assert get_all(e, "obj") == DATA
+    finally:
+        e.close()
+
+
+def test_heal_with_corrupt_survivor(er):
+    """A survivor that turns out bitrot-corrupt mid-heal: the lane must
+    still rebuild the missing shards from the remaining healthy ones."""
+    put(er, "obj", DATA)
+    wipe_object_on(er.drives[0], "bkt", "obj")
+    corrupt_shard_on(er.drives[4], "bkt", "obj")
+    res = er.heal_object("bkt", "obj")  # shallow: corruption found mid-read
+    # The missing shard is rebuilt; the corrupt drive heals too (deep scan
+    # would classify it, shallow heal repairs on the read path evidence).
+    assert get_all(er, "obj") == DATA
+    res2 = er.heal_object("bkt", "obj", scan_deep=True)
+    assert all(s.state == DRIVE_STATE_OK for s in res2.after)
+    assert get_all(er, "obj") == DATA
