@@ -396,6 +396,45 @@ def bench_host_pipeline() -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_listing() -> dict:
+    """Streamed listing rate (cmd/metacache-set.go:534 role): walk a 50k-
+    object synthetic bucket through stream_journals (objects/s), plus one
+    mid-bucket 1000-key page via the marker-pushdown walk (pages/s). The
+    RSS-bounded 200k-object proof lives in tests/test_listing_scale.py;
+    this records the rate on the bench host."""
+    import shutil
+
+    from minio_tpu.erasure import ErasureObjects
+    from minio_tpu.storage import LocalDrive
+    from minio_tpu.utils.synthbucket import make_synthetic_bucket
+
+    n_objects = 50_000
+    root = _bench_root()
+    try:
+        drives = [LocalDrive(os.path.join(root, f"d{i}")) for i in range(2)]
+        es = ErasureObjects(drives, parity=1)
+        es.make_bucket("big")
+        make_synthetic_bucket(drives, "big", n_objects)
+        t0 = time.perf_counter()
+        seen = sum(1 for _ in es.stream_journals("big", ""))
+        rate = seen / (time.perf_counter() - t0)
+        assert seen == n_objects
+        t0 = time.perf_counter()
+        pages = 0
+        for start in ("p010/", "p025/", "p040/"):
+            res = es.list_objects("big", marker=start + "o0",
+                                  max_keys=1000)
+            assert len(res.objects) == 1000
+            pages += 1
+        page_s = pages / (time.perf_counter() - t0)
+        es.close()
+        return {"metric": "listing_stream_50k", "value": round(rate, 0),
+                "unit": "objects/s", "vs_baseline": 0.0,
+                "midbucket_pages_per_s": round(page_s, 1)}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_degraded() -> dict:
     """Degraded-path serving numbers through the PRODUCT stack, not the
     kernel (cmd/erasure-decode_test.go:344-393 role, lifted to the object
@@ -691,6 +730,7 @@ def main() -> int:
             ("host_pipeline", bench_host_pipeline),
             ("small_objects", bench_small_objects),
             ("degraded", bench_degraded),
+            ("listing", bench_listing),
             ("select", bench_select_csv),
             ("xlmeta", bench_xlmeta_codec),
         ]

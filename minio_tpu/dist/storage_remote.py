@@ -176,7 +176,8 @@ def storage_routes(drives: dict[str, LocalDrive]) -> dict:
 
     def h_walk_dir(p, body):
         def gen() -> Iterator[bytes]:
-            for e in drive(p).walk_dir(p["vol"], p.get("prefix", "")):
+            for e in drive(p).walk_dir(p["vol"], p.get("prefix", ""),
+                                       p.get("start_after", "")):
                 yield pack({"n": e.name, "m": e.meta})
         return gen()
 
@@ -411,8 +412,11 @@ class RemoteDrive(StorageAPI):
         self._call("check_parts", body=pack(fi_to_wire(fi)),
                    vol=volume, path=path)
 
-    def walk_dir(self, volume: str, prefix: str = "") -> Iterator[WalkEntry]:
+    def walk_dir(self, volume: str, prefix: str = "",
+                 start_after: str = "") -> Iterator[WalkEntry]:
+        params = self._params(vol=volume, prefix=prefix)
+        if start_after:
+            params["start_after"] = start_after
         for doc in self._client.iter_msgpack(
-                self._path("walk_dir"),
-                self._params(vol=volume, prefix=prefix)):
+                self._path("walk_dir"), params):
             yield WalkEntry(name=doc["n"], meta=doc["m"])

@@ -100,6 +100,65 @@ def merge_journal_streams(streams: list) -> "Iterator[tuple[str, XLMeta]]":
         yield cur_name, cur_meta
 
 
+def grouped_journal_stream(make_stream, prefix: str, start_after: str,
+                           delimiter: str):
+    """Delimiter-aware journal stream: yields at most ONE member per
+    CommonPrefix group. The restart (start_after = group +
+    MARKER_GROUP_PAD, pruning the group's whole subtree) fires only when a
+    SECOND member of the same group surfaces — single-member groups cost
+    nothing extra, so a bucket of 50k one-object "directories" still
+    streams in one pass, while a 100k-object group is skipped after two
+    reads (reference forward-past behavior, cmd/metacache-entries.go
+    filterPrefixes role). Paginate rolls the one yielded member into the
+    prefix row exactly as it would the first of thousands. Non-grouped
+    names stream through unchanged. `make_stream(start_after)` builds a
+    fresh sorted (name, journal) stream."""
+    from minio_tpu.storage.api import MARKER_GROUP_PAD
+
+    plen = len(prefix)
+    cur_group = None
+    while True:
+        stream = make_stream(start_after)
+        restart = None
+        try:
+            for name, meta in stream:
+                i = name.find(delimiter, plen)
+                group = name[: i + len(delimiter)] if i >= 0 else None
+                if group is not None and group == cur_group:
+                    # Second member of the group: skip the rest of it.
+                    restart = group + MARKER_GROUP_PAD
+                    break
+                cur_group = group
+                yield name, meta
+        finally:
+            close = getattr(stream, "close", None)
+            if close is not None:
+                close()
+        if restart is None:
+            return
+        start_after = restart
+
+
+def pushdown_stream(self_stream, prefix: str, marker: str, delimiter: str,
+                    version_marker: str = ""):
+    """The one marker-pushdown policy every listing layer shares:
+    - version_marker set: no pushdown (the key-marker object's remaining
+      versions must still stream);
+    - delimiter: group-aware stream resuming past whole CommonPrefix
+      groups;
+    - plain: marker as start_after (subtree pruning in the walk).
+    `self_stream(start_after)` builds the layer's sorted journal stream."""
+    from minio_tpu.storage.api import group_start_after
+
+    if version_marker:
+        return self_stream("")
+    if delimiter:
+        return grouped_journal_stream(
+            self_stream, prefix, group_start_after(marker, delimiter),
+            delimiter)
+    return self_stream(marker)
+
+
 def prefetch_stream(gen, depth: int = 32):
     """Run `gen` in a producer thread behind a bounded queue: the k-way
     listing merge then overlaps every drive's walk I/O instead of pulling

@@ -986,8 +986,13 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
                      delimiter: str = "", max_keys: int = 1000) -> ListObjectsInfo:
         self.get_bucket_info(bucket)
+        # Marker pushdown (subtree pruning, group-aware delimiter walks):
+        # listing.pushdown_stream is the single policy shared by every
+        # layer; paginate re-filters either way.
         return listing.paginate_objects(
-            self.stream_journals(bucket, prefix),
+            listing.pushdown_stream(
+                lambda sa: self.stream_journals(bucket, prefix, sa),
+                prefix, marker, delimiter),
             lambda name, fi: self._fi_to_object_info(bucket, name, fi),
             prefix, marker, delimiter, max_keys,
         )
@@ -997,7 +1002,9 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                              max_keys: int = 1000) -> ListObjectVersionsInfo:
         self.get_bucket_info(bucket)
         return listing.paginate_versions(
-            self.stream_journals(bucket, prefix),
+            listing.pushdown_stream(
+                lambda sa: self.stream_journals(bucket, prefix, sa),
+                prefix, marker, delimiter, version_marker),
             lambda name, fi: self._fi_to_object_info(bucket, name, fi),
             prefix, marker, version_marker, delimiter, max_keys,
         )
@@ -1015,7 +1022,10 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         overlaps (the reference's per-drive WalkDir goroutines)."""
         def drive_stream(d: StorageAPI):
             try:
-                for e in d.walk_dir(bucket, prefix):
+                # start_after pushes down into the walk (subtree pruning:
+                # O(page) resume); the belt-and-braces re-check covers
+                # implementations that only best-effort the marker.
+                for e in d.walk_dir(bucket, prefix, start_after):
                     if start_after and e.name <= start_after:
                         continue
                     try:

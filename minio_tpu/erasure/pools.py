@@ -295,8 +295,10 @@ class ErasureServerPools:
                 # Partial stream drained mid-page: names past `end` may
                 # exist — fall through to the walk for a correct page.
         res = listing.paginate_objects(
-            self.stream_journals(bucket, prefix), to_info,
-            prefix, marker, delimiter, max_keys)
+            listing.pushdown_stream(
+                lambda sa: self.stream_journals(bucket, prefix, sa),
+                prefix, marker, delimiter),
+            to_info, prefix, marker, delimiter, max_keys)
         if (res.is_truncated and not marker
                 and not self.metacache.recently_saved(bucket, prefix)):
             # More pages will follow: render a FRESH stream up to the cap
@@ -326,7 +328,9 @@ class ErasureServerPools:
                 if r.is_truncated or not end:
                     return r
         res = listing.paginate_versions(
-            self.stream_journals(bucket, prefix), to_info,
+            listing.pushdown_stream(
+                lambda sa: self.stream_journals(bucket, prefix, sa),
+                prefix, marker, delimiter, version_marker), to_info,
             prefix, marker, version_marker, delimiter, max_keys)
         if (res.is_truncated and not marker
                 and not self.metacache.recently_saved_versions(

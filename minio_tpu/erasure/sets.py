@@ -244,7 +244,9 @@ class ErasureSets:
                      delimiter: str = "", max_keys: int = 1000) -> ListObjectsInfo:
         self.get_bucket_info(bucket)
         return listing.paginate_objects(
-            self.stream_journals(bucket, prefix),
+            listing.pushdown_stream(
+                lambda sa: self.stream_journals(bucket, prefix, sa),
+                prefix, marker, delimiter),
             lambda name, fi: listing.fi_to_object_info(bucket, name, fi),
             prefix, marker, delimiter, max_keys,
         )
@@ -254,7 +256,9 @@ class ErasureSets:
                              max_keys: int = 1000) -> ListObjectVersionsInfo:
         self.get_bucket_info(bucket)
         return listing.paginate_versions(
-            self.stream_journals(bucket, prefix),
+            listing.pushdown_stream(
+                lambda sa: self.stream_journals(bucket, prefix, sa),
+                prefix, marker, delimiter, version_marker),
             lambda name, fi: listing.fi_to_object_info(bucket, name, fi),
             prefix, marker, version_marker, delimiter, max_keys,
         )

@@ -58,6 +58,24 @@ class WalkEntry:
         return self.name.endswith("/")
 
 
+# Lexicographic upper bound for any legal object-name suffix (names cap at
+# 1024 chars): appended to a prefix it names the largest key that prefix
+# range can contain. walk_dir's subtree prune compares against it, and
+# delimiter listings resume past a whole CommonPrefix group by passing
+# marker + MARKER_GROUP_PAD as start_after.
+MARKER_GROUP_PAD = "\U0010ffff" * 1025
+
+
+def group_start_after(marker: str, delimiter: str) -> str:
+    """start_after for a listing continuation: when the marker is a
+    CommonPrefix (delimiter listing rolled a group up), resume past the
+    ENTIRE group so the walk prunes its subtree instead of parsing and
+    discarding every journal inside it."""
+    if delimiter and marker.endswith(delimiter):
+        return marker + MARKER_GROUP_PAD
+    return marker
+
+
 class StorageAPI(abc.ABC):
     """One drive. All methods raise minio_tpu.utils.errors.StorageError
     subclasses on failure."""
@@ -202,6 +220,10 @@ class StorageAPI(abc.ABC):
                     )
 
     @abc.abstractmethod
-    def walk_dir(self, volume: str, prefix: str = "") -> Iterator[WalkEntry]:
-        """Stream sorted entries under prefix with raw journal bytes
-        (reference WalkDir, cmd/metacache-walk.go)."""
+    def walk_dir(self, volume: str, prefix: str = "",
+                 start_after: str = "") -> Iterator[WalkEntry]:
+        """Stream sorted entries under prefix with raw journal bytes,
+        skipping names <= start_after WITHOUT reading their journals —
+        implementations prune whole subtrees below the marker, so a
+        mid-bucket resume is O(page), not O(position) (reference WalkDir
+        forward-to, cmd/metacache-walk.go)."""

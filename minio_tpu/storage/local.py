@@ -27,7 +27,13 @@ from collections import OrderedDict
 from typing import BinaryIO, Iterable, Iterator
 
 from minio_tpu.ops import bitrot
-from minio_tpu.storage.api import DiskInfo, StorageAPI, VolInfo, WalkEntry
+from minio_tpu.storage.api import (
+    MARKER_GROUP_PAD,
+    DiskInfo,
+    StorageAPI,
+    VolInfo,
+    WalkEntry,
+)
 from minio_tpu.storage.fileinfo import FileInfo
 from minio_tpu.storage.xlmeta import XLMeta
 from minio_tpu.utils import errors as se
@@ -607,7 +613,8 @@ class LocalDrive(StorageAPI):
             with self.read_file_stream(volume, rel) as f:
                 bitrot.verify_shard_file(f, shard_data_size, shard_size, algo)
 
-    def walk_dir(self, volume: str, prefix: str = "") -> Iterator[WalkEntry]:
+    def walk_dir(self, volume: str, prefix: str = "",
+                 start_after: str = "") -> Iterator[WalkEntry]:
         """Sorted journal walk. Entries come out in LEXICOGRAPHIC order of
         the full object name — the invariant the streamed k-way listing
         merge relies on. Per-directory sorting alone is NOT lexicographic
@@ -639,9 +646,21 @@ class LocalDrive(StorageAPI):
                     if prefix and not (name.startswith(prefix)
                                        or prefix.startswith(name + "/")):
                         continue
+                    # Marker prune: the largest key this subtree can hold
+                    # is name+"/"+<max suffix> (names are length-capped at
+                    # 1024). If even that bound is <= start_after, no key
+                    # here can follow the marker — skip the subtree without
+                    # touching its journals. Group-resume callers (NextMarker
+                    # = a CommonPrefix) exploit this by passing
+                    # marker+MARKER_GROUP_PAD so the whole group prunes too.
+                    if start_after and name + "/" + MARKER_GROUP_PAD \
+                            <= start_after:
+                        continue
                     yield from _walk(name)
                     continue
                 if prefix and not name.startswith(prefix):
+                    continue
+                if start_after and name <= start_after:
                     continue
                 meta_p = os.path.join(base, *name.split("/"), META_FILE)
                 try:

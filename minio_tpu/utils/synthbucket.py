@@ -1,0 +1,39 @@
+"""Synthetic-namespace builder shared by the listing scale test and the
+listing bench: fans one pre-serialized inline journal out to N objects per
+drive directly on disk (the journal body doesn't embed the object name —
+volume/name are storage-call parameters), so a 100k+ bucket materializes in
+seconds instead of minutes through put_object."""
+
+from __future__ import annotations
+
+import os
+
+from minio_tpu.storage.fileinfo import FileInfo, PartInfo
+from minio_tpu.storage.xlmeta import XLMeta
+
+
+def make_synthetic_bucket(drives, bucket: str, n_objects: int) -> None:
+    """Write n_objects inline-object journals under every drive's bucket
+    dir, laid out two levels deep (p{NNN}/o{NNNNNN}) to keep per-directory
+    entry counts sane. The bucket volume must already exist."""
+    fi = FileInfo.new(bucket, "x")
+    fi.size, fi.inline_data, fi.data_dir = 1, b"x", ""
+    fi.mod_time = 1700000000.0
+    fi.metadata = {"etag": "0" * 32}
+    fi.parts = [PartInfo(1, 1, 1, fi.mod_time)]
+    journal = XLMeta()
+    journal.add_version(fi)
+    raw = journal.serialize()
+    for d in drives:
+        broot = os.path.join(d.root, bucket)
+        # Hot loop is one mkdir + one open/write/close of raw syscalls per
+        # object; buffered io doubles the wall time at this file count.
+        for p in range(-(-n_objects // 1000)):
+            os.makedirs(os.path.join(broot, f"p{p:03d}"), exist_ok=True)
+        for i in range(n_objects):
+            odir = os.path.join(broot, f"p{i // 1000:03d}", f"o{i:06d}")
+            os.mkdir(odir)
+            fd = os.open(os.path.join(odir, "meta.mp"),
+                         os.O_WRONLY | os.O_CREAT, 0o644)
+            os.write(fd, raw)
+            os.close(fd)

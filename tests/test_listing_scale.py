@@ -1,0 +1,134 @@
+"""Scale proof for the streamed walks (reference cmd/metacache-set.go:534):
+a 200k-object bucket is listed end-to-end with peak RSS growth bounded to
+O(page), and the heal walk streams a prefix without materializing the
+namespace. The parse-count tests in test_streamed_listing.py pin the
+algorithmic shape; this pins the actual memory footprint at scale.
+
+Objects are synthesized by writing one pre-serialized inline journal per
+(object, drive) directly — the journal body doesn't embed the object name
+(volume/name are storage-call parameters), so a single byte blob fans out
+to the whole namespace in seconds instead of minutes through put_object.
+"""
+
+from __future__ import annotations
+
+import os
+import resource
+import time
+
+import pytest
+
+from minio_tpu.erasure import ErasureObjects
+from minio_tpu.storage import LocalDrive
+from minio_tpu.utils.synthbucket import make_synthetic_bucket
+
+N_OBJECTS = 200_000
+N_DRIVES = 2
+
+
+def _rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+@pytest.fixture(scope="module")
+def huge_set(tmp_path_factory):
+    # /dev/shm: 800k tiny files on the VM's virtio disk would take minutes
+    # and measure the disk, not the walk.
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="mtpu_scale_", dir=base)
+    drives = [LocalDrive(os.path.join(root, f"d{i}"))
+              for i in range(N_DRIVES)]
+    es = ErasureObjects(drives, parity=1, block_size=1 << 16)
+    es.make_bucket("huge")
+
+    t0 = time.perf_counter()
+    make_synthetic_bucket(drives, "huge", N_OBJECTS)
+    creation_s = time.perf_counter() - t0
+    yield es, creation_s
+    es.close()
+    import shutil
+
+    shutil.rmtree(root, ignore_errors=True)
+
+
+def test_full_listing_rss_bounded(huge_set):
+    es, _ = huge_set
+    base = _rss_mb()
+    seen = 0
+    last = ""
+    t0 = time.perf_counter()
+    for name, _meta in es.stream_journals("huge", ""):
+        assert name > last, "stream out of order"
+        last = name
+        seen += 1
+    dt = time.perf_counter() - t0
+    grown = _rss_mb() - base
+    rate = seen / dt
+    assert seen == N_OBJECTS
+    # O(page) bound: the walk holds one directory page + merge lookahead
+    # per drive. 80 MB is ~25x a page and ~1/40th of materializing 400k
+    # parsed journals (which measured >1 GB in the r2 design).
+    assert grown < 80, f"listing grew RSS by {grown:.0f} MB"
+    assert rate > 5_000, f"list rate {rate:.0f} obj/s"
+
+
+def test_paged_listing_continuation(huge_set):
+    """V2-style pagination across the big bucket: each page is O(page);
+    spot-walk 5 pages from three offsets."""
+    es, _ = huge_set
+    base = _rss_mb()
+    for start in ("", "p050/", "p199/"):
+        marker = start
+        for _ in range(5):
+            res = es.list_objects("huge", marker=marker, max_keys=1000)
+            if not res.objects:
+                break
+            marker = res.objects[-1].name
+    grown = _rss_mb() - base
+    assert grown < 80, f"paged listing grew RSS by {grown:.0f} MB"
+
+
+def test_delimiter_group_resume_prunes(huge_set):
+    """Resuming a delimiter listing after a CommonPrefix group must NOT
+    walk the group's subtree: 200 pages x 1000-object groups would cost
+    200k journal reads per page otherwise. Also pins S3 semantics for a
+    PLAIN marker equal to a prefix: keys inside still stream."""
+    es, _ = huge_set
+    res = es.list_objects("huge", delimiter="/", max_keys=10)
+    assert [p.rstrip("/") for p in res.prefixes[:2]] == ["p000", "p001"]
+    assert res.is_truncated
+    t0 = time.perf_counter()
+    marker = "p000/"
+    pages = 0
+    while marker and pages < 20:
+        res = es.list_objects("huge", marker=marker, delimiter="/",
+                              max_keys=10)
+        pages += 1
+        marker = (res.prefixes[-1] if res.prefixes
+                  else (res.objects[-1].name if res.objects else ""))
+        if not res.is_truncated:
+            break
+    dt = time.perf_counter() - t0
+    assert pages >= 19
+    # 20 pages over 200 groups: with the prune this is directory scans
+    # only (~ms); without it each page re-parsed up to 200k journals.
+    assert dt < 5.0, f"group-resume pages took {dt:.1f}s"
+    # Plain marker (no delimiter) equal to a group prefix: resume INSIDE.
+    res = es.list_objects("huge", marker="p123/", max_keys=5)
+    assert [o.name for o in res.objects] == [
+        f"p123/o{123000 + i:06d}" for i in range(5)]
+
+
+def test_heal_walk_streams(huge_set):
+    """heal_objects over a 1k-object prefix: bounded memory, touches only
+    the prefix (inline objects heal as metadata-quorum checks)."""
+    es, _ = huge_set
+    base = _rss_mb()
+    n = 0
+    for res in es.heal_objects("huge", prefix="p042/", dry_run=True):
+        n += 1
+    grown = _rss_mb() - base
+    assert n == 1000
+    assert grown < 60, f"heal walk grew RSS by {grown:.0f} MB"
