@@ -604,6 +604,50 @@ def bench_small_objects() -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_select_parquet() -> dict:
+    """S3 Select over Parquet (pkg/s3select parquet role): column-chunk
+    decode rate plus two end-to-end queries over a 1M-row file — a numeric
+    aggregate (string column never materializes: the lazy-BA columnar
+    contract) and a string-predicate scan (pays str construction)."""
+    import io
+
+    from minio_tpu.s3select.engine import S3SelectRequest, run_select
+    from minio_tpu.s3select.parquet import ParquetReader, write_parquet
+
+    n = 1_000_000
+    rows = [{"id": i, "price": float(i % 1000) + 0.5,
+             "qty": float(i % 7), "name": f"name{i % 100}"}
+            for i in range(n)]
+    schema = [("id", "int64"), ("price", "double"),
+              ("qty", "double"), ("name", "string")]
+    raw = write_parquet(rows, schema)
+    best_dec = 0.0
+    for _ in range(3):
+        r = ParquetReader(raw)
+        t0 = time.perf_counter()
+        for _n_rows, _data in r.iter_column_groups():
+            pass
+        best_dec = max(best_dec, len(raw) / (time.perf_counter() - t0))
+
+    def q(expr):
+        req = S3SelectRequest(expression=expr, input_format="PARQUET",
+                              output_format="CSV")
+        b"".join(run_select(io.BytesIO(raw), req))  # warm
+        t0 = time.perf_counter()
+        b"".join(run_select(io.BytesIO(raw), req))
+        return len(raw) / (time.perf_counter() - t0)
+
+    agg = q("SELECT COUNT(*), SUM(s.price) FROM S3Object s "
+            "WHERE s.price > 500")
+    strq = q("SELECT COUNT(*) FROM S3Object s WHERE s.name = 'name42'")
+    return {"metric": "s3select_parquet_decode_1M_rows",
+            "value": round(best_dec / 1e6, 1), "unit": "MB/s",
+            "vs_baseline": 0.0,
+            "agg_query_mbs": round(agg / 1e6, 1),
+            "string_filter_mbs": round(strq / 1e6, 1),
+            "file_mb": round(len(raw) / 1e6, 1)}
+
+
 def bench_xlmeta_codec() -> dict:
     """xl.meta journal codec throughput (BASELINE msgp-codec row,
     cmd/*_gen_test.go role): serialize+parse a 32-version journal."""
@@ -732,6 +776,7 @@ def main() -> int:
             ("degraded", bench_degraded),
             ("listing", bench_listing),
             ("select", bench_select_csv),
+            ("select_parquet", bench_select_parquet),
             ("xlmeta", bench_xlmeta_codec),
         ]
         if use_pallas:

@@ -101,6 +101,17 @@ def _build_and_load():
             ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_uint64, ctypes.c_uint8, ctypes.c_void_p]
         lib.mtpu_csv_parse_floats.restype = ctypes.c_int64
+        lib.mtpu_pq_rle_bp.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
+            ctypes.c_uint64, ctypes.c_void_p]
+        lib.mtpu_pq_rle_bp.restype = ctypes.c_int64
+        lib.mtpu_pq_plain_byte_array.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_void_p]
+        lib.mtpu_pq_plain_byte_array.restype = ctypes.c_int64
+        lib.mtpu_pq_unpack_bools.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_void_p]
+        lib.mtpu_pq_unpack_bools.restype = None
         lib.mtpu_jsonl_extract.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
             ctypes.c_uint32, ctypes.c_void_p, ctypes.c_void_p,
@@ -386,6 +397,57 @@ def csv_parse_floats(data: bytes, foff, flen, quote: bytes = b'"'):
     lib.mtpu_csv_parse_floats(data, foff.ctypes.data, flen.ctypes.data,
                               len(foff), quote[0], out.ctypes.data)
     return out
+
+
+# --- Parquet column-chunk decode kernels -------------------------------------
+
+def pq_rle_bp(buf: bytes, bit_width: int, count: int):
+    """Decode a Parquet RLE/bit-packed hybrid run to a uint32 array
+    (definition levels, dictionary indices). Truncated input zero-fills,
+    matching the tolerant Python decoder. Raises on malformed varints."""
+    import numpy as np
+
+    lib = _build_and_load()
+    if lib is None:
+        raise OSError("native parquet decoder unavailable")
+    out = np.empty(count, dtype=np.uint32)
+    rc = lib.mtpu_pq_rle_bp(buf, len(buf), bit_width, count,
+                            out.ctypes.data)
+    if rc < 0:
+        raise ValueError("malformed RLE/bit-packed run")
+    return out
+
+
+def pq_plain_byte_array(buf: bytes, count: int):
+    """Scan a PLAIN BYTE_ARRAY page: (starts uint64 array, lens uint32
+    array) locating each value inside buf. Raises if a length prefix
+    overruns the page (corrupt data)."""
+    import numpy as np
+
+    lib = _build_and_load()
+    if lib is None:
+        raise OSError("native parquet decoder unavailable")
+    starts = np.empty(count, dtype=np.uint64)
+    lens = np.empty(count, dtype=np.uint32)
+    rc = lib.mtpu_pq_plain_byte_array(buf, len(buf), count,
+                                      starts.ctypes.data, lens.ctypes.data)
+    if rc < 0:
+        raise ValueError("BYTE_ARRAY length prefix overruns page")
+    return starts, lens
+
+
+def pq_unpack_bools(buf: bytes, count: int):
+    """Unpack count LSB-first bits to a bool array (PLAIN BOOLEAN page)."""
+    import numpy as np
+
+    lib = _build_and_load()
+    if lib is None:
+        raise OSError("native parquet decoder unavailable")
+    if len(buf) * 8 < count:
+        raise ValueError("boolean page shorter than value count")
+    out = np.empty(count, dtype=np.uint8)
+    lib.mtpu_pq_unpack_bools(buf, count, out.ctypes.data)
+    return out.astype(bool)
 
 
 # --- JSON-lines field extractor (S3 Select vector engine) --------------------

@@ -282,6 +282,92 @@ def _decode_plain(buf: bytes, ptype: int, count: int,
     raise ParquetError(f"unsupported physical type {ptype}")
 
 
+_NP_DTYPES = {_T_INT32: "<i4", _T_INT64: "<i8",
+              _T_FLOAT: "<f4", _T_DOUBLE: "<f8"}
+
+
+def _rle_bp_np(buf: bytes, pos: int, end: int, bit_width: int, count: int):
+    """RLE/bit-packed hybrid decode to a uint32 array — native kernel when
+    the .so is present, the Python decoder otherwise."""
+    import numpy as np
+
+    nlib = _native_pq()
+    if nlib is not None:
+        try:
+            return nlib.pq_rle_bp(buf[pos:end], bit_width, count)
+        except ValueError as e:
+            raise ParquetError(str(e)) from None
+        except OSError:
+            pass
+    return np.asarray(_rle_bp_hybrid(buf, pos, end, bit_width, count),
+                      dtype=np.uint32)
+
+
+def _def_levels_np(buf: bytes, pos: int, end: int, n: int):
+    """Definition levels (flat schema: bit width 1) as a bool mask."""
+    return _rle_bp_np(buf, pos, end, 1, n).astype(bool)
+
+
+def _decode_plain_typed(data: bytes, pos: int, ptype: int, count: int,
+                        type_length: int = 0):
+    """PLAIN decode into the typed form: ("np", ndarray) for fixed-width
+    numerics and booleans (zero boxing — .tolist() at materialization
+    yields exactly the Python values struct.unpack produced), ("list",
+    values) for byte-arrays and legacy types. BYTE_ARRAY offsets scan runs
+    in the native kernel when available."""
+    import numpy as np
+
+    dt = _NP_DTYPES.get(ptype)
+    if dt is not None:
+        itemsize = int(dt[-1])
+        if len(data) - pos < count * itemsize:
+            raise ParquetError("PLAIN page truncated")
+        return "np", np.frombuffer(data, dtype=dt, count=count, offset=pos)
+    if ptype == _T_BOOLEAN:
+        if (len(data) - pos) * 8 < count:
+            raise ParquetError("PLAIN boolean page truncated")
+        nlib = _native_pq()
+        if nlib is not None:
+            try:
+                return "np", nlib.pq_unpack_bools(data[pos:], count)
+            except OSError:
+                pass
+        return "np", np.asarray(
+            _decode_plain(data[pos:], ptype, count), dtype=bool)
+    if ptype == _T_BYTE_ARRAY:
+        nlib = _native_pq()
+        if nlib is not None:
+            try:
+                starts, lens = nlib.pq_plain_byte_array(data[pos:], count)
+            except ValueError as e:
+                raise ParquetError(str(e)) from None
+            except OSError:
+                return "list", _decode_plain(data[pos:], ptype, count)
+            # Offsets validated; str construction deferred to first touch.
+            return "ba", (data, pos, starts, lens)
+        return "list", [
+            (v.decode("utf-8") if _is_utf8(v) else v)
+            for v in _decode_plain(data[pos:], ptype, count)]
+    # FIXED / INT96 / exotica: the exact per-value loop.
+    return "list", _decode_plain(data[pos:], ptype, count, type_length)
+
+
+def _ba_to_list(ba) -> list:
+    """Materialize a lazy byte-array piece through DecodedColumn's one
+    decode loop (dictionaries are small and gathered immediately, so
+    laziness buys nothing there)."""
+    n = len(ba[2])
+    return DecodedColumn(n, ba=ba)._materialize()
+
+
+def _is_utf8(b: bytes) -> bool:
+    try:
+        b.decode("utf-8")
+        return True
+    except UnicodeDecodeError:
+        return False
+
+
 def _decompress(codec: int, data: bytes, uncompressed_size: int) -> bytes:
     if codec == 0:
         return data
@@ -291,6 +377,133 @@ def _decompress(codec: int, data: bytes, uncompressed_size: int) -> bytes:
         return zlib.decompress(data, 16 + zlib.MAX_WBITS)  # gzip framing
     raise ParquetError(f"unsupported codec {codec} "
                        "(UNCOMPRESSED/SNAPPY/GZIP implemented)")
+
+
+class DecodedColumn:
+    """One column chunk's values in two forms: a numpy fast form
+    (np_vals/np_present) the vector Select lane consumes without boxing a
+    single value, and a lazily materialized exact Python list — the row
+    engine's shape; .tolist() yields the same Python ints/floats/bools the
+    old struct.unpack loops did, so engine semantics are unchanged."""
+
+    __slots__ = ("n", "np_vals", "np_present", "_list", "_ba")
+
+    def __init__(self, n: int, np_vals=None, np_present=None, values=None,
+                 ba=None):
+        self.n = n
+        self.np_vals = np_vals        # dense typed ndarray (len n) or None
+        self.np_present = np_present  # bool ndarray; None == all present
+        self._list = values           # prebuilt exact list or None
+        self._ba = ba                 # (page, base, starts, lens): LAZY
+        # byte-array form — str objects only build if the query actually
+        # touches this column (offsets were validated at decode time, so
+        # corrupt pages still fail inside the engine's malformed guard).
+
+    def _materialize(self) -> list:
+        if self._list is None:
+            import numpy as np
+
+            if self._ba is not None:
+                page, base, starts, lens = self._ba
+                vals: list = []
+                ap = vals.append
+                for s, ln in zip(starts.tolist(), lens.tolist()):
+                    b = page[base + s: base + s + ln]
+                    try:
+                        ap(b.decode("utf-8"))
+                    except UnicodeDecodeError:
+                        ap(b)
+                if self.np_present is not None:
+                    out: list = [None] * self.n
+                    for i, v in zip(
+                            np.nonzero(self.np_present)[0].tolist(), vals):
+                        out[i] = v
+                    vals = out
+                self._list = vals
+                self._ba = None
+            else:
+                lst = self.np_vals.tolist()
+                if self.np_present is not None:
+                    for i in np.nonzero(~self.np_present)[0].tolist():
+                        lst[i] = None
+                self._list = lst
+        return self._list
+
+    def eq_literal(self, lit: str):
+        """Bytes-level equality against a str literal without building one
+        str object: (eq_mask, present_mask) over rows, or None when the
+        fast compare can't be trusted (already materialized, no lazy page,
+        or non-ASCII bytes present — non-ASCII needs per-value utf8
+        validation to preserve the row engine's bytes-vs-str coercion, so
+        those pages take the exact path)."""
+        if self._ba is None or self._list is not None:
+            return None
+        import numpy as np
+
+        page, base, starts, lens = self._ba
+        arr = np.frombuffer(page, np.uint8, offset=base)
+        if arr.size:
+            # Non-ASCII check over VALUE bytes only — the 4-byte length
+            # prefixes legally carry >=0x80 bytes (any value 128-255
+            # chars long), which must not disable the fast path. Range
+            # sums over a cumulative high-bit count cover each value
+            # window without touching the prefixes.
+            hb = np.cumsum((arr & 0x80).astype(np.int64))
+            s = starts.astype(np.int64)
+            e = s + lens.astype(np.int64) - 1
+            nonempty = lens > 0
+            if nonempty.any():
+                hi = hb[e[nonempty]]
+                lo = np.where(s[nonempty] > 0, hb[s[nonempty] - 1], 0)
+                if (hi - lo).any():
+                    return None
+        present = (self.np_present if self.np_present is not None
+                   else np.ones(self.n, bool))
+        eq = np.zeros(self.n, bool)
+        try:
+            enc = lit.encode("ascii")
+        except UnicodeEncodeError:
+            # ASCII page can never equal a non-ASCII literal.
+            return eq, present
+        rows = np.nonzero(present)[0]
+        cand = np.nonzero(lens == len(enc))[0]
+        L = len(enc)
+        if L and cand.size:
+            # Gather the candidates' L-byte windows in one fancy-indexed
+            # matrix and compare against the literal row-wise.
+            idx = (starts[cand].astype(np.int64)[:, None]
+                   + np.arange(L, dtype=np.int64)[None, :])
+            win = arr[idx]
+            hit = (win == np.frombuffer(enc, np.uint8)[None, :]).all(axis=1)
+            eq[rows[cand[hit]]] = True
+        elif not L:
+            eq[rows[cand]] = True  # empty-string literal
+        return eq, present
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __bool__(self) -> bool:
+        return self.n > 0
+
+    def __getitem__(self, i):
+        return self._materialize()[i]
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+
+def _native_pq():
+    """The native decode kernels, or None (pure-Python fallbacks keep the
+    reader correct on hosts without the .so)."""
+    try:
+        from minio_tpu.native import lib as nlib
+
+        if nlib.available():
+            return nlib
+    except Exception:  # noqa: BLE001
+        pass
+    return None
 
 
 class _Column:
@@ -347,16 +560,19 @@ class ParquetReader:
             ))
         return cols
 
-    def _read_column_chunk(self, col: _Column, cc_meta: dict) -> list:
+    def _read_column_chunk(self, col: _Column, cc_meta: dict) -> DecodedColumn:
+        import numpy as np
+
         codec = cc_meta.get(4, 0)
         num_values = cc_meta.get(5, 0)
         start = cc_meta.get(11, None)           # dictionary_page_offset
         if start is None:
             start = cc_meta.get(9, 0)           # data_page_offset
         pos = start
-        values: list = []
-        dictionary: list | None = None
-        while len(values) < num_values:
+        pieces: list[DecodedColumn] = []
+        got = 0
+        dictionary = None                       # ('np', arr) | ('list', vals)
+        while got < num_values:
             t = _Thrift(self.raw, pos)
             header = t.read_struct()
             page_type = header.get(1, 0)
@@ -368,16 +584,19 @@ class ParquetReader:
                 dph = header.get(7, {})
                 n = dph.get(1, 0)
                 data = _decompress(codec, body, unc_size)
-                dictionary = _decode_plain(data, col.ptype, n,
-                                           col.type_length)
+                dictionary = _decode_plain_typed(data, 0, col.ptype, n,
+                                                 col.type_length)
+                if dictionary[0] == "ba":
+                    dictionary = ("list", _ba_to_list(dictionary[1]))
                 continue
             if page_type == 0:                  # DATA_PAGE v1
                 dph = header.get(5, {})
                 n = dph.get(1, 0)
                 enc = dph.get(2, 0)
                 data = _decompress(codec, body, unc_size)
-                values.extend(self._decode_data_page(
+                pieces.append(self._decode_data_page(
                     col, data, n, enc, dictionary, v2_def=None))
+                got += n
                 continue
             if page_type == 3:                  # DATA_PAGE v2
                 dph = header.get(8, {})
@@ -391,59 +610,99 @@ class ParquetReader:
                 if compressed:
                     payload = _decompress(codec, payload,
                                           unc_size - rep_len - def_len)
-                defs = (_rle_bp_hybrid(levels, rep_len, rep_len + def_len,
-                                       1, n) if col.optional and def_len
-                        else None)
-                values.extend(self._decode_data_page(
+                defs = (_def_levels_np(levels, rep_len, rep_len + def_len, n)
+                        if col.optional and def_len else None)
+                pieces.append(self._decode_data_page(
                     col, payload, n, enc, dictionary, v2_def=defs))
+                got += n
                 continue
             # index/unknown pages: skip
-        return values[:num_values]
+        if len(pieces) == 1 and pieces[0].n >= num_values:
+            c = pieces[0]
+            if c.n == num_values:
+                return c
+            return DecodedColumn(num_values, values=list(
+                c._materialize()[:num_values]))
+        # Multi-page chunk: concatenate, preferring the numpy form when
+        # every page produced one of the same dtype.
+        np_ok = pieces and all(
+            p.np_vals is not None for p in pieces) and len(
+            {p.np_vals.dtype for p in pieces}) == 1
+        if np_ok:
+            vals = np.concatenate([p.np_vals for p in pieces])[:num_values]
+            if any(p.np_present is not None for p in pieces):
+                present = np.concatenate([
+                    p.np_present if p.np_present is not None
+                    else np.ones(p.n, bool) for p in pieces])[:num_values]
+            else:
+                present = None
+            return DecodedColumn(num_values, np_vals=vals,
+                                 np_present=present)
+        flat: list = []
+        for p in pieces:
+            flat.extend(p._materialize())
+        return DecodedColumn(num_values, values=flat[:num_values])
 
     def _decode_data_page(self, col: _Column, data: bytes, n: int, enc: int,
-                          dictionary: list | None, v2_def) -> list:
+                          dictionary, v2_def) -> DecodedColumn:
+        import numpy as np
+
         pos = 0
         if v2_def is not None:
             defs = v2_def
         elif col.optional:
             # v1: def levels length-prefixed RLE (bit width 1 for flat)
             dlen = int.from_bytes(data[pos:pos + 4], "little")
-            defs = _rle_bp_hybrid(data, pos + 4, pos + 4 + dlen, 1, n)
+            defs = _def_levels_np(data, pos + 4, pos + 4 + dlen, n)
             pos += 4 + dlen
         else:
             defs = None
-        present = sum(defs) if defs is not None else n
+        present = int(defs.sum()) if defs is not None else n
         if enc in (_ENC_PLAIN_DICT, _ENC_RLE_DICT):
             if dictionary is None:
                 raise ParquetError("dictionary-encoded page with no dictionary")
             bit_width = data[pos]
-            idx = _rle_bp_hybrid(data, pos + 1, len(data), bit_width, present)
-            vals = [dictionary[i] for i in idx]
+            idx = _rle_bp_np(data, pos + 1, len(data), bit_width, present)
+            kind, dvals = dictionary
+            if kind == "np":
+                if present and idx.max(initial=0) >= len(dvals):
+                    raise IndexError("dictionary index out of range")
+                piece = ("np", dvals[idx])
+            else:
+                piece = ("list", [dvals[i] for i in idx.tolist()])
         elif enc == _ENC_PLAIN:
-            vals = _decode_plain(data[pos:], col.ptype, present,
-                                 col.type_length)
+            piece = _decode_plain_typed(data, pos, col.ptype, present,
+                                        col.type_length)
         elif enc == _ENC_RLE and col.ptype == _T_BOOLEAN:
-            vals = [bool(v) for v in
-                    _rle_bp_hybrid(data, pos + 4, len(data), 1, present)]
+            piece = ("np",
+                     _rle_bp_np(data, pos + 4, len(data), 1,
+                                present).astype(bool))
         else:
             raise ParquetError(f"unsupported encoding {enc}")
+        kind, vals = piece
         if defs is None:
-            return [col.convert(v) for v in vals]
-        # Scatter values into the null skeleton at the defined positions
-        # (one numpy nonzero instead of a per-row branch loop).
-        import numpy as np
-
-        defined = np.nonzero(np.asarray(defs, dtype=bool))[0].tolist()
-        if len(vals) < len(defined):
+            if kind == "np":
+                return DecodedColumn(n, np_vals=vals)
+            if kind == "ba":
+                return DecodedColumn(n, ba=vals)
+            return DecodedColumn(n, values=vals)
+        # Scatter values into the null skeleton at the defined positions.
+        if kind == "ba":
+            # Native scan decoded exactly `present` offsets (or raised).
+            return DecodedColumn(n, np_present=defs, ba=vals)
+        if len(vals) < present:
             # Truncated page: fabricating NULLs for data that exists
             # would silently corrupt SELECT results.
             raise ParquetError(
-                f"page has {len(vals)} values for {len(defined)} "
-                "defined rows")
-        out: list = [None] * len(defs)
-        for i, v in zip(defined, vals):
-            out[i] = col.convert(v)
-        return out
+                f"page has {len(vals)} values for {present} defined rows")
+        if kind == "np":
+            dense = np.zeros(n, dtype=vals.dtype)
+            dense[defs] = vals[:present]
+            return DecodedColumn(n, np_vals=dense, np_present=defs)
+        out: list = [None] * n
+        for i, v in zip(np.nonzero(defs)[0].tolist(), vals):
+            out[i] = v
+        return DecodedColumn(n, values=out)
 
     def iter_column_groups(self) -> Iterator[tuple[int, dict[str, list]]]:
         """Yield (n_rows, {column: decoded values}) per row group — the

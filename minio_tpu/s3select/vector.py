@@ -997,8 +997,34 @@ class _PqCol:
 
     __slots__ = ("vals", "numeric", "present", "odd")
 
-    def __init__(self, raw: list):
+    def __init__(self, raw):
+        from minio_tpu.s3select.parquet import DecodedColumn
+
         n = len(raw)
+        if isinstance(raw, DecodedColumn) and raw.np_vals is not None \
+                and raw.np_vals.dtype.kind in "iufb":
+            # Typed chunk from the native/numpy decoder: classify without
+            # touching a single Python object. Bool chunks stay exact via
+            # the row path (odd), matching the slow loop's behavior.
+            arr = raw.np_vals
+            present = (raw.np_present.copy() if raw.np_present is not None
+                       else np.ones(n, bool))
+            self.present = present
+            if arr.dtype.kind == "b":
+                self.vals = np.zeros(n, np.float64)
+                self.numeric = np.zeros(n, bool)
+                self.odd = np.nonzero(present)[0].tolist()
+                return
+            self.vals = arr.astype(np.float64)
+            if arr.dtype.kind == "i" and arr.dtype.itemsize == 8:
+                big = (arr > _TWO53) | (arr < -_TWO53)
+                self.numeric = present & ~big
+                self.odd = np.nonzero(present & big)[0].tolist()
+            else:
+                self.numeric = present.copy()
+                self.odd = []
+            self.vals[~self.numeric] = 0.0
+            return
         self.vals = np.zeros(n, np.float64)
         self.numeric = np.zeros(n, bool)
         self.present = np.zeros(n, bool)
@@ -1045,6 +1071,18 @@ class ParquetVectorPlan:
             return np.zeros(n, bool), np.zeros(n, bool)
         if isinstance(node.lit, str):
             vals = raw[cn]
+            if node.op in ("=", "<>"):
+                from minio_tpu.s3select.parquet import DecodedColumn
+
+                if isinstance(vals, DecodedColumn):
+                    # Lazy byte-array chunk: bytes-level compare, zero str
+                    # construction (ASCII pages only — eq_literal refuses
+                    # anything needing per-value utf8/coercion semantics).
+                    fast = vals.eq_literal(node.lit)
+                    if fast is not None:
+                        eq, present = fast
+                        value = eq if node.op == "=" else (~eq & present)
+                        return value & present, present.copy()
             eq = np.fromiter((isinstance(v, str) and v == node.lit
                               for v in vals), bool, n)
             present = np.fromiter((v is not None for v in vals), bool, n)
@@ -1071,6 +1109,86 @@ class ParquetVectorPlan:
                 known[ri] = True
                 value[ri] = bool(res)
         return value, known
+
+    def _accumulate_fast(self, ev, data, mask) -> bool:
+        """Chunk-level aggregate accumulation, bit-identical to the row
+        engine or refused (False -> caller row-loops):
+        - SUM chains through np.cumsum seeded with the running state —
+          numpy's cumsum is the sequential left-to-right float addition,
+          exactly the row loop's rounding;
+        - MIN/MAX keep the column's own type (int chunks yield Python
+          ints, so serialization matches the row engine);
+        - refused outright for NaN floats, ints beyond 2^53 (exact
+          big-int semantics), bool/string/exotic chunks (COUNT over any
+          chunk is still fast — presence needs no values)."""
+        from minio_tpu.s3select.parquet import DecodedColumn
+
+        updates = []
+        for f, st in zip(self.query.aggregates, ev.agg_state):
+            if f.star:
+                updates.append((st, None))
+                continue
+            cn = self._colname(f.args[0].name, data)
+            if cn is None:
+                updates.append((st, "missing"))
+                continue
+            chunk = data[cn]
+            if not isinstance(chunk, DecodedColumn):
+                return False
+            if chunk.np_vals is None or chunk.np_vals.dtype.kind not in "if":
+                # Untyped (string/exotic) chunk: only COUNT is safe —
+                # presence is knowable without materializing values.
+                if f.name != "COUNT":
+                    return False
+                pres = (mask if chunk.np_present is None
+                        else mask & chunk.np_present)
+                if chunk.np_vals is None and chunk._ba is None \
+                        and chunk._list is not None:
+                    # Plain list chunk: presence means value is not None.
+                    lst = chunk._list
+                    cnt = sum(1 for ri in np.nonzero(mask)[0].tolist()
+                              if lst[ri] is not None)
+                    updates.append((st, ("count", cnt)))
+                else:
+                    updates.append((st, ("count", int(pres.sum()))))
+                continue
+            arr = chunk.np_vals
+            pres = (mask if chunk.np_present is None
+                    else mask & chunk.np_present)
+            masked = arr[pres]
+            if arr.dtype.kind == "f":
+                if masked.size and np.isnan(masked).any():
+                    return False
+            elif arr.dtype.itemsize == 8 and masked.size and \
+                    ((masked > _TWO53) | (masked < -_TWO53)).any():
+                return False
+            updates.append((st, ("vals", masked)))
+        # Validated: apply (two-phase so a refusal never half-updates).
+        for st, upd in updates:
+            if upd is None:
+                st["count"] += int(mask.sum())
+            elif upd == "missing":
+                continue
+            elif upd[0] == "count":
+                st["count"] += upd[1]
+            else:
+                masked = upd[1]
+                c = int(masked.size)
+                if not c:
+                    continue
+                st["count"] += c
+                seq = np.cumsum(np.concatenate((
+                    np.asarray([st["sum"]], np.float64),
+                    masked.astype(np.float64))))
+                st["sum"] = float(seq[-1])
+                mn, mx = masked.min(), masked.max()
+                if masked.dtype.kind == "i":
+                    mn, mx = int(mn), int(mx)
+                else:
+                    mn, mx = float(mn), float(mx)
+                st["min"] = mn if st["min"] is None else min(st["min"], mn)
+                st["max"] = mx if st["max"] is None else max(st["max"], mx)
+        return True
 
     def run(self, reader, groups, request, query) -> "Iterator[bytes]":
         import io as _io
@@ -1109,11 +1227,13 @@ class ParquetVectorPlan:
                 lambda nd: self._leaf(nd, cols, data, n_rows, ev, row_of))
             mask = v & k
             if ev.is_aggregate:
-                # Sequential accumulation over the surviving rows — the
-                # row engine's arithmetic and order exactly; the columns
-                # only decided WHO survives.
-                for ri in np.nonzero(mask)[0]:
-                    ev.accumulate(row_of(int(ri)))
+                # Vectorized accumulation when provably bit-identical to
+                # the row engine (typed chunks, no NaN, no >2^53 ints:
+                # np.cumsum IS the sequential float chain); otherwise the
+                # exact row-by-row path.
+                if not self._accumulate_fast(ev, data, mask):
+                    for ri in np.nonzero(mask)[0]:
+                        ev.accumulate(row_of(int(ri)))
                 continue
             for ri in np.nonzero(mask)[0]:
                 out = ev.project(row_of(int(ri)))

@@ -2257,4 +2257,111 @@ int64_t mtpu_decode_part(const char* const* paths, const uint8_t* avail,
   }
 }
 
+// ---------------------------------------------------------------------------
+// Parquet column-chunk decode kernels (pkg/s3select/internal/parquet-go
+// role): the per-value hot loops of the reader — RLE/bit-packed hybrid
+// runs (definition levels, dictionary indices), PLAIN BYTE_ARRAY offset
+// scanning, and boolean bit unpack. Page-header thrift parsing stays in
+// Python (a handful of structs per megabyte); these loops run per VALUE.
+// ---------------------------------------------------------------------------
+
+int64_t mtpu_pq_rle_bp(const uint8_t* buf, uint64_t len, uint32_t bit_width,
+                       uint64_t count, uint32_t* out) {
+  // Parquet RLE/bit-packed hybrid: <varint header>(lsb: 1=bit-packed
+  // groups-of-8, 0=RLE run) repeated until `count` values. Returns values
+  // decoded (count on success; missing tail zero-fills, matching the
+  // tolerant Python decoder), or -1 on malformed varint.
+  if (bit_width > 32) return -1;  // file-controlled; >32 would be UB below
+  uint64_t pos = 0, n = 0;
+  const uint32_t byte_width = (bit_width + 7) / 8;
+  while (n < count && pos < len) {
+    uint64_t header = 0;
+    int shift = 0;
+    for (;;) {
+      if (pos >= len) goto done;  // truncated varint: zero-fill the tail
+      uint8_t b = buf[pos++];
+      header |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+      if (shift > 63) return -1;
+    }
+    if (header & 1) {  // bit-packed: (header>>1) groups of 8 values
+      uint64_t groups = header >> 1;
+      uint64_t avail_bytes = len - pos;
+      uint64_t want_bytes = groups * bit_width;  // groups*8*bw/8
+      uint64_t take_bytes = want_bytes < avail_bytes ? want_bytes
+                                                     : avail_bytes;
+      uint64_t vals = groups * 8;
+      if (vals > count - n) vals = count - n;
+      if (bit_width == 0) {
+        std::memset(out + n, 0, vals * sizeof(uint32_t));
+        n += vals;
+        pos += take_bytes;
+        continue;
+      }
+      uint64_t bitpos = 0;
+      const uint8_t* p = buf + pos;
+      uint64_t avail_bits = take_bytes * 8;
+      for (uint64_t i = 0; i < vals; ++i) {
+        uint32_t v = 0;
+        if (bitpos + bit_width <= avail_bits) {
+          // Little-endian bit order within the run.
+          uint64_t byte_i = bitpos >> 3;
+          uint32_t bit_o = bitpos & 7;
+          uint64_t window = 0;
+          uint32_t nb = (bit_o + bit_width + 7) / 8;
+          for (uint32_t bi = 0; bi < nb && byte_i + bi < take_bytes; ++bi)
+            window |= static_cast<uint64_t>(p[byte_i + bi]) << (8 * bi);
+          v = static_cast<uint32_t>((window >> bit_o)
+                                    & ((1ULL << bit_width) - 1));
+        }
+        out[n + i] = v;
+        bitpos += bit_width;
+      }
+      n += vals;
+      pos += take_bytes;
+    } else {  // RLE run: one value repeated (header>>1) times
+      uint64_t run = header >> 1;
+      uint32_t v = 0;
+      for (uint32_t bi = 0; bi < byte_width && pos + bi < len; ++bi)
+        v |= static_cast<uint32_t>(buf[pos + bi]) << (8 * bi);
+      pos += byte_width;
+      if (run > count - n) run = count - n;
+      for (uint64_t i = 0; i < run; ++i) out[n + i] = v;
+      n += run;
+    }
+  }
+done:
+  while (n < count) out[n++] = 0;  // truncated stream: zero-fill
+  return static_cast<int64_t>(n);
+}
+
+int64_t mtpu_pq_plain_byte_array(const uint8_t* buf, uint64_t len,
+                                 uint64_t count, uint64_t* starts,
+                                 uint32_t* lens) {
+  // PLAIN BYTE_ARRAY: count x [u32 length][bytes]. Emits each value's
+  // start offset and length within buf. Returns values decoded, or -1
+  // if a length prefix overruns the buffer (corrupt page).
+  uint64_t pos = 0;
+  for (uint64_t i = 0; i < count; ++i) {
+    if (pos + 4 > len) return -1;
+    uint32_t n = static_cast<uint32_t>(buf[pos]) |
+                 (static_cast<uint32_t>(buf[pos + 1]) << 8) |
+                 (static_cast<uint32_t>(buf[pos + 2]) << 16) |
+                 (static_cast<uint32_t>(buf[pos + 3]) << 24);
+    pos += 4;
+    if (pos + n > len) return -1;
+    starts[i] = pos;
+    lens[i] = n;
+    pos += n;
+  }
+  return static_cast<int64_t>(count);
+}
+
+void mtpu_pq_unpack_bools(const uint8_t* buf, uint64_t count,
+                          uint8_t* out) {
+  for (uint64_t i = 0; i < count; ++i)
+    out[i] = (buf[i >> 3] >> (i & 7)) & 1;
+}
+
 }  // extern "C"

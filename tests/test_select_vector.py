@@ -410,3 +410,64 @@ def test_parquet_bool_vs_string_literal():
                  "SELECT s.id FROM S3Object s WHERE s.flag <> 'True'"):
         vec, row = _both(blob, expr, input_format="PARQUET")
         assert vec == row, expr
+
+
+def _parquet_edge_blob():
+    from minio_tpu.s3select.parquet import write_parquet
+
+    rows = [
+        {"id": (1 << 53) + 3, "price": 1.5, "name": "café"},   # big int
+        {"id": -(1 << 53) - 7, "price": 2.5, "name": ""},      # empty str
+        {"id": 5, "price": None, "name": None},                # nulls
+        {"id": 6, "price": 0.25, "name": "plain"},
+        {"id": 7, "price": float("nan"), "name": "plain"},     # NaN
+        {"id": 8, "price": -1.75, "name": "x" * 40},
+    ]
+    schema = [("id", "int64"), ("price", "double"), ("name", "string")]
+    return write_parquet(rows, schema)
+
+
+@pytest.mark.parametrize("expr", [
+    # Big int64 beyond 2^53: fast accumulate must refuse; MIN/MAX exact.
+    "SELECT SUM(s.id), MIN(s.id), MAX(s.id) FROM S3Object s",
+    # NaN in the column: fast accumulate must refuse (min/max ordering).
+    "SELECT SUM(s.price), MIN(s.price) FROM S3Object s",
+    "SELECT COUNT(s.name), COUNT(s.price), COUNT(*) FROM S3Object s",
+    # Non-ASCII page: bytes-level eq must refuse; exact path decides.
+    "SELECT s.id FROM S3Object s WHERE s.name = 'café'",
+    "SELECT s.id FROM S3Object s WHERE s.name = ''",
+    "SELECT s.id FROM S3Object s WHERE s.name <> 'plain'",
+    "SELECT AVG(s.price) FROM S3Object s WHERE s.id >= 5",
+])
+def test_parquet_fastpath_edges_match_row_engine(expr):
+    blob = _parquet_edge_blob()
+    vec, row = _both(blob, expr, input_format="PARQUET")
+    assert vec == row, expr
+
+
+def test_parquet_int_minmax_stays_int():
+    """MIN/MAX over an int64 chunk must serialize as ints (the row
+    engine's element type), not floats from a widened array."""
+    from minio_tpu.s3select.parquet import write_parquet
+
+    rows = [{"v": i} for i in (5, -3, 42)]
+    blob = write_parquet(rows, [("v", "int64")])
+    vec, row = _both(blob, "SELECT MIN(s.v), MAX(s.v) FROM S3Object s",
+                     input_format="PARQUET")
+    assert vec == row
+    assert b"-3,42" in vec
+
+
+def test_parquet_string_eq_long_values():
+    """Values 128-255 bytes long put >=0x80 bytes in their length
+    prefixes — the bytes-level eq must still engage (prefix bytes are not
+    value bytes) and match the row engine."""
+    from minio_tpu.s3select.parquet import write_parquet
+
+    long_a = "a" * 200
+    rows = [{"k": long_a}, {"k": "b" * 150}, {"k": "short"}] * 5
+    blob = write_parquet(rows, [("k", "string")])
+    expr = f"SELECT COUNT(*) FROM S3Object s WHERE s.k = '{long_a}'"
+    vec, row = _both(blob, expr, input_format="PARQUET")
+    assert vec == row
+    assert b"\n5\n" in vec or b"5" in vec
