@@ -749,17 +749,23 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         reconstruct + block assembly in one GIL-released C++ call per
         window (native/mtpu_native.cc mtpu_decode_part — the reference's
         parallelReader + bitrot verify + ReconstructData,
-        cmd/erasure-decode.go:120-205). None -> Python/device path."""
+        cmd/erasure-decode.go:120-205). Remote drives join the same
+        window: their framed byte ranges prefetch over RPC (in parallel)
+        and feed the decoder as in-memory shards — readers stay
+        interface-uniform like the reference's (cmd/erasure-decode.go:
+        120-188), so one remote drive no longer demotes the whole GET to
+        the Python path. None -> Python/device path."""
         from minio_tpu.native import plane
 
         if (algo not in ("sip256", "highwayhash256") or length <= 0
                 or not plane.available()):
             return None
-        paths = _local_shard_paths(shuffled, bucket, rel)
+        paths, remotes = _shard_paths_mixed(shuffled, bucket, rel)
         if paths is None:
             return None
         k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
         bs = fi.erasure.block_size
+        n = k + m
 
         def gen():
             from concurrent.futures import ThreadPoolExecutor
@@ -767,6 +773,9 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             corrupt_seen = False
             dead: set[int] = set()  # fed forward so later windows never
             end = offset + length   # re-read a shard already known bad
+            # One open stream per remote shard for the whole GET (stat +
+            # open once, sequential ranged reads ride its readahead).
+            streams: dict[int, object] = {}
 
             def windows():
                 pos = offset
@@ -775,6 +784,56 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                                (pos // bs + plane.window_blocks(bs)) * bs)
                     yield pos, wend
                     pos = wend
+
+            def decode_window(pos, wend):
+                """One window with remote-shard escalation: start from the
+                data-first k selection; remote shards the selection needs
+                prefetch their framed range over RPC (in parallel); on
+                failures the selection widens until served or < k left."""
+                nonlocal corrupt_seen
+                mem: dict[int, bytes] = {}
+                lo, ln = plane.framed_range(k, bs, part.size, pos,
+                                            wend - pos)
+                while True:
+                    alive = [i for i in range(n) if i not in dead]
+                    if len(alive) < k:
+                        raise se.InsufficientReadQuorum(
+                            bucket, obj, "not enough live shards")
+                    need = [i for i in alive[:k]
+                            if remotes[i] is not None and i not in mem]
+                    if need:
+                        fetches = parallel_map([
+                            lambda i=i: _fetch_framed(
+                                remotes[i], bucket, rel, lo, ln,
+                                streams, i)
+                            for i in need])
+                        lost = False
+                        for i, blob in zip(need, fetches):
+                            if isinstance(blob, bytes):
+                                mem[i] = blob
+                            else:
+                                dead.add(i)
+                                lost = True
+                        if lost:
+                            continue  # re-select around the dead fetch
+                    skip = dead | {i for i in range(n)
+                                   if remotes[i] is not None
+                                   and i not in mem}
+                    data, states = plane.decode_range(
+                        paths, k, m, bs, part.size, pos, wend - pos,
+                        skip=skip, algorithm=algo, mem=mem)
+                    saw_fail = False
+                    for i, s in enumerate(states):
+                        if s < 0:
+                            dead.add(i)
+                            saw_fail = True
+                        if s == -2:
+                            corrupt_seen = True
+                    if data is not None:
+                        return data
+                    if not saw_fail:
+                        raise se.InsufficientReadQuorum(
+                            bucket, obj, "not enough live shards")
 
             # One-window read-ahead: window N+1 decodes (GIL-released C
             # call) in a worker while window N streams to the client —
@@ -788,30 +847,23 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     while nxt is not None:
                         pos, wend = nxt
                         if fut is None:
-                            fut = ex.submit(plane.decode_range, paths, k, m,
-                                            bs, part.size, pos, wend - pos,
-                                            skip=set(dead), algorithm=algo)
+                            fut = ex.submit(decode_window, pos, wend)
                         try:
-                            data, states = fut.result()
+                            data = fut.result()
                         except OSError as e:
                             raise se.FaultyDisk(
                                 f"native decode: {e}") from e
-                        for i, s in enumerate(states):
-                            if s < 0:
-                                dead.add(i)
-                            if s == -2:
-                                corrupt_seen = True
-                        if data is None:
-                            raise se.InsufficientReadQuorum(
-                                bucket, obj, "not enough live shards")
                         nxt = next(pending, None)
-                        fut = (ex.submit(plane.decode_range, paths, k, m,
-                                         bs, part.size, nxt[0],
-                                         nxt[1] - nxt[0], skip=set(dead),
-                                         algorithm=algo)
+                        fut = (ex.submit(decode_window, nxt[0], nxt[1])
                                if nxt is not None else None)
                         yield data
                 finally:
+                    for f in streams.values():
+                        try:
+                            f.close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                    streams.clear()
                     # One-shot heal trigger on any dead/corrupt shard seen
                     # (reference cmd/erasure-object.go:321-344).
                     if dead and self.mrf is not None:
@@ -1422,25 +1474,85 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
 
 def _local_shard_paths(drives: list[StorageAPI], vol: str,
                        rel: str) -> list[str] | None:
-    """Absolute shard-file paths when EVERY drive is local (unwrapping the
-    disk-ID decorator); None if any drive is remote/faulty-wrapped — the
-    native plane needs direct file access on all n drives."""
+    """Absolute shard-file paths when EVERY drive is local (unwrapping
+    ONLY the disk-ID decorator); None if any drive is remote or otherwise
+    wrapped — the native WRITE lanes (PUT fan-out, heal rebuild) need
+    direct file access on all n drives. The GET lane uses the mixed form
+    below instead."""
+    paths, remotes = _shard_paths_mixed(drives, vol, rel)
+    if paths is None or any(r is not None for r in remotes):
+        return None
+    return paths
+
+
+def _shard_paths_mixed(drives: list[StorageAPI], vol: str, rel: str
+                       ) -> tuple[list[str] | None, list[StorageAPI | None]]:
+    """(paths, remotes) for the mixed native GET lane: paths[i] is the
+    absolute shard path for a local drive ("" otherwise); remotes[i] is
+    the drive object for every NON-local position — those shards prefetch
+    their framed ranges through the drive's own read_file_stream, so any
+    wrapper (remote client, fault injector) keeps its per-call
+    interposition. (None, _) only when a local drive can't map the path
+    (invalid name)."""
     from minio_tpu.storage.idcheck import DiskIDChecker
     from minio_tpu.storage.local import LocalDrive
 
     paths: list[str] = []
+    remotes: list[StorageAPI | None] = []
     for d in drives:
-        # Unwrap ONLY the disk-ID decorator — any other wrapper (remote
-        # client, fault injector) must keep its per-call interposition,
-        # so its presence routes the stream to the Python path.
         base = d.inner if isinstance(d, DiskIDChecker) else d
-        if not isinstance(base, LocalDrive):
-            return None
+        if isinstance(base, LocalDrive):
+            try:
+                paths.append(base._file_path(vol, rel))
+                remotes.append(None)
+                continue
+            except se.StorageError:
+                return None, []
+        paths.append("")
+        remotes.append(d)
+    return paths, remotes
+
+
+def _fetch_framed(drive: StorageAPI, vol: str, rel: str, lo: int,
+                  ln: int, streams: dict | None = None,
+                  key: int | None = None) -> bytes | None:
+    """Fetch [lo, lo+ln) of a shard file through the drive's stream API
+    (ranged RPC for remote drives). None on any failure or short read —
+    the caller marks the shard dead and re-selects. When `streams` is
+    given, the open stream is cached under `key` across windows (one
+    stat/open per shard per GET instead of per window); a failed stream
+    is closed and evicted."""
+    f = streams.get(key) if streams is not None else None
+    opened = f is None
+    if f is None:
         try:
-            paths.append(base._file_path(vol, rel))
-        except se.StorageError:
+            f = drive.read_file_stream(vol, rel)
+        except (se.StorageError, OSError):
             return None
-    return paths
+        if streams is not None:
+            streams[key] = f
+    try:
+        f.seek(lo)
+        buf = bytearray()
+        while len(buf) < ln:
+            chunk = f.read(ln - len(buf))
+            if not chunk:
+                raise OSError("short read")
+            buf += chunk
+        if streams is None:
+            try:
+                f.close()
+            except Exception:  # noqa: BLE001
+                pass
+        return bytes(buf)
+    except (se.StorageError, OSError, ValueError):
+        if streams is not None:
+            streams.pop(key, None)
+        try:
+            f.close()
+        except Exception:  # noqa: BLE001
+            pass
+        return None
 
 
 def _clone_for_drive(fi: FileInfo, index: int) -> FileInfo:

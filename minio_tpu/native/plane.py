@@ -86,7 +86,8 @@ def _lib() -> dict | None:
             ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64,
             ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
             ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_int8)]
+            ctypes.POINTER(ctypes.c_int8),
+            ctypes.POINTER(ctypes.c_char_p)]
         dec.restype = ctypes.c_int64
         _fns = {"encode_part": enc, "decode_part": dec}
     return _fns
@@ -181,12 +182,34 @@ class PartEncoder:
         return [self._rc[i] < 0 for i in range(self.n)]
 
 
+def framed_range(k: int, block_size: int, part_size: int,
+                 offset: int, length: int) -> tuple[int, int]:
+    """(read_off, read_len): the shard-file byte range one decode window
+    touches — per block a [32-byte digest][chunk] record. Mirrors the C
+    decoder's math so the mixed local/remote lane can prefetch exactly
+    the framed bytes a remote shard contributes."""
+    S = (block_size + k - 1) // k
+    rec_full = 32 + S
+    nblocks = (part_size + block_size - 1) // block_size
+    last_len = part_size - (nblocks - 1) * block_size
+    first = offset // block_size
+    last = (offset + length - 1) // block_size
+    wblocks = last - first + 1
+
+    def chunk_len(b):
+        bl = last_len if b == nblocks - 1 else block_size
+        return (bl + k - 1) // k
+
+    return first * rec_full, (wblocks - 1) * rec_full + 32 + chunk_len(last)
+
+
 def decode_range(paths: list[str], k: int, m: int, block_size: int,
                  part_size: int, offset: int, length: int,
                  threads: int = 0,
                  skip: set[int] | None = None,
-                 algorithm: str = "sip256") -> tuple[bytes | None,
-                                                     list[int]]:
+                 algorithm: str = "sip256",
+                 mem: dict[int, bytes] | None = None
+                 ) -> tuple[bytes | None, list[int]]:
     """Serve [offset, offset+length) of a part from its shard files.
 
     Returns (data, shard_state) — data is None when fewer than k shards
@@ -208,10 +231,15 @@ def decode_range(paths: list[str], k: int, m: int, block_size: int,
     avail = bytes([0 if skip and i in skip else 1 for i in range(n)])
     state = (ctypes.c_int8 * n)()
     out = ctypes.create_string_buffer(length) if length else b""
+    mem_arr = None
+    if mem:
+        mem_arr = (ctypes.c_char_p * n)(
+            *[mem.get(i) for i in range(n)])
     rc = fns["decode_part"](
         cpaths, avail, k, m, block_size, part_size, gmat, algo, key,
         offset, length, threads or _threads(),
-        ctypes.cast(out, ctypes.c_void_p) if length else None, state)
+        ctypes.cast(out, ctypes.c_void_p) if length else None, state,
+        mem_arr)
     states = [state[i] for i in range(n)]
     if rc == -2:
         return None, states

@@ -2102,7 +2102,13 @@ int64_t mtpu_decode_part(const char* const* paths, const uint8_t* avail,
                          uint64_t part_size, const uint8_t* gmat, int algo,
                          const uint8_t* key32, uint64_t offset,
                          uint64_t length, int n_threads, uint8_t* out,
-                         int8_t* shard_state) {
+                         int8_t* shard_state,
+                         const uint8_t* const* mem_bufs) {
+  // mem_bufs (optional, may be NULL): mem_bufs[i] != NULL supplies shard
+  // i's framed bytes for EXACTLY the window's [read_off, read_off +
+  // read_len) range — the mixed local/remote GET lane prefetches remote
+  // shards over RPC and verifies/reconstructs them here alongside the
+  // local pread shards.
   const mtpu_digest_fn digest = digest_for(algo);
   if (!k || !block_size || offset + length > part_size) return -1;
   const uint32_t n = k + m;
@@ -2147,27 +2153,31 @@ int64_t mtpu_decode_part(const char* const* paths, const uint8_t* avail,
     auto read_verify = [&](uint32_t ci) {
       uint32_t i = chosen[ci];
       sbuf[ci].resize(read_len);
-      int fd = open(paths[i], O_RDONLY);
-      if (fd < 0) {
-        shard_state[i] = -1;
-        dead[i] = true;
-        failed.store(true);
-        return;
-      }
-      uint64_t got = 0;
-      while (got < read_len) {
-        ssize_t r = pread(fd, sbuf[ci].data() + got, read_len - got,
-                          read_off + got);
-        if (r < 0 && errno == EINTR) continue;  // signal: retry the read
-        if (r <= 0) break;  // r == 0 is EOF: a truly short shard file
-        got += static_cast<uint64_t>(r);
-      }
-      close(fd);
-      if (got != read_len) {
-        shard_state[i] = -1;
-        dead[i] = true;
-        failed.store(true);
-        return;
+      if (mem_bufs != nullptr && mem_bufs[i] != nullptr) {
+        std::memcpy(sbuf[ci].data(), mem_bufs[i], read_len);
+      } else {
+        int fd = open(paths[i], O_RDONLY);
+        if (fd < 0) {
+          shard_state[i] = -1;
+          dead[i] = true;
+          failed.store(true);
+          return;
+        }
+        uint64_t got = 0;
+        while (got < read_len) {
+          ssize_t r = pread(fd, sbuf[ci].data() + got, read_len - got,
+                            read_off + got);
+          if (r < 0 && errno == EINTR) continue;  // signal: retry
+          if (r <= 0) break;  // r == 0 is EOF: a truly short shard file
+          got += static_cast<uint64_t>(r);
+        }
+        close(fd);
+        if (got != read_len) {
+          shard_state[i] = -1;
+          dead[i] = true;
+          failed.store(true);
+          return;
+        }
       }
       uint8_t dig[kDigestLen];
       for (uint64_t b = first; b <= last; ++b) {
