@@ -26,6 +26,13 @@ from minio_tpu.s3.errors import S3Error
 ALGORITHM = "AWS4-HMAC-SHA256"
 STREAMING_PAYLOAD = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
 UNSIGNED_PAYLOAD = "UNSIGNED-PAYLOAD"
+# sha256("") — the reference's default payload hash for HEADER-signed
+# requests that omit x-amz-content-sha256 (getContentSha256Cksum,
+# cmd/signature-v4-utils.go:62; presigned requests default to
+# UNSIGNED-PAYLOAD instead). Generic SigV4 clients (curl --aws-sigv4)
+# sign bodyless requests with exactly this value and send no header.
+EMPTY_SHA256 = ("e3b0c44298fc1c149afbf4c8996fb924"
+                "27ae41e4649b934ca495991b7852b855")
 MAX_SKEW_SECONDS = 15 * 60
 
 
@@ -190,7 +197,7 @@ def verify_header_auth(
     _check_skew(amz_date)
     if not amz_date.startswith(auth.scope_date):
         raise S3Error("SignatureDoesNotMatch")
-    payload_hash = headers.get("x-amz-content-sha256", UNSIGNED_PAYLOAD)
+    payload_hash = headers.get("x-amz-content-sha256", EMPTY_SHA256)
     scope = f"{auth.scope_date}/{auth.region}/{auth.service}/aws4_request"
     canonical = _canonical_request(
         method, path, canonical_query(query_items), headers,
