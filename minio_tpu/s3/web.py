@@ -360,6 +360,15 @@ class WebAPI:
 
     async def upload(self, request: web.Request, bucket: str,
                      key: str) -> web.Response:
+        """Console upload endpoint. Single-shot PUT by default; large
+        files drive the multipart session protocol (the reference
+        browser's chunked uploads, browser/app/js/uploads):
+
+            POST ?action=initiate                 -> {"uploadId"}
+            PUT  ?uploadId=U&partNumber=N  (body) -> {"etag"}
+            POST ?action=complete  {"uploadId", "parts": [{n, etag}]}
+            POST ?action=abort     {"uploadId"}
+        """
         ident = self._identity_from(request)
         if ident is None:
             raise web.HTTPForbidden(text="invalid token")
@@ -368,14 +377,52 @@ class WebAPI:
         import asyncio
         import io
 
-        from minio_tpu.erasure.types import ObjectOptions
+        from minio_tpu.erasure.types import CompletePart, ObjectOptions
 
-        body = await request.read()
         loop = asyncio.get_running_loop()
+        # Multipart control requests carry application/json; the OBJECT's
+        # content type rides the ?ctype= query param on initiate (the
+        # single-shot path uses the request's own Content-Type).
+        ctype = (request.query.get("ctype")
+                 or request.headers.get("Content-Type",
+                                        "application/octet-stream"))
         opts = ObjectOptions(
             versioned=self.s._bucket_versioned(bucket),
-            user_defined={"content-type": request.headers.get(
-                "Content-Type", "application/octet-stream")})
+            user_defined={"content-type": ctype})
+        action = request.query.get("action", "")
+        upload_id = request.query.get("uploadId", "")
+        if action not in ("", "initiate", "complete", "abort"):
+            # An unknown action must never fall through to the whole-
+            # object PUT — a typo'd ?action=compelte would overwrite the
+            # object with the control request's JSON body.
+            raise web.HTTPBadRequest(text=f"unknown action {action!r}")
+        if action == "initiate":
+            uid = await loop.run_in_executor(
+                None, lambda: self.s.obj.new_multipart_upload(
+                    bucket, key, opts))
+            return web.json_response({"uploadId": uid})
+        if action in ("complete", "abort"):
+            doc = json.loads(await request.read() or b"{}")
+            uid = doc.get("uploadId") or upload_id
+            if action == "abort":
+                await loop.run_in_executor(
+                    None, lambda: self.s.obj.abort_multipart_upload(
+                        bucket, key, uid))
+                return web.json_response({})
+            parts = [CompletePart(int(p["partNumber"]), str(p["etag"]))
+                     for p in doc.get("parts", [])]
+            info = await loop.run_in_executor(
+                None, lambda: self.s.obj.complete_multipart_upload(
+                    bucket, key, uid, parts))
+            return web.json_response({"etag": info.etag})
+        body = await request.read()
+        if upload_id:
+            part_number = int(request.query.get("partNumber", "0"))
+            pi = await loop.run_in_executor(
+                None, lambda: self.s.obj.put_object_part(
+                    bucket, key, upload_id, part_number,
+                    io.BytesIO(body), len(body)))
+            return web.json_response({"etag": pi.etag})
         await loop.run_in_executor(
             None, lambda: self.s.obj.put_object(
                 bucket, key, io.BytesIO(body), len(body), opts))
@@ -404,11 +451,29 @@ class WebAPI:
         loop = asyncio.get_running_loop()
         info, stream = await loop.run_in_executor(
             None, lambda: self.s.obj.get_object(bucket, key))
-        resp = web.StreamResponse(status=200, headers={
-            "Content-Type": info.content_type or "application/octet-stream",
+        ctype = info.content_type or "application/octet-stream"
+        # Inline rendering (the console's preview pane) only for content
+        # types that cannot execute script, and even then sandboxed: the
+        # download URL lives on the console origin, so an inline HTML
+        # object would otherwise run attacker script with console reach.
+        inline = (request.query.get("inline") == "1"
+                  and (ctype.startswith(("image/", "video/", "audio/"))
+                       or ctype in ("text/plain", "application/json",
+                                    "application/pdf", "text/csv")))
+        disp = "inline" if inline else "attachment"
+        headers = {
+            "Content-Type": ctype,
             "Content-Length": str(info.size),
+            "X-Content-Type-Options": "nosniff",
             "Content-Disposition":
-                f'attachment; filename="{key.rsplit("/", 1)[-1]}"'})
+                f'{disp}; filename="{key.rsplit("/", 1)[-1]}"'}
+        if ctype != "application/pdf":
+            # Sandbox anything that could carry script (svg images, html
+            # downloads). PDFs are exempt: Chromium refuses to start its
+            # PDF viewer in a sandboxed context, and the viewer brings
+            # its own isolation.
+            headers["Content-Security-Policy"] = "sandbox"
+        resp = web.StreamResponse(status=200, headers=headers)
         await resp.prepare(request)
         it = iter(stream)
         while True:
