@@ -598,6 +598,29 @@ def bench_small_objects() -> dict:
             out.update(best)
         out["value"] = out["put_10KiB"]
         c.close()
+        # ObjectLayer-level ops/s — the reference benchmark's own
+        # semantics (cmd/object-api-putobject_test.go calls
+        # obj.PutObject directly, no HTTP): what the engine does when
+        # the wire protocol isn't the limit.
+        import io as _io
+
+        es = srv.obj
+        payload = os.urandom(10 << 10)
+        for i in range(50):
+            es.put_object("bench", f"lw{i}", _io.BytesIO(payload),
+                          len(payload))
+        n2 = 1500
+        t0 = time.perf_counter()
+        for i in range(n2):
+            es.put_object("bench", f"lo{i}", _io.BytesIO(payload),
+                          len(payload))
+        out["layer_put_10KiB"] = round(n2 / (time.perf_counter() - t0), 1)
+        t0 = time.perf_counter()
+        for i in range(n2):
+            _info, it = es.get_object("bench", f"lo{i}")
+            for _ in it:
+                pass
+        out["layer_get_10KiB"] = round(n2 / (time.perf_counter() - t0), 1)
         return out
     finally:
         loop.call_soon_threadsafe(loop.stop)
