@@ -480,7 +480,7 @@ class HealingMixin:
                     if pos in errs else "/dev/null")
             try:
                 enc = plane.PartEncoder(dst_paths, k, m, codec.block_size,
-                                        algorithm=algo)
+                                        algorithm=algo, compute_md5=False)
                 for pos in range(n):
                     # Pre-fail non-targets AND targets already lost on an
                     # earlier part — no point re-framing onto a dead tmp.

@@ -113,7 +113,7 @@ class PartEncoder:
 
     def __init__(self, paths: list[str], k: int, m: int, block_size: int,
                  do_sync: bool = True, threads: int = 0,
-                 algorithm: str = "sip256"):
+                 algorithm: str = "sip256", compute_md5: bool = True):
         from minio_tpu.ops import gf
 
         self._l = _lib()
@@ -129,7 +129,12 @@ class PartEncoder:
             *[p.encode() for p in paths])
         pm = gf.parity_matrix(k, m) if m else None
         self._pmat = bytes(pm.tobytes()) if pm is not None else b"\x00"
-        self._md5_h = (ctypes.c_uint32 * 4)(*_MD5_INIT)
+        # compute_md5=False skips the segment md5 thread in C entirely
+        # (heal re-frames shards and never reads an ETag; md5 would be
+        # ~40% of single-core heal wall time).
+        self._md5 = compute_md5
+        self._md5_h = ((ctypes.c_uint32 * 4)(*_MD5_INIT)
+                       if compute_md5 else None)
         self._md5_len = ctypes.c_uint64(0)
         self._md5_out = ctypes.create_string_buffer(16)
         self._rc = (ctypes.c_int8 * self.n)()
@@ -172,6 +177,8 @@ class PartEncoder:
 
     @property
     def md5_hex(self) -> str:
+        if not self._md5:
+            raise ValueError("encoder built with compute_md5=False")
         if not self._final:
             raise ValueError("md5 before finalize")
         return self._md5_out.raw.hex()

@@ -1942,9 +1942,19 @@ int64_t mtpu_encode_part(const uint8_t* data, uint64_t len, uint32_t k,
 
   // md5 runs in its own thread over the whole segment — overlapped with the
   // encode workers on multi-core hosts, timesliced on single-core ones.
-  std::thread md5_thr([&] {
-    md5_segment(md5_h, md5_len, data, len, finalize, out_md5);
-  });
+  // md5_h == NULL skips it entirely (the heal lane re-frames shards but
+  // never needs an ETag — md5 would be ~40% of single-core heal time).
+  std::thread md5_thr;
+  if (md5_h != nullptr)
+    md5_thr = std::thread([&] {
+      md5_segment(md5_h, md5_len, data, len, finalize, out_md5);
+    });
+  struct JoinGuard {
+    std::thread& t;
+    ~JoinGuard() {
+      if (t.joinable()) t.join();
+    }
+  } md5_join{md5_thr};
 
   // Raw malloc staging (vector::resize would zero-fill ~1.4x the input —
   // a pure waste, every byte is overwritten by the encode workers).
@@ -1959,10 +1969,7 @@ int64_t mtpu_encode_part(const uint8_t* data, uint64_t len, uint32_t k,
     for (uint32_t i = 0; i < n; ++i)
       if (drive_rc[i] >= 0) {
         bufs[i] = static_cast<uint8_t*>(malloc(file_bytes));
-        if (!bufs[i]) {
-          md5_thr.join();
-          return -1;
-        }
+        if (!bufs[i]) return -1;  // JoinGuard settles the md5 thread
       }
 
     unsigned hw = std::thread::hardware_concurrency();
@@ -2081,7 +2088,7 @@ int64_t mtpu_encode_part(const uint8_t* data, uint64_t len, uint32_t k,
   for (uint32_t i = 1; i < n; ++i) wts.emplace_back(write_drive, i);
   write_drive(0);
   for (auto& t : wts) t.join();
-  md5_thr.join();
+  if (md5_thr.joinable()) md5_thr.join();
   return 0;
 }
 
