@@ -1,0 +1,177 @@
+"""etcd-backed system-config store — the reference's pluggable external
+IAM/config backend (cmd/etcd.go:1-86, cmd/iam-etcd-store.go): federated
+deployments keep identity in a SHARED etcd cluster so every site sees the
+same users/policies, instead of each cluster's own drive-quorum store.
+
+Speaks etcd v3's gRPC-JSON gateway (`/v3/kv/range|put|deleterange`,
+`/v3/auth/authenticate`) over plain HTTP — keys/values travel base64 per
+the gateway contract. Implements exactly the SysConfigStore surface
+(read/write/delete/list_sys_config), so it drops into `IAMSys(store=...)`
+or `BucketMetadataSys` unchanged; sealing (SealedSysStore) layers on top
+the same way it does over the drive store.
+
+Change detection is poll-based: `watch()` compares the prefix's max
+mod_revision on an interval and fires the callback on movement — the
+role of the reference's etcd watch channel (iam-etcd-store.go watchIAM),
+chosen over the gateway's streaming watch for robustness across gateway
+versions.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+import time
+
+
+def _b64(s: bytes) -> str:
+    return base64.b64encode(s).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+class EtcdError(Exception):
+    pass
+
+
+def _range_end(key: bytes) -> bytes:
+    """etcd prefix-range end: the key's lexicographic successor at the
+    prefix level (increment the last byte below 0xff, dropping trailing
+    0xff bytes; all-0xff or empty means 'to the end' = b'\\x00' per the
+    gateway convention)."""
+    k = bytearray(key)
+    while k and k[-1] == 0xFF:
+        k.pop()
+    if not k:
+        return b"\x00"
+    k[-1] += 1
+    return bytes(k)
+
+
+class EtcdConfigStore:
+    def __init__(self, endpoint: str, prefix: str = "minio_tpu/config/",
+                 username: str = "", password: str = "",
+                 timeout: float = 10.0):
+        import requests
+
+        self.endpoint = endpoint.rstrip("/")
+        self.prefix = prefix
+        self.timeout = timeout
+        self._user, self._password = username, password
+        self._s = requests.Session()
+        if username:
+            self._authenticate()
+        self._watch_stop = threading.Event()
+        self._watch_thread: threading.Thread | None = None
+
+    def _authenticate(self) -> None:
+        r = self._s.post(f"{self.endpoint}/v3/auth/authenticate",
+                         json={"name": self._user,
+                               "password": self._password},
+                         timeout=self.timeout)
+        if r.status_code != 200:
+            raise EtcdError(f"etcd auth failed: HTTP {r.status_code}")
+        self._s.headers["Authorization"] = r.json()["token"]
+
+    def _call(self, path: str, doc: dict) -> dict:
+        import requests
+
+        try:
+            r = self._s.post(f"{self.endpoint}{path}", json=doc,
+                             timeout=self.timeout)
+            if r.status_code in (401, 403) and self._user:
+                # etcd simple tokens expire (~300 s default): re-auth
+                # once and retry — otherwise every IAM op fails until
+                # restart.
+                self._authenticate()
+                r = self._s.post(f"{self.endpoint}{path}", json=doc,
+                                 timeout=self.timeout)
+        except requests.RequestException as e:
+            # Typed: the watch loop survives transient outages, IAM ops
+            # surface a clean storage error instead of a transport trace.
+            raise EtcdError(f"etcd {path}: {e}") from e
+        if r.status_code != 200:
+            raise EtcdError(f"etcd {path}: HTTP {r.status_code} {r.text[:200]}")
+        return r.json()
+
+    def _key(self, path: str) -> bytes:
+        return (self.prefix + path).encode()
+
+    # ---- SysConfigStore surface ----
+
+    def read_sys_config(self, path: str) -> bytes:
+        from minio_tpu.utils import errors as se
+
+        doc = self._call("/v3/kv/range", {"key": _b64(self._key(path))})
+        kvs = doc.get("kvs") or []
+        if not kvs:
+            raise se.FileNotFound(path)
+        return _unb64(kvs[0].get("value", ""))
+
+    def write_sys_config(self, path: str, data: bytes) -> None:
+        self._call("/v3/kv/put", {"key": _b64(self._key(path)),
+                                  "value": _b64(data)})
+
+    def delete_sys_config(self, path: str) -> None:
+        self._call("/v3/kv/deleterange", {"key": _b64(self._key(path))})
+
+    def list_sys_config(self, prefix: str = "") -> list[str]:
+        key = self._key(prefix)
+        doc = self._call("/v3/kv/range", {
+            "key": _b64(key), "range_end": _b64(_range_end(key)),
+            "keys_only": True})
+        strip = len(self.prefix)
+        out = []
+        for kv in doc.get("kvs") or []:
+            k = _unb64(kv["key"]).decode()
+            out.append(k[strip:])
+        return sorted(out)
+
+    # ---- change detection (iam-etcd-store.go watchIAM role) ----
+
+    def _change_sig(self, prefix: str) -> tuple[int, int]:
+        """(max mod_revision, key count) under prefix: a put moves the
+        first component, a delete moves the second."""
+        key = self._key(prefix)
+        doc = self._call("/v3/kv/range", {
+            "key": _b64(key), "range_end": _b64(_range_end(key)),
+            "keys_only": True})
+        kvs = doc.get("kvs") or []
+        return (max((int(kv.get("mod_revision", 0)) for kv in kvs),
+                    default=0), len(kvs))
+
+    def watch(self, prefix: str, callback, interval: float = 5.0) -> None:
+        """Fire callback() whenever keys under prefix change (poll-based;
+        one background thread). The baseline is taken SYNCHRONOUSLY here:
+        a change landing between watch() and the first poll tick must
+        fire, not be absorbed into the baseline."""
+        try:
+            last = self._change_sig(prefix)
+        except EtcdError:
+            last = None
+
+        def loop():
+            nonlocal last
+            while not self._watch_stop.wait(interval):
+                try:
+                    cur = self._change_sig(prefix)
+                except EtcdError:
+                    continue
+                if last is not None and cur != last:
+                    try:
+                        callback()
+                    except Exception:  # noqa: BLE001
+                        pass
+                last = cur
+
+        self._watch_thread = threading.Thread(
+            target=loop, daemon=True, name="etcd-watch")
+        self._watch_thread.start()
+
+    def close(self) -> None:
+        self._watch_stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=2)
+        self._s.close()

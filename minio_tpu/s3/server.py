@@ -130,15 +130,45 @@ class S3Server:
         # (cmd/config-encrypted.go role); bucket metadata and scanner
         # state stay plaintext, matching the reference's scope.
         from minio_tpu.crypto.configcrypt import SealedSysStore
+        # Federated identity: MTPU_ETCD_ENDPOINT moves the IAM store to a
+        # shared etcd cluster (reference cmd/etcd.go + iam-etcd-store.go
+        # role) so every site sees the same users/policies; bucket
+        # metadata and scanner state stay on the drive-quorum store, the
+        # reference's scope. Sealing layers identically over either.
+        self._etcd = None
+        etcd_ep = os.environ.get("MTPU_ETCD_ENDPOINT", "")
+        iam_backing = store if has_store else None
+        if etcd_ep:
+            from minio_tpu.dist.etcdstore import EtcdConfigStore
+            self._etcd = EtcdConfigStore(
+                etcd_ep,
+                username=os.environ.get("MTPU_ETCD_USERNAME", ""),
+                password=os.environ.get("MTPU_ETCD_PASSWORD", ""))
+            iam_backing = self._etcd
+        # IAM alone federates over etcd; per-cluster config, bucket
+        # metadata, tiers and scanner state STAY on the drive-quorum
+        # store — sharing e.g. storageclass EC:N between a 4-drive and a
+        # 12-drive site would corrupt both.
         sealed = (SealedSysStore(store, credentials.secret_key)
                   if has_store else None)
+        sealed_iam = (SealedSysStore(iam_backing, credentials.secret_key)
+                      if iam_backing is not None else None)
         notify_bm = (notification_sys.invalidate_bucket_metadata
                      if notification_sys is not None else None)
         notify_iam = (notification_sys.reload_iam
                       if notification_sys is not None else None)
         self.bucket_meta = BucketMetadataSys(store, notify=notify_bm)
         self.iam = IAMSys(credentials.access_key, credentials.secret_key,
-                          store=sealed, notify=notify_iam)
+                          store=sealed_iam, notify=notify_iam)
+        if self._etcd is not None:
+            # Cross-cluster IAM changes land via the watch: another
+            # site's user add/REMOVE shows up here within the poll
+            # interval (iam-etcd-store.go watchIAM role). reload, not
+            # load: deletions must drop from memory too.
+            self._etcd.watch(
+                "iam/", self.iam.reload,
+                interval=float(os.environ.get(
+                    "MTPU_ETCD_WATCH_INTERVAL", "5")))
 
         # Eventing: durable per-target queues under a local spool dir
         # (reference pkg/event/target/queuestore.go).
