@@ -70,8 +70,26 @@ class DataScanner:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
 
+    def _cycle_pause(self) -> float:
+        """Pause between cycles: the live `scanner.cycle` config key when
+        EXPLICITLY set (admin config-set applies on the NEXT wait, like
+        the other scanner knobs), else the constructor interval. The
+        built-in default ("1m") does not override the deployment's
+        configured interval — only an operator's set does, mirroring the
+        configured-values-only rule the storage-class clamp follows."""
+        if self.config is not None:
+            from minio_tpu.admin.configkv import DEFAULTS
+            from minio_tpu.utils.dyntimeout import parse_duration
+
+            raw = self.config.get("scanner", "cycle") or ""
+            if raw and raw != DEFAULTS["scanner"]["cycle"]:
+                v = parse_duration(raw, self.interval)
+                if v > 0:
+                    return v
+        return self.interval
+
     def _loop(self) -> None:
-        while not self._stop.wait(self.interval):
+        while not self._stop.wait(self._cycle_pause()):
             try:
                 self.scan_once()
             except Exception:  # noqa: BLE001 - scanner must never die

@@ -363,3 +363,20 @@ def test_scanner_bitrotscan_config_drives_deep_heal(tmp_path, monkeypatch):
     cfg.set_kv("heal", {"bitrotscan": "on"})
     scanner.scan_once()  # deep verify: corruption found and rebuilt
     assert open(shard, "rb").read() != bytes(blob)
+
+
+def test_scanner_cycle_config_key_live(tmp_path):
+    """scanner.cycle set by the operator overrides the constructor
+    interval on the next wait; the BUILT-IN default must not (the CLI
+    interval wins over an untouched config)."""
+    from minio_tpu.admin.configkv import ConfigSys
+    from minio_tpu.scanner.scanner import DataScanner
+
+    cfg = ConfigSys(None)
+    sc = DataScanner(object_layer=None, bucket_meta=None,
+                     interval=0.25, config=cfg)
+    assert sc._cycle_pause() == 0.25  # untouched config: CLI wins
+    cfg.set_kv("scanner", {"cycle": "2m"})
+    assert sc._cycle_pause() == 120.0
+    cfg.set_kv("scanner", {"cycle": "1m"})  # back to the default literal
+    assert sc._cycle_pause() == 0.25
