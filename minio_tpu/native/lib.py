@@ -399,6 +399,48 @@ def csv_parse_floats(data: bytes, foff, flen, quote: bytes = b'"'):
     return out
 
 
+# --- CPython C-API companion (object-creating fast paths) --------------------
+
+_PYEXT = "unset"
+
+
+def pyext():
+    """The mtpu_pyext extension module (built by native/Makefile), or None
+    — callers keep a pure-Python fallback, like every native lane. The
+    .so is matched by THIS interpreter's exact ABI suffix (a wrong-ABI
+    leftover must not load), rebuilt when the source is newer, and the
+    whole init is locked like _build_and_load (concurrent first-touch
+    must not race two makes onto one output file)."""
+    global _PYEXT
+    if _PYEXT != "unset":
+        return _PYEXT
+    with _mu:
+        if _PYEXT != "unset":
+            return _PYEXT
+        _PYEXT = None
+        try:
+            import importlib.util
+            import sysconfig
+
+            so = os.path.join(
+                _REPO_NATIVE,
+                "mtpu_pyext" + sysconfig.get_config_var("EXT_SUFFIX"))
+            src = os.path.join(_REPO_NATIVE, "mtpu_pyext.c")
+            if (not os.path.exists(so)
+                    or os.path.getmtime(so) < os.path.getmtime(src)):
+                subprocess.run(["make", "-C", _REPO_NATIVE], check=True,
+                               capture_output=True, timeout=120)
+            if os.path.exists(so):
+                spec = importlib.util.spec_from_file_location(
+                    "mtpu_pyext", so)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+                _PYEXT = mod
+        except Exception:  # noqa: BLE001 - fallbacks cover every caller
+            _PYEXT = None
+        return _PYEXT
+
+
 # --- Parquet column-chunk decode kernels -------------------------------------
 
 def pq_rle_bp(buf: bytes, bit_width: int, count: int):

@@ -405,14 +405,23 @@ class DecodedColumn:
 
             if self._ba is not None:
                 page, base, starts, lens = self._ba
-                vals: list = []
-                ap = vals.append
-                for s, ln in zip(starts.tolist(), lens.tolist()):
-                    b = page[base + s: base + s + ln]
-                    try:
-                        ap(b.decode("utf-8"))
-                    except UnicodeDecodeError:
-                        ap(b)
+                ext = None
+                nlib = _native_pq()
+                if nlib is not None:
+                    ext = nlib.pyext()
+                if ext is not None:
+                    # One C loop building the str list (utf-8 decode with
+                    # bytes fallback — convert()'s exact contract).
+                    vals = ext.pq_strs(page, base, starts, lens)
+                else:
+                    vals = []
+                    ap = vals.append
+                    for s, ln in zip(starts.tolist(), lens.tolist()):
+                        b = page[base + s: base + s + ln]
+                        try:
+                            ap(b.decode("utf-8"))
+                        except UnicodeDecodeError:
+                            ap(b)
                 if self.np_present is not None:
                     out: list = [None] * self.n
                     for i, v in zip(
@@ -442,13 +451,14 @@ class DecodedColumn:
 
         page, base, starts, lens = self._ba
         arr = np.frombuffer(page, np.uint8, offset=base)
-        if arr.size:
-            # Non-ASCII check over VALUE bytes only — the 4-byte length
-            # prefixes legally carry >=0x80 bytes (any value 128-255
-            # chars long), which must not disable the fast path. Range
-            # sums over a cumulative high-bit count cover each value
-            # window without touching the prefixes.
-            hb = np.cumsum((arr & 0x80).astype(np.int64))
+        high = (arr & 0x80) if arr.size else None
+        if high is not None and high.any():
+            # High bytes exist somewhere. They may be legal: the 4-byte
+            # length prefixes carry >=0x80 for any value 128-255 chars
+            # long. Only then pay the precise per-value range check
+            # (cumsum of high-bit counts; value windows exclude the
+            # prefixes). The common all-ASCII page skips all of this.
+            hb = np.cumsum(high.astype(np.int64))
             s = starts.astype(np.int64)
             e = s + lens.astype(np.int64) - 1
             nonempty = lens > 0

@@ -1,0 +1,75 @@
+/* mtpu_pyext — CPython C-API companion to libmtpu_native: the pieces that
+ * MUST create Python objects to be fast (ctypes can only hand back flat
+ * buffers). First resident: Parquet BYTE_ARRAY materialization — build a
+ * list of str/bytes from (page, starts, lens) in one C loop instead of a
+ * per-value Python slice+decode (~3x on string-heavy Select paths).
+ *
+ * Built by native/Makefile (g++ links it against Python.h only — no
+ * pybind11); loaded lazily by minio_tpu/native/lib.py with a pure-Python
+ * fallback, like every other native lane. */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <stdint.h>
+
+/* pq_strs(page: bytes, base: int, starts: buffer u64[n], lens: buffer
+ * u32[n]) -> list[str|bytes]: utf-8 decode each value, falling back to
+ * bytes for invalid utf-8 (the reader's convert() contract). */
+static PyObject *pq_strs(PyObject *self, PyObject *args) {
+  Py_buffer page, starts, lens;
+  Py_ssize_t base;
+  if (!PyArg_ParseTuple(args, "y*ny*y*", &page, &base, &starts, &lens))
+    return NULL;
+  PyObject *out = NULL;
+  const uint64_t *st = (const uint64_t *)starts.buf;
+  const uint32_t *ln = (const uint32_t *)lens.buf;
+  Py_ssize_t n = starts.len / (Py_ssize_t)sizeof(uint64_t);
+  if (lens.len / (Py_ssize_t)sizeof(uint32_t) != n) {
+    PyErr_SetString(PyExc_ValueError, "starts/lens length mismatch");
+    goto done;
+  }
+  out = PyList_New(n);
+  if (!out) goto done;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    Py_ssize_t off = base + (Py_ssize_t)st[i];
+    Py_ssize_t l = (Py_ssize_t)ln[i];
+    if (off < 0 || off + l > page.len) {
+      Py_CLEAR(out);
+      PyErr_SetString(PyExc_ValueError, "value range beyond page");
+      goto done;
+    }
+    const char *p = (const char *)page.buf + off;
+    PyObject *v = PyUnicode_DecodeUTF8(p, l, NULL);
+    if (!v) {
+      /* ONLY invalid utf-8 falls back to raw bytes (convert()'s
+       * contract); anything else (MemoryError...) must propagate. */
+      if (!PyErr_ExceptionMatches(PyExc_UnicodeDecodeError)) {
+        Py_CLEAR(out);
+        goto done;
+      }
+      PyErr_Clear();
+      v = PyBytes_FromStringAndSize(p, l);
+      if (!v) {
+        Py_CLEAR(out);
+        goto done;
+      }
+    }
+    PyList_SET_ITEM(out, i, v);
+  }
+done:
+  PyBuffer_Release(&page);
+  PyBuffer_Release(&starts);
+  PyBuffer_Release(&lens);
+  return out;
+}
+
+static PyMethodDef Methods[] = {
+    {"pq_strs", pq_strs, METH_VARARGS,
+     "Materialize Parquet BYTE_ARRAY values to a list of str/bytes."},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef Module = {PyModuleDef_HEAD_INIT, "mtpu_pyext",
+                                    NULL, -1, Methods};
+
+PyMODINIT_FUNC PyInit_mtpu_pyext(void) { return PyModule_Create(&Module); }
