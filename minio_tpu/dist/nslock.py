@@ -96,6 +96,17 @@ class NamespaceLockMap:
             if entry[1] <= 0:
                 del self._table[resource]
 
+    def rlock(self, bucket: str, obj: str, timeout: float = 30.0):
+        """Single-resource READ lock, the GET hot path: a plain __enter__/
+        __exit__ object instead of the generator contextmanager + sorted
+        multi-resource machinery (measurably cheaper at thousands of ops
+        per second). Distributed mode uses the general path — the dsync
+        RPC dominates there anyway."""
+        if self.distributed:
+            return self.lock(bucket, obj, timeout=timeout, readonly=True)
+        return _ReadLease(self, f"{bucket}/{obj}" if obj else bucket,
+                          timeout)
+
     @contextlib.contextmanager
     def lock(self, bucket: str, *objects: str, timeout: float = 30.0,
              readonly: bool = False) -> Iterator[None]:
@@ -136,3 +147,31 @@ class NamespaceLockMap:
                     lk.release_write()
             for res in referenced:
                 self._unref(res)
+
+
+class _ReadLease:
+    """Allocation-minimal context for one local read lock (see
+    NamespaceLockMap.rlock)."""
+
+    __slots__ = ("_map", "_res", "_timeout", "_lk")
+
+    def __init__(self, lock_map: NamespaceLockMap, resource: str,
+                 timeout: float):
+        self._map = lock_map
+        self._res = resource
+        self._timeout = timeout
+        self._lk = None
+
+    def __enter__(self):
+        lk = self._map._get(self._res)
+        if not lk.acquire_read(self._timeout):
+            self._map._unref(self._res)
+            raise se.OperationTimedOut(
+                "", self._res, f"lock timeout on {self._res}")
+        self._lk = lk
+        return self
+
+    def __exit__(self, *exc):
+        self._lk.release_read()
+        self._map._unref(self._res)
+        return False

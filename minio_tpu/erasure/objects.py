@@ -442,7 +442,9 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
     def get_object_info(self, bucket: str, obj: str,
                         opts: ObjectOptions | None = None) -> ObjectInfo:
         opts = opts or ObjectOptions()
-        fi = self._read_quorum_fileinfo(bucket, obj, opts.version_id)
+        # Same election-window read lock as get_object_reader.
+        with self.nslock.rlock(bucket, obj):
+            fi = self._read_quorum_fileinfo(bucket, obj, opts.version_id)
         if fi.deleted:
             if opts.version_id:
                 return self._fi_to_object_info(bucket, obj, fi)
@@ -463,7 +465,17 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         the quorum read twice (reference folds this into a single
         GetObjectNInfo reader, cmd/erasure-object.go:137)."""
         opts = opts or ObjectOptions()
-        fi = self._read_quorum_fileinfo(bucket, obj, opts.version_id)
+        # Read lock around the metadata election (reference GetObject
+        # takes the namespace RLock, cmd/erasure-object.go:176): a
+        # concurrent overwrite fans journals out drive by drive, and an
+        # unlocked reader can catch the set split 50/50 with NEITHER
+        # version reaching read quorum. Held for the election only —
+        # inline objects are then fully consistent (payload rides the
+        # elected journal); shard streams open after release, where the
+        # per-record bitrot framing turns any later mutation into a
+        # typed read error, never silent corruption.
+        with self.nslock.rlock(bucket, obj):
+            fi = self._read_quorum_fileinfo(bucket, obj, opts.version_id)
         if fi.deleted:
             raise se.ObjectNotFound(bucket, obj)
         info = self._fi_to_object_info(bucket, obj, fi)

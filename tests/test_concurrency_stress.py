@@ -192,3 +192,62 @@ def test_concurrent_readahead_streams_with_early_close(es):
     # Layer still fully serviceable after the havoc.
     _, it = es.get_object("bkt", "ra/stream")
     assert hashlib.sha256(b"".join(it)).hexdigest() == digest
+
+
+def test_concurrent_overwrite_read_cache_coherence(es):
+    """Hammer one key with overwrites from one thread while readers
+    race: every read must return SOME complete version's exact payload
+    (never a torn mix, never a stale-beyond-write value after quiesce).
+    Exercises the stat-validated journal cache + FileInfo memo under
+    contention."""
+    import io
+    import threading
+
+    es.make_bucket("coh")
+    payloads = [bytes([i]) * (1000 + i) for i in range(30)]
+    es.put_object("coh", "hot", io.BytesIO(payloads[0]), len(payloads[0]))
+    stop = threading.Event()
+    errors: list[str] = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            p = payloads[i % len(payloads)]
+            try:
+                es.put_object("coh", "hot", io.BytesIO(p), len(p))
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"write: {e}")
+                return
+            i += 1
+
+    def reader():
+        valid = set(payloads)
+        while not stop.is_set():
+            try:
+                _info, it = es.get_object("coh", "hot")
+                got = b"".join(it)
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"read: {e}")
+                return
+            if got not in valid:
+                errors.append(
+                    f"torn read: {len(got)} bytes, first={got[:1]!r}")
+                return
+
+    ths = [threading.Thread(target=writer)] + \
+          [threading.Thread(target=reader) for _ in range(3)]
+    for t in ths:
+        t.start()
+    import time as _t
+
+    _t.sleep(2.0)
+    stop.set()
+    for t in ths:
+        t.join(10)
+    assert not errors, errors[:3]
+    # Quiesced: a final write must be the one visible everywhere.
+    final = b"FINAL" * 999
+    es.put_object("coh", "hot", io.BytesIO(final), len(final))
+    for _ in range(5):
+        _info, it = es.get_object("coh", "hot")
+        assert b"".join(it) == final
