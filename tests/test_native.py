@@ -328,6 +328,27 @@ def test_serving_plane_under_tsan(tmp_path):
                         paths, k, m, bs, len(data), 0, len(data),
                         threads=4)
                     assert out == data
+                    # Mixed lane: one shard served from MEMORY (the RPC
+                    # prefetch shape) alongside file shards.
+                    lo, ln = plane.framed_range(k, bs, len(data),
+                                                0, len(data))
+                    blob = open(paths[1], "rb").read()[lo:lo + ln]
+                    out2, _ = plane.decode_range(
+                        paths, k, m, bs, len(data), 0, len(data),
+                        threads=4, mem={{1: blob}})
+                    assert out2 == data
+                    # Heal shape: re-frame ONLY shard 0, no md5 thread.
+                    heal_paths = list(paths)
+                    heal_paths[0] = paths[0] + ".heal"
+                    enc2 = plane.PartEncoder(heal_paths, k, m, bs,
+                                             threads=4, compute_md5=False)
+                    for i in range(1, k + m):
+                        enc2.fail_drive(i)
+                    enc2.feed(bytearray(data), final=True)
+                    assert not enc2.errors[0]
+                    # Parquet kernels from many threads.
+                    arr = nlib.pq_rle_bp(bytes([0x08, 0x01]), 1, 4)
+                    assert list(arr[:4]) == [1, 1, 1, 1]
                     # Fused Select scan from many threads concurrently.
                     from minio_tpu.native.lib import csv_agg_fused
                     r = csv_agg_fused(csv, b",", b'"', True, 1, 1,
