@@ -52,7 +52,7 @@ from minio_tpu.storage.api import StorageAPI
 from minio_tpu.storage.fileinfo import ChecksumInfo, ErasureInfo, FileInfo, PartInfo
 from minio_tpu.storage.xlmeta import XLMeta
 from minio_tpu.utils import errors as se
-from minio_tpu.utils.quorum import reduce_read_quorum, reduce_write_quorum
+from minio_tpu.utils.quorum import reduce_write_quorum
 
 _WRITE_SENTINEL = None
 
