@@ -494,10 +494,47 @@ def bench_degraded() -> dict:
             assert os.path.exists(p), "heal did not rebuild shard"
         _info, it = es.get_object("bench", "deg")
         assert b"".join(it) == payload
+        # Mixed local/remote GET: 4 of 16 drives served over the storage
+        # RPC (loopback) — the native lane prefetches their framed ranges
+        # into the same decode window (cmd/erasure-decode.go:120-188
+        # interface-uniform readers).
+        mixed = 0.0
+        try:
+            from minio_tpu.dist.rpc import RestClient
+            from minio_tpu.dist.server import NodeServer
+            from minio_tpu.dist.storage_remote import (
+                RemoteDrive,
+                storage_routes,
+            )
+
+            secret = "benchsecret0"
+            rpaths = [f"/rd{i}" for i in range(4)]
+            backing = {p: drives[12 + i] for i, p in enumerate(rpaths)}
+            node = NodeServer(secret=secret)
+            node.register_plane("storage", storage_routes(backing))
+            node.start()
+            client = RestClient(node.host, node.port, secret)
+            mixed_drives = drives[:12] + [RemoteDrive(client, p)
+                                          for p in rpaths]
+            es2 = ErasureObjects(mixed_drives, parity=4,
+                                 bitrot_algorithm="sip256")
+            _info, it = es2.get_object("bench", "deg")  # warm
+            assert sum(len(c) for c in it) == size
+            for _ in range(3):
+                t0 = time.perf_counter()
+                _info, it = es2.get_object("bench", "deg")
+                n = sum(len(c) for c in it)
+                mixed = max(mixed, n / (time.perf_counter() - t0))
+            es2.close()
+            client.close()
+            node.close()
+        except Exception as e:  # noqa: BLE001 - report, don't sink the config
+            log(f"mixed-remote GET leg failed: {e}")
         return {"metric": "get_degraded_2lost_16drive",
                 "value": round(best_get / (1 << 30), 3), "unit": "GiB/s",
                 "vs_baseline": 0.0,
                 "heal_e2e_gibs": round(size / heal_dt / (1 << 30), 3),
+                "get_mixed_4remote_gibs": round(mixed / (1 << 30), 3),
                 "healed_drives": res.healed_count}
     finally:
         shutil.rmtree(root, ignore_errors=True)
