@@ -139,6 +139,7 @@ class WebAPI:
             "PresignedGet": self._presigned_get,
             "GetBucketPolicy": self._get_bucket_policy,
             "SetBucketPolicy": self._set_bucket_policy,
+            "SetAuth": self._set_auth,
         }
         fn = handlers.get(short)
         if fn is None:
@@ -147,6 +148,8 @@ class WebAPI:
             result = await fn(ident, params)
         except (se.ObjectError, se.StorageError) as e:
             return _rpc_error(rid, 500, str(e))
+        except se.IAMError as e:
+            return _rpc_error(rid, 400, str(e))
         except PermissionError as e:
             return _rpc_error(rid, 403, str(e))
         return _rpc_result(rid, result)
@@ -234,6 +237,28 @@ class WebAPI:
                 ObjectOptions(versioned=self.s._bucket_versioned(bucket))))
         errors = [str(r) for r in results if isinstance(r, Exception)]
         return {"errors": errors}
+
+    async def _set_auth(self, ident, params):
+        """Change the LOGGED-IN user's own secret (reference console
+        ChangePasswordModal / web SetAuth): current secret re-verified,
+        root refused — the root credential is deployment configuration
+        (CLI/env), not a mutable IAM document. The session JWT stays
+        valid (it is signed by the server secret, not the user's)."""
+        import asyncio
+
+        current = str(params.get("currentSecretKey", ""))
+        new = str(params.get("newSecretKey", ""))
+        if ident.is_owner:
+            raise PermissionError(
+                "root credentials are set by deployment config")
+        if len(new) < 8 or len(new) > 40:
+            raise se.IAMError("secret key must be 8-40 characters")
+        if self.s.iam.get_secret(ident.access_key) != current:
+            raise PermissionError("current secret key is wrong")
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, self.s.iam.set_user, ident.access_key, new)
+        return {}
 
     async def _server_info(self, ident, params):
         return {"version": "minio_tpu/1.0",

@@ -378,3 +378,30 @@ def test_web_multipart_preserves_content_type(server):
     g = requests.get(base + res["result"]["url"] + "&inline=1")
     assert g.headers["Content-Type"] == "video/mp4"
     assert g.headers["Content-Disposition"].startswith("inline")
+
+
+def test_web_set_auth_changes_own_secret(server):
+    """An IAM user rotates their own secret through the console RPC:
+    wrong current secret 403s, root refused, new secret signs in."""
+    base, srv = server
+    srv.iam.set_user("webuser1", "firstsecret1")
+    tok = _login(base, "webuser1", "firstsecret1")
+    r = _rpc(base, "SetAuth", {"currentSecretKey": "WRONG",
+                               "newSecretKey": "secondsecret2"}, tok)
+    assert r["error"]["code"] == 403
+    r = _rpc(base, "SetAuth", {"currentSecretKey": "firstsecret1",
+                               "newSecretKey": "short"}, tok)
+    assert r["error"]["code"] == 400
+    r = _rpc(base, "SetAuth", {"currentSecretKey": "firstsecret1",
+                               "newSecretKey": "secondsecret2"}, tok)
+    assert "result" in r, r
+    # Old secret dead, new one lives.
+    bad = _rpc(base, "Login", {"username": "webuser1",
+                               "password": "firstsecret1"})
+    assert "error" in bad
+    assert _login(base, "webuser1", "secondsecret2")
+    # Root cannot rotate through the console.
+    rt = _login(base)
+    r = _rpc(base, "SetAuth", {"currentSecretKey": SECRET,
+                               "newSecretKey": "whatever123"}, rt)
+    assert r["error"]["code"] == 403
