@@ -248,12 +248,16 @@ class WebAPI:
 
         current = str(params.get("currentSecretKey", ""))
         new = str(params.get("newSecretKey", ""))
-        if ident.is_owner:
+        if ident.kind != "user":
+            # Root is deployment config; STS/service-account sessions
+            # must NOT mint a permanent IAM user under their (ephemeral)
+            # access key — set_user would outlive the credential.
             raise PermissionError(
-                "root credentials are set by deployment config")
+                "only IAM users can rotate their secret here")
         if len(new) < 8 or len(new) > 40:
             raise se.IAMError("secret key must be 8-40 characters")
-        if self.s.iam.get_secret(ident.access_key) != current:
+        if not hmac.compare_digest(
+                self.s.iam.get_secret(ident.access_key), current):
             raise PermissionError("current secret key is wrong")
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(

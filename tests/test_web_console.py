@@ -405,3 +405,15 @@ def test_web_set_auth_changes_own_secret(server):
     r = _rpc(base, "SetAuth", {"currentSecretKey": SECRET,
                                "newSecretKey": "whatever123"}, rt)
     assert r["error"]["code"] == 403
+
+
+def test_web_set_auth_refuses_temp_credentials(server):
+    """An STS/service session must NOT mint a permanent IAM user under
+    its ephemeral access key via SetAuth."""
+    base, srv = server
+    tc = srv.iam.assume_role("webroot", duration=900)
+    tok = _login(base, tc.access_key, tc.secret_key)
+    r = _rpc(base, "SetAuth", {"currentSecretKey": tc.secret_key,
+                               "newSecretKey": "permanent123"}, tok)
+    assert r["error"]["code"] == 403
+    assert tc.access_key not in srv.iam.users
