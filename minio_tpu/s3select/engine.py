@@ -14,11 +14,13 @@ import zlib
 import json
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass, field
+from datetime import datetime
 from typing import Iterator
 
 from minio_tpu.s3select import eventstream as es
 from minio_tpu.s3select import readers
 from minio_tpu.s3select.sql import MISSING, Evaluator, SelectError, parse
+from minio_tpu.s3select.timestamps import format_sql_timestamp
 
 RECORDS_FLUSH = 128 << 10     # flush a Records event at ~128 KiB
 
@@ -104,6 +106,22 @@ class S3SelectRequest:
         )
 
 
+def _json_default(v):
+    if isinstance(v, datetime):
+        return format_sql_timestamp(v)
+    return str(v)
+
+
+def _csv_cell(v):
+    if v in (None, MISSING):
+        return ""
+    if isinstance(v, datetime):
+        return format_sql_timestamp(v)
+    if isinstance(v, (list, dict)):     # JSONPath wildcard results
+        return json.dumps(v, default=_json_default)
+    return v
+
+
 def _serialize(row: dict, req: S3SelectRequest, header_order: list[str]) -> str:
     if req.output_format == "JSON":
         # Positional _N keys duplicate named CSV columns — prefer names.
@@ -111,13 +129,12 @@ def _serialize(row: dict, req: S3SelectRequest, header_order: list[str]) -> str:
                  if not (k.startswith("_") and k[1:].isdigit())}
         use = named if named else row
         clean = {k: (None if v is MISSING else v) for k, v in use.items()}
-        return json.dumps(clean, default=str) + "\n"
+        return json.dumps(clean, default=_json_default) + "\n"
     buf = io.StringIO()
     w = csv.writer(buf, delimiter=req.out_csv_delimiter,
                    lineterminator=req.out_record_delimiter)
     keys = header_order or list(row)
-    w.writerow(["" if row.get(k) in (None, MISSING) else row.get(k)
-                for k in keys])
+    w.writerow([_csv_cell(row.get(k)) for k in keys])
     return buf.getvalue()
 
 

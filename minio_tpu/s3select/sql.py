@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass, field
+from datetime import datetime, timezone
 from typing import Any
 
 MISSING = object()          # absent column (distinct from SQL NULL)
@@ -34,7 +35,7 @@ _TOKEN_RE = re.compile(r"""
       (?P<number>\d+\.\d*|\.\d+|\d+)
     | (?P<dqident>"(?:[^"]|"")*")
     | (?P<string>'(?:[^']|'')*')
-    | (?P<op><>|!=|<=|>=|\|\||[=<>(),.*/%+\-])
+    | (?P<op><>|!=|<=|>=|\|\||[=<>(),.*/%+\-\[\]])
     | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
     )""", re.VERBOSE)
 
@@ -46,6 +47,20 @@ _KEYWORDS = {
     "INT", "INTEGER", "FLOAT", "DECIMAL", "NUMERIC", "STRING", "BOOL",
     "BOOLEAN", "VARCHAR", "FOR",
 }
+
+# Timestamp function names stay out of _KEYWORDS so bare columns named
+# "timestamp"/"extract"/... remain addressable (same reasoning keeps the
+# time parts YEAR/MONTH/... contextual); primary() recognises these only
+# when directly followed by "(".
+_TSFUNCS = {"EXTRACT", "DATE_ADD", "DATE_DIFF", "UTCNOW", "TO_TIMESTAMP",
+            "TO_STRING"}
+
+# Time parts are NOT keywords (columns named "year" stay addressable);
+# EXTRACT/DATE_ADD/DATE_DIFF read the next word and validate against
+# these (reference parser.go:309,322,329 Timeword tokens).
+_EXTRACT_PARTS = {"YEAR", "MONTH", "DAY", "HOUR", "MINUTE", "SECOND",
+                  "TIMEZONE_HOUR", "TIMEZONE_MINUTE"}
+_ARITH_PARTS = {"YEAR", "MONTH", "DAY", "HOUR", "MINUTE", "SECOND"}
 
 
 @dataclass
@@ -95,6 +110,10 @@ class Lit:
 @dataclass
 class Col:
     name: str          # "" means whole record; "_N" positional
+    # JSONPath steps when the path has array index/wildcard or a
+    # trailing object wildcard (reference jsonpath.go:40-119); None for
+    # plain dotted paths, which keep the flat-dict fast resolution.
+    steps: tuple | None = None
 
 
 @dataclass
@@ -146,6 +165,7 @@ class Func:
     args: list
     star: bool = False          # COUNT(*)
     cast_type: str = ""         # CAST
+    part: str = ""              # EXTRACT / DATE_ADD / DATE_DIFF time part
 
 
 @dataclass
@@ -341,12 +361,16 @@ class Parser:
                 "CAST", "LOWER", "UPPER", "TRIM", "CHAR_LENGTH",
                 "CHARACTER_LENGTH", "SUBSTRING", "COALESCE", "NULLIF")):
             return self.func()
+        if (t.kind == "ident" and t.text.upper() in _TSFUNCS
+                and self.toks[self.i + 1].kind == "op"
+                and self.toks[self.i + 1].text == "("):
+            return self.func()
         if t.kind in ("ident",):
             return self.column()
         raise SelectError(f"unexpected {t.text!r}")
 
     def func(self):
-        name = self.next().text
+        name = self.next().text.upper()
         self.expect("op", "(")
         if name == "CAST":
             e = self.expr()
@@ -359,6 +383,28 @@ class Parser:
             f = Func("COUNT", [], star=True)
             self.aggs.append(f)
             return f
+        if name == "EXTRACT":
+            part = self._timeword(_EXTRACT_PARTS)
+            self.expect("kw", "FROM")
+            e = self.expr()
+            self.expect("op", ")")
+            return Func("EXTRACT", [e], part=part)
+        if name == "DATE_ADD":
+            part = self._timeword(_ARITH_PARTS)
+            self.expect("op", ",")
+            qty = self.expr()
+            self.expect("op", ",")
+            ts = self.expr()
+            self.expect("op", ")")
+            return Func("DATE_ADD", [qty, ts], part=part)
+        if name == "DATE_DIFF":
+            part = self._timeword(_ARITH_PARTS)
+            self.expect("op", ",")
+            t1 = self.expr()
+            self.expect("op", ",")
+            t2 = self.expr()
+            self.expect("op", ")")
+            return Func("DATE_DIFF", [t1, t2], part=part)
         if name == "SUBSTRING":
             args = [self.expr()]
             if self.accept("op", ","):
@@ -384,11 +430,60 @@ class Parser:
             self.aggs.append(f)
         return f
 
+    def _timeword(self, allowed: set[str]) -> str:
+        t = self.next()
+        part = t.text.upper()
+        if t.kind not in ("ident", "kw") or part not in allowed:
+            raise SelectError(f"bad time part {t.text!r}")
+        return part
+
     def column(self):
-        parts = [self.next().text]
-        while self.accept("op", "."):
-            parts.append(self.next().text)
-        return Col(".".join(parts))
+        steps: list[tuple] = [("key", self.next().text)]
+        complex_path = False
+        while True:
+            if self.accept("op", "."):
+                if self.accept("op", "*"):
+                    # Object wildcard: only meaningful as the final step
+                    # (reference jsonpath.go errWilcardObjectUsageInvalid);
+                    # a non-terminal use parses but resolves MISSING.
+                    steps.append(("objwild",))
+                    complex_path = True
+                    continue
+                t = self.next()
+                if t.kind not in ("ident", "kw"):
+                    raise SelectError(f"bad path segment {t.text!r}")
+                steps.append(("key", t.text))
+            elif self.accept("op", "["):
+                if self.accept("op", "*"):
+                    steps.append(("wild",))
+                else:
+                    idx = self.expect("number").text
+                    if not idx.isdigit():
+                        raise SelectError(f"array index must be an "
+                                          f"integer, got {idx}")
+                    steps.append(("idx", int(idx)))
+                self.expect("op", "]")
+                complex_path = True
+            else:
+                break
+        name = _render_path(steps)
+        if not complex_path:
+            return Col(name)
+        return Col(name, steps=tuple(steps))
+
+
+def _render_path(steps) -> str:
+    out: list[str] = []
+    for s in steps:
+        if s[0] == "key":
+            out.append(("." if out else "") + s[1])
+        elif s[0] == "idx":
+            out.append(f"[{s[1]}]")
+        elif s[0] == "wild":
+            out.append("[*]")
+        else:
+            out.append(".*")
+    return "".join(out)
 
 
 def parse(sql: str) -> Query:
@@ -417,12 +512,47 @@ def _num(v):
 
 
 def _cmp_pair(a, b):
-    """Comparison operands: numeric compare when both sides look numeric,
-    else string compare."""
+    """Comparison operands: timestamps compare as instants (a string
+    side parses through the SQL layout ladder), numeric compare when
+    both sides look numeric, else string compare."""
+    if isinstance(a, (list, dict)) or isinstance(b, (list, dict)):
+        # Wildcard-path results: the reference errors comparing array/
+        # object values (inferTypesForCmp); a silent always-False
+        # stringified compare would mask the mistake.
+        raise SelectError("cannot compare array or object value")
+    if isinstance(a, datetime) or isinstance(b, datetime):
+        ta = a if isinstance(a, datetime) else (
+            _ts.parse_sql_timestamp(str(a)))
+        tb = b if isinstance(b, datetime) else (
+            _ts.parse_sql_timestamp(str(b)))
+        if ta is None or tb is None:
+            # The reference errors comparing TIMESTAMP with a
+            # non-timestamp (inferTypesForCmp); never fall through to a
+            # meaningless lexicographic compare of a datetime repr.
+            other = b if tb is None else a
+            raise SelectError(
+                f"cannot compare timestamp with {other!r}")
+        return _aware(ta), _aware(tb)
     na, nb = _num(a), _num(b)
     if na is not None and nb is not None:
         return na, nb
     return str(a), str(b)
+
+
+def _aware(dt: datetime) -> datetime:
+    return dt if dt.tzinfo is not None else dt.replace(tzinfo=timezone.utc)
+
+
+def _as_timestamp(v):
+    """inferTypeAsTimestamp (reference value.go:725): datetimes pass,
+    strings parse through the layout ladder, anything else errors."""
+    if isinstance(v, datetime):
+        return _aware(v)
+    if isinstance(v, str):
+        t = _ts.parse_sql_timestamp(v)
+        if t is not None:
+            return t
+    raise SelectError(f"expected a timestamp, got {v!r}")
 
 
 def _like_to_re(pattern: str, escape: str | None) -> re.Pattern:
@@ -459,12 +589,23 @@ class Evaluator:
         if isinstance(node, Lit):
             return node.value
         if isinstance(node, Col):
+            if node.steps is not None:
+                return self._col_path(node, row)
             v = row.get(node.name, MISSING)
             if v is MISSING and "." in node.name:
                 # First segment may be the table alias (s.age): drop it;
                 # a remaining dotted path addresses nested JSON fields.
                 rest = node.name.split(".", 1)[1]
                 v = row.get(rest, MISSING)
+                if v is MISSING:
+                    # Depth>1 nesting isn't in the flat dict (readers
+                    # flatten one level): walk the nested dicts BEFORE
+                    # the loose last-segment guess, so a same-named
+                    # top-level column can't shadow the nested value.
+                    segs = node.name.split(".")
+                    v = _walk_keys(segs, row)
+                    if v is MISSING:
+                        v = _walk_keys(segs[1:], row)
                 if v is MISSING:
                     v = row.get(node.name.rsplit(".", 1)[-1], MISSING)
             return v
@@ -518,6 +659,17 @@ class Evaluator:
         if isinstance(node, Func):
             return self._func(node, row)
         raise SelectError(f"cannot evaluate {node!r}")
+
+    def _col_path(self, node: Col, row: dict):
+        """Resolve a JSONPath column (array index / wildcard steps) by
+        walking the nested row value (reference jsonpath.go:40-119).
+        The leading segment may be the table alias; retry without it,
+        mirroring the flat-dict fallback above."""
+        v = _walk_path(node.steps, row)
+        if v is MISSING and len(node.steps) > 1 \
+                and node.steps[0][0] == "key":
+            v = _walk_path(node.steps[1:], row)
+        return v
 
     def _binary(self, node: Binary, row: dict):
         op = node.op
@@ -602,6 +754,24 @@ class Evaluator:
         if name == "NULLIF":
             a, b = _cmp_pair(args[0], args[1])
             return None if a == b else args[0]
+        if name == "UTCNOW":
+            return datetime.now(timezone.utc)
+        if any(a is None for a in args):
+            return None         # NULL propagates through timestamp funcs
+        if name == "TO_TIMESTAMP":
+            return _as_timestamp(args[0])
+        if name == "TO_STRING":
+            return _ts.to_string(_as_timestamp(args[0]), str(args[1]))
+        if name == "EXTRACT":
+            return _ts.extract_part(node.part, _as_timestamp(args[0]))
+        if name == "DATE_ADD":
+            qty = _num(args[0])
+            if qty is None:
+                raise SelectError("DATE_ADD quantity must be numeric")
+            return _ts.date_add(node.part, qty, _as_timestamp(args[1]))
+        if name == "DATE_DIFF":
+            return _ts.date_diff(node.part, _as_timestamp(args[0]),
+                                 _as_timestamp(args[1]))
         raise SelectError(f"unknown function {name}")
 
     # -- aggregation --
@@ -616,7 +786,18 @@ class Evaluator:
                 continue
             st["count"] += 1
             n = _num(v)
-            if n is not None:
+            if n is None and isinstance(v, datetime):
+                d = _aware(v)
+                if st["min"] is not None \
+                        and not isinstance(st["min"], datetime):
+                    raise SelectError(
+                        "MIN/MAX over mixed timestamp and numeric values")
+                st["min"] = d if st["min"] is None else min(st["min"], d)
+                st["max"] = d if st["max"] is None else max(st["max"], d)
+            elif n is not None:
+                if isinstance(st["min"], datetime):
+                    raise SelectError(
+                        "MIN/MAX over mixed timestamp and numeric values")
                 st["sum"] += n
                 st["min"] = n if st["min"] is None else min(st["min"], n)
                 st["max"] = n if st["max"] is None else max(st["max"], n)
@@ -656,6 +837,56 @@ class Evaluator:
         return self.eval(self.q.where, row) is True
 
 
+def _walk_keys(segs, v):
+    """Plain key-chain walk through nested dicts; MISSING on any miss."""
+    for k in segs:
+        if not isinstance(v, dict) or k not in v:
+            return MISSING
+        v = v[k]
+    return v
+
+
+def _walk_path(steps, v):
+    """Walk JSONPath steps over a nested value (reference
+    jsonpath.go:40-119).  Lookup failures resolve to MISSING (the
+    engine's absent-column value; it serializes as null, matching the
+    reference's nil results); inside an array wildcard, failed elements
+    append null and nested wildcard lists flatten."""
+    val, _ = _walk_inner(tuple(steps), v)
+    return val
+
+
+def _walk_inner(steps, v):
+    if not steps:
+        return v, False
+    kind = steps[0][0]
+    if kind == "key":
+        if isinstance(v, dict) and steps[0][1] in v:
+            return _walk_inner(steps[1:], v[steps[0][1]])
+        return MISSING, False
+    if kind == "idx":
+        if isinstance(v, list) and steps[0][1] < len(v):
+            return _walk_inner(steps[1:], v[steps[0][1]])
+        return MISSING, False
+    if kind == "objwild":
+        # Valid only as the final step (errWilcardObjectUsageInvalid).
+        if isinstance(v, dict) and len(steps) == 1:
+            return v, False
+        return MISSING, False
+    # array wildcard: map the remainder over elements, flattening the
+    # results of nested wildcards, exactly as the reference does.
+    if not isinstance(v, list):
+        return MISSING, False
+    out = []
+    for a in v:
+        r, flat = _walk_inner(steps[1:], a)
+        if flat and isinstance(r, list):
+            out.extend(r)
+        else:
+            out.append(None if r is MISSING else r)
+    return out, True
+
+
 def _truthy(v) -> bool:
     if isinstance(v, bool):
         return v
@@ -687,6 +918,18 @@ def _cast(v, ty: str):
             if isinstance(v, str):
                 return v.lower() == "true"
             return bool(v)
+        if ty == "TIMESTAMP":
+            if isinstance(v, datetime):
+                return _aware(v)
+            t = _ts.parse_sql_timestamp(str(v))
+            if t is None:
+                raise SelectError(f"cannot CAST {v!r} to TIMESTAMP")
+            return t
     except (ValueError, TypeError):
         raise SelectError(f"cannot CAST {v!r} to {ty}") from None
     raise SelectError(f"unknown CAST type {ty}")
+
+
+# Bottom import: timestamps.py needs SelectError from this module, so it
+# cannot be imported before the class definitions above exist.
+from minio_tpu.s3select import timestamps as _ts  # noqa: E402

@@ -1,0 +1,270 @@
+"""S3 Select timestamp values (pkg/s3select/sql/timestampfuncs.go role).
+
+The dialect's timestamp literal grammar is a fixed ladder of layouts
+(year → nanosecond, reference timestampfuncs.go:23-40); values parse to
+timezone-aware datetimes and format back to the *shortest* layout that
+preserves the value (FormatSQLTimestamp, timestampfuncs.go:52-77).
+EXTRACT / DATE_ADD / DATE_DIFF mirror the reference's part semantics,
+including Go's truncating integer division for timezone parts and the
+calendar-normalising AddDate overflow behavior.
+
+Beyond the reference: TO_TIMESTAMP / TO_STRING actually evaluate here
+(funceval.go:140-142 leaves them errNotImplemented); TO_STRING uses the
+Ion-style pattern tokens AWS documents for S3 Select.
+"""
+
+from __future__ import annotations
+
+import re
+from datetime import datetime, timedelta, timezone
+
+from minio_tpu.s3select.sql import SelectError, _aware as _as_aware
+
+_UTC = timezone.utc
+
+# One regex per reference layout, tried in the reference's order.
+_ZONE = r"(?P<zone>Z|[+-]\d{2}:\d{2})"
+_LAYOUTS = [
+    re.compile(r"^(?P<y>\d{4})T$"),
+    re.compile(r"^(?P<y>\d{4})-(?P<mo>\d{2})T$"),
+    re.compile(r"^(?P<y>\d{4})-(?P<mo>\d{2})-(?P<d>\d{2})T$"),
+    re.compile(r"^(?P<y>\d{4})-(?P<mo>\d{2})-(?P<d>\d{2})T"
+               r"(?P<h>\d{2}):(?P<mi>\d{2})" + _ZONE + "$"),
+    re.compile(r"^(?P<y>\d{4})-(?P<mo>\d{2})-(?P<d>\d{2})T"
+               r"(?P<h>\d{2}):(?P<mi>\d{2}):(?P<s>\d{2})" + _ZONE + "$"),
+    re.compile(r"^(?P<y>\d{4})-(?P<mo>\d{2})-(?P<d>\d{2})T"
+               r"(?P<h>\d{2}):(?P<mi>\d{2}):(?P<s>\d{2})"
+               r"\.(?P<frac>\d{1,9})" + _ZONE + "$"),
+]
+
+
+def _parse_zone(z: str | None) -> timezone:
+    if not z or z == "Z":
+        return _UTC
+    sign = -1 if z[0] == "-" else 1
+    hh, mm = int(z[1:3]), int(z[4:6])
+    return timezone(sign * timedelta(hours=hh, minutes=mm))
+
+
+def parse_sql_timestamp(s: str) -> datetime | None:
+    """The reference's parseSQLTimestamp ladder; None when no layout fits."""
+    for rx in _LAYOUTS:
+        m = rx.match(s)
+        if not m:
+            continue
+        g = m.groupdict()
+        frac = g.get("frac") or ""
+        # Go keeps nanoseconds; datetime holds microseconds. Truncate —
+        # sub-microsecond digits are beyond what we can represent.
+        micro = int((frac + "000000")[:6]) if frac else 0
+        try:
+            return datetime(int(g["y"]), int(g.get("mo") or 1),
+                            int(g.get("d") or 1), int(g.get("h") or 0),
+                            int(g.get("mi") or 0), int(g.get("s") or 0),
+                            micro, _parse_zone(g.get("zone")))
+        except ValueError:
+            return None
+    return None
+
+
+def _zone_suffix(dt: datetime) -> str:
+    off = dt.utcoffset() or timedelta(0)
+    if not off:
+        return "Z"
+    total = int(off.total_seconds())
+    sign = "+" if total >= 0 else "-"
+    total = abs(total)
+    return f"{sign}{total // 3600:02d}:{(total % 3600) // 60:02d}"
+
+
+def format_sql_timestamp(dt: datetime) -> str:
+    """Shortest-layout display (FormatSQLTimestamp,
+    timestampfuncs.go:52-77)."""
+    off = dt.utcoffset()
+    has_zone = off is not None and off != timedelta(0)
+    has_frac = dt.microsecond != 0
+    has_second = dt.second != 0
+    has_time = dt.hour != 0 or dt.minute != 0
+    base = f"{dt.year:04d}-{dt.month:02d}-{dt.day:02d}T"
+    if has_frac:
+        frac = f"{dt.microsecond:06d}".rstrip("0")
+        return (base + f"{dt.hour:02d}:{dt.minute:02d}:{dt.second:02d}"
+                f".{frac}" + _zone_suffix(dt))
+    if has_second:
+        return (base + f"{dt.hour:02d}:{dt.minute:02d}:{dt.second:02d}"
+                + _zone_suffix(dt))
+    if has_time or has_zone:
+        return base + f"{dt.hour:02d}:{dt.minute:02d}" + _zone_suffix(dt)
+    if dt.day != 1:
+        return base
+    if dt.month != 1:
+        return f"{dt.year:04d}-{dt.month:02d}T"
+    return f"{dt.year:04d}T"
+
+
+def _trunc_div(a: int, b: int) -> int:
+    """Go's integer division truncates toward zero; Python's floors."""
+    q = abs(a) // b
+    return -q if a < 0 else q
+
+
+def extract_part(part: str, dt: datetime):
+    """EXTRACT(part FROM ts) — timestampfuncs.go:91-115."""
+    if part == "YEAR":
+        return dt.year
+    if part == "MONTH":
+        return dt.month
+    if part == "DAY":
+        return dt.day
+    if part == "HOUR":
+        return dt.hour
+    if part == "MINUTE":
+        return dt.minute
+    if part == "SECOND":
+        return dt.second
+    off = int((dt.utcoffset() or timedelta(0)).total_seconds())
+    if part == "TIMEZONE_HOUR":
+        return _trunc_div(off, 3600)
+    if part == "TIMEZONE_MINUTE":
+        return _trunc_div(off - _trunc_div(off, 3600) * 3600, 60)
+    raise SelectError(f"EXTRACT: unknown time part {part}")
+
+
+def date_add(part: str, qty: float, dt: datetime) -> datetime:
+    """DATE_ADD — timestampfuncs.go:117-135.  YEAR/MONTH/DAY follow Go's
+    AddDate: month overflow normalises forward (Jan 31 + 1 MONTH →
+    Mar 2/3), it does not clamp."""
+    n = int(qty)      # Go truncates the quantity to an integer count
+    try:
+        if part == "YEAR":
+            return _add_date(dt, n, 0, 0)
+        if part == "MONTH":
+            return _add_date(dt, 0, n, 0)
+        if part == "DAY":
+            return _add_date(dt, 0, 0, n)
+        if part == "HOUR":
+            return dt + timedelta(hours=n)
+        if part == "MINUTE":
+            return dt + timedelta(minutes=n)
+        if part == "SECOND":
+            return dt + timedelta(seconds=n)
+    except (ValueError, OverflowError):
+        # datetime's range is years 1–9999; anything past it must die
+        # as a clean Select error, not an unhandled 500 mid-stream.
+        raise SelectError(
+            f"DATE_ADD result out of range ({part} {n})") from None
+    raise SelectError(f"DATE_ADD: unknown time part {part}")
+
+
+def _add_date(dt: datetime, years: int, months: int, days: int) -> datetime:
+    """Go time.AddDate: add to the calendar fields, then normalise
+    overflow forward (day 31 in a 30-day month spills into the next)."""
+    y = dt.year + years
+    m = dt.month - 1 + months
+    y += m // 12
+    m = m % 12 + 1
+    base = datetime(y, m, 1, dt.hour, dt.minute, dt.second,
+                    dt.microsecond, dt.tzinfo)
+    return base + timedelta(days=dt.day - 1 + days)
+
+
+def date_diff(part: str, t1: datetime, t2: datetime) -> int:
+    """DATE_DIFF — timestampfuncs.go:141-183 (sign via swap+negate)."""
+    if _as_aware(t2) < _as_aware(t1):
+        return -date_diff(part, t2, t1)
+    a, b = _as_aware(t1), _as_aware(t2)
+    dur = b - a
+    if part == "YEAR":
+        dy = t2.year - t1.year
+        if (t2.month, t2.day) >= (t1.month, t1.day):
+            return dy
+        return dy - 1
+    if part == "MONTH":
+        return (t2.year * 12 + t2.month) - (t1.year * 12 + t1.month)
+    secs = int(dur.total_seconds())
+    if part == "DAY":
+        return secs // 86400
+    if part == "HOUR":
+        return secs // 3600
+    if part == "MINUTE":
+        return secs // 60
+    if part == "SECOND":
+        return secs
+    raise SelectError(f"DATE_DIFF: unknown time part {part}")
+
+
+_MONTHS = ["January", "February", "March", "April", "May", "June", "July",
+           "August", "September", "October", "November", "December"]
+
+_TOSTRING_TOKEN = re.compile(r"'(?:[^']|'')*'|y{1,4}|M{1,4}|d{1,2}|a"
+                             r"|h{1,2}|H{1,2}|m{1,2}|s{1,2}|S{1,6}|n"
+                             r"|X{1,5}|x{1,5}|.")
+
+
+def to_string(dt: datetime, pattern: str) -> str:
+    """TO_STRING(ts, pattern) with the Ion/AWS token set: y yyyy M MM MMM
+    MMMM d dd a h hh H HH m mm s ss S.. n X.. x.. and 'quoted' literals."""
+    out: list[str] = []
+    off = int((dt.utcoffset() or timedelta(0)).total_seconds())
+    hour12 = dt.hour % 12 or 12
+    for tok in _TOSTRING_TOKEN.findall(pattern):
+        if tok.startswith("'"):
+            out.append(tok[1:-1].replace("''", "'"))
+        elif tok in ("y", "yyy"):
+            out.append(str(dt.year))
+        elif tok == "yy":
+            out.append(f"{dt.year % 100:02d}")
+        elif tok == "yyyy":
+            out.append(f"{dt.year:04d}")
+        elif tok == "M":
+            out.append(str(dt.month))
+        elif tok == "MM":
+            out.append(f"{dt.month:02d}")
+        elif tok == "MMM":
+            out.append(_MONTHS[dt.month - 1][:3])
+        elif tok == "MMMM":
+            out.append(_MONTHS[dt.month - 1])
+        elif tok == "d":
+            out.append(str(dt.day))
+        elif tok == "dd":
+            out.append(f"{dt.day:02d}")
+        elif tok == "a":
+            out.append("AM" if dt.hour < 12 else "PM")
+        elif tok == "h":
+            out.append(str(hour12))
+        elif tok == "hh":
+            out.append(f"{hour12:02d}")
+        elif tok == "H":
+            out.append(str(dt.hour))
+        elif tok == "HH":
+            out.append(f"{dt.hour:02d}")
+        elif tok == "m":
+            out.append(str(dt.minute))
+        elif tok == "mm":
+            out.append(f"{dt.minute:02d}")
+        elif tok == "s":
+            out.append(str(dt.second))
+        elif tok == "ss":
+            out.append(f"{dt.second:02d}")
+        elif tok[0] == "S":
+            digits = len(tok)
+            out.append(f"{dt.microsecond:06d}"[:digits].ljust(digits, "0"))
+        elif tok == "n":
+            out.append(str(dt.microsecond * 1000))
+        elif tok[0] in ("X", "x"):
+            if off == 0 and tok[0] == "X":
+                out.append("Z")
+            else:
+                sign = "+" if off >= 0 else "-"
+                ao = abs(off)
+                if len(tok) == 1:
+                    out.append(f"{sign}{ao // 3600:02d}")
+                elif len(tok) in (2, 4):
+                    out.append(f"{sign}{ao // 3600:02d}"
+                               f"{(ao % 3600) // 60:02d}")
+                else:
+                    out.append(f"{sign}{ao // 3600:02d}:"
+                               f"{(ao % 3600) // 60:02d}")
+        else:
+            out.append(tok)
+    return "".join(out)

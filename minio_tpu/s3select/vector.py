@@ -97,12 +97,12 @@ _SWAP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "<>": "<>"}
 
 
 def _as_col(node) -> str | None:
-    if isinstance(node, Col) and node.name:
+    if isinstance(node, Col) and node.name and node.steps is None:
         return node.name
     if (isinstance(node, Func) and node.name == "CAST"
             and node.cast_type.upper() in _FLOAT_CASTS
             and len(node.args) == 1 and isinstance(node.args[0], Col)
-            and node.args[0].name):
+            and node.args[0].name and node.args[0].steps is None):
         # CAST(col AS FLOAT): identical numeric lane; non-numeric fields
         # go to the row fallback, which raises exactly as CAST does.
         return node.args[0].name
@@ -167,13 +167,15 @@ def compile_plan(query: Query, request) -> "VectorPlan | None":
         for f in query.aggregates:
             if not f.star and not (len(f.args) == 1
                                    and isinstance(f.args[0], Col)
-                                   and f.args[0].name):
+                                   and f.args[0].name
+                                   and f.args[0].steps is None):
                 return None
     else:
         for p in query.projections:
             if p.expr is None:
                 continue
-            if not (isinstance(p.expr, Col) and p.expr.name):
+            if not (isinstance(p.expr, Col) and p.expr.name
+                    and p.expr.steps is None):
                 return None
     return VectorPlan(query, where, request)
 
@@ -527,14 +529,16 @@ def compile_plan_json(query: Query, request) -> "JSONVectorPlan | None":
         for f in query.aggregates:
             if not f.star:
                 if not (len(f.args) == 1 and isinstance(f.args[0], Col)
-                        and f.args[0].name):
+                        and f.args[0].name
+                        and f.args[0].steps is None):
                     return None
                 cols.add(f.args[0].name)
     else:
         for p in query.projections:
             if p.expr is None:
                 continue
-            if not (isinstance(p.expr, Col) and p.expr.name):
+            if not (isinstance(p.expr, Col) and p.expr.name
+                    and p.expr.steps is None):
                 return None
     return JSONVectorPlan(query, where, request)
 
@@ -976,13 +980,15 @@ def compile_plan_parquet(query: Query, request) -> "ParquetVectorPlan | None":
         for f in query.aggregates:
             if not f.star and not (len(f.args) == 1
                                    and isinstance(f.args[0], Col)
-                                   and f.args[0].name):
+                                   and f.args[0].name
+                                   and f.args[0].steps is None):
                 return None
     else:
         for p in query.projections:
             if p.expr is None:
                 continue
-            if not (isinstance(p.expr, Col) and p.expr.name):
+            if not (isinstance(p.expr, Col) and p.expr.name
+                    and p.expr.steps is None):
                 return None
     return ParquetVectorPlan(query, where, request)
 
