@@ -411,6 +411,13 @@ class AdminAPI:
             except ValueError:
                 raise S3Error("InvalidArgument", "bad heal opts") from None
         dry = bool(opts.get("dryRun"))
+        # madmin HealOpts.ScanMode: "deep" verifies bitrot digests on
+        # every shard instead of trusting present-and-stat-clean files.
+        # The wire enum is an integer (HealDeepScan == 2, reference
+        # pkg/madmin/heal-commands.go:31); the string form is accepted
+        # for hand-written clients.
+        sm = opts.get("scanMode", "")
+        deep = sm == 2 or str(sm).lower() == "deep"
 
         def do() -> dict:
             items = []
@@ -419,7 +426,8 @@ class AdminAPI:
                     items.append(self.s.obj.heal_bucket(b.name, dry_run=dry))
             else:
                 items.append(self.s.obj.heal_bucket(bucket, dry_run=dry))
-                for r in self.s.obj.heal_objects(bucket, prefix, dry_run=dry):
+                for r in self.s.obj.heal_objects(bucket, prefix, dry_run=dry,
+                                                 scan_deep=deep):
                     items.append(r)
             return {"items": [_heal_item(i) for i in items]}
 

@@ -157,9 +157,15 @@ class ClusterNode:
             nslock = NamespaceLockMap(
                 distributed=distributed, lockers=self.lockers,
                 owner=f"{self.host}:{self.port}") if distributed else None
+            # Fresh-format leadership: only the node owning the pool's
+            # FIRST endpoint may mint a deployment id; everyone else
+            # retries until the leader's format lands (reference
+            # firstDisk gating in waitForFormatErasure).
             pools.append(ErasureSets(
                 drives, set_drive_count=pool.set_drive_count,
-                parity=self._parity, nslock=nslock, **set_kwargs))
+                parity=self._parity, nslock=nslock,
+                can_format_fresh=pool.endpoints[0].is_local,
+                **set_kwargs))
         self.object_layer = ErasureServerPools(pools)
         return self.object_layer
 

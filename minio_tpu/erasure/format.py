@@ -54,12 +54,19 @@ class FormatInfo:
 
 
 def init_format_erasure(
-    drives: list[StorageAPI], set_drive_count: int
+    drives: list[StorageAPI], set_drive_count: int,
+    can_format_fresh: bool = True,
 ) -> FormatInfo:
     """Read-or-create formats across all drives (reference
     waitForFormatErasure): fresh drives are formatted into the layout,
     existing formats are quorum-verified, and a minority of blank/replaced
-    drives is healed in place. Returns the elected FormatInfo."""
+    drives is healed in place. Returns the elected FormatInfo.
+
+    can_format_fresh: in a multi-node boot only the first-endpoint node
+    may mint a deployment id on an all-blank cluster; every other node
+    waits for the leader's format to appear (reference
+    waitForFormatErasure firstDisk gating, cmd/format-erasure.go —
+    concurrent minting would split the deployment identity)."""
     n = len(drives)
     if n % set_drive_count:
         raise ValueError(f"{n} drives not divisible into sets of {set_drive_count}")
@@ -73,6 +80,10 @@ def init_format_erasure(
     ]
 
     if not existing:
+        if not can_format_fresh:
+            raise se.OperationTimedOut(
+                "", "", "fresh cluster: waiting for the first node to "
+                "write the format")
         # Fresh cluster: mint deployment + drive UUIDs.
         fmt = FormatInfo(
             deployment_id=str(uuid.uuid4()),
@@ -98,6 +109,11 @@ def init_format_erasure(
         tally[key] = tally.get(key, 0) + 1
     (dep_id, sets_key), count = max(tally.items(), key=lambda kv: kv[1])
     if count <= len(existing) // 2:
+        if not can_format_fresh:
+            # Follower racing the leader's parallel format writes: the
+            # half-written layout is transient, not corruption. Retry.
+            raise se.OperationTimedOut(
+                "", "", "format quorum not yet visible; waiting")
         raise se.CorruptedFormat("no format quorum across drives")
     ref = FormatInfo(deployment_id=dep_id, sets=[list(s) for s in sets_key])
     if len(ref.sets) != set_count or any(

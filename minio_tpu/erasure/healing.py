@@ -186,6 +186,15 @@ class HealingMixin:
                 res.after[i].state = DRIVE_STATE_OK
             except se.StorageError:
                 pass
+        # The bucket's metadata doc lives in the mirrored sys store;
+        # reading it triggers that store's read-repair, converging copies
+        # lost/corrupted while a drive was away (this engine hosts the
+        # store only when it is the first set of the deployment).
+        if hasattr(self, "read_sys_config"):
+            try:
+                self.read_sys_config(f"buckets/{bucket}/metadata.mp")
+            except se.StorageError:
+                pass    # no doc (default config) or below quorum
         return res
 
     # -- object heal (reference healObject, cmd/erasure-healing.go:233) --
@@ -295,6 +304,12 @@ class HealingMixin:
         for pos, (drive, r) in enumerate(zip(shuffled_drives, shuffled_results)):
             if isinstance(r, (se.FileNotFound, se.FileVersionNotFound)):
                 states.append(DRIVE_STATE_MISSING)
+                checks.append(None)
+            elif isinstance(r, (se.FileCorrupt, se.CorruptedFormat)):
+                # Unreadable journal (CRC/decode failure) is damage to
+                # heal, not an offline drive (reference disksWithAllParts
+                # treats errFileCorrupt as heal-needing, never skips it).
+                states.append(DRIVE_STATE_CORRUPT)
                 checks.append(None)
             elif isinstance(r, Exception):
                 states.append(DRIVE_STATE_OFFLINE)

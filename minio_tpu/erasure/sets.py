@@ -41,11 +41,13 @@ class ErasureSets:
         parity: int | None = None,
         fmt: FormatInfo | None = None,
         enable_mrf: bool = False,
+        can_format_fresh: bool = True,
         **set_kwargs,
     ):
         set_drive_count = set_drive_count or len(drives)
         if fmt is None:
-            fmt = init_format_erasure(drives, set_drive_count)
+            fmt = init_format_erasure(drives, set_drive_count,
+                                      can_format_fresh=can_format_fresh)
             # Bind each drive to its slot UUID: a swapped/replugged disk
             # surfaces as DiskNotFound on the next guarded call
             # (cmd/xl-storage-disk-id-check.go:64 role).

@@ -26,7 +26,11 @@ class SysConfigStore:
 
     def read_sys_config(self, path: str) -> bytes:
         """Majority-elected content (drives can hold stale generations
-        after missing a write)."""
+        after missing a write), with read-repair: drives whose copy is
+        missing or diverges from the elected content get it rewritten
+        in-line, so config converges the way object heal converges shards
+        (the reference heals `.minio.sys` through the regular object-heal
+        path; this store's analogue is repair-on-read)."""
         rel = f"{CONFIG_PREFIX}/{path}"
         results = parallel_map(
             [lambda d=d: d.read_all(SYS_VOL, rel) for d in self.drives]
@@ -42,6 +46,22 @@ class SysConfigStore:
                 raise se.FileNotFound(path)
             raise se.InsufficientReadQuorum("", path, "no readable config copy")
         (count, data) = max(tally.values(), key=lambda v: v[0])
+        # Repair ONLY when the elected content holds a true write-quorum
+        # majority — a plurality elected among a minority of visible
+        # drives may be the OLD generation, and overwriting the newer
+        # copies with it would roll back an acknowledged write. Below the
+        # floor the read stays best-effort and repair waits for a
+        # healthier view.
+        if count >= self._write_quorum_meta():
+            lag = [d for d, r in zip(self.drives, results)
+                   if not (isinstance(r, (bytes, bytearray))
+                           and bytes(r) == data)
+                   and not isinstance(r, se.DiskNotFound)]
+            if lag:
+                # Best-effort: a drive that fails the repair write stays
+                # divergent and is retried on the next read.
+                parallel_map([lambda d=d: d.write_all(SYS_VOL, rel, data)
+                              for d in lag])
         return data
 
     def write_sys_config(self, path: str, data: bytes) -> None:

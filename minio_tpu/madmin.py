@@ -54,14 +54,15 @@ class AdminClient(RemoteS3Client):
     # -- heal --
 
     def heal(self, bucket: str = "", prefix: str = "",
-             dry_run: bool = False) -> dict:
+             dry_run: bool = False, deep: bool = False) -> dict:
         op = "heal"
         if bucket:
             op += f"/{bucket}"
             if prefix:
                 op += f"/{prefix}"
-        return self._admin_json("POST", op,
-                                body=json.dumps({"dryRun": dry_run}).encode())
+        # scanMode uses madmin's integer enum (HealDeepScan == 2).
+        body = {"dryRun": dry_run, "scanMode": 2 if deep else 1}
+        return self._admin_json("POST", op, body=json.dumps(body).encode())
 
     # -- config --
 

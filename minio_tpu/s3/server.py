@@ -2483,17 +2483,43 @@ def build_server(drive_paths: list[str], access_key: str, secret_key: str,
 
     if any("://" in p for p in drive_paths):
         from minio_tpu.dist.cluster import ClusterNode
+        from minio_tpu.logger import get_logger as _get_logger
 
         host, _, port = server_addr.rpartition(":")
         node = ClusterNode([drive_paths], host=host or "127.0.0.1",
                            port=int(port or 9000), secret=secret_key,
                            set_drive_count=set_drive_count or 0,
                            parity=parity, certs_dir=certs_dir)
-        node.wait_for_peers()
-        layer = node.build_object_layer(enable_mrf=enable_mrf)
-        srv = S3Server(layer, sigv4.Credentials(access_key, secret_key),
-                       versioned_buckets=versioned,
-                       notification_sys=node.notification)
+        # The reference retries cluster bootstrap until the fleet
+        # converges (verifyServerSystemConfig / waitForFormatErs loop)
+        # rather than dying when peers boot slowly or out of order; a
+        # node that crashed here would just be restarted by the
+        # supervisor anyway. Same for the first config/IAM quorum reads:
+        # peers may be seconds away from serving their drives.
+        boot_deadline = time.monotonic() + float(
+            os.environ.get("MTPU_BOOT_TIMEOUT", "600"))
+        while True:
+            layer = None
+            try:
+                node.wait_for_peers()
+                layer = node.build_object_layer(enable_mrf=enable_mrf)
+                srv = S3Server(layer,
+                               sigv4.Credentials(access_key, secret_key),
+                               versioned_buckets=versioned,
+                               notification_sys=node.notification)
+                break
+            except (se.OperationTimedOut, se.InsufficientReadQuorum,
+                    se.InsufficientWriteQuorum) as e:
+                if layer is not None:
+                    try:
+                        layer.close()
+                    except Exception:  # noqa: BLE001 — teardown only
+                        pass
+                if time.monotonic() > boot_deadline:
+                    raise
+                _get_logger().warning(
+                    f"boot: waiting for cluster quorum ({e}); retrying")
+                time.sleep(2.0)
         srv.attach_cluster(node)
         return srv
 
@@ -2572,6 +2598,16 @@ def main(argv=None):
                          "hot-reloaded); empty serves plaintext HTTP")
     args = ap.parse_args(argv)
     import sys as _sys
+
+    # Pin the JAX backend before first device use (the env var alone can
+    # be overridden by site hooks that force-register accelerator
+    # plugins). Cluster harness tests run many server processes on CPU;
+    # an accelerator is single-tenant and must not be grabbed by each.
+    plat = os.environ.get("MTPU_JAX_PLATFORM", "")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
 
     # Raise the fd soft limit to the hard limit (reference pkg/sys
     # setMaxResources) — a drive fleet + RPC fan-out outgrows the default

@@ -752,6 +752,9 @@ class Evaluator:
                     return a
             return None
         if name == "NULLIF":
+            if args[0] is None or args[1] is None:
+                return args[0]      # null operand: never equal (reference
+                # nullif returns v1 when either side is null)
             a, b = _cmp_pair(args[0], args[1])
             return None if a == b else args[0]
         if name == "UTCNOW":
@@ -792,6 +795,7 @@ class Evaluator:
                         and not isinstance(st["min"], datetime):
                     raise SelectError(
                         "MIN/MAX over mixed timestamp and numeric values")
+                st["ts"] = True     # SUM/AVG over timestamps must error
                 st["min"] = d if st["min"] is None else min(st["min"], d)
                 st["max"] = d if st["max"] is None else max(st["max"], d)
             elif n is not None:
@@ -808,6 +812,10 @@ class Evaluator:
             return st["count"]
         if st["count"] == 0:
             return None
+        if f.name in ("SUM", "AVG") and st.get("ts"):
+            # The untouched 0.0 accumulator would be a plausible-looking
+            # wrong answer; the reference errors summing timestamps.
+            raise SelectError(f"{f.name} over timestamp values")
         if f.name == "SUM":
             return st["sum"]
         if f.name == "AVG":

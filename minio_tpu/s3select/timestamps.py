@@ -134,8 +134,8 @@ def date_add(part: str, qty: float, dt: datetime) -> datetime:
     """DATE_ADD — timestampfuncs.go:117-135.  YEAR/MONTH/DAY follow Go's
     AddDate: month overflow normalises forward (Jan 31 + 1 MONTH →
     Mar 2/3), it does not clamp."""
-    n = int(qty)      # Go truncates the quantity to an integer count
     try:
+        n = int(qty)  # Go truncates the quantity to an integer count
         if part == "YEAR":
             return _add_date(dt, n, 0, 0)
         if part == "MONTH":
@@ -149,10 +149,11 @@ def date_add(part: str, qty: float, dt: datetime) -> datetime:
         if part == "SECOND":
             return dt + timedelta(seconds=n)
     except (ValueError, OverflowError):
-        # datetime's range is years 1–9999; anything past it must die
-        # as a clean Select error, not an unhandled 500 mid-stream.
+        # datetime's range is years 1–9999 (and qty may be inf/nan);
+        # anything past it must die as a clean Select error, not an
+        # unhandled 500 mid-stream.
         raise SelectError(
-            f"DATE_ADD result out of range ({part} {n})") from None
+            f"DATE_ADD result out of range ({part} {qty})") from None
     raise SelectError(f"DATE_ADD: unknown time part {part}")
 
 

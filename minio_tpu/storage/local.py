@@ -590,6 +590,12 @@ class LocalDrive(StorageAPI):
             meta = self._load_meta(dst_volume, dst_path)
         except se.FileNotFound:
             meta = XLMeta()
+        except (se.FileCorrupt, se.CorruptedFormat):
+            # Unreadable journal (CRC/decode failure): its version history
+            # is already lost — rebuild from the incoming version rather
+            # than wedging the commit (the reference's RenameData rewrites
+            # a corrupted destination xl.meta; heal re-adds the rest).
+            meta = XLMeta()
         # Replacing a null version: reclaim its data dir (exact-vid — see
         # write_metadata).
         try:

@@ -64,13 +64,14 @@ class SigV4Client:
 
     def request(self, method: str, path: str, query: dict | None = None,
                 headers: dict | None = None, data: bytes = b"",
-                allow_redirects: bool = True) -> requests.Response:
+                allow_redirects: bool = True,
+                timeout: float = 30) -> requests.Response:
         query = query or {}
         headers = dict(headers or {})
         signed = self._sign(method, path, query, headers, data)
         url = self.endpoint + urllib.parse.quote(path, safe="/-._~")
         return self.session.request(method, url, params=query, headers=signed,
-                                    data=data, timeout=30,
+                                    data=data, timeout=timeout,
                                     allow_redirects=allow_redirects)
 
     # convenience verbs

@@ -235,3 +235,31 @@ def test_heal_with_corrupt_survivor(er):
     res2 = er.heal_object("bkt", "obj", scan_deep=True)
     assert all(s.state == DRIVE_STATE_OK for s in res2.after)
     assert get_all(er, "obj") == DATA
+
+
+def test_heal_rebuilds_drive_with_corrupt_journal(er):
+    """A drive whose meta.mp itself is unreadable (CRC/decode failure)
+    classifies CORRUPT — not offline — and heal rewrites both the journal
+    and the shards (reference disksWithAllParts treats errFileCorrupt as
+    heal-needing; RenameData overwrites a corrupted destination meta)."""
+    put(er, "obj", DATA)
+    meta = os.path.join(er.drives[3].root, "bkt", "obj", "meta.mp")
+    raw = bytearray(open(meta, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(meta, "wb").write(bytes(raw))
+    # The shards on that drive too — nothing on it should survive.
+    corrupt_shard_on(er.drives[3], "bkt", "obj")
+
+    res = er.heal_object("bkt", "obj")
+    before = {s.endpoint: s.state for s in res.before}
+    assert before[er.drives[3].endpoint()] == DRIVE_STATE_CORRUPT
+    after = {s.endpoint: s.state for s in res.after}
+    assert after[er.drives[3].endpoint()] == DRIVE_STATE_OK
+
+    # The journal is readable again and carries the version.
+    fi = er.drives[3].read_version("bkt", "obj", "")
+    assert fi.size == len(DATA)
+    assert get_all(er, "obj") == DATA
+    # Deep re-verify: everything is clean.
+    res2 = er.heal_object("bkt", "obj", scan_deep=True)
+    assert all(s.state == DRIVE_STATE_OK for s in res2.after)

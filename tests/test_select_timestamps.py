@@ -275,6 +275,31 @@ def test_min_max_over_timestamps():
     assert out["lo"] == datetime(2010, 2, 3, tzinfo=UTC)
 
 
+def test_date_add_nonfinite_quantity_is_clean_error():
+    q = parse("SELECT DATE_ADD(day, s.x, TO_TIMESTAMP('2010T')) AS v "
+              "FROM S3Object s")
+    ev = Evaluator(q)
+    for bad in ("inf", "nan", "-inf"):
+        with pytest.raises(SelectError):
+            ev.project({"x": bad})
+
+
+def test_sum_avg_over_timestamps_errors():
+    for agg in ("SUM", "AVG"):
+        q = parse(f"SELECT {agg}(CAST(s.ts AS TIMESTAMP)) AS v "
+                  "FROM S3Object s")
+        ev = Evaluator(q)
+        ev.accumulate({"ts": "2010-02-03T"})
+        with pytest.raises(SelectError):
+            ev.project({})
+
+
+def test_nullif_with_null_operand_returns_first():
+    assert _eval_one("NULLIF(TO_TIMESTAMP('2010T'), NULL)") \
+        == datetime(2010, 1, 1, tzinfo=UTC)
+    assert _eval_one("NULLIF(NULL, 5)") is None
+
+
 def test_min_max_mixed_timestamp_numeric_errors():
     q = parse("SELECT MIN(s.v) AS m FROM S3Object s")
     ev = Evaluator(q)
