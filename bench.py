@@ -647,17 +647,25 @@ def bench_small_objects() -> dict:
             es.put_object("bench", f"lw{i}", _io.BytesIO(payload),
                           len(payload))
         n2 = 1500
-        t0 = time.perf_counter()
-        for i in range(n2):
-            es.put_object("bench", f"lo{i}", _io.BytesIO(payload),
-                          len(payload))
-        out["layer_put_10KiB"] = round(n2 / (time.perf_counter() - t0), 1)
-        t0 = time.perf_counter()
-        for i in range(n2):
-            _info, it = es.get_object("bench", f"lo{i}")
-            for _ in it:
-                pass
-        out["layer_get_10KiB"] = round(n2 / (time.perf_counter() - t0), 1)
+        # Best-of-2 like the HTTP phases: the layer loops share this
+        # host's single core with whatever else runs, and a background
+        # scheduling hiccup otherwise taxes the recorded number by 2-3x.
+        for rep in range(2):
+            t0 = time.perf_counter()
+            for i in range(n2):
+                es.put_object("bench", f"lo{rep}-{i}", _io.BytesIO(payload),
+                              len(payload))
+            out["layer_put_10KiB"] = max(
+                out.get("layer_put_10KiB", 0),
+                round(n2 / (time.perf_counter() - t0), 1))
+            t0 = time.perf_counter()
+            for i in range(n2):
+                _info, it = es.get_object("bench", f"lo{rep}-{i}")
+                for _ in it:
+                    pass
+            out["layer_get_10KiB"] = max(
+                out.get("layer_get_10KiB", 0),
+                round(n2 / (time.perf_counter() - t0), 1))
         return out
     finally:
         loop.call_soon_threadsafe(loop.stop)

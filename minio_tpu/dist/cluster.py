@@ -152,20 +152,31 @@ class ClusterNode:
 
         distributed = bool(self.peer_nodes)
         pools = []
-        for pool in self.pools_layout:
-            drives = [self.drive_for(ep) for ep in pool.endpoints]
-            nslock = NamespaceLockMap(
-                distributed=distributed, lockers=self.lockers,
-                owner=f"{self.host}:{self.port}") if distributed else None
-            # Fresh-format leadership: only the node owning the pool's
-            # FIRST endpoint may mint a deployment id; everyone else
-            # retries until the leader's format lands (reference
-            # firstDisk gating in waitForFormatErasure).
-            pools.append(ErasureSets(
-                drives, set_drive_count=pool.set_drive_count,
-                parity=self._parity, nslock=nslock,
-                can_format_fresh=pool.endpoints[0].is_local,
-                **set_kwargs))
+        try:
+            for pool in self.pools_layout:
+                drives = [self.drive_for(ep) for ep in pool.endpoints]
+                nslock = NamespaceLockMap(
+                    distributed=distributed, lockers=self.lockers,
+                    owner=f"{self.host}:{self.port}") if distributed else None
+                # Fresh-format leadership: only the node owning the pool's
+                # FIRST endpoint may mint a deployment id; everyone else
+                # retries until the leader's format lands (reference
+                # firstDisk gating in waitForFormatErasure).
+                pools.append(ErasureSets(
+                    drives, set_drive_count=pool.set_drive_count,
+                    parity=self._parity, nslock=nslock,
+                    can_format_fresh=pool.endpoints[0].is_local,
+                    **set_kwargs))
+        except Exception:
+            # A later pool failing (e.g. waiting on the format leader)
+            # must not leak earlier pools' worker threads across the
+            # caller's boot retries.
+            for p in pools:
+                try:
+                    p.close()
+                except Exception:  # noqa: BLE001 — teardown only
+                    pass
+            raise
         self.object_layer = ErasureServerPools(pools)
         return self.object_layer
 

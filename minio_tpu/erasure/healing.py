@@ -188,13 +188,12 @@ class HealingMixin:
                 pass
         # The bucket's metadata doc lives in the mirrored sys store;
         # reading it triggers that store's read-repair, converging copies
-        # lost/corrupted while a drive was away (this engine hosts the
-        # store only when it is the first set of the deployment).
-        if hasattr(self, "read_sys_config"):
-            try:
-                self.read_sys_config(f"buckets/{bucket}/metadata.mp")
-            except se.StorageError:
-                pass    # no doc (default config) or below quorum
+        # lost/corrupted while a drive was away. Sets that don't host the
+        # deployment's store simply have no doc and resolve FileNotFound.
+        try:
+            self.read_sys_config(f"buckets/{bucket}/metadata.mp")
+        except se.StorageError:
+            pass    # no doc (default config) or below quorum
         return res
 
     # -- object heal (reference healObject, cmd/erasure-healing.go:233) --

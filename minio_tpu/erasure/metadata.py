@@ -91,14 +91,20 @@ def parallel_map(fns: Sequence[Callable], max_workers: int | None = None,
     return results
 
 
+def election_sig(fi: FileInfo) -> tuple:
+    """The quorum election signature: drives agreeing on this tuple hold
+    the same logical version (findFileInfoInQuorum's comparison key,
+    cmd/erasure-metadata.go:124-155). ONE definition — the serial
+    early-exit read path and the full election must never diverge."""
+    return (round(fi.mod_time, 6), fi.data_dir, fi.version_id, fi.deleted)
+
+
 def find_fileinfo_in_quorum(fis: Sequence[object], quorum: int,
                             bucket: str, obj: str) -> FileInfo:
     """Elect the authoritative FileInfo: at least `quorum` drives must agree
     on (mod_time, data_dir, version). Reference findFileInfoInQuorum
     (cmd/erasure-metadata.go:124-155)."""
-    def sig(fi: FileInfo):
-        return (round(fi.mod_time, 6), fi.data_dir, fi.version_id, fi.deleted)
-
+    sig = election_sig
     counter = Counter(sig(fi) for fi in fis if isinstance(fi, FileInfo))
     if counter:
         best, count = counter.most_common(1)[0]

@@ -33,6 +33,7 @@ from minio_tpu.erasure.sysstore import SysConfigStore
 from minio_tpu.erasure.healing import HealingMixin, MRFHealer
 from minio_tpu.erasure.multipart import MultipartMixin
 from minio_tpu.erasure.metadata import (
+    election_sig,
     find_fileinfo_in_quorum,
     hash_order,
     parallel_map,
@@ -1456,10 +1457,41 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 bucket, obj, "precondition failed: object changed")
 
     def _read_quorum_fileinfo(self, bucket: str, obj: str, version_id: str) -> FileInfo:
-        results = parallel_map(
-            [lambda d=d: d.read_version(bucket, obj, version_id) for d in self.drives],
-            serial=self._serial_meta_reads,
-        )
+        if self._serial_meta_reads:
+            # All-local cached journal reads run sequentially; once a
+            # strict majority agrees on (mod_time, data_dir, version),
+            # the remaining drives cannot change the election — skip
+            # them (the shards they hold are addressed by the elected
+            # distribution, not by these metadata reads).
+            need = self.n // 2 + 1
+            results = []
+            tally: dict = {}
+            for d in self.drives:
+                try:
+                    r = d.read_version(bucket, obj, version_id)
+                except Exception as e:  # noqa: BLE001 — per-drive data
+                    r = e
+                results.append(r)
+                # Early exit only for live versions: a delete marker's
+                # read quorum depends on the geometry of the NON-deleted
+                # versions other drives may hold, which a partial read
+                # cannot know — markers always take the full election.
+                if isinstance(r, FileInfo) and not r.deleted:
+                    s = election_sig(r)
+                    tally[s] = tally.get(s, 0) + 1
+                    # The read quorum is this geometry's data_blocks,
+                    # which can exceed a bare majority (k > n/2+1 at low
+                    # parity) — stop only when both are satisfied.
+                    k = r.erasure.data_blocks or 0
+                    if tally[s] >= max(need, k):
+                        # This fi IS the quorum election — re-counting
+                        # through find_fileinfo_in_quorum adds nothing.
+                        return r
+        else:
+            results = parallel_map(
+                [lambda d=d: d.read_version(bucket, obj, version_id)
+                 for d in self.drives],
+            )
         if all(isinstance(r, se.FileNotFound) for r in results):
             raise se.ObjectNotFound(bucket, obj)
         if any(isinstance(r, se.FileVersionNotFound) for r in results) and not any(

@@ -52,6 +52,16 @@ class SysConfigStore:
         # copies with it would roll back an acknowledged write. Below the
         # floor the read stays best-effort and repair waits for a
         # healthier view.
+        #
+        # Racing a concurrent writer is safe under this floor: every
+        # drive this repair touches returned the NEW bytes, i.e. was read
+        # AFTER the writer reached it, and every drive backing the old
+        # election gets the writer's bytes after our read — so the new
+        # generation always keeps >= quorum copies (the repair set is
+        # bounded by n - quorum). Known narrow window: a read overlapping
+        # a concurrent delete_sys_config can re-create a just-deleted
+        # minority copy (no tombstones in this store); sys-config deletes
+        # are rare admin operations and the next delete sweeps it.
         if count >= self._write_quorum_meta():
             lag = [d for d, r in zip(self.drives, results)
                    if not (isinstance(r, (bytes, bytearray))

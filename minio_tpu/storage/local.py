@@ -69,7 +69,8 @@ class LocalDrive(StorageAPI):
         # XLMeta objects are only ever read (to_fileinfo); mutating paths
         # (write_metadata et al) parse fresh bytes.
         self._meta_cache: "OrderedDict[tuple[str, str], tuple]" = OrderedDict()
-        self._meta_cache_cap = 2048
+        self._meta_cache_cap = 16384
+        self._mpath_cache: dict[tuple[str, str], str] = {}
         self._meta_cache_lock = threading.Lock()
         # EWMA of journal-store duration (write+fsync+rename): lets the
         # object layer choose serial fan-out for metadata writes on media
@@ -342,7 +343,16 @@ class LocalDrive(StorageAPI):
     # ---------- versioned metadata ----------
 
     def _meta_path(self, volume: str, path: str) -> str:
-        return os.path.join(self._file_path(volume, path), META_FILE)
+        # Resolution is deterministic, so memoize: the split/validate/join
+        # chain is a quarter of a cached-journal read on the hot GET path.
+        key = (volume, path)
+        mp = self._mpath_cache.get(key)
+        if mp is None:
+            mp = os.path.join(self._file_path(volume, path), META_FILE)
+            if len(self._mpath_cache) >= self._meta_cache_cap * 2:
+                self._mpath_cache.clear()
+            self._mpath_cache[key] = mp
+        return mp
 
     def _load_meta(self, volume: str, path: str) -> XLMeta:
         try:
