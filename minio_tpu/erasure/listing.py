@@ -268,15 +268,10 @@ def paginate_objects(
                            objects=objects, prefixes=prefixes)
 
 
-def entries_from_journals(
-    journals,
-    to_info: Callable[[str, FileInfo], object],
-    cap: int = 0,
-) -> list[tuple[str, object]]:
-    """Render a journal map/stream into the sorted live-object entry
-    stream the metacache persists (cmd/metacache-stream.go role). cap > 0
-    bounds how much of a stream is rendered (partial metacache)."""
-    out = []
+def iter_entries_from_journals(journals, to_info):
+    """Lazy form of entries_from_journals — the metacache block renderer
+    consumes this incrementally (O(block) memory, cmd/metacache-stream.go
+    progressive-write role)."""
     for name, meta in _as_sorted_items(journals):
         try:
             fi = meta.to_fileinfo("", name, None)
@@ -284,10 +279,20 @@ def entries_from_journals(
             continue
         if fi.deleted:
             continue
-        out.append((name, to_info(name, fi)))
-        if cap and len(out) >= cap:
-            break
-    return out
+        yield name, to_info(name, fi)
+
+
+def iter_version_entries_from_journals(journals, to_info):
+    """Lazy version-stream form (delete markers included)."""
+    for name, meta in _as_sorted_items(journals):
+        try:
+            infos = [to_info(name, fi)
+                     for fi in meta.list_versions("", name)]
+        except se.StorageError:
+            continue
+        if infos:
+            yield name, infos
+
 
 
 def paginate_cached(
@@ -331,26 +336,6 @@ def paginate_cached(
                            next_marker=next_marker if truncated else "",
                            objects=objects, prefixes=prefixes)
 
-
-def version_entries_from_journals(
-    journals,
-    to_info: Callable[[str, FileInfo], object],
-    cap: int = 0,
-) -> list[tuple[str, list]]:
-    """Rendered version stream for the metacache: per name, every version
-    newest-first INCLUDING delete markers (versions listings show them)."""
-    out = []
-    for name, meta in _as_sorted_items(journals):
-        try:
-            infos = [to_info(name, fi)
-                     for fi in meta.list_versions("", name)]
-        except se.StorageError:
-            continue
-        if infos:
-            out.append((name, infos))
-        if cap and len(out) >= cap:
-            break
-    return out
 
 
 def paginate_versions_cached(

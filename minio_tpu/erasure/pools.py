@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import BinaryIO, Iterator
 
 from minio_tpu.erasure import listing
+from minio_tpu.erasure import metacache as metacache_mod
 from minio_tpu.erasure.healing import HealResultItem
 from minio_tpu.erasure.metadata import parallel_map
 from minio_tpu.erasure.sets import ErasureSets
@@ -39,10 +40,10 @@ class ErasureServerPools:
         if not pools:
             raise ValueError("no pools")
         self.pools = pools
-        from minio_tpu.erasure.metacache import Metacache
-        self.metacache = Metacache(self)
+        self.metacache = metacache_mod.Metacache(self)
 
     def close(self) -> None:
+        self.metacache.close()
         for p in self.pools:
             p.close()
 
@@ -271,29 +272,36 @@ class ErasureServerPools:
     def merged_journals(self, bucket: str, prefix: str) -> dict[str, XLMeta]:
         return dict(self.stream_journals(bucket, prefix))
 
-    # Bound on the rendered metacache stream: continuation pages within
-    # the cap seek the persisted stream; pages past it fall back to the
-    # streamed walk (the cache records its end). Keeps the cache itself
-    # O(cap), never O(namespace).
+    # Synchronous render bound: page 1 persists this many entries before
+    # returning (bounds page-1 latency); a daemon renderer continues the
+    # SAME walk up to METACACHE_MAX_STREAM in blocks, so sequential
+    # continuations ride the persisted stream while memory stays
+    # O(block) on both sides (cmd/metacache-stream.go progressive role).
     METACACHE_MAX_ENTRIES = 10_000
+    METACACHE_MAX_STREAM = 1_000_000
 
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
                      delimiter: str = "", max_keys: int = 1000) -> ListObjectsInfo:
         self.get_bucket_info(bucket)
         to_info = lambda name, fi: listing.fi_to_object_info(bucket, name, fi)  # noqa: E731
         # Continuation pages serve from the persisted metacache stream —
-        # the first page walked the namespace and saved it; the S3 marker
-        # doubles as the seek position (cmd/metacache-stream.go role).
+        # the first page walked the namespace and rendered it; the S3
+        # marker seeks into the block index (cmd/metacache-stream.go).
         if marker:
-            cached = self.metacache.load(bucket, prefix, marker)
+            cached = self.metacache.entries_from(bucket, prefix, marker)
             if cached is not None:
-                entries, end = cached
-                r = listing.paginate_cached(
-                    entries, prefix, marker, delimiter, max_keys)
-                if r.is_truncated or not end:
+                it, complete = cached
+                try:
+                    r = listing.paginate_cached(
+                        it, prefix, marker, delimiter, max_keys)
+                except metacache_mod.CacheGone:
+                    r = None
+                if r is not None and (r.is_truncated or complete):
                     return r
-                # Partial stream drained mid-page: names past `end` may
-                # exist — fall through to the walk for a correct page.
+                # Capped stream drained mid-page (or a block vanished):
+                # names past the rendered range may exist — fall through
+                # to the walk for a correct page.
+                self.metacache.misses += 1
         res = listing.paginate_objects(
             listing.pushdown_stream(
                 lambda sa: self.stream_journals(bucket, prefix, sa),
@@ -301,16 +309,14 @@ class ErasureServerPools:
             to_info, prefix, marker, delimiter, max_keys)
         if (res.is_truncated and not marker
                 and not self.metacache.recently_saved(bucket, prefix)):
-            # More pages will follow: render a FRESH stream up to the cap
-            # and persist it so they don't re-walk. Only page 1 renders —
-            # a continuation already past the cap would re-save the same
-            # partial stream uselessly on every page.
-            cap = self.METACACHE_MAX_ENTRIES
-            entries = listing.entries_from_journals(
-                self.stream_journals(bucket, prefix), to_info, cap=cap)
-            self.metacache.save(
-                bucket, prefix, entries,
-                end=entries[-1][0] if len(entries) >= cap else "")
+            # More pages will follow: render a FRESH walk into the block
+            # stream (sync up to the page-1 bound, then background).
+            self.metacache.render(
+                bucket, prefix,
+                listing.iter_entries_from_journals(
+                    self.stream_journals(bucket, prefix), to_info),
+                kind="o", sync_cap=self.METACACHE_MAX_ENTRIES,
+                stream_cap=self.METACACHE_MAX_STREAM)
         return res
 
     def list_object_versions(self, bucket: str, prefix: str = "", marker: str = "",
@@ -319,14 +325,19 @@ class ErasureServerPools:
         self.get_bucket_info(bucket)
         to_info = lambda name, fi: listing.fi_to_object_info(bucket, name, fi)  # noqa: E731
         if marker:
-            cached = self.metacache.load_versions(bucket, prefix, marker)
+            cached = self.metacache.entries_from(bucket, prefix, marker,
+                                                 kind="v")
             if cached is not None:
-                entries, end = cached
-                r = listing.paginate_versions_cached(
-                    entries, prefix, marker, version_marker, delimiter,
-                    max_keys)
-                if r.is_truncated or not end:
+                it, complete = cached
+                try:
+                    r = listing.paginate_versions_cached(
+                        it, prefix, marker, version_marker, delimiter,
+                        max_keys)
+                except metacache_mod.CacheGone:
+                    r = None
+                if r is not None and (r.is_truncated or complete):
                     return r
+                self.metacache.misses += 1
         res = listing.paginate_versions(
             listing.pushdown_stream(
                 lambda sa: self.stream_journals(bucket, prefix, sa),
@@ -336,14 +347,13 @@ class ErasureServerPools:
                 and not self.metacache.recently_saved_versions(
                     bucket, prefix)):
             # Scanner + client continuations seek into the persisted
-            # stream instead of re-walking every page (page-1 render only,
-            # see list_objects).
-            cap = self.METACACHE_MAX_ENTRIES
-            entries = listing.version_entries_from_journals(
-                self.stream_journals(bucket, prefix), to_info, cap=cap)
-            self.metacache.save_versions(
-                bucket, prefix, entries,
-                end=entries[-1][0] if len(entries) >= cap else "")
+            # block stream instead of re-walking every page.
+            self.metacache.render(
+                bucket, prefix,
+                listing.iter_version_entries_from_journals(
+                    self.stream_journals(bucket, prefix), to_info),
+                kind="v", sync_cap=self.METACACHE_MAX_ENTRIES,
+                stream_cap=self.METACACHE_MAX_STREAM)
         return res
 
     # -- healing --

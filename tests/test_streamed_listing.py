@@ -115,9 +115,10 @@ def test_full_listing_paged_is_complete(big_set):
 
 
 def test_pools_metacache_partial_bounded(tmp_path, monkeypatch):
-    """The pool metacache renders at most METACACHE_MAX_ENTRIES; pages
-    within the cap hit the cache, pages past it fall back to the walk —
-    and every page stays correct."""
+    """The pool metacache renders a bounded stream; pages within the cap
+    hit the cache, pages past it fall back to the walk — and every page
+    stays correct. (Both the sync and async render bounds are pinned so
+    the stream is genuinely capped.)"""
     from minio_tpu.erasure.pools import ErasureServerPools
     from minio_tpu.erasure.sets import ErasureSets
 
@@ -125,6 +126,7 @@ def test_pools_metacache_partial_bounded(tmp_path, monkeypatch):
                      parity=1)
     pools = ErasureServerPools([s1])
     monkeypatch.setattr(type(pools), "METACACHE_MAX_ENTRIES", 40)
+    monkeypatch.setattr(type(pools), "METACACHE_MAX_STREAM", 40)
     pools.make_bucket("pbkt")
     for i in range(120):
         pools.put_object("pbkt", f"k{i:04d}", io.BytesIO(b"x"), 1)
