@@ -194,6 +194,7 @@ class Metacache:
         self._last_read.setdefault(key, time.time())
 
         def bg():
+            finished = False
             try:
                 while not self._closed:
                     if self._stale(bucket, created):
@@ -215,6 +216,17 @@ class Metacache:
             except Exception:   # noqa: BLE001 — drives may be closing
                 pass
             finally:
+                if not finished and not self._closed:
+                    # Abandoned mid-stream: the final sweep never ran, so
+                    # reclaim the previous generation's tail now — those
+                    # blocks are beyond this idx's range and would
+                    # otherwise leak in the replicated store forever.
+                    for i in range(state["blocks"],
+                                   state.get("old_blocks", 0)):
+                        try:
+                            self._store.delete_sys_config(f"{base}/blk{i}")
+                        except se.StorageError:
+                            pass
                 with self._render_lock:
                     self._rendering.discard(key)
 
@@ -271,7 +283,12 @@ class Metacache:
     def _load_idx(self, bucket: str, prefix: str, kind: str):
         self._last_read[(bucket, prefix, kind)] = time.time()
         if len(self._last_read) > 4096:
-            self._last_read.clear()
+            # Evict the oldest half — a blanket clear() would zero the
+            # read clocks of every in-flight renderer and idle-abandon
+            # them all at once.
+            for k, _ in sorted(self._last_read.items(),
+                               key=lambda kv: kv[1])[:2048]:
+                self._last_read.pop(k, None)
         base = self._base(bucket, prefix, kind)
         # Any memoized generation within ttl and not dirty serves; a
         # peer's newer render is picked up when this expires.

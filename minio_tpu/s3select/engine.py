@@ -189,7 +189,8 @@ def run_select(body_stream, request: S3SelectRequest
                           if hasattr(body_stream, "read")
                           else bytes(body_stream))
                 reader = ParquetReader(raw_pq)
-                groups = list(reader.iter_column_groups())
+                want = pplan.needed_columns([c.name for c in reader.columns])
+                groups = list(reader.iter_column_groups(want))
             except ParquetError as e:
                 raise SelectError(f"parquet: {e}") from None
             except (_struct.error, zlib.error, IndexError,

@@ -438,21 +438,24 @@ class DecodedColumn:
                 self._list = lst
         return self._list
 
-    def eq_literal(self, lit: str):
-        """Bytes-level equality against a str literal without building one
-        str object: (eq_mask, present_mask) over rows, or None when the
+    def match_literal(self, lit: str, prefix: bool = False):
+        """Bytes-level string match against a literal without building one
+        str object: (hit_mask, present_mask) over rows, or None when the
         fast compare can't be trusted (already materialized, no lazy page,
         or non-ASCII bytes present — non-ASCII needs per-value utf8
         validation to preserve the row engine's bytes-vs-str coercion, so
-        those pages take the exact path)."""
+        those pages take the exact path). prefix=True implements
+        LIKE 'lit%' (value startswith)."""
         if self._ba is None or self._list is not None:
             return None
         import numpy as np
 
         page, base, starts, lens = self._ba
         arr = np.frombuffer(page, np.uint8, offset=base)
-        high = (arr & 0x80) if arr.size else None
-        if high is not None and high.any():
+        # One allocation-free reduction answers the common all-ASCII case
+        # (a masked any() would materialize a page-sized temp).
+        if arr.size and int(arr.max()) >= 0x80:
+            high = arr & 0x80
             # High bytes exist somewhere. They may be legal: the 4-byte
             # length prefixes carry >=0x80 for any value 128-255 chars
             # long. Only then pay the precise per-value range check
@@ -469,26 +472,33 @@ class DecodedColumn:
                     return None
         present = (self.np_present if self.np_present is not None
                    else np.ones(self.n, bool))
-        eq = np.zeros(self.n, bool)
+        hit = np.zeros(self.n, bool)
         try:
             enc = lit.encode("ascii")
         except UnicodeEncodeError:
-            # ASCII page can never equal a non-ASCII literal.
-            return eq, present
+            # ASCII page can never match a non-ASCII literal.
+            return hit, present
         rows = np.nonzero(present)[0]
-        cand = np.nonzero(lens == len(enc))[0]
         L = len(enc)
+        cand = np.nonzero(lens >= L if prefix else lens == L)[0]
         if L and cand.size:
-            # Gather the candidates' L-byte windows in one fancy-indexed
-            # matrix and compare against the literal row-wise.
-            idx = (starts[cand].astype(np.int64)[:, None]
-                   + np.arange(L, dtype=np.int64)[None, :])
-            win = arr[idx]
-            hit = (win == np.frombuffer(enc, np.uint8)[None, :]).all(axis=1)
-            eq[rows[cand[hit]]] = True
+            # Cheap first/last-byte prefilter before the window gather:
+            # two scalar-compare passes usually drop most candidates, so
+            # the fancy-indexed matrix compare touches a fraction of the
+            # page.
+            st = starts[cand].astype(np.int64)
+            keep = (arr[st] == enc[0]) & (arr[st + (L - 1)] == enc[-1])
+            cand = cand[keep]
+            if cand.size:
+                idx = (starts[cand].astype(np.int64)[:, None]
+                       + np.arange(L, dtype=np.int64)[None, :])
+                win = arr[idx]
+                ok = (win == np.frombuffer(enc, np.uint8)[None, :]
+                      ).all(axis=1)
+                hit[rows[cand[ok]]] = True
         elif not L:
-            eq[rows[cand]] = True  # empty-string literal
-        return eq, present
+            hit[rows[cand]] = True  # empty literal: eq empty / any prefix
+        return hit, present
 
     def __len__(self) -> int:
         return self.n
@@ -714,10 +724,13 @@ class ParquetReader:
             out[i] = v
         return DecodedColumn(n, values=out)
 
-    def iter_column_groups(self) -> Iterator[tuple[int, dict[str, list]]]:
+    def iter_column_groups(self, want: "set[str] | None" = None
+                           ) -> Iterator[tuple[int, dict[str, list]]]:
         """Yield (n_rows, {column: decoded values}) per row group — the
         COLUMN-CHUNK form the vectorized Select lane consumes directly
-        (row dicts are only materialized for rows that survive WHERE)."""
+        (row dicts are only materialized for rows that survive WHERE).
+        want: decode only these columns (projection pushdown — a COUNT
+        over one predicate column must not pay for the other chunks)."""
         for rg in self.row_groups:
             chunks = rg.get(1, [])
             data: dict[str, list] = {}
@@ -726,6 +739,8 @@ class ParquetReader:
                 md = cc.get(3, {})
                 path = [p.decode() for p in md.get(3, [])]
                 name = path[0] if path else ""
+                if want is not None and name not in want:
+                    continue
                 col = next((c for c in self.columns if c.name == name), None)
                 if col is None:
                     continue

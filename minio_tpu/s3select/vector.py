@@ -26,6 +26,8 @@ from minio_tpu.s3select.sql import (
     Col,
     Evaluator,
     Func,
+    InList,
+    Like,
     Lit,
     Query,
     Unary,
@@ -109,9 +111,75 @@ def _as_col(node) -> str | None:
     return None
 
 
+def _bare_col(node) -> str | None:
+    """String-compare leaves must anchor on a BARE column: a CAST-wrapped
+    column (which _as_col accepts for the numeric lane) carries cast
+    semantics — erroring on non-castable values — that a raw byte compare
+    would silently bypass."""
+    if isinstance(node, Col) and node.name and node.steps is None:
+        return node.name
+    return None
+
+
+def _compile_like(node):
+    """LIKE 'prefix%' (literal ASCII pattern) -> the like-pfx leaf.
+    Anything else — mid-string %, _, ESCAPE, and wildcard-free patterns
+    (whose '$'-anchored regex ALSO matches a trailing-newline value, so
+    they are not byte equality) — row-falls-back."""
+    col = _bare_col(node.e)
+    if (col is None or node.escape or not isinstance(node.pattern, Lit)
+            or not isinstance(node.pattern.value, str)):
+        raise _Unsupported("like shape")
+    pat = node.pattern.value
+    if not pat.isascii() or "_" in pat:
+        raise _Unsupported("like wildcard shape")
+    if pat.endswith("%") and "%" not in pat[:-1]:
+        leaf = _Cmp(col, "like-pfx", pat[:-1], node)
+    else:
+        raise _Unsupported("general like pattern")
+    return _Bool("NOT", [leaf]) if node.negate else leaf
+
+
+def _compile_in(node):
+    """IN (literals...) -> an OR-chain of the same eq leaves '=' compiles
+    to, reusing each lane's equality path; three-valued OR reproduces the
+    row engine's NULL propagation."""
+    col = _bare_col(node.e)
+    if col is None or not node.items:
+        raise _Unsupported("in shape")
+    kids = []
+    for item in node.items:
+        if not isinstance(item, Lit):
+            raise _Unsupported("non-literal IN item")
+        v = item.value
+        eq_node = Binary("=", node.e, item)
+        if isinstance(v, bool) or v is None:
+            raise _Unsupported("bool/null IN item")
+        if isinstance(v, (int, float)):
+            kids.append(_Cmp(col, "=", v, eq_node))
+            continue
+        if not isinstance(v, str):
+            raise _Unsupported("exotic IN item")
+        try:
+            float(v)
+        except ValueError:
+            if v.isascii():
+                kids.append(_Cmp(col, "=", v, eq_node))
+                continue
+        raise _Unsupported("numeric-ish/non-ascii IN string")
+    leaf = kids[0]
+    for k in kids[1:]:
+        leaf = _Bool("OR", [leaf, k])
+    return _Bool("NOT", [leaf]) if node.negate else leaf
+
+
 def _compile_where(node):
     if node is None:
         return None
+    if isinstance(node, Like):
+        return _compile_like(node)
+    if isinstance(node, InList):
+        return _compile_in(node)
     if isinstance(node, Binary):
         if node.op in ("AND", "OR"):
             return _Bool(node.op, [_compile_where(node.l),
@@ -126,7 +194,10 @@ def _compile_where(node):
                         raise _Unsupported("bool literal")
                     if isinstance(v, (int, float)):
                         return _Cmp(col, op, v, node)
-                    if isinstance(v, str) and op in ("=", "<>"):
+                    if (isinstance(v, str) and op in ("=", "<>")
+                            and _bare_col(l) is not None):
+                        # Bare column only: CAST(col AS FLOAT) = 'str'
+                        # must keep the cast's error semantics (row path).
                         try:
                             float(v)
                         except ValueError:
@@ -292,11 +363,14 @@ class VectorPlan:
         if ci is None:  # unknown column -> MISSING -> NULL comparison
             return np.zeros(n, bool), np.zeros(n, bool)
         if isinstance(node.lit, str):
-            # = / <> against a non-numeric ASCII literal: pure bytes
-            # equality on the unquoted field (the row engine string-
-            # compares exactly this way for non-numeric literals).
+            # = / <> / like-pfx against a non-numeric ASCII literal: pure
+            # bytes equality (or prefix equality) on the unquoted field
+            # (the row engine string-compares exactly this way for
+            # non-numeric literals; LIKE 'p%' is a prefix test on str).
             idx, present = batch.col_field_idx(ci)
             lit = node.lit.encode()
+            L = len(lit)
+            pfx = node.op == "like-pfx"
             eq = np.zeros(n, bool)
             cand = np.nonzero(present)[0]
             offs, lens = batch.foff[idx], batch.flen[idx]
@@ -306,8 +380,8 @@ class VectorPlan:
                 raw = batch.data[off:off + ln]
                 if ln >= 2 and raw[0] == q and raw[-1] == q:
                     raw = raw[1:-1].replace(batch.quote * 2, batch.quote)
-                eq[ri] = raw == lit
-            value = eq if node.op == "=" else (~eq & present)
+                eq[ri] = raw[:L] == lit if pfx else raw == lit
+            value = (~eq & present) if node.op == "<>" else eq
             return value & present, present
         vals, ok, present = batch.floats(ci)
         lit = float(node.lit)
@@ -667,13 +741,16 @@ class JSONVectorPlan:
         known = np.zeros(n, bool)
         if isinstance(node.lit, str):
             # Vector lane: real JSON strings, byte-compared (escape-free
-            # by construction). Everything else odd -> row fallback.
+            # by construction); like-pfx is a bytes prefix test.
+            # Everything else odd -> row fallback.
             lit = node.lit.encode()
+            L = len(lit)
+            pfx = node.op == "like-pfx"
             svals = kinds == 2
             for ri in np.nonzero(svals & ~batch.pyrow)[0]:
                 raw = batch.data[voff[ri]:voff[ri] + vlen[ri]]
-                eq = raw == lit
-                value[ri] = eq if node.op == "=" else not eq
+                eq = raw[:L] == lit if pfx else raw == lit
+                value[ri] = (not eq) if node.op == "<>" else eq
                 known[ri] = True
             odd = (~svals & (kinds != 0) & (kinds != 5)) | batch.pyrow
         else:
@@ -1070,6 +1147,37 @@ class ParquetVectorPlan:
                 return cand
         return None
 
+    def needed_columns(self, file_cols: list) -> "set[str] | None":
+        """Projection pushdown: the file columns this plan can possibly
+        touch (WHERE leaves + aggregate args + projected columns), or
+        None for no pruning (SELECT *). Row-dict fallbacks only ever
+        evaluate nodes over these same columns, so pruned chunks are
+        never consulted."""
+        qcols: set[str] = set()
+        for p in self.query.projections:
+            if p.expr is None:
+                return None
+            if isinstance(p.expr, Col):
+                qcols.add(p.expr.name)
+        for f in self.query.aggregates:
+            if not f.star:
+                qcols.add(f.args[0].name)
+
+        def walk(nd):
+            if isinstance(nd, _Cmp):
+                qcols.add(nd.col)
+            elif isinstance(nd, _Bool):
+                for k in nd.kids:
+                    walk(k)
+
+        walk(self.where)
+        want: set[str] = set()
+        for fc in file_cols:
+            for qn in qcols:
+                if fc in _name_candidates(qn):
+                    want.add(fc)
+        return want
+
     def _leaf(self, node, cols: dict, raw: dict, n: int, ev: Evaluator,
               row_of):
         cn = self._colname(node.col, raw)
@@ -1077,22 +1185,28 @@ class ParquetVectorPlan:
             return np.zeros(n, bool), np.zeros(n, bool)
         if isinstance(node.lit, str):
             vals = raw[cn]
-            if node.op in ("=", "<>"):
-                from minio_tpu.s3select.parquet import DecodedColumn
+            from minio_tpu.s3select.parquet import DecodedColumn
 
-                if isinstance(vals, DecodedColumn):
-                    # Lazy byte-array chunk: bytes-level compare, zero str
-                    # construction (ASCII pages only — eq_literal refuses
-                    # anything needing per-value utf8/coercion semantics).
-                    fast = vals.eq_literal(node.lit)
-                    if fast is not None:
-                        eq, present = fast
-                        value = eq if node.op == "=" else (~eq & present)
-                        return value & present, present.copy()
-            eq = np.fromiter((isinstance(v, str) and v == node.lit
-                              for v in vals), bool, n)
+            if isinstance(vals, DecodedColumn):
+                # Lazy byte-array chunk: bytes-level compare (equality or
+                # LIKE-prefix), zero str construction (ASCII pages only —
+                # the matcher refuses anything needing per-value utf8 /
+                # coercion semantics).
+                fast = vals.match_literal(node.lit,
+                                          prefix=node.op == "like-pfx")
+                if fast is not None:
+                    eq, present = fast
+                    value = (~eq & present) if node.op == "<>" else eq
+                    return value & present, present.copy()
+            if node.op == "like-pfx":
+                eq = np.fromiter(
+                    (isinstance(v, str) and v.startswith(node.lit)
+                     for v in vals), bool, n)
+            else:
+                eq = np.fromiter((isinstance(v, str) and v == node.lit
+                                  for v in vals), bool, n)
             present = np.fromiter((v is not None for v in vals), bool, n)
-            value = eq if node.op == "=" else (~eq & present)
+            value = (~eq & present) if node.op == "<>" else eq
             value = value & present
             known = present.copy()
             # Present non-str values (bools, numbers): the row engine's

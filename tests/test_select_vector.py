@@ -145,9 +145,30 @@ def test_vector_handles_terminator_variants(data):
 
 
 def test_unsupported_shapes_decline():
-    req = _req("SELECT * FROM S3Object s WHERE s.name LIKE 'x%'")
+    # LIKE 'x%' / IN (...) now vectorize; shapes the lanes still can't
+    # mirror exactly must keep declining.
+    req = _req("SELECT * FROM S3Object s WHERE s.name LIKE '%x'")
     assert vector.compile_plan(parse(req.expression), req) is None
-    req = _req("SELECT * FROM S3Object s WHERE s.id IN (1, 2)")
+    # Wildcard-free LIKE is NOT byte equality ('$' also matches before a
+    # trailing newline) — must stay on the row path.
+    req = _req("SELECT * FROM S3Object s WHERE s.name LIKE 'abc'")
+    assert vector.compile_plan(parse(req.expression), req) is None
+    # CAST-wrapped string compares keep the cast's error semantics.
+    req = _req("SELECT * FROM S3Object s "
+               "WHERE CAST(s.name AS FLOAT) LIKE 'x%'")
+    assert vector.compile_plan(parse(req.expression), req) is None
+    req = _req("SELECT * FROM S3Object s "
+               "WHERE CAST(s.name AS FLOAT) = 'paris'")
+    assert vector.compile_plan(parse(req.expression), req) is None
+    req = _req("SELECT * FROM S3Object s WHERE s.name LIKE 'a_c'")
+    assert vector.compile_plan(parse(req.expression), req) is None
+    req = _req("SELECT * FROM S3Object s "
+               "WHERE s.name LIKE 'x!%' ESCAPE '!'")
+    assert vector.compile_plan(parse(req.expression), req) is None
+    req = _req("SELECT * FROM S3Object s WHERE s.id IN (1, s.other)")
+    assert vector.compile_plan(parse(req.expression), req) is None
+    # Numeric-ish string in IN: coercion rules differ -> decline.
+    req = _req("SELECT * FROM S3Object s WHERE s.name IN ('500', 'x')")
     assert vector.compile_plan(parse(req.expression), req) is None
     req = _req("SELECT UPPER(s.name) FROM S3Object s")
     assert vector.compile_plan(parse(req.expression), req) is None
@@ -471,3 +492,68 @@ def test_parquet_string_eq_long_values():
     vec, row = _both(blob, expr, input_format="PARQUET")
     assert vec == row
     assert b"\n5\n" in vec or b"5" in vec
+
+
+CSV_STR = (b"id,name,city\n"
+           + b"".join(b"%d,name%d,%s\n" % (i, i % 30,
+                                           [b"paris", b"nyc", b"", b"lille"][i % 4])
+                      for i in range(400)))
+
+
+def test_like_prefix_vectorizes_and_matches_row():
+    req = _req("SELECT COUNT(*) FROM S3Object s WHERE s.name LIKE 'name1%'")
+    assert vector.compile_plan(parse(req.expression), req) is not None
+    for expr in (
+        "SELECT COUNT(*) FROM S3Object s WHERE s.name LIKE 'name1%'",
+        "SELECT s.id FROM S3Object s WHERE s.city LIKE 'par%'",
+        "SELECT s.id FROM S3Object s WHERE s.name NOT LIKE 'name2%'",
+        "SELECT s.id FROM S3Object s WHERE s.city LIKE 'paris'",
+        "SELECT s.id FROM S3Object s WHERE s.city LIKE '%'",
+    ):
+        vec, row = _both(CSV_STR, expr)
+        assert vec == row, expr
+
+
+def test_in_list_vectorizes_and_matches_row():
+    req = _req("SELECT COUNT(*) FROM S3Object s WHERE s.id IN (1, 2, 3)")
+    assert vector.compile_plan(parse(req.expression), req) is not None
+    for expr in (
+        "SELECT COUNT(*) FROM S3Object s WHERE s.id IN (1, 2, 3)",
+        "SELECT s.id FROM S3Object s WHERE s.city IN ('paris', 'lille')",
+        "SELECT s.id FROM S3Object s WHERE s.city NOT IN ('nyc')",
+        "SELECT s.id FROM S3Object s "
+        "WHERE s.id IN (7) OR s.city IN ('paris')",
+    ):
+        vec, row = _both(CSV_STR, expr)
+        assert vec == row, expr
+
+
+def test_like_trailing_newline_value_matches_row():
+    # '$' in the row engine's LIKE regex matches before a trailing
+    # newline; quoted CSV fields can carry one. Equivalence must hold.
+    data = (b"id,city\n"
+            b'1,paris\n'
+            b'2,"paris\n"\n'
+            b"3,lille\n")
+    for expr in ("SELECT s.id FROM S3Object s WHERE s.city LIKE 'paris'",
+                 "SELECT s.id FROM S3Object s WHERE s.city LIKE 'par%'"):
+        vec, row = _both(data, expr)
+        assert vec == row, expr
+
+
+def test_like_in_jsonl_matches_row():
+    import json as _json
+
+    docs = b"".join(
+        _json.dumps({"id": i, "name": f"name{i % 30}",
+                     "city": ["paris", "nyc", None, "lille"][i % 4]}
+                    ).encode() + b"\n"
+        for i in range(300))
+    for expr in (
+        "SELECT COUNT(*) FROM S3Object s WHERE s.name LIKE 'name1%'",
+        "SELECT s.id FROM S3Object s WHERE s.city IN ('paris', 'lille')",
+        "SELECT s.id FROM S3Object s WHERE s.name NOT LIKE 'name2%'",
+    ):
+        vec, row = _both(docs, expr, input_format="JSON",
+                         output_format="JSON")
+        assert vec == row, expr
