@@ -371,6 +371,19 @@ class RemoteDrive(StorageAPI):
     def read_file_stream(self, volume: str, path: str) -> BinaryIO:
         return _RemoteFile(self, volume, path)
 
+    def read_file_range_stream(self, volume: str, path: str, off: int,
+                               length: int):
+        """ONE long-lived streamed request for [off, off+length) — the
+        reference's ReadFileStream shape (cmd/storage-rest-client.go:475):
+        a sequential consumer (the mixed GET lane's framed prefetch)
+        rides a single socket instead of paying per-window request
+        setup. Returns a file-like with read()/close()."""
+        return self._client.call(
+            self._path("read_file_stream"),
+            self._params(vol=volume, path=path, off=str(off),
+                         len=str(length)),
+            stream=True)
+
     def rename_file(self, src_volume: str, src_path: str,
                     dst_volume: str, dst_path: str) -> None:
         self._call("rename_file", svol=src_volume, spath=src_path,

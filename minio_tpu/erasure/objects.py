@@ -789,12 +789,60 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             # One open stream per remote shard for the whole GET (stat +
             # open once, sequential ranged reads ride its readahead).
             streams: dict[int, object] = {}
+            # Long-lived range streams (reference ReadFileStream shape):
+            # the whole GET's framed extent rides ONE streamed request
+            # per remote shard; windows read sequentially off it.
+            rstreams: dict[int, tuple] = {}     # i -> (stream, next_off)
+            lo_all, ln_all = plane.framed_range(k, bs, part.size, offset,
+                                                length)
+
+            def fetch_remote(i, lo, ln):
+                ent = rstreams.pop(i, None)
+                if ent is not None and ent[1] != lo:
+                    try:
+                        ent[0].close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    ent = None
+                if ent is None:
+                    opener = getattr(remotes[i], "read_file_range_stream",
+                                     None)
+                    if opener is None:
+                        # Fault injectors / exotic wrappers interpose on
+                        # read_file_stream — keep their per-call hooks.
+                        return _fetch_framed(remotes[i], bucket, rel, lo,
+                                             ln, streams, i)
+                    try:
+                        ent = (opener(bucket, rel, lo,
+                                      lo_all + ln_all - lo), lo)
+                    except (se.StorageError, OSError):
+                        return None
+                st = ent[0]
+                try:
+                    buf = _read_exact(st, ln)
+                except (se.StorageError, OSError, ValueError):
+                    try:
+                        st.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    return None
+                rstreams[i] = (st, lo + ln)
+                return buf
+
+            # All-local GETs take one giant decode window (fewest C
+            # calls); with remote shards the window shrinks so the
+            # one-ahead pipeline genuinely overlaps window N+1's RPC
+            # prefetch with window N's decode — a single 64 MiB window
+            # would serialize the whole transfer before the first
+            # decode byte.
+            wb = plane.window_blocks(bs)
+            if any(r is not None for r in remotes):
+                wb = max(1, min(wb, (8 << 20) // bs))
 
             def windows():
                 pos = offset
                 while pos < end:
-                    wend = min(end,
-                               (pos // bs + plane.window_blocks(bs)) * bs)
+                    wend = min(end, (pos // bs + wb) * bs)
                     yield pos, wend
                     pos = wend
 
@@ -816,9 +864,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                             if remotes[i] is not None and i not in mem]
                     if need:
                         fetches = parallel_map([
-                            lambda i=i: _fetch_framed(
-                                remotes[i], bucket, rel, lo, ln,
-                                streams, i)
+                            lambda i=i: fetch_remote(i, lo, ln)
                             for i in need])
                         lost = False
                         for i, blob in zip(need, fetches):
@@ -871,12 +917,29 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                                if nxt is not None else None)
                         yield data
                 finally:
+                    # An abandoned GET (client disconnect mid-stream) can
+                    # leave window N+1 decoding in the worker; closing
+                    # its streams under it would fail healthy shards and
+                    # mark live nodes offline. Settle the future first
+                    # (same discipline as the Python lane's
+                    # producer-join before closing readers).
+                    if fut is not None and not fut.cancel():
+                        try:
+                            fut.result(timeout=30)
+                        except Exception:  # noqa: BLE001 — teardown only
+                            pass
                     for f in streams.values():
                         try:
                             f.close()
                         except Exception:  # noqa: BLE001
                             pass
                     streams.clear()
+                    for st, _off in rstreams.values():
+                        try:
+                            st.close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                    rstreams.clear()
                     # One-shot heal trigger on any dead/corrupt shard seen
                     # (reference cmd/erasure-object.go:321-344).
                     if dead and self.mrf is not None:
@@ -1557,6 +1620,18 @@ def _shard_paths_mixed(drives: list[StorageAPI], vol: str, rel: str
     return paths, remotes
 
 
+def _read_exact(f, n: int) -> bytes:
+    """Read exactly n bytes from a stream; OSError on early EOF — the
+    ONE short-read rule every remote shard reader shares."""
+    buf = bytearray()
+    while len(buf) < n:
+        c = f.read(n - len(buf))
+        if not c:
+            raise OSError("short read")
+        buf += c
+    return bytes(buf)
+
+
 def _fetch_framed(drive: StorageAPI, vol: str, rel: str, lo: int,
                   ln: int, streams: dict | None = None,
                   key: int | None = None) -> bytes | None:
@@ -1577,18 +1652,13 @@ def _fetch_framed(drive: StorageAPI, vol: str, rel: str, lo: int,
             streams[key] = f
     try:
         f.seek(lo)
-        buf = bytearray()
-        while len(buf) < ln:
-            chunk = f.read(ln - len(buf))
-            if not chunk:
-                raise OSError("short read")
-            buf += chunk
+        buf = _read_exact(f, ln)
         if streams is None:
             try:
                 f.close()
             except Exception:  # noqa: BLE001
                 pass
-        return bytes(buf)
+        return buf
     except (se.StorageError, OSError, ValueError):
         if streams is not None:
             streams.pop(key, None)

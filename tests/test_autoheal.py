@@ -141,12 +141,23 @@ def test_live_drive_replacement_heals_end_to_end(tmp_path):
         victim_root = s.drives[victim_slot].inner.root \
             if hasattr(s.drives[victim_slot], "inner") \
             else s.drives[victim_slot].root
-        shutil.rmtree(victim_root)
-        os.makedirs(victim_root)
+        # The wipe races the live 0.1s monitor (which may be mid-write
+        # into the tree) — retry until the teardown wins, exactly like
+        # yanking a real disk under IO.
+        for _ in range(50):
+            try:
+                shutil.rmtree(victim_root)
+                break
+            except OSError:
+                _t.sleep(0.05)
+        else:
+            raise AssertionError("could not wipe the victim drive "
+                                 "(monitor kept re-creating files)")
+        os.makedirs(victim_root, exist_ok=True)
         # The live monitor must reformat + rebuild without intervention.
         # Generous deadline: the shared 1-core CI host can stall the
         # 0.1s-interval monitor under full-suite load.
-        deadline = _t.time() + 90
+        deadline = _t.time() + 150
         while _t.time() < deadline:
             try:
                 fmt = s.drives[victim_slot].read_format()
