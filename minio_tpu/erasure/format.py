@@ -188,11 +188,31 @@ def _claim_slot(drive: StorageAPI, fmt: "FormatInfo",
     and the live heal_format monitor — the claim ritual must not
     diverge between them."""
     from minio_tpu.erasure.autoheal import mark_drive_healing
+    from minio_tpu.storage.idcheck import DiskIDChecker
 
     try:
+        # Re-probe at claim time: the drive must STILL be provably blank
+        # and mounted. An unmounted root reads FaultyDisk — writing the
+        # tracker there would recreate the root on the parent filesystem
+        # and route the format (and every healed shard) onto it, the
+        # exact case the local drive's root guards defend against.
+        base = drive.inner if isinstance(drive, DiskIDChecker) else drive
+        try:
+            base.read_format()
+            return False    # no longer blank: claimed concurrently
+        except se.UnformattedDisk:
+            pass
+        except se.StorageError:
+            return False    # unmounted/dying — never touch the path
+        # Tracker BEFORE identity: the instant the drive carries a valid
+        # slot format it must already be marked healing — an observer (or
+        # a crash) between the two writes must never see a formatted,
+        # tracker-less, shard-empty drive and call it healthy. The
+        # tracker write goes through the bare drive: the identity guard
+        # would (correctly) refuse a blank disk.
+        mark_drive_healing(base, slot_uuid)
         drive.write_format(fmt.to_doc(slot_uuid))
         drive.set_disk_id(slot_uuid)
-        mark_drive_healing(drive, slot_uuid)
         return True
     except se.StorageError:
         return False  # still dying; retried on the next pass/boot

@@ -473,8 +473,13 @@ class HealingMixin:
         k, m = codec.k, codec.m
         n = k + m
         errs: dict[int, Exception | None] = {pos: None for pos in targets}
-        win_blocks = plane.window_blocks(codec.block_size)
-        win = win_blocks * codec.block_size
+        # Small enough windows that the 1-deep pipeline genuinely
+        # overlaps: with one giant window, decode and the encoder's
+        # write-back serialize and heal runs at decode+write instead of
+        # max(decode, write) (reference erasure-lowlevel-heal.go pipes
+        # the decode straight into the encode).
+        win = plane.pipeline_window_blocks(codec.block_size) \
+            * codec.block_size
         from minio_tpu.storage.idcheck import DiskIDChecker
 
         for part in latest.parts:

@@ -837,7 +837,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             # decode byte.
             wb = plane.window_blocks(bs)
             if any(r is not None for r in remotes):
-                wb = max(1, min(wb, (8 << 20) // bs))
+                wb = plane.pipeline_window_blocks(bs)
 
             def windows():
                 pos = offset

@@ -39,6 +39,18 @@ def seg_blocks(block_size: int) -> int:
 def window_blocks(block_size: int) -> int:
     return max(1, WINDOW_BYTES // block_size)
 
+
+PIPELINE_WINDOW_BYTES = 8 << 20
+
+
+def pipeline_window_blocks(block_size: int) -> int:
+    """Window size (in blocks) for 1-deep overlapped pipelines (mixed
+    GET prefetch/decode, heal decode/write-back): small enough that
+    stage N+1 genuinely overlaps stage N — one giant window would
+    serialize the stages end to end."""
+    return max(1, min(window_blocks(block_size),
+                      PIPELINE_WINDOW_BYTES // block_size))
+
 _MD5_INIT = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476)
 
 # Bitrot digest selector for the C pipelines: name -> (algo id, key).

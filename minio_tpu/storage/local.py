@@ -171,9 +171,15 @@ class LocalDrive(StorageAPI):
     def make_vol(self, volume: str) -> None:
         d = self._vol_dir(volume)
         try:
-            os.makedirs(d, exist_ok=False)
+            # mkdir, NOT makedirs: a missing drive root means the drive
+            # is unmounted — creating it would put the volume (and every
+            # shard after it) on the parent filesystem.
+            os.mkdir(d)
         except FileExistsError:
             raise se.VolumeExists(volume) from None
+        except FileNotFoundError:
+            raise se.FaultyDisk(
+                f"drive root missing (unmounted?): {self.root}") from None
         except OSError as e:
             raise se.FaultyDisk(str(e)) from e
 

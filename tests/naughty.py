@@ -44,7 +44,11 @@ class NaughtyDisk:
             return fn
 
         def wrapped(*a, **kw):
-            self._maybe_fail(name)
+            # Specialized read entry points share their base method's
+            # fault program — a per_method hook on read_file_stream must
+            # also fire for the long-lived range-stream variant.
+            self._maybe_fail({"read_file_range_stream":
+                              "read_file_stream"}.get(name, name))
             return fn(*a, **kw)
 
         return wrapped
