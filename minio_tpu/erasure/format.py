@@ -173,13 +173,16 @@ def init_format_erasure(
         if not (heal_blanks and isinstance(results[i], (dict, se.UnformattedDisk))):
             continue
         slot_uuid = ref.sets[slot // set_drive_count][slot % set_drive_count]
-        _claim_slot(drive, ref, slot_uuid)
+        # Boot init classified this drive against the FULL drive set, so
+        # a placed-but-duplicate UUID here is a real duplicate to
+        # reclaim, not a concurrent claim.
+        _claim_slot(drive, ref, slot_uuid, allow_placed_reclaim=True)
     drives[:] = ordered  # callers consume the UUID-ordered layout
     return ref
 
 
 def _claim_slot(drive: StorageAPI, fmt: "FormatInfo",
-                slot_uuid: str) -> bool:
+                slot_uuid: str, allow_placed_reclaim: bool = False) -> bool:
     """Format a provably-blank drive into a slot: write its format.json,
     rebind the disk-ID guard, and leave a healing tracker so the
     background auto-healer rebuilds its shards and resumes across
@@ -197,13 +200,36 @@ def _claim_slot(drive: StorageAPI, fmt: "FormatInfo",
         # and route the format (and every healed shard) onto it, the
         # exact case the local drive's root guards defend against.
         base = drive.inner if isinstance(drive, DiskIDChecker) else drive
+        cur = None
         try:
-            base.read_format()
-            return False    # no longer blank: claimed concurrently
+            cur = base.read_format()
         except se.UnformattedDisk:
-            pass
+            pass            # provably blank and mounted — claimable
         except se.StorageError:
-            return False    # unmounted/dying — never touch the path
+            # Unmounted/dying OR unparseable doc: both refuse — a
+            # corrupt document may be a FOREIGN drive's damaged format
+            # (never reformat over it; operator decision).
+            return False
+        if cur is not None:
+            try:
+                f = FormatInfo.from_doc(cur)
+            except (se.StorageError, KeyError, TypeError, ValueError):
+                return False    # malformed doc: same refusal as corrupt
+            if f.deployment_id != fmt.deployment_id:
+                return False    # foreign drive: never reformat
+            if f.this == slot_uuid:
+                return False    # claimed concurrently for this slot
+            if any(f.this in s for s in fmt.sets) \
+                    and not allow_placed_reclaim:
+                # A validly placed UUID means another actor claimed the
+                # drive for a different slot — overwriting would mint a
+                # duplicate identity. Boot init opts in (it classified
+                # against the full set, so "placed" there means a real
+                # duplicate to reclaim).
+                return False
+            # Same deployment, stale UNPLACED UUID: reclaimable — the
+            # boot path's "stale UUID in this deployment" case, which
+            # MUST reformat.
         # Tracker BEFORE identity: the instant the drive carries a valid
         # slot format it must already be marked healing — an observer (or
         # a crash) between the two writes must never see a formatted,
@@ -229,20 +255,28 @@ def heal_format(es_sets) -> int:
 
     Conservative by design, like boot-time init: a drive carrying a
     FOREIGN deployment's format or a corrupt/unreadable format document
-    is never reformatted (that is an operator decision); only provably
-    blank drives are claimed. Returns the number of slots reformatted."""
+    is never reformatted (that is an operator decision). Claimable:
+    provably blank drives, and SAME-deployment drives whose slot UUID is
+    stale — not this slot's and not validly placed anywhere in the
+    layout (boot-time init reclaims exactly those; the live monitor must
+    not strand them until a restart). Returns slots reformatted."""
     fmt: FormatInfo = es_sets.format
     sdc = es_sets.set_drive_count
+    placed = {u for s in fmt.sets for u in s}
     healed = 0
     for slot, drive in enumerate(es_sets.drives):
         slot_uuid = fmt.sets[slot // sdc][slot % sdc]
         try:
-            drive.read_format()
-            continue  # formatted (right or wrong): the disk-ID guard rules
+            cur = drive.read_format()
+            f = FormatInfo.from_doc(cur)
+            if (f.deployment_id != fmt.deployment_id
+                    or f.this == slot_uuid or f.this in placed):
+                continue  # foreign / correct / placed: the guard rules
+            # Same deployment, stale unplaced UUID: reclaim live.
         except se.UnformattedDisk:
             pass
-        except se.StorageError:
-            continue  # unreadable/corrupt: refuse to claim it
+        except (se.StorageError, KeyError, TypeError, ValueError):
+            continue  # unreadable/corrupt/malformed: refuse to claim it
         if _claim_slot(drive, fmt, slot_uuid):
             healed += 1
     return healed

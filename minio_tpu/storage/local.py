@@ -178,8 +178,12 @@ class LocalDrive(StorageAPI):
         except FileExistsError:
             raise se.VolumeExists(volume) from None
         except FileNotFoundError:
+            if not os.path.isdir(self.root):
+                raise se.FaultyDisk(
+                    f"drive root missing (unmounted?): {self.root}"
+                ) from None
             raise se.FaultyDisk(
-                f"drive root missing (unmounted?): {self.root}") from None
+                f"missing parent directory for volume {volume}") from None
         except OSError as e:
             raise se.FaultyDisk(str(e)) from e
 

@@ -44,11 +44,15 @@ class NaughtyDisk:
             return fn
 
         def wrapped(*a, **kw):
-            # Specialized read entry points share their base method's
-            # fault program — a per_method hook on read_file_stream must
-            # also fire for the long-lived range-stream variant.
-            self._maybe_fail({"read_file_range_stream":
-                              "read_file_stream"}.get(name, name))
+            # Specialized read entry points ALSO honor their base
+            # method's fault program: a hook keyed on the specific name
+            # fires first; otherwise read_file_range_stream falls back
+            # to read_file_stream's program.
+            if name == "read_file_range_stream" \
+                    and name not in self.per_method:
+                self._maybe_fail("read_file_stream")
+            else:
+                self._maybe_fail(name)
             return fn(*a, **kw)
 
         return wrapped

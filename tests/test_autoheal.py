@@ -216,3 +216,55 @@ def test_heal_pacing_config(tmp_path):
     healer_idle.run_once()
     assert _t.time() - t0 < busy_dt / 2
     assert HealingTracker.load(victim) is None
+
+
+def test_live_stale_uuid_drive_reclaimed(tmp_path):
+    """A same-deployment drive whose slot UUID went stale (not this
+    slot's, not placed anywhere) is reclaimed by the LIVE monitor —
+    reformatted to its slot id, tracker-marked, shards rebuilt — without
+    a restart (boot-time init already reclaims these; the live
+    heal_format pass must not strand them)."""
+    import shutil
+    import time as _t
+
+    from minio_tpu.erasure.sets import ErasureSets
+
+    roots = [tmp_path / f"d{i}" for i in range(4)]
+    s = ErasureSets([LocalDrive(str(r)) for r in roots], parity=1)
+    s.make_bucket("live")
+    payloads = {}
+    for i in range(5):
+        data = os.urandom(90_000)
+        payloads[f"o{i}"] = data
+        s.sets[0].put_object("live", f"o{i}", io.BytesIO(data), len(data))
+    uuid0 = s.format.sets[0][0]
+    healer = AutoHealer(s, interval=0.1)
+    healer.start()
+    try:
+        base = s.drives[0].inner if hasattr(s.drives[0], "inner") \
+            else s.drives[0]
+        doc = base.read_format()
+        doc["erasure"]["this"] = "00000000-dead-beef-0000-000000000000"
+        shutil.rmtree(os.path.join(base.root, "live"))
+        base.write_format(doc)
+        deadline = _t.time() + 150
+        while _t.time() < deadline:
+            try:
+                fmt = base.read_format()
+                if (fmt.get("erasure", {}).get("this") == uuid0
+                        and HealingTracker.load(base) is None
+                        and all(os.path.isdir(
+                            os.path.join(base.root, "live", n))
+                            for n in payloads)):
+                    break
+            except Exception:  # noqa: BLE001
+                pass
+            _t.sleep(0.1)
+        else:
+            raise AssertionError("stale-UUID drive was not reclaimed")
+    finally:
+        healer.close()
+    for name, data in payloads.items():
+        _, stream = s.sets[0].get_object("live", name)
+        assert b"".join(stream) == data
+    s.close()

@@ -66,6 +66,29 @@ def test_format_heals_blank_drive(tmp_path):
     assert drives2[2].read_format()["erasure"]["this"] == fmt.sets[0][2]
 
 
+def test_format_reclaims_stale_uuid_drive(tmp_path):
+    """A same-deployment drive whose slot UUID is no longer in the layout
+    (stale/duplicate) must be reclaimed: reformatted into its slot with a
+    healing tracker — the claim-time blank re-probe must not refuse it
+    (r5 regression guard for _claim_slot)."""
+    import json
+
+    from minio_tpu.erasure.autoheal import HealingTracker
+
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    fmt = init_format_erasure(drives, 4)
+    # Corrupt drive 2's identity to a UUID the layout does not place.
+    doc = drives[2].read_format()
+    doc["erasure"]["this"] = "00000000-dead-beef-0000-000000000000"
+    drives[2].write_format(doc)
+    drives2 = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    fmt2 = init_format_erasure(drives2, 4)
+    assert fmt2.sets == fmt.sets
+    assert drives2[2].read_format()["erasure"]["this"] == fmt.sets[0][2]
+    assert HealingTracker.load(drives2[2]) is not None, \
+        "reclaimed drive must carry a healing tracker"
+
+
 def test_format_rejects_layout_change(tmp_path):
     drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(8)]
     init_format_erasure(drives, 4)
