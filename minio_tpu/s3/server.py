@@ -980,6 +980,10 @@ class S3Server:
             raise S3Error("MethodNotAllowed", resource=path)
 
         # ---------- object level ----------
+        # S3's literal versionId "null" names the null (unversioned)
+        # version; the journal resolves it to the empty stored id
+        # (storage/xlmeta.py NULL_VERSION_REQ) — passed through verbatim
+        # so it can never be mistaken for "latest" on versioned buckets.
         opts = ObjectOptions(
             version_id=q.get("versionId", ""),
             versioned=self._bucket_versioned(bucket),

@@ -50,7 +50,8 @@ FORMAT_VERSION = 2
 VTYPE_OBJECT = 1
 VTYPE_DELETE_MARKER = 2
 
-NULL_VERSION_ID = ""
+NULL_VERSION_ID = ""          # the null version's STORED id
+NULL_VERSION_REQ = "null"     # S3's request literal for that version
 
 
 def _fi_to_doc(fi: FileInfo) -> dict:
@@ -479,6 +480,8 @@ class XLMeta:
     def delete_version(self, version_id: str, volume: str, name: str) -> FileInfo:
         """Remove a version; returns the removed FileInfo (caller deletes its
         data dir)."""
+        if version_id == NULL_VERSION_REQ:
+            version_id = ""     # the null version's stored id
         for i, v in enumerate(self.versions):
             if v.vid == version_id:
                 del self._versions[i]
@@ -509,9 +512,14 @@ class XLMeta:
 
     def to_fileinfo(self, volume: str, name: str, version_id: str | None = None) -> FileInfo:
         """Resolve a version (None/'' => latest) to FileInfo — decodes
-        exactly ONE version body, the per-request fast path."""
+        exactly ONE version body, the per-request fast path. The literal
+        request id "null" names the null (unversioned) version — stored
+        with the EMPTY id — and never means "latest" (S3 semantics;
+        reference nullVersionID, cmd/xl-storage-format-v2.go)."""
         if not self.version_count:
             raise se.FileNotFound(name)
+        if version_id == NULL_VERSION_REQ:
+            return self.exact_version(volume, name, "")
         if self._versions is None:
             try:
                 idx = self._col_lookup(version_id, latest_ok=True)
@@ -536,10 +544,13 @@ class XLMeta:
 
     def exact_version(self, volume: str, name: str,
                       version_id: str) -> FileInfo:
-        """Exact-vid lookup: '' matches ONLY the null version, never
-        'latest'. The replace-reclaim paths (write_metadata/rename_data)
-        use this — resolving '' to the latest VERSIONED entry there would
-        rmtree a live version's data dir."""
+        """Exact-vid lookup: '' (or the request-literal "null") matches
+        ONLY the null version, never 'latest'. The replace-reclaim paths
+        (write_metadata/rename_data) use this — resolving '' to the
+        latest VERSIONED entry there would rmtree a live version's data
+        dir."""
+        if version_id == NULL_VERSION_REQ:
+            version_id = ""
         if self._versions is None:
             try:
                 idx = self._col_lookup(version_id, latest_ok=False)

@@ -344,3 +344,35 @@ def test_object_tags(es):
     # tags update must not break data
     _, stream = es.get_object("bucket", "obj")
     assert _read_all(stream) == b"d" * 100
+
+
+def test_version_id_null_names_null_version_not_latest(es):
+    """The request literal versionId="null" resolves to the version
+    stored with the EMPTY id (written before versioning) — never to
+    "latest" — and 404s when no null version exists (S3 semantics,
+    reference nullVersionID)."""
+    es.make_bucket("nvbkt")
+    null_body = b"unversioned-generation"
+    es.put_object("nvbkt", "k", io.BytesIO(null_body), len(null_body))
+    v2_body = b"versioned-generation-2"
+    info2 = es.put_object("nvbkt", "k", io.BytesIO(v2_body), len(v2_body),
+                          ObjectOptions(versioned=True))
+    assert info2.version_id  # a real uuid
+    # Latest is v2...
+    _i, st = es.get_object("nvbkt", "k")
+    assert b"".join(st) == v2_body
+    # ...but versionId=null is the original unversioned generation.
+    _i, st = es.get_object("nvbkt", "k",
+                           opts=ObjectOptions(version_id="null",
+                                              versioned=True))
+    assert b"".join(st) == null_body
+    # Deleting the null version removes exactly it.
+    es.delete_object("nvbkt", "k", ObjectOptions(version_id="null",
+                                              versioned=True))
+    _i, st = es.get_object("nvbkt", "k")
+    assert b"".join(st) == v2_body
+    with pytest.raises(se.VersionNotFound):
+        _i, st = es.get_object("nvbkt", "k",
+                               opts=ObjectOptions(version_id="null",
+                                                  versioned=True))
+        b"".join(st)

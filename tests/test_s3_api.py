@@ -450,3 +450,18 @@ def test_storage_class_config_drives_parity(tmp_path):
                   ObjectOptions(user_defined={
                       "x-amz-storage-class": "REDUCED_REDUNDANCY"}))
     assert es.latest_fileinfo("scp", "rrs").erasure.parity_blocks == 2
+
+
+def test_version_id_null_addresses_unversioned_object(client, bucket):
+    """S3's literal versionId=null names the null (unversioned) version:
+    GET/HEAD/DELETE with ?versionId=null must hit the object written
+    without versioning (gsutil addresses objects as key#null)."""
+    body = b"null-version-body"
+    assert client.put(f"/{bucket}/nullv", data=body).status_code == 200
+    r = client.get(f"/{bucket}/nullv", query={"versionId": "null"})
+    assert r.status_code == 200 and r.content == body
+    r = client.head(f"/{bucket}/nullv", query={"versionId": "null"})
+    assert r.status_code == 200
+    r = client.delete(f"/{bucket}/nullv", query={"versionId": "null"})
+    assert r.status_code in (200, 204)
+    assert client.get(f"/{bucket}/nullv").status_code == 404
