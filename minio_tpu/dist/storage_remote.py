@@ -162,9 +162,19 @@ def storage_routes(drives: dict[str, LocalDrive]) -> dict:
                                 fi_from_wire(unpack(body.read(-1))))
 
     def h_rename_data(p, body):
-        drive(p).rename_data(p["svol"], p["spath"],
+        tok = drive(p).rename_data(
+            p["svol"], p["spath"], fi_from_wire(unpack(body.read(-1))),
+            p["dvol"], p["dpath"],
+            defer_reclaim=p.get("defer") == "1")
+        return pack({"token": tok or ""})
+
+    def h_commit_rename(p, body):
+        drive(p).commit_rename(p.get("token", ""))
+
+    def h_undo_rename(p, body):
+        drive(p).undo_rename(p["vol"], p["path"],
                              fi_from_wire(unpack(body.read(-1))),
-                             p["dvol"], p["dpath"])
+                             p.get("token", "") or None)
 
     def h_verify_file(p, body):
         drive(p).verify_file(p["vol"], p["path"],
@@ -410,10 +420,22 @@ class RemoteDrive(StorageAPI):
                    vol=volume, path=path)
 
     def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
-                    dst_volume: str, dst_path: str) -> None:
-        self._call("rename_data", body=pack(fi_to_wire(fi)),
-                   svol=src_volume, spath=src_path,
-                   dvol=dst_volume, dpath=dst_path)
+                    dst_volume: str, dst_path: str,
+                    defer_reclaim: bool = False) -> "str | None":
+        doc = self._call("rename_data", body=pack(fi_to_wire(fi)),
+                         svol=src_volume, spath=src_path,
+                         dvol=dst_volume, dpath=dst_path,
+                         defer="1" if defer_reclaim else "0")
+        tok = (doc or {}).get("token", "")
+        return tok or None
+
+    def commit_rename(self, token: str) -> None:
+        self._call("commit_rename", token=token or "")
+
+    def undo_rename(self, volume: str, path: str, fi: FileInfo,
+                    token: "str | None") -> None:
+        self._call("undo_rename", body=pack(fi_to_wire(fi)),
+                   vol=volume, path=path, token=token or "")
 
     # -- verification / listing --
 

@@ -311,6 +311,7 @@ class MultipartMixin:
         )
 
         tmp_rel = f"tmp/{uuid.uuid4().hex}"
+        tokens: list = [None] * len(shuffled)
 
         def commit(i, drive):
             for p in parts:
@@ -318,42 +319,70 @@ class MultipartMixin:
                                   SYS_VOL, f"{tmp_rel}/part.{p.part_number}")
             f = fi.clone()
             f.erasure.index = i + 1
-            drive.rename_data(SYS_VOL, tmp_rel, f, bucket, obj)
+            tokens[i] = drive.rename_data(SYS_VOL, tmp_rel, f, bucket, obj,
+                                          defer_reclaim=True)
 
-        # Commit under the per-object namespace lock (reference takes the
-        # dist lock around CompleteMultipartUpload's rename commit).
+        # Commit under the per-object namespace lock — INCLUDING the
+        # quorum decision and any undo: the undo mutates the live object
+        # namespace (undo_rename, pulling parts back out of the object's
+        # data dir), and a concurrent PUT landing between commit and
+        # undo must never have its acknowledged version destroyed
+        # (reference takes the dist lock around CompleteMultipartUpload's
+        # whole rename commit).
         with self.nslock.lock(bucket, obj):
             outcomes = parallel_map(
                 [lambda i=i, d=d: commit(i, d) for i, d in enumerate(shuffled)]
             )
-        try:
-            reduce_write_quorum(outcomes, write_quorum, bucket, obj)
-        except Exception:
-            # Quorum failed after parts may have moved into tmp: move them
-            # BACK into the session so the client can retry Complete —
-            # uploaded part data must never be destroyed by a transient
-            # failure.
-            def restore(drive):
-                for p in parts:
+            try:
+                reduce_write_quorum(outcomes, write_quorum, bucket, obj)
+            except Exception:
+                # Quorum failed: move parts BACK into the session so the
+                # client can retry Complete — uploaded part data must
+                # never be destroyed by a transient failure. Drives whose
+                # commit SUCCEEDED hold the parts inside the new object
+                # data dir; pull them back out, then undo the rename
+                # (dropping the new journal entry and restoring whatever
+                # it displaced), so listings never show a below-quorum
+                # object.
+                undo_fi = fi.clone()
+
+                def restore(i, drive):
+                    src = (f"{obj}/{fi.data_dir}"
+                           if outcomes[i] is None else tmp_rel)
+                    src_vol = bucket if outcomes[i] is None else SYS_VOL
+                    for p in parts:
+                        try:
+                            drive.rename_file(
+                                src_vol, f"{src}/part.{p.part_number}",
+                                SYS_VOL, f"{mp}/part.{p.part_number}")
+                        except se.StorageError:
+                            pass
+                    if outcomes[i] is None:
+                        try:
+                            drive.undo_rename(bucket, obj, undo_fi,
+                                              tokens[i])
+                        except se.StorageError:
+                            pass
                     try:
-                        drive.rename_file(SYS_VOL, f"{tmp_rel}/part.{p.part_number}",
-                                          SYS_VOL, f"{mp}/part.{p.part_number}")
+                        drive.delete(SYS_VOL, tmp_rel, recursive=True)
                     except se.StorageError:
                         pass
-                try:
-                    drive.delete(SYS_VOL, tmp_rel, recursive=True)
-                except se.StorageError:
-                    pass
 
-            parallel_map([lambda d=d: restore(d) for d in shuffled])
-            raise
-        # Success: reclaim tmp leftovers on drives whose commit failed midway.
-        for i, o in enumerate(outcomes):
-            if isinstance(o, Exception):
-                try:
-                    shuffled[i].delete(SYS_VOL, tmp_rel, recursive=True)
-                except se.StorageError:
-                    pass
+                parallel_map([lambda i=i, d=d: restore(i, d)
+                              for i, d in enumerate(shuffled)])
+                raise
+
+        # Success: discard displaced state; reclaim tmp leftovers on
+        # drives whose commit failed midway (exceptions are captured as
+        # values by parallel_map).
+        def post_commit(i, drive):
+            if isinstance(outcomes[i], Exception):
+                drive.delete(SYS_VOL, tmp_rel, recursive=True)
+            elif tokens[i]:
+                drive.commit_rename(tokens[i])
+
+        parallel_map([lambda i=i, d=d: post_commit(i, d)
+                      for i, d in enumerate(shuffled)])
         parallel_map(
             [lambda d=d: d.delete(SYS_VOL, mp, recursive=True) for d in self.drives]
         )

@@ -377,37 +377,75 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 outcomes = parallel_map(
                     [
                         lambda d=d: d.write_metadata_single(
-                            bucket, obj, fi, raw, journal)
+                            bucket, obj, fi, raw, journal,
+                            defer_reclaim=True)
                         for d in shuffled
                     ],
                     serial=serial_writes,
                 )
-                reduce_write_quorum(outcomes, write_quorum, bucket, obj)
+                try:
+                    reduce_write_quorum(outcomes, write_quorum, bucket, obj)
+                except Exception:
+                    # Same undo discipline as the streaming commit: an
+                    # inline overwrite below quorum must restore the
+                    # displaced generation on drives that committed.
+                    undo_fi = FileInfo(volume=bucket, name=obj,
+                                       version_id=fi.version_id)
+
+                    def undo(i, d):
+                        if not isinstance(outcomes[i], Exception):
+                            d.undo_rename(bucket, obj, undo_fi,
+                                          outcomes[i])
+
+                    parallel_map([lambda i=i, d=d: undo(i, d)
+                                  for i, d in enumerate(shuffled)])
+                    raise
+                toks = [o for o in outcomes
+                        if o and not isinstance(o, Exception)]
+                if toks:
+                    parallel_map(
+                        [lambda d=d, t=t: d.commit_rename(t)
+                         for d, t in zip(shuffled, outcomes)
+                         if t and not isinstance(t, Exception)])
             return self._fi_to_object_info(bucket, obj, fi)
 
         # Streaming erasure path.
         tmp_rel = f"tmp/{uuid.uuid4().hex}"
         sys_vol = ".mtpu.sys"
 
-        total, md5_hex, errs = self._fan_out_encode(
-            shuffled, sys_vol, f"{tmp_rel}/part.1", data, size, codec,
-            write_quorum, bucket, obj, initial=first_block,
-        )
+        def cleanup_tmp():
+            parallel_map(
+                [lambda d=d: d.delete(sys_vol, tmp_rel, recursive=True)
+                 for d in shuffled])
+
+        try:
+            total, md5_hex, errs = self._fan_out_encode(
+                shuffled, sys_vol, f"{tmp_rel}/part.1", data, size, codec,
+                write_quorum, bucket, obj, initial=first_block,
+            )
+        except (se.StorageError, se.ObjectError):
+            # Quorum lost mid-encode (InsufficientWriteQuorum is an
+            # ObjectError): the healthy drives' tmp staging must not
+            # linger — every other failure path fans out this cleanup.
+            cleanup_tmp()
+            raise
 
         if size >= 0 and total != size:
-            parallel_map(
-                [lambda d=d: d.delete(sys_vol, tmp_rel, recursive=True) for d in shuffled]
-            )
+            cleanup_tmp()
             raise se.IncompleteBody(bucket, obj, f"got {total} of {size} bytes")
 
         fi.size = total
         fi.metadata.setdefault("etag", md5_hex)
         fi.parts = [PartInfo(1, total, total, fi.mod_time)]
 
+        tokens: list = [None] * len(shuffled)
+
         def commit(i: int, drive: StorageAPI):
             if errs[i] is not None:
                 raise errs[i]
-            drive.rename_data(sys_vol, tmp_rel, _clone_for_drive(fi, i + 1), bucket, obj)
+            tokens[i] = drive.rename_data(
+                sys_vol, tmp_rel, _clone_for_drive(fi, i + 1), bucket, obj,
+                defer_reclaim=True)
 
         # Commit under the namespace lock (the reference takes the dist
         # lock just before metadata write + rename, cmd/erasure-object.go:736).
@@ -415,10 +453,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             try:
                 self._check_put_precondition(bucket, obj, opts)
             except se.ObjectError:
-                parallel_map(
-                    [lambda d=d: d.delete(sys_vol, tmp_rel, recursive=True)
-                     for d in shuffled]
-                )
+                cleanup_tmp()
                 raise
             outcomes = parallel_map(
                 [lambda i=i, d=d: commit(i, d) for i, d in enumerate(shuffled)]
@@ -426,10 +461,31 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             try:
                 reduce_write_quorum(outcomes, write_quorum, bucket, obj)
             except Exception:
-                parallel_map(
-                    [lambda d=d: d.delete(sys_vol, tmp_rel, recursive=True) for d in shuffled]
-                )
+                # Below quorum: UNDO everywhere — drives that failed
+                # still hold tmp staging; drives that committed must
+                # drop the just-written version AND restore whatever the
+                # commit displaced (a replaced version's journal entry +
+                # data dir), or listings (which union journals) would
+                # show an object GET quorum-fails on, and an overwrite
+                # would have destroyed the previous generation
+                # (reference undo-rename discipline).
+                undo_fi = FileInfo(volume=bucket, name=obj,
+                                   version_id=fi.version_id,
+                                   data_dir=fi.data_dir)
+
+                def undo(i, d):
+                    if isinstance(outcomes[i], Exception):
+                        d.delete(sys_vol, tmp_rel, recursive=True)
+                    else:
+                        d.undo_rename(bucket, obj, undo_fi, tokens[i])
+
+                parallel_map([lambda i=i, d=d: undo(i, d)
+                              for i, d in enumerate(shuffled)])
                 raise
+            # Quorum reached: discard the displaced state for good.
+            if any(tokens):
+                parallel_map([lambda d=d, t=t: d.commit_rename(t)
+                              for d, t in zip(shuffled, tokens) if t])
         # Partial success: quorum met but some drive missed the write — queue
         # it for background heal (reference addPartial, cmd/erasure-object.go:1150).
         if self.mrf is not None and any(isinstance(o, Exception) for o in outcomes):

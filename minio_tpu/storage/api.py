@@ -160,15 +160,19 @@ class StorageAPI(abc.ABC):
         (reference WriteMetadata, cmd/xl-storage.go:897)."""
 
     def write_metadata_single(self, volume: str, path: str, fi: FileInfo,
-                              raw: bytes, meta=None) -> None:
+                              raw: bytes, meta=None,
+                              defer_reclaim: bool = False) -> "str | None":
         """write_metadata specialized for a PUT whose resulting journal the
         caller ALREADY serialized (`raw` = journal holding exactly `fi`):
         a drive whose journal is absent — or holds only the version this
         write replaces — may store `raw` verbatim, skipping its own
         load+merge+serialize. Identical bytes then land on every drive of
         the set for the price of ONE serialize. Default falls back to the
-        classic merge path (remote drives ship the FileInfo over RPC)."""
+        classic merge path (remote drives ship the FileInfo over RPC).
+        defer_reclaim: park the displaced version in a reclaim capsule
+        and return its token (commit_rename/undo_rename contract)."""
         self.write_metadata(volume, path, fi)
+        return None
 
     @abc.abstractmethod
     def read_version(self, volume: str, path: str, version_id: str = "",
@@ -186,10 +190,23 @@ class StorageAPI(abc.ABC):
 
     @abc.abstractmethod
     def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
-                    dst_volume: str, dst_path: str) -> None:
+                    dst_volume: str, dst_path: str,
+                    defer_reclaim: bool = False) -> "str | None":
         """Commit: move fi.data_dir from the tmp area into the object dir and
         append fi to the journal, atomically per-drive (reference RenameData,
-        cmd/xl-storage.go:1780)."""
+        cmd/xl-storage.go:1780). defer_reclaim=True parks displaced state
+        in a reclaim capsule and returns its token (None when nothing was
+        displaced); see commit_rename/undo_rename."""
+
+    def commit_rename(self, token: str) -> None:
+        """Discard a reclaim capsule after write quorum (no-op default
+        for drives that never defer)."""
+
+    def undo_rename(self, volume: str, path: str, fi: FileInfo,
+                    token: "str | None") -> None:
+        """Roll back a committed rename_data: drop the new version and
+        restore the capsule's displaced state (reference undo-rename)."""
+        self.delete_version(volume, path, fi)
 
     # --- verification / listing ---
 

@@ -29,7 +29,8 @@ _GUARDED = {
     "read_file_range_stream", "rename_file",
     "write_metadata", "write_metadata_single", "read_version", "read_xl",
     "delete_version",
-    "rename_data", "verify_file", "check_parts",
+    "rename_data", "commit_rename", "undo_rename",
+    "verify_file", "check_parts",
 }
 
 

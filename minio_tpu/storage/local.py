@@ -455,13 +455,18 @@ class LocalDrive(StorageAPI):
                         (st.st_ino, st.st_mtime_ns, st.st_size), meta)
 
     def write_metadata_single(self, volume: str, path: str, fi: FileInfo,
-                              raw: bytes, meta=None) -> None:
+                              raw: bytes, meta=None,
+                              defer_reclaim: bool = False) -> "str | None":
         """Store the caller-serialized one-version journal directly when
         this drive's current journal is absent or holds exactly the version
         being replaced (the non-versioned overwrite); otherwise fall back
         to the classic merge. Cuts the small-object PUT from four
-        serializes to one across the set."""
+        serializes to one across the set. defer_reclaim: park the
+        displaced version (entry + data dir) in a reclaim capsule and
+        return its token — same commit_rename/undo_rename contract as
+        rename_data, so a below-quorum inline overwrite is undoable."""
         self.stat_vol(volume)
+        token: str | None = None
         try:
             cur, memo = self._cached_meta_entry(volume, path)
         except se.FileNotFound:
@@ -473,11 +478,19 @@ class LocalDrive(StorageAPI):
                     old = cur.to_fileinfo(volume, path)
                     memo[""] = old
             except se.StorageError:
-                return self.write_metadata(volume, path, fi)
-            if (cur.version_count != 1 or old.deleted
-                    or old.version_id != fi.version_id):
-                return self.write_metadata(volume, path, fi)
-            if old.data_dir and old.data_dir != fi.data_dir:
+                old = None
+            if old is not None and defer_reclaim and not old.deleted \
+                    and old.version_id == fi.version_id:
+                token = self._stash_displaced(
+                    volume, path, old,
+                    move_data=bool(old.data_dir
+                                   and old.data_dir != fi.data_dir))
+            if old is None or (cur.version_count != 1 or old.deleted
+                               or old.version_id != fi.version_id):
+                self.write_metadata(volume, path, fi)
+                return token
+            if old.data_dir and old.data_dir != fi.data_dir \
+                    and not token:
                 shutil.rmtree(
                     os.path.join(self._file_path(volume, path), old.data_dir),
                     ignore_errors=True,
@@ -504,6 +517,39 @@ class LocalDrive(StorageAPI):
         else:
             with self._meta_cache_lock:
                 self._meta_cache.pop((volume, path), None)
+        return token
+
+    def _stash_displaced(self, volume: str, path: str, old: FileInfo,
+                         move_data: bool) -> "str | None":
+        """Park a displaced version into a reclaim capsule (entry doc in
+        old.mp, data dir in olddata when move_data) and return its token.
+        A stash failure rolls the data move back and degrades to FaultyDisk
+        — the caller's quorum accounting treats it like any drive error,
+        never a stranded half-capsule."""
+        token = f"reclaim-{uuid.uuid4().hex}"
+        cap = os.path.join(self.root, SYS_VOL, "tmp", token)
+        obj_dir = self._file_path(volume, path)
+        old_data = os.path.join(obj_dir, old.data_dir) if old.data_dir \
+            else ""
+        moved = False
+        try:
+            os.makedirs(cap, exist_ok=True)
+            oldj = XLMeta()
+            oldj.add_version(old)
+            with open(os.path.join(cap, "old.mp"), "wb") as f:
+                f.write(oldj.serialize())
+            if move_data and os.path.isdir(old_data):
+                os.replace(old_data, os.path.join(cap, "olddata"))
+                moved = True
+        except OSError as e:
+            if moved:
+                try:
+                    os.replace(os.path.join(cap, "olddata"), old_data)
+                except OSError:
+                    pass
+            shutil.rmtree(cap, ignore_errors=True)
+            raise se.FaultyDisk(f"reclaim stash: {e}") from e
+        return token
 
     def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
         self.stat_vol(volume)
@@ -579,10 +625,21 @@ class LocalDrive(StorageAPI):
             self._prune_empty_parents(os.path.dirname(obj_dir), volume)
 
     def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
-                    dst_volume: str, dst_path: str) -> None:
+                    dst_volume: str, dst_path: str,
+                    defer_reclaim: bool = False) -> str | None:
+        """Commit staged data + journal entry. defer_reclaim=True defers
+        destruction of whatever this commit DISPLACES (a replaced
+        version's data dir, a clobbered stale data dir, the replaced
+        journal entry) into a reclaim capsule under the sys tmp area and
+        returns its token: the caller purges it after write quorum
+        (commit_rename) or restores it on quorum failure (undo_rename) —
+        the reference's commitRenameDataDir/undo discipline. Default
+        (False) reclaims inline, the pre-existing single-drive
+        semantics."""
         src_dir = self._file_path(src_volume, src_path)
         obj_dir = self._file_path(dst_volume, dst_path)
         os.makedirs(obj_dir, exist_ok=True)
+        token: str | None = None
         if fi.data_dir:
             dst_data = os.path.join(obj_dir, fi.data_dir)
             # Healing overwrites an existing (corrupt/stale) data dir.
@@ -605,6 +662,9 @@ class LocalDrive(StorageAPI):
                     os.replace(aside, dst_data)
                 raise se.FaultyDisk(str(e)) from e
             if aside:
+                # Defer-mode callers (PUT/complete commits) never clobber
+                # an existing data dir of the same name — that is the
+                # heal flow — so the aside is reclaimed inline either way.
                 shutil.rmtree(aside, ignore_errors=True)
         try:
             meta = self._load_meta(dst_volume, dst_path)
@@ -617,16 +677,70 @@ class LocalDrive(StorageAPI):
             # a corrupted destination xl.meta; heal re-adds the rest).
             meta = XLMeta()
         # Replacing a null version: reclaim its data dir (exact-vid — see
-        # write_metadata).
+        # write_metadata), or park the whole displaced version in a
+        # reclaim capsule when the caller wants the commit undoable.
         try:
             old = meta.exact_version(dst_volume, dst_path, fi.version_id)
-            if old.data_dir and old.data_dir != fi.data_dir and not old.deleted:
-                shutil.rmtree(os.path.join(obj_dir, old.data_dir), ignore_errors=True)
+            displaces_data = (old.data_dir and old.data_dir != fi.data_dir
+                              and not old.deleted)
+            if defer_reclaim:
+                token = self._stash_displaced(
+                    dst_volume, dst_path, old,
+                    move_data=bool(displaces_data))
+            elif displaces_data:
+                shutil.rmtree(os.path.join(obj_dir, old.data_dir),
+                              ignore_errors=True)
+        except se.FileVersionNotFound:
+            pass
         except se.StorageError:
             pass
         meta.add_version(fi)
         self._store_meta(dst_volume, dst_path, meta)
         _fsync_dir(obj_dir)
+        return token
+
+    def commit_rename(self, token: str) -> None:
+        """Quorum reached: discard the displaced state for good."""
+        if not token or "/" in token or ".." in token:
+            return
+        shutil.rmtree(os.path.join(self.root, SYS_VOL, "tmp", token),
+                      ignore_errors=True)
+
+    def undo_rename(self, volume: str, path: str, fi: FileInfo,
+                    token: str | None) -> None:
+        """Quorum failed on other drives: remove the committed version
+        and restore what rename_data displaced, so the drive rejoins the
+        pre-PUT state (listings must not show a below-quorum object, and
+        a replaced version's data must survive)."""
+        try:
+            self.delete_version(volume, path, fi)
+        except se.StorageError:
+            pass
+        if not token or "/" in token or ".." in token:
+            return
+        cap = os.path.join(self.root, SYS_VOL, "tmp", token)
+        if not os.path.isdir(cap):
+            return
+        obj_dir = self._file_path(volume, path)
+        oldmp = os.path.join(cap, "old.mp")
+        if os.path.exists(oldmp):
+            try:
+                oldj = XLMeta.parse(open(oldmp, "rb").read())
+                old = oldj.to_fileinfo(volume, path)
+                olddata = os.path.join(cap, "olddata")
+                if os.path.isdir(olddata) and old.data_dir:
+                    os.makedirs(obj_dir, exist_ok=True)
+                    os.replace(olddata,
+                               os.path.join(obj_dir, old.data_dir))
+                try:
+                    meta = self._load_meta(volume, path)
+                except se.StorageError:
+                    meta = XLMeta()
+                meta.add_version(old)
+                self._store_meta(volume, path, meta)
+            except (se.StorageError, OSError):
+                pass    # best-effort: heal converges the remainder
+        shutil.rmtree(cap, ignore_errors=True)
 
     # ---------- verification / walking ----------
 

@@ -13,23 +13,33 @@ import threading
 class NaughtyDisk:
     def __init__(self, inner, per_call: dict[int, Exception] | None = None,
                  per_method: dict[str, Exception] | None = None,
-                 default: Exception | None = None):
+                 default: Exception | None = None,
+                 per_method_call: dict | None = None):
         """per_call: {global call index (1-based): error to raise};
         per_method: {method name: error} (every call of that method fails);
-        default: raised for any call index not in per_call (when set)."""
+        per_method_call: {(method name, k): error} — fail only the k-th
+        call OF THAT METHOD (1-based), the reference naughty-disk's
+        per-call error matrices; default: raised for any call index not
+        in per_call (when set)."""
         self.inner = inner
         self.per_call = per_call or {}
         self.per_method = per_method or {}
+        self.per_method_call = per_method_call or {}
         self.default = default
         self.calls = 0
+        self.method_calls: dict[str, int] = {}
         self._mu = threading.Lock()
 
     def _maybe_fail(self, name: str) -> None:
         with self._mu:
             self.calls += 1
             n = self.calls
+            self.method_calls[name] = self.method_calls.get(name, 0) + 1
+            mk = self.method_calls[name]
         if name in self.per_method:
             raise self.per_method[name]
+        if (name, mk) in self.per_method_call:
+            raise self.per_method_call[(name, mk)]
         if n in self.per_call:
             raise self.per_call[n]
         if self.default is not None and self.per_call:
@@ -46,10 +56,13 @@ class NaughtyDisk:
         def wrapped(*a, **kw):
             # Specialized read entry points ALSO honor their base
             # method's fault program: a hook keyed on the specific name
-            # fires first; otherwise read_file_range_stream falls back
-            # to read_file_stream's program.
-            if name == "read_file_range_stream" \
-                    and name not in self.per_method:
+            # (per_method OR per_method_call) fires first; otherwise
+            # read_file_range_stream falls back to read_file_stream's
+            # program.
+            if (name == "read_file_range_stream"
+                    and name not in self.per_method
+                    and not any(k[0] == name
+                                for k in self.per_method_call)):
                 self._maybe_fail("read_file_stream")
             else:
                 self._maybe_fail(name)

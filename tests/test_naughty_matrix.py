@@ -1,0 +1,277 @@
+"""Per-call fault-injection matrices over the stateful paths — the
+reference's naughty-disk error-matrix tier (cmd/naughty-disk_test.go +
+cmd/erasure-healing_test.go et al.): instead of wrecking files on disk,
+sweep "the i-th call of method M on drive D fails" through healing,
+complete-multipart and paged listing, asserting the TWO invariants a
+quorum system owes its callers at every injection point:
+
+  1. the operation either succeeds (fault absorbed by quorum/fallback)
+     or raises a CLEAN typed error (StorageError/ObjectError) — never an
+     unhandled exception;
+  2. no torn state: afterwards reads return exactly the right bytes,
+     listings the right names, and a fault-free retry of the operation
+     converges.
+"""
+
+import io
+import os
+
+import pytest
+
+from minio_tpu.erasure import ErasureObjects
+from minio_tpu.erasure.types import CompletePart, ObjectOptions
+from minio_tpu.storage import LocalDrive
+from minio_tpu.utils import errors as se
+from tests.naughty import NaughtyDisk
+
+CLEAN = (se.StorageError, se.ObjectError)
+
+METHODS = ("write_metadata", "rename_data", "read_file_stream",
+           "read_version")
+INDICES = (1, 2, 3, 5)
+
+
+def _drives(tmp_path, tag, n=4):
+    return [LocalDrive(str(tmp_path / f"{tag}-d{i}")) for i in range(n)]
+
+
+def _set(tmp_path, tag):
+    drives = _drives(tmp_path, tag)
+    es = ErasureObjects(drives, parity=1)
+    es.make_bucket("bkt")
+    return es, drives
+
+
+def _err(method, idx):
+    return {(method, idx): se.FaultyDisk(f"naughty {method}#{idx}")}
+
+
+# ---------------------------------------------------------------------------
+# heal
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("method", METHODS)
+@pytest.mark.parametrize("idx", INDICES)
+def test_heal_error_matrix(tmp_path, method, idx):
+    data = os.urandom(300_000)
+    # Build cleanly, then inject on drive 1 for the heal itself.
+    es, drives = _set(tmp_path, f"h{method}{idx}")
+    es.put_object("bkt", "obj", io.BytesIO(data), len(data))
+    # Wreck drive 3's copy (the heal target); drive 1 misbehaves mid-heal.
+    import shutil
+    shutil.rmtree(os.path.join(drives[3].root, "bkt", "obj"))
+    es.close()
+
+    drives2 = _drives(tmp_path, f"h{method}{idx}")
+    drives2[1] = NaughtyDisk(drives2[1], per_method_call=_err(method, idx))
+    es2 = ErasureObjects(drives2, parity=1)
+    try:
+        es2.heal_object("bkt", "obj")
+    except CLEAN:
+        pass                       # clean typed failure is acceptable
+    # Invariant: reads stay exact regardless of the heal outcome.
+    _i, st = es2.get_object("bkt", "obj")
+    assert b"".join(st) == data
+    es2.close()
+    # Fault-free retry converges: the wrecked copy is restored on disk.
+    drives3 = _drives(tmp_path, f"h{method}{idx}")
+    es3 = ErasureObjects(drives3, parity=1)
+    res = es3.heal_object("bkt", "obj")
+    assert os.path.isdir(os.path.join(drives3[3].root, "bkt", "obj"))
+    _i, st = es3.get_object("bkt", "obj")
+    assert b"".join(st) == data
+    es3.close()
+
+
+# ---------------------------------------------------------------------------
+# complete-multipart
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("method", METHODS)
+@pytest.mark.parametrize("idx", INDICES)
+def test_complete_multipart_error_matrix(tmp_path, method, idx):
+    part1 = os.urandom(5 << 20)            # S3 minimum for non-last parts
+    part2 = os.urandom(120_000)
+    es, drives = _set(tmp_path, f"m{method}{idx}")
+    uid = es.new_multipart_upload("bkt", "mp")
+    r1 = es.put_object_part("bkt", "mp", uid, 1, io.BytesIO(part1),
+                            len(part1))
+    r2 = es.put_object_part("bkt", "mp", uid, 2, io.BytesIO(part2),
+                            len(part2))
+    es.close()
+
+    drives2 = _drives(tmp_path, f"m{method}{idx}")
+    drives2[1] = NaughtyDisk(drives2[1], per_method_call=_err(method, idx))
+    es2 = ErasureObjects(drives2, parity=1)
+    completed = False
+    try:
+        es2.complete_multipart_upload(
+            "bkt", "mp", uid,
+            [CompletePart(1, r1.etag), CompletePart(2, r2.etag)])
+        completed = True
+    except CLEAN:
+        pass
+    want = part1 + part2
+    es2.close()
+    drives3 = _drives(tmp_path, f"m{method}{idx}")
+    es3 = ErasureObjects(drives3, parity=1)
+    if completed:
+        # All-or-nothing: the committed object is exact.
+        _i, st = es3.get_object("bkt", "mp")
+        assert b"".join(st) == want
+    else:
+        # Clean failure: NO partial object is ever visible, and a
+        # fault-free retry of the SAME complete still succeeds.
+        with pytest.raises(CLEAN):
+            _i, st = es3.get_object("bkt", "mp")
+            b"".join(st)
+        es3.complete_multipart_upload(
+            "bkt", "mp", uid,
+            [CompletePart(1, r1.etag), CompletePart(2, r2.etag)])
+        _i, st = es3.get_object("bkt", "mp")
+        assert b"".join(st) == want
+    es3.close()
+
+
+# ---------------------------------------------------------------------------
+# paged listing
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("method", ("walk_dir", "read_version", "read_all"))
+@pytest.mark.parametrize("idx", INDICES)
+def test_paged_listing_error_matrix(tmp_path, method, idx):
+    names = [f"o{i:03d}" for i in range(40)]
+    es, drives = _set(tmp_path, f"l{method}{idx}")
+    for n in names:
+        es.put_object("bkt", n, io.BytesIO(b"x" * 2048), 2048)
+    es.close()
+
+    drives2 = _drives(tmp_path, f"l{method}{idx}")
+    drives2[1] = NaughtyDisk(drives2[1], per_method_call=_err(method, idx))
+    es2 = ErasureObjects(drives2, parity=1)
+    got: list[str] = []
+    marker = ""
+    pages = 0
+    try:
+        while True:
+            res = es2.list_objects("bkt", marker=marker, max_keys=7)
+            got.extend(o.name for o in res.objects)
+            pages += 1
+            assert pages < 30
+            if not res.is_truncated:
+                break
+            marker = res.next_marker
+        # Fault absorbed: the listing must be COMPLETE and exact — a
+        # silently shortened page is torn state, not tolerance.
+        assert got == names
+    except CLEAN:
+        pass
+    es2.close()
+    # Fault-free listing is exact.
+    drives3 = _drives(tmp_path, f"l{method}{idx}")
+    es3 = ErasureObjects(drives3, parity=1)
+    got3, marker = [], ""
+    while True:
+        res = es3.list_objects("bkt", marker=marker, max_keys=7)
+        got3.extend(o.name for o in res.objects)
+        if not res.is_truncated:
+            break
+        marker = res.next_marker
+    assert got3 == names
+    es3.close()
+
+
+# ---------------------------------------------------------------------------
+# double fault: beyond parity -> clean quorum error, still no torn state
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("method", ("create_file", "rename_data"))
+def test_double_fault_put_is_atomic(tmp_path, method):
+    data = os.urandom(200_000)
+    drives = _drives(tmp_path, f"df{method}")
+    # Two drives fail the FIRST call of the method: with parity 1 the
+    # write quorum (3) is unreachable -> the PUT must fail cleanly.
+    for slot in (1, 2):
+        drives[slot] = NaughtyDisk(
+            drives[slot], per_method={method: se.FaultyDisk("df")})
+    es = ErasureObjects(drives, parity=1)
+    es.make_bucket("bkt")
+    with pytest.raises(CLEAN):
+        es.put_object("bkt", "atomic", io.BytesIO(data), len(data))
+    es.close()
+    # No partial object is ever visible afterwards.
+    drives2 = _drives(tmp_path, f"df{method}")
+    es2 = ErasureObjects(drives2, parity=1)
+    with pytest.raises(CLEAN):
+        _i, st = es2.get_object("bkt", "atomic")
+        b"".join(st)
+    res = es2.list_objects("bkt")
+    assert all(o.name != "atomic" for o in res.objects)
+    es2.close()
+
+
+def test_double_fault_overwrite_preserves_old_generation(tmp_path):
+    """A below-quorum OVERWRITE must leave the previous generation fully
+    intact: readable bytes, single listing entry — the commit's deferred
+    reclaim + undo_rename restores the displaced version (reference
+    undo-rename discipline)."""
+    old = os.urandom(180_000)
+    drives = _drives(tmp_path, "ow")
+    es = ErasureObjects(drives, parity=1)
+    es.make_bucket("bkt")
+    es.put_object("bkt", "keep", io.BytesIO(old), len(old))
+    es.close()
+
+    drives2 = _drives(tmp_path, "ow")
+    for slot in (1, 2):
+        drives2[slot] = NaughtyDisk(
+            drives2[slot], per_method={"rename_data": se.FaultyDisk("ow")})
+    es2 = ErasureObjects(drives2, parity=1)
+    with pytest.raises(CLEAN):
+        es2.put_object("bkt", "keep", io.BytesIO(os.urandom(180_000)),
+                       180_000)
+    es2.close()
+
+    drives3 = _drives(tmp_path, "ow")
+    es3 = ErasureObjects(drives3, parity=1)
+    _i, st = es3.get_object("bkt", "keep")
+    assert b"".join(st) == old, "overwrite failure destroyed old bytes"
+    res = es3.list_objects("bkt")
+    assert [o.name for o in res.objects] == ["keep"]
+    # And the drive-level state converges: a fault-free heal reports OK.
+    es3.heal_object("bkt", "keep")
+    _i, st = es3.get_object("bkt", "keep")
+    assert b"".join(st) == old
+    es3.close()
+
+
+def test_double_fault_inline_overwrite_preserves_old_generation(tmp_path):
+    """A below-quorum INLINE overwrite (small body over a large object)
+    takes the write_metadata_single fast path — it must honor the same
+    undo discipline: the old generation's data dir and journal entry
+    survive."""
+    old = os.urandom(180_000)              # streaming generation
+    drives = _drives(tmp_path, "iow")
+    es = ErasureObjects(drives, parity=1)
+    es.make_bucket("bkt")
+    es.put_object("bkt", "keep", io.BytesIO(old), len(old))
+    es.close()
+
+    drives2 = _drives(tmp_path, "iow")
+    for slot in (1, 2):
+        drives2[slot] = NaughtyDisk(
+            drives2[slot],
+            per_method={"write_metadata_single": se.FaultyDisk("iow"),
+                        "write_metadata": se.FaultyDisk("iow")})
+    es2 = ErasureObjects(drives2, parity=1)
+    with pytest.raises(CLEAN):
+        es2.put_object("bkt", "keep", io.BytesIO(b"tiny"), 4)  # inline
+    es2.close()
+
+    drives3 = _drives(tmp_path, "iow")
+    es3 = ErasureObjects(drives3, parity=1)
+    _i, st = es3.get_object("bkt", "keep")
+    assert b"".join(st) == old, "inline overwrite failure destroyed old bytes"
+    res = es3.list_objects("bkt")
+    assert [o.name for o in res.objects] == ["keep"]
+    es3.close()
