@@ -20,7 +20,7 @@ from minio_tpu.dist.rpc import RestClient, pack, unpack
 from minio_tpu.storage.api import DiskInfo, StorageAPI, VolInfo, WalkEntry
 from minio_tpu.storage.fileinfo import FileInfo
 from minio_tpu.storage.local import LocalDrive
-from minio_tpu.storage.xlmeta import _doc_to_fi, _fi_to_doc
+from minio_tpu.storage.xlmeta import XLMeta, _doc_to_fi, _fi_to_doc
 from minio_tpu.utils import errors as se
 
 PLANE = "storage"
@@ -147,6 +147,18 @@ def storage_routes(drives: dict[str, LocalDrive]) -> dict:
     def h_write_metadata(p, body):
         drive(p).write_metadata(p["vol"], p["path"],
                                 fi_from_wire(unpack(body.read(-1))))
+
+    def h_write_metadata_single(p, body):
+        # `raw` IS a journal holding exactly the one version being
+        # written — reconstruct fi (and the journal-cache seed) from it
+        # instead of shipping the inline body twice on the wire.
+        raw = body.read(-1)
+        journal = XLMeta.parse(raw)
+        fi = journal.to_fileinfo(p["vol"], p["path"])
+        tok = drive(p).write_metadata_single(
+            p["vol"], p["path"], fi, raw, meta=journal,
+            defer_reclaim=p.get("defer") == "1")
+        return pack({"token": tok or ""})
 
     def h_read_version(p, body):
         fi = drive(p).read_version(p["vol"], p["path"],
@@ -404,6 +416,21 @@ class RemoteDrive(StorageAPI):
     def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
         self._call("write_metadata", body=pack(fi_to_wire(fi)),
                    vol=volume, path=path)
+
+    def write_metadata_single(self, volume: str, path: str, fi: FileInfo,
+                              raw: bytes, meta=None,
+                              defer_reclaim: bool = False) -> "str | None":
+        """Ships ONLY the pre-serialized journal (which holds exactly
+        `fi`, inline body included) — the server reconstructs fi and the
+        cache seed from it — keeping the single-serialize fast path AND
+        the deferred-reclaim contract over the wire (the base-class
+        default would fall back to the merge path with no undo
+        capsule)."""
+        doc = self._call("write_metadata_single", body=raw,
+                         vol=volume, path=path,
+                         defer="1" if defer_reclaim else "0")
+        tok = (doc or {}).get("token", "")
+        return tok or None
 
     def read_version(self, volume: str, path: str, version_id: str = "",
                      read_data: bool = False) -> FileInfo:

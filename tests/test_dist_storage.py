@@ -286,3 +286,47 @@ def test_native_get_lane_mixed_local_remote(tmp_path, monkeypatch):
     finally:
         srv.close()
         client.close()
+
+
+def test_remote_write_metadata_single_defer_and_undo(node):
+    """The inline-PUT fast path over RPC: the pre-serialized journal
+    ships once, defer_reclaim returns a capsule token, undo_rename
+    restores the displaced generation, commit_rename discards it."""
+    from minio_tpu.storage.xlmeta import XLMeta
+
+    _srv, drives, remotes = node
+    r = remotes[0]
+    r.make_vol("bkt")
+
+    def fi_for(body: bytes, vid: str = "") -> FileInfo:
+        f = FileInfo(volume="bkt", name="obj", version_id=vid,
+                     mod_time=1000.0)
+        f.size = len(body)
+        f.inline_data = body
+        f.metadata = {"etag": "x" * 32}
+        return f
+
+    old = fi_for(b"old-generation")
+    j = XLMeta(); j.add_version(old)
+    tok = r.write_metadata_single("bkt", "obj", old, j.serialize())
+    assert tok is None              # nothing displaced on first write
+    assert r.read_version("bkt", "obj", "").inline_data == b"old-generation"
+
+    # Overwrite with defer: the displaced version parks in a capsule.
+    new = fi_for(b"new-generation")
+    new.mod_time = 2000.0
+    j2 = XLMeta(); j2.add_version(new)
+    tok = r.write_metadata_single("bkt", "obj", new, j2.serialize(),
+                                  defer_reclaim=True)
+    assert tok, "overwrite must return a reclaim token"
+    assert r.read_version("bkt", "obj", "").inline_data == b"new-generation"
+
+    # Undo restores the old generation across the wire.
+    r.undo_rename("bkt", "obj", new, tok)
+    assert r.read_version("bkt", "obj", "").inline_data == b"old-generation"
+
+    # And a committed overwrite stays committed after commit_rename.
+    tok = r.write_metadata_single("bkt", "obj", new, j2.serialize(),
+                                  defer_reclaim=True)
+    r.commit_rename(tok)
+    assert r.read_version("bkt", "obj", "").inline_data == b"new-generation"
