@@ -15,7 +15,7 @@ import time
 from aiohttp import web
 
 from minio_tpu.admin.configkv import ConfigSys
-from minio_tpu.admin.metrics import collect_metrics
+from minio_tpu.admin.metrics import PROM_CONTENT_TYPE, collect_metrics
 from minio_tpu.iam.policy import PolicyArgs
 from minio_tpu.s3.errors import S3Error
 from minio_tpu.utils import errors as se
@@ -69,7 +69,8 @@ class AdminAPI:
             body = await run(
                 collect_metrics, self.s.obj, self.s.stats,
                 self.s.scanner.usage if self.s.scanner else None)
-            return web.Response(body=body, content_type="text/plain")
+            return web.Response(body=body,
+                                headers={"Content-Type": PROM_CONTENT_TYPE})
 
         if op == "heal":
             self._authorize(identity, "admin:Heal")
@@ -107,7 +108,8 @@ class AdminAPI:
             self._authorize(identity, "admin:ServerTrace")
             return await self._bus_stream(request, self.s.trace_bus,
                                           peer_stream="trace_stream",
-                                          all_nodes=q.get("all", "true") != "false")
+                                          all_nodes=q.get("all", "true") != "false",
+                                          type_filter=q.get("type", ""))
         if op == "consolelog" and m == "GET":
             self._authorize(identity, "admin:ConsoleLog")
             return await self._bus_stream(request,
@@ -466,11 +468,14 @@ class AdminAPI:
         raise S3Error("MethodNotAllowed", resource=request.path)
 
     async def _bus_stream(self, request, bus, peer_stream: str = "",
-                          all_nodes: bool = True) -> web.StreamResponse:
+                          all_nodes: bool = True,
+                          type_filter: str = "") -> web.StreamResponse:
         """Stream a local pubsub as JSON lines, merged with every peer's
         matching stream (reference `mc admin trace`/`console` subscribe to
         all nodes via peer REST, cmd/peer-rest-client.go:782): peer pullers
-        run in daemon threads feeding the same local queue."""
+        run in daemon threads feeding the same local queue. `type_filter`
+        keeps only records of one trace type — http/storage/rpc/internal —
+        the `mc admin trace --call storage/internal` selector."""
         import queue as _queue
         import threading as _threading
 
@@ -517,6 +522,8 @@ class AdminAPI:
                     if item is None:
                         # Heartbeat keeps the connection honest.
                         await resp.write(b"\n")
+                        continue
+                    if type_filter and item.get("type", "") != type_filter:
                         continue
                     await resp.write(json.dumps(item).encode() + b"\n")
             except (ConnectionResetError, asyncio.CancelledError):

@@ -10,6 +10,12 @@ from __future__ import annotations
 
 import time
 
+from minio_tpu import obs
+
+# Prometheus text exposition 0.0.4 — scrapers content-negotiate on the
+# version parameter; bare text/plain is rejected by strict clients.
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4"
+
 
 def _esc(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
@@ -42,8 +48,8 @@ def collect_metrics(object_layer, stats, usage=None,
 
     # -- process --
     p.family("minio_tpu_process_uptime_seconds", "Server uptime", "counter")
-    p.sample("minio_tpu_process_uptime_seconds",
-             round(time.time() - (started or stats.started), 3))
+    up = stats.uptime() if started is None else time.time() - started
+    p.sample("minio_tpu_process_uptime_seconds", round(up, 3))
 
     # -- per-API request stats --
     snap = stats.snapshot()
@@ -51,6 +57,12 @@ def collect_metrics(object_layer, stats, usage=None,
              "Total S3 requests by API", "counter")
     p.family("minio_tpu_s3_requests_errors_total",
              "Total S3 requests that errored, by API", "counter")
+    p.family("minio_tpu_s3_requests_4xx_errors_total",
+             "Total S3 requests that errored with 4xx, by API", "counter")
+    p.family("minio_tpu_s3_requests_5xx_errors_total",
+             "Total S3 requests that errored with 5xx, by API", "counter")
+    p.family("minio_tpu_s3_requests_canceled_total",
+             "Total S3 requests canceled by the client, by API", "counter")
     p.family("minio_tpu_s3_requests_seconds_total",
              "Cumulative time serving each API", "counter")
     p.family("minio_tpu_s3_traffic_received_bytes",
@@ -60,6 +72,9 @@ def collect_metrics(object_layer, stats, usage=None,
         lbl = {"api": api}
         p.sample("minio_tpu_s3_requests_total", s["count"], lbl)
         p.sample("minio_tpu_s3_requests_errors_total", s["errors"], lbl)
+        p.sample("minio_tpu_s3_requests_4xx_errors_total", s["4xx"], lbl)
+        p.sample("minio_tpu_s3_requests_5xx_errors_total", s["5xx"], lbl)
+        p.sample("minio_tpu_s3_requests_canceled_total", s["canceled"], lbl)
         p.sample("minio_tpu_s3_requests_seconds_total", s["totalSeconds"], lbl)
         p.sample("minio_tpu_s3_traffic_received_bytes", s["rxBytes"], lbl)
         p.sample("minio_tpu_s3_traffic_sent_bytes", s["txBytes"], lbl)
@@ -106,4 +121,30 @@ def collect_metrics(object_layer, stats, usage=None,
     p.family("minio_tpu_cluster_health_status",
              "1 when every set holds write quorum")
     p.sample("minio_tpu_cluster_health_status", healthy)
+
+    # -- observability registry (latency/TTFB/drive/RPC histograms,
+    #    fabric counters, encode gauge — whatever the planes registered) --
+    obs.render_into(p)
+    _render_trace_dropped(p)
+    return p.render()
+
+
+def _render_trace_dropped(p: PromText) -> None:
+    p.family("minio_tpu_trace_dropped_total",
+             "Trace records dropped on slow trace subscribers", "counter")
+    p.sample("minio_tpu_trace_dropped_total", obs.trace_bus().dropped)
+
+
+def collect_node_metrics(stats) -> bytes:
+    """Node-scope scrape (/minio/v2/metrics/node): this process's own
+    planes — request/TTFB latency, per-drive op latency, RPC fabric —
+    without the cluster-wide capacity/usage/health collectors (the
+    reference's node vs cluster metrics-v2 split)."""
+    p = PromText()
+    p.family("minio_tpu_process_uptime_seconds", "Server uptime", "counter")
+    p.sample("minio_tpu_process_uptime_seconds", round(stats.uptime(), 3))
+    p.family("minio_tpu_s3_requests_current", "In-flight S3 requests")
+    p.sample("minio_tpu_s3_requests_current", stats.current_requests)
+    obs.render_into(p)
+    _render_trace_dropped(p)
     return p.render()

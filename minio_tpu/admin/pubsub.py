@@ -17,6 +17,10 @@ class PubSub:
         self._subs: list[queue.Queue] = []
         self._mu = threading.Lock()
         self._max_queue = max_queue
+        # Records dropped on slow consumers — silent loss would make a
+        # gappy trace look complete; exported as
+        # minio_tpu_trace_dropped_total for the process trace bus.
+        self.dropped = 0
 
     @property
     def has_subscribers(self) -> bool:
@@ -29,7 +33,8 @@ class PubSub:
             try:
                 q.put_nowait(item)
             except queue.Full:  # slow consumer: drop, never block
-                pass
+                with self._mu:
+                    self.dropped += 1
 
     def subscribe(self) -> "Subscription":
         q: queue.Queue = queue.Queue(maxsize=self._max_queue)

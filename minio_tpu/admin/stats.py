@@ -26,8 +26,15 @@ class HTTPStats:
     def __init__(self):
         self._mu = threading.Lock()
         self._apis: dict[str, _APIStat] = {}
+        # Wall clock kept for display only; every duration computes from
+        # the monotonic anchor so an NTP step can never yield a negative
+        # uptime or latency.
         self.started = time.time()
+        self._started_mono = time.monotonic()
         self.current_requests = 0
+
+    def uptime(self) -> float:
+        return time.monotonic() - self._started_mono
 
     def begin(self) -> float:
         with self._mu:
@@ -35,7 +42,7 @@ class HTTPStats:
         return time.perf_counter()
 
     def end(self, api: str, t0: float, status: int,
-            rx: int = 0, tx: int = 0) -> None:
+            rx: int = 0, tx: int = 0, canceled: bool = False) -> None:
         dt = time.perf_counter() - t0
         with self._mu:
             self.current_requests -= 1
@@ -44,7 +51,11 @@ class HTTPStats:
             st.total_seconds += dt
             st.rx_bytes += rx
             st.tx_bytes += tx
-            if status >= 500:
+            if canceled:
+                # A client disconnect is neither a 4xx nor a 5xx — it gets
+                # its own counter and stays out of the error rate.
+                st.canceled += 1
+            elif status >= 500:
                 st.errors += 1
                 st.e5xx += 1
             elif status >= 400:
@@ -54,11 +65,12 @@ class HTTPStats:
     def snapshot(self) -> dict:
         with self._mu:
             return {
-                "uptime": time.time() - self.started,
+                "uptime": self.uptime(),
                 "currentRequests": self.current_requests,
                 "apis": {
                     name: {"count": s.count, "errors": s.errors,
                            "4xx": s.e4xx, "5xx": s.e5xx,
+                           "canceled": s.canceled,
                            "totalSeconds": round(s.total_seconds, 6),
                            "rxBytes": s.rx_bytes, "txBytes": s.tx_bytes}
                     for name, s in self._apis.items()},

@@ -29,7 +29,7 @@ import hashlib
 import os
 import struct
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+from minio_tpu.crypto.aead import AESGCM
 
 from minio_tpu.native import lib as nativelib
 

@@ -17,7 +17,7 @@ import base64
 import os
 import secrets as pysecrets
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+from minio_tpu.crypto.aead import AESGCM
 
 
 class KMSError(Exception):

@@ -23,7 +23,7 @@ import os
 import struct
 from typing import BinaryIO
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+from minio_tpu.crypto.aead import AESGCM
 
 CHUNK_SIZE = 64 << 10
 TAG_SIZE = 16

@@ -25,11 +25,28 @@ from typing import BinaryIO, Iterable, Iterator
 
 import msgpack
 
+from minio_tpu import obs
 from minio_tpu.utils import errors as se
 
 DEFAULT_TIMEOUT = 30.0
 HEALTH_INTERVAL = 1.0
 ERR_STATUS = 599  # carries a typed storage error in the body
+
+# Fabric observability: the r5 TCP_NODELAY fix and the adaptive connect
+# deadline are only provable with a live latency distribution + failure
+# counters per peer (reference minio_inter_node_* metric families).
+_RPC_LATENCY = obs.histogram(
+    "minio_tpu_rpc_latency_seconds",
+    "Inter-node RPC call latency by peer", ("peer",))
+_RPC_ERRORS = obs.counter(
+    "minio_tpu_rpc_errors_total",
+    "RPC calls failed on network/timeout by peer", ("peer",))
+_RPC_OFFLINE = obs.counter(
+    "minio_tpu_rpc_offline_total",
+    "Transitions of a peer to offline", ("peer",))
+_RPC_RECONNECTS = obs.counter(
+    "minio_tpu_rpc_reconnects_total",
+    "Successful reconnects after a peer went offline", ("peer",))
 
 
 # --- auth tokens -------------------------------------------------------------
@@ -180,6 +197,12 @@ class RestClient:
         self._lock = threading.Lock()
         self._pool: list[http.client.HTTPConnection] = []
         self._probing = False
+        peer = f"{host}:{port}"
+        self._obs_peer = peer
+        self._obs_lat = _RPC_LATENCY.labels(peer=peer)
+        self._obs_err = _RPC_ERRORS.labels(peer=peer)
+        self._obs_off = _RPC_OFFLINE.labels(peer=peer)
+        self._obs_rec = _RPC_RECONNECTS.labels(peer=peer)
 
     # -- connection pool --
 
@@ -238,6 +261,7 @@ class RestClient:
             if not self._online:
                 return
             self._online = False
+            self._obs_off.inc()
             if self._probing:
                 return
             self._probing = True
@@ -259,6 +283,7 @@ class RestClient:
                 with self._lock:
                     self._online = True
                     self._probing = False
+                self._obs_rec.inc()
                 return
 
     def close(self) -> None:
@@ -271,6 +296,28 @@ class RestClient:
             self._pool.clear()
 
     # -- calls --
+
+    def _obs_done(self, path: str, dt: float, status: int = 0,
+                  err: Exception | None = None) -> None:
+        """Record one fabric round trip: latency for completed round
+        trips, the error counter for network failures, and a typed `rpc`
+        trace record when watched. Failures stay OUT of the latency
+        histogram — connect refusals (near-zero) and timeouts (deadline-
+        length) would bend the very distribution the family exists to
+        prove; they have their own counter."""
+        if err is None:
+            self._obs_lat.observe(dt)
+        else:
+            self._obs_err.inc()
+        if obs.has_subscribers():
+            rec = {"type": "rpc", "time": time.time(),
+                   "peer": self._obs_peer, "path": path,
+                   "durationNs": int(dt * 1e9)}
+            if status:
+                rec["status"] = status
+            if err is not None:
+                rec["error"] = f"{type(err).__name__}: {err}"
+            obs.publish(rec)
 
     def call(self, path: str, params: dict | None = None,
              body: bytes | Iterable[bytes] | None = None,
@@ -285,7 +332,12 @@ class RestClient:
         qs = urllib.parse.urlencode(params or {})
         url = path + ("?" + qs if qs else "")
         headers = {"Authorization": "Bearer " + sign_token(self.secret)}
-        conn = self._get_conn()
+        t_conn = time.monotonic()
+        try:
+            conn = self._get_conn()
+        except se.StorageError as e:
+            self._obs_done(path, time.monotonic() - t_conn, err=e)
+            raise
         # The adaptive deadline governs METADATA-class calls only (no
         # body / small body). Bulk transfers (chunked shard uploads) keep
         # the static timeout — a deadline converged on 10 ms metadata
@@ -317,6 +369,7 @@ class RestClient:
                 pass
             if adaptive and isinstance(e, TimeoutError):
                 self.dyn_timeout.log_failure()
+            self._obs_done(path, time.monotonic() - t0, err=e)
             self.mark_offline()
             raise se.DiskNotFound(
                 f"{self.host}:{self.port}: {e}") from e
@@ -327,11 +380,19 @@ class RestClient:
             if resp.status == ERR_STATUS:
                 doc = unpack(resp.read())
                 self._put_conn(conn)
+                # A typed storage error is a SUCCESSFUL fabric round trip
+                # — latency counts, the error counter does not.
+                self._obs_done(path, time.monotonic() - t0,
+                               status=resp.status)
                 raise se.by_name(doc.get("err", "StorageError"),
                                  doc.get("msg", ""))
             if resp.status != 200:
                 msg = resp.read()[:512].decode(errors="replace")
                 self._put_conn(conn)
+                # Completed round trip (like the 599 path): real latency,
+                # not a network failure — keep it out of the error counter.
+                self._obs_done(path, time.monotonic() - t0,
+                               status=resp.status)
                 raise se.FaultyDisk(
                     f"{self.host}:{self.port}{path}: HTTP {resp.status} {msg}")
             if stream:
@@ -341,6 +402,9 @@ class RestClient:
                 # must not kill a legitimately slow stream mid-read.
                 if conn.sock is not None:
                     conn.sock.settimeout(self.timeout)
+                # Stream latency = time to first byte; the body pays as
+                # the caller drains.
+                self._obs_done(path, time.monotonic() - t0, status=200)
                 return _ResponseStream(resp, self, conn)
             data = resp.read()
         except (OSError, http.client.HTTPException) as e:
@@ -353,10 +417,12 @@ class RestClient:
                 pass
             if isinstance(e, TimeoutError):
                 self.dyn_timeout.log_failure()
+            self._obs_done(path, time.monotonic() - t0, err=e)
             self.mark_offline()
             raise se.DiskNotFound(
                 f"{self.host}:{self.port}: {e}") from e
         self._put_conn(conn)
+        self._obs_done(path, time.monotonic() - t0, status=200)
         return data
 
     def call_msgpack(self, path: str, params: dict | None = None,

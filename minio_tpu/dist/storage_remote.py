@@ -16,6 +16,7 @@ from __future__ import annotations
 import io
 from typing import BinaryIO, Iterable, Iterator
 
+from minio_tpu import obs
 from minio_tpu.dist.rpc import RestClient, pack, unpack
 from minio_tpu.storage.api import DiskInfo, StorageAPI, VolInfo, WalkEntry
 from minio_tpu.storage.fileinfo import FileInfo
@@ -304,6 +305,10 @@ class RemoteDrive(StorageAPI):
         self._disk = disk_path
         self._endpoint = endpoint or f"{client.host}:{client.port}{disk_path}"
         self._disk_id = ""
+        # Remote drives feed the SAME drive-latency family + storage
+        # trace shape LocalDrive uses — the whole fleet as seen from this
+        # node, with the fabric hop included in the duration.
+        self._observe_op = obs.drive_op_observer(self._endpoint)
 
     def _path(self, method: str) -> str:
         return f"/rpc/{PLANE}/v1/{method}"
@@ -384,8 +389,10 @@ class RemoteDrive(StorageAPI):
 
     def create_file(self, volume: str, path: str,
                     chunks: Iterable[bytes]) -> int:
-        doc = self._call("create_file", body=chunks, vol=volume, path=path)
-        return doc["n"]
+        with obs.timed_op(self._observe_op, "create_file", volume, path):
+            doc = self._call("create_file", body=chunks, vol=volume,
+                             path=path)
+            return doc["n"]
 
     def append_file(self, volume: str, path: str, data: bytes) -> None:
         self._call("append_file", body=data, vol=volume, path=path)
@@ -426,17 +433,20 @@ class RemoteDrive(StorageAPI):
         the deferred-reclaim contract over the wire (the base-class
         default would fall back to the merge path with no undo
         capsule)."""
-        doc = self._call("write_metadata_single", body=raw,
-                         vol=volume, path=path,
-                         defer="1" if defer_reclaim else "0")
-        tok = (doc or {}).get("token", "")
-        return tok or None
+        with obs.timed_op(self._observe_op, "write_metadata_single",
+                          volume, path):
+            doc = self._call("write_metadata_single", body=raw,
+                             vol=volume, path=path,
+                             defer="1" if defer_reclaim else "0")
+            tok = (doc or {}).get("token", "")
+            return tok or None
 
     def read_version(self, volume: str, path: str, version_id: str = "",
                      read_data: bool = False) -> FileInfo:
-        doc = self._call("read_version", vol=volume, path=path,
-                         vid=version_id, data="1" if read_data else "0")
-        return fi_from_wire(doc)
+        with obs.timed_op(self._observe_op, "read_version", volume, path):
+            doc = self._call("read_version", vol=volume, path=path,
+                             vid=version_id, data="1" if read_data else "0")
+            return fi_from_wire(doc)
 
     def read_xl(self, volume: str, path: str) -> bytes:
         return self._client.call(self._path("read_xl"),
@@ -449,12 +459,14 @@ class RemoteDrive(StorageAPI):
     def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
                     dst_volume: str, dst_path: str,
                     defer_reclaim: bool = False) -> "str | None":
-        doc = self._call("rename_data", body=pack(fi_to_wire(fi)),
-                         svol=src_volume, spath=src_path,
-                         dvol=dst_volume, dpath=dst_path,
-                         defer="1" if defer_reclaim else "0")
-        tok = (doc or {}).get("token", "")
-        return tok or None
+        with obs.timed_op(self._observe_op, "rename_data",
+                          dst_volume, dst_path):
+            doc = self._call("rename_data", body=pack(fi_to_wire(fi)),
+                             svol=src_volume, spath=src_path,
+                             dvol=dst_volume, dpath=dst_path,
+                             defer="1" if defer_reclaim else "0")
+            tok = (doc or {}).get("token", "")
+            return tok or None
 
     def commit_rename(self, token: str) -> None:
         self._call("commit_rename", token=token or "")

@@ -27,6 +27,7 @@ import time
 import uuid
 from typing import BinaryIO, Iterator
 
+from minio_tpu import obs
 from minio_tpu.erasure.codec import DEFAULT_BLOCK_SIZE, ErasureCodec
 from minio_tpu.erasure import listing
 from minio_tpu.erasure.sysstore import SysConfigStore
@@ -60,6 +61,12 @@ _WRITE_SENTINEL = None
 # Objects at or below this size are inlined into the journal instead of
 # getting shard files (reference inlines small objects in xl.meta v2).
 INLINE_DATA_LIMIT = 16 << 10
+
+# Rolling erasure-encode throughput, EWMA over per-fan-out bytes/wall —
+# the live counterpart of PERF.md's hand-run encode benchmarks.
+_ENCODE_GIBPS = obs.gauge(
+    "minio_tpu_encode_gibps",
+    "Rolling erasure encode+fan-out throughput in GiB/s (EWMA)")
 
 
 def _read_full(data: BinaryIO, n: int) -> bytes:
@@ -151,6 +158,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         # below the shared-pool dispatch cost. Wide sets and any remote
         # drive keep the parallel fan-out (RPC/disk latency dominates there).
         self._serial_meta_reads = self.n <= 8 and self._drives_all_local()
+        self._encode_gibps: float | None = None
 
     @property
     def fast_local_reads(self) -> bool:
@@ -374,15 +382,17 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             serial_writes = self.fast_local_reads
             with self.nslock.lock(bucket, obj):
                 self._check_put_precondition(bucket, obj, opts)
-                outcomes = parallel_map(
-                    [
-                        lambda d=d: d.write_metadata_single(
-                            bucket, obj, fi, raw, journal,
-                            defer_reclaim=True)
-                        for d in shuffled
-                    ],
-                    serial=serial_writes,
-                )
+                with obs.span("commit", bucket=bucket, object=obj,
+                              inline=True):
+                    outcomes = parallel_map(
+                        [
+                            lambda d=d: d.write_metadata_single(
+                                bucket, obj, fi, raw, journal,
+                                defer_reclaim=True)
+                            for d in shuffled
+                        ],
+                        serial=serial_writes,
+                    )
                 try:
                     reduce_write_quorum(outcomes, write_quorum, bucket, obj)
                 except Exception:
@@ -419,10 +429,12 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                  for d in shuffled])
 
         try:
-            total, md5_hex, errs = self._fan_out_encode(
-                shuffled, sys_vol, f"{tmp_rel}/part.1", data, size, codec,
-                write_quorum, bucket, obj, initial=first_block,
-            )
+            with obs.span("encode", bucket=bucket, object=obj) as sp:
+                total, md5_hex, errs = self._fan_out_encode(
+                    shuffled, sys_vol, f"{tmp_rel}/part.1", data, size, codec,
+                    write_quorum, bucket, obj, initial=first_block,
+                )
+                sp.set(bytes=total)
         except (se.StorageError, se.ObjectError):
             # Quorum lost mid-encode (InsufficientWriteQuorum is an
             # ObjectError): the healthy drives' tmp staging must not
@@ -455,9 +467,11 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             except se.ObjectError:
                 cleanup_tmp()
                 raise
-            outcomes = parallel_map(
-                [lambda i=i, d=d: commit(i, d) for i, d in enumerate(shuffled)]
-            )
+            with obs.span("commit", bucket=bucket, object=obj):
+                outcomes = parallel_map(
+                    [lambda i=i, d=d: commit(i, d)
+                     for i, d in enumerate(shuffled)]
+                )
             try:
                 reduce_write_quorum(outcomes, write_quorum, bucket, obj)
             except Exception:
@@ -1467,9 +1481,11 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         The all-local sip256 configuration takes the native C++ lane
         instead (_native_fan_out); this Python/device path serves
         accelerator-fused digests and remote-drive topologies."""
+        t_enc = time.perf_counter()
         native = self._native_fan_out(shuffled, vol, rel, data, size, codec,
                                       write_quorum, bucket, obj, initial)
         if native is not None:
+            self._note_encode_rate(native[0], time.perf_counter() - t_enc)
             return native
         qs: list[queue.Queue] = [queue.Queue(maxsize=8) for _ in range(self.n)]
         errs: list[Exception | None] = [None] * self.n
@@ -1557,7 +1573,19 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 q.put(_WRITE_SENTINEL)
             for t in threads:
                 t.join()
+        self._note_encode_rate(total, time.perf_counter() - t_enc)
         return total, md5.hexdigest(), errs
+
+    def _note_encode_rate(self, nbytes: int, wall: float) -> None:
+        """Rolling encode throughput: EWMA over per-fan-out bytes/wall —
+        a regression in the codec or shard path shows up in the gauge
+        without re-running bench.py."""
+        if nbytes <= 0 or wall <= 0.0:
+            return
+        gibps = nbytes / wall / (1 << 30)
+        e = self._encode_gibps
+        self._encode_gibps = gibps if e is None else 0.7 * e + 0.3 * gibps
+        _ENCODE_GIBPS.set(self._encode_gibps)
 
     def _check_put_precondition(self, bucket: str, obj: str,
                                 opts: ObjectOptions) -> None:
@@ -1575,7 +1603,13 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             raise se.ObjectError(
                 bucket, obj, "precondition failed: object changed")
 
-    def _read_quorum_fileinfo(self, bucket: str, obj: str, version_id: str) -> FileInfo:
+    def _read_quorum_fileinfo(self, bucket: str, obj: str,
+                              version_id: str) -> FileInfo:
+        with obs.span("quorum-read", bucket=bucket, object=obj):
+            return self._read_quorum_fileinfo_inner(bucket, obj, version_id)
+
+    def _read_quorum_fileinfo_inner(self, bucket: str, obj: str,
+                                    version_id: str) -> FileInfo:
         if self._serial_meta_reads:
             # All-local cached journal reads run sequentially; once a
             # strict majority agrees on (mod_time, data_dir, version),

@@ -1,0 +1,75 @@
+"""Unified observability: metric registry + request spans + trace bus.
+
+Role-equivalent of the reference's cmd/metrics-v2.go metric descriptors
+plus the pkg/pubsub-backed `mc admin trace` plumbing, folded into one
+module so every plane (HTTP, storage, RPC fabric, erasure engine)
+records through the same two primitives:
+
+- `histogram()/counter()/gauge()` — process-global, named metric
+  families rendered into the Prometheus exposition by admin/metrics.py.
+  Always-on (a scrape must see the full history), built to be cheap
+  enough for the hot path (one bisect + a short lock per observe).
+- `span()` and `publish()` — typed trace records on the process trace
+  bus. ZERO-overhead when nothing subscribes: `span()` returns a shared
+  no-op context manager without allocating, and publishers gate on
+  `has_subscribers()` (the same contract the HTTP layer has always used
+  via `trace_bus.has_subscribers`, cmd/handler-utils.go:362-364).
+
+The bus is process-global (the reference's globalTrace pubsub): every
+S3Server/drive/RPC client in the process shares it, so `mc admin trace`
+on any server sees the node's whole request path.
+"""
+
+from minio_tpu.obs.histogram import (  # noqa: F401
+    LATENCY_BUCKETS,
+    CounterVec,
+    GaugeVec,
+    Histogram,
+    HistogramVec,
+    counter,
+    gauge,
+    histogram,
+    registry,
+    render_into,
+)
+from minio_tpu.obs.span import (  # noqa: F401
+    Span,
+    has_subscribers,
+    publish,
+    span,
+    timed_op,
+    trace_bus,
+)
+
+import time as _time  # noqa: E402
+
+# The four StorageAPI ops carrying the object hot path — the per-drive
+# latency family tracks exactly these (reference
+# minio_node_drive_latency_us).
+DRIVE_OPS = ("read_version", "create_file", "write_metadata_single",
+             "rename_data")
+
+
+def drive_op_observer(drive: str):
+    """observe(op, t0, volume, path, err=None) closure for one drive:
+    feeds minio_tpu_drive_latency_seconds{drive,op} and, when watched,
+    typed `storage` trace records. The single shape shared by LocalDrive
+    and RemoteDrive, so local and remote records can never fork."""
+    lat = histogram("minio_tpu_drive_latency_seconds",
+                    "Storage op latency by drive and op", ("drive", "op"))
+    children = {op: lat.labels(drive=drive, op=op) for op in DRIVE_OPS}
+
+    def observe(op: str, t0: float, volume: str, path: str,
+                err: BaseException | None = None) -> None:
+        dt = _time.perf_counter() - t0
+        children[op].observe(dt)
+        if has_subscribers():
+            rec = {"type": "storage", "time": _time.time(),
+                   "drive": drive, "op": op,
+                   "vol": volume, "path": path,
+                   "durationNs": int(dt * 1e9)}
+            if err is not None:
+                rec["error"] = f"{type(err).__name__}: {err}"
+            publish(rec)
+
+    return observe

@@ -1,0 +1,200 @@
+"""Fixed-bucket histograms + counters/gauges with a process registry.
+
+The exposition contract mirrors client_golang's (what cmd/metrics-v2.go
+renders): log-spaced `le` upper bounds, cumulative bucket counts ending
+at `+Inf`, plus `_sum` and `_count` series. `observe()` is lock-cheap —
+one bisect over a 16-entry tuple and a short critical section — so the
+per-drive read path (~10us with a warm journal cache) can afford it on
+every call.
+
+Rendering is duck-typed against admin.metrics.PromText (family/sample)
+so this module stays import-light and the admin exporter depends on us,
+never the reverse.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+# Log-spaced seconds: 100us .. 10s, the spread between a cached journal
+# stat and a cold distributed PUT (reference metrics-v2 latency buckets).
+LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                   0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram:
+    """One labelset's distribution: counts per `le` bound + sum."""
+
+    __slots__ = ("buckets", "_counts", "_sum", "_mu")
+
+    def __init__(self, buckets=LATENCY_BUCKETS):
+        self.buckets = tuple(float(b) for b in buckets)
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._mu = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        i = bisect.bisect_left(self.buckets, value)
+        with self._mu:
+            self._counts[i] += 1
+            self._sum += value
+
+    def snapshot(self) -> tuple[list[int], float]:
+        """(per-bucket counts incl. +Inf, sum) — a consistent pair."""
+        with self._mu:
+            return list(self._counts), self._sum
+
+
+class HistogramVec:
+    def __init__(self, name: str, help_: str, labelnames: tuple[str, ...],
+                 buckets=LATENCY_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.labelnames = tuple(labelnames)
+        self.buckets = tuple(float(b) for b in buckets)
+        self._children: dict[tuple, Histogram] = {}
+        self._mu = threading.Lock()
+
+    def labels(self, **kv) -> Histogram:
+        key = tuple(str(kv[n]) for n in self.labelnames)
+        h = self._children.get(key)
+        if h is None:
+            with self._mu:
+                h = self._children.setdefault(key, Histogram(self.buckets))
+        return h
+
+    def render_into(self, p) -> None:
+        p.family(self.name, self.help, "histogram")
+        for key, h in sorted(self._children.items()):
+            counts, total = h.snapshot()
+            base = dict(zip(self.labelnames, key))
+            cum = 0
+            for bound, c in zip(self.buckets, counts):
+                cum += c
+                p.sample(f"{self.name}_bucket", cum,
+                         {**base, "le": _fmt(bound)})
+            cum += counts[-1]
+            p.sample(f"{self.name}_bucket", cum, {**base, "le": "+Inf"})
+            p.sample(f"{self.name}_sum", round(total, 6), base or None)
+            p.sample(f"{self.name}_count", cum, base or None)
+
+
+class CounterVec:
+    def __init__(self, name: str, help_: str, labelnames: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.labelnames = tuple(labelnames)
+        self._children: dict[tuple, "_Counter"] = {}
+        self._mu = threading.Lock()
+
+    def labels(self, **kv) -> "_Counter":
+        key = tuple(str(kv[n]) for n in self.labelnames)
+        c = self._children.get(key)
+        if c is None:
+            with self._mu:
+                c = self._children.setdefault(key, _Counter())
+        return c
+
+    def render_into(self, p) -> None:
+        p.family(self.name, self.help, "counter")
+        for key, c in sorted(self._children.items()):
+            p.sample(self.name, c.value,
+                     dict(zip(self.labelnames, key)) or None)
+
+
+class _Counter:
+    __slots__ = ("value", "_mu")
+
+    def __init__(self):
+        self.value = 0
+        self._mu = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._mu:
+            self.value += n
+
+
+class GaugeVec:
+    def __init__(self, name: str, help_: str, labelnames: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.labelnames = tuple(labelnames)
+        self._children: dict[tuple, list] = {}
+        self._mu = threading.Lock()
+
+    def labels(self, **kv) -> "_Gauge":
+        key = tuple(str(kv[n]) for n in self.labelnames)
+        g = self._children.get(key)
+        if g is None:
+            with self._mu:
+                g = self._children.setdefault(key, _Gauge())
+        return g
+
+    def set(self, value: float, **kv) -> None:
+        self.labels(**kv).set(value)
+
+    def render_into(self, p) -> None:
+        p.family(self.name, self.help, "gauge")
+        for key, g in sorted(self._children.items()):
+            p.sample(self.name, round(g.value, 6),
+                     dict(zip(self.labelnames, key)) or None)
+
+
+class _Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = value
+
+
+def _fmt(bound: float) -> str:
+    s = repr(bound)
+    return s[:-2] if s.endswith(".0") else s
+
+
+# --- process registry --------------------------------------------------------
+
+_REGISTRY: dict[str, object] = {}
+_REG_MU = threading.Lock()
+
+
+def _register(name: str, factory):
+    with _REG_MU:
+        v = _REGISTRY.get(name)
+        if v is None:
+            v = factory()
+            _REGISTRY[name] = v
+        return v
+
+
+def histogram(name: str, help_: str, labelnames: tuple[str, ...] = (),
+              buckets=LATENCY_BUCKETS) -> HistogramVec:
+    """Get-or-create: modules on both ends of a family (LocalDrive and
+    RemoteDrive both feed drive latency) share one vec by name."""
+    return _register(name, lambda: HistogramVec(name, help_, labelnames,
+                                                buckets))
+
+
+def counter(name: str, help_: str,
+            labelnames: tuple[str, ...] = ()) -> CounterVec:
+    return _register(name, lambda: CounterVec(name, help_, labelnames))
+
+
+def gauge(name: str, help_: str,
+          labelnames: tuple[str, ...] = ()) -> GaugeVec:
+    return _register(name, lambda: GaugeVec(name, help_, labelnames))
+
+
+def registry() -> list:
+    with _REG_MU:
+        return [v for _n, v in sorted(_REGISTRY.items())]
+
+
+def render_into(p) -> None:
+    """Render every registered family into a PromText-shaped sink."""
+    for vec in registry():
+        vec.render_into(p)

@@ -1,0 +1,127 @@
+"""Contextvar span recorder over the process trace bus.
+
+A span is a timed section that publishes ONE typed trace record at exit
+(`mc admin trace --call` shape): {type, name, durationNs, time, ...attrs},
+with the enclosing span's name attached as `parent` when both live on the
+same thread of control.
+
+Zero-overhead contract: `span()` returns the shared `_NOOP` singleton —
+no Span object, no contextvar write, no clock read — unless the bus has
+a subscriber at entry. The guard is re-checked at exit only through the
+publish gate, so a subscriber attaching mid-span at worst misses that
+one record. `Span.allocated` counts constructions so tests can assert
+the hot path stays allocation-free without a subscriber.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from contextlib import contextmanager
+
+from minio_tpu.admin.pubsub import PubSub
+
+_BUS = PubSub()
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "mtpu_span", default=None)
+
+
+def trace_bus() -> PubSub:
+    """The process trace bus (reference globalTrace pubsub)."""
+    return _BUS
+
+
+def has_subscribers() -> bool:
+    return _BUS.has_subscribers
+
+
+def publish(record: dict) -> None:
+    """Publish a pre-built trace record. Callers on hot paths must gate
+    on has_subscribers() BEFORE building the record."""
+    _BUS.publish(record)
+
+
+class Span:
+    allocated = 0  # class-level construction count (zero-overhead guard)
+
+    __slots__ = ("name", "typ", "attrs", "_t0", "_token")
+
+    def __init__(self, name: str, typ: str, attrs: dict):
+        Span.allocated += 1
+        self.name = name
+        self.typ = typ
+        self.attrs = attrs
+        self._t0 = 0.0
+        self._token = None
+
+    def set(self, **kv) -> None:
+        """Attach attrs discovered mid-span (e.g. byte counts)."""
+        self.attrs.update(kv)
+
+    def __enter__(self) -> "Span":
+        self._token = _current.set(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dur = time.perf_counter() - self._t0
+        parent = None
+        if self._token is not None:
+            parent = self._token.old_value
+            if parent is contextvars.Token.MISSING:
+                parent = None
+            _current.reset(self._token)
+        if _BUS.has_subscribers:
+            rec = {"type": self.typ, "name": self.name,
+                   "time": time.time(), "durationNs": int(dur * 1e9)}
+            if isinstance(parent, Span):
+                rec["parent"] = parent.name
+            if exc is not None:
+                rec["error"] = f"{type(exc).__name__}: {exc}"
+            rec.update(self.attrs)
+            _BUS.publish(rec)
+        return False
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def set(self, **kv) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+def span(name: str, typ: str = "internal", **attrs):
+    """Timed trace section; `with obs.span("quorum-read", bucket=b): ...`.
+    Returns the no-op singleton when nobody is watching."""
+    if not _BUS.has_subscribers:
+        return _NOOP
+    return Span(name, typ, attrs)
+
+
+def current() -> Span | None:
+    return _current.get()
+
+
+@contextmanager
+def timed_op(observe, op: str, volume: str, path: str):
+    """Shared timing wrapper for per-op storage instrumentation:
+    `observe(op, t0, volume, path, err)` fires on both success and
+    failure. Not for microsecond-hot paths (generator contextmanagers
+    cost ~1us per entry) — those keep an inline try/finally."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    except BaseException as e:
+        observe(op, t0, volume, path, e)
+        raise
+    else:
+        observe(op, t0, volume, path)

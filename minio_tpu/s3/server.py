@@ -23,10 +23,14 @@ from typing import Iterator
 
 from aiohttp import web
 
+from minio_tpu import obs
 from minio_tpu.admin.configkv import ConfigSys
 from minio_tpu.admin.handlers import ADMIN_PREFIX, AdminAPI
-from minio_tpu.admin.metrics import collect_metrics
-from minio_tpu.admin.pubsub import PubSub
+from minio_tpu.admin.metrics import (
+    PROM_CONTENT_TYPE,
+    collect_metrics,
+    collect_node_metrics,
+)
 from minio_tpu.admin.stats import HTTPStats
 from minio_tpu.bucket import objectlock as olock
 from minio_tpu.crypto import compress as czip
@@ -68,6 +72,17 @@ class _MemStore:
 
 XML_TYPE = "application/xml"
 MAX_OBJECT_SIZE = 5 * (1 << 40)
+
+# Request-path latency distributions (reference metrics-v2
+# minio_s3_requests_* / minio_s3_ttfb_seconds). TTFB for a streamed GET
+# is stamped when the response headers flush; buffered responses fall
+# back to handler completion (bytes leave with the return).
+_REQ_LATENCY = obs.histogram(
+    "minio_tpu_s3_requests_latency_seconds",
+    "End-to-end request latency by API", ("api",))
+_REQ_TTFB = obs.histogram(
+    "minio_tpu_s3_ttfb_seconds",
+    "Time to first response byte by API", ("api",))
 # Inline-object streams are plain list iterators (zero IO behind next()) —
 # the GET fast path detects them by type to drain on the event loop.
 _LIST_ITER = type(iter([]))
@@ -188,7 +203,10 @@ class S3Server:
         self.stats = HTTPStats()
         self.bandwidth: dict[str, dict[str, int]] = {}
         self._bw_mu = __import__("threading").Lock()
-        self.trace_bus = PubSub()
+        # The PROCESS trace bus (reference globalTrace): storage, RPC and
+        # erasure spans publish here too, so one `mc admin trace`
+        # subscription sees the whole request path.
+        self.trace_bus = obs.trace_bus()
         self.config = ConfigSys(sealed)
         # Per-bucket bandwidth ENFORCEMENT (pkg/bandwidth role) — rates
         # from the `bandwidth` config subsystem, applied to PUT ingest and
@@ -598,7 +616,9 @@ class S3Server:
                     "x-amz-security-token, x-amz-user-agent, *",
                 "Access-Control-Max-Age": "3600"})
         t0 = self.stats.begin()
+        request["mtpu-t0"] = t0
         resp = None
+        canceled = False
         try:
             # Request-concurrency throttle (reference maxClients,
             # cmd/handler-api.go:136): over the configured ceiling new
@@ -619,6 +639,11 @@ class S3Server:
         except web.HTTPException as e:  # web-console handlers raise these
             resp = e
             raise
+        except asyncio.CancelledError:
+            # Client went away mid-request (aiohttp cancels the handler):
+            # account it separately — a disconnect is not a server error.
+            canceled = True
+            raise
         except Exception as e:  # noqa: BLE001 - surface as S3 InternalError
             s3e = from_exception(e, path)
             if s3e.api.code == "NoSuchBucket":
@@ -630,10 +655,20 @@ class S3Server:
             return resp
         finally:
             status = resp.status if resp is not None else 500
+            if canceled and resp is None:
+                # Client closed the connection before a response formed —
+                # nginx's 499, NOT a server error.
+                status = 499
             api = request.get("api", request.method.lower())
             rx = request.content_length or 0
             tx = (resp.content_length or 0) if resp is not None else 0
-            self.stats.end(api, t0, status, rx=rx, tx=tx)
+            dt = time.perf_counter() - t0
+            self.stats.end(api, t0, status, rx=rx, tx=tx, canceled=canceled)
+            _REQ_LATENCY.labels(api=api).observe(dt)
+            # Streamed GETs stamp first-byte at header flush; everything
+            # else flushes with the handler return, so TTFB == latency.
+            ttfb = request.get("mtpu-ttfb")
+            _REQ_TTFB.labels(api=api).observe(dt if ttfb is None else ttfb)
             # Per-bucket bandwidth accounting (pkg/bandwidth role).
             bkt = path.lstrip("/").split("/", 1)[0]
             if bkt and not bkt.startswith("minio") and (rx or tx):
@@ -647,12 +682,20 @@ class S3Server:
             if self.trace_bus.has_subscribers:
                 import time as _time
 
-                self.trace_bus.publish({
+                rec = {
+                    "type": "http",
                     "time": _time.time(), "api": api,
                     "method": request.method, "path": path,
                     "status": status, "requestId": request_id,
                     "remote": self._client_ip(request),
-                })
+                    "durationNs": int(dt * 1e9),
+                    "rx": rx, "tx": tx,
+                }
+                if canceled:
+                    rec["canceled"] = True
+                if ttfb is not None:
+                    rec["ttfbNs"] = int(ttfb * 1e9)
+                self.trace_bus.publish(rec)
             # Per-request AUDIT record (reference logger.AuditLog at every
             # handler, cmd/object-handlers.go:1378) — zero cost unless an
             # audit target is configured.
@@ -819,7 +862,18 @@ class S3Server:
                 body = await loop.run_in_executor(
                     None, collect_metrics, self.obj, self.stats,
                     self.scanner.usage if self.scanner else None)
-                return web.Response(body=body, content_type="text/plain")
+                return web.Response(
+                    body=body, headers={"Content-Type": PROM_CONTENT_TYPE})
+            if path == "/minio/v2/metrics/node":
+                # Node-scope scrape: this process's planes only (the
+                # reference's cluster/node metrics-v2 split).
+                request["api"] = "metrics"
+                self.admin._authorize(identity, "admin:Prometheus")
+                loop = asyncio.get_running_loop()
+                body = await loop.run_in_executor(
+                    None, collect_node_metrics, self.stats)
+                return web.Response(
+                    body=body, headers={"Content-Type": PROM_CONTENT_TYPE})
             raise S3Error("MethodNotAllowed", resource=path)
 
         parts = path.lstrip("/").split("/", 1)
@@ -2173,6 +2227,11 @@ class S3Server:
             return web.Response(status=status, body=body, headers=headers)
         resp = web.StreamResponse(status=status, headers=headers)
         await resp.prepare(request)
+        # First response bytes (the headers) just flushed: this is the
+        # stream's TTFB, picked up by _entry's finally.
+        t0_req = request.get("mtpu-t0")
+        if t0_req is not None:
+            request["mtpu-ttfb"] = time.perf_counter() - t0_req
         loop = asyncio.get_running_loop()
         it = iter(stream)
         while True:

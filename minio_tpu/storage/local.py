@@ -26,6 +26,7 @@ import uuid
 from collections import OrderedDict
 from typing import BinaryIO, Iterable, Iterator
 
+from minio_tpu import obs
 from minio_tpu.ops import bitrot
 from minio_tpu.storage.api import (
     MARKER_GROUP_PAD,
@@ -78,6 +79,11 @@ class LocalDrive(StorageAPI):
         # NVMe with write cache) while keeping parallel fan-out on slow
         # fsync media. Unknown (no sample yet) reads as NOT fast.
         self._sync_ewma: float | None = None
+        # Per-drive op latency + `storage` trace records — the shared
+        # observer (pre-resolved histogram children, trace gated on
+        # subscribers) keeps the hot-path cost at two clock reads + one
+        # observe.
+        self._observe_op = obs.drive_op_observer(self.root)
         try:
             os.makedirs(os.path.join(self.root, SYS_VOL, "tmp"), exist_ok=True)
         except OSError as e:
@@ -303,16 +309,17 @@ class LocalDrive(StorageAPI):
         fp = self._file_path(volume, path)
         os.makedirs(os.path.dirname(fp), exist_ok=True)
         written = 0
-        try:
-            w = DirectWriter(fp)
+        with obs.timed_op(self._observe_op, "create_file", volume, path):
             try:
-                for chunk in chunks:
-                    w.write(chunk)
-                    written += len(chunk)
-            finally:
-                w.close(sync=True)
-        except OSError as e:
-            raise se.FaultyDisk(str(e)) from e
+                w = DirectWriter(fp)
+                try:
+                    for chunk in chunks:
+                        w.write(chunk)
+                        written += len(chunk)
+                finally:
+                    w.close(sync=True)
+            except OSError as e:
+                raise se.FaultyDisk(str(e)) from e
         return written
 
     def append_file(self, volume: str, path: str, data: bytes) -> None:
@@ -465,6 +472,15 @@ class LocalDrive(StorageAPI):
         displaced version (entry + data dir) in a reclaim capsule and
         return its token — same commit_rename/undo_rename contract as
         rename_data, so a below-quorum inline overwrite is undoable."""
+        with obs.timed_op(self._observe_op, "write_metadata_single",
+                          volume, path):
+            return self._write_metadata_single(
+                volume, path, fi, raw, meta=meta,
+                defer_reclaim=defer_reclaim)
+
+    def _write_metadata_single(self, volume: str, path: str, fi: FileInfo,
+                               raw: bytes, meta=None,
+                               defer_reclaim: bool = False) -> "str | None":
         self.stat_vol(volume)
         token: str | None = None
         try:
@@ -575,14 +591,24 @@ class LocalDrive(StorageAPI):
 
     def read_version(self, volume: str, path: str, version_id: str = "",
                      read_data: bool = False) -> FileInfo:
-        meta, fi_memo = self._cached_meta_entry(volume, path)
-        fi = fi_memo.get(version_id)
-        if fi is None:
-            fi = meta.to_fileinfo(volume, path, version_id)
-            fi_memo[version_id] = fi
-        # Clone: callers mutate their FileInfo (erasure.index, checksum
-        # election); the memoized copy must stay pristine.
-        return fi.clone()
+        # Inline timing (not obs.timed_op): a cached-journal read is ~2us
+        # and a generator contextmanager entry would be measurable here.
+        t0 = time.perf_counter()
+        err: BaseException | None = None
+        try:
+            meta, fi_memo = self._cached_meta_entry(volume, path)
+            fi = fi_memo.get(version_id)
+            if fi is None:
+                fi = meta.to_fileinfo(volume, path, version_id)
+                fi_memo[version_id] = fi
+            # Clone: callers mutate their FileInfo (erasure.index, checksum
+            # election); the memoized copy must stay pristine.
+            return fi.clone()
+        except BaseException as e:
+            err = e
+            raise
+        finally:
+            self._observe_op("read_version", t0, volume, path, err)
 
     def read_xl(self, volume: str, path: str) -> bytes:
         try:
@@ -636,6 +662,15 @@ class LocalDrive(StorageAPI):
         the reference's commitRenameDataDir/undo discipline. Default
         (False) reclaims inline, the pre-existing single-drive
         semantics."""
+        with obs.timed_op(self._observe_op, "rename_data",
+                          dst_volume, dst_path):
+            return self._rename_data(src_volume, src_path, fi,
+                                     dst_volume, dst_path,
+                                     defer_reclaim=defer_reclaim)
+
+    def _rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
+                     dst_volume: str, dst_path: str,
+                     defer_reclaim: bool = False) -> str | None:
         src_dir = self._file_path(src_volume, src_path)
         obj_dir = self._file_path(dst_volume, dst_path)
         os.makedirs(obj_dir, exist_ok=True)

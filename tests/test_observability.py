@@ -1,0 +1,378 @@
+"""Observability layer tests: strict Prometheus exposition over a live
+scrape, node-scope endpoint, typed trace records with ?type= filtering,
+per-drive op records during a PUT, and the zero-overhead span guard
+(cmd/metrics-v2_test.go + madmin trace test roles)."""
+
+import json
+import re
+import socket
+import threading
+import time
+
+import pytest
+import requests
+from aiohttp import web
+
+from tests.s3client import SigV4Client
+
+ACCESS = "obsroot"
+SECRET = "obsroot-secret1"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    import asyncio
+
+    from minio_tpu.s3.server import build_server
+
+    root = tmp_path_factory.mktemp("obs-drives")
+    srv = build_server([str(root / f"d{i}") for i in range(4)], ACCESS,
+                       SECRET)
+    port = _free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(srv.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(30)
+    yield f"http://127.0.0.1:{port}", srv
+    loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return SigV4Client(server[0], ACCESS, SECRET)
+
+
+@pytest.fixture(scope="module")
+def traffic(client):
+    """Seed every request-path family: bucket, inline PUT, streaming PUT
+    (> inline limit, exercises encode+commit), GET, and a 404."""
+    assert client.put("/obsbkt").status_code == 200
+    assert client.put("/obsbkt/small", data=b"tiny").status_code == 200
+    assert client.put("/obsbkt/big",
+                      data=b"x" * (1 << 20)).status_code == 200
+    assert client.get("/obsbkt/small").status_code == 200
+    assert client.get("/obsbkt/big").status_code == 200
+    assert client.get("/obsbkt/definitely-missing").status_code == 404
+    return True
+
+
+# ---------------------------------------------------------------------------
+# strict exposition parsing
+# ---------------------------------------------------------------------------
+
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) .*$")
+_TYPE_RE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+    r"(counter|gauge|histogram|summary|untyped)$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text: str):
+    """Strict 0.0.4 text-format parse: every line is HELP, TYPE or a
+    sample; samples only for families with a prior TYPE; values numeric.
+    Returns (families {name: type}, samples [(name, labels, value)])."""
+    families: dict[str, str] = {}
+    samples: list = []
+    for ln, line in enumerate(text.split("\n"), 1):
+        if not line:
+            continue
+        m = _HELP_RE.match(line)
+        if m:
+            continue
+        m = _TYPE_RE.match(line)
+        if m:
+            families[m.group(1)] = m.group(2)
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"line {ln} is not HELP/TYPE/sample: {line!r}"
+        name, rawlbl, rawval = m.groups()
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in families:
+                base = name[: -len(suffix)]
+        assert base in families, f"line {ln}: sample {name} has no TYPE"
+        labels = dict(_LABEL_RE.findall(rawlbl[1:-1])) if rawlbl else {}
+        value = float("inf") if rawval == "+Inf" else float(rawval)
+        samples.append((name, labels, value))
+    return families, samples
+
+
+def _histogram_series(families, samples, family):
+    assert families.get(family) == "histogram", \
+        f"{family} missing or not a histogram"
+    by_labelset: dict = {}
+    for name, labels, value in samples:
+        if name != f"{family}_bucket":
+            continue
+        key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+        by_labelset.setdefault(key, []).append((labels["le"], value))
+    return by_labelset
+
+
+def _check_histogram(families, samples, family, want_samples=True):
+    series = _histogram_series(families, samples, family)
+    if want_samples:
+        assert series, f"{family} has no bucket samples"
+    counts = {(n, tuple(sorted(lbl.items()))): v
+              for n, lbl, v in samples}
+    for key, buckets in series.items():
+        vals = [v for _le, v in buckets]
+        les = [le for le, _v in buckets]
+        assert les[-1] == "+Inf", f"{family}{key}: buckets must end at +Inf"
+        bounds = [float("inf") if le == "+Inf" else float(le) for le in les]
+        assert bounds == sorted(bounds), f"{family}{key}: le not ascending"
+        assert vals == sorted(vals), \
+            f"{family}{key}: bucket counts not cumulative: {vals}"
+        # _count must equal the +Inf bucket.
+        cnt = counts.get((f"{family}_count", key))
+        assert cnt == vals[-1], f"{family}{key}: _count != +Inf bucket"
+        assert (f"{family}_sum", key) in counts, f"{family}{key}: no _sum"
+
+
+def _scrape(client, path="/minio/v2/metrics/cluster"):
+    r = client.get(path)
+    assert r.status_code == 200, r.text
+    return r
+
+
+def test_exposition_content_type(client, traffic):
+    for path in ("/minio/v2/metrics/cluster", "/minio/v2/metrics/node",
+                 "/minio/admin/v3/metrics"):
+        r = _scrape(client, path)
+        assert r.headers["Content-Type"].startswith(
+            "text/plain; version=0.0.4"), (path, r.headers["Content-Type"])
+
+
+def test_cluster_scrape_strict_and_histograms(client, traffic):
+    r = _scrape(client)
+    families, samples = parse_exposition(r.text)
+    # The four request-path distributions of the acceptance criteria.
+    _check_histogram(families, samples,
+                     "minio_tpu_s3_requests_latency_seconds")
+    _check_histogram(families, samples, "minio_tpu_s3_ttfb_seconds")
+    _check_histogram(families, samples, "minio_tpu_drive_latency_seconds")
+    # Single-node deployment: the RPC family is registered (HELP/TYPE)
+    # but has no peers to sample.
+    _check_histogram(families, samples, "minio_tpu_rpc_latency_seconds",
+                     want_samples=False)
+    hists = [f for f, t in families.items() if t == "histogram"]
+    assert len(hists) >= 4, hists
+    # Legacy collectors still render.
+    assert families.get("minio_tpu_s3_requests_total") == "counter"
+    assert families.get("minio_tpu_cluster_health_status") == "gauge"
+
+
+def test_drive_and_api_labels(server, client, traffic):
+    _, srv = server
+    _, samples = parse_exposition(_scrape(client).text)
+    drive_ops = {lbl["op"] for n, lbl, v in samples
+                 if n == "minio_tpu_drive_latency_seconds_bucket"}
+    assert "read_version" in drive_ops
+    assert "write_metadata_single" in drive_ops
+    # The 1 MiB PUT took the streaming path: shard writes + commits.
+    assert "create_file" in drive_ops
+    assert "rename_data" in drive_ops
+    # The obs registry is process-global: other test modules' drives may
+    # also appear in the scrape — assert on THIS server's drive set.
+    drives = {lbl["drive"] for n, lbl, v in samples
+              if n == "minio_tpu_drive_latency_seconds_bucket"}
+    ours = {d.root for d in srv.obj.all_drives()}
+    assert len(ours) == 4 and ours <= drives
+    apis = {lbl["api"] for n, lbl, v in samples
+            if n == "minio_tpu_s3_requests_latency_seconds_bucket"}
+    assert "PutObject" in apis and "GetObject" in apis
+
+
+def test_encode_gauge_after_streaming_put(client, traffic):
+    _, samples = parse_exposition(_scrape(client).text)
+    vals = [v for n, _l, v in samples if n == "minio_tpu_encode_gibps"]
+    assert vals and vals[0] > 0
+
+
+def test_4xx_export(client, traffic):
+    _, samples = parse_exposition(_scrape(client).text)
+    e4 = sum(v for n, _l, v in samples
+             if n == "minio_tpu_s3_requests_4xx_errors_total")
+    assert e4 >= 1
+
+
+def test_node_scope_endpoint(client, traffic):
+    families, samples = parse_exposition(
+        _scrape(client, "/minio/v2/metrics/node").text)
+    assert "minio_tpu_process_uptime_seconds" in families
+    _check_histogram(families, samples, "minio_tpu_drive_latency_seconds")
+    assert "minio_tpu_rpc_latency_seconds" in families
+    assert "minio_tpu_trace_dropped_total" in families
+    # Cluster-wide collectors stay off the node scrape.
+    assert "minio_tpu_cluster_disk_online_total" not in families
+    assert "minio_tpu_bucket_usage_total_bytes" not in families
+
+
+# ---------------------------------------------------------------------------
+# trace stream: typed records + ?type= filter
+# ---------------------------------------------------------------------------
+
+def _wait_no_subscribers(bus, deadline=5.0):
+    end = time.time() + deadline
+    while bus.has_subscribers and time.time() < end:
+        time.sleep(0.05)
+    return not bus.has_subscribers
+
+
+def test_zero_overhead_without_subscriber(server, client):
+    """The guard of the whole design: no span objects (and no trace
+    records) materialize on the hot path unless someone subscribes."""
+    from minio_tpu.obs import Span
+
+    _base, srv = server
+    assert _wait_no_subscribers(srv.trace_bus), "stale trace subscriber"
+    before = Span.allocated
+    assert client.put("/obsbkt/guard", data=b"g" * 100).status_code == 200
+    assert client.put("/obsbkt/guard-big",
+                      data=b"g" * (64 << 10)).status_code == 200
+    assert client.get("/obsbkt/guard").status_code == 200
+    assert Span.allocated == before, \
+        "span allocated with no trace subscriber attached"
+
+
+def test_trace_type_storage_filter(server, client):
+    """?type=storage during a PUT shows per-drive call records — the
+    `mc admin trace --call storage` view."""
+    base, srv = server
+    got: list = []
+    stop = threading.Event()
+
+    def consume():
+        q = {"type": "storage"}
+        headers = SigV4Client(base, ACCESS, SECRET)._sign(
+            "GET", "/minio/admin/v3/trace", q, {}, b"")
+        try:
+            with requests.get(f"{base}/minio/admin/v3/trace", params=q,
+                              headers=headers, stream=True,
+                              timeout=10) as r:
+                for line in r.iter_lines():
+                    if stop.is_set():
+                        return
+                    if line:
+                        got.append(json.loads(line))
+                        if len(got) >= 4:
+                            return
+        except requests.RequestException:
+            pass
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while not srv.trace_bus.has_subscribers and time.time() < deadline:
+        time.sleep(0.05)
+    client.put("/obsbkt/traced", data=b"t" * 100)
+    client.get("/obsbkt/traced")
+    t.join(timeout=10)
+    stop.set()
+    assert got, "no storage trace records received"
+    assert all(rec["type"] == "storage" for rec in got)
+    ops = {rec["op"] for rec in got}
+    assert ops & {"write_metadata_single", "read_version"}, ops
+    for rec in got:
+        assert rec["drive"]
+        assert rec["durationNs"] >= 0
+    assert _wait_no_subscribers(srv.trace_bus)
+
+
+def test_http_and_internal_records_direct(server, client):
+    """Direct bus subscription: HTTP records carry type/durationNs/rx/tx
+    (the satellite fields) and erasure spans surface as `internal`."""
+    _base, srv = server
+    with srv.trace_bus.subscribe() as sub:
+        client.put("/obsbkt/direct", data=b"d" * (64 << 10))
+        client.get("/obsbkt/direct")
+        recs = []
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            item = sub.get(timeout=0.25)
+            if item is not None:
+                recs.append(item)
+            http = [r for r in recs if r.get("type") == "http"
+                    and r.get("api") == "PutObject"]
+            internal = [r for r in recs if r.get("type") == "internal"]
+            if http and internal:
+                break
+    assert http, recs[:5]
+    rec = http[0]
+    assert rec["durationNs"] > 0
+    assert rec["rx"] == 64 << 10
+    assert "tx" in rec and "requestId" in rec
+    names = {r.get("name") for r in internal}
+    assert names & {"quorum-read", "encode", "commit"}, names
+    assert _wait_no_subscribers(srv.trace_bus)
+
+
+def test_trace_dropped_counter(server, client):
+    """Slow-consumer drops are counted and exported (satellite: PubSub
+    must not lose records silently)."""
+    _base, srv = server
+    bus = srv.trace_bus
+    before = bus.dropped
+    sub = bus.subscribe()
+    try:
+        for i in range(1200):  # queue maxsize is 1000
+            bus.publish({"type": "internal", "n": i})
+    finally:
+        sub.close()
+    assert bus.dropped > before
+    _, samples = parse_exposition(_scrape(client).text)
+    exported = [v for n, _l, v in samples
+                if n == "minio_tpu_trace_dropped_total"]
+    assert exported and exported[0] >= bus.dropped - before
+
+
+# ---------------------------------------------------------------------------
+# stats satellites
+# ---------------------------------------------------------------------------
+
+def test_uptime_is_monotonic_not_wall_clock(server):
+    _base, srv = server
+    wall = srv.stats.started
+    try:
+        # A 10-day NTP step backward must not produce negative uptime.
+        srv.stats.started = wall - 864000
+        snap = srv.stats.snapshot()
+        assert 0 <= snap["uptime"] < 86400
+    finally:
+        srv.stats.started = wall
+
+
+def test_canceled_counter_wired(server, client):
+    _base, srv = server
+    t0 = srv.stats.begin()
+    srv.stats.end("GetObject", t0, 200, canceled=True)
+    snap = srv.stats.snapshot()
+    assert snap["apis"]["GetObject"]["canceled"] >= 1
+    _, samples = parse_exposition(_scrape(client).text)
+    canceled = {lbl.get("api"): v for n, lbl, v in samples
+                if n == "minio_tpu_s3_requests_canceled_total"}
+    assert canceled.get("GetObject", 0) >= 1
