@@ -114,6 +114,33 @@ def _timed_chain(step, x0, iters: int) -> float:
     return time.perf_counter() - t0
 
 
+def _median(vals: list[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2
+
+
+def _spread(vals: list[float]) -> float:
+    """(max-min)/median — the record's own noise gauge, so a BENCH round
+    taken on a loaded host is legible as such instead of silently
+    shifting the headline."""
+    m = _median(vals)
+    return round((max(vals) - min(vals)) / m, 4) if m else 0.0
+
+
+def _kernel_rates(step, x0, repeats: int = 3) -> tuple[float, float]:
+    """Median-of-`repeats` measurement: one warmup chain, then `repeats`
+    back-to-back timed chains of ITERS//repeats launches each (short
+    interleaved repeats — a host-load hiccup taxes one repeat, not the
+    whole sample). Returns (median GiB/s, spread)."""
+    _timed_chain(step, x0, WARMUP)
+    per = max(1, ITERS // repeats)
+    rates = [BATCH * BLOCK_SIZE * per
+             / _timed_chain(step, x0, per) / (1 << 30)
+             for _ in range(repeats)]
+    return _median(rates), _spread(rates)
+
+
 def bench_encode(jax, jnp, mod, kernel: str) -> dict:
     """Config 1: plain encode 8+4, 1 MiB blocks."""
     key = jax.random.PRNGKey(0)
@@ -126,11 +153,9 @@ def bench_encode(jax, jnp, mod, kernel: str) -> dict:
     def step(x):
         return chain(x, encode(x))
 
-    _timed_chain(step, data, WARMUP)
-    dt = _timed_chain(step, data, ITERS)
-    gibs = BATCH * BLOCK_SIZE * ITERS / dt / (1 << 30)
+    gibs, spread = _kernel_rates(step, data)
     return {"metric": f"erasure_encode_{K}+{M}_1MiB[{kernel}]",
-            "value": round(gibs, 3), "unit": "GiB/s",
+            "value": round(gibs, 3), "unit": "GiB/s", "spread": spread,
             "vs_baseline": round(gibs / NORTH_STAR_GIBS, 4)}
 
 
@@ -149,11 +174,9 @@ def bench_encode_fused(jax, jnp, dev_platform: str) -> dict:
         parity, _dig = enc(x)
         return chain(x, parity)
 
-    _timed_chain(step, data, WARMUP)
-    dt = _timed_chain(step, data, ITERS)
-    gibs = BATCH * BLOCK_SIZE * ITERS / dt / (1 << 30)
+    gibs, spread = _kernel_rates(step, data)
     return {"metric": f"erasure_encode_bitrot_fused_{K}+{M}_1MiB[{dev_platform}]",
-            "value": round(gibs, 3), "unit": "GiB/s",
+            "value": round(gibs, 3), "unit": "GiB/s", "spread": spread,
             "vs_baseline": round(gibs / NORTH_STAR_GIBS, 4)}
 
 
@@ -176,11 +199,9 @@ def bench_decode(jax, jnp) -> dict:
     def step(s):
         return chain(s, rec(s))
 
-    _timed_chain(step, shards, WARMUP)
-    dt = _timed_chain(step, shards, ITERS)
-    gibs = BATCH * BLOCK_SIZE * ITERS / dt / (1 << 30)
+    gibs, spread = _kernel_rates(step, shards)
     return {"metric": f"erasure_decode_2missing_{K}+{M}_1MiB",
-            "value": round(gibs, 3), "unit": "GiB/s",
+            "value": round(gibs, 3), "unit": "GiB/s", "spread": spread,
             "vs_baseline": round(gibs / NORTH_STAR_GIBS, 4)}
 
 
@@ -212,11 +233,9 @@ def bench_verify_decode_fused(jax, jnp) -> dict:
         r, _d = rec_verify(s)
         return chain(s, r)
 
-    _timed_chain(step, shards, WARMUP)
-    dt = _timed_chain(step, shards, ITERS)
-    gibs = BATCH * BLOCK_SIZE * ITERS / dt / (1 << 30)
+    gibs, spread = _kernel_rates(step, shards)
     return {"metric": f"bitrot_verify_fused_decode_{K}+{M}_1MiB",
-            "value": round(gibs, 3), "unit": "GiB/s",
+            "value": round(gibs, 3), "unit": "GiB/s", "spread": spread,
             "vs_baseline": round(gibs / NORTH_STAR_GIBS, 4)}
 
 
@@ -242,11 +261,9 @@ def bench_heal(jax, jnp) -> dict:
     def step(s):
         return chain(s, heal(s))
 
-    _timed_chain(step, shards, WARMUP)
-    dt = _timed_chain(step, shards, ITERS)
-    gibs = BATCH * BLOCK_SIZE * ITERS / dt / (1 << 30)
+    gibs, spread = _kernel_rates(step, shards)
     return {"metric": f"heal_reconstruct_{HEAL_N}drive_4offline_1MiB",
-            "value": round(gibs, 3), "unit": "GiB/s",
+            "value": round(gibs, 3), "unit": "GiB/s", "spread": spread,
             "vs_baseline": round(gibs / NORTH_STAR_GIBS, 4)}
 
 
@@ -364,19 +381,19 @@ def bench_host_pipeline() -> dict:
         data = os.urandom(size)
         enc = plane.PartEncoder(paths, HEAL_K, HEAL_N - HEAL_K, BLOCK_SIZE)
         enc.feed(data[: 16 << 20], final=True)  # warm (tables, page cache)
-        best_put = 0.0
+        put_rates = []
         for _ in range(3):
             t0 = time.perf_counter()
             enc = plane.PartEncoder(paths, HEAL_K, HEAL_N - HEAL_K,
                                     BLOCK_SIZE)
             enc.feed(data, final=True)
-            best_put = max(best_put, size / (time.perf_counter() - t0))
-        best_get = 0.0
+            put_rates.append(size / (time.perf_counter() - t0))
+        get_rates = []
         for _ in range(3):
             t0 = time.perf_counter()
             out, _states = plane.decode_range(
                 paths, HEAL_K, HEAL_N - HEAL_K, BLOCK_SIZE, size, 0, size)
-            best_get = max(best_get, size / (time.perf_counter() - t0))
+            get_rates.append(size / (time.perf_counter() - t0))
         assert out == data
         # Reference-parity lane: same pipeline with HighwayHash-256
         # framing (the BASELINE config's named bitrot algorithm).
@@ -386,9 +403,10 @@ def bench_host_pipeline() -> dict:
         enc.feed(data, final=True)
         hh_put = size / (time.perf_counter() - t0)
         return {"metric": "host_pipeline_encode_16drive",
-                "value": round(best_put / (1 << 30), 3), "unit": "GiB/s",
+                "value": round(_median(put_rates) / (1 << 30), 3),
+                "unit": "GiB/s", "spread": _spread(put_rates),
                 "vs_baseline": 0.0,
-                "get_gibs": round(best_get / (1 << 30), 3),
+                "get_gibs": round(_median(get_rates) / (1 << 30), 3),
                 "hh256_put_gibs": round(hh_put / (1 << 30), 3),
                 "threads": min(8, os.cpu_count() or 1),
                 "cores": os.cpu_count()}
@@ -429,11 +447,15 @@ def bench_listing() -> dict:
         cold_page_s = 1 / (time.perf_counter() - t0)
         # Page 1 kicks the block-stream render; wait for the background
         # renderer to cover the bucket, then page sequentially mid-bucket.
+        # The wait is bounded by the metacache TTL: the renderer itself
+        # abandons at TTL, so waiting longer can only burn wall clock and
+        # then measure marker-pushdown walk pages as metacache pages.
         pools.list_objects("big", max_keys=1000)
-        deadline = time.time() + 120
+        deadline = time.time() + pools.metacache.ttl
+        stream_complete = False
         while time.time() < deadline:
-            idx = pools.metacache._load_idx("big", "", "o")
-            if idx is not None and idx.get("complete"):
+            if pools.metacache.stream_complete("big", "", "o"):
+                stream_complete = True
                 break
             time.sleep(0.25)
         pages = 0
@@ -449,6 +471,10 @@ def bench_listing() -> dict:
         return {"metric": "listing_stream_50k", "value": round(rate, 0),
                 "unit": "objects/s", "vs_baseline": 0.0,
                 "midbucket_pages_per_s": round(page_s, 1),
+                # False = the stream never covered the bucket before the
+                # TTL; the pages/s above are walk pages, not comparable
+                # to a completed-stream round.
+                "midbucket_stream_complete": stream_complete,
                 "cold_page_s": round(cold_page_s, 1)}
     finally:
         shutil.rmtree(root, ignore_errors=True)
@@ -499,12 +525,12 @@ def bench_degraded() -> dict:
         _info, it = es.get_object("bench", "deg")
         got = b"".join(it)
         assert got == payload, "degraded read mismatch"
-        best_get = 0.0
+        get_rates = []
         for _ in range(3):
             t0 = time.perf_counter()
             _info, it = es.get_object("bench", "deg")
             n = sum(len(c) for c in it)
-            best_get = max(best_get, n / (time.perf_counter() - t0))
+            get_rates.append(n / (time.perf_counter() - t0))
         # Heal e2e: rebuild the 2 lost shards through the serving stack.
         t0 = time.perf_counter()
         res = es.heal_object("bench", "deg")
@@ -550,7 +576,8 @@ def bench_degraded() -> dict:
         except Exception as e:  # noqa: BLE001 - report, don't sink the config
             log(f"mixed-remote GET leg failed: {e}")
         return {"metric": "get_degraded_2lost_16drive",
-                "value": round(best_get / (1 << 30), 3), "unit": "GiB/s",
+                "value": round(_median(get_rates) / (1 << 30), 3),
+                "unit": "GiB/s", "spread": _spread(get_rates),
                 "vs_baseline": 0.0,
                 "heal_e2e_gibs": round(size / heal_dt / (1 << 30), 3),
                 "get_mixed_4remote_gibs": round(mixed / (1 << 30), 3),

@@ -16,6 +16,7 @@ from aiohttp import web
 
 from minio_tpu.admin.configkv import ConfigSys
 from minio_tpu.admin.metrics import PROM_CONTENT_TYPE, collect_metrics
+from minio_tpu.iam import reqctx
 from minio_tpu.iam.policy import PolicyArgs
 from minio_tpu.s3.errors import S3Error
 from minio_tpu.utils import errors as se
@@ -36,12 +37,27 @@ class AdminAPI:
     def _authorize(self, identity, action: str) -> None:
         if identity.kind == "anonymous":
             raise S3Error("AccessDenied", "admin API requires credentials")
-        if not self.s.iam.is_allowed(identity, PolicyArgs(action=action)):
+        # Admin requests evaluate conditioned policies against the same
+        # per-request context as the S3 plane (set by handle() /
+        # authorize_http) — so e.g. a Deny admin:* NotIpAddress
+        # <office CIDR> policy actually bites.
+        if not self.s.iam.is_allowed(identity, PolicyArgs(
+                action=action, conditions=reqctx.get_condition_context())):
             raise S3Error("AccessDenied", f"{action} not allowed")
+
+    def authorize_http(self, request, identity, action: str) -> None:
+        """_authorize with the request's condition context — for admin
+        checks reached outside handle() (the metrics endpoints on the S3
+        router)."""
+        reqctx.set_condition_context(
+            self.s._condition_context(request, identity))
+        self._authorize(identity, action)
 
     async def handle(self, request: web.Request, path: str,
                      identity) -> web.StreamResponse:
         """Dispatch /minio/admin/v3/<op>. `path` excludes the prefix."""
+        reqctx.set_condition_context(
+            self.s._condition_context(request, identity))
         loop = asyncio.get_running_loop()
 
         def run(fn, *a, **kw):
@@ -415,11 +431,26 @@ class AdminAPI:
         dry = bool(opts.get("dryRun"))
         # madmin HealOpts.ScanMode: "deep" verifies bitrot digests on
         # every shard instead of trusting present-and-stat-clean files.
-        # The wire enum is an integer (HealDeepScan == 2, reference
-        # pkg/madmin/heal-commands.go:31); the string form is accepted
-        # for hand-written clients.
-        sm = opts.get("scanMode", "")
-        deep = sm == 2 or str(sm).lower() == "deep"
+        # The wire enum is an integer (HealNormalScan == 1, HealDeepScan
+        # == 2, reference pkg/madmin/heal-commands.go:31); the string
+        # forms "normal"/"deep" are accepted for hand-written clients.
+        # Anything else is rejected — a typo'd deep request silently
+        # running a shallow scan would skip the bitrot verification the
+        # operator asked for.
+        sm = opts.get("scanMode", None)
+        if sm in (None, ""):
+            deep = False
+        else:
+            try:
+                smi = int(sm)
+            except (TypeError, ValueError):
+                smi = {"normal": 1, "deep": 2}.get(str(sm).lower())
+            # 0 is madmin's HealUnknownScan — Go clients that leave
+            # HealOpts.ScanMode unset marshal it; treat as normal.
+            if smi not in (0, 1, 2):
+                raise S3Error("InvalidArgument",
+                              f"unrecognized scanMode {sm!r}")
+            deep = smi == 2
 
         def do() -> dict:
             items = []

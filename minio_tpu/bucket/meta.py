@@ -108,7 +108,16 @@ class BucketMetadataSys:
 
     def update(self, bucket: str, **changes) -> BucketMetadata:
         """Read-modify-write one or more config fields, persist, recache,
-        and fan out invalidation."""
+        and fan out invalidation. Bucket policies are the one payload
+        validated here rather than only at the HTTP handler: every write
+        path (S3 PutBucketPolicy, web console, admin import) must reject
+        a policy whose conditions can't be evaluated — storing one would
+        fail open on Deny (iam/condition.py fail-closed contract)."""
+        pol = changes.get("policy_json")
+        if pol:
+            from minio_tpu.iam.policy import Policy
+
+            Policy.parse(pol).validate()
         meta = self.get(bucket)
         for k, v in changes.items():
             if not hasattr(meta, k):

@@ -34,6 +34,7 @@ import dataclasses
 import hashlib
 import threading
 import time
+import uuid
 from collections import OrderedDict
 
 from minio_tpu.dist.rpc import pack, unpack
@@ -66,6 +67,11 @@ class Metacache:
         self._render_lock = threading.Lock()
         self._last_read: dict[tuple, float] = {}
         self._closed = False
+        # Stamped into every published idx: only the node that rendered a
+        # generation may reclaim its replicated-store docs on expiry —
+        # another node's clock/TTL view must never delete blocks a peer
+        # is mid-publish on (its _rendering set is invisible here).
+        self._owner = uuid.uuid4().hex[:16]
 
     # Background rendering continues only while someone keeps reading the
     # stream (the reference's metacache likewise stops feeding listings
@@ -267,7 +273,8 @@ class Metacache:
     def _publish_idx(self, base, created, state, complete: bool,
                      final: bool = False) -> None:
         doc = {"v": 2, "created": created, "starts": list(state["starts"]),
-               "blocks": state["blocks"], "complete": complete}
+               "blocks": state["blocks"], "complete": complete,
+               "owner": self._owner}
         self._store.write_sys_config(f"{base}/idx", pack(doc))
         self._memo_put(f"{base}/idx", created, doc)
         if final:
@@ -307,13 +314,25 @@ class Metacache:
         created = doc.get("created", 0)
         if (doc.get("v") != 2 or time.time() - created > self.ttl
                 or self._stale(bucket, created)):
-            # Expired/stale generation: reclaim it from the replicated
-            # store (the durable analogue of the old single-doc drop) —
-            # unless a local renderer is mid-publish of a NEW generation,
-            # whose idx the delete would clobber.
+            # Expired/stale generation: always reclaim the in-memory memo;
+            # the REPLICATED docs are deleted only by the node that
+            # rendered them (owner stamp) and only while no local renderer
+            # is mid-publish of a new generation — a peer's expiry view
+            # must not delete blocks another node just published under a
+            # fresh idx (per-node _rendering/_dirty_at are invisible
+            # cross-node; generation checks keep correctness, but the
+            # deletes would degrade its continuations to full walks).
+            # Hard-expired generations (owner restarted/died: its uuid is
+            # gone forever) are fair game for ANY node — no peer can be
+            # mid-render of something 10 TTLs old, and without this
+            # escape hatch a dead owner's blocks would leak in the
+            # replicated store indefinitely.
+            self._memo_drop_prefix(base)
             with self._render_lock:
                 rendering = (bucket, prefix, kind) in self._rendering
-            if not rendering:
+            hard_expired = time.time() - created > 10 * self.ttl
+            if not rendering and (doc.get("owner") == self._owner
+                                  or hard_expired):
                 self.drop(bucket, prefix, kind)
             return None
         self._memo_put(f"{base}/idx", created, doc)
@@ -361,6 +380,14 @@ class Metacache:
 
         self.hits += 1
         return gen(), bool(idx["complete"])
+
+    def stream_complete(self, bucket: str, prefix: str = "",
+                        kind: str = "o") -> bool:
+        """Public completeness probe: does a live (unexpired, non-stale)
+        stream cover the whole namespace? Benchmarks and operators poll
+        this instead of reaching into _load_idx."""
+        idx = self._load_idx(bucket, prefix, kind)
+        return bool(idx and idx.get("complete"))
 
     # -- drop --------------------------------------------------------------
 

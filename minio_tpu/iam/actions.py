@@ -29,6 +29,14 @@ _BUCKET_SUB = {
                     "s3:PutReplicationConfiguration",
                     "s3:PutReplicationConfiguration"),
     "quota": ("admin:GetBucketQuota", "admin:SetBucketQuota", None),
+    "acl": ("s3:GetBucketAcl", "s3:PutBucketAcl", None),
+    "website": ("s3:GetBucketWebsite", "s3:PutBucketWebsite",
+                "s3:DeleteBucketWebsite"),
+    "accelerate": ("s3:GetAccelerateConfiguration",
+                   "s3:PutAccelerateConfiguration", None),
+    "requestPayment": ("s3:GetBucketRequestPayment",
+                       "s3:PutBucketRequestPayment", None),
+    "logging": ("s3:GetBucketLogging", "s3:PutBucketLogging", None),
 }
 
 _OBJECT_SUB = {

@@ -16,6 +16,11 @@ import functools
 import json
 from dataclasses import dataclass, field
 
+from minio_tpu.iam.condition import (
+    Conditions,
+    normalize_values,
+    parse_conditions,
+)
 from minio_tpu.utils import errors as se
 
 # Canned policies (pkg/iam/policy/*-canned-policy definitions).
@@ -58,6 +63,14 @@ class PolicyArgs:
     account: str = ""                # requesting access key
     conditions: dict[str, list[str]] = field(default_factory=dict)
 
+    def __post_init__(self):
+        # Normalize the condition context once per authorization
+        # question (lowercase keys, str-list values) — evaluation visits
+        # many statements per request and must not re-copy the dict in
+        # each.
+        if self.conditions:
+            self.conditions = normalize_values(self.conditions)
+
     @property
     def resource(self) -> str:
         return f"{self.bucket}/{self.object}" if self.object else self.bucket
@@ -76,16 +89,6 @@ def _match(pattern: str, value: str) -> bool:
     return fnmatch.fnmatchcase(value, pattern)
 
 
-_CONDITION_OPS = {
-    "StringEquals": lambda want, have: any(h in want for h in have),
-    "StringNotEquals": lambda want, have: all(h not in want for h in have),
-    "StringLike": lambda want, have: any(
-        _match(w, h) for w in want for h in have),
-    "StringNotLike": lambda want, have: not any(
-        _match(w, h) for w in want for h in have),
-}
-
-
 @dataclass
 class Statement:
     effect: str                          # Allow | Deny
@@ -94,6 +97,11 @@ class Statement:
     resources: list[str]
     conditions: dict[str, dict[str, list[str]]]
     principals: list[str] | None         # None = identity policy (no field)
+    # Compiled Condition block (iam/condition.py). Lenient compilation at
+    # parse time: a stored document with a condition this build can't
+    # evaluate gets an unevaluable marker, which evaluates fail-closed
+    # (Deny applies, Allow doesn't). validate() re-parses strict.
+    cond: Conditions | None = None
 
     def matches_principal(self, account: str) -> bool:
         if self.principals is None:
@@ -131,15 +139,14 @@ class Statement:
         return False
 
     def matches_conditions(self, have: dict[str, list[str]]) -> bool:
-        for op, kv in self.conditions.items():
-            fn = _CONDITION_OPS.get(op)
-            if fn is None:
-                return False  # unknown operator -> statement can't apply
-            for key, want in kv.items():
-                if not fn(_as_list(want),
-                          have.get(key, have.get(key.lower(), []))):
-                    return False
-        return True
+        """`have` is a PolicyArgs-normalized context (lowercase keys,
+        str-list values — see PolicyArgs.__post_init__)."""
+        cond = self.cond
+        if cond is None:  # hand-built Statement: compile on first use
+            cond = self.cond = parse_conditions(self.conditions)
+        if not cond:
+            return True
+        return cond.evaluate(have, deny=self.effect == "Deny")
 
     def applies(self, args: PolicyArgs) -> bool:
         return (self.matches_principal(args.account)
@@ -181,13 +188,15 @@ class Policy:
             effect = s.get("Effect", "")
             if effect not in ("Allow", "Deny"):
                 raise se.MalformedPolicy(f"bad Effect {effect!r}")
+            raw_cond = s.get("Condition", {}) or {}
             stmts.append(Statement(
                 effect=effect,
                 actions=[str(a) for a in _as_list(s.get("Action"))],
                 not_actions=[str(a) for a in _as_list(s.get("NotAction"))],
                 resources=[str(r) for r in _as_list(s.get("Resource"))],
-                conditions=s.get("Condition", {}) or {},
+                conditions=raw_cond,
                 principals=principals,
+                cond=parse_conditions(raw_cond),
             ))
         return cls(stmts, version=doc.get("Version", ""))
 
@@ -207,9 +216,16 @@ class Policy:
         return not self.statements
 
     def validate(self) -> None:
+        """Put-time validation (PutBucketPolicy / set_policy / session
+        policies): beyond shape checks, conditions re-parse strict so an
+        operator or key this build can't evaluate is rejected with
+        MalformedPolicy instead of being stored and skipped — the
+        reference's unmarshal-time rejection (pkg/bucket/policy/
+        condition UnmarshalJSON)."""
         for s in self.statements:
             if not s.actions and not s.not_actions:
                 raise se.MalformedPolicy("statement without Action")
+            parse_conditions(s.conditions, strict=True)
 
 
 @functools.lru_cache(maxsize=256)

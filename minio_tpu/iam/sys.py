@@ -80,6 +80,10 @@ class TempCredential:
     # come from the token's policy claim (cmd/sts-handlers.go WebIdentity).
     policies: list[str] = field(default_factory=list)
     subject: str = ""         # IdP subject, for audit
+    # Namespaced token claims ("jwt:sub", "ldap:username", ...) — the
+    # request-condition plane exposes these so session/identity policies
+    # can scope by claim (cmd/iam.go GetClaimsForPolicy role).
+    claims: dict = field(default_factory=dict)
 
     @property
     def expired(self) -> bool:
@@ -235,13 +239,14 @@ class IAMSys:
                     return Identity(access_key, tc.kind,
                                     policies=list(tc.policies),
                                     session_policy=sp,
-                                    claims={"sub": tc.subject})
+                                    claims={"sub": tc.subject,
+                                            **tc.claims})
                 parent_id = (self.identify(tc.parent)
                              if tc.parent != access_key else None)
                 return Identity(
                     access_key, tc.kind, parent=tc.parent,
                     policies=parent_id.policies if parent_id else [],
-                    session_policy=sp)
+                    session_policy=sp, claims=dict(tc.claims))
         raise se.InvalidAccessKey(access_key)
 
     def verify_session_token(self, access_key: str, token: str) -> bool:
@@ -381,7 +386,10 @@ class IAMSys:
     def assume_role(self, parent_access_key: str, duration: int = 3600,
                     session_policy_json: str = "") -> TempCredential:
         if session_policy_json:
-            Policy.parse(session_policy_json)
+            # Full validation, not just parse: a session policy with an
+            # unsupported condition must be rejected here, at issue time
+            # (the request-condition plane's fail-closed contract).
+            Policy.parse(session_policy_json).validate()
         duration = max(900, min(duration, 7 * 24 * 3600))
         tc = TempCredential(
             access_key=_gen_access_key(),
@@ -399,13 +407,16 @@ class IAMSys:
 
     def assume_role_with_claims(self, subject: str, policies: list[str],
                                 duration: int = 3600,
-                                session_policy_json: str = "") -> TempCredential:
+                                session_policy_json: str = "",
+                                claims: dict | None = None) -> TempCredential:
         """Federated temp credentials from a validated IdP token
         (AssumeRoleWithWebIdentity/ClientGrants, cmd/sts-handlers.go:49-102):
         no parent account; authorization comes from the claim-mapped policy
-        names, optionally narrowed by a session policy."""
+        names, optionally narrowed by a session policy. `claims` carries
+        namespaced token attributes ("jwt:sub", "ldap:username", ...) into
+        the credential so condition contexts can expose them."""
         if session_policy_json:
-            Policy.parse(session_policy_json)
+            Policy.parse(session_policy_json).validate()
         # No 900 s floor here: the caller caps at the identity token's own
         # remaining lifetime, which may legitimately be shorter.
         duration = max(1, min(duration, 7 * 24 * 3600))
@@ -419,6 +430,7 @@ class IAMSys:
             session_policy_json=session_policy_json,
             policies=list(policies),
             subject=subject,
+            claims=dict(claims or {}),
         )
         with self._mu:
             self.temp_creds[tc.access_key] = tc
@@ -429,6 +441,8 @@ class IAMSys:
                             session_policy_json: str = "",
                             access_key: str = "",
                             secret_key: str = "") -> TempCredential:
+        if session_policy_json:
+            Policy.parse(session_policy_json).validate()
         tc = TempCredential(
             access_key=access_key or _gen_access_key(),
             secret_key=secret_key or _gen_secret_key(),

@@ -53,6 +53,7 @@ ERRORS = {
     "ServiceUnavailable": APIError("ServiceUnavailable", "The service is unavailable. Please retry.", 503),
     "AuthorizationHeaderMalformed": APIError("AuthorizationHeaderMalformed", "The authorization header is malformed.", 400),
     "NoSuchBucketPolicy": APIError("NoSuchBucketPolicy", "The bucket policy does not exist", 404),
+    "NoSuchWebsiteConfiguration": APIError("NoSuchWebsiteConfiguration", "The specified bucket does not have a website configuration", 404),
     "MalformedPolicy": APIError("MalformedPolicy", "Policy has invalid resource.", 400),
     "NoSuchLifecycleConfiguration": APIError("NoSuchLifecycleConfiguration", "The lifecycle configuration does not exist", 404),
     "ServerSideEncryptionConfigurationNotFoundError": APIError("ServerSideEncryptionConfigurationNotFoundError", "The server side encryption configuration was not found", 404),

@@ -89,6 +89,18 @@ _LIST_ITER = type(iter([]))
 SPOOL_LIMIT = 32 << 20
 
 
+def _scalar_claim(v) -> str | None:
+    """Claim value as a condition string; compound claims (lists, maps)
+    don't map to a single condition value and are skipped. The string
+    spelling itself is the condition subsystem's (one coercion rule for
+    stamping at STS issue time and evaluating at request time)."""
+    from minio_tpu.iam.condition import scalar_str
+
+    if isinstance(v, (str, int, float, bool)):
+        return scalar_str(v)
+    return None
+
+
 def _int_q(q: dict, name: str, default: int, lo: int = 0, hi: int = 100_000) -> int:
     raw = q.get(name)
     if raw in (None, ""):
@@ -574,18 +586,112 @@ class S3Server:
             return True
         return self.bucket_meta.get(bucket).versioning_enabled
 
+    def _condition_context(self, request, identity,
+                           q: dict | None = None) -> dict[str, list[str]]:
+        """The request's condition values (reference getConditionValues,
+        cmd/bucket-policy.go:65-110): every authorized request carries a
+        POPULATED context so conditioned statements — above all a
+        conditioned Deny — evaluate against real values instead of
+        silently not applying. Keys are lowercase (condition keys are
+        case-insensitive); values are string lists."""
+        now = time.time()
+        # Same trust gate as _client_ip: behind a TLS-terminating proxy
+        # the backend hop is plaintext, so the canonical enforce-TLS
+        # Deny (Bool aws:SecureTransport false) would lock the bucket
+        # for everyone unless X-Forwarded-Proto is honored.
+        secure = request.secure
+        if (self.config.get("api", "trust_proxy_headers") or "") in (
+                "on", "1", "true"):
+            fwd_proto = request.headers.get("X-Forwarded-Proto", "")
+            if fwd_proto:
+                secure = fwd_proto.split(",")[0].strip().lower() == "https"
+        ctx: dict[str, list[str]] = {
+            "aws:sourceip": [self._client_ip(request)],
+            "aws:securetransport": ["true" if secure else "false"],
+            "aws:currenttime": [time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime(now))],
+            "aws:epochtime": [str(int(now))],
+        }
+        ua = request.headers.get("User-Agent", "")
+        if ua:
+            ctx["aws:useragent"] = [ua]
+        referer = request.headers.get("Referer", "")
+        if referer:
+            ctx["aws:referer"] = [referer]
+        kind = getattr(identity, "kind", "anonymous")
+        if kind == "anonymous":
+            ctx["aws:principaltype"] = ["Anonymous"]
+        else:
+            ctx["aws:principaltype"] = [
+                {"root": "Account", "sts": "AssumedRole"}.get(kind, "User")]
+            # MinIO usernames ARE access keys; temp/service credentials
+            # report their owning user (cmd/iam.go policy variables).
+            ctx["aws:username"] = [identity.parent or identity.access_key]
+            ctx["aws:userid"] = [identity.access_key]
+        # Auth classification (set during signature verification; absent
+        # on the web/admin JWT planes, where the keys stay missing).
+        auth = request.get("auth-type")
+        if auth:
+            ctx["s3:authtype"] = [auth[0]]
+            ctx["s3:signatureversion"] = [auth[1]]
+        # STS claim values ("jwt:sub", "ldap:username", ...) let
+        # WebIdentity/LDAP session policies scope by claim.
+        for ck, cv in getattr(identity, "claims", {}).items():
+            lk = str(ck).lower()
+            if lk.startswith(("jwt:", "ldap:")):
+                ctx[lk] = [str(cv)]
+        if q:
+            if q.get("versionId"):
+                ctx["s3:versionid"] = [q["versionId"]]
+            # Listing scope keys ride only when the client sent them
+            # (AWS populates s3:prefix et al. per-request, not with
+            # defaults — a policy requiring s3:prefix must see an
+            # unprefixed listing as non-matching).
+            for qk, ck2 in (("prefix", "s3:prefix"),
+                            ("delimiter", "s3:delimiter"),
+                            ("max-keys", "s3:max-keys")):
+                if qk in q:
+                    ctx[ck2] = [q[qk]]
+        for hk, ck3 in (
+                ("x-amz-object-lock-mode", "s3:object-lock-mode"),
+                ("x-amz-object-lock-retain-until-date",
+                 "s3:object-lock-retain-until-date"),
+                ("x-amz-object-lock-legal-hold",
+                 "s3:object-lock-legal-hold"),
+                ("x-amz-acl", "s3:x-amz-acl"),
+                ("x-amz-copy-source", "s3:x-amz-copy-source"),
+                ("x-amz-storage-class", "s3:x-amz-storage-class"),
+                ("x-amz-metadata-directive", "s3:x-amz-metadata-directive"),
+                ("x-amz-server-side-encryption",
+                 "s3:x-amz-server-side-encryption"),
+                ("x-amz-server-side-encryption-aws-kms-key-id",
+                 "s3:x-amz-server-side-encryption-aws-kms-key-id"),
+                ("x-amz-content-sha256", "s3:x-amz-content-sha256"),
+        ):
+            hv = request.headers.get(hk, "")
+            if hv:
+                ctx[ck3] = [hv]
+        # Already lowercase str-lists — mark it so the PolicyArgs built
+        # from this context (one per _check_access; one per KEY on bulk
+        # delete) don't each re-copy the dict.
+        from minio_tpu.iam.condition import normalize_values
+        return normalize_values(ctx)
+
     def _check_access(self, identity, action: str, bucket: str, key: str,
-                      conditions: dict | None = None) -> None:
+                      conditions: dict) -> None:
         """Authorize: identity policies ∪ bucket policy; explicit denies in
-        either win (cmd/auth-handler.go:274 checkRequestAuthType)."""
+        either win (cmd/auth-handler.go:274 checkRequestAuthType).
+        `conditions` is required — every call site supplies the populated
+        per-request context from _condition_context (an empty default here
+        made conditioned Deny statements silently inert)."""
         args = PolicyArgs(action=action, bucket=bucket, object=key,
-                          conditions=conditions or {})
+                          conditions=conditions)
         pol_raw = (self.bucket_meta.get(bucket).policy_json
                    if bucket else b"")
         if pol_raw:
             bp = Policy.parse_cached(pol_raw)
             bargs = PolicyArgs(action=action, bucket=bucket, object=key,
-                               conditions=conditions or {},
+                               conditions=conditions,
                                account=identity.access_key or "*")
             # Bucket-policy deny beats everything, including identity allow.
             for st in bp.statements:
@@ -596,6 +702,23 @@ class S3Server:
         if self.iam.is_allowed(identity, args):
             return
         raise S3Error("AccessDenied", resource=f"/{bucket}/{key}")
+
+    @staticmethod
+    def _require_private_acl(request, body: bytes) -> None:
+        """PutBucketAcl/PutObjectAcl accept only the private canned ACL
+        (header or XML body); grants the policy model can't express are
+        refused with NotImplemented (reference acl-handlers.go)."""
+        canned = request.headers.get("x-amz-acl", "")
+        if canned and canned != "private":
+            raise S3Error("NotImplemented",
+                          f"canned ACL {canned!r} is not supported")
+        try:
+            if not xmlutil.acl_body_is_private(body):
+                raise S3Error("NotImplemented",
+                              "only the private (FULL_CONTROL owner) ACL "
+                              "is supported")
+        except ValueError:
+            raise S3Error("MalformedXML") from None
 
     async def _entry(self, request: web.Request) -> web.StreamResponse:
         request_id = uuid.uuid4().hex[:16].upper()
@@ -784,6 +907,8 @@ class S3Server:
             request.query_string, keep_blank_values=True)]
         q = dict(query_items)
         # --- auth (reference cmd/auth-handler.go:102 classification) ---
+        # The classification also feeds the s3:authtype /
+        # s3:signatureversion condition keys (request["auth-type"]).
         if "X-Amz-Signature" in q:
             creds = sigv4.verify_presigned(
                 request.method, path, query_items, request.headers,
@@ -793,11 +918,13 @@ class S3Server:
             payload_hash = q.get("X-Amz-Content-Sha256", sigv4.UNSIGNED_PAYLOAD)
             auth_sig = None
             identity = self.iam.identify(creds.access_key)
+            request["auth-type"] = ("REST-QUERY-STRING", "AWS4-HMAC-SHA256")
         elif request.headers.get("Authorization", "").startswith(sigv4.ALGORITHM):
             _, payload_hash = sigv4.verify_header_auth(
                 request.method, path, query_items, request.headers, self._lookup)
             auth_sig = sigv4.parse_auth_header(request.headers["Authorization"])
             identity = self.iam.identify(auth_sig.access_key)
+            request["auth-type"] = ("REST-HEADER", "AWS4-HMAC-SHA256")
         elif sigv2.is_v2_header(request.headers):
             # Legacy SigV2 clients (cmd/signature-v2.go).
             creds = sigv2.verify_header_auth(
@@ -806,6 +933,7 @@ class S3Server:
             auth_sig = None
             payload_hash = sigv4.UNSIGNED_PAYLOAD
             identity = self.iam.identify(creds.access_key)
+            request["auth-type"] = ("REST-HEADER", "AWS")
         elif sigv2.is_v2_presigned(q):
             creds = sigv2.verify_presigned(
                 request.method, path, query_items, request.headers,
@@ -813,6 +941,7 @@ class S3Server:
             auth_sig = None
             payload_hash = sigv4.UNSIGNED_PAYLOAD
             identity = self.iam.identify(creds.access_key)
+            request["auth-type"] = ("REST-QUERY-STRING", "AWS")
         else:
             # Anonymous: allowed only where the bucket policy grants it.
             identity, payload_hash, auth_sig = (
@@ -857,7 +986,8 @@ class S3Server:
                 return await self.web.download(request, b, k)
             if path == "/minio/v2/metrics/cluster":
                 request["api"] = "metrics"
-                self.admin._authorize(identity, "admin:Prometheus")
+                self.admin.authorize_http(request, identity,
+                                          "admin:Prometheus")
                 loop = asyncio.get_running_loop()
                 body = await loop.run_in_executor(
                     None, collect_metrics, self.obj, self.stats,
@@ -868,7 +998,8 @@ class S3Server:
                 # Node-scope scrape: this process's planes only (the
                 # reference's cluster/node metrics-v2 split).
                 request["api"] = "metrics"
-                self.admin._authorize(identity, "admin:Prometheus")
+                self.admin.authorize_http(request, identity,
+                                          "admin:Prometheus")
                 loop = asyncio.get_running_loop()
                 body = await loop.run_in_executor(
                     None, collect_node_metrics, self.stats)
@@ -897,10 +1028,12 @@ class S3Server:
                     raise S3Error("AccessDenied", resource=path)
                 buckets = await run(self.obj.list_buckets)
                 if not identity.is_owner:
+                    cond_ctx = self._condition_context(request, identity, q)
                     allowed = []
                     for b in buckets:
                         ok_args = PolicyArgs(action="s3:ListBucket",
-                                             bucket=b.name)
+                                             bucket=b.name,
+                                             conditions=cond_ctx)
                         if self.iam.is_allowed(identity, ok_args):
                             allowed.append(b)
                     buckets = allowed
@@ -918,13 +1051,18 @@ class S3Server:
         action = action_for(m, sub, bucket, key, request.headers)
         request["api"] = "PostPolicy" if post_form else action.split(":", 1)[-1]
         bulk_delete = m == "POST" and not key and "delete" in q
+        # Built once per request, reused by in-handler re-checks
+        # (RestoreObject, bulk delete) — the values don't change
+        # mid-request.
+        cond_ctx = self._condition_context(request, identity, q)
+        request["cond-ctx"] = cond_ctx
         if not post_form and not bulk_delete:
             # Browser POST uploads authenticate via the signed policy
             # document inside the form; the handler checks access itself.
             # Bulk delete authorizes per object key (AWS DeleteObjects
             # semantics) — an endpoint-level check against the bare bucket
             # resource would wrongly reject object-scoped policies.
-            self._check_access(identity, action, bucket, key)
+            self._check_access(identity, action, bucket, key, cond_ctx)
 
         # ---------- bucket config subresources ----------
         if not key:
@@ -1055,6 +1193,21 @@ class S3Server:
             await run(self.obj.delete_object_tags, bucket, key, opts)
             return web.Response(status=204, headers=hdr)
 
+        # ----- object ACL: canned FULL_CONTROL answer, private-only PUT
+        #       (reference cmd/acl-handlers.go GetObjectACLHandler) -----
+        if "acl" in q:
+            if m in ("GET", "HEAD"):
+                await run(self.obj.get_object_info, bucket, key, opts)
+                return web.Response(body=xmlutil.acl_xml(),
+                                    content_type=XML_TYPE, headers=hdr)
+            if m == "PUT":
+                self._require_private_acl(request, await request.read())
+                await run(self.obj.get_object_info, bucket, key, opts)
+                return web.Response(status=200, headers=hdr)
+            # Terminal: DELETE ?acl must never fall through to the
+            # object-DELETE branch below (S3 has no DeleteObjectAcl).
+            raise S3Error("MethodNotAllowed", resource=path)
+
         # ----- object lock: retention / legal hold (pkg/bucket/object/lock,
         #       cmd/object-handlers.go PutObjectRetentionHandler etc.) -----
         if "retention" in q:
@@ -1111,7 +1264,8 @@ class S3Server:
             # (reference PostRestoreObjectHandler; our tiers read through,
             # so restore = pull the data back into the cluster).
             request["api"] = "RestoreObject"
-            self._check_access(identity, "s3:RestoreObject", bucket, key)
+            self._check_access(identity, "s3:RestoreObject", bucket, key,
+                               request["cond-ctx"])
             if not hasattr(self.obj, "restore_transitioned"):
                 raise S3Error("NotImplemented", resource=path)
             try:
@@ -1306,6 +1460,7 @@ class S3Server:
                 "utf-8", "replace")
 
         creds = sigv4.verify_post_policy(form, self._lookup)
+        request["auth-type"] = ("POST", "AWS4-HMAC-SHA256")
         # The "bucket" condition matches the request target, not a form
         # field (cmd/postpolicyform.go injects it the same way).
         form.setdefault("bucket", bucket)
@@ -1319,7 +1474,8 @@ class S3Server:
 
         identity = self.iam.identify(creds.access_key)
         request["identity"] = identity
-        self._check_access(identity, "s3:PutObject", bucket, key)
+        self._check_access(identity, "s3:PutObject", bucket, key,
+                           self._condition_context(request, identity))
 
         opts = ObjectOptions(versioned=self._bucket_versioned(bucket))
         if "content-type" in form:
@@ -1367,12 +1523,62 @@ class S3Server:
             "replication": ("replication_xml",
                             "ReplicationConfigurationNotFoundError"),
         }
-        config_subs = ({"policy", "versioning", "object-lock", "notification"}
+        config_subs = ({"policy", "versioning", "object-lock", "notification",
+                        "acl", "website", "accelerate", "requestPayment",
+                        "logging"}
                        | set(verbatim))
         if not (sub & config_subs):
             return None
 
         await run(self.obj.get_bucket_info, bucket)  # 404 before config
+
+        # ----- ACL: canned answers only (reference cmd/acl-handlers.go:
+        # 120-287 — access control is policy-based; ACL probes from SDK
+        # tooling like gsutil `ls -L` / boto get_acl get the FULL_CONTROL
+        # owner document, and only the private canned ACL is writable) --
+        if "acl" in sub:
+            if m in ("GET", "HEAD"):
+                return web.Response(body=xmlutil.acl_xml(),
+                                    content_type=XML_TYPE, headers=hdr)
+            if m == "PUT":
+                self._require_private_acl(request, await request.read())
+                return web.Response(status=200, headers=hdr)
+            raise S3Error("MethodNotAllowed", resource=f"/{bucket}")
+
+        # ----- dummy subresources (reference cmd/dummy-handlers.go):
+        # harmless defaults so SDK probes succeed instead of erroring ----
+        if "website" in sub:
+            if m in ("GET", "HEAD"):
+                raise S3Error("NoSuchWebsiteConfiguration",
+                              resource=f"/{bucket}")
+            if m == "DELETE":
+                return web.Response(status=204, headers=hdr)
+            raise S3Error("NotImplemented", resource=f"/{bucket}")
+        if "accelerate" in sub:
+            if m in ("GET", "HEAD"):
+                body = (b'<?xml version="1.0" encoding="UTF-8"?>'
+                        b'<AccelerateConfiguration xmlns="http://s3.amazon'
+                        b'aws.com/doc/2006-03-01/"></AccelerateConfiguration>')
+                return web.Response(body=body, content_type=XML_TYPE,
+                                    headers=hdr)
+            raise S3Error("NotImplemented", resource=f"/{bucket}")
+        if "requestPayment" in sub:
+            if m in ("GET", "HEAD"):
+                body = (b'<?xml version="1.0" encoding="UTF-8"?>'
+                        b'<RequestPaymentConfiguration xmlns="http://s3.'
+                        b'amazonaws.com/doc/2006-03-01/"><Payer>BucketOwner'
+                        b'</Payer></RequestPaymentConfiguration>')
+                return web.Response(body=body, content_type=XML_TYPE,
+                                    headers=hdr)
+            raise S3Error("NotImplemented", resource=f"/{bucket}")
+        if "logging" in sub:
+            if m in ("GET", "HEAD"):
+                body = (b'<?xml version="1.0" encoding="UTF-8"?>'
+                        b'<BucketLoggingStatus xmlns="http://s3.amazonaws'
+                        b'.com/doc/2006-03-01/"></BucketLoggingStatus>')
+                return web.Response(body=body, content_type=XML_TYPE,
+                                    headers=hdr)
+            raise S3Error("NotImplemented", resource=f"/{bucket}")
 
         if "policy" in sub:
             if m == "PUT":
@@ -1524,8 +1730,13 @@ class S3Server:
             if remaining <= 0:
                 raise S3Error("AccessDenied", "identity token expired")
             duration = min(max(900, duration), remaining)
+            # Scalar token claims travel namespaced ("jwt:sub", ...) so
+            # session/identity policies can condition on them.
+            jwt_claims = {f"jwt:{k}": s for k, v in claims.items()
+                          if (s := _scalar_claim(v)) is not None}
             tc = self.iam.assume_role_with_claims(
-                subject, policies, duration, session_policy)
+                subject, policies, duration, session_policy,
+                claims=jwt_claims)
         elif action == "AssumeRoleWithLDAPIdentity":
             from minio_tpu.iam.ldap import LDAPError, LDAPValidator
 
@@ -1555,7 +1766,8 @@ class S3Server:
             except LDAPError as e:
                 raise S3Error("AccessDenied", str(e)) from None
             tc = self.iam.assume_role_with_claims(
-                subject, policies, max(900, duration), session_policy)
+                subject, policies, max(900, duration), session_policy,
+                claims={"ldap:username": username, "ldap:user": subject})
         else:
             raise S3Error("STSNotImplemented")
 
@@ -2250,13 +2462,24 @@ class S3Server:
         objects, quiet = xmlutil.parse_delete_xml(body)
         identity = request.get("identity")
 
+        base_ctx = request.get("cond-ctx") or self._condition_context(
+            request, identity)
+
         def authorize():
             ok, den = [], []
             for k, v in objects:
                 action = ("s3:DeleteObjectVersion" if v
                           else "s3:DeleteObject")
+                ctx = base_ctx
+                if v:  # per-key version scope (s3:versionid conditions)
+                    # NormalizedContext copy keeps the already-normalized
+                    # marker — a plain {**base_ctx} would make every
+                    # PolicyArgs re-normalize the full context per key.
+                    from minio_tpu.iam.condition import NormalizedContext
+                    ctx = NormalizedContext(base_ctx)
+                    ctx["s3:versionid"] = [v]
                 try:
-                    self._check_access(identity, action, bucket, k)
+                    self._check_access(identity, action, bucket, k, ctx)
                     ok.append((k, v))
                 except S3Error:
                     den.append((k, "AccessDenied", "Access Denied."))

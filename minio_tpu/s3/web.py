@@ -22,6 +22,10 @@ import urllib.parse
 from aiohttp import web
 
 from minio_tpu.iam.policy import PolicyArgs
+from minio_tpu.iam.reqctx import (
+    get_condition_context,
+    set_condition_context,
+)
 from minio_tpu.utils import errors as se
 
 TOKEN_TTL = 24 * 3600.0
@@ -105,8 +109,12 @@ class WebAPI:
 
     def _allowed(self, ident, action: str, bucket: str = "",
                  obj: str = "") -> bool:
+        # Conditioned policies evaluate against the real request here
+        # too (a conditioned Deny must bite on the console plane, not
+        # just the S3 API) — context set at rpc/upload/download dispatch.
         return self.s.iam.is_allowed(
-            ident, PolicyArgs(action=action, bucket=bucket, object=obj))
+            ident, PolicyArgs(action=action, bucket=bucket, object=obj,
+                              conditions=get_condition_context()))
 
     # -- JSON-RPC 2.0 endpoint --
 
@@ -126,6 +134,7 @@ class WebAPI:
         ident = self._identity_from(request)
         if ident is None:
             return _rpc_error(rid, 401, "invalid or expired token")
+        set_condition_context(self.s._condition_context(request, ident))
 
         handlers = {
             "ListBuckets": self._list_buckets,
@@ -401,6 +410,7 @@ class WebAPI:
         ident = self._identity_from(request)
         if ident is None:
             raise web.HTTPForbidden(text="invalid token")
+        set_condition_context(self.s._condition_context(request, ident))
         if not self._allowed(ident, "s3:PutObject", bucket, key):
             raise web.HTTPForbidden(text="PutObject denied")
         import asyncio
@@ -473,6 +483,7 @@ class WebAPI:
             ident = self.s.iam.identify(ak)
         except se.InvalidAccessKey:
             raise web.HTTPForbidden(text="unknown identity") from None
+        set_condition_context(self.s._condition_context(request, ident))
         if not self._allowed(ident, "s3:GetObject", bucket, key):
             raise web.HTTPForbidden(text="GetObject denied")
         import asyncio

@@ -42,6 +42,58 @@ def error_xml(code: str, message: str, resource: str, request_id: str,
     return render(root)
 
 
+# Stable synthetic canonical-user id (the reference's
+# globalMinioDefaultOwnerID, cmd/api-utils.go) — there is no per-user
+# canonical id space; every resource reports the deployment owner.
+DEFAULT_OWNER_ID = (
+    "02d6176db174dc93cb1b899f7c6078f08654445fe8cf1b6ce98d8855f66bdbf4")
+
+
+def acl_xml(display_name: str = "minio-tpu") -> bytes:
+    """Canned GetBucketAcl/GetObjectAcl answer (reference acl-handlers.go
+    GetBucketACLHandler:120-287): owner with one FULL_CONTROL grant — the
+    only ACL state the policy-based access model can express."""
+    root = _doc("AccessControlPolicy")
+    o = _el(root, "Owner")
+    _el(o, "ID", DEFAULT_OWNER_ID)
+    _el(o, "DisplayName", display_name)
+    lst = _el(root, "AccessControlList")
+    g = _el(lst, "Grant")
+    grantee = _el(g, "Grantee")
+    grantee.set("xmlns:xsi", "http://www.w3.org/2001/XMLSchema-instance")
+    grantee.set("xsi:type", "CanonicalUser")
+    _el(grantee, "ID", DEFAULT_OWNER_ID)
+    _el(grantee, "DisplayName", display_name)
+    _el(g, "Permission", "FULL_CONTROL")
+    return render(root)
+
+
+def acl_body_is_private(body: bytes) -> bool:
+    """True when a PutAcl XML body expresses the private ACL — at most
+    ONE grant, FULL_CONTROL, no group/URI grantee. More than one grant
+    (e.g. a cross-account CanonicalUser add) must be refused, not
+    silently no-oped with a 200 (the reference rejects any body with
+    extra grants with NotImplemented, cmd/acl-handlers.go)."""
+    if not body.strip():
+        return True
+    try:
+        root = ET.fromstring(body)
+    except ET.ParseError:
+        raise ValueError("malformed ACL XML") from None
+    def tag(el):
+        return el.tag.rsplit("}", 1)[-1]
+    if tag(root) != "AccessControlPolicy":
+        # A foreign document (wrong payload on ?acl) is malformed, not a
+        # silently-accepted private ACL.
+        raise ValueError("body is not an AccessControlPolicy")
+    grants = [el for el in root.iter() if tag(el) == "Grant"]
+    if len(grants) > 1:
+        return False
+    perms = [el.text or "" for el in root.iter() if tag(el) == "Permission"]
+    uris = [el for el in root.iter() if tag(el) == "URI"]
+    return not uris and all(p == "FULL_CONTROL" for p in perms)
+
+
 def list_buckets_xml(buckets, owner="minio-tpu") -> bytes:
     root = _doc("ListAllMyBucketsResult")
     o = _el(root, "Owner")

@@ -10,10 +10,11 @@ Exactness contract: rows whose fields defeat the bulk float parser
 (non-numeric strings, exotic spellings) are re-evaluated ROW-WISE with
 the ordinary `sql.Evaluator` on the original parsed values, so results
 match the row engine bit-for-bit; the vector path is a fast lane for the
-common shape, not a second dialect. Queries outside the supported shape
-(LIKE, IN, BETWEEN, string ordering, expressions in projections,
-custom record delimiters, comment lines) return None from compile_plan
-and take the row engine.
+common shape, not a second dialect. LIKE and IN predicates are
+vectorized (masks over the indexed field table); queries outside the
+supported shape (expressions in projections, multi-char delimiters,
+comment lines, WHERE nodes _compile_where can't lower) return None from
+compile_plan and take the row engine.
 """
 
 from __future__ import annotations

@@ -2,9 +2,11 @@
 
 Role-equivalent of pkg/bucket/lifecycle (lifecycle.go Eval/ComputeAction):
 rules with prefix/tag filters; supported actions — Expiration (Days/Date,
-ExpiredObjectDeleteMarker), NoncurrentVersionExpiration, and
-AbortIncompleteMultipartUpload. Transition (tiering) parses but is inert
-until a tier backend exists.
+ExpiredObjectDeleteMarker), NoncurrentVersionExpiration,
+AbortIncompleteMultipartUpload, and Transition: StorageClass names a
+tier registered in scanner/tiers.py and the scanner moves eligible
+versions' data to that tier backend (reads pass through transparently;
+RestoreObject pulls data back).
 """
 
 from __future__ import annotations

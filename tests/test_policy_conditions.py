@@ -1,0 +1,500 @@
+"""Condition-evaluation matrix: operators × keys × Allow/Deny, unit and
+over live HTTP (iam/condition.py + the server's getConditionValues role).
+
+The security property under test: conditioned policies are never silently
+inert — a `Deny` + `IpAddress` blocks a real request, an unsupported
+operator is rejected at put time with MalformedPolicy, and a stored
+document that still carries an unevaluable condition fails CLOSED.
+"""
+
+import json
+
+import pytest
+import requests
+
+from minio_tpu.iam.policy import Policy, PolicyArgs
+from minio_tpu.iam.sys import IAMSys
+from minio_tpu.utils import errors as se
+
+
+def mk(statements):
+    return Policy.parse(json.dumps(
+        {"Version": "2012-10-17", "Statement": statements}))
+
+
+def allowed(p, action="s3:GetObject", bucket="b", obj="o", **conds):
+    ctx = {k.replace("__", ":"): v for k, v in conds.items()}
+    return p.is_allowed(PolicyArgs(action=action, bucket=bucket, object=obj,
+                                   conditions=ctx))
+
+
+# ---------------------------------------------------------------------------
+# operator matrix: one Allow per operator family; context matching the
+# condition grants, context missing/violating it denies.
+# ---------------------------------------------------------------------------
+
+MATRIX = [
+    # (operator, key, policy values, matching ctx value, violating ctx value)
+    ("StringEquals", "aws:username", ["alice"], "alice", "bob"),
+    ("StringNotEquals", "aws:username", ["bob"], "alice", "bob"),
+    ("StringEqualsIgnoreCase", "aws:useragent", ["CURL/8"], "curl/8", "wget"),
+    ("StringNotEqualsIgnoreCase", "aws:useragent", ["WGET"], "curl", "wget"),
+    ("StringLike", "s3:prefix", ["photos/*"], "photos/2026", "docs/x"),
+    ("StringNotLike", "s3:prefix", ["tmp/*"], "photos/1", "tmp/x"),
+    ("Bool", "aws:securetransport", ["true"], "true", "false"),
+    ("BinaryEquals", "aws:referer", ["aGVsbG8="], "hello", "world"),
+    ("NumericEquals", "s3:max-keys", ["100"], "100", "101"),
+    ("NumericNotEquals", "s3:max-keys", ["100"], "99", "100"),
+    ("NumericLessThan", "s3:max-keys", ["100"], "99", "100"),
+    ("NumericLessThanEquals", "s3:max-keys", ["100"], "100", "101"),
+    ("NumericGreaterThan", "s3:max-keys", ["100"], "101", "100"),
+    ("NumericGreaterThanEquals", "s3:max-keys", ["100"], "100", "99"),
+    ("DateEquals", "aws:currenttime", ["2026-01-01T00:00:00Z"],
+     "2026-01-01T00:00:00Z", "2026-01-02T00:00:00Z"),
+    ("DateNotEquals", "aws:currenttime", ["2026-01-01T00:00:00Z"],
+     "2026-01-02T00:00:00Z", "2026-01-01T00:00:00Z"),
+    ("DateLessThan", "aws:currenttime", ["2026-01-01T00:00:00Z"],
+     "2025-12-31T00:00:00Z", "2026-01-01T00:00:00Z"),
+    ("DateLessThanEquals", "aws:currenttime", ["2026-01-01T00:00:00Z"],
+     "2026-01-01T00:00:00Z", "2026-01-02T00:00:00Z"),
+    ("DateGreaterThan", "aws:epochtime", ["1700000000"],
+     "1800000000", "1600000000"),
+    ("DateGreaterThanEquals", "aws:epochtime", ["1700000000"],
+     "1700000000", "1600000000"),
+    ("IpAddress", "aws:sourceip", ["10.0.0.0/8"], "10.1.2.3", "192.168.1.1"),
+    ("NotIpAddress", "aws:sourceip", ["10.0.0.0/8"], "192.168.1.1",
+     "10.1.2.3"),
+]
+
+
+@pytest.mark.parametrize("op,key,want,good,bad", MATRIX,
+                         ids=[m[0] for m in MATRIX])
+def test_operator_matrix_allow(op, key, want, good, bad):
+    p = mk([{"Effect": "Allow", "Action": "s3:GetObject", "Resource": "*",
+             "Condition": {op: {key: want}}}])
+    assert p.is_allowed(PolicyArgs(action="s3:GetObject", bucket="b",
+                                   object="o", conditions={key: [good]}))
+    assert not p.is_allowed(PolicyArgs(action="s3:GetObject", bucket="b",
+                                       object="o", conditions={key: [bad]}))
+
+
+@pytest.mark.parametrize("op,key,want,good,bad", MATRIX,
+                         ids=[m[0] for m in MATRIX])
+def test_operator_matrix_deny(op, key, want, good, bad):
+    p = mk([{"Effect": "Allow", "Action": "s3:*", "Resource": "*"},
+            {"Effect": "Deny", "Action": "s3:GetObject", "Resource": "*",
+             "Condition": {op: {key: want}}}])
+    args = lambda v: PolicyArgs(action="s3:GetObject", bucket="b",  # noqa: E731
+                                object="o", conditions={key: [v]})
+    assert not p.is_allowed(args(good))   # condition holds -> Deny fires
+    assert p.is_allowed(args(bad))
+
+
+def test_null_operator():
+    p = mk([{"Effect": "Allow", "Action": "s3:GetObject", "Resource": "*",
+             "Condition": {"Null": {"s3:versionid": True}}}])
+    assert allowed(p)
+    assert not allowed(p, s3__versionid=["v1"])
+    p2 = mk([{"Effect": "Allow", "Action": "s3:GetObject", "Resource": "*",
+              "Condition": {"Null": {"s3:versionid": "false"}}}])
+    assert allowed(p2, s3__versionid=["v1"])
+    assert not allowed(p2)
+
+
+def test_missing_key_semantics():
+    """Positive operators fail on a missing key; negated forms hold."""
+    pos = mk([{"Effect": "Allow", "Action": "s3:GetObject", "Resource": "*",
+               "Condition": {"IpAddress": {"aws:SourceIp": "10.0.0.0/8"}}}])
+    assert not allowed(pos)     # no aws:sourceip in context
+    neg = mk([{"Effect": "Allow", "Action": "s3:GetObject", "Resource": "*",
+               "Condition": {"NotIpAddress": {"aws:SourceIp": "10.0.0.0/8"}}}])
+    assert allowed(neg)
+
+
+def test_ipaddress_matches_ipv4_mapped_ipv6():
+    """Dual-stack listeners report IPv4 peers as ::ffff:a.b.c.d — an
+    IPv4 CIDR Deny must still fire (version mismatch silently not
+    matching would be the inert-Deny failure all over again)."""
+    p = mk([{"Effect": "Allow", "Action": "s3:*", "Resource": "*"},
+            {"Effect": "Deny", "Action": "s3:GetObject", "Resource": "*",
+             "Condition": {"IpAddress": {"aws:SourceIp": "10.0.0.0/8"}}}])
+    assert not allowed(p, aws__sourceip=["::ffff:10.1.2.3"])
+    assert allowed(p, aws__sourceip=["::ffff:192.168.1.1"])
+
+
+def test_condition_keys_case_insensitive():
+    p = mk([{"Effect": "Allow", "Action": "s3:GetObject", "Resource": "*",
+             "Condition": {"StringEquals": {"AWS:SourceIP": "1.2.3.4"}}}])
+    assert p.is_allowed(PolicyArgs(
+        action="s3:GetObject", bucket="b", object="o",
+        conditions={"aws:sourceip": ["1.2.3.4"]}))
+
+
+# ---------------------------------------------------------------------------
+# fail-closed: put-time rejection + evaluation-time behavior for stored
+# documents with unevaluable conditions
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("cond", [
+    {"StringFancy": {"aws:SourceIp": "1.2.3.4"}},        # unknown operator
+    {"ForAnyValue:StringEquals": {"aws:username": "a"}},  # unsupported set op
+    {"StringEqualsIfExists": {"aws:username": "a"}},      # IfExists variants
+    {"StringEquals": {"aws:no-such-key": "x"}},           # unknown key
+    {"Bool": {"aws:SecureTransport": "maybe"}},           # bad Bool value
+    {"NumericEquals": {"s3:max-keys": "lots"}},           # bad number
+    {"DateEquals": {"aws:CurrentTime": "not-a-date"}},    # bad date
+    {"IpAddress": {"aws:SourceIp": "999.9.9.9/8"}},       # bad CIDR
+    {"BinaryEquals": {"aws:referer": "!!!not-base64"}},   # bad base64
+    {"Null": {"s3:versionid": ["true", "false"]}},        # bad Null shape
+    {"StringEquals": "not-a-map"},                        # bad block shape
+])
+def test_validate_rejects_unevaluable(cond):
+    p = mk([{"Effect": "Allow", "Action": "s3:GetObject", "Resource": "*",
+             "Condition": cond}])
+    with pytest.raises(se.MalformedPolicy):
+        p.validate()
+
+
+def test_stored_unevaluable_condition_fails_closed():
+    """A stored (pre-validation) document with an unknown operator: the
+    Deny statement APPLIES, the Allow statement doesn't — the broken side
+    always lands on deny (the seed failed open here)."""
+    doc = [{"Effect": "Allow", "Action": "s3:*", "Resource": "*"},
+           {"Effect": "Deny", "Action": "s3:GetObject", "Resource": "*",
+            "Condition": {"UnknownOp": {"aws:SourceIp": "1.2.3.4"}}}]
+    assert not allowed(mk(doc), aws__sourceip=["9.9.9.9"])
+    doc2 = [{"Effect": "Allow", "Action": "s3:GetObject", "Resource": "*",
+             "Condition": {"UnknownOp": {"aws:SourceIp": "1.2.3.4"}}}]
+    assert not allowed(mk(doc2), aws__sourceip=["1.2.3.4"])
+
+
+def test_iam_set_policy_rejects_unsupported_conditions():
+    iam = IAMSys("root", "rootsecret")
+    bad = json.dumps({"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Action": "s3:GetObject", "Resource": "*",
+         "Condition": {"NoSuchOp": {"aws:SourceIp": "1.2.3.4"}}}]})
+    with pytest.raises(se.MalformedPolicy):
+        iam.set_policy("badpol", bad)
+    with pytest.raises(se.MalformedPolicy):
+        iam.assume_role("root", session_policy_json=bad)
+    with pytest.raises(se.MalformedPolicy):
+        iam.add_service_account("root", session_policy_json=bad)
+
+
+def test_identity_policy_with_claim_condition():
+    """jwt:* claims thread from the credential into evaluation."""
+    iam = IAMSys("root", "rootsecret")
+    iam.set_policy("claimscoped", json.dumps({
+        "Version": "2012-10-17", "Statement": [
+            {"Effect": "Allow", "Action": "s3:GetObject", "Resource": "*",
+             "Condition": {"StringEquals": {"jwt:groups": "admins"}}}]}))
+    tc = iam.assume_role_with_claims(
+        "subj", ["claimscoped"], claims={"jwt:groups": "admins"})
+    ident = iam.identify(tc.access_key)
+    ctx = {k: [v] for k, v in ident.claims.items() if ":" in k}
+    assert iam.is_allowed(ident, PolicyArgs(
+        action="s3:GetObject", bucket="b", object="o", conditions=ctx))
+    tc2 = iam.assume_role_with_claims(
+        "subj2", ["claimscoped"], claims={"jwt:groups": "interns"})
+    ident2 = iam.identify(tc2.access_key)
+    ctx2 = {k: [v] for k, v in ident2.claims.items() if ":" in k}
+    assert not iam.is_allowed(ident2, PolicyArgs(
+        action="s3:GetObject", bucket="b", object="o", conditions=ctx2))
+
+
+# ---------------------------------------------------------------------------
+# live HTTP: the server's condition context feeding real evaluations
+# ---------------------------------------------------------------------------
+
+BKT = "condbkt"
+
+
+@pytest.fixture(scope="module")
+def cond_bucket(client):
+    r = client.put(f"/{BKT}")
+    assert r.status_code in (200, 409), r.text
+    r = client.put(f"/{BKT}/obj", data=b"conditioned")
+    assert r.status_code == 200, r.text
+    yield BKT
+    client.request("DELETE", f"/{BKT}", query={"policy": ""})
+
+
+def _put_policy(client, statements):
+    body = json.dumps({"Version": "2012-10-17",
+                       "Statement": statements}).encode()
+    return client.request("PUT", f"/{BKT}", query={"policy": ""}, data=body)
+
+
+def _del_policy(client):
+    r = client.request("DELETE", f"/{BKT}", query={"policy": ""})
+    assert r.status_code == 204, r.text
+
+
+def test_put_policy_unsupported_operator_rejected(client, cond_bucket):
+    r = _put_policy(client, [{
+        "Effect": "Deny", "Principal": "*", "Action": "s3:GetObject",
+        "Resource": f"arn:aws:s3:::{BKT}/*",
+        "Condition": {"StringFancy": {"aws:SourceIp": "1.2.3.4"}}}])
+    assert r.status_code == 400, r.text
+    assert "MalformedPolicy" in r.text
+    r = _put_policy(client, [{
+        "Effect": "Deny", "Principal": "*", "Action": "s3:GetObject",
+        "Resource": f"arn:aws:s3:::{BKT}/*",
+        "Condition": {"StringEquals": {"aws:NoSuchKey": "x"}}}])
+    assert r.status_code == 400 and "MalformedPolicy" in r.text
+    # a supported conditioned policy stores fine
+    r = _put_policy(client, [{
+        "Effect": "Deny", "Principal": "*", "Action": "s3:GetObject",
+        "Resource": f"arn:aws:s3:::{BKT}/*",
+        "Condition": {"IpAddress": {"aws:SourceIp": "10.255.0.0/16"}}}])
+    assert r.status_code == 204, r.text
+    _del_policy(client)
+
+
+def test_deny_ipaddress_blocks_live_request(client, cond_bucket):
+    """The acceptance bar: a stored Deny+IpAddress(CIDR) blocks a live
+    HTTP request whose source address matches — even for root."""
+    r = _put_policy(client, [{
+        "Effect": "Deny", "Principal": "*", "Action": "s3:GetObject",
+        "Resource": f"arn:aws:s3:::{BKT}/*",
+        "Condition": {"IpAddress": {"aws:SourceIp": "127.0.0.0/8"}}}])
+    assert r.status_code == 204, r.text
+    try:
+        r = client.get(f"/{BKT}/obj")
+        assert r.status_code == 403, (r.status_code, r.text[:200])
+        # other actions unaffected
+        assert client.head(f"/{BKT}").status_code == 200
+    finally:
+        _del_policy(client)
+    assert client.get(f"/{BKT}/obj").status_code == 200
+
+
+def test_deny_ipaddress_nonmatching_cidr_passes(client, cond_bucket):
+    r = _put_policy(client, [{
+        "Effect": "Deny", "Principal": "*", "Action": "s3:GetObject",
+        "Resource": f"arn:aws:s3:::{BKT}/*",
+        "Condition": {"IpAddress": {"aws:SourceIp": "10.0.0.0/8"}}}])
+    assert r.status_code == 204, r.text
+    try:
+        assert client.get(f"/{BKT}/obj").status_code == 200
+    finally:
+        _del_policy(client)
+
+
+def test_deny_securetransport_false_blocks_plain_http(client, cond_bucket):
+    """Bool over aws:SecureTransport: the canonical 'TLS only' policy
+    actually bites on a plaintext listener."""
+    r = _put_policy(client, [{
+        "Effect": "Deny", "Principal": "*", "Action": "s3:PutObject",
+        "Resource": f"arn:aws:s3:::{BKT}/*",
+        "Condition": {"Bool": {"aws:SecureTransport": "false"}}}])
+    assert r.status_code == 204, r.text
+    try:
+        r = client.put(f"/{BKT}/tls-only", data=b"x")
+        assert r.status_code == 403, (r.status_code, r.text[:200])
+        assert client.get(f"/{BKT}/obj").status_code == 200  # GET untouched
+    finally:
+        _del_policy(client)
+
+
+def test_securetransport_honors_forwarded_proto_when_trusted(client,
+                                                             cond_bucket):
+    """Behind a TLS-terminating proxy (api.trust_proxy_headers on), the
+    enforce-TLS Deny must respect X-Forwarded-Proto — otherwise it locks
+    the bucket for every request."""
+    import json as _json
+
+    r = _put_policy(client, [{
+        "Effect": "Deny", "Principal": "*", "Action": "s3:GetObject",
+        "Resource": f"arn:aws:s3:::{BKT}/*",
+        "Condition": {"Bool": {"aws:SecureTransport": "false"}}}])
+    assert r.status_code == 204, r.text
+    cfg = "/minio/admin/v3/config-kv"
+    try:
+        # untrusted: the header is client-spoofable and must be ignored
+        r = client.get(f"/{BKT}/obj",
+                       headers={"X-Forwarded-Proto": "https"})
+        assert r.status_code == 403
+        r = client.request("PUT", cfg, data=_json.dumps(
+            {"api": {"trust_proxy_headers": "on"}}).encode())
+        assert r.status_code == 200, r.text
+        r = client.get(f"/{BKT}/obj",
+                       headers={"X-Forwarded-Proto": "https"})
+        assert r.status_code == 200, (r.status_code, r.text[:200])
+        assert client.get(f"/{BKT}/obj").status_code == 403  # still plain
+    finally:
+        client.request("PUT", cfg, data=_json.dumps(
+            {"api": {"trust_proxy_headers": "off"}}).encode())
+        _del_policy(client)
+
+
+def test_deny_useragent_stringlike(client, cond_bucket):
+    r = _put_policy(client, [{
+        "Effect": "Deny", "Principal": "*", "Action": "s3:GetObject",
+        "Resource": f"arn:aws:s3:::{BKT}/*",
+        "Condition": {"StringLike": {"aws:UserAgent": "evil-bot/*"}}}])
+    assert r.status_code == 204, r.text
+    try:
+        r = client.get(f"/{BKT}/obj",
+                       headers={"User-Agent": "evil-bot/1.0"})
+        assert r.status_code == 403
+        r = client.get(f"/{BKT}/obj",
+                       headers={"User-Agent": "honest-sdk/2.0"})
+        assert r.status_code == 200
+    finally:
+        _del_policy(client)
+
+
+def test_anonymous_listing_scoped_by_prefix_condition(client, server,
+                                                      cond_bucket):
+    """Allow ListBucket only under photos/ for anonymous principals —
+    s3:prefix rides the condition context only when the client sent it,
+    so an unscoped listing doesn't match the Allow and stays denied."""
+    r = _put_policy(client, [{
+        "Effect": "Allow", "Principal": "*", "Action": "s3:ListBucket",
+        "Resource": f"arn:aws:s3:::{BKT}",
+        "Condition": {"StringLike": {"s3:prefix": "photos/*"}}}])
+    assert r.status_code == 204, r.text
+    try:
+        assert requests.get(
+            f"{server}/{BKT}", params={"prefix": "photos/2026"},
+            timeout=10).status_code == 200
+        assert requests.get(
+            f"{server}/{BKT}", params={"prefix": "docs/"},
+            timeout=10).status_code == 403
+        assert requests.get(f"{server}/{BKT}", timeout=10).status_code == 403
+    finally:
+        _del_policy(client)
+
+
+def test_numeric_max_keys_condition_live(client, server, cond_bucket):
+    r = _put_policy(client, [{
+        "Effect": "Allow", "Principal": "*", "Action": "s3:ListBucket",
+        "Resource": f"arn:aws:s3:::{BKT}",
+        "Condition": {"NumericLessThanEquals": {"s3:max-keys": "100"}}}])
+    assert r.status_code == 204, r.text
+    try:
+        assert requests.get(
+            f"{server}/{BKT}", params={"max-keys": "50"},
+            timeout=10).status_code == 200
+        assert requests.get(
+            f"{server}/{BKT}", params={"max-keys": "2000"},
+            timeout=10).status_code == 403
+    finally:
+        _del_policy(client)
+
+
+def test_date_condition_live(client, cond_bucket):
+    """DateGreaterThan over aws:CurrentTime in the past == deny always
+    (the 'policy expiry' shape, inverted)."""
+    r = _put_policy(client, [{
+        "Effect": "Deny", "Principal": "*", "Action": "s3:GetObject",
+        "Resource": f"arn:aws:s3:::{BKT}/*",
+        "Condition": {"DateGreaterThan":
+                      {"aws:CurrentTime": "2020-01-01T00:00:00Z"}}}])
+    assert r.status_code == 204, r.text
+    try:
+        assert client.get(f"/{BKT}/obj").status_code == 403
+    finally:
+        _del_policy(client)
+
+
+# ---------------------------------------------------------------------------
+# ACL + dummy surface (reference acl-handlers.go / dummy-handlers.go)
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_acl_canned_answer(client, cond_bucket):
+    r = client.request("GET", f"/{BKT}", query={"acl": ""})
+    assert r.status_code == 200, r.text
+    assert "FULL_CONTROL" in r.text and "AccessControlPolicy" in r.text
+    # private canned ACL accepted, others refused
+    r = client.request("PUT", f"/{BKT}", query={"acl": ""},
+                       headers={"x-amz-acl": "private"})
+    assert r.status_code == 200, r.text
+    r = client.request("PUT", f"/{BKT}", query={"acl": ""},
+                       headers={"x-amz-acl": "public-read"})
+    assert r.status_code == 501, r.text
+
+
+def test_object_acl_canned_answer(client, cond_bucket):
+    r = client.request("GET", f"/{BKT}/obj", query={"acl": ""})
+    assert r.status_code == 200, r.text
+    assert "FULL_CONTROL" in r.text
+    r = client.request("PUT", f"/{BKT}/obj", query={"acl": ""},
+                       headers={"x-amz-acl": "private"})
+    assert r.status_code == 200, r.text
+    r = client.request("PUT", f"/{BKT}/obj", query={"acl": ""},
+                       headers={"x-amz-acl": "public-read-write"})
+    assert r.status_code == 501, r.text
+    # missing object 404s before the canned answer
+    r = client.request("GET", f"/{BKT}/definitely-missing",
+                       query={"acl": ""})
+    assert r.status_code == 404, r.text
+
+
+def test_delete_acl_does_not_delete_object(client, cond_bucket):
+    """DELETE ?acl is not an S3 operation — it must 405, never fall
+    through to the object-DELETE branch and destroy the object."""
+    r = client.request("DELETE", f"/{BKT}/obj", query={"acl": ""})
+    assert r.status_code == 405, (r.status_code, r.text[:200])
+    assert client.get(f"/{BKT}/obj").status_code == 200  # still there
+    r = client.request("DELETE", f"/{BKT}", query={"acl": ""})
+    assert r.status_code == 405
+
+
+def test_authtype_condition_live(client, cond_bucket):
+    """s3:authtype distinguishes presigned from header-signed requests:
+    the 'no presigned URLs' policy shape."""
+    r = _put_policy(client, [{
+        "Effect": "Deny", "Principal": "*", "Action": "s3:GetObject",
+        "Resource": f"arn:aws:s3:::{BKT}/*",
+        "Condition": {"StringEquals": {"s3:authtype": "REST-QUERY-STRING"}}}])
+    assert r.status_code == 204, r.text
+    try:
+        url = client.presigned_url("GET", f"/{BKT}/obj")
+        assert requests.get(url, timeout=10).status_code == 403
+        assert client.get(f"/{BKT}/obj").status_code == 200  # header auth
+    finally:
+        _del_policy(client)
+
+
+def test_put_acl_foreign_body_rejected(client, cond_bucket):
+    """A non-ACL XML document on ?acl is malformed, not a silently
+    accepted private ACL."""
+    r = client.request("PUT", f"/{BKT}/obj", query={"acl": ""},
+                       data=b"<Tagging><TagSet/></Tagging>")
+    assert r.status_code == 400, (r.status_code, r.text[:200])
+    assert "MalformedXML" in r.text
+
+
+def test_put_acl_multiple_grants_refused(client, cond_bucket):
+    """A body adding a second (cross-account) grant must be refused with
+    NotImplemented, not silently no-oped with a 200."""
+    body = (b'<AccessControlPolicy>'
+            b'<Owner><ID>o</ID></Owner><AccessControlList>'
+            b'<Grant><Grantee><ID>o</ID></Grantee>'
+            b'<Permission>FULL_CONTROL</Permission></Grant>'
+            b'<Grant><Grantee><ID>other-account</ID></Grantee>'
+            b'<Permission>FULL_CONTROL</Permission></Grant>'
+            b'</AccessControlList></AccessControlPolicy>')
+    r = client.request("PUT", f"/{BKT}/obj", query={"acl": ""}, data=body)
+    assert r.status_code == 501, (r.status_code, r.text[:200])
+
+
+def test_dummy_bucket_subresources(client, cond_bucket):
+    r = client.request("GET", f"/{BKT}", query={"website": ""})
+    assert r.status_code == 404 and "NoSuchWebsiteConfiguration" in r.text
+    r = client.request("GET", f"/{BKT}", query={"accelerate": ""})
+    assert r.status_code == 200 and "AccelerateConfiguration" in r.text
+    r = client.request("GET", f"/{BKT}", query={"requestPayment": ""})
+    assert r.status_code == 200 and "BucketOwner" in r.text
+    r = client.request("GET", f"/{BKT}", query={"logging": ""})
+    assert r.status_code == 200 and "BucketLoggingStatus" in r.text
+    # PUTs are refused loudly, not silently swallowed
+    r = client.request("PUT", f"/{BKT}", query={"website": ""},
+                       data=b"<WebsiteConfiguration/>")
+    assert r.status_code == 501
+    # dummy GETs on a missing bucket still 404
+    r = client.request("GET", "/no-such-bkt-xyz", query={"logging": ""})
+    assert r.status_code == 404

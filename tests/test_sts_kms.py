@@ -201,6 +201,68 @@ def test_sts_web_identity_end_to_end(client, server, bucket):
     assert r.status_code == 403, r.text
 
 
+def test_sts_session_policy_claim_condition(client, server, bucket):
+    """A claim-conditioned session policy (Condition on jwt:sub) is
+    enforced over live HTTP: the claim travels from the validated token
+    into the credential and out through the request-condition context."""
+    import requests
+
+    from tests.s3client import SigV4Client
+
+    r = client.request("PUT", "/minio/admin/v3/config-kv", data=json.dumps({
+        "identity_openid": {"enable": "on",
+                            "jwks": json.dumps(HS_JWKS),
+                            "issuer": "https://idp.test",
+                            "audience": "",
+                            "claim_name": "policy"}}).encode())
+    assert r.status_code == 200, r.text
+
+    session_policy = json.dumps({"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Action": ["s3:GetObject", "s3:PutObject"],
+         "Resource": "arn:aws:s3:::*",
+         "Condition": {"StringEquals": {"jwt:sub": "alice"}}}]})
+
+    def assume(sub):
+        tok = make_hs256_jwt(HS_SECRET, {
+            "iss": "https://idp.test", "sub": sub,
+            "exp": time.time() + 600, "policy": "readwrite"})
+        r = requests.post(server + "/", data={
+            "Action": "AssumeRoleWithWebIdentity", "Version": "2011-06-15",
+            "WebIdentityToken": tok, "DurationSeconds": "900",
+            "Policy": session_policy})
+        assert r.status_code == 200, r.text
+        return SigV4Client(server, _xml_field(r.text, "AccessKeyId"),
+                           _xml_field(r.text, "SecretAccessKey"),
+                           session_token=_xml_field(r.text, "SessionToken"))
+
+    alice = assume("alice")
+    r = alice.put(f"/{bucket}/claim-obj", data=b"scoped")
+    assert r.status_code == 200, r.text
+    assert alice.get(f"/{bucket}/claim-obj").content == b"scoped"
+
+    # same policies, same session policy — but the sub claim doesn't
+    # satisfy the condition, so the session policy grants nothing
+    mallory = assume("mallory")
+    assert mallory.put(f"/{bucket}/claim-obj2",
+                       data=b"x").status_code == 403
+    assert mallory.get(f"/{bucket}/claim-obj").status_code == 403
+    client.delete(f"/{bucket}/claim-obj")
+
+    # a session policy with an unsupported condition operator is
+    # rejected at STS time, not stored and skipped
+    bad_policy = json.dumps({"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Action": "s3:GetObject", "Resource": "*",
+         "Condition": {"NoSuchOp": {"jwt:sub": "alice"}}}]})
+    tok = make_hs256_jwt(HS_SECRET, {
+        "iss": "https://idp.test", "sub": "alice",
+        "exp": time.time() + 600, "policy": "readwrite"})
+    r = requests.post(server + "/", data={
+        "Action": "AssumeRoleWithWebIdentity", "Version": "2011-06-15",
+        "WebIdentityToken": tok, "Policy": bad_policy})
+    assert r.status_code == 400, r.text
+    assert "MalformedPolicy" in r.text
+
+
 def test_sse_kms_end_to_end(client, bucket):
     r = client.post("/minio/admin/v3/kms/key/create", query={"key-id": "tkey"})
     assert r.status_code == 200, r.text

@@ -177,6 +177,31 @@ def test_boto2_presigned_url(bucket2):
     assert requests.get(bad).status_code == 403
 
 
+def test_boto2_acl_probes(bucket2):
+    """SDK ACL probes get the canned FULL_CONTROL owner document instead
+    of an error (reference acl-handlers.go), and only the private canned
+    ACL is writable."""
+    from boto.exception import S3ResponseError
+    from boto.s3.key import Key
+
+    _conn, b = bucket2
+    k = Key(b)
+    k.key = "aclprobe.bin"
+    k.set_contents_from_string(b"acl-payload")
+
+    pol = b.get_acl()
+    assert pol.owner.id
+    assert any(g.permission == "FULL_CONTROL" for g in pol.acl.grants)
+    kpol = b.get_acl("aclprobe.bin")
+    assert any(g.permission == "FULL_CONTROL" for g in kpol.acl.grants)
+
+    b.set_acl("private")                  # canned private: accepted
+    b.set_acl("private", "aclprobe.bin")
+    with pytest.raises(S3ResponseError) as ei:
+        b.set_acl("public-read")          # policy model can't express it
+    assert ei.value.status == 501
+
+
 def test_boto2_bad_secret_rejected(endpoint):
     _boto()
     from boto.exception import S3ResponseError
@@ -321,6 +346,21 @@ def test_gsutil_large_roundtrip_and_listing(gsutil_env, tmp_path):
     assert back.read_bytes() == body
     out = _gsutil(gsutil_env, "ls", "-l", "s3://gsconf2").decode()
     assert "big.bin" in out and str(len(body)) in out
+
+
+def test_gsutil_ls_L_acl_probe(gsutil_env, tmp_path):
+    """gsutil `ls -L` issues GET ?acl per object; the canned answer must
+    let the command succeed and report the FULL_CONTROL grant."""
+    _gsutil(gsutil_env, "mb", "s3://gsacl")
+    src = tmp_path / "a.bin"
+    src.write_bytes(os.urandom(8 << 10))
+    _gsutil(gsutil_env, "cp", str(src), "s3://gsacl/a.bin")
+    out = _gsutil(gsutil_env, "ls", "-L", "s3://gsacl/a.bin").decode()
+    assert "a.bin" in out
+    assert "FULL_CONTROL" in out
+    # bucket-level ACL probe rides `ls -L -b`
+    out = _gsutil(gsutil_env, "ls", "-L", "-b", "s3://gsacl").decode()
+    assert "gsacl" in out
 
 
 def test_gsutil_copy_remove_and_bucket_teardown(gsutil_env, tmp_path):
