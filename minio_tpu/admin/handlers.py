@@ -308,17 +308,26 @@ class AdminAPI:
         drives = []
         online = offline = 0
         for d in getattr(layer, "all_drives", lambda: [])():
+            # Drive-resilience plane surface: health state + deadline-hit
+            # count from the HealthChecker wrapper (absent on bare drives).
+            hs = getattr(d, "health_state", None)
+            health = hs() if callable(hs) else None
+            timeouts = getattr(d, "timeouts", None)
             try:
                 di = d.disk_info()
                 online += 1
-                drives.append({"endpoint": di.endpoint or di.mount_path,
-                               "state": "ok", "uuid": di.id,
-                               "totalspace": di.total,
-                               "availspace": di.free,
-                               "healing": di.healing})
+                entry = {"endpoint": di.endpoint or di.mount_path,
+                         "state": "ok", "uuid": di.id,
+                         "totalspace": di.total,
+                         "availspace": di.free,
+                         "healing": di.healing}
             except Exception:  # noqa: BLE001
                 offline += 1
-                drives.append({"endpoint": d.endpoint(), "state": "offline"})
+                entry = {"endpoint": d.endpoint(), "state": "offline"}
+            if health is not None:
+                entry["healthState"] = health
+                entry["timeouts"] = int(timeouts or 0)
+            drives.append(entry)
         health = {}
         try:
             health = layer.health()

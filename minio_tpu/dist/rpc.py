@@ -29,7 +29,9 @@ from minio_tpu import obs
 from minio_tpu.utils import errors as se
 
 DEFAULT_TIMEOUT = 30.0
-HEALTH_INTERVAL = 1.0
+HEALTH_INTERVAL = 1.0        # reconnect probe cadence during the grace runs
+HEALTH_GRACE_PROBES = 3      # probes at base cadence before backing off
+HEALTH_BACKOFF_CAP = 10.0    # max delay between reconnect probes
 ERR_STATUS = 599  # carries a typed storage error in the body
 
 # Fabric observability: the r5 TCP_NODELAY fix and the adaptive connect
@@ -197,6 +199,8 @@ class RestClient:
         self._lock = threading.Lock()
         self._pool: list[http.client.HTTPConnection] = []
         self._probing = False
+        self._closed = False
+        self._probe_stop = threading.Event()
         peer = f"{host}:{port}"
         self._obs_peer = peer
         self._obs_lat = _RPC_LATENCY.labels(peer=peer)
@@ -262,7 +266,7 @@ class RestClient:
                 return
             self._online = False
             self._obs_off.inc()
-            if self._probing:
+            if self._probing or self._closed:
                 return
             self._probing = True
         t = threading.Thread(target=self._probe_loop, daemon=True,
@@ -270,8 +274,18 @@ class RestClient:
         t.start()
 
     def _probe_loop(self) -> None:
-        while True:
-            time.sleep(HEALTH_INTERVAL)
+        """Reconnect probe: a short grace run at the base cadence (quick
+        restarts — the common case — reconnect as fast as ever), then
+        exponential backoff with jitter (capped) so a long-dead peer
+        costs one cheap probe every ~HEALTH_BACKOFF_CAP seconds instead
+        of one per second forever, with probes across many clients
+        decorrelated instead of thundering in lockstep. close() stops a
+        running probe via the event (no leaked daemon)."""
+        import random
+
+        delay = HEALTH_INTERVAL
+        failures = 0
+        while not self._probe_stop.wait(delay * random.uniform(0.6, 1.0)):
             try:
                 conn = self._new_conn(timeout=2.0)
                 conn.request("GET", "/health")
@@ -285,15 +299,22 @@ class RestClient:
                     self._probing = False
                 self._obs_rec.inc()
                 return
+            failures += 1
+            if failures >= HEALTH_GRACE_PROBES:
+                delay = min(delay * 2.0, HEALTH_BACKOFF_CAP)
+        with self._lock:
+            self._probing = False
 
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             for c in self._pool:
                 try:
                     c.close()
                 except Exception:
                     pass
             self._pool.clear()
+        self._probe_stop.set()
 
     # -- calls --
 

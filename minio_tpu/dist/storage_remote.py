@@ -62,6 +62,9 @@ def storage_routes(drives: dict[str, LocalDrive]) -> dict:
             "used_inodes": di.used_inodes, "endpoint": di.endpoint,
             "mount_path": di.mount_path, "id": di.id,
             "healing": di.healing, "error": di.error,
+            # health metrics (drive state / timeout counts) ride along so
+            # the admin drive-info surface sees the whole fleet.
+            "metrics": dict(di.metrics),
         })
 
     def h_get_disk_id(p, body):

@@ -191,7 +191,7 @@ def _claim_slot(drive: StorageAPI, fmt: "FormatInfo",
     and the live heal_format monitor — the claim ritual must not
     diverge between them."""
     from minio_tpu.erasure.autoheal import mark_drive_healing
-    from minio_tpu.storage.idcheck import DiskIDChecker
+    from minio_tpu.storage.healthcheck import unwrap as _unwrap_drive
 
     try:
         # Re-probe at claim time: the drive must STILL be provably blank
@@ -199,7 +199,7 @@ def _claim_slot(drive: StorageAPI, fmt: "FormatInfo",
         # tracker there would recreate the root on the parent filesystem
         # and route the format (and every healed shard) onto it, the
         # exact case the local drive's root guards defend against.
-        base = drive.inner if isinstance(drive, DiskIDChecker) else drive
+        base = _unwrap_drive(drive)
         cur = None
         try:
             cur = base.read_format()

@@ -160,7 +160,8 @@ class HealingMixin:
     # -- bucket heal (reference healBucket, cmd/erasure-healing.go:56) --
 
     def heal_bucket(self, bucket: str, dry_run: bool = False) -> HealResultItem:
-        results = parallel_map([lambda d=d: d.stat_vol(bucket) for d in self.drives])
+        results = parallel_map([lambda d=d: d.stat_vol(bucket) for d in self.drives],
+                               deadline=self._meta_deadline())
         res = HealResultItem(heal_type="bucket", bucket=bucket,
                              disk_count=self.n, dry_run=dry_run)
         have = [not isinstance(r, Exception) for r in results]
@@ -223,7 +224,8 @@ class HealingMixin:
         scan_deep: bool = False,
     ) -> HealResultItem:
         results = parallel_map(
-            [lambda d=d: d.read_version(bucket, obj, version_id) for d in self.drives]
+            [lambda d=d: d.read_version(bucket, obj, version_id) for d in self.drives],
+            deadline=self._meta_deadline(),
         )
         latest = latest_fileinfo(results)
         if latest is None:
@@ -325,7 +327,8 @@ class HealingMixin:
                 else:
                     checks.append(lambda d=drive: d.check_parts(bucket, obj, latest))
         to_run = [(i, c) for i, c in enumerate(checks) if c is not None]
-        outcomes = parallel_map([c for _, c in to_run])
+        outcomes = parallel_map([c for _, c in to_run],
+                                deadline=self._data_deadline())
         for (i, _), out in zip(to_run, outcomes):
             if isinstance(out, Exception):
                 states[i] = (
@@ -480,7 +483,7 @@ class HealingMixin:
         # the decode straight into the encode).
         win = plane.pipeline_window_blocks(codec.block_size) \
             * codec.block_size
-        from minio_tpu.storage.idcheck import DiskIDChecker
+        from minio_tpu.storage.healthcheck import unwrap as _unwrap_drive
 
         for part in latest.parts:
             rel = f"{obj}/{latest.data_dir}/part.{part.number}"
@@ -490,7 +493,7 @@ class HealingMixin:
             dst_paths = []
             for pos in range(n):
                 d = shuffled_drives[pos]
-                base = d.inner if isinstance(d, DiskIDChecker) else d
+                base = _unwrap_drive(d)
                 # Non-target positions are pre-failed below; the C writer
                 # skips a failed drive before ever opening its path, so
                 # the placeholder is never touched.
@@ -587,7 +590,8 @@ class HealingMixin:
             else:
                 drives[pos].write_metadata(bucket, obj, fi)
 
-        outcomes = parallel_map([lambda p=p: write(p) for p in targets])
+        outcomes = parallel_map([lambda p=p: write(p) for p in targets],
+                                deadline=self._meta_deadline())
         for pos, out in zip(targets, outcomes):
             if not isinstance(out, Exception):
                 res.after[pos].state = DRIVE_STATE_OK
@@ -609,7 +613,8 @@ class HealingMixin:
         target = FileInfo(volume=bucket, name=obj, version_id=latest.version_id,
                           data_dir=latest.data_dir)
         parallel_map(
-            [lambda d=d: d.delete_version(bucket, obj, target) for d in self.drives]
+            [lambda d=d: d.delete_version(bucket, obj, target) for d in self.drives],
+            deadline=self._meta_deadline(),
         )
 
 

@@ -159,12 +159,18 @@ def pushdown_stream(self_stream, prefix: str, marker: str, delimiter: str,
     return self_stream(marker)
 
 
-def prefetch_stream(gen, depth: int = 32):
+def prefetch_stream(gen, depth: int = 32, deadline: float | None = None):
     """Run `gen` in a producer thread behind a bounded queue: the k-way
     listing merge then overlaps every drive's walk I/O instead of pulling
     one drive at a time (the reference's per-drive WalkDir goroutines,
     cmd/metacache-walk.go). Abandoning the wrapper (early page end) stops
-    the producer promptly — no thread leaks, no unbounded buffering."""
+    the producer promptly — no thread leaks, no unbounded buffering.
+
+    deadline: max seconds to wait for the NEXT item. A producer stalled
+    past it (hung drive mid-walk) ends this stream early — the k-way
+    merge then lists at quorum from the remaining drives, exactly as if
+    the drive were offline. The stalled producer thread is told to stop
+    and leaks only until its blocking read returns."""
     import queue
     import threading
 
@@ -195,7 +201,13 @@ def prefetch_stream(gen, depth: int = 32):
     t.start()
     try:
         while True:
-            item = q.get()
+            if deadline is None:
+                item = q.get()
+            else:
+                try:
+                    item = q.get(timeout=deadline)
+                except queue.Empty:
+                    return  # producer stalled past the walk deadline
             if item is DONE:
                 return
             yield item

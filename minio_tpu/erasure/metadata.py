@@ -8,10 +8,14 @@ listOnlineDisks modtime election (cmd/erasure-healing-common.go:103).
 from __future__ import annotations
 
 import hashlib
+import threading
+import time
 from collections import Counter
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Callable, Sequence
 
+from minio_tpu import obs
 from minio_tpu.storage.fileinfo import FileInfo
 from minio_tpu.utils import errors as se
 
@@ -42,7 +46,7 @@ def shuffle_by_distribution(items: Sequence, distribution: Sequence[int]) -> lis
 
 
 _POOL: ThreadPoolExecutor | None = None
-_POOL_LOCK = __import__("threading").Lock()
+_POOL_LOCK = threading.Lock()
 
 
 def _shared_pool() -> ThreadPoolExecutor:
@@ -55,8 +59,75 @@ def _shared_pool() -> ThreadPoolExecutor:
     return _POOL
 
 
+_HUNG_WORKERS = obs.counter(
+    "minio_tpu_hung_workers_total",
+    "Worker threads abandoned on a hung drive op (pool capacity refilled)")
+
+
+def note_leaked_worker(pool=None, fut=None) -> None:
+    """Account a worker thread abandoned inside a hung drive op and, when
+    the worker came from a pool, refill the pool's capacity so the leak
+    never starves healthy drives of concurrency. The leaked thread stays
+    blocked until the syscall returns (if ever); it is a daemon.
+
+    Pass the abandoned future as `fut` so the refill is RETURNED when the
+    straggler eventually finishes (its worker goes back to the pool) —
+    without that, a persistently slow drive would ratchet the pool's
+    concurrency cap upward forever."""
+    _HUNG_WORKERS.labels().inc()
+    if pool is None:
+        return
+    with _POOL_LOCK:
+        try:
+            pool._max_workers += 1
+        except Exception:  # noqa: BLE001 - best-effort refill
+            return
+    if fut is not None:
+        def _returned(_f, pool=pool):
+            with _POOL_LOCK:
+                try:
+                    if pool._max_workers > 1:
+                        pool._max_workers -= 1
+                except Exception:  # noqa: BLE001
+                    pass
+
+        fut.add_done_callback(_returned)
+
+
+def run_bounded(fn: Callable, deadline: float) -> bool:
+    """Run fn() in a shared-pool worker and wait at most `deadline`
+    seconds. True when it completed; False when it is still running (the
+    worker is abandoned and accounted, the pool refilled) — callers fall
+    back to a deadline'd parallel path. The escape hatch for serial
+    fast-path loops that could otherwise wedge on one hung drive.
+
+    Called FROM a shared-pool worker (nested fan-out), fn runs inline
+    instead: stacking bounded futures from inside the pool could starve
+    it under load, and the outer layer already carries a deadline."""
+    if threading.current_thread().name.startswith("mtpu-io"):
+        fn()
+        return True
+    pool = _shared_pool()
+    fut = pool.submit(fn)
+    try:
+        fut.result(timeout=deadline)
+        return True
+    except FutureTimeout:
+        if not fut.running() and not fut.done():
+            # Still queued: the pool is saturated, not the drive — one
+            # bounded grace window before giving up (total 2x deadline).
+            try:
+                fut.result(timeout=deadline)
+                return True
+            except FutureTimeout:
+                pass
+        if not fut.cancel():
+            note_leaked_worker(pool, fut)
+        return False
+
+
 def parallel_map(fns: Sequence[Callable], max_workers: int | None = None,
-                 serial: bool = False) -> list:
+                 serial: bool = False, deadline: float | None = None) -> list:
     """Run per-drive closures concurrently, capturing exceptions as values
     (the reference's errgroup-with-indexed-errors pattern, pkg/sync).
 
@@ -65,29 +136,134 @@ def parallel_map(fns: Sequence[Callable], max_workers: int | None = None,
     request path. Nested calls can't deadlock on the shared pool because the
     caller steals any task the pool hasn't started (cancel-or-run-inline):
     the calling thread only ever blocks on closures already RUNNING in a
-    worker, and the nesting structure is a tree, so some leaf always runs."""
-    results: list = [None] * len(fns)
+    worker, and the nesting structure is a tree, so some leaf always runs.
 
-    def run(i):
-        try:
-            results[i] = fns[i]()
-        except Exception as e:  # noqa: BLE001 - per-drive errors are data
-            results[i] = e
+    deadline: overall seconds for the WHOLE fan-out. Stragglers still
+    running at the deadline become se.OperationTimedOut result values —
+    the quorum reducers then treat a hung drive exactly like a failed one.
+    The abandoned worker is accounted and the shared pool refilled until
+    the straggler returns (note_leaked_worker); a straggler that finishes
+    later can never overwrite its slot. Closures still QUEUED at the
+    deadline (pool saturated by nested fan-outs, not a hung drive) get
+    ONE bounded grace window — total wait 2x deadline — before they too
+    are stamped timed out; an unbounded inline steal could wedge the
+    caller on a drive that hung while its closure sat in the queue.
+    With serial, the whole loop runs in one bounded worker."""
+    results: list = [None] * len(fns)
 
     if serial or len(fns) <= 1:
         # Callers pass serial=True when every closure is a known-cheap
         # local operation (e.g. cached journal reads on an all-local set):
-        # there the pool dispatch costs more than the work.
-        for i in range(len(fns)):
-            run(i)
+        # there the pool dispatch costs more than the work. With a
+        # deadline the loop runs in ONE pool worker (a single dispatch,
+        # not one per drive) so a hung closure can't wedge the caller:
+        # slots the loop never filled are stamped OperationTimedOut.
+        if deadline is None:
+            for i in range(len(fns)):
+                try:
+                    results[i] = fns[i]()
+                except Exception as e:  # noqa: BLE001 - per-drive data
+                    results[i] = e
+            return results
+        mu = threading.Lock()
+        filled = [False] * len(fns)
+
+        def run_serial():
+            for i in range(len(fns)):
+                try:
+                    r = fns[i]()
+                except Exception as e:  # noqa: BLE001 - per-drive data
+                    r = e
+                with mu:
+                    if filled[i]:
+                        return  # caller stamped the loop dead: stop
+                    results[i] = r
+                    filled[i] = True
+
+        pool = _shared_pool()
+        fut = pool.submit(run_serial)
+        try:
+            fut.result(timeout=deadline)
+        except FutureTimeout:
+            if not fut.running() and not fut.done():
+                # Still queued: the pool is saturated by nested fan-outs,
+                # not a hung drive — one bounded grace window (total 2x
+                # deadline) instead of an unbounded inline steal, which
+                # could wedge the caller on a drive that hung while
+                # queued.
+                try:
+                    fut.result(timeout=deadline)
+                    return results
+                except FutureTimeout:
+                    pass
+            if not fut.cancel():
+                note_leaked_worker(pool, fut)
+            with mu:
+                for i in range(len(fns)):
+                    if not filled[i]:
+                        filled[i] = True  # blocks a late write
+                        results[i] = se.OperationTimedOut(
+                            msg=f"drive op exceeded {deadline:.2f}s "
+                                "deadline (serial fan-out)")
         return results
+
     pool = _shared_pool()
-    futs = [pool.submit(run, i) for i in range(len(fns))]
+
+    if deadline is None:
+        def run(i):
+            try:
+                results[i] = fns[i]()
+            except Exception as e:  # noqa: BLE001 - per-drive errors are data
+                results[i] = e
+
+        futs = [pool.submit(run, i) for i in range(len(fns))]
+        for i, f in enumerate(futs):
+            if f.cancel():
+                run(i)
+            else:
+                f.result()
+        return results
+
+    # Deadline'd fan-out: the abandon handshake must be raceless — once a
+    # slot is stamped OperationTimedOut, the late-finishing closure drops
+    # its result instead of mutating a list the reducers already read.
+    mu = threading.Lock()
+    abandoned = [False] * len(fns)
+
+    def run_guarded(i):
+        try:
+            r = fns[i]()
+        except Exception as e:  # noqa: BLE001 - per-drive errors are data
+            r = e
+        with mu:
+            if not abandoned[i]:
+                results[i] = r
+
+    futs = [pool.submit(run_guarded, i) for i in range(len(fns))]
+    end = time.monotonic() + deadline
+    # Closures still QUEUED at the deadline get one shared grace window
+    # (total 2x deadline): a saturated pool is not a hung drive, but an
+    # unbounded inline steal could wedge the caller on a drive that hung
+    # while its closure sat in the queue.
+    grace_end = end + deadline
     for i, f in enumerate(futs):
-        if f.cancel():
-            run(i)
-        else:
-            f.result()
+        try:
+            f.result(timeout=max(0.0, end - time.monotonic()))
+            continue
+        except FutureTimeout:
+            pass
+        if not f.running() and not f.done():
+            try:
+                f.result(timeout=max(0.0, grace_end - time.monotonic()))
+                continue
+            except FutureTimeout:
+                pass
+        with mu:
+            abandoned[i] = True
+            results[i] = se.OperationTimedOut(
+                msg=f"drive op exceeded {deadline:.2f}s deadline")
+        if not f.cancel():
+            note_leaked_worker(pool, f)
     return results
 
 

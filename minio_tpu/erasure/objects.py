@@ -25,6 +25,7 @@ import queue
 import threading
 import time
 import uuid
+from concurrent.futures import TimeoutError as _FutTimeout
 from typing import BinaryIO, Iterator
 
 from minio_tpu import obs
@@ -37,9 +38,12 @@ from minio_tpu.erasure.metadata import (
     election_sig,
     find_fileinfo_in_quorum,
     hash_order,
+    note_leaked_worker,
     parallel_map,
+    run_bounded,
     shuffle_by_distribution,
 )
+from minio_tpu.storage import healthcheck as _health
 from minio_tpu.erasure.types import (
     BucketInfo,
     DeletedObject,
@@ -67,6 +71,15 @@ INLINE_DATA_LIMIT = 16 << 10
 _ENCODE_GIBPS = obs.gauge(
     "minio_tpu_encode_gibps",
     "Rolling erasure encode+fan-out throughput in GiB/s (EWMA)")
+
+# Tail-latency hedging on shard reads (first-k-wins): launched spares and
+# how many of them beat the straggler they covered for.
+_HEDGED_READS = obs.counter(
+    "minio_tpu_hedged_reads_total",
+    "Spare shard reads launched after the hedge delay").labels()
+_HEDGED_WINS = obs.counter(
+    "minio_tpu_hedged_reads_won_total",
+    "Hedged shard reads that made quorum before the straggler").labels()
 
 
 def _read_full(data: BinaryIO, n: int) -> bytes:
@@ -159,6 +172,12 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         # drive keep the parallel fan-out (RPC/disk latency dominates there).
         self._serial_meta_reads = self.n <= 8 and self._drives_all_local()
         self._encode_gibps: float | None = None
+        # Hedged shard reads: rolling EWMA of one shard's batch-read
+        # latency feeds the hedge delay; hedge_delay pins it explicitly
+        # (tests / operator override). None delay + no history = no hedge
+        # before the hard data deadline.
+        self._shard_lat: float | None = None
+        self.hedge_delay: float | None = None
 
     @property
     def fast_local_reads(self) -> bool:
@@ -170,12 +189,24 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             getattr(d, "fast_sync", False) for d in self.drives)
 
     def _drives_all_local(self) -> bool:
-        from minio_tpu.storage.idcheck import DiskIDChecker
         from minio_tpu.storage.local import LocalDrive
 
         for d in self.drives:
-            base = d.inner if isinstance(d, DiskIDChecker) else d
-            if type(base) is not LocalDrive:
+            if type(_health.unwrap(d)) is not LocalDrive:
+                return False
+        return True
+
+    def _meta_deadline(self) -> float:
+        """Fan-out deadline for metadata-class quorum ops: the max of the
+        drives' adaptive per-op deadlines (drive-resilience plane)."""
+        return _health.fleet_deadlines(self.drives)[0]
+
+    def _data_deadline(self) -> float:
+        return _health.fleet_deadlines(self.drives)[1]
+
+    def _drives_all_online(self) -> bool:
+        for d in self.drives:
+            if isinstance(d, _health.HealthChecker) and d.state != _health.ONLINE:
                 return False
         return True
 
@@ -207,13 +238,12 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         return list(self.drives)
 
     def health(self) -> dict:
-        online = 0
-        for d in self.drives:
-            try:
-                d.disk_info()
-                online += 1
-            except Exception:  # noqa: BLE001
-                pass
+        # Deadline'd fan-out: the readiness probe must answer even while
+        # a drive is hanging (a hung disk_info counts as offline).
+        results = parallel_map(
+            [lambda d=d: d.disk_info() for d in self.drives],
+            deadline=self._meta_deadline())
+        online = sum(1 for r in results if not isinstance(r, Exception))
         quorum = self._write_quorum_data(self.parity)
         return {
             "healthy": online >= quorum,
@@ -226,7 +256,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
 
     def make_bucket(self, bucket: str, opts: ObjectOptions | None = None) -> None:
         _validate_bucket_name(bucket)
-        results = parallel_map([lambda d=d: d.make_vol(bucket) for d in self.drives])
+        results = parallel_map([lambda d=d: d.make_vol(bucket) for d in self.drives],
+                               deadline=self._meta_deadline())
         exists = sum(1 for r in results if isinstance(r, se.VolumeExists))
         if exists >= self._write_quorum_meta():
             raise se.BucketExists(bucket)
@@ -236,14 +267,16 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         try:
             reduce_write_quorum(results, self._write_quorum_meta(), bucket)
         except se.InsufficientWriteQuorum:
-            parallel_map([lambda d=d: d.delete_vol(bucket) for d in self.drives])
+            parallel_map([lambda d=d: d.delete_vol(bucket) for d in self.drives],
+                         deadline=self._meta_deadline())
             raise
 
     def get_bucket_info(self, bucket: str) -> BucketInfo:
         hit = self._bucket_cache.get(bucket)
         if hit is not None and hit[0] > time.monotonic():
             return hit[1]
-        results = parallel_map([lambda d=d: d.stat_vol(bucket) for d in self.drives])
+        results = parallel_map([lambda d=d: d.stat_vol(bucket) for d in self.drives],
+                               deadline=self._meta_deadline())
         for r in results:
             if not isinstance(r, Exception):
                 info = BucketInfo(r.name, r.created)
@@ -256,7 +289,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         raise se.BucketNotFound(bucket, "", "no drive answered")
 
     def list_buckets(self) -> list[BucketInfo]:
-        results = parallel_map([lambda d=d: d.list_vols() for d in self.drives])
+        results = parallel_map([lambda d=d: d.list_vols() for d in self.drives],
+                               deadline=self._meta_deadline())
         seen: dict[str, BucketInfo] = {}
         for r in results:
             if isinstance(r, Exception):
@@ -268,8 +302,10 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         self._bucket_cache.pop(bucket, None)
+        # Data-class deadline: a forced delete rmtrees arbitrary trees.
         results = parallel_map(
-            [lambda d=d: d.delete_vol(bucket, force=force) for d in self.drives]
+            [lambda d=d: d.delete_vol(bucket, force=force) for d in self.drives],
+            deadline=self._data_deadline(),
         )
         if any(isinstance(r, se.VolumeNotEmpty) for r in results):
             raise se.BucketNotEmpty(bucket)
@@ -378,8 +414,10 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             # Serial fan-out when every drive's measured journal-store cost
             # is below the pool-dispatch cost (all-local fast-sync media);
             # slow-fsync drives keep the parallel write so the op pays
-            # max(fsync) rather than sum(fsync).
-            serial_writes = self.fast_local_reads
+            # max(fsync) rather than sum(fsync). A non-ONLINE drive forces
+            # the deadline-bounded parallel path (a hang must not wedge
+            # the serial loop).
+            serial_writes = self.fast_local_reads and self._drives_all_online()
             with self.nslock.lock(bucket, obj):
                 self._check_put_precondition(bucket, obj, opts)
                 with obs.span("commit", bucket=bucket, object=obj,
@@ -392,6 +430,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                             for d in shuffled
                         ],
                         serial=serial_writes,
+                        deadline=self._meta_deadline(),
                     )
                 try:
                     reduce_write_quorum(outcomes, write_quorum, bucket, obj)
@@ -408,7 +447,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                                           outcomes[i])
 
                     parallel_map([lambda i=i, d=d: undo(i, d)
-                                  for i, d in enumerate(shuffled)])
+                                  for i, d in enumerate(shuffled)],
+                                 deadline=self._meta_deadline())
                     raise
                 toks = [o for o in outcomes
                         if o and not isinstance(o, Exception)]
@@ -416,7 +456,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     parallel_map(
                         [lambda d=d, t=t: d.commit_rename(t)
                          for d, t in zip(shuffled, outcomes)
-                         if t and not isinstance(t, Exception)])
+                         if t and not isinstance(t, Exception)],
+                        deadline=self._meta_deadline())
             return self._fi_to_object_info(bucket, obj, fi)
 
         # Streaming erasure path.
@@ -426,7 +467,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         def cleanup_tmp():
             parallel_map(
                 [lambda d=d: d.delete(sys_vol, tmp_rel, recursive=True)
-                 for d in shuffled])
+                 for d in shuffled],
+                deadline=self._meta_deadline())
 
         try:
             with obs.span("encode", bucket=bucket, object=obj) as sp:
@@ -470,7 +512,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             with obs.span("commit", bucket=bucket, object=obj):
                 outcomes = parallel_map(
                     [lambda i=i, d=d: commit(i, d)
-                     for i, d in enumerate(shuffled)]
+                     for i, d in enumerate(shuffled)],
+                    deadline=self._meta_deadline(),
                 )
             try:
                 reduce_write_quorum(outcomes, write_quorum, bucket, obj)
@@ -494,12 +537,14 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                         d.undo_rename(bucket, obj, undo_fi, tokens[i])
 
                 parallel_map([lambda i=i, d=d: undo(i, d)
-                              for i, d in enumerate(shuffled)])
+                              for i, d in enumerate(shuffled)],
+                             deadline=self._meta_deadline())
                 raise
             # Quorum reached: discard the displaced state for good.
             if any(tokens):
                 parallel_map([lambda d=d, t=t: d.commit_rename(t)
-                              for d, t in zip(shuffled, tokens) if t])
+                              for d, t in zip(shuffled, tokens) if t],
+                             deadline=self._meta_deadline())
         # Partial success: quorum met but some drive missed the write — queue
         # it for background heal (reference addPartial, cmd/erasure-object.go:1150).
         if self.mrf is not None and any(isinstance(o, Exception) for o in outcomes):
@@ -648,25 +693,25 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         first_block = offset // fi.erasure.block_size
         last_block = (offset + length - 1) // fi.erasure.block_size
 
-        # Open readers lazily, data shards first (parity only on demand) —
-        # the staggered any-k read strategy (cmd/erasure-decode.go:120-188).
+        # Select shards data-first (parity only on demand) — the staggered
+        # any-k read strategy (cmd/erasure-decode.go:120-188). Opening is
+        # deferred into the pooled read tasks (_read_chunk_rows), so a
+        # drive hanging at open() is hedged/deadlined exactly like one
+        # hanging mid-read.
         dead: set[int] = set()
         corrupt: set[int] = set()  # the subset of dead that OBSERVED bitrot
+        # Hedge losers: healthy-but-slow shards sidelined for this stream.
+        # Never heal-triggering, and reclaimable when selection runs short
+        # — a benched shard must not cost quorum on a real failure later.
+        benched: set[int] = set()
 
         def ensure_readers() -> list[int]:
-            chosen: list[int] = []
-            for i in list(range(k)) + list(range(k, n)):
-                if len(chosen) == k:
-                    break
-                if i in dead:
-                    continue
-                if readers[i] is None:
-                    try:
-                        readers[i] = open_reader(i)
-                    except se.StorageError:
-                        dead.add(i)
-                        continue
-                chosen.append(i)
+            chosen = [i for i in list(range(k)) + list(range(k, n))
+                      if i not in dead and i not in benched][:k]
+            if len(chosen) < k and benched:
+                benched.clear()  # second chance: slow beats no quorum
+                chosen = [i for i in list(range(k)) + list(range(k, n))
+                          if i not in dead][:k]
             if len(chosen) < k:
                 raise se.InsufficientReadQuorum(bucket, obj, "not enough live shards")
             return sorted(chosen)
@@ -692,7 +737,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                         try:
                             rows = self._read_chunk_rows(
                                 readers, chosen, ids, lens, codec, n,
-                                dead, algo, pool=pool, corrupt=corrupt)
+                                dead, algo, pool=pool, corrupt=corrupt,
+                                open_reader=open_reader, benched=benched)
                             break
                         except se.StorageError:
                             continue
@@ -764,6 +810,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                             rows = self._read_chunk_rows(
                                 readers, chosen, ids, lens, codec, n,
                                 dead, algo, pool=pool, corrupt=corrupt,
+                                open_reader=open_reader, benched=benched,
                             )
                             break
                         except se.StorageError:
@@ -854,8 +901,11 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             from concurrent.futures import ThreadPoolExecutor
 
             corrupt_seen = False
-            dead: set[int] = set()  # fed forward so later windows never
-            end = offset + length   # re-read a shard already known bad
+            # Health-OFFLINE drives start dead (zero I/O on them); later
+            # windows also never re-read a shard already known bad.
+            dead: set[int] = {i for i, d in enumerate(shuffled)
+                              if not d.is_online()}
+            end = offset + length
             # One open stream per remote shard for the whole GET (stat +
             # open once, sequential ranged reads ride its readahead).
             streams: dict[int, object] = {}
@@ -933,9 +983,13 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     need = [i for i in alive[:k]
                             if remotes[i] is not None and i not in mem]
                     if need:
+                        # Deadline'd: a hung remote/injected shard becomes
+                        # a timeout value -> dead -> re-selection, instead
+                        # of wedging the whole GET window.
                         fetches = parallel_map([
                             lambda i=i: fetch_remote(i, lo, ln)
-                            for i in need])
+                            for i in need],
+                            deadline=self._data_deadline())
                         lost = False
                         for i, blob in zip(need, fetches):
                             if isinstance(blob, bytes):
@@ -978,7 +1032,16 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                         if fut is None:
                             fut = ex.submit(decode_window, pos, wend)
                         try:
-                            data = fut.result()
+                            # Bounded: a local pread hung inside the C
+                            # call (NFS stall) must fail the GET typed
+                            # and on time, never wedge it.
+                            data = fut.result(
+                                timeout=2.0 * self._data_deadline())
+                        except _FutTimeout:
+                            note_leaked_worker()
+                            raise se.OperationTimedOut(
+                                bucket, obj, "native decode window "
+                                "exceeded the data deadline") from None
                         except OSError as e:
                             raise se.FaultyDisk(
                                 f"native decode: {e}") from e
@@ -1018,8 +1081,54 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
 
         return gen()
 
+    def _hedge_delay(self) -> float | None:
+        """Seconds to wait on a shard-read straggler before launching a
+        spare reader on an unused parity drive. Derived from the rolling
+        shard-read latency EWMA unless pinned via self.hedge_delay; None
+        (no history yet) defers to the hard data deadline."""
+        if self.hedge_delay is not None:
+            return self.hedge_delay
+        e = self._shard_lat
+        if e is None:
+            return None
+        return max(4.0 * e, 0.02)
+
+    def _note_shard_latency(self, dur: float) -> None:
+        e = self._shard_lat
+        self._shard_lat = dur if e is None else 0.8 * e + 0.2 * dur
+
+    def _abandon_shard(self, i: int, fut, readers, dead,
+                       benched=None, failed=True) -> None:
+        """A straggler lost the hedge (failed=False: sidelined in
+        `benched`, reclaimable, never heal-triggering) or hit the data
+        deadline (failed=True: marked dead like any failed drive):
+        reclaim its reader when the read eventually returns; the pool
+        worker it occupies is accounted and replaced until then.
+        read_shard re-checks the exclusion sets after opening, so a late
+        open can never resurrect the slot."""
+        if failed or benched is None:
+            dead.add(i)
+        else:
+            benched.add(i)
+        rdr = readers[i]
+        readers[i] = None
+
+        def _cleanup(_f, rdr=rdr):
+            if rdr is not None:
+                try:
+                    rdr.src.close()
+                except Exception:  # noqa: BLE001 - teardown only
+                    pass
+
+        if fut.cancel():
+            _cleanup(None)
+            return
+        note_leaked_worker(self._read_pool, fut)
+        fut.add_done_callback(_cleanup)
+
     def _read_chunk_rows(self, readers, chosen, batch_ids, block_lens, codec,
-                         n, dead, algo=None, pool=None, corrupt=None):
+                         n, dead, algo=None, pool=None, corrupt=None,
+                         open_reader=None, benched=None):
         """Read one batch of chunk rows from the chosen shards; marks dead
         drives and raises StorageError to trigger re-selection.
 
@@ -1030,37 +1139,88 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         the GIL in native code. mxsum256 shard files verify in ONE device
         launch per batch (fused.verify_digests) instead of per-chunk host
         hashing — the TPU-native form of the reference's
-        verify-every-ReadAt (cmd/bitrot-streaming.go:115-158)."""
+        verify-every-ReadAt (cmd/bitrot-streaming.go:115-158).
+
+        First-k-wins with hedging: after the hedge delay (rolling-latency
+        derived) spare readers launch on unused parity shards, and the
+        batch completes with the FIRST k shard results — a slow or hung
+        drive degrades GET latency by one hedge delay, not one deadline.
+        Stragglers still pending when k arrive (or at the hard data
+        deadline) are abandoned, never awaited."""
         batched_verify = algo == "mxsum256"
         shard_size = codec.shard_size()
         chunk_lens = [-(-bl // codec.k) for bl in block_lens]
 
         def read_shard(i: int) -> list[tuple[bytes | None, bytes]]:
+            r = readers[i]
+            if r is None:
+                if open_reader is None:
+                    raise se.FaultyDisk(f"shard {i}: no reader")
+                r = open_reader(i)
+                if i in dead or (benched is not None and i in benched):
+                    # Abandoned while the open was in flight: don't
+                    # publish a zombie reader.
+                    try:
+                        r.src.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    raise se.FaultyDisk(f"shard {i}: abandoned")
+                readers[i] = r
             out: list[tuple[bytes | None, bytes]] = []
             for j, b in enumerate(batch_ids):
                 if batched_verify:
-                    want, chunk = readers[i].read_record(b)
+                    want, chunk = r.read_record(b)
                     if len(chunk) != chunk_lens[j]:
                         raise se.FileCorrupt(
                             f"chunk {b} length {len(chunk)} != "
                             f"{chunk_lens[j]}")
                     out.append((want, chunk))
                 else:
-                    out.append((None, readers[i].read_at(
+                    out.append((None, r.read_at(
                         b * shard_size, chunk_lens[j])))
             return out
 
-        from concurrent.futures import CancelledError
+        from concurrent.futures import FIRST_COMPLETED, CancelledError
+        from concurrent.futures import wait as _fwait
 
+        _SHARD_ERRS = (se.StorageError, OSError, CancelledError, RuntimeError)
         results: dict[int, list] = {}
         first_err: tuple[int, Exception] | None = None
-        futures: dict | None = None
+        need = len(chosen)
+
+        def record_failure(i: int, e: Exception) -> None:
+            nonlocal first_err
+            dead.add(i)
+            # FileCorrupt = observed bitrot/truncation -> the queued
+            # heal must deep-verify; a plain open/read failure only
+            # needs the presence scan.
+            if isinstance(e, se.FileCorrupt) and corrupt is not None:
+                corrupt.add(i)
+            readers[i] = None
+            if first_err is None:
+                first_err = (i, e)
+
         if pool is not None:
-            futures = {}
-            try:
-                for i in chosen:
-                    futures[i] = pool.submit(read_shard, i)
-            except RuntimeError as e:
+            futures: dict = {}
+            rev: dict = {}
+            started: dict[int, float] = {}
+            pool_down = False
+
+            def submit(i: int) -> bool:
+                try:
+                    f = pool.submit(read_shard, i)
+                except RuntimeError:
+                    return False
+                futures[i] = f
+                rev[f] = i
+                started[i] = time.monotonic()
+                return True
+
+            for i in chosen:
+                if not submit(i):
+                    pool_down = True
+                    break
+            if pool_down:
                 # Pool shut down mid-submit (layer closing). Do NOT fall
                 # back to inline reads: already-running futures share the
                 # BitrotReaders' seek state, so a concurrent inline pass
@@ -1079,34 +1239,85 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 for i in chosen:
                     dead.add(i)
                     readers[i] = None
-                raise se.FileCorrupt(f"layer closing: {e}") from None
-        for i in chosen:
-            try:
-                results[i] = (futures[i].result() if futures is not None
-                              else read_shard(i))
-            # CancelledError/RuntimeError: the layer is closing and the
-            # pool rejected/cancelled the read — treat like a dead shard
-            # so the retry loop degrades to a clean quorum error.
-            except (se.StorageError, OSError, CancelledError,
-                    RuntimeError) as e:
-                dead.add(i)
-                # FileCorrupt = observed bitrot/truncation -> the queued
-                # heal must deep-verify; a plain open/read failure only
-                # needs the presence scan.
-                if isinstance(e, se.FileCorrupt) and corrupt is not None:
-                    corrupt.add(i)
-                readers[i] = None
-                if first_err is None:
-                    first_err = (i, e)
-        if first_err is not None:
-            i, e = first_err
-            raise se.FileCorrupt(f"shard {i}: {e}") from e
+                raise se.FileCorrupt("layer closing") from None
+
+            t0 = time.monotonic()
+            end = t0 + self._data_deadline()
+            hd = self._hedge_delay()
+            hedge_at = (t0 + hd) if hd is not None else None
+            hedged: set[int] = set()
+            pending = set(futures)
+            while pending and len(results) < need:
+                now = time.monotonic()
+                if now >= end:
+                    break
+                timeout = end - now
+                if hedge_at is not None:
+                    timeout = min(timeout, max(0.0, hedge_at - now))
+                done, _ = _fwait({futures[i] for i in pending},
+                                 timeout=timeout,
+                                 return_when=FIRST_COMPLETED)
+                for f in done:
+                    i = rev[f]
+                    pending.discard(i)
+                    try:
+                        results[i] = f.result()
+                        self._note_shard_latency(
+                            time.monotonic() - started[i])
+                        if (i in hedged and len(results) <= need
+                                and any(j not in hedged for j in pending)):
+                            _HEDGED_WINS.inc()
+                    except _SHARD_ERRS as e:
+                        record_failure(i, e)
+                if (len(results) < need and pending and hedge_at is not None
+                        and time.monotonic() >= hedge_at):
+                    # One spare per straggler, parity-order, never
+                    # reusing a shard already dead or in play.
+                    hedge_at = None
+                    spares = [s for s in range(n)
+                              if s not in dead and s not in futures
+                              and (benched is None or s not in benched)]
+                    for s in spares[:len(pending)]:
+                        if submit(s):
+                            pending.add(s)
+                            hedged.add(s)
+                            _HEDGED_READS.inc()
+            # Settle leftovers: harvest already-done stragglers for free,
+            # abandon the rest (hedge losers / deadline breakers).
+            deadline_hit = len(results) < need
+            for i in list(pending):
+                f = futures[i]
+                if f.done():
+                    try:
+                        results[i] = f.result()
+                        continue
+                    except _SHARD_ERRS as e:
+                        record_failure(i, e)
+                        continue
+                self._abandon_shard(i, f, readers, dead, benched,
+                                    failed=deadline_hit)
+                if deadline_hit and first_err is None:
+                    first_err = (i, se.OperationTimedOut(
+                        msg="shard read exceeded the data deadline"))
+            if len(results) < need:
+                i, e = first_err if first_err is not None else (
+                    -1, se.FaultyDisk("no shard results"))
+                raise se.FileCorrupt(f"shard {i}: {e}") from e
+        else:
+            for i in chosen:
+                try:
+                    results[i] = read_shard(i)
+                except _SHARD_ERRS as e:
+                    record_failure(i, e)
+            if first_err is not None:
+                i, e = first_err
+                raise se.FileCorrupt(f"shard {i}: {e}") from e
 
         rows: list[list[bytes | None]] = []
         records: list[tuple[int, bytes, bytes]] = []  # (drive, want, chunk)
         for j, _b in enumerate(batch_ids):
             row: list[bytes | None] = [None] * n
-            for i in chosen:
+            for i in sorted(results):
                 want, chunk = results[i][j]
                 row[i] = chunk
                 if batched_verify:
@@ -1150,7 +1361,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             )
             with self.nslock.lock(bucket, obj):
                 results = parallel_map(
-                    [lambda d=d: d.delete_version(bucket, obj, marker) for d in self.drives]
+                    [lambda d=d: d.delete_version(bucket, obj, marker) for d in self.drives],
+                    deadline=self._meta_deadline(),
                 )
                 reduce_write_quorum(results, write_quorum, bucket, obj)
             return ObjectInfo(bucket=bucket, name=obj, version_id=marker.version_id,
@@ -1161,7 +1373,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             target = FileInfo(volume=bucket, name=obj, version_id=opts.version_id,
                               data_dir=fi.data_dir)
             results = parallel_map(
-                [lambda d=d: d.delete_version(bucket, obj, target) for d in self.drives]
+                [lambda d=d: d.delete_version(bucket, obj, target) for d in self.drives],
+                deadline=self._meta_deadline(),
             )
             # A drive that never had the version is as good as deleted on it.
             results = [
@@ -1234,8 +1447,13 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             except se.StorageError:
                 return  # offline/unformatted drive: quorum covers it
 
+        # Per-drive walk deadline: a drive that stalls mid-walk is dropped
+        # from the merge (exactly like an offline drive) instead of
+        # wedging the whole listing/heal sweep.
+        walk_deadline = _health.fleet_deadlines(self.drives)[2]
         return listing.merge_journal_streams(
-            [listing.prefetch_stream(drive_stream(d)) for d in self.drives])
+            [listing.prefetch_stream(drive_stream(d), deadline=walk_deadline)
+             for d in self.drives])
 
     def merged_journals(self, bucket: str, prefix: str) -> dict[str, XLMeta]:
         """Materialized journal map — O(namespace) memory; only for small
@@ -1273,7 +1491,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     shuffle_by_distribution(self.drives, fi.erasure.distribution)
                     if fi.erasure.distribution else self.drives
                 )
-            ]
+            ],
+            deadline=self._meta_deadline(),
         )
         reduce_write_quorum(results, self._write_quorum_meta(), bucket, obj)
         return self._fi_to_object_info(bucket, obj, fi)
@@ -1312,7 +1531,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                  d.write_metadata(bucket, obj, f)
                  for i, d in enumerate(
                      shuffle_by_distribution(self.drives, fi.erasure.distribution)
-                     if fi.erasure.distribution else self.drives)]
+                     if fi.erasure.distribution else self.drives)],
+                deadline=self._meta_deadline(),
             )
             reduce_write_quorum(results, self._write_quorum_meta(), bucket, obj)
 
@@ -1489,6 +1709,25 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             return native
         qs: list[queue.Queue] = [queue.Queue(maxsize=8) for _ in range(self.n)]
         errs: list[Exception | None] = [None] * self.n
+        # A writer thread wedged inside a hung create_file stops draining
+        # its queue; the producer notices the queue staying full past the
+        # data deadline, marks the drive timed out, and stops feeding it —
+        # the PUT then completes at quorum (the hung thread is a daemon,
+        # accounted as leaked).
+        gave_up = [False] * self.n
+        put_timeout = self._data_deadline()
+
+        def feed(i: int, item) -> None:
+            if gave_up[i]:
+                return
+            try:
+                qs[i].put(item, timeout=put_timeout)
+            except queue.Full:
+                gave_up[i] = True
+                if errs[i] is None:
+                    errs[i] = se.OperationTimedOut(
+                        msg=f"drive shard write stalled > {put_timeout:.1f}s")
+                note_leaked_worker()
 
         def writer(i: int, drive: StorageAPI):
             def gen():
@@ -1541,8 +1780,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 digs = dig_rows[bi] if dig_rows is not None else None
                 for i in range(self.n):
                     # digest None -> the writer thread hashes the chunk.
-                    qs[i].put((digs[i] if digs is not None else None,
-                               chunks[i]))
+                    feed(i, (digs[i] if digs is not None else None,
+                             chunks[i]))
             alive = sum(1 for e in errs if e is None)
             if alive < write_quorum:
                 raise se.InsufficientWriteQuorum(bucket, obj, "write fan-out lost quorum")
@@ -1569,10 +1808,25 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             while pending:
                 drain_one()
         finally:
-            for q in qs:
-                q.put(_WRITE_SENTINEL)
-            for t in threads:
-                t.join()
+            for i, q in enumerate(qs):
+                try:
+                    q.put(_WRITE_SENTINEL,
+                          timeout=0.1 if gave_up[i] else put_timeout)
+                except queue.Full:
+                    gave_up[i] = True
+            # Bounded join: a healthy writer drains to its sentinel well
+            # inside the deadline; a wedged one is declared timed out and
+            # left behind (daemon) rather than blocking the PUT forever.
+            join_end = time.monotonic() + put_timeout
+            for i, t in enumerate(threads):
+                t.join(timeout=0.1 if gave_up[i]
+                       else max(0.1, join_end - time.monotonic()))
+                if t.is_alive():
+                    gave_up[i] = True
+                    if errs[i] is None:
+                        errs[i] = se.OperationTimedOut(
+                            msg="drive shard writer did not finish")
+                        note_leaked_worker()
         self._note_encode_rate(total, time.perf_counter() - t_enc)
         return total, md5.hexdigest(), errs
 
@@ -1610,40 +1864,58 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
 
     def _read_quorum_fileinfo_inner(self, bucket: str, obj: str,
                                     version_id: str) -> FileInfo:
-        if self._serial_meta_reads:
+        # Serial reads only while every drive is ONLINE; the loop itself
+        # runs in ONE bounded pool worker (run_bounded) so the FIRST hang
+        # on an all-local set frees the caller at the deadline and falls
+        # back to the deadline'd parallel fan-out — a hung drive there
+        # becomes a timeout value the quorum reducers count as failed.
+        serial_done = False
+        if self._serial_meta_reads and self._drives_all_online():
             # All-local cached journal reads run sequentially; once a
             # strict majority agrees on (mod_time, data_dir, version),
             # the remaining drives cannot change the election — skip
             # them (the shards they hold are addressed by the elected
             # distribution, not by these metadata reads).
-            need = self.n // 2 + 1
-            results = []
-            tally: dict = {}
-            for d in self.drives:
-                try:
-                    r = d.read_version(bucket, obj, version_id)
-                except Exception as e:  # noqa: BLE001 — per-drive data
-                    r = e
-                results.append(r)
-                # Early exit only for live versions: a delete marker's
-                # read quorum depends on the geometry of the NON-deleted
-                # versions other drives may hold, which a partial read
-                # cannot know — markers always take the full election.
-                if isinstance(r, FileInfo) and not r.deleted:
-                    s = election_sig(r)
-                    tally[s] = tally.get(s, 0) + 1
-                    # The read quorum is this geometry's data_blocks,
-                    # which can exceed a bare majority (k > n/2+1 at low
-                    # parity) — stop only when both are satisfied.
-                    k = r.erasure.data_blocks or 0
-                    if tally[s] >= max(need, k):
-                        # This fi IS the quorum election — re-counting
-                        # through find_fileinfo_in_quorum adds nothing.
-                        return r
-        else:
+            out: dict = {"fi": None, "results": None}
+
+            def serial_election():
+                need = self.n // 2 + 1
+                results = []
+                tally: dict = {}
+                for d in self.drives:
+                    try:
+                        r = d.read_version(bucket, obj, version_id)
+                    except Exception as e:  # noqa: BLE001 — per-drive data
+                        r = e
+                    results.append(r)
+                    # Early exit only for live versions: a delete marker's
+                    # read quorum depends on the geometry of the NON-deleted
+                    # versions other drives may hold, which a partial read
+                    # cannot know — markers always take the full election.
+                    if isinstance(r, FileInfo) and not r.deleted:
+                        s = election_sig(r)
+                        tally[s] = tally.get(s, 0) + 1
+                        # The read quorum is this geometry's data_blocks,
+                        # which can exceed a bare majority (k > n/2+1 at low
+                        # parity) — stop only when both are satisfied.
+                        k = r.erasure.data_blocks or 0
+                        if tally[s] >= max(need, k):
+                            # This fi IS the quorum election — re-counting
+                            # through find_fileinfo_in_quorum adds nothing.
+                            out["fi"] = r
+                            return
+                out["results"] = results
+
+            if run_bounded(serial_election, self._meta_deadline()):
+                if out["fi"] is not None:
+                    return out["fi"]
+                results = out["results"]
+                serial_done = True
+        if not serial_done:
             results = parallel_map(
                 [lambda d=d: d.read_version(bucket, obj, version_id)
                  for d in self.drives],
+                deadline=self._meta_deadline(),
             )
         if all(isinstance(r, se.FileNotFound) for r in results):
             raise se.ObjectNotFound(bucket, obj)
@@ -1691,13 +1963,12 @@ def _shard_paths_mixed(drives: list[StorageAPI], vol: str, rel: str
     wrapper (remote client, fault injector) keeps its per-call
     interposition. (None, _) only when a local drive can't map the path
     (invalid name)."""
-    from minio_tpu.storage.idcheck import DiskIDChecker
     from minio_tpu.storage.local import LocalDrive
 
     paths: list[str] = []
     remotes: list[StorageAPI | None] = []
     for d in drives:
-        base = d.inner if isinstance(d, DiskIDChecker) else d
+        base = _health.unwrap(d)
         if isinstance(base, LocalDrive):
             try:
                 paths.append(base._file_path(vol, rel))

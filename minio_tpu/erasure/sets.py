@@ -50,10 +50,15 @@ class ErasureSets:
                                       can_format_fresh=can_format_fresh)
             # Bind each drive to its slot UUID: a swapped/replugged disk
             # surfaces as DiskNotFound on the next guarded call
-            # (cmd/xl-storage-disk-id-check.go:64 role).
+            # (cmd/xl-storage-disk-id-check.go:64 role) — then stack the
+            # drive-resilience plane on top: per-op deadlines, the
+            # ONLINE/FAULTY/OFFLINE state machine, and the offline probe
+            # whose restore drops a healing tracker for the AutoHealer.
+            from minio_tpu.storage.healthcheck import wrap_with_healthcheck
             from minio_tpu.storage.idcheck import wrap_with_id_check
 
-            drives = wrap_with_id_check(drives, fmt)
+            drives = wrap_with_healthcheck(
+                wrap_with_id_check(drives, fmt), fmt)
         self.format = fmt
         self.deployment_id = fmt.deployment_id
         self.set_count = len(drives) // set_drive_count

@@ -93,6 +93,9 @@ _EXC_MAP: list[tuple[type, str]] = [
     (se.PreconditionFailed, "PreconditionFailed"),
     (se.InsufficientReadQuorum, "SlowDown"),
     (se.InsufficientWriteQuorum, "SlowDown"),
+    # A deadline'd drive fan-out that still missed quorum: retryable 503,
+    # never a 500 (the drive-resilience plane's visible degradation mode).
+    (se.OperationTimedOut, "SlowDown"),
     (se.MethodNotAllowed, "MethodNotAllowed"),
     (se.FileNotFound, "NoSuchKey"),
     (se.StorageError, "InternalError"),

@@ -896,11 +896,41 @@ class S3Server:
             request["api"] = "healthcheck"
             kind = path.rsplit("/", 1)[-1]
             if kind == "live":
+                # Liveness = the process answers; never touches drives
+                # (reference LivenessCheckHandler).
                 return web.Response(status=200)
             if kind in ("ready", "cluster"):
+                # Readiness/cluster = write-quorum aware: every set must
+                # keep at least write-quorum drives online. With
+                # ?maintenance=true the bar rises by one drive per set —
+                # "can I take one more node down without losing quorum"
+                # (reference ClusterCheckHandler + maintenance mode).
+                maintenance = request.query.get(
+                    "maintenance", "").lower() in ("true", "1", "yes")
+                health_fn = getattr(self.obj, "health",
+                                    lambda: {"healthy": True})
                 loop = asyncio.get_running_loop()
-                h = await loop.run_in_executor(None, self.obj.health)
-                return web.Response(status=200 if h.get("healthy") else 503)
+                h = await loop.run_in_executor(None, health_fn)
+                # Sets layer reports {"sets": [...]}, the pools layer
+                # nests per-pool {"pools": [{"sets": [...]}]} — flatten.
+                sets = h.get("sets") or [
+                    s for p in h.get("pools", [])
+                    for s in p.get("sets", [])]
+                healthy = bool(h.get("healthy"))
+                if maintenance and sets:
+                    healthy = all(
+                        s.get("online", 0) >= s.get("write_quorum", 0) + 1
+                        for s in sets)
+                headers = {}
+                if sets:
+                    headers["X-Minio-Write-Quorum"] = str(
+                        max(s.get("write_quorum", 0) for s in sets))
+                    # Status must agree with the response code the caller
+                    # gets — maintenance bar included.
+                    headers["X-Minio-Server-Status"] = (
+                        "online" if healthy else "degraded")
+                return web.Response(status=200 if healthy else 503,
+                                    headers=headers)
             raise S3Error("MethodNotAllowed", resource=path)
 
         query_items = [(k, v) for k, v in urllib.parse.parse_qsl(

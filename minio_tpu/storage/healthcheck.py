@@ -1,0 +1,523 @@
+"""Drive health-check decorator — deadline-bounded ops + a per-drive
+ONLINE → FAULTY → OFFLINE state machine.
+
+Role-equivalent of cmd/xl-storage-disk-id-check.go's diskHealthTracker:
+a hung drive (NFS stall, dying disk, injected sleep) must not wedge the
+data path. Every guarded StorageAPI call registers an in-flight record
+with a per-op-class deadline fed by an adaptive DynamicTimeout; a single
+process-wide watchdog thread notices records past their deadline, counts
+them against the drive, and walks the state machine:
+
+    ONLINE  --consecutive timeouts/system errors-->  FAULTY
+    FAULTY  --more consecutive failures-->           OFFLINE
+    OFFLINE --background sentinel probe succeeds-->  ONLINE (+ autoheal)
+
+OFFLINE drives fail every guarded call instantly with DiskNotFound and
+ZERO I/O — the quorum reducers then treat the drive exactly like a dead
+one. The caller actually stuck inside the hung syscall is freed at the
+fan-out layer (parallel_map's deadline= / the hedged shard reads), which
+is why ops here run INLINE: the wrapper adds only two clock reads and a
+dict slot per call, keeping the ~10us cached-journal fast path intact
+(the reference likewise tracks health without a goroutine per op).
+
+Streaming ops suspend their deadline while waiting on the *producer*
+(create_file's chunk iterator: a slow client must never indict the
+drive) and re-arm it whenever control returns to drive code; walk_dir
+re-arms per entry, so the deadline always bounds drive-side stalls, not
+total op duration.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid as _uuid
+import weakref
+
+from minio_tpu import obs
+from minio_tpu.utils import errors as se
+from minio_tpu.utils.dyntimeout import DynamicTimeout
+
+SYS_VOL = ".mtpu.sys"
+
+ONLINE = "online"
+FAULTY = "faulty"
+OFFLINE = "offline"
+_STATE_CODE = {ONLINE: 0, FAULTY: 1, OFFLINE: 2}
+
+# Per-op-class (timeout, minimum) seeds for the adaptive deadlines.
+# "meta" bounds journal/volume round trips, "data" bounds shard
+# streams, "walk" bounds the gap between listing entries.
+DEFAULT_DEADLINES = {
+    "meta": (8.0, 1.0),
+    "data": (30.0, 2.0),
+    "walk": (30.0, 2.0),
+}
+
+OFFLINE_AFTER = 3      # consecutive failures before FAULTY -> OFFLINE
+PROBE_INTERVAL = 1.0   # sentinel probe cadence while OFFLINE
+WATCHDOG_TICK = 0.05
+
+# Guarded method -> deadline class. Identity plumbing (get/set_disk_id,
+# read/write_format) stays unguarded: it IS the probe/heal surface.
+OP_CLASS = {
+    "disk_info": "meta",
+    "make_vol": "meta", "stat_vol": "meta", "list_vols": "meta",
+    "delete_vol": "meta", "list_dir": "meta",
+    "read_all": "meta", "write_all": "meta", "delete": "meta",
+    "rename_file": "meta",
+    "write_metadata": "meta", "write_metadata_single": "meta",
+    "read_version": "meta", "read_xl": "meta", "delete_version": "meta",
+    "rename_data": "meta", "commit_rename": "meta", "undo_rename": "meta",
+    "create_file": "data", "append_file": "data",
+    "read_file_stream": "data", "read_file_range_stream": "data",
+    "verify_file": "data", "check_parts": "data",
+    "walk_dir": "walk",
+}
+
+# Errors that indict the DRIVE (unreachable/dying/stalled) — per-object
+# state (FileNotFound, VolumeExists, bitrot, unformatted) is normal
+# operation and counts as healthy contact.
+_SYS_ERRORS = (se.DiskNotFound, se.FaultyDisk, se.OperationTimedOut)
+
+_STATE = obs.gauge(
+    "minio_tpu_drive_state",
+    "Drive health state (0=online, 1=faulty, 2=offline)", ("drive",))
+_TIMEOUTS = obs.counter(
+    "minio_tpu_drive_timeouts_total",
+    "Guarded drive ops that exceeded their op-class deadline", ("drive",))
+
+
+class _Op:
+    """One in-flight guarded call. deadline_at is the only field the
+    watchdog reads; suspension is expressed as deadline_at = +inf so a
+    single (GIL-atomic) attribute write arms/disarms it."""
+
+    __slots__ = ("cls", "start", "deadline_at", "armed_base", "timed_out")
+
+    def __init__(self, cls: str, now: float, timeout: float):
+        self.cls = cls
+        self.start = now
+        self.armed_base = now
+        self.deadline_at = now + timeout
+        self.timed_out = False
+
+
+class _Watchdog:
+    """One process-wide scanner for every HealthChecker's in-flight ops."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._drives: "weakref.WeakSet[HealthChecker]" = weakref.WeakSet()
+        self._thread: threading.Thread | None = None
+
+    def register(self, hc: "HealthChecker") -> None:
+        with self._mu:
+            self._drives.add(hc)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="drive-watchdog")
+                self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            time.sleep(WATCHDOG_TICK)
+            with self._mu:
+                drives = list(self._drives)
+            now = time.monotonic()
+            for hc in drives:
+                try:
+                    hc._watch(now)
+                except Exception:  # noqa: BLE001 - keep the watchdog alive
+                    pass
+
+
+_WATCHDOG = _Watchdog()
+
+
+def _run_with_deadline(fn, timeout: float) -> bool:
+    """Run fn() in a throwaway daemon thread, True only if it returned
+    truthy within the deadline (a hung probe leaks its thread — probes
+    are rare, so thread-per-probe is the simple safe shape)."""
+    result = [False]
+    done = threading.Event()
+
+    def run():
+        try:
+            result[0] = bool(fn())
+        except Exception:  # noqa: BLE001 - probe failure is just False
+            result[0] = False
+        finally:
+            done.set()
+
+    threading.Thread(target=run, daemon=True,
+                     name="drive-health-probe").start()
+    return result[0] if done.wait(timeout) else False
+
+
+class HealthChecker:
+    """Transparent StorageAPI wrapper (stacked OVER DiskIDChecker) that
+    deadline-bounds every guarded op and fails OFFLINE drives fast."""
+
+    def __init__(self, inner, deadlines: dict | None = None,
+                 probe_interval: float = PROBE_INTERVAL,
+                 offline_after: int = OFFLINE_AFTER,
+                 on_restore=None):
+        """deadlines: {"meta"|"data"|"walk": (timeout, minimum)} overrides.
+        on_restore(hc): called after the sentinel probe brings the drive
+        back ONLINE (the autoheal notification hook)."""
+        self._inner = inner
+        self._deadlines = {
+            cls: DynamicTimeout(*((deadlines or {}).get(cls, dflt)))
+            for cls, dflt in DEFAULT_DEADLINES.items()
+        }
+        self._probe_interval = probe_interval
+        self._offline_after = max(1, offline_after)
+        self._on_restore = on_restore
+        self.state = ONLINE
+        self.consecutive = 0      # consecutive timeouts/system errors
+        self.timeouts = 0         # lifetime deadline hits
+        self._mu = threading.Lock()
+        self._inflight: dict[int, _Op] = {}
+        self._tok = 0
+        self._probing = False
+        self._closed = False
+        drive = inner.endpoint() or getattr(inner, "root", "") or repr(inner)
+        self._drive = drive
+        self._g_state = _STATE.labels(drive=drive)
+        self._g_state.set(0)
+        self._c_timeouts = _TIMEOUTS.labels(drive=drive)
+        _WATCHDOG.register(self)
+
+    # -- introspection ------------------------------------------------
+
+    @property
+    def inner(self):
+        return self._inner
+
+    def health_state(self) -> str:
+        return self.state
+
+    def is_online(self) -> bool:
+        return self.state != OFFLINE
+
+    def op_deadlines(self) -> tuple[float, float, float]:
+        """Current adaptive (meta, data, walk) deadlines — the fan-out
+        layers derive their parallel_map/hedge deadlines from these."""
+        return (self._deadlines["meta"].timeout(),
+                self._deadlines["data"].timeout(),
+                self._deadlines["walk"].timeout())
+
+    # -- identity plumbing (unguarded: the probe/heal surface) --------
+
+    def get_disk_id(self) -> str:
+        return self._inner.get_disk_id()
+
+    def set_disk_id(self, disk_id: str) -> None:
+        self._inner.set_disk_id(disk_id)
+
+    def is_local(self) -> bool:
+        return self._inner.is_local()
+
+    def endpoint(self) -> str:
+        return self._inner.endpoint()
+
+    def read_format(self):
+        return self._inner.read_format()
+
+    def write_format(self, doc) -> None:
+        self._inner.write_format(doc)
+        # A rewritten identity is an operator/heal action: trust it and
+        # come back without waiting out the probe cadence.
+        self._restore(via_probe=False)
+
+    def close(self) -> None:
+        self._closed = True
+        self._inner.close()
+
+    def disk_info(self):
+        tok, op = self._begin("meta")
+        err = None
+        try:
+            di = self._inner.disk_info()
+            try:
+                di.metrics.update({"health": self.state,
+                                   "timeouts": self.timeouts})
+            except Exception:  # noqa: BLE001 - annotation only
+                pass
+            return di
+        except Exception as e:
+            err = e
+            raise
+        finally:
+            self._end(tok, op, err)
+
+    # -- bookkeeping --------------------------------------------------
+
+    def _begin(self, cls: str) -> tuple[int, _Op]:
+        if self.state == OFFLINE:
+            raise se.DiskNotFound(f"{self._drive}: drive offline (health)")
+        now = time.monotonic()
+        op = _Op(cls, now, self._deadlines[cls].timeout())
+        with self._mu:
+            self._tok += 1
+            tok = self._tok
+            self._inflight[tok] = op
+        return tok, op
+
+    def _end(self, tok: int, op: _Op, err: BaseException | None) -> None:
+        with self._mu:
+            self._inflight.pop(tok, None)
+        now = time.monotonic()
+        if op.timed_out:
+            # The watchdog already charged this op; a late return (even a
+            # success) never clears the strike — the data path moved on.
+            return
+        if err is None or not (isinstance(err, _SYS_ERRORS)
+                               or isinstance(err, OSError)):
+            # Success OR per-object state: healthy contact with the drive.
+            self._deadlines[op.cls].log_success(now - op.armed_base)
+            self._note_ok()
+        else:
+            self._note_failure()
+
+    def _watch(self, now: float) -> None:
+        """Watchdog tick: charge every in-flight op past its deadline and
+        re-arm it, so a single op hung forever keeps accumulating strikes
+        until the drive goes OFFLINE."""
+        fired = 0
+        with self._mu:
+            for op in self._inflight.values():
+                if now < op.deadline_at:
+                    continue
+                op.timed_out = True
+                dt = self._deadlines[op.cls]
+                dt.log_failure()
+                op.deadline_at = now + dt.timeout()
+                fired += 1
+        for _ in range(fired):
+            self.timeouts += 1
+            self._c_timeouts.inc()
+            self._note_failure()
+
+    def _note_ok(self) -> None:
+        with self._mu:
+            self.consecutive = 0
+            if self.state == FAULTY:
+                self._set_state(ONLINE)
+            # OFFLINE only exits through the probe (or write_format).
+
+    def _note_failure(self) -> None:
+        start_probe = False
+        with self._mu:
+            self.consecutive += 1
+            if self.state == ONLINE:
+                self._set_state(FAULTY)
+            if (self.state == FAULTY
+                    and self.consecutive >= self._offline_after):
+                self._set_state(OFFLINE)
+            if self.state == OFFLINE and not self._probing:
+                self._probing = True
+                start_probe = True
+        if start_probe:
+            threading.Thread(target=self._probe_loop, daemon=True,
+                             name=f"drive-health-{self._drive}").start()
+
+    def _set_state(self, state: str) -> None:
+        """Transition (caller holds self._mu): gauge + trace record."""
+        prev, self.state = self.state, state
+        self._g_state.set(_STATE_CODE[state])
+        if prev != state and obs.has_subscribers():
+            obs.publish({"type": "drive", "time": time.time(),
+                         "drive": self._drive, "state": state,
+                         "prev": prev, "timeouts": self.timeouts})
+
+    # -- offline probe ------------------------------------------------
+
+    def _probe_once(self, path: str) -> bool:
+        """write/read/delete a sentinel under the sys tmp volume THROUGH
+        the inner stack (the disk-ID guard included, so a swapped drive
+        stays offline until reformatted)."""
+        payload = b"mtpu-health-probe"
+        self._inner.write_all(SYS_VOL, path, payload)
+        if self._inner.read_all(SYS_VOL, path) != payload:
+            return False
+        self._inner.delete(SYS_VOL, path)
+        return True
+
+    def _probe_loop(self) -> None:
+        path = f"tmp/health-{_uuid.uuid4().hex}"
+        while not self._closed:
+            time.sleep(self._probe_interval)
+            if self._closed:
+                break
+            budget = self._deadlines["data"].timeout()
+            if _run_with_deadline(lambda: self._probe_once(path), budget):
+                self._restore(via_probe=True)
+                return
+        with self._mu:
+            self._probing = False
+
+    def _restore(self, via_probe: bool) -> None:
+        with self._mu:
+            if via_probe:
+                self._probing = False
+            if self.state == ONLINE:
+                return
+            self.consecutive = 0
+            self._set_state(ONLINE)
+        cb = self._on_restore
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 - notification must not kill us
+                pass
+
+    # -- the guard ----------------------------------------------------
+
+    def _guard_stream_sink(self, fn, volume: str, path: str, chunks):
+        """create_file: the deadline bounds drive-side stalls only — it
+        suspends while the drive waits inside the producer's next()
+        (client bytes), and re-arms on every chunk handoff."""
+        tok, op = self._begin("data")
+        dt = self._deadlines["data"]
+        err = None
+
+        def paced():
+            it = iter(chunks)
+            while True:
+                op.deadline_at = float("inf")   # waiting on the producer
+                try:
+                    chunk = next(it)
+                except StopIteration:
+                    now = time.monotonic()
+                    op.armed_base = now
+                    op.deadline_at = now + dt.timeout()  # final fsync/close
+                    return
+                now = time.monotonic()
+                op.armed_base = now
+                op.deadline_at = now + dt.timeout()
+                yield chunk
+
+        try:
+            return fn(volume, path, paced())
+        except Exception as e:
+            err = e
+            raise
+        finally:
+            self._end(tok, op, err)
+
+    def _guard_walk(self, fn, args, kwargs):
+        """walk_dir: one in-flight record covering the call AND every
+        entry, re-armed per next() — the deadline bounds drive-side
+        stalls (including a hang at call time), while the consumer's
+        think time (deadline suspended at yield) never counts."""
+        tok, op = self._begin("walk")
+        dt = self._deadlines["walk"]
+        try:
+            it = fn(*args, **kwargs)
+        except Exception as e:
+            self._end(tok, op, e)
+            raise
+        op.deadline_at = float("inf")   # suspended until first next()
+
+        def gen():
+            err = None
+            try:
+                while True:
+                    now = time.monotonic()
+                    op.armed_base = now
+                    op.deadline_at = now + dt.timeout()
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        return
+                    op.deadline_at = float("inf")  # consumer's turn
+                    yield item
+            except Exception as e:
+                err = e
+                raise
+            finally:
+                self._end(tok, op, err)
+
+        return gen()
+
+    def __getattr__(self, name: str):
+        fn = getattr(self._inner, name)
+        cls = OP_CLASS.get(name)
+        if cls is None or not callable(fn):
+            return fn
+        if name == "walk_dir":
+            return lambda *a, **kw: self._guard_walk(fn, a, kw)
+        if name == "create_file":
+            return lambda volume, path, chunks: self._guard_stream_sink(
+                fn, volume, path, chunks)
+
+        def guarded(*a, **kw):
+            tok, op = self._begin(cls)
+            err = None
+            try:
+                return fn(*a, **kw)
+            except Exception as e:
+                err = e
+                raise
+            finally:
+                self._end(tok, op, err)
+
+        return guarded
+
+
+# --- fleet helpers -----------------------------------------------------------
+
+def wrap_with_healthcheck(drives: list, fmt=None, **kw) -> list:
+    """Stack a HealthChecker over each (already disk-ID-checked) drive.
+    With a format layout, the probe's restore hook drops a healing
+    tracker carrying the slot UUID so the AutoHealer rebuilds whatever
+    the drive missed while OFFLINE (reference healFreshDisk handoff)."""
+    flat = [u for s in fmt.sets for u in s] if fmt is not None else []
+    out = []
+    for i, d in enumerate(drives):
+        uid = flat[i] if i < len(flat) else ""
+        cb = None
+        if uid:
+            def cb(hc, _uid=uid):
+                from minio_tpu.erasure.autoheal import mark_drive_healing
+
+                try:
+                    mark_drive_healing(hc, _uid)
+                except Exception:  # noqa: BLE001 - heal is best-effort
+                    pass
+        out.append(HealthChecker(d, on_restore=cb, **kw))
+    return out
+
+
+def unwrap(drive):
+    """Peel the health + disk-ID decorators — ONLY those two: fault
+    injectors and remote clients keep their per-call interposition."""
+    from minio_tpu.storage.idcheck import DiskIDChecker
+
+    while True:
+        if isinstance(drive, HealthChecker):
+            drive = drive._inner
+        elif isinstance(drive, DiskIDChecker):
+            drive = drive.inner
+        else:
+            return drive
+
+
+def fleet_deadlines(drives) -> tuple[float, float, float]:
+    """(meta, data, walk) deadline for a quorum fan-out over `drives`:
+    the max of the wrapped drives' adaptive deadlines, or the class
+    defaults when no drive is health-wrapped."""
+    meta: list[float] = []
+    data: list[float] = []
+    walk: list[float] = []
+    for d in drives:
+        if isinstance(d, HealthChecker):
+            m, dd, w = d.op_deadlines()
+            meta.append(m)
+            data.append(dd)
+            walk.append(w)
+    return (max(meta) if meta else DEFAULT_DEADLINES["meta"][0],
+            max(data) if data else DEFAULT_DEADLINES["data"][0],
+            max(walk) if walk else DEFAULT_DEADLINES["walk"][0])

@@ -43,6 +43,11 @@ class DiskIDChecker:
         self._expected = expected_id
         self._interval = interval
         self._last_ok = 0.0
+        # Failed probes are throttled like successes: a dead drive must
+        # not eat a format-document read on every single call (probe
+        # storm) — the failure is cached for the same interval.
+        self._last_fail = 0.0
+        self._fail_msg = ""
 
     # -- identity plumbing (unguarded: these ARE the probe surface) --
 
@@ -56,6 +61,7 @@ class DiskIDChecker:
     def set_disk_id(self, disk_id: str) -> None:
         self._expected = disk_id
         self._inner.set_disk_id(disk_id)
+        self._last_fail = 0.0  # identity changed: re-probe immediately
 
     def disk_info(self):
         return self._inner.disk_info()
@@ -71,9 +77,15 @@ class DiskIDChecker:
 
     def write_format(self, doc) -> None:
         self._inner.write_format(doc)
-        self._last_ok = 0.0  # re-probe after identity rewrite
+        self._last_ok = 0.0   # re-probe after identity rewrite
+        self._last_fail = 0.0
 
     # -- the guard --
+
+    def _fail(self, now: float, msg: str) -> "se.DiskNotFound":
+        self._last_fail = now
+        self._fail_msg = msg
+        return se.DiskNotFound(msg)
 
     def _check(self) -> None:
         if not self._expected:
@@ -81,16 +93,24 @@ class DiskIDChecker:
         now = time.monotonic()
         if now - self._last_ok < self._interval:
             return
+        if self._last_fail and now - self._last_fail < self._interval:
+            # Cached failure: fail fast with ZERO I/O until the throttle
+            # interval passes (then one real probe decides again).
+            raise se.DiskNotFound(self._fail_msg)
         try:
             this = self._inner.get_disk_id()
         except se.StorageError as e:
-            raise se.DiskNotFound(
-                f"{self._inner.endpoint()}: identity probe failed: {e}") from e
+            raise self._fail(
+                now,
+                f"{self._inner.endpoint()}: identity probe failed: {e}"
+            ) from e
         if this != self._expected:
-            raise se.DiskNotFound(
+            raise self._fail(
+                now,
                 f"{self._inner.endpoint()}: drive id {this!r} != expected "
                 f"{self._expected!r} (swapped drive?)")
         self._last_ok = now
+        self._last_fail = 0.0
 
     def __getattr__(self, name: str):
         fn = getattr(self._inner, name)

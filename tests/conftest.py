@@ -7,7 +7,19 @@ JAX_PLATFORMS env var, so we must also override at the jax.config level —
 config wins because backends initialize lazily, after conftest runs.
 """
 
+import faulthandler
 import os
+import signal
+
+# A future hang (a deadlock or an unreleased injected stall) must dump
+# every thread's stack instead of timing out silently: dump on fatal
+# signals AND on the harness's SIGTERM (`timeout` still SIGKILLs after
+# its grace period, so termination is never lost).
+faulthandler.enable()
+try:
+    faulthandler.register(signal.SIGTERM, chain=True)
+except (AttributeError, ValueError, OSError):
+    pass  # non-main thread / platform without register()
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")

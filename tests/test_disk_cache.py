@@ -172,6 +172,12 @@ def test_writeback_survives_backend_outage(tmp_path):
         else:
             raise AssertionError("writeback never committed")
         assert _get(inner, "wbk", "k") == payload
+        # The poll above can observe the committed object between the
+        # committer's put_object returning and its stats increment —
+        # give the counter the same grace the commit itself got.
+        wb_deadline = time.time() + 5
+        while time.time() < wb_deadline and cache.stats["writebacks"] < 1:
+            time.sleep(0.05)
         assert cache.stats["writebacks"] >= 1
     finally:
         cache.close()

@@ -1,0 +1,464 @@
+"""Drive-hang tolerance: deadline-bounded I/O, the per-drive health state
+machine, hedged shard reads, and parallel_map deadline semantics.
+
+One hung drive (NaughtyDisk latency injection — an NFS stall / dying disk)
+must never wedge the data path: PutObject, GetObject and ListObjects
+complete at quorum within a bounded deadline, the drive walks
+ONLINE -> FAULTY -> OFFLINE (fail-fast, zero I/O), and the background
+sentinel probe restores it and hands it to the auto-healer."""
+
+import io
+import threading
+import time
+
+import pytest
+
+from minio_tpu import obs
+from minio_tpu.erasure.metadata import hash_order, parallel_map
+from minio_tpu.erasure.objects import ErasureObjects
+from minio_tpu.storage import healthcheck as hcmod
+from minio_tpu.storage.healthcheck import HealthChecker
+from minio_tpu.storage.local import LocalDrive
+from minio_tpu.utils import errors as se
+from tests.naughty import HANG, NaughtyDisk
+
+D = 1.0          # test op-class deadline (seconds; generous — sandbox fsyncs are slow)
+BOUND = 4.5      # completion bound with one hung drive (CI slack included)
+TIGHT = {"meta": (D, 0.1), "data": (D, 0.1), "walk": (D, 0.1)}
+
+
+def _build_set(tmp_path, probe_interval=60.0, offline_after=2,
+               on_restore=None, health=True):
+    """4-drive EC set (k=2, m=2): LocalDrive <- NaughtyDisk <- HealthChecker."""
+    naughties = [NaughtyDisk(LocalDrive(str(tmp_path / f"d{i}")))
+                 for i in range(4)]
+    if health:
+        drives = [HealthChecker(nd, deadlines=TIGHT,
+                                probe_interval=probe_interval,
+                                offline_after=offline_after,
+                                on_restore=on_restore)
+                  for nd in naughties]
+    else:
+        drives = list(naughties)
+    es = ErasureObjects(drives)
+    es.make_bucket("bkt")
+    return es, naughties, drives
+
+
+def _put_retry(es, bucket, name, payload, tries=4):
+    """Seed helper: retry transient quorum/timeout errors exactly like a
+    client retrying 503 SlowDown — this sandbox's fsyncs can blow the
+    tight test deadline on ALL drives under back-to-back suite load."""
+    for attempt in range(tries):
+        try:
+            return es.put_object(bucket, name, io.BytesIO(payload),
+                                 len(payload))
+        except (se.OperationTimedOut, se.InsufficientWriteQuorum):
+            if attempt == tries - 1:
+                raise
+            time.sleep(0.2)
+
+
+def _wait_for(cond, timeout=8.0, what="condition"):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _drive_of_shard(es, bucket, obj, shard_index=1):
+    """Physical drive index holding 1-based shard `shard_index` of obj."""
+    dist = hash_order(f"{bucket}/{obj}", es.n)
+    return dist.index(shard_index)
+
+
+# ---------------------------------------------------------------------------
+# parallel_map deadline semantics
+# ---------------------------------------------------------------------------
+
+def test_parallel_map_deadline_converts_stragglers():
+    release = threading.Event()
+
+    def fast():
+        return "ok"
+
+    def hung():
+        release.wait()
+        return "late"
+
+    def boom():
+        raise se.FaultyDisk("dead")
+
+    try:
+        t0 = time.monotonic()
+        results = parallel_map([fast, hung, boom], deadline=0.3)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.5
+        assert results[0] == "ok"
+        assert isinstance(results[1], se.OperationTimedOut)
+        assert isinstance(results[2], se.FaultyDisk)
+        # The straggler finishing later must NOT overwrite its slot — the
+        # quorum reducers already consumed the list.
+        release.set()
+        time.sleep(0.2)
+        assert isinstance(results[1], se.OperationTimedOut)
+    finally:
+        release.set()
+
+
+def test_parallel_map_deadline_accounts_leaked_worker():
+    from minio_tpu.erasure.metadata import _HUNG_WORKERS, _shared_pool
+
+    before = _HUNG_WORKERS.labels().value
+    cap_before = _shared_pool()._max_workers
+    release = threading.Event()
+    try:
+        results = parallel_map([lambda: release.wait(), lambda: 1],
+                               deadline=0.2)
+        assert isinstance(results[0], se.OperationTimedOut)
+        assert results[1] == 1
+        assert _HUNG_WORKERS.labels().value > before
+        assert _shared_pool()._max_workers > cap_before
+    finally:
+        release.set()
+
+
+# ---------------------------------------------------------------------------
+# naughty latency injection
+# ---------------------------------------------------------------------------
+
+def test_naughty_latency_injection(tmp_path):
+    nd = NaughtyDisk(LocalDrive(str(tmp_path / "d")),
+                     per_method_delay={"make_vol": 0.15})
+    t0 = time.monotonic()
+    nd.make_vol("v")
+    assert time.monotonic() - t0 >= 0.15
+
+    nd.per_method_delay["stat_vol"] = HANG
+    out = []
+
+    def call():
+        out.append(nd.stat_vol("v"))
+
+    t = threading.Thread(target=call, daemon=True)
+    t.start()
+    t.join(0.2)
+    assert t.is_alive(), "HANG must block until released"
+    nd.release.set()
+    t.join(3.0)
+    assert not t.is_alive() and out, "released hang must complete"
+
+
+def test_naughty_slow_stream(tmp_path):
+    nd = NaughtyDisk(LocalDrive(str(tmp_path / "d")), stream_chunk_delay=0.1)
+    nd.make_vol("v")
+    nd.write_all("v", "f", b"abcdef")
+    f = nd.read_file_stream("v", "f")
+    t0 = time.monotonic()
+    assert f.read(3) == b"abc"
+    assert time.monotonic() - t0 >= 0.1
+    f.close()
+
+
+# ---------------------------------------------------------------------------
+# idcheck: failed identity probes are cached (no probe storm)
+# ---------------------------------------------------------------------------
+
+def test_idcheck_caches_failed_probe():
+    from minio_tpu.storage.idcheck import DiskIDChecker
+
+    class DeadDrive:
+        probes = 0
+
+        def endpoint(self):
+            return "dead:1"
+
+        def get_disk_id(self):
+            DeadDrive.probes += 1
+            raise se.FaultyDisk("unplugged")
+
+        def make_vol(self, v):
+            return None
+
+    w = DiskIDChecker(DeadDrive(), "uuid-A", interval=0.3)
+    with pytest.raises(se.DiskNotFound):
+        w.make_vol("v")
+    assert DeadDrive.probes == 1
+    # Within the throttle interval the cached failure answers — zero I/O.
+    with pytest.raises(se.DiskNotFound):
+        w.make_vol("v")
+    assert DeadDrive.probes == 1
+    time.sleep(0.35)
+    with pytest.raises(se.DiskNotFound):
+        w.make_vol("v")
+    assert DeadDrive.probes == 2
+
+
+# ---------------------------------------------------------------------------
+# the hang matrix: one hung drive, every op bounded + at quorum
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("case", ["put-stream", "put-inline", "get-meta",
+                                  "get-data", "list"])
+def test_hang_matrix(tmp_path, case):
+    es, naughties, drives = _build_set(tmp_path)
+    payload = b"h" * 65536
+    for i in range(3):
+        _put_retry(es, "bkt", f"seed{i}", payload)
+    try:
+        if case == "put-stream":
+            victim = _drive_of_shard(es, "bkt", "hung-put")
+            naughties[victim].per_method_delay["create_file"] = HANG
+            t0 = time.monotonic()
+            es.put_object("bkt", "hung-put", io.BytesIO(payload),
+                          len(payload))
+            assert time.monotonic() - t0 < BOUND
+            info, stream = es.get_object("bkt", "hung-put")
+            assert b"".join(stream) == payload
+        elif case == "put-inline":
+            victim = 0
+            naughties[victim].per_method_delay["write_metadata_single"] = HANG
+            t0 = time.monotonic()
+            es.put_object("bkt", "small", io.BytesIO(b"tiny"), 4)
+            assert time.monotonic() - t0 < BOUND
+        elif case == "get-meta":
+            victim = 0
+            naughties[victim].per_method_delay["read_version"] = HANG
+            t0 = time.monotonic()
+            info = es.get_object_info("bkt", "seed0")
+            assert time.monotonic() - t0 < BOUND
+            assert info.size == len(payload)
+        elif case == "get-data":
+            victim = _drive_of_shard(es, "bkt", "seed1")
+            naughties[victim].per_method_delay["read_file_stream"] = HANG
+            t0 = time.monotonic()
+            info, stream = es.get_object("bkt", "seed1")
+            data = b"".join(stream)
+            assert time.monotonic() - t0 < BOUND
+            assert data == payload
+        else:  # list
+            victim = 0
+            naughties[victim].per_method_delay["walk_dir"] = HANG
+            t0 = time.monotonic()
+            names = [o.name for o in es.list_objects("bkt").objects]
+            assert time.monotonic() - t0 < BOUND
+            assert set(names) >= {"seed0", "seed1", "seed2"}
+        # The watchdog charged the hung op: the victim leaves ONLINE.
+        _wait_for(lambda: drives[victim].state != hcmod.ONLINE,
+                  what=f"{case}: victim drive leaving ONLINE")
+        assert drives[victim].timeouts >= 1
+    finally:
+        for nd in naughties:
+            nd.per_method_delay.clear()
+            nd.release.set()
+        es.close()
+
+
+# ---------------------------------------------------------------------------
+# FAULTY -> OFFLINE -> probe -> restore -> autoheal roundtrip
+# ---------------------------------------------------------------------------
+
+def test_state_machine_roundtrip(tmp_path):
+    from minio_tpu.erasure.autoheal import AutoHealer, HealingTracker, \
+        mark_drive_healing
+
+    restored = []
+
+    def on_restore(hc):
+        restored.append(hc)
+        mark_drive_healing(hc, "uuid-roundtrip")
+
+    es, naughties, drives = _build_set(tmp_path, probe_interval=0.05,
+                                       on_restore=on_restore)
+    payload = b"r" * 40000
+    _put_retry(es, "bkt", "pre", payload)
+    victim = 0
+    nd, hc = naughties[victim], drives[victim]
+    try:
+        # Hang everything health-relevant: ops AND the sentinel probe.
+        for m in ("read_version", "write_all", "read_all"):
+            nd.per_method_delay[m] = HANG
+        # Repeated bounded ops walk the state machine to OFFLINE.
+        for _ in range(6):
+            es.get_object_info("bkt", "pre")
+            if hc.state == hcmod.OFFLINE:
+                break
+            time.sleep(0.3)
+        _wait_for(lambda: hc.state == hcmod.OFFLINE, what="OFFLINE")
+
+        # OFFLINE = fail-fast DiskNotFound with ZERO I/O on the drive.
+        calls_before = nd.calls
+        t0 = time.monotonic()
+        with pytest.raises(se.DiskNotFound):
+            hc.read_all("bkt", "nope")
+        assert time.monotonic() - t0 < 0.25
+        assert nd.calls == calls_before
+
+        # A write the drive misses while offline (quorum 3/4 passes).
+        es.put_object("bkt", "missed", io.BytesIO(payload), len(payload))
+
+        # Unhang: the probe restores the drive and notifies autoheal.
+        nd.per_method_delay.clear()
+        nd.release.set()
+        _wait_for(lambda: hc.state == hcmod.ONLINE, what="probe restore")
+        # The restore callback (tracker write) runs after the state flip:
+        # wait for it rather than racing it.
+        _wait_for(lambda: HealingTracker.load(hc) is not None,
+                  what="healing tracker from on_restore")
+        assert restored and restored[0] is hc
+
+        # The auto-healer picks up the tracker and rebuilds the miss.
+        healer = AutoHealer(es, interval=3600)
+        assert healer.run_once() == 1
+        assert HealingTracker.load(hc) is None
+        fi = nd.inner.read_version("bkt", "missed")
+        assert fi.size == len(payload)
+    finally:
+        for n in naughties:
+            n.per_method_delay.clear()
+            n.release.set()
+        es.close()
+
+
+# ---------------------------------------------------------------------------
+# hedged shard reads: first-k-wins
+# ---------------------------------------------------------------------------
+
+def test_hedged_read_first_k_wins(tmp_path, monkeypatch):
+    # Force the Python shard lane (the native lane has its own
+    # deadline'd degradation; hedging lives in _read_chunk_rows).
+    from minio_tpu.native import plane
+
+    monkeypatch.setattr(plane, "available", lambda: False)
+
+    es, naughties, _ = _build_set(tmp_path, health=False)
+    es.hedge_delay = 0.05
+    payload = bytes(range(256)) * 300   # 76800 B: erasure path, 1 part
+    es.put_object("bkt", "hedge", io.BytesIO(payload), len(payload))
+
+    hedged = obs.counter("minio_tpu_hedged_reads_total", "").labels()
+    won = obs.counter("minio_tpu_hedged_reads_won_total", "").labels()
+    h0, w0 = hedged.value, won.value
+
+    # Slow the drive holding data shard 1: it is always in the initial
+    # data-first selection, so the hedge must fire and a parity spare win.
+    victim = _drive_of_shard(es, "bkt", "hedge")
+    naughties[victim].stream_chunk_delay = 2.5
+    try:
+        t0 = time.monotonic()
+        info, stream = es.get_object("bkt", "hedge")
+        data = b"".join(stream)
+        elapsed = time.monotonic() - t0
+        assert data == payload
+        assert elapsed < 2.0, "hedge must beat the slow shard"
+        assert hedged.value > h0
+        assert won.value > w0
+    finally:
+        naughties[victim].stream_chunk_delay = 0.0
+        es.close()
+
+
+# ---------------------------------------------------------------------------
+# rpc probe: backoff + close() stops the probe thread
+# ---------------------------------------------------------------------------
+
+def test_rpc_probe_stops_on_close():
+    import socket
+
+    from minio_tpu.dist.rpc import RestClient
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here
+
+    c = RestClient("127.0.0.1", port, "secret", timeout=1.0)
+    c.mark_offline()
+    name = f"rpc-health-127.0.0.1:{port}"
+    _wait_for(lambda: any(t.name == name for t in threading.enumerate()),
+              timeout=2.0, what="probe thread start")
+    c.close()
+    _wait_for(lambda: not any(t.name == name and t.is_alive()
+                              for t in threading.enumerate()),
+              timeout=5.0, what="probe thread stop after close()")
+    # After close, going offline again must not spawn a new probe.
+    c._online = True
+    c.mark_offline()
+    time.sleep(0.1)
+    assert not any(t.name == name and t.is_alive()
+                   for t in threading.enumerate())
+
+
+# ---------------------------------------------------------------------------
+# observability: the drive-resilience metric families render
+# ---------------------------------------------------------------------------
+
+class _Sink:
+    def __init__(self):
+        self.families = []
+        self.samples = []
+
+    def family(self, name, help_, typ):
+        self.families.append(name)
+
+    def sample(self, name, value, labels=None):
+        self.samples.append((name, labels))
+
+
+def test_drive_metrics_render(tmp_path):
+    # A checker whose drive hangs long enough to register one timeout.
+    nd = NaughtyDisk(LocalDrive(str(tmp_path / "d")),
+                     per_method_delay={"stat_vol": HANG})
+    hc = HealthChecker(nd, deadlines=TIGHT, probe_interval=60)
+    try:
+        t = threading.Thread(
+            target=lambda: _swallow(hc.stat_vol, "v"), daemon=True)
+        t.start()
+        _wait_for(lambda: hc.timeouts >= 1, what="watchdog timeout count")
+    finally:
+        nd.release.set()
+
+    sink = _Sink()
+    obs.render_into(sink)
+    for fam in ("minio_tpu_drive_state", "minio_tpu_drive_timeouts_total",
+                "minio_tpu_hedged_reads_total",
+                "minio_tpu_hedged_reads_won_total",
+                "minio_tpu_hung_workers_total"):
+        assert fam in sink.families, f"{fam} missing from exposition"
+    state_samples = [s for s in sink.samples
+                     if s[0] == "minio_tpu_drive_state"]
+    assert state_samples, "drive_state must carry per-drive samples"
+
+
+def _swallow(fn, *a):
+    try:
+        fn(*a)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# health endpoints: live vs quorum-aware ready/cluster (+ maintenance)
+# ---------------------------------------------------------------------------
+
+def test_health_endpoint_split(client):
+    r = client.get("/minio/health/live")
+    assert r.status_code == 200
+    for kind in ("ready", "cluster"):
+        r = client.get(f"/minio/health/{kind}")
+        assert r.status_code == 200, r.text
+        assert "X-Minio-Write-Quorum" in r.headers
+    # maintenance mode: 4 drives online, write quorum 3 -> 4 >= 3+1 holds.
+    r = client.get("/minio/health/cluster", query={"maintenance": "true"})
+    assert r.status_code == 200
+
+
+def test_drive_state_in_prometheus_scrape(client):
+    r = client.get("/minio/v2/metrics/cluster")
+    assert r.status_code == 200
+    text = r.text
+    assert "minio_tpu_drive_state" in text
+    assert "minio_tpu_drive_timeouts_total" in text
+    assert "minio_tpu_hedged_reads_total" in text

@@ -145,11 +145,15 @@ def test_disk_id_check_detects_swap(tmp_path):
 
 def test_sets_wrap_drives_with_id_check(tmp_path):
     from minio_tpu.erasure.sets import ErasureSets
+    from minio_tpu.storage.healthcheck import HealthChecker
     from minio_tpu.storage.idcheck import DiskIDChecker
 
     drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
     sets = ErasureSets(drives)
-    assert all(isinstance(d, DiskIDChecker) for d in sets.drives)
+    # The resilience stack: HealthChecker (deadlines + state machine)
+    # over DiskIDChecker (identity guard) over the drive.
+    assert all(isinstance(d, HealthChecker) for d in sets.drives)
+    assert all(isinstance(d.inner, DiskIDChecker) for d in sets.drives)
     sets.make_bucket("bkt")  # guarded calls work end-to-end
     sets.put_object("bkt", "o", io.BytesIO(b"x" * 50_000), 50_000)
     _, stream = sets.get_object("bkt", "o")
