@@ -808,6 +808,54 @@ def bench_xlmeta_codec() -> dict:
             "doc_bytes": len(raw)}
 
 
+def bench_obs_overhead() -> dict:
+    """Observability hot-path cost (docs/TRACING.md zero-overhead
+    contract): span enter/exit ns/op with and without a trace
+    subscriber, histogram observe ns/op, and the trace-context
+    propagation wrapper — the per-request tax every other config in
+    this file silently pays."""
+    from minio_tpu import obs
+
+    def ns_per_op(fn, iters: int) -> float:
+        fn()  # warmup
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        return (time.perf_counter() - t0) / iters * 1e9
+
+    iters = 200_000
+    bus = obs.trace_bus()
+
+    def span_nosub():
+        with obs.span("bench-op", bucket="b"):
+            pass
+
+    span_off = ns_per_op(span_nosub, iters)
+
+    sub = bus.subscribe()
+    try:
+        # Stay under the subscriber queue cap (1000): past it, publish
+        # takes the drop path and the number measured would be the
+        # queue-Full branch, not delivery (it would also pollute the
+        # exported minio_tpu_trace_dropped_total).
+        span_on = ns_per_op(span_nosub, 900)
+        while sub.get(timeout=0) is not None:
+            pass
+    finally:
+        sub.close()
+
+    hist = obs.histogram("bench_obs_overhead_seconds",
+                         "obs_overhead microbench scratch family",
+                         ("lane",)).labels(lane="bench")
+    observe_ns = ns_per_op(lambda: hist.observe(0.001), iters)
+    ctx_ns = ns_per_op(lambda: obs.ctx_wrap(int)(), 50_000)
+    return {"metric": "obs_overhead_span_unwatched", "value": round(span_off, 1),
+            "unit": "ns/op", "vs_baseline": 0.0,
+            "span_subscribed_ns": round(span_on, 1),
+            "histogram_observe_ns": round(observe_ns, 1),
+            "ctx_wrap_call_ns": round(ctx_ns, 1)}
+
+
 def bench_select_csv() -> dict:
     """S3 Select CSV scan rate (BASELINE 'run-to-measure' matrix,
     pkg/s3select/select_benchmark_test.go:132 role): aggregate + WHERE
@@ -892,6 +940,7 @@ def main() -> int:
             ("select", bench_select_csv),
             ("select_parquet", bench_select_parquet),
             ("xlmeta", bench_xlmeta_codec),
+            ("obs_overhead", bench_obs_overhead),
         ]
         if use_pallas:
             plans.insert(1, ("encode_pallas",

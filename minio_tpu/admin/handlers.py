@@ -14,8 +14,9 @@ import time
 
 from aiohttp import web
 
+from minio_tpu import obs
 from minio_tpu.admin.configkv import ConfigSys
-from minio_tpu.admin.metrics import PROM_CONTENT_TYPE, collect_metrics
+from minio_tpu.admin.metrics import PROM_CONTENT_TYPE
 from minio_tpu.iam import reqctx
 from minio_tpu.iam.policy import PolicyArgs
 from minio_tpu.s3.errors import S3Error
@@ -61,7 +62,10 @@ class AdminAPI:
         loop = asyncio.get_running_loop()
 
         def run(fn, *a, **kw):
-            return loop.run_in_executor(None, lambda: fn(*a, **kw))
+            # Propagate the request's trace context into the executor
+            # (heals, config writes etc. emit storage trace records).
+            return loop.run_in_executor(
+                None, obs.ctx_wrap(lambda: fn(*a, **kw)))
 
         q = dict(request.query)
         m = request.method
@@ -82,9 +86,7 @@ class AdminAPI:
             return _json(usage)
         if op == "metrics" and m == "GET":
             self._authorize(identity, "admin:Prometheus")
-            body = await run(
-                collect_metrics, self.s.obj, self.s.stats,
-                self.s.scanner.usage if self.s.scanner else None)
+            body = await run(self.s._cluster_scrape)
             return web.Response(body=body,
                                 headers={"Content-Type": PROM_CONTENT_TYPE})
 
@@ -99,6 +101,12 @@ class AdminAPI:
             if locker is not None:
                 dump = locker.dump()
             return _json({"locks": dump})
+        if op == "top" and rest == "api" and m == "GET":
+            # Live in-flight requests (this view rides the same registry
+            # as minio_tpu_s3_requests_inflight): age, API, trace id —
+            # the `mc admin top api` role beside `top locks`.
+            self._authorize(identity, "admin:ServerInfo")
+            return _json({"requests": self.s.stats.inflight()})
         if op == "force-unlock" and m == "POST":
             # Reference ForceUnlock (lock-rest ForceUnlockHandler): clear a
             # stuck resource on THIS node's locker; in a cluster the admin
@@ -125,7 +133,8 @@ class AdminAPI:
             return await self._bus_stream(request, self.s.trace_bus,
                                           peer_stream="trace_stream",
                                           all_nodes=q.get("all", "true") != "false",
-                                          type_filter=q.get("type", ""))
+                                          type_filter=q.get("type", ""),
+                                          traceid=q.get("traceid", ""))
         if op == "consolelog" and m == "GET":
             self._authorize(identity, "admin:ConsoleLog")
             return await self._bus_stream(request,
@@ -509,13 +518,17 @@ class AdminAPI:
 
     async def _bus_stream(self, request, bus, peer_stream: str = "",
                           all_nodes: bool = True,
-                          type_filter: str = "") -> web.StreamResponse:
+                          type_filter: str = "",
+                          traceid: str = "") -> web.StreamResponse:
         """Stream a local pubsub as JSON lines, merged with every peer's
         matching stream (reference `mc admin trace`/`console` subscribe to
         all nodes via peer REST, cmd/peer-rest-client.go:782): peer pullers
         run in daemon threads feeding the same local queue. `type_filter`
         keeps only records of one trace type — http/storage/rpc/internal —
-        the `mc admin trace --call storage/internal` selector."""
+        the `mc admin trace --call storage/internal` selector. `traceid`
+        keeps only records of one request (trace_id, falling back to the
+        http record's requestId) — follow one request across every layer
+        and node."""
         import queue as _queue
         import threading as _threading
 
@@ -564,6 +577,9 @@ class AdminAPI:
                         await resp.write(b"\n")
                         continue
                     if type_filter and item.get("type", "") != type_filter:
+                        continue
+                    if traceid and traceid not in (
+                            item.get("trace_id"), item.get("requestId")):
                         continue
                     await resp.write(json.dumps(item).encode() + b"\n")
             except (ConnectionResetError, asyncio.CancelledError):

@@ -8,6 +8,7 @@ collectors (:147-154).
 
 from __future__ import annotations
 
+import os
 import time
 
 from minio_tpu import obs
@@ -15,6 +16,17 @@ from minio_tpu import obs
 # Prometheus text exposition 0.0.4 — scrapers content-negotiate on the
 # version parameter; bare text/plain is rejected by strict clients.
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+# Per-peer budget for the federated cluster scrape: stragglers become
+# scrape errors, never a hung scrape (the whole fan-out runs under one
+# parallel_map deadline).
+PEER_SCRAPE_DEADLINE = float(os.environ.get(
+    "MTPU_METRICS_PEER_DEADLINE", "2.0"))
+
+_PEER_SCRAPE_ERRORS = obs.counter(
+    "minio_tpu_peer_scrape_errors_total",
+    "Peer node scrapes that failed or timed out during the federated "
+    "cluster scrape", ("peer",))
 
 
 def _esc(v: str) -> str:
@@ -80,6 +92,7 @@ def collect_metrics(object_layer, stats, usage=None,
         p.sample("minio_tpu_s3_traffic_sent_bytes", s["txBytes"], lbl)
     p.family("minio_tpu_s3_requests_current", "In-flight S3 requests")
     p.sample("minio_tpu_s3_requests_current", snap["currentRequests"])
+    _render_inflight(p, stats)
 
     # -- drives / capacity --
     online = offline = 0
@@ -135,6 +148,16 @@ def _render_trace_dropped(p: PromText) -> None:
     p.sample("minio_tpu_trace_dropped_total", obs.trace_bus().dropped)
 
 
+def _render_inflight(p: PromText, stats) -> None:
+    """Per-API in-flight gauge from the stats inflight registry (the
+    scrape itself always shows as one in-flight `metrics` request)."""
+    p.family("minio_tpu_s3_requests_inflight",
+             "In-flight S3 requests by API")
+    by_api = getattr(stats, "inflight_by_api", dict)()
+    for api, n in sorted(by_api.items()):
+        p.sample("minio_tpu_s3_requests_inflight", n, {"api": api})
+
+
 def collect_node_metrics(stats) -> bytes:
     """Node-scope scrape (/minio/v2/metrics/node): this process's own
     planes — request/TTFB latency, per-drive op latency, RPC fabric —
@@ -145,6 +168,98 @@ def collect_node_metrics(stats) -> bytes:
     p.sample("minio_tpu_process_uptime_seconds", round(stats.uptime(), 3))
     p.family("minio_tpu_s3_requests_current", "In-flight S3 requests")
     p.sample("minio_tpu_s3_requests_current", stats.current_requests)
+    _render_inflight(p, stats)
     obs.render_into(p)
     _render_trace_dropped(p)
     return p.render()
+
+
+# --- cluster federation ------------------------------------------------------
+
+
+def collect_cluster_metrics(object_layer, stats, usage=None, *,
+                            notification=None, local_name: str = "",
+                            deadline: float | None = None) -> bytes:
+    """The federated cluster scrape: this node's cluster collectors plus
+    every peer's node-scope scrape (pulled over the peer `metrics` route),
+    merged with each source's samples under a `server` label.
+
+    The fan-out runs under one parallel_map deadline (the PR 3
+    machinery): a hung peer becomes an OperationTimedOut result value and
+    a `minio_tpu_peer_scrape_errors_total{peer=...}` increment — the
+    scrape itself always returns within the deadline. Without peers the
+    single-node exposition is returned unchanged (no `server` label)."""
+    peers = list(notification.peers) if notification is not None else []
+    if peers:
+        from minio_tpu.erasure.metadata import parallel_map
+
+        results = parallel_map(
+            [p.metrics for p in peers],
+            deadline=PEER_SCRAPE_DEADLINE if deadline is None
+            else deadline)
+        # Count failures BEFORE rendering local families so the error
+        # counter lands in this very scrape, not the next one. An empty
+        # body is a failure too: a reachable fabric whose node never
+        # wired its metrics hook must not just vanish from the cluster.
+        for p, r in zip(peers, results):
+            if isinstance(r, Exception) or not r:
+                _PEER_SCRAPE_ERRORS.labels(peer=p.name).inc()
+    body = collect_metrics(object_layer, stats, usage)
+    if not peers:
+        return body
+    texts: list[tuple[str, str]] = [(local_name or "local", body.decode())]
+    for p, r in zip(peers, results):
+        if isinstance(r, Exception) or not r:
+            continue
+        texts.append((p.name, bytes(r).decode()))
+    return merge_expositions(texts)
+
+
+def merge_expositions(sources: list[tuple[str, str]]) -> bytes:
+    """Merge per-node exposition texts into one document: families keep
+    one HELP/TYPE block (first seen wins) with every source's samples
+    grouped under it, each sample relabeled with server="<node>"."""
+    order: list[str] = []                      # family emit order
+    heads: dict[str, list[str]] = {}           # family -> HELP/TYPE lines
+    rows: dict[str, list[str]] = {}            # family -> relabeled samples
+    for server, text in sources:
+        for line in text.split("\n"):
+            if not line:
+                continue
+            if line.startswith("# "):
+                # "# HELP name ..." / "# TYPE name type"
+                parts = line.split(" ", 3)
+                if len(parts) < 3:
+                    continue
+                fam = parts[2]
+                if fam not in heads:
+                    heads[fam] = []
+                    order.append(fam)
+                    rows[fam] = []
+                if len(heads[fam]) < 2:
+                    heads[fam].append(line)
+                continue
+            name_lbl, _, value = line.rpartition(" ")
+            if not name_lbl:
+                continue
+            name = name_lbl.split("{", 1)[0]
+            fam = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) and name[: -len(suffix)] in heads:
+                    fam = name[: -len(suffix)]
+                    break
+            if fam not in heads:   # sample with no TYPE: pass through
+                heads[fam] = []
+                order.append(fam)
+                rows[fam] = []
+            tag = f'server="{_esc(server)}"'
+            if name_lbl.endswith("}"):
+                relabeled = f"{name_lbl[:-1]},{tag}}} {value}"
+            else:
+                relabeled = f"{name_lbl}{{{tag}}} {value}"
+            rows[fam].append(relabeled)
+    out: list[str] = []
+    for fam in order:
+        out.extend(heads[fam])
+        out.extend(rows[fam])
+    return ("\n".join(out) + "\n").encode()

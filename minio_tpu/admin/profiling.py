@@ -20,16 +20,28 @@ import zipfile
 
 
 class Profiler:
-    """One node's profiling session (at most one active at a time)."""
+    """One node's profiling session (at most one active at a time).
+
+    Kinds: `cpu` (cProfile), `device` (best-effort jax.profiler capture,
+    silently absent when it can't run) and `tpu` — the explicit device
+    plane: jax.profiler.start_trace/stop_trace whose capture dir rides
+    the same zip_profiles / peer profile_download fan-out, degrading to
+    a marker file explaining WHY when the host has no usable device
+    profiler (CPU-only containers must not fail the cluster-wide
+    profiling round, and an empty archive must not read as "captured
+    nothing interesting")."""
 
     def __init__(self):
         self._mu = threading.Lock()
         self._cpu: cProfile.Profile | None = None
         self._jax_dir: str | None = None
+        self._jax_name: str | None = None
+        self._tpu_marker: str | None = None
 
     @property
     def running(self) -> bool:
-        return self._cpu is not None or self._jax_dir is not None
+        return (self._cpu is not None or self._jax_dir is not None
+                or self._tpu_marker is not None)
 
     def start(self, kinds: tuple[str, ...] = ("cpu",)) -> None:
         with self._mu:
@@ -38,15 +50,31 @@ class Profiler:
             if "cpu" in kinds:
                 self._cpu = cProfile.Profile()
                 self._cpu.enable()
-            if "device" in kinds:
+            device_kind = ("tpu" if "tpu" in kinds
+                           else "device" if "device" in kinds else None)
+            if device_kind is not None:
                 d = tempfile.mkdtemp(prefix="mtpu-jaxprof-")
                 try:
                     import jax
 
+                    backend = jax.default_backend()
                     jax.profiler.start_trace(d)
                     self._jax_dir = d
-                except Exception:  # noqa: BLE001 - no device / no profiler
+                    self._jax_name = ("tpu_trace.zip"
+                                      if device_kind == "tpu"
+                                      else "device_trace.zip")
+                    if device_kind == "tpu" and backend == "cpu":
+                        # Capture runs (host trace), but flag the backend
+                        # so the archive reader knows no TPU was profiled.
+                        self._tpu_marker = (
+                            "jax.default_backend() == 'cpu': trace holds "
+                            "host/XLA-CPU events only, no TPU timeline")
+                except Exception as e:  # noqa: BLE001 - no device/profiler
                     shutil.rmtree(d, ignore_errors=True)
+                    if device_kind == "tpu":
+                        self._tpu_marker = (
+                            f"device trace unavailable on this host: "
+                            f"{type(e).__name__}: {e}")
 
     def stop_collect(self) -> dict[str, bytes]:
         """Stop everything and return {filename: payload}."""
@@ -80,9 +108,13 @@ class Profiler:
                         for fn in files:
                             p = os.path.join(root, fn)
                             z.write(p, os.path.relpath(p, self._jax_dir))
-                out["device_trace.zip"] = buf.getvalue()
+                out[self._jax_name or "device_trace.zip"] = buf.getvalue()
                 shutil.rmtree(self._jax_dir, ignore_errors=True)
                 self._jax_dir = None
+                self._jax_name = None
+            if self._tpu_marker is not None:
+                out["tpu_trace.MARKER.txt"] = self._tpu_marker.encode()
+                self._tpu_marker = None
         return out
 
 

@@ -32,20 +32,70 @@ class HTTPStats:
         self.started = time.time()
         self._started_mono = time.monotonic()
         self.current_requests = 0
+        # Live in-flight registry keyed by request id: feeds the
+        # minio_tpu_s3_requests_inflight{api} gauge and the admin
+        # `top api` view (age, API, trace id per active request).
+        self._inflight: dict[str, dict] = {}
 
     def uptime(self) -> float:
         return time.monotonic() - self._started_mono
 
-    def begin(self) -> float:
+    def begin(self, request_id: str = "", api_hint: str = "",
+              remote: str = "", api_get=None) -> float:
+        """api_get: optional zero-arg callable resolving the request's
+        API once dispatch has classified it (the hint is the HTTP method
+        until then)."""
+        t0 = time.perf_counter()
         with self._mu:
             self.current_requests += 1
-        return time.perf_counter()
+            if request_id:
+                self._inflight[request_id] = {
+                    "t0": t0, "api": api_hint or "unknown",
+                    "remote": remote, "api_get": api_get}
+        return t0
+
+    def _resolve_api(self, entry: dict) -> str:
+        get = entry.get("api_get")
+        if get is not None:
+            try:
+                api = get()
+                if api:
+                    return api
+            except Exception:  # noqa: BLE001 - view must never fail
+                pass
+        return entry["api"]
+
+    def inflight(self) -> list[dict]:
+        """Snapshot of active requests, oldest first. trace_id == the
+        request id (the shared identifier across trace/audit records)."""
+        now = time.perf_counter()
+        with self._mu:
+            items = list(self._inflight.items())
+        out = [{"trace_id": rid,
+                "api": self._resolve_api(e),
+                "ageMs": round((now - e["t0"]) * 1000, 3),
+                "remote": e["remote"]}
+               for rid, e in items]
+        out.sort(key=lambda d: -d["ageMs"])
+        return out
+
+    def inflight_by_api(self) -> dict[str, int]:
+        with self._mu:
+            items = list(self._inflight.values())
+        by_api: dict[str, int] = {}
+        for e in items:
+            api = self._resolve_api(e)
+            by_api[api] = by_api.get(api, 0) + 1
+        return by_api
 
     def end(self, api: str, t0: float, status: int,
-            rx: int = 0, tx: int = 0, canceled: bool = False) -> None:
+            rx: int = 0, tx: int = 0, canceled: bool = False,
+            request_id: str = "") -> None:
         dt = time.perf_counter() - t0
         with self._mu:
             self.current_requests -= 1
+            if request_id:
+                self._inflight.pop(request_id, None)
             st = self._apis.setdefault(api, _APIStat())
             st.count += 1
             st.total_seconds += dt

@@ -93,10 +93,15 @@ class ClusterNode:
 
         self.locker = LocalLocker()
         self.hooks = PeerHooks()
+        # Advertised identity: the `node` stamp on trace records emitted
+        # while serving peers, and the `server` label this node's scrape
+        # carries in the federated cluster metrics.
+        self.node_name = f"{host}:{port}"
         self.node_server = NodeServer(host="0.0.0.0" if host not in
                                       ("127.0.0.1", "localhost") else host,
                                       port=self.rpc_port, secret=secret,
-                                      ssl_context=server_ssl)
+                                      ssl_context=server_ssl,
+                                      node_name=self.node_name)
         self.node_server.register_plane(
             "storage", storage_routes(self.local_drives))
         self.node_server.register_plane("lock", lock_routes(self.locker))
@@ -116,7 +121,8 @@ class ClusterNode:
                     continue
                 seen.add(ep.node)
                 self.peer_nodes.append(ep.node)
-        self.peers = [PeerClient(self._client_for(n)) for n in self.peer_nodes]
+        self.peers = [PeerClient(self._client_for(n), name=f"{n[0]}:{n[1]}")
+                      for n in self.peer_nodes]
         self.notification = NotificationSys(self.peers)
 
         # Quorum lockers: this node's local locker + every peer's.
@@ -183,6 +189,8 @@ class ClusterNode:
     def close(self) -> None:
         if self.object_layer is not None:
             self.object_layer.close()
+        for p in self.peers:
+            p.close()
         for c in self._clients.values():
             c.close()
         self.node_server.close()

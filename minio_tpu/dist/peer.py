@@ -46,6 +46,9 @@ class PeerHooks:
         self.trace_bus = None        # admin.pubsub.PubSub | None
         self.console_bus = None      # admin.pubsub.PubSub | None
         self.profiler = None         # admin.profiling.Profiler | None
+        # Node-scope Prometheus exposition (bytes) — what the federated
+        # cluster scrape pulls and relabels under server=<this node>.
+        self.metrics: Callable[[], bytes] = lambda: b""
 
 
 def _stream_bus(bus):
@@ -75,6 +78,9 @@ def peer_routes(hooks: PeerHooks) -> dict:
     def h_obd_info(params, body):
         return pack(hooks.obd_info())
 
+    def h_metrics(params, body):
+        return bytes(hooks.metrics())
+
     def h_trace(params, body):
         return _stream_bus(hooks.trace_bus)
 
@@ -98,6 +104,7 @@ def peer_routes(hooks: PeerHooks) -> dict:
             "reload_iam": h_reload_iam,
             "server_info": h_server_info,
             "obd_info": h_obd_info,
+            "metrics": h_metrics,
             "trace": h_trace,
             "consolelog": h_consolelog,
             "profile_start": h_profile_start,
@@ -109,12 +116,37 @@ def peer_routes(hooks: PeerHooks) -> dict:
 class PeerClient:
     """One per peer node (cmd/peer-rest-client.go)."""
 
-    def __init__(self, client: RestClient):
+    def __init__(self, client: RestClient, name: str = ""):
+        """name: the peer's ADVERTISED identity (S3 host:port) — what its
+        own trace records carry as `node` and its scrape carries as the
+        `server` label. Falls back to the fabric address (RPC port)."""
         self._client = client
+        self._name = name
+        self._obs_client: RestClient | None = None
+
+    def _metrics_client(self) -> RestClient:
+        """Dedicated client for the federated metrics pull. The scrape
+        must NEVER ride the shared fabric client: a peer whose metrics
+        hook stalls past the adaptive metadata deadline would otherwise
+        mark the whole peer offline (storage, locks, everything) and
+        inflate the shared DynamicTimeout — an observability call
+        degrading the data plane. This clone keeps its own offline state
+        and deadline convergence, scoped to the metrics route."""
+        if self._obs_client is None:
+            c = self._client
+
+            class _SSLShim:  # re-pin the fabric CA without sharing state
+                current = staticmethod(c._get_ssl)
+
+            self._obs_client = RestClient(
+                c.host, c.port, c.secret, timeout=c.timeout,
+                scheme=c.scheme,
+                ssl_context=_SSLShim() if c.scheme == "https" else None)
+        return self._obs_client
 
     @property
     def name(self) -> str:
-        return f"{self._client.host}:{self._client.port}"
+        return self._name or f"{self._client.host}:{self._client.port}"
 
     def health(self) -> dict:
         return self._client.call_msgpack(f"/rpc/{PLANE}/v1/health")
@@ -134,6 +166,10 @@ class PeerClient:
 
     def obd_info(self) -> dict:
         return self._client.call_msgpack(f"/rpc/{PLANE}/v1/obd_info")
+
+    def metrics(self) -> bytes:
+        """The peer's node-scope Prometheus exposition (raw bytes)."""
+        return self._metrics_client().call(f"/rpc/{PLANE}/v1/metrics")
 
     def trace_stream(self, heartbeats: bool = False):
         """Iterator over the peer's trace records — the remote half of
@@ -160,6 +196,13 @@ class PeerClient:
 
     def is_online(self) -> bool:
         return self._client.is_online()
+
+    def close(self) -> None:
+        """Release the dedicated metrics client (the shared fabric client
+        is owned and closed by the cluster node)."""
+        if self._obs_client is not None:
+            self._obs_client.close()
+            self._obs_client = None
 
 
 def verify_cluster_bootstrap(peers: list[PeerClient], layout_sig: str,

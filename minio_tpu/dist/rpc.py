@@ -353,6 +353,14 @@ class RestClient:
         qs = urllib.parse.urlencode(params or {})
         url = path + ("?" + qs if qs else "")
         headers = {"Authorization": "Bearer " + sign_token(self.secret)}
+        # Distributed tracing: carry the originating request's trace id
+        # across the fabric so the peer's storage/RPC records correlate
+        # with ours (the reference forwards its amz request id on peer
+        # REST the same way). One contextvar read — nil outside a traced
+        # request.
+        tid = obs.trace_id()
+        if tid:
+            headers["x-mtpu-trace-id"] = tid
         t_conn = time.monotonic()
         try:
             conn = self._get_conn()

@@ -19,6 +19,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import BinaryIO, Callable, Iterable, Iterator
 
+from minio_tpu import obs
 from minio_tpu.dist import rpc
 from minio_tpu.utils import errors as se
 
@@ -79,13 +80,19 @@ class NodeServer:
     """Threaded HTTP server with pluggable RPC planes."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 secret: str = "", ssl_context=None):
+                 secret: str = "", ssl_context=None, node_name: str = ""):
         """ssl_context: serve the fabric over TLS (the reference serves
         every inter-node plane on its TLS listener). Accepts a plain
         server-side SSLContext, or an object with .current() (CertManager)
         — then every new connection handshakes against the freshest
-        context, i.e. rotated certs hot-reload without restart."""
+        context, i.e. rotated certs hot-reload without restart.
+
+        node_name: this node's advertised identity, stamped as `node` on
+        trace records emitted while serving an RPC (carried on the
+        context, not a process global — two in-process test nodes must
+        not share it)."""
         self.secret = secret
+        self.node_name = node_name
         self._routes: dict[tuple[str, str], Handler] = {}
         outer = self
 
@@ -197,6 +204,21 @@ class NodeServer:
         length = req.headers.get("Content-Length")
         body = _BodyReader(req.rfile, int(length) if length else 0, chunked)
 
+        # Restore the caller's trace context before dispatch — and hold
+        # it through the RESPONSE write too: streaming handlers are lazy
+        # generators whose bodies (and their storage records) execute
+        # inside the chunked-write loop. Records the handler emits
+        # correlate with the originating S3 request, stamped with THIS
+        # node's identity.
+        tokens = obs.set_trace_context(
+            trace_id=req.headers.get("x-mtpu-trace-id") or None,
+            node=self.node_name or None)
+        try:
+            self._invoke(req, fn, params, body)
+        finally:
+            obs.reset_trace_context(tokens)
+
+    def _invoke(self, req, fn, params, body):
         try:
             result = fn(params, body)
         except (se.StorageError, se.ObjectError) as e:

@@ -15,8 +15,11 @@ batch-encoded with the full blocks, and the parity is simply truncated back
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
+from minio_tpu.obs import kernel as obs_kernel
 from minio_tpu.ops import rs_xla
 from minio_tpu.utils.shardmath import ceil_div as _ceil_div
 from minio_tpu.utils import shardmath
@@ -201,12 +204,20 @@ class ErasureCodec:
                 # lengths.
                 from minio_tpu.parallel import sharded_encode_with_mxsum
 
+                t0 = time.perf_counter()
                 parity_dev, digs_dev = sharded_encode_with_mxsum(
                     mesh, batch, self.k, self.m)
+                obs_kernel.observe("encode_digests", "mesh", t0,
+                                   blocks=b, nbytes=batch.size,
+                                   out=parity_dev)
             elif dims_ok and self.m and not with_digests:
                 from minio_tpu.parallel import sharded_encode
 
+                t0 = time.perf_counter()
                 parity_dev = sharded_encode(mesh, batch, self.k, self.m)
+                obs_kernel.observe("encode", "mesh", t0,
+                                   blocks=b, nbytes=batch.size,
+                                   out=parity_dev)
             else:
                 data_dev = jnp.asarray(batch)
                 lens_dev = jnp.asarray(chunk_lens, dtype=jnp.int32)

@@ -108,7 +108,7 @@ def run_bounded(fn: Callable, deadline: float) -> bool:
         fn()
         return True
     pool = _shared_pool()
-    fut = pool.submit(fn)
+    fut = pool.submit(obs.ctx_wrap(fn))
     try:
         fut.result(timeout=deadline)
         return True
@@ -181,7 +181,7 @@ def parallel_map(fns: Sequence[Callable], max_workers: int | None = None,
                     filled[i] = True
 
         pool = _shared_pool()
-        fut = pool.submit(run_serial)
+        fut = pool.submit(obs.ctx_wrap(run_serial))
         try:
             fut.result(timeout=deadline)
         except FutureTimeout:
@@ -216,7 +216,11 @@ def parallel_map(fns: Sequence[Callable], max_workers: int | None = None,
             except Exception as e:  # noqa: BLE001 - per-drive errors are data
                 results[i] = e
 
-        futs = [pool.submit(run, i) for i in range(len(fns))]
+        # ctx_wrap per submission: pool workers don't inherit contextvars,
+        # and the per-drive closures emit trace records that must keep the
+        # caller's trace id (each wrap holds its own context copy, so the
+        # futures can run concurrently).
+        futs = [pool.submit(obs.ctx_wrap(run), i) for i in range(len(fns))]
         for i, f in enumerate(futs):
             if f.cancel():
                 run(i)
@@ -239,7 +243,8 @@ def parallel_map(fns: Sequence[Callable], max_workers: int | None = None,
             if not abandoned[i]:
                 results[i] = r
 
-    futs = [pool.submit(run_guarded, i) for i in range(len(fns))]
+    futs = [pool.submit(obs.ctx_wrap(run_guarded), i)
+            for i in range(len(fns))]
     end = time.monotonic() + deadline
     # Closures still QUEUED at the deadline get one shared grace window
     # (total 2x deadline): a saturated pool is not a hung drive, but an

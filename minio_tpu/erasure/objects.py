@@ -828,8 +828,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     # timeout): the readers are ours to close.
                     _close_readers()
 
-        prod = threading.Thread(target=producer_run, daemon=True,
-                                name="shard-readahead")
+        prod = threading.Thread(target=obs.ctx_wrap(producer_run),
+                                daemon=True, name="shard-readahead")
         prod.start()
         try:
             while True:
@@ -1025,12 +1025,13 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                                     thread_name_prefix="native-decode") as ex:
                 try:
                     fut = None
+                    decode_ctx = obs.ctx_wrap(decode_window)
                     pending = windows()
                     nxt = next(pending, None)
                     while nxt is not None:
                         pos, wend = nxt
                         if fut is None:
-                            fut = ex.submit(decode_window, pos, wend)
+                            fut = ex.submit(decode_ctx, pos, wend)
                         try:
                             # Bounded: a local pread hung inside the C
                             # call (NFS stall) must fail the GET typed
@@ -1046,7 +1047,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                             raise se.FaultyDisk(
                                 f"native decode: {e}") from e
                         nxt = next(pending, None)
-                        fut = (ex.submit(decode_window, nxt[0], nxt[1])
+                        fut = (ex.submit(decode_ctx, nxt[0], nxt[1])
                                if nxt is not None else None)
                         yield data
                 finally:
@@ -1208,7 +1209,9 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
 
             def submit(i: int) -> bool:
                 try:
-                    f = pool.submit(read_shard, i)
+                    # ctx_wrap: shard reads run in pool workers but their
+                    # storage/RPC trace records belong to this request.
+                    f = pool.submit(obs.ctx_wrap(read_shard), i)
                 except RuntimeError:
                     return False
                 futures[i] = f
@@ -1659,7 +1662,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 try:
                     if fut is not None:
                         fut.result()  # segment N-1 fully written
-                    fut = ex.submit(enc.feed, chunk, final)
+                    fut = ex.submit(obs.ctx_wrap(enc.feed), chunk, final)
                     if final:
                         fut.result()
                 except OSError as e:
@@ -1755,7 +1758,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     pass
 
         threads = [
-            threading.Thread(target=writer, args=(i, d), daemon=True)
+            threading.Thread(target=obs.ctx_wrap(writer), args=(i, d),
+                             daemon=True)
             for i, d in enumerate(shuffled)
         ]
         for t in threads:

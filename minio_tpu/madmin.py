@@ -7,8 +7,11 @@ signing, reusing the same independent signer the replication client uses.
 
 from __future__ import annotations
 
+import hashlib
+import http.client
 import json
 import urllib.parse
+from typing import Iterator
 
 from minio_tpu.replication.client import RemoteS3Client, RemoteS3Error
 
@@ -48,8 +51,69 @@ class AdminClient(RemoteS3Client):
             raise RemoteS3Error(st)
         return data.decode()
 
+    def metrics_node(self) -> str:
+        """Node-scope scrape (/minio/v2/metrics/node): this server's own
+        planes, without the cluster collectors or peer fan-out."""
+        st, _, data = self._request("GET", "/minio/v2/metrics/node")
+        if st // 100 != 2:
+            raise RemoteS3Error(st)
+        return data.decode()
+
     def top_locks(self) -> dict:
         return self._admin_json("GET", "top/locks")
+
+    def top_api(self) -> dict:
+        """Active requests with age, API and trace id (`mc admin top api`
+        role beside top_locks)."""
+        return self._admin_json("GET", "top/api")
+
+    # -- trace --
+
+    def trace(self, type: str = "", all_nodes: bool = True,
+              traceid: str = "") -> Iterator[dict]:
+        """Stream the server's trace records (`mc admin trace` role):
+        yields one dict per record until the caller stops iterating (the
+        connection closes when the generator is closed or collected).
+
+        type: keep one record type (http/storage/rpc/internal/kernel) —
+        the server-side ?type= filter PR 1 added, reachable at last.
+        all_nodes: merge every peer's stream (?all); False = this node.
+        traceid: follow a single request across layers and nodes."""
+        params: dict = {}
+        if type:
+            params["type"] = type
+        if not all_nodes:
+            params["all"] = "false"
+        if traceid:
+            params["traceid"] = traceid
+        qs = urllib.parse.urlencode(params)
+        raw_path = f"{ADMIN}/trace"
+        path = raw_path + (f"?{qs}" if qs else "")
+        hdrs = self._sign("GET", raw_path, qs, {},
+                          hashlib.sha256(b"").hexdigest())
+        cls = (http.client.HTTPSConnection if self.https
+               else http.client.HTTPConnection)
+        conn = cls(self.host, self.port, timeout=self.timeout)
+        try:
+            conn.request("GET", path, headers=hdrs)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise RemoteS3Error(
+                    resp.status, resp.read().decode(errors="replace"))
+            buf = b""
+            while True:
+                # read1: return whatever arrived — records trickle in and
+                # a full read(n) would block a live stream.
+                chunk = resp.read1(1 << 16)
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if line.strip():  # bare newlines are heartbeats
+                        yield json.loads(line)
+        finally:
+            conn.close()
 
     # -- heal --
 

@@ -19,8 +19,10 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+import time
 
 from minio_tpu.native import lib as nlib
+from minio_tpu.obs import kernel as obs_kernel
 
 # Segment / window sizing: BYTE budgets, realized as whole-block counts
 # per set geometry (multiples of block_size keep md5 chaining legal at
@@ -170,12 +172,16 @@ class PartEncoder:
                                 ctypes.c_char_p) if n else None)
         else:
             data = buf if n else None
+        t0 = time.perf_counter()
         rc = self._l["encode_part"](
             data, n,
             self.k, self.m, self.bs, self._pmat, self._algo, self._key,
             self._paths, self._append, self._do_sync, 1 if final else 0,
             self._threads, self._md5_h, ctypes.byref(self._md5_len),
             self._md5_out, self._rc)
+        # The C++ pipeline runs synchronously under a released GIL — this
+        # IS the device-complete segment latency (encode + bitrot + fan-out).
+        obs_kernel.observe("native_encode_part", "native", t0, nbytes=n)
         if rc != 0:
             raise OSError(f"native encode_part failed (rc={rc})")
         self._append = 1
@@ -254,11 +260,13 @@ def decode_range(paths: list[str], k: int, m: int, block_size: int,
     if mem:
         mem_arr = (ctypes.c_char_p * n)(
             *[mem.get(i) for i in range(n)])
+    t0 = time.perf_counter()
     rc = fns["decode_part"](
         cpaths, avail, k, m, block_size, part_size, gmat, algo, key,
         offset, length, threads or _threads(),
         ctypes.cast(out, ctypes.c_void_p) if length else None, state,
         mem_arr)
+    obs_kernel.observe("native_decode_part", "native", t0, nbytes=length)
     states = [state[i] for i in range(n)]
     if rc == -2:
         return None, states

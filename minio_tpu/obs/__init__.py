@@ -34,11 +34,17 @@ from minio_tpu.obs.histogram import (  # noqa: F401
 )
 from minio_tpu.obs.span import (  # noqa: F401
     Span,
+    ctx_wrap,
+    current_node,
     has_subscribers,
     publish,
+    reset_trace_context,
+    set_default_node,
+    set_trace_context,
     span,
     timed_op,
     trace_bus,
+    trace_id,
 )
 
 import time as _time  # noqa: E402

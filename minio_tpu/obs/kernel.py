@@ -1,0 +1,99 @@
+"""Device-plane kernel observability: per-kernel latency + batch shape.
+
+The TPU kernel plane was a black box beyond the rolling encode gauge —
+profiling-driven kernel optimization (arxiv.org/pdf/2108.02692's program
+of measure → specialize → re-measure for XOR/erasure codes) needs the
+live latency distribution of each launch class, on each backend, from
+the production serving path.
+
+Families (rendered by admin/metrics.py through the shared registry):
+
+- `minio_tpu_kernel_seconds{kernel,backend}` — wall time of one launch
+  as observed by the dispatching host thread.
+- `minio_tpu_kernel_batch_blocks{kernel,backend}` — batch rows staged
+  into the most recent launch.
+- `minio_tpu_kernel_batch_bytes{kernel,backend}` — bytes staged into
+  the most recent launch.
+- `minio_tpu_kernel_launches_total{kernel,backend}` — launch count.
+
+Timing semantics: JAX dispatch is asynchronous, so by default the
+histogram records the host-side dispatch+launch wall time — cheap
+(two clock reads + one observe, no device sync forced on the serving
+pipeline) and already enough to catch recompiles, host staging stalls
+and batch-shape regressions. Setting MTPU_KERNEL_SYNC=1 (or
+set_sync(True)) blocks on the launch's outputs before stamping, turning
+the family into true device-complete latency for profiling sessions —
+never the default, because a forced sync would serialize the
+dispatch-ahead encode pipeline it is measuring.
+
+Typed `kernel` trace records ride the bus under the same zero-overhead
+subscriber gate as every other plane.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from minio_tpu.obs.histogram import counter as _counter
+from minio_tpu.obs.histogram import gauge as _gauge
+from minio_tpu.obs.histogram import histogram as _histogram
+from minio_tpu.obs.span import has_subscribers as _has_subscribers
+from minio_tpu.obs.span import publish as _publish
+
+_KERNEL_SECONDS = _histogram(
+    "minio_tpu_kernel_seconds",
+    "Kernel launch wall time by kernel and backend (host-observed; "
+    "MTPU_KERNEL_SYNC=1 for device-complete timing)",
+    ("kernel", "backend"))
+_KERNEL_LAUNCHES = _counter(
+    "minio_tpu_kernel_launches_total",
+    "Kernel launches by kernel and backend", ("kernel", "backend"))
+_KERNEL_BLOCKS = _gauge(
+    "minio_tpu_kernel_batch_blocks",
+    "Batch rows staged into the most recent kernel launch",
+    ("kernel", "backend"))
+_KERNEL_BYTES = _gauge(
+    "minio_tpu_kernel_batch_bytes",
+    "Bytes staged into the most recent kernel launch",
+    ("kernel", "backend"))
+
+_SYNC = os.environ.get("MTPU_KERNEL_SYNC", "") in ("1", "true", "on")
+
+
+def set_sync(on: bool) -> None:
+    """Force block_until_ready before stamping (profiling sessions)."""
+    global _SYNC
+    _SYNC = bool(on)
+
+
+def sync_enabled() -> bool:
+    return _SYNC
+
+
+def observe(kernel: str, backend: str, t0: float, *,
+            blocks: int = 0, nbytes: int = 0, out=None) -> None:
+    """Record one launch: t0 from time.perf_counter() before dispatch;
+    `out` is the launch's output pytree (synced only under MTPU_KERNEL_SYNC).
+    Exceptions from a failed sync propagate — a launch that dies must not
+    be recorded as fast."""
+    if out is not None and _SYNC:
+        import jax
+
+        jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    _KERNEL_SECONDS.labels(kernel=kernel, backend=backend).observe(dt)
+    _KERNEL_LAUNCHES.labels(kernel=kernel, backend=backend).inc()
+    if blocks:
+        _KERNEL_BLOCKS.set(blocks, kernel=kernel, backend=backend)
+    if nbytes:
+        _KERNEL_BYTES.set(nbytes, kernel=kernel, backend=backend)
+    if _has_subscribers():
+        rec = {"type": "kernel", "time": time.time(),
+               "kernel": kernel, "backend": backend,
+               "durationNs": int(dt * 1e9)}
+        if blocks:
+            rec["blocks"] = blocks
+        if nbytes:
+            rec["bytes"] = nbytes
+        _publish(rec)

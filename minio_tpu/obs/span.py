@@ -11,11 +11,23 @@ a subscriber at entry. The guard is re-checked at exit only through the
 publish gate, so a subscriber attaching mid-span at worst misses that
 one record. `Span.allocated` counts constructions so tests can assert
 the hot path stays allocation-free without a subscriber.
+
+Trace context: a second contextvar pair carries the request's trace id
+(the S3 `request_id`) and the emitting node's identity. Every record
+that reaches the bus is enriched with `trace_id` + `node` at publish
+time — under the subscriber gate, so the unwatched hot path still pays
+nothing beyond the context writes at request entry. The context crosses
+thread boundaries via `ctx_wrap` (executor/pool submissions) and crosses
+the node boundary as the `x-mtpu-trace-id` RPC header (dist/rpc.py sends
+it, dist/server.py restores it before dispatch), which is what ties a
+storage record on a remote drive back to the originating S3 request
+(docs/TRACING.md).
 """
 
 from __future__ import annotations
 
 import contextvars
+import socket
 import time
 from contextlib import contextmanager
 
@@ -24,6 +36,68 @@ from minio_tpu.admin.pubsub import PubSub
 _BUS = PubSub()
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "mtpu_span", default=None)
+
+# --- trace context -----------------------------------------------------------
+
+_trace_id: contextvars.ContextVar = contextvars.ContextVar(
+    "mtpu_trace_id", default=None)
+_node_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "mtpu_node", default=None)
+# Process default node identity; cluster nodes override per dispatch
+# (two in-process test nodes share this module, so identity must be
+# carried on the context, not just a global).
+_NODE_DEFAULT = socket.gethostname()
+
+
+def set_default_node(name: str) -> None:
+    """Process-wide fallback node identity (standalone servers)."""
+    global _NODE_DEFAULT
+    if name:
+        _NODE_DEFAULT = name
+
+
+def set_trace_context(trace_id: str | None = None, node: str | None = None):
+    """Bind trace id and/or node identity to the current context. Returns
+    an opaque token for reset_trace_context (pass through unchanged)."""
+    t1 = _trace_id.set(trace_id) if trace_id is not None else None
+    t2 = _node_ctx.set(node) if node is not None else None
+    return (t1, t2)
+
+
+def reset_trace_context(tokens) -> None:
+    t1, t2 = tokens
+    if t1 is not None:
+        _trace_id.reset(t1)
+    if t2 is not None:
+        _node_ctx.reset(t2)
+
+
+def trace_id() -> str | None:
+    return _trace_id.get()
+
+
+def current_node() -> str:
+    return _node_ctx.get() or _NODE_DEFAULT
+
+
+def ctx_wrap(fn):
+    """Capture the CURRENT context (trace id, node, span parent) and
+    return a callable running fn inside a private copy — the bridge for
+    pool/thread submissions, which do not inherit contextvars. Each call
+    to ctx_wrap snapshots its own copy, so wrapped closures may run
+    concurrently."""
+    ctx = contextvars.copy_context()
+    return lambda *a, **kw: ctx.run(fn, *a, **kw)
+
+
+def _enrich(rec: dict) -> None:
+    """Stamp trace_id + node onto an outbound record. Only called under
+    the subscriber gate."""
+    tid = _trace_id.get()
+    if tid is not None and "trace_id" not in rec:
+        rec["trace_id"] = tid
+    if "node" not in rec:
+        rec["node"] = _node_ctx.get() or _NODE_DEFAULT
 
 
 def trace_bus() -> PubSub:
@@ -36,8 +110,10 @@ def has_subscribers() -> bool:
 
 
 def publish(record: dict) -> None:
-    """Publish a pre-built trace record. Callers on hot paths must gate
-    on has_subscribers() BEFORE building the record."""
+    """Publish a pre-built trace record, enriched with the current trace
+    context (`trace_id`, `node`). Callers on hot paths must gate on
+    has_subscribers() BEFORE building the record."""
+    _enrich(record)
     _BUS.publish(record)
 
 
@@ -79,6 +155,7 @@ class Span:
             if exc is not None:
                 rec["error"] = f"{type(exc).__name__}: {exc}"
             rec.update(self.attrs)
+            _enrich(rec)
             _BUS.publish(rec)
         return False
 

@@ -22,11 +22,50 @@ chunks is one launch, one compiled program.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 
+from minio_tpu.obs import kernel as obs_kernel
 from minio_tpu.ops import mxsum, rs_pallas, rs_xla
+
+_BACKEND: str | None = None
+
+
+def _backend() -> str:
+    """`minio_tpu_kernel_seconds` backend label: JAX platform + which
+    erasure kernel the dispatch selects (tpu:pallas / cpu:xla / ...).
+    Cached — resolving it touches the backend."""
+    global _BACKEND
+    if _BACKEND is None:
+        _BACKEND = (f"{jax.default_backend()}:"
+                    f"{'pallas' if rs_pallas.use_pallas() else 'xla'}")
+    return _BACKEND
+
+
+def _observed(kernel: str, out_of=None):
+    """Wrap a jitted entry point with minio_tpu_kernel_seconds
+    instrumentation. The first positional arg is the batch array (its
+    shape[0]/size label the launch); `out_of` picks the array to sync on
+    under MTPU_KERNEL_SYNC from the return value (identity by default).
+    Under an OUTER trace (a caller composed us into its own jax.jit) the
+    observation is skipped entirely — a trace-time stamp would record
+    compile cost once and then nothing, poisoning the distribution."""
+    def deco(jit_fn):
+        @functools.wraps(jit_fn)
+        def wrapper(data, *a, **kw):
+            if isinstance(data, jax.core.Tracer):
+                return jit_fn(data, *a, **kw)
+            t0 = time.perf_counter()
+            out = jit_fn(data, *a, **kw)
+            obs_kernel.observe(
+                kernel, _backend(), t0, blocks=data.shape[0],
+                nbytes=data.size,
+                out=out if out_of is None else out_of(out))
+            return out
+        return wrapper
+    return deco
 
 
 def _encode_dispatch(data: jax.Array, k: int, m: int) -> jax.Array:
@@ -53,6 +92,7 @@ def _reconstruct_dispatch(shards: jax.Array, k: int, n: int,
     return rs_xla.reconstruct(shards, k, n, survivors, targets)
 
 
+@_observed("encode")
 @functools.partial(jax.jit, static_argnames=("k", "m"))
 def encode_only(data: jax.Array, k: int, m: int) -> jax.Array:
     """Plain parity launch with the same kernel dispatch (used when the
@@ -60,6 +100,7 @@ def encode_only(data: jax.Array, k: int, m: int) -> jax.Array:
     return _encode_dispatch(data, k, m)
 
 
+@_observed("encode_digests")
 @functools.partial(jax.jit, static_argnames=("k", "m"))
 def encode_with_digests(data: jax.Array, k: int, m: int,
                         chunk_lens: jax.Array | None = None
@@ -81,6 +122,7 @@ def encode_with_digests(data: jax.Array, k: int, m: int,
     return parity, digs.reshape(b, n, mxsum.DIGEST_LEN)
 
 
+@_observed("reconstruct_digests")
 @functools.partial(jax.jit, static_argnames=("k", "n", "survivors", "targets"))
 def reconstruct_with_digests(shards: jax.Array, k: int, n: int,
                              survivors: tuple[int, ...],
@@ -102,6 +144,7 @@ def reconstruct_with_digests(shards: jax.Array, k: int, n: int,
     return rebuilt, digs.reshape(b, t, mxsum.DIGEST_LEN)
 
 
+@_observed("reconstruct")
 @functools.partial(jax.jit, static_argnames=("k", "n", "survivors", "targets"))
 def reconstruct_only(shards: jax.Array, k: int, n: int,
                      survivors: tuple[int, ...],
@@ -127,6 +170,7 @@ def _weights_matmul_dispatch(surv: jax.Array, w_t: jax.Array,
                                           out_shards)
 
 
+@_observed("reconstruct_weights", out_of=lambda out: out[0])
 @functools.partial(jax.jit, static_argnames=("out_shards", "with_digests"))
 def reconstruct_weights_digests(surv: jax.Array, w_t: jax.Array,
                                 chunk_lens: jax.Array, out_shards: int,
@@ -148,6 +192,7 @@ def reconstruct_weights_digests(surv: jax.Array, w_t: jax.Array,
     return rebuilt, digs.reshape(b, out_shards, mxsum.DIGEST_LEN)
 
 
+@_observed("verify_digests")
 @jax.jit
 def verify_digests(chunks: jax.Array, lens: jax.Array) -> jax.Array:
     """Batched read-path verify: chunks [N, S] u8 (zero-padded rows),

@@ -28,7 +28,7 @@ from minio_tpu.admin.configkv import ConfigSys
 from minio_tpu.admin.handlers import ADMIN_PREFIX, AdminAPI
 from minio_tpu.admin.metrics import (
     PROM_CONTENT_TYPE,
-    collect_metrics,
+    collect_cluster_metrics,
     collect_node_metrics,
 )
 from minio_tpu.admin.stats import HTTPStats
@@ -286,6 +286,11 @@ class S3Server:
         self.local_locker = None  # set by the cluster node when distributed
         self.notification = notification_sys  # peer fan-out (distributed)
         self.cluster_node = None
+        # Advertised node identity: the `node` field on trace records and
+        # the `server` label in the federated cluster scrape. Standalone
+        # servers fall back to the process default (hostname);
+        # attach_cluster overrides with the advertised host:port.
+        self.node_name = ""
 
         # upload_id -> user_defined: saves a quorum metadata read per
         # UploadPart/ListParts (SSE decisions are sealed at create time and
@@ -294,6 +299,16 @@ class S3Server:
 
         from minio_tpu.s3.web import WebAPI
         self.web = WebAPI(self)
+
+    def _cluster_scrape(self) -> bytes:
+        """The federated cluster scrape — ONE definition shared by
+        /minio/v2/metrics/cluster and its /minio/admin/v3/metrics mirror
+        (docs promise they match). Blocking; run in an executor."""
+        return collect_cluster_metrics(
+            self.obj, self.stats,
+            self.scanner.usage if self.scanner else None,
+            notification=self.notification,
+            local_name=self.node_name)
 
     def _cors_origin(self) -> str:
         """api.cors_allow_origin, cached against the config generation —
@@ -390,11 +405,17 @@ class S3Server:
         breadth of cmd/peer-rest-common.go:27-61)."""
         self.cluster_node = node
         self.notification = node.notification
+        self.node_name = node.node_name
+        obs.set_default_node(node.node_name)
         node.hooks.trace_bus = self.trace_bus
         node.hooks.console_bus = self.logger.console_bus
         node.hooks.server_info = self.admin._server_info
         node.hooks.obd_info = self.admin._obd_info
         node.hooks.profiler = self.profiler
+        # Metrics federation: peers scrape this node's node-scope
+        # exposition over the peer plane and merge it under a `server`
+        # label (admin/metrics.collect_cluster_metrics).
+        node.hooks.metrics = lambda: collect_node_metrics(self.stats)
 
     def configure_logging(self) -> None:
         """(Re)build log/audit targets from the config KV store — the
@@ -722,6 +743,11 @@ class S3Server:
 
     async def _entry(self, request: web.Request) -> web.StreamResponse:
         request_id = uuid.uuid4().hex[:16].upper()
+        # The request id IS the trace id: bound to the handler's context
+        # here, copied into every executor/pool hop (obs.ctx_wrap), and
+        # carried to peers as the x-mtpu-trace-id RPC header — every
+        # trace record this request causes, on any node, shares it.
+        obs.set_trace_context(request_id, node=self.node_name or None)
         path = urllib.parse.unquote(request.raw_path.split("?", 1)[0])
         if request.method == "OPTIONS" and request.headers.get("Origin") \
                 and self._cors_origin():
@@ -738,7 +764,14 @@ class S3Server:
                     "x-amz-date, x-amz-content-sha256, "
                     "x-amz-security-token, x-amz-user-agent, *",
                 "Access-Control-Max-Age": "3600"})
-        t0 = self.stats.begin()
+        t0 = self.stats.begin(
+            request_id=request_id,
+            api_hint=request.method.lower(),
+            remote=self._client_ip(request),
+            # Live API resolution: dispatch stamps request["api"] once it
+            # classifies the call; the `top api` view reads it through
+            # this getter so an in-flight request shows its real API.
+            api_get=lambda: request.get("api"))
         request["mtpu-t0"] = t0
         resp = None
         canceled = False
@@ -786,7 +819,8 @@ class S3Server:
             rx = request.content_length or 0
             tx = (resp.content_length or 0) if resp is not None else 0
             dt = time.perf_counter() - t0
-            self.stats.end(api, t0, status, rx=rx, tx=tx, canceled=canceled)
+            self.stats.end(api, t0, status, rx=rx, tx=tx, canceled=canceled,
+                           request_id=request_id)
             _REQ_LATENCY.labels(api=api).observe(dt)
             # Streamed GETs stamp first-byte at header flush; everything
             # else flushes with the handler return, so TTFB == latency.
@@ -818,7 +852,9 @@ class S3Server:
                     rec["canceled"] = True
                 if ttfb is not None:
                     rec["ttfbNs"] = int(ttfb * 1e9)
-                self.trace_bus.publish(rec)
+                # obs.publish enriches with trace_id + node (the bus is
+                # the same object; the gate above already passed).
+                obs.publish(rec)
             # Per-request AUDIT record (reference logger.AuditLog at every
             # handler, cmd/object-handlers.go:1378) — zero cost unless an
             # audit target is configured.
@@ -1019,9 +1055,10 @@ class S3Server:
                 self.admin.authorize_http(request, identity,
                                           "admin:Prometheus")
                 loop = asyncio.get_running_loop()
-                body = await loop.run_in_executor(
-                    None, collect_metrics, self.obj, self.stats,
-                    self.scanner.usage if self.scanner else None)
+                # Federated: peer node scrapes merge in under a `server`
+                # label, deadline-bounded (a hung peer becomes a scrape
+                # error, never a hung scrape).
+                body = await loop.run_in_executor(None, self._cluster_scrape)
                 return web.Response(
                     body=body, headers={"Content-Type": PROM_CONTENT_TYPE})
             if path == "/minio/v2/metrics/node":
@@ -1044,7 +1081,12 @@ class S3Server:
         loop = asyncio.get_running_loop()
 
         def run(fn, *args, **kw):
-            return loop.run_in_executor(None, lambda: fn(*args, **kw))
+            # Copy the request's context (trace id, node) into the
+            # executor thread — run_in_executor does not propagate
+            # contextvars, and the storage layer's trace records are
+            # emitted from there.
+            return loop.run_in_executor(
+                None, obs.ctx_wrap(lambda: fn(*args, **kw)))
 
         m = request.method
         hdr = {"x-amz-request-id": request_id}
@@ -2476,8 +2518,13 @@ class S3Server:
             request["mtpu-ttfb"] = time.perf_counter() - t0_req
         loop = asyncio.get_running_loop()
         it = iter(stream)
+        # One context copy for the whole drain (the awaits are
+        # sequential, so the copy is never entered concurrently): shard
+        # reads run inside next() on the executor and their storage/RPC
+        # records must keep this request's trace id.
+        drain_next = obs.ctx_wrap(lambda: next(it, None))
         while True:
-            chunk = await loop.run_in_executor(None, next, it, None)
+            chunk = await loop.run_in_executor(None, drain_next)
             if chunk is None:
                 break
             delay = self.bw_throttle.delay(bucket, len(chunk))

@@ -145,6 +145,79 @@ def test_s3_requests_emit_audit(client, bucket, tmp_path_factory):
                        data=json.dumps({"audit_file": {"path": ""}}).encode())
 
 
+def test_audit_and_trace_share_request_id(client, bucket, tmp_path_factory):
+    """Audit↔trace linkage: the audit record and every trace record of
+    one request share the identifier (requestID == trace_id == the
+    x-amz-request-id response header)."""
+    from minio_tpu import obs
+
+    audit_path = str(tmp_path_factory.mktemp("audit-link") / "audit.jsonl")
+    r = client.request(
+        "PUT", "/minio/admin/v3/config-kv",
+        data=json.dumps({"audit_file": {"path": audit_path}}).encode())
+    assert r.status_code == 200, r.text
+
+    bus = obs.trace_bus()
+    try:
+        with bus.subscribe() as sub:
+            r = client.put(f"/{bucket}/trace-linked", data=b"linked")
+            assert r.status_code == 200
+            rid = r.headers["x-amz-request-id"]
+            recs = []
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                item = sub.get(timeout=0.25)
+                if item is not None:
+                    recs.append(item)
+                if any(x.get("type") == "http"
+                       and x.get("requestId") == rid for x in recs):
+                    break
+        http_rec = next(x for x in recs if x.get("type") == "http"
+                        and x.get("requestId") == rid)
+        assert http_rec["trace_id"] == rid
+        # Storage records of the same request carry the same id.
+        mine = [x for x in recs if x.get("trace_id") == rid]
+        assert any(x["type"] == "storage" for x in mine), \
+            [x["type"] for x in recs][:10]
+
+        entries = [json.loads(x)
+                   for x in open(audit_path).read().splitlines()]
+        put = next(e for e in entries
+                   if e["api"]["name"] == "PutObject"
+                   and e["api"]["object"] == "trace-linked")
+        assert put["requestID"] == rid == http_rec["trace_id"]
+    finally:
+        client.request("PUT", "/minio/admin/v3/config-kv",
+                       data=json.dumps({"audit_file": {"path": ""}}).encode())
+
+
+def test_profiler_tpu_kind(client):
+    """The `tpu` profile kind degrades to a marker file when the device
+    trace can't run (CPU-only container) and rides the existing
+    zip_profiles fan-out either way."""
+    from minio_tpu.admin.profiling import Profiler
+
+    p = Profiler()
+    p.start(("tpu",))
+    out = p.stop_collect()
+    assert ("tpu_trace.zip" in out) or ("tpu_trace.MARKER.txt" in out), out
+    if "tpu_trace.MARKER.txt" in out:
+        assert out["tpu_trace.MARKER.txt"]  # says WHY, never empty
+
+    # Same through the admin HTTP plane (?profilerType=tpu).
+    r = client.request("POST", "/minio/admin/v3/profiling/start",
+                       query={"profilerType": "tpu"})
+    assert r.status_code == 200, r.text
+    r = client.get("/minio/admin/v3/profiling/download")
+    assert r.status_code == 200
+    import io as _io
+    import zipfile
+
+    names = zipfile.ZipFile(_io.BytesIO(r.content)).namelist()
+    assert any(n in ("local/tpu_trace.zip", "local/tpu_trace.MARKER.txt")
+               for n in names), names
+
+
 def test_admin_profiling_roundtrip(client):
     r = client.post("/minio/admin/v3/profiling/start")
     assert r.status_code == 200, r.text

@@ -376,3 +376,415 @@ def test_canceled_counter_wired(server, client):
     canceled = {lbl.get("api"): v for n, lbl, v in samples
                 if n == "minio_tpu_s3_requests_canceled_total"}
     assert canceled.get("GetObject", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# device plane: kernel histograms
+# ---------------------------------------------------------------------------
+
+
+def test_kernel_histograms_after_encode_decode(client, traffic):
+    """minio_tpu_kernel_seconds{kernel,backend} carries samples after the
+    streaming PUT + GET, whichever lane served them (device codec,
+    native C++ pipeline, or host hash) — the acceptance criterion's
+    'appears in the node scrape after an encode/decode'."""
+    for path in ("/minio/v2/metrics/cluster", "/minio/v2/metrics/node"):
+        families, samples = parse_exposition(_scrape(client, path).text)
+        _check_histogram(families, samples, "minio_tpu_kernel_seconds")
+        kernels = {(lbl["kernel"], lbl["backend"])
+                   for n, lbl, v in samples
+                   if n == "minio_tpu_kernel_seconds_bucket" and v > 0}
+        assert kernels, "no kernel launches recorded"
+        # Every series names a known lane.
+        for k, b in kernels:
+            assert b in ("native", "host", "mesh") or ":" in b, (k, b)
+        assert families.get("minio_tpu_kernel_launches_total") == "counter"
+
+
+def test_kernel_trace_records(server, client):
+    """Typed `kernel` records ride the bus under the subscriber gate."""
+    _base, srv = server
+    with srv.trace_bus.subscribe() as sub:
+        client.put("/obsbkt/kernelrec", data=b"k" * (1 << 20))
+        client.get("/obsbkt/kernelrec")
+        recs = []
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            item = sub.get(timeout=0.25)
+            if item is not None and item.get("type") == "kernel":
+                recs.append(item)
+                break
+    assert recs, "no kernel trace record"
+    assert recs[0]["durationNs"] >= 0 and recs[0]["kernel"]
+    assert _wait_no_subscribers(srv.trace_bus)
+
+
+# ---------------------------------------------------------------------------
+# trace context: trace_id + node on records, audit linkage
+# ---------------------------------------------------------------------------
+
+
+def test_records_carry_trace_id_and_node(server, client):
+    """Every record of one request — http, storage, internal — shares the
+    request id as trace_id and names the emitting node."""
+    _base, srv = server
+    with srv.trace_bus.subscribe() as sub:
+        r = client.put("/obsbkt/tctx", data=b"t" * (64 << 10))
+        rid = r.headers["x-amz-request-id"]
+        recs = []
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            item = sub.get(timeout=0.25)
+            if item is not None:
+                recs.append(item)
+            if any(x.get("type") == "http" and x.get("requestId") == rid
+                   for x in recs):
+                break
+    mine = [x for x in recs if x.get("trace_id") == rid]
+    types = {x["type"] for x in mine}
+    assert "http" in types and "storage" in types, types
+    assert all(x.get("node") for x in mine)
+    http_rec = next(x for x in mine if x["type"] == "http")
+    assert http_rec["requestId"] == rid  # audit requestID == trace_id
+    assert _wait_no_subscribers(srv.trace_bus)
+
+
+def test_inflight_gauge_and_top_api(server, client, traffic):
+    """The scrape itself is an in-flight `metrics` request; the top/api
+    admin view lists the same registry with age + trace_id."""
+    _, samples = parse_exposition(_scrape(client).text)
+    inflight = {lbl.get("api"): v for n, lbl, v in samples
+                if n == "minio_tpu_s3_requests_inflight"}
+    assert inflight.get("metrics", 0) >= 1, inflight
+    r = client.get("/minio/admin/v3/top/api")
+    assert r.status_code == 200, r.text
+    reqs = r.json()["requests"]
+    assert reqs, "top api view empty during its own request"
+    own = [x for x in reqs if x["api"].startswith("admin.top")]
+    assert own and own[0]["trace_id"] and own[0]["ageMs"] >= 0
+
+
+def test_metrics_docs_drift(client, traffic):
+    """Docs-drift gate: every family the exporters emit must be listed in
+    docs/METRICS.md (the doc drifted silently once in PR 3)."""
+    import os
+
+    docs_path = os.path.join(os.path.dirname(__file__), "..",
+                             "docs", "METRICS.md")
+    with open(docs_path, encoding="utf-8") as f:
+        docs = f.read()
+    for path in ("/minio/v2/metrics/cluster", "/minio/v2/metrics/node"):
+        families, _ = parse_exposition(_scrape(client, path).text)
+        missing = sorted(f for f in families if f not in docs)
+        assert not missing, (
+            f"metric families missing from docs/METRICS.md: {missing}")
+
+
+def test_madmin_trace_stream_and_metrics_node(server, client):
+    """The madmin client can finally reach the server-side filters: a
+    typed streaming trace() and the node-scope scrape."""
+    base, srv = server
+    from minio_tpu.madmin import AdminClient
+
+    adm = AdminClient(base, ACCESS, SECRET)
+    text = adm.metrics_node()
+    assert "minio_tpu_process_uptime_seconds" in text
+    assert "minio_tpu_cluster_disk_online_total" not in text
+
+    got: list = []
+    done = threading.Event()
+
+    def watch():
+        gen = adm.trace(type="http", all_nodes=False)
+        try:
+            for rec in gen:
+                got.append(rec)
+                if len(got) >= 2:
+                    return
+        finally:
+            gen.close()
+            done.set()
+
+    t = threading.Thread(target=watch, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while not srv.trace_bus.has_subscribers and time.time() < deadline:
+        time.sleep(0.05)
+    client.put("/obsbkt/madmin-traced", data=b"m" * 128)
+    client.get("/obsbkt/madmin-traced")
+    assert done.wait(10), "madmin trace stream yielded nothing"
+    assert got and all(r["type"] == "http" for r in got)
+    assert all(r.get("trace_id") and r.get("node") for r in got)
+    top = adm.top_api()
+    assert "requests" in top
+    assert _wait_no_subscribers(srv.trace_bus)
+
+
+# ---------------------------------------------------------------------------
+# 2-node cluster: cross-node tracing + metrics federation
+# ---------------------------------------------------------------------------
+
+CL_SECRET = "obs-cluster-secret"
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    """Two symmetric ClusterNodes (one 8-drive set, 4 per node) with a
+    full S3 front door attached to node 1 — the fixture of the
+    acceptance criteria: a GetObject on node 1 reads node 2's drives
+    over the storage plane."""
+    import asyncio
+
+    from minio_tpu.admin.metrics import collect_node_metrics
+    from minio_tpu.admin.stats import HTTPStats
+    from minio_tpu.dist.cluster import ClusterNode
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.s3 import sigv4
+
+    tmp = tmp_path_factory.mktemp("obs-cluster")
+    s3p1, s3p2 = 19701, 19702          # advertised only
+    rpc1, rpc2 = _free_port(), _free_port()
+    rpc_map = {s3p1: rpc1, s3p2: rpc2}
+    args = [[f"http://127.0.0.1:{s3p1}/n1/disk{{1...4}}",
+             f"http://127.0.0.1:{s3p2}/n2/disk{{1...4}}"]]
+    mk_root = lambda p: str(tmp / p.strip("/").replace("/", "_"))  # noqa: E731
+
+    nodes = []
+    for port, rpc in ((s3p1, rpc1), (s3p2, rpc2)):
+        nodes.append(ClusterNode(
+            args, host="127.0.0.1", port=port, secret=CL_SECRET,
+            root_dir_map=mk_root, local_names={"127.0.0.1"},
+            rpc_port=rpc, rpc_port_of=lambda h, p: rpc_map[p], parity=2))
+    n1, n2 = nodes
+    n1.wait_for_peers(timeout=10)
+    ol1 = n1.build_object_layer()
+    n2.build_object_layer()
+
+    # Node 2 runs no S3 front door; wire its peer metrics hook the way
+    # attach_cluster would.
+    stats2 = HTTPStats()
+    n2.hooks.metrics = lambda: collect_node_metrics(stats2)
+
+    srv = S3Server(ol1, sigv4.Credentials(ACCESS, SECRET),
+                   notification_sys=n1.notification)
+    srv.attach_cluster(n1)
+    port = _free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(srv.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(30)
+    cl = SigV4Client(f"http://127.0.0.1:{port}", ACCESS, SECRET)
+    assert cl.put("/clbkt").status_code == 200
+    assert cl.put("/clbkt/obj",
+                  data=b"c" * ((1 << 20) + 123)).status_code == 200
+    yield {"client": cl, "srv": srv, "n1": n1, "n2": n2,
+           "base": f"http://127.0.0.1:{port}"}
+    loop.call_soon_threadsafe(loop.stop)
+    for n in nodes:
+        try:
+            n.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def test_cluster_one_get_traces_both_nodes(cluster):
+    """Acceptance: one GetObject produces trace records on both nodes
+    sharing a single trace_id."""
+    srv, cl = cluster["srv"], cluster["client"]
+    n1, n2 = cluster["n1"], cluster["n2"]
+    with srv.trace_bus.subscribe() as sub:
+        r = cl.get("/clbkt/obj")
+        assert r.status_code == 200
+        rid = r.headers["x-amz-request-id"]
+        recs = []
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            item = sub.get(timeout=0.25)
+            if item is not None:
+                recs.append(item)
+            nodes_seen = {x.get("node") for x in recs
+                          if x.get("trace_id") == rid}
+            if {n1.node_name, n2.node_name} <= nodes_seen:
+                break
+    mine = [x for x in recs if x.get("trace_id") == rid]
+    nodes_seen = {x["node"] for x in mine}
+    assert {n1.node_name, n2.node_name} <= nodes_seen, (
+        f"trace did not span both nodes: {nodes_seen}")
+    # Remote shard reads show as storage records emitted on node 2.
+    n2_types = {x["type"] for x in mine if x["node"] == n2.node_name}
+    assert "storage" in n2_types, n2_types
+    assert _wait_no_subscribers(srv.trace_bus)
+
+
+def test_cluster_admin_stream_merged_and_traceid_filter(cluster):
+    """The merged ?all stream carries a request's records, and ?traceid=
+    keeps only that request."""
+    srv, cl, base = cluster["srv"], cluster["client"], cluster["base"]
+
+    # -- merged ?all stream sees a live request's records --
+    got: list = []
+
+    def consume(params, want, timeout=10):
+        headers = SigV4Client(base, ACCESS, SECRET)._sign(
+            "GET", "/minio/admin/v3/trace", params, {}, b"")
+        try:
+            with requests.get(f"{base}/minio/admin/v3/trace",
+                              params=params, headers=headers,
+                              stream=True, timeout=timeout) as r:
+                for line in r.iter_lines():
+                    if line:
+                        got.append(json.loads(line))
+                        if len(got) >= want:
+                            return
+        except requests.RequestException:
+            pass
+
+    t = threading.Thread(target=consume, args=({"all": "true"}, 3),
+                         daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while not srv.trace_bus.has_subscribers and time.time() < deadline:
+        time.sleep(0.05)
+    r = cl.get("/clbkt/obj")
+    rid = r.headers["x-amz-request-id"]
+    t.join(timeout=10)
+    assert any(x.get("trace_id") == rid for x in got), got[:3]
+
+    # -- ?traceid= admits only the matching request --
+    got = []
+    t = threading.Thread(
+        target=consume, args=({"traceid": "FILTER-HIT"}, 1), daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while not srv.trace_bus.has_subscribers and time.time() < deadline:
+        time.sleep(0.05)
+    srv.trace_bus.publish({"type": "internal", "name": "miss",
+                           "trace_id": "FILTER-MISS"})
+    srv.trace_bus.publish({"type": "internal", "name": "hit",
+                           "trace_id": "FILTER-HIT"})
+    t.join(timeout=10)
+    assert got and got[0]["trace_id"] == "FILTER-HIT"
+    assert all(x["trace_id"] == "FILTER-HIT" for x in got)
+    assert _wait_no_subscribers(srv.trace_bus)
+
+
+def test_cluster_metrics_federation_both_servers(cluster):
+    """Acceptance: /minio/v2/metrics/cluster returns samples labeled
+    with both `server` values."""
+    cl = cluster["client"]
+    n1, n2 = cluster["n1"], cluster["n2"]
+    r = _scrape(cl)
+    families, samples = parse_exposition(r.text)
+    servers = {lbl.get("server") for _n, lbl, _v in samples}
+    assert n1.node_name in servers and n2.node_name in servers, servers
+    # Histogram invariants survive the merge.
+    _check_histogram(families, samples, "minio_tpu_drive_latency_seconds")
+    # The node endpoint stays single-node (no server label).
+    _, nsamples = parse_exposition(_scrape(cl, "/minio/v2/metrics/node").text)
+    assert not {lbl.get("server") for _n, lbl, _v in nsamples} - {None}
+
+
+def test_cluster_scrape_bounded_with_hung_peer(cluster):
+    """Acceptance: the cluster scrape still returns within the deadline
+    when one peer's metrics route hangs (naughty-style HANG: the hook
+    blocks until released)."""
+    cl, n2 = cluster["client"], cluster["n2"]
+    from tests.naughty import HANG  # the injection contract  # noqa: F401
+
+    release = threading.Event()
+    old = n2.hooks.metrics
+
+    def hang() -> bytes:
+        release.wait(30)  # bounded so the leaked handler always exits
+        return b""
+
+    n2.hooks.metrics = hang
+    try:
+        t0 = time.time()
+        r = _scrape(cl)
+        elapsed = time.time() - t0
+        assert elapsed < 8, f"scrape stalled {elapsed:.1f}s on hung peer"
+        families, samples = parse_exposition(r.text)
+        errs = [v for n, _l, v in samples
+                if n == "minio_tpu_peer_scrape_errors_total"]
+        assert errs and max(errs) >= 1, "hung peer not counted"
+        # The healthy node's samples still render.
+        servers = {lbl.get("server") for _n, lbl, _v in samples}
+        assert cluster["n1"].node_name in servers
+    finally:
+        release.set()
+        n2.hooks.metrics = old
+
+
+def test_cluster_trace_stream_survives_peer_death(cluster):
+    """The merged stream keeps flowing when one peer dies mid-stream.
+    Runs LAST in this module: it takes node 2's RPC fabric down."""
+    srv, base = cluster["srv"], cluster["base"]
+    n2 = cluster["n2"]
+    from minio_tpu.admin.pubsub import PubSub
+
+    peer_bus = PubSub()
+    n2.hooks.trace_bus = peer_bus
+
+    got: list = []
+    stop = threading.Event()
+
+    def consume():
+        params = {"all": "true"}
+        headers = SigV4Client(base, ACCESS, SECRET)._sign(
+            "GET", "/minio/admin/v3/trace", params, {}, b"")
+        try:
+            with requests.get(f"{base}/minio/admin/v3/trace", params=params,
+                              headers=headers, stream=True,
+                              timeout=20) as r:
+                for line in r.iter_lines():
+                    if stop.is_set():
+                        return
+                    if line:
+                        got.append(json.loads(line))
+        except requests.RequestException:
+            pass
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    # Both the local subscription and the peer puller must be live.
+    while (not srv.trace_bus.has_subscribers
+           or not peer_bus.has_subscribers) and time.time() < deadline:
+        time.sleep(0.05)
+    assert peer_bus.has_subscribers, "peer puller never subscribed"
+
+    peer_bus.publish({"type": "internal", "name": "from-n2", "node": "n2"})
+    deadline = time.time() + 5
+    while not any(x.get("name") == "from-n2" for x in got) \
+            and time.time() < deadline:
+        time.sleep(0.05)
+    assert any(x.get("name") == "from-n2" for x in got), "peer record lost"
+
+    # Kill node 2's fabric mid-stream; local records must keep flowing.
+    n2.node_server.close()
+    time.sleep(0.2)
+    srv.trace_bus.publish({"type": "internal", "name": "local-after-death"})
+    deadline = time.time() + 5
+    while not any(x.get("name") == "local-after-death" for x in got) \
+            and time.time() < deadline:
+        srv.trace_bus.publish({"type": "internal",
+                               "name": "local-after-death"})
+        time.sleep(0.2)
+    assert any(x.get("name") == "local-after-death" for x in got), \
+        "merged stream died with the peer"
+    stop.set()
