@@ -225,6 +225,32 @@ class AdminAPI:
             with self.s._bw_mu:
                 return _json({"buckets": dict(self.s.bandwidth),
                               "limits": limits})
+        # -- fault injection (chaos engineering; doubly guarded) --
+        if op == "faults":
+            # The faultplane can sever a production cluster: beyond the
+            # admin:* policy check it requires the operator to have
+            # opted the PROCESS in via MTPU_FAULT_INJECTION=1.
+            self._authorize(identity, "admin:*")
+            import os as _os
+
+            from minio_tpu.dist import faultplane
+
+            if _os.environ.get("MTPU_FAULT_INJECTION", "") != "1":
+                raise S3Error(
+                    "NotImplemented",
+                    "fault injection disabled (set MTPU_FAULT_INJECTION=1)")
+            if m == "GET":
+                return _json(faultplane.describe())
+            if m == "POST":
+                try:
+                    doc = json.loads(await request.read())
+                    if not isinstance(doc, dict):
+                        raise ValueError("fault document must be a "
+                                         "JSON object")
+                    return _json(faultplane.apply_admin(doc))
+                except (ValueError, KeyError, TypeError) as e:
+                    raise S3Error("InvalidArgument", str(e)) from None
+
         # -- service control (cmd/admin-handlers ServiceActionHandler) --
         if op == "service" and m == "POST":
             action = q.get("action", "")
@@ -342,6 +368,15 @@ class AdminAPI:
             health = layer.health()
         except Exception:  # noqa: BLE001
             pass
+        # Peer-resilience plane surface (mirror of per-drive healthState):
+        # one entry per peer with breaker state + retry/shed counters.
+        fabric = []
+        node = getattr(self.s, "cluster_node", None)
+        if node is not None:
+            try:
+                fabric = node.peer_fabric_info()
+            except Exception:  # noqa: BLE001 - info surface only
+                pass
         return {
             "mode": "online" if health.get("healthy") else "degraded",
             "version": VERSION,
@@ -353,6 +388,7 @@ class AdminAPI:
                 "backendType": "Erasure",
                 "pools": health.get("pools", health.get("sets", [])),
             },
+            "peerFabric": fabric,
             "stats": self.s.stats.snapshot(),
         }
 

@@ -135,10 +135,23 @@ class ClusterNode:
     def _client_for(self, node: tuple[str, int]) -> RestClient:
         if node not in self._clients:
             host, port = node
-            self._clients[node] = RestClient(
+            # name: advertised S3 identity, so metric `peer` labels and
+            # fault-injection partitions are declared in TOPOLOGY terms
+            # (not transport ports) — asymmetric partitions then work
+            # with many in-process nodes.
+            c = RestClient(
                 host, self._rpc_port_of(host, port), self.secret,
-                scheme=self.rpc_scheme, ssl_context=self._client_ssl)
+                scheme=self.rpc_scheme, ssl_context=self._client_ssl,
+                name=f"{host}:{port}")
+            c.fault_src = self.node_name
+            self._clients[node] = c
         return self._clients[node]
+
+    def peer_fabric_info(self) -> list[dict]:
+        """Per-peer circuit breaker state + retry/shed counters — the
+        admin server-info surface of the peer-resilience plane (mirror of
+        the per-drive healthState entries)."""
+        return [self._client_for(n).breaker_info() for n in self.peer_nodes]
 
     # -- boot --
 

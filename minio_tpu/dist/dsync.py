@@ -14,6 +14,7 @@ peers via RemoteLocker).
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -22,14 +23,23 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Protocol
 
+from minio_tpu import obs
 from minio_tpu.dist.rpc import RestClient, pack, unpack
 
 # Unrefreshed locks are presumed owned by a dead process and reaped
 # (the reference's lock maintenance loop, cmd/lock-rest-server.go:330).
 LOCK_STALE_AFTER = 60.0
-REFRESH_INTERVAL = 10.0
+REFRESH_INTERVAL = float(os.environ.get("MTPU_DSYNC_REFRESH_INTERVAL",
+                                        "10.0"))
 RETRY_MIN = 0.01
 RETRY_MAX = 0.25
+
+# A held lock dropping its refresh quorum is the partition signal the
+# degraded-write path keys on (commits check `held` and roll back) —
+# count it so an operator can see silent lock losses.
+_REFRESH_LOST = obs.counter(
+    "minio_tpu_dsync_refresh_lost_total",
+    "Held dsync locks dropped after losing their refresh quorum")
 
 
 @dataclass
@@ -226,14 +236,21 @@ class DRWMutex:
     """Quorum read/write lock over n lockers (pkg/dsync/drwmutex.go:56)."""
 
     def __init__(self, resources: list[str], lockers: list,
-                 owner: str = "", refresh_interval: float = REFRESH_INTERVAL):
+                 owner: str = "", refresh_interval: float | None = None,
+                 on_lost=None):
+        """on_lost: called (once) from the refresh thread if the lock
+        loses its refresh quorum while held — the abort signal degraded
+        writes key on."""
         self.resources = resources
         self.lockers = lockers
         self.owner = owner or str(uuid.uuid4())
-        self.refresh_interval = refresh_interval
+        self.refresh_interval = (REFRESH_INTERVAL if refresh_interval is None
+                                 else refresh_interval)
+        self.on_lost = on_lost
         self._uid = ""
         self._readonly = False
         self._held = False
+        self._released = False
         self._stop_refresh = threading.Event()
         self._refresh_thread: threading.Thread | None = None
         self._pool = ThreadPoolExecutor(
@@ -247,8 +264,15 @@ class DRWMutex:
         return max(q, 1)
 
     def _broadcast(self, method: str, args: LockArgs) -> int:
-        futs = [self._pool.submit(getattr(lk, method), args)
-                for lk in self.lockers]
+        futs = []
+        for lk in self.lockers:
+            try:
+                futs.append(self._pool.submit(getattr(lk, method), args))
+            except RuntimeError:
+                # unlock() shut the pool down while the refresh thread
+                # was entering a broadcast — count the locker as
+                # unreachable instead of crashing the daemon thread.
+                pass
         granted = 0
         for f in futs:
             try:
@@ -290,13 +314,21 @@ class DRWMutex:
         return self._acquire_blocking(readonly=True, timeout=timeout)
 
     def unlock(self) -> None:
-        if not self._held:
+        # Keyed on _released, NOT _held: a refresh-quorum loss flips
+        # _held to abort commits, but the minority lockers that still
+        # hold the grant must be released (best effort — partitioned
+        # ones fail fast) and the executor shut down, or every lease
+        # abort would leak worker threads and block new writers for
+        # LOCK_STALE_AFTER.
+        if self._released:
             return
+        self._released = True
         self._held = False
         self._stop_refresh.set()
-        args = LockArgs(uid=self._uid, resources=self.resources,
-                        owner=self.owner, readonly=self._readonly)
-        self._broadcast("runlock" if self._readonly else "unlock", args)
+        if self._uid:
+            args = LockArgs(uid=self._uid, resources=self.resources,
+                            owner=self.owner, readonly=self._readonly)
+            self._broadcast("runlock" if self._readonly else "unlock", args)
         self._pool.shutdown(wait=False)
 
     # -- keepalive (drwmutex.go:214,245) --
@@ -309,9 +341,21 @@ class DRWMutex:
                             owner=self.owner, readonly=self._readonly)
             while not self._stop_refresh.wait(self.refresh_interval):
                 refreshed = self._broadcast("refresh", args)
+                if self._stop_refresh.is_set():
+                    # unlock() raced this tick — a released lock cannot
+                    # lose its quorum (no spurious on_lost/metric).
+                    return
                 if refreshed < self._quorum(self._readonly):
-                    # Lost the quorum — the lock is no longer safe to hold.
+                    # Lost the quorum — the lock is no longer safe to
+                    # hold. Commits in flight observe `held` flipping and
+                    # roll back instead of completing unprotected.
                     self._held = False
+                    _REFRESH_LOST.labels().inc()
+                    if self.on_lost is not None:
+                        try:
+                            self.on_lost()
+                        except Exception:  # noqa: BLE001 - observer only
+                            pass
                     return
 
         self._refresh_thread = threading.Thread(

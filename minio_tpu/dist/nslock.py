@@ -59,6 +59,27 @@ class _RWLock:
             self._cond.notify_all()
 
 
+class LockLease:
+    """What `lock()` yields: a handle whose `held` goes False if the
+    distributed lock loses its refresh quorum mid-critical-section (a
+    partition isolating this node from the locker majority). Commit
+    paths consult it at the point of no return and roll back instead of
+    completing an unprotected write. Local locks can't be lost: `held`
+    is constant True."""
+
+    __slots__ = ("_mx",)
+
+    def __init__(self, mx=None):
+        self._mx = mx
+
+    @property
+    def held(self) -> bool:
+        return True if self._mx is None else self._mx.held
+
+
+_LOCAL_LEASE = LockLease()
+
+
 class NamespaceLockMap:
     """Lock table keyed by "bucket/object" pathnames.
 
@@ -67,10 +88,13 @@ class NamespaceLockMap:
     (the set's lockers, cmd/erasure-sets.go NewNSLock)."""
 
     def __init__(self, distributed: bool = False, lockers: list | None = None,
-                 owner: str = ""):
+                 owner: str = "", refresh_interval: float | None = None):
         self.distributed = distributed
         self.lockers = lockers or []
         self.owner = owner
+        # None -> dsync default (MTPU_DSYNC_REFRESH_INTERVAL); tests pin
+        # it low so partition-during-commit aborts are provable fast.
+        self.refresh_interval = refresh_interval
         # resource -> [lock, refcount]; the refcount is mutated only under
         # _mu (the reference nsLockMap keeps `ref` under lockMapMutex,
         # cmd/namespace-lock.go:141) so an entry can never be GC'd between
@@ -109,18 +133,20 @@ class NamespaceLockMap:
 
     @contextlib.contextmanager
     def lock(self, bucket: str, *objects: str, timeout: float = 30.0,
-             readonly: bool = False) -> Iterator[None]:
+             readonly: bool = False) -> Iterator[LockLease]:
         resources = sorted(f"{bucket}/{o}" if o else bucket
                            for o in (objects or ("",)))
         if self.distributed:
-            mx = DRWMutex(resources, self.lockers, owner=self.owner)
+            mx = DRWMutex(resources, self.lockers, owner=self.owner,
+                          refresh_interval=self.refresh_interval)
             got = mx.get_rlock(timeout) if readonly else mx.get_lock(timeout)
             if not got:
+                mx.unlock()   # release the broadcast pool's workers
                 raise se.OperationTimedOut(
                     bucket, ",".join(objects),
                     f"lock timeout on {resources}")
             try:
-                yield
+                yield LockLease(mx)
             finally:
                 mx.unlock()
             return
@@ -138,7 +164,7 @@ class NamespaceLockMap:
                     raise se.OperationTimedOut(
                         bucket, ",".join(objects), f"lock timeout on {res}")
                 acquired.append(lk)
-            yield
+            yield _LOCAL_LEASE
         finally:
             for lk in reversed(acquired):
                 if readonly:

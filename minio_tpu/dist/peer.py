@@ -138,10 +138,18 @@ class PeerClient:
             class _SSLShim:  # re-pin the fabric CA without sharing state
                 current = staticmethod(c._get_ssl)
 
+            # name= pins the same advertised identity as the fabric
+            # client, so the `peer` metric labels and fault-injection
+            # destination agree across both clients: a partition
+            # covering the peer blacks out the metrics pull too (its
+            # breaker stays independent by design), and dashboards see
+            # one peer, not a transport-address phantom.
             self._obs_client = RestClient(
                 c.host, c.port, c.secret, timeout=c.timeout,
                 scheme=c.scheme,
-                ssl_context=_SSLShim() if c.scheme == "https" else None)
+                ssl_context=_SSLShim() if c.scheme == "https" else None,
+                name=c.fault_dst, lane="metrics")
+            self._obs_client.fault_src = c.fault_src
         return self._obs_client
 
     @property

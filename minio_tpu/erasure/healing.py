@@ -28,6 +28,7 @@ import threading
 import uuid
 from dataclasses import dataclass, field
 
+from minio_tpu import obs
 from minio_tpu.erasure.codec import ErasureCodec
 from minio_tpu.erasure.metadata import parallel_map, shuffle_by_distribution
 from minio_tpu.ops import bitrot
@@ -618,10 +619,28 @@ class HealingMixin:
         )
 
 
+MRF_RETRY_INTERVAL = float(os.environ.get("MTPU_MRF_RETRY_INTERVAL", "1.0"))
+MRF_RETRY_MAX = int(os.environ.get("MTPU_MRF_RETRY_MAX", "600"))
+MRF_RETRY_CAP = float(os.environ.get("MTPU_MRF_RETRY_CAP", "60.0"))
+
+_MRF_REQUEUES = obs.counter(
+    "minio_tpu_mrf_requeues_total",
+    "MRF heals requeued because target drives were still offline")
+
+
 class MRFHealer:
     """Most-recently-failed heal queue (reference mrfOpCh, cmd/erasure.go:41-75):
     partial writes and corrupt reads enqueue here; a background worker retries
-    the heal out of band."""
+    the heal out of band.
+
+    Partition-aware: a heal attempted while the missing shards' drives are
+    still unreachable (peer breaker OPEN / mid-partition) classifies them
+    OFFLINE and rebuilds nothing — such entries are REQUEUED with an
+    exponentially backed-off delay (base `MTPU_MRF_RETRY_INTERVAL`, cap
+    `MTPU_MRF_RETRY_CAP`, at most `MTPU_MRF_RETRY_MAX` attempts) instead
+    of retired, so a degraded write's missed shards reliably drain once
+    the partition heals while a permanently dead drive cannot keep the
+    drain thread busy-spinning. Unhealable states (object deleted) drop."""
 
     def __init__(self, er, maxsize: int = 10000):
         self.er = er
@@ -630,6 +649,15 @@ class MRFHealer:
         # (bucket, obj, version_id) -> deep flag; a deep request upgrades
         # a pending shallow one in place (one heal pass, not two).
         self._pending: dict[tuple[str, str, str], bool] = {}
+        self._attempts: dict[tuple[str, str, str], int] = {}
+        # Key currently being healed. Kept OUT of _pending so an
+        # add_partial racing the in-flight heal re-queues (the running
+        # heal read its metadata before the new damage) — but still
+        # counted by wait_idle.
+        self._inflight: set[tuple[str, str, str]] = set()
+        # Deferred re-heals: [(due_monotonic, key, deep)] — fed back to
+        # _pending/queue at their due time; wait_idle blocks on them.
+        self._retry: list[tuple[float, tuple[str, str, str], bool]] = []
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._drain, daemon=True)
         self._thread.start()
@@ -653,32 +681,104 @@ class MRFHealer:
             with self._seen_lock:
                 self._pending.pop(key, None)
 
+    def _pump_due_retries(self) -> None:
+        import time as _time
+
+        now = _time.monotonic()
+        with self._seen_lock:
+            due = [(k, d) for t, k, d in self._retry if t <= now]
+            self._retry = [e for e in self._retry if e[0] > now]
+            # Re-enter through _pending so a racing add_partial
+            # coalesces exactly as for a first-time enqueue; a retry
+            # carrying deep=True UPGRADES an already-pending shallow
+            # entry (the observed corruption must not be forgotten).
+            to_queue = []
+            for k, d in due:
+                if k in self._pending:
+                    if d:
+                        self._pending[k] = True
+                else:
+                    self._pending[k] = d
+                    to_queue.append((k, d))
+            due = to_queue
+        for key, _deep in due:
+            try:
+                self.q.put_nowait(key)
+            except queue.Full:
+                with self._seen_lock:
+                    self._pending.pop(key, None)
+                    self._attempts.pop(key, None)
+
     def _drain(self) -> None:
+        import time as _time
+
         while not self._stop.is_set():
+            self._pump_due_retries()
             try:
                 key = self.q.get(timeout=0.2)
             except queue.Empty:
                 continue
             bucket, obj, version_id = key
-            # Read the (possibly upgraded) deep flag and retire the entry
-            # together, so an upgrade arriving after this point re-queues.
+            # Pop-before-heal (so damage arriving DURING the heal
+            # re-queues — this attempt read its metadata first), but
+            # track the in-flight key so wait_idle keeps blocking.
             with self._seen_lock:
                 deep = self._pending.pop(key, False)
+                self._inflight.add(key)
+            requeue = False
             try:
-                self.er.heal_object(bucket, obj, version_id, scan_deep=deep)
-            except Exception:  # noqa: BLE001 - best-effort background heal
-                pass
-            finally:
-                self.q.task_done()
+                res = self.er.heal_object(bucket, obj, version_id,
+                                          scan_deep=deep)
+                # Drives unreachable during the attempt (mid-partition /
+                # OPEN peer breaker) classify OFFLINE and got nothing
+                # rebuilt: the entry is NOT drained yet.
+                requeue = any(s.state == DRIVE_STATE_OFFLINE
+                              for s in (res.after or res.before or []))
+            except (se.ObjectNotFound, se.FileNotFound,
+                    se.FileVersionNotFound):
+                pass  # deleted since: nothing left to heal
+            except Exception:  # noqa: BLE001 - transient (quorum/transport)
+                requeue = True
+            with self._seen_lock:
+                self._inflight.discard(key)
+                self._attempts[key] = attempts = self._attempts.get(key, 0) + 1
+                if (requeue and attempts < MRF_RETRY_MAX
+                        and key not in self._pending):
+                    # (a concurrent add_partial already re-queued it —
+                    # that entry covers this retry.) Jittered exponential
+                    # backoff: a partition drains at near-base cadence
+                    # (few attempts), while a permanently dead drive —
+                    # which keeps every heal of its set partial — settles
+                    # to one cheap attempt per MRF_RETRY_CAP instead of
+                    # hammering a full heal pass per object per interval.
+                    delay = min(MRF_RETRY_INTERVAL * (2 ** (attempts - 1)),
+                                max(MRF_RETRY_INTERVAL, MRF_RETRY_CAP))
+                    self._retry.append(
+                        (_time.monotonic() + delay, key, deep))
+                    _MRF_REQUEUES.labels().inc()
+                elif requeue and key in self._pending:
+                    # A concurrent add_partial re-queued the key — that
+                    # entry covers this retry, but it must not downgrade
+                    # an observed-bitrot deep heal to shallow.
+                    self._pending[key] = self._pending[key] or deep
+                elif key not in self._pending:
+                    # Episode over — drained, unhealable, or budget
+                    # exhausted. Reset the counter either way so a
+                    # FUTURE degraded write to this object gets a fresh
+                    # retry budget (and the dict cannot grow unbounded).
+                    self._attempts.pop(key, None)
+            self.q.task_done()
 
     def wait_idle(self, timeout: float = 10.0) -> bool:
-        """Testing hook: block until the queue drains."""
+        """Testing hook: block until the queue drains (in-flight and
+        requeued entries count until their heal actually completes)."""
         import time as _time
 
         deadline = _time.monotonic() + timeout
         while _time.monotonic() < deadline:
             with self._seen_lock:
-                if not self._pending and self.q.empty():
+                if (not self._pending and not self._retry
+                        and not self._inflight and self.q.empty()):
                     return True
             _time.sleep(0.01)
         return False

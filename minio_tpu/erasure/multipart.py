@@ -329,20 +329,19 @@ class MultipartMixin:
         # undo must never have its acknowledged version destroyed
         # (reference takes the dist lock around CompleteMultipartUpload's
         # whole rename commit).
-        with self.nslock.lock(bucket, obj):
+        with self.nslock.lock(bucket, obj) as lease:
             outcomes = parallel_map(
                 [lambda i=i, d=d: commit(i, d) for i, d in enumerate(shuffled)]
             )
-            try:
-                reduce_write_quorum(outcomes, write_quorum, bucket, obj)
-            except Exception:
-                # Quorum failed: move parts BACK into the session so the
-                # client can retry Complete — uploaded part data must
-                # never be destroyed by a transient failure. Drives whose
-                # commit SUCCEEDED hold the parts inside the new object
-                # data dir; pull them back out, then undo the rename
-                # (dropping the new journal entry and restoring whatever
-                # it displaced), so listings never show a below-quorum
+
+            def restore_session():
+                # Move parts BACK into the session so the client can
+                # retry Complete — uploaded part data must never be
+                # destroyed by a transient failure. Drives whose commit
+                # SUCCEEDED hold the parts inside the new object data
+                # dir; pull them back out, then undo the rename (dropping
+                # the new journal entry and restoring whatever it
+                # displaced), so listings never show a below-quorum
                 # object.
                 undo_fi = fi.clone()
 
@@ -370,7 +369,23 @@ class MultipartMixin:
 
                 parallel_map([lambda i=i, d=d: restore(i, d)
                               for i, d in enumerate(shuffled)])
+
+            try:
+                reduce_write_quorum(outcomes, write_quorum, bucket, obj)
+            except Exception:
+                restore_session()
                 raise
+            if not lease.held:
+                # The dsync lock lost its refresh quorum during the
+                # commit fan-out: finishing would complete an unprotected
+                # rename a racing writer may have crossed. Put the
+                # session back (the client retries Complete) and fail
+                # typed — same contract as the put_object commit.
+                restore_session()
+                raise se.OperationTimedOut(
+                    bucket, obj,
+                    "dsync lock quorum lost during commit; multipart "
+                    "complete rolled back")
 
         # Success: discard displaced state; reclaim tmp leftovers on
         # drives whose commit failed midway (exceptions are captured as

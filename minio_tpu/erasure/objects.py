@@ -418,7 +418,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             # the deadline-bounded parallel path (a hang must not wedge
             # the serial loop).
             serial_writes = self.fast_local_reads and self._drives_all_online()
-            with self.nslock.lock(bucket, obj):
+            with self.nslock.lock(bucket, obj) as lease:
                 self._check_put_precondition(bucket, obj, opts)
                 with obs.span("commit", bucket=bucket, object=obj,
                               inline=True):
@@ -432,9 +432,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                         serial=serial_writes,
                         deadline=self._meta_deadline(),
                     )
-                try:
-                    reduce_write_quorum(outcomes, write_quorum, bucket, obj)
-                except Exception:
+
+                def undo_inline():
                     # Same undo discipline as the streaming commit: an
                     # inline overwrite below quorum must restore the
                     # displaced generation on drives that committed.
@@ -449,7 +448,19 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     parallel_map([lambda i=i, d=d: undo(i, d)
                                   for i, d in enumerate(shuffled)],
                                  deadline=self._meta_deadline())
+
+                try:
+                    reduce_write_quorum(outcomes, write_quorum, bucket, obj)
+                except Exception:
+                    undo_inline()
                     raise
+                if not lease.held:
+                    # Lock quorum lost mid-commit (see the streaming
+                    # path): roll back rather than complete unprotected.
+                    undo_inline()
+                    raise se.OperationTimedOut(
+                        bucket, obj, "dsync lock quorum lost during "
+                        "commit; write rolled back")
                 toks = [o for o in outcomes
                         if o and not isinstance(o, Exception)]
                 if toks:
@@ -503,7 +514,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
 
         # Commit under the namespace lock (the reference takes the dist
         # lock just before metadata write + rename, cmd/erasure-object.go:736).
-        with self.nslock.lock(bucket, obj):
+        with self.nslock.lock(bucket, obj) as lease:
             try:
                 self._check_put_precondition(bucket, obj, opts)
             except se.ObjectError:
@@ -515,17 +526,16 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                      for i, d in enumerate(shuffled)],
                     deadline=self._meta_deadline(),
                 )
-            try:
-                reduce_write_quorum(outcomes, write_quorum, bucket, obj)
-            except Exception:
-                # Below quorum: UNDO everywhere — drives that failed
-                # still hold tmp staging; drives that committed must
-                # drop the just-written version AND restore whatever the
-                # commit displaced (a replaced version's journal entry +
-                # data dir), or listings (which union journals) would
-                # show an object GET quorum-fails on, and an overwrite
-                # would have destroyed the previous generation
-                # (reference undo-rename discipline).
+
+            def undo_commit():
+                # UNDO everywhere — drives that failed still hold tmp
+                # staging; drives that committed must drop the
+                # just-written version AND restore whatever the commit
+                # displaced (a replaced version's journal entry + data
+                # dir), or listings (which union journals) would show an
+                # object GET quorum-fails on, and an overwrite would
+                # have destroyed the previous generation (reference
+                # undo-rename discipline).
                 undo_fi = FileInfo(volume=bucket, name=obj,
                                    version_id=fi.version_id,
                                    data_dir=fi.data_dir)
@@ -539,8 +549,25 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 parallel_map([lambda i=i, d=d: undo(i, d)
                               for i, d in enumerate(shuffled)],
                              deadline=self._meta_deadline())
+
+            try:
+                reduce_write_quorum(outcomes, write_quorum, bucket, obj)
+            except Exception:
+                undo_commit()
                 raise
-            # Quorum reached: discard the displaced state for good.
+            if not lease.held:
+                # The dsync lock lost its refresh quorum mid-commit (a
+                # partition isolated us from the locker majority): the
+                # critical section is no longer protected, so a racing
+                # writer on the other side may have committed too.
+                # Completing would risk a silent split-brain overwrite —
+                # roll back and fail typed instead.
+                undo_commit()
+                raise se.OperationTimedOut(
+                    bucket, obj,
+                    "dsync lock quorum lost during commit; write rolled back")
+            # Quorum reached under a live lock: discard the displaced
+            # state for good.
             if any(tokens):
                 parallel_map([lambda d=d, t=t: d.commit_rename(t)
                               for d, t in zip(shuffled, tokens) if t],
@@ -697,8 +724,12 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         # any-k read strategy (cmd/erasure-decode.go:120-188). Opening is
         # deferred into the pooled read tasks (_read_chunk_rows), so a
         # drive hanging at open() is hedged/deadlined exactly like one
-        # hanging mid-read.
-        dead: set[int] = set()
+        # hanging mid-read. Drives already known dead — health-OFFLINE
+        # locals and OPEN-breaker peers — start excluded, so selection
+        # jumps straight to reconstruction instead of paying a doomed
+        # open per batch (the native lane has always done this).
+        dead: set[int] = {i for i, d in enumerate(shuffled)
+                          if not d.is_online()}
         corrupt: set[int] = set()  # the subset of dead that OBSERVED bitrot
         # Hedge losers: healthy-but-slow shards sidelined for this stream.
         # Never heal-triggering, and reclaimable when selection runs short

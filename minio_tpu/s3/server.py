@@ -958,6 +958,30 @@ class S3Server:
                         s.get("online", 0) >= s.get("write_quorum", 0) + 1
                         for s in sets)
                 headers = {}
+                # Peer fabric: breaker-derived liveness. OPEN breakers
+                # already fail drive probes instantly (so the quorum
+                # math above is partition-fast); additionally, a node
+                # that cannot reach a majority of the cluster is on the
+                # minority side of a partition — report 503 so the load
+                # balancer drains it even while its local drives alone
+                # still clear write quorum.
+                node = self.cluster_node
+                if node is not None and node.peer_nodes:
+                    fabric = node.peer_fabric_info()
+                    open_peers = [p["peer"] for p in fabric
+                                  if p["state"] == "open"]
+                    total = len(fabric) + 1          # peers + self
+                    reachable = total - len(open_peers)
+                    # Drain only a STRICT minority side. On an exact even
+                    # split (2-node cluster losing a node, 2-2 in a
+                    # 4-node cluster) there is no minority — draining
+                    # both halves would turn a partial failure into a
+                    # full outage, so ties stay up and the drive
+                    # write-quorum check above remains the arbiter.
+                    if reachable * 2 < total:
+                        healthy = False
+                    headers["X-Minio-Peers-Online"] = str(reachable - 1)
+                    headers["X-Minio-Peers-Offline"] = str(len(open_peers))
                 if sets:
                     headers["X-Minio-Write-Quorum"] = str(
                         max(s.get("write_quorum", 0) for s in sets))

@@ -199,7 +199,13 @@ class HealthChecker:
         return self.state
 
     def is_online(self) -> bool:
-        return self.state != OFFLINE
+        # A remote drive is also dead when its peer's circuit breaker is
+        # OPEN (the inner RemoteDrive delegates to the RestClient) — the
+        # GET path pre-excludes such drives exactly like OFFLINE locals.
+        if self.state == OFFLINE:
+            return False
+        inner_online = getattr(self._inner, "is_online", None)
+        return bool(inner_online()) if callable(inner_online) else True
 
     def op_deadlines(self) -> tuple[float, float, float]:
         """Current adaptive (meta, data, walk) deadlines — the fan-out

@@ -117,3 +117,11 @@ def bucket(client):
     r = client.put("/apitest")
     assert r.status_code in (200, 409), r.text
     return "apitest"
+
+
+def pytest_configure(config):
+    # Tier-1 runs `-m 'not slow'` (ROADMAP.md): long chaos soaks and
+    # multi-minute stress tiers opt out of the window with this marker.
+    config.addinivalue_line(
+        "markers", "slow: long-running soak/stress tests excluded from "
+        "the tier-1 window")

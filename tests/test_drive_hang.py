@@ -384,7 +384,10 @@ def test_rpc_probe_stops_on_close():
                               for t in threading.enumerate()),
               timeout=5.0, what="probe thread stop after close()")
     # After close, going offline again must not spawn a new probe.
-    c._online = True
+    from minio_tpu.dist.rpc import BREAKER_CLOSED
+    with c._lock:
+        c._state = BREAKER_CLOSED
+        c._consec = 0
     c.mark_offline()
     time.sleep(0.1)
     assert not any(t.name == name and t.is_alive()

@@ -1,0 +1,832 @@
+"""Peer-resilience plane: fault injection, circuit breakers, retry
+budgets, and degraded-cluster semantics under network partitions.
+
+Three tiers:
+  1. faultplane determinism + breaker/retry-budget unit tests over a
+     bare NodeServer/RestClient pair (no cluster);
+  2. degraded-commit semantics unit tests (lock lease lost mid-commit
+     rolls back cleanly) over local drives;
+  3. the partition matrix on a 3-node in-process cluster — symmetric
+     split, asymmetric (A→B dead, B→A alive), flapping peer, partition
+     during multipart — asserting every S3 op completes or fails within
+     a small multiple of its configured deadline and that MRF drains the
+     missed shards once the partition heals.
+"""
+
+import contextlib
+import io
+import json
+import re
+import threading
+import time
+
+import pytest
+import requests
+
+from minio_tpu.dist import faultplane
+from minio_tpu.dist import rpc as rpc_mod
+from minio_tpu.dist.dsync import DRWMutex, LocalLocker
+from minio_tpu.dist.server import NodeServer
+from minio_tpu.erasure import healing as healing_mod
+from minio_tpu.erasure.objects import ErasureObjects
+from minio_tpu.erasure.types import CompletePart
+from minio_tpu.storage.local import LocalDrive
+from minio_tpu.utils import errors as se
+
+RPC_SECRET = "partition-test-secret"
+PAYLOAD = b"\xa5" * (256 * 1024)
+
+# Generous "small multiple of the configured deadline" bound: every
+# injected fault is an instant refusal or a controlled delay, so a
+# bounded op finishes in well under this; an UNbounded one (the bug
+# class this file exists to catch) blows straight through it.
+OP_BOUND = 15.0
+
+
+@pytest.fixture()
+def plane():
+    p = faultplane.install(seed=123)
+    yield p
+    faultplane.uninstall()
+
+
+@pytest.fixture()
+def rpc_server():
+    srv = NodeServer(host="127.0.0.1", port=0, secret=RPC_SECRET)
+    srv.register_plane("storage", {
+        "disk_info": lambda params, body: b"ok",
+        "rename_data": lambda params, body: b"renamed",
+        "read_all": lambda params, body: PAYLOAD,
+    })
+    srv.start()
+    yield srv
+    srv.close()
+
+
+def _client(srv, **kw) -> rpc_mod.RestClient:
+    return rpc_mod.RestClient("127.0.0.1", srv.port, RPC_SECRET,
+                              timeout=5.0, **kw)
+
+
+# ---------------------------------------------------------------------------
+# 1a. faultplane determinism (tier-1 fast check)
+# ---------------------------------------------------------------------------
+
+
+def test_faultplane_rules():
+    """Same seed + same programming order => the identical fault
+    schedule; preview does not consume the draws it previews."""
+    def program(p):
+        p.add_rule(faultplane.DELAY, route="read_all", delay=0.01,
+                   jitter=0.05)
+        p.add_rule(faultplane.DELAY, peer="x:1", delay=0.0, jitter=0.2)
+        p.add_rule(faultplane.TRUNCATE, route="read_version",
+                   after_bytes=64, times=2)
+
+    a, b = faultplane.FaultPlane(seed=42), faultplane.FaultPlane(seed=42)
+    program(a)
+    program(b)
+    sched = a.schedule(8)
+    assert sched == b.schedule(8)
+    assert len(sched) == 3 * 8
+
+    # Preview again: identical (schedule() must not consume).
+    assert a.schedule(8) == sched
+
+    # The draws actually fired match the preview, in order.
+    fired = [a._rules[0].draw_delay() for _ in range(8)]
+    assert fired == [d for _act, d in sched[:8]]
+
+    # A different seed diverges (jitter present on rule 0).
+    c = faultplane.FaultPlane(seed=7)
+    program(c)
+    assert c.schedule(8) != sched
+
+
+def test_faultplane_partitions_and_times():
+    p = faultplane.FaultPlane()
+    p.partition("split", ["a:1", "b:2"], ["c:3"])
+    assert p.partitioned("a:1", "c:3") and p.partitioned("c:3", "a:1")
+    assert not p.partitioned("a:1", "b:2")
+    p.isolate("oneway", "a:1", "b:2")
+    assert p.partitioned("a:1", "b:2")
+    assert not p.partitioned("b:2", "a:1")     # asymmetric
+    assert p.heal("oneway")
+    assert not p.partitioned("a:1", "b:2")
+    assert p.heal("split") and not p.heal("split")
+
+    # times= bounds firings.
+    p.add_rule(faultplane.RESET, route="disk_info", times=2)
+    path = "/rpc/storage/v1/disk_info"
+    for _ in range(2):
+        with pytest.raises(ConnectionResetError):
+            p.on_request("", "x:1", path)
+    p.on_request("", "x:1", path)               # rule exhausted: no-op
+
+
+# ---------------------------------------------------------------------------
+# 1b. circuit breaker + retry budget
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_open_fail_fast_zero_socket_work(rpc_server, plane):
+    """OPEN fails instantly with the per-drive error and touches no
+    connection machinery at all (the drive plane's OFFLINE state)."""
+    c = _client(rpc_server)
+    try:
+        plane.isolate("cut", "", c.fault_dst)
+        with pytest.raises(se.DiskNotFound):
+            c.call("/rpc/storage/v1/disk_info")
+        assert c.breaker_state() == rpc_mod.BREAKER_OPEN
+
+        def boom():
+            raise AssertionError("socket work on an OPEN breaker")
+
+        c._get_conn = boom       # the fail-fast path must never reach it
+        t0 = time.monotonic()
+        for _ in range(5):
+            with pytest.raises(se.DiskNotFound) as ei:
+                c.call("/rpc/storage/v1/disk_info")
+            assert "breaker open" in str(ei.value)
+        assert time.monotonic() - t0 < 0.5
+    finally:
+        c.close()
+
+
+def test_half_open_admits_exactly_one_trial(rpc_server, plane):
+    c = _client(rpc_server)
+    try:
+        plane.add_rule(faultplane.DELAY, route="disk_info", delay=0.5,
+                       times=1)
+        with c._lock:
+            c._state = rpc_mod.BREAKER_HALF_OPEN
+        out = {}
+
+        def trial():
+            try:
+                out["v"] = c.call("/rpc/storage/v1/disk_info")
+            except Exception as e:  # noqa: BLE001
+                out["e"] = e
+
+        t = threading.Thread(target=trial)
+        t.start()
+        time.sleep(0.15)           # trial is in flight (inside the delay)
+        t1 = time.monotonic()
+        with pytest.raises(se.DiskNotFound) as ei:
+            c.call("/rpc/storage/v1/disk_info")
+        assert "half-open" in str(ei.value)
+        assert time.monotonic() - t1 < 0.2      # rejected instantly
+        t.join(5)
+        assert out.get("v") == b"ok"            # the single trial won
+        assert c.breaker_state() == rpc_mod.BREAKER_CLOSED
+        assert c.call("/rpc/storage/v1/disk_info") == b"ok"
+    finally:
+        c.close()
+
+
+def test_half_open_trial_failure_reopens(rpc_server, plane):
+    c = _client(rpc_server)
+    try:
+        with c._lock:
+            c._state = rpc_mod.BREAKER_HALF_OPEN
+        plane.add_rule(faultplane.RESET, route="disk_info", times=1)
+        with pytest.raises(se.DiskNotFound):
+            c.call("/rpc/storage/v1/disk_info")
+        assert c.breaker_state() == rpc_mod.BREAKER_OPEN
+    finally:
+        c.close()
+
+
+def test_retry_budget_exhaustion_sheds(rpc_server, plane):
+    """Bounded retries draw from the token bucket; a dry bucket sheds
+    (fails the call) instead of amplifying the outage."""
+    c = _client(rpc_server, retries=5, retry_budget=2, retry_refill=0.0,
+                breaker_failures=10)
+    try:
+        rule = plane.add_rule(faultplane.RESET, route="disk_info")
+        with pytest.raises(se.DiskNotFound):
+            c.call("/rpc/storage/v1/disk_info")
+        assert c._retries == 2        # capacity-2 bucket funded 2 retries
+        assert c._shed == 1           # the 3rd was shed, not slept on
+        assert rule.fired == 3        # 1 initial + 2 retried attempts
+        info = c.breaker_info()
+        assert info["retries"] == 2 and info["retriesShed"] == 1
+    finally:
+        c.close()
+
+
+def test_idempotent_retry_recovers_transient_fault(rpc_server, plane):
+    c = _client(rpc_server, retries=2, breaker_failures=10)
+    try:
+        plane.add_rule(faultplane.RESET, route="disk_info", times=1)
+        assert c.call("/rpc/storage/v1/disk_info") == b"ok"
+        assert c._retries == 1
+    finally:
+        c.close()
+
+
+def test_non_idempotent_routes_never_retry(rpc_server, plane):
+    c = _client(rpc_server, retries=5, breaker_failures=10)
+    try:
+        rule = plane.add_rule(faultplane.RESET, route="rename_data")
+        with pytest.raises(se.DiskNotFound):
+            c.call("/rpc/storage/v1/rename_data")
+        assert rule.fired == 1        # exactly one attempt hit the wire
+        assert c._retries == 0
+    finally:
+        c.close()
+
+
+def test_breaker_probe_recovery_roundtrip(rpc_server, plane):
+    """CLOSED -> OPEN (partition) -> HALF_OPEN (probe) -> CLOSED (trial
+    call) — the full cycle against a live server."""
+    c = _client(rpc_server)
+    try:
+        plane.isolate("cut", "", c.fault_dst)
+        with pytest.raises(se.DiskNotFound):
+            c.call("/rpc/storage/v1/disk_info")
+        assert c.breaker_state() == rpc_mod.BREAKER_OPEN
+        assert c.breaker_info()["opens"] == 1
+        plane.heal("cut")
+        deadline = time.monotonic() + 10
+        while (c.breaker_state() == rpc_mod.BREAKER_OPEN
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert c.breaker_state() == rpc_mod.BREAKER_HALF_OPEN
+        assert c.call("/rpc/storage/v1/disk_info") == b"ok"
+        assert c.breaker_state() == rpc_mod.BREAKER_CLOSED
+    finally:
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# 1c. connection-pool hygiene (the two RestClient bugfixes)
+# ---------------------------------------------------------------------------
+
+
+def test_truncated_stream_drops_connection(rpc_server, plane):
+    """Regression: a connection whose body read failed mid-stream must
+    be dropped, never pooled — pooling it surfaced the breakage as a
+    confusing failure on the NEXT unrelated call."""
+    c = _client(rpc_server, retries=0)
+    try:
+        assert c.call("/rpc/storage/v1/read_all") == PAYLOAD
+        assert len(c._pool) == 1                     # conn pooled healthy
+        plane.add_rule(faultplane.TRUNCATE, route="read_all",
+                       after_bytes=1024, times=1)
+        st = c.call("/rpc/storage/v1/read_all", stream=True)
+        got = b""
+        with pytest.raises(se.StorageError):
+            while chunk := st.read(4096):
+                got += chunk
+        # The cut lands at EXACTLY after_bytes: a valid prefix, then
+        # the reset — never a whole extra chunk.
+        assert got == PAYLOAD[:1024]
+        assert c._pool == []          # poisoned keep-alive conn dropped
+        st.close()                    # close after failure is a no-op
+        assert c._pool == []
+        # The next unrelated call is unaffected (fresh connection).
+        assert c.call("/rpc/storage/v1/read_all") == PAYLOAD
+    finally:
+        c.close()
+
+
+def test_truncated_buffered_body_drops_connection(rpc_server, plane):
+    c = _client(rpc_server, retries=0)
+    try:
+        plane.add_rule(faultplane.TRUNCATE, route="read_all", times=1)
+        with pytest.raises(se.DiskNotFound):
+            c.call("/rpc/storage/v1/read_all")
+        assert c._pool == []
+        assert c.call("/rpc/storage/v1/read_all") == PAYLOAD
+    finally:
+        c.close()
+
+
+def test_corrupt_response_keeps_transport_healthy(rpc_server, plane):
+    """CORRUPT flips payload bytes on an intact transport: the call
+    surfaces garbage (caller-level concern) but the connection is in
+    protocol sync and stays poolable."""
+    c = _client(rpc_server, retries=0)
+    try:
+        plane.add_rule(faultplane.CORRUPT, route="read_all", times=1)
+        data = c.call("/rpc/storage/v1/read_all")
+        assert data != PAYLOAD and len(data) == len(PAYLOAD)
+        assert len(c._pool) == 1
+        assert c.call("/rpc/storage/v1/read_all") == PAYLOAD
+    finally:
+        c.close()
+
+
+def test_close_during_inflight_call(rpc_server, plane):
+    """Regression: close() racing an in-flight call must neither leak
+    the call's socket into the pool nor resurrect the probe thread, and
+    must be idempotent."""
+    c = _client(rpc_server)
+    assert c.call("/rpc/storage/v1/disk_info") == b"ok"
+    plane.add_rule(faultplane.DELAY, route="read_all", delay=0.4, times=1)
+    out = {}
+
+    def go():
+        try:
+            out["v"] = c.call("/rpc/storage/v1/read_all")
+        except Exception as e:  # noqa: BLE001
+            out["e"] = e
+
+    t = threading.Thread(target=go)
+    t.start()
+    time.sleep(0.15)
+    c.close()
+    c.close()                                  # idempotent
+    t.join(5)
+    assert out.get("v") == PAYLOAD             # in-flight call completed
+    assert c._pool == []                       # socket closed, not pooled
+    assert c._probing is False
+    c.mark_offline()                           # post-close: no probe spawn
+    assert c._probing is False
+
+
+# ---------------------------------------------------------------------------
+# 2. degraded-commit semantics (lock lease lost mid-commit)
+# ---------------------------------------------------------------------------
+
+
+class _LostLease:
+    held = False
+
+
+class _LostLockMap:
+    """NamespaceLockMap stand-in whose leases are already lost — the
+    state a dsync lock reaches when a partition cuts it off from the
+    locker majority mid-critical-section."""
+
+    distributed = True
+
+    @contextlib.contextmanager
+    def lock(self, *a, **kw):
+        yield _LostLease()
+
+    def rlock(self, bucket, obj, timeout=30.0):
+        return self.lock(bucket, obj)
+
+
+def _make_set(tmp_path, n=4):
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(n)]
+    return ErasureObjects(drives)
+
+
+def _read_obj(er, bucket, obj) -> bytes:
+    _info, it = er.get_object(bucket, obj)
+    return b"".join(it)
+
+
+@pytest.mark.parametrize("size", [1 << 10, 1 << 20],
+                         ids=["inline", "streaming"])
+def test_put_rolls_back_when_lock_lease_lost(tmp_path, size):
+    """A write whose dsync lease died before the commit's point of no
+    return must roll back (restoring the displaced generation), never
+    complete unprotected."""
+    er = _make_set(tmp_path)
+    er.make_bucket("bkt")
+    v1 = b"1" * size
+    er.put_object("bkt", "o", io.BytesIO(v1), len(v1))
+
+    real = er.nslock
+    er.nslock = _LostLockMap()
+    try:
+        v2 = b"2" * size
+        with pytest.raises(se.OperationTimedOut):
+            er.put_object("bkt", "o", io.BytesIO(v2), len(v2))
+    finally:
+        er.nslock = real
+    assert _read_obj(er, "bkt", "o") == v1       # displaced gen restored
+
+
+def test_multipart_complete_rolls_back_when_lease_lost(tmp_path):
+    er = _make_set(tmp_path)
+    er.make_bucket("bkt")
+    body = b"m" * (1 << 20)
+    uid = er.new_multipart_upload("bkt", "mp")
+    part = er.put_object_part("bkt", "mp", uid, 1, io.BytesIO(body), len(body))
+    parts = [CompletePart(part_number=1, etag=part.etag)]
+
+    real = er.nslock
+    er.nslock = _LostLockMap()
+    try:
+        with pytest.raises(se.OperationTimedOut):
+            er.complete_multipart_upload("bkt", "mp", uid, parts)
+    finally:
+        er.nslock = real
+    with pytest.raises(se.ObjectNotFound):
+        er.get_object_info("bkt", "mp")
+    # The session was restored: the client's retry of Complete succeeds.
+    er.complete_multipart_upload("bkt", "mp", uid, parts)
+    assert _read_obj(er, "bkt", "mp") == body
+
+
+def test_drwmutex_refresh_quorum_loss_flips_held():
+    class FlakyLocker:
+        ok = True
+
+        def lock(self, args):
+            return True
+
+        rlock = lock
+
+        def unlock(self, args):
+            return True
+
+        runlock = unlock
+        force_unlock = unlock
+
+        def refresh(self, args):
+            return self.ok
+
+        def is_online(self):
+            return True
+
+    flaky = FlakyLocker()
+    local = LocalLocker()
+    lost = threading.Event()
+    mx = DRWMutex(["res"], [local, flaky], owner="me",
+                  refresh_interval=0.05, on_lost=lost.set)
+    assert mx.get_lock(timeout=5)
+    assert mx.held
+    flaky.ok = False                  # quorum (2 of 2) now unreachable
+    assert lost.wait(3), "refresh loss not observed"
+    assert not mx.held
+    # unlock() after a lease loss must STILL release the minority
+    # lockers that hold the grant and shut the broadcast pool down —
+    # keying it on `held` leaked both (review regression).
+    mx.unlock()
+    assert local.dump() == {}, "minority locker still holds the grant"
+    assert mx._pool._shutdown
+
+
+def test_healthchecker_is_online_delegates_to_inner():
+    from minio_tpu.storage.healthcheck import HealthChecker
+
+    class StubDrive:
+        online = True
+
+        def endpoint(self):
+            return "stub:/d"
+
+        def is_online(self):
+            return self.online
+
+        def close(self):
+            pass
+
+    stub = StubDrive()
+    hc = HealthChecker(stub)
+    assert hc.is_online()
+    stub.online = False               # peer breaker OPEN under the hood
+    assert not hc.is_online()
+
+
+# ---------------------------------------------------------------------------
+# 3. the partition matrix: 3-node cluster, S3 front door on node 1
+# ---------------------------------------------------------------------------
+
+CL_SECRET = "partition-cluster-secret"
+ACCESS, SECRET = "testadmin", "testsecret123"
+# Drawn at import so a parallel CI shard (or stray process) on fixed
+# ports cannot error the whole module; node identities are just strings
+# derived from whatever ports we got.
+from tests.conftest import free_port as _free_port  # noqa: E402
+
+S3P = tuple(_free_port() for _ in range(3))
+NODE = tuple(f"127.0.0.1:{p}" for p in S3P)
+
+
+@pytest.fixture(scope="module")
+def cluster3(tmp_path_factory):
+    """Three symmetric ClusterNodes over one 8-drive set (4+2+2,
+    parity 2 => write quorum 6): losing EITHER 2-drive node keeps both
+    write and lock quorum, so node 3 can be partitioned away and the
+    cluster must keep serving degraded."""
+    import asyncio
+
+    from aiohttp import web
+
+    from minio_tpu.dist.cluster import ClusterNode
+    from minio_tpu.s3 import sigv4
+    from minio_tpu.s3.server import S3Server
+    from tests.conftest import free_port
+    from tests.s3client import SigV4Client
+
+    tmp = tmp_path_factory.mktemp("partition-cluster")
+    rpc_map = {p: free_port() for p in S3P}
+    args = [[f"http://127.0.0.1:{S3P[0]}/n1/d{{1...4}}",
+             f"http://127.0.0.1:{S3P[1]}/n2/d{{1...2}}",
+             f"http://127.0.0.1:{S3P[2]}/n3/d{{1...2}}"]]
+    mk_root = lambda p: str(tmp / p.strip("/").replace("/", "_"))  # noqa: E731
+
+    prev_mrf = healing_mod.MRF_RETRY_INTERVAL
+    healing_mod.MRF_RETRY_INTERVAL = 0.1   # partition-requeue cadence
+
+    nodes = [ClusterNode(args, host="127.0.0.1", port=p, secret=CL_SECRET,
+                         root_dir_map=mk_root, local_names={"127.0.0.1"},
+                         rpc_port=rpc_map[p],
+                         rpc_port_of=lambda h, pp: rpc_map[pp],
+                         parity=2, set_drive_count=8)
+             for p in S3P]
+    n1, n2, n3 = nodes
+    n1.wait_for_peers(timeout=20)
+    layer1 = n1.build_object_layer(enable_mrf=True)
+    n2.build_object_layer()
+    n3.build_object_layer()
+
+    srv = S3Server(layer1, sigv4.Credentials(ACCESS, SECRET),
+                   notification_sys=n1.notification)
+    srv.attach_cluster(n1)
+    port = free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(srv.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(30)
+    cl = SigV4Client(f"http://127.0.0.1:{port}", ACCESS, SECRET)
+    assert cl.put("/pbkt").status_code == 200
+    yield {"client": cl, "srv": srv, "nodes": nodes, "layer": layer1,
+           "base": f"http://127.0.0.1:{port}"}
+    healing_mod.MRF_RETRY_INTERVAL = prev_mrf
+    loop.call_soon_threadsafe(loop.stop)
+    for n in nodes:
+        try:
+            n.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _mrf(cluster3):
+    return cluster3["layer"].pools[0].sets[0].mrf
+
+
+def _breaker(cluster3, src: int, dst: int) -> rpc_mod.RestClient:
+    return cluster3["nodes"][src]._client_for(("127.0.0.1", S3P[dst]))
+
+
+def _wait_fabric_recovered(cluster3, timeout=20.0) -> None:
+    """Poke the fabric until every n1 breaker is CLOSED again AND the
+    drive-health plane is fully ONLINE. Both matter: breakers close on
+    the first good round trip, but a drive the partition walked to
+    OFFLINE stays there until its 1 Hz sentinel probe succeeds — a test
+    that injects its own partition right after a heal would otherwise
+    start from a silently degraded quorum."""
+    cl = cluster3["client"]
+    drives = cluster3["layer"].pools[0].sets[0].drives
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        cl.get("/pbkt")   # a cheap quorum op exercises every peer client
+        if (all(_breaker(cluster3, 0, i).breaker_state()
+                == rpc_mod.BREAKER_CLOSED for i in (1, 2))
+                and all(d.health_state() == "online" for d in drives
+                        if hasattr(d, "health_state"))):
+            return
+        time.sleep(0.2)
+    raise AssertionError("peer fabric did not recover in time")
+
+
+@pytest.fixture()
+def fabric(cluster3):
+    """Per-test faultplane: fresh rules in, fully-healed fabric out."""
+    p = faultplane.install(seed=99)
+    try:
+        yield p
+    finally:
+        faultplane.uninstall()
+        _wait_fabric_recovered(cluster3)
+        mrf = _mrf(cluster3)
+        if mrf is not None:
+            mrf.wait_idle(timeout=30)
+
+
+def _timed(fn, bound=OP_BOUND):
+    t0 = time.monotonic()
+    out = fn()
+    dt = time.monotonic() - t0
+    assert dt < bound, f"op took {dt:.1f}s (bound {bound}s)"
+    return out
+
+
+def _n3_has_version(cluster3, bucket: str, obj: str) -> bool:
+    n3 = cluster3["nodes"][2]
+    for d in n3.local_drives.values():
+        try:
+            d.read_version(bucket, obj, "")
+        except Exception:  # noqa: BLE001
+            return False
+    return True
+
+
+def test_degraded_write_commits_and_mrf_drains(cluster3, fabric):
+    """Symmetric split isolating node 3: writes reaching quorum commit,
+    reads reconstruct, both bounded; the missed shards drain onto node 3
+    via MRF once the partition heals."""
+    cl = cluster3["client"]
+    fabric.partition("p3", [NODE[0], NODE[1]], [NODE[2]])
+
+    body = PAYLOAD
+    r = _timed(lambda: cl.put("/pbkt/degraded", data=body))
+    assert r.status_code == 200, r.text
+    assert _breaker(cluster3, 0, 2).breaker_state() == rpc_mod.BREAKER_OPEN
+
+    r = _timed(lambda: cl.get("/pbkt/degraded"))
+    assert r.status_code == 200 and r.content == body
+
+    listing = _timed(lambda: cl.get("/pbkt", query={"list-type": "2"}))
+    assert listing.status_code == 200 and "degraded" in listing.text
+
+    assert not _n3_has_version(cluster3, "pbkt", "degraded")
+
+    fabric.heal("p3")
+    assert _mrf(cluster3).wait_idle(timeout=30), "MRF did not drain"
+    assert _n3_has_version(cluster3, "pbkt", "degraded")
+    # Healed shards serve reads even with the OTHER 2-drive node cut.
+    fabric.partition("p2", [NODE[0], NODE[2]], [NODE[1]])
+    r = _timed(lambda: cl.get("/pbkt/degraded"))
+    assert r.status_code == 200 and r.content == body
+    fabric.heal("p2")
+
+
+def test_asymmetric_partition(cluster3, fabric):
+    """A→B dead, B→A alive: node 1's breaker to node 3 opens, while
+    node 3 keeps reaching node 1's drives over the storage plane."""
+    cl = cluster3["client"]
+    fabric.isolate("oneway", NODE[0], NODE[2])
+
+    r = _timed(lambda: cl.put("/pbkt/asym", data=b"a" * 4096))
+    assert r.status_code == 200
+    assert _breaker(cluster3, 0, 2).breaker_state() == rpc_mod.BREAKER_OPEN
+
+    # Reverse direction stays alive: n3 reads an n1 drive directly.
+    n1, n3 = cluster3["nodes"][0], cluster3["nodes"][2]
+    ep = next(e for e in n3.pools_layout[0].endpoints
+              if not e.is_local and e.node == ("127.0.0.1", S3P[0]))
+    di = n3.drive_for(ep).disk_info()
+    assert di.total > 0
+    assert _breaker(cluster3, 2, 0).breaker_state() == rpc_mod.BREAKER_CLOSED
+    assert n1 is cluster3["nodes"][0]
+
+
+def test_flapping_peer_and_breaker_observability(cluster3, fabric):
+    """Two partition/heal cycles; afterwards the full breaker cycle is
+    visible in the cluster scrape and admin server-info."""
+    cl = cluster3["client"]
+    for i in range(2):
+        fabric.partition("flap", [NODE[0], NODE[1]], [NODE[2]])
+        r = _timed(lambda: cl.put(f"/pbkt/flap{i}", data=b"f" * 8192))
+        assert r.status_code == 200
+        assert (_breaker(cluster3, 0, 2).breaker_state()
+                == rpc_mod.BREAKER_OPEN)
+        fabric.heal("flap")
+        _wait_fabric_recovered(cluster3)
+        r = _timed(lambda: cl.get(f"/pbkt/flap{i}"))
+        assert r.status_code == 200
+
+    info = _breaker(cluster3, 0, 2).breaker_info()
+    assert info["opens"] >= 2 and info["state"] == "closed"
+
+    # Metrics plane: breaker state + transition counters, cluster scope.
+    r = cl.get("/minio/v2/metrics/cluster")
+    assert r.status_code == 200
+    text = r.text
+    assert "minio_tpu_peer_breaker_state" in text
+    assert re.search(
+        r'minio_tpu_peer_breaker_state\{[^}]*peer="127\.0\.0\.1:'
+        + str(S3P[2]) + r'"[^}]*\} 0', text), "breaker gauge not CLOSED"
+    for state in ("open", "half-open", "closed"):
+        assert re.search(
+            r'minio_tpu_peer_breaker_transitions_total\{[^}]*state="'
+            + state + r'"', text), f"no {state} transition recorded"
+
+    # Admin surface: per-peer fabric entries ride server-info.
+    r = cl.get("/minio/admin/v3/info")
+    assert r.status_code == 200, r.text
+    fabric_info = r.json()["peerFabric"]
+    entry = next(e for e in fabric_info if e["peer"] == NODE[2])
+    assert entry["state"] == "closed" and entry["opens"] >= 2
+
+
+def test_partition_during_multipart(cluster3, fabric):
+    """Parts uploaded healthy; the partition lands between upload and
+    Complete — the commit still reaches quorum, bounded, and the missed
+    shards heal after the partition lifts."""
+    cl = cluster3["client"]
+    key = "/pbkt/mpart"
+    r = cl.post(key, query={"uploads": ""})
+    assert r.status_code == 200, r.text
+    uid = re.search(r"<UploadId>([^<]+)</UploadId>", r.text).group(1)
+    body = b"P" * (1 << 20)
+    r = cl.put(key, data=body, query={"uploadId": uid, "partNumber": "1"})
+    assert r.status_code == 200, r.text
+    etag = r.headers["ETag"]
+
+    fabric.partition("mp", [NODE[0], NODE[1]], [NODE[2]])
+    xml = ("<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>"
+           f"<ETag>{etag}</ETag></Part></CompleteMultipartUpload>")
+    r = _timed(lambda: cl.post(key, data=xml.encode(),
+                               query={"uploadId": uid}))
+    assert r.status_code == 200, r.text
+
+    r = _timed(lambda: cl.get(key))
+    assert r.status_code == 200 and r.content == body
+
+    fabric.heal("mp")
+    assert _mrf(cluster3).wait_idle(timeout=30)
+    assert _n3_has_version(cluster3, "pbkt", "mpart")
+
+
+def test_minority_node_health_drains(cluster3, fabric):
+    """A node partitioned from the cluster majority reports not-ready so
+    the load balancer drains it; it recovers once the partition heals."""
+    base = cluster3["base"]
+    fabric.partition("iso1", [NODE[0]], [NODE[1], NODE[2]])
+
+    r = _timed(lambda: requests.get(base + "/minio/health/ready",
+                                    timeout=OP_BOUND))
+    assert r.status_code == 503
+    assert r.headers["X-Minio-Peers-Offline"] == "2"
+    assert r.headers["X-Minio-Server-Status"] == "degraded"
+
+    fabric.heal("iso1")
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        r = requests.get(base + "/minio/health/ready", timeout=OP_BOUND)
+        if r.status_code == 200:
+            break
+        time.sleep(0.25)
+    assert r.status_code == 200
+    assert r.headers["X-Minio-Peers-Offline"] == "0"
+
+
+def test_faults_admin_endpoint_guarded(cluster3, monkeypatch):
+    """The HTTP faults surface requires BOTH admin credentials and the
+    process opt-in env; documents round-trip through describe."""
+    cl = cluster3["client"]
+    doc = {"op": "rule", "action": "delay", "route": "never-called",
+           "delay": 0.0}
+    monkeypatch.delenv("MTPU_FAULT_INJECTION", raising=False)
+    r = cl.post("/minio/admin/v3/faults", data=json.dumps(doc).encode())
+    assert r.status_code == 501                       # env gate closed
+
+    monkeypatch.setenv("MTPU_FAULT_INJECTION", "1")
+    try:
+        r = cl.post("/minio/admin/v3/faults", data=json.dumps(doc).encode())
+        assert r.status_code == 200, r.text
+        desc = cl.get("/minio/admin/v3/faults").json()
+        assert desc["installed"]
+        assert desc["rules"][0]["route"] == "never-called"
+        r = cl.post("/minio/admin/v3/faults",
+                    data=json.dumps({"op": "clear"}).encode())
+        assert r.status_code == 200
+        assert cl.get("/minio/admin/v3/faults").json()["rules"] == []
+        r = cl.post("/minio/admin/v3/faults",
+                    data=json.dumps({"op": "bogus"}).encode())
+        assert r.status_code == 400
+    finally:
+        faultplane.uninstall()        # the POST installed a global plane
+
+
+@pytest.mark.slow
+def test_chaos_soak_flapping(cluster3):
+    """Long soak: deterministic flap schedule on node 3, continuous
+    puts/gets, every op bounded, full convergence at the end."""
+    cl = cluster3["client"]
+    plane = faultplane.install(seed=2026)
+    keys = []
+    try:
+        for cycle in range(6):
+            plane.partition("soak", [NODE[0], NODE[1]], [NODE[2]])
+            for j in range(3):
+                key = f"/pbkt/soak-{cycle}-{j}"
+                body = bytes([cycle]) * (32 << 10)
+                r = _timed(lambda k=key, b=body: cl.put(k, data=b))
+                assert r.status_code == 200, r.content
+                keys.append((key, body))
+                r = _timed(lambda k=key: cl.get(k))
+                assert r.status_code == 200, r.content
+            plane.heal("soak")
+            _wait_fabric_recovered(cluster3)
+    finally:
+        faultplane.uninstall()
+        _wait_fabric_recovered(cluster3)
+    assert _mrf(cluster3).wait_idle(timeout=60), "soak MRF backlog"
+    for key, body in keys:
+        r = _timed(lambda k=key: cl.get(k))
+        assert r.status_code == 200 and r.content == body
