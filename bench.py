@@ -856,6 +856,28 @@ def bench_obs_overhead() -> dict:
             "ctx_wrap_call_ns": round(ctx_ns, 1)}
 
 
+def bench_check_overhead() -> dict:
+    """Static-analysis gate cost (docs/ANALYSIS.md): one full
+    `python -m tools.check` pass over minio_tpu/ — the price tier-1 pays
+    per run (tests/test_static_analysis.py) and a pre-commit hook pays
+    per commit. Budget: < 10 s on the full tree; --changed runs scope to
+    the git diff and are proportionally cheaper."""
+    from pathlib import Path
+
+    from tools.check import run as check_run
+
+    root = Path(__file__).resolve().parent
+    check_run(root)  # warmup: rule-module imports, fs cache
+    t0 = time.perf_counter()
+    result = check_run(root)
+    dt = time.perf_counter() - t0
+    return {"metric": "static_check_full_tree", "value": round(dt, 2),
+            "unit": "s", "vs_baseline": 0.0,
+            "findings_baselined": len(result.baselined),
+            "findings_new": len(result.new),
+            "within_budget": dt < 10.0}
+
+
 def bench_select_csv() -> dict:
     """S3 Select CSV scan rate (BASELINE 'run-to-measure' matrix,
     pkg/s3select/select_benchmark_test.go:132 role): aggregate + WHERE
@@ -941,6 +963,7 @@ def main() -> int:
             ("select_parquet", bench_select_parquet),
             ("xlmeta", bench_xlmeta_codec),
             ("obs_overhead", bench_obs_overhead),
+            ("check_overhead", bench_check_overhead),
         ]
         if use_pallas:
             plans.insert(1, ("encode_pallas",

@@ -91,6 +91,8 @@ class Profiler:
                                                  delete=False) as f:
                     tmp = f.name
                 stats.dump_stats(tmp)
+                # mtpu: allow(MTPU002) - admin cold path: stop() runs once
+                # per profiling session and _mu only guards profiler state
                 with open(tmp, "rb") as f:
                     out["cpu.pstats"] = f.read()
                 os.unlink(tmp)

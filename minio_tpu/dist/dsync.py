@@ -267,7 +267,8 @@ class DRWMutex:
         futs = []
         for lk in self.lockers:
             try:
-                futs.append(self._pool.submit(getattr(lk, method), args))
+                futs.append(self._pool.submit(
+                    obs.ctx_wrap(getattr(lk, method)), args))
             except RuntimeError:
                 # unlock() shut the pool down while the refresh thread
                 # was entering a broadcast — count the locker as

@@ -21,6 +21,7 @@ from dataclasses import dataclass
 
 from minio_tpu.erasure.metadata import parallel_map
 from minio_tpu.storage.api import StorageAPI
+from minio_tpu.storage.healthcheck import fleet_deadlines
 from minio_tpu.utils import errors as se
 
 FORMAT_ERASURE = "erasure"
@@ -72,7 +73,8 @@ def init_format_erasure(
         raise ValueError(f"{n} drives not divisible into sets of {set_drive_count}")
     set_count = n // set_drive_count
 
-    results = parallel_map([lambda d=d: d.read_format() for d in drives])
+    results = parallel_map([lambda d=d: d.read_format() for d in drives],
+                           deadline=fleet_deadlines(drives)[0])
     existing = [
         (i, FormatInfo.from_doc(r))
         for i, r in enumerate(results)
@@ -95,7 +97,8 @@ def init_format_erasure(
             d.write_format(fmt.to_doc(this))
             d.set_disk_id(this)
         outcomes = parallel_map(
-            [lambda i=i, d=d: write(i, d) for i, d in enumerate(drives)]
+            [lambda i=i, d=d: write(i, d) for i, d in enumerate(drives)],
+            deadline=fleet_deadlines(drives)[0],
         )
         bad = [o for o in outcomes if isinstance(o, Exception)]
         if bad:

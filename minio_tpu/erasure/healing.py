@@ -541,7 +541,7 @@ class HealingMixin:
                             i for i, s in enumerate(states) if s < 0)
                         if fut is not None:
                             fut.result()
-                        fut = ex.submit(enc.feed, out,
+                        fut = ex.submit(obs.ctx_wrap(enc.feed), out,
                                         off + ln >= part.size)
                         off += ln
                     if fut is not None:

@@ -68,7 +68,8 @@ class MultipartMixin:
         drive that missed a rewrite within write tolerance serving stale
         state."""
         results = parallel_map(
-            [lambda d=d: d.read_all(SYS_VOL, rel) for d in self.drives]
+            [lambda d=d: d.read_all(SYS_VOL, rel) for d in self.drives],
+            deadline=self._meta_deadline(),
         )
         tally: dict[bytes, int] = {}
         for r in results:
@@ -125,7 +126,8 @@ class MultipartMixin:
         mp = self._mp_dir(bucket, obj, upload_id)
         results = parallel_map(
             [lambda d=d: d.write_all(SYS_VOL, f"{mp}/upload.json", raw)
-             for d in self.drives]
+             for d in self.drives],
+            deadline=self._meta_deadline(),
         )
         reduce_write_quorum(results, self._write_quorum_meta(), bucket, obj)
         return upload_id
@@ -160,7 +162,9 @@ class MultipartMixin:
             bucket, obj,
         )
         if size >= 0 and total != size:
-            parallel_map([lambda d=d: d.delete(SYS_VOL, tmp_rel) for d in shuffled])
+            parallel_map([lambda d=d: d.delete(SYS_VOL, tmp_rel)
+                          for d in shuffled],
+                         deadline=self._meta_deadline())
             raise se.IncompleteBody(bucket, obj, f"got {total} of {size} bytes")
 
         mod_time = time.time()
@@ -175,13 +179,21 @@ class MultipartMixin:
                             "mod_time": mod_time}).encode(),
             )
 
+        # mtpu: allow(MTPU001) - no outer envelope: each commit is a
+        # drive-deadline-bounded rename + json write, so every task
+        # terminates with a typed outcome; stamping one OperationTimedOut
+        # would leave the abandoned worker racing the quorum-failure
+        # cleanup below (renaming tmp_rel into part.N AFTER the cleanup
+        # deleted tmp_rel — an orphan part shard on a failed op).
         outcomes = parallel_map(
-            [lambda i=i, d=d: commit(i, d) for i, d in enumerate(shuffled)]
+            [lambda i=i, d=d: commit(i, d) for i, d in enumerate(shuffled)],
         )
         try:
             reduce_write_quorum(outcomes, write_quorum, bucket, obj)
         except Exception:
-            parallel_map([lambda d=d: d.delete(SYS_VOL, tmp_rel) for d in shuffled])
+            parallel_map([lambda d=d: d.delete(SYS_VOL, tmp_rel)
+                          for d in shuffled],
+                         deadline=self._meta_deadline())
             raise
         return PartInfoResult(part_number, md5_hex, total, total, mod_time)
 
@@ -192,7 +204,8 @@ class MultipartMixin:
         # Union of part numbers across drives — a single drive may have
         # missed a part write within quorum tolerance.
         listings = parallel_map(
-            [lambda d=d: d.list_dir(SYS_VOL, mp) for d in self.drives]
+            [lambda d=d: d.list_dir(SYS_VOL, mp) for d in self.drives],
+            deadline=self._meta_deadline(),
         )
         numbers: set[int] = set()
         for names in listings:
@@ -219,7 +232,8 @@ class MultipartMixin:
         # Union of session dirs across all drives, then quorum-read each.
         sessions: set[str] = set()
         listings = parallel_map(
-            [lambda d=d: d.list_dir(SYS_VOL, MP_ROOT) for d in self.drives]
+            [lambda d=d: d.list_dir(SYS_VOL, MP_ROOT) for d in self.drives],
+            deadline=self._meta_deadline(),
         )
         for i, hash_dirs in enumerate(listings):
             if isinstance(hash_dirs, Exception):
@@ -249,8 +263,11 @@ class MultipartMixin:
     def abort_multipart_upload(self, bucket: str, obj: str, upload_id: str) -> None:
         self._read_mp_meta(bucket, obj, upload_id)
         mp = self._mp_dir(bucket, obj, upload_id)
+        # Data-class deadline: a session rmtree is O(parts) of I/O.
         parallel_map(
-            [lambda d=d: d.delete(SYS_VOL, mp, recursive=True) for d in self.drives]
+            [lambda d=d: d.delete(SYS_VOL, mp, recursive=True)
+             for d in self.drives],
+            deadline=self._data_deadline(),
         )
 
     def complete_multipart_upload(
@@ -330,8 +347,14 @@ class MultipartMixin:
         # (reference takes the dist lock around CompleteMultipartUpload's
         # whole rename commit).
         with self.nslock.lock(bucket, obj) as lease:
+            # mtpu: allow(MTPU001) - no outer envelope: commit is
+            # O(parts) sequential renames, each already deadline-bounded
+            # at the drive layer, so every task terminates with a typed
+            # outcome; stamping a commit OperationTimedOut would leave
+            # the abandoned worker racing restore_session's rollback
+            # (rename_data landing after restore pulled the parts back).
             outcomes = parallel_map(
-                [lambda i=i, d=d: commit(i, d) for i, d in enumerate(shuffled)]
+                [lambda i=i, d=d: commit(i, d) for i, d in enumerate(shuffled)],
             )
 
             def restore_session():
@@ -367,6 +390,10 @@ class MultipartMixin:
                     except se.StorageError:
                         pass
 
+                # mtpu: allow(MTPU001) - the rollback must run to
+                # completion on every drive (abandoning it mid-flight
+                # strands a half-restored session the client's retry
+                # then sees as InvalidPart); inner ops are drive-bounded.
                 parallel_map([lambda i=i, d=d: restore(i, d)
                               for i, d in enumerate(shuffled)])
 
@@ -396,10 +423,15 @@ class MultipartMixin:
             elif tokens[i]:
                 drive.commit_rename(tokens[i])
 
+        # Data-class deadlines: both reclaim O(parts) trees (tmp
+        # leftovers / the session dir).
         parallel_map([lambda i=i, d=d: post_commit(i, d)
-                      for i, d in enumerate(shuffled)])
+                      for i, d in enumerate(shuffled)],
+                     deadline=self._data_deadline())
         parallel_map(
-            [lambda d=d: d.delete(SYS_VOL, mp, recursive=True) for d in self.drives]
+            [lambda d=d: d.delete(SYS_VOL, mp, recursive=True)
+             for d in self.drives],
+            deadline=self._data_deadline(),
         )
         if self.mrf is not None and any(isinstance(o, Exception) for o in outcomes):
             self.mrf.add_partial(bucket, obj, fi.version_id)

@@ -204,6 +204,9 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
     def _data_deadline(self) -> float:
         return _health.fleet_deadlines(self.drives)[1]
 
+    def _walk_deadline(self) -> float:
+        return _health.fleet_deadlines(self.drives)[2]
+
     def _drives_all_online(self) -> bool:
         for d in self.drives:
             if isinstance(d, _health.HealthChecker) and d.state != _health.ONLINE:

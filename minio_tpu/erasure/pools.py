@@ -47,6 +47,13 @@ class ErasureServerPools:
         for p in self.pools:
             p.close()
 
+    def _layer_deadline(self, cls: str = "meta") -> float:
+        """Envelope for a fan-out over whole pools: one hop above the
+        set-layer envelope (each pool op is a deadline-bounded set
+        fan-out that resolves within ~2x its own deadline). `cls` must
+        match the inner op's deadline class."""
+        return 2.0 * max(p._layer_deadline(cls) for p in self.pools)
+
     # -- pool choice --
 
     def _pool_free(self, pool: ErasureSets) -> int:
@@ -69,7 +76,8 @@ class ErasureServerPools:
         re-routed by free capacity (which would split versions across pools)."""
         results = parallel_map(
             [lambda p=p: p.latest_fileinfo(bucket, obj, version_id)
-             for p in self.pools]
+             for p in self.pools],
+            deadline=self._layer_deadline(),
         )
         best, best_mt = None, -1.0
         for i, r in enumerate(results):
@@ -99,7 +107,8 @@ class ErasureServerPools:
 
     def make_bucket(self, bucket: str, opts: ObjectOptions | None = None) -> None:
         outcomes = parallel_map([lambda p=p: p.make_bucket(bucket, opts)
-                                 for p in self.pools])
+                                 for p in self.pools],
+                                deadline=self._layer_deadline())
         for o in outcomes:
             if isinstance(o, Exception):
                 raise o
@@ -112,7 +121,8 @@ class ErasureServerPools:
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         outcomes = parallel_map(
-            [lambda p=p: p.delete_bucket(bucket, force=force) for p in self.pools]
+            [lambda p=p: p.delete_bucket(bucket, force=force) for p in self.pools],
+            deadline=self._layer_deadline("data"),
         )
         for o in outcomes:
             if isinstance(o, Exception):

@@ -76,6 +76,18 @@ class ErasureSets:
         for s in self.sets:
             s.close()
 
+    def _layer_deadline(self, cls: str = "meta") -> float:
+        """Envelope for a fan-out over whole sets: each inner drive
+        fan-out resolves its stragglers within ~2x its own adaptive
+        deadline (deadline + queued-grace), and a bucket op does at most
+        a couple of sequential drive hops per set — 4x the slowest set's
+        deadline bounds that without racing healthy-but-busy sets. `cls`
+        must match the inner op's deadline class (delete_bucket rmtrees
+        under the data deadline; metadata ops under meta)."""
+        per_set = {"meta": lambda s: s._meta_deadline(),
+                   "data": lambda s: s._data_deadline()}[cls]
+        return 4.0 * max(per_set(s) for s in self.sets)
+
     # -- routing (cmd/erasure-sets.go:716-736) --
 
     def get_hashed_set(self, obj: str) -> ErasureObjects:
@@ -85,7 +97,8 @@ class ErasureSets:
 
     def make_bucket(self, bucket: str, opts: ObjectOptions | None = None) -> None:
         outcomes = parallel_map([lambda s=s: s.make_bucket(bucket, opts)
-                                 for s in self.sets])
+                                 for s in self.sets],
+                                deadline=self._layer_deadline())
         for o in outcomes:
             if isinstance(o, Exception):
                 raise o
@@ -98,7 +111,8 @@ class ErasureSets:
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         outcomes = parallel_map(
-            [lambda s=s: s.delete_bucket(bucket, force=force) for s in self.sets]
+            [lambda s=s: s.delete_bucket(bucket, force=force) for s in self.sets],
+            deadline=self._layer_deadline("data"),
         )
         for o in outcomes:
             if isinstance(o, Exception):
@@ -191,9 +205,14 @@ class ErasureSets:
 
     def list_multipart_uploads(self, bucket: str, prefix: str = "",
                                max_uploads: int = 1000) -> list[MultipartInfo]:
+        # mtpu: allow(MTPU001) - no fixed envelope fits: the inner op is
+        # O(active sessions) sequential meta fan-outs, each already
+        # deadline-bounded at the drive layer, so the whole call
+        # terminates; an outer deadline sized for a few hops would stamp
+        # busy sets OperationTimedOut and silently truncate the listing.
         results = parallel_map(
             [lambda s=s: s.list_multipart_uploads(bucket, prefix, max_uploads)
-             for s in self.sets]
+             for s in self.sets],
         )
         if all(isinstance(r, Exception) for r in results):
             raise results[0]

@@ -33,7 +33,8 @@ class SysConfigStore:
         path; this store's analogue is repair-on-read)."""
         rel = f"{CONFIG_PREFIX}/{path}"
         results = parallel_map(
-            [lambda d=d: d.read_all(SYS_VOL, rel) for d in self.drives]
+            [lambda d=d: d.read_all(SYS_VOL, rel) for d in self.drives],
+            deadline=self._meta_deadline(),
         )
         tally: dict[bytes, tuple[int, bytes]] = {}
         for r in results:
@@ -71,20 +72,23 @@ class SysConfigStore:
                 # Best-effort: a drive that fails the repair write stays
                 # divergent and is retried on the next read.
                 parallel_map([lambda d=d: d.write_all(SYS_VOL, rel, data)
-                              for d in lag])
+                              for d in lag],
+                             deadline=self._meta_deadline())
         return data
 
     def write_sys_config(self, path: str, data: bytes) -> None:
         rel = f"{CONFIG_PREFIX}/{path}"
         results = parallel_map(
-            [lambda d=d: d.write_all(SYS_VOL, rel, data) for d in self.drives]
+            [lambda d=d: d.write_all(SYS_VOL, rel, data) for d in self.drives],
+            deadline=self._meta_deadline(),
         )
         reduce_write_quorum(results, self._write_quorum_meta(), SYS_VOL, path)
 
     def delete_sys_config(self, path: str) -> None:
         rel = f"{CONFIG_PREFIX}/{path}"
         results = parallel_map(
-            [lambda d=d: d.delete(SYS_VOL, rel) for d in self.drives]
+            [lambda d=d: d.delete(SYS_VOL, rel) for d in self.drives],
+            deadline=self._meta_deadline(),
         )
         results = [None if isinstance(r, se.FileNotFound) else r
                    for r in results]
@@ -96,7 +100,8 @@ class SysConfigStore:
         rel = f"{CONFIG_PREFIX}/{prefix}".rstrip("/")
         names: set[str] = set()
         results = parallel_map(
-            [lambda d=d: _walk_names(d, rel) for d in self.drives]
+            [lambda d=d: _walk_names(d, rel) for d in self.drives],
+            deadline=self._walk_deadline(),
         )
         for r in results:
             if isinstance(r, set):

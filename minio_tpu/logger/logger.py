@@ -54,6 +54,8 @@ class FileTarget:
     def send(self, entry: dict) -> None:
         line = json.dumps(entry, separators=(",", ":")) + "\n"
         with self._mu:
+            # mtpu: allow(MTPU002) - the lock exists to serialize appends:
+            # the audit trail must be durable before send() returns
             with open(self.path, "a", encoding="utf-8") as f:
                 f.write(line)
 

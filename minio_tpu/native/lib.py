@@ -29,6 +29,8 @@ def _build_and_load():
         try:
             if (not os.path.exists(so)
                     or os.path.getmtime(so) < os.path.getmtime(src)):
+                # mtpu: allow(MTPU002) - build-once gate: _mu must be held
+                # across make so concurrent first callers don't race it
                 subprocess.run(["make", "-C", _REPO_NATIVE],
                                check=True, capture_output=True, timeout=120)
             lib = ctypes.CDLL(so)
@@ -428,6 +430,7 @@ def pyext():
             src = os.path.join(_REPO_NATIVE, "mtpu_pyext.c")
             if (not os.path.exists(so)
                     or os.path.getmtime(so) < os.path.getmtime(src)):
+                # mtpu: allow(MTPU002) - same build-once gate as _load()
                 subprocess.run(["make", "-C", _REPO_NATIVE], check=True,
                                capture_output=True, timeout=120)
             if os.path.exists(so):

@@ -37,6 +37,20 @@ _BUS = PubSub()
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "mtpu_span", default=None)
 
+# The closed set of trace record types that may ride the bus. Consumers
+# key on it (admin trace stream `?type=` filtering, docs/TRACING.md), and
+# static rule MTPU006 checks every `obs.publish`/`obs.span` call site
+# against it — add the type here (and to the docs) when introducing a
+# new record shape.
+RECORD_TYPES = frozenset({
+    "internal",   # obs.span default: engine-internal timed sections
+    "http",       # S3 front door request records
+    "storage",    # per-drive op records (local + remote)
+    "drive",      # drive health state transitions
+    "rpc",        # peer fabric round trips
+    "kernel",     # device-plane kernel launches
+})
+
 # --- trace context -----------------------------------------------------------
 
 _trace_id: contextvars.ContextVar = contextvars.ContextVar(

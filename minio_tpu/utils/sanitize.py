@@ -1,0 +1,374 @@
+"""Runtime sanitizers: lock-order (deadlock) tracking + thread-leak
+detection, armed by tests/conftest.py across the tier-1 suite.
+
+The static rules (tools/check) prove properties of call sites; these two
+sanitizers prove properties only an execution can show:
+
+**Lock-order tracker.** `install()` patches `threading.Lock`/`RLock` so
+locks *created by minio_tpu code* come back wrapped. Each wrapper knows
+its creation site (`file:line`); every blocking acquire taken while the
+thread already holds other tracked locks records a site→site edge into a
+process-global acquisition graph. A cycle in that graph is a latent
+ABBA deadlock — two code paths that take the same two locks in opposite
+orders — even if the interleaving that would actually deadlock never
+fired in the run. `check_lock_cycles()` reports cycles; the conftest
+session guard asserts there are none.
+
+Scope limits, on purpose:
+
+- Only locks created from inside `minio_tpu/` are wrapped: stdlib and
+  third-party locks (including the RLock `threading.Condition()` mints
+  for itself — its caller frame is threading.py) stay raw, so the
+  tracker can't break Condition's `_is_owned` protocol or slow down
+  foreign code.
+- Leaf-only hot modules (`obs/histogram.py` — one short lock per
+  observe on every request; `erasure/metadata.py` — a fresh result-slot
+  mutex per deadline'd fan-out) are excluded: their locks never wrap
+  other acquisitions, so they can't participate in a cycle, and
+  wrapping them would tax exactly the paths the obs layer promises are
+  cheap.
+- Edges are keyed by creation site, not instance, so ABBA between two
+  *code paths* is caught even when every individual run is benign.
+  The tradeoff: same-site edges (two instances from one constructor
+  line, e.g. parent/child of one class) are skipped — instance-keyed
+  graphs on those almost never complete a cycle in one process run,
+  and site-keyed self-edges would false-positive on hierarchical
+  same-class locking.
+
+**Thread-leak detector.** `thread_snapshot()` before a test,
+`leaked_threads()` after: any non-daemon thread born during the test
+that survives a short grace join is a leak — an executor without
+shutdown, a worker without a close() path. Threads whose name prefix
+marks them as owned by process-lifetime engine objects are exempt (see
+ALLOWED_THREAD_PREFIXES; every minio_tpu background thread is daemon
+by policy, so anything non-daemon and unexempt is ad-hoc).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import _thread
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Creation-site files whose locks are leaf-only and acquire-hot (see
+# module docstring) — never wrapped.
+EXCLUDED_SITE_FILES = (
+    os.path.join("obs", "histogram.py"),
+    os.path.join("erasure", "metadata.py"),
+    os.path.join("utils", "sanitize.py"),
+)
+
+# Non-daemon thread-name prefixes owned by process-lifetime objects:
+# the shared drive-I/O pool (erasure/metadata.py, process-global by
+# design), per-engine shard-read pools and dsync broadcast pools whose
+# lifetime is the server's (session fixtures), and asyncio's default
+# executor workers.
+ALLOWED_THREAD_PREFIXES = ("mtpu-io", "shard-read", "dsync", "asyncio_")
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+_armed = False
+_graph_mu = _REAL_LOCK()
+# (src_site, dst_site) -> thread name that first recorded the edge.
+_edges: dict[tuple[str, str], str] = {}
+_held = threading.local()  # .stack: list[tracked lock wrappers]
+
+
+def _held_stack() -> list:
+    st = getattr(_held, "stack", None)
+    if st is None:
+        st = _held.stack = []
+    return st
+
+
+def _note_edges(dst_site: str) -> None:
+    for w in _held_stack():
+        src = w._site
+        if src == dst_site:
+            continue
+        key = (src, dst_site)
+        if key not in _edges:  # racy pre-check: adds are idempotent
+            with _graph_mu:
+                _edges.setdefault(key, threading.current_thread().name)
+
+
+class _TrackedLock:
+    __slots__ = ("_inner", "_site", "_holder_stack")
+
+    def __init__(self, site: str):
+        self._inner = _REAL_LOCK()
+        self._site = site
+        # The acquirer's thread-local held list. threading.Lock legally
+        # supports cross-thread release (handoff patterns), so release()
+        # must pop the ACQUIRER's stack, not the releasing thread's —
+        # else the stale entry mints phantom edges from every later
+        # acquire on the acquirer's thread.
+        self._holder_stack = None
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if blocking:
+            _note_edges(self._site)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            st = _held_stack()
+            st.append(self)
+            self._holder_stack = st
+        return got
+
+    def release(self) -> None:
+        st = self._holder_stack
+        self._holder_stack = None
+        self._inner.release()
+        if st is None:
+            st = _held_stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] is self:
+                del st[i]
+                break
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<TrackedLock {self._site} {self._inner!r}>"
+
+
+class _TrackedRLock:
+    __slots__ = ("_inner", "_site", "_owner", "_count")
+
+    def __init__(self, site: str):
+        self._inner = _REAL_RLOCK()
+        self._site = site
+        self._owner = None
+        self._count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        me = _thread.get_ident()
+        if self._owner == me:
+            got = self._inner.acquire(blocking, timeout)
+            if got:
+                self._count += 1
+            return got
+        if blocking:
+            _note_edges(self._site)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._owner = me
+            self._count = 1
+            _held_stack().append(self)
+        return got
+
+    def release(self) -> None:
+        if self._owner != _thread.get_ident():
+            # Not the owner: delegate so the real RLock raises its
+            # RuntimeError WITHOUT touching _owner/_count — clobbering
+            # them here would corrupt the true owner's recursion state
+            # and turn a loud bug into a silent deadlock.
+            self._inner.release()
+            return
+        if self._count > 1:
+            self._count -= 1
+            self._inner.release()
+            return
+        self._owner = None
+        self._count = 0
+        self._inner.release()
+        st = _held_stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] is self:
+                del st[i]
+                break
+
+    # Condition compatibility (if one is ever built over a tracked
+    # RLock): ownership is tracked here, not via the C fast path, and
+    # wait() must fully release a recursively held lock via
+    # _release_save / _acquire_restore (plain release() only drops one
+    # recursion level — the waiter would park still holding the lock).
+    def _is_owned(self) -> bool:
+        return self._owner == _thread.get_ident()
+
+    def _release_save(self) -> int:
+        count = self._count
+        self._owner = None
+        self._count = 0
+        st = _held_stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] is self:
+                del st[i]
+                break
+        for _ in range(count):
+            self._inner.release()
+        return count
+
+    def _acquire_restore(self, count: int) -> None:
+        _note_edges(self._site)
+        for _ in range(count):
+            self._inner.acquire()
+        self._owner = _thread.get_ident()
+        self._count = count
+        _held_stack().append(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<TrackedRLock {self._site} {self._inner!r}>"
+
+
+def _wrap_site() -> str | None:
+    """Creation site ('relpath:line') when the creating frame is
+    minio_tpu code that should be tracked, else None."""
+    try:
+        frame = sys._getframe(2)
+    except ValueError:
+        return None
+    fname = frame.f_code.co_filename
+    if not fname.startswith(_PKG_DIR):
+        return None
+    rel = os.path.relpath(fname, os.path.dirname(_PKG_DIR))
+    for excluded in EXCLUDED_SITE_FILES:
+        if fname.endswith(excluded):
+            return None
+    return f"{rel}:{frame.f_lineno}"
+
+
+def _patched_lock():
+    if _armed:
+        site = _wrap_site()
+        if site is not None:
+            return _TrackedLock(site)
+    return _REAL_LOCK()
+
+
+def _patched_rlock():
+    if _armed:
+        site = _wrap_site()
+        if site is not None:
+            return _TrackedRLock(site)
+    return _REAL_RLOCK()
+
+
+def install() -> None:
+    """Arm the lock-order tracker: locks created by minio_tpu code from
+    now on are wrapped. Idempotent."""
+    global _armed
+    threading.Lock = _patched_lock
+    threading.RLock = _patched_rlock
+    _armed = True
+
+
+def uninstall() -> None:
+    """Disarm and restore the real factories (existing wrappers keep
+    working — they hold real inner locks)."""
+    global _armed
+    _armed = False
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+
+
+def reset_graph() -> None:
+    with _graph_mu:
+        _edges.clear()
+
+
+def lock_edges() -> dict[tuple[str, str], str]:
+    with _graph_mu:
+        return dict(_edges)
+
+
+def restore_edges(saved: dict[tuple[str, str], str]) -> None:
+    """Replace the graph with a previous lock_edges() snapshot — lets a
+    test exercise cycle detection without polluting the session graph
+    the conftest guard asserts on."""
+    with _graph_mu:
+        _edges.clear()
+        _edges.update(saved)
+
+
+def check_lock_cycles() -> list[list[str]]:
+    """Cycles in the site-level acquisition graph — each is a latent
+    ABBA deadlock. Returns [] when the order is a DAG."""
+    with _graph_mu:
+        adj: dict[str, set[str]] = {}
+        for (src, dst) in _edges:
+            adj.setdefault(src, set()).add(dst)
+    cycles: list[list[str]] = []
+    seen_cycles: set[tuple[str, ...]] = set()
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in adj}
+
+    def dfs(node: str, path: list[str]) -> None:
+        color[node] = GRAY
+        path.append(node)
+        for nxt in sorted(adj.get(node, ())):
+            if color.get(nxt, WHITE) == GRAY:
+                cyc = path[path.index(nxt):] + [nxt]
+                # Canonicalize rotation so each cycle reports once.
+                body = cyc[:-1]
+                k = body.index(min(body))
+                canon = tuple(body[k:] + body[:k])
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    cycles.append(list(canon) + [canon[0]])
+            elif color.get(nxt, WHITE) == WHITE:
+                dfs(nxt, path)
+        path.pop()
+        color[node] = BLACK
+
+    for n in sorted(adj):
+        if color.get(n, WHITE) == WHITE:
+            dfs(n, [])
+    return cycles
+
+
+# --- thread-leak detection -------------------------------------------------
+
+
+def thread_snapshot() -> set[threading.Thread]:
+    # Keyed on Thread objects, not idents: CPython recycles idents when
+    # a thread exits, so an ident-keyed snapshot would silently exempt a
+    # leak that happens to reuse a dead predecessor's ident.
+    return set(threading.enumerate())
+
+
+def _live_leaks(before: set[threading.Thread]) -> list[threading.Thread]:
+    out = []
+    for t in threading.enumerate():
+        if (t in before or t.daemon or not t.is_alive()
+                or t is threading.current_thread()):
+            continue
+        if t.name.startswith(ALLOWED_THREAD_PREFIXES):
+            continue
+        out.append(t)
+    return out
+
+
+def leaked_threads(before: set[threading.Thread],
+                   grace: float = 2.0) -> list[threading.Thread]:
+    """Non-daemon, non-exempt threads born since `before` that are still
+    alive after up to `grace` seconds — each one is a missing close()/
+    join()/shutdown() path."""
+    deadline = time.monotonic() + grace
+    leaks = _live_leaks(before)
+    while leaks and time.monotonic() < deadline:
+        time.sleep(0.05)
+        leaks = _live_leaks(before)
+    return leaks

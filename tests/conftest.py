@@ -30,6 +30,21 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# ---------------------------------------------------------------------------
+# Runtime sanitizers (minio_tpu/utils/sanitize.py, docs/ANALYSIS.md):
+# arm the lock-order tracker BEFORE any minio_tpu module is imported so
+# module-level and instance locks are created through the patched
+# factories. MTPU_SANITIZE=0 disarms both sanitizers (e.g. when
+# bisecting whether the tracker itself perturbs a timing-sensitive
+# repro).
+# ---------------------------------------------------------------------------
+
+from minio_tpu.utils import sanitize  # noqa: E402
+
+SANITIZE = os.environ.get("MTPU_SANITIZE", "1") != "0"
+if SANITIZE:
+    sanitize.install()
+
 # The boto3 conformance tier only exists where boto3 is installed; in
 # images without it the module is not collected at all rather than
 # reported as a permanent skip — the EXECUTING third-party tier in this
@@ -117,6 +132,36 @@ def bucket(client):
     r = client.put("/apitest")
     assert r.status_code in (200, 409), r.text
     return "apitest"
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_guard():
+    """Thread-leak sanitizer: no non-daemon, non-exempt thread born
+    during a test may survive it (sanitize.ALLOWED_THREAD_PREFIXES
+    exempts pools owned by session-lived engine objects)."""
+    if not SANITIZE:
+        yield
+        return
+    before = sanitize.thread_snapshot()
+    yield
+    leaks = sanitize.leaked_threads(before)
+    assert not leaks, (
+        "test leaked non-daemon threads (missing close()/join()/"
+        f"shutdown path): {[t.name for t in leaks]}")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lock_order_guard():
+    """Deadlock sanitizer: the lock acquisition graph recorded across
+    the whole session must stay a DAG — a cycle is a latent ABBA
+    deadlock even if this run never interleaved into it."""
+    yield
+    if not SANITIZE:
+        return
+    cycles = sanitize.check_lock_cycles()
+    assert not cycles, (
+        "lock-order cycles recorded (latent ABBA deadlock): "
+        + "; ".join(" -> ".join(c) for c in cycles))
 
 
 def pytest_configure(config):

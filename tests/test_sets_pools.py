@@ -184,6 +184,19 @@ def test_sets_is_object_layer(sets):
     assert isinstance(sets, ObjectLayer)
 
 
+def test_layer_deadline_tracks_inner_op_class(sets):
+    """The bucket-op fan-out envelope must cover the deadline class of
+    the inner op it wraps: delete_bucket rmtrees under the data-class
+    deadline (default 30 s), so a meta-sized envelope (~4 s under fast
+    traffic) would stamp a healthy-but-large force-delete as timed out
+    after the drive-level deletes already committed."""
+    meta = max(s._meta_deadline() for s in sets.sets)
+    data = max(s._data_deadline() for s in sets.sets)
+    assert sets._layer_deadline("meta") >= 4.0 * meta
+    assert sets._layer_deadline("data") >= 4.0 * data
+    assert sets._layer_deadline("data") > sets._layer_deadline("meta")
+
+
 # ---------------- pools ----------------
 
 

@@ -1,0 +1,869 @@
+"""Tier-1 enforcement + fixture tests for the project-native static
+analysis (tools/check, docs/ANALYSIS.md) and the runtime sanitizers
+(minio_tpu/utils/sanitize.py).
+
+Layout:
+
+- `test_tree_is_clean` IS the CI gate: the full framework over
+  minio_tpu/ with the committed baseline — zero new findings, zero
+  stale baseline rows, zero parse errors.
+- Per-rule fixture tests: positive (fires), negative (stays quiet),
+  suppressed (`# mtpu: allow(...)`), baselined — tiny synthetic
+  minio_tpu/ trees under tmp_path.
+- Baseline mechanics: counts, staleness.
+- Sanitizer units: ABBA cycle detection, reentrant RLock tracking,
+  thread-leak reporting + prefix exemption.
+"""
+
+from __future__ import annotations
+
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from minio_tpu.utils import sanitize
+from tools import check as tc
+from tools.check import baseline_rows
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def run_fixture(tmp_path: Path, relpath: str, source: str, rule: str,
+                baseline=None, extra: dict[str, str] | None = None):
+    """Write `source` at tmp_path/relpath (plus any extra files) and run
+    one rule over it with an empty (or given) baseline."""
+    for rel, body in {relpath: source, **(extra or {})}.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+    return tc.run(tmp_path, files=[relpath], rule_ids=[rule],
+                  baseline=baseline or [])
+
+
+# ---------------------------------------------------------------------------
+# The gate
+# ---------------------------------------------------------------------------
+
+
+def test_tree_is_clean():
+    """The committed tree has zero non-baselined findings and zero stale
+    baseline rows — the tier-1 static gate."""
+    result = tc.run(ROOT)
+    assert not result.errors, result.errors
+    assert not result.new, "new static-analysis findings:\n" + "\n".join(
+        f"  {f.location()}: {f.rule}: {f.message}" for f in result.new)
+    assert not result.stale, (
+        "stale baseline rows (fix burned down a finding — delete its "
+        f"row from tools/check/baseline.json): {result.stale}")
+
+
+def test_all_six_rules_registered():
+    rules = tc.all_rules()
+    assert set(rules) == {"MTPU001", "MTPU002", "MTPU003", "MTPU004",
+                          "MTPU005", "MTPU006"}
+
+
+# ---------------------------------------------------------------------------
+# MTPU001 — fan-out deadline / ctx_wrap
+# ---------------------------------------------------------------------------
+
+_MTPU001_POS = """
+    from minio_tpu.erasure.metadata import parallel_map
+
+    def fan(drives, pool, fn):
+        results = parallel_map([lambda d=d: d.stat() for d in drives])
+        fut = pool.submit(fn, 1)
+        return results, fut
+"""
+
+_MTPU001_NEG = """
+    from minio_tpu import obs
+    from minio_tpu.erasure.metadata import parallel_map
+
+    def fan(drives, pool, fn, deadline):
+        results = parallel_map([lambda d=d: d.stat() for d in drives],
+                               deadline=deadline)
+        fut = pool.submit(obs.ctx_wrap(fn), 1)
+        wrapped = obs.ctx_wrap(fn)
+        fut2 = pool.submit(wrapped, 2)
+        return results, fut, fut2
+"""
+
+
+def test_mtpu001_positive(tmp_path):
+    r = run_fixture(tmp_path, "minio_tpu/erasure/fix.py", _MTPU001_POS,
+                    "MTPU001")
+    assert len(r.new) == 2
+    assert {"parallel_map" in f.message or "submit" in f.message
+            for f in r.new} == {True}
+
+
+def test_mtpu001_negative(tmp_path):
+    r = run_fixture(tmp_path, "minio_tpu/erasure/fix.py", _MTPU001_NEG,
+                    "MTPU001")
+    assert not r.new
+
+
+def test_mtpu001_out_of_scope_package(tmp_path):
+    # Request-path packages only: ops/ fan-outs are not its business.
+    r = run_fixture(tmp_path, "minio_tpu/ops/fix.py", _MTPU001_POS,
+                    "MTPU001")
+    assert not r.new
+
+
+def test_mtpu001_suppressed(tmp_path):
+    src = """
+    from minio_tpu.erasure.metadata import parallel_map
+
+    def fan(drives):
+        # mtpu: allow(MTPU001) - boot path, no request deadline yet
+        return parallel_map([lambda d=d: d.stat() for d in drives])
+    """
+    r = run_fixture(tmp_path, "minio_tpu/erasure/fix.py", src, "MTPU001")
+    assert not r.new and len(r.suppressed) == 1
+
+
+def test_mtpu001_baselined(tmp_path):
+    r = run_fixture(tmp_path, "minio_tpu/erasure/fix.py", _MTPU001_POS,
+                    "MTPU001")
+    rows = baseline_rows(r.new)
+    r2 = run_fixture(tmp_path, "minio_tpu/erasure/fix.py", _MTPU001_POS,
+                     "MTPU001", baseline=rows)
+    assert not r2.new and len(r2.baselined) == 2 and not r2.stale
+
+
+# ---------------------------------------------------------------------------
+# MTPU002 — blocking under lock
+# ---------------------------------------------------------------------------
+
+
+def test_mtpu002_positive(tmp_path):
+    src = """
+    import threading
+    import time
+
+    class C:
+        def __init__(self):
+            self._mu = threading.Lock()
+
+        def bad(self, fut, sock):
+            with self._mu:
+                time.sleep(0.1)
+                fut.result()
+                sock.recv(4096)
+    """
+    r = run_fixture(tmp_path, "minio_tpu/dist/fix.py", src, "MTPU002")
+    assert len(r.new) == 3
+
+
+def test_mtpu002_negative(tmp_path):
+    src = """
+    import threading
+    import time
+
+    class C:
+        def __init__(self):
+            self._mu = threading.Lock()
+
+        def ok(self, fut):
+            with self._mu:
+                x = 1  # memory-only work under the lock
+
+            time.sleep(0.0)  # outside the lock
+            fut.result()
+
+            with self._mu:
+                def later():
+                    # deferred: runs outside the lock's critical section
+                    time.sleep(0.1)
+                cb = later
+            return cb, x
+
+        def not_a_lock(self, other, fut):
+            with other:
+                fut.result()
+    """
+    r = run_fixture(tmp_path, "minio_tpu/dist/fix.py", src, "MTPU002")
+    assert not r.new
+
+
+def test_mtpu002_fanout_under_lock(tmp_path):
+    src = """
+    import threading
+
+    from minio_tpu.erasure.metadata import parallel_map
+
+    _mu = threading.Lock()
+
+    def bad(fns, deadline):
+        with _mu:
+            return parallel_map(fns, deadline=deadline)
+    """
+    r = run_fixture(tmp_path, "minio_tpu/erasure/fix.py", src, "MTPU002")
+    assert len(r.new) == 1 and "fan-out" in r.new[0].message
+
+
+def test_mtpu002_suppressed(tmp_path):
+    src = """
+    import threading
+
+    _mu = threading.Lock()
+
+    def send(line, path):
+        with _mu:
+            # mtpu: allow(MTPU002) - the lock exists to serialize appends
+            with open(path, "a") as f:
+                f.write(line)
+    """
+    r = run_fixture(tmp_path, "minio_tpu/logger/fix.py", src, "MTPU002")
+    assert not r.new and len(r.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# MTPU003 — swallowed broad except
+# ---------------------------------------------------------------------------
+
+
+def test_mtpu003_positive(tmp_path):
+    src = """
+    def f(x):
+        try:
+            return x()
+        except Exception:
+            pass
+
+    def g(x):
+        try:
+            return x()
+        except BaseException:
+            return None
+    """
+    r = run_fixture(tmp_path, "minio_tpu/s3/fix.py", src, "MTPU003")
+    assert len(r.new) == 2
+
+
+def test_mtpu003_negative(tmp_path):
+    src = """
+    import logging
+
+    def reraises(x):
+        try:
+            return x()
+        except Exception:
+            raise
+
+    def logs(x):
+        try:
+            return x()
+        except Exception as e:
+            logging.warning("failed: %s", e)
+            return None
+
+    def converts(x, results, i):
+        try:
+            results[i] = x()
+        except Exception as e:
+            results[i] = e
+
+    def narrow(x):
+        try:
+            return x()
+        except ValueError:
+            return None
+    """
+    r = run_fixture(tmp_path, "minio_tpu/s3/fix.py", src, "MTPU003")
+    assert not r.new
+
+
+def test_mtpu003_suppressed_and_baselined(tmp_path):
+    src = """
+    def teardown(conn):
+        try:
+            conn.close()
+        # mtpu: allow(MTPU003) - teardown only
+        except Exception:
+            pass
+
+    def swallow(x):
+        try:
+            return x()
+        except Exception:
+            return None
+    """
+    r = run_fixture(tmp_path, "minio_tpu/dist/fix.py", src, "MTPU003")
+    assert len(r.new) == 1 and len(r.suppressed) == 1
+    rows = baseline_rows(r.new)
+    r2 = run_fixture(tmp_path, "minio_tpu/dist/fix.py", src, "MTPU003",
+                     baseline=rows)
+    assert not r2.new and len(r2.baselined) == 1
+
+
+# ---------------------------------------------------------------------------
+# MTPU004 — JAX hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_mtpu004_positive(tmp_path):
+    src = """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    _CACHE = {}
+
+    @jax.jit
+    def kernel(x):
+        scale = len(_CACHE)            # mutable capture
+        t = time.time()                # trace-time nondeterminism
+        return x * scale + t
+
+    def pipeline(batch):
+        out = kernel(batch)
+        host = np.asarray(out)         # sync outside a designated point
+        jax.block_until_ready(out)     # explicit sync
+        return host
+    """
+    r = run_fixture(tmp_path, "minio_tpu/ops/fix.py", src, "MTPU004")
+    msgs = " | ".join(f.message for f in r.new)
+    assert len(r.new) == 4, msgs
+    assert "TRACE time" in msgs and "mutable" in msgs
+    assert "np.asarray" in msgs and "host sync" in msgs
+
+
+def test_mtpu004_negative(tmp_path):
+    src = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    K = 8  # immutable module constant is fine to close over
+
+    @jax.jit
+    def kernel(x):
+        return x * K
+
+    def digest_host(batch):
+        # designated host boundary: *_host functions may sync
+        return np.asarray(kernel(batch))
+
+    def tables():
+        # np.asarray over host data is not a sync
+        return np.asarray([1, 2, 3], dtype=np.uint8)
+    """
+    r = run_fixture(tmp_path, "minio_tpu/ops/fix.py", src, "MTPU004")
+    assert not r.new, [f.message for f in r.new]
+
+
+def test_mtpu004_jitted_by_assignment_and_scope(tmp_path):
+    src = """
+    import time
+
+    import jax
+
+    def step(x):
+        return x + time.time()
+
+    step_fast = jax.jit(step)
+    """
+    r = run_fixture(tmp_path, "minio_tpu/native/fix.py", src, "MTPU004")
+    assert len(r.new) == 1 and "TRACE time" in r.new[0].message
+    # Same file outside ops/ and native/ is out of scope.
+    r2 = run_fixture(tmp_path, "minio_tpu/s3/fix.py", src, "MTPU004")
+    assert not r2.new
+
+
+def test_mtpu004_suppressed_sync_point(tmp_path):
+    src = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def kernel(x):
+        return x * 2
+
+    def collect(batch):
+        out = kernel(batch)
+        # mtpu: allow(MTPU004) - designated sync point: launch boundary
+        return np.asarray(out)
+    """
+    r = run_fixture(tmp_path, "minio_tpu/ops/fix.py", src, "MTPU004")
+    assert not r.new and len(r.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# MTPU005 — hot-path copies
+# ---------------------------------------------------------------------------
+
+
+def test_mtpu005_positive(tmp_path):
+    src = """
+    def stream(chunks, buf, n):
+        head = bytes(buf)
+        joined = b"".join(chunks)
+        tail = buf[n:]
+        return head, joined, tail
+    """
+    r = run_fixture(tmp_path, "minio_tpu/storage/local.py", src, "MTPU005")
+    assert len(r.new) == 3
+
+
+def test_mtpu005_scope_is_streaming_files_only(tmp_path):
+    src = "def f(buf, n):\n    return bytes(buf), buf[n:]\n"
+    r = run_fixture(tmp_path, "minio_tpu/storage/other.py", src, "MTPU005")
+    assert not r.new
+
+
+def test_mtpu005_negative(tmp_path):
+    src = """
+    def stream(chunks, buf, n, drives, k):
+        view = memoryview(buf)[n:]   # memoryview slice: no copy
+        sep = ", ".join(chunks)      # str join untouched
+        quorum = drives[:k]          # list slice is not a buffer copy
+        return view, sep, quorum
+    """
+    r = run_fixture(tmp_path, "minio_tpu/s3/server.py", src, "MTPU005")
+    assert not r.new, [f.message for f in r.new]
+
+
+def test_mtpu005_baselined_worklist(tmp_path):
+    src = "def f(buf):\n    return bytes(buf)\n"
+    r = run_fixture(tmp_path, "minio_tpu/erasure/objects.py", src, "MTPU005")
+    rows = baseline_rows(r.new)
+    r2 = run_fixture(tmp_path, "minio_tpu/erasure/objects.py", src,
+                     "MTPU005", baseline=rows)
+    assert not r2.new and len(r2.baselined) == 1
+
+
+# ---------------------------------------------------------------------------
+# MTPU006 — obs drift
+# ---------------------------------------------------------------------------
+
+_OBS_EXTRA = {
+    "docs/METRICS.md": """
+    | `minio_tpu_documented_total` | counter | — | documented |
+    """,
+    "minio_tpu/obs/span.py": """
+    RECORD_TYPES = frozenset({"internal", "http"})
+    """,
+}
+
+
+def test_mtpu006_positive(tmp_path):
+    src = """
+    import time
+
+    from minio_tpu import obs
+
+    _C = obs.counter("minio_tpu_undocumented_total", "nope")
+
+    def publishes():
+        obs.publish({"type": "mystery", "time": time.time()})
+        rec = {"type": "also_mystery", "time": time.time()}
+        obs.publish(rec)
+        with obs.span("op", "rogue"):
+            pass
+    """
+    r = run_fixture(tmp_path, "minio_tpu/event/fix.py", src, "MTPU006",
+                    extra=_OBS_EXTRA)
+    msgs = [f.message for f in r.new]
+    assert len(r.new) == 4, msgs
+    assert sum("not documented" in m for m in msgs) == 1
+    assert sum("RECORD_TYPES" in m for m in msgs) == 3
+
+
+def test_mtpu006_negative(tmp_path):
+    src = """
+    import time
+
+    from minio_tpu import obs
+
+    _C = obs.counter("minio_tpu_documented_total", "yep")
+
+    def publishes():
+        obs.publish({"type": "http", "time": time.time()})
+        with obs.span("op"):
+            pass
+        with obs.span("op2", "internal"):
+            pass
+    """
+    r = run_fixture(tmp_path, "minio_tpu/event/fix.py", src, "MTPU006",
+                    extra=_OBS_EXTRA)
+    assert not r.new, [f.message for f in r.new]
+
+
+def test_mtpu006_real_registry_matches_span_py():
+    types = tc.rules.mtpu006_obs_drift._registered_types(ROOT)
+    assert types is not None and "internal" in types and "http" in types
+
+
+# ---------------------------------------------------------------------------
+# Baseline mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_stale_baseline_row_fails(tmp_path):
+    """A baseline row matching no current finding is stale — the gate
+    fails until the row is deleted (the file can only shrink)."""
+    src = "def f(x):\n    return x\n"
+    rows = [{"rule": "MTPU003", "path": "minio_tpu/s3/fix.py",
+             "content": "except Exception:", "count": 1}]
+    r = run_fixture(tmp_path, "minio_tpu/s3/fix.py", src, "MTPU003",
+                    baseline=rows)
+    assert r.stale and not r.ok
+
+
+def test_baseline_count_excess_is_new(tmp_path):
+    """Two identical findings against a count-1 row: one baselined, one
+    new — duplicating a grandfathered pattern still fails."""
+    src = ("def f(x):\n"
+           "    try:\n"
+           "        return x()\n"
+           "    except Exception:\n"
+           "        pass\n"
+           "\n"
+           "def g(x):\n"
+           "    try:\n"
+           "        return x()\n"
+           "    except Exception:\n"
+           "        pass\n")
+    r = run_fixture(tmp_path, "minio_tpu/s3/fix.py", src, "MTPU003")
+    assert len(r.new) == 2
+    rows = baseline_rows(r.new[:1])
+    r2 = run_fixture(tmp_path, "minio_tpu/s3/fix.py", src, "MTPU003",
+                     baseline=rows)
+    assert len(r2.new) == 1 and len(r2.baselined) == 1 and not r2.stale
+
+
+def test_baseline_subset_runs_do_not_report_foreign_stale(tmp_path):
+    """Rows for rules/files outside the checked subset are ignored, not
+    stale — --rule/--changed runs must not demand full-tree context."""
+    src = "def f(x):\n    return x\n"
+    rows = [{"rule": "MTPU005", "path": "minio_tpu/s3/server.py",
+             "content": "return bytes(buf)", "count": 1}]
+    r = run_fixture(tmp_path, "minio_tpu/s3/fix.py", src, "MTPU003",
+                    baseline=rows)
+    assert not r.stale and not r.new
+
+
+def test_unknown_rule_id_rejected(tmp_path):
+    with pytest.raises(KeyError):
+        tc.run(tmp_path, files=[], rule_ids=["MTPU999"])
+
+
+def test_deleted_file_baseline_rows_go_stale(tmp_path):
+    """Rows for a file that no longer exists fail as stale on a
+    directory-scoped run — deleting or renaming a file can't leave rows
+    lingering to grandfather a future violation with the same content."""
+    (tmp_path / "minio_tpu").mkdir(parents=True)
+    (tmp_path / "minio_tpu" / "keep.py").write_text("x = 1\n")
+    rows = [{"rule": "MTPU003", "path": "minio_tpu/gone.py",
+             "content": "except Exception:", "count": 1}]
+    r = tc.run(tmp_path, baseline=rows)
+    assert r.stale and not r.ok
+
+
+def test_nonexistent_path_arg_fails_loudly(tmp_path):
+    """A typo'd path must raise, not silently check nothing and pass."""
+    (tmp_path / "minio_tpu").mkdir(parents=True)
+    (tmp_path / "minio_tpu" / "keep.py").write_text("x = 1\n")
+    with pytest.raises(tc.PathScopeError):
+        tc.run(tmp_path, paths=["minio_tpu/typo.py"])
+
+
+def test_empty_directory_arg_fails_loudly(tmp_path):
+    """An existing directory with zero .py files checks nothing — that
+    must raise too, not exit green while enforcing nothing."""
+    (tmp_path / "minio_tpu").mkdir(parents=True)
+    with pytest.raises(tc.PathScopeError):
+        tc.run(tmp_path)
+
+
+def test_path_outside_root_rejected(tmp_path):
+    repo = tmp_path / "repo"
+    (repo / "minio_tpu").mkdir(parents=True)
+    outside = tmp_path / "outside"
+    outside.mkdir()
+    (outside / "x.py").write_text("x = 1\n")
+    with pytest.raises(tc.PathScopeError):
+        tc.run(repo, paths=[str(outside)])
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_cli_json_and_rule_filter(capsys):
+    import json as json_mod
+
+    from tools.check.__main__ import main as cli_main
+
+    rc = cli_main(["--rule", "MTPU006", "--json"])
+    out = json_mod.loads(capsys.readouterr().out)
+    assert rc == 0 and out["ok"] and out["new"] == []
+
+
+def test_cli_nonexistent_path_is_an_error(capsys):
+    from tools.check.__main__ import main as cli_main
+
+    rc = cli_main(["minio_tpu/no_such_file.py"])
+    assert rc == 2
+    assert "no_such_file" in capsys.readouterr().err
+
+
+def test_cli_changed_rejects_positional_paths(capsys):
+    """--changed computes its own file list; a positional path would be
+    silently ignored — reject the combination instead."""
+    from tools.check.__main__ import main as cli_main
+
+    rc = cli_main(["--changed", "minio_tpu/s3"])
+    assert rc == 2
+    assert "conflict" in capsys.readouterr().err
+
+
+def test_cli_list_rules(capsys):
+    from tools.check.__main__ import main as cli_main
+
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "MTPU001" in out and "MTPU006" in out
+
+
+def test_worklist_doc_is_current(tmp_path):
+    """docs/ZEROCOPY_WORKLIST.md is generated from MTPU005 findings —
+    regenerating must be a no-op on a committed tree."""
+    from tools.check.__main__ import write_worklist
+
+    out = tmp_path / "wl.md"
+    assert write_worklist(ROOT, out) == 0
+    committed = (ROOT / "docs" / "ZEROCOPY_WORKLIST.md").read_text()
+    assert out.read_text() == committed, (
+        "stale docs/ZEROCOPY_WORKLIST.md — run "
+        "`python -m tools.check --worklist`")
+
+
+# ---------------------------------------------------------------------------
+# Runtime sanitizers
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_cycle_detection():
+    """ABBA across two sites is reported even though no run deadlocks:
+    the graph records order, not interleaving."""
+    saved = sanitize.lock_edges()
+    try:
+        sanitize.reset_graph()
+        a = sanitize._TrackedLock("fix.py:1")
+        b = sanitize._TrackedLock("fix.py:2")
+        with a:
+            with b:
+                pass
+        assert sanitize.check_lock_cycles() == []  # A->B alone is a DAG
+        with b:
+            with a:
+                pass
+        cycles = sanitize.check_lock_cycles()
+        assert len(cycles) == 1
+        assert set(cycles[0]) == {"fix.py:1", "fix.py:2"}
+    finally:
+        sanitize.restore_edges(saved)
+
+
+def test_lock_order_same_site_hierarchy_not_flagged():
+    saved = sanitize.lock_edges()
+    try:
+        sanitize.reset_graph()
+        parent = sanitize._TrackedLock("tree.py:9")
+        child = sanitize._TrackedLock("tree.py:9")
+        with parent:
+            with child:
+                pass
+        with child:
+            with parent:
+                pass
+        assert sanitize.check_lock_cycles() == []
+    finally:
+        sanitize.restore_edges(saved)
+
+
+def test_tracked_rlock_reentrancy():
+    saved = sanitize.lock_edges()
+    try:
+        sanitize.reset_graph()
+        rl = sanitize._TrackedRLock("r.py:1")
+        other = sanitize._TrackedLock("r.py:2")
+        with rl:
+            assert rl._is_owned()
+            with rl:  # reentrant: no self-edge, count tracked
+                with other:
+                    pass
+            assert rl._count == 1
+        assert not rl._is_owned()
+        edges = sanitize.lock_edges()
+        assert ("r.py:1", "r.py:2") in edges
+        assert ("r.py:1", "r.py:1") not in edges
+    finally:
+        sanitize.restore_edges(saved)
+
+
+def test_cross_thread_lock_release_leaves_no_phantom_edges():
+    """threading.Lock allows handoff (acquire in A, release in B); the
+    released lock must leave the ACQUIRER's held stack, or every later
+    acquire in A records phantom edges from a lock A no longer holds."""
+    saved = sanitize.lock_edges()
+    try:
+        sanitize.reset_graph()
+        a = sanitize._TrackedLock("hand.py:1")
+        b = sanitize._TrackedLock("hand.py:2")
+        a.acquire()
+        t = threading.Thread(target=a.release)
+        t.start()
+        t.join(5.0)
+        with b:
+            pass
+        assert ("hand.py:1", "hand.py:2") not in sanitize.lock_edges()
+    finally:
+        sanitize.restore_edges(saved)
+
+
+def test_tracked_rlock_non_owner_release_raises_keeps_state():
+    """A non-owner release must raise (like the real RLock) WITHOUT
+    corrupting the owner's recursion state."""
+    rl = sanitize._TrackedRLock("bad.py:1")
+    rl.acquire()
+    rl.acquire()
+    errs = []
+
+    def bad_release():
+        try:
+            rl.release()
+        except RuntimeError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=bad_release)
+    t.start()
+    t.join(5.0)
+    assert errs, "non-owner release did not raise"
+    assert rl._is_owned() and rl._count == 2
+    rl.release()
+    rl.release()
+    assert not rl._is_owned()
+    assert rl.acquire(blocking=False)  # still usable, not deadlocked
+    rl.release()
+
+
+def test_tracked_rlock_condition_wait_recursive():
+    """Condition.wait over a tracked RLock held RECURSIVELY must fully
+    release it (_release_save), or the waiter parks still holding the
+    lock and every notifier deadlocks."""
+    saved = sanitize.lock_edges()
+    try:
+        sanitize.reset_graph()
+        rl = sanitize._TrackedRLock("cv.py:1")
+        cv = threading.Condition(rl)
+        fired = []
+
+        def notifier():
+            with cv:
+                fired.append(True)
+                cv.notify()
+
+        with cv:
+            with cv:  # recursion level 2 when wait() releases
+                t = threading.Thread(target=notifier, daemon=True)
+                t.start()
+                assert cv.wait(timeout=5.0), \
+                    "notifier never got the lock — wait() did not " \
+                    "fully release the recursive hold"
+                assert rl._is_owned() and rl._count == 2
+            assert rl._count == 1
+        t.join(5.0)
+        assert fired and not rl._is_owned()
+    finally:
+        sanitize.restore_edges(saved)
+
+
+def test_nonblocking_acquire_records_no_edge():
+    saved = sanitize.lock_edges()
+    try:
+        sanitize.reset_graph()
+        a = sanitize._TrackedLock("nb.py:1")
+        b = sanitize._TrackedLock("nb.py:2")
+        with a:
+            assert b.acquire(blocking=False)
+            b.release()
+        assert sanitize.lock_edges() == {}  # trylock cannot deadlock
+    finally:
+        sanitize.restore_edges(saved)
+
+
+def test_thread_leak_detector_reports_and_clears():
+    before = sanitize.thread_snapshot()
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, name="fixture-leaker")
+    t.start()
+    try:
+        leaks = sanitize.leaked_threads(before, grace=0.1)
+        assert [x.name for x in leaks] == ["fixture-leaker"]
+    finally:
+        release.set()
+        t.join()
+    assert sanitize.leaked_threads(before, grace=1.0) == []
+
+
+def test_thread_leak_exempts_engine_pool_prefixes():
+    before = sanitize.thread_snapshot()
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, name="mtpu-io_fixture")
+    t.start()
+    try:
+        assert sanitize.leaked_threads(before, grace=0.1) == []
+    finally:
+        release.set()
+        t.join()
+
+
+def test_daemon_threads_are_not_leaks():
+    before = sanitize.thread_snapshot()
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, daemon=True,
+                         name="fixture-daemon")
+    t.start()
+    try:
+        assert sanitize.leaked_threads(before, grace=0.1) == []
+    finally:
+        release.set()
+        t.join()
+
+
+def test_factories_unwrapped_outside_minio_tpu():
+    """Armed or not, locks created from non-minio_tpu frames (this test
+    file) come back raw — the tracker's blast radius is the project."""
+    lk = threading.Lock()
+    assert not isinstance(lk, (sanitize._TrackedLock,
+                               sanitize._TrackedRLock))
+
+
+def test_wrapped_locks_exist_in_engine_objects():
+    """With the sanitizer armed by conftest, locks created by minio_tpu
+    code during the session are tracked wrappers."""
+    import os
+
+    if os.environ.get("MTPU_SANITIZE", "1") == "0":
+        pytest.skip("sanitizers disarmed")
+    from minio_tpu.dist.faultplane import FaultPlane
+
+    fp = FaultPlane()
+    assert isinstance(fp._mu, sanitize._TrackedLock)
+
+
+def test_lock_graph_is_currently_acyclic():
+    """Whatever the suite recorded so far must be a DAG — the same
+    assertion the session guard makes at exit, checkable mid-run."""
+    cycles = sanitize.check_lock_cycles()
+    assert cycles == [], cycles

@@ -1,0 +1,313 @@
+"""Project-native static analysis: machine-checked invariants for the
+concurrency and hot-path disciplines PRs 3-5 established.
+
+The role `go vet` + custom analyzers play for the reference Go tree:
+every invariant that used to live only in reviewers' heads (deadline on
+every fan-out, trace context across executor hops, no blocking I/O under
+a lock, zero-copy streaming, obs/docs drift) is an AST rule here, run by
+`python -m tools.check` and enforced in tier-1 via
+tests/test_static_analysis.py.
+
+Vocabulary:
+
+- **Finding** — one violation: (rule, path, line, message). Its baseline
+  key is the *stripped source line text*, not the line number, so
+  unrelated edits above a grandfathered site don't churn the baseline.
+- **Suppression** — `# mtpu: allow(MTPU002)` on the flagged line or the
+  line directly above it ("this site is deliberate"; the comment is the
+  designation mechanism, e.g. a designated host-sync point for MTPU004).
+- **Baseline** — tools/check/baseline.json: grandfathered findings that
+  existed when a rule landed. New violations fail while the baseline
+  burns down; a baseline entry no longer matching any finding is STALE
+  and fails too, so the file can only shrink.
+
+Adding a rule: drop a module in tools/check/rules/ defining a Rule
+subclass decorated with @register, give it fixture-backed tests in
+tests/test_static_analysis.py, and triage the tree (fix real bugs,
+suppress deliberate sites, baseline the grandfathered tail). See
+docs/ANALYSIS.md for the catalog and workflow.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Sequence
+
+BASELINE_PATH = Path(__file__).with_name("baseline.json")
+
+_ALLOW_RE = re.compile(r"#\s*mtpu:\s*allow\(([^)]*)\)")
+
+
+class PathScopeError(ValueError):
+    """A requested check path matches nothing or lies outside the repo
+    root. Raised instead of silently checking an empty file set — a
+    typo'd path in a CI job or pre-commit hook must fail loudly, not
+    pass green while enforcing nothing."""
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int  # 1-based
+    col: int
+    message: str
+    content: str  # stripped source text of `line` — the baseline key
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "content": self.content}
+
+
+class FileContext:
+    """One parsed source file handed to every in-scope rule."""
+
+    def __init__(self, root: Path, relpath: str, src: str):
+        self.root = root
+        self.relpath = relpath
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=relpath)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule_id: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule_id, self.relpath, line, col, message,
+                       self.line_text(line))
+
+    def allowed_rules(self, lineno: int) -> set[str]:
+        """Rule ids suppressed at `lineno`: an allow() comment on the
+        line itself or anywhere in the contiguous comment block directly
+        above it (multi-line rationale comments are encouraged)."""
+        out: set[str] = set()
+        if 1 <= lineno <= len(self.lines):
+            m = _ALLOW_RE.search(self.lines[lineno - 1])
+            if m:
+                out.update(p.strip() for p in m.group(1).split(","))
+        ln = lineno - 1
+        while 1 <= ln <= len(self.lines) \
+                and self.lines[ln - 1].lstrip().startswith("#"):
+            m = _ALLOW_RE.search(self.lines[ln - 1])
+            if m:
+                out.update(p.strip() for p in m.group(1).split(","))
+            ln -= 1
+        return out
+
+
+class Rule:
+    """One invariant. Subclasses set `id` + `title` and implement
+    check(); cross-file rules collect per file and emit in finalize()."""
+
+    id = "MTPU000"
+    title = "abstract rule"
+
+    def scope(self, relpath: str) -> bool:
+        return True
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, root: Path) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: dict[str, type[Rule]] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    if cls.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules() -> dict[str, type[Rule]]:
+    # Import for side effect: rule modules self-register.
+    from tools.check import rules  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+@dataclass
+class CheckResult:
+    new: list[Finding] = field(default_factory=list)
+    baselined: list[Finding] = field(default_factory=list)
+    suppressed: list[Finding] = field(default_factory=list)
+    stale: list[dict] = field(default_factory=list)  # unmatched baseline rows
+    errors: list[str] = field(default_factory=list)  # unparseable files
+
+    @property
+    def ok(self) -> bool:
+        return not self.new and not self.stale and not self.errors
+
+    def all_findings(self) -> list[Finding]:
+        return sorted(self.new + self.baselined + self.suppressed,
+                      key=lambda f: (f.rule, f.path, f.line))
+
+
+def discover_files(root: Path, paths: Sequence[str] | None = None) -> list[str]:
+    """Repo-relative .py files under `paths` (default: minio_tpu/).
+    Raises PathScopeError for a path that matches nothing or resolves
+    outside `root`."""
+    rels: list[str] = []
+    root_res = root.resolve()
+    for p in paths or ["minio_tpu"]:
+        target = (root / p) if not Path(p).is_absolute() else Path(p)
+        if target.is_dir():
+            found = sorted(target.rglob("*.py"))
+            if not found:
+                raise PathScopeError(f"{p}: directory contains no .py files")
+        elif target.suffix == ".py" and target.exists():
+            found = [target]
+        else:
+            raise PathScopeError(
+                f"{p}: not a directory or existing .py file")
+        for f in found:
+            try:
+                rels.append(f.resolve().relative_to(root_res).as_posix())
+            except ValueError:
+                raise PathScopeError(
+                    f"{p}: {f} is outside the repo root {root_res}"
+                ) from None
+    return sorted(set(rels))
+
+
+def load_baseline(path: Path = BASELINE_PATH) -> list[dict]:
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text())
+    return list(data.get("findings", []))
+
+
+def save_baseline(rows: list[dict], path: Path = BASELINE_PATH) -> None:
+    rows = sorted(rows, key=lambda r: (r["rule"], r["path"], r["content"]))
+    path.write_text(json.dumps({"version": 1, "findings": rows},
+                               indent=1) + "\n")
+
+
+def baseline_rows(findings: Sequence[Finding]) -> list[dict]:
+    """Collapse findings into baseline rows keyed by
+    (rule, path, content) with an occurrence count."""
+    counts: dict[tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[(f.rule, f.path, f.content)] = counts.get(
+            (f.rule, f.path, f.content), 0) + 1
+    return [{"rule": r, "path": p, "content": c, "count": n}
+            for (r, p, c), n in counts.items()]
+
+
+def match_baseline(findings: Sequence[Finding], baseline: Sequence[dict],
+                   checked_rules: set[str], checked_files: set[str],
+                   scope_prefixes: Sequence[str] | None = None,
+                   ) -> tuple[list[Finding], list[Finding], list[dict]]:
+    """Split findings into (new, baselined) and report stale baseline
+    rows. A row matches up to `count` findings with the same
+    (rule, path, stripped-line content); extra findings are new, a row
+    matching fewer than `count` is stale (burn the count down). Rows
+    outside the checked rule/file subset (e.g. under --rule/--changed)
+    are ignored, not stale — EXCEPT rows under `scope_prefixes` (the
+    directory scope of a full run): those are stale even when their file
+    no longer exists, so deleting or renaming a file can't leave rows
+    lingering to grandfather a future violation with the same content."""
+    remaining: dict[tuple[str, str, str], int] = {}
+    for row in baseline:
+        key = (row["rule"], row["path"], row["content"])
+        remaining[key] = remaining.get(key, 0) + int(row.get("count", 1))
+    new: list[Finding] = []
+    matched: list[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.rule, f.path, f.line)):
+        key = (f.rule, f.path, f.content)
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+            matched.append(f)
+        else:
+            new.append(f)
+
+    def covered(p: str) -> bool:
+        if p in checked_files:
+            return True
+        return any(p == pre or p.startswith(pre)
+                   for pre in scope_prefixes or ())
+
+    stale = [{"rule": r, "path": p, "content": c, "count": n}
+             for (r, p, c), n in remaining.items()
+             if n > 0 and r in checked_rules and covered(p)]
+    return new, matched, stale
+
+
+def run(root: Path, paths: Sequence[str] | None = None,
+        rule_ids: Sequence[str] | None = None,
+        files: Sequence[str] | None = None,
+        baseline: Sequence[dict] | None = None) -> CheckResult:
+    """Run the framework: parse every file once, apply each in-scope
+    rule, filter suppressions, then split against the baseline."""
+    root = Path(root)
+    registry = all_rules()
+    if rule_ids:
+        unknown = set(rule_ids) - set(registry)
+        if unknown:
+            raise KeyError(f"unknown rule id(s): {sorted(unknown)}")
+        registry = {rid: registry[rid] for rid in rule_ids}
+    rules = [cls() for _, cls in sorted(registry.items())]
+    rels = list(files) if files is not None else discover_files(root, paths)
+
+    result = CheckResult()
+    raw: list[Finding] = []
+    ctxs: dict[str, FileContext] = {}
+    for rel in rels:
+        try:
+            src = (root / rel).read_text()
+            ctx = FileContext(root, rel, src)
+        except (OSError, SyntaxError, UnicodeDecodeError) as e:
+            result.errors.append(f"{rel}: {type(e).__name__}: {e}")
+            continue
+        ctxs[rel] = ctx
+        for rule in rules:
+            if rule.scope(rel):
+                raw.extend(rule.check(ctx))
+    for rule in rules:
+        raw.extend(rule.finalize(root))
+
+    visible: list[Finding] = []
+    for f in raw:
+        ctx = ctxs.get(f.path)
+        if ctx is not None and f.rule in ctx.allowed_rules(f.line):
+            result.suppressed.append(f)
+        else:
+            visible.append(f)
+
+    base = load_baseline() if baseline is None else list(baseline)
+    checked_rules = {r.id for r in rules}
+    checked_files = set(rels)
+    # Directory-scoped runs (not --changed's explicit file list) also
+    # stale-check rows for files that no longer exist under the scope.
+    scope_prefixes: tuple[str, ...] | None = None
+    if files is None:
+        pres = []
+        for p in paths or ["minio_tpu"]:
+            pp = Path(p)
+            if pp.is_absolute():
+                try:
+                    rel = pp.resolve().relative_to(root.resolve()).as_posix()
+                except ValueError:
+                    continue
+            else:
+                rel = pp.as_posix()
+            pres.append(rel if rel.endswith(".py") else rel.rstrip("/") + "/")
+        scope_prefixes = tuple(pres)
+    result.new, result.baselined, result.stale = match_baseline(
+        visible, base, checked_rules, checked_files, scope_prefixes)
+    return result

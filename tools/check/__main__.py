@@ -1,0 +1,164 @@
+"""CLI: `python -m tools.check` — exit non-zero on non-baselined
+findings (or stale baseline rows).
+
+  python -m tools.check                        # full tree (minio_tpu/)
+  python -m tools.check --rule MTPU002         # one rule
+  python -m tools.check --changed              # git-diff-scoped (pre-commit)
+  python -m tools.check --json                 # machine-readable output
+  python -m tools.check --update-baseline      # re-grandfather findings
+  python -m tools.check --worklist             # docs/ZEROCOPY_WORKLIST.md
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from tools.check import (
+    BASELINE_PATH,
+    PathScopeError,
+    all_rules,
+    baseline_rows,
+    run,
+    save_baseline,
+)
+
+ROOT = Path(__file__).resolve().parents[2]
+
+
+def changed_files(root: Path) -> list[str]:
+    """Working-tree-changed .py files under minio_tpu/ (staged, unstaged
+    and untracked) — the pre-commit scope."""
+    out = subprocess.run(
+        ["git", "-C", str(root), "status", "--porcelain"],
+        capture_output=True, text=True, check=True).stdout
+    files = []
+    for line in out.splitlines():
+        path = line[3:].split(" -> ")[-1].strip().strip('"')
+        if path.endswith(".py") and path.startswith("minio_tpu/") \
+                and (root / path).exists():
+            files.append(path)
+    return sorted(set(files))
+
+
+def write_worklist(root: Path, out_path: Path) -> int:
+    """Generate docs/ZEROCOPY_WORKLIST.md from ALL MTPU005 findings
+    (baselined included — the worklist is the audit, the baseline is the
+    gate)."""
+    result = run(root, rule_ids=["MTPU005"])
+    findings = result.all_findings()
+    lines = [
+        "# Zero-copy worklist (generated)",
+        "",
+        "Every byte-copy site on the PUT/GET streaming paths, found by",
+        "static rule MTPU005 (`python -m tools.check --worklist` to",
+        "regenerate). This is the starting site list for the multi-core",
+        "front-door / zero-copy refactor (ROADMAP item 1): each entry is",
+        "one full pass over payload bytes that a `memoryview` pipeline",
+        "would skip. Convert a site, drop its baseline row, regenerate.",
+        "",
+        f"**{len(findings)} sites** across "
+        f"{len({f.path for f in findings})} files.",
+        "",
+    ]
+    by_path: dict[str, list] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    for path in sorted(by_path):
+        lines.append(f"## {path}")
+        lines.append("")
+        for f in sorted(by_path[path], key=lambda f: f.line):
+            lines.append(f"- `{path}:{f.line}` — `{f.content}`")
+        lines.append("")
+    out_path.write_text("\n".join(lines).rstrip() + "\n")
+    print(f"wrote {out_path} ({len(findings)} sites)")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.check",
+        description="project-native static analysis (docs/ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: minio_tpu/)")
+    ap.add_argument("--rule", action="append", dest="rules", metavar="ID",
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--changed", action="store_true",
+                    help="check only git-changed files (fast pre-commit)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite baseline.json from current findings")
+    ap.add_argument("--worklist", action="store_true",
+                    help="regenerate docs/ZEROCOPY_WORKLIST.md from "
+                         "MTPU005 findings")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list registered rules")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, cls in sorted(all_rules().items()):
+            print(f"{rid}  {cls.title}")
+        return 0
+
+    if args.worklist:
+        return write_worklist(ROOT, ROOT / "docs" / "ZEROCOPY_WORKLIST.md")
+
+    files = None
+    if args.changed:
+        if args.paths:
+            print("error: --changed and positional paths conflict — "
+                  "pass one or the other", file=sys.stderr)
+            return 2
+        files = changed_files(ROOT)
+        if not files:
+            print("no changed minio_tpu/*.py files")
+            return 0
+
+    try:
+        result = run(ROOT, paths=args.paths or None, rule_ids=args.rules,
+                     files=files)
+    except PathScopeError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        if args.rules or args.changed or args.paths:
+            print("--update-baseline requires a full default run",
+                  file=sys.stderr)
+            return 2
+        rows = baseline_rows(result.new + result.baselined)
+        save_baseline(rows, BASELINE_PATH)
+        print(f"baseline rewritten: {len(rows)} rows "
+              f"({len(result.new) + len(result.baselined)} findings)")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [f.as_dict() for f in result.new],
+            "baselined": [f.as_dict() for f in result.baselined],
+            "suppressed": [f.as_dict() for f in result.suppressed],
+            "stale_baseline": result.stale,
+            "errors": result.errors,
+            "ok": result.ok,
+        }, indent=1))
+    else:
+        for f in sorted(result.new, key=lambda f: (f.path, f.line)):
+            print(f"{f.location()}: {f.rule}: {f.message}")
+            print(f"    {f.content}")
+        for row in result.stale:
+            print(f"STALE baseline row: {row['rule']} {row['path']} "
+                  f"x{row['count']}: {row['content']!r}")
+        for err in result.errors:
+            print(f"ERROR: {err}")
+        print(f"{len(result.new)} new, {len(result.baselined)} baselined, "
+              f"{len(result.suppressed)} suppressed, "
+              f"{len(result.stale)} stale baseline rows")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+"""Rule modules self-register on import (tools.check.all_rules)."""
+
+from tools.check.rules import (  # noqa: F401
+    mtpu001_fanout,
+    mtpu002_lock_blocking,
+    mtpu003_swallow,
+    mtpu004_jax,
+    mtpu005_copies,
+    mtpu006_obs_drift,
+)

@@ -1,0 +1,76 @@
+"""Shared AST helpers for the rule modules."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.check import Rule, register  # noqa: F401  (re-export for rules)
+
+
+def terminal_name(func: ast.expr) -> str | None:
+    """The rightmost name of a call target: `obs.ctx_wrap` -> "ctx_wrap",
+    `parallel_map` -> "parallel_map"."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def dotted_name(node: ast.expr) -> str | None:
+    """Full dotted path when the expression is a plain Name/Attribute
+    chain: `jax.jit` -> "jax.jit"; anything else -> None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_call_to(node: ast.AST, *names: str) -> bool:
+    """True when node is a Call whose terminal or dotted name is in
+    `names` (so both `ctx_wrap(f)` and `obs.ctx_wrap(f)` match
+    "ctx_wrap")."""
+    if not isinstance(node, ast.Call):
+        return False
+    return (terminal_name(node.func) in names
+            or dotted_name(node.func) in names)
+
+
+def str_const(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def walk_skipping_nested_functions(body: list[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested def/lambda bodies —
+    code that runs later, in a different locking/async context."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Decorators and defaults evaluate in the current context.
+            stack.extend(node.decorator_list)
+            stack.extend(d for d in node.args.defaults if d is not None)
+            stack.extend(d for d in (node.args.kw_defaults or [])
+                         if d is not None)
+            continue
+        if isinstance(node, ast.Lambda):
+            stack.extend(node.args.defaults)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def function_scopes(tree: ast.Module) -> Iterator[tuple[ast.AST, list[ast.stmt]]]:
+    """Yield (scope_node, body) for the module and every (async)
+    function, in source order."""
+    yield tree, tree.body
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, node.body

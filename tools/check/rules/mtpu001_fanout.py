@@ -1,0 +1,72 @@
+"""MTPU001 — every request-path fan-out is deadline-bounded and carries
+trace context.
+
+PR 3 made `parallel_map(deadline=)` the only way a hung drive becomes a
+quorum-visible `OperationTimedOut` instead of a wedged request; PR 4
+made `obs.ctx_wrap` the only way the trace id survives an executor hop.
+Both invariants die silently when a new call site forgets the kwarg, so:
+in request-path packages (s3/, erasure/, dist/, storage/),
+
+- `parallel_map(...)` must pass `deadline=` (ctx_wrap is applied
+  internally per submission), and
+- `<executor>.submit(fn, ...)` must submit `obs.ctx_wrap(fn)` (or a name
+  bound to one in the same file); the enclosing wait carries the
+  deadline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.check import FileContext, Finding, Rule, register
+from tools.check.rules.base import is_call_to, terminal_name
+
+_PACKAGES = ("minio_tpu/s3/", "minio_tpu/erasure/", "minio_tpu/dist/",
+             "minio_tpu/storage/")
+
+
+@register
+class FanoutRule(Rule):
+    id = "MTPU001"
+    title = "request-path fan-out without deadline= / obs.ctx_wrap"
+
+    def scope(self, relpath: str) -> bool:
+        return relpath.startswith(_PACKAGES)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        # Names bound to ctx_wrap(...) results anywhere in the file:
+        # `decode_ctx = obs.ctx_wrap(decode); ex.submit(decode_ctx, ...)`
+        # is as good as submitting the wrap call inline.
+        wrapped_names: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Assign)
+                    and is_call_to(node.value, "ctx_wrap")):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        wrapped_names.add(tgt.id)
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = terminal_name(node.func)
+            if name == "parallel_map":
+                if not any(kw.arg == "deadline" for kw in node.keywords):
+                    yield ctx.finding(
+                        self.id, node,
+                        "parallel_map() without deadline=: a hung drive "
+                        "wedges this fan-out forever instead of becoming "
+                        "an OperationTimedOut quorum value")
+            elif name == "submit" and isinstance(node.func, ast.Attribute):
+                if not node.args:
+                    continue
+                fn = node.args[0]
+                ok = is_call_to(fn, "ctx_wrap") or (
+                    isinstance(fn, ast.Name) and fn.id in wrapped_names)
+                if not ok:
+                    yield ctx.finding(
+                        self.id, node,
+                        "executor submit() without obs.ctx_wrap: the "
+                        "worker loses the request's trace context "
+                        "(trace_id/node contextvars do not cross pool "
+                        "threads)")

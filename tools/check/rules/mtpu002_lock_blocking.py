@@ -1,0 +1,120 @@
+"""MTPU002 — no blocking call while holding a threading.Lock/RLock.
+
+A lock held across socket/file I/O, a future `.result()`, a `sleep`, or
+a nested fan-out turns one slow drive or peer into whole-process
+convoying — every thread touching that lock now waits on the blocked
+syscall, which is exactly how the drive-hang matrix (PR 3) used to wedge
+pre-deadline code. Locks guard memory, deadlines guard I/O; the two must
+not nest this way.
+
+Detection: the file's `threading.Lock()/RLock()` bindings (module
+globals, locals, `self.<attr>`) are collected, then every `with <lock>:`
+body is scanned for blocking calls. Nested function bodies are skipped —
+they run later, not under the lock.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.check import FileContext, Finding, Rule, register
+from tools.check.rules.base import (
+    dotted_name,
+    terminal_name,
+    walk_skipping_nested_functions,
+)
+
+# Attribute calls that block on another thread of control.
+_BLOCKING_ATTRS = {"result", "recv", "recv_into", "sendall", "accept",
+                   "connect", "wait"}
+# Dotted calls that are syscalls / subprocesses.
+_BLOCKING_DOTTED = {"time.sleep", "os.fsync", "os.fdatasync", "os.read",
+                    "os.write", "socket.create_connection",
+                    "subprocess.run", "subprocess.check_output",
+                    "subprocess.check_call", "subprocess.call",
+                    "urllib.request.urlopen"}
+# Bare-name calls.
+_BLOCKING_NAMES = {"sleep", "open"}
+# Project fan-outs: these block up to their deadline — never under a lock.
+_BLOCKING_FANOUT = {"parallel_map", "run_bounded"}
+# `.join` receivers that look like threads (str.join is everywhere, so
+# receiver names gate this one).
+_THREADISH = {"t", "th", "thread", "prod", "worker", "writer"}
+
+
+def _lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    return (dotted_name(node.func) in ("threading.Lock", "threading.RLock")
+            or (isinstance(node.func, ast.Name)
+                and node.func.id in ("Lock", "RLock")))
+
+
+def _blocking_reason(call: ast.Call) -> str | None:
+    name = terminal_name(call.func)
+    dotted = dotted_name(call.func)
+    if dotted in _BLOCKING_DOTTED:
+        return f"{dotted}()"
+    if name in _BLOCKING_FANOUT:
+        return f"{name}() fan-out (blocks up to its deadline)"
+    if isinstance(call.func, ast.Attribute):
+        if name in _BLOCKING_ATTRS:
+            return f".{name}()"
+        if name == "join":
+            recv = terminal_name(call.func.value)
+            if recv in _THREADISH or (recv or "").endswith("thread"):
+                return ".join() on a thread"
+        return None
+    if isinstance(call.func, ast.Name) and name in _BLOCKING_NAMES:
+        return f"{name}()"
+    return None
+
+
+@register
+class LockBlockingRule(Rule):
+    id = "MTPU002"
+    title = "blocking call while holding a threading lock"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        names: set[str] = set()
+        attrs: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            value = None
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value, targets = node.value, [node.target]
+            if value is None or not _lock_ctor(value):
+                continue
+            for tgt in targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+                elif isinstance(tgt, ast.Attribute):
+                    attrs.add(tgt.attr)
+
+        if not names and not attrs:
+            return
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            held = None
+            for item in node.items:
+                e = item.context_expr
+                if isinstance(e, ast.Name) and e.id in names:
+                    held = e.id
+                elif isinstance(e, ast.Attribute) and e.attr in attrs:
+                    held = e.attr
+            if held is None:
+                continue
+            for sub in walk_skipping_nested_functions(node.body):
+                if isinstance(sub, ast.Call):
+                    reason = _blocking_reason(sub)
+                    if reason:
+                        yield ctx.finding(
+                            self.id, sub,
+                            f"blocking {reason} while holding lock "
+                            f"'{held}': one stalled call convoys every "
+                            "thread contending this lock")

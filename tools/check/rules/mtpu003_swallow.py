@@ -1,0 +1,77 @@
+"""MTPU003 — no broad except handler that swallows the error.
+
+`except Exception` catching `OperationTimedOut`/`DiskNotFound` and
+dropping them silently is how a deadline'd fan-out (PR 3) or a breaker
+trip (PR 5) degrades back into "the object just wasn't there": the
+typed error the lower layer worked hard to produce never reaches the
+quorum reducer, the log, or the caller.
+
+A broad handler (`except:`, `except Exception`, `except BaseException`,
+or a tuple containing either) passes when its body does any of:
+
+- re-raise (`raise` / `raise X`),
+- log or publish the failure (logging/print/obs.publish-style calls), or
+- convert the exception to a value: the bound name (`except ... as e`)
+  is referenced — the errors-as-data idiom the quorum reducers consume
+  (`results[i] = e`).
+
+Everything else is a swallow. Deliberate best-effort sites say so with
+`# mtpu: allow(MTPU003)`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.check import FileContext, Finding, Rule, register
+from tools.check.rules.base import terminal_name, walk_skipping_nested_functions
+
+_LOG_NAMES = {"debug", "info", "warning", "warn", "error", "exception",
+              "critical", "log", "publish", "print", "audit"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    for n in names:
+        nm = terminal_name(n) if isinstance(n, (ast.Name, ast.Attribute)) else None
+        if nm in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+@register
+class SwallowRule(Rule):
+    id = "MTPU003"
+    title = "broad except handler swallows the error"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler) or not _is_broad(node):
+                continue
+            handled = False
+            for sub in walk_skipping_nested_functions(node.body):
+                if isinstance(sub, ast.Raise):
+                    handled = True
+                    break
+                if isinstance(sub, ast.Call):
+                    name = terminal_name(sub.func)
+                    if name in _LOG_NAMES or (name or "").startswith("log"):
+                        handled = True
+                        break
+                if (node.name is not None and isinstance(sub, ast.Name)
+                        and sub.id == node.name
+                        and isinstance(sub.ctx, ast.Load)):
+                    handled = True
+                    break
+            if not handled:
+                what = ("bare except" if node.type is None
+                        else "broad except")
+                yield ctx.finding(
+                    self.id, node,
+                    f"{what} swallows the error: no re-raise, no log, "
+                    "and the exception is never converted to a result "
+                    "value — OperationTimedOut/DiskNotFound vanish here")

@@ -1,0 +1,209 @@
+"""MTPU004 — JAX hygiene in the device pipelines (ops/, native/).
+
+Three failure classes the device plane cannot afford:
+
+1. **Host sync inside the pipeline.** `np.asarray`/`np.array` over a
+   value produced by jax/jnp (or a jitted function), `.item()`,
+   `jax.device_get`, `block_until_ready` — each one stalls the
+   dispatch-ahead pipeline until the device drains. Syncs are legal only
+   at designated points: functions whose name marks them as the host
+   boundary (`*_host`, `*_np`, `*_sync`) or sites annotated
+   `# mtpu: allow(MTPU004)`.
+2. **Mutable state captured by a jitted function.** jit traces once per
+   shape; a closed-over module-level dict/list/set (or `global`
+   rebinding, or a bound `self`) is baked in at trace time and silently
+   stale forever after.
+3. **Nondeterminism under trace.** `time.time()` / `random.*` inside a
+   jitted body executes at trace time, not call time — the classic
+   "Date inside the kernel" bug.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.check import FileContext, Finding, Rule, register
+from tools.check.rules.base import (
+    dotted_name,
+    terminal_name,
+    walk_skipping_nested_functions,
+)
+
+_HOST_FN_SUFFIXES = ("_host", "_np", "_sync")
+_NONDET_DOTTED = {"time.time", "time.perf_counter", "time.monotonic",
+                  "datetime.now", "datetime.utcnow", "random.random",
+                  "random.randint", "random.choice", "np.random.rand",
+                  "np.random.randn"}
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """jax.jit / jit / functools.partial(jax.jit, ...)."""
+    if dotted_name(node) in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call) and terminal_name(node.func) == "partial":
+        return bool(node.args) and _is_jit_expr(node.args[0])
+    return False
+
+
+def _jitted_functions(tree: ast.Module) -> list[ast.FunctionDef]:
+    """Functions jitted by decorator or by a `name = jax.jit(fn)`
+    assignment elsewhere in the module."""
+    by_name: dict[str, ast.FunctionDef] = {}
+    jitted: dict[int, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            by_name[node.name] = node
+            if any(_is_jit_expr(d) for d in node.decorator_list):
+                jitted[id(node)] = node
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and dotted_name(node.func) == "jax.jit"
+                and node.args and isinstance(node.args[0], ast.Name)):
+            fn = by_name.get(node.args[0].id)
+            if fn is not None:
+                jitted[id(fn)] = fn
+    return list(jitted.values())
+
+
+def _module_mutables(tree: ast.Module) -> set[str]:
+    """Module-level names bound to mutable containers, plus anything any
+    function rebinds via `global`."""
+    out: set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            if isinstance(stmt.value, (ast.List, ast.Dict, ast.Set,
+                                       ast.ListComp, ast.DictComp,
+                                       ast.SetComp)) or (
+                    isinstance(stmt.value, ast.Call)
+                    and terminal_name(stmt.value.func) in ("list", "dict",
+                                                           "set",
+                                                           "defaultdict",
+                                                           "OrderedDict")):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    return out
+
+
+def _local_names(fn: ast.FunctionDef) -> set[str]:
+    args = fn.args
+    names = {a.arg for a in (args.posonlyargs + args.args + args.kwonlyargs)}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not fn:
+                names.add(node.name)
+    return names
+
+
+def _device_producer(call: ast.Call, jitted_names: set[str]) -> bool:
+    """Call that yields a device value: jnp.*/jax.* (minus host-side
+    namespaces) or a jitted function of this module."""
+    d = dotted_name(call.func)
+    if d is not None and (d.startswith("jnp.") or d.startswith("jax.lax.")
+                         or d in ("jax.device_put",)):
+        return True
+    name = terminal_name(call.func)
+    return name in jitted_names
+
+
+@register
+class JaxHygieneRule(Rule):
+    id = "MTPU004"
+    title = "JAX hygiene: host sync / mutable capture / trace nondeterminism"
+
+    def scope(self, relpath: str) -> bool:
+        return relpath.startswith(("minio_tpu/ops/", "minio_tpu/native/"))
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        tree = ctx.tree
+        jitted = _jitted_functions(tree)
+        jitted_names = {fn.name for fn in jitted}
+        mutables = _module_mutables(tree)
+
+        # -- inside jitted bodies: capture + nondeterminism ------------
+        for fn in jitted:
+            locals_ = _local_names(fn)
+            if "self" in {a.arg for a in fn.args.args[:1]}:
+                yield ctx.finding(
+                    self.id, fn,
+                    f"jitted function '{fn.name}' takes self: the bound "
+                    "instance is baked in at trace time (mutable state "
+                    "captured by jit)")
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    d = dotted_name(node.func)
+                    if d in _NONDET_DOTTED:
+                        yield ctx.finding(
+                            self.id, node,
+                            f"{d}() inside jitted '{fn.name}' runs at "
+                            "TRACE time, not call time — the value is "
+                            "frozen into the compiled kernel")
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id in mutables and node.id not in locals_):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"jitted '{fn.name}' closes over module-level "
+                        f"mutable '{node.id}': jit captures it at trace "
+                        "time; later mutation is silently ignored")
+
+        # -- host syncs outside designated boundaries ------------------
+        for scope_fn in [None] + [n for n in ast.walk(tree)
+                                  if isinstance(n, ast.FunctionDef)]:
+            if scope_fn is not None and (
+                    scope_fn.name.endswith(_HOST_FN_SUFFIXES)
+                    or scope_fn.name.startswith("host_")):
+                continue  # designated host boundary
+            body = tree.body if scope_fn is None else scope_fn.body
+            # Pass 1: names assigned from device producers in this scope
+            # (nested function bodies are their own scope — skipped; the
+            # walker yields in arbitrary order, hence the separate pass).
+            device_names: set[str] = set()
+            for node in walk_skipping_nested_functions(body):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and _device_producer(node.value, jitted_names)):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            device_names.add(tgt.id)
+            # Pass 2: the sync scan.
+            for node in walk_skipping_nested_functions(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func)
+                name = terminal_name(node.func)
+                if d in ("jax.device_get",) or name == "block_until_ready":
+                    yield ctx.finding(
+                        self.id, node,
+                        "host sync in the device pipeline: stalls "
+                        "dispatch-ahead until the device drains (allow "
+                        "only at designated sync points)")
+                    continue
+                if name == "item" and isinstance(node.func, ast.Attribute) \
+                        and not node.args:
+                    yield ctx.finding(
+                        self.id, node,
+                        ".item() forces a device->host transfer per "
+                        "element — a hidden sync in the pipeline")
+                    continue
+                if d in ("np.asarray", "np.array", "numpy.asarray",
+                         "numpy.array") and node.args:
+                    arg = node.args[0]
+                    synced = (isinstance(arg, ast.Call)
+                              and _device_producer(arg, jitted_names)) or (
+                        isinstance(arg, ast.Name) and arg.id in device_names)
+                    if synced:
+                        yield ctx.finding(
+                            self.id, node,
+                            "np.asarray over a device value blocks on "
+                            "the launch — a host sync outside a "
+                            "designated boundary")

@@ -1,0 +1,69 @@
+"""MTPU005 — hot-path copy lint: the zero-copy worklist.
+
+The e2e wall is host byte-shuffling (ROADMAP: kernels at ~1 TiB/s, the
+wire at 0.21 GiB/s): every `bytes(...)` materialization, `b"".join`
+coalesce, and buffer slice-copy on the PUT/GET streaming paths is a
+full pass over the payload that `memoryview` would skip. This rule
+flags them in the three streaming files so the multi-core front-door
+refactor starts from an exact site list — the committed findings ARE
+`docs/ZEROCOPY_WORKLIST.md` (python -m tools.check --worklist), and the
+baseline burns down as sites convert.
+
+Slice heuristics key on buffer-ish names (`buf`, `chunk`, `payload`,
+`body`, ...): shard *lists* are sliced legitimately everywhere and stay
+out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.check import FileContext, Finding, Rule, register
+from tools.check.rules.base import terminal_name
+
+FILES = ("minio_tpu/erasure/objects.py", "minio_tpu/storage/local.py",
+         "minio_tpu/s3/server.py")
+
+_BUF_NAMES = {"buf", "buffer", "chunk", "payload", "body", "blob", "raw",
+              "mv", "view", "frame", "tail", "head"}
+
+
+@register
+class HotPathCopyRule(Rule):
+    id = "MTPU005"
+    title = "byte copy on a streaming path (zero-copy worklist)"
+
+    def scope(self, relpath: str) -> bool:
+        return relpath in FILES
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = terminal_name(node.func)
+                if (isinstance(node.func, ast.Name) and name == "bytes"
+                        and node.args):
+                    yield ctx.finding(
+                        self.id, node,
+                        "bytes(...) materializes a full copy of the "
+                        "payload; pass a memoryview through instead")
+                elif (name == "join"
+                      and isinstance(node.func, ast.Attribute)
+                      and isinstance(node.func.value, ast.Constant)
+                      and isinstance(node.func.value.value, bytes)):
+                    yield ctx.finding(
+                        self.id, node,
+                        'b"".join coalesces chunks into one fresh '
+                        "buffer; stream the chunks (or writev) instead")
+            elif (isinstance(node, ast.Subscript)
+                  and isinstance(node.ctx, ast.Load)
+                  and isinstance(node.slice, ast.Slice)):
+                base = node.value
+                base_name = None
+                if isinstance(base, (ast.Name, ast.Attribute)):
+                    base_name = terminal_name(base)
+                if base_name in _BUF_NAMES:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"slice of buffer '{base_name}' copies the "
+                        "bytes; slice a memoryview of it instead")

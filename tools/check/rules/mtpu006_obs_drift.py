@@ -1,0 +1,144 @@
+"""MTPU006 — observability drift, statically.
+
+PR 4 added a *runtime* drift gate (test_metrics_docs_drift): families
+the exporter emits during a test run must be documented. That gate only
+sees families whose code paths the test suite happens to exercise; this
+rule promotes it to static coverage of the whole tree:
+
+- every metric family declared anywhere (`obs.counter/gauge/histogram`
+  or exporter `family()` calls with a `minio_tpu_*` literal) must appear
+  in docs/METRICS.md;
+- every trace record type published to the bus (`obs.publish({"type":
+  ...})` dict literals, `obs.span(..., typ)` call sites) must be in the
+  `RECORD_TYPES` registry in minio_tpu/obs/span.py — consumers (the
+  admin trace stream's `?type=` filter, docs/TRACING.md) key on that
+  closed set.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterable
+
+from tools.check import FileContext, Finding, Rule, register
+from tools.check.rules.base import (
+    dotted_name,
+    function_scopes,
+    str_const,
+    terminal_name,
+    walk_skipping_nested_functions,
+)
+
+_METRIC_FNS = {"counter", "gauge", "histogram", "family"}
+
+
+def _doc_families(root: Path) -> set[str] | None:
+    doc = root / "docs" / "METRICS.md"
+    if not doc.exists():
+        return None
+    return set(re.findall(r"minio_tpu_\w+", doc.read_text()))
+
+
+def _registered_types(root: Path) -> set[str] | None:
+    """Parse RECORD_TYPES out of minio_tpu/obs/span.py without importing
+    the project."""
+    span_py = root / "minio_tpu" / "obs" / "span.py"
+    if not span_py.exists():
+        return None
+    try:
+        tree = ast.parse(span_py.read_text())
+    except SyntaxError:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "RECORD_TYPES":
+                    try:
+                        return set(ast.literal_eval(
+                            node.value.args[0]
+                            if isinstance(node.value, ast.Call)
+                            else node.value))
+                    except (ValueError, IndexError):
+                        return None
+    return None
+
+
+@register
+class ObsDriftRule(Rule):
+    id = "MTPU006"
+    title = "metric family / trace record type not registered"
+
+    def __init__(self) -> None:
+        # (finding, family) and (finding, record_type) pending finalize.
+        self._families: list[tuple[Finding, str]] = []
+        self._types: list[tuple[Finding, str]] = []
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = terminal_name(node.func)
+            if name in _METRIC_FNS and node.args:
+                fam = str_const(node.args[0])
+                if fam and fam.startswith("minio_tpu_"):
+                    self._families.append((ctx.finding(
+                        self.id, node,
+                        f"metric family '{fam}' is not documented in "
+                        "docs/METRICS.md"), fam))
+            if name == "span" and dotted_name(node.func) == "obs.span":
+                typ = "internal"
+                if len(node.args) >= 2:
+                    typ = str_const(node.args[1]) or ""
+                for kw in node.keywords:
+                    if kw.arg == "typ":
+                        typ = str_const(kw.value) or ""
+                if typ:
+                    self._types.append((ctx.finding(
+                        self.id, node,
+                        f"trace record type '{typ}' is not in "
+                        "obs.span RECORD_TYPES"), typ))
+
+        # publish({...}) / publish(rec): "type" keys of dict literals
+        # that reach a publish call within the same function scope.
+        for _scope, body in function_scopes(ctx.tree):
+            dicts: dict[str, ast.Dict] = {}
+            published: list[ast.expr] = []
+            for node in walk_skipping_nested_functions(body):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Dict)):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            dicts[tgt.id] = node.value
+                if (isinstance(node, ast.Call)
+                        and terminal_name(node.func) in ("publish",
+                                                         "_publish")
+                        and node.args):
+                    published.append(node.args[0])
+            for arg in published:
+                d = arg if isinstance(arg, ast.Dict) else (
+                    dicts.get(arg.id) if isinstance(arg, ast.Name) else None)
+                if d is None:
+                    continue
+                for k, v in zip(d.keys, d.values):
+                    if k is not None and str_const(k) == "type":
+                        typ = str_const(v)
+                        if typ:
+                            self._types.append((ctx.finding(
+                                self.id, v,
+                                f"trace record type '{typ}' is not in "
+                                "obs.span RECORD_TYPES"), typ))
+        return ()
+
+    def finalize(self, root: Path) -> Iterable[Finding]:
+        doc = _doc_families(root)
+        if doc is not None:
+            for finding, fam in self._families:
+                if fam not in doc:
+                    yield finding
+        registry = _registered_types(root)
+        if registry is not None:
+            for finding, typ in self._types:
+                if typ not in registry:
+                    yield finding
