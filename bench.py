@@ -586,6 +586,44 @@ def bench_degraded() -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _serve_http(srv):
+    """Run an S3Server's aiohttp app on a background event loop; returns
+    (port, stop_fn) with port None when startup timed out. Shared by
+    every HTTP-driven bench config (small_objects, chaos_smoke)."""
+    import asyncio
+    import socket as _socket
+    import threading
+
+    from aiohttp import web
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    port_holder: list[int] = []
+
+    def run_srv():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(srv.app)
+            await runner.setup()
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port_holder.append(s.getsockname()[1])
+            s.close()
+            site = web.TCPSite(runner, "127.0.0.1", port_holder[0])
+            await site.start()
+            started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    threading.Thread(target=run_srv, daemon=True).start()
+    stop = lambda: loop.call_soon_threadsafe(loop.stop)  # noqa: E731
+    if not started.wait(30):
+        return None, stop
+    return port_holder[0], stop
+
+
 def bench_small_objects() -> dict:
     """Small-object HTTP ops/s (cmd/object-api-putobject_test.go:452-558
     role, lifted to the full HTTP stack): 4 KiB and 10 KiB PUT/GET over a
@@ -595,48 +633,22 @@ def bench_small_objects() -> dict:
     server, not a client library. Client and server share this host's
     core(s); on a 1-core box the numbers are a true single-core
     (client+server) budget — see PERF.md for the per-op breakdown."""
-    import asyncio
     import shutil
-    import threading
-
-    from aiohttp import web
 
     from minio_tpu.s3.leanclient import LeanS3
     from minio_tpu.s3.server import build_server
 
     ak, sk = "benchak00", "benchsk00secret0"
     root = _bench_root()
-    loop = asyncio.new_event_loop()
-    started = threading.Event()
-    port_holder: list[int] = []
+    stop = lambda: None  # noqa: E731
     try:
         srv = build_server([os.path.join(root, f"d{i}") for i in range(4)],
                            ak, sk, versioned=False)
-
-        def run_srv():
-            asyncio.set_event_loop(loop)
-
-            async def start():
-                import socket as _socket
-
-                runner = web.AppRunner(srv.app)
-                await runner.setup()
-                s = _socket.socket()
-                s.bind(("127.0.0.1", 0))
-                port_holder.append(s.getsockname()[1])
-                s.close()
-                site = web.TCPSite(runner, "127.0.0.1", port_holder[0])
-                await site.start()
-                started.set()
-
-            loop.run_until_complete(start())
-            loop.run_forever()
-
-        threading.Thread(target=run_srv, daemon=True).start()
-        if not started.wait(30):
+        port, stop = _serve_http(srv)
+        if port is None:
             return {"metric": "putobject_small_e2e",
                     "error": "server failed to start"}
-        c = LeanS3("127.0.0.1", port_holder[0], ak, sk)
+        c = LeanS3("127.0.0.1", port, ak, sk)
         st, body = c.put("/bench")
         assert st == 200, body
         out: dict = {"metric": "putobject_small_e2e", "unit": "ops/s",
@@ -714,7 +726,87 @@ def bench_small_objects() -> dict:
                 round(n2 / (time.perf_counter() - t0), 1))
         return out
     finally:
-        loop.call_soon_threadsafe(loop.stop)
+        stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def bench_chaos_smoke() -> dict:
+    """Robustness-under-load over time (docs/CHAOS.md): a bounded storm
+    — mixed PUT/GET/DELETE fleet against a live SigV4 server while one
+    drive HANGs mid-run — reporting ops/s, p99 latency, error count,
+    and whether the zero-lost-acknowledged-write invariant held. BENCH
+    files then track whether perf refactors trade durability for
+    speed."""
+    import shutil
+
+    from minio_tpu.chaos import naughty as chaos_naughty
+    from minio_tpu.chaos.invariants import check_acknowledged_writes
+    from minio_tpu.chaos.ledger import WriteLedger
+    from minio_tpu.chaos.workload import MixedWorkload
+    from minio_tpu.s3.server import build_server
+    from tests.s3client import SigV4Client
+
+    ak, sk = "benchak00", "benchsk00secret0"
+    root = _bench_root()
+    stop = lambda: None  # noqa: E731
+    prev_wrap = os.environ.get(chaos_naughty.WRAP_ENV)
+    os.environ[chaos_naughty.WRAP_ENV] = "1"
+    try:
+        srv = build_server([os.path.join(root, f"d{i}") for i in range(4)],
+                           ak, sk, versioned=False)
+        port, stop = _serve_http(srv)
+        if port is None:
+            return {"metric": "chaos_smoke", "error": "server not up"}
+        base = f"http://127.0.0.1:{port}"
+        assert SigV4Client(base, ak, sk).put("/bench").status_code == 200
+
+        seed = int(os.environ.get("MTPU_CHAOS_SEED", "0") or 0)
+        ledger = WriteLedger()
+        fleet = MixedWorkload(
+            lambda: SigV4Client(base, ak, sk), ledger, "bench",
+            seed=seed, workers=4, sizes=(4 << 10, 32 << 10),
+            weights={"put": 5, "get": 5, "delete": 1, "list": 1},
+            op_timeout=30.0)
+
+        victims = chaos_naughty._match(os.path.join(root, "d1"))
+        storm_s = 12.0
+        t0 = time.perf_counter()
+        fleet.start()
+        time.sleep(storm_s * 0.3)
+        for nd in victims:                    # drive hang mid-run
+            nd.per_method_delay["read_version"] = chaos_naughty.HANG
+            nd.per_method_delay["create_file"] = chaos_naughty.HANG
+        time.sleep(storm_s * 0.4)
+        chaos_naughty.clear_all()             # release before the tail
+        time.sleep(storm_s * 0.3)
+        fleet.stop(timeout=60)
+        wall = time.perf_counter() - t0
+
+        c = SigV4Client(base, ak, sk)
+
+        def get_fn(key):
+            r = c.get(f"/bench/{key}")
+            return r.status_code, (r.content if r.status_code == 200
+                                   else b"")
+
+        rep = check_acknowledged_writes(get_fn, ledger, seed=seed)
+        stats = fleet.stats
+        return {"metric": "chaos_smoke", "unit": "ops/s",
+                "value": round(stats.total_ops() / wall, 1),
+                "vs_baseline": 0.0,
+                "p99_ms": round(stats.p99() * 1e3, 1),
+                "errors": stats.total_errors(),
+                "acked_writes": ledger.acked_count(),
+                "violations": len(stats.violations),
+                "invariant_pass": rep.ok() and not stats.violations,
+                "drive_hung": bool(victims)}
+    finally:
+        if prev_wrap is None:
+            os.environ.pop(chaos_naughty.WRAP_ENV, None)
+        else:
+            os.environ[chaos_naughty.WRAP_ENV] = prev_wrap
+        chaos_naughty.clear_all()
+        stop()
         shutil.rmtree(root, ignore_errors=True)
 
 
@@ -964,6 +1056,7 @@ def main() -> int:
             ("xlmeta", bench_xlmeta_codec),
             ("obs_overhead", bench_obs_overhead),
             ("check_overhead", bench_check_overhead),
+            ("chaos_smoke", bench_chaos_smoke),
         ]
         if use_pallas:
             plans.insert(1, ("encode_pallas",

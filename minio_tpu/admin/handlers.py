@@ -233,6 +233,7 @@ class AdminAPI:
             self._authorize(identity, "admin:*")
             import os as _os
 
+            from minio_tpu.chaos import naughty as chaos_naughty
             from minio_tpu.dist import faultplane
 
             if _os.environ.get("MTPU_FAULT_INJECTION", "") != "1":
@@ -240,13 +241,26 @@ class AdminAPI:
                     "NotImplemented",
                     "fault injection disabled (set MTPU_FAULT_INJECTION=1)")
             if m == "GET":
-                return _json(faultplane.describe())
+                return _json({**faultplane.describe(),
+                              "drives": chaos_naughty.describe()})
             if m == "POST":
                 try:
                     doc = json.loads(await request.read())
                     if not isinstance(doc, dict):
                         raise ValueError("fault document must be a "
                                          "JSON object")
+                    # Drive-plane ops (chaos/naughty.py) ride the same
+                    # guarded route as the network plane; "clear_all"
+                    # is the composed teardown across both planes.
+                    dop = doc.get("op", "")
+                    if not isinstance(dop, str):
+                        raise ValueError("fault op must be a string")
+                    if dop == "clear_all":
+                        from minio_tpu import chaos
+
+                        return _json(chaos.clear_all())
+                    if dop.startswith("drive"):
+                        return _json(chaos_naughty.apply_admin(doc))
                     return _json(faultplane.apply_admin(doc))
                 except (ValueError, KeyError, TypeError) as e:
                     raise S3Error("InvalidArgument", str(e)) from None
@@ -696,6 +710,18 @@ def _heal_item(i) -> dict:
            "versionId": getattr(i, "version_id", ""),
            "objectSize": getattr(i, "object_size", 0),
            "diskCount": getattr(i, "disk_count", 0)}
+    if isinstance(i, Exception):
+        # heal_objects yields typed ObjectErrors as items (e.g. a lock
+        # conflict with a dead node's stale heal lock); name the error
+        # so convergence checkers can tell "errored" from "healed".
+        out["error"] = f"{type(i).__name__}: {i}"
+    if getattr(i, "purged", False):
+        # Dangling cleanup (reference purgeObjectDangling): the object
+        # had fewer journals than parity tolerates — e.g. the remnant
+        # of a partially-applied delete — and heal REMOVED it. That is
+        # convergence, and checkers must be able to tell it from
+        # shards left missing.
+        out["purged"] = True
     before = getattr(i, "before", None)
     after = getattr(i, "after", None)
     if before is not None:

@@ -1,0 +1,86 @@
+"""Composed chaos plane — one seed drives every fault plane.
+
+The repo grew three fault planes that never met: drive faults
+(`chaos/naughty.py`, the NaughtyDisk StorageAPI decorator), network
+faults (`dist/faultplane.py`), and process crash/restart (the OS-process
+crash harness in tests). This package composes them:
+
+- **seed discipline** (this module): every plane derives its RNG seed
+  from one master integer (`MTPU_CHAOS_SEED`), so a single number
+  reproduces the whole storm — the same `(seed, program-order)`
+  contract faultplane already keeps, lifted one level up.
+- **schedule.py** — a deterministic multi-fault scheduler: one
+  programmed timeline of drive/network/process fault events, previewable
+  without consuming (`ChaosProgram.schedule(n)`), executed against
+  pluggable actuators.
+- **ledger.py** — a write-ahead ledger of acknowledged S3 operations
+  (key, ETag, content digest, completion order); the ground truth the
+  invariant checker replays after the storm.
+- **workload.py** — a mixed PUT/GET/DELETE/multipart/list client fleet
+  recording every acknowledged op into the ledger.
+- **invariants.py** — zero-lost-acknowledged-write / torn-read / heal
+  convergence / SLO checks, every failure message carrying the seed
+  that replays the storm.
+
+See docs/CHAOS.md for the scheduler model and invariant definitions.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+#: One integer reproduces the whole storm: network jitter, drive fault
+#: placement, crash timing, and workload key/content streams all derive
+#: from this master seed.
+MASTER_SEED_ENV = "MTPU_CHAOS_SEED"
+
+
+def master_seed(default: int = 0) -> int:
+    """The composed-chaos master seed (`MTPU_CHAOS_SEED`, default 0)."""
+    try:
+        return int(os.environ.get(MASTER_SEED_ENV, "") or default)
+    except ValueError:
+        return default
+
+
+def subseed(master: int, plane: str) -> int:
+    """Stable per-plane child seed. sha256, not `hash()`: string hashing
+    is salted per process, and the whole point is that the SAME integer
+    replays the SAME storm across the test driver and every server
+    process it boots."""
+    h = hashlib.sha256(f"{master}:{plane}".encode()).digest()
+    return int.from_bytes(h[:8], "big") & 0x7FFFFFFFFFFFFFFF
+
+
+def clear_all() -> dict:
+    """Unified teardown: release every NaughtyDisk fault program (HANG
+    sentinels included), uninstall the network fault plane (healing all
+    partitions), and re-close every peer circuit breaker. Invoked from a
+    conftest fixture so an aborted chaos test cannot leak faults into
+    the next test. Returns a summary of what was actually cleared (all
+    zeros on a clean run)."""
+    from minio_tpu.chaos import naughty
+    from minio_tpu.dist import faultplane, rpc
+
+    cleared = {"drive_faults": naughty.clear_all(),
+               "net_plane": 0, "breakers_reset": 0}
+    if faultplane.get() is not None:
+        faultplane.uninstall()
+        cleared["net_plane"] = 1
+    cleared["breakers_reset"] = rpc.reset_breakers()
+    return cleared
+
+
+def anything_armed() -> bool:
+    """Cheap post-test leak probe: is any fault plane still armed? A
+    live client's non-CLOSED breaker counts — a storm that uninstalled
+    its plane but left breakers open would otherwise bleed instant
+    DiskNotFound into the next test's first RPCs."""
+    from minio_tpu.chaos import naughty
+    from minio_tpu.dist import faultplane, rpc
+
+    return (faultplane.get() is not None or naughty.any_armed()
+            or any(not c._closed
+                   and c.breaker_state() != rpc.BREAKER_CLOSED
+                   for c in rpc._clients()))

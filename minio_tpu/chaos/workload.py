@@ -1,0 +1,283 @@
+"""Mixed-workload client fleet — concurrent PUT/GET/DELETE/multipart/
+list traffic that records every acknowledged mutation into a
+`WriteLedger` and torn-read-checks every GET in flight.
+
+The fleet is transport-agnostic: `client_factory()` must return an
+object with `put/get/delete/post(path, ...) -> response` where the
+response has `status_code`, `content`, and `headers` (the repo's
+`tests/s3client.SigV4Client` shape). Workers namespace their keys
+(`w{i}-k{j}`) so every key has a linear history and the ledger's
+expected-state fold is exact.
+
+Op streams are deterministic per worker — `random.Random(subseed(seed,
+"worker-i"))` drives op choice, key choice, and payload bytes — though
+wall-clock interleaving across workers of course is not. Storm-time
+failures (5xx, resets, timeouts) are EXPECTED and recorded as error
+counts; correctness violations (torn or mismatched reads) are recorded
+separately and must be zero."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from minio_tpu.chaos import subseed
+from minio_tpu.chaos.ledger import WriteLedger, digest
+
+# Transport-level failures a storm legitimately produces. requests'
+# exceptions all derive from OSError-adjacent bases; keep this broad
+# but EXPLICIT so programming errors (TypeError & friends) still raise.
+_NET_ERRORS = (ConnectionError, TimeoutError, OSError)
+
+
+def _net_errors():
+    try:
+        import requests
+
+        return _NET_ERRORS + (requests.RequestException,)
+    except ImportError:
+        return _NET_ERRORS
+
+
+class FleetStats:
+    def __init__(self):
+        self.mu = threading.Lock()
+        self.ops: dict[str, int] = {}
+        self.errors: dict[str, int] = {}
+        self.latencies: dict[str, list[float]] = {}
+        self.violations: list[str] = []
+
+    def record(self, kind: str, dt: float, ok: bool) -> None:
+        with self.mu:
+            self.ops[kind] = self.ops.get(kind, 0) + 1
+            self.latencies.setdefault(kind, []).append(dt)
+            if not ok:
+                self.errors[kind] = self.errors.get(kind, 0) + 1
+
+    def violation(self, msg: str) -> None:
+        with self.mu:
+            self.violations.append(msg)
+
+    def total_ops(self) -> int:
+        with self.mu:
+            return sum(self.ops.values())
+
+    def total_errors(self) -> int:
+        with self.mu:
+            return sum(self.errors.values())
+
+    def p99(self, kind: str | None = None) -> float:
+        with self.mu:
+            vals = (sorted(self.latencies.get(kind, [])) if kind
+                    else sorted(v for vs in self.latencies.values()
+                                for v in vs))
+        if not vals:
+            return 0.0
+        return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+
+    def describe(self) -> dict:
+        with self.mu:
+            return {"ops": dict(self.ops), "errors": dict(self.errors),
+                    "violations": list(self.violations),
+                    "p99_s": round(self.p99(), 3)}
+
+
+class MixedWorkload:
+    """`workers` client threads looping a weighted op mix until
+    `stop()`. Sizes stay small-object by default (the chaos tier is a
+    correctness storm, not a throughput bench); `mp_size` parts drive
+    the multipart path through the same ledger."""
+
+    def __init__(self, client_factory, ledger: WriteLedger, bucket: str,
+                 seed: int = 0, workers: int = 6,
+                 sizes: tuple[int, ...] = (4 << 10, 32 << 10, 128 << 10),
+                 mp_size: int = 5 << 20, keyspace: int = 8,
+                 weights: dict[str, int] | None = None,
+                 op_timeout: float = 30.0):
+        self.factory = client_factory
+        self.ledger = ledger
+        self.bucket = bucket
+        self.seed = seed
+        self.workers = workers
+        self.sizes = sizes
+        self.mp_size = mp_size
+        self.keyspace = keyspace
+        self.op_timeout = op_timeout
+        self.weights = weights or {"put": 5, "get": 5, "delete": 1,
+                                   "list": 1, "multipart": 1}
+        self.stats = FleetStats()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "MixedWorkload":
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker, args=(i,),
+                                 name=f"chaos-workload-{i}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self, timeout: float = 60.0) -> bool:
+        self._stop.set()
+        ok = True
+        for t in self._threads:
+            t.join(timeout)
+            ok = ok and not t.is_alive()
+        return ok
+
+    def run_for(self, seconds: float) -> bool:
+        self.start()
+        self._stop.wait(seconds)
+        return self.stop()
+
+    # -- op implementations --------------------------------------------
+    #
+    # Each worker owns its keys and issues ops sequentially, so it can
+    # torn-read-check in flight with a LOCAL candidate map: after an
+    # acked mutation exactly one outcome is allowed; after a FAILED one
+    # the new generation is added to the allowed set (the op may or may
+    # not have committed server-side — both are legal, a third state is
+    # a torn write). `None` in a candidate set means "absent is legal".
+
+    def _settle(self, cand: dict, key: str, sha: str | None,
+                acked: bool) -> None:
+        if acked:
+            cand[key] = {sha}
+        else:
+            cand.setdefault(key, {None}).add(sha)
+
+    def _op_put(self, client, rng, cand, key: str) -> bool:
+        body = rng.randbytes(rng.choice(self.sizes))
+        sha = digest(body)
+        e = self.ledger.intent("put", key, sha, len(body))
+        acked = False
+        try:
+            r = client.put(f"/{self.bucket}/{key}", data=body,
+                           timeout=self.op_timeout)
+            acked = r.status_code == 200
+        finally:
+            # Transport failure == unacked attempt: both outcomes legal.
+            self._settle(cand, key, sha, acked)
+        if acked:
+            self.ledger.ack(e, r.headers.get("ETag", ""))
+        return acked
+
+    def _op_delete(self, client, rng, cand, key: str) -> bool:
+        e = self.ledger.intent("delete", key)
+        acked = False
+        try:
+            r = client.delete(f"/{self.bucket}/{key}",
+                              timeout=self.op_timeout)
+            acked = r.status_code in (200, 204)
+        finally:
+            self._settle(cand, key, None, acked)
+        if acked:
+            self.ledger.ack(e)
+        return acked
+
+    def _op_multipart(self, client, rng, cand, key: str) -> bool:
+        # One full-size part (S3 minimum 5 MiB) + a short tail part:
+        # exercises the multipart commit without making every chaos
+        # object deep-heal-expensive.
+        bodies = [rng.randbytes(self.mp_size), rng.randbytes(64 << 10)]
+        whole = b"".join(bodies)
+        path = f"/{self.bucket}/{key}"
+        r = client.post(path, query={"uploads": ""},
+                        timeout=self.op_timeout)
+        if r.status_code != 200:
+            return False
+        text = r.content.decode("utf-8", "replace")
+        try:
+            uid = text.split("<UploadId>")[1].split("</UploadId>")[0]
+        except IndexError:
+            return False
+        etags = []
+        for n, b in enumerate(bodies, 1):
+            r = client.put(path, data=b,
+                           query={"uploadId": uid, "partNumber": str(n)},
+                           timeout=self.op_timeout)
+            if r.status_code != 200:
+                return False
+            etags.append(r.headers.get("ETag", ""))
+        # The COMPLETE is the acknowledged mutation: intent just before.
+        sha = digest(whole)
+        e = self.ledger.intent("multipart", key, sha, len(whole))
+        done = ("<CompleteMultipartUpload>" + "".join(
+            f"<Part><PartNumber>{n}</PartNumber><ETag>{t}</ETag></Part>"
+            for n, t in enumerate(etags, 1))
+            + "</CompleteMultipartUpload>").encode()
+        acked = False
+        try:
+            r = client.post(path, data=done, query={"uploadId": uid},
+                            timeout=self.op_timeout)
+            acked = r.status_code == 200 and b"<Error>" not in r.content
+        finally:
+            self._settle(cand, key, sha, acked)
+        if acked:
+            self.ledger.ack(e)
+        return acked
+
+    def _op_get(self, client, rng, cand, key: str) -> bool:
+        allowed = cand.get(key, {None})
+        r = client.get(f"/{self.bucket}/{key}", timeout=self.op_timeout)
+        if r.status_code == 200:
+            got = digest(r.content)
+            if got not in allowed:
+                self.stats.violation(
+                    f"torn read {key}: got {len(r.content)}B sha "
+                    f"{got[:12]}, allowed "
+                    f"{[a[:12] if a else None for a in allowed]}")
+                return False
+            return True
+        if r.status_code == 404:
+            if None not in allowed:
+                self.stats.violation(
+                    f"lost acknowledged write {key}: 404 but only "
+                    f"{[a[:12] if a else None for a in allowed]} allowed")
+                return False
+            return True
+        return False
+
+    def _op_list(self, client, rng, wid: int) -> bool:
+        r = client.get(f"/{self.bucket}", query={"list-type": "2",
+                                                 "prefix": f"w{wid}-"},
+                       timeout=self.op_timeout)
+        return r.status_code == 200
+
+    # -- the worker loop -----------------------------------------------
+
+    def _worker(self, wid: int) -> None:
+        rng = random.Random(subseed(self.seed, f"worker-{wid}"))
+        client = self.factory()
+        # Worker-local candidate map (keys are worker-owned): key ->
+        # set of legal read outcomes (digests / None for absent).
+        cand: dict[str, set] = {}
+        kinds = [k for k, w in self.weights.items() for _ in range(w)]
+        net_errors = _net_errors()
+        while not self._stop.is_set():
+            kind = rng.choice(kinds)
+            key = f"w{wid}-k{rng.randrange(self.keyspace)}"
+            if kind == "multipart":
+                key = f"w{wid}-mp{rng.randrange(2)}"
+            t0 = time.monotonic()
+            ok = False
+            try:
+                if kind == "put":
+                    ok = self._op_put(client, rng, cand, key)
+                elif kind == "get":
+                    ok = self._op_get(client, rng, cand, key)
+                elif kind == "delete":
+                    ok = self._op_delete(client, rng, cand, key)
+                elif kind == "multipart":
+                    ok = self._op_multipart(client, rng, cand, key)
+                else:
+                    ok = self._op_list(client, rng, wid)
+            except net_errors:
+                # The storm eating a request is the expected failure
+                # mode (counted via ok=False); the write-ahead intent
+                # row keeps the op visible to the checker.
+                ok = False
+            self.stats.record(kind, time.monotonic() - t0, ok)

@@ -248,8 +248,18 @@ class FaultPlane:
 _PLANE: FaultPlane | None = None
 
 
-def install(plane: FaultPlane | None = None, seed: int = 0) -> FaultPlane:
+def install(plane: FaultPlane | None = None,
+            seed: int | None = None) -> FaultPlane:
+    """Install the process-global plane. With seed=None the plane seed
+    derives from the composed-chaos master (`MTPU_CHAOS_SEED`, via
+    chaos.subseed(master, "net")): one integer then reproduces the
+    network schedule together with the drive and crash schedules. An
+    explicit seed overrides — single-plane tests keep their pinning."""
     global _PLANE
+    if plane is None and seed is None:
+        from minio_tpu import chaos
+
+        seed = chaos.subseed(chaos.master_seed(), "net")
     _PLANE = plane if plane is not None else FaultPlane(seed=seed)
     return _PLANE
 
@@ -278,7 +288,7 @@ def apply_admin(doc: dict) -> dict:
       {"op": "clear"}
     """
     plane = _PLANE if _PLANE is not None else install(
-        seed=int(doc.get("seed", 0)))
+        seed=int(doc["seed"]) if doc.get("seed") is not None else None)
     op = doc.get("op", "")
     if op == "rule":
         kw = {k: doc[k] for k in ("src", "peer", "route", "plane", "delay",

@@ -37,6 +37,7 @@ import socket
 import threading
 import time
 import urllib.parse
+import weakref
 from typing import BinaryIO, Iterable, Iterator
 
 import msgpack
@@ -115,6 +116,23 @@ _RPC_SHED = obs.counter(
     "minio_tpu_rpc_retry_shed_total",
     "Retries shed because the per-peer retry budget was exhausted",
     ("peer",))
+
+# Every live RestClient, weakly — the composed chaos plane's teardown
+# (minio_tpu/chaos.clear_all) force-closes breakers a storm opened so
+# an aborted chaos test cannot bleed OPEN peers into the next test.
+_CLIENTS: "weakref.WeakSet" = weakref.WeakSet()
+_CLIENTS_MU = threading.Lock()
+
+
+def _clients() -> list:
+    with _CLIENTS_MU:
+        return list(_CLIENTS)
+
+
+def reset_breakers() -> int:
+    """Force every OPEN/HALF_OPEN breaker in the process back to CLOSED
+    (chaos teardown hygiene). Returns how many breakers were reset."""
+    return sum(1 for c in _clients() if c.reset_breaker())
 
 
 # --- auth tokens -------------------------------------------------------------
@@ -371,6 +389,8 @@ class RestClient:
         self._obs_retry = _RPC_RETRIES.labels(peer=peer)
         self._obs_shed = _RPC_SHED.labels(peer=peer)
         self._obs_breaker.set(BREAKER_CLOSED)
+        with _CLIENTS_MU:
+            _CLIENTS.add(self)
 
     def _transport_error(self, e: Exception) -> se.StorageError:
         """Typed per-drive error for a NETWORK failure, tagged so the
@@ -483,6 +503,21 @@ class RestClient:
         if closed:
             self._enter_state(BREAKER_CLOSED)
 
+    def reset_breaker(self) -> bool:
+        """Force the breaker back to CLOSED — chaos-plane teardown only
+        (production breakers heal through the probe/HALF_OPEN cycle).
+        The probe loop observes the state flip and retires itself; a
+        closed client is left alone. Returns True when a non-CLOSED
+        breaker was actually reset."""
+        with self._lock:
+            if self._closed or self._state == BREAKER_CLOSED:
+                return False
+            self._state = BREAKER_CLOSED
+            self._half_open_busy = False
+            self._consec = 0
+        self._enter_state(BREAKER_CLOSED)
+        return True
+
     def mark_offline(self) -> None:
         start_probe = False
         with self._lock:
@@ -515,6 +550,13 @@ class RestClient:
         delay = HEALTH_INTERVAL
         failures = 0
         while not self._probe_stop.wait(delay * random.uniform(0.6, 1.0)):
+            with self._lock:
+                # A breaker forced CLOSED out-of-band (reset_breakers,
+                # chaos teardown) retires the probe: it must not race a
+                # reset by re-entering HALF_OPEN on its next success.
+                if self._state != BREAKER_OPEN:
+                    self._probing = False
+                    return
             try:
                 conn = self._new_conn(timeout=2.0, path="/health")
                 conn.request("GET", "/health")
@@ -524,6 +566,13 @@ class RestClient:
                 ok = False
             if ok:
                 with self._lock:
+                    # Recheck under the lock: a reset_breaker() landing
+                    # while this probe's round trip was in flight has
+                    # already closed the breaker — the success must not
+                    # overwrite CLOSED with HALF_OPEN.
+                    if self._state != BREAKER_OPEN:
+                        self._probing = False
+                        return
                     self._state = BREAKER_HALF_OPEN
                     self._half_open_busy = False
                     self._probing = False

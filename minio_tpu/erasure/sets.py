@@ -57,8 +57,18 @@ class ErasureSets:
             from minio_tpu.storage.healthcheck import wrap_with_healthcheck
             from minio_tpu.storage.idcheck import wrap_with_id_check
 
-            drives = wrap_with_healthcheck(
-                wrap_with_id_check(drives, fmt), fmt)
+            drives = wrap_with_id_check(drives, fmt)
+            # Composed chaos plane (docs/CHAOS.md): with
+            # MTPU_CHAOS_DRIVE_WRAP=1 each LOCAL drive gets an inert
+            # NaughtyDisk between the ID check and the health checker,
+            # programmable at runtime through the guarded admin faults
+            # endpoint — injected hangs then exercise the real
+            # ONLINE→FAULTY→OFFLINE machinery and the sentinel probe.
+            from minio_tpu.chaos import naughty as _chaos_naughty
+
+            if _chaos_naughty.wrap_enabled():
+                drives = _chaos_naughty.wrap_drives(drives)
+            drives = wrap_with_healthcheck(drives, fmt)
         self.format = fmt
         self.deployment_id = fmt.deployment_id
         self.set_count = len(drives) // set_drive_count

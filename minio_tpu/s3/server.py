@@ -406,6 +406,12 @@ class S3Server:
         self.cluster_node = node
         self.notification = node.notification
         self.node_name = node.node_name
+        # Admin force-unlock operates on THIS node's dsync locker (the
+        # reference ForceUnlockHandler clears the local lock-rest
+        # server): without this wire the endpoint 501s in exactly the
+        # deployment it exists for. The chaos tier leans on it as the
+        # documented remedy for a dead node's stale heal lock.
+        self.local_locker = node.locker
         obs.set_default_node(node.node_name)
         node.hooks.trace_bus = self.trace_bus
         node.hooks.console_bus = self.logger.console_bus

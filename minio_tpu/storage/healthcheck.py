@@ -29,6 +29,7 @@ total op duration.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid as _uuid
@@ -48,11 +49,23 @@ _STATE_CODE = {ONLINE: 0, FAULTY: 1, OFFLINE: 2}
 # Per-op-class (timeout, minimum) seeds for the adaptive deadlines.
 # "meta" bounds journal/volume round trips, "data" bounds shard
 # streams, "walk" bounds the gap between listing entries.
+# MTPU_DRIVE_DEADLINE_{META,DATA,WALK} override the seed (the chaos
+# harness tightens them so an injected hang walks a drive OFFLINE
+# within its storm window; production tuning rides the same knobs).
 DEFAULT_DEADLINES = {
     "meta": (8.0, 1.0),
     "data": (30.0, 2.0),
     "walk": (30.0, 2.0),
 }
+
+for _cls, (_t, _m) in list(DEFAULT_DEADLINES.items()):
+    _v = os.environ.get(f"MTPU_DRIVE_DEADLINE_{_cls.upper()}", "")
+    if _v:
+        try:
+            _t = float(_v)
+        except ValueError:
+            continue
+        DEFAULT_DEADLINES[_cls] = (_t, min(_m, _t))
 
 OFFLINE_AFTER = 3      # consecutive failures before FAULTY -> OFFLINE
 PROBE_INTERVAL = 1.0   # sentinel probe cadence while OFFLINE

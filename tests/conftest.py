@@ -134,6 +134,38 @@ def bucket(client):
     return "apitest"
 
 
+@pytest.fixture(scope="session")
+def crash_cluster(tmp_path_factory):
+    """The OS-process 3-node cluster (tests/crash_cluster.py), booted
+    lazily once per session and shared by the crash-recovery and
+    composed-chaos tiers — process boot (3× jax import) is the dominant
+    cost, the storm itself is cheap."""
+    from tests import crash_cluster as cc
+
+    work = tmp_path_factory.mktemp("crashwork")
+    cl = cc.Cluster(work)
+    for i in range(cc.N_NODES):
+        cl.start(i)
+    for i in range(cc.N_NODES):
+        cl.wait_healthy(i)
+    yield cl
+    cl.stop_all()
+
+
+@pytest.fixture(autouse=True)
+def _chaos_fault_hygiene():
+    """Composed-chaos teardown hygiene: an aborted chaos test must not
+    leak faults into the next test. After every test, if ANY fault
+    plane is still armed (network plane installed, a NaughtyDisk
+    program — HANG sentinels included — or a forced-open breaker),
+    release it all. A clean test pays two module-attribute reads."""
+    yield
+    from minio_tpu import chaos
+
+    if chaos.anything_armed():
+        chaos.clear_all()
+
+
 @pytest.fixture(autouse=True)
 def _thread_leak_guard():
     """Thread-leak sanitizer: no non-daemon, non-exempt thread born
@@ -170,3 +202,18 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running soak/stress tests excluded from "
         "the tier-1 window")
+    config.addinivalue_line(
+        "markers", "chaos: composed multi-fault storm tests "
+        "(docs/CHAOS.md); deselect with -m 'not chaos' when iterating "
+        "on unrelated code")
+
+
+def pytest_report_header(config):
+    # Every chaos plane (network jitter, drive fault placement, crash
+    # timing, workload streams) derives from this one integer — a chaos
+    # failure message names it, this header makes the active value
+    # visible up front.
+    from minio_tpu import chaos
+
+    return (f"chaos seed: MTPU_CHAOS_SEED="
+            f"{chaos.master_seed()} (one integer replays the storm)")

@@ -1,148 +1,10 @@
-"""naughty-disk — programmable fault-injection StorageAPI decorator.
+"""Compat shim — NaughtyDisk moved into the package proper
+(minio_tpu/chaos/naughty.py) so the composed chaos plane can wrap live
+server drives behind the guarded admin faults endpoint. Test imports
+(`from tests.naughty import HANG, NaughtyDisk`) keep working unchanged."""
 
-Role-equivalent of cmd/naughty-disk_test.go: wraps a real drive and returns
-programmed errors at chosen call indices or for chosen methods, so failure
-tests exercise per-call error handling (timeouts, partial writes, flaky
-drives) instead of only wrecking files on disk.
-
-Latency injection (the drive-hang test surface): per_method_delay maps a
-method name to seconds of added latency, or to the HANG sentinel for an
-indefinite stall; stream_chunk_delay paces every read() of the streams
-returned by read_file_stream / read_file_range_stream (a drive that opens
-fine but trickles data). Hung calls block on `release` — set it in
-teardown to unstick leaked daemon threads."""
-
-from __future__ import annotations
-
-import threading
-import time
-
-# Sentinel for per_method_delay: the call blocks until `release` is set
-# (an injected drive hang, the NFS-stall failure mode).
-HANG = float("inf")
-
-
-class NaughtyDisk:
-    def __init__(self, inner, per_call: dict[int, Exception] | None = None,
-                 per_method: dict[str, Exception] | None = None,
-                 default: Exception | None = None,
-                 per_method_call: dict | None = None,
-                 per_method_delay: dict[str, float] | None = None,
-                 stream_chunk_delay: float = 0.0):
-        """per_call: {global call index (1-based): error to raise};
-        per_method: {method name: error} (every call of that method fails);
-        per_method_call: {(method name, k): error} — fail only the k-th
-        call OF THAT METHOD (1-based), the reference naughty-disk's
-        per-call error matrices; default: raised for any call index not
-        in per_call (when set);
-        per_method_delay: {method name: seconds | HANG} — sleep before
-        forwarding (HANG blocks until self.release is set);
-        stream_chunk_delay: seconds slept inside every read() of streams
-        returned by read_file_stream/read_file_range_stream."""
-        self.inner = inner
-        self.per_call = per_call or {}
-        self.per_method = per_method or {}
-        self.per_method_call = per_method_call or {}
-        self.per_method_delay = per_method_delay or {}
-        self.stream_chunk_delay = stream_chunk_delay
-        self.default = default
-        self.calls = 0
-        self.method_calls: dict[str, int] = {}
-        self.release = threading.Event()  # unsticks HANG'd calls
-        self._mu = threading.Lock()
-
-    def _maybe_delay(self, name: str) -> None:
-        d = self.per_method_delay.get(name)
-        if not d:
-            return
-        if d == HANG:
-            self.release.wait()
-        else:
-            time.sleep(d)
-
-    def _maybe_fail(self, name: str) -> None:
-        with self._mu:
-            self.calls += 1
-            n = self.calls
-            self.method_calls[name] = self.method_calls.get(name, 0) + 1
-            mk = self.method_calls[name]
-        if name in self.per_method:
-            raise self.per_method[name]
-        if (name, mk) in self.per_method_call:
-            raise self.per_method_call[(name, mk)]
-        if n in self.per_call:
-            raise self.per_call[n]
-        if self.default is not None and self.per_call:
-            # default fires only when a per_call program exists and the
-            # index is past it (mirrors naughty-disk's defaultErr)
-            if n > max(self.per_call):
-                raise self.default
-
-    def __getattr__(self, name: str):
-        fn = getattr(self.inner, name)
-        if not callable(fn) or name.startswith("_"):
-            return fn
-
-        def wrapped(*a, **kw):
-            # Specialized read entry points ALSO honor their base
-            # method's fault program: a hook keyed on the specific name
-            # (per_method, per_method_call or per_method_delay) fires
-            # first; otherwise read_file_range_stream falls back to
-            # read_file_stream's program.
-            prog = name
-            if (name == "read_file_range_stream"
-                    and name not in self.per_method
-                    and name not in self.per_method_delay
-                    and not any(k[0] == name
-                                for k in self.per_method_call)):
-                prog = "read_file_stream"
-            self._maybe_fail(prog)
-            self._maybe_delay(prog)
-            out = fn(*a, **kw)
-            if (self.stream_chunk_delay
-                    and name in ("read_file_stream",
-                                 "read_file_range_stream")):
-                return _SlowStream(out, self.stream_chunk_delay,
-                                   self.release)
-            return out
-
-        return wrapped
-
-
-class _SlowStream:
-    """File-like pacing wrapper: every read sleeps the chunk delay
-    (HANG blocks until released) — a drive serving bytes at a trickle."""
-
-    def __init__(self, inner, delay: float, release: threading.Event):
-        self._inner = inner
-        self._delay = delay
-        self._release = release
-
-    def _pace(self) -> None:
-        if self._delay == HANG:
-            self._release.wait()
-        else:
-            time.sleep(self._delay)
-
-    def read(self, *a, **kw):
-        self._pace()
-        return self._inner.read(*a, **kw)
-
-    def read1(self, *a, **kw):
-        self._pace()
-        return self._inner.read1(*a, **kw)
-
-    def __getattr__(self, name):
-        return getattr(self._inner, name)
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
-
-    def close(self):
-        try:
-            self._inner.close()
-        except Exception:  # noqa: BLE001 - teardown only
-            pass
+from minio_tpu.chaos.naughty import (  # noqa: F401
+    HANG,
+    NaughtyDisk,
+    _SlowStream,
+)

@@ -90,6 +90,12 @@ class SigV4Client:
     def post(self, path, data=b"", **kw):
         return self.request("POST", path, data=data, **kw)
 
+    def ledgered(self, bucket: str, ledger=None) -> "LedgeredClient":
+        """Acknowledged-write recording view of this client (composed
+        chaos plane): every mutation rides a write-ahead ledger row and
+        `verify_settled` replays the ledger bit-exactly afterwards."""
+        return LedgeredClient(self, bucket, ledger=ledger)
+
     def presigned_url(self, method: str, path: str, expires: int = 3600) -> str:
         now = datetime.datetime.now(datetime.timezone.utc)
         amz_date = now.strftime("%Y%m%dT%H%M%SZ")
@@ -120,3 +126,60 @@ class SigV4Client:
             key = hmac.new(key, part.encode(), hashlib.sha256).digest()
         sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
         return f"{self.endpoint}{path}?{cq}&X-Amz-Signature={sig}"
+
+
+class LedgeredClient:
+    """Acknowledged-write bookkeeping for soak/chaos tests, backed by
+    the chaos plane's write-ahead ledger (minio_tpu/chaos/ledger.py):
+    mutations record an intent row before the request and an ack row
+    only on a 2xx, and `verify_settled` replays the ledger afterwards —
+    every settled acked write must read back bit-exactly (the
+    zero-lost-acknowledged-write invariant), in-flight tails may land
+    either way but never torn. Replaces ad-hoc `keys.append((key,
+    body))` bookkeeping in partition/chaos soaks."""
+
+    def __init__(self, client: SigV4Client, bucket: str, ledger=None):
+        from minio_tpu.chaos.ledger import WriteLedger
+
+        self.client = client
+        self.bucket = bucket
+        self.ledger = ledger if ledger is not None else WriteLedger()
+
+    def _path(self, key: str) -> str:
+        return f"/{self.bucket}/{key}"
+
+    def put(self, key: str, data: bytes, **kw):
+        from minio_tpu.chaos.ledger import digest
+
+        e = self.ledger.intent("put", key, digest(data), len(data))
+        r = self.client.put(self._path(key), data=data, **kw)
+        if r.status_code == 200:
+            self.ledger.ack(e, r.headers.get("ETag", ""))
+        return r
+
+    def delete(self, key: str, **kw):
+        e = self.ledger.intent("delete", key)
+        r = self.client.delete(self._path(key), **kw)
+        if r.status_code in (200, 204):
+            self.ledger.ack(e)
+        return r
+
+    def get(self, key: str, **kw):
+        return self.client.get(self._path(key), **kw)
+
+    def verify_settled(self, client: SigV4Client | None = None, seed: int = 0):
+        """Replay the ledger through `client` (default: the recording
+        client) and assert zero lost acknowledged writes / no torn
+        reads. Returns the InvariantReport for further assertions."""
+        from minio_tpu.chaos.invariants import check_acknowledged_writes
+
+        cl = client if client is not None else self.client
+
+        def get_fn(key):
+            r = cl.get(self._path(key))
+            return r.status_code, (r.content if r.status_code == 200
+                                   else b"")
+
+        rep = check_acknowledged_writes(get_fn, self.ledger, seed=seed)
+        rep.assert_ok()
+        return rep

@@ -1,0 +1,475 @@
+"""Composed chaos plane: deterministic multi-fault storms with a
+zero-lost-acknowledged-write invariant checker (docs/CHAOS.md).
+
+Three tiers:
+  1. fast determinism/semantics units — one seed reproduces the whole
+     storm (schedule preview, subseed stability, ledger fold,
+     invariant-checker sensitivity, teardown hygiene);
+  2. the bounded tier-1 storm on the shared OS-process cluster: hung
+     drive + asymmetric partition + one real SIGKILL under a concurrent
+     mixed workload, ending in zero acknowledged-write loss, bit-exact
+     reads, and heal convergence — all asserted;
+  3. a @pytest.mark.slow flapping soak that additionally asserts p99
+     latency and 5xx-rate SLOs from the obs/ histograms.
+
+Every failure message carries MTPU_CHAOS_SEED; the same integer replays
+the identical fault schedule (asserted below, not just promised).
+"""
+
+import threading
+import time
+
+import pytest
+
+from minio_tpu import chaos
+from minio_tpu.chaos import invariants, ledger as ledger_mod, schedule
+from minio_tpu.chaos import naughty as naughty_mod
+from minio_tpu.chaos.workload import MixedWorkload
+from minio_tpu.dist import faultplane
+from minio_tpu.dist import rpc as rpc_mod
+from tests.crash_cluster import (
+    DRIVES_PER_NODE,
+    N_NODES,
+    wait_drives_online,
+)
+
+# One integer reproduces the storm; override with MTPU_CHAOS_SEED.
+SEED = chaos.master_seed(default=20260803)
+
+
+# ---------------------------------------------------------------------------
+# 1a. seed discipline + schedule determinism
+# ---------------------------------------------------------------------------
+
+def test_subseed_stable_across_processes():
+    """subseed is sha256-based, NOT hash(): string hashing is salted
+    per process and the seed must mean the same storm in the driver and
+    every server subprocess. Pin one value so any change to the
+    derivation (which would silently retire every recorded repro seed)
+    fails loudly."""
+    assert chaos.subseed(42, "net") == chaos.subseed(42, "net")
+    assert chaos.subseed(42, "net") != chaos.subseed(42, "drive")
+    assert chaos.subseed(42, "net") != chaos.subseed(43, "net")
+    assert chaos.subseed(0, "net") == 3066711364380105199
+
+
+def test_program_generation_deterministic():
+    kw = dict(nodes=["a:1", "b:2", "c:3"], drives=["d0", "d1"],
+              kill_nodes=["c:3"])
+    a = schedule.ChaosProgram.generate(SEED, 60.0, **kw)
+    b = schedule.ChaosProgram.generate(SEED, 60.0, **kw)
+    assert a.schedule() == b.schedule()
+    assert a.schedule(5) == b.schedule(5) == a.schedule()[:5]
+    # Preview does not consume: repeated previews are identical.
+    assert a.schedule() == a.schedule()
+    # Another seed yields another storm.
+    c = schedule.ChaosProgram.generate(SEED + 1, 60.0, **kw)
+    assert a.schedule() != c.schedule()
+    # Generated storms are well-formed: every hang is cleared, every
+    # partition healed, every kill restarted — within the duration.
+    kinds = [k for _t, k, *_rest in a.schedule()]
+    assert kinds.count(schedule.DRIVE_HANG) == kinds.count(
+        schedule.DRIVE_CLEAR)
+    assert kinds.count(schedule.NET_PARTITION) == kinds.count(
+        schedule.NET_HEAL)
+    assert kinds.count(schedule.KILL) == kinds.count(schedule.RESTART) == 1
+    assert a.duration() <= 60.0
+
+
+def test_scheduler_applies_in_order_and_records_errors():
+    prog = schedule.ChaosProgram(SEED)
+    prog.add(0.02, schedule.DRIVE_HANG, "d0", method="read_version")
+    prog.add(0.05, schedule.NET_HEAL, "x", name="p")
+    prog.add(0.08, schedule.KILL, "node-without-actuator")
+    applied = []
+    sched = schedule.ChaosScheduler(prog, {
+        schedule.DRIVE_HANG: lambda ev: applied.append(ev.kind),
+        schedule.NET_HEAL: lambda ev: applied.append(ev.kind),
+        # KILL deliberately unwired: the storm must continue and the
+        # miss must be recorded, not raised.
+    })
+    sched.start()
+    assert sched.join(5.0)
+    assert applied == [schedule.DRIVE_HANG, schedule.NET_HEAL]
+    assert sched.applied() == prog.schedule(2)
+    assert len(sched.errors()) == 1 and "KILL".lower() in str(
+        sched.errors()[0])
+
+
+def test_faultplane_derives_seed_from_chaos_master(monkeypatch):
+    monkeypatch.setenv(chaos.MASTER_SEED_ENV, "5")
+    p = faultplane.install()
+    try:
+        assert p.seed == chaos.subseed(5, "net")
+    finally:
+        faultplane.uninstall()
+    # Explicit seeds still pin single-plane tests.
+    p = faultplane.install(seed=123)
+    try:
+        assert p.seed == 123
+    finally:
+        faultplane.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# 1b. ledger fold + invariant checker sensitivity
+# ---------------------------------------------------------------------------
+
+def test_ledger_expected_state_fold():
+    L = ledger_mod.WriteLedger()
+    # settled put
+    e = L.intent("put", "a", "A1", 2)
+    L.ack(e, "etag-a")
+    # settled put superseded by in-flight put: either generation legal
+    e = L.intent("put", "b", "B1", 2)
+    L.ack(e)
+    L.intent("put", "b", "B2", 2)
+    # acked delete after acked put: absent is the only legal outcome
+    e = L.intent("put", "c", "C1", 2)
+    L.ack(e)
+    e = L.intent("delete", "c")
+    L.ack(e)
+    # never acked at all: absent or the attempted generation
+    L.intent("put", "d", "D1", 2)
+
+    exp = L.expected()
+    assert exp["a"].must_exist and exp["a"].candidates == ["A1"]
+    assert not exp["b"].must_exist
+    assert exp["b"].candidates == ["B1", "B2"]
+    assert exp["c"].candidates == [None]
+    assert exp["d"].candidates == [None, "D1"]
+    assert L.acked_count() == 4
+
+
+def test_invariant_checker_catches_loss_torn_and_ghost():
+    L = ledger_mod.WriteLedger()
+    bodies = {"lost": b"xx", "torn": b"yyyy", "ok": b"zz"}
+    for k, v in bodies.items():
+        e = L.intent("put", k, ledger_mod.digest(v), len(v))
+        L.ack(e)
+    e = L.intent("delete", "ghost")
+    L.ack(e)
+
+    served = {"lost": (404, b""), "torn": (200, b"yyXX"),
+              "ok": (200, b"zz"), "ghost": (200, b"boo")}
+    rep = invariants.check_acknowledged_writes(
+        lambda k: served[k], L, seed=777)
+    assert not rep.ok() and len(rep.failures) == 3
+    msg = rep.summary()
+    assert "MTPU_CHAOS_SEED=777" in msg        # the repro seed is IN the
+    with pytest.raises(AssertionError, match="MTPU_CHAOS_SEED=777"):
+        rep.assert_ok()                        # failure message itself
+
+    # And a fully-healthy serve passes.
+    served.update({"lost": (200, b"xx"), "torn": (200, b"yyyy"),
+                   "ghost": (404, b"")})
+    invariants.check_acknowledged_writes(
+        lambda k: served[k], L, seed=777).assert_ok()
+
+
+def test_slo_quantile_and_delta():
+    fam = "minio_tpu_s3_requests_latency_seconds"
+    before = "\n".join([
+        f'{fam}_bucket{{api="PutObject",le="0.1"}} 0',
+        f'{fam}_bucket{{api="PutObject",le="1"}} 0',
+        f'{fam}_bucket{{api="PutObject",le="+Inf"}} 0',
+        'minio_tpu_s3_requests_total{api="PutObject"} 0',
+        'minio_tpu_s3_requests_5xx_errors_total{api="PutObject"} 0'])
+    after = "\n".join([
+        f'{fam}_bucket{{api="PutObject",le="0.1"}} 98',
+        f'{fam}_bucket{{api="PutObject",le="1"}} 100',
+        f'{fam}_bucket{{api="PutObject",le="+Inf"}} 100',
+        'minio_tpu_s3_requests_total{api="PutObject"} 100',
+        'minio_tpu_s3_requests_5xx_errors_total{api="PutObject"} 3'])
+    win = invariants.delta(invariants.parse_exposition(after),
+                           invariants.parse_exposition(before))
+    p99 = invariants.histogram_quantile(win, fam, 0.99,
+                                        {"api": "PutObject"})
+    assert 0.1 < p99 <= 1.0
+    rep = invariants.check_slos(win, seed=SEED, p99_bound=1.0,
+                                error_rate_bound=0.05,
+                                apis=("PutObject",))
+    rep.assert_ok()
+    rep = invariants.check_slos(win, seed=SEED, p99_bound=0.05,
+                                error_rate_bound=0.01,
+                                apis=("PutObject",))
+    assert len(rep.failures) == 2
+    # A quantile landing in +Inf is an SLO failure, not false comfort.
+    inf_win = invariants.parse_exposition(
+        f'{fam}_bucket{{api="PutObject",le="+Inf"}} 7')
+    assert invariants.histogram_quantile(inf_win, fam, 0.99) == float(
+        "inf")
+
+
+# ---------------------------------------------------------------------------
+# 1c. teardown hygiene: clear_all releases every plane
+# ---------------------------------------------------------------------------
+
+def test_clear_all_releases_hangs_planes_and_breakers():
+    # A leaked HANG with a thread parked on it...
+    nd = naughty_mod.NaughtyDisk(object())
+    nd.per_method_delay["read_version"] = naughty_mod.HANG
+    woke = threading.Event()
+
+    def parked():
+        nd._maybe_delay("read_version")
+        woke.set()
+
+    t = threading.Thread(target=parked)
+    t.start()
+    try:
+        assert not woke.wait(0.1)
+        # ...a leaked network plane...
+        faultplane.install(seed=1).partition("leak", ["a:1"], ["b:2"])
+        # ...and a breaker forced OPEN by the storm.
+        c = rpc_mod.RestClient("127.0.0.1", 1, "secret", timeout=0.5)
+        c.mark_offline()
+        assert c.breaker_state() == rpc_mod.BREAKER_OPEN
+
+        assert chaos.anything_armed()
+        cleared = chaos.clear_all()
+        assert cleared["drive_faults"] >= 1
+        assert cleared["net_plane"] == 1
+        assert cleared["breakers_reset"] >= 1
+        assert woke.wait(2.0), "clear_all did not release the HANG"
+        assert faultplane.get() is None
+        assert c.breaker_state() == rpc_mod.BREAKER_CLOSED
+        assert not chaos.anything_armed()
+        # A fault armed AFTER the sweep blocks on a fresh event.
+        nd.per_method_delay["read_version"] = naughty_mod.HANG
+        t2 = threading.Thread(
+            target=lambda: nd._maybe_delay("read_version"), daemon=True)
+        t2.start()
+        t2.join(0.1)
+        assert t2.is_alive(), "post-clear HANG must block again"
+        nd.release.set()
+        t2.join(2.0)
+        c.close()
+    finally:
+        nd.clear_faults()
+        t.join(5.0)
+
+
+# ---------------------------------------------------------------------------
+# 2. the bounded tier-1 storm (hung drive + asymmetric partition + one
+#    SIGKILL, concurrent mixed workload, ~60 s end to end)
+# ---------------------------------------------------------------------------
+
+def _storm_program(cl) -> schedule.ChaosProgram:
+    """The bounded composed storm. All three planes overlap in the
+    middle: while node0's d1 is hung, node0 also cannot reach node2,
+    and node2 is then SIGKILL'd outright."""
+    n0d1 = str(cl.work / "n0" / "d1")
+    p = schedule.ChaosProgram(SEED)
+    p.add(1.0, schedule.DRIVE_HANG, n0d1, method="read_version")
+    p.add(1.5, schedule.DRIVE_HANG, n0d1, method="create_file")
+    p.add(3.0, schedule.NET_ISOLATE, cl.node_name(2), name="asym",
+          src=cl.node_name(0), dst=cl.node_name(2))
+    p.add(6.0, schedule.KILL, "2")
+    p.add(9.0, schedule.DRIVE_CLEAR, n0d1)
+    p.add(11.0, schedule.RESTART, "2")
+    p.add(13.0, schedule.NET_HEAL, cl.node_name(2), name="asym")
+    return p
+
+
+def _actuators(cl) -> dict:
+    import requests
+
+    def on_live_nodes(doc):
+        # Best-effort fleet-wide application: each node's fault plane is
+        # independent, and a node mid-reboot (its plane died with the
+        # SIGKILL — nothing to heal there) must not fail the storm.
+        for i in range(N_NODES):
+            if cl.procs[i] is None:
+                continue
+            try:
+                cl.fault(i, doc)
+            except requests.RequestException:
+                continue
+
+    return {
+        schedule.DRIVE_HANG: lambda ev: cl.fault(0, {
+            "op": "drive", "endpoint": ev.target,
+            "method": ev.params["method"], "delay": "hang"}),
+        schedule.DRIVE_DELAY: lambda ev: cl.fault(0, {
+            "op": "drive", "endpoint": ev.target,
+            "method": ev.params["method"],
+            "delay": ev.params.get("delay", 0.5)}),
+        schedule.DRIVE_CLEAR: lambda ev: cl.fault(0, {
+            "op": "drive_clear", "endpoint": ev.target}),
+        schedule.NET_ISOLATE: lambda ev: cl.fault(0, {
+            "op": "isolate", "name": ev.params["name"],
+            "src": ev.params["src"], "dst": ev.params["dst"]}),
+        schedule.NET_PARTITION: lambda ev: on_live_nodes({
+            "op": "partition", "name": ev.params["name"],
+            "groups": [[ev.target], list(ev.params["rest"])]}),
+        schedule.NET_HEAL: lambda ev: on_live_nodes({
+            "op": "heal", "name": ev.params["name"]}),
+        schedule.KILL: lambda ev: cl.kill9(int(ev.target)),
+        schedule.RESTART: lambda ev: cl.start(int(ev.target)),
+    }
+
+
+def _converge(cl, bucket: str, seed: int, lgr, workload,
+              heal_timeout: float = 240) -> None:
+    """Post-storm: clear residual faults, wait the fleet healthy, then
+    assert every invariant — all with the seed in the failure text."""
+    # Residual fault sweep on every live node (belt and braces: the
+    # program clears its own faults, an aborted storm might not have).
+    for i in range(N_NODES):
+        if cl.procs[i] is not None:
+            cl.clear_faults(i)
+    for i in range(N_NODES):
+        if cl.procs[i] is None:
+            cl.start(i)
+        cl.wait_healthy(i)
+    wait_drives_online(cl, N_NODES * DRIVES_PER_NODE, timeout=120)
+
+    # In-storm torn reads / ghost reads: must be zero.
+    assert not workload.stats.violations, (
+        f"in-storm read violations {workload.stats.violations[:5]} — "
+        f"reproduce with MTPU_CHAOS_SEED={seed}")
+
+    # Zero lost acknowledged writes, node0's front door.
+    c0, c1 = cl.client(0), cl.client(1)
+
+    def get_via(cli):
+        def get_fn(key):
+            r = cli.get(f"/{bucket}/{key}", timeout=60)
+            return r.status_code, (r.content if r.status_code == 200
+                                   else b"")
+        return get_fn
+
+    invariants.check_acknowledged_writes(get_via(c0), lgr,
+                                         seed=seed).assert_ok()
+    # Cross-node agreement on settled keys.
+    invariants.check_cross_node_agreement(
+        [get_via(c0), get_via(c1)], lgr, seed=seed).assert_ok()
+
+    # Heal convergence: drives already online; a deep heal must leave
+    # every surviving object fully redundant.
+    invariants.check_heal_convergence(
+        lambda: cl.admin_info(0),
+        lambda: [i for i in cl.deep_heal(0, bucket,
+                                         timeout=heal_timeout)
+                 if i.get("object")],
+        want_drives=N_NODES * DRIVES_PER_NODE, seed=seed,
+        timeout=60).assert_ok()
+
+
+@pytest.mark.chaos
+def test_bounded_composed_storm(crash_cluster, tmp_path):
+    """The tier-1 storm: one seed drives drive/network/process faults
+    under a live mixed workload; afterwards nothing acknowledged is
+    lost, nothing reads torn, and the set heals to full redundancy."""
+    cl = crash_cluster
+    for i in range(N_NODES):            # a prior test's kill must not
+        if cl.procs.get(i) is None:     # bleed into this storm
+            cl.start(i)
+            cl.wait_healthy(i)
+    bucket = "chaosbkt"
+    r = cl.client(0).put(f"/{bucket}")
+    assert r.status_code in (200, 409), r.text
+
+    # Determinism gate (acceptance): the same seed programs the same
+    # storm, previewable without consuming.
+    prog = _storm_program(cl)
+    assert prog.schedule() == _storm_program(cl).schedule()
+
+    lgr = ledger_mod.WriteLedger(path=str(tmp_path / "ledger.jsonl"))
+    clients = [cl.client(0), cl.client(1)]
+    fleet = MixedWorkload(
+        # Workload rides the two surviving front doors; node2 is the
+        # SIGKILL victim.
+        lambda _n=iter(range(10 ** 9)): clients[next(_n) % 2],
+        lgr, bucket, seed=SEED, workers=6, op_timeout=60.0)
+
+    sched = schedule.ChaosScheduler(prog, _actuators(cl))
+    t0 = time.monotonic()
+    sched.start()
+    try:
+        fleet.run_for(16.0)
+    finally:
+        sched.stop()
+        assert sched.join(60.0)
+    storm_s = time.monotonic() - t0
+
+    # The scheduler really applied the previewed schedule, in order.
+    assert sched.errors() == [], (
+        f"actuation errors {sched.errors()} — "
+        f"reproduce with MTPU_CHAOS_SEED={SEED}")
+    assert sched.applied() == prog.schedule()
+
+    # The storm produced real acknowledged traffic to check.
+    assert lgr.acked_count() >= 10, (
+        f"storm too quiet: {lgr.describe()} after {storm_s:.0f}s "
+        f"(ops {fleet.stats.describe()})")
+
+    _converge(cl, bucket, SEED, lgr, fleet)
+    lgr.close()
+
+
+# ---------------------------------------------------------------------------
+# 3. the slow soak: generated flapping storm + SLOs from obs/
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_soak_flapping_storm_slo(crash_cluster, tmp_path):
+    import os
+
+    cl = crash_cluster
+    bucket = "chaossoak"
+    r = cl.client(0).put(f"/{bucket}")
+    assert r.status_code in (200, 409), r.text
+
+    duration = float(os.environ.get("MTPU_CHAOS_SOAK_S", "90"))
+    p99_slo = float(os.environ.get("MTPU_CHAOS_P99_SLO", "12.0"))
+    err_slo = float(os.environ.get("MTPU_CHAOS_ERR_SLO", "0.5"))
+
+    prog = schedule.ChaosProgram.generate(
+        SEED, duration,
+        nodes=[cl.node_name(i) for i in range(N_NODES)],
+        drives=[str(cl.work / "n0" / "d2"), str(cl.work / "n1" / "d0")],
+        kill_nodes=["2"])
+    assert prog.schedule() == schedule.ChaosProgram.generate(
+        SEED, duration,
+        nodes=[cl.node_name(i) for i in range(N_NODES)],
+        drives=[str(cl.work / "n0" / "d2"), str(cl.work / "n1" / "d0")],
+        kill_nodes=["2"]).schedule()
+
+    acts = _actuators(cl)
+    # Drive faults land on the node that LOCALLY serves the drive.
+    acts[schedule.DRIVE_HANG] = lambda ev: cl.fault(
+        0 if "/n0/" in ev.target else 1,
+        {"op": "drive", "endpoint": ev.target,
+         "method": ev.params["method"], "delay": "hang"})
+    acts[schedule.DRIVE_CLEAR] = lambda ev: cl.fault(
+        0 if "/n0/" in ev.target else 1,
+        {"op": "drive_clear", "endpoint": ev.target})
+
+    before = invariants.parse_exposition(cl.scrape(0))
+    lgr = ledger_mod.WriteLedger(path=str(tmp_path / "soak-ledger.jsonl"))
+    clients = [cl.client(0), cl.client(1)]
+    fleet = MixedWorkload(
+        lambda _n=iter(range(10 ** 9)): clients[next(_n) % 2],
+        lgr, bucket, seed=SEED, workers=8, op_timeout=60.0)
+
+    sched = schedule.ChaosScheduler(prog, acts)
+    sched.start()
+    try:
+        fleet.run_for(duration + 2.0)
+    finally:
+        sched.stop()
+        assert sched.join(120.0)
+
+    assert lgr.acked_count() >= 50, f"soak too quiet: {lgr.describe()}"
+    _converge(cl, bucket, SEED, lgr, fleet, heal_timeout=600)
+
+    # SLOs over the storm window only (metrics are cumulative and the
+    # cluster is session-shared: diff two scrapes).
+    window = invariants.delta(invariants.parse_exposition(cl.scrape(0)),
+                              before)
+    invariants.check_slos(window, seed=SEED, p99_bound=p99_slo,
+                          error_rate_bound=err_slo).assert_ok()
+    lgr.close()

@@ -1,12 +1,15 @@
-"""Process-boundary crash/recovery harness (verify-healing.sh tier).
+"""Process-boundary crash/recovery tests (verify-healing.sh tier).
 
 The reference proves healing under real process death: a 3-node cluster
 booted as OS processes, nodes killed and drives corrupted mid-traffic,
 then convergence asserted (buildscripts/verify-healing.sh:31-96). Every
-other cluster test in this repo is in-process threads; this module is
-the real thing — three `python -m minio_tpu.s3.server` processes on
-real sockets, `SIGKILL` mid-PUT and mid-multipart, drive corruption
-while a node is down, restart, heal, and the invariants:
+other cluster test in this repo is in-process threads; this tier is the
+real thing — the shared `crash_cluster` harness (tests/crash_cluster.py,
+conftest session fixture, also driven by the composed-chaos tier in
+tests/test_chaos.py) runs three `python -m minio_tpu.s3.server`
+processes on real sockets, `SIGKILL`s mid-PUT / mid-multipart /
+mid-heal, corrupts drives while a node is down, restarts, heals, and
+asserts the invariants:
 
   * a PUT interrupted by node death is atomic — afterwards the object
     is either fully readable with the exact bytes or absent; never a
@@ -15,183 +18,48 @@ while a node is down, restart, heal, and the invariants:
     completes to the correct bytes,
   * heal converges after kill -9 + on-disk corruption + restart
     (missing shards re-materialise, corrupted shards rewritten),
+  * a node SIGKILL'd MID-HEAL restarts into a cluster that still
+    converges (the MRF requeue and a re-run heal finish the job),
   * the format/journal quorum holds: every node reboots into the same
     12-drive layout and serves an identical listing.
-
-Topology: 3 nodes × 4 drives, one 12-wide set at parity 4 → write
-quorum is exactly 8, so the cluster keeps accepting writes with one
-node dead (the reference's 3-node/EC-split premise).
 """
 
 import json
 import os
-import signal
-import socket
-import subprocess
-import sys
 import threading
 import time
-from pathlib import Path
 
 import pytest
 import requests
 
-from tests.s3client import SigV4Client
-
-ACCESS, SECRET = "crashroot", "crashroot-secret1"
-N_NODES = 3
-DRIVES_PER_NODE = 4
-BOOT_TIMEOUT = 90
-
-
-def _free_port_block(n: int, span: int = 1000) -> list[int]:
-    """n S3 ports whose +span RPC companions are also free."""
-    out: list[int] = []
-    base = 20000 + (os.getpid() * 7) % 20000
-    p = base
-    while len(out) < n and p < 64000:
-        ok = True
-        for cand in (p, p + span):
-            s = socket.socket()
-            try:
-                s.bind(("127.0.0.1", cand))
-            except OSError:
-                ok = False
-            finally:
-                s.close()
-        if ok:
-            out.append(p)
-        p += 1
-    assert len(out) == n, "no free port block"
-    return out
-
-
-class Cluster:
-    """Three server OS processes sharing one endpoint layout."""
-
-    def __init__(self, work: Path):
-        self.work = work
-        self.ports = _free_port_block(N_NODES)
-        self.procs: dict[int, subprocess.Popen | None] = {}
-        self.endpoints = []
-        for i in range(N_NODES):
-            for d in range(DRIVES_PER_NODE):
-                path = work / f"n{i}" / f"d{d}"
-                path.parent.mkdir(parents=True, exist_ok=True)
-                self.endpoints.append(
-                    f"http://127.0.0.1:{self.ports[i]}{path}")
-
-    def env(self) -> dict:
-        env = dict(os.environ)
-        env.update({
-            "MTPU_ROOT_USER": ACCESS,
-            "MTPU_ROOT_PASSWORD": SECRET,
-            "MTPU_JAX_PLATFORM": "cpu",
-            "JAX_PLATFORMS": "cpu",
-        })
-        return env
-
-    def start(self, i: int) -> None:
-        log = open(self.work / f"node{i}.log", "ab")
-        self.procs[i] = subprocess.Popen(
-            [sys.executable, "-m", "minio_tpu.s3.server",
-             "--address", f"127.0.0.1:{self.ports[i]}",
-             "--parity", "4", "--scan-interval", "0",
-             *self.endpoints],
-            stdout=log, stderr=log, env=self.env(),
-            cwd="/root/repo")
-
-    def kill9(self, i: int) -> None:
-        p = self.procs[i]
-        assert p is not None
-        p.send_signal(signal.SIGKILL)
-        p.wait(timeout=30)
-        self.procs[i] = None
-
-    def stop_all(self) -> None:
-        for i, p in self.procs.items():
-            if p is not None and p.poll() is None:
-                p.send_signal(signal.SIGKILL)
-        for p in self.procs.values():
-            if p is not None:
-                try:
-                    p.wait(timeout=30)
-                except subprocess.TimeoutExpired:
-                    pass
-
-    def base(self, i: int) -> str:
-        return f"http://127.0.0.1:{self.ports[i]}"
-
-    def wait_healthy(self, i: int, timeout: float = BOOT_TIMEOUT) -> None:
-        deadline = time.monotonic() + timeout
-        last = ""
-        while time.monotonic() < deadline:
-            p = self.procs[i]
-            assert p is not None
-            if p.poll() is not None:
-                # Peer-bootstrap timeout exit while the other nodes are
-                # still importing on a loaded host — relaunch, exactly
-                # as systemd restarts the reference server. A genuine
-                # crash loops until the deadline and raises with the log.
-                time.sleep(1.0)
-                self.start(i)
-                continue
-            try:
-                r = requests.get(self.base(i) + "/minio/health/live",
-                                 timeout=2)
-                if r.status_code == 200:
-                    return
-                last = f"HTTP {r.status_code}"
-            except requests.RequestException as e:
-                last = str(e)
-            time.sleep(0.5)
-        raise AssertionError(
-            f"node{i} not healthy in {timeout}s ({last}); log tail: " +
-            (self.work / f"node{i}.log").read_text()[-2000:])
-
-    def client(self, i: int) -> SigV4Client:
-        return SigV4Client(self.base(i), ACCESS, SECRET)
+from tests.crash_cluster import (
+    DRIVES_PER_NODE,
+    N_NODES,
+    restart_and_wait,
+    wait_drives_online,
+)
 
 
 @pytest.fixture(scope="module")
-def cluster(tmp_path_factory):
-    work = tmp_path_factory.mktemp("crashwork")
-    cl = Cluster(work)
+def cluster(crash_cluster):
+    c = crash_cluster.client(0)
+    r = c.put("/crashbkt")
+    assert r.status_code in (200, 409), r.text
+    return crash_cluster
+
+
+@pytest.fixture(autouse=True)
+def _fleet_alive(crash_cluster):
+    """Every test here assumes a fully-live fleet at entry; without
+    this, one test failing mid-kill leaves its victim dead and
+    cascades into every later test in the session."""
     for i in range(N_NODES):
-        cl.start(i)
-    for i in range(N_NODES):
-        cl.wait_healthy(i)
-    c = cl.client(0)
-    assert c.put("/crashbkt").status_code == 200
-    yield cl
-    cl.stop_all()
+        if crash_cluster.procs.get(i) is None:
+            restart_and_wait(crash_cluster, i)
+    yield
 
 
-def _wait_drives_online(cl: Cluster, want: int, timeout: float = 60) -> None:
-    """Until every live node's RPC fabric has reconnected all drives
-    (the health plane re-probes at 1 Hz after a peer restart)."""
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        counts = []
-        for i in range(N_NODES):
-            if cl.procs[i] is None:
-                continue
-            r = cl.client(i).get("/minio/admin/v3/info")
-            counts.append(r.json().get("drivesOnline", 0)
-                          if r.status_code == 200 else 0)
-        if counts and all(n == want for n in counts):
-            return
-        time.sleep(0.5)
-    raise AssertionError(f"drives did not come online: {counts} != {want}")
-
-
-def _restart_and_wait(cl: Cluster, i: int) -> None:
-    cl.start(i)
-    cl.wait_healthy(i)
-    _wait_drives_online(cl, N_NODES * DRIVES_PER_NODE)
-
-
-def _get_all_nodes(cl: Cluster, key: str) -> list:
+def _get_all_nodes(cl, key: str) -> list:
     """Status+body of GET {key} from every live node."""
     out = []
     for i in range(N_NODES):
@@ -233,7 +101,7 @@ def test_kill9_serving_node_mid_put_leaves_no_partial(cluster):
         else:
             assert code == 404
     # ...nor after it reboots into the cluster.
-    _restart_and_wait(cluster, 0)
+    restart_and_wait(cluster, 0)
     seen = _get_all_nodes(cluster, "/crashbkt/torn-obj")
     assert len(seen) == N_NODES
     codes = {code for code, _ in seen}
@@ -279,7 +147,7 @@ def test_multipart_survives_peer_kill9_and_restart(cluster):
     etags[2] = r.headers["ETag"]
 
     # ...and still knows its parts after the peer reboots.
-    _restart_and_wait(cluster, 2)
+    restart_and_wait(cluster, 2)
     r = c.put(key, data=bodies[2],
               query={"uploadId": uid, "partNumber": "3"})
     assert r.status_code == 200, r.text
@@ -329,7 +197,7 @@ def test_heal_converges_after_kill9_and_corruption(cluster):
     r = c.get("/crashbkt/heal-obj", timeout=120)
     assert r.status_code == 200 and r.content == body
 
-    _restart_and_wait(cluster, 2)
+    restart_and_wait(cluster, 2)
 
     r = c.post("/minio/admin/v3/heal/crashbkt",
                data=json.dumps({"dryRun": False,
@@ -351,7 +219,113 @@ def test_heal_converges_after_kill9_and_corruption(cluster):
 
 
 # ---------------------------------------------------------------------------
-# 4. format/journal quorum intact: rolling restart, identical listings
+# 4. SIGKILL the node running a heal mid-reconstruction (PR5's MRF
+#    requeue composed with the crash harness)
+# ---------------------------------------------------------------------------
+
+def test_kill9_mid_heal_still_converges(cluster):
+    c = cluster.client(0)
+    bodies = {f"midheal-{k}": os.urandom(2 << 20) for k in range(4)}
+    for key, body in bodies.items():
+        assert c.put(f"/crashbkt/{key}", data=body,
+                     timeout=120).status_code == 200
+
+    # Damage node0's local shards of every midheal object so the heal
+    # node has real reconstruction work in flight when it dies.
+    n0 = cluster.work / "n0"
+    wrecked = set()
+    for f in sorted(n0.rglob("*")):
+        if f.is_file() and "midheal-" in str(f) and f.name.startswith("part."):
+            f.unlink()
+            # (drive root, object) — the re-run heal may commit a fresh
+            # data-dir generation, so convergence is "this drive holds
+            # SOME complete shard of this object again", not the exact
+            # pre-kill path.
+            drive = f.relative_to(n0).parts[0]
+            obj = f.relative_to(n0 / drive / "crashbkt").parts[0]
+            wrecked.add((drive, obj))
+    assert wrecked, "no shard files found to wreck"
+
+    # Heal runs ON node0 (the admin endpoint heals through the node's
+    # own layer); kill it mid-reconstruction.
+    def do_heal():
+        try:
+            cluster.client(0).post(
+                "/minio/admin/v3/heal/crashbkt/midheal-",
+                data=json.dumps({"dryRun": False,
+                                 "scanMode": "deep"}).encode(),
+                timeout=300)
+        except requests.RequestException:
+            return  # the SIGKILL landing mid-response is the test
+
+    t = threading.Thread(target=do_heal)
+    t.start()
+    time.sleep(0.5)               # inside the heal fan-out
+    cluster.kill9(0)
+    t.join(timeout=60)
+    assert not t.is_alive()
+
+    # The dead incarnation's exclusive heal lock on whatever object it
+    # was reconstructing survives on the peer lockers until
+    # LOCK_STALE_AFTER (60 s) — even READS of that object 503 until it
+    # expires. Apply the documented operator remedy first: admin
+    # force-unlock on every surviving locker.
+    paths = ",".join(f"crashbkt/{k}" for k in bodies)
+    for i in (1, 2):
+        r = cluster.client(i).post("/minio/admin/v3/force-unlock",
+                                   query={"paths": paths})
+        assert r.status_code == 200, r.text
+
+    # Survivors keep serving the right bytes while node0 is down. The
+    # first reads may still 503 SlowDown while node1's fabric walks
+    # node0's drives to OFFLINE — that is the designed degradation
+    # (bounded, typed, retryable), so retry exactly like an S3 client.
+    deadline = time.monotonic() + 30
+    while True:
+        r = cluster.client(1).get("/crashbkt/midheal-0", timeout=120)
+        if r.status_code == 200 or time.monotonic() > deadline:
+            break
+        time.sleep(1.0)
+    assert r.status_code == 200 and r.content == bodies["midheal-0"]
+
+    restart_and_wait(cluster, 0)
+
+    # Re-run the heal to completion; a heal interrupted by process
+    # death must leave no state a second pass cannot finish. Items
+    # WITHOUT per-drive states are heals that errored (a residual lock
+    # conflict surfaces that way) — retry briefly, then require every
+    # object fully ok.
+    deadline = time.monotonic() + 90
+    items: list = []
+    while time.monotonic() < deadline:
+        r = cluster.client(0).post(
+            "/minio/admin/v3/heal/crashbkt/midheal-",
+            data=json.dumps({"dryRun": False, "scanMode": "deep"}).encode(),
+            timeout=300)
+        assert r.status_code == 200, r.text
+        items = [i for i in r.json()["items"] if i.get("object")]
+        converged = {i["object"] for i in items
+                     if i.get("after") and all(
+                         s.get("state") == "ok" for s in i["after"])}
+        if converged >= set(bodies):
+            break
+        time.sleep(3)
+    else:
+        raise AssertionError(f"heal never converged: {items}")
+
+    # Convergence on disk (every wrecked drive×object holds a complete
+    # shard again) and through every front door.
+    for drive, obj in sorted(wrecked):
+        parts = [p for p in (n0 / drive / "crashbkt" / obj).rglob("part.*")
+                 if not p.name.endswith(".tmp")]
+        assert parts, f"re-run heal left no shard of {obj} on {drive}"
+    for key, body in bodies.items():
+        for code, got in _get_all_nodes(cluster, f"/crashbkt/{key}"):
+            assert code == 200 and got == body
+
+
+# ---------------------------------------------------------------------------
+# 5. format/journal quorum intact: rolling restart, identical listings
 # ---------------------------------------------------------------------------
 
 def test_rolling_restart_keeps_format_and_listing_quorum(cluster):
@@ -362,7 +336,7 @@ def test_rolling_restart_keeps_format_and_listing_quorum(cluster):
 
     for i in range(N_NODES):
         cluster.kill9(i)
-        _restart_and_wait(cluster, i)
+        restart_and_wait(cluster, i)
 
     listings = []
     for i in range(N_NODES):
