@@ -8,7 +8,12 @@ so the seed in an assertion message IS the repro command):
    settles on an acked PUT must read back 200 with the exact sha256;
    keys with in-flight tails must read back one of the candidate
    generations in full (or 404 where absence is legal). Anything else
-   is a lost or torn write.
+   is a lost or torn write. 5xx is the S3 retry contract, not a
+   durability verdict: acked keys get a bounded retry window (stale
+   dsync lease after a SIGKILL, MRF drain) and then still fail;
+   never-acked tail keys may legally sit 503-pending until deep heal
+   purges the below-quorum remnant (heal convergence runs after this
+   check) — loss and torn bytes are still always violations.
 2. **heal convergence** — after faults clear, every drive returns
    online and a deep heal reports every surviving object fully
    redundant (all per-drive after-states "ok").
@@ -58,14 +63,40 @@ class InvariantReport:
 # 1. zero lost acknowledged writes / no torn reads
 # ---------------------------------------------------------------------------
 
+def _get_retrying_5xx(get_fn, key, deadline: float,
+                      interval: float = 1.5):
+    """One ledger-replay read with bounded patience for 5xx: a 503 is
+    the S3 RETRY contract (SlowDown), not a durability verdict — a
+    SIGKILL'd node's stale dsync lock 503s reads of that object until
+    the lease expires (LOCK_STALE_AFTER), and MRF/breaker drain can
+    briefly 503 too. Durability is still asserted: run out the window
+    and the caller fails the key exactly as before. `deadline` is an
+    absolute monotonic instant SHARED across the whole check — N
+    genuinely-lost keys cost one window total, not N windows (the
+    transient causes expire on wall clock, not per key)."""
+    while True:
+        status, body = get_fn(key)
+        if status < 500 or time.monotonic() >= deadline:
+            return status, body
+        time.sleep(interval)
+
+
 def check_acknowledged_writes(get_fn, ledger: WriteLedger,
-                              seed: int = 0) -> InvariantReport:
+                              seed: int = 0,
+                              retry_5xx_s: float = 60.0) -> InvariantReport:
     """`get_fn(key) -> (status_code, body_bytes)` — typically a closure
     over one node's S3 client. Replays the whole ledger."""
     rep = InvariantReport("zero-lost-acknowledged-writes", seed)
+    retry_deadline = time.monotonic() + retry_5xx_s
     for key, st in sorted(ledger.expected().items()):
         rep.checked += 1
         status, body = get_fn(key)
+        if status >= 500 and st.settled is not None:
+            # The client holds an ack for SOME generation of this key
+            # (a settled PUT or DELETE, possibly with an in-flight
+            # tail): 5xx only ever buys the bounded retry window — it
+            # can never excuse the key from the checks below.
+            status, body = _get_retrying_5xx(get_fn, key, retry_deadline)
         if st.must_exist:
             want = st.settled.sha256
             if status != 200:
@@ -90,6 +121,17 @@ def check_acknowledged_writes(get_fn, ledger: WriteLedger,
                 rep.fail(f"{key}: 404 but absence is not a legal "
                          f"outcome (candidates "
                          f"{[c[:12] if c else None for c in st.candidates]})")
+        elif status >= 500 and st.settled is None:
+            # NOTHING on this key was ever acknowledged: a PUT killed
+            # mid-flight can leave a below-quorum remnant that 503s
+            # until deep heal purges it as dangling — and heal
+            # convergence runs AFTER this check. With no ack held,
+            # "unavailable pending heal" is a legal landing (neither
+            # lost nor torn); 200-with-wrong-bytes and illegal 404s
+            # above still fail, and any key with an acked generation
+            # already burned the bounded retry window before reaching
+            # here and fails in the branch below.
+            pass
         else:
             rep.fail(f"{key}: post-storm read failed with HTTP {status}")
     return rep
@@ -97,10 +139,12 @@ def check_acknowledged_writes(get_fn, ledger: WriteLedger,
 
 def check_cross_node_agreement(get_fns: list, ledger: WriteLedger,
                                seed: int = 0,
-                               sample: int = 24) -> InvariantReport:
+                               sample: int = 24,
+                               retry_5xx_s: float = 60.0) -> InvariantReport:
     """Every node's front door serves the same settled bytes (reads are
     quorum reads, so divergence means split-brain metadata)."""
     rep = InvariantReport("cross-node-agreement", seed)
+    retry_deadline = time.monotonic() + retry_5xx_s
     expected = ledger.expected()
     keys = [key for key, st in sorted(expected.items())
             if st.must_exist][:sample]
@@ -109,6 +153,8 @@ def check_cross_node_agreement(get_fns: list, ledger: WriteLedger,
         want = expected[key].settled.sha256
         for i, fn in enumerate(get_fns):
             status, body = fn(key)
+            if status >= 500:
+                status, body = _get_retrying_5xx(fn, key, retry_deadline)
             if status != 200 or digest(body) != want:
                 rep.fail(f"{key}: node{i} serves HTTP {status} "
                          f"sha {digest(body)[:12] if body else '-'} "

@@ -1,0 +1,58 @@
+"""Batched device data plane (docs/DATAPLANE.md).
+
+Aggregates concurrent codec work — PUT shard-encodes, GET
+reconstructions, bitrot verifies — from request threads into coalesced
+fused-kernel launches (batcher.py) staged through a ring of
+pre-allocated device-bound buffers (ring.py), instead of one dispatch
+per object.
+
+Opt-in via `MTPU_BATCHED_DATAPLANE=1`; per-object dispatch
+(erasure/codec.py, ops/fused.py) remains both the fallback and the
+bit-exactness oracle. The process-global plane is created lazily on
+first use and lives for the process (its threads are daemons named
+`mtpu-dataplane-*`, exempted as session-lived in utils/sanitize.py);
+tests that build private planes close() them.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from minio_tpu.dataplane.batcher import BatchPlane  # noqa: F401
+
+ENABLE_ENV = "MTPU_BATCHED_DATAPLANE"
+
+_global_mu = threading.Lock()
+_global_plane: BatchPlane | None = None
+
+
+def enabled() -> bool:
+    """Read the env gate live — cheap, and tests flip it per-case."""
+    return os.environ.get(ENABLE_ENV, "") in ("1", "true", "on")
+
+
+def get_plane() -> BatchPlane:
+    """The process-global plane, created on first use."""
+    global _global_plane
+    with _global_mu:
+        if _global_plane is None or _global_plane.closed:
+            _global_plane = BatchPlane()
+        return _global_plane
+
+
+def maybe_plane() -> BatchPlane | None:
+    """The global plane when the gate is on, else None (per-object
+    dispatch). The serving integration points call this per batch."""
+    if not enabled():
+        return None
+    return get_plane()
+
+
+def reset_global() -> None:
+    """Close and drop the global plane (tests; safe when never built)."""
+    global _global_plane
+    with _global_mu:
+        plane, _global_plane = _global_plane, None
+    if plane is not None:
+        plane.close()

@@ -1,0 +1,602 @@
+"""Submission plane: coalesce concurrent codec work into lane launches.
+
+Request threads (PUT shard-encodes, GET reconstructions, bitrot
+verifies) enqueue `CodecRequest`s and immediately get futures back; ONE
+dispatcher thread drains the queue into fixed-shape lane batches
+bucketed by (op, k, m|t, shard-width bucket) and launches each batch as
+a single fused kernel (ring.lane_kernel) instead of one dispatch per
+object — the serving-layer form of the restructure-many-small-codec-
+calls-into-batches move (PAPERS.md, XOR-EC program optimization), and
+the "device ring buffer" PAPER.md's north star names.
+
+Batching policy (adaptive, env-tunable — docs/DATAPLANE.md):
+  * launch when the lane FILLS (a burst rides one launch), OR
+  * when the oldest request in the lane has waited MTPU_DP_MAX_WAIT_US
+    (default 500 us) — a lone request keeps bounded latency.
+
+Backpressure: the submission queue is bounded (MTPU_DP_QUEUE requests);
+a full queue rejects the submit with `OperationTimedOut`, which the S3
+layer already maps to 503 SlowDown — the front door degrades instead of
+buffering unbounded batches in memory.
+
+Pipeline: the dispatcher only STAGES (memcpy into a recycled ring slot)
+and DISPATCHES (async JAX launch); a separate completion thread
+materializes outputs, resolves futures and recycles slots, so host
+staging of batch N+1 overlaps the device kernel of batch N (ring depth
+2 = classic double buffering; `SlotRing.acquire` is the throttle when
+the device falls a full ring behind).
+
+Bit-exactness: lane padding is invisible in results — parity columns
+never mix (zero-padded shard tails encode to zero parity and are sliced
+off) and mxsum digests are cap-invariant (length rides as data) — so
+batched output is bit-identical to the per-object dispatch, which stays
+both the fallback and the oracle (tests/test_dataplane.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+
+import numpy as np
+
+from minio_tpu.dataplane import ring
+from minio_tpu.obs import kernel as obs_kernel
+from minio_tpu.utils import errors as se
+
+_CLOSE = object()
+
+DEFAULT_LANE_BLOCKS = 32    # encode/reconstruct rows per launch
+DEFAULT_VERIFY_ROWS = 128   # verify chunks per launch
+DEFAULT_MAX_WAIT_US = 500   # lone-request latency bound (microseconds)
+DEFAULT_QUEUE_CAP = 256     # bounded submission queue (requests)
+DEFAULT_RING_DEPTH = 4      # staging slots per lane (double buffer+)
+DEFAULT_MAX_WIDTH = 65536   # widest chunk the serving gate coalesces
+
+
+def _backend() -> str:
+    """The shared kernel-metrics backend label (ops/fused.py owns the
+    format — dp_* rows must join with every other kernel row)."""
+    from minio_tpu.ops import fused
+
+    return fused._backend()
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class _BaseKey(tuple):
+    """Accumulation key: LaneKey minus the row bucket (rows are decided
+    at launch time from the fill)."""
+
+    __slots__ = ()
+
+    def __new__(cls, op: str, k: int, aux: int, width: int, digests: bool):
+        return super().__new__(cls, (op, k, aux, width, digests))
+
+    @property
+    def op(self) -> str:
+        return self[0]
+
+
+class CodecRequest:
+    """One submitted unit of codec work: `rows` staging slots, a stage
+    callback run by the dispatcher, a finish callback run by the
+    completion thread, and the future request threads wait on."""
+
+    __slots__ = ("base", "rows", "stage", "finish", "future", "t_submit")
+
+    def __init__(self, base: _BaseKey, rows: int, stage, finish):
+        self.base = base
+        self.rows = rows
+        self.stage = stage
+        self.finish = finish
+        self.future: Future = Future()
+        self.t_submit = time.perf_counter()
+
+
+class _OpenBatch:
+    __slots__ = ("base", "reqs", "fill", "first_ts")
+
+    def __init__(self, base: _BaseKey):
+        self.base = base
+        self.reqs: list[CodecRequest] = []
+        self.fill = 0
+        self.first_ts = time.perf_counter()
+
+
+class PendingBatchedEncode:
+    """Drop-in for codec.PendingEncode on the batched plane: wait()
+    returns the same (per-block chunk rows, per-block digests | None)
+    shape, with data chunks aliasing the caller's block buffers and
+    parity chunks aliasing the batch launch output."""
+
+    def __init__(self, k: int, m: int, groups):
+        # groups: list of (request, blocks, chunk_lens, flats)
+        self._k = k
+        self._m = m
+        self._groups = groups
+
+    def wait(self):
+        k, m = self._k, self._m
+        out_chunks: list[list[memoryview]] = []
+        out_digs: list[list[bytes]] | None = None
+        for req, blocks, lens, flats in self._groups:
+            parity, digs = req.future.result()
+            if digs is not None and out_digs is None:
+                out_digs = []
+            for bi, block in enumerate(blocks):
+                s = lens[bi]
+                src = flats[bi] if flats[bi] is not None else block
+                mv = memoryview(src)
+                # mtpu: allow(MTPU005) - slicing a memoryview IS the
+                # zero-copy form this rule asks for; no bytes move here.
+                row = [mv[i * s:(i + 1) * s] for i in range(k)]
+                if m:
+                    row += [memoryview(parity[bi, j])[:s] for j in range(m)]
+                out_chunks.append(row)
+                if out_digs is not None:
+                    out_digs.append([digs[bi, i].tobytes()
+                                     for i in range(k + m)])
+        return out_chunks, out_digs
+
+
+class BatchPlane:
+    """The process-wide batched device data plane (docs/DATAPLANE.md).
+
+    One dispatcher + one completion thread; request threads only enqueue
+    and wait futures. All knobs resolve env vars at construction so the
+    global plane follows deployment config and tests can pin values."""
+
+    def __init__(self, *, lane_blocks: int | None = None,
+                 verify_rows: int | None = None,
+                 max_wait_s: float | None = None,
+                 queue_cap: int | None = None,
+                 ring_depth: int | None = None,
+                 name: str = "mtpu-dataplane"):
+        import os
+
+        env = os.environ.get
+        self.lane_blocks = lane_blocks if lane_blocks is not None else int(
+            env("MTPU_DP_LANE_BLOCKS", str(DEFAULT_LANE_BLOCKS)))
+        self.verify_rows = verify_rows if verify_rows is not None else int(
+            env("MTPU_DP_VERIFY_ROWS", str(DEFAULT_VERIFY_ROWS)))
+        self.max_wait_s = max_wait_s if max_wait_s is not None else float(
+            env("MTPU_DP_MAX_WAIT_US", str(DEFAULT_MAX_WAIT_US))) / 1e6
+        self.max_width = int(env("MTPU_DP_MAX_WIDTH",
+                                 str(DEFAULT_MAX_WIDTH)))
+        cap = queue_cap if queue_cap is not None else int(
+            env("MTPU_DP_QUEUE", str(DEFAULT_QUEUE_CAP)))
+        depth = ring_depth if ring_depth is not None else int(
+            env("MTPU_DP_RING_DEPTH", str(DEFAULT_RING_DEPTH)))
+        self._q: queue.Queue = queue.Queue(maxsize=cap)
+        self._done_q: queue.Queue = queue.Queue()
+        self._rings = ring.RingPool(depth=depth)
+        self._open: dict[_BaseKey, _OpenBatch] = {}  # dispatcher-only
+        self._closed = False
+        self._close_mu = threading.Lock()
+        self._broken: BaseException | None = None
+        # Test hook: clearing the gate parks the dispatcher so the
+        # bounded queue can be filled deterministically.
+        self._gate = threading.Event()
+        self._gate.set()
+        # Plane-local stats: launch/request/row counters are written by
+        # the dispatcher thread only; "rejected" is written by request
+        # threads under _close_mu. Readable anywhere.
+        self._stats = {"launches": 0, "requests": 0, "rows": 0,
+                       "capacity": 0, "rejected": 0}
+        self._dispatch_t = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name=f"{name}-dispatch")
+        self._complete_t = threading.Thread(
+            target=self._complete_loop, daemon=True,
+            name=f"{name}-complete")
+        self._dispatch_t.start()
+        self._complete_t.start()
+
+    # ------------------------------------------------------------------
+    # submission API (request threads)
+    # ------------------------------------------------------------------
+
+    def accepts_chunk(self, s: int) -> bool:
+        """Serving-gate width check: the plane targets the small/mid
+        object regime where the per-launch tax dominates; blocks wider
+        than MTPU_DP_MAX_WIDTH already amortize their own launches in
+        per-object batches (and on CPU backends a coalesced wide launch
+        can LOSE to concurrent per-object ones — PERF.md). Integration
+        points fall back to per-object dispatch above the gate."""
+        return s <= self.max_width
+
+    def begin_encode(self, k: int, m: int, block_size: int,
+                     blocks: list[bytes],
+                     with_digests: bool = False) -> PendingBatchedEncode:
+        """Queue a batch of erasure blocks for coalesced encode (+fused
+        mxsum digests). Same result contract as codec.begin_encode."""
+        if m <= 0:
+            raise ValueError("batched plane needs parity shards (m > 0)")
+        if not blocks:
+            return PendingBatchedEncode(k, m, [])
+        # Validate EVERY block before submitting any group — exactly
+        # like codec.begin_encode stages nothing on a bad batch; a
+        # mid-list reject must not leave earlier groups already queued.
+        for bi, block in enumerate(blocks):
+            if not 0 < len(block) <= block_size:
+                raise ValueError(f"block {bi} size {len(block)}")
+        # Width-bucket by the batch's ACTUAL chunk length, not the
+        # codec's full shard width: a 10 KiB object rides a narrow lane
+        # instead of a 1 MiB-block-wide one. Bit-exact either way —
+        # parity columns never mix and digests are cap-invariant — but
+        # the device stops paying for padded zeros.
+        s_max = max(_ceil_div(len(b), k) for b in blocks)
+        width = ring.width_bucket(s_max)
+        base = _BaseKey(ring.OP_ENCODE, k, m, width, with_digests)
+        groups = []
+        for g0 in range(0, len(blocks), self.lane_blocks):
+            grp = blocks[g0:g0 + self.lane_blocks]
+            lens: list[int] = []
+            flats: list[np.ndarray | None] = []
+            views: list[np.ndarray] = []
+            for bi, block in enumerate(grp):
+                s = _ceil_div(len(block), k)
+                lens.append(s)
+                if len(block) == k * s:
+                    flats.append(None)
+                    views.append(np.frombuffer(block, dtype=np.uint8)
+                                 .reshape(k, s))
+                else:
+                    flat = np.zeros(k * s, dtype=np.uint8)
+                    flat[:len(block)] = np.frombuffer(block, dtype=np.uint8)
+                    flats.append(flat)
+                    views.append(flat.reshape(k, s))
+
+            def stage(slot, row0, views=views, lens=lens):
+                for bi, v in enumerate(views):
+                    s = lens[bi]
+                    r = row0 + bi
+                    slot.data[r, :, :s] = v
+                    slot.data[r, :, s:] = 0
+                    slot.lens[r] = s
+
+            def finish(outs, row0, nrows=len(grp)):
+                parity, digs = outs
+                return (parity[row0:row0 + nrows],
+                        digs[row0:row0 + nrows] if digs is not None
+                        else None)
+
+            req = CodecRequest(base, len(grp), stage, finish)
+            self._submit(req)
+            groups.append((req, grp, lens, flats))
+        return PendingBatchedEncode(k, m, groups)
+
+    def digest_chunks(self, chunks: list, cap: int) -> list[bytes]:
+        """Coalesced mxsum256 digests of a ragged list of byte chunks
+        (each <= cap) — same contract as fused.digest_chunks_host, but
+        many concurrent readers share one launch."""
+        if not chunks:
+            return []
+        # Width from the longest chunk actually present (<= cap): the
+        # digest of a chunk is identical under any staging cap, so the
+        # lane only needs to fit the bytes it carries.
+        width = ring.width_bucket(max(len(c) for c in chunks) or 1)
+        base = _BaseKey(ring.OP_VERIFY, 0, 0, width, True)
+        reqs = []
+        for g0 in range(0, len(chunks), self.verify_rows):
+            grp = chunks[g0:g0 + self.verify_rows]
+
+            def stage(slot, row0, grp=grp):
+                for ci, c in enumerate(grp):
+                    r = row0 + ci
+                    ln = len(c)
+                    slot.data[r, :ln] = np.frombuffer(c, dtype=np.uint8)
+                    slot.data[r, ln:] = 0
+                    slot.lens[r] = ln
+
+            def finish(outs, row0, nrows=len(grp)):
+                return outs[row0:row0 + nrows]
+
+            req = CodecRequest(base, len(grp), stage, finish)
+            self._submit(req)
+            reqs.append(req)
+        out: list[bytes] = []
+        for req in reqs:
+            digs = req.future.result()
+            out.extend(digs[i].tobytes() for i in range(req.rows))
+        return out
+
+    def decode_blocks(self, k: int, m: int, block_size: int,
+                      shard_chunks: list[list[bytes | None]],
+                      block_lens: list[int],
+                      need_all: bool = False) -> list[list[bytes]]:
+        """codec.decode_blocks through the coalesced plane. Mixed failure
+        patterns batch natively: every row carries its own decode matrix
+        as runtime DATA (gf2_matmul_multi), so concurrent GETs with
+        different dead drives still share one launch."""
+        from minio_tpu.ops import rs_xla
+
+        n = k + m
+        if not shard_chunks:
+            return []
+        want = list(range(n) if need_all else range(k))
+        chunk_lens = [_ceil_div(bl, k) for bl in block_lens]
+        per_block: list[tuple[tuple[int, ...], tuple[int, ...]]] = []
+        t_max = 0
+        for bi, row in enumerate(shard_chunks):
+            present = [i for i in range(n) if row[i] is not None]
+            if len(present) < k:
+                raise se.InsufficientReadQuorum(
+                    "", "", f"block {bi}: only {len(present)} of {k} shards")
+            survivors = tuple(present[:k])
+            targets = tuple(i for i in want if row[i] is None)
+            per_block.append((survivors, targets))
+            t_max = max(t_max, len(targets))
+        if t_max == 0:
+            return [[row[i] for i in want] for row in shard_chunks]  # type: ignore[misc]
+
+        from minio_tpu.utils.shardmath import pow2_bucket
+
+        t_pad = pow2_bucket(t_max)  # pow2 target-count lane
+        width = ring.width_bucket(max(chunk_lens))
+        base = _BaseKey(ring.OP_RECONSTRUCT, k, t_pad, width, False)
+        groups = []
+        for g0 in range(0, len(shard_chunks), self.lane_blocks):
+            rows_grp = shard_chunks[g0:g0 + self.lane_blocks]
+            pb_grp = per_block[g0:g0 + self.lane_blocks]
+            weights = []
+            for (survivors, targets) in pb_grp:
+                if targets:
+                    weights.append(rs_xla._decode_weights_np(
+                        k, n, survivors, targets))
+                else:
+                    weights.append(None)
+
+            def stage(slot, row0, rows_grp=rows_grp, pb_grp=pb_grp,
+                      weights=weights):
+                for bi, row in enumerate(rows_grp):
+                    r = row0 + bi
+                    survivors, targets = pb_grp[bi]
+                    for ci, si in enumerate(survivors):
+                        c = row[si]
+                        slot.data[r, ci, :len(c)] = np.frombuffer(
+                            c, dtype=np.uint8)
+                        slot.data[r, ci, len(c):] = 0
+                    w = weights[bi]
+                    if w is None:
+                        slot.weights[r] = 0
+                    else:
+                        tw = w.shape[1]
+                        slot.weights[r, :, :tw] = w
+                        slot.weights[r, :, tw:] = 0
+
+            def finish(outs, row0, nrows=len(rows_grp)):
+                return outs[row0:row0 + nrows]
+
+            req = CodecRequest(base, len(rows_grp), stage, finish)
+            self._submit(req)
+            groups.append((req, rows_grp, pb_grp,
+                           chunk_lens[g0:g0 + self.lane_blocks]))
+
+        out: list[list[bytes]] = []
+        for req, rows_grp, pb_grp, lens_grp in groups:
+            rebuilt = req.future.result()
+            for bi, row in enumerate(rows_grp):
+                _survivors, targets = pb_grp[bi]
+                s = lens_grp[bi]
+                fixed = list(row)
+                for ti, shard_idx in enumerate(targets):
+                    fixed[shard_idx] = rebuilt[bi, ti, :s].tobytes()
+                out.append([fixed[i] for i in want])
+        return out
+
+    # ------------------------------------------------------------------
+    # plumbing
+    # ------------------------------------------------------------------
+
+    def _submit(self, req: CodecRequest) -> None:
+        if self._closed:
+            raise se.OperationTimedOut(msg="batched dataplane is closed")
+        if self._broken is not None:
+            raise se.OperationTimedOut(
+                msg=f"batched dataplane failed: {self._broken}")
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            with self._close_mu:  # rejected count: cross-thread writes
+                self._stats["rejected"] += 1
+            obs_kernel.dataplane_rejected(req.base.op)
+            raise se.OperationTimedOut(
+                msg="batched dataplane saturated (bounded queue full)"
+            ) from None
+        if self._closed and not self._dispatch_t.is_alive():
+            # TOCTOU with close(): the pre-put closed check passed, but
+            # close() drained the queue and joined the dispatcher before
+            # our put landed — nothing will ever consume it. Fail every
+            # straggler (FIFO: anything still queued after the
+            # dispatcher exited is post-close) so no future is orphaned.
+            self._drain_failed(se.OperationTimedOut(
+                msg="batched dataplane closed"))
+
+    def _capacity(self, base: _BaseKey) -> int:
+        return (self.verify_rows if base.op == ring.OP_VERIFY
+                else self.lane_blocks)
+
+    def _next_deadline(self) -> float | None:
+        """Seconds until the oldest open batch must launch (None: no
+        open batches — block on the queue)."""
+        if not self._open:
+            return None
+        now = time.perf_counter()
+        first = min(b.first_ts for b in self._open.values())
+        return max(0.0, first + self.max_wait_s - now)
+
+    def _dispatch_loop(self) -> None:
+        try:
+            while True:
+                self._gate.wait()
+                timeout = self._next_deadline()
+                try:
+                    item = self._q.get(timeout=timeout)
+                except queue.Empty:
+                    item = None
+                if item is _CLOSE:
+                    self._flush(force=True)
+                    break
+                if item is not None:
+                    self._add(item)
+                self._flush(force=False)
+        except BaseException as e:  # noqa: BLE001 - relay to waiters
+            self._broken = e
+            self._fail_open(e)
+            self._drain_failed(e)
+        finally:
+            self._done_q.put(_CLOSE)
+
+    def _add(self, req: CodecRequest) -> None:
+        cap = self._capacity(req.base)
+        batch = self._open.get(req.base)
+        if batch is not None and batch.fill + req.rows > cap:
+            self._launch(batch)
+            batch = None
+        if batch is None:
+            batch = self._open[req.base] = _OpenBatch(req.base)
+        batch.reqs.append(req)
+        batch.fill += req.rows
+
+    def _flush(self, force: bool) -> None:
+        now = time.perf_counter()
+        for base in list(self._open):
+            batch = self._open[base]
+            if (force or batch.fill >= self._capacity(base)
+                    or now - batch.first_ts >= self.max_wait_s):
+                self._launch(batch)
+
+    def _launch(self, batch: _OpenBatch) -> None:
+        self._open.pop(batch.base, None)
+        op, k, aux, width, digests = batch.base
+        cap = self._capacity(batch.base)
+        rb = ring.rows_bucket(batch.fill, cap)
+        slot_key = ring.LaneKey(op, k, aux, width, cap, digests)
+        slot = self._rings.ring(slot_key).acquire()
+        try:
+            row0 = 0
+            for req in batch.reqs:
+                req.stage(slot, row0)
+                row0 += req.rows
+            kern = ring.lane_kernel(
+                ring.LaneKey(op, k, aux, width, rb, digests))
+            t0 = time.perf_counter()
+            if op == ring.OP_RECONSTRUCT:
+                outs = kern(slot.data[:rb], slot.weights[:rb])
+            else:
+                outs = kern(slot.data[:rb], slot.lens[:rb])
+            obs_kernel.observe(
+                f"dp_{op}", _backend(), t0, blocks=rb,
+                nbytes=int(slot.data[:rb].size),
+                out=outs)
+            now = time.perf_counter()
+            obs_kernel.dataplane_launch(
+                op, batch.fill, cap,
+                [now - r.t_submit for r in batch.reqs])
+            st = self._stats
+            st["launches"] += 1
+            st["requests"] += len(batch.reqs)
+            st["rows"] += batch.fill
+            st["capacity"] += cap
+        except BaseException as e:  # noqa: BLE001 - fail this batch only
+            for req in batch.reqs:
+                if not req.future.done():
+                    req.future.set_exception(e)
+            self._rings.ring(slot_key).release(slot)
+            if not isinstance(e, Exception):
+                raise
+            return
+        self._done_q.put((slot_key, slot, outs, batch.reqs))
+
+    def _complete_loop(self) -> None:
+        while True:
+            item = self._done_q.get()
+            if item is _CLOSE:
+                return
+            self._finish_host(*item)
+
+    def _finish_host(self, slot_key, slot, outs, reqs) -> None:
+        """Materialize one launch (the only device->host sync point),
+        resolve its requests' futures, recycle the slot."""
+        try:
+            if slot_key.op == ring.OP_ENCODE:
+                parity, digs = outs
+                mat = (np.asarray(parity),
+                       np.asarray(digs) if digs is not None else None)
+            else:
+                mat = np.asarray(outs)
+            row0 = 0
+            for req in reqs:
+                try:
+                    req.future.set_result(req.finish(mat, row0))
+                except Exception as e:  # noqa: BLE001 - per-request
+                    if not req.future.done():
+                        req.future.set_exception(e)
+                row0 += req.rows
+        except BaseException as e:  # noqa: BLE001 - fail the whole batch
+            for req in reqs:
+                if not req.future.done():
+                    req.future.set_exception(
+                        e if isinstance(e, Exception)
+                        else RuntimeError(repr(e)))
+        finally:
+            self._rings.ring(slot_key).release(slot)
+
+    def _fail_open(self, e: BaseException) -> None:
+        err = e if isinstance(e, Exception) else RuntimeError(repr(e))
+        for batch in self._open.values():
+            for req in batch.reqs:
+                if not req.future.done():
+                    req.future.set_exception(err)
+        self._open.clear()
+
+    def _drain_failed(self, e: BaseException) -> None:
+        err = e if isinstance(e, Exception) else RuntimeError(repr(e))
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if item is _CLOSE:
+                continue
+            try:
+                item.future.set_exception(err)
+            except InvalidStateError:
+                pass  # a racing drainer already resolved this future
+
+    # ------------------------------------------------------------------
+    # lifecycle / introspection
+    # ------------------------------------------------------------------
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop accepting work, drain every in-flight batch (all futures
+        resolve — none orphaned), then join both threads."""
+        with self._close_mu:
+            if self._closed:
+                return
+            self._closed = True
+        self._gate.set()
+        self._q.put(_CLOSE)
+        self._dispatch_t.join(timeout)
+        self._complete_t.join(timeout)
+        # Late racers that slipped into the queue after _CLOSE: fail
+        # them rather than leaving futures forever pending.
+        self._drain_failed(se.OperationTimedOut(
+            msg="batched dataplane closed"))
+        self._rings.clear()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def stats(self) -> dict:
+        st = dict(self._stats)
+        st["mean_occupancy"] = (st["rows"] / st["capacity"]
+                                if st["capacity"] else 0.0)
+        return st

@@ -168,30 +168,49 @@ class ErasureCodec:
         from minio_tpu.ops import fused
 
         s_full = self.shard_size()
-        batch = np.empty((len(blocks), self.k, s_full), dtype=np.uint8)
+        # Shape bucketing (fused.bucket_rows / bucket_width): pad the
+        # row count to the next power of two so mixed object sizes
+        # (whose tail batches carry arbitrary block counts) cannot
+        # churn the jit trace cache, and stage at the batch's ACTUAL
+        # pow-2 chunk width instead of the geometry's full shard width
+        # — a 10 KiB object must not pay a 1 MiB-block-wide launch.
+        # Both paddings are invisible in results: parity columns never
+        # mix and mxsum digests are cap-invariant; pad rows are zeros
+        # with chunk_len 0 and every consumer iterates real blocks only.
         chunk_lens: list[int] = []
-        padded: list[bytes | None] = []
         for bi, block in enumerate(blocks):
             if not 0 < len(block) <= self.block_size:
                 raise ValueError(f"block {bi} size {len(block)}")
-            s = _ceil_div(len(block), self.k)
-            chunk_lens.append(s)
-            if s == s_full and len(block) == self.k * s_full:
+            chunk_lens.append(_ceil_div(len(block), self.k))
+        rows = fused.bucket_rows(len(blocks))
+        s_stage = min(s_full, fused.bucket_width(max(chunk_lens)))
+        batch = np.empty((rows, self.k, s_stage), dtype=np.uint8)
+        padded: list[bytes | None] = []
+        for bi, block in enumerate(blocks):
+            s = chunk_lens[bi]
+            if s == s_stage and len(block) == self.k * s_stage:
                 padded.append(None)
                 batch[bi] = np.frombuffer(block, dtype=np.uint8).reshape(
-                    self.k, s_full)
+                    self.k, s_stage)
             else:
                 flat = np.zeros(self.k * s, dtype=np.uint8)
                 flat[: len(block)] = np.frombuffer(block, dtype=np.uint8)
                 padded.append(flat.tobytes())
                 batch[bi, :, :s] = flat.reshape(self.k, s)
                 batch[bi, :, s:] = 0
+        if rows != len(blocks):
+            batch[len(blocks):] = 0
+        staged_lens = chunk_lens + [0] * (rows - len(blocks))
         parity_dev = digs_dev = None
         if self.m or with_digests:
             mesh = serving_mesh()
             b = len(blocks)
+            # rows (not b) is the staged batch dim: pow-2 row padding
+            # keeps non-pow-2 tail batches mesh-eligible — pad rows are
+            # zeros, their parity/digests are computed and ignored
+            # (wait() iterates real blocks only).
             dims_ok = (mesh is not None
-                       and b % mesh.shape["dp"] == 0
+                       and rows % mesh.shape["dp"] == 0
                        and self.k % mesh.shape["tp"] == 0
                        and s_full % mesh.shape["sp"] == 0)
             if (dims_ok and self.m and with_digests
@@ -220,7 +239,7 @@ class ErasureCodec:
                                    out=parity_dev)
             else:
                 data_dev = jnp.asarray(batch)
-                lens_dev = jnp.asarray(chunk_lens, dtype=jnp.int32)
+                lens_dev = jnp.asarray(staged_lens, dtype=jnp.int32)
                 if self.m and with_digests:
                     parity_dev, digs_dev = fused.encode_with_digests(
                         data_dev, self.k, self.m, lens_dev)
@@ -228,9 +247,9 @@ class ErasureCodec:
                     parity_dev = fused.encode_only(data_dev, self.k, self.m)
                 else:  # digests for a parity-less geometry (k shards only)
                     digs_dev = fused.verify_digests(
-                        data_dev.reshape(len(blocks) * self.k, s_full),
+                        data_dev.reshape(rows * self.k, s_stage),
                         jnp.repeat(lens_dev, self.k),
-                    ).reshape(len(blocks), self.k, -1)
+                    ).reshape(rows, self.k, -1)
         return PendingEncode(self, blocks, chunk_lens, padded,
                              parity_dev, digs_dev)
 
@@ -277,8 +296,12 @@ class ErasureCodec:
         # Survivor-compacted staging ([B, k, S], no dead parity rows) and
         # the decode matrix as runtime data — the failure pattern stays
         # out of the jit compile key (C(n, <=m) patterns exist; static
-        # args would recompile the kernel per pattern mid-sweep).
-        batch = np.zeros((len(shard_chunks), self.k, s_full), dtype=np.uint8)
+        # args would recompile the kernel per pattern mid-sweep). Rows
+        # pad to the power-of-two bucket (fused.bucket_rows) so a heal
+        # sweep's ragged tail batches reuse the same compiled program.
+        rows = fused.bucket_rows(len(shard_chunks))
+        s_stage = min(s_full, fused.bucket_width(max(chunk_lens)))
+        batch = np.zeros((rows, self.k, s_stage), dtype=np.uint8)
         for bi, row in enumerate(shard_chunks):
             for ci, si in enumerate(survivors):
                 c = row[si]
@@ -287,9 +310,10 @@ class ErasureCodec:
 
         w_t = jnp.asarray(rs_pallas._decode_weights_t(
             self.k, n, survivors, tuple(targets)))
+        staged_lens = chunk_lens + [0] * (rows - len(shard_chunks))
         rebuilt_dev, digs_dev = fused.reconstruct_weights_digests(
             jnp.asarray(batch), w_t,
-            jnp.asarray(chunk_lens, dtype=jnp.int32),
+            jnp.asarray(staged_lens, dtype=jnp.int32),
             len(targets), with_digests=with_digests)
         return PendingDecode(tuple(targets), chunk_lens, rebuilt_dev, digs_dev)
 
@@ -336,10 +360,14 @@ class ErasureCodec:
             ]
 
         survivors = tuple([i for i in range(n) if present[i]][: self.k])
-        s_full = self.shard_size()
+        from minio_tpu.ops import fused
+
+        s_stage = min(self.shard_size(),
+                      fused.bucket_width(max(chunk_lens)))
         # Rows are already compacted to the k survivors, so feed the raw
         # GF(2) contraction with the per-pattern decode weights directly.
-        batch = np.zeros((len(shard_chunks), self.k, s_full), dtype=np.uint8)
+        batch = np.zeros((len(shard_chunks), self.k, s_stage),
+                         dtype=np.uint8)
         for bi, row in enumerate(shard_chunks):
             for si, shard_idx in enumerate(survivors):
                 c = row[shard_idx]
@@ -371,12 +399,15 @@ class ErasureCodec:
         pattern)."""
         from minio_tpu.utils import errors as se
 
+        from minio_tpu.ops import fused
+
         n = self.k + self.m
         if not shard_chunks:
             return []
-        s_full = self.shard_size()
         want = list(range(n) if need_all else range(self.k))
         chunk_lens = [_ceil_div(bl, self.k) for bl in block_lens]
+        s_stage = min(self.shard_size(),
+                      fused.bucket_width(max(chunk_lens)))
 
         per_block: list[tuple[tuple[int, ...], tuple[int, ...]]] = []
         t_max = 1
@@ -394,7 +425,8 @@ class ErasureCodec:
         if all(not t for _, t in per_block):
             return [[row[i] for i in want] for row in shard_chunks]  # type: ignore[misc]
 
-        batch = np.zeros((len(shard_chunks), self.k, s_full), dtype=np.uint8)
+        batch = np.zeros((len(shard_chunks), self.k, s_stage),
+                         dtype=np.uint8)
         weights = np.zeros((len(shard_chunks), self.k * 8, t_max * 8),
                            dtype=np.int8)
         for bi, row in enumerate(shard_chunks):

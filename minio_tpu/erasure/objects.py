@@ -28,7 +28,7 @@ import uuid
 from concurrent.futures import TimeoutError as _FutTimeout
 from typing import BinaryIO, Iterator
 
-from minio_tpu import obs
+from minio_tpu import dataplane, obs
 from minio_tpu.erasure.codec import DEFAULT_BLOCK_SIZE, ErasureCodec
 from minio_tpu.erasure import listing
 from minio_tpu.erasure.sysstore import SysConfigStore
@@ -776,7 +776,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                             break
                         except se.StorageError:
                             continue
-                    decoded = codec.decode_blocks(rows, lens)
+                    decoded = self._decode_rows(codec, rows, lens)
                     for j, b in enumerate(ids):
                         block = b"".join(decoded[j])[: lens[j]]
                         blk_start = b * fi.erasure.block_size
@@ -873,7 +873,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 if tag == "err":
                     raise a
                 batch_ids, block_lens, rows = a, b_, c
-                decoded = codec.decode_blocks(rows, block_lens)
+                decoded = self._decode_rows(codec, rows, block_lens)
                 for j, b in enumerate(batch_ids):
                     block = b"".join(decoded[j])[: block_lens[j]]
                     blk_start = b * fi.erasure.block_size
@@ -1364,14 +1364,38 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             self._verify_records(records, codec, readers, dead, corrupt)
         return rows
 
+    def _decode_rows(self, codec: ErasureCodec, rows, lens):
+        """GET-path reconstruction: through the batched plane when
+        enabled (concurrent GETs with even DIFFERENT failure patterns
+        share one launch — per-row decode matrices ride as data), else
+        the per-object codec path."""
+        plane = dataplane.maybe_plane() if codec.m else None
+        if plane is not None and lens and plane.accepts_chunk(
+                -(-max(lens) // codec.k)):
+            try:
+                return plane.decode_blocks(codec.k, codec.m,
+                                           codec.block_size, rows, lens)
+            except se.OperationTimedOut:
+                pass  # plane saturated: per-object dispatch still serves
+        return codec.decode_blocks(rows, lens)
+
     def _verify_records(self, records, codec, readers, dead,
                         corrupt=None) -> None:
         """One batched mxsum256 launch over every chunk just read; a digest
         mismatch marks the drive dead and retriggers shard selection."""
         from minio_tpu.ops import fused
 
-        got = fused.digest_chunks_host([c for _i, _w, c in records],
-                                       codec.shard_size())
+        plane = dataplane.maybe_plane()
+        got = None
+        if plane is not None and plane.accepts_chunk(codec.shard_size()):
+            try:
+                got = plane.digest_chunks([c for _i, _w, c in records],
+                                          codec.shard_size())
+            except se.OperationTimedOut:
+                got = None  # plane saturated: per-object launch below
+        if got is None:
+            got = fused.digest_chunks_host([c for _i, _w, c in records],
+                                           codec.shard_size())
         for ri, (i, want, _chunk) in enumerate(records):
             if got[ri] != want:
                 dead.add(i)
@@ -1802,6 +1826,19 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         # Device-fused digests share the encode launch (ops/fused.py); any
         # other algorithm is hashed host-side per chunk.
         use_fused = self.bitrot_algorithm == "mxsum256"
+        # Batched data plane (MTPU_BATCHED_DATAPLANE=1): concurrent PUTs
+        # coalesce their encode launches; per-object dispatch is the
+        # fallback (and the bit-exactness oracle). Parity-less
+        # geometries stay per-object (nothing to coalesce but digests).
+        plane = dataplane.maybe_plane() if codec.m else None
+
+        def begin_encode(blocks: list[bytes]):
+            if plane is not None and plane.accepts_chunk(
+                    -(-max(len(b) for b in blocks) // codec.k)):
+                return plane.begin_encode(codec.k, codec.m,
+                                          codec.block_size, blocks,
+                                          with_digests=use_fused)
+            return codec.begin_encode(blocks, with_digests=use_fused)
         bitrot_algo = bitrot.get_algorithm(self.bitrot_algorithm)
         md5 = hashlib.md5()
         total = 0
@@ -1835,14 +1872,14 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 total += len(block)
                 batch.append(block)
                 if len(batch) >= self.batch_blocks:
-                    pending.append(codec.begin_encode(batch, with_digests=use_fused))
+                    pending.append(begin_encode(batch))
                     batch = []
                     if len(pending) >= pipeline_depth:
                         drain_one()
                 remaining = bs if size < 0 else min(bs, size - total)
                 block = _read_full(data, remaining)
             if batch:
-                pending.append(codec.begin_encode(batch, with_digests=use_fused))
+                pending.append(begin_encode(batch))
             while pending:
                 drain_one()
         finally:

@@ -16,6 +16,13 @@ Families (rendered by admin/metrics.py through the shared registry):
   the most recent launch.
 - `minio_tpu_kernel_launches_total{kernel,backend}` — launch count.
 
+Batched-dataplane families (minio_tpu/dataplane, docs/DATAPLANE.md):
+`minio_tpu_dataplane_launches_total{op}` / `_requests_total{op}`
+(amortization ratio), `_batch_fill{op}` (occupancy histogram),
+`_queue_wait_seconds{op}` (submit→launch wait),
+`_backpressure_total{op}` (bounded-queue rejections → 503 SlowDown).
+Lane launches also ride `minio_tpu_kernel_seconds{kernel="dp_*"}`.
+
 Timing semantics: JAX dispatch is asynchronous, so by default the
 histogram records the host-side dispatch+launch wall time — cheap
 (two clock reads + one observe, no device sync forced on the serving
@@ -58,6 +65,25 @@ _KERNEL_BYTES = _gauge(
     "Bytes staged into the most recent kernel launch",
     ("kernel", "backend"))
 
+# Batched-dataplane families (minio_tpu/dataplane, docs/DATAPLANE.md):
+# how well coalescing amortizes the launch tax, observable live.
+_DP_QUEUE_WAIT = _histogram(
+    "minio_tpu_dataplane_queue_wait_seconds",
+    "Submit-to-launch wait of one coalesced codec request", ("op",))
+_DP_FILL = _histogram(
+    "minio_tpu_dataplane_batch_fill",
+    "Filled fraction of each coalesced lane launch (occupancy)", ("op",))
+_DP_LAUNCHES = _counter(
+    "minio_tpu_dataplane_launches_total",
+    "Coalesced lane launches by op", ("op",))
+_DP_REQUESTS = _counter(
+    "minio_tpu_dataplane_requests_total",
+    "Codec requests carried by coalesced launches", ("op",))
+_DP_REJECTED = _counter(
+    "minio_tpu_dataplane_backpressure_total",
+    "Requests rejected at the bounded submission queue (503 SlowDown)",
+    ("op",))
+
 _SYNC = os.environ.get("MTPU_KERNEL_SYNC", "") in ("1", "true", "on")
 
 
@@ -69,6 +95,24 @@ def set_sync(on: bool) -> None:
 
 def sync_enabled() -> bool:
     return _SYNC
+
+
+def dataplane_launch(op: str, filled: int, capacity: int,
+                     waits: list[float]) -> None:
+    """Record one coalesced launch: occupancy + per-request queue wait
+    (submit to launch). Called by the dispatcher thread only."""
+    _DP_LAUNCHES.labels(op=op).inc()
+    _DP_REQUESTS.labels(op=op).inc(len(waits))
+    if capacity:
+        _DP_FILL.labels(op=op).observe(filled / capacity)
+    wait_hist = _DP_QUEUE_WAIT.labels(op=op)
+    for w in waits:
+        wait_hist.observe(w)
+
+
+def dataplane_rejected(op: str) -> None:
+    """One submission bounced off the bounded queue (backpressure)."""
+    _DP_REJECTED.labels(op=op).inc()
 
 
 def observe(kernel: str, backend: str, t0: float, *,

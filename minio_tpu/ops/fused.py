@@ -33,6 +33,35 @@ from minio_tpu.ops import mxsum, rs_pallas, rs_xla
 _BACKEND: str | None = None
 
 
+def bucket_rows(b: int) -> int:
+    """Next power-of-two batch-row count (>= 1).
+
+    jit traces once per SHAPE: under mixed object sizes the tail batch
+    of every object carries a different row count, so unbucketed batch
+    dims mint a fresh trace per distinct count — compile churn on the
+    serving path. The dispatch layers (erasure/codec.py staging,
+    digest_chunks_host, dataplane lanes) pad the batch dim to this
+    bucket and slice results back, bounding the trace count per entry
+    point to log2(max batch)+1 (compile-count probe:
+    tests/test_dataplane.py)."""
+    from minio_tpu.utils.shardmath import pow2_bucket
+
+    return pow2_bucket(b)
+
+
+def bucket_width(s: int, floor: int = 512) -> int:
+    """Next power-of-two staging width (>= floor) for a shard chunk of s
+    bytes. The dispatch layers stage batches at the bucket of their
+    ACTUAL max chunk length instead of the geometry's full shard width:
+    a small object's launch then touches KiBs, not a 1 MiB-block-wide
+    row of padding. Free by construction — parity columns never mix and
+    mxsum digests are cap-invariant (ops/mxsum.py), so results are
+    bit-identical under any staging width >= the chunk length."""
+    from minio_tpu.utils.shardmath import pow2_bucket
+
+    return pow2_bucket(s, floor=floor)
+
+
 def _backend() -> str:
     """`minio_tpu_kernel_seconds` backend label: JAX platform + which
     erasure kernel the dispatch selects (tpu:pallas / cpu:xla / ...).
@@ -212,9 +241,7 @@ def digest_chunks_host(chunks: list[bytes], cap: int) -> list[bytes]:
 
     from minio_tpu.utils.bufpool import GLOBAL_POOL
 
-    n = 1
-    while n < len(chunks):
-        n *= 2
+    n = bucket_rows(len(chunks))
     batch = GLOBAL_POOL.get((n, cap), zero=True)
     lens = np.zeros(n, dtype=np.int32)
     for i, c in enumerate(chunks):

@@ -67,7 +67,11 @@ EXCLUDED_SITE_FILES = (
 # design), per-engine shard-read pools and dsync broadcast pools whose
 # lifetime is the server's (session fixtures), and asyncio's default
 # executor workers.
-ALLOWED_THREAD_PREFIXES = ("mtpu-io", "shard-read", "dsync", "asyncio_")
+# "mtpu-dataplane": the process-global batched data plane's dispatcher
+# and completion threads (minio_tpu/dataplane) — session-lived like the
+# shared I/O pool; test-local planes are close()d and never leak.
+ALLOWED_THREAD_PREFIXES = ("mtpu-io", "shard-read", "dsync", "asyncio_",
+                           "mtpu-dataplane")
 
 _REAL_LOCK = threading.Lock
 _REAL_RLOCK = threading.RLock

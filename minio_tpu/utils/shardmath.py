@@ -12,6 +12,18 @@ def ceil_div(a: int, b: int) -> int:
     return -(-a // b)
 
 
+def pow2_bucket(n: int, floor: int = 1) -> int:
+    """Next power of two >= n (and >= floor) — THE bucketing rule for
+    every staged batch dimension (row counts, shard widths, target
+    counts). One implementation on purpose: codec staging, the fused
+    dispatch layer and the dataplane lane keys must round identically
+    or they mint divergent jit-trace families (docs/DATAPLANE.md)."""
+    w = max(floor, 1)
+    while w < n:
+        w *= 2
+    return w
+
+
 def shard_size(block_size: int, data_blocks: int) -> int:
     """Shard chunk width for one erasure block."""
     return ceil_div(block_size, data_blocks)

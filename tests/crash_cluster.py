@@ -91,6 +91,13 @@ class Cluster:
             "MTPU_FAULT_INJECTION": "1",
             "MTPU_CHAOS_DRIVE_WRAP": "1",
             "MTPU_MRF_RETRY_INTERVAL": "0.2",
+            # Batched device data plane ON for the whole crash/chaos
+            # tier: the tier-1 storm's SIGKILL lands while coalesced
+            # encode batches are in flight, so zero-lost-acknowledged-
+            # write is proven WITH the plane serving (docs/DATAPLANE.md;
+            # an ack only ever follows the commit, which only follows
+            # the batch's futures resolving).
+            "MTPU_BATCHED_DATAPLANE": "1",
             # Tight drive deadlines: an injected hang must walk the
             # drive FAULTY→OFFLINE within the bounded storm window
             # (deadlines stay adaptive — a genuinely slow sandbox

@@ -121,7 +121,8 @@ class JaxHygieneRule(Rule):
     title = "JAX hygiene: host sync / mutable capture / trace nondeterminism"
 
     def scope(self, relpath: str) -> bool:
-        return relpath.startswith(("minio_tpu/ops/", "minio_tpu/native/"))
+        return relpath.startswith(("minio_tpu/ops/", "minio_tpu/native/",
+                                   "minio_tpu/dataplane/"))
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         tree = ctx.tree
