@@ -160,6 +160,15 @@ class NaughtyDisk:
                     and not any(k[0] == name
                                 for k in self.per_method_call)):
                 prog = "read_file_stream"
+            # The async group-commit entry honors the sync journal
+            # store's fault program: a chaos schedule hanging
+            # write_metadata_single must also hang the two-phase path.
+            if (name == "journal_commit_async"
+                    and name not in self.per_method
+                    and name not in self.per_method_delay
+                    and not any(k[0] == name
+                                for k in self.per_method_call)):
+                prog = "write_metadata_single"
             self._maybe_fail(prog)
             self._maybe_delay(prog)
             out = fn(*a, **kw)

@@ -443,6 +443,8 @@ class HealingMixin:
 
     def _commit_healed(self, bucket, obj, latest, shuffled_drives, targets,
                        sys_vol, tmp_dirs, pool) -> list[int]:
+        # Heal rewrites journals out from under any cached election.
+        self._meta_invalidate(bucket, obj)
         healed = []
         for pos in targets:
             if pool.errs[pos] is not None:
@@ -584,6 +586,8 @@ class HealingMixin:
 
     def _heal_write_metadata(self, bucket, obj, latest, drives, targets, res,
                              positions_are_physical=False):
+        self._meta_invalidate(bucket, obj)
+
         def write(pos):
             fi = _clone_fi(latest, 0 if positions_are_physical else pos + 1)
             if latest.deleted:

@@ -356,6 +356,9 @@ class MultipartMixin:
             outcomes = parallel_map(
                 [lambda i=i, d=d: commit(i, d) for i, d in enumerate(shuffled)],
             )
+            # The commit rewrote the object's journals (success or not,
+            # some drives moved): any cached election is stale.
+            self._meta_invalidate(bucket, obj)
 
             def restore_session():
                 # Move parts BACK into the session so the client can

@@ -21,6 +21,7 @@ Differences from the reference are deliberate TPU-first design:
 from __future__ import annotations
 
 import hashlib
+import os
 import queue
 import threading
 import time
@@ -28,7 +29,7 @@ import uuid
 from concurrent.futures import TimeoutError as _FutTimeout
 from typing import BinaryIO, Iterator
 
-from minio_tpu import dataplane, obs
+from minio_tpu import dataplane, metaplane, obs
 from minio_tpu.erasure.codec import DEFAULT_BLOCK_SIZE, ErasureCodec
 from minio_tpu.erasure import listing
 from minio_tpu.erasure.sysstore import SysConfigStore
@@ -178,6 +179,23 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         # before the hard data deadline.
         self._shard_lat: float | None = None
         self.hedge_delay: float | None = None
+        # Set-level post-election FileInfo cache (docs/METAPLANE.md):
+        # GET/HEAD revalidate one cached election with per-local-drive
+        # journal signatures instead of paying the N-drive fan-out.
+        # Gated with the group-commit plane; None = every read elects.
+        self._setcache = None
+        if metaplane.enabled():
+            from minio_tpu.metaplane.setcache import SetFileInfoCache
+
+            self._setcache = SetFileInfoCache(metaplane.cache_objects())
+
+    def _meta_invalidate(self, bucket: str, obj: str) -> None:
+        """Drop the set-level FileInfo cache entry after a mutating
+        fan-out (delete, metadata write, multipart complete, heal).
+        Signature validation would catch these anyway; eager
+        invalidation keeps the common case from paying a miss probe."""
+        if self._setcache is not None:
+            self._setcache.invalidate(bucket, obj)
 
     @property
     def fast_local_reads(self) -> bool:
@@ -425,21 +443,31 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 self._check_put_precondition(bucket, obj, opts)
                 with obs.span("commit", bucket=bucket, object=obj,
                               inline=True):
-                    outcomes = parallel_map(
-                        [
-                            lambda d=d: d.write_metadata_single(
-                                bucket, obj, fi, raw, journal,
-                                defer_reclaim=True)
-                            for d in shuffled
-                        ],
-                        serial=serial_writes,
-                        deadline=self._meta_deadline(),
-                    )
+                    outcomes = None
+                    if self._setcache is not None:
+                        # Metaplane armed: two-phase group commit —
+                        # submit to every drive's WAL from this thread,
+                        # then await the shared fsyncs; no pool worker
+                        # blocked per drive (docs/METAPLANE.md).
+                        outcomes = self._inline_commit_fast(
+                            shuffled, bucket, obj, fi, raw, journal)
+                    if outcomes is None:
+                        outcomes = parallel_map(
+                            [
+                                lambda d=d: d.write_metadata_single(
+                                    bucket, obj, fi, raw, journal,
+                                    defer_reclaim=True)
+                                for d in shuffled
+                            ],
+                            serial=serial_writes,
+                            deadline=self._meta_deadline(),
+                        )
 
                 def undo_inline():
                     # Same undo discipline as the streaming commit: an
                     # inline overwrite below quorum must restore the
                     # displaced generation on drives that committed.
+                    self._meta_invalidate(bucket, obj)
                     undo_fi = FileInfo(volume=bucket, name=obj,
                                        version_id=fi.version_id)
 
@@ -472,6 +500,11 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                          for d, t in zip(shuffled, outcomes)
                          if t and not isinstance(t, Exception)],
                         deadline=self._meta_deadline())
+                if self._setcache is not None:
+                    # Write-through: the committed journal IS what an
+                    # election would return (index 0 on every drive),
+                    # so the first GET skips the fan-out outright.
+                    self._setcache.populate(bucket, obj, "", fi, shuffled)
             return self._fi_to_object_info(bucket, obj, fi)
 
         # Streaming erasure path.
@@ -539,6 +572,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 # object GET quorum-fails on, and an overwrite would
                 # have destroyed the previous generation (reference
                 # undo-rename discipline).
+                self._meta_invalidate(bucket, obj)
                 undo_fi = FileInfo(volume=bucket, name=obj,
                                    version_id=fi.version_id,
                                    data_dir=fi.data_dir)
@@ -575,6 +609,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 parallel_map([lambda d=d, t=t: d.commit_rename(t)
                               for d, t in zip(shuffled, tokens) if t],
                              deadline=self._meta_deadline())
+            self._meta_invalidate(bucket, obj)
         # Partial success: quorum met but some drive missed the write — queue
         # it for background heal (reference addPartial, cmd/erasure-object.go:1150).
         if self.mrf is not None and any(isinstance(o, Exception) for o in outcomes):
@@ -1425,6 +1460,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     [lambda d=d: d.delete_version(bucket, obj, marker) for d in self.drives],
                     deadline=self._meta_deadline(),
                 )
+                self._meta_invalidate(bucket, obj)
                 reduce_write_quorum(results, write_quorum, bucket, obj)
             return ObjectInfo(bucket=bucket, name=obj, version_id=marker.version_id,
                               delete_marker=True, mod_time=marker.mod_time)
@@ -1437,6 +1473,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 [lambda d=d: d.delete_version(bucket, obj, target) for d in self.drives],
                 deadline=self._meta_deadline(),
             )
+            self._meta_invalidate(bucket, obj)
             # A drive that never had the version is as good as deleted on it.
             results = [
                 None if isinstance(r, (se.FileNotFound, se.FileVersionNotFound)) else r
@@ -1555,6 +1592,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             ],
             deadline=self._meta_deadline(),
         )
+        self._meta_invalidate(bucket, obj)
         reduce_write_quorum(results, self._write_quorum_meta(), bucket, obj)
         return self._fi_to_object_info(bucket, obj, fi)
 
@@ -1595,6 +1633,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                      if fi.erasure.distribution else self.drives)],
                 deadline=self._meta_deadline(),
             )
+            self._meta_invalidate(bucket, obj)
             reduce_write_quorum(results, self._write_quorum_meta(), bucket, obj)
 
     def restore_transitioned(self, bucket: str, obj: str,
@@ -1916,6 +1955,72 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         self._encode_gibps = gibps if e is None else 0.7 * e + 0.3 * gibps
         _ENCODE_GIBPS.set(self._encode_gibps)
 
+    def _inline_commit_fast(self, shuffled, bucket: str, obj: str,
+                            fi: FileInfo, raw: bytes, journal):
+        """Two-phase inline-PUT commit through the group-commit plane:
+        submit the single-journal record to every drive's WAL
+        (journal_commit_async — the call rides the full wrapper chain,
+        so disk-ID checks, fault injection, and health deadlines all
+        interpose), then await every shared-fsync future under the meta
+        deadline. Outcomes mirror the sync fan-out: reclaim token or
+        per-drive exception values for the quorum reducer.
+
+        The submit side is PURE MEMORY on an unwrapped armed drive (the
+        commit prework runs in the committer thread), so submits run
+        inline with no pool hop. With the chaos drive wrap armed, an
+        injected fault may block the call itself — there the submit
+        loop runs under run_bounded, and a wedged loop falls back to
+        the deadline'd parallel_map (a re-store after partial
+        submission is idempotent: same key, same bytes).
+
+        Returns None to fall back when any drive lacks the two-phase
+        entry (remote / unarmed)."""
+        fns = []
+        for d in shuffled:
+            fn = getattr(d, "journal_commit_async", None)
+            if fn is None:
+                return None
+            fns.append(fn)
+        futs: list = []
+
+        def submit_all():
+            for fn in fns:
+                try:
+                    f = fn(bucket, obj, fi, raw, meta=journal,
+                           defer_reclaim=True)
+                except Exception as e:  # noqa: BLE001 - per-drive data
+                    futs.append(e)
+                    continue
+                if f is None:
+                    futs.append(None)  # drive not armed: abort fast path
+                    return
+                futs.append(f)
+
+        if os.environ.get("MTPU_CHAOS_DRIVE_WRAP", "") == "1":
+            if not run_bounded(submit_all, self._meta_deadline()):
+                return None  # injected hang mid-submit: bounded fallback
+        else:
+            submit_all()
+        if any(f is None for f in futs):
+            return None
+        deadline = time.monotonic() + self._meta_deadline()
+        outcomes: list = []
+        for f in futs:
+            if isinstance(f, Exception):
+                outcomes.append(f)
+                continue
+            try:
+                outcomes.append(
+                    f.result(timeout=max(0.0, deadline - time.monotonic())))
+            except se.StorageError as e:
+                outcomes.append(e)
+            except _FutTimeout:
+                outcomes.append(se.OperationTimedOut(
+                    bucket, obj, "wal group commit exceeded deadline"))
+            except Exception as e:  # noqa: BLE001 - per-drive data
+                outcomes.append(e)
+        return outcomes
+
     def _check_put_precondition(self, bucket: str, obj: str,
                                 opts: ObjectOptions) -> None:
         """Conditional-PUT guard, called INSIDE the commit lock: abort the
@@ -1934,8 +2039,25 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
 
     def _read_quorum_fileinfo(self, bucket: str, obj: str,
                               version_id: str) -> FileInfo:
+        sc = self._setcache
+        pre_sigs = None
+        if sc is not None:
+            fi = sc.lookup(bucket, obj, version_id)
+            if fi is not None:
+                # Signature-validated post-election hit: the N-drive
+                # fan-out + election is skipped entirely.
+                return fi
+            # Signatures BEFORE the election: a mutation racing the
+            # fan-out read leaves these stale, so the entry self-
+            # invalidates at the next lookup instead of serving the
+            # pre-mutation election under post-mutation signatures.
+            pre_sigs = sc.snapshot_sigs(bucket, obj, self.drives)
         with obs.span("quorum-read", bucket=bucket, object=obj):
-            return self._read_quorum_fileinfo_inner(bucket, obj, version_id)
+            fi = self._read_quorum_fileinfo_inner(bucket, obj, version_id)
+        if sc is not None:
+            sc.populate(bucket, obj, version_id, fi, self.drives,
+                        sigs=pre_sigs)
+        return fi
 
     def _read_quorum_fileinfo_inner(self, bucket: str, obj: str,
                                     version_id: str) -> FileInfo:

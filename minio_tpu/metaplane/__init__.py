@@ -1,0 +1,68 @@
+"""Group-commit metadata plane (docs/METAPLANE.md).
+
+The host-side twin of the batched device data plane (PR 8): where
+`dataplane/` coalesces concurrent codec work into fused-kernel lane
+launches, this package coalesces concurrent journal commits into one
+durable WAL fsync per drive per batch, and puts a set-level
+post-election FileInfo cache in front of the N-drive quorum read.
+
+Three pieces:
+
+- `wal.py` — the per-drive append-only journal format: CRC-framed
+  records, torn-tail-tolerant scan, replay-on-mount fold.
+- `groupcommit.py` — `DriveWAL`: one committer thread per drive;
+  concurrent journal stores enqueue records and get futures, the
+  committer appends a batch and fsyncs ONCE (durability is the WAL
+  fsync, not the materialized `meta.mp`); per-object journals
+  materialize asynchronously, with checkpoint/truncate keeping the
+  journal bounded.
+- `setcache.py` — `SetFileInfoCache`: write-through post-election
+  FileInfo cache consulted by GET/HEAD before the per-drive fan-out,
+  validated against per-local-drive journal signatures.
+
+Opt-in via `MTPU_METAPLANE=1`; the per-request write+fsync+rename path
+remains both the fallback and the correctness oracle. WAL replay on
+drive mount runs regardless of the gate (a journal left by a crashed
+armed process must converge even if the next boot is unarmed).
+Committer threads are session-lived daemons named `mtpu-metaplane-*`
+(exempted in utils/sanitize.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+ENABLE_ENV = "MTPU_METAPLANE"
+
+
+def enabled() -> bool:
+    """Read the env gate live — cheap, and tests flip it per-case."""
+    return os.environ.get(ENABLE_ENV, "") in ("1", "true", "on")
+
+
+def wal_max_bytes() -> int:
+    """Checkpoint threshold: WAL size that triggers materialize-all +
+    sync + truncate (the journal stays bounded)."""
+    return int(os.environ.get("MTPU_WAL_MAX_BYTES", str(16 << 20)))
+
+
+def wal_max_pending() -> int:
+    """Materialization backlog bound: above this many distinct pending
+    keys the committer drains even under sustained commit load."""
+    return int(os.environ.get("MTPU_WAL_MAX_PENDING", "4096"))
+
+
+def wal_max_batch() -> int:
+    """Records per group commit (writev bound; IOV_MAX headroom)."""
+    return int(os.environ.get("MTPU_WAL_MAX_BATCH", "256"))
+
+
+def wal_queue_depth() -> int:
+    """Bounded submission queue per drive — full queue is backpressure
+    (FaultyDisk to the caller, counted in quorum), never unbounded RAM."""
+    return int(os.environ.get("MTPU_WAL_QUEUE", "8192"))
+
+
+def cache_objects() -> int:
+    """Set-level FileInfo cache capacity in objects (LRU)."""
+    return int(os.environ.get("MTPU_METAPLANE_CACHE", "4096"))

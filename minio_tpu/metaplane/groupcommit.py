@@ -1,0 +1,568 @@
+"""DriveWAL — per-drive group commit over the WAL journal.
+
+One committer thread per armed drive. Concurrent journal stores
+(`LocalDrive._store_meta` / the inline-PUT single-journal fast path)
+enqueue records and block on futures; the committer drains the queue,
+appends the whole batch to the WAL with one `writev`, and fsyncs ONCE —
+the futures resolve only after that fsync lands, so the S3 ack rides
+exactly one shared fsync instead of a write+fsync+rename per request.
+
+`meta.mp` files materialize asynchronously: after the fsync the batch
+is published to an in-memory pending overlay (reads — `read_version`,
+`read_xl`, `_load_meta` — consult it first, so read-your-write holds
+the instant the future resolves), and the committer writes the actual
+per-object journals when the queue goes idle (or when the backlog
+exceeds `MTPU_WAL_MAX_PENDING`), *without* per-file fsync — durability
+is the WAL until checkpoint. Checkpoint (WAL past `MTPU_WAL_MAX_BYTES`)
+materializes everything, `os.sync()`s once, and truncates the journal.
+
+Crash anatomy (proven by tests/test_metaplane.py + the armed chaos
+storm):
+
+- SIGKILL before the batch fsync — the WAL tail is torn; `wal.scan`
+  stops before it; the writes were never acked and are legally lost.
+- SIGKILL after fsync, before materialize — replay on next mount folds
+  the WAL and rewrites every key's journal bit-exact; acked writes
+  survive.
+- SIGKILL mid-checkpoint — the WAL still holds every record until the
+  post-sync truncate, and replay is idempotent.
+
+Error discipline: an append/fsync failure marks the WAL broken, fails
+the batch's futures with FaultyDisk (the caller's quorum accounting
+treats the drive as failed), and subsequent submits fail fast. A
+materialize failure leaves the record pending (still served from
+memory, still durable in the WAL) and blocks checkpoint truncation.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import shutil
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import Future
+
+from minio_tpu import metaplane, obs
+from minio_tpu.metaplane import wal as walfmt
+from minio_tpu.utils import errors as se
+
+_COMMITS = obs.counter(
+    "minio_tpu_metaplane_commits_total",
+    "Journal records group-committed through the per-drive WAL",
+    ("drive",))
+_FSYNCS = obs.counter(
+    "minio_tpu_metaplane_fsyncs_total",
+    "WAL fsyncs — commits/fsyncs is the live group-commit amortization",
+    ("drive",))
+_BATCH_FILL = obs.histogram(
+    "minio_tpu_metaplane_batch_fill",
+    "Records per WAL group commit",
+    ("drive",),
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+_WAL_BYTES = obs.gauge(
+    "minio_tpu_metaplane_wal_bytes",
+    "Current WAL journal size (truncates at checkpoint)",
+    ("drive",))
+
+_seq_lock = threading.Lock()
+_seq = 0
+
+
+def _next_seq() -> int:
+    global _seq
+    with _seq_lock:
+        _seq += 1
+        return _seq
+
+
+class Entry:
+    """One pending (committed-but-not-materialized) journal state.
+    `raw is None` means the journal was deleted (tombstone)."""
+
+    __slots__ = ("lsn", "raw", "meta", "memo", "mt")
+
+    def __init__(self, lsn: int, raw, meta, mt: float):
+        self.lsn = lsn
+        self.raw = raw
+        self.meta = meta
+        self.memo: dict = {}
+        self.mt = mt
+
+    @property
+    def removed(self) -> bool:
+        return self.raw is None
+
+
+def replay(drive, wal_path: str) -> "tuple[int, int]":
+    """Fold + apply a WAL left by a previous process; returns
+    (applied, failed) record counts — the journal is truncated only
+    when failed == 0. Runs on EVERY mount (armed or not): a crashed
+    armed session's acked writes must converge regardless of the next
+    boot's gate. The `mt` tiebreak guards the armed→unarmed→armed
+    interleave: state written directly by an unarmed process is newer
+    than the stale WAL record and wins."""
+    from minio_tpu.storage.xlmeta import XLMeta
+
+    final = walfmt.fold(wal_path)
+    applied = 0
+    failed = 0
+    for (vol, path), rec in final.items():
+        stat_err = False
+        try:
+            disk_mt = drive._disk_meta_mt(vol, path)
+        except se.StorageError:
+            disk_mt = None  # unreadable/corrupt journal: the record wins
+            stat_err = True
+        if disk_mt is not None and disk_mt > rec.mt + 1e-9:
+            continue  # disk is newer (unarmed-session write)
+        if rec.rtype == walfmt.REC_COMMIT:
+            try:
+                meta = XLMeta.parse(rec.raw)  # scan hands out real bytes
+            # mtpu: allow(MTPU003) - a CRC-valid but unparseable record
+            # is unrecoverable by construction; skipping it (rather than
+            # wedging the mount) degrades to a missed write on ONE
+            # drive, which quorum + heal absorb.
+            except Exception:  # noqa: BLE001
+                continue
+            try:
+                drive._store_meta_disk(vol, path, rec.raw,
+                                       meta=meta, fsync=False)
+                applied += 1
+            except se.StorageError:
+                failed += 1
+                continue
+        else:  # REC_REMOVE
+            if disk_mt is None and not stat_err:
+                continue  # genuinely absent: nothing to remove
+            # A corrupt/unreadable journal under an acked REMOVE still
+            # gets removed (that IS the acked state); a transient stat
+            # failure falls through too — a failing _remove_meta_disk
+            # then counts as failed and keeps the WAL for the next
+            # mount instead of truncating the record away.
+            try:
+                drive._remove_meta_disk(vol, path)
+                applied += 1
+            except se.StorageError:
+                failed += 1
+                continue
+    if applied:
+        os.sync()  # one barrier instead of a per-file fsync storm
+    if failed == 0:
+        # Only a fully-applied journal may truncate: a record that
+        # could not be written back (full/failing disk at mount) is an
+        # ACKED state the WAL must keep carrying for the next mount.
+        walfmt.reset(wal_path)
+    return applied, failed
+
+
+class DriveWAL:
+    """Group-commit engine for one LocalDrive (see module docstring)."""
+
+    def __init__(self, drive):
+        self.drive = drive
+        self._dir = os.path.join(drive.root, drive.sys_volume(), "wal")
+        self.path = os.path.join(self._dir, "journal.wal")
+        os.makedirs(self._dir, exist_ok=True)
+        self._max_bytes = metaplane.wal_max_bytes()
+        self._max_pending = metaplane.wal_max_pending()
+        self._max_batch = metaplane.wal_max_batch()
+        # Test-only crash window: hold the committer this long before
+        # each batch fsync so a harness can land a real SIGKILL between
+        # append and fsync (tests/test_metaplane.py crash matrix).
+        self._test_hold_fsync = float(
+            os.environ.get("MTPU_WAL_TEST_HOLD_FSYNC_S", "0") or 0)
+        # Lazy mode: never materialize between checkpoints (reads serve
+        # from the pending overlay). The crash matrix uses it to pin the
+        # fsynced-but-not-materialized state; also a valid operating
+        # point for pure write bursts.
+        self._lazy = os.environ.get("MTPU_WAL_LAZY_MATERIALIZE", "") == "1"
+
+        replay_failed = 0
+        if os.path.exists(self.path):
+            _applied, replay_failed = replay(drive, self.path)
+        self._fd = os.open(self.path,
+                           os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+        if os.fstat(self._fd).st_size == 0:
+            os.write(self._fd, walfmt.MAGIC)
+            os.fsync(self._fd)
+        self._bytes = os.fstat(self._fd).st_size
+
+        self._q: queue.Queue = queue.Queue(maxsize=metaplane.wal_queue_depth())
+        self._mu = threading.Lock()  # pending overlay + key lsn map
+        self._pending: "OrderedDict[tuple[str, str], Entry]" = OrderedDict()
+        self._key_lsn: "OrderedDict[tuple[str, str], int]" = OrderedDict()
+        self._key_lsn_cap = 65536
+        self._lsn = 0
+        self._broken: str | None = None
+        self._closed = False
+        self._trash: list[str] = []
+        if replay_failed:
+            # Replay could not write some acked records back (full or
+            # flaky disk at mount) and kept the journal: seed the whole
+            # fold into the pending overlay — reads serve the acked
+            # state, drains retry materialization, and checkpoint stays
+            # blocked until every record lands.
+            for (vol, path), rec in walfmt.fold(self.path).items():
+                self._lsn += 1
+                self._pending[(vol, path)] = Entry(
+                    self._lsn,
+                    rec.raw if rec.rtype == walfmt.REC_COMMIT else None,
+                    None, rec.mt)
+                self._key_lsn[(vol, path)] = self._lsn
+
+        self._c_commits = _COMMITS.labels(drive=drive.root)
+        self._c_fsyncs = _FSYNCS.labels(drive=drive.root)
+        self._h_fill = _BATCH_FILL.labels(drive=drive.root)
+        self._g_bytes = _WAL_BYTES.labels(drive=drive.root)
+        self._g_bytes.set(self._bytes)
+
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"mtpu-metaplane-{_next_seq()}")
+        self._thread.start()
+
+    # ---------- submission (request threads) ----------
+
+    def _bump_lsn(self, key: tuple[str, str]) -> int:
+        with self._mu:
+            self._lsn += 1
+            self._key_lsn[key] = self._lsn
+            self._key_lsn.move_to_end(key)
+            while len(self._key_lsn) > self._key_lsn_cap:
+                self._key_lsn.popitem(last=False)
+            return self._lsn
+
+    def _submit(self, item) -> Future:
+        if self._broken is not None:
+            raise se.FaultyDisk(f"wal broken: {self._broken}")
+        if self._closed:
+            raise se.FaultyDisk("wal closed")
+        try:
+            self._q.put_nowait(item)
+        except queue.Full:
+            raise se.FaultyDisk("wal commit queue full (backpressure)") \
+                from None
+        return item[-1]
+
+    def submit_commit(self, volume: str, path: str, raw, meta) -> Future:
+        """Enqueue a full-journal store; resolves after the covering
+        WAL fsync. `raw` is the serialized journal (bytes/memoryview,
+        not copied); `meta` the parsed XLMeta (seeds the read overlay)."""
+        self.drive._note_journal_key(volume, path)
+        lsn = self._bump_lsn((volume, path))
+        mt = meta.latest_mt if meta is not None else time.time()
+        return self._submit(
+            ("commit", volume, path, raw, meta, mt, lsn, Future()))
+
+    def submit_remove(self, volume: str, path: str) -> Future:
+        """Enqueue a journal deletion (last version removed)."""
+        lsn = self._bump_lsn((volume, path))
+        return self._submit(
+            ("remove", volume, path, None, None, time.time(), lsn, Future()))
+
+    def submit_single(self, volume: str, path: str, fi, raw, meta,
+                      defer_reclaim: bool) -> Future:
+        """Enqueue a single-journal store (the inline-PUT commit) whose
+        PREWORK — vol stat, displaced-version stash, merge fallback —
+        runs in the committer, so this call is pure memory: request
+        threads never touch the drive on the submit side (no pool hop
+        needed for hang isolation; a hung drive surfaces as a future
+        the caller's deadline'd await stamps). The future resolves to
+        the reclaim token (or raises the per-drive error).
+
+        Same-key commits are serialized by the erasure layer's
+        namespace lock, so a batch never carries two singles for one
+        key whose prework could read around each other."""
+        # Evaluated BEFORE noting the key: proves to the committer that
+        # no journal predates this record, skipping its existence stat.
+        assume_new = self.drive.journal_known_absent(volume, path)
+        self.drive._note_journal_key(volume, path)
+        lsn = self._bump_lsn((volume, path))
+        mt = meta.latest_mt if meta is not None else time.time()
+        return self._submit(
+            ("single", volume, path, (fi, raw, defer_reclaim, assume_new),
+             meta, mt, lsn, Future()))
+
+    def flush(self, timeout: float = 60.0) -> None:
+        """Barrier: every record enqueued before this call is durable
+        AND materialized on return — listings/walks that read `meta.mp`
+        straight off the filesystem call this first. Cheap when idle."""
+        with self._mu:
+            idle = not self._pending
+        if idle and self._q.empty():
+            return
+        if self._broken is not None or self._closed:
+            self._drain_materialize(force=True)
+            return
+        fut: Future = Future()
+        try:
+            self._q.put(("flush", fut), timeout=timeout)
+        except queue.Full:
+            raise se.FaultyDisk("wal commit queue full (backpressure)") \
+                from None
+        fut.result(timeout=timeout)
+
+    def forget_subtree(self, volume: str, prefix: str) -> None:
+        """A recursive filesystem delete (session/tmp rmtree, volume
+        force-delete) removed journals out-of-band: drop pending overlay
+        entries AND per-key signature LSNs under the prefix (a stale
+        ("w", lsn) signature must not keep validating a set-cache entry
+        for a destroyed journal), and append one REMOVE_PREFIX tombstone
+        so replay drops every earlier WAL record there — including
+        records already materialized but not yet checkpointed.
+        Fire-and-forget — the rmtree itself carries the operation's
+        (pre-existing) durability semantics."""
+        def under(k):
+            return k[0] == volume and (not prefix or k[1] == prefix
+                                       or k[1].startswith(prefix + "/"))
+
+        with self._mu:
+            for k in [k for k in self._pending if under(k)]:
+                del self._pending[k]
+            for k in [k for k in self._key_lsn if under(k)]:
+                del self._key_lsn[k]
+        try:
+            self._submit(("remove_prefix", volume, prefix, None, None,
+                          time.time(), 0, Future()))
+        except se.StorageError:
+            return  # broken/full: a replay resurrection here is the
+            # dangling-object case deep heal already purges
+
+    def forget_key(self, volume: str, path: str) -> None:
+        """Exact-key variant of forget_subtree for a single journal
+        removed out-of-band (never touches nested keys like 'a/b/c'
+        when 'a/b' is forgotten)."""
+        with self._mu:
+            self._pending.pop((volume, path), None)
+        try:
+            self.submit_remove(volume, path)
+        except se.StorageError:
+            return  # as above: heal purges the dangling remnant
+
+    # ---------- read overlay (request threads) ----------
+
+    def pending_entry(self, volume: str, path: str) -> Entry | None:
+        """The committed-but-unmaterialized state for a key, or None
+        when disk is authoritative. `entry.removed` marks deletion."""
+        with self._mu:
+            return self._pending.get((volume, path))
+
+    def key_sig(self, volume: str, path: str):
+        """Logical journal signature while armed: every mutation bumps
+        the key's LSN at submit, so ("w", lsn) names the journal state
+        exactly (one owning process per drive by contract). None once
+        the key ages out of the LRU — callers fall back to stat."""
+        with self._mu:
+            lsn = self._key_lsn.get((volume, path))
+        return None if lsn is None else ("w", lsn)
+
+    # ---------- committer ----------
+
+    def _run(self) -> None:
+        while True:
+            try:
+                item = self._q.get(timeout=0.5)
+            except queue.Empty:
+                if self._closed:
+                    return
+                self._drain_materialize()
+                continue
+            batch = [item]
+            while len(batch) < self._max_batch:
+                try:
+                    batch.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            close_fut = None
+            flushes: list[Future] = []
+            recs: list[tuple] = []
+            for it in batch:
+                if it[0] == "flush":
+                    flushes.append(it[1])
+                elif it[0] == "close":
+                    close_fut = it[1]
+                else:
+                    recs.append(it)
+            if recs:
+                self._commit_batch(recs)
+            with self._mu:
+                backlog = len(self._pending)
+            # Materialize on IDLE (the queue-empty timeout tick above),
+            # on barriers, and on backlog pressure — never eagerly after
+            # every batch: per-key journal files cost ~5 filesystem
+            # round-trips each, and paying them inside a burst would put
+            # the deferred work right back on the commit path's medium.
+            # A burst therefore rides the WAL at writev+fsync cost and
+            # the backlog drains in the gaps (bounded by max_pending).
+            if flushes or close_fut is not None \
+                    or backlog > self._max_pending:
+                self._drain_materialize(force=True)
+            for f in flushes:
+                f.set_result(None)
+            if self._bytes > self._max_bytes and self._broken is None:
+                self._checkpoint()
+            if close_fut is not None:
+                self._checkpoint()
+                close_fut.set_result(None)
+                return
+
+    def _commit_batch(self, recs: list[tuple]) -> None:
+        # Resolve "single" records' prework (vol stat, displaced-state
+        # stash, merge fallback) HERE in the committer — the submit side
+        # stayed pure memory. A prework failure fails only that record's
+        # future; the rest of the batch commits.
+        staged: list[tuple] = []  # (rtype, vol, path, raw, meta, mt,
+        #                            lsn, fut, token)
+        for kind, vol, path, payload, meta, mt, lsn, fut in recs:
+            if kind == "single":
+                fi, raw, defer_reclaim, assume_new = payload
+                try:
+                    self.drive._stat_vol_cached(vol)
+                    token, merged = self.drive._single_prework(
+                        vol, path, fi, defer_reclaim,
+                        assume_new=assume_new, defer_fs=True)
+                except Exception as e:  # noqa: BLE001 - per-record: the
+                    # error travels to exactly the caller whose commit
+                    # it is (quorum counts the drive as failed)
+                    fut.set_exception(e if isinstance(e, se.StorageError)
+                                      else se.FaultyDisk(str(e)))
+                    continue
+                if merged is not None:
+                    meta = merged
+                    raw = merged.serialize()
+                    mt = merged.latest_mt
+                staged.append((walfmt.REC_COMMIT, vol, path, raw, meta,
+                               mt, lsn, fut, token))
+            elif kind == "commit":
+                staged.append((walfmt.REC_COMMIT, vol, path, payload,
+                               meta, mt, lsn, fut, None))
+            elif kind == "remove_prefix":
+                staged.append((walfmt.REC_REMOVE_PREFIX, vol, path, b"",
+                               None, mt, lsn, fut, None))
+            else:
+                staged.append((walfmt.REC_REMOVE, vol, path, b"", None,
+                               mt, lsn, fut, None))
+        if not staged:
+            return
+        frames = [walfmt.frame_record(rtype, mt, vol, path, raw)
+                  for rtype, vol, path, raw, _m, mt, _l, _f, _t in staged]
+        try:
+            n = walfmt.append_records(self._fd, frames)
+            if self._test_hold_fsync:
+                time.sleep(self._test_hold_fsync)
+            os.fsync(self._fd)
+        except OSError as e:
+            self._broken = str(e)
+            err = se.FaultyDisk(f"wal append/fsync failed: {e}")
+            for rec in staged:
+                rec[7].set_exception(err)
+            return
+        self._bytes += n
+        self._g_bytes.set(self._bytes)
+        self._c_fsyncs.inc()
+        self._c_commits.inc(len(staged))
+        self._h_fill.observe(len(staged))
+        # Publish the overlay BEFORE resolving futures: the instant the
+        # ack fires, a read must see the new state. Entries carry LSNs
+        # so a newer published state is never downgraded.
+        with self._mu:
+            for rtype, vol, path, raw, meta, mt, lsn, _fut, _tok in staged:
+                if rtype == walfmt.REC_REMOVE_PREFIX:
+                    # Drop anything that slipped into the overlay for
+                    # the destroyed subtree between forget and commit.
+                    pre = path
+                    for k in [k for k in self._pending
+                              if k[0] == vol
+                              and (not pre or k[1] == pre
+                                   or k[1].startswith(pre + "/"))]:
+                        del self._pending[k]
+                    continue
+                key = (vol, path)
+                cur = self._pending.get(key)
+                if cur is not None and cur.lsn > lsn:
+                    continue
+                self._pending[key] = Entry(
+                    lsn, raw if rtype == walfmt.REC_COMMIT else None,
+                    meta, mt)
+                self._pending.move_to_end(key)
+        for rec in staged:
+            rec[7].set_result(rec[8])
+
+    def note_trash(self, path: str) -> None:
+        """A displaced data dir parked by an O(1) rename during commit
+        prework; the tree is destroyed at the next idle drain instead
+        of head-of-line blocking the committer's batch (a multi-GiB
+        rmtree inside the commit cycle would stall every concurrent
+        group commit on this drive past the meta deadline)."""
+        self._trash.append(path)
+
+    def _drain_trash(self) -> None:
+        while self._trash:
+            shutil.rmtree(self._trash.pop(), ignore_errors=True)
+
+    def _drain_materialize(self, force: bool = False) -> None:
+        """Write every currently-pending journal to its meta.mp (no
+        per-file fsync — the WAL is durability until checkpoint). One
+        pass over a snapshot: entries that fail stay pending (still
+        served from memory, still in the WAL) and pin the checkpoint;
+        entries superseded mid-write keep their newer overlay."""
+        self._drain_trash()
+        if self._lazy and not (force or self._closed):
+            return
+        with self._mu:
+            snapshot = list(self._pending.items())
+        for key, entry in snapshot:
+            vol, path = key
+            try:
+                if entry.removed:
+                    self.drive._remove_meta_disk(vol, path)
+                else:
+                    self.drive._store_meta_disk(
+                        vol, path, entry.raw, meta=entry.meta, fsync=False)
+            except se.StorageError:
+                continue  # stays pending; checkpoint refuses to truncate
+            with self._mu:
+                if self._pending.get(key) is entry:
+                    del self._pending[key]
+
+    def _checkpoint(self) -> None:
+        """Materialize everything, one sync barrier, truncate the WAL."""
+        self._drain_materialize(force=True)
+        with self._mu:
+            if self._pending:
+                return  # a stuck materialization pins the WAL
+        try:
+            os.sync()
+            os.ftruncate(self._fd, 0)
+            os.write(self._fd, walfmt.MAGIC)
+            os.fsync(self._fd)
+        except OSError as e:
+            self._broken = str(e)
+            return
+        self._bytes = len(walfmt.MAGIC)
+        self._g_bytes.set(self._bytes)
+
+    # ---------- lifecycle ----------
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Drain, checkpoint, stop the committer (tests; process-lived
+        drives just die with their daemon)."""
+        if self._closed:
+            return
+        try:
+            fut: Future = Future()
+            self._q.put(("close", fut), timeout=timeout)
+            self._closed = True
+            fut.result(timeout=timeout)
+        # mtpu: allow(MTPU003) - teardown: a broken WAL already failed
+        # its waiters with typed errors; close only needs the committer
+        # thread stopped.
+        except Exception:  # noqa: BLE001
+            self._closed = True
+        self._thread.join(timeout=timeout)
+        try:
+            os.close(self._fd)
+        except OSError:
+            return

@@ -1,0 +1,169 @@
+"""SetFileInfoCache — post-election FileInfo cache for one erasure set.
+
+A hot GET/HEAD pays N per-drive `read_version` calls plus a quorum
+election per request even when nothing changed. This cache stores the
+ELECTED FileInfo (inline payload included) keyed by (bucket, object,
+version_id) and revalidates it with one cheap per-LOCAL-drive journal
+signature check instead of the fan-out:
+
+- while the metaplane WAL is armed, a drive's signature is its
+  ("w", lsn) — a dict lookup; every journal mutation on that drive
+  bumps it at submit time;
+- otherwise it is the journal's (inode, mtime_ns, size) stat triple —
+  the same racy-stat-hardened signature the per-drive journal cache
+  uses (storage/local.py).
+
+Coherence with writers in OTHER processes (the distributed case: every
+node serves the same set) rides the same signatures: a remote node's
+commit reaches this node's local drives through the storage RPC, moves
+their signatures, and the next lookup misses into a fresh election. An
+entry is only stored when at least one local-drive signature could be
+captured; a write that reached quorum while missing EVERY local drive
+is the one stale window (bounded by heal, which rewrites the local
+copies and moves the signatures). In-process mutating paths
+additionally invalidate eagerly (delete, multipart complete, heal,
+tags/metadata writes) so the common case never waits on a signature
+mismatch.
+
+Entries hand out clones both ways (callers mutate FileInfo freely).
+Delete markers and error results are never cached — negative caching
+would turn an in-flight PUT into a spurious 404.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from minio_tpu import obs
+
+_HITS = obs.counter(
+    "minio_tpu_metaplane_cache_hits_total",
+    "Set-level FileInfo cache hits (quorum fan-out + election skipped)"
+).labels()
+_MISSES = obs.counter(
+    "minio_tpu_metaplane_cache_misses_total",
+    "Set-level FileInfo cache misses (absent or signature-invalidated)"
+).labels()
+_INVALIDATIONS = obs.counter(
+    "minio_tpu_metaplane_cache_invalidations_total",
+    "Set-level FileInfo cache entries dropped by mutating paths"
+).labels()
+
+
+def _local_base(drive):
+    """The underlying LocalDrive for signature checks, or None. Peels
+    only the health/disk-id decorators (healthcheck.unwrap): remote
+    clients and fault injectors are not signature sources."""
+    from minio_tpu.storage import healthcheck as _health
+    from minio_tpu.storage.local import LocalDrive
+
+    base = _health.unwrap(drive)
+    return base if isinstance(base, LocalDrive) else None
+
+
+class SetFileInfoCache:
+    def __init__(self, cap: int = 4096):
+        self._cap = max(16, cap)
+        self._mu = threading.Lock()
+        # (bucket, obj) -> {version_id: (FileInfo, [(LocalDrive, sig)])}
+        self._objects: "OrderedDict[tuple[str, str], dict]" = OrderedDict()
+
+    # ---------- read path ----------
+
+    def lookup(self, bucket: str, obj: str, version_id: str = ""):
+        """The cached elected FileInfo (a private clone) when every
+        recorded local-drive signature still matches; else None."""
+        key = (bucket, obj)
+        with self._mu:
+            vids = self._objects.get(key)
+            rec = vids.get(version_id) if vids else None
+            if rec is not None:
+                self._objects.move_to_end(key)
+        if rec is None:
+            _MISSES.inc()
+            return None
+        fi, sigs = rec
+        # Signature checks run outside the lock: stat-backed sigs touch
+        # the filesystem.
+        for drive, sig in sigs:
+            if drive.meta_sig(bucket, obj) != sig:
+                with self._mu:
+                    vids = self._objects.get(key)
+                    if vids is not None and vids.get(version_id) is rec:
+                        del vids[version_id]
+                        if not vids:
+                            self._objects.pop(key, None)
+                _MISSES.inc()
+                return None
+        _HITS.inc()
+        return fi.clone()
+
+    # ---------- write-through ----------
+
+    def snapshot_sigs(self, bucket: str, obj: str, drives) -> list:
+        """Per-local-drive signatures captured BEFORE a quorum election
+        (pass to populate): if a mutation interleaves with the fan-out
+        read, these pre-read signatures no longer match the drives at
+        the next lookup, so the stale election can never be served. A
+        populate with post-read signatures would validate a pre-read
+        FileInfo against post-mutation state — caching exactly the
+        write the reader raced."""
+        sigs = []
+        for d in drives:
+            base = _local_base(d)
+            if base is None:
+                continue
+            sigs.append((base, base.meta_sig(bucket, obj)))
+        return sigs
+
+    def populate(self, bucket: str, obj: str, version_id: str, fi,
+                 drives, sigs: "list | None" = None) -> None:
+        """Store an elected (or just-committed) FileInfo. `sigs` must be
+        a pre-read snapshot_sigs() capture for election results; None
+        (capture now) is only safe when the caller holds the object's
+        namespace lock around both the commit and this call (the
+        write-through path). No-op unless at least one local-drive
+        signature is known — a node with no local member of this set
+        cannot validate and must re-elect."""
+        if fi is None or getattr(fi, "deleted", False):
+            return
+        if sigs is None:
+            sigs = self.snapshot_sigs(bucket, obj, drives)
+        if not sigs or any(sig is None for _b, sig in sigs):
+            return  # journal not (yet) visible on a local drive: unsafe
+        rec = (fi.clone(), sigs)
+        key = (bucket, obj)
+        with self._mu:
+            vids = self._objects.get(key)
+            if vids is None:
+                vids = {}
+                self._objects[key] = vids
+            # Bound the per-object version dict too: the object-level
+            # LRU never evicts a HOT object, so distinct-version reads
+            # against one key would otherwise accumulate entries (and
+            # inline payloads) without limit.
+            if version_id not in vids:
+                while len(vids) >= 8:
+                    vids.pop(next(iter(vids)))
+            vids[version_id] = rec
+            self._objects.move_to_end(key)
+            while len(self._objects) > self._cap:
+                self._objects.popitem(last=False)
+
+    # ---------- invalidation ----------
+
+    def invalidate(self, bucket: str, obj: str) -> None:
+        """Drop every cached version of an object (mutating paths)."""
+        with self._mu:
+            had = self._objects.pop((bucket, obj), None)
+        if had:
+            _INVALIDATIONS.inc()
+
+    def clear(self) -> None:
+        with self._mu:
+            self._objects.clear()
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._objects)

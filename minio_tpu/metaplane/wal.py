@@ -1,0 +1,187 @@
+"""Per-drive WAL journal format + replay fold (docs/METAPLANE.md).
+
+One append-only file per drive at `<root>/.mtpu.sys/wal/journal.wal`:
+
+    MAGIC "MTPUWAL1"
+    record*   [u32 payload_len][u32 crc32(payload)][payload]
+    payload = [u8 type][f64 mt][u16 vol_len][u16 path_len][u32 raw_len]
+              [vol utf-8][path utf-8][raw journal bytes]
+
+Types: COMMIT (full serialized journal for the key — the whole
+`meta.mp` those bytes would become) and REMOVE (journal deletion; `mt`
+is the wall clock at append, used only as a replay tiebreak against
+state written by an unarmed process). Because *every* journal mutation
+on an armed drive rides the WAL, the last record per key in file order
+is the key's authoritative post-crash state.
+
+Durability contract: a record counts only once the WAL fsync covering
+it returns — `scan()` stops at the first short/corrupt frame, so a torn
+tail (SIGKILL between append and fsync) cleanly truncates to the last
+durable record; the write it carried was never acknowledged and is
+legally lost.
+
+Append is zero-copy: headers are packed once, CRC folds over the parts
+sequentially (zlib.crc32 chaining), and the frame reaches the kernel as
+an `os.writev` gather list — payload bytes are never joined or sliced
+into fresh buffers on the hot path. The scan side is cold (mount-time
+replay) and trades copies for simplicity.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Iterator, NamedTuple
+
+MAGIC = b"MTPUWAL1"
+REC_COMMIT = 1
+REC_REMOVE = 2
+# Prefix tombstone: an out-of-band recursive delete (session/tmp
+# rmtree, volume force-delete) destroyed every journal under
+# (volume, path-prefix); replay must drop all EARLIER records there.
+REC_REMOVE_PREFIX = 3
+
+_FRAME = struct.Struct("<II")       # payload_len, crc32
+_HEAD = struct.Struct("<BdHHI")     # type, mt, vol_len, path_len, raw_len
+
+# writev gather-list bound: 4 buffers per record, stay far under IOV_MAX.
+_IOV_RECORDS = 128
+
+
+class Record(NamedTuple):
+    rtype: int
+    mt: float
+    volume: str
+    path: str
+    raw: bytes
+
+
+def frame_record(rtype: int, mt: float, volume: str, path: str,
+                 raw) -> list:
+    """The writev gather list for one record: [frame+head, vol, path,
+    raw]. `raw` may be bytes or a memoryview — it is never copied."""
+    vb = volume.encode("utf-8")
+    pb = path.encode("utf-8")
+    head = _HEAD.pack(rtype, mt, len(vb), len(pb), len(raw))
+    crc = zlib.crc32(head)
+    crc = zlib.crc32(vb, crc)
+    crc = zlib.crc32(pb, crc)
+    crc = zlib.crc32(raw, crc)
+    payload_len = len(head) + len(vb) + len(pb) + len(raw)
+    return [_FRAME.pack(payload_len, crc) + head, vb, pb, raw]
+
+
+def append_records(fd: int, recs: list[list]) -> int:
+    """writev the framed records (already gather lists from
+    frame_record) to an O_APPEND fd; returns bytes written. Chunked so
+    one giant batch can't exceed IOV_MAX."""
+    total = 0
+    flat: list = []
+    for gather in recs:
+        flat.extend(gather)
+        if len(flat) >= _IOV_RECORDS * 4:
+            total += _writev_all(fd, flat)
+            flat = []
+    if flat:
+        total += _writev_all(fd, flat)
+    return total
+
+
+def _writev_all(fd: int, bufs: list) -> int:
+    want = sum(len(b) for b in bufs)
+    done = os.writev(fd, bufs)
+    while done < want:
+        # Short writev (interrupt / pipe-ish fs): resume at the byte
+        # offset without re-slicing whole buffers we already wrote.
+        skip = done
+        rest = []
+        for b in bufs:
+            if skip >= len(b):
+                skip -= len(b)
+                continue
+            rest.append(memoryview(b)[skip:] if skip else b)
+            skip = 0
+        bufs = rest
+        n = os.writev(fd, bufs)
+        if n <= 0:
+            raise OSError("wal writev stalled")
+        done += n
+    return want
+
+
+def scan(path: str) -> Iterator[Record]:
+    """Yield durable records in file order, stopping cleanly at the
+    first torn or corrupt frame (everything after a torn tail was never
+    fsync-acknowledged). A file without the magic yields nothing."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return
+    if not data.startswith(MAGIC):
+        return
+    off = len(MAGIC)
+    n = len(data)
+    while off + _FRAME.size <= n:
+        payload_len, crc = _FRAME.unpack_from(data, off)
+        start = off + _FRAME.size
+        end = start + payload_len
+        if payload_len < _HEAD.size or end > n:
+            return  # torn tail
+        if zlib.crc32(data[start:end]) != crc:
+            return  # corrupt frame: stop at last durable record
+        rtype, mt, vl, pl, rl = _HEAD.unpack_from(data, start)
+        so = start + _HEAD.size
+        if so + vl + pl + rl != end:
+            return
+        vol = data[so:so + vl].decode("utf-8", "replace")
+        key = data[so + vl:so + vl + pl].decode("utf-8", "replace")
+        raw = data[so + vl + pl:end]
+        yield Record(rtype, mt, vol, key, raw)
+        off = end
+
+
+def fold(path: str) -> dict[tuple[str, str], Record]:
+    """Last-record-per-key fold of a WAL file — the replay work list.
+    File order IS commit order (single committer, O_APPEND). A
+    REMOVE_PREFIX record drops every earlier record under its prefix
+    (the journals were rmtree'd out-of-band; replay must not
+    resurrect them)."""
+    out: dict[tuple[str, str], Record] = {}
+    for rec in scan(path):
+        if rec.rtype == REC_REMOVE_PREFIX:
+            pre = rec.path
+            doomed = [k for k in out
+                      if k[0] == rec.volume
+                      and (not pre or k[1] == pre
+                           or k[1].startswith(pre + "/"))]
+            for k in doomed:
+                del out[k]
+            continue
+        out[(rec.volume, rec.path)] = rec
+    return out
+
+
+def reset(path: str) -> None:
+    """(Re)write an empty journal: magic only, durably. Called at
+    checkpoint after every folded record is materialized + synced, and
+    at mount after replay."""
+    tmp = path + ".tmp"
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    try:
+        os.write(fd, MAGIC)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+    try:
+        dfd = os.open(os.path.dirname(path), os.O_RDONLY)
+    except OSError:
+        return  # best-effort: the rename above already landed
+    try:
+        os.fsync(dfd)
+    except OSError:
+        return
+    finally:
+        os.close(dfd)

@@ -80,6 +80,7 @@ OP_CLASS = {
     "read_all": "meta", "write_all": "meta", "delete": "meta",
     "rename_file": "meta",
     "write_metadata": "meta", "write_metadata_single": "meta",
+    "journal_commit_async": "meta",
     "read_version": "meta", "read_xl": "meta", "delete_version": "meta",
     "rename_data": "meta", "commit_rename": "meta", "undo_rename": "meta",
     "create_file": "data", "append_file": "data",
@@ -471,6 +472,25 @@ class HealthChecker:
         if name == "create_file":
             return lambda volume, path, chunks: self._guard_stream_sink(
                 fn, volume, path, chunks)
+        if name == "journal_commit_async":
+            # Two-phase group commit: the op guard must span until the
+            # WAL fsync resolves the future — a hung fsync walks the
+            # drive FAULTY→OFFLINE exactly like a hung sync store.
+            def guarded_async(*a, **kw):
+                tok, op = self._begin(cls)
+                try:
+                    fut = fn(*a, **kw)
+                except Exception as e:
+                    self._end(tok, op, e)
+                    raise
+                if fut is None:  # WAL not armed: no deferred completion
+                    self._end(tok, op, None)
+                    return None
+                fut.add_done_callback(
+                    lambda f: self._end(tok, op, f.exception()))
+                return fut
+
+            return guarded_async
 
         def guarded(*a, **kw):
             tok, op = self._begin(cls)

@@ -27,7 +27,8 @@ _GUARDED = {
     "list_dir", "walk_dir", "read_all", "write_all", "delete",
     "create_file", "append_file", "read_file_stream",
     "read_file_range_stream", "rename_file",
-    "write_metadata", "write_metadata_single", "read_version", "read_xl",
+    "write_metadata", "write_metadata_single", "journal_commit_async",
+    "read_version", "read_xl",
     "delete_version",
     "rename_data", "commit_rename", "undo_rename",
     "verify_file", "check_parts",

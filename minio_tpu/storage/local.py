@@ -44,16 +44,38 @@ META_FILE = "meta.mp"
 FORMAT_FILE = "format.json"
 FORMAT_VERSION = 1
 
+_DIR_FSYNC_ERRORS = obs.counter(
+    "minio_tpu_dir_fsync_errors_total",
+    "Directory fsyncs that failed at a commit point (open or fsync "
+    "error) — a pulled drive otherwise looks durably committed",
+    ("drive",))
 
-def _fsync_dir(path: str) -> None:
+
+def _fsync_dir(path: str, drive: str = "") -> None:
+    """Best-effort directory fsync at commit points. Failure stays
+    best-effort (rename durability degrades to the filesystem's
+    ordering), but it is COUNTED and traced — a drive yanked mid-commit
+    must not be invisible."""
     try:
         fd = os.open(path, os.O_RDONLY)
-    except OSError:
+    except OSError as e:
+        _note_dir_fsync_error(drive or path, path, e)
         return
     try:
         os.fsync(fd)
+    except OSError as e:
+        _note_dir_fsync_error(drive or path, path, e)
     finally:
         os.close(fd)
+
+
+def _note_dir_fsync_error(drive: str, path: str, err: OSError) -> None:
+    _DIR_FSYNC_ERRORS.labels(drive=drive).inc()
+    if obs.has_subscribers():
+        obs.publish({"type": "storage", "time": time.time(),
+                     "drive": drive, "op": "dir_fsync", "vol": "",
+                     "path": path,
+                     "error": f"{type(err).__name__}: {err}"})
 
 
 class LocalDrive(StorageAPI):
@@ -73,6 +95,18 @@ class LocalDrive(StorageAPI):
         self._meta_cache_cap = 16384
         self._mpath_cache: dict[tuple[str, str], str] = {}
         self._meta_cache_lock = threading.Lock()
+        # Positive volume-existence TTL cache (WAL committer prework).
+        self._vol_ok: dict[str, float] = {}
+        # Fresh-volume key tracking: a volume THIS process created via
+        # make_vol started empty, and every journal under it is created
+        # through this drive (one owning process per drive by contract),
+        # so `key not in set` PROVES no journal exists — the group-commit
+        # prework skips the existence stat for new keys. The set is a
+        # safe superset ("may exist"); None = tracking lost (cap hit),
+        # absent vol = pre-existing volume. Ops: set add/contains are
+        # GIL-atomic.
+        self._fresh_vols: dict[str, "set | None"] = {}
+        self._fresh_vol_cap = 1 << 17
         # EWMA of journal-store duration (write+fsync+rename): lets the
         # object layer choose serial fan-out for metadata writes on media
         # where the store is cheaper than a thread-pool dispatch (tmpfs,
@@ -88,6 +122,25 @@ class LocalDrive(StorageAPI):
             os.makedirs(os.path.join(self.root, SYS_VOL, "tmp"), exist_ok=True)
         except OSError as e:
             raise se.DiskAccessDenied(str(e)) from e
+        # Group-commit metadata plane (docs/METAPLANE.md): armed, every
+        # journal store rides the per-drive WAL and one shared fsync.
+        # Replay-on-mount runs even UNARMED when a previous (armed,
+        # crashed) process left a journal — acked writes must converge
+        # regardless of the next boot's gate.
+        from minio_tpu import metaplane
+
+        self._wal = None
+        if metaplane.enabled():
+            from minio_tpu.metaplane.groupcommit import DriveWAL
+
+            self._wal = DriveWAL(self)  # replays any leftover journal
+        else:
+            wal_path = os.path.join(self.root, SYS_VOL, "wal",
+                                    "journal.wal")
+            if os.path.exists(wal_path):
+                from minio_tpu.metaplane import groupcommit
+
+                groupcommit.replay(self, wal_path)
 
     # ---------- identity ----------
 
@@ -124,7 +177,7 @@ class LocalDrive(StorageAPI):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._format_path())
-        _fsync_dir(os.path.dirname(self._format_path()))
+        _fsync_dir(os.path.dirname(self._format_path()), self.root)
 
     def disk_info(self) -> DiskInfo:
         st = os.statvfs(self.root)
@@ -176,11 +229,13 @@ class LocalDrive(StorageAPI):
 
     def make_vol(self, volume: str) -> None:
         d = self._vol_dir(volume)
+        self._vol_ok.pop(volume, None)
         try:
             # mkdir, NOT makedirs: a missing drive root means the drive
             # is unmounted — creating it would put the volume (and every
             # shard after it) on the parent filesystem.
             os.mkdir(d)
+            self._fresh_vols[volume] = set()
         except FileExistsError:
             raise se.VolumeExists(volume) from None
         except FileNotFoundError:
@@ -212,8 +267,45 @@ class LocalDrive(StorageAPI):
             raise se.VolumeNotFound(volume) from None
         return VolInfo(volume, st.st_ctime)
 
+    def _note_journal_key(self, volume: str, path: str) -> None:
+        """Record that a journal may now exist at (volume, path) —
+        called by every journal-creating path (WAL submit, disk store).
+        Past the cap, tracking for the volume is dropped (None), never
+        wrong."""
+        s = self._fresh_vols.get(volume)
+        if s is None:
+            return
+        if len(s) >= self._fresh_vol_cap:
+            self._fresh_vols[volume] = None
+            return
+        s.add(path)
+
+    def journal_known_absent(self, volume: str, path: str) -> bool:
+        """True only when this process PROVABLY never created a journal
+        at (volume, path) on a volume it created empty — lets the
+        group-commit prework skip the existence stat for new keys."""
+        s = self._fresh_vols.get(volume)
+        return s is not None and path not in s
+
+    def _stat_vol_cached(self, volume: str) -> None:
+        """Volume-existence check with a short positive TTL — the WAL
+        committer's per-record guard. The erasure layer already fronts
+        PUTs with its own 2s bucket cache, so the cross-process
+        bucket-delete window this opens is one the request path
+        accepts today; in-process delete_vol/make_vol invalidate."""
+        now = time.monotonic()
+        exp = self._vol_ok.get(volume)
+        if exp is not None and exp > now:
+            return
+        self.stat_vol(volume)
+        self._vol_ok[volume] = now + 2.0
+
     def delete_vol(self, volume: str, force: bool = False) -> None:
         d = self._vol_dir(volume)
+        self._vol_ok.pop(volume, None)
+        self._fresh_vols.pop(volume, None)
+        if self._wal is not None and force:
+            self._wal.forget_subtree(volume, "")
         try:
             if force:
                 shutil.rmtree(d)
@@ -256,6 +348,17 @@ class LocalDrive(StorageAPI):
 
     def delete(self, volume: str, path: str, recursive: bool = False) -> None:
         fp = self._file_path(volume, path)
+        if self._wal is not None:
+            # The tree (or journal) vanishes out-of-band: drop any WAL
+            # overlay underneath it and log REMOVEs so replay cannot
+            # resurrect journals this rmtree destroys.
+            if recursive:
+                self._wal.forget_subtree(volume, path)
+            elif os.path.basename(fp) == META_FILE:
+                # Exact key only: forgetting the subtree would tombstone
+                # NESTED keys ('a/b/c' under 'a/b') this delete never
+                # touches.
+                self._wal.forget_key(volume, os.path.dirname(path))
         try:
             if recursive:
                 shutil.rmtree(fp)
@@ -281,6 +384,8 @@ class LocalDrive(StorageAPI):
             d = os.path.dirname(d)
 
     def list_dir(self, volume: str, dir_path: str, count: int = -1) -> list[str]:
+        if self._wal is not None:
+            self._wal.flush()  # directory must reflect every acked commit
         d = self._file_path(volume, dir_path) if dir_path else self._vol_dir(volume)
         try:
             names = []
@@ -355,7 +460,7 @@ class LocalDrive(StorageAPI):
             raise se.FileNotFound(f"{src_volume}/{src_path}") from None
         except OSError as e:
             raise se.FaultyDisk(str(e)) from e
-        _fsync_dir(os.path.dirname(dst))
+        _fsync_dir(os.path.dirname(dst), self.root)
 
     # ---------- versioned metadata ----------
 
@@ -372,6 +477,15 @@ class LocalDrive(StorageAPI):
         return mp
 
     def _load_meta(self, volume: str, path: str) -> XLMeta:
+        if self._wal is not None:
+            pe = self._wal.pending_entry(volume, path)
+            if pe is not None:
+                if pe.removed:
+                    raise se.FileNotFound(f"{volume}/{path}")
+                # Fresh parse: _load_meta callers MUTATE the journal
+                # (add_version/delete_version); the overlay's parsed
+                # copy must stay pristine for readers.
+                return XLMeta.parse(pe.raw)
         try:
             with open(self._meta_path(volume, path), "rb") as f:
                 return XLMeta.parse(f.read())
@@ -381,6 +495,24 @@ class LocalDrive(StorageAPI):
             raise se.FileNotFound(f"{volume}/{path}") from None
         except OSError as e:
             raise se.FaultyDisk(str(e)) from e
+
+    def _disk_meta_mt(self, volume: str, path: str) -> "float | None":
+        """mod_time of the newest version in the ON-DISK journal, None
+        when absent — the WAL replay tiebreak (never overlay-aware)."""
+        try:
+            with open(self._meta_path(volume, path), "rb") as f:
+                raw = f.read()
+        except (FileNotFoundError, NotADirectoryError):
+            return None
+        except OSError as e:
+            raise se.FaultyDisk(str(e)) from e
+        try:
+            return XLMeta.parse(raw).latest_mt
+        except se.StorageError:
+            raise
+        except Exception as e:  # noqa: BLE001 - any parse failure means
+            # the on-disk journal is unusable; typed for the caller
+            raise se.FileCorrupt(f"{volume}/{path}: {e}") from e
 
     def _note_sync(self, dt: float) -> None:
         e = self._sync_ewma
@@ -415,6 +547,19 @@ class LocalDrive(StorageAPI):
         """Stat-validated cache entry (XLMeta, fi_memo) for a journal.
         fi_memo maps version_id -> decoded FileInfo (read_version hands out
         clones, never the memoized object)."""
+        if self._wal is not None:
+            pe = self._wal.pending_entry(volume, path)
+            if pe is not None:
+                # Committed-but-unmaterialized state: the WAL overlay IS
+                # the journal (read-your-write the instant the group
+                # fsync acks).
+                if pe.removed:
+                    raise se.FileNotFound(f"{volume}/{path}")
+                meta = pe.meta
+                if meta is None:
+                    meta = XLMeta.parse(pe.raw)
+                    pe.meta = meta
+                return meta, pe.memo
         mp = self._meta_path(volume, path)
         try:
             st = os.stat(mp)
@@ -437,15 +582,37 @@ class LocalDrive(StorageAPI):
         return meta, {}
 
     def _store_meta(self, volume: str, path: str, meta: XLMeta) -> None:
+        raw = meta.serialize()
+        if self._wal is not None:
+            # Group commit: durability is the shared WAL fsync; the
+            # meta.mp materializes asynchronously (reads consult the
+            # overlay meanwhile).
+            t0 = time.perf_counter()
+            self._wal_wait(self._wal.submit_commit(volume, path, raw, meta))
+            self._note_sync(time.perf_counter() - t0)
+            return
+        t0 = time.perf_counter()
+        self._store_meta_disk(volume, path, raw, meta=meta, fsync=True)
+        self._note_sync(time.perf_counter() - t0)
+
+    def _store_meta_disk(self, volume: str, path: str, raw,
+                         meta: "XLMeta | None" = None,
+                         fsync: bool = True) -> None:
+        """Write serialized journal bytes to meta.mp (tmp + optional
+        fsync + rename). The WAL materializer calls this with
+        fsync=False — the WAL carries durability until checkpoint."""
         mp = self._meta_path(volume, path)
+        self._note_journal_key(volume, path)
         os.makedirs(os.path.dirname(mp), exist_ok=True)
         tmp = mp + f".tmp.{uuid.uuid4().hex}"
-        t0 = time.perf_counter()
         try:
-            with open(tmp, "wb") as f:
-                f.write(meta.serialize())
-                f.flush()
-                os.fsync(f.fileno())
+            fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+            try:
+                os.write(fd, raw)
+                if fsync:
+                    os.fsync(fd)
+            finally:
+                os.close(fd)
             # Sign BEFORE the rename: rename preserves the inode, so this
             # signature names exactly the bytes we wrote — if a concurrent
             # writer replaces the journal right after us, their file has a
@@ -455,11 +622,47 @@ class LocalDrive(StorageAPI):
             os.replace(tmp, mp)
         except OSError as e:
             raise se.FaultyDisk(str(e)) from e
-        self._note_sync(time.perf_counter() - t0)
-        # The writer never mutates `meta` after the store, so seed the read
-        # cache with it (saves the next reader's parse).
-        self._cache_put(volume, path,
-                        (st.st_ino, st.st_mtime_ns, st.st_size), meta)
+        if meta is not None:
+            # The writer never mutates `meta` after the store, so seed the
+            # read cache with it (saves the next reader's parse).
+            self._cache_put(volume, path,
+                            (st.st_ino, st.st_mtime_ns, st.st_size), meta)
+        else:
+            with self._meta_cache_lock:
+                self._meta_cache.pop((volume, path), None)
+
+    def _remove_meta_disk(self, volume: str, path: str) -> None:
+        """Remove a journal + prune empty parents (the materialized form
+        of a WAL REMOVE record; also the direct delete_version tail)."""
+        mp = self._meta_path(volume, path)
+        try:
+            os.remove(mp)
+        except FileNotFoundError:
+            pass
+        except OSError as e:
+            raise se.FaultyDisk(str(e)) from e
+        with self._meta_cache_lock:
+            self._meta_cache.pop((volume, path), None)
+        obj_dir = os.path.dirname(mp)
+        try:
+            os.rmdir(obj_dir)
+        except OSError:
+            return  # non-empty (data dirs remain) or already gone
+        self._prune_empty_parents(os.path.dirname(obj_dir), volume)
+
+    @staticmethod
+    def _wal_wait(fut):
+        """Block on a group-commit future (returns its value — the
+        reclaim token for singles); unreached commits become FaultyDisk
+        (quorum counts the drive as failed)."""
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        try:
+            return fut.result(timeout=60.0)
+        except se.StorageError:
+            raise
+        except _FutTimeout:
+            raise se.FaultyDisk("wal group commit stalled") from None
 
     def write_metadata_single(self, volume: str, path: str, fi: FileInfo,
                               raw: bytes, meta=None,
@@ -478,11 +681,42 @@ class LocalDrive(StorageAPI):
                 volume, path, fi, raw, meta=meta,
                 defer_reclaim=defer_reclaim)
 
-    def _write_metadata_single(self, volume: str, path: str, fi: FileInfo,
-                               raw: bytes, meta=None,
-                               defer_reclaim: bool = False) -> "str | None":
-        self.stat_vol(volume)
+    def _reclaim_dir(self, d: str, defer_fs: bool) -> None:
+        """Destroy a displaced data dir. With defer_fs (committer
+        context) the tree is parked with one O(1) rename and rmtree'd
+        at the next idle drain — a large displaced object must not
+        head-of-line block every concurrent group commit on the
+        drive."""
+        if defer_fs and self._wal is not None:
+            trash = os.path.join(self.root, SYS_VOL, "tmp",
+                                 f"trash-{uuid.uuid4().hex}")
+            try:
+                os.replace(d, trash)
+            except OSError:
+                pass  # fall through to the inline rmtree below
+            else:
+                self._wal.note_trash(trash)
+                return
+        shutil.rmtree(d, ignore_errors=True)
+
+    def _single_prework(self, volume: str, path: str, fi: FileInfo,
+                        defer_reclaim: bool,
+                        assume_new: bool = False,
+                        defer_fs: bool = False) -> tuple:
+        """The non-commit half of a single-journal store: reclaim/stash
+        whatever this write displaces, and detect the classic-merge case
+        (multi-version journal / vid mismatch). Returns (token, merged):
+        merged is the fully merged XLMeta to store INSTEAD of the
+        caller-serialized one-version journal, or None when the raw
+        single-version journal may be stored directly. Runs in the WAL
+        committer when the plane is armed (the submit side is pure
+        memory); same-key callers are serialized by the erasure layer's
+        namespace lock."""
         token: str | None = None
+        if assume_new:
+            # Submit-side proof (journal_known_absent on a fresh volume)
+            # that no journal exists: skip the existence probe entirely.
+            return token, None
         try:
             cur, memo = self._cached_meta_entry(volume, path)
         except se.FileNotFound:
@@ -503,36 +737,74 @@ class LocalDrive(StorageAPI):
                                    and old.data_dir != fi.data_dir))
             if old is None or (cur.version_count != 1 or old.deleted
                                or old.version_id != fi.version_id):
-                self.write_metadata(volume, path, fi)
-                return token
+                # Classic merge (write_metadata semantics, inlined so
+                # the committer can run it without re-entering the WAL):
+                # reclaim the exact version's displaced data dir, fold
+                # the new version into the full journal.
+                try:
+                    merged = self._load_meta(volume, path)
+                except se.FileNotFound:
+                    merged = XLMeta()
+                try:
+                    prev = merged.exact_version(volume, path,
+                                                fi.version_id)
+                    if prev.data_dir and prev.data_dir != fi.data_dir \
+                            and not prev.deleted:
+                        self._reclaim_dir(
+                            os.path.join(self._file_path(volume, path),
+                                         prev.data_dir), defer_fs)
+                except se.StorageError:
+                    pass
+                merged.add_version(fi)
+                return token, merged
             if old.data_dir and old.data_dir != fi.data_dir \
                     and not token:
-                shutil.rmtree(
-                    os.path.join(self._file_path(volume, path), old.data_dir),
-                    ignore_errors=True,
-                )
-        mp = self._meta_path(volume, path)
-        os.makedirs(os.path.dirname(mp), exist_ok=True)
-        tmp = mp + f".tmp.{uuid.uuid4().hex}"
+                self._reclaim_dir(
+                    os.path.join(self._file_path(volume, path),
+                                 old.data_dir), defer_fs)
+        return token, None
+
+    def journal_commit_async(self, volume: str, path: str, fi: FileInfo,
+                             raw, meta=None, defer_reclaim: bool = False):
+        """Two-phase single-journal commit for the group-commit plane:
+        enqueue the record (pure memory — vol stat, displaced-state
+        stash, and merge fallback all run in the committer) and return
+        a future that resolves to the reclaim token after the shared
+        WAL fsync. The erasure layer submits to every drive first and
+        then awaits all futures, so one PUT pays max(group fsync) once
+        instead of a pool dispatch + blocked worker per drive. None
+        when the WAL is not armed (callers use the sync fan-out)."""
+        if self._wal is None:
+            return None
         t0 = time.perf_counter()
-        try:
-            fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
-            try:
-                os.write(fd, raw)
-                os.fsync(fd)
-            finally:
-                os.close(fd)
-            st = os.stat(tmp)
-            os.replace(tmp, mp)
-        except OSError as e:
-            raise se.FaultyDisk(str(e)) from e
-        self._note_sync(time.perf_counter() - t0)
-        if meta is not None:
-            self._cache_put(volume, path,
-                            (st.st_ino, st.st_mtime_ns, st.st_size), meta)
+        fut = self._wal.submit_single(volume, path, fi, raw, meta,
+                                      defer_reclaim)
+        fut.add_done_callback(
+            lambda _f, t0=t0: self._note_sync(time.perf_counter() - t0))
+        return fut
+
+    def _write_metadata_single(self, volume: str, path: str, fi: FileInfo,
+                               raw: bytes, meta=None,
+                               defer_reclaim: bool = False) -> "str | None":
+        if self._wal is not None:
+            # Inline-PUT group commit: the ack contract is the shared
+            # WAL fsync (docs/METAPLANE.md), not this drive's meta.mp.
+            t0 = time.perf_counter()
+            fut = self._wal.submit_single(volume, path, fi, raw, meta,
+                                          defer_reclaim)
+            token = self._wal_wait(fut)
+            self._note_sync(time.perf_counter() - t0)
+            return token
+        self.stat_vol(volume)
+        token, merged = self._single_prework(volume, path, fi,
+                                             defer_reclaim)
+        t0 = time.perf_counter()
+        if merged is not None:
+            self._store_meta_disk(volume, path, merged.serialize(),
+                                  meta=merged, fsync=True)
         else:
-            with self._meta_cache_lock:
-                self._meta_cache.pop((volume, path), None)
+            self._store_meta_disk(volume, path, raw, meta=meta, fsync=True)
+        self._note_sync(time.perf_counter() - t0)
         return token
 
     def _stash_displaced(self, volume: str, path: str, old: FileInfo,
@@ -611,6 +883,12 @@ class LocalDrive(StorageAPI):
             self._observe_op("read_version", t0, volume, path, err)
 
     def read_xl(self, volume: str, path: str) -> bytes:
+        if self._wal is not None:
+            pe = self._wal.pending_entry(volume, path)
+            if pe is not None:
+                if pe.removed:
+                    raise se.FileNotFound(f"{volume}/{path}")
+                return pe.raw
         try:
             with open(self._meta_path(volume, path), "rb") as f:
                 return f.read()
@@ -639,16 +917,16 @@ class LocalDrive(StorageAPI):
             shutil.rmtree(os.path.join(obj_dir, removed.data_dir), ignore_errors=True)
         if meta.versions:
             self._store_meta(volume, path, meta)
+        elif self._wal is not None:
+            # The removal must be WAL-ordered (replay would otherwise
+            # resurrect an earlier commit record for this key) and the
+            # delete ack durable — ride the same group fsync.
+            self._wal_wait(self._wal.submit_remove(volume, path))
         else:
             try:
-                os.remove(self._meta_path(volume, path))
-            except OSError:
-                pass
-            try:
-                os.rmdir(obj_dir)
-            except OSError:
-                pass
-            self._prune_empty_parents(os.path.dirname(obj_dir), volume)
+                self._remove_meta_disk(volume, path)
+            except se.StorageError:
+                pass  # best-effort, as before: heal converges the rest
 
     def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
                     dst_volume: str, dst_path: str,
@@ -731,7 +1009,7 @@ class LocalDrive(StorageAPI):
             pass
         meta.add_version(fi)
         self._store_meta(dst_volume, dst_path, meta)
-        _fsync_dir(obj_dir)
+        _fsync_dir(obj_dir, self.root)
         return token
 
     def commit_rename(self, token: str) -> None:
@@ -800,6 +1078,10 @@ class LocalDrive(StorageAPI):
         carry-trailing-slash convention, cmd/metacache-walk.go). This also
         lists keys nested under an object key ('a' and 'a/b' coexisting).
         """
+        if self._wal is not None:
+            # The walk reads meta.mp straight off the filesystem; every
+            # acked commit must be materialized first (cheap when idle).
+            self._wal.flush()
         base = self._vol_dir(volume)
         if not os.path.isdir(base):
             raise se.VolumeNotFound(volume)
@@ -845,6 +1127,30 @@ class LocalDrive(StorageAPI):
                     continue  # plain directory level (no journal here)
 
         yield from _walk("")
+
+    # ---------- metadata-plane hooks (docs/METAPLANE.md) ----------
+
+    def meta_sig(self, volume: str, path: str):
+        """Cheap logical signature of this drive's journal for the
+        set-level FileInfo cache: the WAL per-key LSN while armed (a
+        dict lookup; bumps on every mutation), else the stat triple the
+        per-drive journal cache already trusts. None = journal absent
+        or unknowable (callers must re-elect)."""
+        if self._wal is not None:
+            sig = self._wal.key_sig(volume, path)
+            if sig is not None:
+                return sig
+        try:
+            st = os.stat(self._meta_path(volume, path))
+        except OSError:
+            return None
+        return (st.st_ino, st.st_mtime_ns, st.st_size)
+
+    def close_wal(self) -> None:
+        """Drain + checkpoint + stop the group-commit thread (tests;
+        process-lived drives just exit with their daemon)."""
+        if self._wal is not None:
+            self._wal.close()
 
     # ---------- tmp helpers (used by the erasure layer) ----------
 

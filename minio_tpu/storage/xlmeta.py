@@ -67,7 +67,13 @@ def _fi_to_doc(fi: FileInfo) -> dict:
             "dd": fi.data_dir,
             "sz": fi.size,
             "meta": fi.metadata,
-            "parts": [asdict(p) for p in fi.parts],
+            # Hand-rolled (not dataclasses.asdict, which walks the
+            # dataclass machinery recursively): this encode sits on the
+            # per-journal-commit hot path and asdict was ~25% of it.
+            "parts": [{"number": p.number, "size": p.size,
+                       "actual_size": p.actual_size,
+                       "mod_time": p.mod_time, "etag": p.etag}
+                      for p in fi.parts],
             "ec": {
                 "algo": fi.erasure.algorithm,
                 "k": fi.erasure.data_blocks,

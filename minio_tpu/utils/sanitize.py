@@ -70,8 +70,11 @@ EXCLUDED_SITE_FILES = (
 # "mtpu-dataplane": the process-global batched data plane's dispatcher
 # and completion threads (minio_tpu/dataplane) — session-lived like the
 # shared I/O pool; test-local planes are close()d and never leak.
+# "mtpu-metaplane": per-drive WAL group-commit committer threads
+# (minio_tpu/metaplane/groupcommit.py) — they live as long as their
+# drive (the server's session); test-local drives close_wal() them.
 ALLOWED_THREAD_PREFIXES = ("mtpu-io", "shard-read", "dsync", "asyncio_",
-                           "mtpu-dataplane")
+                           "mtpu-dataplane", "mtpu-metaplane")
 
 _REAL_LOCK = threading.Lock
 _REAL_RLOCK = threading.RLock

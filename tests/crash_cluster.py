@@ -98,6 +98,13 @@ class Cluster:
             # an ack only ever follows the commit, which only follows
             # the batch's futures resolving).
             "MTPU_BATCHED_DATAPLANE": "1",
+            # Group-commit metadata plane ON for the whole crash/chaos
+            # tier: the storm's SIGKILL lands between WAL-append, the
+            # shared fsync, and materialization, so zero-lost-
+            # acknowledged-write is proven WITH group commit serving
+            # (docs/METAPLANE.md; an ack only ever follows the WAL
+            # fsync, and replay-on-mount restores acked journals).
+            "MTPU_METAPLANE": "1",
             # Tight drive deadlines: an injected hang must walk the
             # drive FAULTY→OFFLINE within the bounded storm window
             # (deadlines stay adaptive — a genuinely slow sandbox

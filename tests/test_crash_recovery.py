@@ -207,7 +207,18 @@ def test_heal_converges_after_kill9_and_corruption(cluster):
     assert any(i.get("object") == "heal-obj" for i in items)
 
     # Convergence on disk: missing shards re-materialised, rotten shards
-    # rewritten to different (correct) bytes.
+    # rewritten to different (correct) bytes. Journals written by heal
+    # ride the group-commit WAL when the metaplane is armed and
+    # materialize on the committer's idle tick (docs/METAPLANE.md) —
+    # poll briefly rather than demanding instant filesystem visibility.
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        missing = [f for f in wrecked_missing if not f.exists()]
+        rotten_left = [f for f, rotten in wrecked_rotten
+                       if f.exists() and f.read_bytes() == rotten]
+        if not missing and not rotten_left:
+            break
+        time.sleep(0.25)
     for f in wrecked_missing:
         assert f.exists(), f"heal did not restore {f}"
     for f, rotten in wrecked_rotten:

@@ -1,0 +1,611 @@
+"""Group-commit metadata plane (docs/METAPLANE.md).
+
+WAL format units, group-commit semantics (batched fsync, read-your-write
+through the pending overlay, checkpoint/truncate), replay-on-mount, the
+set-level FileInfo cache, and the crash-mid-group-commit matrix: a REAL
+SIGKILL lands (a) between WAL append and fsync — the write was never
+acked and may land either way but never torn — and (b) after the fsync
+ack but before materialization — replay must recover it bit-exact.
+
+The armed cluster storm (tests/test_chaos.py boots every node with
+MTPU_METAPLANE=1 via tests/crash_cluster.py) proves the same contract
+under composed drive+network+process faults; these tests pin the exact
+windows deterministically and stay well inside the tier-1 budget.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import struct
+import subprocess
+import sys
+import time
+import threading
+
+import pytest
+
+from minio_tpu import metaplane, obs
+from minio_tpu.metaplane import wal as walfmt
+from minio_tpu.storage.fileinfo import FileInfo
+from minio_tpu.storage.xlmeta import XLMeta
+from minio_tpu.utils import errors as se
+
+
+def _metric(name):
+    for v in obs.registry():
+        if v.name == name:
+            return v
+    raise AssertionError(f"family {name} not registered")
+
+
+def _total(vec) -> float:
+    return sum(c.value for c in vec._children.values())
+
+
+def _mk_fi(bucket: str, obj: str, payload: bytes,
+           vid: str = "") -> FileInfo:
+    fi = FileInfo.new(bucket, obj)
+    fi.version_id = vid
+    fi.mod_time = time.time()
+    fi.size = len(payload)
+    fi.inline_data = payload
+    return fi
+
+
+@pytest.fixture
+def armed_drive(tmp_path, monkeypatch):
+    monkeypatch.setenv("MTPU_METAPLANE", "1")
+    from minio_tpu.storage.local import LocalDrive
+
+    d = LocalDrive(str(tmp_path / "d0"))
+    d.make_vol("bkt")
+    yield d
+    d.close_wal()
+
+
+# ---------------------------------------------------------------------------
+# WAL format
+# ---------------------------------------------------------------------------
+
+
+def test_wal_format_roundtrip(tmp_path):
+    p = str(tmp_path / "j.wal")
+    walfmt.reset(p)
+    fd = os.open(p, os.O_WRONLY | os.O_APPEND)
+    recs = [
+        (walfmt.REC_COMMIT, 1.5, "vol", "a/b/c", b"journal-bytes"),
+        (walfmt.REC_REMOVE, 2.5, "vol", "gone", b""),
+        (walfmt.REC_COMMIT, 3.5, "v2", "uni/é漢", b"x" * 4096),
+    ]
+    walfmt.append_records(
+        fd, [walfmt.frame_record(*r) for r in recs])
+    os.close(fd)
+    got = list(walfmt.scan(p))
+    assert [(r.rtype, r.mt, r.volume, r.path, bytes(r.raw)) for r in got] \
+        == recs
+    # fold keeps last-per-key
+    folded = walfmt.fold(p)
+    assert folded[("vol", "a/b/c")].rtype == walfmt.REC_COMMIT
+    assert folded[("vol", "gone")].rtype == walfmt.REC_REMOVE
+
+
+def test_wal_torn_tail_and_corruption(tmp_path):
+    p = str(tmp_path / "j.wal")
+    walfmt.reset(p)
+    fd = os.open(p, os.O_WRONLY | os.O_APPEND)
+    walfmt.append_records(fd, [
+        walfmt.frame_record(walfmt.REC_COMMIT, 1.0, "v", "k1", b"one"),
+        walfmt.frame_record(walfmt.REC_COMMIT, 2.0, "v", "k2", b"two"),
+    ])
+    os.close(fd)
+    whole = open(p, "rb").read()
+    # Torn tail: drop the last 2 bytes — record 2 vanishes cleanly.
+    open(p, "wb").write(whole[:-2])
+    assert [r.path for r in walfmt.scan(p)] == ["k1"]
+    # Corrupt a payload byte of record 1 — scan stops before it.
+    bad = bytearray(whole)
+    bad[len(walfmt.MAGIC) + struct.calcsize("<II") + 3] ^= 0xFF
+    open(p, "wb").write(bytes(bad))
+    assert list(walfmt.scan(p)) == []
+    # No magic at all: nothing.
+    open(p, "wb").write(b"garbage")
+    assert list(walfmt.scan(p)) == []
+
+
+# ---------------------------------------------------------------------------
+# group commit on a live drive
+# ---------------------------------------------------------------------------
+
+
+def test_group_commit_batches_fsyncs(armed_drive):
+    d = armed_drive
+    commits0 = _total(_metric("minio_tpu_metaplane_commits_total"))
+    fsyncs0 = _total(_metric("minio_tpu_metaplane_fsyncs_total"))
+    n = 48
+
+    def put(i: int):
+        d.write_metadata("bkt", f"k{i}", _mk_fi("bkt", f"k{i}",
+                                                bytes([i]) * 8))
+
+    ths = [threading.Thread(target=put, args=(i,)) for i in range(n)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    for i in range(n):
+        assert d.read_version("bkt", f"k{i}").inline_data == bytes([i]) * 8
+    commits = _total(_metric("minio_tpu_metaplane_commits_total")) - commits0
+    fsyncs = _total(_metric("minio_tpu_metaplane_fsyncs_total")) - fsyncs0
+    assert commits == n
+    # 48 concurrent commits through one committer must coalesce at least
+    # once; the exact ratio is scheduling-dependent.
+    assert fsyncs < commits, (fsyncs, commits)
+
+
+def test_read_your_write_before_materialize(tmp_path, monkeypatch):
+    monkeypatch.setenv("MTPU_METAPLANE", "1")
+    monkeypatch.setenv("MTPU_WAL_LAZY_MATERIALIZE", "1")
+    from minio_tpu.storage.local import LocalDrive
+
+    d = LocalDrive(str(tmp_path / "d0"))
+    d.make_vol("bkt")
+    try:
+        d.write_metadata("bkt", "obj", _mk_fi("bkt", "obj", b"payload"))
+        mp = tmp_path / "d0" / "bkt" / "obj" / "meta.mp"
+        assert not mp.exists(), "lazy mode must not have materialized"
+        # read_version, read_xl, _load_meta all serve the overlay
+        assert d.read_version("bkt", "obj").inline_data == b"payload"
+        assert XLMeta.parse(d.read_xl("bkt", "obj")).version_count == 1
+        # the walk flushes first: listing sees the object AND the file
+        names = [w.name for w in d.walk_dir("bkt")]
+        assert names == ["obj"]
+        assert mp.exists(), "walk_dir flush materializes"
+        # deletion through the WAL: gone from reads, replay-safe
+        fi = d.read_version("bkt", "obj")
+        d.delete_version("bkt", "obj", fi)
+        with pytest.raises(se.FileNotFound):
+            d.read_version("bkt", "obj")
+    finally:
+        d.close_wal()
+    assert not mp.exists()
+
+
+def test_checkpoint_truncates_wal(tmp_path, monkeypatch):
+    monkeypatch.setenv("MTPU_METAPLANE", "1")
+    monkeypatch.setenv("MTPU_WAL_MAX_BYTES", "4096")
+    from minio_tpu.storage.local import LocalDrive
+
+    d = LocalDrive(str(tmp_path / "d0"))
+    d.make_vol("bkt")
+    try:
+        for i in range(64):
+            d.write_metadata("bkt", f"k{i}",
+                             _mk_fi("bkt", f"k{i}", os.urandom(256)))
+        d._wal.flush()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if os.path.getsize(d._wal.path) <= len(walfmt.MAGIC):
+                break
+            time.sleep(0.05)
+        assert os.path.getsize(d._wal.path) <= len(walfmt.MAGIC), \
+            "checkpoint must truncate the WAL back to its header"
+        for i in range(64):
+            assert (tmp_path / "d0" / "bkt" / f"k{i}" / "meta.mp").exists()
+    finally:
+        d.close_wal()
+    # Remount replays nothing and state is intact.
+    monkeypatch.delenv("MTPU_METAPLANE")
+    d2 = LocalDrive(str(tmp_path / "d0"))
+    assert d2.read_version("bkt", "k7").size == 256
+
+
+def test_replay_on_unarmed_mount(tmp_path, monkeypatch):
+    monkeypatch.setenv("MTPU_METAPLANE", "1")
+    monkeypatch.setenv("MTPU_WAL_LAZY_MATERIALIZE", "1")
+    from minio_tpu.storage.local import LocalDrive
+
+    d = LocalDrive(str(tmp_path / "d0"))
+    d.make_vol("bkt")
+    d.write_metadata("bkt", "obj", _mk_fi("bkt", "obj", b"survive-me"))
+    mp = tmp_path / "d0" / "bkt" / "obj" / "meta.mp"
+    assert not mp.exists()
+    # Abandon WITHOUT close (crash): the WAL holds the only copy.
+    del d
+    monkeypatch.delenv("MTPU_METAPLANE")
+    monkeypatch.delenv("MTPU_WAL_LAZY_MATERIALIZE")
+    d2 = LocalDrive(str(tmp_path / "d0"))
+    assert mp.exists(), "unarmed mount must still replay the WAL"
+    assert d2.read_version("bkt", "obj").inline_data == b"survive-me"
+    # WAL is truncated after replay — a second mount replays nothing.
+    wal_path = tmp_path / "d0" / ".mtpu.sys" / "wal" / "journal.wal"
+    assert os.path.getsize(wal_path) <= len(walfmt.MAGIC)
+
+
+def test_replay_mt_guard_keeps_newer_disk_state(tmp_path):
+    """A stale WAL record (armed session crashed) must not clobber a
+    journal an UNARMED session wrote afterwards: the mod-time tiebreak
+    keeps the newer on-disk state."""
+    from minio_tpu.metaplane import groupcommit
+    from minio_tpu.storage.local import LocalDrive
+
+    d = LocalDrive(str(tmp_path / "d0"))
+    d.make_vol("bkt")
+    old = XLMeta()
+    old.add_version(_mk_fi("bkt", "obj", b"stale-wal-state"))
+    wal_dir = tmp_path / "d0" / ".mtpu.sys" / "wal"
+    wal_dir.mkdir(parents=True, exist_ok=True)
+    wal_path = str(wal_dir / "journal.wal")
+    walfmt.reset(wal_path)
+    fd = os.open(wal_path, os.O_WRONLY | os.O_APPEND)
+    walfmt.append_records(fd, [walfmt.frame_record(
+        walfmt.REC_COMMIT, old.latest_mt, "bkt", "obj", old.serialize())])
+    os.close(fd)
+    # Unarmed process writes a NEWER journal directly.
+    newer = _mk_fi("bkt", "obj", b"newer-disk-state")
+    newer.mod_time = old.latest_mt + 10.0
+    d.write_metadata("bkt", "obj", newer)
+    applied, failed = groupcommit.replay(d, wal_path)
+    assert applied == 0 and failed == 0
+    assert d.read_version("bkt", "obj").inline_data == b"newer-disk-state"
+
+
+def test_rmtree_subtree_not_resurrected_by_replay(tmp_path, monkeypatch):
+    """An out-of-band recursive delete (session cleanup, bucket force
+    delete) must leave a REMOVE_PREFIX tombstone: a WAL COMMIT record
+    already MATERIALIZED (but not yet checkpointed) would otherwise be
+    re-applied by replay, resurrecting the destroyed journal."""
+    monkeypatch.setenv("MTPU_METAPLANE", "1")
+    from minio_tpu.storage.local import LocalDrive
+
+    d = LocalDrive(str(tmp_path / "d0"))
+    d.make_vol("bkt")
+    d.write_metadata("bkt", "a/b", _mk_fi("bkt", "a/b", b"doomed"))
+    d._wal.flush()  # materialized; the COMMIT record is still in the WAL
+    assert (tmp_path / "d0" / "bkt" / "a" / "b" / "meta.mp").exists()
+    d.delete("bkt", "a", recursive=True)
+    d._wal.flush()
+    del d  # crash: tombstone is durable with the next batch fsync
+    monkeypatch.delenv("MTPU_METAPLANE")
+    d2 = LocalDrive(str(tmp_path / "d0"))
+    with pytest.raises(se.FileNotFound):
+        d2.read_version("bkt", "a/b")
+    assert not (tmp_path / "d0" / "bkt" / "a").exists()
+
+
+def test_forget_key_spares_nested_keys(tmp_path, monkeypatch):
+    """Deleting one journal out-of-band forgets exactly that key —
+    never the nested keys that share its directory prefix."""
+    monkeypatch.setenv("MTPU_METAPLANE", "1")
+    from minio_tpu.storage.local import LocalDrive
+
+    d = LocalDrive(str(tmp_path / "d0"))
+    d.make_vol("bkt")
+    d.write_metadata("bkt", "a/b", _mk_fi("bkt", "a/b", b"outer"))
+    d.write_metadata("bkt", "a/b/c", _mk_fi("bkt", "a/b/c", b"nested"))
+    d._wal.flush()
+    d.delete("bkt", "a/b/meta.mp")
+    with pytest.raises(se.FileNotFound):
+        d.read_version("bkt", "a/b")
+    assert d.read_version("bkt", "a/b/c").inline_data == b"nested"
+    del d  # crash: replay must preserve exactly this split
+    monkeypatch.delenv("MTPU_METAPLANE")
+    d2 = LocalDrive(str(tmp_path / "d0"))
+    with pytest.raises(se.FileNotFound):
+        d2.read_version("bkt", "a/b")
+    assert d2.read_version("bkt", "a/b/c").inline_data == b"nested"
+
+
+def test_replay_applies_acked_remove_over_corrupt_journal(tmp_path,
+                                                          monkeypatch):
+    """An acked REMOVE must still land when the on-disk journal is
+    torn/corrupt (the unsynced materialization died with the crash) —
+    skipping it would leave the drive serving FileCorrupt forever for
+    a key whose delete was acknowledged."""
+    monkeypatch.setenv("MTPU_METAPLANE", "1")
+    monkeypatch.setenv("MTPU_WAL_LAZY_MATERIALIZE", "1")
+    from minio_tpu.storage.local import LocalDrive
+
+    d = LocalDrive(str(tmp_path / "d0"))
+    d.make_vol("bkt")
+    fi = _mk_fi("bkt", "gone", b"body")
+    d.write_metadata("bkt", "gone", fi)
+    d.delete_version("bkt", "gone", d.read_version("bkt", "gone"))
+    # Crash leaves a CORRUPT journal on disk (torn materialization).
+    mp = tmp_path / "d0" / "bkt" / "gone" / "meta.mp"
+    mp.parent.mkdir(parents=True, exist_ok=True)
+    mp.write_bytes(b"torn-garbage")
+    del d
+    monkeypatch.delenv("MTPU_METAPLANE")
+    monkeypatch.delenv("MTPU_WAL_LAZY_MATERIALIZE")
+    d2 = LocalDrive(str(tmp_path / "d0"))
+    assert not mp.exists(), "acked REMOVE left a corrupt journal behind"
+    with pytest.raises(se.FileNotFound):
+        d2.read_version("bkt", "gone")
+
+
+def test_replay_keeps_wal_when_apply_fails(tmp_path, monkeypatch):
+    """A record that cannot be written back at mount (failing disk) is
+    an ACKED state: replay must keep the journal, not truncate it."""
+    from minio_tpu.metaplane import groupcommit
+    from minio_tpu.storage.local import LocalDrive
+
+    monkeypatch.setenv("MTPU_METAPLANE", "1")
+    monkeypatch.setenv("MTPU_WAL_LAZY_MATERIALIZE", "1")
+    d = LocalDrive(str(tmp_path / "d1"))
+    d.make_vol("bkt")
+    d.write_metadata("bkt", "stuck", _mk_fi("bkt", "stuck", b"keep-me"))
+    del d  # crash with the record only in the WAL
+    monkeypatch.delenv("MTPU_METAPLANE")
+    monkeypatch.delenv("MTPU_WAL_LAZY_MATERIALIZE")
+
+    wal_path = str(tmp_path / "d1" / ".mtpu.sys" / "wal" / "journal.wal")
+    size_before = os.path.getsize(wal_path)
+    # Replay against a drive whose journal write-back fails.
+    probe = LocalDrive.__new__(LocalDrive)
+    probe.root = str(tmp_path / "d1")
+
+    def failing_store(*a, **kw):
+        raise se.FaultyDisk("disk full")
+
+    probe._store_meta_disk = failing_store
+    probe._disk_meta_mt = lambda vol, path: None
+    applied, failed = groupcommit.replay(probe, wal_path)
+    assert failed == 1 and applied == 0
+    assert os.path.getsize(wal_path) == size_before, \
+        "replay truncated a journal it could not apply"
+    # Healthy remount still recovers the acked write from the kept WAL.
+    d5 = LocalDrive(str(tmp_path / "d1"))
+    assert d5.read_version("bkt", "stuck").inline_data == b"keep-me"
+
+
+# ---------------------------------------------------------------------------
+# set-level FileInfo cache
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def armed_set(tmp_path, monkeypatch):
+    monkeypatch.setenv("MTPU_METAPLANE", "1")
+    from minio_tpu.erasure.objects import ErasureObjects
+    from minio_tpu.storage.local import LocalDrive
+
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureObjects(drives, parity=2)
+    es.make_bucket("bkt")
+    yield es, drives
+    es.close()
+    for d in drives:
+        d.close_wal()
+
+
+def test_setcache_hits_skip_fanout(armed_set):
+    import io
+
+    es, drives = armed_set
+    payload = os.urandom(10 << 10)
+    es.put_object("bkt", "hot", io.BytesIO(payload), len(payload))
+
+    calls = {"n": 0}
+    orig = drives[0].read_version
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    drives[0].read_version = counting
+    hits0 = _total(_metric("minio_tpu_metaplane_cache_hits_total"))
+    for _ in range(5):
+        _info, it = es.get_object("bkt", "hot")
+        assert b"".join(it) == payload
+    drives[0].read_version = orig
+    assert calls["n"] == 0, "cache hits must not fan out read_version"
+    assert _total(_metric("minio_tpu_metaplane_cache_hits_total")) \
+        - hits0 >= 5
+
+
+def test_setcache_invalidation_on_mutations(armed_set):
+    import io
+
+    es, _drives = armed_set
+    payload = os.urandom(4 << 10)
+    es.put_object("bkt", "mut", io.BytesIO(payload), len(payload))
+    _info, it = es.get_object("bkt", "mut")
+    assert b"".join(it) == payload
+    # overwrite: next read returns the new bytes (write-through replaces)
+    p2 = os.urandom(5 << 10)
+    es.put_object("bkt", "mut", io.BytesIO(p2), len(p2))
+    _info, it = es.get_object("bkt", "mut")
+    assert b"".join(it) == p2
+    # tags write invalidates; read still correct and reflects tags
+    es.put_object_tags("bkt", "mut", "k=v")
+    assert es.get_object_tags("bkt", "mut") == "k=v"
+    # delete: 404, entry dropped
+    inv0 = _total(_metric("minio_tpu_metaplane_cache_invalidations_total"))
+    es.delete_object("bkt", "mut")
+    with pytest.raises(se.ObjectNotFound):
+        es.get_object("bkt", "mut")
+    assert _total(_metric(
+        "minio_tpu_metaplane_cache_invalidations_total")) > inv0
+
+
+def test_setcache_signature_catches_sideband_write(armed_set):
+    """A journal change that does NOT pass through the cache's own
+    invalidation hooks (here: a direct drive-level store, standing in
+    for another process's commit) flips the per-drive signature and
+    forces re-election instead of serving the stale entry."""
+    import io
+
+    es, drives = armed_set
+    payload = os.urandom(2 << 10)
+    es.put_object("bkt", "side", io.BytesIO(payload), len(payload))
+    _info, it = es.get_object("bkt", "side")
+    assert b"".join(it) == payload
+    # Sideband: rewrite the journal on every drive directly.
+    new_fi = es._read_quorum_fileinfo("bkt", "side", "")
+    new_fi.inline_data = b"side-band!"
+    new_fi.size = len(b"side-band!")
+    new_fi.mod_time = time.time() + 1
+    for d in drives:
+        d.write_metadata("bkt", "side", new_fi.clone())
+    _info, it = es.get_object("bkt", "side")
+    assert b"".join(it) == b"side-band!"
+
+
+def test_e2e_bitexact_against_unarmed_oracle(tmp_path, monkeypatch):
+    """Everything written through the armed plane must read bit-exact
+    through the ORACLE path: fresh unarmed drives + engine over the same
+    roots (replay + materialized journals are the only carrier)."""
+    import io
+
+    from minio_tpu.erasure.objects import ErasureObjects
+    from minio_tpu.storage.local import LocalDrive
+
+    monkeypatch.setenv("MTPU_METAPLANE", "1")
+    roots = [str(tmp_path / f"d{i}") for i in range(4)]
+    drives = [LocalDrive(r) for r in roots]
+    es = ErasureObjects(drives, parity=2)
+    es.make_bucket("bkt")
+    bodies = {
+        "tiny": b"x",
+        "inline-edge": os.urandom(16 << 10),
+        "streamed": os.urandom((1 << 20) + 17),
+        "empty": b"",
+    }
+    for name, body in bodies.items():
+        es.put_object("bkt", name, io.BytesIO(body), len(body))
+    es.close()
+    for d in drives:
+        d.close_wal()
+
+    monkeypatch.delenv("MTPU_METAPLANE")
+    oracle = ErasureObjects([LocalDrive(r) for r in roots], parity=2)
+    try:
+        for name, body in bodies.items():
+            _info, it = oracle.get_object("bkt", name)
+            assert b"".join(it) == body, f"{name} not bit-exact"
+        listed = [o.name for o in oracle.list_objects("bkt").objects]
+        assert listed == sorted(bodies)
+    finally:
+        oracle.close()
+
+
+# ---------------------------------------------------------------------------
+# crash-mid-group-commit matrix (real SIGKILL)
+# ---------------------------------------------------------------------------
+
+_CHILD = r"""
+import os, sys, threading, time
+root, marker, mode = sys.argv[1], sys.argv[2], sys.argv[3]
+from minio_tpu.storage.local import LocalDrive
+from minio_tpu.storage.fileinfo import FileInfo
+d = LocalDrive(root)
+try:
+    d.make_vol("bkt")
+except Exception:
+    pass
+fi = FileInfo.new("bkt", "crashkey")
+fi.mod_time = time.time()
+fi.inline_data = b"D" * 512
+fi.size = 512
+if mode == "pre_fsync":
+    # The committer holds before fsync (MTPU_WAL_TEST_HOLD_FSYNC_S):
+    # write from a side thread, signal the parent the append window is
+    # open, then wait to be SIGKILLed. The future NEVER resolves, so
+    # nothing is ever acked.
+    t = threading.Thread(
+        target=lambda: d.write_metadata("bkt", "crashkey", fi),
+        daemon=True)
+    t.start()
+    time.sleep(0.5)  # let the committer append and enter the hold
+    open(marker, "w").write("WINDOW-OPEN")
+    time.sleep(60)
+else:  # post_fsync: ack lands, materialization never runs (lazy mode)
+    d.write_metadata("bkt", "crashkey", fi)  # returns = group fsync ack
+    open(marker, "w").write("ACKED")
+    time.sleep(60)
+"""
+
+
+def _run_crash_child(tmp_path, mode: str, extra_env: dict) -> str:
+    root = str(tmp_path / "cd0")
+    marker = str(tmp_path / f"marker-{mode}")
+    env = dict(os.environ)
+    env.update({"MTPU_METAPLANE": "1", "JAX_PLATFORMS": "cpu",
+                **extra_env})
+    proc = subprocess.Popen([sys.executable, "-c", _CHILD, root, marker,
+                             mode], env=env, cwd="/root/repo")
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if os.path.exists(marker):
+            break
+        assert proc.poll() is None, "crash child exited early"
+        time.sleep(0.05)
+    assert os.path.exists(marker), f"{mode}: child never opened the window"
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=30)
+    return root
+
+
+def test_crash_before_wal_fsync_never_acked(tmp_path):
+    """SIGKILL lands while the committer sits between append and fsync:
+    the client was never acked, so the write may land either way on
+    replay — but the journal must be whole-or-absent, never torn."""
+    root = _run_crash_child(tmp_path, "pre_fsync",
+                            {"MTPU_WAL_TEST_HOLD_FSYNC_S": "45"})
+    marker = tmp_path / "marker-pre_fsync"
+    assert marker.read_text() == "WINDOW-OPEN"  # and NOT an ack
+    from minio_tpu.storage.local import LocalDrive
+
+    d = LocalDrive(root)  # unarmed mount: replays whatever was durable
+    try:
+        fi = d.read_version("bkt", "crashkey")
+        # Landed: must be the complete journal, bit-exact.
+        assert fi.inline_data == b"D" * 512
+    except se.FileNotFound:
+        pass  # legally lost: never acknowledged
+
+
+def test_crash_after_fsync_before_materialize_replays(tmp_path):
+    """SIGKILL lands after the group fsync acked the write but before
+    any meta.mp materialized (lazy mode pins that state): replay on the
+    next mount must recover it bit-exact."""
+    root = _run_crash_child(tmp_path, "post_fsync",
+                            {"MTPU_WAL_LAZY_MATERIALIZE": "1"})
+    marker = tmp_path / "marker-post_fsync"
+    assert marker.read_text() == "ACKED"
+    mp = os.path.join(root, "bkt", "crashkey", "meta.mp")
+    assert not os.path.exists(mp), "lazy mode: nothing materialized"
+    from minio_tpu.storage.local import LocalDrive
+
+    d = LocalDrive(root)
+    fi = d.read_version("bkt", "crashkey")
+    assert fi.inline_data == b"D" * 512, "acked write lost"
+    assert os.path.exists(mp)
+
+
+# ---------------------------------------------------------------------------
+# observability satellites
+# ---------------------------------------------------------------------------
+
+
+def test_dir_fsync_errors_are_counted(tmp_path):
+    from minio_tpu.storage import local as lmod
+
+    before = _total(lmod._DIR_FSYNC_ERRORS)
+    lmod._fsync_dir(str(tmp_path / "does-not-exist"), "driveX")
+    assert _total(lmod._DIR_FSYNC_ERRORS) == before + 1
+
+
+def test_metaplane_metric_families_registered(armed_drive):
+    armed_drive.write_metadata("bkt", "m",
+                               _mk_fi("bkt", "m", b"mm"))
+    for fam in ("minio_tpu_metaplane_commits_total",
+                "minio_tpu_metaplane_fsyncs_total",
+                "minio_tpu_metaplane_batch_fill",
+                "minio_tpu_metaplane_wal_bytes",
+                "minio_tpu_metaplane_cache_hits_total",
+                "minio_tpu_metaplane_cache_misses_total",
+                "minio_tpu_metaplane_cache_invalidations_total",
+                "minio_tpu_dir_fsync_errors_total"):
+        _metric(fam)

@@ -24,7 +24,8 @@ from tools.check.rules.base import terminal_name
 
 FILES = ("minio_tpu/erasure/objects.py", "minio_tpu/storage/local.py",
          "minio_tpu/s3/server.py", "minio_tpu/dataplane/batcher.py",
-         "minio_tpu/dataplane/ring.py")
+         "minio_tpu/dataplane/ring.py", "minio_tpu/metaplane/wal.py",
+         "minio_tpu/metaplane/groupcommit.py")
 
 _BUF_NAMES = {"buf", "buffer", "chunk", "payload", "body", "blob", "raw",
               "mv", "view", "frame", "tail", "head"}
