@@ -38,9 +38,13 @@ NET_ISOLATE = "net_isolate"      # asymmetric edge (src -> dst dead)
 NET_HEAL = "net_heal"            # heal a named partition
 KILL = "kill"                    # SIGKILL a node
 RESTART = "restart"              # restart a killed node
+WORKER_KILL = "worker_kill"      # SIGKILL one front-door worker (the
+#                                  supervisor respawns it — the storm
+#                                  asserts the respawn SLO separately)
 
 KINDS = (DRIVE_HANG, DRIVE_DELAY, DRIVE_SLOW, DRIVE_CLEAR,
-         NET_PARTITION, NET_ISOLATE, NET_HEAL, KILL, RESTART)
+         NET_PARTITION, NET_ISOLATE, NET_HEAL, KILL, RESTART,
+         WORKER_KILL)
 
 
 class ChaosEvent:
@@ -115,7 +119,9 @@ class ChaosProgram:
                  hang_methods: tuple[str, ...] = ("create_file",
                                                   "read_version"),
                  kill_at_frac: float = 0.45,
-                 restart_after: float = 4.0) -> "ChaosProgram":
+                 restart_after: float = 4.0,
+                 worker_kill_targets: list[str] | None = None,
+                 worker_kill_period: float = 12.0) -> "ChaosProgram":
         """A flapping storm: partitions cycle on/off around
         `flap_period`, one drive at a time hangs for `hang_hold` around
         `hang_period`, and each of `kill_nodes` is SIGKILL'd once near
@@ -160,6 +166,19 @@ class ChaosProgram:
             prog.add(at, KILL, kn)
             prog.add(at + restart_after + proc_rng.uniform(0.0, 1.0),
                      RESTART, kn)
+
+        # Rolling front-door worker kills (no RESTART twin: the
+        # supervisor respawns on its own — that IS the thing the storm
+        # proves). A fresh RNG family keeps every pre-existing seed's
+        # timeline bit-identical when no targets are given.
+        if worker_kill_targets:
+            wrk_rng = random.Random(subseed(seed, "worker-schedule"))
+            t = wrk_rng.uniform(2.0, 5.0)
+            while t + 1.0 < duration:
+                prog.add(t, WORKER_KILL,
+                         wrk_rng.choice(list(worker_kill_targets)))
+                t += max(2.0, worker_kill_period
+                         + wrk_rng.uniform(-2.0, 2.0))
         return prog
 
 

@@ -95,7 +95,9 @@ def derive_part_key(object_key: bytes, part_nonce: bytes) -> bytes:
     derived key, cmd/encryption-v1.go part crypto)."""
     import hmac as _hmac
 
-    return _hmac.new(object_key, b"mtpu-part-key" + part_nonce,
+    # Accept memoryview/bytearray nonces from zero-copy GET pipelines
+    # (12 bytes — the coercion is not a payload copy).
+    return _hmac.new(object_key, b"mtpu-part-key" + bytes(part_nonce),
                      hashlib.sha256).digest()
 
 
@@ -181,7 +183,7 @@ class DecryptReader:
                  start_chunk: int = 0, total_chunks: int | None = None):
         self._it = iter(it)
         self._aes = AESGCM(object_key)
-        self._nonce = base_nonce
+        self._nonce = bytes(base_nonce)  # 12B; views welcome upstream
         self._index = start_chunk
         self._total = total_chunks
 

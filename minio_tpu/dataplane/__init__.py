@@ -25,6 +25,11 @@ ENABLE_ENV = "MTPU_BATCHED_DATAPLANE"
 
 _global_mu = threading.Lock()
 _global_plane: BatchPlane | None = None
+# Optional plane router (the multi-process front door installs one so
+# non-owner workers route submissions over the shared-memory lane ring
+# — minio_tpu/frontdoor/laneserver.py). Called under the env gate;
+# returning None falls through to the process-local plane.
+_router = None
 
 
 def enabled() -> bool:
@@ -41,11 +46,22 @@ def get_plane() -> BatchPlane:
         return _global_plane
 
 
+def set_router(fn) -> None:
+    """Install (or clear, with None) a plane router consulted by
+    maybe_plane before the process-local plane."""
+    global _router
+    _router = fn
+
+
 def maybe_plane() -> BatchPlane | None:
     """The global plane when the gate is on, else None (per-object
     dispatch). The serving integration points call this per batch."""
     if not enabled():
         return None
+    if _router is not None:
+        plane = _router()
+        if plane is not None:
+            return plane
     return get_plane()
 
 

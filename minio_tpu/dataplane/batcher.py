@@ -132,8 +132,6 @@ class PendingBatchedEncode:
                 s = lens[bi]
                 src = flats[bi] if flats[bi] is not None else block
                 mv = memoryview(src)
-                # mtpu: allow(MTPU005) - slicing a memoryview IS the
-                # zero-copy form this rule asks for; no bytes move here.
                 row = [mv[i * s:(i + 1) * s] for i in range(k)]
                 if m:
                     row += [memoryview(parity[bi, j])[:s] for j in range(m)]

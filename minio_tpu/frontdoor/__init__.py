@@ -1,0 +1,99 @@
+"""Multi-process front door (docs/FRONTDOOR.md).
+
+The batch planes solved the codec-dispatch and fsync walls; what is
+left between the fused kernels (0.5-1.1 TiB/s) and the wire
+(~0.21 GiB/s) is one Python process: one GIL, one event loop, one
+core. This package breaks that wall with N OS-process *workers*, each
+running the full asyncio S3 server on a shared `SO_REUSEPORT` listener
+(the kernel load-balances accepts), under a *supervisor* that spawns,
+respawns-on-death and drains them — while keeping both batch planes
+MORE coalesced, not less:
+
+- metaplane: per-drive WAL committers keep single-writer ownership by
+  writing per-worker journal *segments* (`journal.w<id>.wal`); mount
+  replay folds every segment under an exclusive lock, and multi-worker
+  mode materializes journals eagerly (still no per-file fsync — the
+  ack rides the shared WAL fsync exactly as before) so read-your-write
+  holds across processes through the filesystem.
+- dataplane: lane submissions from ALL workers coalesce into shared
+  kernel launches through a shared-memory submission ring (shm.py);
+  worker 0 hosts the lane server, the others submit over the ring and
+  fall back to their local plane when the ring is unavailable.
+
+Worker identity threads into obs: trace records carry `<addr>#w<id>`
+as the node, every response carries `X-Mtpu-Worker`, and the
+`minio_tpu_frontdoor_*` metric families all label by `worker`.
+
+Run: python -m minio_tpu.frontdoor --workers 4 \
+         --address 127.0.0.1:9000 /tmp/d{0...3}
+"""
+
+from __future__ import annotations
+
+import os
+
+WORKERS_ENV = "MTPU_FRONTDOOR_WORKERS"
+WORKER_ID_ENV = "MTPU_FRONTDOOR_WORKER"
+DRAIN_ENV = "MTPU_FRONTDOOR_DRAIN_S"
+SHARD_ENV = "MTPU_FRONTDOOR_SHARD"
+RING_ENV = "MTPU_FRONTDOOR_RING"
+SHARED_LANES_ENV = "MTPU_FRONTDOOR_SHARED_LANES"
+CONTROL_ENV = "MTPU_FRONTDOOR_CONTROL"
+
+
+def worker_count() -> int:
+    """Configured worker-pool width (1 = classic single process)."""
+    try:
+        return max(1, int(os.environ.get(WORKERS_ENV, "1") or 1))
+    except ValueError:
+        return 1
+
+
+def worker_id() -> int | None:
+    """This process's worker id, or None outside a front-door worker."""
+    raw = os.environ.get(WORKER_ID_ENV, "")
+    if raw == "":
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def multiworker() -> bool:
+    """True inside a worker of a pool with siblings — the mode where
+    cross-process coherence rules (WAL segments, eager materialize,
+    stat-based cache signatures) must apply."""
+    return worker_id() is not None and worker_count() > 1
+
+
+def drain_timeout() -> float:
+    """Graceful-drain window on SIGTERM before escalation."""
+    try:
+        return float(os.environ.get(DRAIN_ENV, "10") or 10)
+    except ValueError:
+        return 10.0
+
+
+def shard_policy() -> str:
+    """`router` (default — the supervisor accepts and passes fds
+    round-robin; deterministic on every kernel, including sandboxes
+    whose SO_REUSEPORT dispatch does not balance across processes) or
+    `reuseport` (zero-hop kernel dispatch for hosts that balance)."""
+    return os.environ.get(SHARD_ENV, "router") or "router"
+
+
+def control_path() -> str:
+    """The router control socket the supervisor published (router
+    shard policy only)."""
+    return os.environ.get(CONTROL_ENV, "")
+
+
+def shared_lanes() -> bool:
+    """Cross-process dataplane coalescing over the shm ring."""
+    return os.environ.get(SHARED_LANES_ENV, "") in ("1", "true", "on")
+
+
+def ring_name() -> str:
+    """The shm submission-ring name the supervisor published."""
+    return os.environ.get(RING_ENV, "")

@@ -1,0 +1,51 @@
+"""CLI: boot the multi-process front door.
+
+    python -m minio_tpu.frontdoor --workers 4 \
+        --address 127.0.0.1:9000 /tmp/d0 /tmp/d1 /tmp/d2 /tmp/d3
+
+The supervisor stays in the foreground; SIGTERM/SIGINT drain the pool
+(stop accepting, finish in-flight requests, checkpoint WAL segments).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+from minio_tpu import frontdoor
+from minio_tpu.frontdoor.supervisor import Supervisor
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        description="minio_tpu multi-process S3 front door")
+    ap.add_argument("drives", nargs="+")
+    ap.add_argument("--address", default="0.0.0.0:9000")
+    ap.add_argument("--workers", type=int,
+                    default=frontdoor.worker_count())
+    ap.add_argument("--parity", type=int, default=None)
+    ap.add_argument("--set-drives", type=int, default=None)
+    ap.add_argument("--versioned", action="store_true")
+    ap.add_argument("--shared-lanes", action="store_true",
+                    default=frontdoor.shared_lanes())
+    args = ap.parse_args(argv)
+
+    sup = Supervisor(args.drives, args.address, args.workers,
+                     parity=args.parity, set_drives=args.set_drives,
+                     versioned=args.versioned,
+                     shared_lanes=args.shared_lanes)
+    done = threading.Event()
+
+    def _drain(_sig, _frm):
+        done.set()
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)
+    sup.start()
+    done.wait()
+    sup.drain()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,363 @@
+"""Cross-process lane service over the shm submission ring.
+
+`LaneServer` runs inside the lane-owner worker (worker 0): a scanner
+thread claims SUBMITTED slots and hands them to a small pool that
+submits the work into the owner's process-local `BatchPlane` — so ring
+traffic from every worker coalesces with the owner's own request
+threads into shared fused-kernel launches.
+
+`LaneClient` runs inside every other worker and implements the subset
+of the `BatchPlane` surface the serving integration points call
+(`accepts_chunk`, `begin_encode`, `digest_chunks`, `decode_blocks`).
+Encode and digest batches ride the ring; reconstructions (rarer, and
+already coalesced per-process under failure) stay on the local plane.
+Every ring miss — oversized batch, no free slot, timeout, server dead —
+falls back to the local plane: the ring is throughput, never
+correctness (docs/FRONTDOOR.md).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from minio_tpu import obs
+from minio_tpu.frontdoor import shm
+
+_RING_SUBMITS = obs.counter(
+    "minio_tpu_frontdoor_ring_submits_total",
+    "Codec batches a worker submitted over the shared-memory ring",
+    ("worker", "op"))
+_RING_FALLBACKS = obs.counter(
+    "minio_tpu_frontdoor_ring_fallbacks_total",
+    "Ring misses served by the worker-local plane instead",
+    ("worker", "reason"))
+_RING_SERVED = obs.counter(
+    "minio_tpu_frontdoor_ring_served_total",
+    "Ring batches the lane-owner worker completed",
+    ("worker", "op"))
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class _PendingRingEncode:
+    """PendingBatchedEncode-shaped handle for a ring-submitted encode:
+    wait() polls the slot, rebuilds the (chunk rows, digests) contract
+    with data chunks aliasing the caller's block buffers, and falls
+    back to the local plane on any ring fault."""
+
+    def __init__(self, client: "LaneClient", slot: int, seq: int,
+                 k: int, m: int, block_size: int, blocks: list,
+                 with_digests: bool):
+        self._c = client
+        self._slot = slot
+        self._seq = seq
+        self._k = k
+        self._m = m
+        self._bs = block_size
+        self._blocks = blocks
+        self._digests = with_digests
+
+    def _fallback(self):
+        pend = self._c.local().begin_encode(
+            self._k, self._m, self._bs, self._blocks,
+            with_digests=self._digests)
+        return pend.wait()
+
+    def wait(self):
+        resp = self._c._await_slot(self._slot, self._seq)
+        if resp is None:
+            self._c._note_fallback("timeout")
+            return self._fallback()
+        k, m = self._k, self._m
+        out_chunks: list[list] = []
+        out_digs: list[list[bytes]] | None = [] if self._digests else None
+        off = 0
+        dig_w = (k + m) * 32
+        for block in self._blocks:
+            s = _ceil_div(len(block), k)
+            if len(block) == k * s:
+                src = block
+            else:
+                src = bytearray(k * s)
+                src[:len(block)] = block
+            mv = memoryview(src)
+            row = [mv[i * s:(i + 1) * s] for i in range(k)]
+            pmv = memoryview(resp)
+            for j in range(m):
+                row.append(pmv[off + j * s:off + (j + 1) * s])
+            off += m * s
+            out_chunks.append(row)
+            if out_digs is not None:
+                dv = pmv[off:off + dig_w]
+                # Digest views into the private response copy (writers
+                # stream them; memoryview compares by content).
+                out_digs.append([dv[i * 32:(i + 1) * 32]
+                                 for i in range(k + m)])
+                off += dig_w
+        return out_chunks, out_digs
+
+
+class LaneClient:
+    """Ring-side stand-in for the process BatchPlane (non-owner
+    workers). Not a subclass — it forwards everything it does not
+    route over the ring to the worker-local plane."""
+
+    def __init__(self, ring: shm.Ring, worker: int, nworkers: int):
+        self.ring = ring
+        self.worker = worker
+        per = max(1, ring.nslots // max(1, nworkers))
+        self._lo = min(worker * per, ring.nslots)
+        self._hi = min(self._lo + per, ring.nslots)
+        self._mu = threading.Lock()
+        self._leased: set[int] = set()
+        self._seq = (os.getpid() & 0xFFFFFFFF) << 32
+        self._degraded_until = 0.0
+        self._timeout = shm.ring_timeout_s()
+        self._wlabel = str(worker)
+        self.closed = False
+
+    # -- local-plane delegation ----------------------------------------
+
+    def local(self):
+        from minio_tpu import dataplane
+
+        return dataplane.get_plane()
+
+    def accepts_chunk(self, s: int) -> bool:
+        return self.local().accepts_chunk(s)
+
+    def decode_blocks(self, *a, **kw):
+        return self.local().decode_blocks(*a, **kw)
+
+    def _note_fallback(self, reason: str) -> None:
+        _RING_FALLBACKS.labels(worker=self._wlabel, reason=reason).inc()
+
+    # -- slot machinery -------------------------------------------------
+
+    def _acquire(self) -> tuple[int, int] | None:
+        if time.monotonic() < self._degraded_until:
+            return None
+        with self._mu:
+            for i in range(self._lo, self._hi):
+                if i in self._leased:
+                    continue
+                if self.ring.state(i) == shm.FREE:
+                    self._leased.add(i)
+                    self._seq += 1
+                    return i, self._seq
+        return None
+
+    def _release(self, slot: int, abandoned: bool = False) -> None:
+        with self._mu:
+            self._leased.discard(slot)
+        if abandoned:
+            # Server owns the slot now; it flips ABANDONED->FREE when
+            # (and only when) its task for this seq completes.
+            self.ring._set_state(slot, shm.ABANDONED)
+            self._degraded_until = time.monotonic() + 5.0
+
+    def _await_slot(self, slot: int, seq: int):
+        """Poll until the server commits (DONE/ERROR) for `seq`; returns
+        a private copy of the response bytes, or None on any miss."""
+        deadline = time.monotonic() + self._timeout
+        pause = 20e-6
+        while True:
+            st = self.ring.state(slot)
+            if st in (shm.DONE, shm.ERROR):
+                head = self.ring.head(slot)
+                resp_len, resp_seq = head[8], head[9]
+                if resp_seq != seq:
+                    # Stale response from a previous incarnation of this
+                    # slot — treat as a miss; the slot recycles below.
+                    self.ring._set_state(slot, shm.FREE)
+                    self._release(slot)
+                    return None
+                resp = None
+                if st == shm.DONE:
+                    resp = bytearray(resp_len)
+                    resp[:] = self.ring.resp_view(slot)[:resp_len]
+                self.ring._set_state(slot, shm.FREE)
+                self._release(slot)
+                return resp
+            if time.monotonic() > deadline:
+                self._release(slot, abandoned=True)
+                return None
+            time.sleep(pause)
+            pause = min(pause * 2, 500e-6)
+
+    # -- BatchPlane surface --------------------------------------------
+
+    def digest_chunks(self, chunks: list, cap: int) -> list[bytes]:
+        need_req = shm.chunks_size(chunks)
+        need_resp = len(chunks) * 32
+        if (not chunks or need_req > self.ring.req_cap
+                or need_resp > self.ring.resp_cap):
+            if chunks:
+                self._note_fallback("oversize")
+            return self.local().digest_chunks(chunks, cap)
+        got = self._acquire()
+        if got is None:
+            self._note_fallback("no_slot")
+            return self.local().digest_chunks(chunks, cap)
+        slot, seq = got
+        req_len = shm.pack_chunks(self.ring.req_view(slot), chunks)
+        self.ring.publish(slot, shm.OP_DIGEST, 0, 0, 0, seq,
+                          len(chunks), req_len)
+        _RING_SUBMITS.labels(worker=self._wlabel, op="digest").inc()
+        resp = self._await_slot(slot, seq)
+        if resp is None:
+            self._note_fallback("timeout")
+            return self.local().digest_chunks(chunks, cap)
+        dmv = memoryview(resp)
+        return [dmv[i * 32:(i + 1) * 32] for i in range(len(chunks))]
+
+    def begin_encode(self, k: int, m: int, block_size: int,
+                     blocks: list, with_digests: bool = False):
+        need_req = shm.chunks_size(blocks)
+        need_resp = sum(m * _ceil_div(len(b), k) for b in blocks)
+        if with_digests:
+            need_resp += len(blocks) * (k + m) * 32
+        if (not blocks or need_req > self.ring.req_cap
+                or need_resp > self.ring.resp_cap):
+            if blocks:
+                self._note_fallback("oversize")
+            return self.local().begin_encode(k, m, block_size, blocks,
+                                             with_digests=with_digests)
+        got = self._acquire()
+        if got is None:
+            self._note_fallback("no_slot")
+            return self.local().begin_encode(k, m, block_size, blocks,
+                                             with_digests=with_digests)
+        slot, seq = got
+        req_len = shm.pack_chunks(self.ring.req_view(slot), blocks)
+        flags = shm.FLAG_DIGESTS if with_digests else 0
+        self.ring.publish(slot, shm.OP_ENCODE, flags, k, m, seq,
+                          len(blocks), req_len)
+        _RING_SUBMITS.labels(worker=self._wlabel, op="encode").inc()
+        return _PendingRingEncode(self, slot, seq, k, m, block_size,
+                                  blocks, with_digests)
+
+    def close(self) -> None:
+        self.closed = True
+        self.ring.close()
+
+
+class LaneServer:
+    """Drains the ring into the owner worker's local BatchPlane."""
+
+    def __init__(self, ring: shm.Ring, plane=None, pool: int = 8,
+                 worker: int = 0):
+        self.ring = ring
+        self._plane = plane
+        self._stop = threading.Event()
+        self._inflight: set[int] = set()
+        self._mu = threading.Lock()
+        self._wlabel = str(worker)
+        self._pool = ThreadPoolExecutor(
+            max_workers=pool, thread_name_prefix="mtpu-frontdoor-lane")
+        ring.reset_stale()
+        self._thread = threading.Thread(
+            target=self._scan_loop, daemon=True,
+            name="mtpu-frontdoor-ring")
+        self._thread.start()
+
+    def plane(self):
+        if self._plane is not None:
+            return self._plane
+        from minio_tpu import dataplane
+
+        return dataplane.get_plane()
+
+    def _scan_loop(self) -> None:
+        while not self._stop.is_set():
+            busy = False
+            for i in range(self.ring.nslots):
+                st = self.ring.state(i)
+                if st == shm.ABANDONED:
+                    # A producer stopped waiting AFTER our task finished
+                    # (or a respawn fenced it): with no in-flight task
+                    # the slot is provably quiescent — recycle it.
+                    with self._mu:
+                        if i not in self._inflight:
+                            self.ring._set_state(i, shm.FREE)
+                    continue
+                if st != shm.SUBMITTED:
+                    continue
+                with self._mu:
+                    if i in self._inflight:
+                        continue
+                    self._inflight.add(i)
+                busy = True
+                self._pool.submit(obs.ctx_wrap(
+                    lambda i=i: self._serve_slot(i)))
+            if not busy:
+                # Idle poll: 500us keeps worst-case ring latency at the
+                # same order as the plane's own max-wait batching bound.
+                self._stop.wait(500e-6)
+
+    def _serve_slot(self, i: int) -> None:
+        try:
+            st, op, flags, k, m, seq, rows, req_len, _rl, _rs = \
+                self.ring.head(i)
+            if st != shm.SUBMITTED:
+                return
+            try:
+                reqs = shm.unpack_chunks(self.ring.req_view(i), rows,
+                                         req_len)
+                if op == shm.OP_DIGEST:
+                    resp_len = self._do_digest(i, reqs)
+                elif op == shm.OP_ENCODE:
+                    resp_len = self._do_encode(
+                        i, reqs, k, m, bool(flags & shm.FLAG_DIGESTS))
+                else:
+                    raise ValueError(f"unknown ring op {op}")
+            except Exception as e:  # noqa: BLE001 - travels to the
+                # producer as a typed ring ERROR; it recomputes locally
+                msg = f"{type(e).__name__}: {e}".encode()[:self.ring.resp_cap]
+                self.ring.resp_view(i)[:len(msg)] = msg
+                self.ring.respond(i, seq, len(msg), ok=False)
+                return
+            self.ring.respond(i, seq, resp_len, ok=True)
+            _RING_SERVED.labels(
+                worker=self._wlabel,
+                op="digest" if op == shm.OP_DIGEST else "encode").inc()
+        finally:
+            with self._mu:
+                self._inflight.discard(i)
+
+    def _do_digest(self, i: int, chunks: list) -> int:
+        cap = max(len(c) for c in chunks)
+        digs = self.plane().digest_chunks(chunks, cap)
+        out = self.ring.resp_view(i)
+        for j, d in enumerate(digs):
+            out[j * 32:(j + 1) * 32] = d
+        return len(digs) * 32
+
+    def _do_encode(self, i: int, blocks: list, k: int, m: int,
+                   with_digests: bool) -> int:
+        bs = max(len(b) for b in blocks)
+        pend = self.plane().begin_encode(k, m, bs, blocks,
+                                         with_digests=with_digests)
+        chunk_rows, dig_rows = pend.wait()
+        out = self.ring.resp_view(i)
+        off = 0
+        for bi, block in enumerate(blocks):
+            s = _ceil_div(len(block), k)
+            for j in range(m):
+                out[off:off + s] = chunk_rows[bi][k + j]
+                off += s
+            if with_digests:
+                for d in dig_rows[bi]:
+                    out[off:off + 32] = d
+                    off += 32
+        return off
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout)
+        self._pool.shutdown(wait=False)

@@ -1,0 +1,48 @@
+"""Shared-port listeners for the worker plane.
+
+`SO_REUSEPORT` lets every worker bind the same (host, port); the kernel
+hashes each new connection's 4-tuple onto one of the bound sockets, so
+accepts distribute across workers with zero handoff cost — the
+reference deployment shape for multi-process HTTP front doors. Hosts
+without it (exotic kernels) fall back to the supervisor's
+accept-and-pass router (`router.py`), selected by `MTPU_FRONTDOOR_SHARD`.
+"""
+
+from __future__ import annotations
+
+import socket
+
+
+def supports_reuseport() -> bool:
+    """Probe, don't guess: the constant existing does not prove setsockopt
+    accepts it on this kernel (gVisor et al.)."""
+    if not hasattr(socket, "SO_REUSEPORT"):
+        return False
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def make_listener(host: str, port: int, backlog: int = 1024,
+                  reuse_port: bool = True) -> socket.socket:
+    """A bound, listening TCP socket ready for aiohttp's SockSite.
+    With `reuse_port`, N workers each call this with the same address
+    and the kernel balances accepts across them."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if reuse_port:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.bind((host or "0.0.0.0", port))
+        s.listen(backlog)
+        s.setblocking(False)
+    except BaseException:
+        s.close()
+        raise
+    return s

@@ -1,0 +1,222 @@
+"""Accept-and-pass shard router: the SO_REUSEPORT fallback.
+
+Kernels whose `SO_REUSEPORT` dispatch does not balance across
+processes (gVisor routes every connection to one listener and fails
+over poorly when that process dies) get the classic front-door shape
+instead: the supervisor owns the ONE TCP listener and passes each
+accepted connection — the fd itself, over a Unix control socket with
+SCM_RIGHTS — to workers round-robin. Workers adopt the fd straight
+into their asyncio loop (`connect_accepted_socket` onto the aiohttp
+request handler), so the router touches no payload bytes, only
+connection setup; with keep-alive clients it is out of the request
+path entirely.
+
+A worker that dies mid-rotation just drops out (send fails, the
+connection moves to the next worker); the respawned worker re-registers
+over the control socket and rejoins the rotation. Selected by
+`MTPU_FRONTDOOR_SHARD=router` (the default — deterministic everywhere);
+`reuseport` keeps the zero-hop kernel dispatch for hosts that balance.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+
+from minio_tpu.logger import get_logger
+
+
+class AcceptRouter:
+    """Supervisor-side: one TCP listener, fd-passing to workers."""
+
+    def __init__(self, host: str, port: int, control_path: str):
+        self.host = host or "0.0.0.0"
+        self.port = port
+        self.control_path = control_path
+        self._workers: dict[int, socket.socket] = {}  # wid -> unix conn
+        self._rr: list[int] = []
+        self._rr_pos = 0
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._log = get_logger()
+        try:
+            os.unlink(control_path)
+        except FileNotFoundError:
+            pass
+        self._ctl = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._ctl.bind(control_path)
+        self._ctl.listen(64)
+        self._lsn = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsn.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsn.bind((self.host, port))
+        self._lsn.listen(1024)
+        self._threads = [
+            threading.Thread(target=self._register_loop, daemon=True,
+                             name="mtpu-frontdoor-ctl"),
+            threading.Thread(target=self._accept_loop, daemon=True,
+                             name="mtpu-frontdoor-accept"),
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- worker registration -------------------------------------------
+
+    def _register_loop(self) -> None:
+        self._ctl.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._ctl.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                wid = int(conn.recv(16).decode() or "-1")
+            except (OSError, ValueError):
+                conn.close()
+                continue
+            # Accepted conns inherit the listener's 0.5 s timeout; fd
+            # sends are tiny but must not drop a worker on a scheduler
+            # hiccup.
+            conn.settimeout(5.0)
+            with self._mu:
+                old = self._workers.pop(wid, None)
+                self._workers[wid] = conn
+                self._rr = sorted(self._workers)
+            if old is not None:
+                old.close()
+
+    def _drop(self, wid: int) -> None:
+        with self._mu:
+            conn = self._workers.pop(wid, None)
+            self._rr = sorted(self._workers)
+        if conn is not None:
+            conn.close()
+
+    # -- accept + pass --------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        self._lsn.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsn.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            self._pass(conn)
+
+    def _pass(self, conn: socket.socket) -> None:
+        """Round-robin the accepted fd to a live worker; every worker
+        failing means the pool is mid-respawn — drop the connection
+        (clients retry, exactly as with a dead single-process server)."""
+        for _ in range(max(1, len(self._rr))):
+            with self._mu:
+                if not self._rr:
+                    break
+                self._rr_pos = (self._rr_pos + 1) % len(self._rr)
+                wid = self._rr[self._rr_pos]
+                wconn = self._workers[wid]
+            try:
+                socket.send_fds(wconn, [b"c"], [conn.fileno()])
+                conn.close()
+                return
+            except OSError:
+                self._drop(wid)
+        conn.close()
+
+    def workers_connected(self) -> list[int]:
+        with self._mu:
+            return sorted(self._workers)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(2.0)
+        self._lsn.close()
+        self._ctl.close()
+        with self._mu:
+            conns, self._workers, self._rr = \
+                list(self._workers.values()), {}, []
+        for c in conns:
+            c.close()
+        try:
+            os.unlink(self.control_path)
+        except OSError:
+            return
+
+
+class WorkerReceiver:
+    """Worker-side: adopt routed fds into the asyncio server."""
+
+    def __init__(self, control_path: str, wid: int, loop, handler,
+                 on_eof=None):
+        """`handler` is the aiohttp protocol factory
+        (web.AppRunner().server) connections attach to. `on_eof` fires
+        when the supervisor side closes (or dies): with the router
+        holding the only listener, an orphaned worker can never see
+        another connection — the callback should drain it."""
+        import time
+
+        self._loop = loop
+        self._handler = handler
+        self._on_eof = on_eof
+        self._stop = threading.Event()
+        self._conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        # Transient refusals happen when the supervisor's control
+        # thread is mid-accept at spawn time: retry briefly rather
+        # than dying into a respawn loop.
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                self._conn.connect(control_path)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        self._conn.sendall(str(wid).encode())
+        self._thread = threading.Thread(
+            target=self._recv_loop, daemon=True,
+            name="mtpu-frontdoor-recv")
+        self._thread.start()
+
+    def _recv_loop(self) -> None:
+        self._conn.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                _msg, fds, _flags, _addr = socket.recv_fds(
+                    self._conn, 16, 4)
+            except socket.timeout:
+                continue
+            except OSError:
+                self._notify_eof()
+                return
+            if not fds:
+                # Control socket closed: the supervisor drained — or
+                # died. Either way no connection can ever reach this
+                # worker again; hand it to the drain path.
+                self._notify_eof()
+                return
+            for fd in fds:
+                sock = socket.socket(fileno=fd)
+                sock.setblocking(False)
+                self._loop.call_soon_threadsafe(
+                    self._adopt, sock)
+
+    def _notify_eof(self) -> None:
+        if self._on_eof is not None and not self._stop.is_set():
+            self._loop.call_soon_threadsafe(self._on_eof)
+
+    def _adopt(self, sock) -> None:
+        self._loop.create_task(
+            self._loop.connect_accepted_socket(self._handler, sock))
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+        self._thread.join(2.0)

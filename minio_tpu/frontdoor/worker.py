@@ -1,0 +1,203 @@
+"""One front-door worker: the full asyncio S3 server on a shared port.
+
+Spawned by the supervisor (`python -m minio_tpu.frontdoor.worker`) with
+its identity in the environment: `MTPU_FRONTDOOR_WORKER` (id),
+`MTPU_FRONTDOOR_WORKERS` (pool width), `MTPU_WAL_SEGMENT` (per-worker
+WAL journal segment) and optionally `MTPU_FRONTDOOR_RING` (shared lane
+ring). Each worker binds its own `SO_REUSEPORT` listener on the shared
+address — the kernel balances accepts — and:
+
+- threads its identity into obs (`node` = `<addr>#w<id>` on every
+  trace record, `X-Mtpu-Worker` on every response,
+  `minio_tpu_frontdoor_requests_total{worker}`),
+- worker 0 hosts the cross-process lane server and the auto-healer;
+  the others route dataplane submissions over the ring,
+- drains gracefully on SIGTERM: stop accepting, let in-flight requests
+  finish inside the drain window, checkpoint the WAL segments, exit 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+import sys
+
+from minio_tpu import frontdoor, obs
+
+_REQS = obs.counter(
+    "minio_tpu_frontdoor_requests_total",
+    "Requests served, by front-door worker", ("worker",))
+_UP = obs.gauge(
+    "minio_tpu_frontdoor_worker_up",
+    "1 while this front-door worker is serving", ("worker",))
+
+
+def _local_drives(layer) -> list:
+    """Every LocalDrive in the layer stack (for WAL checkpoint at
+    drain)."""
+    out, stack, seen = [], [layer], set()
+    while stack:
+        node = stack.pop()
+        if node is None or id(node) in seen:
+            continue
+        seen.add(id(node))
+        if hasattr(node, "close_wal"):
+            out.append(node)
+            continue
+        for attr in ("pools", "sets", "drives"):
+            kids = getattr(node, attr, None)
+            if kids:
+                stack.extend(kids)
+        inner = getattr(node, "inner", None)
+        if inner is not None:
+            stack.append(inner)
+    return out
+
+
+def _arm_shared_lanes(wid: int):
+    """Wire this worker into the cross-process lane ring (worker 0
+    serves it, the rest submit to it). Returns a stop callable."""
+    from minio_tpu import dataplane
+    from minio_tpu.frontdoor import laneserver, shm
+
+    name = frontdoor.ring_name()
+    if not (frontdoor.shared_lanes() and name and dataplane.enabled()):
+        return lambda: None
+    try:
+        ring = shm.Ring.attach(name)
+    except (OSError, ValueError):
+        return lambda: None  # no ring, no coalescing: local plane serves
+    if wid == 0:
+        server = laneserver.LaneServer(ring, worker=wid)
+
+        def stop():
+            server.stop()
+            ring.close()
+
+        return stop
+    client = laneserver.LaneClient(ring, wid, frontdoor.worker_count())
+    dataplane.set_router(lambda: client)
+
+    def stop():
+        dataplane.set_router(None)
+        client.close()
+
+    return stop
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="minio_tpu front-door worker")
+    ap.add_argument("drives", nargs="+")
+    ap.add_argument("--address", default="0.0.0.0:9000")
+    ap.add_argument("--parity", type=int, default=None)
+    ap.add_argument("--set-drives", type=int, default=None)
+    ap.add_argument("--versioned", action="store_true")
+    args = ap.parse_args(argv)
+
+    plat = os.environ.get("MTPU_JAX_PLATFORM", "")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    from minio_tpu.utils import sysres
+
+    sysres.maximize_nofile()
+
+    from minio_tpu.frontdoor import listener as fdl
+    from minio_tpu.s3.server import build_server
+
+    wid = frontdoor.worker_id() or 0
+    wlabel = str(wid)
+    host, _, port = args.address.rpartition(":")
+    access = os.environ.get("MTPU_ROOT_USER", "minioadmin")
+    secret = os.environ.get("MTPU_ROOT_PASSWORD", "minioadmin")
+    srv = build_server(args.drives, access, secret,
+                       versioned=args.versioned, parity=args.parity,
+                       set_drive_count=args.set_drives,
+                       server_addr=args.address)
+    # Worker identity on every trace record this process emits.
+    obs.set_default_node(f"{args.address}#w{wid}")
+    srv.node_name = f"{args.address}#w{wid}"
+    up = _UP.labels(worker=wlabel)
+    up.set(1)
+    reqs = _REQS.labels(worker=wlabel)
+
+    async def _stamp_worker(request, response):
+        response.headers.setdefault("X-Mtpu-Worker", wlabel)
+        reqs.inc()
+
+    srv.app.on_response_prepare.append(_stamp_worker)
+
+    stop_lanes = _arm_shared_lanes(wid)
+    if wid == 0:
+        # One healer per pool of workers: N auto-healers racing the
+        # same sets would duplicate every heal fan-out.
+        srv.start_auto_heal()
+
+    control = frontdoor.control_path()
+    routed = frontdoor.shard_policy() == "router" and control
+    sock = None
+    if not routed:
+        sock = fdl.make_listener(host or "0.0.0.0", int(port or 9000),
+                                 reuse_port=fdl.supports_reuseport())
+
+    from aiohttp import web
+
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    draining = asyncio.Event()
+
+    async def serve():
+        runner = web.AppRunner(srv.app)
+        await runner.setup()
+        receiver = site = None
+        if routed:
+            # Router shard: no listener here — adopt connection fds the
+            # supervisor passes over the control socket.
+            from minio_tpu.frontdoor.router import WorkerReceiver
+
+            # Supervisor gone (drain OR death) = no new connections can
+            # ever arrive: finish in-flight work and exit instead of
+            # lingering as an orphan.
+            receiver = WorkerReceiver(control, wid, loop, runner.server,
+                                      on_eof=draining.set)
+        else:
+            site = web.SockSite(runner, sock,
+                                shutdown_timeout=frontdoor.drain_timeout())
+            await site.start()
+        await draining.wait()
+        # Stop accepting first (listener / control socket), then let
+        # in-flight requests run out inside the drain window.
+        if receiver is not None:
+            receiver.stop()
+        if site is not None:
+            await site.stop()
+        await runner.cleanup()
+
+    def _drain(*_a) -> None:
+        draining.set()
+
+    loop.add_signal_handler(signal.SIGTERM, _drain)
+    loop.add_signal_handler(signal.SIGINT, _drain)
+    try:
+        loop.run_until_complete(serve())
+    finally:
+        up.set(0)
+        stop_lanes()
+        # Checkpoint this worker's WAL segments so a clean drain leaves
+        # nothing for the next mount's replay fold.
+        from minio_tpu.logger import get_logger
+
+        for d in _local_drives(srv.obj):
+            try:
+                d.close_wal()
+            except Exception as e:  # noqa: BLE001 - drain is
+                # best-effort; replay-on-mount converges whatever is left
+                get_logger().warning(f"frontdoor drain: wal close: {e}")
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

@@ -63,6 +63,35 @@ def wal_queue_depth() -> int:
     return int(os.environ.get("MTPU_WAL_QUEUE", "8192"))
 
 
+def wal_segment() -> str:
+    """Journal segment suffix for this process (`journal.<seg>.wal`).
+    Empty = the classic single-owner `journal.wal`. The front-door
+    supervisor stamps `MTPU_WAL_SEGMENT=w<id>` into every worker so
+    each per-drive WAL file keeps exactly one writer process
+    (docs/FRONTDOOR.md single-writer contract)."""
+    return os.environ.get("MTPU_WAL_SEGMENT", "")
+
+
+def single_owner() -> bool:
+    """True when this process is the drive's only journal writer — the
+    classic deployment. False under a multi-worker front door, where
+    cross-process coherence rules apply: journals materialize eagerly
+    inside the ack (still no per-file fsync), cache signatures fall
+    back to stat triples, and the fresh-volume existence proof is
+    disabled (a sibling may have created the journal)."""
+    from minio_tpu import frontdoor
+
+    return not frontdoor.multiworker()
+
+
+def eager_materialize() -> bool:
+    """Materialize each batch before resolving its futures. Forced in
+    multi-worker mode (cross-process read-your-write flows through the
+    filesystem); opt-in via MTPU_WAL_EAGER=1 otherwise."""
+    return (not single_owner()
+            or os.environ.get("MTPU_WAL_EAGER", "") == "1")
+
+
 def cache_objects() -> int:
     """Set-level FileInfo cache capacity in objects (LRU)."""
     return int(os.environ.get("MTPU_METAPLANE_CACHE", "4096"))

@@ -103,9 +103,88 @@ def replay(drive, wal_path: str) -> "tuple[int, int]":
     boot's gate. The `mt` tiebreak guards the armed→unarmed→armed
     interleave: state written directly by an unarmed process is newer
     than the stale WAL record and wins."""
+    final = walfmt.fold(wal_path)
+    applied, failed = _apply_fold(drive, final)
+    if failed == 0:
+        walfmt.reset(wal_path)
+    return applied, failed
+
+
+def replay_all(drive, wal_dir: str) -> "tuple[int, int]":
+    """Replay every ORPHANED journal segment under the drive's wal dir
+    in one merged fold — the multi-worker mount path
+    (docs/FRONTDOOR.md). Serialized across concurrently-booting workers
+    by an exclusive flock on `.replay.lock`; segments whose owner
+    process is STILL ALIVE (it holds an exclusive flock on its open
+    segment fd for its whole life — released by the kernel even on
+    SIGKILL) are skipped entirely: folding them would race the live
+    committer, and resetting them would silently unlink the fd its
+    durability rides on. Orphan segments are truncated only on a
+    fully-applied fold, exactly like the single-segment contract."""
+    import fcntl
+
+    os.makedirs(wal_dir, exist_ok=True)
+    lfd = _replay_lock(wal_dir)
+    try:
+        applied, failed, _orphans = _replay_orphans(drive, wal_dir)
+        return applied, failed
+    finally:
+        try:
+            fcntl.flock(lfd, fcntl.LOCK_UN)
+        finally:
+            os.close(lfd)
+
+
+def _replay_lock(wal_dir: str) -> int:
+    import fcntl
+
+    lfd = os.open(os.path.join(wal_dir, ".replay.lock"),
+                  os.O_CREAT | os.O_RDWR, 0o644)
+    fcntl.flock(lfd, fcntl.LOCK_EX)
+    return lfd
+
+
+def _replay_orphans(drive, wal_dir: str) -> "tuple[int, int, list]":
+    """Core of replay_all; caller holds the `.replay.lock` flock.
+    Returns (applied, failed, orphan_paths) — orphans are kept on disk
+    when failed > 0 so the caller can seed its overlay from them."""
+    import fcntl
+
+    orphan_fds: list[int] = []
+    orphans: list[str] = []
+    try:
+        for p in walfmt.segment_paths(wal_dir):
+            try:
+                fd = os.open(p, os.O_RDWR)
+            except OSError:
+                continue
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                os.close(fd)  # live owner: leave the segment alone
+                continue
+            orphan_fds.append(fd)
+            orphans.append(p)
+        if not orphans:
+            return 0, 0, []
+        final = walfmt.fold_merged(orphans)
+        applied, failed = _apply_fold(drive, final)
+        if failed == 0:
+            for p in orphans:
+                walfmt.reset(p)
+        return applied, failed, orphans
+    finally:
+        for fd in orphan_fds:
+            try:
+                os.close(fd)
+            except OSError:
+                continue
+
+
+def _apply_fold(drive, final) -> "tuple[int, int]":
+    """Write a replay fold back to the drive; (applied, failed)."""
     from minio_tpu.storage.xlmeta import XLMeta
 
-    final = walfmt.fold(wal_path)
     applied = 0
     failed = 0
     for (vol, path), rec in final.items():
@@ -149,11 +228,9 @@ def replay(drive, wal_path: str) -> "tuple[int, int]":
                 continue
     if applied:
         os.sync()  # one barrier instead of a per-file fsync storm
-    if failed == 0:
-        # Only a fully-applied journal may truncate: a record that
-        # could not be written back (full/failing disk at mount) is an
-        # ACKED state the WAL must keep carrying for the next mount.
-        walfmt.reset(wal_path)
+    # Only a fully-applied journal may truncate (callers enforce): a
+    # record that could not be written back (full/failing disk at
+    # mount) is an ACKED state the WAL must keep carrying.
     return applied, failed
 
 
@@ -163,7 +240,11 @@ class DriveWAL:
     def __init__(self, drive):
         self.drive = drive
         self._dir = os.path.join(drive.root, drive.sys_volume(), "wal")
-        self.path = os.path.join(self._dir, "journal.wal")
+        # Single-writer ownership under the multi-process front door:
+        # each worker journals into its own segment; replay folds all.
+        seg = metaplane.wal_segment()
+        self.path = os.path.join(
+            self._dir, f"journal.{seg}.wal" if seg else "journal.wal")
         os.makedirs(self._dir, exist_ok=True)
         self._max_bytes = metaplane.wal_max_bytes()
         self._max_pending = metaplane.wal_max_pending()
@@ -178,12 +259,44 @@ class DriveWAL:
         # fsynced-but-not-materialized state; also a valid operating
         # point for pure write bursts.
         self._lazy = os.environ.get("MTPU_WAL_LAZY_MATERIALIZE", "") == "1"
+        # Multi-worker coherence (docs/FRONTDOOR.md): sibling workers
+        # read through the filesystem, so every batch materializes
+        # before its futures resolve (no per-file fsync — the ack still
+        # rides exactly one WAL fsync) and the per-key LSN signature is
+        # meaningless across processes (key_sig returns None; the set
+        # cache falls back to stat triples, which eager materialization
+        # keeps current).
+        self._multi = not metaplane.single_owner()
+        self._eager = metaplane.eager_materialize()
+
+        # Replay-then-claim under ONE replay lock: fold every orphaned
+        # segment, then open + flock our own before anyone else's
+        # replay could mistake it for an orphan and truncate it out
+        # from under the fd (the flock is the liveness mark replay_all
+        # keys on; the kernel drops it even on SIGKILL).
+        import fcntl
 
         replay_failed = 0
-        if os.path.exists(self.path):
-            _applied, replay_failed = replay(drive, self.path)
-        self._fd = os.open(self.path,
-                           os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+        replay_kept: list = []
+        lfd = _replay_lock(self._dir)
+        try:
+            _applied, replay_failed, replay_kept = _replay_orphans(
+                drive, self._dir)
+            self._fd = os.open(self.path,
+                               os.O_WRONLY | os.O_APPEND | os.O_CREAT,
+                               0o644)
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                os.close(self._fd)
+                raise se.FaultyDisk(
+                    f"wal segment {self.path} is owned by a live "
+                    "process (duplicate worker id?)") from None
+        finally:
+            try:
+                fcntl.flock(lfd, fcntl.LOCK_UN)
+            finally:
+                os.close(lfd)
         if os.fstat(self._fd).st_size == 0:
             os.write(self._fd, walfmt.MAGIC)
             os.fsync(self._fd)
@@ -203,8 +316,11 @@ class DriveWAL:
             # flaky disk at mount) and kept the journal: seed the whole
             # fold into the pending overlay — reads serve the acked
             # state, drains retry materialization, and checkpoint stays
-            # blocked until every record lands.
-            for (vol, path), rec in walfmt.fold(self.path).items():
+            # blocked until every record lands. Seed from the KEPT
+            # orphan segments only — live siblings' segments are their
+            # owners' to serve.
+            for (vol, path), rec in walfmt.fold_merged(
+                    replay_kept).items():
                 self._lsn += 1
                 self._pending[(vol, path)] = Entry(
                     self._lsn,
@@ -353,7 +469,11 @@ class DriveWAL:
         """Logical journal signature while armed: every mutation bumps
         the key's LSN at submit, so ("w", lsn) names the journal state
         exactly (one owning process per drive by contract). None once
-        the key ages out of the LRU — callers fall back to stat."""
+        the key ages out of the LRU — callers fall back to stat — and
+        always None under a multi-worker front door, where a sibling's
+        commits move state this process's LSNs never see."""
+        if self._multi:
+            return None
         with self._mu:
             lsn = self._key_lsn.get((volume, path))
         return None if lsn is None else ("w", lsn)
@@ -487,6 +607,12 @@ class DriveWAL:
                     lsn, raw if rtype == walfmt.REC_COMMIT else None,
                     meta, mt)
                 self._pending.move_to_end(key)
+        if self._eager:
+            # Cross-process read-your-write: sibling workers have no
+            # view of this overlay, so the journals must be on the
+            # filesystem before the ack fires (page-cache writes only —
+            # durability stays the WAL fsync above).
+            self._drain_materialize(force=True)
         for rec in staged:
             rec[7].set_result(rec[8])
 
@@ -545,6 +671,21 @@ class DriveWAL:
         self._g_bytes.set(self._bytes)
 
     # ---------- lifecycle ----------
+
+    def abandon(self) -> None:
+        """Test-only SIGKILL simulation: stop the committer dead and
+        release the segment flock WITHOUT materializing, checkpointing
+        or resolving anything — on-disk state is exactly what a real
+        crash leaves, and the segment reads as orphaned to the next
+        mount's replay (a live committer's flock otherwise correctly
+        blocks replay from folding a file mid-write)."""
+        self._closed = True
+        self._broken = "abandoned (test crash)"
+        self._thread.join(5.0)
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass
 
     def close(self, timeout: float = 30.0) -> None:
         """Drain, checkpoint, stop the committer (tests; process-lived

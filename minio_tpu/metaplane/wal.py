@@ -163,6 +163,63 @@ def fold(path: str) -> dict[tuple[str, str], Record]:
     return out
 
 
+def segment_paths(wal_dir: str) -> list[str]:
+    """Every journal segment under a drive's wal dir, sorted. The
+    classic single-owner journal is `journal.wal`; front-door workers
+    write single-writer segments `journal.w<id>.wal` (one producer
+    process per file — docs/FRONTDOOR.md)."""
+    try:
+        names = os.listdir(wal_dir)
+    except OSError:
+        return []
+    return sorted(os.path.join(wal_dir, n) for n in names
+                  if n.startswith("journal") and n.endswith(".wal"))
+
+
+def fold_merged(paths: list[str]) -> dict[tuple[str, str], Record]:
+    """Cross-segment replay fold: within a segment, file order is
+    commit order (single producer, O_APPEND); across segments the only
+    order is each record's wall-clock `mt`, so the newest mt wins per
+    key and a REMOVE_PREFIX tombstone in one segment drops other
+    segments' older records under its prefix. Same-key cross-worker
+    races therefore converge last-writer-wins — exactly the S3
+    contract concurrent writers already get on the live path."""
+    folds = []
+    tombs: list[tuple[int, Record]] = []
+    for si, p in enumerate(paths):
+        out: dict[tuple[str, str], Record] = {}
+        for rec in scan(p):
+            if rec.rtype == REC_REMOVE_PREFIX:
+                pre = rec.path
+                for k in [k for k in out
+                          if k[0] == rec.volume
+                          and (not pre or k[1] == pre
+                               or k[1].startswith(pre + "/"))]:
+                    del out[k]
+                tombs.append((si, rec))
+                continue
+            out[(rec.volume, rec.path)] = rec
+        folds.append(out)
+    merged: dict[tuple[str, str], tuple[int, Record]] = {}
+    for si, out in enumerate(folds):
+        for k, rec in out.items():
+            cur = merged.get(k)
+            if cur is None or rec.mt >= cur[1].mt:
+                merged[k] = (si, rec)
+    for tsi, tomb in tombs:
+        # The tombstone's own segment already applied it in file order
+        # (records after it there legitimately survive); other
+        # segments' records only have mt to order against.
+        pre = tomb.path
+        for k in [k for k, (si, rec) in merged.items()
+                  if si != tsi and k[0] == tomb.volume
+                  and rec.mt <= tomb.mt
+                  and (not pre or k[1] == pre
+                       or k[1].startswith(pre + "/"))]:
+            del merged[k]
+    return {k: rec for k, (_si, rec) in merged.items()}
+
+
 def reset(path: str) -> None:
     """(Re)write an empty journal: magic only, durably. Called at
     checkpoint after every folded record is materialized + synced, and

@@ -2101,7 +2101,9 @@ class S3Server:
                     else:
                         _, nstream = get(bucket, key, enc_pos,
                                          sse.NONCE_SIZE, opts)
-                        nonce = b"".join(nstream)
+                        nonce = bytearray()
+                        for piece in nstream:
+                            nonce += piece
                         if len(nonce) != sse.NONCE_SIZE:
                             raise sse.SSEError(
                                 f"part nonce truncated: {len(nonce)} bytes")
@@ -2334,8 +2336,10 @@ class S3Server:
                 if delay > 0:
                     await asyncio.sleep(delay)
                 if chunked is not None:
-                    chunked.feed(chunk)
-                    spool.write(chunked.take())
+                    # Verified chunk views stream straight to the spool
+                    # (valid until the next feed — written before it).
+                    for piece in chunked.feed(chunk):
+                        spool.write(piece)
                 else:
                     if sha is not None:
                         sha.update(chunk)
@@ -2510,7 +2514,9 @@ class S3Server:
             if length <= self._GET_DRAIN_LIMIT \
                     and (drain_all or type(stream) is _LIST_ITER) \
                     and not _check_conditional(request, info):
-                body = b"".join(stream)
+                # Drain to a chunk LIST, not one joined buffer: the
+                # chunks flow to the socket as-is (zero coalesce pass).
+                body = list(stream)
             return status, offset, length, info, stream, visible, body
 
         if getattr(self.obj, "fast_local_reads", False):
@@ -2522,7 +2528,7 @@ class S3Server:
                 open_sync(False)
             if body is None and length <= self._GET_DRAIN_LIMIT \
                     and not _check_conditional(request, info):
-                body = await run(lambda: b"".join(stream))
+                body = await run(lambda: list(stream))
         else:
             status, offset, length, info, stream, visible, body = \
                 await run(open_sync, True)
@@ -2535,10 +2541,21 @@ class S3Server:
         if status == 206:
             headers["Content-Range"] = f"bytes {offset}-{offset + length - 1}/{visible}"
         if body is not None:
-            delay = self.bw_throttle.delay(bucket, len(body))
+            delay = self.bw_throttle.delay(bucket, length)
             if delay > 0:
                 await asyncio.sleep(delay)
-            return web.Response(status=status, body=body, headers=headers)
+            if len(body) == 1:
+                return web.Response(status=status, body=body[0],
+                                    headers=headers)
+            # Multi-chunk drained body: write each chunk through the
+            # stream writer (Content-Length is already set above) —
+            # payload bytes go socket-ward without ever being joined.
+            resp = web.StreamResponse(status=status, headers=headers)
+            await resp.prepare(request)
+            for c in body:
+                await resp.write(c)
+            await resp.write_eof()
+            return resp
         resp = web.StreamResponse(status=status, headers=headers)
         await resp.prepare(request)
         # First response bytes (the headers) just flushed: this is the
@@ -2634,22 +2651,25 @@ class _CloseProxy:
 
 def _peel_prefix(stream, n: int):
     """Take the first n bytes off a bytes-iterator; returns (rest_iter,
-    prefix). rest_iter preserves the remaining bytes and close()."""
+    prefix memoryview). rest_iter preserves the remaining bytes and
+    close(); nothing is re-joined — the accumulated head is sliced as
+    memoryviews (the backing bytearray is never resized after export)."""
     it = iter(stream)
-    buf = bytearray()
-    while len(buf) < n:
+    acc = bytearray()
+    while len(acc) < n:
         try:
-            buf += next(it)
+            acc += next(it)
         except StopIteration:
             # PEP 479: letting this escape into a consuming generator
             # becomes RuntimeError mid-response; surface a clean error.
             raise sse.SSEError(
-                f"stream truncated: {len(buf)} of {n} prefix bytes"
+                f"stream truncated: {len(acc)} of {n} prefix bytes"
             ) from None
-    prefix, rest = bytes(buf[:n]), bytes(buf[n:])
+    mv = memoryview(acc)
+    prefix, rest = mv[:n], mv[n:]
 
     def gen():
-        if rest:
+        if len(rest):
             yield rest
         yield from it
 
@@ -2663,18 +2683,19 @@ def _trim_iter(it, skip: int, length: int, source=None):
     remaining = length
     drop = skip
     for chunk in it:
+        cv = memoryview(chunk)
         if drop:
-            if len(chunk) <= drop:
-                drop -= len(chunk)
+            if len(cv) <= drop:
+                drop -= len(cv)
                 continue
-            chunk = chunk[drop:]
+            cv = cv[drop:]
             drop = 0
-        if len(chunk) >= remaining:
-            yield chunk[:remaining]
+        if len(cv) >= remaining:
+            yield cv[:remaining]
             remaining = 0
             break
-        remaining -= len(chunk)
-        yield chunk
+        remaining -= len(cv)
+        yield cv
     close = getattr(source, "close", None)
     if close is not None:
         close()

@@ -264,8 +264,14 @@ class ChunkedSigV4Reader:
     """Decodes + verifies a STREAMING-AWS4-HMAC-SHA256-PAYLOAD body
     (aws-chunked: <hex-len>;chunk-signature=<sig>\\r\\n<data>\\r\\n ...,
     terminated by a 0-length chunk). Reference:
-    cmd/streaming-signature-v4.go. Operates on fully buffered or
-    incrementally fed bytes via feed()/read()."""
+    cmd/streaming-signature-v4.go.
+
+    Zero-copy pipeline: `feed(data)` returns memoryviews into the
+    internal buffer — one per verified chunk — that the caller streams
+    straight to its sink (spool/encoder). The views are valid only
+    until the NEXT feed() call: feed releases them and compacts the
+    consumed prefix before appending, so verified payload bytes are
+    hashed and written exactly once and never re-joined."""
 
     def __init__(self, creds: Credentials, auth_signature: str, amz_date: str,
                  scope_date: str, region: str, service: str):
@@ -274,14 +280,11 @@ class ChunkedSigV4Reader:
         self._amz_date = amz_date
         self._scope = f"{scope_date}/{region}/{service}/aws4_request"
         self._buf = bytearray()
-        self._out = bytearray()
+        self._consumed = 0
+        self._views: list = []
         self._done = False
 
-    def feed(self, data: bytes) -> None:
-        self._buf += data
-        self._drain()
-
-    def _chunk_string_to_sign(self, chunk: bytes) -> str:
+    def _chunk_string_to_sign(self, chunk) -> str:
         return "\n".join([
             "AWS4-HMAC-SHA256-PAYLOAD",
             self._amz_date,
@@ -291,12 +294,23 @@ class ChunkedSigV4Reader:
             hashlib.sha256(chunk).hexdigest(),
         ])
 
-    def _drain(self) -> None:
+    def feed(self, data) -> list:
+        """Append wire bytes; returns the newly verified payload chunks
+        as memoryviews (valid until the next feed)."""
+        for v in self._views:
+            v.release()
+        self._views = []
+        if self._consumed:
+            del self._buf[:self._consumed]
+            self._consumed = 0
+        self._buf += data
+        out: list = []
+        base = None
         while not self._done:
-            nl = self._buf.find(b"\r\n")
+            nl = self._buf.find(b"\r\n", self._consumed)
             if nl < 0:
-                return
-            header = bytes(self._buf[:nl]).decode("latin-1")
+                break
+            header = self._buf[self._consumed:nl].decode("latin-1")
             try:
                 size_hex, _, rest = header.partition(";")
                 size = int(size_hex, 16)
@@ -305,27 +319,30 @@ class ChunkedSigV4Reader:
                 raise S3Error("SignatureDoesNotMatch", "malformed chunk header") from None
             need = nl + 2 + size + 2
             if len(self._buf) < need:
-                return
-            chunk = bytes(self._buf[nl + 2: nl + 2 + size])
+                break
+            if base is None:
+                base = memoryview(self._buf)
+            chunk = base[nl + 2: nl + 2 + size]
             want = hmac.new(self._key, self._chunk_string_to_sign(chunk).encode(),
                             hashlib.sha256).hexdigest()
             if not hmac.compare_digest(want, sig):
                 raise S3Error("SignatureDoesNotMatch", "chunk signature mismatch")
             self._prev_sig = want
-            del self._buf[:need]
+            self._consumed = need
             if size == 0:
                 self._done = True
             else:
-                self._out += chunk
+                out.append(chunk)
+        # Keep every exported view (the base too) so the next feed can
+        # release them before compacting the bytearray.
+        self._views = list(out)
+        if base is not None:
+            self._views.append(base)
+        return out
 
     @property
     def done(self) -> bool:
         return self._done
-
-    def take(self) -> bytes:
-        out = bytes(self._out)
-        self._out.clear()
-        return out
 
 
 def verify_post_policy(form: dict, creds_lookup) -> "Credentials":

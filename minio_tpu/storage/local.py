@@ -135,12 +135,13 @@ class LocalDrive(StorageAPI):
 
             self._wal = DriveWAL(self)  # replays any leftover journal
         else:
-            wal_path = os.path.join(self.root, SYS_VOL, "wal",
-                                    "journal.wal")
-            if os.path.exists(wal_path):
+            wal_dir = os.path.join(self.root, SYS_VOL, "wal")
+            from minio_tpu.metaplane import wal as walfmt
+
+            if walfmt.segment_paths(wal_dir):
                 from minio_tpu.metaplane import groupcommit
 
-                groupcommit.replay(self, wal_path)
+                groupcommit.replay_all(self, wal_dir)
 
     # ---------- identity ----------
 
@@ -283,7 +284,13 @@ class LocalDrive(StorageAPI):
     def journal_known_absent(self, volume: str, path: str) -> bool:
         """True only when this process PROVABLY never created a journal
         at (volume, path) on a volume it created empty — lets the
-        group-commit prework skip the existence stat for new keys."""
+        group-commit prework skip the existence stat for new keys.
+        Never proven under a multi-worker front door: a sibling worker
+        may have journaled the key through its own drive handle."""
+        from minio_tpu import metaplane
+
+        if not metaplane.single_owner():
+            return False
         s = self._fresh_vols.get(volume)
         return s is not None and path not in s
 

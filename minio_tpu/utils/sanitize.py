@@ -74,7 +74,8 @@ EXCLUDED_SITE_FILES = (
 # (minio_tpu/metaplane/groupcommit.py) — they live as long as their
 # drive (the server's session); test-local drives close_wal() them.
 ALLOWED_THREAD_PREFIXES = ("mtpu-io", "shard-read", "dsync", "asyncio_",
-                           "mtpu-dataplane", "mtpu-metaplane")
+                           "mtpu-dataplane", "mtpu-metaplane",
+                           "mtpu-frontdoor")
 
 _REAL_LOCK = threading.Lock
 _REAL_RLOCK = threading.RLock

@@ -210,7 +210,10 @@ def test_replay_on_unarmed_mount(tmp_path, monkeypatch):
     d.write_metadata("bkt", "obj", _mk_fi("bkt", "obj", b"survive-me"))
     mp = tmp_path / "d0" / "bkt" / "obj" / "meta.mp"
     assert not mp.exists()
-    # Abandon WITHOUT close (crash): the WAL holds the only copy.
+    # Crash WITHOUT close: the WAL holds the only copy. abandon()
+    # releases the segment flock the way a real SIGKILL would (a LIVE
+    # committer's flock correctly blocks replay from its segment).
+    d._wal.abandon()
     del d
     monkeypatch.delenv("MTPU_METAPLANE")
     monkeypatch.delenv("MTPU_WAL_LAZY_MATERIALIZE")
@@ -315,6 +318,7 @@ def test_replay_applies_acked_remove_over_corrupt_journal(tmp_path,
     mp = tmp_path / "d0" / "bkt" / "gone" / "meta.mp"
     mp.parent.mkdir(parents=True, exist_ok=True)
     mp.write_bytes(b"torn-garbage")
+    d._wal.abandon()  # SIGKILL-faithful: flock released, nothing flushed
     del d
     monkeypatch.delenv("MTPU_METAPLANE")
     monkeypatch.delenv("MTPU_WAL_LAZY_MATERIALIZE")
@@ -335,6 +339,7 @@ def test_replay_keeps_wal_when_apply_fails(tmp_path, monkeypatch):
     d = LocalDrive(str(tmp_path / "d1"))
     d.make_vol("bkt")
     d.write_metadata("bkt", "stuck", _mk_fi("bkt", "stuck", b"keep-me"))
+    d._wal.abandon()
     del d  # crash with the record only in the WAL
     monkeypatch.delenv("MTPU_METAPLANE")
     monkeypatch.delenv("MTPU_WAL_LAZY_MATERIALIZE")

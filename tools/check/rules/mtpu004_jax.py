@@ -122,7 +122,8 @@ class JaxHygieneRule(Rule):
 
     def scope(self, relpath: str) -> bool:
         return relpath.startswith(("minio_tpu/ops/", "minio_tpu/native/",
-                                   "minio_tpu/dataplane/"))
+                                   "minio_tpu/dataplane/",
+                                   "minio_tpu/frontdoor/"))
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         tree = ctx.tree

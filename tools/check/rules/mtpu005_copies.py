@@ -11,7 +11,10 @@ baseline burns down as sites convert.
 
 Slice heuristics key on buffer-ish names (`buf`, `chunk`, `payload`,
 `body`, ...): shard *lists* are sliced legitimately everywhere and stay
-out of scope.
+out of scope. Names assigned from a `memoryview(...)` call anywhere in
+the file are exempt from the slice check — slicing a memoryview IS the
+zero-copy form this rule pushes toward (file-scope tracking, not
+dataflow: a heuristic matching the rule's own naming heuristics).
 """
 
 from __future__ import annotations
@@ -23,12 +26,33 @@ from tools.check import FileContext, Finding, Rule, register
 from tools.check.rules.base import terminal_name
 
 FILES = ("minio_tpu/erasure/objects.py", "minio_tpu/storage/local.py",
-         "minio_tpu/s3/server.py", "minio_tpu/dataplane/batcher.py",
+         "minio_tpu/s3/server.py", "minio_tpu/s3/sigv4.py",
+         "minio_tpu/dataplane/batcher.py",
          "minio_tpu/dataplane/ring.py", "minio_tpu/metaplane/wal.py",
-         "minio_tpu/metaplane/groupcommit.py")
+         "minio_tpu/metaplane/groupcommit.py",
+         "minio_tpu/frontdoor/shm.py",
+         "minio_tpu/frontdoor/laneserver.py")
 
 _BUF_NAMES = {"buf", "buffer", "chunk", "payload", "body", "blob", "raw",
               "mv", "view", "frame", "tail", "head"}
+
+
+def _memoryview_names(tree: ast.AST) -> set:
+    """Names bound (anywhere in the file) from a memoryview(...) call,
+    possibly through a subscript (`mv = memoryview(b)[n:]`)."""
+    out: set = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        val = node.value
+        while isinstance(val, ast.Subscript):
+            val = val.value
+        if (isinstance(val, ast.Call) and isinstance(val.func, ast.Name)
+                and val.func.id == "memoryview"):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
 
 
 @register
@@ -40,6 +64,7 @@ class HotPathCopyRule(Rule):
         return relpath in FILES
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
+        mv_names = _memoryview_names(ctx.tree)
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.Call):
                 name = terminal_name(node.func)
@@ -64,7 +89,7 @@ class HotPathCopyRule(Rule):
                 base_name = None
                 if isinstance(base, (ast.Name, ast.Attribute)):
                     base_name = terminal_name(base)
-                if base_name in _BUF_NAMES:
+                if base_name in _BUF_NAMES and base_name not in mv_names:
                     yield ctx.finding(
                         self.id, node,
                         f"slice of buffer '{base_name}' copies the "
