@@ -838,9 +838,10 @@ def bench_multicore() -> dict:
     small, nsmall = 10 << 10, 120  # ops/s axis, per client
     rows = []
     root = _bench_root()
+    # Batch planes ride their defaults (on since the convergence) —
+    # the headline rows measure the default pipeline, no arming knobs.
     env = {"MTPU_ROOT_USER": ak, "MTPU_ROOT_PASSWORD": sk,
-           "MTPU_JAX_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
-           "MTPU_METAPLANE": "1", "MTPU_BATCHED_DATAPLANE": "1"}
+           "MTPU_JAX_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu"}
     try:
         for w in (1, 2, 4, 8):
             wroot = os.path.join(root, f"w{w}")
@@ -928,10 +929,9 @@ def _metaplane_layer_compare(writers: int = 32, per: int = 25) -> dict:
 
     def one_mode(armed: bool) -> tuple[float, float]:
         prev = os.environ.get("MTPU_METAPLANE")
-        if armed:
-            os.environ["MTPU_METAPLANE"] = "1"
-        else:
-            os.environ.pop("MTPU_METAPLANE", None)
+        # Gate is opt-out since the default flip: the oracle mode must
+        # say "0" explicitly (unset now means armed).
+        os.environ["MTPU_METAPLANE"] = "1" if armed else "0"
         from minio_tpu.storage.local import LocalDrive
 
         root = tempfile.mkdtemp(prefix="mtpu_metaplane_", dir="/tmp")
@@ -1005,6 +1005,301 @@ def _metaplane_layer_compare(writers: int = 32, per: int = 25) -> dict:
         "fsyncs_per_put_oracle": oracle_fp,
         "fsyncs_per_put_metaplane": mp_fp,
     }
+
+
+def bench_pipeline_converged() -> dict:
+    """Converged batch pipeline (PR 12, docs/DATAPLANE.md §coverage):
+    multipart part-PUTs, whole-set heal, and scanner/journal sys-file
+    writes, default pipeline vs per-request oracle (MTPU_*=0). Lanes
+    dp-shard across local devices, so a single-device CPU fallback run
+    re-execs on the repo's standard 8-virtual-device host mesh exactly
+    like bench_batched_dataplane."""
+    import subprocess
+
+    import jax as _jax
+
+    if _jax.default_backend() == "cpu" and len(_jax.devices()) == 1:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import json, bench; "
+             "print(json.dumps(bench._pipeline_converged_measure()))"],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        for line in reversed(r.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                return json.loads(line)
+        raise RuntimeError(
+            f"subprocess measure failed rc={r.returncode}: "
+            f"{(r.stderr or r.stdout)[-400:]}")
+    return _pipeline_converged_measure()
+
+
+def _pipeline_converged_measure() -> dict:
+    """The pipeline_converged measurement body on fresh 4-drive sets
+    on /tmp (durable-fsync medium):
+
+      - multipart part-PUT ops/s, 16 concurrent uploaders (part
+        encodes ride the lanes, part journals the WAL blob lane);
+      - whole-set heal GiB/s, two drives wiped, 8 concurrent healers
+        (reconstructs ride the mixed-failure-pattern lanes,
+        write-backs the WAL);
+      - scanner/journal sys-file writes, 8 concurrent writers: fsyncs
+        per write (checkpoint / usage-doc shape riding the blob
+        lane's shared fsync).
+    """
+    import io
+    import shutil
+    import tempfile
+    import threading
+
+    def one_mode(armed: bool) -> dict:
+        prev = {g: os.environ.get(g) for g in
+                ("MTPU_METAPLANE", "MTPU_BATCHED_DATAPLANE")}
+        val = "1" if armed else "0"
+        os.environ["MTPU_METAPLANE"] = val
+        os.environ["MTPU_BATCHED_DATAPLANE"] = val
+        from minio_tpu.erasure.objects import ErasureObjects
+        from minio_tpu.storage.local import LocalDrive
+
+        root = tempfile.mkdtemp(prefix="mtpu_pipeconv_", dir="/tmp")
+        res: dict = {}
+        try:
+            drives = [LocalDrive(os.path.join(root, f"d{i}"))
+                      for i in range(4)]
+            # mxsum256 keeps the codec on the device lane (the native
+            # sip256 lane would bypass the plane under either gate), a
+            # 128 KiB block keeps chunks inside the serving-gate width.
+            es = ErasureObjects(drives, parity=2,
+                                block_size=128 << 10,
+                                bitrot_algorithm="mxsum256")
+            es.make_bucket("bench")
+
+            # -- multipart part-PUT ops/s, 16 concurrent uploaders.
+            # 32 KiB parts: the small/mid regime the lanes target
+            # (PR 8's 1.9-3.3x rows) — each part is one narrow-chunk
+            # encode whose launch tax coalescing amortizes. Median of
+            # 3 reps (single-core host jitter).
+            part = os.urandom(32 << 10)
+            up_ids = [es.new_multipart_upload("bench", f"mp{i}")
+                      for i in range(16)]
+            for uid, i in zip(up_ids, range(16)):  # warm
+                es.put_object_part("bench", f"mp{i}", uid, 1,
+                                   io.BytesIO(part), len(part))
+            per = 16
+            errs: list = []
+
+            def uploader(i: int, base: int) -> None:
+                try:
+                    for p in range(base, base + per):
+                        es.put_object_part("bench", f"mp{i}", up_ids[i],
+                                           p, io.BytesIO(part),
+                                           len(part))
+                except Exception as e:  # noqa: BLE001 - surface
+                    errs.append(e)
+
+            reps = []
+            for rep in range(3):
+                base = 2 + rep * per
+                ths = [threading.Thread(target=uploader, args=(i, base))
+                       for i in range(16)]
+                t0 = time.perf_counter()
+                for t in ths:
+                    t.start()
+                for t in ths:
+                    t.join()
+                reps.append(16 * per / (time.perf_counter() - t0))
+            if errs:
+                raise errs[0]
+            res["part_put_ops"] = round(_median(reps), 1)
+
+            # -- whole-set heal GiB/s: wipe two drives, heal the sweep.
+            # Many small objects (16 KiB chunks — inside the
+            # reconstruct-lane gate): the motivating workload — the
+            # per-object path pays a launch per object, the lanes
+            # coalesce across the 16 healers. An 8-object warm round
+            # compiles the lane kernels outside the timed window.
+            payload = os.urandom(32 << 10)
+            n_obj, warm = 96, 8
+            for i in range(n_obj + warm):
+                es.put_object("bench", f"heal{i}", io.BytesIO(payload),
+                              len(payload))
+            for d in drives:
+                if d._wal is not None:
+                    d._wal.flush()
+            for d in drives[:2]:
+                for i in range(n_obj + warm):
+                    try:
+                        d.delete("bench", f"heal{i}", recursive=True)
+                    except Exception:  # noqa: BLE001 - already absent
+                        pass
+            # Whole-set heal = many objects in flight at once (the MRF
+            # drain + admin heal shape): 16 concurrent healers, so the
+            # armed mode's reconstruct rows coalesce across objects.
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=16) as ex:
+                list(ex.map(  # warm: lane compiles, caches primed
+                    lambda i: es.heal_object("bench", f"heal{n_obj + i}"),
+                    range(warm)))
+            # Best-of-2 (re-wipe between reps): heal e2e is dominated
+            # by per-object metadata machinery on this host, so single
+            # runs carry 20-30% scheduler noise.
+            dt = None
+            for _rep in range(2):
+                for d in drives[:2]:
+                    for i in range(n_obj):
+                        try:
+                            d.delete("bench", f"heal{i}", recursive=True)
+                        except Exception:  # noqa: BLE001 - absent
+                            pass
+                t0 = time.perf_counter()
+                with ThreadPoolExecutor(max_workers=16) as ex:
+                    healed = list(ex.map(
+                        lambda i: es.heal_object("bench", f"heal{i}"),
+                        range(n_obj)))
+                rep_dt = time.perf_counter() - t0
+                dt = rep_dt if dt is None else min(dt, rep_dt)
+                ok = sum(1 for h in healed
+                         if not isinstance(h, Exception)
+                         and getattr(h, "healed_count", 0) > 0)
+            res["heal_objects_ok"] = ok
+            res["heal_gibs"] = round(n_obj * len(payload) / dt / (1 << 30),
+                                     3)
+
+            # -- scanner/journal sys-file writes: fsyncs per write --
+            counts = {"n": 0}
+            real = os.fsync
+
+            def patched(fd):
+                counts["n"] += 1
+                return real(fd)
+
+            doc = os.urandom(4 << 10)
+            sys_errs: list = []
+
+            def sys_writer(t: int) -> None:
+                try:
+                    for i in range(16):
+                        es.write_sys_config(f"scanner/bench-{t}-{i}.mp",
+                                            doc)
+                except Exception as e:  # noqa: BLE001 - surface
+                    sys_errs.append(e)
+
+            os.fsync = patched
+            try:
+                t0 = time.perf_counter()
+                sys_ths = [threading.Thread(target=sys_writer, args=(t,))
+                           for t in range(8)]
+                for th in sys_ths:
+                    th.start()
+                for th in sys_ths:
+                    th.join()
+                dt = time.perf_counter() - t0
+            finally:
+                os.fsync = real
+            if sys_errs:
+                raise sys_errs[0]
+            res["sys_write_ops"] = round(128 / dt, 1)
+            res["sys_fsyncs_per_write"] = round(counts["n"] / 128, 2)
+            # Bit-exact read-backs through whichever path served.
+            assert es.read_sys_config("scanner/bench-3-7.mp") == doc
+            _info, it = es.get_object("bench", "heal3")
+            assert b"".join(it) == payload, "healed object not bit-exact"
+            es.close()
+            for d in drives:
+                d.close_wal()
+            return res
+        finally:
+            for g, v in prev.items():
+                if v is None:
+                    os.environ.pop(g, None)
+                else:
+                    os.environ[g] = v
+            shutil.rmtree(root, ignore_errors=True)
+
+    conv = one_mode(True)
+    oracle = one_mode(False)
+    out = {"metric": "pipeline_converged", "unit": "ops/s",
+           "vs_baseline": 0.0, "value": conv["part_put_ops"]}
+    for k_, v in conv.items():
+        out[f"{k_}_converged"] = v
+    for k_, v in oracle.items():
+        out[f"{k_}_oracle"] = v
+    out["part_put_speedup"] = round(
+        conv["part_put_ops"] / max(oracle["part_put_ops"], 1e-9), 2)
+    out["heal_speedup"] = round(
+        conv["heal_gibs"] / max(oracle["heal_gibs"], 1e-9), 2)
+    out.update(_recon_codec_slice())
+    return out
+
+
+def _recon_codec_slice(writers: int = 8, n_ops: int = 256) -> dict:
+    """The reconstruct CODEC slice in isolation (per-object dispatch vs
+    coalesced lane, concurrent callers, heal's digest-fused shape):
+    heal e2e on a 1-core host is dominated by per-object metadata
+    machinery that neither mode avoids, so the codec-slice speedup is
+    the number the lane actually moves — and what a real TPU host's
+    whole-set heal is bounded by."""
+    import threading
+
+    from minio_tpu.dataplane.batcher import BatchPlane
+    from minio_tpu.erasure.codec import ErasureCodec
+
+    k, m, bs = 2, 2, 128 << 10
+    codec = ErasureCodec(k, m, bs)
+    targets = (0, 1)
+    blocks = [os.urandom(32 << 10)]  # 16 KiB chunks: in-gate regime
+    lens = [len(b) for b in blocks]
+    enc = codec.encode_blocks(blocks)
+    rows = [[None if i in targets else bytes(r[i]) for i in range(k + m)]
+            for r in enc]
+
+    def run_writers(fn) -> float:
+        errs: list = []
+
+        def w(count):
+            try:
+                for _ in range(count):
+                    fn()
+            except Exception as e:  # noqa: BLE001 - surface
+                errs.append(e)
+
+        ts = [threading.Thread(target=w, args=(n_ops // writers,))
+              for _ in range(writers)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise errs[0]
+        return n_ops / (time.perf_counter() - t0)
+
+    plane = BatchPlane()
+    try:
+        def per_object():
+            codec.begin_reconstruct(rows, lens, targets,
+                                    with_digests=True).wait()
+
+        def batched():
+            plane.begin_reconstruct(k, m, bs, rows, lens, targets,
+                                    with_digests=True).wait()
+
+        per_object()
+        for _ in range(2):  # warm: compile the lane rows-buckets
+            run_writers(batched)
+        po = _median([run_writers(per_object) for _ in range(3)])
+        bp = _median([run_writers(batched) for _ in range(3)])
+    finally:
+        plane.close()
+    return {"recon_codec_perobj_ops": round(po, 1),
+            "recon_codec_plane_ops": round(bp, 1),
+            "recon_codec_speedup": round(bp / po, 2)}
 
 
 def bench_chaos_smoke() -> dict:
@@ -1446,6 +1741,7 @@ def main() -> int:
             ("verify_decode", lambda: bench_verify_decode_fused(jax, jnp)),
             ("heal", lambda: bench_heal(jax, jnp)),
             ("batched_dataplane", bench_batched_dataplane),
+            ("pipeline_converged", bench_pipeline_converged),
             ("e2e", bench_e2e_multipart),
             ("host_pipeline", bench_host_pipeline),
             ("small_objects", bench_small_objects),

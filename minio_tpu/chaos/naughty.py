@@ -160,15 +160,22 @@ class NaughtyDisk:
                     and not any(k[0] == name
                                 for k in self.per_method_call)):
                 prog = "read_file_stream"
-            # The async group-commit entry honors the sync journal
-            # store's fault program: a chaos schedule hanging
-            # write_metadata_single must also hang the two-phase path.
+            # The async group-commit entries honor their sync twins'
+            # fault programs: a chaos schedule hanging
+            # write_metadata_single / write_all must also hang the
+            # two-phase paths.
             if (name == "journal_commit_async"
                     and name not in self.per_method
                     and name not in self.per_method_delay
                     and not any(k[0] == name
                                 for k in self.per_method_call)):
                 prog = "write_metadata_single"
+            if (name == "write_all_async"
+                    and name not in self.per_method
+                    and name not in self.per_method_delay
+                    and not any(k[0] == name
+                                for k in self.per_method_call)):
+                prog = "write_all"
             self._maybe_fail(prog)
             self._maybe_delay(prog)
             out = fn(*a, **kw)
@@ -250,6 +257,16 @@ def wrap_drives(drives: list) -> list:
 def _registered() -> list[NaughtyDisk]:
     with _DISKS_MU:
         return list(_DISKS)
+
+
+def any_present() -> bool:
+    """Any NaughtyDisk alive in this process — armed or not. The
+    two-phase group-commit submit loops consult this: a submit that is
+    pure memory on a plain drive can BLOCK inside an interposed fault
+    program (HANG lands on the caller, not a pool worker), so the loop
+    must run bounded whenever an injector even exists (a program can
+    arm between the check and the call)."""
+    return len(_DISKS) > 0
 
 
 def any_armed() -> bool:

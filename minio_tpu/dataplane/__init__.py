@@ -6,12 +6,14 @@ fused-kernel launches (batcher.py) staged through a ring of
 pre-allocated device-bound buffers (ring.py), instead of one dispatch
 per object.
 
-Opt-in via `MTPU_BATCHED_DATAPLANE=1`; per-object dispatch
-(erasure/codec.py, ops/fused.py) remains both the fallback and the
-bit-exactness oracle. The process-global plane is created lazily on
-first use and lives for the process (its threads are daemons named
-`mtpu-dataplane-*`, exempted as session-lived in utils/sanitize.py);
-tests that build private planes close() them.
+ON BY DEFAULT since the pipeline convergence (PR 12): the env gate is
+opt-OUT — `MTPU_BATCHED_DATAPLANE=0` restores per-object dispatch,
+which survives as the fallback and the bit-exactness oracle (the
+chaos-storm oracle runs are its remaining deployment). The
+process-global plane is created lazily on first use and lives for the
+process (its threads are daemons named `mtpu-dataplane-*`, exempted as
+session-lived in utils/sanitize.py); tests that build private planes
+close() them.
 """
 
 from __future__ import annotations
@@ -33,8 +35,9 @@ _router = None
 
 
 def enabled() -> bool:
-    """Read the env gate live — cheap, and tests flip it per-case."""
-    return os.environ.get(ENABLE_ENV, "") in ("1", "true", "on")
+    """Read the env gate live — cheap, and tests flip it per-case.
+    Default ON; "0"/"false"/"off" opts out (per-object oracle)."""
+    return os.environ.get(ENABLE_ENV, "1") not in ("0", "false", "off")
 
 
 def get_plane() -> BatchPlane:

@@ -44,6 +44,7 @@ import numpy as np
 
 from minio_tpu.dataplane import ring
 from minio_tpu.obs import kernel as obs_kernel
+from minio_tpu.utils import admission
 from minio_tpu.utils import errors as se
 
 _CLOSE = object()
@@ -54,6 +55,12 @@ DEFAULT_MAX_WAIT_US = 500   # lone-request latency bound (microseconds)
 DEFAULT_QUEUE_CAP = 256     # bounded submission queue (requests)
 DEFAULT_RING_DEPTH = 4      # staging slots per lane (double buffer+)
 DEFAULT_MAX_WIDTH = 65536   # widest chunk the serving gate coalesces
+# Reconstruct lanes have a narrower CPU crossover than encode lanes:
+# per-row decode matrices make the coalesced kernel heavier per byte
+# (measured: +15% at 16 KiB chunks, -19% at 64 KiB on the 8-dev CPU
+# mesh), so heal/degraded-GET coalescing gates lower by default.
+# Accelerator deployments raise it (MTPU_DP_MAX_RECON_WIDTH).
+DEFAULT_MAX_RECON_WIDTH = 16384
 
 
 def _backend() -> str:
@@ -142,6 +149,44 @@ class PendingBatchedEncode:
         return out_chunks, out_digs
 
 
+class PendingBatchedReconstruct:
+    """Drop-in for codec.PendingDecode on the batched plane: wait()
+    returns the same (per block: rebuilt chunk per target, per block:
+    digest per target | None) shape. Rebuilt chunks AND their mxsum
+    digests come out of one digest-fused reconstruct-lane launch
+    (ring.lane_kernel) shared with every concurrent heal, not one
+    dispatch per object — parity with codec.begin_reconstruct's fused
+    digests."""
+
+    def __init__(self, plane: "BatchPlane", targets: tuple[int, ...],
+                 chunk_lens: list[int], groups, with_digests: bool,
+                 digest_cap: int):
+        self.targets = targets
+        self._plane = plane
+        self._lens = chunk_lens
+        self._groups = groups  # list of (request, nrows)
+        self._digests = with_digests
+        self._cap = digest_cap
+
+    def wait(self):
+        t = len(self.targets)
+        out_chunks: list[list[bytes]] = []
+        out_digs: list[list[bytes]] | None = [] if self._digests else None
+        bi = 0
+        for req, nrows in self._groups:
+            res = req.future.result()
+            rebuilt, digs = res if isinstance(res, tuple) else (res, None)
+            for r in range(nrows):
+                s = self._lens[bi]
+                out_chunks.append([rebuilt[r, ti, :s].tobytes()
+                                   for ti in range(t)])
+                if out_digs is not None:
+                    out_digs.append([digs[r, ti].tobytes()
+                                     for ti in range(t)])
+                bi += 1
+        return out_chunks, out_digs
+
+
 class BatchPlane:
     """The process-wide batched device data plane (docs/DATAPLANE.md).
 
@@ -166,6 +211,8 @@ class BatchPlane:
             env("MTPU_DP_MAX_WAIT_US", str(DEFAULT_MAX_WAIT_US))) / 1e6
         self.max_width = int(env("MTPU_DP_MAX_WIDTH",
                                  str(DEFAULT_MAX_WIDTH)))
+        self.max_recon_width = int(env("MTPU_DP_MAX_RECON_WIDTH",
+                                       str(DEFAULT_MAX_RECON_WIDTH)))
         cap = queue_cap if queue_cap is not None else int(
             env("MTPU_DP_QUEUE", str(DEFAULT_QUEUE_CAP)))
         depth = ring_depth if ring_depth is not None else int(
@@ -207,6 +254,12 @@ class BatchPlane:
         can LOSE to concurrent per-object ones — PERF.md). Integration
         points fall back to per-object dispatch above the gate."""
         return s <= self.max_width
+
+    def accepts_recon_chunk(self, s: int) -> bool:
+        """Reconstruct-lane width gate (MTPU_DP_MAX_RECON_WIDTH) — the
+        heal/degraded-GET analogue of accepts_chunk with the narrower
+        measured crossover."""
+        return s <= self.max_recon_width
 
     def begin_encode(self, k: int, m: int, block_size: int,
                      blocks: list[bytes],
@@ -388,6 +441,83 @@ class BatchPlane:
                 out.append([fixed[i] for i in want])
         return out
 
+    def begin_reconstruct(self, k: int, m: int, block_size: int,
+                          shard_chunks: list[list[bytes | None]],
+                          block_lens: list[int],
+                          targets: tuple[int, ...],
+                          with_digests: bool = False
+                          ) -> "PendingBatchedReconstruct":
+        """codec.begin_reconstruct through the coalesced plane — the
+        heal shape: every block in the batch shares ONE failure pattern
+        (fixed survivors, fixed rebuild targets), but concurrent heals
+        of different objects with DIFFERENT patterns still share a lane
+        launch because each row carries its own decode matrix as data
+        (gf2_matmul_multi), and with_digests fuses the rebuilt chunks'
+        mxsum digests into the SAME launch — a whole-set heal issues
+        coalesced single launches instead of one dispatch per object.
+        Same result contract as codec.begin_reconstruct."""
+        from minio_tpu.ops import rs_xla
+        from minio_tpu.utils.shardmath import pow2_bucket
+
+        n = k + m
+        if not shard_chunks:
+            return PendingBatchedReconstruct(self, tuple(targets), [], [],
+                                             False, 0)
+        pattern = [c is not None for c in shard_chunks[0]]
+        for row in shard_chunks[1:]:
+            if [c is not None for c in row] != pattern:
+                raise ValueError(
+                    "begin_reconstruct needs one failure pattern per "
+                    "batch (use decode_blocks for mixed patterns)")
+        present = [i for i in range(n) if pattern[i]]
+        if len(present) < k:
+            raise se.InsufficientReadQuorum(
+                "", "", f"only {len(present)} of {k} shards available")
+        survivors = tuple(present[:k])
+        targets = tuple(targets)
+        chunk_lens = [_ceil_div(bl, k) for bl in block_lens]
+        t_pad = pow2_bucket(max(1, len(targets)))
+        width = ring.width_bucket(max(chunk_lens))
+        base = _BaseKey(ring.OP_RECONSTRUCT, k, t_pad, width,
+                        with_digests)
+        w = rs_xla._decode_weights_np(k, n, survivors, targets) \
+            if targets else None
+        groups = []
+        for g0 in range(0, len(shard_chunks), self.lane_blocks):
+            rows_grp = shard_chunks[g0:g0 + self.lane_blocks]
+            lens_grp = chunk_lens[g0:g0 + self.lane_blocks]
+
+            def stage(slot, row0, rows_grp=rows_grp, lens_grp=lens_grp,
+                      w=w):
+                for bi, row in enumerate(rows_grp):
+                    r = row0 + bi
+                    for ci, si in enumerate(survivors):
+                        c = row[si]
+                        slot.data[r, ci, :len(c)] = np.frombuffer(
+                            c, dtype=np.uint8)
+                        slot.data[r, ci, len(c):] = 0
+                    slot.lens[r] = lens_grp[bi]
+                    if w is None:
+                        slot.weights[r] = 0
+                    else:
+                        tw = w.shape[1]
+                        slot.weights[r, :, :tw] = w
+                        slot.weights[r, :, tw:] = 0
+
+            def finish(outs, row0, nrows=len(rows_grp)):
+                if isinstance(outs, tuple):  # digest-fused heal lane
+                    rebuilt, digs = outs
+                    return (rebuilt[row0:row0 + nrows],
+                            digs[row0:row0 + nrows])
+                return outs[row0:row0 + nrows]
+
+            req = CodecRequest(base, len(rows_grp), stage, finish)
+            self._submit(req)
+            groups.append((req, len(rows_grp)))
+        return PendingBatchedReconstruct(self, targets, chunk_lens,
+                                         groups, with_digests,
+                                         _ceil_div(block_size, k))
+
     # ------------------------------------------------------------------
     # plumbing
     # ------------------------------------------------------------------
@@ -404,8 +534,12 @@ class BatchPlane:
             with self._close_mu:  # rejected count: cross-thread writes
                 self._stats["rejected"] += 1
             obs_kernel.dataplane_rejected(req.base.op)
-            raise se.OperationTimedOut(
-                msg="batched dataplane saturated (bounded queue full)"
+            # Unified admission: a full lane sheds exactly like a full
+            # WAL queue — OperationTimedOut -> 503 SlowDown, one shared
+            # shed family (utils/admission.py).
+            raise admission.shed(
+                "dataplane", "lane_full",
+                "batched dataplane saturated (bounded queue full)"
             ) from None
         if self._closed and not self._dispatch_t.is_alive():
             # TOCTOU with close(): the pre-put closed check passed, but
@@ -485,7 +619,12 @@ class BatchPlane:
             kern = ring.lane_kernel(
                 ring.LaneKey(op, k, aux, width, rb, digests))
             t0 = time.perf_counter()
-            if op == ring.OP_RECONSTRUCT:
+            if op == ring.OP_RECONSTRUCT and digests:
+                # Heal lane: rebuilt chunks + their mxsum digests in
+                # ONE launch (lens drive the cap-invariant digest).
+                outs = kern(slot.data[:rb], slot.weights[:rb],
+                            slot.lens[:rb])
+            elif op == ring.OP_RECONSTRUCT:
                 outs = kern(slot.data[:rb], slot.weights[:rb])
             else:
                 outs = kern(slot.data[:rb], slot.lens[:rb])
@@ -527,6 +666,9 @@ class BatchPlane:
                 parity, digs = outs
                 mat = (np.asarray(parity),
                        np.asarray(digs) if digs is not None else None)
+            elif slot_key.op == ring.OP_RECONSTRUCT and slot_key.digests:
+                rebuilt, digs = outs
+                mat = (np.asarray(rebuilt), np.asarray(digs))
             else:
                 mat = np.asarray(outs)
             row0 = 0

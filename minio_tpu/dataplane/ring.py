@@ -171,12 +171,17 @@ def lane_kernel(key: LaneKey):
     encode      (data [R,k,W], lens [R]) -> (parity [R,m,W], digs|None)
     verify      (data [R,W],   lens [R]) -> digs [R,32]
     reconstruct (data [R,k,W], w [R,k*8,t*8]) -> rebuilt [R,t,W]
+    reconstruct+digests adds lens [R] and fuses the rebuilt chunks'
+    mxsum digests into the SAME launch (the heal lane — parity with
+    codec.begin_reconstruct's fused digests, so a heal batch never
+    pays a second queued launch for its bitrot frames)
     """
     import jax
 
     from minio_tpu.ops import fused, rs_xla
 
     k, m = key.k, key.aux
+    nargs = 2
     if key.op == OP_ENCODE and key.digests:
         def launch(data, lens):
             return fused.encode_with_digests(data, k, m, lens)
@@ -186,6 +191,18 @@ def lane_kernel(key: LaneKey):
     elif key.op == OP_VERIFY:
         def launch(data, lens):
             return fused.verify_digests(data, lens)
+    elif key.op == OP_RECONSTRUCT and key.digests:
+        t = key.aux
+        nargs = 3
+
+        def launch(data, weights, lens):
+            import jax.numpy as jnp
+
+            rebuilt = rs_xla.gf2_matmul_multi(data, weights, t)
+            r, _t, w = rebuilt.shape
+            digs = fused.verify_digests(
+                rebuilt.reshape(r * t, w), jnp.repeat(lens, t))
+            return rebuilt, digs.reshape(r, t, -1)
     else:
         t = key.aux
 
@@ -196,7 +213,8 @@ def lane_kernel(key: LaneKey):
     shard = _row_sharding()
     if shard is not None and key.rows % len(jax.devices()) == 0:
         return jax.jit(launch, donate_argnums=donate,
-                       in_shardings=(shard, shard), out_shardings=shard)
+                       in_shardings=(shard,) * nargs,
+                       out_shardings=shard)
     return jax.jit(launch, donate_argnums=donate)
 
 

@@ -28,7 +28,7 @@ import threading
 import uuid
 from dataclasses import dataclass, field
 
-from minio_tpu import obs
+from minio_tpu import dataplane, obs
 from minio_tpu.erasure.codec import ErasureCodec
 from minio_tpu.erasure.metadata import parallel_map, shuffle_by_distribution
 from minio_tpu.ops import bitrot
@@ -372,6 +372,28 @@ class HealingMixin:
                 pool.errs[pos] = err
             return self._commit_healed(bucket, obj, latest, shuffled_drives,
                                        targets, sys_vol, tmp_dirs, pool)
+        use_fused = algo == "mxsum256"
+        t_tuple = tuple(targets)
+        # Batched data plane: a whole-set heal's reconstructs coalesce
+        # onto the mixed-failure-pattern lanes (per-row decode matrices
+        # ride as data), sharing launches with concurrent heals AND
+        # degraded GETs instead of one dispatch per object; the
+        # per-object codec path stays the fallback and the oracle.
+        plane = dataplane.maybe_plane() if m else None
+
+        def begin_rebuild(rows, block_lens):
+            if (plane is not None and block_lens
+                    and plane.accepts_recon_chunk(
+                        -(-max(block_lens) // k))):
+                try:
+                    return plane.begin_reconstruct(
+                        k, m, latest.erasure.block_size, rows,
+                        block_lens, t_tuple, with_digests=use_fused)
+                except se.OperationTimedOut:
+                    pass  # plane saturated: per-object dispatch serves
+            return codec.begin_reconstruct(rows, block_lens, t_tuple,
+                                           with_digests=use_fused)
+
         try:
             for part in latest.parts:
                 shard_data_size = latest.erasure.shard_file_size(part.size)
@@ -387,8 +409,6 @@ class HealingMixin:
                     # while the device rebuilds batch N; rebuilt chunks +
                     # their bitrot digests come out of ONE fused launch
                     # when the algorithm is the device checksum.
-                    use_fused = algo == "mxsum256"
-                    t_tuple = tuple(targets)
                     pending: list = []
 
                     def drain_one() -> None:
@@ -415,9 +435,7 @@ class HealingMixin:
                             for pos in chosen:
                                 row[pos] = readers[pos].read_at(b * shard_size, chunk_len)
                             rows.append(row)
-                        pending.append(codec.begin_reconstruct(
-                            rows, block_lens, t_tuple,
-                            with_digests=use_fused))
+                        pending.append(begin_rebuild(rows, block_lens))
                         if len(pending) >= 2:
                             drain_one()
                         bi = batch_ids[-1] + 1

@@ -24,6 +24,7 @@ from typing import BinaryIO
 
 from minio_tpu.erasure.codec import ErasureCodec
 from minio_tpu.erasure.metadata import hash_order, parallel_map, shuffle_by_distribution
+from minio_tpu.erasure.sysstore import mirror_write_all
 from minio_tpu.erasure.types import (
     CompletePart,
     MultipartInfo,
@@ -71,21 +72,26 @@ class MultipartMixin:
             [lambda d=d: d.read_all(SYS_VOL, rel) for d in self.drives],
             deadline=self._meta_deadline(),
         )
-        tally: dict[bytes, int] = {}
+        # Digest-keyed tally (any bytes-like copy counts without being
+        # materialized as a hashable key — the sysstore election shape).
+        tally: dict[bytes, tuple[int, bytes]] = {}
         for r in results:
             if isinstance(r, (bytes, bytearray)):
-                tally[bytes(r)] = tally.get(bytes(r), 0) + 1
+                h = hashlib.sha256(r).digest()
+                n, _ = tally.get(h, (0, b""))
+                tally[h] = (n + 1, r)
         if not tally:
             return None
 
-        def rank(raw: bytes):
+        def rank(entry: tuple[int, bytes]):
+            count, raw = entry
             try:
                 mt = json.loads(raw).get("mod_time", 0.0)
             except ValueError:
                 return (-1, 0.0)
-            return (tally[raw], mt)
+            return (count, mt)
 
-        best = max(tally, key=rank)
+        _count, best = max(tally.values(), key=rank)
         try:
             return json.loads(best)
         except ValueError:
@@ -124,11 +130,11 @@ class MultipartMixin:
         }
         raw = json.dumps(meta).encode()
         mp = self._mp_dir(bucket, obj, upload_id)
-        results = parallel_map(
-            [lambda d=d: d.write_all(SYS_VOL, f"{mp}/upload.json", raw)
-             for d in self.drives],
-            deadline=self._meta_deadline(),
-        )
+        # Session journal rides the WAL blob lane (one shared fsync per
+        # drive per batch) — concurrent upload creations group-commit.
+        results = mirror_write_all(self.drives, SYS_VOL,
+                                   f"{mp}/upload.json", raw,
+                                   self._meta_deadline())
         reduce_write_quorum(results, self._write_quorum_meta(), bucket, obj)
         return upload_id
 
@@ -173,21 +179,34 @@ class MultipartMixin:
             if errs[i] is not None:
                 raise errs[i]
             drive.rename_file(SYS_VOL, tmp_rel, SYS_VOL, f"{mp}/part.{part_number}")
-            drive.write_all(
-                SYS_VOL, f"{mp}/part.{part_number}.json",
-                json.dumps({"size": total, "etag": md5_hex,
-                            "mod_time": mod_time}).encode(),
-            )
 
         # mtpu: allow(MTPU001) - no outer envelope: each commit is a
-        # drive-deadline-bounded rename + json write, so every task
-        # terminates with a typed outcome; stamping one OperationTimedOut
-        # would leave the abandoned worker racing the quorum-failure
-        # cleanup below (renaming tmp_rel into part.N AFTER the cleanup
-        # deleted tmp_rel — an orphan part shard on a failed op).
+        # drive-deadline-bounded rename, so every task terminates with a
+        # typed outcome; stamping one OperationTimedOut would leave the
+        # abandoned worker racing the quorum-failure cleanup below
+        # (renaming tmp_rel into part.N AFTER the cleanup deleted
+        # tmp_rel — an orphan part shard on a failed op).
         outcomes = parallel_map(
             [lambda i=i, d=d: commit(i, d) for i, d in enumerate(shuffled)],
         )
+        # Part journal rides the WAL blob lane AFTER the shard rename
+        # (a part.json must never elect without its shard data): one
+        # shared fsync per drive per batch, so concurrent part uploads
+        # from many clients group-commit instead of paying a per-part
+        # fsync per drive. Only drives whose rename landed get the
+        # journal — same publish-after-data order as the old in-closure
+        # write_all.
+        pj_raw = json.dumps({"size": total, "etag": md5_hex,
+                             "mod_time": mod_time}).encode()
+        ok_idx = [i for i, o in enumerate(outcomes)
+                  if not isinstance(o, Exception)]
+        pj_out = mirror_write_all(
+            [shuffled[i] for i in ok_idx], SYS_VOL,
+            f"{mp}/part.{part_number}.json", pj_raw,
+            self._meta_deadline())
+        for i, o in zip(ok_idx, pj_out):
+            if isinstance(o, Exception):
+                outcomes[i] = o
         try:
             reduce_write_quorum(outcomes, write_quorum, bucket, obj)
         except Exception:

@@ -90,7 +90,9 @@ def _read_full(data: BinaryIO, n: int) -> bytes:
     Fast path: most sources (BytesIO, spool files) satisfy the whole read
     in one call — return that buffer directly instead of paying two extra
     whole-segment copies (bytearray append + bytes()), which showed up as
-    ~25% of large-PUT wall time."""
+    ~25% of large-PUT wall time. The slow path hands back its accumulator
+    bytearray as-is: every consumer (md5, np.frombuffer, the native
+    encoder's from_buffer borrow) takes any bytes-like buffer."""
     if n <= 0:
         return b""
     first = data.read(n)
@@ -104,7 +106,7 @@ def _read_full(data: BinaryIO, n: int) -> bytes:
         if not chunk:
             break
         buf += chunk
-    return bytes(buf)
+    return buf
 
 
 def default_parity(n_drives: int) -> int:
@@ -419,7 +421,9 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     bucket, obj, f"got {len(first_block)} of {size} bytes")
             md5.update(first_block)
             fi.size = len(first_block)
-            fi.inline_data = bytes(first_block)
+            # No defensive copy: the buffer is never mutated after this
+            # point, and the journal serializer takes any bytes-like.
+            fi.inline_data = first_block
             fi.data_dir = ""
             fi.metadata.setdefault("etag", md5.hexdigest())
             fi.parts = [PartInfo(1, fi.size, fi.size, fi.mod_time)]
@@ -813,13 +817,13 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                             continue
                     decoded = self._decode_rows(codec, rows, lens)
                     for j, b in enumerate(ids):
-                        block = b"".join(decoded[j])[: lens[j]]
                         blk_start = b * fi.erasure.block_size
                         lo = max(offset, blk_start) - blk_start
                         hi = min(offset + length,
                                  blk_start + lens[j]) - blk_start
                         if hi > lo:
-                            yield block[lo:hi]
+                            yield from _yield_block_range(
+                                decoded[j], lo, hi)
             finally:
                 for r in readers:
                     if r is not None:
@@ -910,12 +914,11 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                 batch_ids, block_lens, rows = a, b_, c
                 decoded = self._decode_rows(codec, rows, block_lens)
                 for j, b in enumerate(batch_ids):
-                    block = b"".join(decoded[j])[: block_lens[j]]
                     blk_start = b * fi.erasure.block_size
                     lo = max(offset, blk_start) - blk_start
                     hi = min(offset + length, blk_start + block_lens[j]) - blk_start
                     if hi > lo:
-                        yield block[lo:hi]
+                        yield from _yield_block_range(decoded[j], lo, hi)
         finally:
             # Runs on normal completion AND early close (GeneratorExit) —
             # callers that read exactly length bytes leave the generator
@@ -1061,7 +1064,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                             deadline=self._data_deadline())
                         lost = False
                         for i, blob in zip(need, fetches):
-                            if isinstance(blob, bytes):
+                            if isinstance(blob, (bytes, bytearray)):
                                 mem[i] = blob
                             else:
                                 dead.add(i)
@@ -1405,7 +1408,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         share one launch — per-row decode matrices ride as data), else
         the per-object codec path."""
         plane = dataplane.maybe_plane() if codec.m else None
-        if plane is not None and lens and plane.accepts_chunk(
+        if plane is not None and lens and plane.accepts_recon_chunk(
                 -(-max(lens) // codec.k)):
             try:
                 return plane.decode_blocks(codec.k, codec.m,
@@ -1842,7 +1845,10 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                         # single producer thread — n drives hash in
                         # parallel, the reference's per-goroutine
                         # bitrot-writer layout (cmd/bitrot-streaming.go:46).
-                        digest = bitrot_algo.digest(bytes(chunk))
+                        # Memoryview chunks pass straight through: every
+                        # digest impl takes bytes-like buffers (the native
+                        # kernels borrow writable views via from_buffer).
+                        digest = bitrot_algo.digest(chunk)
                     yield digest
                     yield chunk
 
@@ -1996,7 +2002,9 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     return
                 futs.append(f)
 
-        if os.environ.get("MTPU_CHAOS_DRIVE_WRAP", "") == "1":
+        from minio_tpu.erasure.sysstore import submits_may_block
+
+        if submits_may_block():
             if not run_bounded(submit_all, self._meta_deadline()):
                 return None  # injected hang mid-submit: bounded fallback
         else:
@@ -2178,16 +2186,40 @@ def _shard_paths_mixed(drives: list[StorageAPI], vol: str, rel: str
     return paths, remotes
 
 
+def _yield_block_range(chunks, lo: int, hi: int):
+    """Yield [lo, hi) of a decoded block as memoryview slices of its k
+    data chunks — the zero-copy replacement for joining the chunks into
+    one fresh block buffer and slicing that (two full passes over the
+    payload per block on the GET hot path). Trailing shard padding
+    falls away because hi is capped at the block's real length."""
+    pos = 0
+    for c in chunks:
+        if pos >= hi:
+            return
+        end = pos + len(c)
+        a = max(lo, pos)
+        b = min(hi, end)
+        if b > a:
+            yield memoryview(c)[a - pos:b - pos]
+        pos = end
+
+
 def _read_exact(f, n: int) -> bytes:
     """Read exactly n bytes from a stream; OSError on early EOF — the
-    ONE short-read rule every remote shard reader shares."""
-    buf = bytearray()
+    ONE short-read rule every remote shard reader shares. Returns the
+    accumulator bytearray as-is (single-read fast path returns the
+    stream's own buffer): consumers take any bytes-like, including the
+    native decoder's mem shards (ctypes borrows writable buffers)."""
+    first = f.read(n)
+    if first and len(first) == n:
+        return first
+    buf = bytearray(first or b"")
     while len(buf) < n:
         c = f.read(n - len(buf))
         if not c:
             raise OSError("short read")
         buf += c
-    return bytes(buf)
+    return buf
 
 
 def _fetch_framed(drive: StorageAPI, vol: str, rel: str, lo: int,

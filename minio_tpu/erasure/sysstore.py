@@ -11,13 +11,95 @@ config survives the same drive losses the data path does.
 from __future__ import annotations
 
 import hashlib
+import os
+import time
+from concurrent.futures import TimeoutError as _FutTimeout
 
-from minio_tpu.erasure.metadata import parallel_map
+from minio_tpu.erasure.metadata import parallel_map, run_bounded
 from minio_tpu.utils import errors as se
 from minio_tpu.utils.quorum import reduce_write_quorum
 
 SYS_VOL = ".mtpu.sys"
 CONFIG_PREFIX = "config"
+
+
+def submits_may_block() -> bool:
+    """True when a two-phase group-commit SUBMIT can block the calling
+    thread: a fault injector sits in the drive chain (the chaos wrap,
+    or any directly-constructed NaughtyDisk in this process). Plain
+    drives keep the pure-memory inline submit."""
+    if os.environ.get("MTPU_CHAOS_DRIVE_WRAP", "") == "1":
+        return True
+    from minio_tpu.chaos import naughty
+
+    return naughty.any_present()
+
+
+def mirror_write_all(drives, vol: str, rel: str, data: bytes,
+                     deadline: float) -> list:
+    """Mirrored small-file write across a drive set through the WAL
+    blob lane when available: submit to every armed drive's group
+    commit (pure memory — the ack rides ONE shared fsync per drive per
+    batch), then await all futures under the deadline; drives without
+    the two-phase entry (remote, unarmed) take the classic parallel
+    write_all fan-out with its per-file fsync. Returns per-drive
+    outcomes (None | Exception) for the caller's quorum reducer — the
+    metaplane's answer to sys-file traffic (multipart part journals,
+    scanner checkpoints, config docs) competing with foreground acks
+    for fsyncs."""
+    n = len(drives)
+    futs: list = [None] * n
+    sync_idx: list[int] = []
+
+    def submit_all():
+        for i, d in enumerate(drives):
+            fn = getattr(d, "write_all_async", None)
+            if fn is None:
+                sync_idx.append(i)
+                continue
+            try:
+                f = fn(vol, rel, data)
+            except Exception as e:  # noqa: BLE001 - per-drive data
+                futs[i] = e
+                continue
+            if f is None:
+                sync_idx.append(i)  # drive not armed: sync fan-out
+            else:
+                futs[i] = f
+
+    if submits_may_block():
+        # An injected fault may hang the submit call itself: bound the
+        # loop; a wedged loop degrades every drive to the deadline'd
+        # sync fan-out (a duplicate store is idempotent — same bytes).
+        if not run_bounded(submit_all, deadline):
+            futs = [None] * n
+            sync_idx = list(range(n))
+    else:
+        submit_all()
+
+    outcomes: list = [None] * n
+    if sync_idx:
+        sync_out = parallel_map(
+            [lambda d=drives[i]: d.write_all(vol, rel, data)
+             for i in sync_idx],
+            deadline=deadline)
+        for i, out in zip(sync_idx, sync_out):
+            outcomes[i] = out
+    end = time.monotonic() + deadline
+    for i, f in enumerate(futs):
+        if f is None:
+            continue
+        if isinstance(f, Exception):
+            outcomes[i] = f
+            continue
+        try:
+            f.result(timeout=max(0.0, end - time.monotonic()))
+        except _FutTimeout:
+            outcomes[i] = se.OperationTimedOut(
+                msg="wal blob commit exceeded deadline")
+        except Exception as e:  # noqa: BLE001 - per-drive data
+            outcomes[i] = e
+    return outcomes
 
 
 class SysConfigStore:
@@ -77,11 +159,13 @@ class SysConfigStore:
         return data
 
     def write_sys_config(self, path: str, data: bytes) -> None:
+        # Blob lane: scanner checkpoints / usage docs / config rides
+        # the per-drive group commit when armed — background churn
+        # shares the WAL's batched fsync instead of adding a foreground
+        # per-file fsync per drive.
         rel = f"{CONFIG_PREFIX}/{path}"
-        results = parallel_map(
-            [lambda d=d: d.write_all(SYS_VOL, rel, data) for d in self.drives],
-            deadline=self._meta_deadline(),
-        )
+        results = mirror_write_all(self.drives, SYS_VOL, rel, data,
+                                   self._meta_deadline())
         reduce_write_quorum(results, self._write_quorum_meta(), SYS_VOL, path)
 
     def delete_sys_config(self, path: str) -> None:

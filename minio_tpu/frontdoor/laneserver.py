@@ -8,12 +8,14 @@ threads into shared fused-kernel launches.
 
 `LaneClient` runs inside every other worker and implements the subset
 of the `BatchPlane` surface the serving integration points call
-(`accepts_chunk`, `begin_encode`, `digest_chunks`, `decode_blocks`).
-Encode and digest batches ride the ring; reconstructions (rarer, and
-already coalesced per-process under failure) stay on the local plane.
-Every ring miss — oversized batch, no free slot, timeout, server dead —
-falls back to the local plane: the ring is throughput, never
-correctness (docs/FRONTDOOR.md).
+(`accepts_chunk`, `begin_encode`, `digest_chunks`, `decode_blocks`,
+`begin_reconstruct`). Encode, digest and heal-shaped reconstruct
+batches ride the ring (OP_RECONSTRUCT: one failure pattern per batch,
+so a whole-set heal running in ANY worker coalesces into the owner's
+lanes); mixed-pattern GET decodes (already coalesced per-process under
+failure) stay on the local plane. Every ring miss — oversized batch,
+no free slot, timeout, server dead — falls back to the local plane:
+the ring is throughput, never correctness (docs/FRONTDOOR.md).
 """
 
 from __future__ import annotations
@@ -102,6 +104,79 @@ class _PendingRingEncode:
         return out_chunks, out_digs
 
 
+def _pack_recon_meta(survivors, targets, block_lens) -> bytes:
+    """Meta chunk for an OP_RECONSTRUCT request: [u8 n_surv][surv*]
+    [u8 n_tgt][tgt*][u32 block_len]* — positions fit u8 (n <= 256)."""
+    import struct
+
+    return struct.pack(
+        f"<B{len(survivors)}BB{len(targets)}B{len(block_lens)}I",
+        len(survivors), *survivors, len(targets), *targets, *block_lens)
+
+
+def _unpack_recon_meta(meta):
+    import struct
+
+    ns = meta[0]
+    survivors = tuple(meta[1:1 + ns])
+    off = 1 + ns
+    nt = meta[off]
+    targets = tuple(meta[off + 1:off + 1 + nt])
+    off += 1 + nt
+    nlens = (len(meta) - off) // 4
+    block_lens = list(struct.unpack_from(f"<{nlens}I", meta, off))
+    return survivors, targets, block_lens
+
+
+class _PendingRingReconstruct:
+    """PendingDecode-shaped handle for a ring-submitted reconstruct:
+    wait() polls the slot and rebuilds the (rebuilt chunk rows, digest
+    rows) contract; any ring fault falls back to the local plane."""
+
+    def __init__(self, client: "LaneClient", slot: int, seq: int,
+                 k: int, m: int, block_size: int, shard_chunks,
+                 block_lens, targets: tuple, with_digests: bool):
+        self._c = client
+        self._slot = slot
+        self._seq = seq
+        self._k = k
+        self._m = m
+        self._bs = block_size
+        self._rows = shard_chunks
+        self._lens = block_lens
+        self.targets = targets
+        self._digests = with_digests
+
+    def _fallback(self):
+        pend = self._c.local().begin_reconstruct(
+            self._k, self._m, self._bs, self._rows, self._lens,
+            self.targets, with_digests=self._digests)
+        return pend.wait()
+
+    def wait(self):
+        resp = self._c._await_slot(self._slot, self._seq)
+        if resp is None:
+            self._c._note_fallback("timeout")
+            return self._fallback()
+        t = len(self.targets)
+        out_chunks: list[list[bytes]] = []
+        out_digs: list[list[bytes]] | None = [] if self._digests else None
+        pmv = memoryview(resp)
+        off = 0
+        for bl in self._lens:
+            s = _ceil_div(bl, self._k)
+            row = []
+            for _ti in range(t):
+                row.append(pmv[off:off + s].tobytes())
+                off += s
+            out_chunks.append(row)
+            if out_digs is not None:
+                out_digs.append([pmv[off + i * 32:off + (i + 1) * 32]
+                                 .tobytes() for i in range(t)])
+                off += t * 32
+        return out_chunks, out_digs
+
+
 class LaneClient:
     """Ring-side stand-in for the process BatchPlane (non-owner
     workers). Not a subclass — it forwards everything it does not
@@ -130,6 +205,9 @@ class LaneClient:
 
     def accepts_chunk(self, s: int) -> bool:
         return self.local().accepts_chunk(s)
+
+    def accepts_recon_chunk(self, s: int) -> bool:
+        return self.local().accepts_recon_chunk(s)
 
     def decode_blocks(self, *a, **kw):
         return self.local().decode_blocks(*a, **kw)
@@ -215,6 +293,66 @@ class LaneClient:
             return self.local().digest_chunks(chunks, cap)
         dmv = memoryview(resp)
         return [dmv[i * 32:(i + 1) * 32] for i in range(len(chunks))]
+
+    def begin_reconstruct(self, k: int, m: int, block_size: int,
+                          shard_chunks: list, block_lens: list,
+                          targets, with_digests: bool = False):
+        """Heal-shaped reconstruct over the ring: one failure pattern
+        per batch; per-block survivor rows ride as concatenated chunks
+        behind a meta chunk. Any miss falls back to the local plane."""
+        targets = tuple(targets)
+        n = k + m
+        if not shard_chunks or not targets:
+            return self.local().begin_reconstruct(
+                k, m, block_size, shard_chunks, block_lens, targets,
+                with_digests=with_digests)
+        survivors = tuple(
+            i for i in range(n) if shard_chunks[0][i] is not None)[:k]
+        rows = []
+        for bi, row in enumerate(shard_chunks):
+            s = _ceil_div(block_lens[bi], k)
+            buf = bytearray(k * s)
+            ok = len(row) == n
+            for ci, si in enumerate(survivors):
+                c = row[si] if ok and row[si] is not None else None
+                if c is None or len(c) != s:
+                    ok = False
+                    break
+                buf[ci * s:(ci + 1) * s] = c
+            if not ok:
+                # Ragged/mismatched pattern: the local plane validates
+                # and serves (shared-lane coalescing is best-effort).
+                return self.local().begin_reconstruct(
+                    k, m, block_size, shard_chunks, block_lens, targets,
+                    with_digests=with_digests)
+            rows.append(buf)
+        meta = _pack_recon_meta(survivors, targets, block_lens)
+        chunks = [meta] + rows
+        t = len(targets)
+        need_resp = sum((_ceil_div(bl, k) * t
+                         + (t * 32 if with_digests else 0))
+                        for bl in block_lens)
+        if (shm.chunks_size(chunks) > self.ring.req_cap
+                or need_resp > self.ring.resp_cap):
+            self._note_fallback("oversize")
+            return self.local().begin_reconstruct(
+                k, m, block_size, shard_chunks, block_lens, targets,
+                with_digests=with_digests)
+        got = self._acquire()
+        if got is None:
+            self._note_fallback("no_slot")
+            return self.local().begin_reconstruct(
+                k, m, block_size, shard_chunks, block_lens, targets,
+                with_digests=with_digests)
+        slot, seq = got
+        req_len = shm.pack_chunks(self.ring.req_view(slot), chunks)
+        flags = shm.FLAG_DIGESTS if with_digests else 0
+        self.ring.publish(slot, shm.OP_RECONSTRUCT, flags, k, m, seq,
+                          len(chunks), req_len)
+        _RING_SUBMITS.labels(worker=self._wlabel, op="reconstruct").inc()
+        return _PendingRingReconstruct(self, slot, seq, k, m, block_size,
+                                       shard_chunks, block_lens, targets,
+                                       with_digests)
 
     def begin_encode(self, k: int, m: int, block_size: int,
                      blocks: list, with_digests: bool = False):
@@ -314,6 +452,9 @@ class LaneServer:
                 elif op == shm.OP_ENCODE:
                     resp_len = self._do_encode(
                         i, reqs, k, m, bool(flags & shm.FLAG_DIGESTS))
+                elif op == shm.OP_RECONSTRUCT:
+                    resp_len = self._do_reconstruct(
+                        i, reqs, k, m, bool(flags & shm.FLAG_DIGESTS))
                 else:
                     raise ValueError(f"unknown ring op {op}")
             except Exception as e:  # noqa: BLE001 - travels to the
@@ -325,7 +466,9 @@ class LaneServer:
             self.ring.respond(i, seq, resp_len, ok=True)
             _RING_SERVED.labels(
                 worker=self._wlabel,
-                op="digest" if op == shm.OP_DIGEST else "encode").inc()
+                op={shm.OP_DIGEST: "digest",
+                    shm.OP_ENCODE: "encode",
+                    shm.OP_RECONSTRUCT: "reconstruct"}[op]).inc()
         finally:
             with self._mu:
                 self._inflight.discard(i)
@@ -351,6 +494,34 @@ class LaneServer:
             for j in range(m):
                 out[off:off + s] = chunk_rows[bi][k + j]
                 off += s
+            if with_digests:
+                for d in dig_rows[bi]:
+                    out[off:off + 32] = d
+                    off += 32
+        return off
+
+    def _do_reconstruct(self, i: int, reqs: list, k: int, m: int,
+                        with_digests: bool) -> int:
+        survivors, targets, block_lens = _unpack_recon_meta(reqs[0])
+        n = k + m
+        shard_chunks = []
+        for bi, row_buf in enumerate(reqs[1:]):
+            s = _ceil_div(block_lens[bi], k)
+            row: list = [None] * n
+            for ci, si in enumerate(survivors):
+                row[si] = row_buf[ci * s:(ci + 1) * s]
+            shard_chunks.append(row)
+        bs = max(block_lens)
+        pend = self.plane().begin_reconstruct(
+            k, m, bs, shard_chunks, block_lens, targets,
+            with_digests=with_digests)
+        chunk_rows, dig_rows = pend.wait()
+        out = self.ring.resp_view(i)
+        off = 0
+        for bi, row in enumerate(chunk_rows):
+            for c in row:
+                out[off:off + len(c)] = c
+                off += len(c)
             if with_digests:
                 for d in dig_rows[bi]:
                     out[off:off + 32] = d

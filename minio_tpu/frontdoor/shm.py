@@ -51,7 +51,11 @@ _SLOT = struct.Struct("<BBBBBxxxQIIIQ")
 _SLOT_SIZE = 64
 
 FREE, SUBMITTED, DONE, ERROR, ABANDONED = 0, 1, 2, 3, 4
-OP_DIGEST, OP_ENCODE = 1, 2
+# OP_RECONSTRUCT (PR 12): heal/degraded-GET rebuilds ride the ring too
+# — one failure pattern per batch (the heal shape); the request carries
+# a meta chunk (survivors, targets, block lens) ahead of the per-block
+# survivor rows, the response the rebuilt target chunks (+ digests).
+OP_DIGEST, OP_ENCODE, OP_RECONSTRUCT = 1, 2, 3
 FLAG_DIGESTS = 1
 
 _U32 = struct.Struct("<I")

@@ -20,10 +20,13 @@ Three pieces:
   FileInfo cache consulted by GET/HEAD before the per-drive fan-out,
   validated against per-local-drive journal signatures.
 
-Opt-in via `MTPU_METAPLANE=1`; the per-request write+fsync+rename path
-remains both the fallback and the correctness oracle. WAL replay on
-drive mount runs regardless of the gate (a journal left by a crashed
-armed process must converge even if the next boot is unarmed).
+ON BY DEFAULT since the pipeline convergence (PR 12): the env gate is
+opt-OUT — `MTPU_METAPLANE=0` restores the per-request
+write+fsync+rename path, which survives as the fallback and the
+correctness oracle (the chaos-storm oracle runs are its remaining
+deployment). WAL replay on drive mount runs regardless of the gate (a
+journal left by a crashed armed process must converge even if the next
+boot is unarmed).
 Committer threads are session-lived daemons named `mtpu-metaplane-*`
 (exempted in utils/sanitize.py).
 """
@@ -36,8 +39,9 @@ ENABLE_ENV = "MTPU_METAPLANE"
 
 
 def enabled() -> bool:
-    """Read the env gate live — cheap, and tests flip it per-case."""
-    return os.environ.get(ENABLE_ENV, "") in ("1", "true", "on")
+    """Read the env gate live — cheap, and tests flip it per-case.
+    Default ON; "0"/"false"/"off" opts out (per-request oracle)."""
+    return os.environ.get(ENABLE_ENV, "1") not in ("0", "false", "off")
 
 
 def wal_max_bytes() -> int:

@@ -46,6 +46,7 @@ from concurrent.futures import Future
 
 from minio_tpu import metaplane, obs
 from minio_tpu.metaplane import wal as walfmt
+from minio_tpu.utils import admission
 from minio_tpu.utils import errors as se
 
 _COMMITS = obs.counter(
@@ -69,6 +70,15 @@ _WAL_BYTES = obs.gauge(
 _seq_lock = threading.Lock()
 _seq = 0
 
+# Same-process segment ownership: the single-writer contract is per
+# PROCESS (the flock enforces it across processes), so a LocalDrive
+# re-mounted over the same root in one process — the restart pattern
+# every format/heal bootstrap uses — gracefully takes the segment over
+# by closing its predecessor (drain + checkpoint + flock release)
+# instead of refusing with a duplicate-owner error.
+_live_mu = threading.Lock()
+_live_by_path: dict = {}
+
 
 def _next_seq() -> int:
     global _seq
@@ -79,16 +89,22 @@ def _next_seq() -> int:
 
 class Entry:
     """One pending (committed-but-not-materialized) journal state.
-    `raw is None` means the journal was deleted (tombstone)."""
+    `raw is None` means the journal was deleted (tombstone). `blob`
+    marks a raw sys-file record (REC_BLOB): `path` is then the file
+    path itself and materialization writes the bytes verbatim — meta
+    readers (`pending_entry`) never see blob entries and blob readers
+    (`pending_blob`) never see journal entries."""
 
-    __slots__ = ("lsn", "raw", "meta", "memo", "mt")
+    __slots__ = ("lsn", "raw", "meta", "memo", "mt", "blob")
 
-    def __init__(self, lsn: int, raw, meta, mt: float):
+    def __init__(self, lsn: int, raw, meta, mt: float,
+                 blob: bool = False):
         self.lsn = lsn
         self.raw = raw
         self.meta = meta
         self.memo: dict = {}
         self.mt = mt
+        self.blob = blob
 
     @property
     def removed(self) -> bool:
@@ -189,13 +205,31 @@ def _apply_fold(drive, final) -> "tuple[int, int]":
     failed = 0
     for (vol, path), rec in final.items():
         stat_err = False
+        blob = rec.rtype in (walfmt.REC_BLOB, walfmt.REC_BLOB_REMOVE)
         try:
-            disk_mt = drive._disk_meta_mt(vol, path)
+            # Blob records tiebreak against the blob FILE's mtime; the
+            # journal records against the meta.mp under the key.
+            disk_mt = (drive._disk_blob_mt(vol, path) if blob
+                       else drive._disk_meta_mt(vol, path))
         except se.StorageError:
             disk_mt = None  # unreadable/corrupt journal: the record wins
             stat_err = True
         if disk_mt is not None and disk_mt > rec.mt + 1e-9:
             continue  # disk is newer (unarmed-session write)
+        if rec.rtype == walfmt.REC_BLOB:
+            try:
+                drive._store_blob_disk(vol, path, rec.raw)
+                applied += 1
+            except se.StorageError:
+                failed += 1
+            continue
+        if rec.rtype == walfmt.REC_BLOB_REMOVE:
+            try:
+                drive._remove_blob_disk(vol, path)
+                applied += 1
+            except se.StorageError:
+                failed += 1
+            continue
         if rec.rtype == walfmt.REC_COMMIT:
             try:
                 meta = XLMeta.parse(rec.raw)  # scan hands out real bytes
@@ -276,6 +310,17 @@ class DriveWAL:
         # keys on; the kernel drops it even on SIGKILL).
         import fcntl
 
+        # In-process predecessor (re-mount over the same root): close
+        # it BEFORE taking the replay lock — its committer may need a
+        # flush that briefly touches the same drive, and its released
+        # flock is what lets the claim below succeed.
+        with _live_mu:
+            prior = _live_by_path.pop(self.path, None)
+        if prior is not None:
+            prior_wal = prior()
+            if prior_wal is not None and not prior_wal._closed:
+                prior_wal.close()
+
         replay_failed = 0
         replay_kept: list = []
         lfd = _replay_lock(self._dir)
@@ -307,6 +352,11 @@ class DriveWAL:
         self._pending: "OrderedDict[tuple[str, str], Entry]" = OrderedDict()
         self._key_lsn: "OrderedDict[tuple[str, str], int]" = OrderedDict()
         self._key_lsn_cap = 65536
+        # Blob keys that may still have a record in the WAL (cleared at
+        # checkpoint — a truncated WAL cannot resurrect anything). None
+        # = cap exceeded: "may exist" degrades to "always forget".
+        self._blob_keys: "set | None" = set()
+        self._blob_keys_cap = 65536
         self._lsn = 0
         self._broken: str | None = None
         self._closed = False
@@ -322,17 +372,26 @@ class DriveWAL:
             for (vol, path), rec in walfmt.fold_merged(
                     replay_kept).items():
                 self._lsn += 1
+                blob = rec.rtype in (walfmt.REC_BLOB,
+                                     walfmt.REC_BLOB_REMOVE)
                 self._pending[(vol, path)] = Entry(
                     self._lsn,
-                    rec.raw if rec.rtype == walfmt.REC_COMMIT else None,
-                    None, rec.mt)
-                self._key_lsn[(vol, path)] = self._lsn
+                    rec.raw if rec.rtype in (walfmt.REC_COMMIT,
+                                             walfmt.REC_BLOB) else None,
+                    None, rec.mt, blob=blob)
+                if not blob:
+                    self._key_lsn[(vol, path)] = self._lsn
 
         self._c_commits = _COMMITS.labels(drive=drive.root)
         self._c_fsyncs = _FSYNCS.labels(drive=drive.root)
         self._h_fill = _BATCH_FILL.labels(drive=drive.root)
         self._g_bytes = _WAL_BYTES.labels(drive=drive.root)
         self._g_bytes.set(self._bytes)
+
+        import weakref
+
+        with _live_mu:
+            _live_by_path[self.path] = weakref.ref(self)
 
         self._thread = threading.Thread(
             target=self._run, daemon=True,
@@ -358,8 +417,14 @@ class DriveWAL:
         try:
             self._q.put_nowait(item)
         except queue.Full:
-            raise se.FaultyDisk("wal commit queue full (backpressure)") \
-                from None
+            # Unified admission: a full WAL queue sheds exactly like a
+            # full dataplane lane — OperationTimedOut -> 503 SlowDown,
+            # one shared shed family (utils/admission.py). Quorum
+            # reducers raise the dominant error, so a set whose drives
+            # all shed surfaces SlowDown, never a 500.
+            raise admission.shed(
+                "metaplane", "wal_full",
+                "wal commit queue full (backpressure)") from None
         return item[-1]
 
     def submit_commit(self, volume: str, path: str, raw, meta) -> Future:
@@ -377,6 +442,67 @@ class DriveWAL:
         lsn = self._bump_lsn((volume, path))
         return self._submit(
             ("remove", volume, path, None, None, time.time(), lsn, Future()))
+
+    def _bump_lsn_only(self) -> int:
+        """LSN for a blob record: orders overlay entries without
+        entering the per-key signature map (blobs have no set-cache
+        signatures to serve)."""
+        with self._mu:
+            self._lsn += 1
+            return self._lsn
+
+    def submit_blob(self, volume: str, path: str, raw) -> Future:
+        """Enqueue a raw sys-file store (multipart part journal,
+        scanner checkpoint, sys-config doc) — the blob lane of the
+        group commit: the ack rides the same shared WAL fsync as
+        journal commits, and the file materializes on idle ticks with
+        NO per-file fsync. `raw` is bytes/memoryview, not copied."""
+        if not isinstance(raw, bytes):
+            # Blob docs are small control files (json/msgpack) and in
+            # practice arrive as bytes already; real bytes keep the
+            # overlay directly servable by read_all and its callers.
+            raw = memoryview(raw).tobytes()
+        lsn = self._bump_lsn_only()
+        with self._mu:
+            if self._blob_keys is not None:
+                self._blob_keys.add((volume, path))
+                if len(self._blob_keys) > self._blob_keys_cap:
+                    self._blob_keys = None  # superset tracking lost
+        return self._submit(
+            ("blob", volume, path, raw, None, time.time(), lsn, Future()))
+
+    def has_blob_state(self, volume: str, path: str) -> bool:
+        """True when the WAL may still carry a record for this blob
+        (pending overlay, or a record appended since the last
+        checkpoint) — the gate for forget_blob, so plain-file deletes
+        of never-journaled files cost nothing."""
+        key = (volume, path)
+        with self._mu:
+            ent = self._pending.get(key)
+            if ent is not None and ent.blob:
+                return True
+            return self._blob_keys is None or key in self._blob_keys
+
+    def forget_blob(self, volume: str, path: str) -> bool:
+        """A blob file was deleted out-of-band (delete_sys_config, part
+        cleanup): drop its overlay entry and log a BLOB_REMOVE so
+        replay cannot resurrect a file whose COMMIT record is still in
+        the WAL. Fire-and-forget like forget_key. Returns True when a
+        LIVE pending entry was dropped — the caller's filesystem
+        remove may then legitimately find no file on disk."""
+        key = (volume, path)
+        dropped = False
+        with self._mu:
+            ent = self._pending.get(key)
+            if ent is not None and ent.blob:
+                dropped = not ent.removed
+                del self._pending[key]
+        try:
+            self._submit(("blob_remove", volume, path, None, None,
+                          time.time(), self._bump_lsn_only(), Future()))
+        except (se.StorageError, se.OperationTimedOut):
+            pass  # broken/full: the stale copy loses the election
+        return dropped
 
     def submit_single(self, volume: str, path: str, fi, raw, meta,
                       defer_reclaim: bool) -> Future:
@@ -416,8 +542,9 @@ class DriveWAL:
         try:
             self._q.put(("flush", fut), timeout=timeout)
         except queue.Full:
-            raise se.FaultyDisk("wal commit queue full (backpressure)") \
-                from None
+            raise admission.shed(
+                "metaplane", "wal_flush_full",
+                "wal commit queue full (backpressure)") from None
         fut.result(timeout=timeout)
 
     def forget_subtree(self, volume: str, prefix: str) -> None:
@@ -442,7 +569,7 @@ class DriveWAL:
         try:
             self._submit(("remove_prefix", volume, prefix, None, None,
                           time.time(), 0, Future()))
-        except se.StorageError:
+        except (se.StorageError, se.OperationTimedOut):
             return  # broken/full: a replay resurrection here is the
             # dangling-object case deep heal already purges
 
@@ -454,16 +581,25 @@ class DriveWAL:
             self._pending.pop((volume, path), None)
         try:
             self.submit_remove(volume, path)
-        except se.StorageError:
+        except (se.StorageError, se.OperationTimedOut):
             return  # as above: heal purges the dangling remnant
 
     # ---------- read overlay (request threads) ----------
 
     def pending_entry(self, volume: str, path: str) -> Entry | None:
         """The committed-but-unmaterialized state for a key, or None
-        when disk is authoritative. `entry.removed` marks deletion."""
+        when disk is authoritative. `entry.removed` marks deletion.
+        Blob entries are invisible here (journal readers only)."""
         with self._mu:
-            return self._pending.get((volume, path))
+            ent = self._pending.get((volume, path))
+            return None if ent is not None and ent.blob else ent
+
+    def pending_blob(self, volume: str, path: str) -> Entry | None:
+        """The committed-but-unmaterialized state of a raw sys file
+        (read_all's overlay), or None when disk is authoritative."""
+        with self._mu:
+            ent = self._pending.get((volume, path))
+            return ent if ent is not None and ent.blob else None
 
     def key_sig(self, volume: str, path: str):
         """Logical journal signature while armed: every mutation bumps
@@ -561,6 +697,12 @@ class DriveWAL:
             elif kind == "remove_prefix":
                 staged.append((walfmt.REC_REMOVE_PREFIX, vol, path, b"",
                                None, mt, lsn, fut, None))
+            elif kind == "blob":
+                staged.append((walfmt.REC_BLOB, vol, path, payload,
+                               None, mt, lsn, fut, None))
+            elif kind == "blob_remove":
+                staged.append((walfmt.REC_BLOB_REMOVE, vol, path, b"",
+                               None, mt, lsn, fut, None))
             else:
                 staged.append((walfmt.REC_REMOVE, vol, path, b"", None,
                                mt, lsn, fut, None))
@@ -603,9 +745,12 @@ class DriveWAL:
                 cur = self._pending.get(key)
                 if cur is not None and cur.lsn > lsn:
                     continue
+                blob = rtype in (walfmt.REC_BLOB, walfmt.REC_BLOB_REMOVE)
                 self._pending[key] = Entry(
-                    lsn, raw if rtype == walfmt.REC_COMMIT else None,
-                    meta, mt)
+                    lsn,
+                    raw if rtype in (walfmt.REC_COMMIT, walfmt.REC_BLOB)
+                    else None,
+                    meta, mt, blob=blob)
                 self._pending.move_to_end(key)
         if self._eager:
             # Cross-process read-your-write: sibling workers have no
@@ -642,7 +787,12 @@ class DriveWAL:
         for key, entry in snapshot:
             vol, path = key
             try:
-                if entry.removed:
+                if entry.blob:
+                    if entry.removed:
+                        self.drive._remove_blob_disk(vol, path)
+                    else:
+                        self.drive._store_blob_disk(vol, path, entry.raw)
+                elif entry.removed:
                     self.drive._remove_meta_disk(vol, path)
                 else:
                     self.drive._store_meta_disk(
@@ -669,6 +819,10 @@ class DriveWAL:
             return
         self._bytes = len(walfmt.MAGIC)
         self._g_bytes.set(self._bytes)
+        with self._mu:
+            # Truncated WAL cannot resurrect any blob: forget tracking
+            # restarts empty (and recovers from a prior cap overflow).
+            self._blob_keys = set()
 
     # ---------- lifecycle ----------
 

@@ -41,6 +41,13 @@ REC_REMOVE = 2
 # rmtree, volume force-delete) destroyed every journal under
 # (volume, path-prefix); replay must drop all EARLIER records there.
 REC_REMOVE_PREFIX = 3
+# Blob records: raw sys files (multipart part journals, scanner
+# checkpoints, sys-config docs) group-committed through the same WAL —
+# `path` is the FILE path (not a journal key) and materialization is a
+# tmp+rename write of the raw bytes with no per-file fsync. The frame
+# format is identical; only the apply side dispatches differently.
+REC_BLOB = 4
+REC_BLOB_REMOVE = 5
 
 _FRAME = struct.Struct("<II")       # payload_len, crc32
 _HEAD = struct.Struct("<BdHHI")     # type, mt, vol_len, path_len, raw_len

@@ -189,25 +189,43 @@ def _sip256_py(key32: bytes, data: bytes) -> bytes:
     return out
 
 
-def sip256(key32: bytes, data: bytes) -> bytes:
+def _cbuf(data):
+    """A c_char_p-compatible borrow of any bytes-like object: bytes
+    pass through, writable buffers (bytearray, np-backed memoryview)
+    are borrowed via from_buffer with zero copy; only a read-only
+    non-bytes view (rare: a slice over client bytes) pays a copy."""
+    if isinstance(data, bytes):
+        return data
+    mv = memoryview(data)
+    if mv.readonly:
+        return mv.tobytes()
+    return ctypes.cast((ctypes.c_char * len(mv)).from_buffer(mv),
+                       ctypes.c_char_p)
+
+
+def sip256(key32: bytes, data) -> bytes:
     lib = _build_and_load()
     if lib is None:
-        return _sip256_py(key32, data)
+        return _sip256_py(key32, bytes(data) if not isinstance(
+            data, bytes) else data)
     out = ctypes.create_string_buffer(32)
-    lib.mtpu_sip256(key32, data, len(data), out)
+    n = len(data)
+    lib.mtpu_sip256(key32, _cbuf(data), n, out)
     return out.raw
 
 
-def highwayhash256(key32: bytes, data: bytes) -> bytes:
+def highwayhash256(key32: bytes, data) -> bytes:
     """HighwayHash-256 (the reference's default bitrot algorithm) via the
     native kernel; pure-Python fallback when the toolchain is absent."""
     lib = _build_and_load()
     if lib is None:
         from minio_tpu.native.hh_py import highwayhash256_py
 
-        return highwayhash256_py(key32, data)
+        return highwayhash256_py(key32, bytes(data) if not isinstance(
+            data, bytes) else data)
     out = ctypes.create_string_buffer(32)
-    lib.mtpu_highwayhash256(key32, data, len(data), out)
+    n = len(data)
+    lib.mtpu_highwayhash256(key32, _cbuf(data), n, out)
     return out.raw
 
 

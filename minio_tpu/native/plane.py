@@ -258,8 +258,17 @@ def decode_range(paths: list[str], k: int, m: int, block_size: int,
     out = ctypes.create_string_buffer(length) if length else b""
     mem_arr = None
     if mem:
+        # Bytearray shards (remote prefetch accumulators) are borrowed
+        # zero-copy, like PartEncoder.feed; the mem dict keeps every
+        # buffer alive across the call.
+        def _cp(b):
+            if b is None or isinstance(b, bytes):
+                return b
+            return ctypes.cast(
+                (ctypes.c_char * len(b)).from_buffer(b), ctypes.c_char_p)
+
         mem_arr = (ctypes.c_char_p * n)(
-            *[mem.get(i) for i in range(n)])
+            *[_cp(mem.get(i)) for i in range(n)])
     t0 = time.perf_counter()
     rc = fns["decode_part"](
         cpaths, avail, k, m, block_size, part_size, gmat, algo, key,

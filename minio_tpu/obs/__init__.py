@@ -49,11 +49,13 @@ from minio_tpu.obs.span import (  # noqa: F401
 
 import time as _time  # noqa: E402
 
-# The four StorageAPI ops carrying the object hot path — the per-drive
+# The StorageAPI ops carrying the object hot path — the per-drive
 # latency family tracks exactly these (reference
-# minio_node_drive_latency_us).
+# minio_node_drive_latency_us). The two *_async entries are the armed
+# metaplane's group-commit twins (submit → shared-fsync resolution),
+# recorded over the full two-phase span.
 DRIVE_OPS = ("read_version", "create_file", "write_metadata_single",
-             "rename_data")
+             "rename_data", "journal_commit_async", "write_all_async")
 
 
 def drive_op_observer(drive: str):

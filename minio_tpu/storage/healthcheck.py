@@ -77,7 +77,8 @@ OP_CLASS = {
     "disk_info": "meta",
     "make_vol": "meta", "stat_vol": "meta", "list_vols": "meta",
     "delete_vol": "meta", "list_dir": "meta",
-    "read_all": "meta", "write_all": "meta", "delete": "meta",
+    "read_all": "meta", "write_all": "meta",
+    "write_all_async": "meta", "delete": "meta",
     "rename_file": "meta",
     "write_metadata": "meta", "write_metadata_single": "meta",
     "journal_commit_async": "meta",
@@ -472,7 +473,7 @@ class HealthChecker:
         if name == "create_file":
             return lambda volume, path, chunks: self._guard_stream_sink(
                 fn, volume, path, chunks)
-        if name == "journal_commit_async":
+        if name in ("journal_commit_async", "write_all_async"):
             # Two-phase group commit: the op guard must span until the
             # WAL fsync resolves the future — a hung fsync walks the
             # drive FAULTY→OFFLINE exactly like a hung sync store.

@@ -24,7 +24,8 @@ CHECK_INTERVAL = 5.0
 
 _GUARDED = {
     "make_vol", "stat_vol", "list_vols", "delete_vol",
-    "list_dir", "walk_dir", "read_all", "write_all", "delete",
+    "list_dir", "walk_dir", "read_all", "write_all", "write_all_async",
+    "delete",
     "create_file", "append_file", "read_file_stream",
     "read_file_range_stream", "rename_file",
     "write_metadata", "write_metadata_single", "journal_commit_async",

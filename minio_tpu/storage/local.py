@@ -311,8 +311,14 @@ class LocalDrive(StorageAPI):
         d = self._vol_dir(volume)
         self._vol_ok.pop(volume, None)
         self._fresh_vols.pop(volume, None)
-        if self._wal is not None and force:
-            self._wal.forget_subtree(volume, "")
+        if self._wal is not None:
+            if force:
+                self._wal.forget_subtree(volume, "")
+            else:
+                # The emptiness check below is the FILESYSTEM's rmdir:
+                # acked journals still in the group-commit overlay must
+                # materialize first or a non-empty bucket would delete.
+                self._wal.flush()
         try:
             if force:
                 shutil.rmtree(d)
@@ -341,7 +347,76 @@ class LocalDrive(StorageAPI):
         except OSError as e:
             raise se.FaultyDisk(str(e)) from e
 
+    def write_all_async(self, volume: str, path: str, data: bytes):
+        """Two-phase write_all through the group-commit plane: the
+        returned future resolves after the shared WAL fsync covering
+        the record (durability is the WAL, not a per-file fsync); the
+        file itself materializes on idle ticks / flush barriers. None
+        when the WAL is not armed — callers fall back to write_all.
+        This is the blob lane sys-file traffic rides (multipart part
+        journals, scanner checkpoints, sys-config docs) so background
+        churn stops paying a foreground fsync per file per drive."""
+        if self._wal is None:
+            return None
+        self.stat_vol(volume)
+        self._file_path(volume, path)  # validate before journaling
+        t0 = time.perf_counter()
+        fut = self._wal.submit_blob(volume, path, data)
+
+        def _done(f, t0=t0):
+            # The callback runs in the committer thread; ctx_wrap binds
+            # the SUBMITTING request's trace context so the storage
+            # record lands in the right trace.
+            self._note_sync(time.perf_counter() - t0)
+            self._observe_op("write_all_async", t0, volume, path,
+                             f.exception())
+
+        fut.add_done_callback(obs.ctx_wrap(_done))
+        return fut
+
+    def _store_blob_disk(self, volume: str, path: str, raw) -> None:
+        """Materialize a WAL blob record: tmp+rename, NO fsync (the WAL
+        carries durability until checkpoint)."""
+        fp = self._file_path(volume, path)
+        os.makedirs(os.path.dirname(fp), exist_ok=True)
+        tmp = fp + f".tmp.{uuid.uuid4().hex}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(raw)
+            os.replace(tmp, fp)
+        except OSError as e:
+            raise se.FaultyDisk(str(e)) from e
+
+    def _remove_blob_disk(self, volume: str, path: str) -> None:
+        fp = self._file_path(volume, path)
+        try:
+            os.remove(fp)
+        except FileNotFoundError:
+            pass
+        except OSError as e:
+            raise se.FaultyDisk(str(e)) from e
+        self._prune_empty_parents(os.path.dirname(fp), volume)
+
+    def _disk_blob_mt(self, volume: str, path: str) -> "float | None":
+        """mtime of the ON-DISK blob file, None when absent — the WAL
+        replay tiebreak for blob records (mirrors _disk_meta_mt)."""
+        try:
+            return os.stat(self._file_path(volume, path)).st_mtime
+        except (FileNotFoundError, NotADirectoryError):
+            return None
+        except OSError as e:
+            raise se.FaultyDisk(str(e)) from e
+
     def read_all(self, volume: str, path: str) -> bytes:
+        if self._wal is not None:
+            pe = self._wal.pending_blob(volume, path)
+            if pe is not None:
+                # Committed-but-unmaterialized blob: the overlay IS the
+                # file (read-your-write the instant the group fsync
+                # acks — multipart part elections, scanner resume).
+                if pe.removed:
+                    raise se.FileNotFound(f"{volume}/{path}")
+                return pe.raw
         fp = self._file_path(volume, path)
         try:
             with open(fp, "rb") as f:
@@ -355,6 +430,7 @@ class LocalDrive(StorageAPI):
 
     def delete(self, volume: str, path: str, recursive: bool = False) -> None:
         fp = self._file_path(volume, path)
+        wal_blob_pending = False
         if self._wal is not None:
             # The tree (or journal) vanishes out-of-band: drop any WAL
             # overlay underneath it and log REMOVEs so replay cannot
@@ -366,6 +442,12 @@ class LocalDrive(StorageAPI):
                 # NESTED keys ('a/b/c' under 'a/b') this delete never
                 # touches.
                 self._wal.forget_key(volume, os.path.dirname(path))
+            elif self._wal.has_blob_state(volume, path):
+                # A blob whose COMMIT record may still sit in the WAL
+                # (part journal, sys-config doc): tombstone it so
+                # replay cannot resurrect the deleted file. Plain files
+                # that never rode the blob lane skip this entirely.
+                wal_blob_pending = self._wal.forget_blob(volume, path)
         try:
             if recursive:
                 shutil.rmtree(fp)
@@ -374,6 +456,8 @@ class LocalDrive(StorageAPI):
             else:
                 os.remove(fp)
         except FileNotFoundError:
+            if wal_blob_pending:
+                return  # the file only ever existed in the WAL overlay
             raise se.FileNotFound(f"{volume}/{path}") from None
         except OSError as e:
             if e.errno == errno.ENOTEMPTY:
@@ -786,8 +870,18 @@ class LocalDrive(StorageAPI):
         t0 = time.perf_counter()
         fut = self._wal.submit_single(volume, path, fi, raw, meta,
                                       defer_reclaim)
-        fut.add_done_callback(
-            lambda _f, t0=t0: self._note_sync(time.perf_counter() - t0))
+
+        def _done(f, t0=t0):
+            # Committer-thread callback with the submitting request's
+            # trace context: the commit's per-drive latency + `storage`
+            # trace record stay attributable exactly like the sync
+            # store's (the armed default must not lose the op from the
+            # request trace).
+            self._note_sync(time.perf_counter() - t0)
+            self._observe_op("journal_commit_async", t0, volume, path,
+                             f.exception())
+
+        fut.add_done_callback(obs.ctx_wrap(_done))
         return fut
 
     def _write_metadata_single(self, volume: str, path: str, fi: FileInfo,

@@ -1,0 +1,38 @@
+"""Unified admission control for the converged batch pipeline.
+
+Both batch planes are bounded queues — the device data plane's lane
+submission queue (dataplane/batcher.py) and the metadata plane's
+per-drive WAL commit queue (metaplane/groupcommit.py). When either
+fills, the front door must DEGRADE, not buffer or deadlock, and it must
+degrade the same way regardless of which plane saturated: the submit is
+rejected with `OperationTimedOut`, which the S3 error map renders as
+503 SlowDown (the retryable S3 contract), and the shed is counted in
+ONE metric family keyed by (plane, cause) so operators see saturation
+as a single signal instead of two plane-specific dialects.
+
+This module is deliberately tiny: it owns the shared metric and the
+error construction, nothing else — the planes keep their own queue
+mechanics.
+"""
+
+from __future__ import annotations
+
+from minio_tpu import obs
+from minio_tpu.utils import errors as se
+
+_SHED = obs.counter(
+    "minio_tpu_admission_shed_total",
+    "Requests shed at a full batch-plane admission queue "
+    "(surfaces as 503 SlowDown)",
+    ("plane", "cause"))
+
+
+def shed(plane: str, cause: str, msg: str) -> se.OperationTimedOut:
+    """Count one shed and build the typed rejection. The caller raises
+    the returned error (returning it keeps `raise ... from None` at the
+    call site, where the queue.Full context lives).
+
+    plane: "dataplane" | "metaplane"; cause: a short stable slug
+    ("lane_full", "wal_full", "wal_flush_full", "closed")."""
+    _SHED.labels(plane=plane, cause=cause).inc()
+    return se.OperationTimedOut(msg=msg)

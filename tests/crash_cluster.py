@@ -79,6 +79,11 @@ class Cluster:
 
     def env(self) -> dict:
         env = dict(os.environ)
+        # A leaked per-test gate override (monkeypatch active while the
+        # session fixture boots) must not flip the cluster off its
+        # defaults-on posture.
+        env.pop("MTPU_BATCHED_DATAPLANE", None)
+        env.pop("MTPU_METAPLANE", None)
         env.update({
             "MTPU_ROOT_USER": ACCESS,
             "MTPU_ROOT_PASSWORD": SECRET,
@@ -91,20 +96,13 @@ class Cluster:
             "MTPU_FAULT_INJECTION": "1",
             "MTPU_CHAOS_DRIVE_WRAP": "1",
             "MTPU_MRF_RETRY_INTERVAL": "0.2",
-            # Batched device data plane ON for the whole crash/chaos
-            # tier: the tier-1 storm's SIGKILL lands while coalesced
-            # encode batches are in flight, so zero-lost-acknowledged-
-            # write is proven WITH the plane serving (docs/DATAPLANE.md;
-            # an ack only ever follows the commit, which only follows
-            # the batch's futures resolving).
-            "MTPU_BATCHED_DATAPLANE": "1",
-            # Group-commit metadata plane ON for the whole crash/chaos
-            # tier: the storm's SIGKILL lands between WAL-append, the
-            # shared fsync, and materialization, so zero-lost-
-            # acknowledged-write is proven WITH group commit serving
-            # (docs/METAPLANE.md; an ack only ever follows the WAL
-            # fsync, and replay-on-mount restores acked journals).
-            "MTPU_METAPLANE": "1",
+            # Both batch planes run at their DEFAULTS — on since the
+            # pipeline convergence (PR 12) — so the tier-1 storm's
+            # SIGKILL lands mid-coalesced-batch and between WAL-append/
+            # shared-fsync/materialize exactly as production would see
+            # it: zero-lost-acknowledged-write is proven with the
+            # default pipeline serving, no special arming. (The
+            # per-request oracle deployment is MTPU_*=0.)
             # Tight drive deadlines: an injected hang must walk the
             # drive FAULTY→OFFLINE within the bounded storm window
             # (deadlines stay adaptive — a genuinely slow sandbox

@@ -312,17 +312,21 @@ def _actuators(cl) -> dict:
 
 def _converge(cl, bucket: str, seed: int, lgr, workload,
               heal_timeout: float = 240) -> None:
-    """Post-storm: clear residual faults, wait the fleet healthy, then
+    """Post-storm: wait the fleet healthy, clear residual faults, then
     assert every invariant — all with the seed in the failure text."""
-    # Residual fault sweep on every live node (belt and braces: the
-    # program clears its own faults, an aborted storm might not have).
-    for i in range(N_NODES):
-        if cl.procs[i] is not None:
-            cl.clear_faults(i)
+    # Every node serving FIRST: a node the storm restarted in its last
+    # seconds may still be booting (WAL mount replay + jax init), and
+    # posting /faults at it would read as a refused connection, not a
+    # storm failure. /minio/health/live never fans out, so residual
+    # network faults cannot wedge this wait.
     for i in range(N_NODES):
         if cl.procs[i] is None:
             cl.start(i)
         cl.wait_healthy(i)
+    # Residual fault sweep (belt and braces: the program clears its own
+    # faults, an aborted storm might not have).
+    for i in range(N_NODES):
+        cl.clear_faults(i)
     wait_drives_online(cl, N_NODES * DRIVES_PER_NODE, timeout=120)
 
     # In-storm torn reads / ghost reads: must be zero.

@@ -306,19 +306,28 @@ def test_deep_verify_routes_through_plane(tmp_path, monkeypatch):
         dataplane.reset_global()
 
 
-def test_plane_disabled_by_default():
-    assert dataplane.maybe_plane() is None or dataplane.enabled()
+def test_plane_enabled_by_default(monkeypatch):
+    """Since the pipeline convergence the gate is opt-OUT: unset means
+    ON, and "0" restores the per-object oracle."""
+    monkeypatch.delenv("MTPU_BATCHED_DATAPLANE", raising=False)
+    assert dataplane.enabled()
+    monkeypatch.setenv("MTPU_BATCHED_DATAPLANE", "0")
+    assert not dataplane.enabled()
+    assert dataplane.maybe_plane() is None
 
 
-def test_crash_cluster_arms_dataplane(tmp_path):
-    """The shared OS-process cluster boots every node with the plane ON
-    — the tier-1 chaos storm (test_chaos.py: hung drive + partition +
-    real SIGKILL under a mixed workload) therefore proves
-    zero-lost-acknowledged-write with coalesced batches in flight."""
+def test_crash_cluster_runs_plane_defaults(tmp_path):
+    """The shared OS-process cluster boots every node on the DEFAULT
+    gates (planes on) — the tier-1 chaos storm (test_chaos.py: hung
+    drive + partition + real SIGKILL under a mixed workload) proves
+    zero-lost-acknowledged-write with the default pipeline serving,
+    and a leaked per-test "0" override cannot flip it off."""
     from tests.crash_cluster import Cluster
 
     cl = Cluster(tmp_path)
-    assert cl.env().get("MTPU_BATCHED_DATAPLANE") == "1"
+    env = cl.env()
+    assert env.get("MTPU_BATCHED_DATAPLANE") is None
+    assert env.get("MTPU_METAPLANE") is None
 
 
 # ---------------------------------------------------------------------------

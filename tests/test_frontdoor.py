@@ -111,6 +111,36 @@ def test_ring_digest_and_encode_bitexact(lane_ring):
                 [bytes(d) for d in odigs[bi]]
 
 
+def test_ring_reconstruct_bitexact(lane_ring):
+    """OP_RECONSTRUCT (PR 12): the heal shape — one failure pattern
+    per batch — rides the ring bit-exact vs the in-process plane, with
+    the rebuilt chunks' digests."""
+    from minio_tpu import dataplane
+
+    _ring, _server, client = lane_ring
+    oracle = dataplane.get_plane()
+    k, m, bs = 4, 2, 1 << 16
+    n = k + m
+    from minio_tpu.erasure.codec import ErasureCodec
+
+    codec = ErasureCodec(k, m, bs)
+    blocks = [os.urandom(sz) for sz in (40_000, 65_536, 123)]
+    lens = [len(b) for b in blocks]
+    enc = codec.encode_blocks(blocks)
+    targets = (1, 4)
+    rows = [[None if i in targets else bytes(row[i]) for i in range(n)]
+            for row in enc]
+    got, gdig = client.begin_reconstruct(
+        k, m, bs, rows, lens, targets, with_digests=True).wait()
+    want, wdig = oracle.begin_reconstruct(
+        k, m, bs, rows, lens, targets, with_digests=True).wait()
+    for bi in range(len(blocks)):
+        assert [bytes(c) for c in got[bi]] == \
+            [bytes(c) for c in want[bi]]
+        assert [bytes(d) for d in gdig[bi]] == \
+            [bytes(d) for d in wdig[bi]]
+
+
 def test_ring_oversize_falls_back_local(lane_ring):
     _ring, _server, client = lane_ring
     big = [os.urandom(1 << 20)] * 2  # > req_cap of the default slot

@@ -28,9 +28,23 @@ def er(tmp_path):
     er.close()
 
 
+def flush_wal(er):
+    """Materialize every drive's WAL overlay onto the filesystem: these
+    tests damage drives OUT-OF-BAND (rmtree/truncate straight on disk),
+    which models external corruption of at-rest state — the journals
+    must actually BE at rest first (the armed default keeps them in the
+    group-commit overlay between idle ticks)."""
+    for d in er.drives:
+        wal = getattr(d, "_wal", None)
+        if wal is not None:
+            wal.flush()
+
+
 def put(er, name, data, **opts):
-    return er.put_object("bkt", name, io.BytesIO(data), len(data),
+    info = er.put_object("bkt", name, io.BytesIO(data), len(data),
                          ObjectOptions(**opts) if opts else None)
+    flush_wal(er)
+    return info
 
 
 def get_all(er, name, **opts):
@@ -133,6 +147,7 @@ def test_heal_delete_marker(er):
     put(er, "obj", DATA, versioned=True)
     info = er.delete_object("bkt", "obj", ObjectOptions(versioned=True))
     assert info.delete_marker
+    flush_wal(er)  # the marker journal must be at rest before the wipe
     # Drop the whole journal on two drives; marker must be re-propagated.
     for d in er.drives[:2]:
         wipe_object_on(d, "bkt", "obj")

@@ -195,7 +195,7 @@ def test_checkpoint_truncates_wal(tmp_path, monkeypatch):
     finally:
         d.close_wal()
     # Remount replays nothing and state is intact.
-    monkeypatch.delenv("MTPU_METAPLANE")
+    monkeypatch.setenv("MTPU_METAPLANE", "0")
     d2 = LocalDrive(str(tmp_path / "d0"))
     assert d2.read_version("bkt", "k7").size == 256
 
@@ -215,7 +215,7 @@ def test_replay_on_unarmed_mount(tmp_path, monkeypatch):
     # committer's flock correctly blocks replay from its segment).
     d._wal.abandon()
     del d
-    monkeypatch.delenv("MTPU_METAPLANE")
+    monkeypatch.setenv("MTPU_METAPLANE", "0")
     monkeypatch.delenv("MTPU_WAL_LAZY_MATERIALIZE")
     d2 = LocalDrive(str(tmp_path / "d0"))
     assert mp.exists(), "unarmed mount must still replay the WAL"
@@ -225,10 +225,11 @@ def test_replay_on_unarmed_mount(tmp_path, monkeypatch):
     assert os.path.getsize(wal_path) <= len(walfmt.MAGIC)
 
 
-def test_replay_mt_guard_keeps_newer_disk_state(tmp_path):
+def test_replay_mt_guard_keeps_newer_disk_state(tmp_path, monkeypatch):
     """A stale WAL record (armed session crashed) must not clobber a
     journal an UNARMED session wrote afterwards: the mod-time tiebreak
     keeps the newer on-disk state."""
+    monkeypatch.setenv("MTPU_METAPLANE", "0")  # unarmed by design
     from minio_tpu.metaplane import groupcommit
     from minio_tpu.storage.local import LocalDrive
 
@@ -269,7 +270,7 @@ def test_rmtree_subtree_not_resurrected_by_replay(tmp_path, monkeypatch):
     d.delete("bkt", "a", recursive=True)
     d._wal.flush()
     del d  # crash: tombstone is durable with the next batch fsync
-    monkeypatch.delenv("MTPU_METAPLANE")
+    monkeypatch.setenv("MTPU_METAPLANE", "0")
     d2 = LocalDrive(str(tmp_path / "d0"))
     with pytest.raises(se.FileNotFound):
         d2.read_version("bkt", "a/b")
@@ -292,7 +293,7 @@ def test_forget_key_spares_nested_keys(tmp_path, monkeypatch):
         d.read_version("bkt", "a/b")
     assert d.read_version("bkt", "a/b/c").inline_data == b"nested"
     del d  # crash: replay must preserve exactly this split
-    monkeypatch.delenv("MTPU_METAPLANE")
+    monkeypatch.setenv("MTPU_METAPLANE", "0")
     d2 = LocalDrive(str(tmp_path / "d0"))
     with pytest.raises(se.FileNotFound):
         d2.read_version("bkt", "a/b")
@@ -320,7 +321,7 @@ def test_replay_applies_acked_remove_over_corrupt_journal(tmp_path,
     mp.write_bytes(b"torn-garbage")
     d._wal.abandon()  # SIGKILL-faithful: flock released, nothing flushed
     del d
-    monkeypatch.delenv("MTPU_METAPLANE")
+    monkeypatch.setenv("MTPU_METAPLANE", "0")
     monkeypatch.delenv("MTPU_WAL_LAZY_MATERIALIZE")
     d2 = LocalDrive(str(tmp_path / "d0"))
     assert not mp.exists(), "acked REMOVE left a corrupt journal behind"
@@ -341,7 +342,7 @@ def test_replay_keeps_wal_when_apply_fails(tmp_path, monkeypatch):
     d.write_metadata("bkt", "stuck", _mk_fi("bkt", "stuck", b"keep-me"))
     d._wal.abandon()
     del d  # crash with the record only in the WAL
-    monkeypatch.delenv("MTPU_METAPLANE")
+    monkeypatch.setenv("MTPU_METAPLANE", "0")
     monkeypatch.delenv("MTPU_WAL_LAZY_MATERIALIZE")
 
     wal_path = str(tmp_path / "d1" / ".mtpu.sys" / "wal" / "journal.wal")
@@ -483,7 +484,7 @@ def test_e2e_bitexact_against_unarmed_oracle(tmp_path, monkeypatch):
     for d in drives:
         d.close_wal()
 
-    monkeypatch.delenv("MTPU_METAPLANE")
+    monkeypatch.setenv("MTPU_METAPLANE", "0")
     oracle = ErasureObjects([LocalDrive(r) for r in roots], parity=2)
     try:
         for name, body in bodies.items():
@@ -504,6 +505,13 @@ import os, sys, threading, time
 root, marker, mode = sys.argv[1], sys.argv[2], sys.argv[3]
 from minio_tpu.storage.local import LocalDrive
 from minio_tpu.storage.fileinfo import FileInfo
+
+def mark(text):
+    # Atomic: the parent SIGKILLs the moment the marker EXISTS, so the
+    # content must land in the same instant (tmp + rename).
+    with open(marker + ".tmp", "w") as f:
+        f.write(text)
+    os.replace(marker + ".tmp", marker)
 d = LocalDrive(root)
 try:
     d.make_vol("bkt")
@@ -523,11 +531,11 @@ if mode == "pre_fsync":
         daemon=True)
     t.start()
     time.sleep(0.5)  # let the committer append and enter the hold
-    open(marker, "w").write("WINDOW-OPEN")
+    mark("WINDOW-OPEN")
     time.sleep(60)
 else:  # post_fsync: ack lands, materialization never runs (lazy mode)
     d.write_metadata("bkt", "crashkey", fi)  # returns = group fsync ack
-    open(marker, "w").write("ACKED")
+    mark("ACKED")
     time.sleep(60)
 """
 

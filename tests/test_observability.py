@@ -259,9 +259,10 @@ def test_zero_overhead_without_subscriber(server, client):
         "span allocated with no trace subscriber attached"
 
 
-def test_trace_type_storage_filter(server, client):
+def test_trace_type_storage_filter(server, client, traffic):
     """?type=storage during a PUT shows per-drive call records — the
-    `mc admin trace --call storage` view."""
+    `mc admin trace --call storage` view. (`traffic` guarantees the
+    bucket exists when this test runs alone.)"""
     base, srv = server
     got: list = []
     stop = threading.Event()
@@ -296,7 +297,11 @@ def test_trace_type_storage_filter(server, client):
     assert got, "no storage trace records received"
     assert all(rec["type"] == "storage" for rec in got)
     ops = {rec["op"] for rec in got}
-    assert ops & {"write_metadata_single", "read_version"}, ops
+    # Armed default: the inline commit records as the two-phase
+    # journal_commit_async; the per-request oracle records the sync
+    # store; a cache-missing GET records read_version.
+    assert ops & {"write_metadata_single", "read_version",
+                  "journal_commit_async", "write_all_async"}, ops
     for rec in got:
         assert rec["drive"]
         assert rec["durationNs"] >= 0

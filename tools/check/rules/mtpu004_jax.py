@@ -123,7 +123,8 @@ class JaxHygieneRule(Rule):
     def scope(self, relpath: str) -> bool:
         return relpath.startswith(("minio_tpu/ops/", "minio_tpu/native/",
                                    "minio_tpu/dataplane/",
-                                   "minio_tpu/frontdoor/"))
+                                   "minio_tpu/frontdoor/",
+                                   "minio_tpu/erasure/codec.py"))
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         tree = ctx.tree
