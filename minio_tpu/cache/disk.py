@@ -26,7 +26,19 @@ import threading
 import time
 from typing import BinaryIO, Iterator
 
+from minio_tpu import obs
 from minio_tpu.utils import errors as se
+
+# Shared with erasure/objects.py's hot-tier hook (the obs registry
+# dedupes by family name): latest-only caches bypass explicitly
+# versioned reads BY CONTRACT — without this counter those reads are
+# invisible (they are neither hits nor misses), and the disk cache and
+# the HBM hot tier would account the same contract differently
+# (docs/METRICS.md).
+_CACHE_BYPASS = obs.counter(
+    "minio_tpu_cache_bypass_total",
+    "Reads that bypassed a latest-only cache tier by contract",
+    ("reason",))
 
 GC_HIGH_WATERMARK = 0.9      # GC triggers above 90% of quota ...
 GC_LOW_WATERMARK = 0.7       # ... and evicts down to 70%
@@ -361,7 +373,11 @@ class CacheObjects:
         from minio_tpu.erasure.types import ObjectInfo
 
         version = getattr(opts, "version_id", "") if opts else ""
-        if version:  # versioned reads bypass the cache (latest-only cache)
+        if version:
+            # Versioned reads bypass the cache (latest-only cache):
+            # counted as a bypass, not a miss — the entry keyed on this
+            # (bucket, object) may be perfectly valid for latest reads.
+            _CACHE_BYPASS.labels(reason="versioned").inc()
             return self.inner.get_object(bucket, obj, offset, length, opts)
 
         dp, mp = self._paths(bucket, obj)

@@ -29,7 +29,7 @@ import uuid
 from concurrent.futures import TimeoutError as _FutTimeout
 from typing import BinaryIO, Iterator
 
-from minio_tpu import dataplane, metaplane, obs
+from minio_tpu import dataplane, hottier, metaplane, obs
 from minio_tpu.erasure.codec import DEFAULT_BLOCK_SIZE, ErasureCodec
 from minio_tpu.erasure import listing
 from minio_tpu.erasure.sysstore import SysConfigStore
@@ -81,6 +81,15 @@ _HEDGED_READS = obs.counter(
 _HEDGED_WINS = obs.counter(
     "minio_tpu_hedged_reads_won_total",
     "Hedged shard reads that made quorum before the straggler").labels()
+
+# Shared with cache/disk.py (the registry dedupes by family name):
+# latest-only caches — the disk cache and the HBM hot tier — bypass
+# explicitly-versioned reads and account them here instead of
+# miscounting them as misses (docs/METRICS.md).
+_CACHE_BYPASS = obs.counter(
+    "minio_tpu_cache_bypass_total",
+    "Reads that bypassed a latest-only cache tier by contract",
+    ("reason",))
 
 
 def _read_full(data: BinaryIO, n: int) -> bytes:
@@ -195,9 +204,16 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         """Drop the set-level FileInfo cache entry after a mutating
         fan-out (delete, metadata write, multipart complete, heal).
         Signature validation would catch these anyway; eager
-        invalidation keeps the common case from paying a miss probe."""
+        invalidation keeps the common case from paying a miss probe.
+        The HBM hot tier rides the same hook: the mutation drops (and,
+        for a still-hot key, re-admits) its device residence — the
+        serve-time identity check makes this advisory, never
+        load-bearing (docs/HOTTIER.md)."""
         if self._setcache is not None:
             self._setcache.invalidate(bucket, obj)
+        tier = hottier.maybe_tier()
+        if tier is not None:
+            tier.invalidate(bucket, obj)
 
     @property
     def fast_local_reads(self) -> bool:
@@ -325,6 +341,9 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         self._bucket_cache.pop(bucket, None)
+        tier = hottier.maybe_tier()
+        if tier is not None:
+            tier.invalidate_bucket(bucket)
         # Data-class deadline: a forced delete rmtrees arbitrary trees.
         results = parallel_map(
             [lambda d=d: d.delete_vol(bucket, force=force) for d in self.drives],
@@ -509,6 +528,12 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     # election would return (index 0 on every drive),
                     # so the first GET skips the fan-out outright.
                     self._setcache.populate(bucket, obj, "", fi, shuffled)
+                tier = hottier.maybe_tier()
+                if tier is not None:
+                    # An inline overwrite displaces any shard-backed
+                    # resident generation (the streaming path rides
+                    # _meta_invalidate; inline commits skip it).
+                    tier.invalidate(bucket, obj)
             return self._fi_to_object_info(bucket, obj, fi)
 
         # Streaming erasure path.
@@ -664,9 +689,11 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         if fi.deleted:
             raise se.ObjectNotFound(bucket, obj)
         info = self._fi_to_object_info(bucket, obj, fi)
+        pinned = bool(opts.version_id)
 
         def open_range(offset: int = 0, length: int = -1) -> Iterator[bytes]:
-            return self._open_fi_range(bucket, obj, fi, offset, length)
+            return self._open_fi_range(bucket, obj, fi, offset, length,
+                                       pinned=pinned)
 
         return info, open_range
 
@@ -682,7 +709,8 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         return info, open_range(offset, length)
 
     def _open_fi_range(self, bucket: str, obj: str, fi: FileInfo,
-                       offset: int, length: int) -> Iterator[bytes]:
+                       offset: int, length: int,
+                       pinned: bool = False) -> Iterator[bytes]:
         if length < 0:
             length = fi.size - offset
         if offset < 0 or length < 0 or offset + length > fi.size:
@@ -692,6 +720,26 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
             return iter([payload])
         tier_name = fi.metadata.get(
             "x-mtpu-internal-transition-tier") if fi.metadata else ""
+        if not tier_name and fi.data_dir:
+            hot = hottier.maybe_tier()
+            if hot is not None:
+                if pinned:
+                    # Latest-only tier: an explicitly versioned read
+                    # bypasses by contract — same accounting as the
+                    # disk cache's versioned bypass (docs/METRICS.md).
+                    _CACHE_BYPASS.labels(reason="hottier_versioned").inc()
+                else:
+                    served = hot.serve(bucket, obj, fi, offset, length)
+                    if served is not None:
+                        # Device-resident hit: one gather+digest launch
+                        # + one DMA; zero drive opens.
+                        return served
+                    hot.note_miss(
+                        bucket, obj, fi.size,
+                        reader=lambda b=bucket, o=obj: self.get_object(
+                            b, o),
+                        grid=(fi.erasure.data_blocks,
+                              fi.erasure.block_size))
         if tier_name and not fi.data_dir:
             # Transitioned version: data lives on the remote tier; stream
             # through transparently (reference transitioned-object reads,

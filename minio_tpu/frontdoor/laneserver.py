@@ -104,6 +104,36 @@ class _PendingRingEncode:
         return out_chunks, out_digs
 
 
+def _pack_hotget(bucket: str, obj: str, ident: tuple, offset: int,
+                 length: int) -> bytes:
+    """OP_HOTGET meta chunk: the key, the caller's elected-FileInfo
+    identity (version, etag, size, mod_time — what must match the
+    resident entry for a hit), and the byte range."""
+    import struct
+
+    vid, etag, size, mt = ident
+    bb, ob = bucket.encode(), obj.encode()
+    vb, eb = vid.encode(), etag.encode()
+    return struct.pack("<dQQQHHHH", float(mt), int(size), offset,
+                       length, len(bb), len(ob), len(vb),
+                       len(eb)) + bb + ob + vb + eb
+
+
+def _unpack_hotget(meta):
+    import struct
+
+    mt, size, offset, length, lb, lo, lv, le = struct.unpack_from(
+        "<dQQQHHHH", meta, 0)
+    off = struct.calcsize("<dQQQHHHH")
+    # str(view, "utf-8") decodes straight off the ring's memoryview —
+    # the header's key/identity strings never round-trip bytes().
+    bucket = str(meta[off:off + lb], "utf-8"); off += lb
+    obj = str(meta[off:off + lo], "utf-8"); off += lo
+    vid = str(meta[off:off + lv], "utf-8"); off += lv
+    etag = str(meta[off:off + le], "utf-8"); off += le
+    return bucket, obj, (vid, etag, size, mt), offset, length
+
+
 def _pack_recon_meta(survivors, targets, block_lens) -> bytes:
     """Meta chunk for an OP_RECONSTRUCT request: [u8 n_surv][surv*]
     [u8 n_tgt][tgt*][u32 block_len]* — positions fit u8 (n <= 256)."""
@@ -380,9 +410,75 @@ class LaneClient:
         return _PendingRingEncode(self, slot, seq, k, m, block_size,
                                   blocks, with_digests)
 
+    def hot_get(self, bucket: str, obj: str, ident: tuple, offset: int,
+                length: int) -> bytearray | None:
+        """Probe the lane owner's hot-object tier for [offset,
+        offset+length) of a key whose elected identity is `ident`;
+        None on any miss (cold, identity mismatch, oversize, no slot,
+        timeout) — the caller serves its local drive path. The probe
+        itself feeds the owner's shared heat tracker, so sibling GETs
+        drive admission exactly like the owner's own. A served ERROR
+        and an abandoned slot are both accounted `hot_miss` (the poll
+        cannot tell them apart after the slot recycles)."""
+        meta = _pack_hotget(bucket, obj, ident, offset, length)
+        if (4 + len(meta) > self.ring.req_cap
+                or length > self.ring.resp_cap):
+            self._note_fallback("oversize")
+            return None
+        got = self._acquire()
+        if got is None:
+            self._note_fallback("no_slot")
+            return None
+        slot, seq = got
+        req_len = shm.pack_chunks(self.ring.req_view(slot), [meta])
+        self.ring.publish(slot, shm.OP_HOTGET, 0, 0, 0, seq, 1, req_len)
+        _RING_SUBMITS.labels(worker=self._wlabel, op="hotget").inc()
+        resp = self._await_slot(slot, seq)
+        if resp is None or len(resp) != length:
+            self._note_fallback("hot_miss")
+            return None
+        return resp
+
     def close(self) -> None:
         self.closed = True
         self.ring.close()
+
+
+class HotRingClient:
+    """Tier-shaped stand-in for sibling workers (hottier.set_router):
+    hits ride the ring into worker 0's device-resident tier; misses,
+    heat and invalidation all resolve server-side — the OP_HOTGET
+    probe carries the caller's freshly elected identity, so a stale
+    resident entry can only miss, never serve (docs/HOTTIER.md)."""
+
+    def __init__(self, lane: LaneClient):
+        self._lane = lane
+
+    def serve(self, bucket: str, obj: str, fi, offset: int, length: int):
+        from minio_tpu.hottier.tier import fi_ident
+
+        return self.serve_ident(bucket, obj, fi_ident(fi), offset,
+                                length)
+
+    def serve_ident(self, bucket: str, obj: str, ident: tuple,
+                    offset: int, length: int):
+        if length <= 0:
+            return None
+        data = self._lane.hot_get(bucket, obj, ident, offset, length)
+        if data is None:
+            return None
+        return iter([memoryview(data)])
+
+    def note_miss(self, bucket: str, obj: str, size: int, reader=None,
+                  grid=None) -> None:
+        """No-op: the OP_HOTGET probe already fed the owner's heat."""
+
+    def invalidate(self, bucket: str, obj: str) -> None:
+        """No-op: the owner drops a stale entry the first time any
+        worker's probe shows a newer elected identity."""
+
+    def invalidate_bucket(self, bucket: str) -> None:
+        """No-op — same contract as invalidate()."""
 
 
 class LaneServer:
@@ -455,6 +551,8 @@ class LaneServer:
                 elif op == shm.OP_RECONSTRUCT:
                     resp_len = self._do_reconstruct(
                         i, reqs, k, m, bool(flags & shm.FLAG_DIGESTS))
+                elif op == shm.OP_HOTGET:
+                    resp_len = self._do_hotget(i, reqs)
                 else:
                     raise ValueError(f"unknown ring op {op}")
             except Exception as e:  # noqa: BLE001 - travels to the
@@ -468,7 +566,8 @@ class LaneServer:
                 worker=self._wlabel,
                 op={shm.OP_DIGEST: "digest",
                     shm.OP_ENCODE: "encode",
-                    shm.OP_RECONSTRUCT: "reconstruct"}[op]).inc()
+                    shm.OP_RECONSTRUCT: "reconstruct",
+                    shm.OP_HOTGET: "hotget"}[op]).inc()
         finally:
             with self._mu:
                 self._inflight.discard(i)
@@ -498,6 +597,30 @@ class LaneServer:
                 for d in dig_rows[bi]:
                     out[off:off + 32] = d
                     off += 32
+        return off
+
+    def _do_hotget(self, i: int, reqs: list) -> int:
+        """Serve a sibling's hot GET from this worker's tier; a miss
+        raises (→ ring ERROR → the sibling's drive path) AFTER feeding
+        the shared heat tracker, so sibling traffic drives admission."""
+        from minio_tpu import hottier
+
+        bucket, obj, ident, offset, length = _unpack_hotget(reqs[0])
+        tier = hottier.get_tier() if hottier.enabled() else None
+        if tier is None:
+            raise ValueError("hot tier disabled on the lane owner")
+        served = tier.serve_ident(bucket, obj, ident, offset, length)
+        if served is None:
+            # ident[2] is the elected size; reader=None resolves to the
+            # process-global reader this worker registered at boot.
+            tier.note_miss(bucket, obj, ident[2])
+            raise LookupError("hottier miss")
+        out = self.ring.resp_view(i)
+        off = 0
+        for mv in served:
+            ln = len(mv)
+            out[off:off + ln] = mv
+            off += ln
         return off
 
     def _do_reconstruct(self, i: int, reqs: list, k: int, m: int,

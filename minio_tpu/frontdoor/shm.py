@@ -55,7 +55,13 @@ FREE, SUBMITTED, DONE, ERROR, ABANDONED = 0, 1, 2, 3, 4
 # — one failure pattern per batch (the heal shape); the request carries
 # a meta chunk (survivors, targets, block lens) ahead of the per-block
 # survivor rows, the response the rebuilt target chunks (+ digests).
-OP_DIGEST, OP_ENCODE, OP_RECONSTRUCT = 1, 2, 3
+# OP_HOTGET (hot-object tier, docs/HOTTIER.md): a sibling worker's hot
+# GET probes worker 0's device-resident tier — the request is one meta
+# chunk (key + elected-FileInfo identity + byte range), the DONE
+# response the requested payload bytes; a miss travels as ERROR and
+# the sibling serves its local drive path. The probe doubles as the
+# heat feed, so every worker's GETs drive one shared admission policy.
+OP_DIGEST, OP_ENCODE, OP_RECONSTRUCT, OP_HOTGET = 1, 2, 3, 4
 FLAG_DIGESTS = 1
 
 _U32 = struct.Struct("<I")

@@ -56,10 +56,16 @@ def _local_drives(layer) -> list:
     return out
 
 
-def _arm_shared_lanes(wid: int):
+def _arm_shared_lanes(wid: int, srv=None):
     """Wire this worker into the cross-process lane ring (worker 0
-    serves it, the rest submit to it). Returns a stop callable."""
-    from minio_tpu import dataplane
+    serves it, the rest submit to it). Returns a stop callable.
+
+    The hot-object tier rides the same ring: worker 0 owns the ONE
+    device-resident tier (and registers its object layer as the
+    tier's admit reader); siblings route hot GETs through OP_HOTGET
+    (hottier.set_router) so every worker's hot traffic coalesces into
+    shared residence and shared launches (docs/HOTTIER.md)."""
+    from minio_tpu import dataplane, hottier
     from minio_tpu.frontdoor import laneserver, shm
 
     name = frontdoor.ring_name()
@@ -71,17 +77,25 @@ def _arm_shared_lanes(wid: int):
         return lambda: None  # no ring, no coalescing: local plane serves
     if wid == 0:
         server = laneserver.LaneServer(ring, worker=wid)
+        if hottier.enabled() and srv is not None:
+            hottier.set_reader(
+                lambda b, o, _l=srv.obj: _l.get_object(b, o))
 
         def stop():
+            hottier.set_reader(None)
             server.stop()
             ring.close()
 
         return stop
     client = laneserver.LaneClient(ring, wid, frontdoor.worker_count())
     dataplane.set_router(lambda: client)
+    if hottier.enabled():
+        hot = laneserver.HotRingClient(client)
+        hottier.set_router(lambda: hot)
 
     def stop():
         dataplane.set_router(None)
+        hottier.set_router(None)
         client.close()
 
     return stop
@@ -130,7 +144,7 @@ def main(argv=None) -> None:
 
     srv.app.on_response_prepare.append(_stamp_worker)
 
-    stop_lanes = _arm_shared_lanes(wid)
+    stop_lanes = _arm_shared_lanes(wid, srv)
     if wid == 0:
         # One healer per pool of workers: N auto-healers racing the
         # same sets would duplicate every heal fan-out.

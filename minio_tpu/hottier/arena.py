@@ -1,0 +1,168 @@
+"""Per-lane device arenas + the hot-GET serve kernel.
+
+A resident object occupies one device array of shape
+(rows, k, width) — its payload split on the object's own erasure grid
+(block_size blocks, each block split into its k data-shard chunks),
+staged exactly like a dataplane ring slot: `rows` is the pow-2 bucket
+of the block count and `width` the pow-2 bucket of the chunk length
+(utils/shardmath.pow2_bucket — THE rule shared with codec staging and
+the lane keys), so the whole tier lives on a bounded *shape set*. That
+is what makes the arena behave like `dataplane/ring.py`'s slot rings on
+a real accelerator: XLA's device allocator recycles freed same-shape
+HBM buffers, and the jit cache for the serve kernel below is bounded
+to the same lane keys instead of churning per object size.
+
+Host staging buffers (the admit-time memcpy target for the H2D
+transfer) recycle through a per-shape free list — steady-state
+admission allocates nothing on the host. The byte budget
+(MTPU_HOTTIER_BYTES) is accounted on the device arrays; eviction frees
+the arrays (their HBM returns to the allocator's same-shape pool) and
+returns the staging buffer to the free list.
+
+Serve kernel: one jitted launch per (rows, k, width, window, verify)
+lane — `dynamic_slice` gathers the requested block window out of the
+resident array and, with verify on, fuses the window's mxsum digests
+into the SAME launch (ops/fused.verify_digests — the digest kernel the
+codec and heal lanes already fuse). The host compares those digests to
+the admit-time baseline before a single byte reaches the response:
+resident bits that rotted in device memory fall back to the drive
+path, exactly like on-disk bitrot. Decoding from the k resident data
+shards of a systematic RS code is the identity solve, so the "gather"
+IS the reconstruct — no GF work is needed until shards are lost, which
+is the drive path's job.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+
+import numpy as np
+
+DEFAULT_BUDGET_BYTES = 256 << 20   # device-resident byte budget
+_MIN_WIDTH = 512                   # narrowest staged chunk width
+
+
+def width_bucket(s: int) -> int:
+    from minio_tpu.utils.shardmath import pow2_bucket
+
+    return pow2_bucket(s, floor=_MIN_WIDTH)
+
+
+def rows_bucket(n: int) -> int:
+    from minio_tpu.utils.shardmath import pow2_bucket
+
+    return pow2_bucket(max(1, n))
+
+
+def entry_shape(nblocks: int, k: int, chunk_len: int) -> tuple:
+    """The pow2-bucketed arena shape for an object of `nblocks` erasure
+    blocks with data-chunk length `chunk_len`."""
+    return (rows_bucket(nblocks), k, width_bucket(chunk_len))
+
+
+def shape_bytes(shape: tuple) -> int:
+    r, k, w = shape
+    # data + per-block lens (i32) + per-chunk digest baseline (32 B).
+    return r * k * w + r * 4 + r * k * 32
+
+
+class DeviceArena:
+    """Budget-bounded device residence accounting + host staging reuse.
+
+    acquire() hands out a zeroed host staging array of the requested
+    shape (recycled when possible); seal() device_puts it and charges
+    the budget; release() uncharges and recycles the staging buffer.
+    All bookkeeping is a leaf lock — no device work happens under it.
+    """
+
+    def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES):
+        self.budget = budget_bytes
+        self._mu = threading.Lock()
+        self._used = 0
+        self._free: dict[tuple, list[np.ndarray]] = {}
+
+    @property
+    def used_bytes(self) -> int:
+        return self._used
+
+    def fits(self, shape: tuple) -> bool:
+        with self._mu:
+            return self._used + shape_bytes(shape) <= self.budget
+
+    def would_free(self, shapes) -> int:
+        return sum(shape_bytes(s) for s in shapes)
+
+    def acquire(self, shape: tuple) -> np.ndarray:
+        """A zeroed host staging array (NOT yet charged to the budget —
+        seal() charges when the device copy lands)."""
+        with self._mu:
+            pool = self._free.get(shape)
+            buf = pool.pop() if pool else None
+        if buf is None:
+            return np.zeros(shape, dtype=np.uint8)
+        buf[:] = 0
+        return buf
+
+    def seal(self, shape: tuple, staging: np.ndarray):
+        """Device_put the staged bytes and charge the budget. Returns
+        the device array; the staging buffer stays with the caller
+        until release() (its reuse contract mirrors ring.Slot: the
+        transfer reads straight out of it)."""
+        import jax
+
+        dev = jax.device_put(staging)
+        with self._mu:
+            self._used += shape_bytes(shape)
+        return dev
+
+    def recycle_staging(self, shape: tuple, staging: np.ndarray) -> None:
+        with self._mu:
+            self._free.setdefault(shape, []).append(staging)
+            # Bound the per-shape free list: staging reuse is a fast
+            # path, not a second cache.
+            del self._free[shape][4:]
+
+    def release(self, shape: tuple) -> None:
+        with self._mu:
+            self._used = max(0, self._used - shape_bytes(shape))
+
+    def clear(self) -> None:
+        with self._mu:
+            self._used = 0
+            self._free.clear()
+
+
+@functools.lru_cache(maxsize=256)
+def serve_kernel(rows: int, k: int, width: int, window: int,
+                 verify: bool):
+    """The hot-GET launch for one arena lane: gather `window` blocks
+    starting at a (traced) row offset out of the resident (rows, k,
+    width) array, with the window's mxsum digests fused into the same
+    launch when verify is on. Shapes are pow2-bucketed on every axis,
+    so the compiled-program set is bounded per lane (probe:
+    trace_count())."""
+    import jax
+    import jax.numpy as jnp
+
+    from minio_tpu.ops import fused
+
+    if verify:
+        def launch(data, lens, start):
+            win = jax.lax.dynamic_slice(data, (start, 0, 0),
+                                        (window, k, width))
+            wl = jax.lax.dynamic_slice(lens, (start,), (window,))
+            digs = fused.verify_digests(win.reshape(window * k, width),
+                                        jnp.repeat(wl, k))
+            return win, digs.reshape(window, k, 32)
+    else:
+        def launch(data, lens, start):
+            del lens
+            return jax.lax.dynamic_slice(data, (start, 0, 0),
+                                         (window, k, width)), None
+    return jax.jit(launch)
+
+
+def trace_count() -> int:
+    """Compiled serve-program count (recompilation probe for tests)."""
+    return serve_kernel.cache_info().currsize

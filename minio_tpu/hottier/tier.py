@@ -1,0 +1,641 @@
+"""HotObjectTier — the device-resident hot-object serving tier.
+
+Residency model (docs/HOTTIER.md): an admitted object's payload is
+split on its own erasure grid — block_size blocks, each block split
+into its k data-shard chunks (systematic RS: the data shards ARE
+contiguous block slices) — and staged into one pow2-bucketed device
+array per object (hottier/arena.py), together with a per-chunk mxsum
+digest baseline. A hot GET then:
+
+  1. elects FileInfo exactly as today (set-cache signature-validated),
+  2. matches the elected identity (version, etag, size, mod_time)
+     against the resident entry — any mismatch is a miss, never a
+     stale serve,
+  3. launches ONE device kernel (gather the requested block window +
+     fused mxsum digests of exactly the rows being served),
+  4. DMAs the window out, compares digests to the admit baseline, and
+     streams memoryview slices straight to the response.
+
+Zero drive opens, zero quorum fan-out, zero host reassembly. Every
+miss (absent, cold, identity-changed, digest-rotted, saturated) falls
+back to the drive path, which stays the bit-exactness oracle.
+
+Heat/admission: a per-object exponential-decay EWMA fed by the GET
+serving path (the same request stream behind
+minio_tpu_s3_requests_total{api="GetObject"}). A key whose heat
+crosses MTPU_HOTTIER_MIN_HEAT is queued for admission; one background
+thread (mtpu-hottier-admit) re-reads it through the drive path — the
+oracle — stages, digests, and installs. Admission is epoch-fenced:
+every invalidation bumps the key's epoch, and an admit only installs
+if the epoch it captured before reading is still current, so a PUT
+racing an admit can never leave stale bytes resident. Eviction drops
+the coldest entries when the byte budget needs room.
+
+Coherence: every mutating path that invalidates the FileInfo set
+cache (PUT, DELETE, heal, multipart complete, tags/metadata writes)
+invalidates here through the same hook (_meta_invalidate); a hot key
+re-admits after the drop (write-through). None of that is load-
+bearing for correctness — step 2 above is.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+
+from minio_tpu import obs
+from minio_tpu.hottier import arena
+from minio_tpu.logger import get_logger
+from minio_tpu.utils import errors as se
+
+_HITS = obs.counter(
+    "minio_tpu_hottier_hits_total",
+    "Hot-tier GETs served from device-resident shards (zero drive I/O)"
+).labels()
+_MISSES = obs.counter(
+    "minio_tpu_hottier_misses_total",
+    "Hot-tier lookups that fell back to the drive path "
+    "(absent, cold, identity-changed, digest-mismatch, or oversize)"
+).labels()
+_ADMITS = obs.counter(
+    "minio_tpu_hottier_admits_total",
+    "Objects admitted (or re-admitted) into device residence").labels()
+_EVICTIONS = obs.counter(
+    "minio_tpu_hottier_evictions_total",
+    "Resident entries dropped (budget pressure, invalidation, or "
+    "digest mismatch)").labels()
+_BYTES = obs.gauge(
+    "minio_tpu_hottier_bytes",
+    "Device bytes currently charged to resident hot objects")
+
+DEFAULT_MAX_OBJECT = 8 << 20
+# One GET scores ~1.0 heat; the default threshold sits between the
+# first GET (1.0) and the second (just under 2.0 after decay), so a
+# key admits on its second read inside the halflife window.
+DEFAULT_MIN_HEAT = 1.5
+DEFAULT_HALFLIFE_S = 60.0
+# Eviction hysteresis: a victim must be this factor colder than the
+# admitting key. Without it a uniform round-robin scan thrashes the
+# whole arena — the key just read is always epsilon-hotter than the
+# oldest resident, so every miss would evict a resident that was
+# about to hit (classic sequential-scan cache pollution).
+EVICT_MARGIN = 1.5
+# Per-key admission cooldown: an admit is a full oracle read, and a
+# hot key being overwritten continuously (write-through re-admit after
+# every invalidation) or a hot key that keeps losing _make_room would
+# otherwise re-read itself on every GET — background load that
+# competes with foreground serving and heal on small hosts. One
+# attempt per key per cooldown bounds it.
+DEFAULT_ADMIT_COOLDOWN_S = 2.0
+
+# The admit thread must not re-note its own oracle reads: its GET runs
+# through the same _open_fi_range hook that feeds heat.
+_tl = threading.local()
+
+
+def fi_ident(fi) -> tuple:
+    """The generation identity of an elected FileInfo: what must match
+    for resident bytes to be the bytes this election describes."""
+    return (fi.version_id or "", fi.metadata.get("etag", ""),
+            int(fi.size), float(fi.mod_time))
+
+
+def info_ident(info) -> tuple:
+    """Same identity from an ObjectInfo (the admit reader's view)."""
+    return (getattr(info, "version_id", "") or "", info.etag,
+            int(info.size), float(info.mod_time))
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class _Entry:
+    __slots__ = ("ident", "k", "bs", "size", "nblocks", "shape",
+                 "data", "lens_dev", "lens", "digs", "staging")
+
+    def __init__(self, ident, k, bs, size, nblocks, shape, data,
+                 lens_dev, lens, digs, staging):
+        self.ident = ident
+        self.k = k
+        self.bs = bs
+        self.size = size
+        self.nblocks = nblocks
+        self.shape = shape
+        self.data = data          # device (rows, k, width) u8
+        self.lens_dev = lens_dev  # device (rows,) i32 chunk lengths
+        self.lens = lens          # host copy of lens_dev
+        self.digs = digs          # host (rows, k, 32) admit baseline
+        self.staging = staging    # host staging (returned on evict)
+
+
+class HotObjectTier:
+    def __init__(self, *, budget_bytes: int | None = None,
+                 max_object: int | None = None,
+                 min_heat: float | None = None,
+                 halflife_s: float | None = None,
+                 verify: bool | None = None):
+        env = os.environ.get
+        self.max_object = max_object if max_object is not None else int(
+            env("MTPU_HOTTIER_MAX_OBJECT", str(DEFAULT_MAX_OBJECT)))
+        self.min_heat = min_heat if min_heat is not None else float(
+            env("MTPU_HOTTIER_MIN_HEAT", str(DEFAULT_MIN_HEAT)))
+        self.halflife = halflife_s if halflife_s is not None else float(
+            env("MTPU_HOTTIER_HALFLIFE_S", str(DEFAULT_HALFLIFE_S)))
+        self.verify = verify if verify is not None else (
+            env("MTPU_HOTTIER_VERIFY", "1") not in ("0", "false", "off"))
+        self.admit_cooldown = float(env("MTPU_HOTTIER_ADMIT_COOLDOWN_S",
+                                        str(DEFAULT_ADMIT_COOLDOWN_S)))
+        budget = budget_bytes if budget_bytes is not None else int(
+            env("MTPU_HOTTIER_BYTES", str(arena.DEFAULT_BUDGET_BYTES)))
+        self.arena = arena.DeviceArena(budget)
+        self._mu = threading.Lock()           # leaf: entries/heat/epochs
+        self._entries: dict[tuple, _Entry] = {}
+        self._heat: dict[tuple, tuple[float, float]] = {}  # (value, t)
+        self._epoch: dict[tuple, int] = {}
+        self._pending: set[tuple] = set()
+        self._last_attempt: dict[tuple, float] = {}
+        self._readers: dict[tuple, object] = {}
+        self._q: queue.Queue = queue.Queue(maxsize=256)
+        self.closed = False
+        self._stats = {"hits": 0, "misses": 0, "admits": 0,
+                       "evictions": 0, "admit_errors": 0}
+        self._admit_t = threading.Thread(
+            target=self._admit_loop, daemon=True,
+            name="mtpu-hottier-admit")
+        self._admit_t.start()
+
+    # ------------------------------------------------------------------
+    # heat
+    # ------------------------------------------------------------------
+
+    def _touch(self, key: tuple, now: float) -> float:
+        """Bump the key's decaying heat; caller holds _mu."""
+        val, t = self._heat.get(key, (0.0, now))
+        dt = max(0.0, now - t)
+        val = val * (0.5 ** (dt / self.halflife)) + 1.0
+        self._heat[key] = (val, now)
+        if len(self._heat) > 8192:
+            # Bound the heat map: drop the coldest half by decayed value.
+            items = sorted(self._heat.items(),
+                           key=lambda kv: kv[1][0])
+            for k, _v in items[:4096]:
+                if k not in self._entries:
+                    self._heat.pop(k, None)
+        return val
+
+    def _heat_of(self, key: tuple, now: float) -> float:
+        val, t = self._heat.get(key, (0.0, now))
+        return val * (0.5 ** (max(0.0, now - t) / self.halflife))
+
+    # ------------------------------------------------------------------
+    # the serving path
+    # ------------------------------------------------------------------
+
+    def serve(self, bucket: str, obj: str, fi, offset: int, length: int):
+        """Serve [offset, offset+length) from device residence, or None
+        (drive path). `fi` is the caller's freshly elected FileInfo —
+        its identity gates the hit."""
+        return self.serve_ident(bucket, obj, fi_ident(fi), offset,
+                                length)
+
+    def serve_ident(self, bucket: str, obj: str, ident: tuple,
+                    offset: int, length: int):
+        if length <= 0:
+            return None
+        key = (bucket, obj)
+        drop = None
+        with self._mu:
+            entry = self._entries.get(key)
+            if entry is not None and entry.ident != ident:
+                # Identity moved under the entry (a mutation this
+                # process never saw — e.g. a sibling worker's PUT):
+                # the entry can only mislead future heat, drop it now.
+                drop = self._entries.pop(key)
+            if drop is not None or entry is None:
+                entry = None
+            else:
+                self._touch(key, time.monotonic())
+        if drop is not None:
+            self._release(drop)
+            _EVICTIONS.inc()
+            self._stats["evictions"] += 1
+        if entry is None:
+            return None
+        out = self._serve_entry(entry, offset, length)
+        if out is None:
+            # Digest mismatch: resident bits rotted — evict; the
+            # caller's note_miss accounts the fallback.
+            self.invalidate(bucket, obj)
+            return None
+        _HITS.inc()
+        self._stats["hits"] += 1
+        return out
+
+    def _serve_entry(self, entry: _Entry, offset: int, length: int):
+        rows, k, width = entry.shape
+        b0 = offset // entry.bs
+        b1 = (offset + length - 1) // entry.bs + 1
+        nb = arena.rows_bucket(b1 - b0)
+        start = min(b0, rows - nb)
+        kern = arena.serve_kernel(rows, k, width, nb, self.verify)
+        win, digs = kern(entry.data, entry.lens_dev, start)
+        mat = np.asarray(win)          # the one D2H sync (the DMA)
+        if digs is not None:
+            got = np.asarray(digs)
+            for b in range(b0, min(b1, entry.nblocks)):
+                if not np.array_equal(got[b - start],
+                                      entry.digs[b]):
+                    return None
+        out: list[memoryview] = []
+        end = offset + length
+        for b in range(b0, b1):
+            blk_start = b * entry.bs
+            s = int(entry.lens[b])
+            lo = max(offset, blk_start) - blk_start
+            hi = min(end, blk_start + min(entry.bs,
+                                          entry.size - blk_start))
+            hi -= blk_start
+            if hi <= lo:
+                continue
+            # Walk the block's k resident chunks, memoryview slices
+            # only (the _yield_block_range discipline).
+            pos = 0
+            row = mat[b - start]
+            for i in range(k):
+                if pos >= hi:
+                    break
+                cend = pos + s
+                a = max(lo, pos)
+                z = min(hi, cend)
+                if z > a:
+                    out.append(memoryview(row[i])[a - pos:z - pos])
+                pos = cend
+        return iter(out)
+
+    # ------------------------------------------------------------------
+    # heat feed + admission
+    # ------------------------------------------------------------------
+
+    def note_miss(self, bucket: str, obj: str, size: int,
+                  reader=None, grid: tuple | None = None) -> None:
+        """Feed heat for a GET the drive path served; queue admission
+        once the key is provably hot. `reader` is a zero-arg callable
+        returning (ObjectInfo, byte-iterator) through the oracle path;
+        None uses the process-global reader (hottier.set_reader).
+        `grid` is the object's (data_blocks, block_size) — it only
+        shapes the resident layout, bytes served are grid-independent."""
+        if getattr(_tl, "in_admit", False):
+            return  # the admit thread's own oracle read is not demand
+        _MISSES.inc()
+        self._stats["misses"] += 1
+        if size <= 0 or size > self.max_object:
+            return
+        key = (bucket, obj)
+        enqueue = False
+        with self._mu:
+            heat = self._touch(key, time.monotonic())
+            prev = self._readers.get(key)
+            self._readers[key] = (
+                reader if reader is not None else
+                (prev[0] if prev else None),
+                grid if grid is not None else (prev[1] if prev else None),
+                size or (prev[2] if prev else 0))
+            if len(self._readers) > 8192:
+                self._readers.pop(next(iter(self._readers)))
+            if (heat >= self.min_heat and key not in self._entries
+                    and key not in self._pending):
+                self._pending.add(key)
+                epoch = self._epoch.get(key, 0)
+                enqueue = True
+        if enqueue:
+            try:
+                self._q.put_nowait((key, epoch))
+            except queue.Full:
+                with self._mu:
+                    self._pending.discard(key)
+
+    def invalidate(self, bucket: str, obj: str) -> None:
+        """Drop residence for a mutated key (PUT/DELETE/heal/multipart
+        complete ride this through _meta_invalidate) and bump its
+        epoch so an in-flight admission cannot install stale bytes.
+        A key that was resident re-admits (write-through) once the
+        mutation settles."""
+        key = (bucket, obj)
+        readmit = False
+        with self._mu:
+            self._epoch[key] = self._epoch.get(key, 0) + 1
+            entry = self._entries.pop(key, None)
+            if (entry is not None and key not in self._pending
+                    and self._heat_of(key, time.monotonic())
+                    >= self.min_heat and key in self._readers):
+                self._pending.add(key)
+                epoch = self._epoch[key]
+                readmit = True
+        if entry is not None:
+            self._release(entry)
+            _EVICTIONS.inc()
+            self._stats["evictions"] += 1
+        if readmit:
+            try:
+                self._q.put_nowait((key, epoch))
+            except queue.Full:
+                with self._mu:
+                    self._pending.discard(key)
+
+    def invalidate_bucket(self, bucket: str) -> None:
+        with self._mu:
+            victims = [k for k in self._entries if k[0] == bucket]
+            entries = [self._entries.pop(k) for k in victims]
+            for k in victims:
+                self._epoch[k] = self._epoch.get(k, 0) + 1
+        for e in entries:
+            self._release(e)
+            _EVICTIONS.inc()
+            self._stats["evictions"] += 1
+
+    # ------------------------------------------------------------------
+    # the admit thread
+    # ------------------------------------------------------------------
+
+    def _admit_loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            key, epoch = item
+            try:
+                self._admit_one(key, epoch)
+            except (se.StorageError, se.ObjectError, OSError) as e:
+                # The oracle read failed (object gone, quorum lost,
+                # drive fault): nothing resident changes; the key can
+                # re-heat later.
+                get_logger().debug(
+                    f"hottier admit {key[0]}/{key[1]}: {e}")
+                self._stats["admit_errors"] += 1
+            except Exception as e:  # noqa: BLE001 - admit is advisory;
+                # a bug here must degrade to drive-path serving, not
+                # kill the thread.
+                get_logger().warning(
+                    f"hottier admit {key[0]}/{key[1]}: "
+                    f"{type(e).__name__}: {e}")
+                self._stats["admit_errors"] += 1
+            finally:
+                with self._mu:
+                    self._pending.discard(key)
+
+    def _admit_one(self, key: tuple, epoch: int) -> None:
+        from minio_tpu import hottier as _ht
+
+        bucket, obj = key
+        now = time.monotonic()
+        with self._mu:
+            if self._epoch.get(key, 0) != epoch or self.closed:
+                return
+            if now - self._last_attempt.get(key, -1e9) \
+                    < self.admit_cooldown:
+                return  # churny key: one oracle read per cooldown
+            self._last_attempt[key] = now
+            if len(self._last_attempt) > 8192:
+                cut = now - max(self.admit_cooldown, 1.0)
+                self._last_attempt = {
+                    k2: t for k2, t in self._last_attempt.items()
+                    if t >= cut}
+            rec = self._readers.get(key)
+        reader, grid, noted_size = rec if rec is not None else (None,) * 3
+        if noted_size:
+            # Doomed-admission pre-check on the NOTED size: skip the
+            # whole oracle read when the entry could not be installed
+            # anyway (over budget, or no victim cold enough to evict).
+            k_est, bs_est = self._grid(grid)
+            est = arena.entry_shape(
+                _ceil_div(noted_size, bs_est), k_est,
+                _ceil_div(min(bs_est, noted_size), k_est))
+            if not self._room_likely(key, est):
+                return
+        if reader is None:
+            default = _ht.default_reader()
+            if default is None:
+                return
+            reader = (lambda r=default, b=bucket, o=obj: r(b, o))
+        _tl.in_admit = True
+        try:
+            info, stream = reader()
+        finally:
+            _tl.in_admit = False
+        ident = info_ident(info)
+        size = int(info.size)
+        if size <= 0 or size > self.max_object:
+            self._drain(stream)
+            return
+        k, bs = self._grid(grid)
+        if k <= 0 or bs <= 0:
+            self._drain(stream)
+            return
+        nblocks = _ceil_div(size, bs)
+        chunk_len = _ceil_div(min(bs, size), k)
+        shape = arena.entry_shape(nblocks, k, chunk_len)
+        if not self._make_room(key, shape):
+            self._drain(stream)
+            return
+        staging = self.arena.acquire(shape)
+        lens = np.zeros((shape[0],), dtype=np.int32)
+        ok = self._stage(staging, lens, stream, size, k, bs, nblocks)
+        if not ok:
+            self.arena.recycle_staging(shape, staging)
+            return
+        entry = self._seal(ident, k, bs, size, nblocks, shape, staging,
+                           lens)
+        if entry is None:
+            self.arena.recycle_staging(shape, staging)
+            return
+        displaced = None
+        with self._mu:
+            if self._epoch.get(key, 0) != epoch or self.closed:
+                installed = False
+            else:
+                displaced = self._entries.get(key)
+                self._entries[key] = entry
+                installed = True
+        if not installed:
+            self.arena.release(shape)
+            self.arena.recycle_staging(shape, staging)
+            return
+        if displaced is not None:
+            self._release(displaced)
+        _ADMITS.inc()
+        self._stats["admits"] += 1
+        _BYTES.set(self.arena.used_bytes)
+
+    def _grid(self, grid: tuple | None) -> tuple[int, int]:
+        """(k, block_size) — the object's erasure grid, from the miss
+        note when the erasure layer supplied it, else the deployment
+        defaults (e.g. ring-noted keys). The grid only shapes the
+        resident layout; served bytes are grid-independent."""
+        if grid is not None and grid[0] and grid[1]:
+            return int(grid[0]), int(grid[1])
+        from minio_tpu.erasure.codec import DEFAULT_BLOCK_SIZE
+
+        return 4, DEFAULT_BLOCK_SIZE
+
+    def _stage(self, staging: np.ndarray, lens: np.ndarray, stream,
+               size: int, k: int, bs: int, nblocks: int) -> bool:
+        """Fold the oracle stream into the arena staging layout. The
+        flat payload lands once (np copy per stream chunk), then each
+        block's k data-shard chunks alias into their lane rows."""
+        flat = np.empty(size, dtype=np.uint8)
+        pos = 0
+        for piece in stream:
+            ln = len(piece)
+            if pos + ln > size:
+                return False  # stream longer than the elected size
+            flat[pos:pos + ln] = np.frombuffer(piece, dtype=np.uint8)
+            pos += ln
+        if pos != size:
+            return False
+        for b in range(nblocks):
+            blk = flat[b * bs:min((b + 1) * bs, size)]
+            s = _ceil_div(len(blk), k)
+            lens[b] = s
+            for i in range(k):
+                c = blk[i * s:(i + 1) * s]
+                if len(c):
+                    staging[b, i, :len(c)] = c
+        return True
+
+    def _seal(self, ident, k, bs, size, nblocks, shape, staging, lens):
+        """Device_put + admit-time digest baseline. The baseline is
+        hashed from the HOST staging bytes (fused.digest_chunks_host —
+        its own device launch over a separate transfer), then the serve
+        kernel re-hashes the RESIDENT copy; a mismatch means the admit
+        transfer itself corrupted and the entry is refused."""
+        from minio_tpu.ops import fused
+
+        rows, _k, width = shape
+        chunks = []
+        for b in range(nblocks):
+            s = int(lens[b])
+            for i in range(k):
+                chunks.append(staging[b, i, :s])
+        base = fused.digest_chunks_host(chunks, width)
+        digs = np.zeros((rows, k, 32), dtype=np.uint8)
+        ci = 0
+        for b in range(nblocks):
+            for i in range(k):
+                digs[b, i] = np.frombuffer(base[ci], dtype=np.uint8)
+                ci += 1
+        data_dev = self.arena.seal(shape, staging)
+        import jax
+
+        lens_dev = jax.device_put(lens)
+        if self.verify:
+            kern = arena.serve_kernel(rows, k, width, rows, True)
+            _win, dv = kern(data_dev, lens_dev, 0)
+            got = np.asarray(dv)
+            for b in range(nblocks):
+                if not np.array_equal(got[b], digs[b]):
+                    self.arena.release(shape)
+                    return None
+        return _Entry(ident, k, bs, size, nblocks, shape, data_dev,
+                      lens_dev, lens, digs, staging)
+
+    def _room_likely(self, key: tuple, shape: tuple) -> bool:
+        """Non-destructive preview of _make_room: would the eviction
+        policy find enough margin-colder victims? Run BEFORE the admit
+        pays its oracle read — evicting nothing, promising nothing."""
+        need = arena.shape_bytes(shape)
+        if need > self.arena.budget:
+            return False
+        if self.arena.fits(shape):
+            return True
+        now = time.monotonic()
+        with self._mu:
+            my_heat = self._heat_of(key, now)
+            freeable = 0
+            for k2, e2 in self._entries.items():
+                if k2 == key:
+                    continue
+                if self._heat_of(k2, now) * EVICT_MARGIN < my_heat:
+                    freeable += arena.shape_bytes(e2.shape)
+        return self.arena.used_bytes - freeable + need <= self.arena.budget
+
+    def _make_room(self, key: tuple, shape: tuple) -> bool:
+        """Evict the coldest entries until `shape` fits the budget.
+        Victims must be EVICT_MARGIN colder than the admitting key —
+        a resident never yields to an equal-heat admission, so a
+        uniform scan over a working set larger than the budget leaves
+        the resident subset stable (and hitting) instead of churning
+        every entry through the arena."""
+        if arena.shape_bytes(shape) > self.arena.budget:
+            return False
+        while not self.arena.fits(shape):
+            now = time.monotonic()
+            with self._mu:
+                my_heat = self._heat_of(key, now)
+                victims = sorted(
+                    ((self._heat_of(k2, now), k2)
+                     for k2 in self._entries if k2 != key))
+                if not victims or victims[0][0] * EVICT_MARGIN >= my_heat:
+                    return False
+                vkey = victims[0][1]
+                entry = self._entries.pop(vkey)
+                self._epoch[vkey] = self._epoch.get(vkey, 0) + 1
+            self._release(entry)
+            _EVICTIONS.inc()
+            self._stats["evictions"] += 1
+        return True
+
+    def _drain(self, stream) -> None:
+        for _ in stream:
+            pass
+
+    def _release(self, entry: _Entry) -> None:
+        self.arena.release(entry.shape)
+        self.arena.recycle_staging(entry.shape, entry.staging)
+        _BYTES.set(self.arena.used_bytes)
+
+    # ------------------------------------------------------------------
+    # lifecycle / introspection
+    # ------------------------------------------------------------------
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Tests: wait until no admission is queued or in flight."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._mu:
+                idle = not self._pending
+            if idle and self._q.empty():
+                return True
+            time.sleep(0.01)
+        return False
+
+    def resident(self, bucket: str, obj: str) -> bool:
+        with self._mu:
+            return (bucket, obj) in self._entries
+
+    def stats(self) -> dict:
+        with self._mu:
+            st = dict(self._stats)
+            st["resident_objects"] = len(self._entries)
+            st["pending"] = len(self._pending)
+        st["resident_bytes"] = self.arena.used_bytes
+        return st
+
+    def close(self, timeout: float = 10.0) -> None:
+        self.closed = True
+        self._q.put(None)
+        self._admit_t.join(timeout)
+        with self._mu:
+            entries = list(self._entries.values())
+            self._entries.clear()
+            self._heat.clear()
+            self._pending.clear()
+            self._readers.clear()
+        for e in entries:
+            self._release(e)
+        self.arena.clear()
+        _BYTES.set(0)

@@ -73,9 +73,12 @@ EXCLUDED_SITE_FILES = (
 # "mtpu-metaplane": per-drive WAL group-commit committer threads
 # (minio_tpu/metaplane/groupcommit.py) — they live as long as their
 # drive (the server's session); test-local drives close_wal() them.
+# "mtpu-hottier": the process-global hot tier's admit thread
+# (minio_tpu/hottier/tier.py) — session-lived like the dataplane's;
+# test-local tiers close() it and never leak.
 ALLOWED_THREAD_PREFIXES = ("mtpu-io", "shard-read", "dsync", "asyncio_",
                            "mtpu-dataplane", "mtpu-metaplane",
-                           "mtpu-frontdoor")
+                           "mtpu-frontdoor", "mtpu-hottier")
 
 _REAL_LOCK = threading.Lock
 _REAL_RLOCK = threading.RLock

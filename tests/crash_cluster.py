@@ -96,6 +96,19 @@ class Cluster:
             "MTPU_FAULT_INJECTION": "1",
             "MTPU_CHAOS_DRIVE_WRAP": "1",
             "MTPU_MRF_RETRY_INTERVAL": "0.2",
+            # HBM hot tier armed (opt-in gate): the storm's SIGKILLs,
+            # partitions and heals all run with device-resident serving
+            # live — the tier must never mask a lost or stale write
+            # (the hottier cases in test_chaos.py + the storm
+            # invariants). Admission threshold raised from the default
+            # 1.5: the post-storm invariant checkers read EVERY acked
+            # key 2-4x back-to-back, which at the default would queue a
+            # full-namespace admission wave (background oracle reads)
+            # in every node exactly while deep-heal convergence runs on
+            # this 1-core host. 4 still admits the dedicated hottier
+            # test's polled keys in a handful of reads.
+            "MTPU_HOTTIER": "1",
+            "MTPU_HOTTIER_MIN_HEAT": "4",
             # Both batch planes run at their DEFAULTS — on since the
             # pipeline convergence (PR 12) — so the tier-1 storm's
             # SIGKILL lands mid-coalesced-batch and between WAL-append/

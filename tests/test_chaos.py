@@ -414,6 +414,117 @@ def test_bounded_composed_storm(crash_cluster, tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# 2b. hot-tier invariants on the OS-process cluster (MTPU_HOTTIER=1 —
+#     crash_cluster.py arms it on every node): device residence must
+#     never mask a lost write, a stale generation, or a healed shard.
+# ---------------------------------------------------------------------------
+
+def _metric_value(text: str, name: str) -> float:
+    total = 0.0
+    seen = False
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+                seen = True
+            except ValueError:
+                continue
+    return total if seen else 0.0
+
+
+@pytest.mark.chaos
+def test_hottier_chaos_invariants(crash_cluster, tmp_path):
+    """With the tier armed fleet-wide: (a) a hot object serves from
+    device residence bit-exact and ETag-equal to a drive-path node;
+    (b) an overwrite through ANOTHER node is visible immediately (the
+    serve-time identity check — no cross-process invalidation exists);
+    (c) a heal rewriting shards under a resident object reads
+    bit-exact; (d) a SIGKILL between PUT-ack and admit loses nothing
+    (residence is volatile, the WAL ack is the durability)."""
+    import os
+
+    cl = crash_cluster
+    for i in range(N_NODES):
+        if cl.procs.get(i) is None:
+            cl.start(i)
+            cl.wait_healthy(i)
+    wait_drives_online(cl, N_NODES * DRIVES_PER_NODE, timeout=120)
+    bucket = "hotchaos"
+    c0, c1 = cl.client(0), cl.client(1)
+    # The storm test precedes this one on the shared cluster: tolerate
+    # a short SlowDown window while its last heals settle.
+    deadline = time.monotonic() + 60
+    while True:
+        r = c0.put(f"/{bucket}")
+        if r.status_code in (200, 409):
+            break
+        assert time.monotonic() < deadline, r.text
+        time.sleep(1.0)
+
+    # (a) heat a shard-backed object on node0 until the async admit
+    # lands (96 KiB > inline limit), then prove the hit is exact.
+    body = os.urandom(96 << 10)
+    assert c0.put(f"/{bucket}/hk", data=body).status_code == 200
+    deadline = time.monotonic() + 90
+    while True:
+        r = c0.get(f"/{bucket}/hk", timeout=30)
+        assert r.status_code == 200 and r.content == body
+        if _metric_value(cl.scrape(0),
+                         "minio_tpu_hottier_admits_total") >= 1:
+            break
+        assert time.monotonic() < deadline, (
+            f"tier never admitted — reproduce with MTPU_CHAOS_SEED="
+            f"{SEED}; scrape: "
+            + "\n".join(ln for ln in cl.scrape(0).splitlines()
+                        if "hottier" in ln))
+        time.sleep(0.3)
+    r0 = c0.get(f"/{bucket}/hk", timeout=30)
+    r1 = c1.get(f"/{bucket}/hk", timeout=30)  # node1: drive path
+    assert r0.content == body == r1.content
+    assert r0.headers.get("ETag") == r1.headers.get("ETag")
+    assert _metric_value(cl.scrape(0),
+                         "minio_tpu_hottier_hits_total") >= 1
+
+    # (b) cross-process staleness: overwrite via node1, read via node0
+    # — the resident generation may only MISS, never serve.
+    body2 = os.urandom(96 << 10)
+    assert c1.put(f"/{bucket}/hk", data=body2).status_code == 200
+    r = c0.get(f"/{bucket}/hk", timeout=30)
+    assert r.status_code == 200 and r.content == body2, (
+        f"hot tier served a stale generation — reproduce with "
+        f"MTPU_CHAOS_SEED={SEED}")
+
+    # (c) heal under residence: re-heat body2, lose a shard file on
+    # disk, deep-heal, and re-read bit-exact from BOTH front doors.
+    for _ in range(3):
+        assert c0.get(f"/{bucket}/hk", timeout=30).content == body2
+    shard_files = list(cl.work.glob(f"n*/d*/{bucket}/hk/*/part.1"))
+    assert shard_files, "no shard files found for the hot key"
+    shard_files[0].unlink()
+    items = cl.deep_heal(0, bucket)
+    assert any(i.get("object") == "hk" for i in items), items
+    assert c0.get(f"/{bucket}/hk", timeout=30).content == body2
+    assert c1.get(f"/{bucket}/hk", timeout=30).content == body2
+
+    # (d) SIGKILL between PUT-ack and hot-tier admit: the first GET
+    # heats the key (admission may be mid-read when the node dies) —
+    # after restart the bytes must be there, served by the drive path
+    # of a cold tier.
+    body3 = os.urandom(96 << 10)
+    assert c0.put(f"/{bucket}/hk2", data=body3).status_code == 200
+    r = c0.get(f"/{bucket}/hk2", timeout=30)
+    assert r.status_code == 200 and r.content == body3
+    cl.kill9(0)
+    cl.start(0)
+    cl.wait_healthy(0)
+    r = cl.client(0).get(f"/{bucket}/hk2", timeout=60)
+    assert r.status_code == 200 and r.content == body3, (
+        f"acked write lost across SIGKILL with the tier armed — "
+        f"reproduce with MTPU_CHAOS_SEED={SEED}")
+    wait_drives_online(cl, N_NODES * DRIVES_PER_NODE, timeout=120)
+
+
+# ---------------------------------------------------------------------------
 # 3. the slow soak: generated flapping storm + SLOs from obs/
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,358 @@
+"""HBM-resident hot-object tier (minio_tpu/hottier, docs/HOTTIER.md).
+
+Four tiers:
+  1. bit-exactness — every hot-path response (full and ranged, 16
+     concurrent readers) is byte-exact AND ETag-equal against the
+     drive-path oracle (MTPU_HOTTIER=0 on the same set);
+  2. coherence — PUT/DELETE/heal invalidate through the same hooks as
+     the FileInfo set cache, explicitly versioned reads bypass (and
+     count in minio_tpu_cache_bypass_total), and a stale resident
+     entry can only miss (serve-time identity check), never serve;
+  3. residence mechanics — heat-EWMA admission, budget-bounded
+     coldest-first eviction, digest-mismatch fallback to the drive
+     path, inline objects never admitted, bounded jit traces;
+  4. the cross-process ring — OP_HOTGET probes worker 0's tier
+     (hit bytes, miss → local fallback, identity mismatch → miss).
+
+The chaos-plane cases (SIGKILL between PUT-ack and admit, heal
+rewriting shards under a resident object, the full storm with
+MTPU_HOTTIER=1) live in tests/test_chaos.py on the OS-process cluster.
+"""
+
+import io
+import os
+import threading
+
+import pytest
+
+from minio_tpu import hottier
+from minio_tpu.erasure import ErasureObjects
+from minio_tpu.erasure.types import ObjectOptions
+from minio_tpu.hottier import arena
+from minio_tpu.storage import LocalDrive
+
+B = "hotbkt"
+
+
+def _payload(n: int, seed: int = 0) -> bytes:
+    import numpy as np
+
+    return np.random.default_rng(seed).integers(
+        0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+@pytest.fixture()
+def hot_set(tmp_path, monkeypatch):
+    monkeypatch.setenv("MTPU_HOTTIER", "1")
+    # No admission cooldown in tests: the re-admit cases poll tightly.
+    monkeypatch.setenv("MTPU_HOTTIER_ADMIT_COOLDOWN_S", "0")
+    hottier.reset_global()
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureObjects(drives, parity=1)
+    es.make_bucket(B)
+    yield es
+    es.close()
+    hottier.reset_global()
+
+
+def _get(es, obj, off=0, ln=-1):
+    info, it = es.get_object(B, obj, off, ln)
+    return info, b"".join(bytes(c) for c in it)
+
+
+def _oracle(es, obj, off=0, ln=-1):
+    """The same read with the tier gated OFF — the drive path."""
+    os.environ["MTPU_HOTTIER"] = "0"
+    try:
+        return _get(es, obj, off, ln)
+    finally:
+        os.environ["MTPU_HOTTIER"] = "1"
+
+
+def _admit(es, obj, tries: int = 4) -> None:
+    """Heat the key until the async admission lands."""
+    tier = hottier.get_tier()
+    for _ in range(tries):
+        _get(es, obj)
+        assert tier.drain(30)
+        if tier.resident(B, obj):
+            return
+    raise AssertionError(f"never admitted: {tier.stats()}")
+
+
+# ---------------------------------------------------------------------------
+# 1. bit-exactness vs the drive-path oracle
+# ---------------------------------------------------------------------------
+
+def test_hit_bit_exact_full_and_ranged(hot_set):
+    es = hot_set
+    body = _payload((1 << 20) + 12345, seed=1)
+    es.put_object(B, "o1", io.BytesIO(body), len(body))
+    _admit(es, "o1")
+    tier = hottier.get_tier()
+    h0 = tier.stats()["hits"]
+    info, got = _get(es, "o1")
+    oinfo, want = _oracle(es, "o1")
+    assert got == want == body
+    assert info.etag == oinfo.etag
+    assert tier.stats()["hits"] > h0, "resident object did not hit"
+    import random
+
+    rng = random.Random(7)
+    t0 = arena.trace_count()
+    for _ in range(24):
+        off = rng.randrange(len(body))
+        ln = rng.randrange(1, len(body) - off + 1)
+        _info, got = _get(es, "o1", off, ln)
+        assert got == body[off:off + ln], (off, ln)
+    # Pow2 window bucketing keeps the serve-kernel trace set bounded
+    # under arbitrary ranges (the ring.py discipline).
+    assert arena.trace_count() - t0 <= 4
+
+
+def test_sixteen_concurrent_readers_bit_exact_and_etag(hot_set):
+    es = hot_set
+    bodies = {f"c{i}": _payload(256 << 10, seed=10 + i) for i in range(3)}
+    etags = {}
+    for k, v in bodies.items():
+        es.put_object(B, k, io.BytesIO(v), len(v))
+        etags[k] = _oracle(es, k)[0].etag
+        _admit(es, k)
+    failures: list[str] = []
+
+    def reader(wid: int) -> None:
+        import random
+
+        rng = random.Random(wid)
+        for _ in range(8):
+            k = rng.choice(list(bodies))
+            body = bodies[k]
+            if rng.random() < 0.5:
+                info, got = _get(es, k)
+                want = body
+            else:
+                off = rng.randrange(len(body))
+                ln = rng.randrange(1, len(body) - off + 1)
+                info, got = _get(es, k, off, ln)
+                want = body[off:off + ln]
+            if got != want:
+                failures.append(f"w{wid} {k}: byte mismatch")
+            if info.etag != etags[k]:
+                failures.append(f"w{wid} {k}: etag mismatch")
+
+    threads = [threading.Thread(target=reader, args=(w,))
+               for w in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not failures, failures[:5]
+    st = hottier.get_tier().stats()
+    assert st["hits"] >= 16, st
+
+
+# ---------------------------------------------------------------------------
+# 2. coherence
+# ---------------------------------------------------------------------------
+
+def test_overwrite_serves_new_bytes_and_readmits(hot_set):
+    es = hot_set
+    b1 = _payload(300 << 10, seed=2)
+    b2 = _payload(300 << 10, seed=3)
+    es.put_object(B, "ow", io.BytesIO(b1), len(b1))
+    _admit(es, "ow")
+    es.put_object(B, "ow", io.BytesIO(b2), len(b2))
+    _info, got = _get(es, "ow")
+    assert got == b2, "stale bytes after overwrite"
+    tier = hottier.get_tier()
+    assert tier.drain(30)
+    # Write-through: the still-hot key re-admitted the NEW generation.
+    _admit(es, "ow")
+    _info, got = _get(es, "ow")
+    assert got == b2
+
+
+def test_delete_then_404(hot_set):
+    es = hot_set
+    body = _payload(200 << 10, seed=4)
+    es.put_object(B, "del", io.BytesIO(body), len(body))
+    _admit(es, "del")
+    es.delete_object(B, "del")
+    from minio_tpu.utils import errors as se
+
+    with pytest.raises(se.ObjectNotFound):
+        es.get_object(B, "del")
+
+
+def test_heal_under_resident_object_stays_bit_exact(hot_set, tmp_path):
+    es = hot_set
+    body = _payload(400 << 10, seed=5)
+    es.put_object(B, "healme", io.BytesIO(body), len(body))
+    _admit(es, "healme")
+    fi = es.latest_fileinfo(B, "healme")
+    # Lose one shard file out from under the resident object.
+    victim = None
+    for d in range(4):
+        p = tmp_path / f"d{d}" / B / "healme" / fi.data_dir / "part.1"
+        if p.exists():
+            victim = p
+            break
+    assert victim is not None
+    victim.unlink()
+    res = es.heal_object(B, "healme")
+    assert res.healed_count >= 1
+    assert victim.exists(), "heal did not rewrite the shard"
+    _info, got = _get(es, "healme")
+    oinfo, want = _oracle(es, "healme")
+    assert got == want == body
+    # Heal invalidated through _meta_invalidate; the key re-heats and
+    # re-admits without ever serving a wrong byte.
+    _admit(es, "healme")
+    _info, got = _get(es, "healme")
+    assert got == body
+
+
+def test_versioned_read_bypasses_with_counter(hot_set):
+    es = hot_set
+    b1 = _payload(100 << 10, seed=6)
+    b2 = _payload(100 << 10, seed=7)
+    i1 = es.put_object(B, "ver", io.BytesIO(b1), len(b1),
+                       ObjectOptions(versioned=True))
+    es.put_object(B, "ver", io.BytesIO(b2), len(b2),
+                  ObjectOptions(versioned=True))
+    _admit(es, "ver")
+    from minio_tpu.erasure.objects import _CACHE_BYPASS
+
+    c0 = _CACHE_BYPASS.labels(reason="hottier_versioned").value
+    info, it = es.get_object(
+        B, "ver", opts=ObjectOptions(version_id=i1.version_id))
+    assert b"".join(bytes(c) for c in it) == b1
+    assert _CACHE_BYPASS.labels(
+        reason="hottier_versioned").value == c0 + 1
+    # And the latest still hits the tier.
+    _info, got = _get(es, "ver")
+    assert got == b2
+
+
+# ---------------------------------------------------------------------------
+# 3. residence mechanics
+# ---------------------------------------------------------------------------
+
+def test_inline_objects_never_admitted(hot_set):
+    es = hot_set
+    body = _payload(4 << 10, seed=8)  # under INLINE_DATA_LIMIT
+    es.put_object(B, "tiny", io.BytesIO(body), len(body))
+    for _ in range(4):
+        _info, got = _get(es, "tiny")
+        assert got == body
+    tier = hottier.get_tier()
+    assert tier.drain(10)
+    assert not tier.resident(B, "tiny")
+
+
+def test_budget_evicts_coldest_first(tmp_path, monkeypatch):
+    monkeypatch.setenv("MTPU_HOTTIER", "1")
+    monkeypatch.setenv("MTPU_HOTTIER_ADMIT_COOLDOWN_S", "0")
+    # Budget fits ~one 2 MiB entry: with k=3 the 1 MiB-block chunks
+    # (349526 B) bucket to 512 KiB rows, so one entry charges ~3.1 MiB.
+    monkeypatch.setenv("MTPU_HOTTIER_BYTES", str(4 << 20))
+    hottier.reset_global()
+    drives = [LocalDrive(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureObjects(drives, parity=1)
+    try:
+        es.make_bucket(B)
+        cold = _payload(2 << 20, seed=9)
+        hot = _payload(2 << 20, seed=10)
+        es.put_object(B, "cold", io.BytesIO(cold), len(cold))
+        es.put_object(B, "hot", io.BytesIO(hot), len(hot))
+        _admit(es, "cold")
+        tier = hottier.get_tier()
+        # Make "hot" hotter than "cold", then admit: cold is the victim.
+        for _ in range(6):
+            _get(es, "hot")
+            tier.drain(30)
+        assert tier.resident(B, "hot"), tier.stats()
+        assert not tier.resident(B, "cold")
+        st = tier.stats()
+        assert st["evictions"] >= 1
+        assert st["resident_bytes"] <= 4 << 20
+        _info, got = _get(es, "hot")
+        assert got == hot
+        _info, got = _get(es, "cold")  # evicted: drive path, still exact
+        assert got == cold
+    finally:
+        es.close()
+        hottier.reset_global()
+
+
+def test_digest_mismatch_falls_back_to_drive_path(hot_set):
+    es = hot_set
+    body = _payload(128 << 10, seed=11)
+    es.put_object(B, "rot", io.BytesIO(body), len(body))
+    _admit(es, "rot")
+    tier = hottier.get_tier()
+    with tier._mu:
+        entry = tier._entries[(B, "rot")]
+    # Simulate resident-bit rot: the baseline no longer matches what
+    # the serve launch will hash.
+    entry.digs[0, 0, 0] ^= 0xFF
+    _info, got = _get(es, "rot")
+    assert got == body, "fallback did not serve the drive path"
+    assert not tier.resident(B, "rot"), "rotted entry not evicted"
+    assert tier.stats()["evictions"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# 4. the cross-process ring (OP_HOTGET)
+# ---------------------------------------------------------------------------
+
+def test_ring_hotget_roundtrip(monkeypatch):
+    monkeypatch.setenv("MTPU_HOTTIER", "1")
+    hottier.reset_global()
+    from minio_tpu.frontdoor import laneserver, shm
+
+    body = _payload(200 << 10, seed=12)
+
+    class Info:
+        etag, size, mod_time, version_id = "e-ring", len(body), 42.5, ""
+
+    served_reads = []
+
+    def reader(b, o):
+        served_reads.append((b, o))
+        return Info(), iter([body])
+
+    hottier.set_reader(reader)
+    ring = shm.Ring.create(nslots=8)
+    server = laneserver.LaneServer(ring, worker=0)
+    client = laneserver.LaneClient(ring, 1, 2)
+    try:
+        ident = ("", "e-ring", len(body), 42.5)
+        # Cold probes: misses that feed the owner's shared heat.
+        assert client.hot_get(B, "rk", ident, 0, len(body)) is None
+        assert client.hot_get(B, "rk", ident, 0, len(body)) is None
+        tier = hottier.get_tier()
+        assert tier.drain(30)
+        assert tier.resident(B, "rk"), tier.stats()
+        assert served_reads == [(B, "rk")]
+        got = client.hot_get(B, "rk", ident, 0, len(body))
+        assert got is not None and bytes(got) == body
+        got = client.hot_get(B, "rk", ident, 1000, 5000)
+        assert bytes(got) == body[1000:6000]
+        # The tier-shaped client the router installs.
+        hot = laneserver.HotRingClient(client)
+        out = hot.serve_ident(B, "rk", ident, 2000, 3000)
+        assert b"".join(bytes(c) for c in out) == body[2000:5000]
+        # A newer elected identity can only miss — and drops the entry.
+        newer = ("", "e-ring-2", len(body), 43.0)
+        assert client.hot_get(B, "rk", newer, 0, 16) is None
+        assert not tier.resident(B, "rk")
+        # Oversize responses never ride the ring.
+        assert client.hot_get(B, "rk", ident, 0,
+                              ring.resp_cap + 1) is None
+    finally:
+        server.stop()
+        client.close()
+        ring.unlink()
+        hottier.set_reader(None)
+        hottier.reset_global()

@@ -113,8 +113,14 @@ def test_delimiter_group_resume_prunes(huge_set):
     dt = time.perf_counter() - t0
     assert pages >= 19
     # 20 pages over 200 groups: with the prune this is directory scans
-    # only (~ms); without it each page re-parsed up to 200k journals.
-    assert dt < 5.0, f"group-resume pages took {dt:.1f}s"
+    # only (~ms); without it each page re-parsed up to 200k journals
+    # (minutes). The budget is a *prune-regression* gate, not a latency
+    # SLO — under full-suite load (sanitizers armed, sibling tests on
+    # the same core) the same directory scans measured 3-6x their
+    # standalone wall time, which flaked the old 5 s budget without any
+    # algorithmic regression (PR 12 note). 20 s still fails an unpruned
+    # walk by an order of magnitude.
+    assert dt < 20.0, f"group-resume pages took {dt:.1f}s"
     # Plain marker (no delimiter) equal to a group prefix: resume INSIDE.
     res = es.list_objects("huge", marker="p123/", max_keys=5)
     assert [o.name for o in res.objects] == [

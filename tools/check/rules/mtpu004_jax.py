@@ -124,6 +124,7 @@ class JaxHygieneRule(Rule):
         return relpath.startswith(("minio_tpu/ops/", "minio_tpu/native/",
                                    "minio_tpu/dataplane/",
                                    "minio_tpu/frontdoor/",
+                                   "minio_tpu/hottier/",
                                    "minio_tpu/erasure/codec.py"))
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:

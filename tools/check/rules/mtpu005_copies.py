@@ -33,7 +33,9 @@ FILES = ("minio_tpu/erasure/objects.py", "minio_tpu/storage/local.py",
          "minio_tpu/frontdoor/shm.py",
          "minio_tpu/frontdoor/laneserver.py",
          "minio_tpu/erasure/healing.py",
-         "minio_tpu/erasure/multipart.py")
+         "minio_tpu/erasure/multipart.py",
+         "minio_tpu/hottier/tier.py",
+         "minio_tpu/hottier/arena.py")
 
 _BUF_NAMES = {"buf", "buffer", "chunk", "payload", "body", "blob", "raw",
               "mv", "view", "frame", "tail", "head"}
