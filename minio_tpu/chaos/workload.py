@@ -77,10 +77,15 @@ class FleetStats:
         return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
 
     def describe(self) -> dict:
+        # p99() takes self.mu itself (a non-reentrant Lock) — compute
+        # it BEFORE the snapshot lock. Calling it under mu deadlocked
+        # unconditionally; the path only runs in storm-failure
+        # diagnostics, so no test ever executed it (found by MTPU007).
+        p99 = self.p99()
         with self.mu:
             return {"ops": dict(self.ops), "errors": dict(self.errors),
                     "violations": list(self.violations),
-                    "p99_s": round(self.p99(), 3)}
+                    "p99_s": round(p99, 3)}
 
 
 class MixedWorkload:

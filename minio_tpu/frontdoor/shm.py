@@ -62,6 +62,19 @@ FREE, SUBMITTED, DONE, ERROR, ABANDONED = 0, 1, 2, 3, 4
 # the sibling serves its local drive path. The probe doubles as the
 # heat feed, so every worker's GETs drive one shared admission policy.
 OP_DIGEST, OP_ENCODE, OP_RECONSTRUCT, OP_HOTGET = 1, 2, 3, 4
+# Closed opcode registry (static rule MTPU009, docs/ANALYSIS.md): every
+# ring dispatch site — the LaneServer drain, its served-op label map,
+# the LaneClient builders — must handle every member, so a new opcode
+# cannot silently fall through one side of the client/server pair.
+# tools/check parses this literal statically; add the constant above
+# AND the row here, then let the analyzer point at every dispatch that
+# does not handle it yet.
+RING_OPS = {
+    "OP_DIGEST": OP_DIGEST,
+    "OP_ENCODE": OP_ENCODE,
+    "OP_RECONSTRUCT": OP_RECONSTRUCT,
+    "OP_HOTGET": OP_HOTGET,
+}
 FLAG_DIGESTS = 1
 
 _U32 = struct.Struct("<I")

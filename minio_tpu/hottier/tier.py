@@ -448,6 +448,12 @@ class HotObjectTier:
         if not ok:
             self.arena.recycle_staging(shape, staging)
             return
+        # Ownership transfer, not an escape: on success the sealed
+        # _Entry OWNS this staging array (entry.staging) for its whole
+        # resident lifetime — it returns to the arena free list only at
+        # eviction, via _release -> recycle_staging. Every failure path
+        # below recycles it here instead.
+        # mtpu: allow(MTPU008)
         entry = self._seal(ident, k, bs, size, nblocks, shape, staging,
                            lens)
         if entry is None:

@@ -205,6 +205,11 @@ def _apply_fold(drive, final) -> "tuple[int, int]":
     failed = 0
     for (vol, path), rec in final.items():
         stat_err = False
+        # REC_REMOVE_PREFIX never reaches a fold: fold()/fold_merged()
+        # consume tombstones in-stream (they delete the keys they
+        # cover and are dropped), so the dispatch below is total over
+        # every record type a fold output can contain.
+        # mtpu: allow(MTPU009)
         blob = rec.rtype in (walfmt.REC_BLOB, walfmt.REC_BLOB_REMOVE)
         try:
             # Blob records tiebreak against the blob FILE's mtime; the
@@ -246,7 +251,7 @@ def _apply_fold(drive, final) -> "tuple[int, int]":
             except se.StorageError:
                 failed += 1
                 continue
-        else:  # REC_REMOVE
+        elif rec.rtype == walfmt.REC_REMOVE:
             if disk_mt is None and not stat_err:
                 continue  # genuinely absent: nothing to remove
             # A corrupt/unreadable journal under an acked REMOVE still
@@ -260,6 +265,14 @@ def _apply_fold(drive, final) -> "tuple[int, int]":
             except se.StorageError:
                 failed += 1
                 continue
+        else:
+            # A record type this build does not understand (newer
+            # writer, older reader). The old bare `else` treated it as
+            # a REMOVE and would have DELETED metadata for it — count
+            # it failed instead, which keeps the journal for a build
+            # that can apply it (truncation requires failed == 0).
+            failed += 1
+            continue
     if applied:
         os.sync()  # one barrier instead of a per-file fsync storm
     # Only a fully-applied journal may truncate (callers enforce): a
@@ -372,6 +385,11 @@ class DriveWAL:
             for (vol, path), rec in walfmt.fold_merged(
                     replay_kept).items():
                 self._lsn += 1
+                # Not a dispatch gap: REC_REMOVE seeds raw=None (a
+                # pending removal Entry) through the else by design,
+                # and REC_REMOVE_PREFIX cannot appear in a fold —
+                # fold_merged consumes tombstones in-stream.
+                # mtpu: allow(MTPU009)
                 blob = rec.rtype in (walfmt.REC_BLOB,
                                      walfmt.REC_BLOB_REMOVE)
                 self._pending[(vol, path)] = Entry(

@@ -48,6 +48,18 @@ REC_REMOVE_PREFIX = 3
 # format is identical; only the apply side dispatches differently.
 REC_BLOB = 4
 REC_BLOB_REMOVE = 5
+# Closed record-type registry (static rule MTPU009, docs/ANALYSIS.md):
+# every WAL dispatch site — the replay fold apply, the commit staging,
+# the overlay publish — must handle every member or carry a written
+# suppression; a record type added here without teaching replay would
+# otherwise silently drop acked state at the next crash.
+WAL_RECORD_TYPES = {
+    "REC_COMMIT": REC_COMMIT,
+    "REC_REMOVE": REC_REMOVE,
+    "REC_REMOVE_PREFIX": REC_REMOVE_PREFIX,
+    "REC_BLOB": REC_BLOB,
+    "REC_BLOB_REMOVE": REC_BLOB_REMOVE,
+}
 
 _FRAME = struct.Struct("<II")       # payload_len, crc32
 _HEAD = struct.Struct("<BdHHI")     # type, mt, vol_len, path_len, raw_len

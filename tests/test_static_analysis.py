@@ -60,10 +60,11 @@ def test_tree_is_clean():
         f"row from tools/check/baseline.json): {result.stale}")
 
 
-def test_all_six_rules_registered():
+def test_all_ten_rules_registered():
     rules = tc.all_rules()
     assert set(rules) == {"MTPU001", "MTPU002", "MTPU003", "MTPU004",
-                          "MTPU005", "MTPU006"}
+                          "MTPU005", "MTPU006", "MTPU007", "MTPU008",
+                          "MTPU009", "MTPU010"}
 
 
 # ---------------------------------------------------------------------------
@@ -867,3 +868,646 @@ def test_lock_graph_is_currently_acyclic():
     assertion the session guard makes at exit, checkable mid-run."""
     cycles = sanitize.check_lock_cycles()
     assert cycles == [], cycles
+
+
+# ---------------------------------------------------------------------------
+# The pass-1 call-graph engine (tools/check/project.py)
+# ---------------------------------------------------------------------------
+
+
+def build_index(tmp_path: Path, files: dict[str, str], use_cache=False):
+    from tools.check.project import ProjectIndex
+
+    for rel, body in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+    return ProjectIndex.build(tmp_path, use_cache=use_cache)
+
+
+_ENGINE_A = """
+    import threading
+    from minio_tpu.fix import b
+
+    MU_A = threading.Lock()
+
+    def take_a():
+        with MU_A:
+            pass
+
+    def forward():
+        with MU_A:
+            b.take_b()
+"""
+
+_ENGINE_B = """
+    import threading
+    from minio_tpu.fix import a
+
+    MU_B = threading.Lock()
+
+    def take_b():
+        with MU_B:
+            pass
+
+    def reverse():
+        with MU_B:
+            a.take_a()
+"""
+
+
+def test_engine_cross_module_resolution(tmp_path):
+    idx = build_index(tmp_path, {"minio_tpu/fix/a.py": _ENGINE_A,
+                                 "minio_tpu/fix/b.py": _ENGINE_B})
+    assert idx.resolve_call("minio_tpu/fix/a.py", "", "b", "take_b") == \
+        ("minio_tpu/fix/b.py", "take_b")
+    assert idx.resolve_call("minio_tpu/fix/a.py", "", None, "take_a") == \
+        ("minio_tpu/fix/a.py", "take_a")
+    assert idx.resolve_call("minio_tpu/fix/a.py", "", "b", "missing") \
+        is None
+
+
+def test_engine_transitive_acquires_through_calls(tmp_path):
+    idx = build_index(tmp_path, {"minio_tpu/fix/a.py": _ENGINE_A,
+                                 "minio_tpu/fix/b.py": _ENGINE_B})
+    acq = idx.transitive_acquires("minio_tpu/fix/a.py", "forward")
+    assert "minio_tpu/fix/a.py:MU_A" in acq
+    assert "minio_tpu/fix/b.py:MU_B" in acq
+
+
+def test_engine_cycle_detection_unit():
+    from tools.check.rules.mtpu007_lockorder import find_cycles
+
+    cycles = find_cycles({"a": {"b"}, "b": {"c"}, "c": {"a"}})
+    assert len(cycles) == 1 and set(cycles[0]) == {"a", "b", "c"}
+    assert find_cycles({"a": {"b"}, "b": {"c"}}) == []
+
+
+def test_engine_cache_invalidation_on_file_change(tmp_path):
+    import os as _os
+
+    from tools.check.project import CACHE_NAME, ProjectIndex
+
+    rel = "minio_tpu/fix/mod.py"
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True)
+    p.write_text("def f():\n    pass\n")
+    idx1 = ProjectIndex.build(tmp_path, use_cache=True)
+    assert "f" in idx1.files[rel]["functions"]
+    assert (tmp_path / CACHE_NAME).exists()
+
+    p.write_text("def g():\n    pass\n")
+    st = _os.stat(p)
+    _os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns + 10_000_000))
+    idx2 = ProjectIndex.build(tmp_path, use_cache=True)
+    assert "g" in idx2.files[rel]["functions"]
+    assert "f" not in idx2.files[rel]["functions"]
+
+
+def test_engine_unchanged_files_come_from_cache(tmp_path):
+    import json as _json
+
+    from tools.check.project import CACHE_NAME, ProjectIndex, _MEMO
+
+    rel = "minio_tpu/fix/mod.py"
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True)
+    p.write_text("def f():\n    pass\n")
+    ProjectIndex.build(tmp_path, use_cache=True)
+    # Poison the cached summary, drop the in-process memo, rebuild: the
+    # unchanged stamp must win (proof the summarizer did not re-run).
+    cache_path = tmp_path / CACHE_NAME
+    data = _json.loads(cache_path.read_text())
+    data["files"][rel]["summary"]["functions"] = {"poisoned": {
+        "line": 1, "cls": "", "params": [], "calls": [], "regions": [],
+        "flocks": [], "flock_rel_line": None, "returns_holding": False,
+        "param_stores": [], "param_passes": []}}
+    cache_path.write_text(_json.dumps(data))
+    _MEMO.pop(str(tmp_path.resolve()), None)
+    idx = ProjectIndex.build(tmp_path, use_cache=True)
+    assert "poisoned" in idx.files[rel]["functions"]
+
+
+def test_engine_env_read_aliases_and_name_constants(tmp_path):
+    src = """
+    import os
+
+    ENABLE_ENV = "MTPU_FIX_BY_CONST"
+
+    def reads():
+        env = os.environ.get
+        a = env("MTPU_FIX_ALIASED", "1")
+        b = os.environ.get(ENABLE_ENV, "")
+        c = os.environ.get(f"MTPU_FIX_FAMILY_{a}", "")
+        return a, b, c
+    """
+    idx = build_index(tmp_path, {"minio_tpu/fix/mod.py": src})
+    reads = {r["name"]: r for _rel, r in idx.env_reads()}
+    assert "MTPU_FIX_ALIASED" in reads
+    assert "MTPU_FIX_BY_CONST" in reads
+    assert reads["MTPU_FIX_FAMILY_"]["prefix"] is True
+
+
+# ---------------------------------------------------------------------------
+# MTPU007 — static lock order through call edges
+# ---------------------------------------------------------------------------
+
+
+def test_mtpu007_abba_through_call_chain(tmp_path):
+    """The sanitizer's blind spot: an ABBA cycle reachable only through
+    a cross-module call chain no test ever executes is still caught."""
+    r = run_fixture(tmp_path, "minio_tpu/fix/a.py", _ENGINE_A, "MTPU007",
+                    extra={"minio_tpu/fix/b.py": _ENGINE_B})
+    assert any("lock-order cycle" in f.message for f in r.new), \
+        [f.message for f in r.new]
+    assert any("MU_A" in f.message and "MU_B" in f.message
+               for f in r.new)
+
+
+def test_mtpu007_consistent_order_negative(tmp_path):
+    src = """
+    import threading
+    from minio_tpu.fix import b
+
+    MU_A = threading.Lock()
+
+    def forward():
+        with MU_A:
+            b.take_b()
+
+    def forward_again():
+        with MU_A:
+            with b.MU_B:
+                pass
+    """
+    other = """
+    import threading
+
+    MU_B = threading.Lock()
+
+    def take_b():
+        with MU_B:
+            pass
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/a.py", src, "MTPU007",
+                    extra={"minio_tpu/fix/b.py": other})
+    assert not r.new
+
+
+def test_mtpu007_self_reacquisition_positive(tmp_path):
+    """The FleetStats.describe bug shape: `with self.mu:` calling a
+    method that takes the same non-reentrant Lock — an unconditional
+    deadlock the moment the path runs."""
+    src = """
+    import threading
+
+    class Stats:
+        def __init__(self):
+            self.mu = threading.Lock()
+
+        def p99(self):
+            with self.mu:
+                return 1
+
+        def describe(self):
+            with self.mu:
+                return self.p99()
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/stats.py", src, "MTPU007")
+    assert len(r.new) == 1
+    assert "re-acquired while held" in r.new[0].message
+    assert "p99()" in r.new[0].message
+
+
+def test_mtpu007_rlock_reacquisition_negative(tmp_path):
+    src = """
+    import threading
+
+    class Stats:
+        def __init__(self):
+            self.mu = threading.RLock()
+
+        def p99(self):
+            with self.mu:
+                return 1
+
+        def describe(self):
+            with self.mu:
+                return self.p99()
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/stats.py", src, "MTPU007")
+    assert not r.new
+
+
+def test_mtpu007_flock_then_mutex_orders_against_reverse(tmp_path):
+    """A function returning while holding a file lock extends the hold
+    over its caller's remaining body; a path taking the mutex first and
+    the flock second closes the cycle."""
+    src = """
+    import fcntl
+    import os
+    import threading
+
+    MU = threading.Lock()
+
+    def _claim(root):
+        fd = os.open(root + "/.replay.lock", os.O_RDWR)
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        return fd
+
+    def replay(root):
+        _claim(root)
+        with MU:
+            pass
+
+    def reverse(root, fd):
+        with MU:
+            fd2 = os.open(root + "/.replay.lock", os.O_RDWR)
+            fcntl.flock(fd2, fcntl.LOCK_EX)
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/wal.py", src, "MTPU007")
+    assert any("lock-order cycle" in f.message for f in r.new), \
+        [f.message for f in r.new]
+
+
+def test_mtpu007_suppressed(tmp_path):
+    src = """
+    import threading
+
+    class Stats:
+        def __init__(self):
+            self.mu = threading.Lock()
+
+        def p99(self):
+            with self.mu:
+                return 1
+
+        def describe(self):
+            # mtpu: allow(MTPU007) - fixture: deliberate, documented
+            with self.mu:
+                return self.p99()
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/stats.py", src, "MTPU007")
+    assert not r.new and len(r.suppressed) == 1
+
+
+def test_fleetstats_describe_regression():
+    """chaos.workload.FleetStats.describe deadlocked unconditionally
+    (p99 re-took self.mu under describe's hold) until MTPU007 found it —
+    it only ran in assert-failure diagnostics. Drive it for real, with a
+    watchdog so a regression fails instead of hanging the suite."""
+    from minio_tpu.chaos.workload import FleetStats
+
+    stats = FleetStats()
+    stats.record("GET", 0.01, ok=True)
+    out: dict = {}
+    t = threading.Thread(target=lambda: out.update(stats.describe()),
+                         daemon=True)
+    t.start()
+    t.join(timeout=5.0)
+    assert not t.is_alive(), "FleetStats.describe deadlocked again"
+    assert out["ops"] == {"GET": 1} and out["p99_s"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# MTPU008 — slot-scoped buffer lifetime
+# ---------------------------------------------------------------------------
+
+
+def test_mtpu008_ring_view_stored_past_release(tmp_path):
+    """The acceptance fixture: a ring-slot memoryview stored into an
+    attribute outlives the slot's FREE->SUBMITTED->DONE recycle."""
+    src = """
+    class Server:
+        def drain(self, ring, idx):
+            view = ring.req_view(idx)
+            self.last_req = view
+            ring.respond(idx)
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/srv.py", src, "MTPU008")
+    assert len(r.new) == 1
+    assert "stored into attribute" in r.new[0].message
+
+
+def test_mtpu008_returned_after_release(tmp_path):
+    src = """
+    def serve(ring, idx):
+        view = ring.req_view(idx)
+        ring.respond(idx)
+        return view
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/srv.py", src, "MTPU008")
+    assert len(r.new) == 1
+    assert "after the slot's release point" in r.new[0].message
+
+
+def test_mtpu008_container_store_and_slice_alias(tmp_path):
+    src = """
+    class Q:
+        def push(self, ring, idx):
+            view = ring.req_view(idx)
+            head = view[:16]
+            self._q.append(head)
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/q.py", src, "MTPU008")
+    assert len(r.new) == 1
+    assert ".append()" in r.new[0].message
+
+
+def test_mtpu008_thread_capture(tmp_path):
+    src = """
+    import threading
+
+    def bg(ring, idx):
+        view = ring.req_view(idx)
+        t = threading.Thread(target=lambda: bytes(view))
+        t.start()
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/bg.py", src, "MTPU008")
+    assert len(r.new) == 1
+    assert "captured by Thread() closure" in r.new[0].message
+
+
+def test_mtpu008_interprocedural_store(tmp_path):
+    """Passing the view to a resolved callee that stores its parameter
+    is the same escape, one hop removed (pass-1 param summaries)."""
+    src = """
+    from minio_tpu.fix.sink import keep
+
+    def hand(ring, idx):
+        view = ring.req_view(idx)
+        keep(view)
+    """
+    sink = """
+    class _State:
+        pass
+
+    STATE = _State()
+
+    def keep(v):
+        STATE.held = v
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/a.py", src, "MTPU008",
+                    extra={"minio_tpu/fix/sink.py": sink})
+    assert len(r.new) == 1
+    assert "passed to keep()" in r.new[0].message
+
+
+def test_mtpu008_copy_negative(tmp_path):
+    src = """
+    def serve(ring, idx):
+        view = ring.req_view(idx)
+        data = bytes(view)
+        ring.respond(idx)
+        return data
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/srv.py", src, "MTPU008")
+    assert not r.new
+
+
+def test_mtpu008_use_before_release_negative(tmp_path):
+    src = """
+    def serve(ring, idx, out):
+        view = ring.req_view(idx)
+        out[0:4] = view[0:4]
+        n = len(view)
+        ring.respond(idx)
+        return n
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/srv.py", src, "MTPU008")
+    assert not r.new
+
+
+def test_mtpu008_suppressed_ownership_rationale(tmp_path):
+    src = """
+    class Server:
+        def drain(self, ring, idx):
+            view = ring.req_view(idx)
+            # Ownership transfer: entry holds the slot until evict.
+            # mtpu: allow(MTPU008)
+            self.last_req = view
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/srv.py", src, "MTPU008")
+    assert not r.new and len(r.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# MTPU009 — closed protocol registries
+# ---------------------------------------------------------------------------
+
+_PROTO = """
+    OP_A = 1
+    OP_B = 2
+    OP_C = 3
+
+    FIX_OPS = {"OP_A": OP_A, "OP_B": OP_B, "OP_C": OP_C}
+"""
+
+
+def test_mtpu009_dispatch_gap(tmp_path):
+    src = """
+    from minio_tpu.fix import proto
+
+    def dispatch(op):
+        if op == proto.OP_A:
+            return 1
+        if op == proto.OP_B:
+            return 2
+        return 0
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/srv.py", src, "MTPU009",
+                    extra={"minio_tpu/fix/proto.py": _PROTO})
+    assert len(r.new) == 1
+    assert "never references OP_C" in r.new[0].message
+
+
+def test_mtpu009_total_dispatch_negative(tmp_path):
+    src = """
+    from minio_tpu.fix import proto
+
+    def dispatch(op):
+        if op == proto.OP_A:
+            return 1
+        if op == proto.OP_B:
+            return 2
+        if op == proto.OP_C:
+            return 3
+        return 0
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/srv.py", src, "MTPU009",
+                    extra={"minio_tpu/fix/proto.py": _PROTO})
+    assert not r.new
+
+
+def test_mtpu009_dispatch_map_gap(tmp_path):
+    src = """
+    from minio_tpu.fix.proto import OP_A, OP_B
+
+    LABELS = {OP_A: "a", OP_B: "b"}
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/labels.py", src, "MTPU009",
+                    extra={"minio_tpu/fix/proto.py": _PROTO})
+    assert any("dispatch map" in f.message and "OP_C" in f.message
+               for f in r.new), [f.message for f in r.new]
+
+
+def test_mtpu009_orphan_and_side_channel(tmp_path):
+    proto = """
+    OP_A = 1
+    OP_B = 2
+    OP_ROGUE = 9
+
+    FIX_OPS = {"OP_A": OP_A, "OP_B": OP_B}
+    """
+    user = """
+    from minio_tpu.fix.proto import OP_A
+
+    def touch(op):
+        return op == OP_A
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/proto.py", proto, "MTPU009",
+                    extra={"minio_tpu/fix/user.py": user})
+    msgs = [f.message for f in r.new]
+    assert any("OP_B" in m and "never referenced outside" in m
+               for m in msgs), msgs
+    assert any("OP_ROGUE" in m and "not in any registry" in m
+               for m in msgs), msgs
+
+
+def test_mtpu009_same_name_other_module_not_confused(tmp_path):
+    """dataplane's string lane keys share names with shm's ring opcodes;
+    module-qualified resolution must keep them apart."""
+    src = """
+    OP_A = "encode-lane"
+
+    def lane(op):
+        if op == OP_A:
+            return 1
+        return 0
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/lanes.py", src, "MTPU009",
+                    extra={"minio_tpu/fix/proto.py": _PROTO})
+    assert not r.new
+
+
+def test_mtpu009_suppressed(tmp_path):
+    src = """
+    from minio_tpu.fix import proto
+
+    def dispatch(op):
+        # OP_C is consumed upstream and cannot reach this drain.
+        # mtpu: allow(MTPU009)
+        if op == proto.OP_A:
+            return 1
+        if op == proto.OP_B:
+            return 2
+        return 0
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/srv.py", src, "MTPU009",
+                    extra={"minio_tpu/fix/proto.py": _PROTO})
+    assert not r.new and len(r.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# MTPU010 — env-knob drift gate
+# ---------------------------------------------------------------------------
+
+_KNOB_READ = """
+    import os
+
+    def conf():
+        return os.environ.get("MTPU_FIX_KNOB", "1")
+"""
+
+
+def test_mtpu010_undocumented_knob(tmp_path):
+    r = run_fixture(tmp_path, "minio_tpu/fix/conf.py", _KNOB_READ,
+                    "MTPU010")
+    assert len(r.new) == 1
+    assert "undocumented knob MTPU_FIX_KNOB" in r.new[0].message
+
+
+def test_mtpu010_documented_negative(tmp_path):
+    doc = ("# knobs\n"
+           "| Knob | Default | Read in | Docs | Purpose |\n"
+           "|---|---|---|---|---|\n"
+           "| `MTPU_FIX_KNOB` | `1` | `fix/conf` | — | fixture knob |\n")
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs/KNOBS.md").write_text(doc)
+    r = run_fixture(tmp_path, "minio_tpu/fix/conf.py", _KNOB_READ,
+                    "MTPU010")
+    assert not r.new
+
+
+def test_mtpu010_stale_row_and_placeholder(tmp_path):
+    doc = ("# knobs\n"
+           "| Knob | Default | Read in | Docs | Purpose |\n"
+           "|---|---|---|---|---|\n"
+           "| `MTPU_FIX_KNOB` | `1` | `fix/conf` | — | **UNDOCUMENTED** "
+           "placeholder |\n"
+           "| `MTPU_FIX_GONE` | `0` | `fix/conf` | — | removed knob |\n")
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs/KNOBS.md").write_text(doc)
+    r = run_fixture(tmp_path, "minio_tpu/fix/conf.py", _KNOB_READ,
+                    "MTPU010")
+    msgs = [f.message for f in r.new]
+    assert any("stale registry row MTPU_FIX_GONE" in m for m in msgs), msgs
+    assert any("UNDOCUMENTED placeholder" in m for m in msgs), msgs
+    assert all(f.path == "docs/KNOBS.md" for f in r.new)
+
+
+def test_mtpu010_dynamic_family(tmp_path):
+    src = """
+    import os
+
+    def deadline(cls):
+        return os.environ.get(f"MTPU_FIX_DEADLINE_{cls}", "")
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/conf.py", src, "MTPU010")
+    assert len(r.new) == 1
+    assert "dynamic knob family 'MTPU_FIX_DEADLINE_*'" in r.new[0].message
+    doc = ("| Knob | Default | Read in | Docs | Purpose |\n"
+           "|---|---|---|---|---|\n"
+           "| `MTPU_FIX_DEADLINE_META` | — | `fix/conf` | — | meta |\n")
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs/KNOBS.md").write_text(doc)
+    r2 = run_fixture(tmp_path, "minio_tpu/fix/conf.py", src, "MTPU010")
+    assert not r2.new
+
+
+def test_mtpu010_suppressed(tmp_path):
+    src = """
+    import os
+
+    def conf():
+        # mtpu: allow(MTPU010) - fixture: deliberately unregistered
+        return os.environ.get("MTPU_FIX_KNOB", "1")
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/conf.py", src, "MTPU010")
+    assert not r.new and len(r.suppressed) == 1
+
+
+def test_knobs_doc_is_current():
+    """docs/KNOBS.md matches a fresh generation from the committed tree
+    — the registry is generated, never hand-drifted (the other half of
+    the MTPU010 gate, same pattern as the zero-copy worklist)."""
+    from tools.check.knobs import render
+    from tools.check.project import ProjectIndex
+
+    committed = (ROOT / "docs" / "KNOBS.md").read_text()
+    assert render(ProjectIndex.build(ROOT)) == committed, (
+        "docs/KNOBS.md is stale — run `python -m tools.check --knobs` "
+        "and commit the result")
+
+
+def test_knob_docs_entries_all_render():
+    """Every curated KNOB_DOCS entry appears in the generated registry —
+    a description for a knob the scan no longer sees is dead curation
+    (except dynamic-family expansions, which render only while their
+    prefix read exists)."""
+    from tools.check.knobs import KNOB_DOCS, scan_knobs
+    from tools.check.project import ProjectIndex
+
+    rendered = set(scan_knobs(ProjectIndex.build(ROOT)))
+    dead = sorted(set(KNOB_DOCS) - rendered)
+    assert not dead, f"KNOB_DOCS entries no scan read matches: {dead}"

@@ -109,10 +109,22 @@ class FileContext:
 
 class Rule:
     """One invariant. Subclasses set `id` + `title` and implement
-    check(); cross-file rules collect per file and emit in finalize()."""
+    check(); cross-file rules collect per file and emit in finalize().
+    Interprocedural rules set `needs_index = True` and receive the
+    pass-1 ProjectIndex (tools/check/project.py) via prepare() before
+    any check() call."""
 
     id = "MTPU000"
     title = "abstract rule"
+    needs_index = False
+
+    def __init__(self) -> None:
+        self.index = None          # ProjectIndex when needs_index
+        self.checked: set[str] = set()  # files in this run's scope
+
+    def prepare(self, index, checked: set[str]) -> None:
+        self.index = index
+        self.checked = checked
 
     def scope(self, relpath: str) -> bool:
         return True
@@ -167,7 +179,11 @@ def discover_files(root: Path, paths: Sequence[str] | None = None) -> list[str]:
     for p in paths or ["minio_tpu"]:
         target = (root / p) if not Path(p).is_absolute() else Path(p)
         if target.is_dir():
-            found = sorted(target.rglob("*.py"))
+            # __pycache__ holds compiled artifacts, never sources —
+            # skipped everywhere file sets are gathered so no audit
+            # (rules, worklist, knob registry) ever matches bytecode.
+            found = sorted(f for f in target.rglob("*.py")
+                           if "__pycache__" not in f.parts)
             if not found:
                 raise PathScopeError(f"{p}: directory contains no .py files")
         elif target.suffix == ".py" and target.exists():
@@ -270,11 +286,22 @@ def run(root: Path, paths: Sequence[str] | None = None,
     for rel in rels:
         try:
             src = (root / rel).read_text()
-            ctx = FileContext(root, rel, src)
+            ctxs[rel] = FileContext(root, rel, src)
         except (OSError, SyntaxError, UnicodeDecodeError) as e:
             result.errors.append(f"{rel}: {type(e).__name__}: {e}")
-            continue
-        ctxs[rel] = ctx
+
+    if any(r.needs_index for r in rules):
+        # Pass 1: the project-wide symbol table / call graph, built
+        # over the DEFAULT scope (cross-file resolution must not shrink
+        # with --changed / path args). Already-parsed trees are reused.
+        from tools.check.project import ProjectIndex
+
+        index = ProjectIndex.build(
+            root, trees={rel: c.tree for rel, c in ctxs.items()})
+        for rule in rules:
+            rule.prepare(index, set(rels))
+
+    for rel, ctx in ctxs.items():
         for rule in rules:
             if rule.scope(rel):
                 raw.extend(rule.check(ctx))

@@ -95,6 +95,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--worklist", action="store_true",
                     help="regenerate docs/ZEROCOPY_WORKLIST.md from "
                          "MTPU005 findings")
+    ap.add_argument("--knobs", action="store_true",
+                    help="regenerate docs/KNOBS.md from the MTPU_* "
+                         "env-read scan (rule MTPU010's registry)")
     ap.add_argument("--list-rules", action="store_true",
                     help="list registered rules")
     args = ap.parse_args(argv)
@@ -106,6 +109,11 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.worklist:
         return write_worklist(ROOT, ROOT / "docs" / "ZEROCOPY_WORKLIST.md")
+
+    if args.knobs:
+        from tools.check.knobs import write_knobs
+
+        return write_knobs(ROOT, ROOT / "docs" / "KNOBS.md")
 
     files = None
     if args.changed:
@@ -137,7 +145,12 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if args.as_json:
+        # Stable machine schema (CI annotation contract, documented in
+        # docs/ANALYSIS.md): additive changes only — new keys may
+        # appear, existing keys keep their shape; "schema" bumps on any
+        # breaking change.
         print(json.dumps({
+            "schema": 1,
             "new": [f.as_dict() for f in result.new],
             "baselined": [f.as_dict() for f in result.baselined],
             "suppressed": [f.as_dict() for f in result.suppressed],

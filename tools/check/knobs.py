@@ -1,0 +1,436 @@
+"""docs/KNOBS.md generator + registry parser (rule MTPU010).
+
+`python -m tools.check --knobs` regenerates docs/KNOBS.md from two
+sources:
+
+- the pass-1 scan (ProjectIndex `env_reads`): every `MTPU_*` read
+  under minio_tpu/ with its static default and the modules that
+  consume it — the mechanical truth;
+- `KNOB_DOCS` below: the curated one-line purpose and doc cross-link
+  per knob — the part a scan cannot know.
+
+A knob the scan finds with no KNOB_DOCS entry renders an UNDOCUMENTED
+placeholder row, which rule MTPU010 fails — so a new knob cannot ship
+silently. A KNOB_DOCS entry the scan no longer sees simply stops
+rendering (and a stale committed row fails the rule the other way).
+
+Dynamic families (`MTPU_DRIVE_DEADLINE_{cls}`) render one row per
+documented expansion: KNOB_DOCS carries the concrete names and the
+generator matches them against the scanned prefix.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+_ROW_RE = re.compile(r"^\|\s*`(MTPU_[A-Z0-9_]+)`\s*\|")
+
+# name -> (doc link relative to docs/, one-line purpose). Keep sorted.
+KNOB_DOCS: dict[str, tuple[str, str]] = {
+    "MTPU_BATCHED_DATAPLANE": (
+        "DATAPLANE.md",
+        "Batch-dataplane gate: coalesced encode/decode/verify lanes "
+        "(default); `0` falls back to per-request fused launches."),
+    "MTPU_BOOT_TIMEOUT": (
+        "RESILIENCE.md",
+        "Seconds the boot loop waits for pool quorum (peers may be "
+        "seconds away from serving their drives) before failing."),
+    "MTPU_CACHE_COMMIT": (
+        "",
+        "Gateway disk-cache commit mode for `--cache-dir`: "
+        "`writethrough` or `writeback`."),
+    "MTPU_CERTS_DIR": (
+        "",
+        "TLS certificate directory (public.crt/private.key) — the "
+        "`--certs-dir` default."),
+    "MTPU_CHAOS_DRIVE_WRAP": (
+        "CHAOS.md",
+        "`1` marks this process as running chaos fault injectors in "
+        "the drive chain, so erasure submits route through the "
+        "injector-aware path instead of the pure-memory inline one."),
+    "MTPU_CHAOS_SEED": (
+        "CHAOS.md",
+        "Deterministic seed for chaos storms — reproduces a failing "
+        "storm schedule exactly."),
+    "MTPU_DP_LANE_BLOCKS": (
+        "DATAPLANE.md",
+        "Encode/reconstruct rows coalesced per device launch."),
+    "MTPU_DP_MAX_RECON_WIDTH": (
+        "DATAPLANE.md",
+        "Widest chunk (bytes) the reconstruct lane coalesces — lower "
+        "than the serving gate by default (wide-chunk batching loses "
+        "on CPU); accelerator deployments raise it."),
+    "MTPU_DP_MAX_WAIT_US": (
+        "DATAPLANE.md",
+        "Lone-request latency bound: microseconds a lane waits to "
+        "fill a batch before launching anyway."),
+    "MTPU_DP_MAX_WIDTH": (
+        "DATAPLANE.md",
+        "Widest chunk (bytes) the serving-path encode/decode gate "
+        "coalesces."),
+    "MTPU_DP_QUEUE": (
+        "DATAPLANE.md",
+        "Bounded batch-lane submission queue (requests); a full queue "
+        "is backpressure, never unbounded RAM."),
+    "MTPU_DP_RING_DEPTH": (
+        "DATAPLANE.md",
+        "Staging slots per lane (double-buffer and beyond): host "
+        "fills slot N+1 while the device runs slot N."),
+    "MTPU_DP_VERIFY_ROWS": (
+        "DATAPLANE.md",
+        "Bitrot-verify chunks coalesced per device launch."),
+    "MTPU_DRIVE_DEADLINE_DATA": (
+        "RESILIENCE.md",
+        "Drive-op deadline override (seconds) for the `data` class "
+        "(shard streams). The chaos harness tightens it so an "
+        "injected hang walks a drive OFFLINE within its storm window."),
+    "MTPU_DRIVE_DEADLINE_META": (
+        "RESILIENCE.md",
+        "Drive-op deadline override (seconds) for the `meta` class "
+        "(journal/volume round trips)."),
+    "MTPU_DRIVE_DEADLINE_WALK": (
+        "RESILIENCE.md",
+        "Drive-op deadline override (seconds) for the `walk` class "
+        "(gap between listing entries)."),
+    "MTPU_DSYNC_REFRESH_INTERVAL": (
+        "RESILIENCE.md",
+        "Distributed-lock refresh interval (seconds); locks go stale "
+        "at 60 s without a refresh."),
+    "MTPU_ETCD_ENDPOINT": (
+        "",
+        "etcd endpoint for bucket-metadata federation; empty disables "
+        "the etcd integration."),
+    "MTPU_ETCD_PASSWORD": (
+        "",
+        "etcd authentication password (credential — set via the "
+        "environment, never a config file)."),
+    "MTPU_ETCD_USERNAME": (
+        "",
+        "etcd authentication username."),
+    "MTPU_ETCD_WATCH_INTERVAL": (
+        "",
+        "Seconds between etcd bucket-metadata poll sweeps."),
+    "MTPU_EVENT_QUEUE_DIR": (
+        "",
+        "On-disk spool directory for bucket-notification events "
+        "(survives target outages; per-pid temp dir by default)."),
+    "MTPU_FAULT_INJECTION": (
+        "CHAOS.md",
+        "`1` opts this PROCESS into the admin faultplane handlers — "
+        "beyond admin:* policy, because the faultplane can sever a "
+        "production cluster."),
+    "MTPU_FRONTDOOR_CONTROL": (
+        "FRONTDOOR.md",
+        "Router control-socket path, stamped into workers by the "
+        "front-door supervisor (router shard policy only)."),
+    "MTPU_FRONTDOOR_DRAIN_S": (
+        "FRONTDOOR.md",
+        "Graceful-drain window (seconds) a worker gets on SIGTERM "
+        "before escalation."),
+    "MTPU_FRONTDOOR_RING": (
+        "FRONTDOOR.md",
+        "shm submission-ring name, stamped into workers by the "
+        "supervisor; empty means no ring (single-process mode)."),
+    "MTPU_FRONTDOOR_RING_TIMEOUT_S": (
+        "FRONTDOOR.md",
+        "Seconds a ring client waits for slot completion before "
+        "abandoning the slot (worker crash containment)."),
+    "MTPU_FRONTDOOR_SHARD": (
+        "FRONTDOOR.md",
+        "Connection shard policy: `router` (userspace pre-accept "
+        "round-robin, deterministic everywhere) or `reuseport` "
+        "(zero-hop kernel dispatch where SO_REUSEPORT balances)."),
+    "MTPU_FRONTDOOR_SHARED_LANES": (
+        "FRONTDOOR.md",
+        "`1` converges worker dataplane traffic onto the shared shm "
+        "ring so batches coalesce ACROSS processes."),
+    "MTPU_FRONTDOOR_SLOT_BYTES": (
+        "FRONTDOOR.md",
+        "Payload bytes per shm ring slot; larger ops split across "
+        "chained slots."),
+    "MTPU_FRONTDOOR_WORKER": (
+        "FRONTDOOR.md",
+        "This process's worker id, stamped by the supervisor; its "
+        "presence is what marks a process as a front-door worker."),
+    "MTPU_FRONTDOOR_WORKERS": (
+        "FRONTDOOR.md",
+        "Front-door worker-pool width; `1` is the classic "
+        "single-process server."),
+    "MTPU_GATEWAY_ACCESS_KEY": (
+        "",
+        "Upstream S3 access key for gateway mode (`--gateway`)."),
+    "MTPU_GATEWAY_SECRET_KEY": (
+        "",
+        "Upstream S3 secret key for gateway mode (credential)."),
+    "MTPU_HOTTIER": (
+        "HOTTIER.md",
+        "`1` enables the HBM-resident hot-object tier (device-side "
+        "GET serving); the drive path stays as miss fallback and "
+        "bit-exactness oracle."),
+    "MTPU_HOTTIER_ADMIT_COOLDOWN_S": (
+        "HOTTIER.md",
+        "Per-key admission-attempt cooldown (seconds): one oracle "
+        "read per churny key per window."),
+    "MTPU_HOTTIER_BYTES": (
+        "HOTTIER.md",
+        "HBM budget (bytes) for resident hot objects."),
+    "MTPU_HOTTIER_HALFLIFE_S": (
+        "HOTTIER.md",
+        "Heat-decay half-life (seconds) for the admission/eviction "
+        "policy."),
+    "MTPU_HOTTIER_MAX_OBJECT": (
+        "HOTTIER.md",
+        "Largest object (bytes) the tier will admit."),
+    "MTPU_HOTTIER_MIN_HEAT": (
+        "HOTTIER.md",
+        "Minimum decayed heat before a key is considered for "
+        "admission."),
+    "MTPU_HOTTIER_VERIFY": (
+        "HOTTIER.md",
+        "Admit-time verification that the RESIDENT copy re-hashes to "
+        "the host staging baseline (default on); `0` trusts the "
+        "admit transfer."),
+    "MTPU_JAX_PLATFORM": (
+        "",
+        "Force the JAX platform (`cpu`, `tpu`, …) before first device "
+        "use — cluster harness processes pin `cpu` so a single-tenant "
+        "accelerator is not grabbed by each."),
+    "MTPU_KERNEL_SYNC": (
+        "METRICS.md",
+        "`1` makes kernel observability block until device-complete "
+        "(true kernel seconds); default times host dispatch only."),
+    "MTPU_KMS_DEFAULT_KEY": (
+        "",
+        "Default SSE-KMS key id used when a request names none."),
+    "MTPU_KMS_KEY_FILE": (
+        "",
+        "Path to the KMS master-key file; overrides the derived "
+        "default."),
+    "MTPU_KMS_SECRET_KEY": (
+        "",
+        "Static KMS master secret (credential); defaults to a "
+        "root-credential derivation."),
+    "MTPU_MESH_CODEC": (
+        "DATAPLANE.md",
+        "`1` opts the mesh-sharded codec lane in on CPU, whose "
+        "\"devices\" are virtual — how the test suite exercises the "
+        "multi-device path; real accelerator meshes enable it "
+        "automatically."),
+    "MTPU_METAPLANE": (
+        "METAPLANE.md",
+        "Group-commit metadata plane gate (default on); `0` falls "
+        "back to per-op direct drive writes."),
+    "MTPU_METAPLANE_CACHE": (
+        "METAPLANE.md",
+        "Set-level FileInfo LRU cache capacity (objects)."),
+    "MTPU_METRICS_PEER_DEADLINE": (
+        "METRICS.md",
+        "Deadline (seconds) for the cluster-metrics peer scrape "
+        "fan-out; hung peers count into the scrape-error metric."),
+    "MTPU_MRF_RETRY_CAP": (
+        "RESILIENCE.md",
+        "MRF heal-retry exponential-backoff cap (seconds)."),
+    "MTPU_MRF_RETRY_INTERVAL": (
+        "RESILIENCE.md",
+        "MRF heal-retry initial interval (seconds)."),
+    "MTPU_MRF_RETRY_MAX": (
+        "RESILIENCE.md",
+        "MRF heal-retry attempt bound before an entry is dropped to "
+        "the background scanner."),
+    "MTPU_NATIVE_PLANE": (
+        "DATAPLANE.md",
+        "Native fused encode/decode pipeline gate (default on); `0` "
+        "falls back to the composed per-stage ops."),
+    "MTPU_PEER_BREAKER_FAILURES": (
+        "RESILIENCE.md",
+        "Consecutive failures before a peer's circuit breaker opens."),
+    "MTPU_PEER_RETRIES": (
+        "RESILIENCE.md",
+        "Retry attempts per peer RPC (idempotent routes only)."),
+    "MTPU_PEER_RETRY_BUDGET": (
+        "RESILIENCE.md",
+        "Token-bucket budget shared by peer-RPC retries — bounds "
+        "retry amplification under brownout."),
+    "MTPU_PEER_RETRY_REFILL": (
+        "RESILIENCE.md",
+        "Peer-retry token-bucket refill rate (tokens/second)."),
+    "MTPU_REQUIRE_AESGCM": (
+        "",
+        "`1` turns the stdlib-AEAD fallback (cryptography wheel "
+        "missing) into a boot failure instead of a warning — an image "
+        "rebuild must never switch SSE providers unnoticed."),
+    "MTPU_ROOT_PASSWORD": (
+        "",
+        "Root (admin) secret key; the `minioadmin` default is for "
+        "development only."),
+    "MTPU_ROOT_USER": (
+        "",
+        "Root (admin) access key."),
+    "MTPU_USE_PALLAS": (
+        "",
+        "Force (`1`) or forbid (`0`) the Pallas TPU RS kernels on the "
+        "serving/bench path; default auto-selects by backend (on for "
+        "TPU)."),
+    "MTPU_WAL_EAGER": (
+        "METAPLANE.md",
+        "`1` materializes each WAL batch before its futures resolve "
+        "even in single-owner mode (multi-worker mode forces this for "
+        "cross-process read-your-write)."),
+    "MTPU_WAL_LAZY_MATERIALIZE": (
+        "METAPLANE.md",
+        "`1` never materializes between checkpoints — reads serve "
+        "from the pending overlay; pins the fsynced-but-not-"
+        "materialized state for the crash matrix, also a valid "
+        "operating point for pure write bursts."),
+    "MTPU_WAL_MAX_BATCH": (
+        "METAPLANE.md",
+        "Records per WAL group commit (writev bound; IOV_MAX "
+        "headroom)."),
+    "MTPU_WAL_MAX_BYTES": (
+        "METAPLANE.md",
+        "Checkpoint threshold: WAL size (bytes) that triggers "
+        "materialize-all + sync + truncate."),
+    "MTPU_WAL_MAX_PENDING": (
+        "METAPLANE.md",
+        "Materialization backlog bound (distinct pending keys) above "
+        "which the committer drains even under sustained load."),
+    "MTPU_WAL_QUEUE": (
+        "METAPLANE.md",
+        "Per-drive bounded WAL submission queue; full is "
+        "backpressure (FaultyDisk into quorum), never unbounded RAM."),
+    "MTPU_WAL_SEGMENT": (
+        "FRONTDOOR.md",
+        "Journal segment suffix (`journal.<seg>.wal`) the supervisor "
+        "stamps per worker so each per-drive WAL file keeps exactly "
+        "one writer process; empty = classic single-owner journal."),
+    "MTPU_WAL_TEST_HOLD_FSYNC_S": (
+        "METAPLANE.md",
+        "Test-only: seconds the committer parks before each batch "
+        "fsync so the crash matrix can land a SIGKILL between append "
+        "and fsync."),
+}
+
+
+def registry_rows(doc_path: Path) -> list[dict]:
+    """Parse the committed registry: [{name, line, text,
+    undocumented}]. Missing file -> empty registry (every read is then
+    undocumented, which is the bootstrapping failure mode we want)."""
+    try:
+        lines = doc_path.read_text().splitlines()
+    except OSError:
+        return []
+    rows = []
+    for i, line in enumerate(lines, 1):
+        m = _ROW_RE.match(line.strip())
+        if m:
+            rows.append({"name": m.group(1), "line": i,
+                         "text": line.strip(),
+                         "undocumented": "UNDOCUMENTED" in line})
+    return rows
+
+
+def scan_knobs(index) -> dict[str, dict]:
+    """Mechanical side of the registry: name -> {defaults: [..],
+    files: [..], prefix_only: bool} from the pass-1 env-read scan.
+    Dynamic prefix reads expand to every KNOB_DOCS name under the
+    prefix (or surface the bare prefix when none is documented yet)."""
+    exact: dict[str, dict] = {}
+    prefixes: dict[str, set[str]] = {}
+    for rel, read in index.env_reads():
+        if read["prefix"]:
+            prefixes.setdefault(read["name"], set()).add(rel)
+            continue
+        row = exact.setdefault(read["name"],
+                               {"defaults": [], "files": set()})
+        row["files"].add(rel)
+        d = _clean_default(read["default"])
+        if d is not None and d not in row["defaults"]:
+            row["defaults"].append(d)
+    for prefix, rels in prefixes.items():
+        expansions = [n for n in KNOB_DOCS if n.startswith(prefix)]
+        for name in expansions or [prefix + "*"]:
+            row = exact.setdefault(name, {"defaults": [], "files": set()})
+            row["files"] |= rels
+    return {n: {"defaults": row["defaults"],
+                "files": sorted(row["files"])}
+            for n, row in sorted(exact.items())}
+
+
+def _clean_default(src: str | None) -> str | None:
+    """Render a static default expression: string/number constants come
+    through bare, anything computed stays as the source snippet."""
+    if src is None:
+        return None
+    s = src.strip()
+    if len(s) >= 2 and s[0] == s[-1] and s[0] in "\"'":
+        inner = s[1:-1]
+        return inner if inner else '""'
+    return s
+
+
+def _short(rel: str) -> str:
+    s = rel
+    if s.startswith("minio_tpu/"):
+        s = s[len("minio_tpu/"):]
+    if s.endswith(".py"):
+        s = s[:-3]
+    return s
+
+
+def render(index) -> str:
+    """The full docs/KNOBS.md text (generated, do not hand-edit)."""
+    knobs = scan_knobs(index)
+    lines = [
+        "# MTPU_* environment knobs (generated)",
+        "",
+        "Every `MTPU_*` environment variable read under `minio_tpu/`,",
+        "found by the pass-1 analyzer scan and described by",
+        "`tools/check/knobs.py` (`python -m tools.check --knobs` to",
+        "regenerate — hand edits will be overwritten). Rule",
+        "[MTPU010](ANALYSIS.md#mtpu010) gates both directions in",
+        "tier-1: an undocumented read fails at the read site, a row no",
+        "code reads any more fails as stale.",
+        "",
+        "Defaults are the static fallback at the read site (`—` means",
+        "the knob has no default: unset disables the feature or the",
+        "code requires it). \"Read in\" paths are relative to",
+        "`minio_tpu/`.",
+        "",
+        f"**{len(knobs)} knobs.**",
+        "",
+        "| Knob | Default | Read in | Docs | Purpose |",
+        "|---|---|---|---|---|",
+    ]
+    for name, row in knobs.items():
+        doc = KNOB_DOCS.get(name)
+        defaults = " / ".join(f"`{d}`" for d in row["defaults"]) or "—"
+        files = ", ".join(f"`{_short(f)}`" for f in row["files"])
+        if doc is None:
+            link, purpose = "—", "**UNDOCUMENTED** — add a KNOB_DOCS " \
+                "entry in tools/check/knobs.py"
+        else:
+            link_target, purpose = doc
+            link = f"[{link_target.split('.md')[0].split('#')[0]}]" \
+                   f"({link_target})" if link_target else "—"
+        lines.append(f"| `{name}` | {defaults} | {files} | {link} "
+                     f"| {purpose} |")
+    lines += [
+        "",
+        "Related: [ANALYSIS.md](ANALYSIS.md) (the drift gate),",
+        "[METAPLANE.md](METAPLANE.md), [DATAPLANE.md](DATAPLANE.md),",
+        "[FRONTDOOR.md](FRONTDOOR.md), [HOTTIER.md](HOTTIER.md),",
+        "[CHAOS.md](CHAOS.md), [RESILIENCE.md](RESILIENCE.md) (the",
+        "subsystems the knobs tune).",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def write_knobs(root: Path, out_path: Path) -> int:
+    from tools.check.project import ProjectIndex
+
+    index = ProjectIndex.build(Path(root))
+    out_path.write_text(render(index))
+    n = len(scan_knobs(index))
+    print(f"wrote {out_path} ({n} knobs)")
+    return 0

@@ -7,4 +7,8 @@ from tools.check.rules import (  # noqa: F401
     mtpu004_jax,
     mtpu005_copies,
     mtpu006_obs_drift,
+    mtpu007_lockorder,
+    mtpu008_buflife,
+    mtpu009_protocol,
+    mtpu010_knobs,
 )

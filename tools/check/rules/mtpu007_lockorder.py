@@ -1,0 +1,267 @@
+"""MTPU007 — static lock-order acyclicity, through call edges.
+
+The runtime lock-order sanitizer (minio_tpu/utils/sanitize.py) records
+acquisition edges only for interleavings a test run actually executed,
+and deliberately leaves hot leaf modules unwrapped. This rule is its
+static twin: it derives the acquisition graph from `with <lock>:`
+nesting *through the approximate call graph* (pass 1,
+tools/check/project.py), so an ABBA pair reachable only via a call
+chain that no test ever drives — the sanitizer's blind spot — still
+fails the gate. File locks count too: a blocking `fcntl.flock`
+(`.replay.lock`, the WAL segment claim) is a graph node like any
+mutex, and a helper that returns while holding one (`_replay_lock`)
+extends its hold over the caller's remaining body.
+
+Edges:
+
+- `with a:` directly nesting `with b:` (same function) -> a→b;
+- `with a:` enclosing a resolved call to f -> a→x for every lock x in
+  f's bounded-depth transitive acquire set;
+- a blocking flock acquire (or a call to a returns-holding helper)
+  -> flock→x for locks acquired later in the same function body.
+
+Lock identity is the *creation site class attribute or module global*
+(`file:Class.attr`), matching the sanitizer's site-keyed graph: two
+instances from one constructor line are one node, so same-site
+parent/child hierarchies don't false-positive, and ABBA between code
+paths is caught even when each run is benign. A cycle in the final
+graph is a latent deadlock; each one is a new finding anchored at one
+of its edge sites.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable
+
+from tools.check import Finding, Rule, register
+
+
+def find_cycles(adj: dict[str, set[str]]) -> list[list[str]]:
+    """Cycles in a site-level graph (same canonicalization as
+    sanitize.check_lock_cycles: each cycle reported once, rotated to
+    its minimal node)."""
+    cycles: list[list[str]] = []
+    seen: set[tuple[str, ...]] = set()
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in adj}
+
+    def dfs(node: str, path: list[str]) -> None:
+        color[node] = GRAY
+        path.append(node)
+        for nxt in sorted(adj.get(node, ())):
+            if color.get(nxt, WHITE) == GRAY:
+                body = path[path.index(nxt):]
+                k = body.index(min(body))
+                canon = tuple(body[k:] + body[:k])
+                if canon not in seen:
+                    seen.add(canon)
+                    cycles.append(list(canon) + [canon[0]])
+            elif color.get(nxt, WHITE) == WHITE:
+                dfs(nxt, path)
+        path.pop()
+        color[node] = BLACK
+
+    for n in sorted(adj):
+        if color.get(n, WHITE) == WHITE:
+            dfs(n, [])
+    return cycles
+
+
+@register
+class LockOrderRule(Rule):
+    id = "MTPU007"
+    title = "static lock-order cycle (latent ABBA deadlock)"
+    needs_index = True
+
+    def _resolve_target(self, idx, rel: str, cls: str, base, name):
+        tgt = idx.resolve_call(rel, cls, base, name)
+        if tgt is None and base is None:
+            tgt = idx.resolve_ctor(rel, name)
+        return tgt
+
+    def finalize(self, root: Path) -> Iterable[Finding]:
+        idx = self.index
+        if idx is None:
+            return
+        # (src, dst) -> (path, line, text) of the first site creating
+        # the edge — the anchor if this edge ends up in a cycle.
+        edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+
+        def add(src: str, dst: str, rel: str, line: int,
+                text: str) -> None:
+            if src == dst:
+                return  # same-site hierarchy, like the sanitizer
+            edges.setdefault((src, dst), (rel, line, text))
+
+        for rel, s in idx.files.items():
+            for qual, fn in s["functions"].items():
+                cls = fn["cls"]
+                for region in fn["regions"]:
+                    held = idx.resolve_lock(rel, cls,
+                                            tuple(region["lock"]))
+                    if held is None:
+                        continue
+                    for ref, line, text in region["inner_locks"]:
+                        inner = idx.resolve_lock(rel, cls, tuple(ref))
+                        if inner is not None:
+                            add(held, inner, rel, line, text)
+                    for line, text in region["inner_flocks"]:
+                        add(held, idx.flock_node(rel, qual), rel, line,
+                            text)
+                    for base, name, line in region["inner_calls"]:
+                        tgt = self._resolve_target(idx, rel, cls, base,
+                                                   name)
+                        if tgt is None:
+                            continue
+                        for acq in idx.transitive_acquires(*tgt):
+                            add(held, acq, rel, line,
+                                self._line(idx, rel, line))
+                # A blocking flock (direct, or via a returns-holding
+                # helper) is held for the function's remaining body.
+                holds: list[tuple[str, int]] = [
+                    (idx.flock_node(rel, qual), line)
+                    for line, _t in fn["flocks"]]
+                for base, name, line in fn["calls"]:
+                    tgt = self._resolve_target(idx, rel, cls, base, name)
+                    if tgt is None:
+                        continue
+                    callee = idx.files[tgt[0]]["functions"][tgt[1]]
+                    if callee.get("returns_holding"):
+                        holds.append((idx.flock_node(*tgt), line))
+                if not holds:
+                    continue
+                rel_line = fn["flock_rel_line"]
+                for fnode, start in holds:
+                    end = rel_line if rel_line is not None \
+                        and rel_line > start else None
+                    for region in fn["regions"]:
+                        if region["line"] > start and (
+                                end is None or region["line"] < end):
+                            held2 = idx.resolve_lock(
+                                rel, cls, tuple(region["lock"]))
+                            if held2 is not None:
+                                add(fnode, held2, rel, region["line"],
+                                    region["text"])
+                    for base, name, line in fn["calls"]:
+                        if line <= start or (end is not None
+                                             and line >= end):
+                            continue
+                        tgt = self._resolve_target(idx, rel, cls, base,
+                                                   name)
+                        if tgt is None:
+                            continue
+                        for acq in idx.transitive_acquires(*tgt):
+                            add(fnode, acq, rel, line,
+                                self._line(idx, rel, line))
+
+        # -- same-instance re-acquisition of a non-reentrant Lock -------
+        # `with self.mu:` calling (through same-instance edges: self
+        # methods of the holder class, module functions for a module
+        # global) a function that takes the SAME lock again deadlocks
+        # unconditionally when executed. These hide on rarely-driven
+        # paths (failure diagnostics, error branches) — the cycle check
+        # skips self-edges (same-site hierarchies), so this is its own
+        # check, restricted to provably-same-instance chains.
+        for rel in sorted(idx.files):
+            if rel not in self.checked:
+                continue
+            s = idx.files[rel]
+            for qual, fn in sorted(s["functions"].items()):
+                cls = fn["cls"]
+                for region in fn["regions"]:
+                    held = idx.resolve_lock(rel, cls,
+                                            tuple(region["lock"]))
+                    if held is None or idx.lock_kind(held) != "Lock":
+                        continue
+                    base0 = region["lock"][0]
+                    if base0 not in ("self", ""):
+                        continue  # same-instance only provable there
+                    chain = self._reacquires(idx, rel, cls, held, base0,
+                                             region["inner_calls"])
+                    if chain:
+                        yield Finding(
+                            self.id, rel, region["line"], 0,
+                            f"non-reentrant lock '{held}' re-acquired "
+                            "while held: this `with` block calls "
+                            f"{' -> '.join(chain)} which takes the "
+                            "same threading.Lock again — deadlocks "
+                            "unconditionally the first time this path "
+                            "runs (move the call outside the critical "
+                            "section or make the inner helper "
+                            "lock-free)",
+                            region["text"])
+
+        adj: dict[str, set[str]] = {}
+        for (src, dst) in edges:
+            adj.setdefault(src, set()).add(dst)
+        for cycle in find_cycles(adj):
+            # Anchor at the smallest (path, line) edge site among the
+            # cycle's edges — deterministic, and present in a full run.
+            sites = []
+            for a, b in zip(cycle, cycle[1:]):
+                site = edges.get((a, b))
+                if site is not None:
+                    sites.append(site)
+            if not sites:
+                continue
+            path, line, text = min(sites)
+            chain = " -> ".join(cycle)
+            yield Finding(
+                self.id, path, line, 0,
+                f"static lock-order cycle (latent ABBA deadlock): "
+                f"{chain}; this site takes "
+                f"'{cycle[1]}' while holding '{cycle[0]}', another "
+                "path orders them the other way — even if no test ever "
+                "interleaves them, the order must be made consistent",
+                text)
+
+    def _reacquires(self, idx, rel: str, holder_cls: str, node: str,
+                    base0: str, calls, depth: int = 4,
+                    visited: set | None = None) -> list[str] | None:
+        """Call-chain (function names) from `calls` to a function that
+        re-takes `node` on the same instance, or None. Same-instance
+        edges only: `self.m()` within the holder class (self.X locks),
+        plus same-module function calls (module-global locks)."""
+        if depth <= 0:
+            return None
+        visited = visited if visited is not None else set()
+        for base, name, _line in calls:
+            tgt = None
+            if base == "self" and holder_cls:
+                tgt = idx.resolve_call(rel, holder_cls, "self", name)
+            elif base is None and base0 == "":
+                t = idx.resolve_call(rel, "", None, name)
+                if t is not None and t[0] == rel:
+                    tgt = t
+            if tgt is None or tgt in visited:
+                continue
+            visited.add(tgt)
+            callee = idx.files[tgt[0]]["functions"][tgt[1]]
+            inner_calls = []
+            for region in callee["regions"]:
+                if tuple(region["lock"])[0] == base0:
+                    inner = idx.resolve_lock(tgt[0], callee["cls"],
+                                             tuple(region["lock"]))
+                    if inner == node:
+                        return [f"{name}()"]
+                inner_calls.extend(region["inner_calls"])
+            sub = self._reacquires(idx, tgt[0], callee["cls"], node,
+                                   base0, callee["calls"], depth - 1,
+                                   visited)
+            if sub is not None:
+                return [f"{name}()"] + sub
+        return None
+
+    def _line(self, idx, rel: str, line: int) -> str:
+        cache = getattr(self, "_line_cache", None)
+        if cache is None:
+            cache = self._line_cache = {}
+        lines = cache.get(rel)
+        if lines is None:
+            try:
+                lines = (idx.root / rel).read_text().splitlines()
+            except OSError:
+                lines = []
+            cache[rel] = lines
+        return lines[line - 1].strip() if 1 <= line <= len(lines) else ""

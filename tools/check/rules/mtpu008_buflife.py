@@ -1,0 +1,305 @@
+"""MTPU008 — slot-scoped buffer must not outlive its producer.
+
+The zero-copy burn-down (worklist 16 → 0) made borrowed memoryviews
+the normal currency on every hot path — and with them the
+use-after-recycle class: a view into an shm ring slot is valid only
+until the slot's FREE→SUBMITTED→DONE recycle, a WAL gather list only
+until the writev returns, an arena staging buffer only until it is
+recycled, a ChunkedSigV4Reader feed only until the next feed. Storing
+such a view anywhere that outlives that window silently aliases bytes
+a later request will overwrite.
+
+Ephemeral producers (matched module-qualified where possible, by
+distinctive method name where the receiver is an instance):
+
+- `*.req_view(..)` / `*.resp_view(..)` / `unpack_chunks(..)` — shm
+  ring slot areas (minio_tpu/frontdoor/shm.py);
+- `frame_record(..)` — WAL writev gather lists aliasing caller raw
+  bytes (minio_tpu/metaplane/wal.py);
+- `*.arena.acquire(..)` — hottier staging buffers
+  (minio_tpu/hottier/arena.py);
+- `chunked.feed(..)` — SigV4 chunk views (minio_tpu/s3/sigv4.py);
+- slices / `memoryview()` / iteration of any of the above.
+
+Escapes flagged (each needs an explicit copy — `bytes()`,
+`.tobytes()` — or an `# mtpu: allow(MTPU008)` ownership rationale):
+
+1. stored into an attribute (`self.x = view`, `obj.attr = view`);
+2. stored into an attribute-rooted container
+   (`self._q.append(view)`, `self._cache[key] = view` — slice-assign
+   `buf[a:b] = view` copies bytes and is fine);
+3. captured by a thread/executor closure (`Thread(target=..)`,
+   `submit(..)`, `ctx_wrap(..)` over a lambda or nested def that
+   reads the view);
+4. returned after the slot's release point (`_set_state`/`respond`/
+   `release`/`recycle_staging`/a second `feed` earlier in the same
+   function);
+5. passed to a resolved function that stores the parameter into an
+   attribute/container (pass-1 `param_escapes` summaries, bounded
+   depth — the interprocedural store).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.check import FileContext, Finding, Rule, register
+from tools.check.rules.base import dotted_name, terminal_name
+
+# Terminal names that ALWAYS produce ephemeral views.
+_PRODUCER_NAMES = {"req_view", "resp_view", "unpack_chunks",
+                   "frame_record"}
+# Dotted suffixes for producers whose terminal name is too common.
+_PRODUCER_SUFFIXES = ("arena.acquire", "chunked.feed")
+# Calls that release/recycle the producing slot: a return of a view
+# after one of these is a use-after-recycle by construction.
+_RELEASE_NAMES = {"_set_state", "respond", "recycle_staging",
+                  "reset_range", "reset_stale"}
+# A second feed() releases the previous feed's views.
+_RELEASE_SUFFIXES = ("chunked.feed",)
+_THREADY = {"Thread", "Timer", "submit", "ctx_wrap", "start_new_thread",
+            "run_in_executor", "call_soon_threadsafe"}
+_COPIES = {"bytes", "bytearray", "tobytes"}
+
+
+def _is_producer_call(node: ast.Call) -> bool:
+    name = terminal_name(node.func)
+    if name in _PRODUCER_NAMES:
+        return True
+    dotted = dotted_name(node.func)
+    if dotted and dotted.endswith(_PRODUCER_SUFFIXES):
+        return True
+    return False
+
+
+def _is_release_call(node: ast.Call) -> bool:
+    if terminal_name(node.func) in _RELEASE_NAMES:
+        return True
+    dotted = dotted_name(node.func)
+    return bool(dotted and dotted.endswith(_RELEASE_SUFFIXES))
+
+
+def _func_scopes(tree: ast.Module):
+    yield "", None, tree.body
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, None, node.body
+        elif isinstance(node, ast.ClassDef):
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    yield f"{node.name}.{stmt.name}", node.name, stmt.body
+
+
+def _walk_shallow(body):
+    """Walk without descending into nested def/lambda bodies."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class BufferLifetimeRule(Rule):
+    id = "MTPU008"
+    title = "slot-scoped buffer escapes its producer's lifetime"
+    needs_index = True
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for qual, cls, body in _func_scopes(ctx.tree):
+            name = qual.rsplit(".", 1)[-1]
+            if name in _PRODUCER_NAMES:
+                # The producer's own body hands out the views — its
+                # return IS the designated contract.
+                is_producer = True
+            else:
+                is_producer = False
+            yield from self._check_scope(ctx, qual, cls, body,
+                                         is_producer)
+
+    # -- one function scope ---------------------------------------------
+
+    def _check_scope(self, ctx: FileContext, qual: str,
+                     cls: str | None, body,
+                     is_producer: bool) -> Iterable[Finding]:
+        eph: set[str] = set()
+        release_line: int | None = None
+        # Collect in source order so propagation is flow-ish.
+        stmts = sorted(
+            (n for n in _walk_shallow(body) if hasattr(n, "lineno")),
+            key=lambda n: (n.lineno, n.col_offset))
+        nested_defs: dict[str, ast.AST] = {}
+        for node in stmts:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested_defs[node.name] = node
+
+        for node in stmts:
+            # -- bindings -----------------------------------------------
+            if isinstance(node, ast.Assign) and len(node.targets) >= 1:
+                if self._eph_value(node.value, eph):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            eph.add(tgt.id)
+                        elif isinstance(tgt, ast.Attribute):
+                            yield ctx.finding(
+                                self.id, node,
+                                self._msg("stored into attribute "
+                                          f"'{ast.unparse(tgt)}'"))
+                        elif isinstance(tgt, ast.Subscript) \
+                                and not isinstance(tgt.slice, ast.Slice):
+                            recv = dotted_name(tgt.value) or ""
+                            if "." in recv:
+                                yield ctx.finding(
+                                    self.id, node,
+                                    self._msg("stored into container "
+                                              f"'{recv}[..]'"))
+                elif self._is_copy(node.value):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            eph.discard(tgt.id)
+                else:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            eph.discard(tgt.id)
+            if isinstance(node, ast.For) \
+                    and isinstance(node.target, ast.Name) \
+                    and self._eph_value(node.iter, eph):
+                eph.add(node.target.id)
+
+            if not isinstance(node, ast.Call):
+                continue
+
+            # -- releases -----------------------------------------------
+            if _is_release_call(node):
+                if release_line is None or node.lineno < release_line:
+                    release_line = node.lineno
+
+            name = terminal_name(node.func)
+            # -- container stores ---------------------------------------
+            if name in ("append", "add", "insert", "appendleft",
+                        "setdefault") \
+                    and isinstance(node.func, ast.Attribute):
+                recv = dotted_name(node.func.value) or ""
+                if "." in recv:
+                    for a in node.args:
+                        if isinstance(a, ast.Name) and a.id in eph:
+                            yield ctx.finding(
+                                self.id, node,
+                                self._msg(f"stored into '{recv}' via "
+                                          f".{name}()"))
+
+            # -- thread / executor capture ------------------------------
+            if name in _THREADY:
+                for a in list(node.args) + [kw.value for kw in
+                                            node.keywords]:
+                    captured = self._captures_eph(a, eph, nested_defs)
+                    if captured:
+                        yield ctx.finding(
+                            self.id, node,
+                            self._msg(f"captured by {name}() closure "
+                                      f"(reads '{captured}' after this "
+                                      "frame moved on)"))
+
+            # -- interprocedural store ----------------------------------
+            yield from self._interproc(ctx, cls, node, eph)
+
+        # -- return past release ----------------------------------------
+        if is_producer or release_line is None:
+            return
+        for node in stmts:
+            if isinstance(node, ast.Return) and node.value is not None \
+                    and node.lineno > release_line:
+                if self._mentions_eph(node.value, eph):
+                    yield ctx.finding(
+                        self.id, node,
+                        self._msg("returned after the slot's release "
+                                  f"point (line {release_line})"))
+
+    # -- helpers --------------------------------------------------------
+
+    def _msg(self, how: str) -> str:
+        return (f"slot-scoped view {how}: the backing slot recycles "
+                "under it (FREE->SUBMITTED->DONE / staging reuse / "
+                "next feed) — copy with bytes()/.tobytes() or carry "
+                "an ownership rationale")
+
+    def _eph_value(self, value: ast.expr, eph: set[str]) -> bool:
+        """True when `value` evaluates to an ephemeral view: a producer
+        call, a slice/memoryview/subscript of an ephemeral name, or an
+        ephemeral name itself."""
+        if isinstance(value, ast.Call):
+            if _is_producer_call(value):
+                return True
+            if terminal_name(value.func) == "memoryview" and value.args:
+                return self._eph_value(value.args[0], eph)
+            return False
+        if isinstance(value, ast.Name):
+            return value.id in eph
+        if isinstance(value, ast.Subscript):
+            return self._eph_value(value.value, eph)
+        return False
+
+    def _is_copy(self, value: ast.expr) -> bool:
+        if isinstance(value, ast.Call):
+            return terminal_name(value.func) in _COPIES
+        return False
+
+    def _mentions_eph(self, value: ast.expr, eph: set[str]) -> str | None:
+        for n in ast.walk(value):
+            if isinstance(n, ast.Name) and n.id in eph:
+                return n.id
+        return None
+
+    def _captures_eph(self, arg: ast.expr, eph: set[str],
+                      nested: dict[str, ast.AST]) -> str | None:
+        if isinstance(arg, ast.Lambda):
+            return self._mentions_eph(arg.body, eph)
+        if isinstance(arg, ast.Name) and arg.id in nested:
+            fn = nested[arg.id]
+            for stmt in fn.body:
+                got = self._mentions_eph(stmt, eph)
+                if got:
+                    return got
+        return None
+
+    def _interproc(self, ctx: FileContext, cls: str | None,
+                   call: ast.Call, eph: set[str]) -> Iterable[Finding]:
+        idx = self.index
+        if idx is None or not eph:
+            return
+        if _is_release_call(call) or _is_producer_call(call):
+            return  # handing the view back is the contract, not escape
+        tgt_raw = self._target(call.func)
+        if tgt_raw is None:
+            return
+        base, name = tgt_raw
+        tgt = idx.resolve_call(ctx.relpath, cls or "", base, name)
+        if tgt is None and base is None:
+            tgt = idx.resolve_ctor(ctx.relpath, name)
+        if tgt is None:
+            return
+        callee = idx.files[tgt[0]]["functions"][tgt[1]]
+        shift = 1 if callee["cls"] and base != tgt[1].split(".")[0] else 0
+        for ai, a in enumerate(call.args):
+            if isinstance(a, ast.Name) and a.id in eph \
+                    and idx.param_escapes(tgt[0], tgt[1], ai + shift):
+                yield ctx.finding(
+                    self.id, call,
+                    self._msg(f"passed to {name}(), which stores that "
+                              "parameter into an attribute/container"))
+
+    @staticmethod
+    def _target(func: ast.expr) -> tuple[str | None, str] | None:
+        if isinstance(func, ast.Name):
+            return None, func.id
+        if isinstance(func, ast.Attribute):
+            base = dotted_name(func.value)
+            if base is None:
+                return None
+            return base, func.attr
+        return None

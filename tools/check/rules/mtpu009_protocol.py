@@ -1,0 +1,170 @@
+"""MTPU009 — closed protocol registries: dispatch must be total.
+
+The shm ring opcodes (`RING_OPS`, minio_tpu/frontdoor/shm.py) and WAL
+record types (`WAL_RECORD_TYPES`, minio_tpu/metaplane/wal.py) are
+closed sets dispatched by hand-rolled `if`/`elif` chains on both sides
+of a process boundary — the LaneServer drain vs the LaneClient
+builders, the committer's staging vs the replay fold. Adding a member
+to one side and forgetting the other does not fail loudly: the ring
+falls through to a generic error, replay silently drops an acked
+record type. This rule closes the loop statically:
+
+- **dispatch totality** — a function that tests ≥ 2 members of one
+  registry (`==`/`in` comparisons, match cases) is a dispatch over it
+  and must *reference* every registered member (handling a member via
+  `else` is invisible to the reader and to this rule — name it);
+- **dispatch maps** — a dict literal keyed by ≥ 2 members (a served-op
+  label map) must contain every member;
+- **orphans** — a registered member referenced nowhere outside its
+  defining module is half a protocol (one side of the pair was never
+  built);
+- **side channels** — an `OP_*`/`REC_*` integer constant in a
+  registry-defining module that is not itself registered.
+
+References resolve module-qualified through the pass-1 symbol table,
+so `ring.OP_ENCODE` (dataplane's *string* lane keys) never collides
+with `shm.OP_ENCODE` (the ring's registered opcode). Registries are
+module-level dict literals named `*_OPS` / `*_RECORD_TYPES` /
+`*_REGISTRY` with `"OP_*"`/`"REC_*"` string keys.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable
+
+from tools.check import Finding, Rule, register
+
+
+@register
+class ProtocolRegistryRule(Rule):
+    id = "MTPU009"
+    title = "closed protocol registry dispatched non-totally"
+    needs_index = True
+
+    def finalize(self, root: Path) -> Iterable[Finding]:
+        idx = self.index
+        if idx is None:
+            return
+        regs = idx.registries()  # name -> (rel, {member: value})
+        if not regs:
+            return
+        # member refs grouped by (file, scope, registry) and
+        # (file, dict_line, registry); plus global per-registry use.
+        scope_refs: dict[tuple, dict[str, list]] = {}
+        dict_refs: dict[tuple, dict[str, list]] = {}
+        used_outside: dict[tuple[str, str], set[str]] = {}
+
+        reg_of_member: dict[str, list[tuple[str, str]]] = {}
+        for rname, (rrel, members) in regs.items():
+            for m in members:
+                reg_of_member.setdefault(m, []).append((rrel, rname))
+
+        for rel, s in idx.files.items():
+            for ref in s["reg_refs"]:
+                home = idx.member_home(rel, ref["base"], ref["name"])
+                if home is None:
+                    continue
+                rkey = None
+                for rrel, rname in reg_of_member.get(ref["name"], ()):
+                    if rrel == home:
+                        rkey = (rrel, rname)
+                        break
+                if rkey is None:
+                    continue
+                if rel != home:
+                    used_outside.setdefault(rkey, set()).add(ref["name"])
+                skey = (rel, ref["scope"], rkey)
+                scope_refs.setdefault(skey, {}).setdefault(
+                    ref["name"], []).append(ref)
+                if ref["kind"] == "dictkey":
+                    dkey = (rel, ref["dict_line"], rkey)
+                    dict_refs.setdefault(dkey, {}).setdefault(
+                        ref["name"], []).append(ref)
+
+        # -- dispatch totality per function scope ------------------------
+        for (rel, scope, rkey), by_member in sorted(
+                scope_refs.items(), key=lambda kv: (kv[0][0],
+                                                    kv[0][1] or "")):
+            if rel not in self.checked:
+                continue
+            members = regs[rkey[1]][1]
+            tested = {m for m, refs in by_member.items()
+                      if any(r["kind"] == "test" for r in refs)}
+            if len(tested) < 2:
+                continue
+            missing = sorted(set(members) - set(by_member))
+            if not missing:
+                continue
+            anchor = min((r for refs in by_member.values()
+                          for r in refs if r["kind"] == "test"),
+                         key=lambda r: r["line"])
+            where = f"{scope}()" if scope else "module scope"
+            yield Finding(
+                self.id, rel, anchor["line"], 0,
+                f"{where} dispatches on {rkey[1]} "
+                f"({', '.join(sorted(tested))}) but never references "
+                f"{', '.join(missing)} — handle every registered "
+                "member explicitly (an else-branch hides the gap) or "
+                "carry a written suppression",
+                anchor["text"])
+
+        # -- dispatch maps ----------------------------------------------
+        for (rel, dline, rkey), by_member in sorted(dict_refs.items()):
+            if rel not in self.checked or len(by_member) < 2:
+                continue
+            members = regs[rkey[1]][1]
+            missing = sorted(set(members) - set(by_member))
+            if not missing:
+                continue
+            anchor = min((r for refs in by_member.values()
+                          for r in refs), key=lambda r: r["line"])
+            yield Finding(
+                self.id, rel, anchor["line"], 0,
+                f"dispatch map over {rkey[1]} is missing "
+                f"{', '.join(missing)} — a registered code would fall "
+                "through this table",
+                anchor["text"])
+
+        # -- orphans + side channels ------------------------------------
+        for rname, (rrel, members) in sorted(regs.items()):
+            if rrel not in self.checked:
+                continue
+            s = idx.files[rrel]
+            reg_line = s["registry_lines"].get(rname, 1)
+            reg_text = self._line(idx, rrel, reg_line)
+            orphan = sorted(set(members)
+                            - used_outside.get((rrel, rname), set()))
+            for m in orphan:
+                yield Finding(
+                    self.id, rrel, reg_line, 0,
+                    f"registry member {m} in {rname} is never "
+                    "referenced outside its defining module — one side "
+                    "of the protocol pair was never built (or the "
+                    "member is dead)",
+                    reg_text)
+            registered_all = {m for reg in s["registries"].values()
+                              for m in reg}
+            for cname, cline in sorted(s["int_consts"].items()):
+                if cname not in registered_all and any(
+                        cname.startswith(p) for p in ("OP_", "REC_")):
+                    yield Finding(
+                        self.id, rrel, cline, 0,
+                        f"protocol constant {cname} is not in any "
+                        f"registry of this module — register it (and "
+                        "let the dispatch checks fan out) or rename it "
+                        "out of the OP_/REC_ namespace",
+                        self._line(idx, rrel, cline))
+
+    def _line(self, idx, rel: str, line: int) -> str:
+        cache = getattr(self, "_line_cache", None)
+        if cache is None:
+            cache = self._line_cache = {}
+        lines = cache.get(rel)
+        if lines is None:
+            try:
+                lines = (idx.root / rel).read_text().splitlines()
+            except OSError:
+                lines = []
+            cache[rel] = lines
+        return lines[line - 1].strip() if 1 <= line <= len(lines) else ""

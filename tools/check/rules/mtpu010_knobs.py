@@ -1,0 +1,89 @@
+"""MTPU010 — env-knob drift gate (code ↔ docs/KNOBS.md, both ways).
+
+The tree reads ~70 `MTPU_*` environment knobs; before this rule about
+20 of them existed only as `os.environ.get` calls someone had to grep
+for. docs/KNOBS.md is now the generated registry (name, default,
+consuming modules, doc cross-link — `python -m tools.check --knobs`
+regenerates it from the pass-1 scan plus the curated descriptions in
+tools/check/knobs.py). This rule keeps the two sides from drifting:
+
+- a knob read anywhere in minio_tpu/ that is not a registry row fails
+  at the read site (new knob: document it in KNOB_DOCS, regenerate);
+- a registry row no code reads any more is stale and fails (knob
+  removed: regenerate);
+- a row still carrying the generator's UNDOCUMENTED placeholder fails
+  (the scan found the knob but nobody wrote its description).
+
+Dynamic families — `os.environ.get(f"MTPU_DRIVE_DEADLINE_{cls}")` —
+are prefix reads: the registry must carry at least one row under the
+literal prefix, and every row under it counts as read.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable
+
+from tools.check import Finding, Rule, register
+from tools.check.knobs import registry_rows
+
+KNOBS_DOC = "docs/KNOBS.md"
+
+
+@register
+class KnobDriftRule(Rule):
+    id = "MTPU010"
+    title = "MTPU_* env knob missing from (or stale in) docs/KNOBS.md"
+    needs_index = True
+
+    def finalize(self, root: Path) -> Iterable[Finding]:
+        idx = self.index
+        if idx is None:
+            return
+        rows = registry_rows(Path(root) / KNOBS_DOC)
+        names = {r["name"] for r in rows}
+
+        exact_reads: set[str] = set()
+        prefix_reads: set[str] = set()
+        for rel, read in idx.env_reads():
+            if read["prefix"]:
+                prefix_reads.add(read["name"])
+            else:
+                exact_reads.add(read["name"])
+            if rel in self.checked:
+                if read["prefix"]:
+                    if not any(n.startswith(read["name"]) for n in names):
+                        yield Finding(
+                            self.id, rel, read["line"], 0,
+                            f"dynamic knob family '{read['name']}*' has "
+                            f"no rows in {KNOBS_DOC} — document each "
+                            "expansion in tools/check/knobs.py and run "
+                            "`python -m tools.check --knobs`",
+                            read["text"])
+                elif read["name"] not in names:
+                    yield Finding(
+                        self.id, rel, read["line"], 0,
+                        f"undocumented knob {read['name']}: not in "
+                        f"{KNOBS_DOC} — add a KNOB_DOCS entry in "
+                        "tools/check/knobs.py and run "
+                        "`python -m tools.check --knobs`",
+                        read["text"])
+
+        for row in rows:
+            name = row["name"]
+            used = name in exact_reads or any(
+                name.startswith(p) for p in prefix_reads)
+            if not used:
+                yield Finding(
+                    self.id, KNOBS_DOC, row["line"], 0,
+                    f"stale registry row {name}: no code under "
+                    "minio_tpu/ reads it — delete its KNOB_DOCS entry "
+                    "and regenerate",
+                    row["text"])
+            elif row["undocumented"]:
+                yield Finding(
+                    self.id, KNOBS_DOC, row["line"], 0,
+                    f"knob {name} is registered but still carries the "
+                    "UNDOCUMENTED placeholder — write its description "
+                    "in tools/check/knobs.py KNOB_DOCS",
+                    row["text"])
