@@ -1829,11 +1829,121 @@ def bench_obs_overhead() -> dict:
                          ("lane",)).labels(lane="bench")
     observe_ns = ns_per_op(lambda: hist.observe(0.001), iters)
     ctx_ns = ns_per_op(lambda: obs.ctx_wrap(int)(), 50_000)
+
+    # Flight recorder (docs/TRACING.md): the armed numbers price a full
+    # timeline cycle and its per-stage calls; the disarmed numbers are
+    # the always-paid hot-path tax (one contextvar read returning None)
+    # — the <2% acceptance bound rides the delta they add to span_off.
+    from minio_tpu.obs import flight
+
+    was_armed = flight.armed()
+    flight.set_armed(False)
+    try:
+        fl_begin_off = ns_per_op(lambda: flight.begin("BENCHTRACE"), iters)
+        fl_mark_off = ns_per_op(lambda: flight.mark("bench"), iters)
+    finally:
+        flight.set_armed(True)
+
+    def timeline_cycle():
+        flight.begin("BENCHTRACE", "BenchOp")
+        flight.mark("rx_drain")
+        flight.stamp("dp_launch", 1e-6, "dataplane")
+        flight.end(200)
+
+    try:
+        fl_cycle_on = ns_per_op(timeline_cycle, 20_000)
+        tl = flight.begin("BENCHTRACE", "BenchOp")
+        fl_mark_on = ns_per_op(lambda: flight.mark("bench"), iters)
+        fl_stamp_on = ns_per_op(
+            lambda: flight.stamp("bench", 1e-6, "dataplane"), iters)
+        if tl is not None:
+            flight.end(200)
+    finally:
+        flight.set_armed(was_armed)
+
     return {"metric": "obs_overhead_span_unwatched", "value": round(span_off, 1),
             "unit": "ns/op", "vs_baseline": 0.0,
             "span_subscribed_ns": round(span_on, 1),
             "histogram_observe_ns": round(observe_ns, 1),
-            "ctx_wrap_call_ns": round(ctx_ns, 1)}
+            "ctx_wrap_call_ns": round(ctx_ns, 1),
+            "flight_disarmed_begin_ns": round(fl_begin_off, 1),
+            "flight_disarmed_mark_ns": round(fl_mark_off, 1),
+            "flight_armed_mark_ns": round(fl_mark_on, 1),
+            "flight_armed_stamp_ns": round(fl_stamp_on, 1),
+            "flight_timeline_cycle_ns": round(fl_cycle_on, 1)}
+
+
+def bench_stage_breakdown() -> dict:
+    """Per-stage latency decomposition (docs/TRACING.md flight recorder):
+    PUT and GET stage tables at two object sizes over a live
+    SigV4-authenticated server, read back from the recorder's own
+    timelines. 64 KiB chunks pass the dataplane serving gate (coalesced
+    launches, dp_* stamps); 1 MiB falls back to per-object dispatch —
+    the table shows where each mode spends its wall clock. Doubles as a
+    fidelity check: sequential stages must tile the recorded e2e."""
+    import shutil
+
+    from minio_tpu.obs import flight
+    from minio_tpu.s3.leanclient import LeanS3
+    from minio_tpu.s3.server import build_server
+
+    ak, sk = "benchak00", "benchsk00secret0"
+    root = _bench_root()
+    stop = lambda: None  # noqa: E731
+    was_armed = flight.armed()
+    # The native C++ PUT lane serves host-side without a CodecRequest;
+    # pin the device-codec fan-out so the plane stages are on the table.
+    prev_native = os.environ.get("MTPU_NATIVE_PLANE")
+    os.environ["MTPU_NATIVE_PLANE"] = "0"
+    flight.set_armed(True)
+    try:
+        srv = build_server([os.path.join(root, f"d{i}") for i in range(4)],
+                           ak, sk, versioned=False)
+        port, stop = _serve_http(srv)
+        if port is None:
+            return {"metric": "stage_breakdown",
+                    "error": "server failed to start"}
+        c = LeanS3("127.0.0.1", port, ak, sk)
+        st, body = c.put("/bench")
+        assert st == 200, body
+        out: dict = {"metric": "stage_breakdown", "unit": "us",
+                     "vs_baseline": 0.0, "cores": os.cpu_count()}
+        n = 30
+        for size, label in ((64 << 10, "64KiB"), (1 << 20, "1MiB")):
+            payload = os.urandom(size)
+            for i in range(8):  # warm: compile paths, prime caches
+                c.put(f"/bench/w{label}{i}", payload)
+                c.get(f"/bench/w{label}{i}")
+            flight.reset()
+            for i in range(n):
+                st, _ = c.put(f"/bench/{label}-{i}", payload)
+                assert st == 200
+            for i in range(n):
+                st, b = c.get(f"/bench/{label}-{i}")
+                assert st == 200 and len(b) == size
+            for api, key in (("PutObject", "put"), ("GetObject", "get")):
+                snaps = flight.snapshot(api=api)[:n]
+                assert snaps, f"no {api} timelines recorded"
+                stages: dict[str, float] = {}
+                for s in snaps:
+                    for seg in s["stages"]:
+                        stages[seg["stage"]] = (stages.get(seg["stage"], 0)
+                                                + seg["dur_ns"])
+                e2e = sum(s["e2e_ns"] for s in snaps) / len(snaps)
+                out[f"{key}_{label}_e2e_us"] = round(e2e / 1e3, 1)
+                for stage, total_ns in sorted(stages.items()):
+                    out[f"{key}_{label}_{stage}_us"] = round(
+                        total_ns / len(snaps) / 1e3, 1)
+        out["value"] = out["put_64KiB_e2e_us"]
+        return out
+    finally:
+        flight.set_armed(was_armed)
+        if prev_native is None:
+            os.environ.pop("MTPU_NATIVE_PLANE", None)
+        else:
+            os.environ["MTPU_NATIVE_PLANE"] = prev_native
+        stop()
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def bench_check_overhead() -> dict:
@@ -1947,6 +2057,7 @@ def main() -> int:
             ("select_parquet", bench_select_parquet),
             ("xlmeta", bench_xlmeta_codec),
             ("obs_overhead", bench_obs_overhead),
+            ("stage_breakdown", bench_stage_breakdown),
             ("check_overhead", bench_check_overhead),
             ("chaos_smoke", bench_chaos_smoke),
         ]

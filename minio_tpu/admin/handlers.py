@@ -134,7 +134,22 @@ class AdminAPI:
                                           peer_stream="trace_stream",
                                           all_nodes=q.get("all", "true") != "false",
                                           type_filter=q.get("type", ""),
-                                          traceid=q.get("traceid", ""))
+                                          traceid=q.get("traceid", ""),
+                                          plane_filter=q.get("plane", ""))
+        if op == "perf" and rest == "timeline" and m == "GET":
+            # Flight-recorder query: per-request stage timelines from
+            # this node's recorder + its sibling front-door workers,
+            # federated across peers the way /metrics/cluster fans out.
+            self._authorize(identity, "admin:ServerInfo")
+            params = {"traceid": q.get("traceid", ""),
+                      "api": q.get("api", ""),
+                      "worst": q.get("worst", "")}
+            out = await run(self._perf_timelines, params)
+            notif = getattr(self.s, "notification", None)
+            if (q.get("all", "true") != "false" and notif is not None
+                    and notif.peers):
+                out["peers"] = await run(notif.perf_all, params)
+            return _json(out)
         if op == "consolelog" and m == "GET":
             self._authorize(identity, "admin:ConsoleLog")
             return await self._bus_stream(request,
@@ -566,10 +581,27 @@ class AdminAPI:
                                       if not cfg.is_dynamic(s)]})
         raise S3Error("MethodNotAllowed", resource=request.path)
 
+    def _perf_timelines(self, params: dict) -> dict:
+        """Flight-recorder snapshots for THIS node: the local process
+        ring/worst board plus sibling front-door workers' shm spools
+        (flight.collect). The peer fan-out happens in the route above
+        (notif.perf_all), mirroring the metrics split."""
+        from minio_tpu.obs import flight
+
+        try:
+            worst = int(params.get("worst") or 0)
+        except (TypeError, ValueError):
+            worst = 0
+        return {"node": obs.current_node(),
+                "timelines": flight.collect(
+                    str(params.get("traceid") or ""),
+                    str(params.get("api") or ""), worst)}
+
     async def _bus_stream(self, request, bus, peer_stream: str = "",
                           all_nodes: bool = True,
                           type_filter: str = "",
-                          traceid: str = "") -> web.StreamResponse:
+                          traceid: str = "",
+                          plane_filter: str = "") -> web.StreamResponse:
         """Stream a local pubsub as JSON lines, merged with every peer's
         matching stream (reference `mc admin trace`/`console` subscribe to
         all nodes via peer REST, cmd/peer-rest-client.go:782): peer pullers
@@ -578,7 +610,10 @@ class AdminAPI:
         the `mc admin trace --call storage/internal` selector. `traceid`
         keeps only records of one request (trace_id, falling back to the
         http record's requestId) — follow one request across every layer
-        and node."""
+        and node. `plane_filter` keeps only records stamped with one
+        plane (dataplane/metaplane/ring/hottier) — the batch-plane
+        records carry it; classic record types have no plane and are
+        filtered out when the selector is set."""
         import queue as _queue
         import threading as _threading
 
@@ -627,6 +662,9 @@ class AdminAPI:
                         await resp.write(b"\n")
                         continue
                     if type_filter and item.get("type", "") != type_filter:
+                        continue
+                    if plane_filter and item.get("plane", "") != \
+                            plane_filter:
                         continue
                     if traceid and traceid not in (
                             item.get("trace_id"), item.get("requestId")):

@@ -43,6 +43,8 @@ from concurrent.futures import Future, InvalidStateError
 import numpy as np
 
 from minio_tpu.dataplane import ring
+from minio_tpu import obs
+from minio_tpu.obs import flight
 from minio_tpu.obs import kernel as obs_kernel
 from minio_tpu.utils import admission
 from minio_tpu.utils import errors as se
@@ -94,7 +96,8 @@ class CodecRequest:
     callback run by the dispatcher, a finish callback run by the
     completion thread, and the future request threads wait on."""
 
-    __slots__ = ("base", "rows", "stage", "finish", "future", "t_submit")
+    __slots__ = ("base", "rows", "stage", "finish", "future", "t_submit",
+                 "trace_id", "tl")
 
     def __init__(self, base: _BaseKey, rows: int, stage, finish):
         self.base = base
@@ -103,6 +106,11 @@ class CodecRequest:
         self.finish = finish
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
+        # Critical-path attribution: the submitting request's trace id
+        # and flight-recorder timeline ride the request through the
+        # dispatcher/completion threads (which have no request context).
+        self.trace_id = obs.trace_id()
+        self.tl = flight.current()
 
 
 class _OpenBatch:
@@ -636,6 +644,23 @@ class BatchPlane:
             obs_kernel.dataplane_launch(
                 op, batch.fill, cap,
                 [now - r.t_submit for r in batch.reqs])
+            for r in batch.reqs:
+                if r.tl is not None:
+                    # Queue wait = submit → kernel dispatch (batching
+                    # wait + staging memcpy); launch = the device
+                    # dispatch for the whole batch.
+                    r.tl.stamp("dp_queue_wait", t0 - r.t_submit,
+                               "dataplane")
+                    r.tl.stamp("dp_launch", now - t0, "dataplane")
+            if obs.has_subscribers():
+                obs.publish({
+                    "type": "batch", "plane": "dataplane", "op": op,
+                    "rows": batch.fill, "capacity": cap,
+                    "requests": len(batch.reqs),
+                    "members": [r.trace_id for r in batch.reqs
+                                if r.trace_id],
+                    "time": time.time(),
+                    "durationNs": int((now - t0) * 1e9)})
             st = self._stats
             st["launches"] += 1
             st["requests"] += len(batch.reqs)
@@ -662,6 +687,7 @@ class BatchPlane:
         """Materialize one launch (the only device->host sync point),
         resolve its requests' futures, recycle the slot."""
         try:
+            t0 = time.perf_counter()
             if slot_key.op == ring.OP_ENCODE:
                 parity, digs = outs
                 mat = (np.asarray(parity),
@@ -671,6 +697,10 @@ class BatchPlane:
                 mat = (np.asarray(rebuilt), np.asarray(digs))
             else:
                 mat = np.asarray(outs)
+            dt_mat = time.perf_counter() - t0
+            for req in reqs:
+                if req.tl is not None:
+                    req.tl.stamp("dp_materialize", dt_mat, "dataplane")
             row0 = 0
             for req in reqs:
                 try:

@@ -49,6 +49,10 @@ class PeerHooks:
         # Node-scope Prometheus exposition (bytes) — what the federated
         # cluster scrape pulls and relabels under server=<this node>.
         self.metrics: Callable[[], bytes] = lambda: b""
+        # Flight-recorder query: params {traceid, api, worst} -> this
+        # node's stage timelines (admin perf/timeline federation).
+        self.perf_timeline: Callable[[dict], dict] = lambda params: {
+            "node": "", "timelines": []}
 
 
 def _stream_bus(bus):
@@ -81,6 +85,9 @@ def peer_routes(hooks: PeerHooks) -> dict:
     def h_metrics(params, body):
         return bytes(hooks.metrics())
 
+    def h_perf_timeline(params, body):
+        return pack(hooks.perf_timeline(params or {}))
+
     def h_trace(params, body):
         return _stream_bus(hooks.trace_bus)
 
@@ -105,6 +112,7 @@ def peer_routes(hooks: PeerHooks) -> dict:
             "server_info": h_server_info,
             "obd_info": h_obd_info,
             "metrics": h_metrics,
+            "perf_timeline": h_perf_timeline,
             "trace": h_trace,
             "consolelog": h_consolelog,
             "profile_start": h_profile_start,
@@ -178,6 +186,13 @@ class PeerClient:
     def metrics(self) -> bytes:
         """The peer's node-scope Prometheus exposition (raw bytes)."""
         return self._metrics_client().call(f"/rpc/{PLANE}/v1/metrics")
+
+    def perf_timeline(self, params: dict | None = None) -> dict:
+        """The peer's flight-recorder timelines (filtered server-side).
+        Rides the dedicated observability client for the same reason as
+        metrics(): a stalled query must not poison the fabric client."""
+        return self._metrics_client().call_msgpack(
+            f"/rpc/{PLANE}/v1/perf_timeline", params or {})
 
     def trace_stream(self, heartbeats: bool = False):
         """Iterator over the peer's trace records — the remote half of
@@ -284,6 +299,14 @@ class NotificationSys:
 
     def obd_all(self) -> list[dict]:
         results = self._fanout(lambda p: p.obd_info())
+        return [r if not isinstance(r, Exception)
+                else {"error": str(r), "node": p.name}
+                for p, r in zip(self.peers, results)]
+
+    def perf_all(self, params: dict | None = None) -> list[dict]:
+        """Every peer's flight-recorder timelines — the perf/timeline
+        endpoint's cluster fan-out (same shape as server_info_all)."""
+        results = self._fanout(lambda p: p.perf_timeline(params))
         return [r if not isinstance(r, Exception)
                 else {"error": str(r), "node": p.name}
                 for p, r in zip(self.peers, results)]

@@ -30,6 +30,7 @@ from concurrent.futures import TimeoutError as _FutTimeout
 from typing import BinaryIO, Iterator
 
 from minio_tpu import dataplane, hottier, metaplane, obs
+from minio_tpu.obs import flight
 from minio_tpu.erasure.codec import DEFAULT_BLOCK_SIZE, ErasureCodec
 from minio_tpu.erasure import listing
 from minio_tpu.erasure.sysstore import SysConfigStore
@@ -429,6 +430,9 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         first_block = _read_full(
             data, min(self.block_size, size) if size >= 0 else self.block_size
         )
+        # Timeline: request-body receive up to the first block boundary
+        # (small objects: the whole body) is the rx_drain stage.
+        flight.mark("rx_drain")
 
         # Small-object fast path: inline into the journal, no shard files —
         # one metadata write per drive instead of shard + rename.
@@ -534,6 +538,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     # resident generation (the streaming path rides
                     # _meta_invalidate; inline commits skip it).
                     tier.invalidate(bucket, obj)
+            flight.mark("commit", "metaplane")
             return self._fi_to_object_info(bucket, obj, fi)
 
         # Streaming erasure path.
@@ -553,6 +558,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                     write_quorum, bucket, obj, initial=first_block,
                 )
                 sp.set(bytes=total)
+            flight.mark("encode", "dataplane")
         except (se.StorageError, se.ObjectError):
             # Quorum lost mid-encode (InsufficientWriteQuorum is an
             # ObjectError): the healthy drives' tmp staging must not
@@ -639,6 +645,7 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
                               for d, t in zip(shuffled, tokens) if t],
                              deadline=self._meta_deadline())
             self._meta_invalidate(bucket, obj)
+        flight.mark("commit", "metaplane")
         # Partial success: quorum met but some drive missed the write — queue
         # it for background heal (reference addPartial, cmd/erasure-object.go:1150).
         if self.mrf is not None and any(isinstance(o, Exception) for o in outcomes):
@@ -686,6 +693,9 @@ class ErasureObjects(HealingMixin, MultipartMixin, SysConfigStore):
         # typed read error, never silent corruption.
         with self.nslock.rlock(bucket, obj):
             fi = self._read_quorum_fileinfo(bucket, obj, opts.version_id)
+        # Timeline: quorum metadata election (the GET's metadata stage —
+        # decode + transfer land in the trailing resp_drain segment).
+        flight.mark("meta_elect", "metaplane")
         if fi.deleted:
             raise se.ObjectNotFound(bucket, obj)
         info = self._fi_to_object_info(bucket, obj, fi)

@@ -27,6 +27,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 from minio_tpu import obs
 from minio_tpu.frontdoor import shm
+from minio_tpu.obs import flight
 
 _RING_SUBMITS = obs.counter(
     "minio_tpu_frontdoor_ring_submits_total",
@@ -40,6 +41,13 @@ _RING_SERVED = obs.counter(
     "minio_tpu_frontdoor_ring_served_total",
     "Ring batches the lane-owner worker completed",
     ("worker", "op"))
+
+_OP_NAMES = {
+    shm.OP_DIGEST: "digest",
+    shm.OP_ENCODE: "encode",
+    shm.OP_RECONSTRUCT: "reconstruct",
+    shm.OP_HOTGET: "hotget",
+}
 
 
 def _ceil_div(a: int, b: int) -> int:
@@ -73,7 +81,7 @@ class _PendingRingEncode:
     def wait(self):
         resp = self._c._await_slot(self._slot, self._seq)
         if resp is None:
-            self._c._note_fallback("timeout")
+            self._c._note_fallback(shm.REASON_TIMEOUT)
             return self._fallback()
         k, m = self._k, self._m
         out_chunks: list[list] = []
@@ -186,7 +194,7 @@ class _PendingRingReconstruct:
     def wait(self):
         resp = self._c._await_slot(self._slot, self._seq)
         if resp is None:
-            self._c._note_fallback("timeout")
+            self._c._note_fallback(shm.REASON_TIMEOUT)
             return self._fallback()
         t = len(self.targets)
         out_chunks: list[list[bytes]] = []
@@ -245,6 +253,13 @@ class LaneClient:
     def _note_fallback(self, reason: str) -> None:
         _RING_FALLBACKS.labels(worker=self._wlabel, reason=reason).inc()
 
+    def _tid(self) -> bytes:
+        """The current request's trace id, as slot-header bytes — the
+        lane server restores it around the serve so cross-process work
+        stays attributed to the originating request."""
+        t = obs.trace_id()
+        return t.encode("ascii", "replace") if t else b""
+
     # -- slot machinery -------------------------------------------------
 
     def _acquire(self) -> tuple[int, int] | None:
@@ -271,7 +286,17 @@ class LaneClient:
 
     def _await_slot(self, slot: int, seq: int):
         """Poll until the server commits (DONE/ERROR) for `seq`; returns
-        a private copy of the response bytes, or None on any miss."""
+        a private copy of the response bytes, or None on any miss. The
+        whole wait lands on the request timeline as a `ring_wait` stamp
+        (submission → response, i.e. the cross-process hop)."""
+        t_wait = time.perf_counter()
+        try:
+            return self._poll_slot(slot, seq)
+        finally:
+            flight.stamp("ring_wait", time.perf_counter() - t_wait,
+                         "ring")
+
+    def _poll_slot(self, slot: int, seq: int):
         deadline = time.monotonic() + self._timeout
         pause = 20e-6
         while True:
@@ -306,20 +331,20 @@ class LaneClient:
         if (not chunks or need_req > self.ring.req_cap
                 or need_resp > self.ring.resp_cap):
             if chunks:
-                self._note_fallback("oversize")
+                self._note_fallback(shm.REASON_OVERSIZE)
             return self.local().digest_chunks(chunks, cap)
         got = self._acquire()
         if got is None:
-            self._note_fallback("no_slot")
+            self._note_fallback(shm.REASON_NO_SLOT)
             return self.local().digest_chunks(chunks, cap)
         slot, seq = got
         req_len = shm.pack_chunks(self.ring.req_view(slot), chunks)
         self.ring.publish(slot, shm.OP_DIGEST, 0, 0, 0, seq,
-                          len(chunks), req_len)
+                          len(chunks), req_len, self._tid())
         _RING_SUBMITS.labels(worker=self._wlabel, op="digest").inc()
         resp = self._await_slot(slot, seq)
         if resp is None:
-            self._note_fallback("timeout")
+            self._note_fallback(shm.REASON_TIMEOUT)
             return self.local().digest_chunks(chunks, cap)
         dmv = memoryview(resp)
         return [dmv[i * 32:(i + 1) * 32] for i in range(len(chunks))]
@@ -364,13 +389,13 @@ class LaneClient:
                         for bl in block_lens)
         if (shm.chunks_size(chunks) > self.ring.req_cap
                 or need_resp > self.ring.resp_cap):
-            self._note_fallback("oversize")
+            self._note_fallback(shm.REASON_OVERSIZE)
             return self.local().begin_reconstruct(
                 k, m, block_size, shard_chunks, block_lens, targets,
                 with_digests=with_digests)
         got = self._acquire()
         if got is None:
-            self._note_fallback("no_slot")
+            self._note_fallback(shm.REASON_NO_SLOT)
             return self.local().begin_reconstruct(
                 k, m, block_size, shard_chunks, block_lens, targets,
                 with_digests=with_digests)
@@ -378,7 +403,7 @@ class LaneClient:
         req_len = shm.pack_chunks(self.ring.req_view(slot), chunks)
         flags = shm.FLAG_DIGESTS if with_digests else 0
         self.ring.publish(slot, shm.OP_RECONSTRUCT, flags, k, m, seq,
-                          len(chunks), req_len)
+                          len(chunks), req_len, self._tid())
         _RING_SUBMITS.labels(worker=self._wlabel, op="reconstruct").inc()
         return _PendingRingReconstruct(self, slot, seq, k, m, block_size,
                                        shard_chunks, block_lens, targets,
@@ -393,19 +418,19 @@ class LaneClient:
         if (not blocks or need_req > self.ring.req_cap
                 or need_resp > self.ring.resp_cap):
             if blocks:
-                self._note_fallback("oversize")
+                self._note_fallback(shm.REASON_OVERSIZE)
             return self.local().begin_encode(k, m, block_size, blocks,
                                              with_digests=with_digests)
         got = self._acquire()
         if got is None:
-            self._note_fallback("no_slot")
+            self._note_fallback(shm.REASON_NO_SLOT)
             return self.local().begin_encode(k, m, block_size, blocks,
                                              with_digests=with_digests)
         slot, seq = got
         req_len = shm.pack_chunks(self.ring.req_view(slot), blocks)
         flags = shm.FLAG_DIGESTS if with_digests else 0
         self.ring.publish(slot, shm.OP_ENCODE, flags, k, m, seq,
-                          len(blocks), req_len)
+                          len(blocks), req_len, self._tid())
         _RING_SUBMITS.labels(worker=self._wlabel, op="encode").inc()
         return _PendingRingEncode(self, slot, seq, k, m, block_size,
                                   blocks, with_digests)
@@ -423,19 +448,20 @@ class LaneClient:
         meta = _pack_hotget(bucket, obj, ident, offset, length)
         if (4 + len(meta) > self.ring.req_cap
                 or length > self.ring.resp_cap):
-            self._note_fallback("oversize")
+            self._note_fallback(shm.REASON_OVERSIZE)
             return None
         got = self._acquire()
         if got is None:
-            self._note_fallback("no_slot")
+            self._note_fallback(shm.REASON_NO_SLOT)
             return None
         slot, seq = got
         req_len = shm.pack_chunks(self.ring.req_view(slot), [meta])
-        self.ring.publish(slot, shm.OP_HOTGET, 0, 0, 0, seq, 1, req_len)
+        self.ring.publish(slot, shm.OP_HOTGET, 0, 0, 0, seq, 1, req_len,
+                          self._tid())
         _RING_SUBMITS.labels(worker=self._wlabel, op="hotget").inc()
         resp = self._await_slot(slot, seq)
         if resp is None or len(resp) != length:
-            self._note_fallback("hot_miss")
+            self._note_fallback(shm.REASON_HOT_MISS)
             return None
         return resp
 
@@ -536,38 +562,62 @@ class LaneServer:
 
     def _serve_slot(self, i: int) -> None:
         try:
-            st, op, flags, k, m, seq, rows, req_len, _rl, _rs = \
+            st, op, flags, k, m, seq, rows, req_len, _rl, _rs, tid_raw = \
                 self.ring.head(i)
             if st != shm.SUBMITTED:
                 return
+            # Restore the submitting worker's trace context from the
+            # slot header: trace records and the server-side timeline
+            # below attribute to the ORIGINATING request, not to the
+            # lane owner's scanner thread.
+            tid = shm.decode_tid(tid_raw)
+            opname = _OP_NAMES.get(op, "unknown")
+            tok = obs.set_trace_context(tid) if tid else None
+            tl = flight.detached(tid, f"ring:{opname}") if tid else None
+            t0 = time.perf_counter()
+            ok = True
             try:
-                reqs = shm.unpack_chunks(self.ring.req_view(i), rows,
-                                         req_len)
-                if op == shm.OP_DIGEST:
-                    resp_len = self._do_digest(i, reqs)
-                elif op == shm.OP_ENCODE:
-                    resp_len = self._do_encode(
-                        i, reqs, k, m, bool(flags & shm.FLAG_DIGESTS))
-                elif op == shm.OP_RECONSTRUCT:
-                    resp_len = self._do_reconstruct(
-                        i, reqs, k, m, bool(flags & shm.FLAG_DIGESTS))
-                elif op == shm.OP_HOTGET:
-                    resp_len = self._do_hotget(i, reqs)
-                else:
-                    raise ValueError(f"unknown ring op {op}")
-            except Exception as e:  # noqa: BLE001 - travels to the
-                # producer as a typed ring ERROR; it recomputes locally
-                msg = f"{type(e).__name__}: {e}".encode()[:self.ring.resp_cap]
-                self.ring.resp_view(i)[:len(msg)] = msg
-                self.ring.respond(i, seq, len(msg), ok=False)
-                return
-            self.ring.respond(i, seq, resp_len, ok=True)
-            _RING_SERVED.labels(
-                worker=self._wlabel,
-                op={shm.OP_DIGEST: "digest",
-                    shm.OP_ENCODE: "encode",
-                    shm.OP_RECONSTRUCT: "reconstruct",
-                    shm.OP_HOTGET: "hotget"}[op]).inc()
+                try:
+                    reqs = shm.unpack_chunks(self.ring.req_view(i), rows,
+                                             req_len)
+                    if op == shm.OP_DIGEST:
+                        resp_len = self._do_digest(i, reqs)
+                    elif op == shm.OP_ENCODE:
+                        resp_len = self._do_encode(
+                            i, reqs, k, m, bool(flags & shm.FLAG_DIGESTS))
+                    elif op == shm.OP_RECONSTRUCT:
+                        resp_len = self._do_reconstruct(
+                            i, reqs, k, m, bool(flags & shm.FLAG_DIGESTS))
+                    elif op == shm.OP_HOTGET:
+                        resp_len = self._do_hotget(i, reqs)
+                    else:
+                        raise ValueError(f"unknown ring op {op}")
+                except Exception as e:  # noqa: BLE001 - travels to the
+                    # producer as a typed ring ERROR; it recomputes
+                    # locally
+                    ok = False
+                    msg = f"{type(e).__name__}: {e}".encode()[
+                        :self.ring.resp_cap]
+                    self.ring.resp_view(i)[:len(msg)] = msg
+                    self.ring.respond(i, seq, len(msg), ok=False)
+                    return
+                self.ring.respond(i, seq, resp_len, ok=True)
+                _RING_SERVED.labels(worker=self._wlabel,
+                                    op=opname).inc()
+            finally:
+                dur = time.perf_counter() - t0
+                if tl is not None:
+                    tl.mark("serve", "ring")
+                    flight.finish(tl, status=200 if ok else 500)
+                if obs.has_subscribers():
+                    obs.publish({"type": "ring", "plane": "ring",
+                                 "op": opname, "slot": i,
+                                 "rows": rows, "ok": ok,
+                                 "worker": self._wlabel,
+                                 "time": time.time(),
+                                 "durationNs": int(dur * 1e9)})
+                if tok is not None:
+                    obs.reset_trace_context(tok)
         finally:
             with self._mu:
                 self._inflight.discard(i)

@@ -43,11 +43,14 @@ import os
 import struct
 import time
 
-MAGIC = b"MTPUFDR1"
+MAGIC = b"MTPUFDR2"   # v2: slot header carries the originating trace id
 _HDR = struct.Struct("<8sII")       # magic, nslots, slot_bytes
 _HDR_SIZE = 64
-# state, op, flags, k, m, pad, seq, rows, req_len, resp_len, resp_seq
-_SLOT = struct.Struct("<BBBBBxxxQIIIQ")
+# state, op, flags, k, m, pad, seq, rows, req_len, resp_len, resp_seq,
+# trace id (16 ASCII bytes, NUL-padded — the S3 request id of the
+# submitting worker's request, so the lane server's batch/ring records
+# attribute cross-process work to the originating request).
+_SLOT = struct.Struct("<BBBBBxxxQIIIQ16s")
 _SLOT_SIZE = 64
 
 FREE, SUBMITTED, DONE, ERROR, ABANDONED = 0, 1, 2, 3, 4
@@ -76,6 +79,22 @@ RING_OPS = {
     "OP_HOTGET": OP_HOTGET,
 }
 FLAG_DIGESTS = 1
+
+# Why a LaneClient gave up on ring service and fell back to its local
+# plane. Closed registry (static rule MTPU009, docs/ANALYSIS.md): the
+# `ring_fallbacks_total{reason}` label set is exactly these — a new
+# fallback path must add its constant here (and a row in
+# docs/FRONTDOOR.md) before it can ship.
+REASON_OVERSIZE = "oversize"    # op exceeds the slot request area
+REASON_NO_SLOT = "no_slot"      # worker's slot range fully in flight
+REASON_TIMEOUT = "timeout"      # server missed the slot deadline
+REASON_HOT_MISS = "hot_miss"    # hot-tier probe answered ERROR (miss)
+RING_FALLBACK_REASONS = {
+    "REASON_OVERSIZE": REASON_OVERSIZE,
+    "REASON_NO_SLOT": REASON_NO_SLOT,
+    "REASON_TIMEOUT": REASON_TIMEOUT,
+    "REASON_HOT_MISS": REASON_HOT_MISS,
+}
 
 _U32 = struct.Struct("<I")
 
@@ -169,7 +188,7 @@ class Ring:
 
     def head(self, i: int) -> tuple:
         """(state, op, flags, k, m, seq, rows, req_len, resp_len,
-        resp_seq)"""
+        resp_seq, tid)"""
         return _SLOT.unpack_from(self.buf, self._off(i))
 
     def state(self, i: int) -> int:
@@ -187,11 +206,13 @@ class Ring:
         return memoryview(self.buf)[off:off + self.resp_cap]
 
     def publish(self, i: int, op: int, flags: int, k: int, m: int,
-                seq: int, rows: int, req_len: int) -> None:
+                seq: int, rows: int, req_len: int,
+                tid: bytes = b"") -> None:
         """Producer: header first (state FREE), then the state byte —
-        the SUBMITTED store is the commit point."""
+        the SUBMITTED store is the commit point. `tid` is the
+        originating request's trace id (≤16 ASCII bytes)."""
         _SLOT.pack_into(self.buf, self._off(i), FREE, op, flags, k, m,
-                        seq, rows, req_len, 0, 0)
+                        seq, rows, req_len, 0, 0, tid[:16])
         self._set_state(i, SUBMITTED)
 
     def respond(self, i: int, seq: int, resp_len: int, ok: bool) -> bool:
@@ -199,14 +220,14 @@ class Ring:
         (state, seq) so a response never lands on a slot the producer
         has already abandoned/reused; echoes seq as resp_seq."""
         off = self._off(i)
-        st, op, flags, k, m, cur_seq, rows, req_len, _rl, _rs = \
+        st, op, flags, k, m, cur_seq, rows, req_len, _rl, _rs, tid = \
             _SLOT.unpack_from(self.buf, off)
         if st != SUBMITTED or cur_seq != seq:
             if st == ABANDONED and cur_seq == seq:
                 self._set_state(i, FREE)
             return False
         _SLOT.pack_into(self.buf, off, SUBMITTED, op, flags, k, m,
-                        seq, rows, req_len, resp_len, seq)
+                        seq, rows, req_len, resp_len, seq, tid)
         self._set_state(i, DONE if ok else ERROR)
         return True
 
@@ -254,3 +275,130 @@ def unpack_chunks(area, rows: int, req_len: int) -> list:
 
 def chunks_size(chunks) -> int:
     return sum(4 + len(c) for c in chunks)
+
+
+def decode_tid(tid: bytes) -> str:
+    """Slot-header trace id bytes -> trace id string ('' when absent)."""
+    return tid.rstrip(b"\x00").decode("ascii", "replace")
+
+
+# -- flight-recorder spool ----------------------------------------------
+#
+# The admin perf endpoint must see EVERY worker's flight recorder, but
+# timelines complete at request rate — far too hot for a control-socket
+# round trip per request. Instead each worker owns a small shared-memory
+# spool (single writer, round-robin over fixed slots) and appends every
+# completed timeline snapshot as JSON; at query time any worker attaches
+# its siblings' spools read-only and merges. Readers tolerate torn
+# writes (a snapshot being overwritten mid-read) by construction: the
+# length word is cleared before the payload is rewritten and stored
+# last, and a JSON parse failure just skips the slot — the spool is a
+# best-effort observability cache, never a correctness dependency.
+
+FLIGHT_MAGIC = b"MTPUFLS1"
+DEFAULT_FLIGHT_SLOTS = 128
+DEFAULT_FLIGHT_SLOT_BYTES = 4096
+
+
+class FlightSpool:
+    """Per-worker shm ring of recent timeline snapshots (JSON)."""
+
+    def __init__(self, shm, nslots: int, cap: int, owner: bool):
+        self._shm = shm
+        self.nslots = nslots
+        self.cap = cap
+        self._owner = owner
+        self._cursor = 0
+        self.buf = shm.buf
+
+    @classmethod
+    def create(cls, name: str, nslots: int = DEFAULT_FLIGHT_SLOTS,
+               cap: int = DEFAULT_FLIGHT_SLOT_BYTES) -> "FlightSpool":
+        from multiprocessing import shared_memory
+
+        size = _HDR_SIZE + nslots * (4 + cap)
+        try:
+            shm = shared_memory.SharedMemory(name=name, create=True,
+                                             size=size)
+        except FileExistsError:
+            # Leftover from a crashed predecessor with the same name
+            # (worker respawn): reclaim it.
+            stale = shared_memory.SharedMemory(name=name)
+            stale.close()
+            stale.unlink()
+            shm = shared_memory.SharedMemory(name=name, create=True,
+                                             size=size)
+        _HDR.pack_into(shm.buf, 0, FLIGHT_MAGIC, nslots, cap)
+        return cls(shm, nslots, cap, owner=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "FlightSpool":
+        from multiprocessing import shared_memory
+
+        shm = shared_memory.SharedMemory(name=name)
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(shm._name, "shared_memory")
+        # mtpu: allow(MTPU003) - tracker internals vary by Python
+        # version; the tracking noise is cosmetic, never fatal.
+        except Exception:  # noqa: BLE001
+            pass
+        magic, nslots, cap = _HDR.unpack_from(shm.buf, 0)
+        if magic != FLIGHT_MAGIC:
+            shm.close()
+            raise ValueError(f"shm segment {name!r} is not a flight spool")
+        return cls(shm, nslots, cap, owner=False)
+
+    @property
+    def name(self) -> str:
+        return self._shm.name
+
+    def _off(self, i: int) -> int:
+        return _HDR_SIZE + i * (4 + self.cap)
+
+    def put(self, snap: dict) -> None:
+        """Owner only. Oversized snapshots are dropped (the local ring
+        still has them; only the cross-worker view loses the entry)."""
+        import json
+
+        raw = json.dumps(snap, separators=(",", ":")).encode()
+        if len(raw) > self.cap:
+            return
+        i = self._cursor
+        self._cursor = (i + 1) % self.nslots
+        off = self._off(i)
+        _U32.pack_into(self.buf, off, 0)
+        self.buf[off + 4:off + 4 + len(raw)] = raw
+        _U32.pack_into(self.buf, off, len(raw))
+
+    def read_all(self) -> list[dict]:
+        import json
+
+        out = []
+        for i in range(self.nslots):
+            off = self._off(i)
+            (ln,) = _U32.unpack_from(self.buf, off)
+            if not ln or ln > self.cap:
+                continue
+            try:
+                # Decode straight off the shm view (json.loads takes
+                # str) — no intermediate bytes copy.
+                out.append(json.loads(str(
+                    memoryview(self.buf)[off + 4:off + 4 + ln], "utf-8")))
+            except (ValueError, UnicodeDecodeError):
+                continue  # torn write — writer is mid-overwrite
+        return out
+
+    def close(self) -> None:
+        try:
+            self._shm.close()
+        except (OSError, BufferError):
+            return
+
+    def unlink(self) -> None:
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except OSError:
+                return

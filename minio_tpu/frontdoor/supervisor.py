@@ -72,6 +72,7 @@ class Supervisor:
         self._backoff: dict[int, float] = {}
         self._respawn_at: dict[int, float] = {}
         self._spawned_at: dict[int, float] = {}
+        self.flight_base = f"mtpu_flt_{os.getpid()}_"
         self._log = get_logger()
 
     # -- lifecycle ------------------------------------------------------
@@ -114,6 +115,9 @@ class Supervisor:
             # Single-writer WAL ownership: each worker journals into
             # its own per-drive segment (docs/FRONTDOOR.md).
             "MTPU_WAL_SEGMENT": f"w{i}",
+            # Flight-recorder spool base: worker i owns shm segment
+            # f"{base}w{i}"; siblings attach read-only at query time.
+            "MTPU_FLIGHT_SPOOL": self.flight_base,
         })
         if self.ring is not None:
             env[frontdoor.RING_ENV] = self.ring.name
@@ -260,3 +264,18 @@ class Supervisor:
             self.ring.close()
             self.ring.unlink()
             self.ring = None
+        # Workers unlink their own flight spools on a clean drain; sweep
+        # whatever a SIGKILLed straggler left behind.
+        from multiprocessing import shared_memory
+
+        for i in range(self.workers):
+            try:
+                stale = shared_memory.SharedMemory(
+                    name=f"{self.flight_base}w{i}")
+            except OSError:
+                continue
+            stale.close()
+            try:
+                stale.unlink()
+            except OSError:
+                pass

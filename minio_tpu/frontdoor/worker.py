@@ -101,6 +101,57 @@ def _arm_shared_lanes(wid: int, srv=None):
     return stop
 
 
+def _arm_flight(wid: int):
+    """Wire this worker's flight recorder into the cross-worker spool
+    fabric: the worker owns one shm FlightSpool (`<base>w<id>`, base
+    supervisor-stamped via MTPU_FLIGHT_SPOOL) that every finished
+    timeline also lands in, and reads its siblings' spools on query —
+    so the admin perf endpoint answers for the whole pool no matter
+    which worker the kernel routed the query to. Returns a stop
+    callable."""
+    from minio_tpu.obs import flight
+
+    flight.set_worker(wid)
+    base = os.environ.get("MTPU_FLIGHT_SPOOL", "")
+    if not (base and flight.armed()):
+        return lambda: None
+    from minio_tpu.frontdoor import shm
+
+    try:
+        spool = shm.FlightSpool.create(f"{base}w{wid}")
+    except (OSError, ValueError):
+        return lambda: None  # no spool: local recorder still works
+    flight.attach_sink(spool.put)
+    nworkers = frontdoor.worker_count()
+
+    def read_siblings() -> list[dict]:
+        # Attach-per-query (not cached): a sibling may have respawned
+        # and recreated its spool since the last read.
+        out = []
+        for o in range(nworkers):
+            if o == wid:
+                continue
+            try:
+                sib = shm.FlightSpool.attach(f"{base}w{o}")
+            except (OSError, ValueError):
+                continue
+            try:
+                out.extend(sib.read_all())
+            finally:
+                sib.close()
+        return out
+
+    flight.set_sibling_reader(read_siblings)
+
+    def stop():
+        flight.attach_sink(None)
+        flight.set_sibling_reader(None)
+        spool.close()
+        spool.unlink()
+
+    return stop
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="minio_tpu front-door worker")
     ap.add_argument("drives", nargs="+")
@@ -145,6 +196,7 @@ def main(argv=None) -> None:
     srv.app.on_response_prepare.append(_stamp_worker)
 
     stop_lanes = _arm_shared_lanes(wid, srv)
+    stop_flight = _arm_flight(wid)
     if wid == 0:
         # One healer per pool of workers: N auto-healers racing the
         # same sets would duplicate every heal fan-out.
@@ -200,6 +252,7 @@ def main(argv=None) -> None:
     finally:
         up.set(0)
         stop_lanes()
+        stop_flight()
         # Checkpoint this worker's WAL segments so a clean drain leaves
         # nothing for the next mount's replay fold.
         from minio_tpu.logger import get_logger

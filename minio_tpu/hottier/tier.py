@@ -49,6 +49,7 @@ import numpy as np
 
 from minio_tpu import obs
 from minio_tpu.hottier import arena
+from minio_tpu.obs import flight
 from minio_tpu.logger import get_logger
 from minio_tpu.utils import errors as se
 
@@ -71,6 +72,18 @@ _EVICTIONS = obs.counter(
 _BYTES = obs.gauge(
     "minio_tpu_hottier_bytes",
     "Device bytes currently charged to resident hot objects")
+_HIT_RATIO = obs.gauge(
+    "minio_tpu_hottier_hit_ratio",
+    "Hot-tier hit ratio (hits / lookups) since process start")
+_HEAT = obs.gauge(
+    "minio_tpu_hottier_heat",
+    "Tracked keys whose decayed heat is <= le (cumulative buckets; "
+    "+Inf = all tracked keys) — the admission-threshold tuning view",
+    ("le",))
+# Fixed bucket bounds bracketing the admission threshold's practical
+# range (DEFAULT_MIN_HEAT=1.5): where the population sits relative to
+# MTPU_HOTTIER_MIN_HEAT is exactly what admission tuning needs to see.
+_HEAT_BOUNDS = (0.5, 1.0, 2.0, 4.0, 8.0, 16.0)
 
 DEFAULT_MAX_OBJECT = 8 << 20
 # One GET scores ~1.0 heat; the default threshold sits between the
@@ -164,6 +177,7 @@ class HotObjectTier:
         self.closed = False
         self._stats = {"hits": 0, "misses": 0, "admits": 0,
                        "evictions": 0, "admit_errors": 0}
+        self._gauge_t = 0.0  # last heat/hit-ratio gauge refresh
         self._admit_t = threading.Thread(
             target=self._admit_loop, daemon=True,
             name="mtpu-hottier-admit")
@@ -191,6 +205,27 @@ class HotObjectTier:
     def _heat_of(self, key: tuple, now: float) -> float:
         val, t = self._heat.get(key, (0.0, now))
         return val * (0.5 ** (max(0.0, now - t) / self.halflife))
+
+    def _refresh_gauges(self) -> None:
+        """Throttled (1 s) refresh of the heat-distribution and
+        hit-ratio gauges from whichever lookup got here first — a
+        scrape sees at-most-a-second-old truth without any lookup
+        paying a full O(keys) pass."""
+        now = time.monotonic()
+        with self._mu:
+            if now - self._gauge_t < 1.0:
+                return
+            self._gauge_t = now
+            heats = [v * (0.5 ** (max(0.0, now - t) / self.halflife))
+                     for v, t in self._heat.values()]
+            hits = self._stats["hits"]
+            misses = self._stats["misses"]
+        for b in _HEAT_BOUNDS:
+            _HEAT.labels(le=str(b)).set(
+                sum(1 for h in heats if h <= b))
+        _HEAT.labels(le="+Inf").set(len(heats))
+        if hits + misses:
+            _HIT_RATIO.set(hits / (hits + misses))
 
     # ------------------------------------------------------------------
     # the serving path
@@ -226,14 +261,26 @@ class HotObjectTier:
             self._stats["evictions"] += 1
         if entry is None:
             return None
+        t0 = time.perf_counter()
         out = self._serve_entry(entry, offset, length)
         if out is None:
             # Digest mismatch: resident bits rotted — evict; the
             # caller's note_miss accounts the fallback.
             self.invalidate(bucket, obj)
             return None
+        dt = time.perf_counter() - t0
         _HITS.inc()
         self._stats["hits"] += 1
+        # Attribution: the device serve lands on the request timeline
+        # (it replaces the drive read inside the response-drain stage)
+        # and, when watched, on the trace bus.
+        flight.stamp("hottier_serve", dt, "hottier")
+        if obs.has_subscribers():
+            obs.publish({"type": "hottier", "plane": "hottier",
+                         "event": "hit", "bucket": bucket, "obj": obj,
+                         "bytes": length, "time": time.time(),
+                         "durationNs": int(dt * 1e9)})
+        self._refresh_gauges()
         return out
 
     def _serve_entry(self, entry: _Entry, offset: int, length: int):
@@ -293,6 +340,7 @@ class HotObjectTier:
             return  # the admit thread's own oracle read is not demand
         _MISSES.inc()
         self._stats["misses"] += 1
+        self._refresh_gauges()
         if size <= 0 or size > self.max_object:
             return
         key = (bucket, obj)

@@ -46,6 +46,7 @@ from concurrent.futures import Future
 
 from minio_tpu import metaplane, obs
 from minio_tpu.metaplane import wal as walfmt
+from minio_tpu.obs import flight
 from minio_tpu.utils import admission
 from minio_tpu.utils import errors as se
 
@@ -432,6 +433,15 @@ class DriveWAL:
             raise se.FaultyDisk(f"wal broken: {self._broken}")
         if self._closed:
             raise se.FaultyDisk("wal closed")
+        # Critical-path attribution rides the Future itself (every
+        # submit shape ends in one): the committer thread reads it back
+        # after the covering fsync to stamp the submitting request's
+        # timeline and link the group's member trace ids. Attached
+        # BEFORE enqueue — the committer may drain the item immediately.
+        tid = obs.trace_id()
+        tl = flight.current()
+        if tid is not None or tl is not None:
+            item[-1].mtpu_fctx = (tid, tl, time.perf_counter())
         try:
             self._q.put_nowait(item)
         except queue.Full:
@@ -744,6 +754,24 @@ class DriveWAL:
         self._c_fsyncs.inc()
         self._c_commits.inc(len(staged))
         self._h_fill.observe(len(staged))
+        # Attribution: the fsync above is the durability point — stamp
+        # each member request's timeline with its submit→fsync wait and
+        # link the group's members in one `batch` record.
+        t_ack = time.perf_counter()
+        members = []
+        for rec in staged:
+            fctx = getattr(rec[7], "mtpu_fctx", None)
+            if fctx is None:
+                continue
+            tid, tl, t_sub = fctx
+            if tid:
+                members.append(tid)
+            if tl is not None:
+                tl.stamp("wal_fsync_wait", t_ack - t_sub, "metaplane")
+        if obs.has_subscribers():
+            obs.publish({"type": "batch", "plane": "metaplane",
+                         "records": len(staged), "members": members,
+                         "time": time.time()})
         # Publish the overlay BEFORE resolving futures: the instant the
         # ack fires, a read must see the new state. Entries carry LSNs
         # so a newer published state is never downgraded.

@@ -32,6 +32,7 @@ from minio_tpu.obs.histogram import (  # noqa: F401
     registry,
     render_into,
 )
+from minio_tpu.obs import flight  # noqa: F401
 from minio_tpu.obs.span import (  # noqa: F401
     Span,
     ctx_wrap,

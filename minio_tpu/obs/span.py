@@ -49,6 +49,10 @@ RECORD_TYPES = frozenset({
     "drive",      # drive health state transitions
     "rpc",        # peer fabric round trips
     "kernel",     # device-plane kernel launches
+    "batch",      # plane batch boundaries (dataplane launch / WAL group
+                  # fsync) linking member trace_ids
+    "ring",       # shm ring lane serves (cross-process front-door hop)
+    "hottier",    # HBM hot-tier serve/admit/evict events
 })
 
 # --- trace context -----------------------------------------------------------

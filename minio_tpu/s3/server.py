@@ -24,6 +24,7 @@ from typing import Iterator
 from aiohttp import web
 
 from minio_tpu import obs
+from minio_tpu.obs import flight
 from minio_tpu.admin.configkv import ConfigSys
 from minio_tpu.admin.handlers import ADMIN_PREFIX, AdminAPI
 from minio_tpu.admin.metrics import (
@@ -418,6 +419,10 @@ class S3Server:
         node.hooks.server_info = self.admin._server_info
         node.hooks.obd_info = self.admin._obd_info
         node.hooks.profiler = self.profiler
+        # Flight-recorder federation: the perf/timeline endpoint fans
+        # out the same way server_info does — each peer answers with its
+        # local ring/worst boards, filtered server-side.
+        node.hooks.perf_timeline = self.admin._perf_timelines
         # Metrics federation: peers scrape this node's node-scope
         # exposition over the peer plane and merge it under a `server`
         # label (admin/metrics.collect_cluster_metrics).
@@ -754,6 +759,10 @@ class S3Server:
         # carried to peers as the x-mtpu-trace-id RPC header — every
         # trace record this request causes, on any node, shares it.
         obs.set_trace_context(request_id, node=self.node_name or None)
+        # Flight recorder: the stage timeline opens with the trace
+        # context and closes (final `resp_drain` segment) in the finally
+        # below — queryable via /minio/admin/v3/perf/timeline.
+        flight.begin(request_id)
         path = urllib.parse.unquote(request.raw_path.split("?", 1)[0])
         if request.method == "OPTIONS" and request.headers.get("Origin") \
                 and self._cors_origin():
@@ -825,6 +834,8 @@ class S3Server:
             rx = request.content_length or 0
             tx = (resp.content_length or 0) if resp is not None else 0
             dt = time.perf_counter() - t0
+            flight.set_api(api)
+            flight.end(status=status)
             self.stats.end(api, t0, status, rx=rx, tx=tx, canceled=canceled,
                            request_id=request_id)
             _REQ_LATENCY.labels(api=api).observe(dt)
@@ -1044,6 +1055,9 @@ class S3Server:
                 ANONYMOUS, sigv4.UNSIGNED_PAYLOAD, None)
 
         request["identity"] = identity
+        # Timeline: everything up to here (header parse + signature
+        # verification + identity resolution) is the auth stage.
+        flight.mark("auth")
 
         # Temp (STS) credentials must also present their session token
         # (cmd/auth-handler.go getSessionToken check).

@@ -141,6 +141,65 @@ def test_ring_reconstruct_bitexact(lane_ring):
             [bytes(d) for d in wdig[bi]]
 
 
+def test_ring_trace_id_hop_and_timelines(lane_ring):
+    """The slot header carries the submitter's trace id across the
+    process hop: the lane server serves under that context and records
+    a detached `ring:<op>` timeline sharing it, while the submitter's
+    own timeline gains a `ring_wait` detail stamp."""
+    from minio_tpu import obs
+    from minio_tpu.obs import flight
+
+    _ring, _server, client = lane_ring
+    flight.reset()
+    rid = "RINGHOP000000001"
+    tok = obs.set_trace_context(rid)
+    flight.begin(rid, "GetObject")
+    try:
+        client.digest_chunks([os.urandom(1024)], 16_384)
+    finally:
+        flight.end()
+        obs.reset_trace_context(tok)
+    snaps = flight.collect(traceid=rid)
+    apis = {s["api"] for s in snaps}
+    assert {"GetObject", "ring:digest"} <= apis, apis
+    sub = next(s for s in snaps if s["api"] == "GetObject")
+    assert any(s["stage"] == "ring_wait" and s["plane"] == "ring"
+               and not s["seq"] for s in sub["stages"]), sub["stages"]
+    srv = next(s for s in snaps if s["api"] == "ring:digest")
+    assert srv["trace_id"] == rid
+    assert [s["stage"] for s in srv["stages"] if s["seq"]] == ["serve"]
+    flight.reset()
+
+
+def test_ring_serve_trace_record(lane_ring):
+    """Worker 0's ring serves publish a `ring` trace record carrying
+    the originating worker's trace id."""
+    from minio_tpu import obs
+
+    _ring, _server, client = lane_ring
+    rid = "RINGREC000000001"
+    got: list = []
+    with obs.trace_bus().subscribe() as sub:
+        tok = obs.set_trace_context(rid)
+        try:
+            client.digest_chunks([os.urandom(512)], 16_384)
+        finally:
+            obs.reset_trace_context(tok)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            item = sub.get(timeout=0.25)
+            if item is not None:
+                got.append(item)
+            if any(r.get("type") == "ring" for r in got):
+                break
+    rings = [r for r in got if r.get("type") == "ring"]
+    assert rings, [r.get("type") for r in got]
+    rec = rings[0]
+    assert rec["plane"] == "ring" and rec["op"] == "digest"
+    assert rec["ok"] and rec["durationNs"] >= 0
+    assert rec.get("trace_id") == rid, rec
+
+
 def test_ring_oversize_falls_back_local(lane_ring):
     _ring, _server, client = lane_ring
     big = [os.urandom(1 << 20)] * 2  # > req_cap of the default slot
@@ -267,7 +326,11 @@ def fd(tmp_path_factory):
         shared_lanes=True, log_dir=str(root),
         env={"MTPU_ROOT_USER": S3_ACCESS, "MTPU_ROOT_PASSWORD": S3_SECRET,
              "MTPU_JAX_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
-             "MTPU_METAPLANE": "1", "MTPU_BATCHED_DATAPLANE": "1"})
+             "MTPU_METAPLANE": "1", "MTPU_BATCHED_DATAPLANE": "1",
+             # Keep PUT encodes on the device-codec plane (the native
+             # C++ lane would serve them host-side) so non-zero workers
+             # route codec work over the shared-lane shm ring.
+             "MTPU_NATIVE_PLANE": "0"})
     sup.start()
     f = _FD(sup, port)
     f.wait_pool(2)
@@ -312,6 +375,47 @@ def test_wal_single_writer_segments(fd):
     segs = sorted(n for n in os.listdir(wal_dir)
                   if n.startswith("journal") and n.endswith(".wal"))
     assert segs == ["journal.w0.wal", "journal.w1.wal"], segs
+
+
+def test_flight_timeline_cross_worker_queryable(fd):
+    """Acceptance: a request served by a NON-ZERO worker (its codec
+    work routed over the shm ring) yields a stage timeline whose
+    sequential stages sum to within 10% of e2e, queryable through the
+    admin perf endpoint from ANY worker — the flight-spool fan-in."""
+    rid = wid = None
+    for i in range(12):
+        c = fd.client()
+        # Inside the dataplane serving gate (chunk <= 64 KiB at k=3),
+        # so a non-zero worker routes the encode over the shm ring.
+        r = c.put(f"/fdbkt/flt-{i}", data=os.urandom(120_000))
+        assert r.status_code == 200, r.text
+        w = r.headers.get("X-Mtpu-Worker", "0")
+        if w != "0":
+            rid, wid = r.headers["x-amz-request-id"], int(w)
+            break
+    assert rid, "router never placed a PUT on a non-zero worker"
+    found = None
+    deadline = time.monotonic() + 20
+    while found is None and time.monotonic() < deadline:
+        # Fresh connections round-robin, so this interrogates BOTH
+        # workers; each must answer for the whole pool via the spools.
+        r = fd.client().get("/minio/admin/v3/perf/timeline",
+                            query={"traceid": rid, "all": "false"})
+        assert r.status_code == 200, r.text
+        tls = [s for s in r.json()["timelines"]
+               if s["trace_id"] == rid and s["api"] == "PutObject"]
+        if tls:
+            found = tls[0]
+            break
+        time.sleep(0.25)
+    assert found, f"timeline for {rid} not queryable from the pool"
+    assert found["worker"] == wid
+    stages = {s["stage"] for s in found["stages"]}
+    assert {"auth", "rx_drain", "encode", "commit",
+            "resp_drain"} <= stages, stages
+    seq = sum(s["dur_ns"] for s in found["stages"] if s["seq"])
+    assert abs(seq - found["e2e_ns"]) <= 0.1 * found["e2e_ns"], (
+        seq, found["e2e_ns"])
 
 
 def test_put_get_bitexact_vs_single_process_oracle(fd, client, bucket):

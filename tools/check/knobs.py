@@ -120,6 +120,24 @@ KNOB_DOCS: dict[str, tuple[str, str]] = {
         "`1` opts this PROCESS into the admin faultplane handlers — "
         "beyond admin:* policy, because the faultplane can sever a "
         "production cluster."),
+    "MTPU_FLIGHT": (
+        "TRACING.md",
+        "`0`/`false`/`off` disarms the per-request flight recorder; "
+        "armed (default) every request keeps a stage timeline, "
+        "queryable via `GET /minio/admin/v3/perf/timeline`."),
+    "MTPU_FLIGHT_RING": (
+        "TRACING.md",
+        "Flight-recorder ring depth: the last N completed request "
+        "timelines kept per process (default 256)."),
+    "MTPU_FLIGHT_SPOOL": (
+        "TRACING.md",
+        "Flight-spool shm base name, stamped into workers by the "
+        "front-door supervisor; worker i writes snapshots into "
+        "`<base>w<i>` so any worker can answer for the pool."),
+    "MTPU_FLIGHT_WORST": (
+        "TRACING.md",
+        "Slowest-N board depth: how many worst-case timelines the "
+        "flight recorder retains per API (default 8)."),
     "MTPU_FRONTDOOR_CONTROL": (
         "FRONTDOOR.md",
         "Router control-socket path, stamped into workers by the "
