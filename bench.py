@@ -1576,6 +1576,238 @@ def bench_chaos_smoke() -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+# Aggressor client process for bench_qos_fairness: unpaced PUT-only
+# threads against one bucket, code counts as JSON on stdout. A separate
+# process per aggressor keeps its CPU off the victims' GIL so the storm
+# can genuinely out-offer the front door.
+_QOS_AGG_SCRIPT = r"""
+import json, os, sys, threading, time
+sys.path.insert(0, os.getcwd())
+from tests.s3client import SigV4Client
+base, ak, sk, bucket = sys.argv[1:5]
+n, secs, size = int(sys.argv[5]), float(sys.argv[6]), int(sys.argv[7])
+codes, mu, stop = {}, threading.Lock(), threading.Event()
+def worker(wid):
+    c = SigV4Client(base, ak, sk)
+    body = os.urandom(size)
+    i = 0
+    while not stop.is_set():
+        i += 1
+        key = "/%s/p%d-w%d-k%d" % (bucket, os.getpid(), wid, i % 4)
+        try:
+            sc = c.put(key, data=body, timeout=30).status_code
+        except Exception:
+            sc = 599
+        with mu:
+            codes[sc] = codes.get(sc, 0) + 1
+        if sc == 503:
+            stop.wait(0.5)  # SlowDown contract: back off, then retry
+ts = [threading.Thread(target=worker, args=(w,)) for w in range(n)]
+for t in ts: t.start()
+time.sleep(secs)
+stop.set()
+for t in ts: t.join(60)
+print(json.dumps(codes))
+"""
+
+
+def bench_qos_fairness() -> dict:
+    """Per-tenant QoS fairness (docs/QOS.md): aggressor + victim
+    tenants against the multi-process front door, armed (MTPU_QOS=1
+    with a per-tenant ops quota) vs disarmed — per-tenant ops/s, client
+    p99, and quota-shed counts from the metrics scrape. The armed
+    victim must retain >=0.5x its unloaded ops/s through the storm;
+    the disarmed run records how far the same storm drags victims when
+    admission cannot tell tenants apart."""
+    import shutil
+    import subprocess
+    import threading
+
+    from minio_tpu.chaos import invariants
+    from minio_tpu.frontdoor.supervisor import Supervisor
+    from tests.conftest import free_port
+    from tests.s3client import SigV4Client
+
+    ak, sk = "benchak00", "benchsk00secret0"
+    agg_bkt, vic_bkts = "qosagg", ("qosvic1", "qosvic2")
+    unloaded_s, storm_s = 5.0, 8.0
+
+    def run_fleet(base, bucket, threads, pace, seconds, puts_only=False,
+                  size=8 << 10):
+        """Closed-loop per-tenant clients: paced PUT(+GET) ticks.
+        Returns {"ops": n_2xx, "n5xx": n, "p99_ms": client p99}."""
+        lats: list[float] = []
+        codes: dict[int, int] = {}
+        mu = threading.Lock()
+        stop = threading.Event()
+
+        def worker(wid: int) -> None:
+            c = SigV4Client(base, ak, sk)
+            body = os.urandom(size)
+            if pace:  # stagger so the first tick isn't one burst
+                stop.wait(pace * (wid % 8) / 8)
+            i = 0
+            while not stop.is_set():
+                i += 1
+                key = f"/{bucket}/w{wid}-k{i % 4}"
+                t0 = time.perf_counter()
+                try:
+                    r = c.put(key, data=body, timeout=30)
+                    sc = r.status_code
+                    if sc == 200 and not puts_only:
+                        sc = c.get(key, timeout=30).status_code
+                except Exception:  # noqa: BLE001 - count as transport err
+                    sc = 599
+                dt = time.perf_counter() - t0
+                with mu:
+                    codes[sc] = codes.get(sc, 0) + 1
+                    if sc == 200:
+                        lats.append(dt)
+                if pace:
+                    stop.wait(pace)
+
+        ts = [threading.Thread(target=worker, args=(w,))
+              for w in range(threads)]
+        for t in ts:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in ts:
+            t.join(60)
+        lats.sort()
+        return {"ops": sum(n for c, n in codes.items() if c < 300),
+                "n5xx": sum(n for c, n in codes.items()
+                            if 500 <= c < 600),
+                "p99_ms": round(lats[int(0.99 * (len(lats) - 1))] * 1e3, 1)
+                if lats else 0.0}
+
+    def run_mode(armed: bool) -> dict:
+        root = _bench_root()
+        port = free_port()
+        # The contended resource is the per-drive WAL commit queue: the
+        # fsync hold models durable-media fsync latency (the bench root
+        # is tmpfs, where fsync is free and no queue ever forms), and
+        # MAX_BATCH=1 makes every commit pay it, so the committer is a
+        # fixed-rate server and admission ORDER is what decides victim
+        # latency. Disarmed, the queue is FIFO: a victim's commit waits
+        # behind every in-flight aggressor record (collapse is
+        # queue-wait, not errors). Armed, the DRR queue pops each
+        # tenant's lane at its share — a victim record overtakes the
+        # aggressor backlog — and the ops quota sheds the rest of the
+        # storm as 503 SlowDown.
+        env = {"MTPU_ROOT_USER": ak, "MTPU_ROOT_PASSWORD": sk,
+               "MTPU_JAX_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
+               "MTPU_METAPLANE": "1", "MTPU_BATCHED_DATAPLANE": "1",
+               "MTPU_WAL_TEST_HOLD_FSYNC_S": "0.02",
+               "MTPU_WAL_MAX_BATCH": "1",
+               "MTPU_WAL_QUEUE": "256"}
+        # Quota sized to trip WITHIN the storm window: the closed-loop
+        # aggressor lands ~60 submits/s per queue, so 25 ops/s with a
+        # 1-s burst drains its bucket in under a second and the rest of
+        # the storm sheds as SlowDown — which is ALSO what relieves the
+        # worker's event loop (shed clients back off instead of
+        # occupying rx_drain), the one resource DRR cannot schedule.
+        if armed:
+            env.update({"MTPU_QOS": "1", "MTPU_QOS_RATE_OPS": "25",
+                        "MTPU_QOS_BURST_S": "1",
+                        "MTPU_QOS_MIN_SHARE": "4"})
+        sup = Supervisor([os.path.join(root, f"d{i}") for i in range(4)],
+                         f"127.0.0.1:{port}", workers=1, parity=1,
+                         shared_lanes=False, log_dir=root, env=env)
+        sup.start()
+        base = f"http://127.0.0.1:{port}"
+        c = SigV4Client(base, ak, sk)
+        try:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                try:
+                    if c.get("/minio/health/live",
+                             timeout=5).status_code == 200:
+                        break
+                except Exception:  # noqa: BLE001 - boot poll
+                    pass
+                time.sleep(0.2)
+            for b in (agg_bkt, *vic_bkts):
+                assert c.put(f"/{b}").status_code in (200, 409)
+
+            # Unloaded: victims alone, paced well under the quota.
+            un: list[dict] = []
+            ths = [threading.Thread(
+                target=lambda b=b: un.append(
+                    run_fleet(base, b, 3, 0.3, unloaded_s)))
+                for b in vic_bkts]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+
+            # Storm: same victim load + an aggressor made of CLIENT
+            # PROCESSES (in-process threads share the bench GIL and
+            # cannot out-offer the server; real noisy neighbors do).
+            before = invariants.parse_exposition(
+                c.get("/minio/v2/metrics/node", timeout=15).text)
+            st: list[dict] = []
+            procs = [subprocess.Popen(
+                [sys.executable, "-c", _QOS_AGG_SCRIPT, base, ak, sk,
+                 agg_bkt, "32", str(storm_s), str(8 << 10)],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                stdout=subprocess.PIPE, text=True)
+                for _ in range(2)]
+            ths = [threading.Thread(
+                target=lambda b=b: st.append(
+                    run_fleet(base, b, 3, 0.3, storm_s)))
+                for b in vic_bkts]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            agg_codes: dict[int, int] = {}
+            for p in procs:
+                out_s, _ = p.communicate(timeout=120)
+                for k, v in json.loads(out_s or "{}").items():
+                    agg_codes[int(k)] = agg_codes.get(int(k), 0) + v
+            window = invariants.delta(invariants.parse_exposition(
+                c.get("/minio/v2/metrics/node", timeout=15).text), before)
+
+            vic_un_ops = sum(f["ops"] for f in un) / unloaded_s
+            vic_st_ops = sum(f["ops"] for f in st) / storm_s
+            return {
+                "vic_unloaded_ops_s": round(vic_un_ops, 1),
+                "vic_storm_ops_s": round(vic_st_ops, 1),
+                "vic_retention": round(vic_st_ops / vic_un_ops, 3)
+                if vic_un_ops else 0.0,
+                "vic_unloaded_p99_ms": max(f["p99_ms"] for f in un),
+                "vic_storm_p99_ms": max(f["p99_ms"] for f in st),
+                "vic_5xx": sum(f["n5xx"] for f in st),
+                "agg_ops_s": round(sum(
+                    n for sc, n in agg_codes.items()
+                    if sc < 300) / storm_s, 1),
+                "agg_5xx": sum(n for sc, n in agg_codes.items()
+                               if 500 <= sc < 600),
+                "quota_sheds": invariants.counter_sum(
+                    window, "minio_tpu_admission_shed_total",
+                    {"cause": "tenant_quota"}),
+                "total_sheds": invariants.counter_sum(
+                    window, "minio_tpu_admission_shed_total", {}),
+            }
+        finally:
+            sup.drain()
+            shutil.rmtree(root, ignore_errors=True)
+
+    armed = run_mode(True)
+    disarmed = run_mode(False)
+    out = {"metric": "qos_fairness", "unit": "ratio",
+           "value": armed["vic_retention"],
+           "vs_baseline": disarmed["vic_retention"],
+           "fair": armed["vic_retention"] >= 0.5
+           and armed["vic_5xx"] == 0
+           and disarmed["vic_retention"] < armed["vic_retention"],
+           "quota": "25 ops/s per queue, burst 1s, min_share 4"}
+    out.update({f"armed_{k}": v for k, v in armed.items()})
+    out.update({f"disarmed_{k}": v for k, v in disarmed.items()})
+    return out
+
+
 def _batched_dataplane_measure() -> dict:
     """The batched_dataplane measurement body (run in THIS process's
     device topology; bench_batched_dataplane picks the topology)."""
@@ -2060,6 +2292,7 @@ def main() -> int:
             ("stage_breakdown", bench_stage_breakdown),
             ("check_overhead", bench_check_overhead),
             ("chaos_smoke", bench_chaos_smoke),
+            ("qos_fairness", bench_qos_fairness),
         ]
         if use_pallas:
             plans.insert(1, ("encode_pallas",

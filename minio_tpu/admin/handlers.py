@@ -143,7 +143,8 @@ class AdminAPI:
             self._authorize(identity, "admin:ServerInfo")
             params = {"traceid": q.get("traceid", ""),
                       "api": q.get("api", ""),
-                      "worst": q.get("worst", "")}
+                      "worst": q.get("worst", ""),
+                      "tenant": q.get("tenant", "")}
             out = await run(self._perf_timelines, params)
             notif = getattr(self.s, "notification", None)
             if (q.get("all", "true") != "false" and notif is not None
@@ -595,7 +596,8 @@ class AdminAPI:
         return {"node": obs.current_node(),
                 "timelines": flight.collect(
                     str(params.get("traceid") or ""),
-                    str(params.get("api") or ""), worst)}
+                    str(params.get("api") or ""), worst,
+                    str(params.get("tenant") or ""))}
 
     async def _bus_stream(self, request, bus, peer_stream: str = "",
                           all_nodes: bool = True,

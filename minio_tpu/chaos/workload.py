@@ -47,6 +47,10 @@ class FleetStats:
         self.errors: dict[str, int] = {}
         self.latencies: dict[str, list[float]] = {}
         self.violations: list[str] = []
+        # HTTP status histogram across every response the fleet saw
+        # (including intermediate multipart calls) — the per-tenant QoS
+        # gates count 5xx/503 from here without scraping the server.
+        self.codes: dict[int, int] = {}
 
     def record(self, kind: str, dt: float, ok: bool) -> None:
         with self.mu:
@@ -54,6 +58,15 @@ class FleetStats:
             self.latencies.setdefault(kind, []).append(dt)
             if not ok:
                 self.errors[kind] = self.errors.get(kind, 0) + 1
+
+    def status(self, code: int) -> None:
+        with self.mu:
+            self.codes[code] = self.codes.get(code, 0) + 1
+
+    def count_code(self, lo: int, hi: int) -> int:
+        """Responses with lo <= status < hi (e.g. 500, 600 for 5xx)."""
+        with self.mu:
+            return sum(n for c, n in self.codes.items() if lo <= c < hi)
 
     def violation(self, msg: str) -> None:
         with self.mu:
@@ -85,7 +98,35 @@ class FleetStats:
         with self.mu:
             return {"ops": dict(self.ops), "errors": dict(self.errors),
                     "violations": list(self.violations),
+                    "codes": dict(self.codes),
                     "p99_s": round(p99, 3)}
+
+
+class _StatusClient:
+    """Transport wrapper: mirrors every response's status code into
+    FleetStats (including intermediate multipart calls), so SLO gates
+    can count 5xx without instrumenting each op implementation."""
+
+    def __init__(self, inner, stats: FleetStats):
+        self._inner = inner
+        self._stats = stats
+
+    def _call(self, name, *a, **kw):
+        r = getattr(self._inner, name)(*a, **kw)
+        self._stats.status(r.status_code)
+        return r
+
+    def put(self, *a, **kw):
+        return self._call("put", *a, **kw)
+
+    def get(self, *a, **kw):
+        return self._call("get", *a, **kw)
+
+    def delete(self, *a, **kw):
+        return self._call("delete", *a, **kw)
+
+    def post(self, *a, **kw):
+        return self._call("post", *a, **kw)
 
 
 class MixedWorkload:
@@ -256,7 +297,7 @@ class MixedWorkload:
 
     def _worker(self, wid: int) -> None:
         rng = random.Random(subseed(self.seed, f"worker-{wid}"))
-        client = self.factory()
+        client = _StatusClient(self.factory(), self.stats)
         # Worker-local candidate map (keys are worker-owned): key ->
         # set of legal read outcomes (digests / None for absent).
         cand: dict[str, set] = {}

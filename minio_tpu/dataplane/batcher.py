@@ -46,6 +46,7 @@ from minio_tpu.dataplane import ring
 from minio_tpu import obs
 from minio_tpu.obs import flight
 from minio_tpu.obs import kernel as obs_kernel
+from minio_tpu import qos
 from minio_tpu.utils import admission
 from minio_tpu.utils import errors as se
 
@@ -97,7 +98,7 @@ class CodecRequest:
     completion thread, and the future request threads wait on."""
 
     __slots__ = ("base", "rows", "stage", "finish", "future", "t_submit",
-                 "trace_id", "tl")
+                 "trace_id", "tl", "tenant")
 
     def __init__(self, base: _BaseKey, rows: int, stage, finish):
         self.base = base
@@ -111,6 +112,11 @@ class CodecRequest:
         # dispatcher/completion threads (which have no request context).
         self.trace_id = obs.trace_id()
         self.tl = flight.current()
+        # QoS attribution: whose lane slots this work consumes. Captured
+        # at construction like the trace id — worker 0's coalesced lanes
+        # schedule rows by this key even when the submitting context is
+        # a ring worker restoring identity from the slot header.
+        self.tenant = qos.current_key()
 
 
 class _OpenBatch:
@@ -225,7 +231,15 @@ class BatchPlane:
             env("MTPU_DP_QUEUE", str(DEFAULT_QUEUE_CAP)))
         depth = ring_depth if ring_depth is not None else int(
             env("MTPU_DP_RING_DEPTH", str(DEFAULT_RING_DEPTH)))
-        self._q: queue.Queue = queue.Queue(maxsize=cap)
+        # Admission queue: plain bounded queue, or a tenant-fair DRR
+        # queue when the QoS plane is armed (MTPU_QOS=1). Cost model:
+        # rows x block width ~ staged bytes, so byte quotas meter real
+        # lane occupancy, not request counts.
+        self._q = qos.plane_queue(
+            "dataplane", cap,
+            tenant_of=lambda r: r.tenant,
+            cost_of=lambda r: r.rows * max(1, r.base[3]),
+            is_control=lambda it: it is _CLOSE)
         self._done_q: queue.Queue = queue.Queue()
         self._rings = ring.RingPool(depth=depth)
         self._open: dict[_BaseKey, _OpenBatch] = {}  # dispatcher-only
@@ -532,19 +546,25 @@ class BatchPlane:
 
     def _submit(self, req: CodecRequest) -> None:
         if self._closed:
-            raise se.OperationTimedOut(msg="batched dataplane is closed")
+            raise admission.shed(
+                "dataplane", "closed", "batched dataplane is closed")
         if self._broken is not None:
             raise se.OperationTimedOut(
                 msg=f"batched dataplane failed: {self._broken}")
         try:
             self._q.put_nowait(req)
-        except queue.Full:
+        except queue.Full as e:
             with self._close_mu:  # rejected count: cross-thread writes
                 self._stats["rejected"] += 1
             obs_kernel.dataplane_rejected(req.base.op)
             # Unified admission: a full lane sheds exactly like a full
             # WAL queue — OperationTimedOut -> 503 SlowDown, one shared
-            # shed family (utils/admission.py).
+            # shed family (utils/admission.py). A QoS token-bucket
+            # reject is the same wire contract, distinct cause slug.
+            if isinstance(e, qos.QuotaFull):
+                raise admission.shed(
+                    "dataplane", "tenant_quota",
+                    "tenant over dataplane rate quota") from None
             raise admission.shed(
                 "dataplane", "lane_full",
                 "batched dataplane saturated (bounded queue full)"

@@ -25,7 +25,7 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
-from minio_tpu import obs
+from minio_tpu import obs, qos
 from minio_tpu.frontdoor import shm
 from minio_tpu.obs import flight
 
@@ -232,6 +232,12 @@ class LaneClient:
         self._degraded_until = 0.0
         self._timeout = shm.ring_timeout_s()
         self._wlabel = str(worker)
+        # QoS (MTPU_QOS=1): per-tenant OP_HOTGET ring admission — a
+        # tenant over its probe quota or slot share is denied the RING,
+        # not the request (the local drive path still serves), so the
+        # degradation is the existing fallback, reason "qos". None when
+        # disarmed.
+        self._hotget_gate = qos.ring_gate(max(1, self._hi - self._lo))
         self.closed = False
 
     # -- local-plane delegation ----------------------------------------
@@ -340,7 +346,8 @@ class LaneClient:
         slot, seq = got
         req_len = shm.pack_chunks(self.ring.req_view(slot), chunks)
         self.ring.publish(slot, shm.OP_DIGEST, 0, 0, 0, seq,
-                          len(chunks), req_len, self._tid())
+                          len(chunks), req_len, self._tid(),
+                          qos.tenant_tag())
         _RING_SUBMITS.labels(worker=self._wlabel, op="digest").inc()
         resp = self._await_slot(slot, seq)
         if resp is None:
@@ -403,7 +410,8 @@ class LaneClient:
         req_len = shm.pack_chunks(self.ring.req_view(slot), chunks)
         flags = shm.FLAG_DIGESTS if with_digests else 0
         self.ring.publish(slot, shm.OP_RECONSTRUCT, flags, k, m, seq,
-                          len(chunks), req_len, self._tid())
+                          len(chunks), req_len, self._tid(),
+                          qos.tenant_tag())
         _RING_SUBMITS.labels(worker=self._wlabel, op="reconstruct").inc()
         return _PendingRingReconstruct(self, slot, seq, k, m, block_size,
                                        shard_chunks, block_lens, targets,
@@ -430,7 +438,8 @@ class LaneClient:
         req_len = shm.pack_chunks(self.ring.req_view(slot), blocks)
         flags = shm.FLAG_DIGESTS if with_digests else 0
         self.ring.publish(slot, shm.OP_ENCODE, flags, k, m, seq,
-                          len(blocks), req_len, self._tid())
+                          len(blocks), req_len, self._tid(),
+                          qos.tenant_tag())
         _RING_SUBMITS.labels(worker=self._wlabel, op="encode").inc()
         return _PendingRingEncode(self, slot, seq, k, m, block_size,
                                   blocks, with_digests)
@@ -450,16 +459,25 @@ class LaneClient:
                 or length > self.ring.resp_cap):
             self._note_fallback(shm.REASON_OVERSIZE)
             return None
-        got = self._acquire()
-        if got is None:
-            self._note_fallback(shm.REASON_NO_SLOT)
+        gate = self._hotget_gate
+        tkey = qos.current_key() if gate is not None else ""
+        if gate is not None and not gate.acquire(tkey):
+            self._note_fallback(shm.REASON_QOS)
             return None
-        slot, seq = got
-        req_len = shm.pack_chunks(self.ring.req_view(slot), [meta])
-        self.ring.publish(slot, shm.OP_HOTGET, 0, 0, 0, seq, 1, req_len,
-                          self._tid())
-        _RING_SUBMITS.labels(worker=self._wlabel, op="hotget").inc()
-        resp = self._await_slot(slot, seq)
+        try:
+            got = self._acquire()
+            if got is None:
+                self._note_fallback(shm.REASON_NO_SLOT)
+                return None
+            slot, seq = got
+            req_len = shm.pack_chunks(self.ring.req_view(slot), [meta])
+            self.ring.publish(slot, shm.OP_HOTGET, 0, 0, 0, seq, 1,
+                              req_len, self._tid(), qos.tenant_tag())
+            _RING_SUBMITS.labels(worker=self._wlabel, op="hotget").inc()
+            resp = self._await_slot(slot, seq)
+        finally:
+            if gate is not None:
+                gate.release(tkey)
         if resp is None or len(resp) != length:
             self._note_fallback(shm.REASON_HOT_MISS)
             return None
@@ -562,17 +580,21 @@ class LaneServer:
 
     def _serve_slot(self, i: int) -> None:
         try:
-            st, op, flags, k, m, seq, rows, req_len, _rl, _rs, tid_raw = \
-                self.ring.head(i)
+            (st, op, flags, k, m, seq, rows, req_len, _rl, _rs, tid_raw,
+             ten_raw) = self.ring.head(i)
             if st != shm.SUBMITTED:
                 return
-            # Restore the submitting worker's trace context from the
-            # slot header: trace records and the server-side timeline
-            # below attribute to the ORIGINATING request, not to the
-            # lane owner's scanner thread.
+            # Restore the submitting worker's trace AND tenant context
+            # from the slot header: trace records and the server-side
+            # timeline below attribute to the ORIGINATING request, not
+            # to the lane owner's scanner thread — and the CodecRequests
+            # this serve submits into the local plane carry the
+            # originating tenant, so QoS charges the right lane.
             tid = shm.decode_tid(tid_raw)
+            tenant = shm.decode_tenant(ten_raw)
             opname = _OP_NAMES.get(op, "unknown")
             tok = obs.set_trace_context(tid) if tid else None
+            qtok = qos.bind_key(tenant) if tenant else None
             tl = flight.detached(tid, f"ring:{opname}") if tid else None
             t0 = time.perf_counter()
             ok = True
@@ -614,8 +636,11 @@ class LaneServer:
                                  "op": opname, "slot": i,
                                  "rows": rows, "ok": ok,
                                  "worker": self._wlabel,
+                                 "tenant": tenant,
                                  "time": time.time(),
                                  "durationNs": int(dur * 1e9)})
+                if qtok is not None:
+                    qos.reset(qtok)
                 if tok is not None:
                     obs.reset_trace_context(tok)
         finally:

@@ -43,15 +43,20 @@ import os
 import struct
 import time
 
-MAGIC = b"MTPUFDR2"   # v2: slot header carries the originating trace id
+MAGIC = b"MTPUFDR3"   # v3: slot header carries trace id + tenant tag
 _HDR = struct.Struct("<8sII")       # magic, nslots, slot_bytes
 _HDR_SIZE = 64
 # state, op, flags, k, m, pad, seq, rows, req_len, resp_len, resp_seq,
 # trace id (16 ASCII bytes, NUL-padded — the S3 request id of the
 # submitting worker's request, so the lane server's batch/ring records
-# attribute cross-process work to the originating request).
-_SLOT = struct.Struct("<BBBBBxxxQIIIQ16s")
+# attribute cross-process work to the originating request), tenant tag
+# (12 utf-8 bytes, NUL-padded — the originating tenant's key, truncated;
+# worker 0 rebinds it before submitting into its local plane so the QoS
+# scheduler charges ring work to the right lane). Exactly fills the
+# 64-byte slot header.
+_SLOT = struct.Struct("<BBBBBxxxQIIIQ16s12s")
 _SLOT_SIZE = 64
+assert _SLOT.size == _SLOT_SIZE
 
 FREE, SUBMITTED, DONE, ERROR, ABANDONED = 0, 1, 2, 3, 4
 # OP_RECONSTRUCT (PR 12): heal/degraded-GET rebuilds ride the ring too
@@ -89,11 +94,13 @@ REASON_OVERSIZE = "oversize"    # op exceeds the slot request area
 REASON_NO_SLOT = "no_slot"      # worker's slot range fully in flight
 REASON_TIMEOUT = "timeout"      # server missed the slot deadline
 REASON_HOT_MISS = "hot_miss"    # hot-tier probe answered ERROR (miss)
+REASON_QOS = "qos"              # tenant over its ring share/quota
 RING_FALLBACK_REASONS = {
     "REASON_OVERSIZE": REASON_OVERSIZE,
     "REASON_NO_SLOT": REASON_NO_SLOT,
     "REASON_TIMEOUT": REASON_TIMEOUT,
     "REASON_HOT_MISS": REASON_HOT_MISS,
+    "REASON_QOS": REASON_QOS,
 }
 
 _U32 = struct.Struct("<I")
@@ -188,7 +195,7 @@ class Ring:
 
     def head(self, i: int) -> tuple:
         """(state, op, flags, k, m, seq, rows, req_len, resp_len,
-        resp_seq, tid)"""
+        resp_seq, tid, tenant)"""
         return _SLOT.unpack_from(self.buf, self._off(i))
 
     def state(self, i: int) -> int:
@@ -207,12 +214,13 @@ class Ring:
 
     def publish(self, i: int, op: int, flags: int, k: int, m: int,
                 seq: int, rows: int, req_len: int,
-                tid: bytes = b"") -> None:
+                tid: bytes = b"", tenant: bytes = b"") -> None:
         """Producer: header first (state FREE), then the state byte —
         the SUBMITTED store is the commit point. `tid` is the
-        originating request's trace id (≤16 ASCII bytes)."""
+        originating request's trace id (≤16 ASCII bytes); `tenant` the
+        originating tenant key tag (≤12 utf-8 bytes)."""
         _SLOT.pack_into(self.buf, self._off(i), FREE, op, flags, k, m,
-                        seq, rows, req_len, 0, 0, tid[:16])
+                        seq, rows, req_len, 0, 0, tid[:16], tenant[:12])
         self._set_state(i, SUBMITTED)
 
     def respond(self, i: int, seq: int, resp_len: int, ok: bool) -> bool:
@@ -220,14 +228,14 @@ class Ring:
         (state, seq) so a response never lands on a slot the producer
         has already abandoned/reused; echoes seq as resp_seq."""
         off = self._off(i)
-        st, op, flags, k, m, cur_seq, rows, req_len, _rl, _rs, tid = \
+        st, op, flags, k, m, cur_seq, rows, req_len, _rl, _rs, tid, ten = \
             _SLOT.unpack_from(self.buf, off)
         if st != SUBMITTED or cur_seq != seq:
             if st == ABANDONED and cur_seq == seq:
                 self._set_state(i, FREE)
             return False
         _SLOT.pack_into(self.buf, off, SUBMITTED, op, flags, k, m,
-                        seq, rows, req_len, resp_len, seq, tid)
+                        seq, rows, req_len, resp_len, seq, tid, ten)
         self._set_state(i, DONE if ok else ERROR)
         return True
 
@@ -280,6 +288,11 @@ def chunks_size(chunks) -> int:
 def decode_tid(tid: bytes) -> str:
     """Slot-header trace id bytes -> trace id string ('' when absent)."""
     return tid.rstrip(b"\x00").decode("ascii", "replace")
+
+
+def decode_tenant(ten: bytes) -> str:
+    """Slot-header tenant tag bytes -> tenant key ('' when absent)."""
+    return ten.rstrip(b"\x00").decode("utf-8", "replace")
 
 
 # -- flight-recorder spool ----------------------------------------------

@@ -44,7 +44,7 @@ import time
 from collections import OrderedDict
 from concurrent.futures import Future
 
-from minio_tpu import metaplane, obs
+from minio_tpu import metaplane, obs, qos
 from minio_tpu.metaplane import wal as walfmt
 from minio_tpu.obs import flight
 from minio_tpu.utils import admission
@@ -79,6 +79,21 @@ _seq = 0
 # instead of refusing with a duplicate-owner error.
 _live_mu = threading.Lock()
 _live_by_path: dict = {}
+
+
+def _wal_cost(item) -> int:
+    """Byte cost of one WAL submit for QoS byte quotas: the serialized
+    payload length (index 3 across every record shape; "single" nests
+    the raw journal at payload[1])."""
+    raw = item[3]
+    if isinstance(raw, tuple):
+        raw = raw[1] if len(raw) > 1 else None
+    if raw is None:
+        return 0
+    try:
+        return len(raw)
+    except TypeError:
+        return 0
 
 
 def _next_seq() -> int:
@@ -361,7 +376,19 @@ class DriveWAL:
             os.fsync(self._fd)
         self._bytes = os.fstat(self._fd).st_size
 
-        self._q: queue.Queue = queue.Queue(maxsize=metaplane.wal_queue_depth())
+        # Admission queue: plain bounded queue, or a tenant-fair DRR
+        # queue when the QoS plane is armed (MTPU_QOS=1). The tenant
+        # key rides the item's Future (attached in _submit, like
+        # mtpu_fctx); byte quotas meter the serialized payload — the
+        # blob lane's large sys-files count at full weight. flush/close
+        # barriers are control items: admitted unconditionally, and the
+        # fair queue releases them only after everything enqueued
+        # before them, preserving the flush contract under reordering.
+        self._q = qos.plane_queue(
+            "metaplane", metaplane.wal_queue_depth(),
+            tenant_of=lambda it: getattr(it[-1], "mtpu_tenant", None),
+            cost_of=_wal_cost,
+            is_control=lambda it: it[0] in ("flush", "close"))
         self._mu = threading.Lock()  # pending overlay + key lsn map
         self._pending: "OrderedDict[tuple[str, str], Entry]" = OrderedDict()
         self._key_lsn: "OrderedDict[tuple[str, str], int]" = OrderedDict()
@@ -442,14 +469,23 @@ class DriveWAL:
         tl = flight.current()
         if tid is not None or tl is not None:
             item[-1].mtpu_fctx = (tid, tl, time.perf_counter())
+        tenant = qos.current_key()
+        if tenant != qos.UNATTRIBUTED:
+            item[-1].mtpu_tenant = tenant
         try:
             self._q.put_nowait(item)
-        except queue.Full:
+        except queue.Full as e:
             # Unified admission: a full WAL queue sheds exactly like a
             # full dataplane lane — OperationTimedOut -> 503 SlowDown,
             # one shared shed family (utils/admission.py). Quorum
             # reducers raise the dominant error, so a set whose drives
-            # all shed surfaces SlowDown, never a 500.
+            # all shed surfaces SlowDown, never a 500. A QoS
+            # token-bucket reject is the same wire contract, distinct
+            # cause slug.
+            if isinstance(e, qos.QuotaFull):
+                raise admission.shed(
+                    "metaplane", "tenant_quota",
+                    "tenant over wal rate quota") from None
             raise admission.shed(
                 "metaplane", "wal_full",
                 "wal commit queue full (backpressure)") from None
@@ -759,7 +795,11 @@ class DriveWAL:
         # link the group's members in one `batch` record.
         t_ack = time.perf_counter()
         members = []
+        tenants = set()
         for rec in staged:
+            ten = getattr(rec[7], "mtpu_tenant", None)
+            if ten:
+                tenants.add(ten)
             fctx = getattr(rec[7], "mtpu_fctx", None)
             if fctx is None:
                 continue
@@ -771,6 +811,7 @@ class DriveWAL:
         if obs.has_subscribers():
             obs.publish({"type": "batch", "plane": "metaplane",
                          "records": len(staged), "members": members,
+                         "tenants": sorted(tenants),
                          "time": time.time()})
         # Publish the overlay BEFORE resolving futures: the instant the
         # ack fires, a read must see the new state. Entries carry LSNs

@@ -75,13 +75,14 @@ class Timeline:
 
     allocated = 0  # class-level construction count (zero-overhead guard)
 
-    __slots__ = ("trace_id", "api", "_t0", "_cursor", "_stages",
+    __slots__ = ("trace_id", "api", "tenant", "_t0", "_cursor", "_stages",
                  "_done", "_lock")
 
     def __init__(self, trace_id: str, api: str = ""):
         Timeline.allocated += 1
         self.trace_id = trace_id
         self.api = api
+        self.tenant = ""
         now = time.perf_counter()
         self._t0 = now
         self._cursor = now
@@ -120,6 +121,7 @@ class Timeline:
         return {
             "trace_id": self.trace_id,
             "api": api,
+            "tenant": self.tenant,
             "node": _current_node(),
             "worker": _worker,
             "time": time.time(),
@@ -152,6 +154,12 @@ def set_api(api: str) -> None:
     tl = _tl.get()
     if tl is not None:
         tl.api = api
+
+
+def set_tenant(tenant: str) -> None:
+    tl = _tl.get()
+    if tl is not None:
+        tl.tenant = tenant
 
 
 def mark(stage: str, plane: str = "s3") -> None:
@@ -251,19 +259,21 @@ def reset() -> None:
 # --- query -------------------------------------------------------------------
 
 
-def _matches(snap: dict, traceid: str, api: str) -> bool:
+def _matches(snap: dict, traceid: str, api: str, tenant: str = "") -> bool:
     if traceid and snap.get("trace_id") != traceid:
         return False
     if api and snap.get("api") != api:
+        return False
+    if tenant and snap.get("tenant") != tenant:
         return False
     return True
 
 
 def query(snaps, traceid: str = "", api: str = "",
-          worst: int = 0) -> list[dict]:
-    """Filter + order an iterable of snapshots: trace-id/api exact
-    match; `worst` keeps the N slowest, else newest first."""
-    out = [s for s in snaps if _matches(s, traceid, api)]
+          worst: int = 0, tenant: str = "") -> list[dict]:
+    """Filter + order an iterable of snapshots: trace-id/api/tenant
+    exact match; `worst` keeps the N slowest, else newest first."""
+    out = [s for s in snaps if _matches(s, traceid, api, tenant)]
     if worst > 0:
         out.sort(key=lambda s: -s.get("e2e_ns", 0))
         return out[:worst]
@@ -272,7 +282,7 @@ def query(snaps, traceid: str = "", api: str = "",
 
 
 def snapshot(traceid: str = "", api: str = "",
-             worst: int = 0) -> list[dict]:
+             worst: int = 0, tenant: str = "") -> list[dict]:
     """This process's recorder contents, filtered."""
     with _mu:
         if worst > 0:
@@ -281,19 +291,19 @@ def snapshot(traceid: str = "", api: str = "",
             snaps = [s for board in boards for _, s in board]
         else:
             snaps = list(_ring)
-    return query(snaps, traceid, api, worst)
+    return query(snaps, traceid, api, worst, tenant)
 
 
 def collect(traceid: str = "", api: str = "",
-            worst: int = 0) -> list[dict]:
+            worst: int = 0, tenant: str = "") -> list[dict]:
     """Local recorder + sibling front-door workers' spools, filtered.
     Peer federation happens a layer up (admin/handlers.py), the same
     split /metrics/cluster uses."""
-    snaps = snapshot(traceid, api, worst)
+    snaps = snapshot(traceid, api, worst, tenant)
     reader = _sibling_reader
     if reader is not None:
         try:
-            snaps = query(snaps + reader(), traceid, api, worst)
+            snaps = query(snaps + reader(), traceid, api, worst, tenant)
         # mtpu: allow(MTPU003) - a sibling worker mid-respawn (its spool
         # gone or half-built) degrades the answer to local-only; the
         # query must still serve what this worker has.

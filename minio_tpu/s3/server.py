@@ -23,7 +23,7 @@ from typing import Iterator
 
 from aiohttp import web
 
-from minio_tpu import obs
+from minio_tpu import obs, qos
 from minio_tpu.obs import flight
 from minio_tpu.admin.configkv import ConfigSys
 from minio_tpu.admin.handlers import ADMIN_PREFIX, AdminAPI
@@ -84,6 +84,16 @@ _REQ_LATENCY = obs.histogram(
 _REQ_TTFB = obs.histogram(
     "minio_tpu_s3_ttfb_seconds",
     "Time to first response byte by API", ("api",))
+# Per-tenant SLO families (QoS plane, docs/QOS.md): tenant = the
+# "access_key/bucket" key bound in _dispatch. Always on — the noisy-
+# neighbor chaos gate reads scrape deltas of these to prove each
+# victim's p99/5xx held while an aggressor shed.
+_TENANT_LATENCY = obs.histogram(
+    "minio_tpu_tenant_request_seconds",
+    "End-to-end request latency by tenant", ("tenant",))
+_TENANT_REQS = obs.counter(
+    "minio_tpu_tenant_requests_total",
+    "Requests by tenant and status class", ("tenant", "code"))
 # Inline-object streams are plain list iterators (zero IO behind next()) —
 # the GET fast path detects them by type to drain on the event loop.
 _LIST_ITER = type(iter([]))
@@ -786,7 +796,10 @@ class S3Server:
             # Live API resolution: dispatch stamps request["api"] once it
             # classifies the call; the `top api` view reads it through
             # this getter so an in-flight request shows its real API.
-            api_get=lambda: request.get("api"))
+            api_get=lambda: request.get("api"),
+            # Same lazy contract for the tenant column: bound by
+            # dispatch after auth resolves the identity.
+            tenant_get=lambda: request.get("tenant"))
         request["mtpu-t0"] = t0
         resp = None
         canceled = False
@@ -839,6 +852,11 @@ class S3Server:
             self.stats.end(api, t0, status, rx=rx, tx=tx, canceled=canceled,
                            request_id=request_id)
             _REQ_LATENCY.labels(api=api).observe(dt)
+            tkey = request.get("tenant")
+            if tkey:
+                _TENANT_LATENCY.labels(tenant=tkey).observe(dt)
+                _TENANT_REQS.labels(
+                    tenant=tkey, code=f"{status // 100}xx").inc()
             # Streamed GETs stamp first-byte at header flush; everything
             # else flushes with the handler return, so TTFB == latency.
             ttfb = request.get("mtpu-ttfb")
@@ -1055,6 +1073,19 @@ class S3Server:
                 ANONYMOUS, sigv4.UNSIGNED_PAYLOAD, None)
 
         request["identity"] = identity
+        # Tenant identity (minio_tpu/qos): (access key, bucket), bound
+        # ONCE here next to the trace contextvar — every batch-plane
+        # submit, WAL record, shm ring slot and shed counter downstream
+        # attributes to it (the contextvar crosses executor hops via
+        # obs.ctx_wrap exactly like the trace id). The /minio/ admin
+        # and metrics planes stay on the unattributed system lane.
+        tpath = path.lstrip("/").split("/", 1)[0]
+        if not tpath.startswith("minio"):
+            qos.bind(getattr(identity, "access_key", "") or "anonymous",
+                     tpath)
+            tkey = qos.current_key()
+            request["tenant"] = tkey
+            flight.set_tenant(tkey)
         # Timeline: everything up to here (header parse + signature
         # verification + identity resolution) is the auth stage.
         flight.mark("auth")

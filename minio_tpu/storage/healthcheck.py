@@ -92,8 +92,12 @@ OP_CLASS = {
 
 # Errors that indict the DRIVE (unreachable/dying/stalled) — per-object
 # state (FileNotFound, VolumeExists, bitrot, unformatted) is normal
-# operation and counts as healthy contact.
+# operation and counts as healthy contact. AdmissionShed subclasses
+# OperationTimedOut but is policy backpressure (queue share / tenant
+# quota), not drive sickness — it reached the plane and was rejected on
+# purpose, so it must count as contact, never as a strike.
 _SYS_ERRORS = (se.DiskNotFound, se.FaultyDisk, se.OperationTimedOut)
+_BACKPRESSURE = (se.AdmissionShed,)
 
 _STATE = obs.gauge(
     "minio_tpu_drive_state",
@@ -294,9 +298,10 @@ class HealthChecker:
             # The watchdog already charged this op; a late return (even a
             # success) never clears the strike — the data path moved on.
             return
-        if err is None or not (isinstance(err, _SYS_ERRORS)
-                               or isinstance(err, OSError)):
-            # Success OR per-object state: healthy contact with the drive.
+        if err is None or isinstance(err, _BACKPRESSURE) or not (
+                isinstance(err, _SYS_ERRORS) or isinstance(err, OSError)):
+            # Success, per-object state, or an admission shed: all are
+            # healthy contact with the drive.
             self._deadlines[op.cls].log_success(now - op.armed_base)
             self._note_ok()
         else:

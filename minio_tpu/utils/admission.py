@@ -7,32 +7,60 @@ fills, the front door must DEGRADE, not buffer or deadlock, and it must
 degrade the same way regardless of which plane saturated: the submit is
 rejected with `OperationTimedOut`, which the S3 error map renders as
 503 SlowDown (the retryable S3 contract), and the shed is counted in
-ONE metric family keyed by (plane, cause) so operators see saturation
-as a single signal instead of two plane-specific dialects.
+ONE metric family keyed by (plane, cause, tenant) so operators see
+saturation as a single signal instead of two plane-specific dialects —
+and, since the QoS plane (minio_tpu/qos/), see WHO was shed.
 
-This module is deliberately tiny: it owns the shared metric and the
-error construction, nothing else — the planes keep their own queue
-mechanics.
+The slug vocabulary is closed (MTPU011): a shed site may only use a
+plane from ADMISSION_PLANES and a cause from ADMISSION_CAUSES. New
+slugs are added here — next to the registry the dashboards key on —
+not minted inline at call sites.
+
+This module is deliberately tiny: it owns the shared metric, the slug
+registries, and the error construction, nothing else — the planes keep
+their own queue mechanics.
 """
 
 from __future__ import annotations
 
-from minio_tpu import obs
+from minio_tpu import obs, qos
 from minio_tpu.utils import errors as se
+
+# Closed registries (MTPU011). Every shed() call site must pass literal
+# members; tools/check/rules/mtpu011_admission.py parses these without
+# importing and flags unregistered slugs at the call site.
+ADMISSION_PLANES = frozenset({
+    "dataplane",    # batched device lanes (dataplane/batcher.py)
+    "metaplane",    # WAL group commit, incl. blob lane (groupcommit.py)
+})
+
+ADMISSION_CAUSES = frozenset({
+    "lane_full",       # dataplane submission queue at capacity/share
+    "wal_full",        # WAL commit queue at capacity/share
+    "wal_flush_full",  # flush barrier could not even be enqueued
+    "closed",          # plane shut down; submit arrived after close
+    "tenant_quota",    # per-tenant token bucket (qos) rejected the op
+})
 
 _SHED = obs.counter(
     "minio_tpu_admission_shed_total",
     "Requests shed at a full batch-plane admission queue "
     "(surfaces as 503 SlowDown)",
-    ("plane", "cause"))
+    ("plane", "cause", "tenant"))
 
 
-def shed(plane: str, cause: str, msg: str) -> se.OperationTimedOut:
+def shed(plane: str, cause: str, msg: str) -> se.AdmissionShed:
     """Count one shed and build the typed rejection. The caller raises
     the returned error (returning it keeps `raise ... from None` at the
     call site, where the queue.Full context lives).
 
-    plane: "dataplane" | "metaplane"; cause: a short stable slug
-    ("lane_full", "wal_full", "wal_flush_full", "closed")."""
-    _SHED.labels(plane=plane, cause=cause).inc()
-    return se.OperationTimedOut(msg=msg)
+    plane: an ADMISSION_PLANES member; cause: an ADMISSION_CAUSES
+    member. The tenant label comes from the request context ("-" for
+    unattributed work, e.g. internal maintenance submits).
+
+    The rejection is AdmissionShed, not bare OperationTimedOut: the
+    drive-health layer must see policy backpressure as healthy contact,
+    or one tenant's quota sheds would strike a shared drive OFFLINE and
+    fail every other tenant's quorum."""
+    _SHED.labels(plane=plane, cause=cause, tenant=qos.current_key()).inc()
+    return se.AdmissionShed(msg=msg)

@@ -161,6 +161,15 @@ class OperationTimedOut(ObjectError):
     pass
 
 
+class AdmissionShed(OperationTimedOut):
+    """A batch-plane admission rejection (utils/admission.shed): the
+    request was shed by policy — queue share, tenant quota, or plane
+    shutdown — not lost to a sick drive. Subclassing OperationTimedOut
+    keeps the S3 mapping (503 SlowDown) and every existing isinstance
+    site, while letting the drive-health layer exclude sheds from its
+    failure accounting: backpressure must never walk a drive OFFLINE."""
+
+
 # --- IAM / policy errors (reference cmd/iam-errors.go, pkg/iam/policy) ---
 
 

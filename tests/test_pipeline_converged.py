@@ -45,8 +45,9 @@ def _close_layer(es, drives):
         d.close_wal()
 
 
-def _shed_value(plane: str, cause: str) -> int:
-    return admission._SHED.labels(plane=plane, cause=cause).value
+def _shed_value(plane: str, cause: str, tenant: str = "-") -> int:
+    return admission._SHED.labels(plane=plane, cause=cause,
+                                  tenant=tenant).value
 
 
 # ---------------------------------------------------------------------------

@@ -60,11 +60,11 @@ def test_tree_is_clean():
         f"row from tools/check/baseline.json): {result.stale}")
 
 
-def test_all_ten_rules_registered():
+def test_all_rules_registered():
     rules = tc.all_rules()
     assert set(rules) == {"MTPU001", "MTPU002", "MTPU003", "MTPU004",
                           "MTPU005", "MTPU006", "MTPU007", "MTPU008",
-                          "MTPU009", "MTPU010"}
+                          "MTPU009", "MTPU010", "MTPU011"}
 
 
 # ---------------------------------------------------------------------------
@@ -1511,3 +1511,108 @@ def test_knob_docs_entries_all_render():
     rendered = set(scan_knobs(ProjectIndex.build(ROOT)))
     dead = sorted(set(KNOB_DOCS) - rendered)
     assert not dead, f"KNOB_DOCS entries no scan read matches: {dead}"
+
+
+# ---------------------------------------------------------------------------
+# MTPU011 — closed admission shed-slug vocabulary
+# ---------------------------------------------------------------------------
+
+_MTPU011_REGISTRY = {
+    "minio_tpu/utils/admission.py": """
+    ADMISSION_PLANES = frozenset({"dataplane", "metaplane"})
+    ADMISSION_CAUSES = frozenset({"lane_full", "wal_full"})
+
+    def shed(plane, cause, detail):
+        pass
+    """,
+}
+
+
+def test_mtpu011_unregistered_slugs(tmp_path):
+    src = """
+    from minio_tpu.utils import admission
+
+    def submit():
+        raise admission.shed("dataplane", "lane-full", "typo'd cause")
+
+    def submit2():
+        raise admission.shed("hotplane", "lane_full", "unknown plane")
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/plane.py", src, "MTPU011",
+                    extra=_MTPU011_REGISTRY)
+    assert len(r.new) == 2
+    assert any("'lane-full'" in f.message for f in r.new)
+    assert any("'hotplane'" in f.message for f in r.new)
+
+
+def test_mtpu011_non_literal_slug(tmp_path):
+    src = """
+    from minio_tpu.utils import admission
+
+    def submit(cause):
+        raise admission.shed("dataplane", cause, "dynamic slug")
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/plane.py", src, "MTPU011",
+                    extra=_MTPU011_REGISTRY)
+    assert len(r.new) == 1
+    assert "string literal" in r.new[0].message
+
+
+def test_mtpu011_registered_negative(tmp_path):
+    src = """
+    from minio_tpu.utils import admission
+
+    def submit():
+        raise admission.shed("dataplane", "lane_full", "queue full")
+
+    def commit():
+        raise admission.shed("metaplane", "wal_full", "wal full")
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/plane.py", src, "MTPU011",
+                    extra=_MTPU011_REGISTRY)
+    assert not r.new
+
+
+def test_mtpu011_registry_module_itself_skipped(tmp_path):
+    # Docstring examples / helpers inside utils/admission.py are not
+    # call sites to police.
+    src = """
+    ADMISSION_PLANES = frozenset({"dataplane", "metaplane"})
+    ADMISSION_CAUSES = frozenset({"lane_full", "wal_full"})
+
+    def shed(plane, cause, detail):
+        pass
+
+    def _example():
+        return shed("exampleplane", "examplecause", "doc example")
+    """
+    r = run_fixture(tmp_path, "minio_tpu/utils/admission.py", src,
+                    "MTPU011")
+    assert not r.new
+
+
+def test_mtpu011_suppressed(tmp_path):
+    src = """
+    from minio_tpu.utils import admission
+
+    def submit():
+        # mtpu: allow(MTPU011) - fixture: deliberately unregistered
+        raise admission.shed("dataplane", "lane-full", "suppressed")
+    """
+    r = run_fixture(tmp_path, "minio_tpu/fix/plane.py", src, "MTPU011",
+                    extra=_MTPU011_REGISTRY)
+    assert not r.new and len(r.suppressed) == 1
+
+
+def test_mtpu011_static_parse_matches_runtime_registry():
+    """The rule's importless parse of utils/admission.py sees exactly
+    the registries the running code exports — the closed vocabulary
+    cannot drift between analyzer and runtime."""
+    from minio_tpu.utils.admission import ADMISSION_CAUSES, ADMISSION_PLANES
+    from tools.check.rules.mtpu011_admission import _registries
+
+    regs = _registries(ROOT)
+    assert regs is not None
+    planes, causes = regs
+    assert planes == set(ADMISSION_PLANES)
+    assert causes == set(ADMISSION_CAUSES)

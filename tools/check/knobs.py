@@ -273,6 +273,43 @@ KNOB_DOCS: dict[str, tuple[str, str]] = {
     "MTPU_PEER_RETRY_REFILL": (
         "RESILIENCE.md",
         "Peer-retry token-bucket refill rate (tokens/second)."),
+    "MTPU_QOS": (
+        "QOS.md",
+        "`1` arms the per-tenant QoS plane: fair queues at both batch "
+        "planes plus the OP_HOTGET ring gate; disarmed (default) "
+        "admission is bit-identical to the pre-QoS tree."),
+    "MTPU_QOS_BURST_S": (
+        "QOS.md",
+        "Seconds of rate a tenant's token buckets accumulate as burst "
+        "headroom."),
+    "MTPU_QOS_HOTGET_OPS": (
+        "QOS.md",
+        "Per-tenant OP_HOTGET ring probes/second (token bucket); over "
+        "quota falls back to the local drive path, never a 503. "
+        "`0` = unlimited."),
+    "MTPU_QOS_MIN_SHARE": (
+        "QOS.md",
+        "Per-tenant backlog floor (queued items) below which the "
+        "weighted share cap never bites."),
+    "MTPU_QOS_QUANTUM": (
+        "QOS.md",
+        "Deficit-round-robin quantum: items granted per weight unit "
+        "per scheduler round (bounds starvation to one round)."),
+    "MTPU_QOS_RATE_BYTES": (
+        "QOS.md",
+        "Per-tenant payload bytes/second quota at plane admission "
+        "(token bucket); over quota sheds 503 SlowDown "
+        "(`tenant_quota`). `0` = unlimited."),
+    "MTPU_QOS_RATE_OPS": (
+        "QOS.md",
+        "Per-tenant submissions/second quota at plane admission "
+        "(token bucket); over quota sheds 503 SlowDown "
+        "(`tenant_quota`). `0` = unlimited."),
+    "MTPU_QOS_WEIGHTS": (
+        "QOS.md",
+        "Tenant weights, `key=weight,...` — key is "
+        "`access_key/bucket`, `access_key`, or `*`; unlisted tenants "
+        "weigh 1. Weights set DRR service ratio and backlog share."),
     "MTPU_REQUIRE_AESGCM": (
         "",
         "`1` turns the stdlib-AEAD fallback (cryptography wheel "

@@ -11,4 +11,5 @@ from tools.check.rules import (  # noqa: F401
     mtpu008_buflife,
     mtpu009_protocol,
     mtpu010_knobs,
+    mtpu011_admission,
 )

@@ -1,0 +1,111 @@
+"""MTPU011 — admission shed slug vocabulary, statically closed.
+
+`minio_tpu_admission_shed_total{plane,cause,tenant}` is the ONE signal
+operators watch for saturation, and the QoS chaos/bench gates key on
+exact (plane, cause) pairs. Before this rule a new shed site could mint
+any slug inline — a typo'd `"lane-full"` would silently fork the family
+and every dashboard/alert keyed on the registry would miss it.
+
+The registries live next to the metric they label
+(minio_tpu/utils/admission.py: `ADMISSION_PLANES`,
+`ADMISSION_CAUSES`); this rule parses them without importing and flags
+every `admission.shed(plane, cause, ...)` call site whose literal
+plane/cause is not a member. Non-literal arguments are flagged too:
+the vocabulary is closed, so a shed site must say which registered
+slug it emits where the analyzer (and the reviewer) can see it.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from tools.check import FileContext, Finding, Rule, register
+from tools.check.rules.base import str_const, terminal_name
+
+_REGISTRY_PATH = ("minio_tpu", "utils", "admission.py")
+
+
+def _registries(root: Path) -> tuple[set[str], set[str]] | None:
+    """Parse ADMISSION_PLANES / ADMISSION_CAUSES out of
+    utils/admission.py without importing the project."""
+    mod = root.joinpath(*_REGISTRY_PATH)
+    if not mod.exists():
+        return None
+    try:
+        tree = ast.parse(mod.read_text())
+    except SyntaxError:
+        return None
+    found: dict[str, set[str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id in (
+                    "ADMISSION_PLANES", "ADMISSION_CAUSES"):
+                val = node.value
+                if (isinstance(val, ast.Call)
+                        and terminal_name(val.func) == "frozenset"
+                        and val.args):
+                    val = val.args[0]
+                try:
+                    found[tgt.id] = set(ast.literal_eval(val))
+                except ValueError:
+                    return None
+    if "ADMISSION_PLANES" not in found or "ADMISSION_CAUSES" not in found:
+        return None
+    return found["ADMISSION_PLANES"], found["ADMISSION_CAUSES"]
+
+
+@register
+class AdmissionSlugRule(Rule):
+    id = "MTPU011"
+    title = "admission shed slug not in the closed registry"
+
+    def __init__(self) -> None:
+        # (finding, kind, slug|None) pending finalize; slug None means
+        # the argument was not a string literal.
+        self._sites: list[tuple[Finding, str, str | None]] = []
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.relpath.replace("\\", "/").endswith("utils/admission.py"):
+            # The registry module's own docstring examples / metric
+            # declaration are not call sites.
+            return ()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node.func) != "shed":
+                continue
+            if len(node.args) < 2:
+                continue
+            for kind, arg in (("plane", node.args[0]),
+                              ("cause", node.args[1])):
+                slug = str_const(arg)
+                if slug is None:
+                    self._sites.append((ctx.finding(
+                        self.id, arg,
+                        f"shed() {kind} must be a string literal from "
+                        "the ADMISSION registry (utils/admission.py) — "
+                        "the vocabulary is closed"), kind, None))
+                else:
+                    self._sites.append((ctx.finding(
+                        self.id, arg,
+                        f"shed() {kind} '{slug}' is not registered in "
+                        f"ADMISSION_{kind.upper()}S "
+                        "(utils/admission.py)"), kind, slug))
+        return ()
+
+    def finalize(self, root: Path) -> Iterable[Finding]:
+        regs = _registries(root)
+        if regs is None:
+            return
+        planes, causes = regs
+        for finding, kind, slug in self._sites:
+            if slug is None:
+                yield finding
+            elif kind == "plane" and slug not in planes:
+                yield finding
+            elif kind == "cause" and slug not in causes:
+                yield finding
