@@ -384,11 +384,19 @@ class DriveWAL:
         # barriers are control items: admitted unconditionally, and the
         # fair queue releases them only after everything enqueued
         # before them, preserving the flush contract under reordering.
+        # Tombstones are ordering FENCES: replay's fold() resolves
+        # dominance by WAL file order, so a remove_prefix/remove/
+        # blob_remove reordered across tenant lanes would resurrect an
+        # rmtree'd journal (tombstone written before an earlier commit)
+        # or replay-delete a fresh one (later commit written before the
+        # tombstone) — the fence pins file order to submit order there.
         self._q = qos.plane_queue(
             "metaplane", metaplane.wal_queue_depth(),
             tenant_of=lambda it: getattr(it[-1], "mtpu_tenant", None),
             cost_of=_wal_cost,
-            is_control=lambda it: it[0] in ("flush", "close"))
+            is_control=lambda it: it[0] in ("flush", "close"),
+            is_barrier=lambda it: it[0] in ("remove_prefix", "remove",
+                                            "blob_remove"))
         self._mu = threading.Lock()  # pending overlay + key lsn map
         self._pending: "OrderedDict[tuple[str, str], Entry]" = OrderedDict()
         self._key_lsn: "OrderedDict[tuple[str, str], int]" = OrderedDict()

@@ -42,11 +42,13 @@ from minio_tpu.qos.scheduler import FairQueue, QuotaFull, RingGate, TokenBucket
 __all__ = [
     "FairQueue", "QuotaFull", "RingGate", "TokenBucket", "Tenant",
     "armed", "bind", "bind_key", "reset", "current", "current_key",
-    "parse_weights", "plane_queue", "ring_gate", "tenant_tag",
-    "key_from_tag", "UNATTRIBUTED", "TAG_LEN",
+    "metric_key", "parse_weights", "plane_queue", "ring_gate",
+    "tenant_tag", "key_from_tag", "UNATTRIBUTED", "METRIC_OVERFLOW",
+    "TAG_LEN",
 ]
 
 UNATTRIBUTED = "-"
+METRIC_OVERFLOW = "~other"   # fold label once the cardinality cap hits
 TAG_LEN = 12   # tenant tag width in the shm slot header (bytes)
 
 
@@ -100,6 +102,35 @@ def current():
 def current_key() -> str:
     t = _tenant.get()
     return t.key if t is not None else UNATTRIBUTED
+
+
+# -- metric label hygiene ---------------------------------------------
+#
+# The tenant key embeds the bucket SEGMENT OF THE URL, taken before any
+# bucket-existence check — an unauthenticated scanner sweeping paths
+# would mint one time-series per probe ("anonymous/<path>") in every
+# per-tenant metric family. The FairQueue has its own 4096-lane
+# backstop; this is the registry-side one: after _METRIC_TENANTS_CAP
+# distinct keys, new tenants fold into the single METRIC_OVERFLOW
+# label. Scheduling/quotas are never folded — only metric labels.
+
+_METRIC_TENANTS_CAP = 1024
+_metric_tenants: set = set()
+
+
+def metric_key(key: str | None = None) -> str:
+    """Tenant label safe for unbounded-cardinality metric families:
+    the tenant key itself until the distinct-label backstop fills,
+    METRIC_OVERFLOW after. First-come-first-labeled; benign races
+    under the GIL can only overshoot the cap by a few entries."""
+    if key is None:
+        key = current_key()
+    if key == UNATTRIBUTED or key in _metric_tenants:
+        return key
+    if len(_metric_tenants) >= _METRIC_TENANTS_CAP:
+        return METRIC_OVERFLOW
+    _metric_tenants.add(key)
+    return key
 
 
 # -- serialization across the shm ring -------------------------------
@@ -156,10 +187,12 @@ def _fenv(raw: str, default: float) -> float:
 # -- wiring factories ------------------------------------------------
 
 def plane_queue(plane: str, cap: int, *, tenant_of=None, cost_of=None,
-                is_control=None):
+                is_control=None, is_barrier=None):
     """The admission queue for one batch plane: a plain bounded
     `queue.Queue` when disarmed (bit-identical legacy behavior), a
-    tenant-fair `FairQueue` when armed."""
+    tenant-fair `FairQueue` when armed. `is_barrier` marks items that
+    must keep strict submit order against everything else (the WAL's
+    tombstone records — see scheduler.py's fence contract)."""
     if not armed():
         import queue
         return queue.Queue(maxsize=cap)
@@ -174,6 +207,7 @@ def plane_queue(plane: str, cap: int, *, tenant_of=None, cost_of=None,
         tenant_of=tenant_of,
         cost_of=cost_of,
         is_control=is_control,
+        is_barrier=is_barrier,
         unattributed=UNATTRIBUTED)
 
 

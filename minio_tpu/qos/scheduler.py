@@ -11,13 +11,17 @@ bound: at most `quantum x sum(weights of other active lanes)` items are
 served between two services of a backlogged lane).
 
 Admission is where isolation happens. A non-control `put_nowait` is
-checked against (1) the tenant's token buckets — ops/sec and bytes/sec,
-raising `QuotaFull` so call sites can label the shed `tenant_quota` —
-and (2) the tenant's backlog share, `max(min_share, cap x w / W)` where
-`W` sums the weights of tenants that currently hold backlog (plus the
-requester): a saturated tenant hits `queue.Full` at its share while
-other tenants still have admission headroom. When only one tenant is
-active its share is the whole cap, so the queue stays work-conserving.
+checked against (1) the tenant's backlog share, `max(min_share,
+cap x w / W)` where `W` sums the weights of tenants that currently hold
+backlog (plus the requester): a saturated tenant hits `queue.Full` at
+its share while other tenants still have admission headroom (when only
+one tenant is active its share is the whole cap, so the queue stays
+work-conserving) — and only then (2) the tenant's token buckets —
+ops/sec and bytes/sec, raising `QuotaFull` so call sites can label the
+shed `tenant_quota`. Capacity is checked BEFORE quota so a put bounced
+off its share never burns rate tokens: a blocking `put()` re-tries on
+every wakeup, and debit-first would push a share-pinned tenant into
+spurious QuotaFull sheds on its own rejected attempts.
 
 Control items (the batcher's `_CLOSE`, the WAL's `("flush", fut)` /
 `("close", fut)`) are never quota-checked and never count against any
@@ -27,6 +31,18 @@ all lanes' heads are newer than it. That preserves the WAL flush
 barrier ("every record enqueued before flush() is durable on return")
 under DRR reordering — the reordering is confined to items enqueued
 after the barrier.
+
+Barrier items (`is_barrier`) are stronger: a strict ordering FENCE.
+They ride their tenant lane like data (share + quota accounted), but
+`get()` releases nothing enqueued after a queued barrier until the
+barrier itself has drained, and the barrier drains only after
+everything enqueued before it. The WAL wires its tombstone records
+(`remove_prefix`, `blob_remove`, `remove`) as barriers: replay's
+`fold()` resolves dominance by WAL FILE ORDER, so a tombstone that
+physically preceded an earlier-submitted commit under its prefix would
+resurrect an rmtree'd journal — and a commit submitted after the
+tombstone, written before it, would be replay-deleted. The fence pins
+file order to submit order exactly at tombstones and nowhere else.
 
 All state is guarded by one condition variable; nothing blocking runs
 under the lock (token buckets are pure arithmetic).
@@ -76,6 +92,14 @@ class TokenBucket:
             return True
         return False
 
+    def untake(self, n: float = 1.0) -> None:
+        """Refund tokens from a take whose admission was then rejected
+        by another check — the op never entered the queue, so it must
+        not count against the rate."""
+        if self.rate <= 0:
+            return
+        self._level = min(self.burst, self._level + n)
+
 
 class _Lane:
     __slots__ = ("key", "weight", "items", "deficit", "ops", "byt")
@@ -105,7 +129,7 @@ class FairQueue:
                  min_share: int = 1, rate_ops: float = 0.0,
                  rate_bytes: float = 0.0, burst_s: float = 1.0,
                  tenant_of=None, cost_of=None, is_control=None,
-                 unattributed: str = "-"):
+                 is_barrier=None, unattributed: str = "-"):
         self.cap = max(1, int(cap))
         self.quantum = max(1, int(quantum))
         self.min_share = max(1, int(min_share))
@@ -116,11 +140,13 @@ class FairQueue:
         self._tenant_of = tenant_of
         self._cost_of = cost_of
         self._is_control = is_control
+        self._is_barrier = is_barrier
         self._unattributed = unattributed
         self._cond = threading.Condition(threading.Lock())
         self._lanes: dict[str, _Lane] = {}
         self._active: list[_Lane] = []   # lanes with backlog, DRR order
         self._control: deque = deque()   # (seq, item)
+        self._fences: deque = deque()    # seqs of queued barrier items
         self._seq = 0
         self._total = 0
         self._ai = 0                     # DRR cursor into _active
@@ -178,6 +204,11 @@ class FairQueue:
             return True
         key = self._key_for(item)
         lane = self._lane(key)
+        # Capacity before quota: a put destined to bounce off the
+        # backlog share must not burn the tenant's rate tokens (a
+        # blocking put() re-debits on every wakeup retry otherwise).
+        if self._total >= 2 * self.cap or len(lane.items) >= self._share(lane):
+            raise queue.Full(key)
         if not lane.ops.take(1.0):
             raise QuotaFull(key)
         if self._rate_bytes > 0 and self._cost_of is not None:
@@ -189,12 +220,13 @@ class FairQueue:
             except Exception:  # noqa: BLE001
                 cost = 0.0
             if cost > 0 and not lane.byt.take(cost):
+                lane.ops.untake(1.0)   # the op was never admitted
                 raise QuotaFull(key)
-        if self._total >= 2 * self.cap or len(lane.items) >= self._share(lane):
-            raise queue.Full(key)
         self._seq += 1
         lane.items.append((self._seq, item))
         self._total += 1
+        if self._is_barrier is not None and self._is_barrier(item):
+            self._fences.append(self._seq)
         if len(lane.items) == 1:
             self._active.append(lane)
         self._cond.notify_all()
@@ -242,17 +274,34 @@ class FairQueue:
         if self._control_ready():
             self._total -= 1
             return self._control.popleft()[1]
+        fence = self._fences[0] if self._fences else None
         while True:
             if self._ai >= len(self._active):
                 self._ai = 0
             lane = self._active[self._ai]
+            if fence is not None:
+                head = lane.items[0][0]
+                # Ordering fence (WAL tombstones): nothing enqueued
+                # after the fence may drain before it, and the fence
+                # itself goes only once it is the oldest item queued —
+                # file order equals submit order exactly at fences.
+                # Never livelocks: while any pre-fence item remains it
+                # is some lane's head (lanes are seq-sorted), and once
+                # none remains the fence head itself is eligible.
+                if head > fence or (head == fence and any(
+                        l.items[0][0] < fence for l in self._active
+                        if l is not lane)):
+                    self._ai += 1
+                    continue
             if lane.deficit < 1.0:
                 lane.deficit += self.quantum * lane.weight
                 if lane.deficit < 1.0:
                     lane.deficit = 1.0
-            _, item = lane.items.popleft()
+            seq, item = lane.items.popleft()
             lane.deficit -= 1.0
             self._total -= 1
+            if fence is not None and seq == fence:
+                self._fences.popleft()
             if not lane.items:
                 lane.deficit = 0.0
                 self._active.pop(self._ai)

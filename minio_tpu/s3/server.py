@@ -854,9 +854,13 @@ class S3Server:
             _REQ_LATENCY.labels(api=api).observe(dt)
             tkey = request.get("tenant")
             if tkey:
-                _TENANT_LATENCY.labels(tenant=tkey).observe(dt)
+                # metric_key folds unbounded tenant keys (scanner
+                # probes mint "anonymous/<path>" pre-bucket-check) into
+                # "~other" past the registry cardinality backstop.
+                mkey = qos.metric_key(tkey)
+                _TENANT_LATENCY.labels(tenant=mkey).observe(dt)
                 _TENANT_REQS.labels(
-                    tenant=tkey, code=f"{status // 100}xx").inc()
+                    tenant=mkey, code=f"{status // 100}xx").inc()
             # Streamed GETs stamp first-byte at header flush; everything
             # else flushes with the handler return, so TTFB == latency.
             ttfb = request.get("mtpu-ttfb")
@@ -1078,9 +1082,12 @@ class S3Server:
         # submit, WAL record, shm ring slot and shed counter downstream
         # attributes to it (the contextvar crosses executor hops via
         # obs.ctx_wrap exactly like the trace id). The /minio/ admin
-        # and metrics planes stay on the unattributed system lane.
+        # and metrics planes stay on the unattributed system lane —
+        # the EXACT reserved segment only: a real bucket merely named
+        # "minio-..." is a tenant like any other (quotas, metrics,
+        # fairness), never the system lane.
         tpath = path.lstrip("/").split("/", 1)[0]
-        if not tpath.startswith("minio"):
+        if tpath != "minio":
             qos.bind(getattr(identity, "access_key", "") or "anonymous",
                      tpath)
             tkey = qos.current_key()

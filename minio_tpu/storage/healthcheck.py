@@ -298,10 +298,17 @@ class HealthChecker:
             # The watchdog already charged this op; a late return (even a
             # success) never clears the strike — the data path moved on.
             return
-        if err is None or isinstance(err, _BACKPRESSURE) or not (
+        if err is not None and isinstance(err, _BACKPRESSURE):
+            # An admission shed is healthy contact — but its
+            # near-instant turnaround is NOT an IO sample: during a
+            # quota storm an all-shed window would shrink the adaptive
+            # deadline toward its floor and time out (and strike) the
+            # next real drive IO. Note contact, skip the model.
+            self._note_ok()
+        elif err is None or not (
                 isinstance(err, _SYS_ERRORS) or isinstance(err, OSError)):
-            # Success, per-object state, or an admission shed: all are
-            # healthy contact with the drive.
+            # Success or per-object state: healthy contact with a real
+            # duration the deadline model may learn from.
             self._deadlines[op.cls].log_success(now - op.armed_base)
             self._note_ok()
         else:

@@ -61,6 +61,11 @@ def shed(plane: str, cause: str, msg: str) -> se.AdmissionShed:
     The rejection is AdmissionShed, not bare OperationTimedOut: the
     drive-health layer must see policy backpressure as healthy contact,
     or one tenant's quota sheds would strike a shared drive OFFLINE and
-    fail every other tenant's quorum."""
-    _SHED.labels(plane=plane, cause=cause, tenant=qos.current_key()).inc()
+    fail every other tenant's quorum.
+
+    The tenant label passes qos.metric_key(): unbounded distinct keys
+    (a scanner sweeping bucket paths) fold into "~other" past the
+    cardinality backstop instead of minting a series per probe."""
+    _SHED.labels(plane=plane, cause=cause,
+                 tenant=qos.metric_key()).inc()
     return se.AdmissionShed(msg=msg)

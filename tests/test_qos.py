@@ -172,10 +172,11 @@ def test_fairqueue_control_never_quota_checked():
     CTL = ("flush", object())
     q = FairQueue(2, tenant_of=lambda it: it[0],
                   is_control=lambda it: it[0] == "flush",
-                  rate_ops=1000.0, burst_s=2 / 1000.0)   # burst = 2
+                  rate_ops=0.001, burst_s=2_000.0)   # burst = 2, ~no refill
     q.put_nowait(("a", 0))
     q.put_nowait(("a", 1))          # lane at cap, bucket empty...
     q.put_nowait(CTL)               # ...control still admitted
+    q.get_nowait()                  # free a share slot: quota decides now
     with pytest.raises(QuotaFull):
         q.put_nowait(("a", 2))
 
@@ -199,6 +200,59 @@ def test_fairqueue_control_barrier_orders_after_predecessors():
     before = out[:ctl_at]
     assert {("a", i) for i in range(4)} <= set(before)
     assert {("b", i) for i in range(4)} <= set(before)
+
+
+def test_fairqueue_barrier_is_full_ordering_fence():
+    """A tombstone-style barrier rides its tenant lane but is a strict
+    ordering fence: it drains after every item enqueued before it and
+    before every item enqueued after it, even when DRR weights would
+    otherwise reorder across lanes — WAL replay folds resolve
+    dominance by file order, so file order must equal submit order
+    exactly at tombstones."""
+    q = FairQueue(64, weights={"a": 8.0, "b": 1.0},
+                  tenant_of=lambda it: it[0],
+                  is_barrier=lambda it: it[1] == "TOMB")
+    for i in range(8):
+        q.put_nowait(("b", i))          # light lane, enqueued first
+    q.put_nowait(("a", "TOMB"))         # tombstone in the heavy lane
+    for i in range(4):
+        q.put_nowait(("a", i))          # heavy lane, enqueued after
+    out = [q.get_nowait() for _ in range(13)]
+    at = out.index(("a", "TOMB"))
+    assert set(out[:at]) == {("b", i) for i in range(8)}
+    assert set(out[at + 1:]) == {("a", i) for i in range(4)}
+
+
+def test_fairqueue_capacity_reject_does_not_burn_quota():
+    """A put bounced off the backlog share must not debit the token
+    bucket: a blocking put() re-tries admission on every wakeup, and
+    debit-first would push a share-pinned tenant into spurious
+    QuotaFull sheds off its own rejected attempts."""
+    # rate ~0 so nothing refills during the test; burst carries 3.
+    q = _fq(cap=2, rate_ops=0.001, burst_s=3_000.0)
+    q.put_nowait(("a", 0))
+    q.put_nowait(("a", 1))              # share full; one token left
+    for _ in range(5):
+        with pytest.raises(queue.Full) as ei:
+            q.put_nowait(("a", 2))
+        assert not isinstance(ei.value, QuotaFull)   # capacity, not quota
+    q.get_nowait()
+    q.put_nowait(("a", 2))              # the last token was preserved...
+    q.get_nowait()
+    with pytest.raises(QuotaFull):
+        q.put_nowait(("a", 3))          # ...and only that one
+
+
+def test_fairqueue_byte_quota_reject_refunds_op_token():
+    q = FairQueue(16, tenant_of=lambda it: it[0],
+                  cost_of=lambda it: it[1],
+                  rate_ops=0.001, burst_s=2_000.0,   # 2 op tokens
+                  rate_bytes=0.001)                  # 2 byte tokens
+    for _ in range(3):
+        with pytest.raises(QuotaFull):
+            q.put_nowait(("a", 500))    # byte reject refunds the op take
+    q.put_nowait(("a", 1))
+    q.put_nowait(("a", 1))              # both op tokens survived
 
 
 def test_fairqueue_get_timeout_and_blocking_handoff():
@@ -315,6 +369,26 @@ def test_bind_key_round_trip():
     tok = qos.bind_key(qos.UNATTRIBUTED)
     try:
         assert qos.current() is None
+    finally:
+        qos.reset(tok)
+
+
+def test_metric_key_folds_past_cardinality_cap(monkeypatch):
+    """The metric-label backstop: an unauthenticated scanner sweeping
+    bucket paths mints tenant keys without bound, but the metric
+    registry folds everything past the cap into one overflow label
+    (scheduling lanes have their own 4096 backstop; this is the
+    time-series side)."""
+    monkeypatch.setattr(qos, "_metric_tenants", set())
+    monkeypatch.setattr(qos, "_METRIC_TENANTS_CAP", 3)
+    assert [qos.metric_key(f"scan/b{i}") for i in range(3)] == \
+        ["scan/b0", "scan/b1", "scan/b2"]
+    assert qos.metric_key("scan/b3") == qos.METRIC_OVERFLOW
+    assert qos.metric_key("scan/b1") == "scan/b1"   # known keys keep labels
+    assert qos.metric_key(qos.UNATTRIBUTED) == qos.UNATTRIBUTED
+    tok = qos.bind("late", "bkt")
+    try:        # no-arg form reads the bound tenant, same fold
+        assert qos.metric_key() == qos.METRIC_OVERFLOW
     finally:
         qos.reset(tok)
 
@@ -601,6 +675,82 @@ def test_quota_shed_never_strikes_drive_health():
             hc.write_all("v", "p", b"x")
     assert hc.health_state() == ONLINE
     assert hc.consecutive == 0
+
+
+def test_shed_durations_never_feed_the_deadline_model():
+    """Sheds are healthy contact but NOT IO samples: a sustained quota
+    storm produces near-zero turnarounds, and logging them as
+    successes would shrink the adaptive deadline toward its floor and
+    time out (and strike) the next real drive IO."""
+    from minio_tpu.storage.healthcheck import HealthChecker
+    from minio_tpu.utils import dyntimeout
+
+    hc = HealthChecker(
+        _ShedDrive(lambda: admission.shed("metaplane", "tenant_quota",
+                                          "storm")),
+        offline_after=1)
+    dt = hc._deadlines["meta"]
+    before = dt.timeout()
+    for _ in range(dyntimeout.LOG_SIZE + 50):   # > one adjust window
+        with pytest.raises(se.AdmissionShed):
+            hc.write_all("v", "p", b"x")
+    assert dt.timeout() == before
+    assert not dt._durations        # no shed duration was ever logged
+
+
+def test_wal_tombstone_file_order_pins_submit_order_when_armed(
+        tmp_path, monkeypatch):
+    """Armed, skewed weights, parked committer: a forget_subtree
+    tombstone must land in the WAL file after every record submitted
+    before it (a light lane DRR would otherwise leave behind — replay
+    would resurrect the rmtree'd journals) and before every record
+    submitted after it (a heavy lane DRR would otherwise promote —
+    replay would delete the fresh writes)."""
+    monkeypatch.setenv("MTPU_METAPLANE", "1")
+    monkeypatch.setenv("MTPU_QOS", "1")
+    monkeypatch.setenv("MTPU_QOS_WEIGHTS", "heavy=8,light=1")
+    monkeypatch.setenv("MTPU_WAL_TEST_HOLD_FSYNC_S", "0.3")
+    from minio_tpu.metaplane import wal as walfmt
+
+    d = LocalDrive(str(tmp_path / "d0"))
+    try:
+        futs = []
+        tok = qos.bind("park", "b")
+        try:        # bait record parks the committer in its fsync hold
+            futs.append(d.write_all_async(".mtpu.sys", "park.mp", b"p"))
+        finally:
+            qos.reset(tok)
+        time.sleep(0.1)
+        # 6 records > one DRR round (quantum 4 x weight 1): without the
+        # fence the scheduler would move on to the tombstone's lane
+        # with two of these still queued, writing them after it.
+        tok = qos.bind("light", "b")
+        try:
+            for i in range(6):
+                futs.append(d.write_all_async(
+                    ".mtpu.sys", f"t/sub/before{i}.mp", b"x"))
+        finally:
+            qos.reset(tok)
+        d._wal.forget_subtree(".mtpu.sys", "t/sub")   # system lane
+        tok = qos.bind("heavy", "b")
+        try:
+            for i in range(3):
+                futs.append(d.write_all_async(
+                    ".mtpu.sys", f"t/sub/after{i}.mp", b"y"))
+        finally:
+            qos.reset(tok)
+        for f in futs:
+            f.result(timeout=30)
+        recs = [(r.rtype, r.path) for r in walfmt.scan(d._wal.path)
+                if r.path.startswith("t/sub")]
+        tomb_at = next(i for i, (rt, _p) in enumerate(recs)
+                       if rt == walfmt.REC_REMOVE_PREFIX)
+        assert {p for _rt, p in recs[:tomb_at]} == {
+            f"t/sub/before{i}.mp" for i in range(6)}
+        assert {p for _rt, p in recs[tomb_at + 1:]} == {
+            f"t/sub/after{i}.mp" for i in range(3)}
+    finally:
+        d.close_wal()
 
 
 def test_bare_timeout_still_strikes_drive_health():
