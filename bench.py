@@ -1496,6 +1496,119 @@ def _recon_codec_slice(writers: int = 8, n_ops: int = 256) -> dict:
             "recon_codec_speedup": round(bp / po, 2)}
 
 
+def bench_replication() -> dict:
+    """Cross-cluster replication plane (docs/REPLICATION.md): steady-
+    state replicated PUT ops/s through the WAL-journaled queue, then a
+    partitioned-link backlog drained after heal (the resync MRF) as
+    catch-up MiB/s. Two in-process clusters over real HTTP; the
+    two-OS-process chaos gate lives in tests/test_replication.py."""
+    import shutil
+
+    from minio_tpu import chaos
+    from minio_tpu.dist import faultplane
+    from minio_tpu.s3.server import build_server
+    from tests.s3client import SigV4Client
+
+    ak, sk = "benchak00", "benchsk00secret0"
+    root = _bench_root()
+    stops: list = []
+    knobs = {"MTPU_REPL_RESYNC_INTERVAL": "1",
+             "MTPU_REPL_RETRY_INTERVAL": "0.2",
+             "MTPU_REPL_RETRY_CAP": "0.5",
+             "MTPU_REPL_RETRY_MAX": "1",
+             "MTPU_REPL_WORKERS": "4"}
+    saved = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    src_srv = dst_srv = None
+    try:
+        src_srv = build_server(
+            [os.path.join(root, f"s{i}") for i in range(4)], ak, sk)
+        dst_srv = build_server(
+            [os.path.join(root, f"d{i}") for i in range(4)], ak, sk)
+        sp, stop1 = _serve_http(src_srv)
+        stops.append(stop1)
+        dp, stop2 = _serve_http(dst_srv)
+        stops.append(stop2)
+        if sp is None or dp is None:
+            return {"metric": "replication", "error": "server not up"}
+        src = SigV4Client(f"http://127.0.0.1:{sp}", ak, sk)
+        dst = SigV4Client(f"http://127.0.0.1:{dp}", ak, sk)
+        assert src.put("/origin").status_code == 200
+        assert dst.put("/mirror").status_code == 200
+        r = src.put("/minio/admin/v3/set-remote-target",
+                    query={"bucket": "origin"},
+                    data=json.dumps({"endpoint": f"http://127.0.0.1:{dp}",
+                                     "accessKey": ak, "secretKey": sk,
+                                     "targetBucket": "mirror"}).encode())
+        assert r.status_code == 200, r.text
+        xml = (b"<ReplicationConfiguration><Rule><ID>r</ID>"
+               b"<Status>Enabled</Status><Priority>1</Priority>"
+               b"<Filter><Prefix>docs/</Prefix></Filter>"
+               b"<Destination><Bucket>arn:aws:s3:::mirror</Bucket>"
+               b"</Destination><DeleteReplication><Status>Enabled"
+               b"</Status></DeleteReplication></Rule>"
+               b"</ReplicationConfiguration>")
+        assert src.put("/origin", data=xml,
+                       query={"replication": ""}).status_code == 200
+
+        size = 64 << 10
+        body = os.urandom(size)
+        pool = src_srv.replication
+
+        # Steady state: ack + replicate, wall-clocked to full drain.
+        n1 = 48
+        t0 = time.perf_counter()
+        for i in range(n1):
+            assert src.put(f"/origin/docs/a{i}",
+                           data=body).status_code == 200
+        pool.drain(timeout=120)
+        steady = time.perf_counter() - t0
+
+        # Partition the inter-cluster link (src's identity is "local"
+        # in a standalone layer), accumulate a backlog, heal, and
+        # measure the resync MRF's catch-up.
+        plane = faultplane.install()
+        plane.partition("xlink", ["local"], [f"127.0.0.1:{dp}"])
+        n2 = 32
+        for i in range(n2):
+            assert src.put(f"/origin/docs/b{i}",
+                           data=body).status_code == 200
+        backlog = pool.describe()["backlog"]
+        plane.heal("xlink")
+        t1 = time.perf_counter()
+        deadline = t1 + 180
+        while time.perf_counter() < deadline:
+            if pool.describe()["backlog"] == 0:
+                break
+            pool.resync_once(force=True)
+            time.sleep(0.2)
+        drain = time.perf_counter() - t1
+        converged = dst.get(f"/mirror/docs/b{n2 - 1}").status_code == 200
+        return {"metric": "replication", "unit": "ops/s",
+                "value": round(n1 / steady, 1), "vs_baseline": 0.0,
+                "object_kib": size >> 10,
+                "steady_mibs": round(n1 * size / steady / (1 << 20), 1),
+                "backlog_peak": backlog,
+                "drain_s": round(drain, 2),
+                "drain_mibs": round(
+                    n2 * size / max(drain, 1e-9) / (1 << 20), 1),
+                "converged": converged,
+                "journaled": pool._journal is not None}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        chaos.clear_all()
+        for s in (src_srv, dst_srv):
+            if s is not None:
+                s.replication.close()
+        for stop in stops:
+            stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_chaos_smoke() -> dict:
     """Robustness-under-load over time (docs/CHAOS.md): a bounded storm
     — mixed PUT/GET/DELETE fleet against a live SigV4 server while one
@@ -2293,6 +2406,7 @@ def main() -> int:
             ("check_overhead", bench_check_overhead),
             ("chaos_smoke", bench_chaos_smoke),
             ("qos_fairness", bench_qos_fairness),
+            ("replication", bench_replication),
         ]
         if use_pallas:
             plans.insert(1, ("encode_pallas",

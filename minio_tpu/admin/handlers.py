@@ -219,7 +219,14 @@ class AdminAPI:
             return _json({})
         if op == "replication-status" and m == "GET":
             self._authorize(identity, "admin:ServerInfo")
-            return _json(self.s.replication.stats)
+            return _json(self.s.replication.describe())
+        if op == "replication-resync" and m == "POST":
+            # Operator MRF trigger: requeue the journal backlog and
+            # every PENDING/FAILED status now, bypassing the interval
+            # gate (a healed partition drains without waiting).
+            self._authorize(identity, "admin:SetBucketTarget")
+            return _json(self.s.replication.resync_once(
+                bucket=q.get("bucket", ""), force=True))
         if op == "cache" and m == "GET":
             # Disk-cache observability (reference CacheMetrics admin
             # surface): hit/miss/eviction/writeback counters when a cache

@@ -68,7 +68,10 @@ def clear_all() -> dict:
     if faultplane.get() is not None:
         faultplane.uninstall()
         cleared["net_plane"] = 1
-    cleared["breakers_reset"] = rpc.reset_breakers()
+    from minio_tpu.replication import client as repl_client
+
+    cleared["breakers_reset"] = (rpc.reset_breakers()
+                                 + repl_client.reset_breakers())
     return cleared
 
 

@@ -226,6 +226,14 @@ def _apply_fold(drive, final) -> "tuple[int, int]":
         # cover and are dropped), so the dispatch below is total over
         # every record type a fold output can contain.
         # mtpu: allow(MTPU009)
+        if rec.rtype in (walfmt.REC_REPL_INTENT, walfmt.REC_REPL_DONE):
+            # Replication intents live in their own segment
+            # (replication.wal, replayed by replication/journal.py —
+            # never by the drive mount). One in a DRIVE journal is
+            # misrouted; keep it (failed blocks truncation) rather
+            # than guess at materialization.
+            failed += 1
+            continue
         blob = rec.rtype in (walfmt.REC_BLOB, walfmt.REC_BLOB_REMOVE)
         try:
             # Blob records tiebreak against the blob FILE's mtime; the
@@ -420,12 +428,18 @@ class DriveWAL:
             # owners' to serve.
             for (vol, path), rec in walfmt.fold_merged(
                     replay_kept).items():
-                self._lsn += 1
                 # Not a dispatch gap: REC_REMOVE seeds raw=None (a
                 # pending removal Entry) through the else by design,
                 # and REC_REMOVE_PREFIX cannot appear in a fold —
                 # fold_merged consumes tombstones in-stream.
                 # mtpu: allow(MTPU009)
+                if rec.rtype in (walfmt.REC_REPL_INTENT,
+                                 walfmt.REC_REPL_DONE):
+                    # Misrouted replication intent (its home is the
+                    # replication.wal segment): it must not seed the
+                    # drive overlay as a phantom journal entry.
+                    continue
+                self._lsn += 1
                 blob = rec.rtype in (walfmt.REC_BLOB,
                                      walfmt.REC_BLOB_REMOVE)
                 self._pending[(vol, path)] = Entry(
@@ -826,6 +840,10 @@ class DriveWAL:
         # so a newer published state is never downgraded.
         with self._mu:
             for rtype, vol, path, raw, meta, mt, lsn, _fut, _tok in staged:
+                # REC_REPL_INTENT/REC_REPL_DONE never enter the commit
+                # queue — replication/journal.py appends them to its
+                # own segment, never through DriveWAL staging.
+                # mtpu: allow(MTPU009)
                 if rtype == walfmt.REC_REMOVE_PREFIX:
                     # Drop anything that slipped into the overlay for
                     # the destroyed subtree between forget and commit.

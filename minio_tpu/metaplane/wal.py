@@ -48,6 +48,19 @@ REC_REMOVE_PREFIX = 3
 # format is identical; only the apply side dispatches differently.
 REC_BLOB = 4
 REC_BLOB_REMOVE = 5
+# Replication intents (docs/REPLICATION.md): an acked PUT/DELETE on a
+# replicated bucket journals its cross-cluster intent BEFORE the task
+# enters the in-memory queue, and journals DONE only once the far
+# cluster acknowledged — replay re-enqueues every intent without a
+# matching DONE, so a SIGKILL between the S3 ack and the replication
+# attempt cannot lose the intent. `volume` is the bucket, `path` the
+# unique intent id, `raw` the msgpack task document. The replication
+# journal rides the same frame format + torn-tail contract in its own
+# segment (`replication.wal`); if one of these records ever lands in a
+# drive journal it folds with blob semantics (intent = doc write,
+# done = doc removal).
+REC_REPL_INTENT = 6
+REC_REPL_DONE = 7
 # Closed record-type registry (static rule MTPU009, docs/ANALYSIS.md):
 # every WAL dispatch site — the replay fold apply, the commit staging,
 # the overlay publish — must handle every member or carry a written
@@ -59,6 +72,8 @@ WAL_RECORD_TYPES = {
     "REC_REMOVE_PREFIX": REC_REMOVE_PREFIX,
     "REC_BLOB": REC_BLOB,
     "REC_BLOB_REMOVE": REC_BLOB_REMOVE,
+    "REC_REPL_INTENT": REC_REPL_INTENT,
+    "REC_REPL_DONE": REC_REPL_DONE,
 }
 
 _FRAME = struct.Struct("<II")       # payload_len, crc32

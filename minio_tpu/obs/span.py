@@ -53,6 +53,8 @@ RECORD_TYPES = frozenset({
                   # fsync) linking member trace_ids
     "ring",       # shm ring lane serves (cross-process front-door hop)
     "hottier",    # HBM hot-tier serve/admit/evict events
+    "replication",  # cross-cluster replication task lifecycle
+                    # (queued / completed / failed / skipped)
 })
 
 # --- trace context -----------------------------------------------------------

@@ -1,0 +1,188 @@
+"""Durable replication-intent journal (docs/REPLICATION.md).
+
+The replication analogue of the metaplane drive WAL: one append-only
+segment per node at `<drive0.root>/.mtpu.sys/wal/replication.wal`,
+riding the exact metaplane frame format (metaplane/wal.py MAGIC +
+CRC-framed records, torn-tail truncation contract) with the two
+replication record types from the closed MTPU009 registry:
+
+  REC_REPL_INTENT  volume=bucket, path=intent id, raw=msgpack task doc
+  REC_REPL_DONE    volume=bucket, path=intent id (raw empty)
+
+`queue_task` appends + fsyncs the INTENT before the task enters the
+in-memory queue — the S3 ack that follows can therefore never outrun
+durability of the replication obligation. Workers append DONE (no
+fsync needed for correctness: replaying a completed intent re-puts an
+identical object — replication is idempotent, so DONE is an
+optimization record and rides the next append's fsync or the page
+cache). Mount replay folds the segment last-record-per-intent-id and
+re-enqueues every intent without a DONE: a SIGKILL between ack and
+replication attempt replays the intent on remount.
+
+The segment is named `replication.wal` precisely so the drive mount's
+`segment_paths()` glob (journal*.wal) never picks it up — the drive
+fold and this journal own disjoint files; the record types still live
+in the one closed registry so every WAL dispatch site names them.
+
+Compaction: when the file outgrows `_COMPACT_BYTES` the live fold is
+rewritten into a fresh segment (tmp + fsync + rename, same discipline
+as walfmt.reset) so a long-lived node's journal stays bounded by its
+actual backlog, not its lifetime write count.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+import msgpack
+
+from minio_tpu.metaplane import wal as walfmt
+
+log = logging.getLogger("minio_tpu.replication")
+
+SEGMENT_NAME = "replication.wal"
+_COMPACT_BYTES = 4 << 20   # rewrite the segment past this size
+
+
+class ReplicationJournal:
+    """Append/replay over one replication WAL segment. Thread-safe:
+    workers append DONE records concurrently with the request path's
+    INTENT appends; one lock serializes the O_APPEND writes so frames
+    never interleave."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._mu = threading.Lock()
+        self._fd: int | None = None
+        self._seq = 0
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        if not os.path.exists(path):
+            walfmt.reset(path)
+
+    # -- id minting ----------------------------------------------------
+
+    def mint_id(self) -> str:
+        """Unique intent id: wall-clock ns + per-process counter. Ids
+        only need uniqueness within one segment lifetime; the counter
+        disambiguates same-nanosecond mints and the timestamp orders
+        replay across restarts."""
+        with self._mu:
+            self._seq += 1
+            return f"{time.time_ns():x}-{self._seq:x}"
+
+    # -- appends -------------------------------------------------------
+
+    def _open(self) -> int:
+        if self._fd is None:
+            self._fd = os.open(self.path,
+                               os.O_WRONLY | os.O_APPEND | os.O_CREAT,
+                               0o644)
+        return self._fd
+
+    def append_intent(self, bucket: str, intent_id: str, doc: dict) -> None:
+        """Durably journal one replication intent: append + fsync. The
+        caller must not enqueue (let alone ack) before this returns."""
+        raw = msgpack.packb(doc)
+        rec = walfmt.frame_record(walfmt.REC_REPL_INTENT, time.time(),
+                                  bucket, intent_id, raw)
+        with self._mu:
+            fd = self._open()
+            walfmt.append_records(fd, [rec])
+            # The lock IS the durability order: append+fsync must
+            # serialize here (the WAL group-commit contract).
+            # mtpu: allow(MTPU002)
+            os.fsync(fd)
+
+    def append_done(self, bucket: str, intent_id: str) -> None:
+        """Journal completion. No fsync: replaying an already-completed
+        intent re-applies an idempotent PUT/DELETE — DONE bounds replay
+        work, it does not carry acked state."""
+        rec = walfmt.frame_record(walfmt.REC_REPL_DONE, time.time(),
+                                  bucket, intent_id, b"")
+        with self._mu:
+            fd = self._open()
+            walfmt.append_records(fd, [rec])
+
+    # -- replay / maintenance ------------------------------------------
+
+    def replay(self) -> list[tuple[str, dict]]:
+        """Unfinished intents in append order: every INTENT without a
+        matching DONE, as (intent_id, task doc). Torn tails truncate
+        cleanly (walfmt.scan contract); an INTENT whose doc fails to
+        decode is dropped — it was CRC-valid, so this only happens
+        across an incompatible format change, and a dropped intent
+        degrades to the resync pass re-discovering the PENDING status."""
+        live: dict[str, tuple[str, dict]] = {}
+        order: list[str] = []
+        for rec in walfmt.scan(self.path):
+            # The non-replication registry members all fall through to
+            # the explicit foreign-type skip below.
+            # mtpu: allow(MTPU009)
+            if rec.rtype == walfmt.REC_REPL_DONE:
+                live.pop(rec.path, None)
+                continue
+            if rec.rtype != walfmt.REC_REPL_INTENT:
+                continue   # foreign record type: not ours to replay
+            try:
+                doc = msgpack.unpackb(rec.raw, strict_map_key=False)
+            except Exception:  # noqa: BLE001 - unreadable doc, see above
+                log.warning("replication intent %s: undecodable doc "
+                            "dropped (resync rediscovers by status)",
+                            rec.path)
+                continue
+            if rec.path not in live:
+                order.append(rec.path)
+            live[rec.path] = (rec.volume, doc)
+        return [(iid, live[iid][1]) for iid in order if iid in live]
+
+    def backlog(self) -> int:
+        return len(self.replay())
+
+    def maybe_compact(self) -> bool:
+        """Rewrite the segment down to its live fold once it outgrows
+        the compaction bound. Returns True when a rewrite happened."""
+        try:
+            if os.path.getsize(self.path) < _COMPACT_BYTES:
+                return False
+        except OSError:
+            return False
+        with self._mu:
+            live = {}
+            for rec in walfmt.scan(self.path):
+                # Foreign registry members are dropped by compaction:
+                # replay skipped them as not-ours already.
+                # mtpu: allow(MTPU009)
+                if rec.rtype == walfmt.REC_REPL_DONE:
+                    live.pop(rec.path, None)
+                elif rec.rtype == walfmt.REC_REPL_INTENT:
+                    live[rec.path] = rec
+            tmp = self.path + ".compact"
+            fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+            try:
+                # Compaction is cold and MUST exclude appends — a
+                # frame written mid-rewrite would be silently lost.
+                # mtpu: allow(MTPU002)
+                os.write(fd, walfmt.MAGIC)
+                recs = [walfmt.frame_record(r.rtype, r.mt, r.volume,
+                                            r.path, r.raw)
+                        for r in live.values()]
+                if recs:
+                    walfmt.append_records(fd, recs)
+                # mtpu: allow(MTPU002)
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            os.replace(tmp, self.path)
+            if self._fd is not None:
+                os.close(self._fd)   # reopen on next append (new inode)
+                self._fd = None
+        return True
+
+    def close(self) -> None:
+        with self._mu:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None

@@ -1,25 +1,60 @@
-"""ReplicationPool — async workers draining the replication queue.
+"""ReplicationPool — durable, partition-tolerant replication workers.
 
 Role-equivalent of cmd/bucket-replication.go:810-859 (resizable worker
-pool) + replicateObject:566: tasks carry (bucket, key, version, op); a
-worker reads the object locally, pushes it to the bucket's remote target
-with the replica marker, and flips the source's
-x-amz-replication-status PENDING → COMPLETED/FAILED. Targets come from
-the bucket metadata targets registry (cmd/bucket-targets.go).
+pool) + replicateObject:566, rebuilt on the system's durability and
+fault contracts (docs/REPLICATION.md):
+
+- **Durable intents**: `queue_task` appends + fsyncs a replication
+  intent through `journal.ReplicationJournal` BEFORE the task enters
+  the in-memory queue; workers append DONE once the far cluster
+  acknowledged. Boot replay re-enqueues every unfinished intent, so a
+  SIGKILL between the S3 ack and the replication attempt cannot lose
+  the obligation.
+- **Retry fabric**: failed attempts requeue with bounded, jittered
+  exponential backoff (MTPU_REPL_RETRY_*); the per-target circuit
+  breaker + token-bucket retry budget live in client.py and mirror
+  dist/rpc.py — an OPEN target costs zero socket work per task.
+- **Resync MRF**: a background pass (and scanner/admin triggers)
+  re-walks the journal backlog and PENDING/FAILED statuses and
+  requeues them, bandwidth-metered (MTPU_REPL_RESYNC_BPS) — the MRF
+  requeue discipline the heal path already follows.
+- **Ordering**: tasks route to workers by key hash, so one key's
+  PUT/DELETE history replays in order even with workers > 1; retries
+  re-read the source at attempt time, so a retried PUT can never
+  resurrect a key its DELETE already removed on the far side.
+- **Attribution**: workers bind the reserved `!replication` QoS tenant
+  (backlog drain never starves foreground tenants under MTPU_QOS=1)
+  and publish `replication` trace records + `minio_tpu_replication_*`
+  metric families.
+
+Targets come from the bucket metadata targets registry
+(cmd/bucket-targets.go).
 """
 
 from __future__ import annotations
 
+import heapq
 import json
 import logging
+import os
 import queue
+import random
 import threading
+import time
+import zlib
 from dataclasses import dataclass
 
+from minio_tpu import obs, qos
 from minio_tpu.erasure.types import ObjectOptions
+from minio_tpu.obs import flight
 from minio_tpu.replication.client import RemoteS3Client, RemoteS3Error
+from minio_tpu.replication.journal import SEGMENT_NAME, ReplicationJournal
 from minio_tpu.replication.rules import (
     META_STATUS,
+    STATUS_COMPLETED,
+    STATUS_FAILED,
+    STATUS_PENDING,
+    STATUS_REPLICA,
     ReplicationConfig,
     parse_replication_xml,
 )
@@ -29,6 +64,30 @@ log = logging.getLogger("minio_tpu.replication")
 
 OP_PUT = "put"
 OP_DELETE = "delete"
+# Closed op registry (MTPU009): the worker dispatch and the journal
+# replay both key on these strings (they ride the msgpack intent doc).
+REPL_OPS = {
+    "OP_PUT": OP_PUT,
+    "OP_DELETE": OP_DELETE,
+}
+
+# Reserved QoS tenant for replication traffic. '!' can never appear in
+# a real access key (sigv4 credential scope), so the lane cannot
+# collide with a foreground tenant.
+REPL_TENANT = "!replication"
+
+_QUEUED = obs.counter("minio_tpu_replication_queued_total",
+                      "Replication tasks accepted into the queue")
+_COMPLETED = obs.counter("minio_tpu_replication_completed_total",
+                         "Replication tasks acknowledged by the target")
+_FAILED = obs.counter("minio_tpu_replication_failed_total",
+                      "Replication attempts that failed")
+_REQUEUED = obs.counter("minio_tpu_replication_requeued_total",
+                        "Tasks requeued by retry backoff or resync")
+_SHED = obs.counter("minio_tpu_replication_shed_total",
+                    "Tasks shed on a full queue (journal/resync recover)")
+_BACKLOG = obs.gauge("minio_tpu_replication_backlog",
+                     "Journaled intents not yet acknowledged by the target")
 
 
 @dataclass
@@ -37,6 +96,8 @@ class ReplicationTask:
     key: str
     version_id: str = ""
     op: str = OP_PUT
+    attempts: int = 0
+    intent_id: str = ""
 
 
 @dataclass
@@ -92,34 +153,109 @@ class BucketTargetSys:
 
 class ReplicationPool:
     def __init__(self, object_layer, bucket_meta, targets: BucketTargetSys,
-                 workers: int = 2, queue_size: int = 10000):
+                 workers: int = 0, queue_size: int = 0,
+                 journal_dir: str | None = None, node: str = "local"):
         self.obj = object_layer
         self.bucket_meta = bucket_meta
         self.targets = targets
-        self._q: queue.Queue = queue.Queue(maxsize=queue_size)
+        self.node = node or "local"   # faultplane src identity
+        workers = workers or int(os.environ.get("MTPU_REPL_WORKERS", "2"))
+        queue_size = queue_size or int(
+            os.environ.get("MTPU_REPL_QUEUE_SIZE", "10000"))
+        per_worker = max(1, queue_size // max(1, workers))
+        self._test_hold = float(
+            os.environ.get("MTPU_REPL_TEST_HOLD_S", "0") or 0)
+        self._retry_max = int(os.environ.get("MTPU_REPL_RETRY_MAX", "5"))
+        self._retry_interval = float(
+            os.environ.get("MTPU_REPL_RETRY_INTERVAL", "1.0"))
+        self._retry_cap = float(os.environ.get("MTPU_REPL_RETRY_CAP", "30"))
+        self._resync_interval = float(
+            os.environ.get("MTPU_REPL_RESYNC_INTERVAL", "30"))
+        self._resync_bps = float(os.environ.get("MTPU_REPL_RESYNC_BPS", "0"))
+
+        self._stats_mu = threading.Lock()
+        self.stats = {"queued": 0, "completed": 0, "failed": 0,
+                      "requeued": 0, "shed": 0, "replayed": 0,
+                      "skipped": 0, "meta_errors": 0}
+        self._backlog = 0
+        # Refcount of queued/in-flight/retry-parked tasks per
+        # bucket\x00key — resync's dedup guard, nothing more (normal
+        # queueing never consults it).
+        self._live: dict[str, int] = {}
+
+        self._clients: dict[tuple, RemoteS3Client] = {}
+        self._clients_mu = threading.Lock()
+
+        self._retry: list[tuple[float, int, ReplicationTask]] = []
+        self._retry_seq = 0
+        self._retry_mu = threading.Lock()
+        self._last_resync = time.monotonic()
+        self._resync_mu = threading.Lock()
+
+        self._journal: ReplicationJournal | None = None
+        if os.environ.get("MTPU_REPL_JOURNAL", "1") == "1":
+            root = journal_dir
+            if root is None:
+                drives = getattr(object_layer, "drives", None)
+                if drives is None:
+                    # Pools/sets layers expose all_drives(); remote
+                    # drives have no local root and are skipped below.
+                    all_drives = getattr(object_layer, "all_drives", None)
+                    drives = all_drives() if callable(all_drives) else []
+                for d in drives:
+                    r = getattr(d, "root", None)
+                    if r:
+                        root = os.path.join(r, ".mtpu.sys", "wal")
+                        break
+            if root:
+                try:
+                    self._journal = ReplicationJournal(
+                        os.path.join(root, SEGMENT_NAME))
+                except OSError as e:
+                    log.warning("replication journal disabled: %s", e)
+
         self._stop = False
+        self._queues: list[queue.Queue] = [
+            queue.Queue(maxsize=per_worker) for _ in range(workers)]
         self._threads: list[threading.Thread] = []
-        self.resize(workers)
-        self.stats = {"queued": 0, "completed": 0, "failed": 0}
+        self._inflight = 0
+        for i in range(workers):
+            t = threading.Thread(target=self._worker, args=(i,), daemon=True,
+                                 name=f"replication-{i}")
+            t.start()
+            self._threads.append(t)
+        self._replay_journal()
+        self._pump_thread = threading.Thread(
+            target=self._pump, daemon=True, name="replication-pump")
+        self._pump_thread.start()
 
     # -- pool management (resizable, :810-849) --
 
     def resize(self, workers: int) -> None:
+        """Grow the pool. Each new worker brings its own queue; key-hash
+        routing re-shards, so in-queue ordering only holds for tasks
+        queued after the resize — grow at boot, not mid-storm."""
         while len(self._threads) < workers:
-            t = threading.Thread(target=self._worker, daemon=True,
-                                 name=f"replication-{len(self._threads)}")
+            i = len(self._threads)
+            self._queues.append(queue.Queue(
+                maxsize=max(1, self._queues[0].maxsize)))
+            t = threading.Thread(target=self._worker, args=(i,), daemon=True,
+                                 name=f"replication-{i}")
             t.start()
             self._threads.append(t)
 
     def close(self) -> None:
         self._stop = True
-        for _ in self._threads:
+        for q in self._queues:
             try:
-                self._q.put_nowait(None)
+                q.put_nowait(None)
             except queue.Full:
-                pass
+                pass   # workers poll with a timeout and see _stop
         for t in self._threads:
             t.join(timeout=2.0)
+        self._pump_thread.join(timeout=2.0)
+        if self._journal is not None:
+            self._journal.close()
 
     # -- config resolution --
 
@@ -131,6 +267,16 @@ class ReplicationPool:
             return parse_replication_xml(raw)
         except ValueError:
             return None
+
+    def describe(self) -> dict:
+        """Admin replication-status document."""
+        with self._stats_mu:
+            out = dict(self.stats)
+            out["backlog"] = self._backlog
+        out["retry_parked"] = len(self._retry)
+        from minio_tpu.replication import client as _client
+        out["targets"] = _client.breaker_infos()
+        return out
 
     # -- enqueue (called from the data path; never blocks) --
 
@@ -144,75 +290,367 @@ class ReplicationPool:
         if task.op == OP_DELETE and not (rule.delete_marker_replication
                                          or rule.delete_replication):
             return False
+        if self._journal is not None and not task.intent_id:
+            t0 = time.perf_counter()
+            task.intent_id = self._journal.mint_id()
+            self._journal.append_intent(
+                task.bucket, task.intent_id,
+                {"bucket": task.bucket, "key": task.key,
+                 "version_id": task.version_id, "op": task.op})
+            with self._stats_mu:
+                self._backlog += 1
+                _BACKLOG.set(self._backlog)
+            flight.stamp("repl_journal", time.perf_counter() - t0,
+                         "replication")
+        return self._submit(task)
+
+    def _route(self, task: ReplicationTask) -> int:
+        h = zlib.crc32(f"{task.bucket}/{task.key}".encode())
+        return h % len(self._queues)
+
+    def _submit(self, task: ReplicationTask) -> bool:
+        lk = f"{task.bucket}\x00{task.key}"
         try:
-            self._q.put_nowait(task)
-            self.stats["queued"] += 1
-            return True
+            self._queues[self._route(task)].put_nowait(task)
         except queue.Full:
+            # The durable intent (if journaled) survives the shed;
+            # replay or the next resync pass re-discovers it.
+            with self._stats_mu:
+                self.stats["shed"] += 1
+            _SHED.labels().inc()
             return False
+        with self._stats_mu:
+            self.stats["queued"] += 1
+            self._live[lk] = self._live.get(lk, 0) + 1
+        _QUEUED.labels().inc()
+        if obs.has_subscribers():
+            obs.publish({"type": "replication", "time": time.time(),
+                         "event": "queued", "bucket": task.bucket,
+                         "key": task.key, "op": task.op,
+                         "attempts": task.attempts})
+        return True
 
     def drain(self, timeout: float = 10.0) -> None:
-        """Tests/shutdown: wait until the queue empties."""
-        import time
-
+        """Tests/shutdown: wait until queues + in-flight tasks empty."""
         deadline = time.monotonic() + timeout
-        while not self._q.empty() and time.monotonic() < deadline:
+        while time.monotonic() < deadline:
+            if all(q.empty() for q in self._queues) and self._inflight == 0:
+                break   # retry-parked tasks intentionally don't block
+                        # drain; tests wait on backlog/remote state
             time.sleep(0.02)
         time.sleep(0.05)  # let in-flight tasks finish status writes
 
+    # -- journal replay / retry pump / resync --
+
+    def _replay_journal(self) -> None:
+        if self._journal is None:
+            return
+        pending = self._journal.replay()
+        with self._stats_mu:
+            self._backlog = len(pending)
+            _BACKLOG.set(self._backlog)
+        for iid, doc in pending:
+            try:
+                task = ReplicationTask(doc["bucket"], doc["key"],
+                                       doc.get("version_id", ""),
+                                       doc.get("op", OP_PUT),
+                                       intent_id=iid)
+            except (KeyError, TypeError):
+                continue   # unreadable doc; resync rediscovers by status
+            if self._submit(task):
+                with self._stats_mu:
+                    self.stats["replayed"] += 1
+
+    def _pump(self) -> None:
+        """Retry dispatcher + resync timer + journal compaction."""
+        while not self._stop:
+            time.sleep(0.2)
+            now = time.monotonic()
+            due = []
+            with self._retry_mu:
+                while self._retry and self._retry[0][0] <= now:
+                    due.append(heapq.heappop(self._retry)[2])
+            for task in due:
+                self._release(task)
+                self._submit(task)
+            if (self._resync_interval > 0
+                    and now - self._last_resync >= self._resync_interval):
+                try:
+                    self.resync_once()
+                except Exception:  # noqa: BLE001 - pump must survive
+                    log.exception("replication resync pass failed")
+            if self._journal is not None:
+                try:
+                    self._journal.maybe_compact()
+                except OSError as e:
+                    log.warning("replication journal compaction: %s", e)
+
+    def _schedule_retry(self, task: ReplicationTask) -> bool:
+        task.attempts += 1
+        if task.attempts > self._retry_max:
+            return False   # persistent backlog: journal intent + FAILED
+                           # status remain; resync owns it from here
+        delay = min(self._retry_cap,
+                    self._retry_interval * (1 << (task.attempts - 1)))
+        delay *= random.uniform(0.5, 1.5)
+        with self._retry_mu:
+            self._retry_seq += 1
+            heapq.heappush(self._retry,
+                           (time.monotonic() + delay, self._retry_seq, task))
+        with self._stats_mu:
+            self.stats["requeued"] += 1
+        _REQUEUED.labels().inc()
+        return True
+
+    def resync_once(self, bucket: str = "", force: bool = False) -> dict:
+        """The MRF pass: requeue the journal backlog plus every
+        PENDING/FAILED status, bounded by queue capacity and metered to
+        MTPU_REPL_RESYNC_BPS. Timer-driven (MTPU_REPL_RESYNC_INTERVAL),
+        scanner-hooked, and admin-triggerable (force bypasses the
+        interval gate)."""
+        now = time.monotonic()
+        with self._resync_mu:
+            if not force and now - self._last_resync < self._resync_interval:
+                return {"skipped": True}
+            self._last_resync = now
+        requeued = scanned = 0
+        budget_t0 = time.monotonic()
+        budget_bytes = 0
+
+        def meter(size: int) -> None:
+            nonlocal budget_bytes
+            if self._resync_bps <= 0:
+                return
+            budget_bytes += size
+            ahead = (budget_bytes / self._resync_bps
+                     - (time.monotonic() - budget_t0))
+            if ahead > 0:
+                time.sleep(min(ahead, 1.0))
+
+        # 1) Journal backlog: intents that were shed or exhausted their
+        # retries. _live-guarded so a queued/parked task never doubles.
+        if self._journal is not None:
+            for iid, doc in self._journal.replay():
+                try:
+                    task = ReplicationTask(doc["bucket"], doc["key"],
+                                           doc.get("version_id", ""),
+                                           doc.get("op", OP_PUT),
+                                           intent_id=iid)
+                except (KeyError, TypeError):
+                    continue
+                lk = f"{task.bucket}\x00{task.key}"
+                with self._stats_mu:
+                    if self._live.get(lk, 0) > 0:
+                        continue
+                if not self._submit(task):
+                    break   # queue full: next pass continues
+                requeued += 1
+                with self._stats_mu:
+                    self.stats["requeued"] += 1
+                _REQUEUED.labels().inc()
+
+        # 2) Status walk: PENDING/FAILED objects whose intents were
+        # never journaled (journal disabled / unreadable doc).
+        try:
+            buckets = [bucket] if bucket else [
+                b.name for b in self.obj.list_buckets()]
+        except (se.ObjectError, se.StorageError, AttributeError):
+            buckets = []
+        for b in buckets:
+            if self.config_for(b) is None:
+                continue
+            marker = ""
+            while True:
+                try:
+                    res = self.obj.list_objects(b, marker=marker,
+                                                max_keys=500)
+                except (se.ObjectError, se.StorageError):
+                    break
+                for info in res.objects:
+                    scanned += 1
+                    status = info.user_defined.get(META_STATUS, "")
+                    if not status or status in (STATUS_COMPLETED,
+                                                STATUS_REPLICA):
+                        continue
+                    if status in (STATUS_PENDING, STATUS_FAILED):
+                        lk = f"{b}\x00{info.name}"
+                        with self._stats_mu:
+                            if self._live.get(lk, 0) > 0:
+                                continue
+                        task = ReplicationTask(b, info.name,
+                                               op=OP_PUT)
+                        if not self.queue_task(task):
+                            continue
+                        requeued += 1
+                        with self._stats_mu:
+                            self.stats["requeued"] += 1
+                        _REQUEUED.labels().inc()
+                        meter(info.size)
+                if not res.is_truncated:
+                    break
+                marker = res.next_marker
+        return {"requeued": requeued, "scanned": scanned}
+
     # -- the worker --
 
-    def _worker(self) -> None:
+    def _client_for(self, target: BucketTarget) -> RemoteS3Client:
+        key = (target.endpoint, target.access_key)
+        with self._clients_mu:
+            c = self._clients.get(key)
+            if c is None:
+                c = RemoteS3Client(target.endpoint, target.access_key,
+                                   target.secret_key, region=target.region,
+                                   fault_src=self.node)
+                self._clients[key] = c
+            return c
+
+    def set_node(self, node: str) -> None:
+        """Late-bind the faultplane identity (attach_cluster runs after
+        pool construction)."""
+        self.node = node or "local"
+        with self._clients_mu:
+            for c in self._clients.values():
+                c.fault_src = self.node
+                c.breaker.fault_src = self.node
+
+    def _worker(self, idx: int) -> None:
+        qos.bind_key(REPL_TENANT)
+        q = self._queues[idx]
         while not self._stop:
-            task = self._q.get()
+            try:
+                task = q.get(timeout=0.25)
+            except queue.Empty:
+                continue
             if task is None:
                 return
+            if self._test_hold > 0:
+                # Crash-matrix hook: pin the window between the S3 ack
+                # and the first replication attempt (test_replication).
+                time.sleep(self._test_hold)
+            with self._stats_mu:
+                self._inflight += 1
             try:
                 self._replicate(task)
             except Exception:  # noqa: BLE001 - worker must survive
                 log.exception("replication task failed hard: %s", task)
+                self._release(task)
+            finally:
+                with self._stats_mu:
+                    self._inflight -= 1
+
+    def _release(self, task: ReplicationTask) -> None:
+        lk = f"{task.bucket}\x00{task.key}"
+        with self._stats_mu:
+            n = self._live.get(lk, 0) - 1
+            if n > 0:
+                self._live[lk] = n
+            else:
+                self._live.pop(lk, None)
+
+    def _finish(self, task: ReplicationTask, outcome: str,
+                size: int = 0, dur: float = 0.0) -> None:
+        """Terminal bookkeeping: journal DONE, release the live ref,
+        count, trace."""
+        if task.intent_id and self._journal is not None:
+            self._journal.append_done(task.bucket, task.intent_id)
+            with self._stats_mu:
+                self._backlog = max(0, self._backlog - 1)
+                _BACKLOG.set(self._backlog)
+        self._release(task)
+        with self._stats_mu:
+            if outcome == "completed":
+                self.stats["completed"] += 1
+            else:
+                self.stats["skipped"] += 1
+        if outcome == "completed":
+            _COMPLETED.labels().inc()
+        if obs.has_subscribers():
+            obs.publish({"type": "replication", "time": time.time(),
+                         "event": outcome, "bucket": task.bucket,
+                         "key": task.key, "op": task.op, "bytes": size,
+                         "duration": dur, "attempts": task.attempts})
 
     def _replicate(self, task: ReplicationTask) -> None:
+        t0 = time.perf_counter()
         target = self.targets.get_target(task.bucket)
         cfg = self.config_for(task.bucket)
         rule = cfg.rule_for(task.key) if cfg else None
         if target is None or rule is None:
+            # Config/target removed after queueing: the obligation is
+            # void — retire the intent so it never replays.
+            self._finish(task, "skipped")
             return
-        client = RemoteS3Client(target.endpoint, target.access_key,
-                                target.secret_key, region=target.region)
+        client = self._client_for(target)
         dest_bucket = target.target_bucket or rule.target_bucket
 
+        size = 0
         if task.op == OP_DELETE:
             try:
                 client.delete_object(dest_bucket, task.key)
-                self.stats["completed"] += 1
+                ok = True
             except (RemoteS3Error, OSError):
-                self.stats["failed"] += 1
-            return
+                ok = False
+        else:
+            opts = ObjectOptions(version_id=task.version_id)
+            try:
+                info, stream = self.obj.get_object(task.bucket, task.key,
+                                                   opts=opts)
+            except (se.ObjectError, se.StorageError):
+                # Source gone — deleted before replication ran. Also the
+                # ordering backstop: a retried PUT re-reads at attempt
+                # time, so it can never resurrect a deleted key.
+                self._finish(task, "skipped")
+                return
+            headers = {META_STATUS: STATUS_REPLICA}
+            for k, v in info.user_defined.items():
+                if k.startswith("x-amz-meta-"):
+                    headers[k] = v
+            ct = info.user_defined.get("content-type")
+            if ct:
+                headers["content-type"] = ct
+            size = info.size
+            try:
+                # Streamed chunk-by-chunk: the erasure read iterator
+                # feeds the socket directly, never joined into one buf.
+                client.put_object(dest_bucket, task.key, stream, headers,
+                                  length=info.size)
+                ok = True
+            except (RemoteS3Error, OSError):
+                ok = False
+            finally:
+                close = getattr(stream, "close", None)
+                if close is not None:
+                    close()
+            self._write_status(
+                task, STATUS_COMPLETED if ok else STATUS_FAILED, opts)
 
-        opts = ObjectOptions(version_id=task.version_id)
-        try:
-            info, stream = self.obj.get_object(task.bucket, task.key,
-                                               opts=opts)
-            body = b"".join(stream)
-        except (se.ObjectError, se.StorageError):
-            return  # deleted before replication ran
-        headers = {"x-amz-replication-status": "REPLICA"}
-        for k, v in info.user_defined.items():
-            if k.startswith("x-amz-meta-"):
-                headers[k] = v
-        ct = info.user_defined.get("content-type")
-        if ct:
-            headers["content-type"] = ct
-        status = "COMPLETED"
-        try:
-            client.put_object(dest_bucket, task.key, body, headers)
-            self.stats["completed"] += 1
-        except (RemoteS3Error, OSError):
-            status = "FAILED"
+        dur = time.perf_counter() - t0
+        if ok:
+            self._finish(task, "completed", size, dur)
+            return
+        with self._stats_mu:
             self.stats["failed"] += 1
+        _FAILED.labels().inc()
+        if obs.has_subscribers():
+            obs.publish({"type": "replication", "time": time.time(),
+                         "event": "failed", "bucket": task.bucket,
+                         "key": task.key, "op": task.op,
+                         "duration": dur, "attempts": task.attempts})
+        if not self._schedule_retry(task):
+            # Retries exhausted: drop the live ref so resync may
+            # requeue; the journal intent + FAILED status persist as
+            # the durable backlog.
+            self._release(task)
+
+    def _write_status(self, task: ReplicationTask, status: str,
+                      opts: ObjectOptions) -> None:
         try:
             self.obj.put_object_metadata(
                 task.bucket, task.key, {META_STATUS: status}, opts)
-        except (se.ObjectError, se.StorageError):
-            pass
+        except (se.ObjectError, se.StorageError) as e:
+            # Never swallowed silently (MTPU003): a stale PENDING status
+            # is re-walked by resync, but the operator must see why.
+            log.warning("replication status write-back failed %s/%s: %s",
+                        task.bucket, task.key, e)
+            with self._stats_mu:
+                self.stats["meta_errors"] += 1

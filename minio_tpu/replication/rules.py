@@ -5,7 +5,23 @@ from __future__ import annotations
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass, field
 
-META_STATUS = "x-amz-replication-status"   # PENDING/COMPLETED/FAILED/REPLICA
+META_STATUS = "x-amz-replication-status"
+
+# Replication status lifecycle on the SOURCE object: PENDING at ack,
+# COMPLETED/FAILED after the attempt; REPLICA marks the far-side copy
+# so a bidirectional pair never replicates a replica back. Closed
+# registry (MTPU009): the resync pass dispatches on these — a status
+# added here without teaching resync would strand objects invisibly.
+STATUS_PENDING = "PENDING"
+STATUS_COMPLETED = "COMPLETED"
+STATUS_FAILED = "FAILED"
+STATUS_REPLICA = "REPLICA"
+REPL_STATUS_REGISTRY = {
+    "STATUS_PENDING": STATUS_PENDING,
+    "STATUS_COMPLETED": STATUS_COMPLETED,
+    "STATUS_FAILED": STATUS_FAILED,
+    "STATUS_REPLICA": STATUS_REPLICA,
+}
 
 
 def _strip(tag: str) -> str:

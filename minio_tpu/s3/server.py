@@ -385,7 +385,8 @@ class S3Server:
                                    interval=interval,
                                    heal_objects=heal_objects,
                                    tracker=self.update_tracker,
-                                   config=self.config)
+                                   config=self.config,
+                                   replication=self.replication)
         self.scanner.start()
 
     # Set by main() (the CLI entry point); embedded servers either leave it
@@ -423,6 +424,9 @@ class S3Server:
         # deployment it exists for. The chaos tier leans on it as the
         # documented remedy for a dead node's stale heal lock.
         self.local_locker = node.locker
+        # Replication's faultplane identity: partition rules between
+        # clusters name this node's advertised host:port as the source.
+        self.replication.set_node(node.node_name)
         obs.set_default_node(node.node_name)
         node.hooks.trace_bus = self.trace_bus
         node.hooks.console_bus = self.logger.console_bus
@@ -2419,8 +2423,8 @@ class S3Server:
         self._apply_object_lock(request, bucket, opts)
         repl_cfg = self.replication.config_for(bucket)
         if repl_cfg is not None and repl_cfg.rule_for(key) is not None:
-            from minio_tpu.replication.rules import META_STATUS
-            opts.user_defined[META_STATUS] = "PENDING"
+            from minio_tpu.replication.rules import META_STATUS, STATUS_PENDING
+            opts.user_defined[META_STATUS] = STATUS_PENDING
         spool, size = await self._spool_body(request, payload_hash,
                                              auth_sig, bucket)
         reader, size2 = self._maybe_compress_put(
@@ -2444,9 +2448,9 @@ class S3Server:
         self._emit(request, evt.OBJECT_CREATED_PUT, bucket, key,
                    size=info.size, etag=info.etag, version_id=info.version_id)
         if repl_cfg is not None:
-            from minio_tpu.replication.pool import ReplicationTask
+            from minio_tpu.replication.pool import OP_PUT, ReplicationTask
             self.replication.queue_task(ReplicationTask(
-                bucket, key, info.version_id))
+                bucket, key, info.version_id, op=OP_PUT))
         return web.Response(status=200, headers={**hdr, **extra})
 
     async def _put_part(self, request, bucket, key, upload_id, part_number,

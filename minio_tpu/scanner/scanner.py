@@ -34,7 +34,8 @@ class DataScanner:
     def __init__(self, object_layer, bucket_meta: BucketMetadataSys,
                  store=None, notifier=None,
                  interval: float = SCAN_INTERVAL,
-                 heal_objects: bool = False, tracker=None, config=None):
+                 heal_objects: bool = False, tracker=None, config=None,
+                 replication=None):
         self.obj = object_layer
         self.bucket_meta = bucket_meta
         # Config KV provider for the `heal` subsystem (bitrotscan toggle —
@@ -55,6 +56,11 @@ class DataScanner:
 
             tracker = UpdateTracker(self.store)
         self.tracker = tracker
+        # Replication MRF rider (docs/REPLICATION.md): each completed
+        # cycle nudges the pool's resync pass, so stranded
+        # PENDING/FAILED statuses requeue on the scanner cadence even
+        # if the pool's own timer thread died.
+        self.replication = replication
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -198,6 +204,11 @@ class DataScanner:
             except Exception:  # noqa: BLE001 - accounting is best-effort
                 log.exception("usage persist failed")
             self._clear_position()
+        if self.replication is not None:
+            try:
+                self.replication.resync_once()
+            except Exception:  # noqa: BLE001 - resync is best-effort here
+                log.exception("replication resync (scanner) failed")
         return fresh
 
     # -- mid-cycle checkpoint --

@@ -310,6 +310,47 @@ KNOB_DOCS: dict[str, tuple[str, str]] = {
         "Tenant weights, `key=weight,...` — key is "
         "`access_key/bucket`, `access_key`, or `*`; unlisted tenants "
         "weigh 1. Weights set DRR service ratio and backlog share."),
+    "MTPU_REPL_JOURNAL": (
+        "REPLICATION.md",
+        "`1` (default) journals every replication intent durably "
+        "before enqueue (replay on remount); `0` disables the journal "
+        "— a crash may then lose queued-but-unattempted replication."),
+    "MTPU_REPL_QUEUE_SIZE": (
+        "REPLICATION.md",
+        "Total in-memory replication queue capacity, split across "
+        "workers. Overflow sheds (counted) — journaled intents are "
+        "re-discovered by replay/resync."),
+    "MTPU_REPL_RESYNC_BPS": (
+        "REPLICATION.md",
+        "Resync (MRF) bandwidth meter in bytes/sec for requeued "
+        "object payloads; `0` (default) unmetered."),
+    "MTPU_REPL_RESYNC_INTERVAL": (
+        "REPLICATION.md",
+        "Seconds between automatic resync passes over the journal "
+        "backlog and PENDING/FAILED statuses; `0` disables the timer "
+        "(scanner and admin triggers still work)."),
+    "MTPU_REPL_RETRY_CAP": (
+        "REPLICATION.md",
+        "Upper bound in seconds on the per-task replication retry "
+        "backoff (exponential, jittered)."),
+    "MTPU_REPL_RETRY_INTERVAL": (
+        "REPLICATION.md",
+        "Base seconds for the per-task replication retry backoff "
+        "(doubles per attempt up to MTPU_REPL_RETRY_CAP)."),
+    "MTPU_REPL_RETRY_MAX": (
+        "REPLICATION.md",
+        "Bounded per-task replication attempts before the task parks "
+        "in the persistent backlog (journal intent + FAILED status) "
+        "for resync to requeue."),
+    "MTPU_REPL_TEST_HOLD_S": (
+        "REPLICATION.md",
+        "Test-only: worker holds this many seconds between dequeue "
+        "and the replication attempt — pins the ack-to-attempt crash "
+        "window for the SIGKILL replay matrix."),
+    "MTPU_REPL_WORKERS": (
+        "REPLICATION.md",
+        "Replication worker threads; tasks route to workers by key "
+        "hash, so per-key PUT/DELETE order holds at any width."),
     "MTPU_REQUIRE_AESGCM": (
         "",
         "`1` turns the stdlib-AEAD fallback (cryptography wheel "

@@ -57,7 +57,7 @@ CACHE_VERSION = 5
 _LOCK_CTORS = {"Lock", "RLock", "Condition"}
 _REG_NAME_RE = re.compile(
     r"^[A-Z0-9_]*(?:_OPS|_RECORD_TYPES|_REGISTRY|_REASONS)$")
-_REG_MEMBER_RE = re.compile(r"^(?:OP|REC|REASON)_[A-Z0-9_]+$")
+_REG_MEMBER_RE = re.compile(r"^(?:OP|REC|REASON|STATUS)_[A-Z0-9_]+$")
 _ENV_NAME_RE = re.compile(r"^MTPU_[A-Z0-9_]*$")
 
 _MEMO: dict[str, tuple[dict, "ProjectIndex"]] = {}

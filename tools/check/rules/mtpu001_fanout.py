@@ -25,7 +25,8 @@ from tools.check.rules.base import is_call_to, terminal_name
 _PACKAGES = ("minio_tpu/s3/", "minio_tpu/erasure/", "minio_tpu/dist/",
              "minio_tpu/storage/", "minio_tpu/dataplane/",
              "minio_tpu/metaplane/", "minio_tpu/frontdoor/",
-             "minio_tpu/scanner/", "minio_tpu/hottier/")
+             "minio_tpu/scanner/", "minio_tpu/hottier/",
+             "minio_tpu/replication/")
 
 
 @register

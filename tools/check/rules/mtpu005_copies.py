@@ -35,7 +35,10 @@ FILES = ("minio_tpu/erasure/objects.py", "minio_tpu/storage/local.py",
          "minio_tpu/erasure/healing.py",
          "minio_tpu/erasure/multipart.py",
          "minio_tpu/hottier/tier.py",
-         "minio_tpu/hottier/arena.py")
+         "minio_tpu/hottier/arena.py",
+         "minio_tpu/replication/pool.py",
+         "minio_tpu/replication/client.py",
+         "minio_tpu/replication/journal.py")
 
 _BUF_NAMES = {"buf", "buffer", "chunk", "payload", "body", "blob", "raw",
               "mv", "view", "frame", "tail", "head"}
