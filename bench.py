@@ -2206,6 +2206,29 @@ def bench_obs_overhead() -> dict:
     finally:
         flight.set_armed(was_armed)
 
+    # Exemplars (docs/SLO.md): disarmed observe must price identically
+    # to plain observe (one module-global bool check); armed pays the
+    # sampled capture. Run outside any trace context so armed captures
+    # take the no-trace-id early exit — the common hot-path case.
+    ex_was = obs.exemplars_armed()
+    obs.set_exemplars(False)
+    try:
+        ex_off_ns = ns_per_op(lambda: hist.observe(0.001), iters)
+    finally:
+        obs.set_exemplars(True, every=8)
+    try:
+        ex_on_ns = ns_per_op(lambda: hist.observe(0.001), iters)
+    finally:
+        obs.set_exemplars(ex_was)
+
+    # TSDB sampler (obs/tsdb.py): priced per-TICK, not per-op — nothing
+    # on any request path touches the ring; this is the background cost
+    # of one snapshot of the default family set.
+    from minio_tpu.obs import tsdb as obs_tsdb
+
+    db = obs_tsdb.TSDB(sample_s=3600)
+    tick_ns = ns_per_op(db.sample_now, 200)
+
     return {"metric": "obs_overhead_span_unwatched", "value": round(span_off, 1),
             "unit": "ns/op", "vs_baseline": 0.0,
             "span_subscribed_ns": round(span_on, 1),
@@ -2215,7 +2238,10 @@ def bench_obs_overhead() -> dict:
             "flight_disarmed_mark_ns": round(fl_mark_off, 1),
             "flight_armed_mark_ns": round(fl_mark_on, 1),
             "flight_armed_stamp_ns": round(fl_stamp_on, 1),
-            "flight_timeline_cycle_ns": round(fl_cycle_on, 1)}
+            "flight_timeline_cycle_ns": round(fl_cycle_on, 1),
+            "exemplar_disarmed_observe_ns": round(ex_off_ns, 1),
+            "exemplar_armed_observe_ns": round(ex_on_ns, 1),
+            "tsdb_sample_tick_ns": round(tick_ns, 1)}
 
 
 def bench_stage_breakdown() -> dict:
@@ -2458,6 +2484,12 @@ def main() -> int:
                         if out.get("error") else note)
     out["configs"] = configs
     out["wall_s"] = round(time.time() - t_start, 1)
+    # Host attribution (docs/SLO.md): every BENCH row carries the
+    # calibration fingerprint of the machine that produced it, so a
+    # result file can never be compared against the wrong host class.
+    from minio_tpu.obs import calibration
+
+    out["calibration"] = calibration.fingerprint()
     print(json.dumps(out))
     return 0
 

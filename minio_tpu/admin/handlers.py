@@ -86,9 +86,38 @@ class AdminAPI:
             return _json(usage)
         if op == "metrics" and m == "GET":
             self._authorize(identity, "admin:Prometheus")
+            from minio_tpu.admin.metrics import maybe_gzip
+
             body = await run(self.s._cluster_scrape)
-            return web.Response(body=body,
-                                headers={"Content-Type": PROM_CONTENT_TYPE})
+            body, enc = maybe_gzip(
+                body, request.headers.get("Accept-Encoding"))
+            headers = {"Content-Type": PROM_CONTENT_TYPE}
+            if enc:
+                headers["Content-Encoding"] = enc
+            return web.Response(body=body, headers=headers)
+        if op == "slo" and m == "GET":
+            # SLO plane (docs/SLO.md): burn-rate state federated across
+            # front-door workers (shm spool) and peers (rpc fan-out,
+            # deadline-bounded like the cluster scrape); `slo/history`
+            # dumps this node's metric ring for offline analysis.
+            self._authorize(identity, "admin:Prometheus")
+            if rest == "history":
+                from minio_tpu.obs import tsdb as _tsdb
+
+                secs = float(q.get("seconds", "0") or 0)
+                doc = await run(_tsdb.get().history, secs,
+                                q.get("prefix", ""))
+                return _gzjson({"node": getattr(self.s, "node_name", ""),
+                                "history": doc}, request)
+            if rest:
+                raise S3Error("MethodNotAllowed", resource=path)
+            from minio_tpu.admin.metrics import collect_cluster_slo
+
+            notif = (getattr(self.s, "notification", None)
+                     if q.get("all", "true") != "false" else None)
+            out = await run(collect_cluster_slo, notif,
+                            getattr(self.s, "node_name", ""))
+            return _gzjson(out, request)
 
         if op == "heal":
             self._authorize(identity, "admin:Heal")
@@ -783,3 +812,16 @@ def _heal_item(i) -> dict:
 def _json(doc) -> web.Response:
     return web.Response(body=json.dumps(doc).encode(),
                         content_type="application/json")
+
+
+def _gzjson(doc, request) -> web.Response:
+    """JSON response honoring Accept-Encoding: gzip — the SLO/history
+    answers carry whole metric rings and compress ~10x."""
+    from minio_tpu.admin.metrics import maybe_gzip
+
+    body, enc = maybe_gzip(json.dumps(doc).encode(),
+                           request.headers.get("Accept-Encoding"))
+    headers = {"Content-Type": "application/json"}
+    if enc:
+        headers["Content-Encoding"] = enc
+    return web.Response(body=body, headers=headers)

@@ -17,6 +17,12 @@ from minio_tpu import obs
 # version parameter; bare text/plain is rejected by strict clients.
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4"
 
+# OpenMetrics flavor (docs/SLO.md): same families, plus exemplar
+# annotations on histogram buckets and a trailing `# EOF`. Served when
+# the scraper's Accept header asks for it.
+OPENMETRICS_CONTENT_TYPE = ("application/openmetrics-text; "
+                            "version=1.0.0; charset=utf-8")
+
 # Per-peer budget for the federated cluster scrape: stragglers become
 # scrape errors, never a hung scrape (the whole fan-out runs under one
 # parallel_map deadline).
@@ -34,29 +40,66 @@ def _esc(v: str) -> str:
 
 
 class PromText:
-    def __init__(self):
+    """Text sink for the duck-typed family/sample render contract.
+    `openmetrics=True` switches on the exemplar-bearing flavor:
+    histogram vecs see `wants_exemplars` and pass captured
+    (trace_id, value, ts) tuples, rendered as
+    `... # {trace_id="..."} value ts` per the OpenMetrics exemplar
+    syntax, and `render()` appends the mandatory `# EOF`."""
+
+    def __init__(self, openmetrics: bool = False):
         self.lines: list[str] = []
+        self.openmetrics = openmetrics
+        self.wants_exemplars = openmetrics
 
     def family(self, name: str, help_: str, typ: str = "gauge") -> None:
         self.lines.append(f"# HELP {name} {help_}")
         self.lines.append(f"# TYPE {name} {typ}")
 
-    def sample(self, name: str, value, labels: dict | None = None) -> None:
+    def sample(self, name: str, value, labels: dict | None = None,
+               exemplar: tuple | None = None) -> None:
         if labels:
             lbl = ",".join(f'{k}="{_esc(str(v))}"'
                            for k, v in sorted(labels.items()))
-            self.lines.append(f"{name}{{{lbl}}} {value}")
+            line = f"{name}{{{lbl}}} {value}"
         else:
-            self.lines.append(f"{name} {value}")
+            line = f"{name} {value}"
+        if exemplar is not None and self.openmetrics:
+            tid, ex_val, ex_ts = exemplar
+            line += (f' # {{trace_id="{_esc(str(tid))}"}} '
+                     f"{ex_val} {round(float(ex_ts), 3)}")
+        self.lines.append(line)
 
     def render(self) -> bytes:
-        return ("\n".join(self.lines) + "\n").encode()
+        body = "\n".join(self.lines) + "\n"
+        if self.openmetrics:
+            body += "# EOF\n"
+        return body.encode()
+
+
+def wants_openmetrics(accept: str | None) -> bool:
+    """Content negotiation: any Accept mentioning the OpenMetrics media
+    type gets the exemplar-bearing flavor."""
+    return "application/openmetrics-text" in (accept or "")
+
+
+def maybe_gzip(body: bytes, accept_encoding: str | None,
+               min_size: int = 256) -> tuple[bytes, str | None]:
+    """(body, Content-Encoding header value or None): gzip when the
+    client advertises it and the body is big enough for the header
+    overhead to pay off."""
+    if "gzip" in (accept_encoding or "").lower() and len(body) >= min_size:
+        import gzip as _gzip
+
+        return _gzip.compress(body, 5), "gzip"
+    return body, None
 
 
 def collect_metrics(object_layer, stats, usage=None,
-                    started: float | None = None) -> bytes:
+                    started: float | None = None, *,
+                    openmetrics: bool = False) -> bytes:
     """One scrape (families mirror docs/metrics/prometheus/list.md)."""
-    p = PromText()
+    p = PromText(openmetrics)
 
     # -- process --
     p.family("minio_tpu_process_uptime_seconds", "Server uptime", "counter")
@@ -158,12 +201,12 @@ def _render_inflight(p: PromText, stats) -> None:
         p.sample("minio_tpu_s3_requests_inflight", n, {"api": api})
 
 
-def collect_node_metrics(stats) -> bytes:
+def collect_node_metrics(stats, *, openmetrics: bool = False) -> bytes:
     """Node-scope scrape (/minio/v2/metrics/node): this process's own
     planes — request/TTFB latency, per-drive op latency, RPC fabric —
     without the cluster-wide capacity/usage/health collectors (the
     reference's node vs cluster metrics-v2 split)."""
-    p = PromText()
+    p = PromText(openmetrics)
     p.family("minio_tpu_process_uptime_seconds", "Server uptime", "counter")
     p.sample("minio_tpu_process_uptime_seconds", round(stats.uptime(), 3))
     p.family("minio_tpu_s3_requests_current", "In-flight S3 requests")
@@ -179,7 +222,8 @@ def collect_node_metrics(stats) -> bytes:
 
 def collect_cluster_metrics(object_layer, stats, usage=None, *,
                             notification=None, local_name: str = "",
-                            deadline: float | None = None) -> bytes:
+                            deadline: float | None = None,
+                            openmetrics: bool = False) -> bytes:
     """The federated cluster scrape: this node's cluster collectors plus
     every peer's node-scope scrape (pulled over the peer `metrics` route),
     merged with each source's samples under a `server` label.
@@ -204,7 +248,11 @@ def collect_cluster_metrics(object_layer, stats, usage=None, *,
         for p, r in zip(peers, results):
             if isinstance(r, Exception) or not r:
                 _PEER_SCRAPE_ERRORS.labels(peer=p.name).inc()
-    body = collect_metrics(object_layer, stats, usage)
+    # Exemplars can't survive merge_expositions' relabeling, so the
+    # federated (multi-node) scrape always serves 0.0.4; only the
+    # single-node path honors OpenMetrics negotiation (docs/SLO.md).
+    body = collect_metrics(object_layer, stats, usage,
+                           openmetrics=openmetrics and not peers)
     if not peers:
         return body
     texts: list[tuple[str, str]] = [(local_name or "local", body.decode())]
@@ -213,6 +261,35 @@ def collect_cluster_metrics(object_layer, stats, usage=None, *,
             continue
         texts.append((p.name, bytes(r).decode()))
     return merge_expositions(texts)
+
+
+def collect_cluster_slo(notification=None, local_name: str = "",
+                        deadline: float | None = None) -> dict:
+    """The federated /slo answer: this node's worker-merged state plus
+    every peer's, pulled over the peer `slo` route under the same
+    parallel_map deadline discipline as the cluster scrape. A hung or
+    dead peer becomes an entry in `errors` and a
+    `minio_tpu_peer_scrape_errors_total{peer=...}` increment — the
+    fan-out always returns within the deadline."""
+    from minio_tpu.obs import slo as _slo
+
+    out: dict = {"nodes": {local_name or "local": _slo.collect_local()},
+                 "errors": []}
+    peers = list(notification.peers) if notification is not None else []
+    if peers:
+        from minio_tpu.erasure.metadata import parallel_map
+
+        results = parallel_map(
+            [p.slo for p in peers],
+            deadline=PEER_SCRAPE_DEADLINE if deadline is None
+            else deadline)
+        for p, r in zip(peers, results):
+            if isinstance(r, Exception) or not isinstance(r, dict):
+                _PEER_SCRAPE_ERRORS.labels(peer=p.name).inc()
+                out["errors"].append(p.name)
+                continue
+            out["nodes"][p.name] = r
+    return out
 
 
 def merge_expositions(sources: list[tuple[str, str]]) -> bytes:

@@ -235,11 +235,15 @@ _SAMPLE_RE = re.compile(
 
 def parse_exposition(text: str) -> dict[tuple[str, tuple], float]:
     """{(family_sample_name, sorted-label-items): value} — enough
-    structure to diff two scrapes and fold histogram buckets."""
+    structure to diff two scrapes and fold histogram buckets. Accepts
+    both flavors the exporter serves: OpenMetrics exemplar suffixes
+    (` # {trace_id=...} v ts`) are stripped before the sample parse."""
     out: dict[tuple[str, tuple], float] = {}
     for line in text.splitlines():
         if not line or line.startswith("#"):
             continue
+        if " # " in line:
+            line = line.split(" # ", 1)[0]
         m = _SAMPLE_RE.match(line)
         if m is None:
             continue
@@ -310,6 +314,16 @@ def counter_sum(samples: dict, family: str,
             continue
         total += v
     return total
+
+
+def window_from_ring(tsdb, seconds: float) -> dict:
+    """A `check_slos`-ready window from the on-node metric ring
+    (obs/tsdb.py) instead of two live scrapes: the ring's snapshots
+    share parse_exposition's key shape by construction, so the delta
+    drops straight into histogram_quantile/counter_sum/check_slos.
+    `tsdb` is an obs.tsdb.TSDB (e.g. obs.tsdb.get())."""
+    _span, window = tsdb.delta_window(seconds)
+    return window
 
 
 def check_slos(window: dict, seed: int = 0, *, p99_bound: float,

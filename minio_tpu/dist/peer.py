@@ -53,6 +53,9 @@ class PeerHooks:
         # node's stage timelines (admin perf/timeline federation).
         self.perf_timeline: Callable[[dict], dict] = lambda params: {
             "node": "", "timelines": []}
+        # SLO plane (obs/slo.py): this node's worker-merged burn-rate
+        # state, pulled by the federated GET /minio/admin/v3/slo.
+        self.slo: Callable[[], dict] = lambda: {}
 
 
 def _stream_bus(bus):
@@ -88,6 +91,9 @@ def peer_routes(hooks: PeerHooks) -> dict:
     def h_perf_timeline(params, body):
         return pack(hooks.perf_timeline(params or {}))
 
+    def h_slo(params, body):
+        return pack(hooks.slo())
+
     def h_trace(params, body):
         return _stream_bus(hooks.trace_bus)
 
@@ -113,6 +119,7 @@ def peer_routes(hooks: PeerHooks) -> dict:
             "obd_info": h_obd_info,
             "metrics": h_metrics,
             "perf_timeline": h_perf_timeline,
+            "slo": h_slo,
             "trace": h_trace,
             "consolelog": h_consolelog,
             "profile_start": h_profile_start,
@@ -193,6 +200,12 @@ class PeerClient:
         metrics(): a stalled query must not poison the fabric client."""
         return self._metrics_client().call_msgpack(
             f"/rpc/{PLANE}/v1/perf_timeline", params or {})
+
+    def slo(self) -> dict:
+        """The peer's worker-merged SLO burn-rate state (obs/slo.py).
+        Same dedicated observability client as metrics()."""
+        return self._metrics_client().call_msgpack(
+            f"/rpc/{PLANE}/v1/slo")
 
     def trace_stream(self, heartbeats: bool = False):
         """Iterator over the peer's trace records — the remote half of

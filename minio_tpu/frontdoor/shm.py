@@ -316,6 +316,8 @@ DEFAULT_FLIGHT_SLOT_BYTES = 4096
 class FlightSpool:
     """Per-worker shm ring of recent timeline snapshots (JSON)."""
 
+    MAGIC = FLIGHT_MAGIC
+
     def __init__(self, shm, nslots: int, cap: int, owner: bool):
         self._shm = shm
         self.nslots = nslots
@@ -341,7 +343,7 @@ class FlightSpool:
             stale.unlink()
             shm = shared_memory.SharedMemory(name=name, create=True,
                                              size=size)
-        _HDR.pack_into(shm.buf, 0, FLIGHT_MAGIC, nslots, cap)
+        _HDR.pack_into(shm.buf, 0, cls.MAGIC, nslots, cap)
         return cls(shm, nslots, cap, owner=True)
 
     @classmethod
@@ -358,9 +360,10 @@ class FlightSpool:
         except Exception:  # noqa: BLE001
             pass
         magic, nslots, cap = _HDR.unpack_from(shm.buf, 0)
-        if magic != FLIGHT_MAGIC:
+        if magic != cls.MAGIC:
             shm.close()
-            raise ValueError(f"shm segment {name!r} is not a flight spool")
+            raise ValueError(f"shm segment {name!r} is not a "
+                             f"{cls.__name__} spool")
         return cls(shm, nslots, cap, owner=False)
 
     @property
@@ -415,3 +418,28 @@ class FlightSpool:
                 self._shm.unlink()
             except OSError:
                 return
+
+
+# -- SLO state spool ----------------------------------------------------
+#
+# The SLO endpoint (obs/slo.py) needs every worker's latest evaluation,
+# but unlike timelines there is exactly ONE current state per worker —
+# so the spool is a single-slot mailbox: the engine overwrites its slot
+# after every evaluation, siblings attach read-only at query time. Same
+# torn-write tolerance as FlightSpool (length word cleared first,
+# stored last; a parse failure reads as "no state yet").
+
+STATE_MAGIC = b"MTPUSLS1"
+DEFAULT_STATE_BYTES = 32768
+
+
+class StateSpool(FlightSpool):
+    """Per-worker latest-JSON-state mailbox (FlightSpool with one
+    slot and its own magic)."""
+
+    MAGIC = STATE_MAGIC
+
+    @classmethod
+    def create(cls, name: str, nslots: int = 1,
+               cap: int = DEFAULT_STATE_BYTES) -> "StateSpool":
+        return super().create(name, nslots, cap)

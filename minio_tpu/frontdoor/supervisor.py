@@ -118,6 +118,9 @@ class Supervisor:
             # Flight-recorder spool base: worker i owns shm segment
             # f"{base}w{i}"; siblings attach read-only at query time.
             "MTPU_FLIGHT_SPOOL": self.flight_base,
+            # SLO state mailbox base (worker i owns f"{base}slo{i}") —
+            # shares the flight namespace so sweep covers both.
+            "MTPU_SLO_SPOOL": self.flight_base,
         })
         if self.ring is not None:
             env[frontdoor.RING_ENV] = self.ring.name
@@ -269,13 +272,14 @@ class Supervisor:
         from multiprocessing import shared_memory
 
         for i in range(self.workers):
-            try:
-                stale = shared_memory.SharedMemory(
-                    name=f"{self.flight_base}w{i}")
-            except OSError:
-                continue
-            stale.close()
-            try:
-                stale.unlink()
-            except OSError:
-                pass
+            for name in (f"{self.flight_base}w{i}",
+                         f"{self.flight_base}slo{i}"):
+                try:
+                    stale = shared_memory.SharedMemory(name=name)
+                except OSError:
+                    continue
+                stale.close()
+                try:
+                    stale.unlink()
+                except OSError:
+                    pass

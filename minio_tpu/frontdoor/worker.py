@@ -152,6 +152,56 @@ def _arm_flight(wid: int):
     return stop
 
 
+def _arm_slo(wid: int):
+    """Wire this worker's SLO engine into the cross-worker fabric,
+    mirroring _arm_flight: one shm StateSpool mailbox (`<base>slo<id>`,
+    base supervisor-stamped via MTPU_SLO_SPOOL) holds the worker's
+    latest burn-rate evaluation, and the /slo endpoint merges siblings'
+    mailboxes at query time (obs.slo.collect_local). Returns a stop
+    callable."""
+    from minio_tpu.obs import slo, tsdb
+
+    slo.set_worker(wid)
+    base = os.environ.get("MTPU_SLO_SPOOL", "")
+    if not (base and tsdb.armed()):
+        return lambda: None
+    from minio_tpu.frontdoor import shm
+
+    try:
+        spool = shm.StateSpool.create(f"{base}slo{wid}")
+    except (OSError, ValueError):
+        return lambda: None  # no spool: local state still serves
+
+    slo.attach_sink(spool.put)
+    nworkers = frontdoor.worker_count()
+
+    def read_siblings() -> list[dict]:
+        # Attach-per-query, same respawn reasoning as _arm_flight.
+        out = []
+        for o in range(nworkers):
+            if o == wid:
+                continue
+            try:
+                sib = shm.StateSpool.attach(f"{base}slo{o}")
+            except (OSError, ValueError):
+                continue
+            try:
+                out.extend(sib.read_all())
+            finally:
+                sib.close()
+        return out
+
+    slo.set_sibling_reader(read_siblings)
+
+    def stop():
+        slo.attach_sink(None)
+        slo.set_sibling_reader(None)
+        spool.close()
+        spool.unlink()
+
+    return stop
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="minio_tpu front-door worker")
     ap.add_argument("drives", nargs="+")
@@ -197,6 +247,7 @@ def main(argv=None) -> None:
 
     stop_lanes = _arm_shared_lanes(wid, srv)
     stop_flight = _arm_flight(wid)
+    stop_slo = _arm_slo(wid)
     if wid == 0:
         # One healer per pool of workers: N auto-healers racing the
         # same sets would duplicate every heal fan-out.
@@ -253,6 +304,7 @@ def main(argv=None) -> None:
         up.set(0)
         stop_lanes()
         stop_flight()
+        stop_slo()
         # Checkpoint this worker's WAL segments so a clean drain leaves
         # nothing for the next mount's replay fold.
         from minio_tpu.logger import get_logger

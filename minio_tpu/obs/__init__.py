@@ -27,12 +27,18 @@ from minio_tpu.obs.histogram import (  # noqa: F401
     Histogram,
     HistogramVec,
     counter,
+    exemplar_captures,
+    exemplars_armed,
     gauge,
     histogram,
     registry,
     render_into,
+    set_exemplars,
 )
+from minio_tpu.obs import calibration  # noqa: F401
 from minio_tpu.obs import flight  # noqa: F401
+from minio_tpu.obs import slo  # noqa: F401
+from minio_tpu.obs import tsdb  # noqa: F401
 from minio_tpu.obs.span import (  # noqa: F401
     Span,
     ctx_wrap,

@@ -10,12 +10,52 @@ every call.
 Rendering is duck-typed against admin.metrics.PromText (family/sample)
 so this module stays import-light and the admin exporter depends on us,
 never the reverse.
+
+OpenMetrics exemplars (docs/SLO.md): when armed (`MTPU_EXEMPLAR`, on by
+default), every `MTPU_EXEMPLAR_EVERY`-th observation that runs under a
+request trace context captures its trace id against the bucket it
+landed in, and the exporter renders it as an OpenMetrics exemplar
+annotation under content negotiation — a burning latency SLO links one
+click to `perf/timeline?traceid=`. Disarmed, the hot path pays one
+module-global bool check and allocates nothing; `exemplar_captures()`
+counts captures so the zero-overhead tests can assert exactly that.
 """
 
 from __future__ import annotations
 
 import bisect
+import os
 import threading
+import time
+
+# The closed set of label keys an exemplar annotation may carry (static
+# rule MTPU006 checks this literal against docs/SLO.md — a new exemplar
+# dimension must be documented before it can ship).
+EXEMPLAR_LABELS = ("trace_id",)
+
+_EX_ARMED = os.environ.get("MTPU_EXEMPLAR", "1") not in ("0", "false",
+                                                         "off")
+_EX_EVERY = max(1, int(os.environ.get("MTPU_EXEMPLAR_EVERY", "8") or 8))
+_ex_captures = 0
+_trace_id_fn = None  # lazily bound to obs.span.trace_id on first capture
+
+
+def exemplars_armed() -> bool:
+    return _EX_ARMED
+
+
+def set_exemplars(on: bool, every: int | None = None) -> None:
+    """Test/bench hook — the production gate is MTPU_EXEMPLAR at boot."""
+    global _EX_ARMED, _EX_EVERY
+    _EX_ARMED = bool(on)
+    if every is not None:
+        _EX_EVERY = max(1, int(every))
+
+
+def exemplar_captures() -> int:
+    """How many exemplars have ever been captured (zero-overhead guard:
+    must not move while disarmed)."""
+    return _ex_captures
 
 # Log-spaced seconds: 100us .. 10s, the spread between a cached journal
 # stat and a cold distributed PUT (reference metrics-v2 latency buckets).
@@ -26,24 +66,56 @@ LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
 class Histogram:
     """One labelset's distribution: counts per `le` bound + sum."""
 
-    __slots__ = ("buckets", "_counts", "_sum", "_mu")
+    __slots__ = ("buckets", "_counts", "_sum", "_mu", "_ex_n",
+                 "_exemplars")
 
     def __init__(self, buckets=LATENCY_BUCKETS):
         self.buckets = tuple(float(b) for b in buckets)
         self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
         self._sum = 0.0
         self._mu = threading.Lock()
+        self._ex_n = 0
+        # bucket index -> (trace_id, value, unix_ts). Written without
+        # the lock: a single dict-slot store is atomic under the GIL,
+        # and a reader racing an overwrite sees either exemplar — both
+        # valid. Sampling keeps the armed tax to one counter increment
+        # on most observes.
+        self._exemplars: dict[int, tuple] = {}
 
     def observe(self, value: float) -> None:
         i = bisect.bisect_left(self.buckets, value)
         with self._mu:
             self._counts[i] += 1
             self._sum += value
+        if _EX_ARMED:
+            self._ex_n += 1
+            if self._ex_n % _EX_EVERY == 0:
+                _capture_exemplar(self, i, value)
+
+    def exemplar(self, bucket_index: int) -> tuple | None:
+        """(trace_id, value, ts) captured for one bucket, or None."""
+        return self._exemplars.get(bucket_index)
 
     def snapshot(self) -> tuple[list[int], float]:
         """(per-bucket counts incl. +Inf, sum) — a consistent pair."""
         with self._mu:
             return list(self._counts), self._sum
+
+
+def _capture_exemplar(h: Histogram, i: int, value: float) -> None:
+    """Off the fast path (every Nth armed observe): bind the trace-id
+    accessor lazily (histogram stays import-light) and store the
+    latest exemplar for the bucket the observation landed in."""
+    global _trace_id_fn, _ex_captures
+    if _trace_id_fn is None:
+        from minio_tpu.obs.span import trace_id
+
+        _trace_id_fn = trace_id
+    tid = _trace_id_fn()
+    if not tid:
+        return
+    h._exemplars[i] = (tid, value, time.time())
+    _ex_captures += 1
 
 
 class HistogramVec:
@@ -66,18 +138,34 @@ class HistogramVec:
 
     def render_into(self, p) -> None:
         p.family(self.name, self.help, "histogram")
-        for key, h in sorted(self._children.items()):
+        # Snapshot the child map under the vec lock: a concurrent
+        # labels() insert during a scrape must never tear the family
+        # (RuntimeError mid-iteration, or a half-rendered labelset).
+        with self._mu:
+            children = sorted(self._children.items())
+        want_ex = getattr(p, "wants_exemplars", False)
+        for key, h in children:
             counts, total = h.snapshot()
             base = dict(zip(self.labelnames, key))
             cum = 0
-            for bound, c in zip(self.buckets, counts):
+            for i, (bound, c) in enumerate(zip(self.buckets, counts)):
                 cum += c
-                p.sample(f"{self.name}_bucket", cum,
-                         {**base, "le": _fmt(bound)})
+                self._bucket(p, cum, {**base, "le": _fmt(bound)},
+                             h.exemplar(i) if want_ex else None)
             cum += counts[-1]
-            p.sample(f"{self.name}_bucket", cum, {**base, "le": "+Inf"})
+            self._bucket(p, cum, {**base, "le": "+Inf"},
+                         h.exemplar(len(self.buckets)) if want_ex
+                         else None)
             p.sample(f"{self.name}_sum", round(total, 6), base or None)
             p.sample(f"{self.name}_count", cum, base or None)
+
+    def _bucket(self, p, cum, labels, ex) -> None:
+        # Exemplars travel by keyword only when present, so plain
+        # PromText-shaped sinks without the parameter keep working.
+        if ex is not None:
+            p.sample(f"{self.name}_bucket", cum, labels, exemplar=ex)
+        else:
+            p.sample(f"{self.name}_bucket", cum, labels)
 
 
 class CounterVec:
@@ -98,7 +186,9 @@ class CounterVec:
 
     def render_into(self, p) -> None:
         p.family(self.name, self.help, "counter")
-        for key, c in sorted(self._children.items()):
+        with self._mu:
+            children = sorted(self._children.items())
+        for key, c in children:
             p.sample(self.name, c.value,
                      dict(zip(self.labelnames, key)) or None)
 
@@ -136,7 +226,9 @@ class GaugeVec:
 
     def render_into(self, p) -> None:
         p.family(self.name, self.help, "gauge")
-        for key, g in sorted(self._children.items()):
+        with self._mu:
+            children = sorted(self._children.items())
+        for key, g in children:
             p.sample(self.name, round(g.value, 6),
                      dict(zip(self.labelnames, key)) or None)
 

@@ -28,9 +28,12 @@ from minio_tpu.obs import flight
 from minio_tpu.admin.configkv import ConfigSys
 from minio_tpu.admin.handlers import ADMIN_PREFIX, AdminAPI
 from minio_tpu.admin.metrics import (
+    OPENMETRICS_CONTENT_TYPE,
     PROM_CONTENT_TYPE,
     collect_cluster_metrics,
     collect_node_metrics,
+    maybe_gzip,
+    wants_openmetrics,
 )
 from minio_tpu.admin.stats import HTTPStats
 from minio_tpu.bucket import objectlock as olock
@@ -294,6 +297,20 @@ class S3Server:
         self.tiers = TierRegistry(sealed)
         set_global(self.tiers)
         self.admin = AdminAPI(self)
+
+        # SLO plane (docs/SLO.md): arm the on-node metric ring + burn-
+        # rate engine (no-op under MTPU_SLO=0), persist coarse history
+        # through the sys store, and feed the exporter-side per-API
+        # counters into the ring. Keyed source: a rebuilt server in the
+        # same process replaces its predecessor's stats feed.
+        from minio_tpu.obs import calibration as _calibration
+        from minio_tpu.obs import slo as _slo
+        _calibration.publish_build_info()
+        _slo_engine = _slo.ensure_started(store=store)
+        if _slo_engine is not None:
+            _slo_engine.db.add_source(self._slo_stats_source,
+                                      key="s3-stats")
+
         self.local_locker = None  # set by the cluster node when distributed
         self.notification = notification_sys  # peer fan-out (distributed)
         self.cluster_node = None
@@ -311,7 +328,7 @@ class S3Server:
         from minio_tpu.s3.web import WebAPI
         self.web = WebAPI(self)
 
-    def _cluster_scrape(self) -> bytes:
+    def _cluster_scrape(self, openmetrics: bool = False) -> bytes:
         """The federated cluster scrape — ONE definition shared by
         /minio/v2/metrics/cluster and its /minio/admin/v3/metrics mirror
         (docs promise they match). Blocking; run in an executor."""
@@ -319,7 +336,24 @@ class S3Server:
             self.obj, self.stats,
             self.scanner.usage if self.scanner else None,
             notification=self.notification,
-            local_name=self.node_name)
+            local_name=self.node_name,
+            openmetrics=openmetrics)
+
+    def _has_peers(self) -> bool:
+        return bool(self.notification is not None
+                    and getattr(self.notification, "peers", None))
+
+    def _slo_stats_source(self):
+        """TSDB source (obs/tsdb.py): the HTTPStats-derived per-API
+        request/error counters only exist exporter-side, so the ring
+        samples them through this closure."""
+        snap = self.stats.snapshot()
+        for api, s in snap["apis"].items():
+            lbl = {"api": api}
+            yield "minio_tpu_s3_requests_total", lbl, s["count"]
+            yield "minio_tpu_s3_requests_errors_total", lbl, s["errors"]
+            yield ("minio_tpu_s3_requests_5xx_errors_total", lbl,
+                   s["5xx"])
 
     def _cors_origin(self) -> str:
         """api.cors_allow_origin, cached against the config generation —
@@ -441,6 +475,10 @@ class S3Server:
         # exposition over the peer plane and merge it under a `server`
         # label (admin/metrics.collect_cluster_metrics).
         node.hooks.metrics = lambda: collect_node_metrics(self.stats)
+        # SLO federation: peers pull this node's worker-merged burn-rate
+        # state for the federated GET /minio/admin/v3/slo.
+        from minio_tpu.obs import slo as _slo
+        node.hooks.slo = _slo.collect_local
 
     def configure_logging(self) -> None:
         """(Re)build log/audit targets from the config KV store — the
@@ -1141,12 +1179,23 @@ class S3Server:
                 self.admin.authorize_http(request, identity,
                                           "admin:Prometheus")
                 loop = asyncio.get_running_loop()
+                # OpenMetrics (exemplars) only applies single-node: the
+                # multi-node merge relabels samples and cannot carry
+                # exemplar suffixes (docs/SLO.md).
+                om = (wants_openmetrics(request.headers.get("Accept"))
+                      and not self._has_peers())
                 # Federated: peer node scrapes merge in under a `server`
                 # label, deadline-bounded (a hung peer becomes a scrape
                 # error, never a hung scrape).
-                body = await loop.run_in_executor(None, self._cluster_scrape)
-                return web.Response(
-                    body=body, headers={"Content-Type": PROM_CONTENT_TYPE})
+                body = await loop.run_in_executor(
+                    None, self._cluster_scrape, om)
+                body, enc = maybe_gzip(
+                    body, request.headers.get("Accept-Encoding"))
+                headers = {"Content-Type": OPENMETRICS_CONTENT_TYPE
+                           if om else PROM_CONTENT_TYPE}
+                if enc:
+                    headers["Content-Encoding"] = enc
+                return web.Response(body=body, headers=headers)
             if path == "/minio/v2/metrics/node":
                 # Node-scope scrape: this process's planes only (the
                 # reference's cluster/node metrics-v2 split).
@@ -1154,10 +1203,17 @@ class S3Server:
                 self.admin.authorize_http(request, identity,
                                           "admin:Prometheus")
                 loop = asyncio.get_running_loop()
+                om = wants_openmetrics(request.headers.get("Accept"))
                 body = await loop.run_in_executor(
-                    None, collect_node_metrics, self.stats)
-                return web.Response(
-                    body=body, headers={"Content-Type": PROM_CONTENT_TYPE})
+                    None, lambda: collect_node_metrics(
+                        self.stats, openmetrics=om))
+                body, enc = maybe_gzip(
+                    body, request.headers.get("Accept-Encoding"))
+                headers = {"Content-Type": OPENMETRICS_CONTENT_TYPE
+                           if om else PROM_CONTENT_TYPE}
+                if enc:
+                    headers["Content-Encoding"] = enc
+                return web.Response(body=body, headers=headers)
             raise S3Error("MethodNotAllowed", resource=path)
 
         parts = path.lstrip("/").split("/", 1)
@@ -3002,6 +3058,13 @@ def build_server(drive_paths: list[str], access_key: str, secret_key: str,
         get_logger().warning(w)
 
     drives = [LocalDrive(p) for p in drive_paths]
+    # Calibration profile on drive 0 (docs/SLO.md): write-or-compare
+    # the host fingerprint + tuned gates; a mismatch raises
+    # minio_tpu_calibration_stale instead of silently serving gates
+    # tuned for other hardware.
+    from minio_tpu.obs import calibration as _calibration
+
+    _calibration.boot(drive_paths[0])
     sets = ErasureSets(drives, set_drive_count=set_drive_count, parity=parity,
                        enable_mrf=enable_mrf)
     layer = ErasureServerPools([sets])

@@ -76,9 +76,12 @@ EXCLUDED_SITE_FILES = (
 # "mtpu-hottier": the process-global hot tier's admit thread
 # (minio_tpu/hottier/tier.py) — session-lived like the dataplane's;
 # test-local tiers close() it and never leak.
+# "mtpu-slo": the process-global SLO plane's sampler thread
+# (obs/tsdb.py "mtpu-slo-sampler") — session-lived; tests tear it down
+# via obs.slo.reset().
 ALLOWED_THREAD_PREFIXES = ("mtpu-io", "shard-read", "dsync", "asyncio_",
                            "mtpu-dataplane", "mtpu-metaplane",
-                           "mtpu-frontdoor", "mtpu-hottier")
+                           "mtpu-frontdoor", "mtpu-hottier", "mtpu-slo")
 
 _REAL_LOCK = threading.Lock
 _REAL_RLOCK = threading.RLock

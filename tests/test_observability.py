@@ -619,6 +619,60 @@ def test_flight_disarmed_zero_overhead(server, client):
         flight.set_armed(was)
 
 
+def test_exemplar_disarmed_zero_overhead(server, client):
+    """Third leg of the zero-overhead contract (docs/SLO.md): with
+    exemplar capture disarmed, request traffic must not capture (or
+    even count toward) a single exemplar."""
+    from minio_tpu import obs
+
+    obs.set_exemplars(False)
+    try:
+        before = obs.exemplar_captures()
+        assert client.put("/obsbkt/noex",
+                          data=b"e" * (64 << 10)).status_code == 200
+        assert client.get("/obsbkt/noex").status_code == 200
+        assert obs.exemplar_captures() == before, \
+            "exemplar captured while disarmed"
+    finally:
+        obs.set_exemplars(True, every=8)
+
+
+def test_exposition_never_tears_under_mutation(client, traffic):
+    """A scrape concurrent with registry writes (new label children
+    materializing mid-render) must still produce a strictly parseable
+    exposition: one HELP/TYPE head per family, no truncated lines."""
+    from minio_tpu import obs
+
+    # Deliberately outside the minio_tpu_ namespace: scratch families
+    # must not enter the docs-drift contract.
+    h = obs.histogram("obs_mutation_scratch_seconds",
+                      "scrape-vs-mutation scratch family", ("k",))
+    c = obs.counter("obs_mutation_scratch_total",
+                    "scrape-vs-mutation scratch counter", ("k",))
+    stop = threading.Event()
+
+    def mutate():
+        i = 0
+        while not stop.is_set():
+            h.labels(k=f"m{i % 97}").observe(0.001 * (i % 13))
+            c.labels(k=f"m{i % 89}").inc()
+            i += 1
+
+    threads = [threading.Thread(target=mutate, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(8):
+            text = _scrape(client, "/minio/v2/metrics/node").text
+            families, samples = parse_exposition(text)  # strict: raises
+            assert "obs_mutation_scratch_seconds" in families
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(5.0)
+
+
 def test_trace_plane_filter_batch_records(server, client, traffic,
                                           monkeypatch):
     """?plane=dataplane keeps only dataplane-stamped records; the

@@ -1,0 +1,493 @@
+"""Cluster SLO plane gates (docs/SLO.md).
+
+Four surfaces under test:
+- burn-rate math + window trimming over the on-node metric ring
+  (obs/tsdb.py + obs/slo.py), including the counter-reset clamp and
+  coarse-tier persistence through a sys-config store;
+- the chaos-injected breach path: a drive-delay storm makes PutObject
+  latency blow its objective, the breach gauge flips within one fast
+  window, and the OpenMetrics exemplar captured during the storm
+  resolves through GET /minio/admin/v3/perf/timeline?traceid=;
+- federation degradation: a hung or dead peer bounds the /slo fan-out
+  and lands in minio_tpu_peer_scrape_errors_total instead of stalling;
+- content negotiation: OpenMetrics + gzip on the scrape endpoints, and
+  per-host calibration profiles flipping minio_tpu_calibration_stale.
+"""
+
+import gzip as gzip_mod
+import json
+import os
+import re
+import socket
+import threading
+import time
+
+import pytest
+from aiohttp import web
+
+from tests.s3client import SigV4Client
+
+ACCESS, SECRET = "sloadmin", "slosecret123"
+
+# Env pinned for the module's server: chaos-wrappable drives, tiny burn
+# windows, and a sampler cadence long enough that every snapshot in the
+# tests below is an explicit sample_now() (deterministic windows).
+_ENV = {
+    "MTPU_CHAOS_DRIVE_WRAP": "1",
+    "MTPU_SLO_SAMPLE_S": "3600",
+    "MTPU_SLO_FAST_WINDOW_S": "60",
+    "MTPU_SLO_SLOW_WINDOW_S": "120",
+}
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(scope="module")
+def slo_server(tmp_path_factory):
+    import asyncio
+
+    from minio_tpu.chaos import naughty
+    from minio_tpu.obs import slo as slo_mod
+    from minio_tpu.s3.server import build_server
+
+    old = {k: os.environ.get(k) for k in _ENV}
+    os.environ.update(_ENV)
+    # The engine/ring are process singletons built by whichever module's
+    # server came first: rebuild them under THIS module's env.
+    slo_mod.reset()
+    root = tmp_path_factory.mktemp("slo-drives")
+    srv = build_server([str(root / f"d{i}") for i in range(4)], ACCESS,
+                       SECRET)
+    port = _free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def start():
+            runner = web.AppRunner(srv.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(30)
+    naughties = [nd for nd in naughty._registered()
+                 if str(root) in str(nd.inner.endpoint())]
+    assert len(naughties) == 4, "chaos drive wrap did not engage"
+    yield f"http://127.0.0.1:{port}", srv, naughties
+    naughty.clear_all()
+    slo_mod.reset()
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.fixture(scope="module")
+def client(slo_server):
+    return SigV4Client(slo_server[0], ACCESS, SECRET)
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math (pure units)
+# ---------------------------------------------------------------------------
+
+_LAT = "minio_tpu_s3_requests_latency_seconds_bucket"
+
+
+def _k(name, **labels):
+    return (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+
+
+def test_latency_burn_good_bad_split():
+    """good = cumulative count at the smallest bound >= threshold_s; the
+    put objective (threshold 1.0, target 0.99) burns (bad/total)/0.01."""
+    from minio_tpu.obs.slo import SLO_OBJECTIVES, SLOEngine
+
+    win = {_k(_LAT, api="PutObject", le="0.5"): 90.0,
+           _k(_LAT, api="PutObject", le="1"): 95.0,
+           _k(_LAT, api="PutObject", le="+Inf"): 100.0,
+           # another API must not leak into the match
+           _k(_LAT, api="GetObject", le="+Inf"): 50.0}
+    burn, per = SLOEngine._latency_burn(
+        SLO_OBJECTIVES["put_latency_p99"], win)
+    assert burn == pytest.approx(5.0)       # 5 bad / 100 / 0.01 budget
+    assert per["_"]["total"] == 100.0 and per["_"]["bad"] == 5.0
+
+
+def test_latency_burn_grouped_worst_tenant_wins():
+    from minio_tpu.obs.slo import SLO_OBJECTIVES, SLOEngine
+
+    fam = "minio_tpu_tenant_request_seconds_bucket"
+    win = {_k(fam, tenant="calm", le="1"): 100.0,
+           _k(fam, tenant="calm", le="+Inf"): 100.0,      # 0% bad
+           _k(fam, tenant="noisy", le="1"): 50.0,
+           _k(fam, tenant="noisy", le="+Inf"): 100.0}     # 50% bad
+    burn, per = SLOEngine._latency_burn(
+        SLO_OBJECTIVES["tenant_latency_p99"], win)
+    assert burn == pytest.approx(50.0)
+    assert per["noisy"]["burn"] == pytest.approx(50.0)
+    assert per["calm"]["burn"] == 0.0
+
+
+def test_error_ratio_burn():
+    from minio_tpu.obs.slo import SLO_OBJECTIVES, SLOEngine
+
+    win = {_k("minio_tpu_s3_requests_total", api="PutObject"): 600.0,
+           _k("minio_tpu_s3_requests_total", api="GetObject"): 400.0,
+           _k("minio_tpu_s3_requests_5xx_errors_total",
+              api="PutObject"): 2.0}
+    burn, _per = SLOEngine._error_burn(
+        SLO_OBJECTIVES["s3_error_ratio"], win)
+    assert burn == pytest.approx(2.0)       # 0.2% bad / 0.1% budget
+
+
+def test_merge_states_worst_burn_and_breach_any():
+    from minio_tpu.obs.slo import merge_states
+
+    def st(worker, burn, breach):
+        return {"time": 1.0 + worker, "worker": worker,
+                "slos": {"put_latency_p99": {
+                    "breach": breach, "target": 0.99, "kind": "latency",
+                    "windows": {"fast": {"burn": burn, "window_s": 60,
+                                         "groups": {}}}}}}
+
+    merged = merge_states([st(0, 2.0, False), st(1, 30.0, True)])
+    assert merged["workers"] == [0, 1]
+    s = merged["slos"]["put_latency_p99"]
+    assert s["breach"] is True
+    assert s["windows"]["fast"]["burn"] == pytest.approx(30.0)
+
+
+# ---------------------------------------------------------------------------
+# ring windows, reset clamp, persistence
+# ---------------------------------------------------------------------------
+
+class _FakeStore:
+    def __init__(self):
+        self.blobs: dict[str, bytes] = {}
+
+    def read_sys_config(self, key: str) -> bytes:
+        return self.blobs[key]
+
+    def write_sys_config(self, key: str, blob: bytes) -> None:
+        self.blobs[key] = bytes(blob)
+
+
+def _counter_source(state):
+    def src():
+        return [("test_slo_ring_total", {"api": "x"}, state["v"])]
+    return src
+
+
+def test_ring_delta_window_and_reset_clamp():
+    from minio_tpu.obs.tsdb import TSDB
+
+    db = TSDB(families=("test_slo_ring_total",), sample_s=3600,
+              persist_s=10**9)
+    state = {"v": 100.0}
+    db.add_source(_counter_source(state), key="t")
+    db.sample_now()
+    state["v"] = 140.0
+    db.sample_now()
+    span, win = db.delta_window(60)
+    assert span > 0
+    assert win[_k("test_slo_ring_total", api="x")] == pytest.approx(40.0)
+    # A counter RESET (restart) must clamp to 0, not go negative.
+    state["v"] = 3.0
+    db.sample_now()
+    _span, win = db.delta_window(60)
+    assert win[_k("test_slo_ring_total", api="x")] == 0.0
+    # chaos invariants consume the same window shape straight off the ring
+    from minio_tpu.chaos.invariants import window_from_ring
+
+    assert window_from_ring(db, 60) == win
+
+
+def test_ring_persist_restore_roundtrip():
+    from minio_tpu.obs.tsdb import TSDB
+
+    store = _FakeStore()
+    db = TSDB(families=("test_slo_ring_total",), sample_s=3600,
+              persist_s=10**9)
+    db.attach_store(store, "slo/history.json.gz")   # cold start: no blob
+    state = {"v": 7.0}
+    db.add_source(_counter_source(state), key="t")
+    db.sample_now()
+    state["v"] = 9.0
+    db.sample_now()
+    db.persist()
+    blob = store.blobs["slo/history.json.gz"]
+    doc = json.loads(gzip_mod.decompress(blob).decode())
+    assert doc["v"] == 1 and len(doc["coarse"]) == 2
+
+    db2 = TSDB(families=("test_slo_ring_total",), sample_s=3600,
+               persist_s=10**9)
+    db2.attach_store(store, "slo/history.json.gz")
+    hist = db2.history()
+    assert len(hist) == 2
+    assert hist[-1]["samples"] == [
+        ["test_slo_ring_total", [["api", "x"]], 9.0]]
+    # History restored from a predecessor seeds the window base: the
+    # successor's first fresh sample immediately yields a delta.
+    state["v"] = 15.0
+    db2.add_source(_counter_source(state), key="t")
+    db2.sample_now()
+    _span, win = db2.delta_window(3600)
+    assert win[_k("test_slo_ring_total", api="x")] == pytest.approx(8.0)
+
+
+def test_history_endpoint_prefix_filter(slo_server, client):
+    from minio_tpu.obs import tsdb
+
+    assert client.put("/histbkt").status_code == 200
+    assert client.put("/histbkt/a", data=b"h" * 512).status_code == 200
+    tsdb.get().sample_now()
+    r = client.get("/minio/admin/v3/slo/history",
+                   query={"prefix": "minio_tpu_s3_requests_total"})
+    assert r.status_code == 200, r.text
+    doc = r.json()
+    assert doc["history"], "ring empty after sample_now"
+    names = {s[0] for ent in doc["history"] for s in ent["samples"]}
+    assert names == {"minio_tpu_s3_requests_total"}, names
+
+
+# ---------------------------------------------------------------------------
+# chaos-injected breach + exemplar resolution (the acceptance path)
+# ---------------------------------------------------------------------------
+
+_EXEMPLAR_RE = re.compile(
+    r'^minio_tpu_s3_requests_latency_seconds_bucket\{[^}]*api="PutObject"'
+    r'[^}]*\} \S+ # \{trace_id="([0-9A-Za-z]+)"\}', re.M)
+
+
+def test_chaos_drive_storm_breaches_put_slo_and_exemplar_resolves(
+        slo_server, client):
+    from minio_tpu import obs
+    from minio_tpu.obs import slo as slo_mod
+    from minio_tpu.obs import tsdb
+
+    _base, _srv, naughties = slo_server
+    eng = slo_mod.engine()
+    assert eng is not None, "SLO engine not started by build_server"
+    assert eng.fast_s == 60.0 and eng.slow_s == 120.0
+
+    assert client.put("/slobkt").status_code == 200
+    obs.set_exemplars(True, every=1)
+    try:
+        tsdb.get().sample_now()       # window base (fires evaluate)
+        for nd in naughties:
+            nd.per_method_delay.update(
+                {"create_file": 1.3, "write_all": 1.3})
+        t0 = time.monotonic()
+        for i in range(4):
+            r = client.put(f"/slobkt/slow-{i}", data=b"s" * (1 << 20))
+            assert r.status_code == 200, r.text
+        assert time.monotonic() - t0 > 1.0, \
+            "drive delays did not slow the PUTs; storm ineffective"
+    finally:
+        for nd in naughties:
+            nd.clear_faults()
+        obs.set_exemplars(True, every=8)
+    # The evaluation listener fires inside this sample_now.
+    tsdb.get().sample_now()
+    state = eng.state()
+    put = state["slos"]["put_latency_p99"]
+    assert put["windows"]["fast"]["burn"] >= eng.threshold, put
+    assert put["breach"] is True, put
+    # 5xx never happened: the error-ratio objective must NOT page.
+    assert state["slos"]["s3_error_ratio"]["breach"] is False
+
+    # Breach gauge is on the ordinary scrape...
+    r = client.get("/minio/v2/metrics/node")
+    assert r.status_code == 200
+    assert 'minio_tpu_slo_breach{slo="put_latency_p99"} 1.0' in r.text
+    # ...and the federated admin answer agrees.
+    r = client.get("/minio/admin/v3/slo")
+    assert r.status_code == 200, r.text
+    doc = r.json()
+    assert not doc["errors"]
+    (_node, st), = doc["nodes"].items()
+    assert st["slos"]["put_latency_p99"]["breach"] is True
+
+    # OpenMetrics scrape carries an exemplar from the storm; its
+    # trace_id deep-links to the flight recorder timeline.
+    r = client.get("/minio/v2/metrics/node",
+                   headers={"Accept": "application/openmetrics-text"})
+    assert r.status_code == 200
+    assert r.headers["Content-Type"].startswith(
+        "application/openmetrics-text")
+    assert r.text.rstrip().endswith("# EOF")
+    m = _EXEMPLAR_RE.search(r.text)
+    assert m, "no PutObject exemplar in OpenMetrics exposition"
+    tid = m.group(1)
+    r = client.get("/minio/admin/v3/perf/timeline",
+                   query={"traceid": tid, "all": "false"})
+    assert r.status_code == 200, r.text
+    snaps = r.json()["timelines"]
+    assert snaps and snaps[0]["trace_id"] == tid
+    assert snaps[0]["api"] == "PutObject"
+
+
+def test_breach_clears_after_recovery(slo_server, client):
+    """Fast-window burn decays once healthy traffic refills the window:
+    the NEXT evaluation over a window whose deltas are all-good drops
+    the breach gauge (the ring keeps the storm in the slow tier)."""
+    from minio_tpu.obs import slo as slo_mod
+    from minio_tpu.obs import tsdb
+
+    eng = slo_mod.engine()
+    db = tsdb.get()
+    # Refill: fast PUTs only, then re-evaluate over a fresh base whose
+    # delta excludes the storm (base = the post-storm snapshot).
+    for i in range(3):
+        assert client.put(f"/slobkt/ok-{i}",
+                          data=b"k" * 4096).status_code == 200
+    time.sleep(0.05)
+    db.sample_now()
+    # Shrink the windows to just the healthy tail for this check.
+    old_fast, old_slow = eng.fast_s, eng.slow_s
+    eng.fast_s = eng.slow_s = 0.01
+    try:
+        state = eng.evaluate()
+    finally:
+        eng.fast_s, eng.slow_s = old_fast, old_slow
+    put = state["slos"]["put_latency_p99"]
+    assert put["breach"] is False, put
+    r = client.get("/minio/v2/metrics/node")
+    assert 'minio_tpu_slo_breach{slo="put_latency_p99"} 0.0' in r.text
+
+
+# ---------------------------------------------------------------------------
+# degraded federation
+# ---------------------------------------------------------------------------
+
+class _DeadPeer:
+    name = "peer-dead"
+
+    def slo(self):
+        raise ConnectionError("connection refused")
+
+
+class _HungPeer:
+    name = "peer-hung"
+
+    def slo(self):
+        time.sleep(3.0)
+        return {}
+
+
+class _FakeNotification:
+    def __init__(self, peers):
+        self.peers = peers
+
+
+def test_slo_fanout_bounded_by_dead_and_hung_peers(slo_server):
+    from minio_tpu.admin.metrics import (_PEER_SCRAPE_ERRORS,
+                                         collect_cluster_slo)
+
+    dead0 = _PEER_SCRAPE_ERRORS.labels(peer="peer-dead").value
+    hung0 = _PEER_SCRAPE_ERRORS.labels(peer="peer-hung").value
+    notif = _FakeNotification([_DeadPeer(), _HungPeer()])
+    t0 = time.monotonic()
+    out = collect_cluster_slo(notif, "local", deadline=0.5)
+    wall = time.monotonic() - t0
+    assert wall < 2.5, f"hung peer stalled the fan-out for {wall:.1f}s"
+    assert sorted(out["errors"]) == ["peer-dead", "peer-hung"]
+    assert "local" in out["nodes"]
+    assert "peer-dead" not in out["nodes"]
+    assert _PEER_SCRAPE_ERRORS.labels(peer="peer-dead").value == dead0 + 1
+    assert _PEER_SCRAPE_ERRORS.labels(peer="peer-hung").value == hung0 + 1
+
+
+# ---------------------------------------------------------------------------
+# gzip negotiation + calibration profiles
+# ---------------------------------------------------------------------------
+
+def test_maybe_gzip_size_delta_and_small_body_passthrough():
+    from minio_tpu.admin.metrics import maybe_gzip
+
+    body = ("minio_tpu_s3_requests_total{api=\"GetObject\"} 1\n"
+            * 200).encode()
+    out, enc = maybe_gzip(body, "gzip, deflate")
+    assert enc == "gzip"
+    assert len(out) < len(body) / 4, (len(out), len(body))
+    assert gzip_mod.decompress(out) == body
+    # No Accept-Encoding -> identity; tiny bodies -> identity.
+    assert maybe_gzip(body, None) == (body, None)
+    assert maybe_gzip(b"tiny", "gzip") == (b"tiny", None)
+
+
+def test_scrape_endpoints_gzip_when_negotiated(slo_server, client):
+    r = client.get("/minio/v2/metrics/node",
+                   headers={"Accept-Encoding": "gzip"})
+    assert r.status_code == 200
+    assert r.headers.get("Content-Encoding") == "gzip"
+    assert "minio_tpu_process_uptime_seconds" in r.text  # decodes clean
+    r = client.get("/minio/admin/v3/slo",
+                   headers={"Accept-Encoding": "gzip"})
+    assert r.status_code == 200
+    assert r.headers.get("Content-Encoding") == "gzip"
+    assert "slos" in r.text
+    # Without negotiation the bytes are identity-encoded.
+    r = client.request("GET", "/minio/v2/metrics/node",
+                       headers={"Accept-Encoding": "identity"})
+    assert r.headers.get("Content-Encoding") is None
+
+
+def test_calibration_profile_boot_and_staleness(tmp_path):
+    from minio_tpu.obs import calibration
+
+    d0 = tmp_path / "drive0"
+    d0.mkdir()
+    first = calibration.boot(str(d0))
+    assert first["stale"] == []
+    prof_path = d0 / ".mtpu.sys" / "calibration.json"
+    assert prof_path.exists()
+    again = calibration.boot(str(d0))
+    assert again["stale"] == []
+
+    # The host changed under the profile: cores recorded differently.
+    doc = json.loads(prof_path.read_text())
+    doc["fingerprint"]["cores"] = doc["fingerprint"]["cores"] + 64
+    doc["fingerprint"]["fsync_medium"] = "carrier-pigeon"
+    prof_path.write_text(json.dumps(doc))
+    stale = calibration.boot(str(d0))
+    assert set(stale["stale"]) == {"cores", "fsync_medium"}
+    # The stale gauge is process-global: park it back at 0 (a matching
+    # profile) so scrape-level tests see the server's own boot verdict.
+    d1 = tmp_path / "drive1"
+    d1.mkdir()
+    calibration.boot(str(d1))
+    assert calibration.boot(str(d1))["stale"] == []
+
+
+def test_calibration_and_build_info_on_scrape(slo_server, client):
+    r = client.get("/minio/v2/metrics/node")
+    assert "minio_tpu_calibration_stale 0.0" in r.text
+    m = re.search(r'minio_tpu_build_info\{([^}]*)\} 1\.0', r.text)
+    assert m, "build info gauge missing"
+    assert "version=" in m.group(1) and "platform=" in m.group(1)
+
+
+def test_bench_stamps_calibration_fingerprint():
+    from minio_tpu.obs import calibration
+
+    fp = calibration.fingerprint()
+    assert {"cores", "page_size", "platform", "devices"} <= set(fp)
+    prof = calibration.profile()
+    assert set(prof) >= {"fingerprint", "gates", "time"}

@@ -115,6 +115,16 @@ KNOB_DOCS: dict[str, tuple[str, str]] = {
         "",
         "On-disk spool directory for bucket-notification events "
         "(survives target outages; per-pid temp dir by default)."),
+    "MTPU_EXEMPLAR": (
+        "SLO.md",
+        "`0`/`false`/`off` disarms OpenMetrics exemplar capture; armed "
+        "(default) latency histograms sample the active trace id so "
+        "scrapes can deep-link a slow bucket to its flight-recorder "
+        "timeline."),
+    "MTPU_EXEMPLAR_EVERY": (
+        "SLO.md",
+        "Exemplar sampling stride: capture the trace id on every Nth "
+        "traced observation per histogram child (default 8)."),
     "MTPU_FAULT_INJECTION": (
         "CHAOS.md",
         "`1` opts this PROCESS into the admin faultplane handlers — "
@@ -363,6 +373,55 @@ KNOB_DOCS: dict[str, tuple[str, str]] = {
     "MTPU_ROOT_USER": (
         "",
         "Root (admin) access key."),
+    "MTPU_SLO": (
+        "SLO.md",
+        "`0`/`false`/`off` disarms the on-node SLO plane (metric "
+        "history ring + burn-rate evaluation); armed is the default."),
+    "MTPU_SLO_BURN_THRESHOLD": (
+        "SLO.md",
+        "Burn-rate multiple that counts as a breach when BOTH windows "
+        "exceed it (default 14.4 — the classic 2%-of-monthly-budget-"
+        "in-an-hour page)."),
+    "MTPU_SLO_COARSE_WINDOW_S": (
+        "SLO.md",
+        "Retention (seconds) of the 1-minute downsampled tier of the "
+        "on-node metric history ring (default 86400)."),
+    "MTPU_SLO_FAMILIES": (
+        "SLO.md",
+        "Comma-separated metric-family allowlist the SLO sampler "
+        "snapshots each tick; empty = the built-in serving-path set."),
+    "MTPU_SLO_FAST_WINDOW_S": (
+        "SLO.md",
+        "Fast burn-rate window (seconds, default 300): catches "
+        "budget-torching incidents within minutes."),
+    "MTPU_SLO_PERSIST_S": (
+        "SLO.md",
+        "Cadence (seconds, default 60) at which the coarse history "
+        "tier is persisted through the sys-store blob lane so burn "
+        "context survives a restart."),
+    "MTPU_SLO_PERSIST_SAMPLES": (
+        "SLO.md",
+        "Cap on persisted coarse-tier entries (default 120) so the "
+        "sys-store snapshot stays bounded."),
+    "MTPU_SLO_RAW_WINDOW_S": (
+        "SLO.md",
+        "Retention (seconds) of the full-resolution tier of the "
+        "on-node metric history ring (default 3900 — one slow window "
+        "plus slack)."),
+    "MTPU_SLO_SAMPLE_S": (
+        "SLO.md",
+        "SLO sampler cadence (seconds, default 5): how often the "
+        "history ring snapshots the selected metric families."),
+    "MTPU_SLO_SLOW_WINDOW_S": (
+        "SLO.md",
+        "Slow burn-rate window (seconds, default 3600): confirms the "
+        "fast window is a sustained burn, not a blip."),
+    "MTPU_SLO_SPOOL": (
+        "SLO.md",
+        "SLO state-spool shm base name, stamped into workers by the "
+        "front-door supervisor; worker i publishes its burn state "
+        "into `<base>slo<i>` so any worker can answer `/slo` for the "
+        "pool."),
     "MTPU_USE_PALLAS": (
         "",
         "Force (`1`) or forbid (`0`) the Pallas TPU RS kernels on the "

@@ -12,7 +12,12 @@ rule promotes it to static coverage of the whole tree:
   ...})` dict literals, `obs.span(..., typ)` call sites) must be in the
   `RECORD_TYPES` registry in minio_tpu/obs/span.py — consumers (the
   admin trace stream's `?type=` filter, docs/TRACING.md) key on that
-  closed set.
+  closed set;
+- every SLO objective name (`SLO_OBJECTIVES` keys, minio_tpu/obs/
+  slo.py) and every exemplar label (`EXEMPLAR_LABELS`, minio_tpu/obs/
+  histogram.py) must appear in docs/SLO.md — the alerting surface and
+  the exemplar record type are operator contracts, documented before
+  they ship.
 """
 
 from __future__ import annotations
@@ -62,6 +67,33 @@ def _registered_types(root: Path) -> set[str] | None:
                             else node.value))
                     except (ValueError, IndexError):
                         return None
+    return None
+
+
+def _literal_assign(path: Path, name: str):
+    """literal_eval the module-level assignment `name = <literal>` in
+    `path`, returning (value, source_line, line_no); None when the file
+    or assignment is absent or not a pure literal."""
+    if not path.exists():
+        return None
+    try:
+        src = path.read_text()
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    lines = src.splitlines()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    try:
+                        value = ast.literal_eval(node.value)
+                    except ValueError:
+                        return None
+                    line_no = getattr(node, "lineno", 1)
+                    text = (lines[line_no - 1].strip()
+                            if 0 < line_no <= len(lines) else "")
+                    return value, text, line_no
     return None
 
 
@@ -142,3 +174,31 @@ class ObsDriftRule(Rule):
             for finding, typ in self._types:
                 if typ not in registry:
                     yield finding
+        yield from self._slo_doc_drift(root)
+
+    def _slo_doc_drift(self, root: Path) -> Iterable[Finding]:
+        """Objective names and exemplar labels missing from docs/SLO.md."""
+        slo_doc = root / "docs" / "SLO.md"
+        doc_text = slo_doc.read_text() if slo_doc.exists() else ""
+
+        objectives = _literal_assign(
+            root / "minio_tpu" / "obs" / "slo.py", "SLO_OBJECTIVES")
+        if objectives is not None:
+            value, text, line = objectives
+            for name in value:
+                if name not in doc_text:
+                    yield Finding(
+                        self.id, "minio_tpu/obs/slo.py", line, 0,
+                        f"SLO objective '{name}' is not documented in "
+                        "docs/SLO.md", text)
+
+        labels = _literal_assign(
+            root / "minio_tpu" / "obs" / "histogram.py", "EXEMPLAR_LABELS")
+        if labels is not None:
+            value, text, line = labels
+            for name in value:
+                if name not in doc_text:
+                    yield Finding(
+                        self.id, "minio_tpu/obs/histogram.py", line, 0,
+                        f"exemplar label '{name}' is not documented in "
+                        "docs/SLO.md", text)
